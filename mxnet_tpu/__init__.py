@@ -1,0 +1,48 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capabilities
+of the Apache-MXNet-1.x lineage reference (`fegin/mxnet`).
+
+Imperative NDArray with device contexts (`mx.tpu()`), tape autograd, Gluon
+Block/HybridBlock/Trainer with hybridize()->XLA jit, Module compat, a
+KVStore lowered to XLA collectives over ICI/DCN, data pipeline, optimizers,
+metrics, model zoo.  See SURVEY.md at the repo root for the full layer map.
+
+Conventional import:  import mxnet_tpu as mx
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray, waitall
+from . import autograd
+from . import random
+from . import profiler
+from . import serialization
+
+__all__ = [
+    "MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",
+    "num_gpus", "num_tpus", "nd", "ndarray", "NDArray", "waitall",
+    "autograd", "random", "profiler",
+]
+
+
+def __getattr__(name):
+    # Subsystems that import lazily to keep `import mxnet_tpu` light and to
+    # tolerate partial builds during bring-up.
+    import importlib
+
+    lazy = {"gluon", "optimizer", "initializer", "metric", "kvstore",
+            "lr_scheduler", "io", "image", "symbol", "module", "parallel",
+            "callback", "model", "test_utils", "engine", "runtime",
+            "visualization", "recordio", "contrib"}
+    if name in lazy:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

@@ -1,0 +1,359 @@
+"""Tape-based autograd over the op registry.
+
+TPU-native counterpart of the reference's imperative autograd
+(ref: src/imperative/imperative.cc Imperative::RecordOp / Imperative::Backward;
+python/mxnet/autograd.py record()/pause()/backward()/grad()).
+
+Design: under ``record()``, every differentiable op appends a tape node
+holding (op, attrs, input jax values, parent links).  ``backward`` walks the
+tape in reverse topological order and computes input cotangents through a
+jit-cached ``jax.vjp`` of the op's pure function (see ops/registry.grad_fn)
+— the XLA analogue of the reference's nnvm Gradient pass + RunGraph, except
+each node's backward is a cached compiled executable and XLA DCE removes
+unused forward recomputation inside the vjp.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = [
+    "record", "pause", "train_mode", "predict_mode",
+    "is_recording", "is_training", "backward", "grad", "get_symbol",
+    "mark_variables", "Function",
+]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _State()
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+class _RecordingScope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec = recording
+        self._train = training
+        self._prev: Tuple[bool, bool] = (False, False)
+
+    def __enter__(self):
+        self._prev = (_STATE.recording, _STATE.training)
+        if self._rec is not None:
+            _STATE.recording = self._rec
+        if self._train is not None:
+            _STATE.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.recording, _STATE.training = self._prev
+        return False
+
+
+def record(train_mode: bool = True):
+    """Scope in which ops are recorded on the tape (and train-mode is on)."""
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(None, True)
+
+
+def predict_mode():
+    return _RecordingScope(None, False)
+
+
+# --------------------------------------------------------------------------
+# Tape
+# --------------------------------------------------------------------------
+
+class TapeNode:
+    """One recorded op application."""
+
+    __slots__ = ("op", "attrs_key", "in_values", "parents", "n_out",
+                 "out_index_of", "custom_backward")
+
+    def __init__(self, op, attrs_key, in_values, parents, n_out,
+                 custom_backward=None):
+        self.op = op                      # Operator (or None for Function)
+        self.attrs_key = attrs_key
+        self.in_values = in_values        # list of jax arrays (primals)
+        # parents[i] = (TapeNode|None leaf_marker, NDArray) for input i
+        self.parents = parents
+        self.n_out = n_out
+        self.custom_backward = custom_backward
+
+
+def _node_of(x) -> Optional[Tuple[TapeNode, int]]:
+    return getattr(x, "_ag_node", None)
+
+
+def _requires_grad(x) -> bool:
+    return getattr(x, "_ag_grad_req", "null") != "null" or _node_of(x) is not None
+
+
+def record_op(op, attrs_key, nd_inputs, in_values, results):
+    """Called by ops.registry.invoke when recording. Links outputs to a node."""
+    if not any(_requires_grad(x) for x in nd_inputs if hasattr(x, "_ag_grad_req")):
+        # No tracked input anywhere upstream: nothing to record.
+        if not any(_node_of(x) for x in nd_inputs if hasattr(x, "shape")):
+            return
+    outs = results if isinstance(results, (list, tuple)) else (results,)
+    parents = []
+    for x in nd_inputs:
+        if hasattr(x, "_ag_grad_req"):
+            parents.append((_node_of(x), x))
+        else:
+            parents.append((None, None))
+    node = TapeNode(op, attrs_key, list(in_values), parents, len(outs))
+    for i, o in enumerate(outs):
+        o._ag_node = (node, i)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """ref: autograd.mark_variables — attach externally-allocated grads."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._ag_grad_req = req
+        v._ag_grad = g
+
+
+# --------------------------------------------------------------------------
+# Backward pass
+# --------------------------------------------------------------------------
+
+def _toposort(roots: List[TapeNode]) -> List[TapeNode]:
+    """Iterative post-order (graphs can be deeper than the recursion limit,
+    e.g. long unrolled RNNs)."""
+    order: List[TapeNode] = []
+    seen = set()
+    stack: List[Tuple[TapeNode, bool]] = [(r, False) for r in roots]
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for pn, _ in node.parents:
+            if pn is not None and id(pn[0]) not in seen:
+                stack.append((pn[0], False))
+    return order
+
+
+def backward(outputs, out_grads=None, retain_graph: bool = False,
+             train_mode: bool = True):
+    """Compute gradients of `outputs` wrt all tracked leaves.
+
+    ref: python/mxnet/autograd.py::backward → MXAutogradBackwardEx →
+    Imperative::Backward.  Grad accumulation respects each leaf's grad_req
+    ('write' | 'add' | 'null').
+    """
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(outputs, NDArray):
+        outputs = [outputs]
+    if out_grads is None:
+        out_grads = [None] * len(outputs)
+    elif isinstance(out_grads, NDArray):
+        out_grads = [out_grads]
+
+    # cotangents keyed by (id(node), out_index); `written` tracks leaves
+    # already written THIS pass so grad_req='write' overwrites across
+    # passes but accumulates across paths within one pass.
+    cts: Dict[Tuple[int, int], Any] = {}
+    written: set = set()
+    roots = []
+    for o, og in zip(outputs, out_grads):
+        ni = _node_of(o)
+        if ni is None:
+            if getattr(o, "_ag_grad_req", "null") != "null":
+                # output IS a leaf: d out/d out = head grad
+                head = og.data if og is not None else jnp.ones(o.shape, o.data.dtype)
+                _accumulate_leaf(o, head, written)
+            continue
+        node, idx = ni
+        head = og.data if og is not None else jnp.ones(o.shape, o.data.dtype)
+        key = (id(node), idx)
+        cts[key] = cts[key] + head if key in cts else head
+        roots.append(node)
+
+    if not roots:
+        return
+
+    order = _toposort(roots)
+    from .ops import registry as _reg
+
+    for node in reversed(order):
+        node_cts = [cts.pop((id(node), i), None) for i in range(node.n_out)]
+        if all(c is None for c in node_cts):
+            continue
+        # fill missing output cotangents with zeros (vjp needs full pytree)
+        if node.custom_backward is not None:
+            in_grads = node.custom_backward(node_cts)
+            argpos = list(range(len(node.parents)))
+        else:
+            argpos = [i for i, (pn, leaf) in enumerate(node.parents)
+                      if pn is not None or (leaf is not None and
+                                            getattr(leaf, "_ag_grad_req", "null") != "null")]
+            argpos = [i for i in argpos
+                      if jnp.issubdtype(jnp.asarray(node.in_values[i]).dtype, jnp.inexact)]
+            if not argpos:
+                continue
+            if any(c is None for c in node_cts):
+                # fill missing output cotangents with zeros (vjp needs the
+                # full output pytree); eval_shape only on this rare path
+                out_shapes = jax.eval_shape(
+                    lambda *xs: node.op.fn(*xs, **_reg.thaw_attrs(node.attrs_key)),
+                    *node.in_values)
+                flat_shapes = (out_shapes if isinstance(out_shapes, (list, tuple))
+                               else (out_shapes,))
+                node_cts = [c if c is not None else jnp.zeros(s.shape, s.dtype)
+                            for c, s in zip(node_cts, flat_shapes)]
+            ct_arg = tuple(node_cts) if node.n_out > 1 else node_cts[0]
+            gfn = _reg.grad_fn(node.op, node.attrs_key, tuple(argpos))
+            in_grads_sel = gfn(node.in_values, ct_arg)
+            in_grads = [None] * len(node.parents)
+            for i, g in zip(argpos, in_grads_sel):
+                in_grads[i] = g
+
+        for (pn, leaf), g in zip(node.parents, in_grads):
+            if g is None:
+                continue
+            if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0:
+                continue
+            if pn is not None:
+                pnode, pidx = pn
+                key = (id(pnode), pidx)
+                cts[key] = cts[key] + g if key in cts else g
+            elif leaf is not None and getattr(leaf, "_ag_grad_req", "null") != "null":
+                _accumulate_leaf(leaf, g, written)
+
+    if not retain_graph:
+        for o in outputs:
+            if _node_of(o) is not None:
+                o._ag_node = None
+
+
+def _accumulate_leaf(leaf, g, written: set):
+    from .ndarray.ndarray import NDArray
+
+    req = getattr(leaf, "_ag_grad_req", "null")
+    if req == "null":
+        return
+    gnd = getattr(leaf, "_ag_grad", None)
+    g = jnp.asarray(g, leaf.data.dtype)
+    if gnd is None:
+        leaf._ag_grad = NDArray(g, ctx=leaf.ctx)
+    elif req == "add" or id(leaf) in written:
+        gnd._data = gnd.data + g
+    else:  # 'write': first touch this pass overwrites, later touches add
+        gnd._data = g
+    written.add(id(leaf))
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """ref: autograd.grad — returns grads instead of accumulating into .grad."""
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    saved = [(getattr(v, "_ag_grad_req", "null"), getattr(v, "_ag_grad", None))
+             for v in variables]
+    for v in variables:
+        v._ag_grad_req = "write"
+        v._ag_grad = None
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph or create_graph),
+                 train_mode=train_mode)
+        out = []
+        for v in variables:
+            if v._ag_grad is None:
+                raise MXNetError("one of the variables does not participate "
+                                 "in the graph of heads")
+            out.append(v._ag_grad)
+    finally:
+        for v, (req, g) in zip(variables, saved):
+            v._ag_grad_req = req
+            v._ag_grad = g
+    return out
+
+
+def get_symbol(x):
+    raise MXNetError("autograd.get_symbol: use HybridBlock tracing instead "
+                     "(symbolic extraction of an imperative tape is not "
+                     "supported in the TPU build)")
+
+
+class Function:
+    """Custom differentiable function (ref: autograd.Function).
+
+    Subclass and implement forward(self, *inputs) and
+    backward(self, *output_grads); call the instance on NDArrays.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = (outputs,) if single else tuple(outputs)
+        if is_recording():
+            parents = [(_node_of(x), x) for x in inputs]
+
+            def custom_backward(node_cts, _self=self, _outs=outs):
+                cts_nd = [NDArray(c) if c is not None else
+                          NDArray(jnp.zeros(o.shape, o.data.dtype))
+                          for c, o in zip(node_cts, _outs)]
+                with pause():
+                    gs = _self.backward(*cts_nd)
+                if not isinstance(gs, (list, tuple)):
+                    gs = (gs,)
+                return [g.data if g is not None else None for g in gs]
+
+            node = TapeNode(None, None, [x.data for x in inputs], parents,
+                            len(outs), custom_backward=custom_backward)
+            for i, o in enumerate(outs):
+                o._ag_node = (node, i)
+        return outputs

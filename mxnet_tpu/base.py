@@ -1,0 +1,125 @@
+"""Foundations: errors, registries, env-var config, dtype tables.
+
+TPU-native counterpart of the reference's dmlc-core surface
+(ref: 3rdparty/dmlc-core — CHECK/LOG, dmlc::GetEnv, Registry<T>) and
+python/mxnet/base.py (error type, handle plumbing).  Here the Python layer
+is the primary frontend, so the "registry" and "env" helpers live natively
+in Python; the C ABI (src/c_api) is used for the native engine/IO modules
+only (see mxnet_tpu/lib.py).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Generic, Optional, TypeVar
+
+import numpy as np
+
+__all__ = [
+    "MXNetError",
+    "get_env",
+    "Registry",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+]
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (ref: python/mxnet/base.py::MXNetError)."""
+
+
+def check(cond: bool, msg: str = "") -> None:
+    """CHECK() analogue (ref: dmlc-core logging.h). Raises MXNetError."""
+    if not cond:
+        raise MXNetError(msg or "check failed")
+
+
+_TRUTHY = {"1", "true", "yes", "on"}
+_FALSY = {"0", "false", "no", "off"}
+
+
+def get_env(name: str, default: Any = None, typ: Optional[type] = None) -> Any:
+    """dmlc::GetEnv analogue — typed env-var lookup.
+
+    Env vars keep MXNET_-compatible names where the knob has a reference
+    equivalent (ref: docs/faq/env_var.md).
+    """
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if typ is None:
+        typ = type(default) if default is not None else str
+    if typ is bool:
+        low = raw.strip().lower()
+        if low in _TRUTHY:
+            return True
+        if low in _FALSY:
+            return False
+        raise MXNetError(f"env var {name}={raw!r} is not a boolean")
+    try:
+        return typ(raw)
+    except ValueError as e:
+        raise MXNetError(f"env var {name}={raw!r} is not a {typ.__name__}") from e
+
+
+T = TypeVar("T")
+
+
+class Registry(Generic[T]):
+    """Typed name->entry registry (ref: dmlc-core registry.h Registry<T>).
+
+    Used for ops, optimizers, initializers, metrics, data iterators —
+    mirroring how the reference registers everything through
+    DMLC_REGISTRY_* macros and lists entries through the C API.
+    """
+
+    def __init__(self, kind: str, lowercase: bool = True):
+        self._kind = kind
+        self._entries: Dict[str, T] = {}
+        self._lock = threading.Lock()
+        self._lowercase = lowercase
+
+    def _key(self, name: str) -> str:
+        return name.lower() if self._lowercase else name
+
+    def register(self, name: Optional[str] = None, allow_override: bool = False):
+        def _do(entry: T, _name=name) -> T:
+            key = self._key(_name if _name is not None else getattr(entry, "__name__"))
+            with self._lock:
+                if key in self._entries and not allow_override:
+                    raise MXNetError(
+                        f"{self._kind} '{key}' is already registered")
+                self._entries[key] = entry
+            return entry
+
+        return _do
+
+    def get(self, name: str) -> T:
+        try:
+            return self._entries[self._key(name)]
+        except KeyError:
+            raise MXNetError(
+                f"unknown {self._kind} '{name}'; registered: "
+                f"{sorted(self._entries)[:50]}") from None
+
+    def __contains__(self, name: str) -> bool:
+        return self._key(name) in self._entries
+
+    def list(self):
+        return sorted(self._entries)
+
+    def items(self):
+        return self._entries.items()
+
+
+def classproperty(fn: Callable):
+    class _CP:
+        def __get__(self, obj, owner):
+            return fn(owner)
+
+    return _CP()

@@ -1,0 +1,48 @@
+"""mxnet_tpu.ndarray (aka mx.nd): NDArray + the generated op namespace.
+
+ref: python/mxnet/ndarray/__init__.py — op functions are synthesized from
+the registry (see register.py); NDArray and creation ops are re-exported.
+"""
+from __future__ import annotations
+
+import jax as _jax
+import numpy as _np
+
+from .ndarray import (NDArray, arange, array, concatenate, empty, from_jax,
+                      full, ones, stack, wrap_outputs, zeros)
+from . import random
+from . import register as _register
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "concatenate", "stack", "from_jax", "random", "waitall", "save",
+           "load", "zeros_like", "ones_like"]
+
+
+def waitall():
+    """Block until all dispatched work completes (ref: Engine::WaitForAll).
+
+    PjRt executes per-device work in dispatch order, so a trivial
+    computation's completion implies all earlier work on that device is done.
+    """
+    for d in _jax.devices():
+        _jax.device_get(_jax.device_put(_np.zeros(()), d))
+
+
+def save(fname: str, data):
+    """Save NDArrays (ref: NDArray::Save, mx.nd.save). See ..serialization."""
+    from ..serialization import save_ndarrays
+
+    save_ndarrays(fname, data)
+
+
+def load(fname: str):
+    from ..serialization import load_ndarrays
+
+    return load_ndarrays(fname)
+
+
+def __getattr__(name: str):
+    try:
+        return _register.lookup(name)
+    except AttributeError:
+        raise AttributeError(f"module 'mxnet_tpu.ndarray' has no attribute {name!r}")

@@ -1,0 +1,75 @@
+"""nd.random namespace (ref: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+from .. import random as _random
+from ..ops.registry import invoke
+
+__all__ = ["uniform", "normal", "randn", "randint", "gamma", "exponential",
+           "poisson", "negative_binomial", "multinomial", "shuffle",
+           "bernoulli", "gumbel", "laplace", "seed"]
+
+seed = _random.seed
+
+
+def _sample(op, shape, dtype, ctx, **params):
+    if shape is None:
+        shape = (1,)
+    if isinstance(shape, int):
+        shape = (shape,)
+    out = invoke(op, _random.next_key(), shape=tuple(shape),
+                 dtype=dtype or "float32", **params)
+    return out.as_in_context(ctx) if ctx is not None else out
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None):
+    return _sample("_random_uniform", shape, dtype, ctx, low=low, high=high)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None):
+    return _sample("_random_normal", shape, dtype, ctx, loc=loc, scale=scale)
+
+
+def randn(*shape, dtype=None, ctx=None):
+    return normal(0.0, 1.0, shape or (1,), dtype=dtype, ctx=ctx)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
+    return _sample("_random_randint", shape, dtype, ctx, low=low, high=high)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None, out=None):
+    return _sample("_random_gamma", shape, dtype, ctx, alpha=alpha, beta=beta)
+
+
+def exponential(scale=1.0, shape=None, dtype=None, ctx=None, out=None):
+    return _sample("_random_exponential", shape, dtype, ctx, lam=1.0 / scale)
+
+
+def poisson(lam=1.0, shape=None, dtype=None, ctx=None, out=None):
+    return _sample("_random_poisson", shape, dtype, ctx, lam=lam)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype=None, ctx=None, out=None):
+    return _sample("_random_negative_binomial", shape, dtype, ctx, k=k, p=p)
+
+
+def gumbel(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None):
+    return _sample("_random_gumbel", shape, dtype, ctx, loc=loc, scale=scale)
+
+
+def laplace(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None):
+    return _sample("_random_laplace", shape, dtype, ctx, loc=loc, scale=scale)
+
+
+def bernoulli(p=0.5, shape=None, dtype=None, ctx=None, out=None):
+    return _sample("_random_bernoulli", shape, dtype, ctx, p=p)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", **kw):
+    return invoke("_sample_multinomial", _random.next_key(), data,
+                  shape=tuple(shape) if not isinstance(shape, int) else (shape,),
+                  get_prob=get_prob, dtype=dtype)
+
+
+def shuffle(data, **kw):
+    return invoke("_shuffle", _random.next_key(), data)

@@ -1,0 +1,92 @@
+"""Generated op namespace for mxnet_tpu.nd.
+
+Counterpart of the reference's import-time wrapper generation
+(ref: python/mxnet/ndarray/register.py::_make_ndarray_function, which lists
+registered ops through the C API and synthesizes Python functions).  Here
+wrappers are synthesized lazily from the op registry via module __getattr__.
+
+Special frontends (RNG injection, train-mode injection, in-place aux-state
+rebinds) are defined explicitly below, matching the reference ops whose
+kernels consult OpContext state.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from .. import autograd
+from .. import random as _random
+from ..ops.registry import OP_REGISTRY, invoke
+from .ndarray import NDArray
+
+
+def _make_wrapper(name: str) -> Callable:
+    def fn(*args, out=None, name=name, **kwargs):
+        res = invoke(name, *args, **kwargs)
+        if out is not None:
+            src = res[0] if isinstance(res, list) else res
+            out._data = src._data
+            return out
+        return res
+
+    fn.__name__ = name
+    fn.__qualname__ = name
+    fn.__doc__ = f"Imperative wrapper for registered op '{name}'."
+    return fn
+
+
+# ---- special frontends ----------------------------------------------------
+
+def Dropout(data, p=0.5, mode="training", axes=(), **kw):
+    """ref: nd.Dropout — consults global train mode; key auto-threaded."""
+    return invoke("Dropout", data, _random.next_key(), p=p, mode=mode,
+                  axes=tuple(axes), _train=autograd.is_training())
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+              momentum=0.9, fix_gamma=False, use_global_stats=False,
+              output_mean_var=False, axis=1, **kw):
+    """ref: nd.BatchNorm — updates moving stats in place in train mode."""
+    train = autograd.is_training() and not use_global_stats
+    res = invoke("BatchNorm", data, gamma, beta, moving_mean, moving_var,
+                 eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+                 use_global_stats=use_global_stats, axis=axis, _train=train)
+    if train:
+        out, new_mean, new_var = res
+        moving_mean._data = new_mean._data
+        moving_var._data = new_var._data
+        return out
+    return res
+
+
+def _make_random_wrapper(op_name: str):
+    def fn(*args, ctx=None, **kwargs):
+        out = invoke(op_name, _random.next_key(), *args, **kwargs)
+        if ctx is not None:
+            out = out.as_in_context(ctx)
+        return out
+
+    fn.__name__ = op_name
+    return fn
+
+
+_SPECIAL: Dict[str, Callable] = {
+    "Dropout": Dropout,
+    "dropout": Dropout,
+    "BatchNorm": BatchNorm,
+    "batch_norm": BatchNorm,
+}
+for _rn in ("_random_uniform", "_random_normal", "_random_randint",
+            "_random_gamma", "_random_exponential", "_random_poisson",
+            "_random_bernoulli", "_sample_multinomial", "_shuffle",
+            "_random_gumbel", "_random_laplace", "_random_negative_binomial"):
+    _SPECIAL[_rn] = _make_random_wrapper(_rn)
+_SPECIAL["sample_multinomial"] = _SPECIAL["_sample_multinomial"]
+_SPECIAL["shuffle"] = _SPECIAL["_shuffle"]
+
+
+def lookup(name: str):
+    if name in _SPECIAL:
+        return _SPECIAL[name]
+    if name in OP_REGISTRY:
+        return _make_wrapper(name)
+    raise AttributeError(f"no registered op '{name}'")

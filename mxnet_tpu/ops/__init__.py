@@ -1,0 +1,18 @@
+"""Op registry package — importing this module registers the core op set.
+
+Counterpart of the reference's operator registration at library-load time
+(ref: src/operator/** static NNVM_REGISTER_OP initialisers, listed through
+MXListAllOpNames and surfaced to Python by generated wrappers).
+"""
+from . import registry
+from .registry import (OP_REGISTRY, Operator, apply_pure, get_op, invoke,
+                       list_ops, register_op)
+
+# registration side effects
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import random_ops  # noqa: F401
+
+__all__ = ["registry", "OP_REGISTRY", "Operator", "apply_pure", "get_op",
+           "invoke", "list_ops", "register_op"]

@@ -1,0 +1,403 @@
+"""Neural-net ops: FC, Conv, BatchNorm, Pooling, LayerNorm, Dropout, …
+
+TPU-native counterpart of the reference's src/operator/nn/** (CUDA/cuDNN
+kernels: fully_connected, convolution + cudnn_convolution, batch_norm,
+pooling, activation, dropout, softmax, layer_norm, embedding in
+indexing_op).  Everything lowers to XLA HLO via lax — convolutions map
+straight onto the MXU via lax.conv_general_dilated; normalisations are
+fused elementwise chains XLA folds into neighbouring ops; there is no
+hand-written kernel or autotune cache (XLA owns scheduling).
+
+Stateful training-mode ops follow a functional contract:
+  * Dropout takes an explicit PRNG key input (threaded by the frontend
+    from mxnet_tpu.random's provider) and a static `train` attr.
+  * BatchNorm in train mode returns (out, new_running_mean, new_running_var);
+    the Gluon layer rebinds its running-stat buffers — the TPU-safe way to
+    express the reference's in-place aux-state update.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected (ref: src/operator/nn/fully_connected-inl.h)
+# ---------------------------------------------------------------------------
+
+@register_op("FullyConnected", aliases=("fully_connected",))
+def _fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
+                     flatten=True):
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = jnp.matmul(data, weight.T)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution (ref: src/operator/nn/convolution-inl.h, cudnn_convolution)
+# ---------------------------------------------------------------------------
+
+def _conv_dims(kernel):
+    return len(kernel)
+
+
+@register_op("Convolution", aliases=("convolution",))
+def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                 pad=(), num_filter=0, num_group=1, no_bias=False,
+                 layout=None, cudnn_tune=None, cudnn_off=False, workspace=1024):
+    nd = len(kernel) if kernel else data.ndim - 2
+    stride = tuple(stride) if stride else (1,) * nd
+    dilate = tuple(dilate) if dilate else (1,) * nd
+    pad = tuple(pad) if pad else (0,) * nd
+    if nd == 1:
+        dn_in, dn_k, dn_out = "NCH", "OIH", "NCH"
+    elif nd == 2:
+        dn_in, dn_k, dn_out = ("NCHW", "OIHW", "NCHW") if layout in (None, "NCHW") \
+            else ("NHWC", "HWIO", "NHWC")
+    else:
+        dn_in, dn_k, dn_out = "NCDHW", "OIDHW", "NCDHW"
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=(dn_in, dn_k, dn_out),
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
+    if out.dtype != data.dtype:
+        out = out.astype(data.dtype)
+    if bias is not None and not no_bias:
+        if dn_out[-1] == "C":
+            out = out + bias
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register_op("Deconvolution", aliases=("deconvolution",))
+def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                   pad=(), adj=(), num_filter=0, num_group=1, no_bias=False,
+                   target_shape=None, layout=None, workspace=1024,
+                   cudnn_tune=None, cudnn_off=False):
+    nd = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nd
+    pad = tuple(pad) if pad else (0,) * nd
+    # MXNet deconv weight layout is (in, out/group, *k); with
+    # transpose_kernel=True jax swaps the I/O axes of the spec, so the spec
+    # must name them O,I for axes 0,1 to land on (in, out) correctly.
+    dn = {1: ("NCH", "OIH", "NCH"),
+          2: ("NCHW", "OIHW", "NCHW"),
+          3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
+    out = lax.conv_transpose(
+        data, weight, strides=stride,
+        padding=[(p, p) for p in pad],
+        dimension_numbers=dn, transpose_kernel=True)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling (ref: src/operator/nn/pooling-inl.h)
+# ---------------------------------------------------------------------------
+
+@register_op("Pooling", aliases=("pooling",))
+def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
+             global_pool=False, pooling_convention="valid", count_include_pad=True,
+             cudnn_off=False, layout=None):
+    nd = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            return jnp.max(data, axis=axes, keepdims=True)
+        return jnp.mean(data, axis=axes, keepdims=True)
+    kernel = tuple(kernel)
+    stride = tuple(stride) if stride else (1,) * nd
+    pad = tuple(pad) if pad else (0,) * nd
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pooling_convention == "full":
+        # ceil-mode: extend padding on the right so ceil division is covered
+        extra = []
+        for i in range(nd):
+            in_sz = data.shape[2 + i] + 2 * pad[i]
+            rem = (in_sz - kernel[i]) % stride[i]
+            extra.append(0 if rem == 0 else stride[i] - rem)
+        padding = ((0, 0), (0, 0)) + tuple(
+            (p, p + e) for p, e in zip(pad, extra))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            return s / float(np.prod(kernel))
+        ones = jnp.ones_like(data)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return s / cnt
+    if pool_type == "lp":
+        s = lax.reduce_window(jnp.abs(data) ** 2, 0.0, lax.add, window, strides, padding)
+        return jnp.sqrt(s)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+# ---------------------------------------------------------------------------
+# Normalisation (ref: batch_norm.cc/.cu, layer_norm.cc, instance/group norm)
+# ---------------------------------------------------------------------------
+
+def _bn_nout(attrs):
+    return 3 if attrs.get("_train", False) else 1
+
+
+@register_op("BatchNorm", aliases=("batch_norm",), num_outputs=_bn_nout)
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+                momentum=0.9, fix_gamma=False, use_global_stats=False,
+                output_mean_var=False, axis=1, cudnn_off=False, _train=False):
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if _train and not use_global_stats:
+        red = tuple(i for i in range(data.ndim) if i != axis)
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+        out = (data - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + eps)
+        out = out * g.reshape(shape) + beta.reshape(shape)
+        n = np.prod([data.shape[i] for i in red])
+        unbiased = var * (n / max(n - 1, 1))
+        new_mean = momentum * moving_mean + (1 - momentum) * mean
+        new_var = momentum * moving_var + (1 - momentum) * unbiased
+        return out, new_mean, new_var
+    out = (data - moving_mean.reshape(shape)) * lax.rsqrt(moving_var.reshape(shape) + eps)
+    return out * g.reshape(shape) + beta.reshape(shape)
+
+
+@register_op("LayerNorm", aliases=("layer_norm",))
+def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register_op("InstanceNorm", aliases=("instance_norm",))
+def _instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register_op("GroupNorm", aliases=("group_norm",))
+def _group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    b, c = data.shape[:2]
+    rest = data.shape[2:]
+    x = data.reshape((b, num_groups, c // num_groups) + rest)
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    out = ((x - mean) * lax.rsqrt(var + eps)).reshape(data.shape)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register_op("RMSNorm", aliases=("rms_norm",))
+def _rms_norm(data, gamma, axis=-1, eps=1e-6):
+    ms = jnp.mean(jnp.square(data), axis=axis, keepdims=True)
+    return data * lax.rsqrt(ms + eps) * gamma
+
+
+# ---------------------------------------------------------------------------
+# Activations (ref: activation-inl.h, leaky_relu-inl.h)
+# ---------------------------------------------------------------------------
+
+@register_op("Activation", aliases=("activation",))
+def _activation(data, act_type="relu"):
+    return {
+        "relu": lambda x: jnp.maximum(x, 0),
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "softrelu": jax.nn.softplus,
+        "softsign": lambda x: x / (1 + jnp.abs(x)),
+        "gelu": partial(jax.nn.gelu, approximate=False),
+        "gelu_tanh": partial(jax.nn.gelu, approximate=True),
+        "silu": jax.nn.silu,
+    }[act_type](data)
+
+
+@register_op("LeakyReLU", aliases=("leaky_relu",))
+def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+                lower_bound=0.125, upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "prelu":
+        shape = (1, -1) + (1,) * (data.ndim - 2) if data.ndim > 1 else (-1,)
+        g = gamma.reshape(shape) if gamma.size > 1 else gamma
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, alpha * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2
+        return jnp.where(data >= 0, data, mid * data)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+# ---------------------------------------------------------------------------
+# Softmax family (ref: softmax-inl.h, softmax_output-inl.h)
+# ---------------------------------------------------------------------------
+
+@register_op("softmax")
+def _softmax(data, axis=-1, temperature=None, length=None):
+    x = data / temperature if temperature else data
+    if length is not None:
+        pos = jnp.arange(x.shape[axis])
+        shape = [1] * x.ndim
+        shape[axis] = -1
+        mask = pos.reshape(shape) < length.reshape((-1,) + (1,) * (x.ndim - 1))
+        x = jnp.where(mask, x, -jnp.inf)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax")
+def _log_softmax(data, axis=-1, temperature=None):
+    x = data / temperature if temperature else data
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("softmin")
+def _softmin(data, axis=-1):
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@jax.custom_vjp
+def _softmax_output_core(data, label):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _softmax_output_fwd(data, label):
+    out = jax.nn.softmax(data, axis=-1)
+    return out, (out, label)
+
+
+def _softmax_output_bwd(res, g):
+    out, label = res
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), out.shape[-1], dtype=out.dtype)
+    # reference semantics: backward ignores upstream grad, emits CE grad
+    return ((out - onehot) / out.shape[0], jnp.zeros_like(label))
+
+
+_softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+@register_op("SoftmaxOutput", aliases=("softmax_output",))
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1,
+                    use_ignore=False, multi_output=False, preserve_shape=False,
+                    normalization="null", out_grad=False, smooth_alpha=0.0):
+    """Legacy symbolic loss head (ref: softmax_output-inl.h): forward =
+    softmax, backward = softmax - one_hot(label), via custom_vjp."""
+    return _softmax_output_core(data, label)
+
+
+# ---------------------------------------------------------------------------
+# Dropout (ref: dropout-inl.h) — explicit key input, static train attr
+# ---------------------------------------------------------------------------
+
+@register_op("Dropout", aliases=("dropout",))
+def _dropout(data, key, p=0.5, mode="training", axes=(), _train=False):
+    apply_it = (mode == "always") or _train
+    if not apply_it or p == 0.0:
+        return data
+    shape = list(data.shape)
+    for a in axes:
+        shape[a] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape)).astype(data.dtype)
+    return data * mask / keep
+
+
+# ---------------------------------------------------------------------------
+# Embedding (ref: indexing_op.h Embedding)
+# ---------------------------------------------------------------------------
+
+@register_op("Embedding", aliases=("embedding",))
+def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+               sparse_grad=False):
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0, mode="clip")
+
+
+# ---------------------------------------------------------------------------
+# Losses as ops (ref: ctc_loss, MakeLoss)
+# ---------------------------------------------------------------------------
+
+@register_op("MakeLoss", aliases=("make_loss",))
+def _make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    return data
+
+
+@register_op("stop_gradient", aliases=("BlockGrad", "block_grad"))
+def _stop_gradient(data):
+    return lax.stop_gradient(data)
+
+
+@register_op("CTCLoss", aliases=("ctc_loss",))
+def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
+              use_data_lengths=False, use_label_lengths=False, blank_label="first"):
+    """CTC via dynamic-programming in log space (lax.scan over time).
+
+    data: (seq, batch, alphabet) activations (pre-softmax).
+    label: (batch, label_seq) padded with -1 (or 0s when blank_label='last').
+    """
+    seq_len, batch, alphabet = data.shape
+    logp = jax.nn.log_softmax(data, axis=-1)
+    blank = 0 if blank_label == "first" else alphabet - 1
+    lab = label.astype(jnp.int32)
+    L = lab.shape[1]
+    lab_valid = lab >= 0 if blank_label == "first" else lab > 0
+    lab_len = (jnp.sum(lab_valid, axis=1) if not use_label_lengths
+               else label_lengths.astype(jnp.int32))
+    # extended label sequence with blanks: length 2L+1
+    ext = jnp.full((batch, 2 * L + 1), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(jnp.where(lab_valid, lab, blank))
+    S = 2 * L + 1
+    neg_inf = -1e30
+    alpha0 = jnp.full((batch, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+    first_lab = ext[:, 1]
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.take_along_axis(logp[0], first_lab[:, None], axis=1)[:, 0])
+
+    def step(alpha, logp_t):
+        prev1 = jnp.concatenate([jnp.full((batch, 1), neg_inf), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate([jnp.full((batch, 2), neg_inf), alpha[:, :-2]], axis=1)
+        ext_shift = jnp.concatenate([jnp.full((batch, 2), -2, jnp.int32), ext[:, :-2]], axis=1)
+        allow_skip = (ext != blank) & (ext != ext_shift)
+        merged = jnp.logaddexp(alpha, prev1)
+        merged = jnp.where(allow_skip, jnp.logaddexp(merged, prev2), merged)
+        emit = jnp.take_along_axis(logp_t, ext, axis=1)
+        return merged + emit, None
+
+    alpha_T, _ = lax.scan(step, alpha0, logp[1:])
+    end1 = 2 * lab_len
+    end2 = 2 * lab_len - 1
+    a1 = jnp.take_along_axis(alpha_T, end1[:, None], axis=1)[:, 0]
+    a2 = jnp.take_along_axis(alpha_T, jnp.maximum(end2, 0)[:, None], axis=1)[:, 0]
+    return -jnp.logaddexp(a1, a2)

@@ -1,0 +1,223 @@
+"""Optimizer update ops.
+
+TPU-native counterpart of src/operator/optimizer_op.cc (sgd_update,
+sgd_mom_update, adam_update, rmsprop_update, ftrl_update, signsgd, nag,
+multi-precision variants).  The reference mutates weight/state in place on
+the device; here each op is a pure function returning the new weight (and
+new state tensors) and the Python Optimizer rebinds the NDArray buffers —
+inside a jitted train step XLA turns this into true in-place update via
+buffer donation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+@register_op("sgd_update", num_outputs=1, mutate_inputs=(0,))
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    return weight - lr * g
+
+
+@register_op("sgd_mom_update", num_outputs=2, mutate_inputs=(0, 2))
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register_op("nag_mom_update", num_outputs=2, mutate_inputs=(0, 2))
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register_op("adam_update", num_outputs=3, mutate_inputs=(0, 2, 3))
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    return (weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon),
+            new_mean, new_var)
+
+
+@register_op("rmsprop_update", num_outputs=2, mutate_inputs=(0, 2))
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n
+
+
+@register_op("rmspropalex_update", num_outputs=4, mutate_inputs=(0, 2, 3, 4))
+def _rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_g = (1 - gamma1) * g + gamma1 * g_state
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n, new_g, new_delta
+
+
+@register_op("ftrl_update", num_outputs=3, mutate_inputs=(0, 2, 3))
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(new_z) <= lamda1, jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1) /
+        ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return w, new_z, new_n
+
+
+@register_op("signsgd_update", num_outputs=1, mutate_inputs=(0,))
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register_op("signum_update", num_outputs=2, mutate_inputs=(0, 2))
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * g
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom) - lr * wd * weight
+    return w, new_mom
+
+
+@register_op("adagrad_update", num_outputs=2, mutate_inputs=(0, 2),
+             aliases=("_sparse_adagrad_update",))
+def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_hist = history + jnp.square(g)
+    return weight - lr * (g / jnp.sqrt(new_hist + epsilon) + wd * weight), new_hist
+
+
+@register_op("adadelta_update", num_outputs=3, mutate_inputs=(0, 2, 3))
+def _adadelta_update(weight, grad, acc_g, acc_delta, lr=1.0, rho=0.9,
+                     epsilon=1e-5, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight
+    new_acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
+    new_acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    return weight - lr * delta, new_acc_g, new_acc_delta
+
+
+@register_op("adamax_update", num_outputs=3, mutate_inputs=(0, 2, 3))
+def _adamax_update(weight, grad, mean, var, lr=0.002, beta1=0.9, beta2=0.999,
+                   epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   t=1):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = jnp.maximum(beta2 * var, jnp.abs(g))
+    lr_t = lr / (1 - beta1 ** t)
+    return weight - lr_t * new_mean / (new_var + epsilon), new_mean, new_var
+
+
+@register_op("nadam_update", num_outputs=3, mutate_inputs=(0, 2, 3))
+def _nadam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                  epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                  t=1, schedule_decay=0.004):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    m_t = beta1 * (1 - 0.5 * 0.96 ** (t * schedule_decay))
+    m_t1 = beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * schedule_decay))
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    g_hat = g / (1 - m_t)
+    m_hat = new_mean / (1 - m_t1)
+    m_bar = (1 - m_t) * g_hat + m_t1 * m_hat
+    v_hat = new_var / (1 - beta2 ** t)
+    return weight - lr * m_bar / (jnp.sqrt(v_hat) + epsilon), new_mean, new_var
+
+
+@register_op("lamb_update_phase1", num_outputs=1)
+def _lamb_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 t=1, bias_correction=True, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        mh = new_mean / (1 - beta1 ** t)
+        vh = new_var / (1 - beta2 ** t)
+    else:
+        mh, vh = new_mean, new_var
+    return mh / (jnp.sqrt(vh) + epsilon) + wd * weight
+
+
+# multi-precision (fp16/bf16 weights with fp32 master copy;
+# ref: mp_sgd_update / mp_sgd_mom_update / mp_adam-like kernels)
+
+@register_op("mp_sgd_update", num_outputs=2, mutate_inputs=(0, 2))
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    g = _rescale_clip(grad.astype(jnp.float32), rescale_grad, clip_gradient,
+                      wd, weight32)
+    new_w32 = weight32 - lr * g
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register_op("mp_sgd_mom_update", num_outputs=3, mutate_inputs=(0, 2, 3))
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                       lazy_update=True):
+    g = _rescale_clip(grad.astype(jnp.float32), rescale_grad, clip_gradient,
+                      wd, weight32)
+    new_mom = momentum * mom - lr * g
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register_op("mp_adam_update", num_outputs=4, mutate_inputs=(0, 2, 3, 4))
+def _mp_adam_update(weight, grad, mean, var, weight32, lr=0.001, beta1=0.9,
+                    beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    g = _rescale_clip(grad.astype(jnp.float32), rescale_grad, clip_gradient,
+                      wd, weight32)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w32 = weight32 - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_w32.astype(weight.dtype), new_mean, new_var, new_w32

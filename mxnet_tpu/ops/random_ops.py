@@ -1,0 +1,103 @@
+"""Random sampling ops (ref: src/operator/random/sample_op.cc).
+
+Each op takes an explicit threefry key as its first input (threaded by the
+frontend from mxnet_tpu.random) — stateless under the hood, stateful at the
+MXNet-compatible API surface.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _dt(dtype):
+    return jnp.dtype(dtype if dtype not in (None, "None") else "float32")
+
+
+@register_op("_random_uniform", differentiable=False, aliases=("random_uniform",))
+def _uniform(key, low=0.0, high=1.0, shape=(), dtype="float32"):
+    return jax.random.uniform(key, tuple(shape), _dt(dtype), low, high)
+
+
+@register_op("_random_normal", differentiable=False,
+             aliases=("random_normal", "normal_op"))
+def _normal(key, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    return loc + scale * jax.random.normal(key, tuple(shape), _dt(dtype))
+
+
+@register_op("_random_randint", differentiable=False)
+def _randint(key, low=0, high=1, shape=(), dtype="int32"):
+    return jax.random.randint(key, tuple(shape), low, high, _dt(dtype))
+
+
+@register_op("_random_gamma", differentiable=False)
+def _gamma(key, alpha=1.0, beta=1.0, shape=(), dtype="float32"):
+    return jax.random.gamma(key, alpha, tuple(shape), _dt(dtype)) * beta
+
+
+@register_op("_random_exponential", differentiable=False)
+def _exponential(key, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.exponential(key, tuple(shape), _dt(dtype)) / lam
+
+
+@register_op("_random_poisson", differentiable=False)
+def _poisson(key, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.poisson(key, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register_op("_random_bernoulli", differentiable=False)
+def _bernoulli(key, p=0.5, shape=(), dtype="float32"):
+    return jax.random.bernoulli(key, p, tuple(shape)).astype(_dt(dtype))
+
+
+def _multinomial_nout(attrs):
+    return 2 if attrs.get("get_prob", False) else 1
+
+
+@register_op("_sample_multinomial", differentiable=False,
+             num_outputs=_multinomial_nout)
+def _multinomial(key, data, shape=(), get_prob=False, dtype="int32"):
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    n = int(shape[0]) if shape else 1
+    if data.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(n,))
+    else:
+        out = jax.random.categorical(key, logits[:, None, :], axis=-1,
+                                     shape=(data.shape[0], n))
+    if shape == ():
+        out = out.squeeze(-1) if data.ndim > 1 else out[0]
+    sample = out.astype(_dt(dtype))
+    if get_prob:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        if data.ndim == 1:
+            lp = jnp.take(logp, out)
+        else:
+            lp = jnp.take_along_axis(
+                logp, out.reshape(data.shape[0], -1).astype(jnp.int32),
+                axis=-1).reshape(out.shape)
+        return sample, lp
+    return sample
+
+
+@register_op("_shuffle", differentiable=False, aliases=("shuffle",))
+def _shuffle(key, data):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register_op("_random_gumbel", differentiable=False)
+def _gumbel(key, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    return loc + scale * jax.random.gumbel(key, tuple(shape), _dt(dtype))
+
+
+@register_op("_random_laplace", differentiable=False)
+def _laplace(key, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    return loc + scale * jax.random.laplace(key, tuple(shape), _dt(dtype))
+
+
+@register_op("_random_negative_binomial", differentiable=False)
+def _neg_binomial(key, k=1, p=1.0, shape=(), dtype="float32"):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, tuple(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, tuple(shape)).astype(_dt(dtype))

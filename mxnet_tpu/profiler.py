@@ -1,0 +1,204 @@
+"""Profiler: chrome-trace host-side op records + XLA/TPU xplane bridge.
+
+TPU-native counterpart of the reference profiler
+(ref: src/profiler/profiler.cc, python/mxnet/profiler.py,
+src/c_api/c_api_profile.cc): per-op start/stop records captured at the
+dispatch site, chrome://tracing JSON dump, aggregate stats table, custom
+task/event/counter API.  The device-side timeline comes from JAX's built-in
+profiler (tensorboard xplane) via start_xla_trace/stop_xla_trace.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from .base import get_env
+
+__all__ = [
+    "set_config", "start", "stop", "dump", "dumps", "profile_op",
+    "Task", "Event", "Counter", "scope", "start_xla_trace", "stop_xla_trace",
+]
+
+_lock = threading.Lock()
+_config = {
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "filename": "profile.json",
+    "aggregate_stats": False,
+}
+_running = False
+_events: List[dict] = []
+_agg: Dict[str, List[float]] = defaultdict(list)
+_xla_trace_dir: Optional[str] = None
+
+
+def set_config(**kwargs):
+    _config.update(kwargs)
+
+
+def start():
+    global _running
+    _running = True
+
+
+def stop():
+    global _running
+    _running = False
+
+
+def is_running() -> bool:
+    return _running
+
+
+if get_env("MXNET_PROFILER_AUTOSTART", 0, int):
+    start()
+
+
+@contextlib.contextmanager
+def profile_op(name: str):
+    """Hot-path hook used by ops.registry.invoke.
+
+    Records host dispatch time (device time lives in the xplane trace —
+    dispatch is async so wall time here is launch overhead, matching the
+    reference's 'engine dispatch' lane).
+    """
+    if not _running:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        t1 = time.perf_counter()
+        with _lock:
+            _events.append({
+                "name": name, "ph": "X", "cat": "operator",
+                "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6,
+                "pid": os.getpid(), "tid": threading.get_ident(),
+            })
+            _agg[name].append(t1 - t0)
+
+
+@contextlib.contextmanager
+def scope(name: str, category: str = "user"):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        t1 = time.perf_counter()
+        with _lock:
+            _events.append({
+                "name": name, "ph": "X", "cat": category,
+                "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6,
+                "pid": os.getpid(), "tid": threading.get_ident(),
+            })
+
+
+class Task:
+    """ref: profiler.ProfileTask."""
+
+    def __init__(self, name: str, domain: str = "user"):
+        self.name, self.domain = name, domain
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is None:
+            return
+        t1 = time.perf_counter()
+        with _lock:
+            _events.append({"name": self.name, "ph": "X", "cat": self.domain,
+                            "ts": self._t0 * 1e6, "dur": (t1 - self._t0) * 1e6,
+                            "pid": os.getpid(), "tid": threading.get_ident()})
+        self._t0 = None
+
+
+Event = Task
+
+
+class Counter:
+    """ref: profiler.ProfileCounter."""
+
+    def __init__(self, name: str, domain: str = "user", value: int = 0):
+        self.name, self.domain, self.value = name, domain, value
+        self._emit()
+
+    def _emit(self):
+        with _lock:
+            _events.append({"name": self.name, "ph": "C", "cat": self.domain,
+                            "ts": time.perf_counter() * 1e6,
+                            "pid": os.getpid(),
+                            "args": {self.name: self.value}})
+
+    def set_value(self, v):
+        self.value = v
+        self._emit()
+
+    def increment(self, d=1):
+        self.set_value(self.value + d)
+
+    def decrement(self, d=1):
+        self.set_value(self.value - d)
+
+    def __iadd__(self, d):
+        self.increment(d)
+        return self
+
+    def __isub__(self, d):
+        self.decrement(d)
+        return self
+
+
+def dumps(reset: bool = False) -> str:
+    """Aggregate per-op stats table (ref: AggregateStats::Dump)."""
+    with _lock:
+        rows = []
+        for name, ts in sorted(_agg.items(), key=lambda kv: -sum(kv[1])):
+            n = len(ts)
+            tot = sum(ts) * 1e3
+            rows.append(f"{name:<40s} {n:>8d} {tot:>12.3f} "
+                        f"{tot / n:>10.4f} {min(ts) * 1e3:>10.4f} {max(ts) * 1e3:>10.4f}")
+        if reset:
+            _agg.clear()
+    header = (f"{'Name':<40s} {'Count':>8s} {'Total(ms)':>12s} "
+              f"{'Mean(ms)':>10s} {'Min(ms)':>10s} {'Max(ms)':>10s}")
+    return "\n".join([header] + rows)
+
+
+def dump(finished: bool = True, filename: Optional[str] = None):
+    """Write chrome://tracing JSON."""
+    fn = filename or _config["filename"]
+    with _lock:
+        data = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    with open(fn, "w") as f:
+        json.dump(data, f)
+    return fn
+
+
+def start_xla_trace(logdir: str = "/tmp/mx_xla_trace"):
+    """Capture the device-side timeline via JAX's profiler (xplane,
+    viewable in tensorboard-plugin-profile)."""
+    global _xla_trace_dir
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    _xla_trace_dir = logdir
+
+
+def stop_xla_trace():
+    global _xla_trace_dir
+    import jax
+
+    jax.profiler.stop_trace()
+    d, _xla_trace_dir = _xla_trace_dir, None
+    return d

@@ -1,0 +1,69 @@
+"""Random state: stateful frontend over JAX's stateless threefry keys.
+
+TPU-native counterpart of the reference's random resources
+(ref: src/resource.cc kRandom per-device PRNG states;
+python/mxnet/random.py seed()).
+
+Eagerly, a global key is split on every draw (the MXNet-style stateful
+API).  Inside a traced program (hybridize / jit), the active *key
+provider* instead folds from a traced key input so randomness is a proper
+functional input of the compiled program — the idiomatic TPU design.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+__all__ = ["seed", "next_key", "key_provider", "KeyProvider"]
+
+
+class KeyProvider:
+    """Deterministic stream of PRNG keys split from a root key."""
+
+    def __init__(self, root_key):
+        self._key = root_key
+        self._lock = threading.Lock()
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.provider: Optional[KeyProvider] = None
+
+
+_STATE = _State()
+_GLOBAL = KeyProvider(jax.random.PRNGKey(0))
+
+
+def seed(seed_state: int, ctx=None):
+    """ref: mx.random.seed — reset the global stream."""
+    global _GLOBAL
+    _GLOBAL = KeyProvider(jax.random.PRNGKey(int(seed_state)))
+
+
+def next_key():
+    p = _STATE.provider
+    return (p or _GLOBAL).next_key()
+
+
+class key_provider:
+    """Scope a KeyProvider (used by CachedOp tracing to thread a traced key)."""
+
+    def __init__(self, provider: KeyProvider):
+        self._p = provider
+        self._old = None
+
+    def __enter__(self):
+        self._old = _STATE.provider
+        _STATE.provider = self._p
+        return self._p
+
+    def __exit__(self, *exc):
+        _STATE.provider = self._old
+        return False

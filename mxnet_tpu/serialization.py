@@ -1,0 +1,130 @@
+"""NDArray binary serialization — the `.params` format.
+
+Counterpart of the reference's NDArray::Save/Load
+(ref: src/ndarray/ndarray.cc, magic-tagged dmlc::Stream binary holding
+{names -> arrays}; python surface mx.nd.save/load and
+Block.save_parameters).  Layout implemented here (little-endian):
+
+  file:   u64 list_magic (0x112)   # kMXAPINDArrayListMagic
+          u64 reserved (0)
+          u64 n_arrays, n_arrays * ndarray_record
+          u64 n_names,  n_names  * (u64 len, utf-8 bytes)
+  record: u32 NDARRAY_V2_MAGIC (0xF993FAC9)
+          u32 stype (0 = default dense)
+          u32 ndim, ndim * i64 dims
+          i32 dev_type, i32 dev_id
+          i32 type_flag (MXNet dtype code)
+          raw data bytes (C order)
+
+The list/array magics follow the reference's published constants so files
+round-trip with MXNet-1.x-lineage tooling; bfloat16 uses type_flag 12 and
+is stored as raw uint16 words.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Sequence, Union
+
+import numpy as np
+
+from .base import MXNetError
+from .context import cpu
+from .ndarray.ndarray import NDArray, array as nd_array
+
+LIST_MAGIC = 0x112
+NDARRAY_V2_MAGIC = 0xF993FAC9
+
+# MXNet type_flag codes (ref: include/mxnet/base.h mshadow type enum)
+_TYPE_FLAG = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+              "int32": 4, "int8": 5, "int64": 6, "bool": 7, "bfloat16": 12}
+_FLAG_TYPE = {v: k for k, v in _TYPE_FLAG.items()}
+
+
+def _dtype_name(nd: NDArray) -> str:
+    return str(nd.data.dtype)
+
+
+def _write_one(f, nd: NDArray):
+    name = _dtype_name(nd)
+    a = nd.asnumpy()
+    if name == "bfloat16":
+        raw = a.astype(np.float32)  # numpy lacks bf16; use ml_dtypes view
+        import ml_dtypes
+
+        raw = raw.astype(ml_dtypes.bfloat16)
+        data = raw.tobytes()
+        flag = _TYPE_FLAG["bfloat16"]
+    else:
+        flag = _TYPE_FLAG.get(name)
+        if flag is None:
+            raise MXNetError(f"cannot serialize dtype {name}")
+        data = np.ascontiguousarray(a).tobytes()
+    f.write(struct.pack("<II", NDARRAY_V2_MAGIC, 0))
+    f.write(struct.pack("<I", a.ndim))
+    f.write(struct.pack(f"<{a.ndim}q", *a.shape))
+    f.write(struct.pack("<ii", 1, 0))  # saved context: cpu(0), like reference
+    f.write(struct.pack("<i", flag))
+    f.write(data)
+
+
+def _read_one(f) -> NDArray:
+    magic, stype = struct.unpack("<II", f.read(8))
+    if magic != NDARRAY_V2_MAGIC:
+        raise MXNetError(f"bad ndarray magic {magic:#x}")
+    if stype != 0:
+        raise MXNetError("sparse storage load not supported")
+    (ndim,) = struct.unpack("<I", f.read(4))
+    shape = struct.unpack(f"<{ndim}q", f.read(8 * ndim)) if ndim else ()
+    struct.unpack("<ii", f.read(8))
+    (flag,) = struct.unpack("<i", f.read(4))
+    dtname = _FLAG_TYPE.get(flag)
+    if dtname is None:
+        raise MXNetError(f"unknown type flag {flag}")
+    if dtname == "bfloat16":
+        import ml_dtypes
+
+        npdt = np.dtype(ml_dtypes.bfloat16)
+    else:
+        npdt = np.dtype(dtname)
+    n = int(np.prod(shape)) if shape else 1
+    buf = f.read(n * npdt.itemsize)
+    a = np.frombuffer(buf, dtype=npdt).reshape(shape)
+    return nd_array(a, ctx=cpu(), dtype=npdt)
+
+
+def save_ndarrays(fname: str, data) -> None:
+    """mx.nd.save: accepts NDArray, list of NDArray, or dict name->NDArray."""
+    if isinstance(data, NDArray):
+        arrays, names = [data], []
+    elif isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        arrays, names = list(data), []
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQ", LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            _write_one(f, a)
+        f.write(struct.pack("<Q", len(names)))
+        for nm in names:
+            b = nm.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def load_ndarrays(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
+    with open(fname, "rb") as f:
+        magic, _ = struct.unpack("<QQ", f.read(16))
+        if magic != LIST_MAGIC:
+            raise MXNetError(f"invalid NDArray file {fname}: magic {magic:#x}")
+        (n,) = struct.unpack("<Q", f.read(8))
+        arrays = [_read_one(f) for _ in range(n)]
+        (nn,) = struct.unpack("<Q", f.read(8))
+        names = []
+        for _ in range(nn):
+            (ln,) = struct.unpack("<Q", f.read(8))
+            names.append(f.read(ln).decode("utf-8"))
+    if names:
+        return dict(zip(names, arrays))
+    return arrays

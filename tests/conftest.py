@@ -1,0 +1,35 @@
+"""Test harness config: force the CPU backend with 8 virtual devices.
+
+Mirrors the reference's test strategy (SURVEY.md §4): unit tests run on a
+host backend with numpy as oracle; multi-device behaviour is simulated via
+XLA's virtual host devices; cpu↔tpu consistency has its own opt-in marker.
+
+NOTE (container-specific): the axon TPU plugin is force-registered in every
+python process by sitecustomize and sets jax_platforms programmatically, so
+plain env vars are NOT enough — we must override via jax.config.update.
+This also keeps tests runnable while the single-client TPU tunnel is busy.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ.setdefault("MXNET_TEST_SEED", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    """with_seed-style reproducibility (ref: tests/python/unittest/common.py)."""
+    seed = int(os.environ["MXNET_TEST_SEED"])
+    np.random.seed(seed)
+    import mxnet_tpu as mx
+
+    mx.random.seed(seed)
+    yield

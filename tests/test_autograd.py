@@ -1,0 +1,185 @@
+"""Autograd tests (model: tests/python/unittest/test_autograd.py +
+check_numeric_gradient from python/mxnet/test_utils.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import autograd as ag
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central finite differences of scalar f at numpy x."""
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp, xm = x.copy(), x.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_write_vs_add_semantics():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()  # write
+    for _ in range(2):
+        with ag.record():
+            (x * x).sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2, 4])
+    y = nd.array([1.0, 2.0])
+    y.attach_grad(grad_req="add")
+    for _ in range(2):
+        with ag.record():
+            (y * y).sum().backward()
+    np.testing.assert_allclose(y.grad.asnumpy(), [4, 8])
+
+
+def test_multi_path_accumulation():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 3 + x * x   # dy/dx = 3 + 2x = 7
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [7.0])
+
+
+def test_chain_and_branching():
+    a = np.random.rand(4, 3).astype("float32") + 0.5
+    x = nd.array(a)
+    x.attach_grad()
+    with ag.record():
+        y = (nd.exp(x.log() * 2) + nd.sqrt(x)).sum()
+    y.backward()
+    expect = 2 * a + 0.5 / np.sqrt(a)
+    np.testing.assert_allclose(x.grad.asnumpy(), expect, rtol=1e-4)
+
+
+def test_numeric_gradient_softmax_ce():
+    logits = np.random.randn(4, 5).astype("float32")
+    label = np.array([0, 2, 1, 4])
+
+    def f(lg):
+        e = np.exp(lg - lg.max(axis=1, keepdims=True))
+        p = e / e.sum(axis=1, keepdims=True)
+        return -np.log(p[np.arange(4), label]).sum()
+
+    x = nd.array(logits)
+    x.attach_grad()
+    with ag.record():
+        lp = nd.log_softmax(x)
+        loss = -nd.pick(lp, nd.array(label.astype("float32")), axis=1).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), numeric_grad(f, logits),
+                               rtol=1e-2, atol=1e-3)
+
+
+def test_detach_and_stop_gradient():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        z = y.detach() * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+    x.zero_grad()
+    with ag.record():
+        w = nd.stop_gradient(x * 2) * x
+    w.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_pause_and_modes():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with ag.record():
+        assert ag.is_recording() and ag.is_training()
+        with ag.pause():
+            assert not ag.is_recording()
+            y = x * 2
+        assert y._ag_node is None
+        with ag.predict_mode():
+            assert not ag.is_training()
+
+
+def test_grad_function():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x ** 3).sum()
+    (g,) = ag.grad([y], [x])
+    np.testing.assert_allclose(g.asnumpy(), [3, 12])
+    assert x.grad.asnumpy().tolist() == [0, 0]  # .grad untouched by ag.grad
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+    y.backward(nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [20, 200])
+
+
+def test_custom_function():
+    class Square(ag.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    x = nd.array([2.0, 3.0])
+    x.attach_grad()
+    sq = Square()
+    with ag.record():
+        y = sq(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4, 6])
+
+
+def test_deep_chain_no_recursion_error():
+    x = nd.ones((2,))
+    x.attach_grad()
+    with ag.record():
+        t = x
+        for _ in range(1200):
+            t = t + 1
+        t.sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [1, 1])
+
+
+def test_inplace_op_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        y += x
+        y.sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3, 3])
+
+
+def test_softmax_output_legacy_grad():
+    data = nd.array(np.random.randn(3, 4).astype("float32"))
+    label = nd.array([0.0, 1.0, 2.0])
+    data.attach_grad()
+    with ag.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    sm = out.asnumpy()
+    onehot = np.eye(4)[[0, 1, 2]]
+    np.testing.assert_allclose(data.grad.asnumpy(), (sm - onehot) / 3,
+                               rtol=1e-5, atol=1e-6)

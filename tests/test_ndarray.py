@@ -1,0 +1,155 @@
+"""NDArray frontend tests (model: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation_and_basic_props():
+    x = nd.array(np.arange(12, dtype=np.float64).reshape(3, 4))
+    assert x.shape == (3, 4)
+    assert x.size == 12
+    assert x.ndim == 2
+    assert x.dtype == np.float32  # float64 source narrows by default
+    assert nd.array(np.arange(3)).dtype == np.int32  # int64 narrows too
+    assert nd.zeros((2, 2)).asnumpy().sum() == 0
+    assert nd.ones((2, 2)).asnumpy().sum() == 4
+    assert nd.full((2,), 7).asnumpy().tolist() == [7, 7]
+    np.testing.assert_allclose(nd.arange(0, 6, 2).asnumpy(), [0, 2, 4])
+
+
+def test_arithmetic_matches_numpy():
+    a = np.random.randn(3, 4).astype("float32")
+    b = np.random.randn(3, 4).astype("float32")
+    x, y = nd.array(a), nd.array(b)
+    np.testing.assert_allclose((x + y).asnumpy(), a + b, rtol=1e-6)
+    np.testing.assert_allclose((x - y).asnumpy(), a - b, rtol=1e-6)
+    np.testing.assert_allclose((x * y).asnumpy(), a * b, rtol=1e-6)
+    np.testing.assert_allclose((x / y).asnumpy(), a / b, rtol=1e-5)
+    np.testing.assert_allclose((x + 2).asnumpy(), a + 2, rtol=1e-6)
+    np.testing.assert_allclose((2 - x).asnumpy(), 2 - a, rtol=1e-6)
+    np.testing.assert_allclose((1.0 / (x + 10)).asnumpy(), 1 / (a + 10), rtol=1e-5)
+    np.testing.assert_allclose((-x).asnumpy(), -a)
+    np.testing.assert_allclose((x ** 2).asnumpy(), a ** 2, rtol=1e-5)
+    # numpy-array rhs
+    np.testing.assert_allclose((x + b).asnumpy(), a + b, rtol=1e-6)
+
+
+def test_broadcast_and_comparison():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    row = nd.array([10.0, 20.0])
+    np.testing.assert_allclose((x + row).asnumpy(), [[11, 22], [13, 24]])
+    assert (x > 2).asnumpy().tolist() == [[0, 0], [1, 1]]
+    assert (x == 3).asnumpy().tolist() == [[0, 0], [1, 0]]
+
+
+def test_reshape_transpose_slice():
+    x = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert x.reshape((6, 4)).shape == (6, 4)
+    assert x.reshape((0, -1)).shape == (2, 12)
+    assert x.reshape((-3, 0)).shape == (6, 4)
+    assert x.transpose().shape == (4, 3, 2)
+    assert x.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert x.T.shape == (4, 3, 2)
+    assert x[1].shape == (3, 4)
+    assert x[:, 1:3].shape == (2, 2, 4)
+    assert x.flatten().shape == (2, 12)
+    assert x.expand_dims(0).shape == (1, 2, 3, 4)
+    assert nd.slice_axis(x, axis=2, begin=1, end=3).shape == (2, 3, 2)
+
+
+def test_setitem():
+    x = nd.zeros((3, 3))
+    x[1] = 5
+    x[0, 2] = 7
+    a = x.asnumpy()
+    assert a[1].tolist() == [5, 5, 5]
+    assert a[0, 2] == 7
+    x[:] = 1
+    assert x.asnumpy().sum() == 9
+
+
+def test_reductions():
+    a = np.random.rand(3, 4, 5).astype("float32")
+    x = nd.array(a)
+    np.testing.assert_allclose(x.sum().asscalar(), a.sum(), rtol=1e-5)
+    np.testing.assert_allclose(x.mean(axis=1).asnumpy(), a.mean(1), rtol=1e-5)
+    np.testing.assert_allclose(x.max(axis=(0, 2)).asnumpy(), a.max((0, 2)))
+    np.testing.assert_allclose(x.argmax(axis=1).asnumpy(), a.argmax(1))
+    np.testing.assert_allclose(x.norm().asscalar(),
+                               np.sqrt((a ** 2).sum()), rtol=1e-5)
+
+
+def test_concat_stack_split():
+    x, y = nd.ones((2, 3)), nd.zeros((2, 3))
+    assert nd.concat(x, y, dim=0).shape == (4, 3)
+    assert nd.stack(x, y, axis=0).shape == (2, 2, 3)
+    parts = nd.split(nd.ones((2, 6)), num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+    sq = nd.split(nd.ones((2, 3)), num_outputs=3, axis=1, squeeze_axis=True)
+    assert sq[0].shape == (2,)
+
+
+def test_dot():
+    a = np.random.randn(3, 4).astype("float32")
+    b = np.random.randn(4, 5).astype("float32")
+    np.testing.assert_allclose(nd.dot(nd.array(a), nd.array(b)).asnumpy(),
+                               a @ b, rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(a), nd.array(b.T), transpose_b=True).asnumpy(),
+        a @ b, rtol=1e-5)
+    bd = nd.batch_dot(nd.ones((2, 3, 4)), nd.ones((2, 4, 5)))
+    assert bd.shape == (2, 3, 5)
+
+
+def test_scalar_and_truthiness():
+    s = nd.array(3.5)
+    assert s.asscalar() == pytest.approx(3.5)
+    with pytest.raises(mx.MXNetError):
+        bool(nd.ones((3,)))
+
+
+def test_astype_copy_context():
+    x = nd.array([1.5, 2.5])
+    assert str(x.astype("int32").data.dtype) == "int32"
+    y = x.copy()
+    y[:] = 0
+    assert x.asnumpy().sum() == 4.0
+    z = x.as_in_context(mx.cpu(0))
+    assert z.ctx == mx.cpu(0)
+
+
+def test_save_load_roundtrip(tmp_path):
+    f = str(tmp_path / "t.params")
+    d = {"a": nd.array(np.random.rand(3, 2).astype("float32")),
+         "b": nd.arange(0, 5, dtype="int32")}
+    nd.save(f, d)
+    back = nd.load(f)
+    assert set(back) == {"a", "b"}
+    np.testing.assert_allclose(back["a"].asnumpy(), d["a"].asnumpy())
+    np.testing.assert_array_equal(back["b"].asnumpy(), d["b"].asnumpy())
+    nd.save(f, [nd.ones((2,))])
+    assert isinstance(nd.load(f), list)
+
+
+def test_take_pick_onehot_where():
+    x = nd.array(np.arange(12).reshape(3, 4))
+    t = nd.take(x, nd.array([0, 2]), axis=0)
+    assert t.shape == (2, 4)
+    p = nd.pick(x, nd.array([0, 1, 2]), axis=1)
+    np.testing.assert_allclose(p.asnumpy(), [0, 5, 10])
+    oh = nd.one_hot(nd.array([0, 2]), depth=3)
+    np.testing.assert_allclose(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+    w = nd.where(nd.array([1.0, 0.0]), nd.array([1.0, 1.0]), nd.array([2.0, 2.0]))
+    np.testing.assert_allclose(w.asnumpy(), [1, 2])
+
+
+def test_random_reproducibility():
+    mx.random.seed(42)
+    a = nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(42)
+    b = nd.random.uniform(shape=(5,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = nd.random.normal(loc=2.0, scale=0.001, shape=(1000,)).asnumpy()
+    assert abs(c.mean() - 2.0) < 0.01
