@@ -1,0 +1,81 @@
+"""MNIST MLP — the canonical minimum end-to-end workload
+(ref: example/gluon/mnist.py; BASELINE.md config 1).
+
+Usage:  python examples/gluon/mnist.py [--epochs N] [--cpu] [--hybridize]
+"""
+import argparse
+import time
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def build_net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    return net
+
+
+def transformer(img, label):
+    return img.astype("float32").reshape((-1,)) / 255.0, label
+
+
+def run(epochs=5, ctx=None, hybridize=True, batch_size=100, lr=0.1):
+    ctx = ctx or (mx.tpu() if mx.num_tpus() else mx.cpu())
+    train_data = gluon.data.DataLoader(
+        gluon.data.vision.MNIST(train=True).transform(transformer),
+        batch_size=batch_size, shuffle=True, last_batch="discard")
+    val_data = gluon.data.DataLoader(
+        gluon.data.vision.MNIST(train=False).transform(transformer),
+        batch_size=batch_size, shuffle=False)
+
+    net = build_net()
+    net.initialize(mx.initializer.Xavier(magnitude=2.24), ctx=ctx)
+    if hybridize:
+        net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(epochs):
+        metric.reset()
+        tic = time.time()
+        n = 0
+        for data, label in train_data:
+            data = data.as_in_context(ctx)
+            label = label.as_in_context(ctx)
+            with autograd.record():
+                output = net(data)
+                loss = loss_fn(output, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update([label], [output])
+            n += data.shape[0]
+        name, acc = metric.get()
+        print(f"[epoch {epoch}] {name}={acc:.4f} "
+              f"({n / (time.time() - tic):.0f} samples/s)")
+
+    metric.reset()
+    for data, label in val_data:
+        output = net(data.as_in_context(ctx))
+        metric.update([label.as_in_context(ctx)], [output])
+    name, acc = metric.get()
+    print(f"[val] {name}={acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--no-hybridize", action="store_true")
+    args = p.parse_args()
+    acc = run(args.epochs, mx.cpu() if args.cpu else None,
+              not args.no_hybridize, args.batch_size, args.lr)
+    assert acc > 0.9, f"val accuracy too low: {acc}"

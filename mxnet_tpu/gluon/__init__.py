@@ -1,0 +1,16 @@
+"""Gluon: the imperative high-level API
+(ref: python/mxnet/gluon/__init__.py)."""
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Constant, Parameter, ParameterDict
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import utils
+from . import data
+from . import rnn
+from . import model_zoo
+from . import contrib
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "Parameter", "Constant",
+           "ParameterDict", "Trainer", "nn", "loss", "utils", "data", "rnn",
+           "model_zoo", "contrib"]

@@ -1,0 +1,5 @@
+"""Gluon contrib (ref: python/mxnet/gluon/contrib/__init__.py)."""
+from . import estimator
+from . import nn
+
+__all__ = ["estimator", "nn"]

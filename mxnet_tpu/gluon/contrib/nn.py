@@ -1,0 +1,59 @@
+"""Gluon contrib layers (ref: python/mxnet/gluon/contrib/nn/basic_layers.py):
+Concurrent, HybridConcurrent, Identity, SparseEmbedding(dense-backed),
+SyncBatchNorm(alias), PixelShuffle."""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from .. import nn as _nn
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle2D"]
+
+
+class HybridConcurrent(HybridBlock):
+    """Parallel branches concatenated along `axis`."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self.axis = axis
+
+    def add(self, block):
+        self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        out = [c(x) for c in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+Concurrent = HybridConcurrent
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(_nn.Embedding):
+    """ref: contrib SparseEmbedding — row_sparse grads have no direct XLA
+    analogue; dense-gradient Embedding provides identical results."""
+
+
+class SyncBatchNorm(_nn.BatchNorm):
+    """ref: contrib.SyncBatchNorm — under SPMD the mesh axis makes plain
+    BatchNorm sync implicitly (stats are computed on the sharded batch and
+    psum'd by XLA when requested via parallel.batch_norm_sync)."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+
+
+class PixelShuffle2D(HybridBlock):
+    def __init__(self, factor, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._factor = int(factor) if not isinstance(factor, (tuple, list)) \
+            else int(factor[0])
+
+    def hybrid_forward(self, F, x):
+        return F.depth_to_space(x, block_size=self._factor)

@@ -1,0 +1,244 @@
+"""Vision transforms (ref: python/mxnet/gluon/data/vision/transforms.py):
+Compose, Cast, ToTensor, Normalize, Resize, CenterCrop, RandomResizedCrop,
+RandomFlipLeftRight/TopBottom, RandomBrightness/Contrast/Saturation/Hue,
+RandomColorJitter, RandomLighting."""
+from __future__ import annotations
+
+import numpy as np
+
+from ....base import MXNetError
+from ....ndarray.ndarray import NDArray, array as nd_array
+from ...block import Block, HybridBlock
+from ...nn import Sequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomHue", "RandomColorJitter", "RandomLighting"]
+
+
+class Compose(Sequential):
+    """ref: transforms.Compose — a Sequential of transforms."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (ref: transforms.ToTensor)."""
+
+    def forward(self, x):
+        a = x.asnumpy().astype("float32") / 255.0
+        if a.ndim == 3:
+            a = a.transpose(2, 0, 1)
+        elif a.ndim == 4:
+            a = a.transpose(0, 3, 1, 2)
+        return nd_array(a)
+
+
+class Normalize(Block):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, dtype="float32")
+        self._std = np.asarray(std, dtype="float32")
+
+    def forward(self, x):
+        a = x.asnumpy()
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return nd_array((a - mean) / std)
+
+
+def _resize_np(a, size):
+    """Bilinear resize in numpy (host-side; the native pipeline owns the
+    fast path)."""
+    h, w = a.shape[:2]
+    if isinstance(size, int):
+        ow, oh = size, size
+    else:
+        ow, oh = size
+    ys = np.linspace(0, h - 1, oh)
+    xs = np.linspace(0, w - 1, ow)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    a = a.astype("float32")
+    out = (a[y0][:, x0] * (1 - wy) * (1 - wx) + a[y1][:, x0] * wy * (1 - wx)
+           + a[y0][:, x1] * (1 - wy) * wx + a[y1][:, x1] * wy * wx)
+    return out
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+
+    def forward(self, x):
+        return nd_array(_resize_np(x.asnumpy(), self._size))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        a = x.asnumpy()
+        h, w = a.shape[:2]
+        cw, ch = self._size
+        y0 = max((h - ch) // 2, 0)
+        x0 = max((w - cw) // 2, 0)
+        out = a[y0:y0 + ch, x0:x0 + cw]
+        if out.shape[:2] != (ch, cw):
+            out = _resize_np(out, (cw, ch))
+        return nd_array(out)
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        a = x.asnumpy()
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self._scale) * area
+            ratio = np.random.uniform(*self._ratio)
+            cw = int(round(np.sqrt(target * ratio)))
+            ch = int(round(np.sqrt(target / ratio)))
+            if cw <= w and ch <= h:
+                x0 = np.random.randint(0, w - cw + 1)
+                y0 = np.random.randint(0, h - ch + 1)
+                crop = a[y0:y0 + ch, x0:x0 + cw]
+                return nd_array(_resize_np(crop, self._size))
+        return nd_array(_resize_np(a, self._size))
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return nd_array(x.asnumpy()[:, ::-1].copy())
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return nd_array(x.asnumpy()[::-1].copy())
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        alpha = 1.0 + np.random.uniform(-self._b, self._b)
+        return nd_array(x.asnumpy().astype("float32") * alpha)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        a = x.asnumpy().astype("float32")
+        alpha = 1.0 + np.random.uniform(-self._c, self._c)
+        gray = a.mean()
+        return nd_array(gray + alpha * (a - gray))
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        a = x.asnumpy().astype("float32")
+        alpha = 1.0 + np.random.uniform(-self._s, self._s)
+        if a.ndim == 3 and a.shape[-1] == 3:
+            gray = (a * np.array([0.299, 0.587, 0.114])).sum(-1, keepdims=True)
+            return nd_array(gray + alpha * (a - gray))
+        return x
+
+
+class RandomHue(Block):
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        a = x.asnumpy().astype("float32")
+        if a.ndim != 3 or a.shape[-1] != 3:
+            return x
+        alpha = np.random.uniform(-self._h, self._h)
+        # cheap hue rotation via YIQ approximation
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        t_yiq = np.array([[0.299, 0.587, 0.114],
+                          [0.596, -0.274, -0.321],
+                          [0.211, -0.523, 0.311]], dtype="float32")
+        t_rgb = np.linalg.inv(t_yiq).astype("float32")
+        rot = np.array([[1, 0, 0], [0, u, -w], [0, w, u]], dtype="float32")
+        m = t_rgb @ rot @ t_yiq
+        return nd_array(a @ m.T)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        for t in np.random.permutation(self._ts):
+            x = t(x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (ref: transforms.RandomLighting)."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], dtype="float32")
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.814],
+                        [-0.5836, -0.6948, 0.4203]], dtype="float32")
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        a = x.asnumpy().astype("float32")
+        if a.ndim != 3 or a.shape[-1] != 3:
+            return x
+        alpha = np.random.normal(0, self._alpha, 3).astype("float32")
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return nd_array(a + rgb)

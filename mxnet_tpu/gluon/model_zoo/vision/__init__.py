@@ -1,0 +1,47 @@
+"""Vision model zoo (ref: python/mxnet/gluon/model_zoo/vision/__init__.py —
+get_model factory over resnet/vgg/alexnet/densenet/squeezenet/inception/
+mobilenet)."""
+from .resnet import *  # noqa: F401,F403
+from . import resnet
+from .alexnet import alexnet, AlexNet
+from .vgg import (vgg11, vgg13, vgg16, vgg19, vgg11_bn, vgg13_bn, vgg16_bn,
+                  vgg19_bn, VGG)
+from .mobilenet import (mobilenet1_0, mobilenet0_75, mobilenet0_5,
+                        mobilenet0_25, mobilenet_v2_1_0, mobilenet_v2_0_75,
+                        mobilenet_v2_0_5, mobilenet_v2_0_25, MobileNet,
+                        MobileNetV2)
+from .squeezenet import squeezenet1_0, squeezenet1_1, SqueezeNet
+from .densenet import densenet121, densenet161, densenet169, densenet201, DenseNet
+from .inception import inception_v3, Inception3
+
+from ....base import MXNetError
+
+_models = {
+    "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
+    "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
+    "resnet152_v1": resnet152_v1, "resnet18_v2": resnet18_v2,
+    "resnet34_v2": resnet34_v2, "resnet50_v2": resnet50_v2,
+    "resnet101_v2": resnet101_v2, "resnet152_v2": resnet152_v2,
+    "alexnet": alexnet,
+    "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
+    "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn, "vgg16_bn": vgg16_bn,
+    "vgg19_bn": vgg19_bn,
+    "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
+    "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
+    "mobilenetv2_1.0": mobilenet_v2_1_0, "mobilenetv2_0.75": mobilenet_v2_0_75,
+    "mobilenetv2_0.5": mobilenet_v2_0_5, "mobilenetv2_0.25": mobilenet_v2_0_25,
+    "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
+    "densenet121": densenet121, "densenet161": densenet161,
+    "densenet169": densenet169, "densenet201": densenet201,
+    "inceptionv3": inception_v3,
+}
+
+
+def get_model(name, **kwargs):
+    """ref: model_zoo/__init__.py::get_model."""
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(
+            f"model {name!r} is not in the model zoo; available: "
+            f"{sorted(_models)}")
+    return _models[name](**kwargs)

@@ -1,0 +1,7 @@
+"""Gluon neural-network layers (ref: python/mxnet/gluon/nn/__init__.py)."""
+from .basic_layers import *  # noqa: F401,F403
+from .conv_layers import *  # noqa: F401,F403
+from . import basic_layers, conv_layers
+from ..block import Block, HybridBlock  # noqa: F401
+
+__all__ = basic_layers.__all__ + conv_layers.__all__

@@ -1,0 +1,273 @@
+"""Convolution / pooling Gluon layers
+(ref: python/mxnet/gluon/nn/conv_layers.py: _Conv, Conv1D/2D/3D,
+Conv1DTranspose/2D/3D, _Pooling, Max/Avg/GlobalMax/GlobalAvg pools,
+ReflectionPad2D)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from .basic_layers import Activation
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _tup(x, n):
+    if isinstance(x, (list, tuple)):
+        return tuple(x)
+    return (x,) * n
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 op_name="Convolution", adj=None, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._channels = channels
+        self._in_channels = in_channels
+        ndim = len(kernel_size)
+        self._kwargs = {
+            "kernel": kernel_size, "stride": strides, "dilate": dilation,
+            "pad": padding, "num_filter": channels, "num_group": groups,
+            "no_bias": not use_bias, "layout": layout}
+        if adj is not None:
+            self._kwargs["adj"] = adj
+        self._op_name = op_name
+        with self.name_scope():
+            if op_name == "Convolution":
+                wshape = (channels, in_channels // groups if in_channels else 0) \
+                    + tuple(kernel_size)
+            else:  # Deconvolution weight is (in, out/groups, *k)
+                wshape = (in_channels, channels // groups if channels else 0) \
+                    + tuple(kernel_size)
+            self.weight = self.params.get(
+                "weight", shape=wshape, init=weight_initializer,
+                allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get("bias", shape=(channels,),
+                                            init=bias_initializer)
+            self.act = Activation(activation, prefix=activation + "_") \
+                if activation is not None else None
+
+    def _infer_param_shapes(self, x, *args):
+        c_axis = 1 if self._kwargs.get("layout", "NCHW")[1] == "C" else x.ndim - 1
+        in_c = int(x.shape[c_axis])
+        w = list(self.weight.shape)
+        if self._op_name == "Convolution":
+            w[1] = in_c // self._kwargs["num_group"]
+        else:
+            w[0] = in_c
+            if w[1] == 0:
+                w[1] = self._channels // self._kwargs["num_group"]
+        self.weight.shape = tuple(w)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        op = getattr(F, self._op_name)
+        out = op(x, weight, bias, **self._kwargs)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(channels, _tup(kernel_size, 1), _tup(strides, 1),
+                         _tup(padding, 1), _tup(dilation, 1), groups, layout,
+                         in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         prefix=prefix, params=params)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, prefix=None,
+                 params=None):
+        super().__init__(channels, _tup(kernel_size, 2), _tup(strides, 2),
+                         _tup(padding, 2), _tup(dilation, 2), groups, layout,
+                         in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         prefix=prefix, params=params)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(channels, _tup(kernel_size, 3), _tup(strides, 3),
+                         _tup(padding, 3), _tup(dilation, 3), groups, layout,
+                         in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         prefix=prefix, params=params)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, prefix=None,
+                 params=None):
+        super().__init__(channels, _tup(kernel_size, 1), _tup(strides, 1),
+                         _tup(padding, 1), _tup(dilation, 1), groups, layout,
+                         in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         op_name="Deconvolution", adj=_tup(output_padding, 1),
+                         prefix=prefix, params=params)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(channels, _tup(kernel_size, 2), _tup(strides, 2),
+                         _tup(padding, 2), _tup(dilation, 2), groups, layout,
+                         in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         op_name="Deconvolution", adj=_tup(output_padding, 2),
+                         prefix=prefix, params=params)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, prefix=None,
+                 params=None):
+        super().__init__(channels, _tup(kernel_size, 3), _tup(strides, 3),
+                         _tup(padding, 3), _tup(dilation, 3), groups, layout,
+                         in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         op_name="Deconvolution", adj=_tup(output_padding, 3),
+                         prefix=prefix, params=params)
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
+                 pool_type, layout, count_include_pad=None, prefix=None,
+                 params=None):
+        super().__init__(prefix, params)
+        if strides is None:
+            strides = pool_size
+        self._kwargs = {
+            "kernel": pool_size, "stride": strides, "pad": padding,
+            "global_pool": global_pool, "pool_type": pool_type,
+            "pooling_convention": "full" if ceil_mode else "valid"}
+        if count_include_pad is not None:
+            self._kwargs["count_include_pad"] = count_include_pad
+
+    def _alias(self):
+        return "pool"
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x, **self._kwargs)
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, prefix=None, params=None):
+        super().__init__(_tup(pool_size, 1), _tup(strides, 1) if strides else None,
+                         _tup(padding, 1), ceil_mode, False, "max", layout,
+                         prefix=prefix, params=params)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, prefix=None, params=None):
+        super().__init__(_tup(pool_size, 2), _tup(strides, 2) if strides else None,
+                         _tup(padding, 2), ceil_mode, False, "max", layout,
+                         prefix=prefix, params=params)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, prefix=None, params=None):
+        super().__init__(_tup(pool_size, 3), _tup(strides, 3) if strides else None,
+                         _tup(padding, 3), ceil_mode, False, "max", layout,
+                         prefix=prefix, params=params)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, prefix=None,
+                 params=None):
+        super().__init__(_tup(pool_size, 1), _tup(strides, 1) if strides else None,
+                         _tup(padding, 1), ceil_mode, False, "avg", layout,
+                         count_include_pad, prefix=prefix, params=params)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 prefix=None, params=None):
+        super().__init__(_tup(pool_size, 2), _tup(strides, 2) if strides else None,
+                         _tup(padding, 2), ceil_mode, False, "avg", layout,
+                         count_include_pad, prefix=prefix, params=params)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 prefix=None, params=None):
+        super().__init__(_tup(pool_size, 3), _tup(strides, 3) if strides else None,
+                         _tup(padding, 3), ceil_mode, False, "avg", layout,
+                         count_include_pad, prefix=prefix, params=params)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, layout="NCW", prefix=None, params=None):
+        super().__init__((1,), None, (0,), False, True, "max", layout,
+                         prefix=prefix, params=params)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, layout="NCHW", prefix=None, params=None):
+        super().__init__((1, 1), None, (0, 0), False, True, "max", layout,
+                         prefix=prefix, params=params)
+
+
+class GlobalMaxPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", prefix=None, params=None):
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "max",
+                         layout, prefix=prefix, params=params)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, layout="NCW", prefix=None, params=None):
+        super().__init__((1,), None, (0,), False, True, "avg", layout,
+                         prefix=prefix, params=params)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, layout="NCHW", prefix=None, params=None):
+        super().__init__((1, 1), None, (0, 0), False, True, "avg", layout,
+                         prefix=prefix, params=params)
+
+
+class GlobalAvgPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", prefix=None, params=None):
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "avg",
+                         layout, prefix=prefix, params=params)
+
+
+class ReflectionPad2D(HybridBlock):
+    def __init__(self, padding=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        if isinstance(padding, int):
+            padding = (0, 0, 0, 0, padding, padding, padding, padding)
+        self._padding = tuple(padding)
+
+    def hybrid_forward(self, F, x):
+        return F.pad(x, mode="reflect", pad_width=self._padding)

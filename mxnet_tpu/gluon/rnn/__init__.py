@@ -1,0 +1,10 @@
+"""Gluon recurrent layers (ref: python/mxnet/gluon/rnn/__init__.py)."""
+from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
+                       SequentialRNNCell, BidirectionalCell, DropoutCell,
+                       ZoneoutCell, ResidualCell, HybridSequentialRNNCell)
+from .rnn_layer import RNN, LSTM, GRU
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "HybridSequentialRNNCell",
+           "BidirectionalCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "RNN", "LSTM", "GRU"]

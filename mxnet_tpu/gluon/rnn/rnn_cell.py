@@ -1,0 +1,408 @@
+"""Recurrent cells (ref: python/mxnet/gluon/rnn/rnn_cell.py):
+RecurrentCell base (state_info/begin_state/unroll), RNNCell, LSTMCell,
+GRUCell, SequentialRNNCell, BidirectionalCell, DropoutCell, ZoneoutCell,
+ResidualCell."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
+           "BidirectionalCell", "DropoutCell", "ZoneoutCell", "ResidualCell"]
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+def _format_sequence(length, inputs, layout, merge):
+    """Normalise inputs to a list of per-step tensors or a merged tensor."""
+    from ... import ndarray as nd
+    from ...ndarray.ndarray import NDArray
+
+    axis = layout.find("T")
+    batch_axis = layout.find("N")
+    if isinstance(inputs, (list, tuple)):
+        seq = list(inputs)
+        if merge:
+            merged = nd.stack(*seq, axis=axis) if isinstance(seq[0], NDArray) \
+                else _jstack(seq, axis)
+            return merged, axis, batch_axis
+        return seq, axis, batch_axis
+    # tensor input
+    if merge:
+        return inputs, axis, batch_axis
+    if isinstance(inputs, NDArray):
+        steps = nd.split(inputs, num_outputs=inputs.shape[axis], axis=axis,
+                         squeeze_axis=True)
+        if inputs.shape[axis] == 1:
+            steps = [steps] if isinstance(steps, NDArray) else steps
+        return list(steps), axis, batch_axis
+    import jax.numpy as jnp
+
+    return [jnp.squeeze(s, axis=axis)
+            for s in jnp.split(inputs, inputs.shape[axis], axis=axis)], \
+        axis, batch_axis
+
+
+def _jstack(seq, axis):
+    import jax.numpy as jnp
+
+    return jnp.stack(seq, axis=axis)
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for c in self._children.values():
+            if hasattr(c, "reset"):
+                c.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        from ... import ndarray as nd
+
+        if func is None:
+            func = nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            states.append(func(tuple(info["shape"]), ctx=ctx, **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Explicit unroll (ref: rnn_cell.py::unroll). Under hybridize the
+        whole unroll is traced into one XLA program."""
+        self.reset()
+        inputs_list, axis, batch_axis = _format_sequence(
+            length, inputs, layout, False)
+        if begin_state is None:
+            bs = inputs_list[0].shape[batch_axis] if batch_axis < 1 else \
+                inputs_list[0].shape[0]
+            begin_state = self.begin_state(batch_size=bs,
+                                           ctx=getattr(inputs_list[0], "ctx", None))
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs_list[i], states)
+            outputs.append(output)
+        if valid_length is not None:
+            from ... import ndarray as nd
+
+            stacked = nd.stack(*outputs, axis=axis)
+            stacked = nd.sequence_mask(stacked, valid_length,
+                                       use_sequence_length=True, axis=axis)
+            if merge_outputs is False:
+                outputs = nd.split(stacked, num_outputs=length, axis=axis,
+                                   squeeze_axis=True)
+            else:
+                outputs = stacked
+            return outputs, states
+        if merge_outputs:
+            from ... import ndarray as nd
+
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        return super().forward(inputs, states)
+
+    def _alias(self):
+        return "rnn"
+
+
+HybridRecurrentCell = RecurrentCell
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None):
+        super().__init__(prefix, params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(hidden_size, hidden_size),
+                init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(hidden_size,), init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(hidden_size,), init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def _infer_param_shapes(self, x, *args):
+        self.i2h_weight.shape = (self._hidden_size, int(x.shape[-1]))
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        output = F.Activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class LSTMCell(RecurrentCell):
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None, activation="tanh", recurrent_activation="sigmoid"):
+        super().__init__(prefix, params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, hidden_size),
+                init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def _infer_param_shapes(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, int(x.shape[-1]))
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        slices = F.split(gates, num_outputs=4, axis=1)
+        i = F.sigmoid(slices[0])
+        f = F.sigmoid(slices[1])
+        g = F.tanh(slices[2])
+        o = F.sigmoid(slices[3])
+        c = f * states[1] + i * g
+        h = o * F.tanh(c)
+        return h, [h, c]
+
+
+class GRUCell(RecurrentCell):
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None):
+        super().__init__(prefix, params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(3 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(3 * hidden_size, hidden_size),
+                init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(3 * hidden_size,),
+                init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(3 * hidden_size,),
+                init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def _infer_param_shapes(self, x, *args):
+        self.i2h_weight.shape = (3 * self._hidden_size, int(x.shape[-1]))
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prev = states[0]
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        h2h = F.FullyConnected(prev, h2h_weight, h2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        i2h_r, i2h_z, i2h_n = F.split(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h_n = F.split(h2h, num_outputs=3, axis=1)
+        r = F.sigmoid(i2h_r + h2h_r)
+        z = F.sigmoid(i2h_z + h2h_z)
+        n = F.tanh(i2h_n + r * h2h_n)
+        h = (1 - z) * n + z * prev
+        return h, [h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def hybrid_forward(self, F, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+
+HybridSequentialRNNCell = SequentialRNNCell
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__(prefix=base_cell.prefix + self._alias() + "_",
+                         params=None)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, **kwargs):
+        return self.base_cell.begin_state(**kwargs)
+
+
+class ZoneoutCell(ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def hybrid_forward(self, F, inputs, states):
+        next_output, next_states = self.base_cell(inputs, states)
+        if self.zoneout_outputs > 0:
+            mask = F.Dropout(F.ones_like(next_output), p=self.zoneout_outputs)
+            prev = self._prev_output if self._prev_output is not None \
+                else F.zeros_like(next_output)
+            next_output = F.where(mask, next_output, prev)
+        if self.zoneout_states > 0:
+            new_states = []
+            for ns, s in zip(next_states, states):
+                mask = F.Dropout(F.ones_like(ns), p=self.zoneout_states)
+                new_states.append(F.where(mask, ns, s))
+            next_states = new_states
+        self._prev_output = next_output
+        return next_output, next_states
+
+
+class ResidualCell(ModifierCell):
+    def _alias(self):
+        return "residual"
+
+    def hybrid_forward(self, F, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+        self._output_prefix = output_prefix
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot be stepped; use unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as nd
+
+        self.reset()
+        inputs_list, axis, batch_axis = _format_sequence(length, inputs,
+                                                         layout, False)
+        bs = inputs_list[0].shape[batch_axis - 1 if axis < batch_axis else batch_axis]
+        if begin_state is None:
+            begin_state = self.begin_state(
+                batch_size=inputs_list[0].shape[0],
+                ctx=getattr(inputs_list[0], "ctx", None))
+        l_cell, r_cell = self._children.values()
+        n_l = len(l_cell.state_info())
+        l_out, l_states = l_cell.unroll(length, inputs_list,
+                                        begin_state[:n_l], layout, False,
+                                        valid_length)
+        rev_inputs = list(reversed(inputs_list))
+        r_out, r_states = r_cell.unroll(length, rev_inputs,
+                                        begin_state[n_l:], layout, False,
+                                        valid_length)
+        r_out = list(reversed(r_out))
+        outputs = [nd.concat(l, r, dim=1) for l, r in zip(l_out, r_out)]
+        if merge_outputs:
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, l_states + r_states

@@ -1,0 +1,189 @@
+"""Fused RNN layers (ref: python/mxnet/gluon/rnn/rnn_layer.py: _RNNLayer,
+RNN, LSTM, GRU) over the fused scan-based RNN op (ops/rnn.py)."""
+from __future__ import annotations
+
+from ... import autograd as ag
+from ... import random as rnd
+from ...base import MXNetError
+from ..block import HybridBlock, current_trace
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, mode,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        self._mode = mode
+        super().__init__(prefix, params)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"invalid layout {layout}; must be TNC or NTC")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._mode = mode
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        g = self._gates
+        with self.name_scope():
+            # one Parameter per matrix (gluon layout: {l}{dir}_{i2h,h2h}_*),
+            # packed into the fused op's flat vector at forward time
+            for layer in range(num_layers):
+                for d in range(self._dir):
+                    suffix = "l" if d == 0 else "r"
+                    in_sz = input_size if layer == 0 else hidden_size * self._dir
+                    setattr(self, f"{suffix}{layer}_i2h_weight",
+                            self.params.get(
+                                f"{suffix}{layer}_i2h_weight",
+                                shape=(g * hidden_size, in_sz),
+                                init=i2h_weight_initializer,
+                                allow_deferred_init=True))
+                    setattr(self, f"{suffix}{layer}_h2h_weight",
+                            self.params.get(
+                                f"{suffix}{layer}_h2h_weight",
+                                shape=(g * hidden_size, hidden_size),
+                                init=h2h_weight_initializer))
+                    setattr(self, f"{suffix}{layer}_i2h_bias",
+                            self.params.get(
+                                f"{suffix}{layer}_i2h_bias",
+                                shape=(g * hidden_size,),
+                                init=i2h_bias_initializer))
+                    setattr(self, f"{suffix}{layer}_h2h_bias",
+                            self.params.get(
+                                f"{suffix}{layer}_h2h_bias",
+                                shape=(g * hidden_size,),
+                                init=h2h_bias_initializer))
+
+    def _alias(self):
+        return self._mode
+
+    def state_info(self, batch_size=0):
+        if self._mode == "lstm":
+            return [
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        from ... import ndarray as nd
+
+        if func is None:
+            func = nd.zeros
+        return [func(tuple(info["shape"]), ctx=ctx, **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def _infer_param_shapes(self, x, *args):
+        in_sz = int(x.shape[-1])
+        g = self._gates
+        for d in range(self._dir):
+            suffix = "l" if d == 0 else "r"
+            getattr(self, f"{suffix}0_i2h_weight").shape = \
+                (g * self._hidden_size, in_sz)
+
+    def _ordered_params(self):
+        """cudnn packing: all weights (layer-major, dir-minor, i2h then
+        h2h), then all biases."""
+        names = []
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                s = "l" if d == 0 else "r"
+                names.append(f"{s}{layer}_i2h_weight")
+                names.append(f"{s}{layer}_h2h_weight")
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                s = "l" if d == 0 else "r"
+                names.append(f"{s}{layer}_i2h_bias")
+                names.append(f"{s}{layer}_h2h_bias")
+        return names
+
+    def hybrid_forward(self, F, inputs, states=None, **params):
+        is_nd = hasattr(inputs, "asnumpy")
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+        batch = inputs.shape[1]
+        skip_states = states is None
+        if skip_states:
+            from ... import ndarray as nd
+
+            if is_nd:
+                states = self.begin_state(batch, ctx=inputs.ctx)
+            else:
+                import jax.numpy as jnp
+
+                states = [jnp.zeros(info["shape"])
+                          for info in self.state_info(batch)]
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        flat_names = self._ordered_params()
+        parts = [params[n] for n in flat_names]
+        if is_nd:
+            from ... import ndarray as nd
+
+            packed = nd.concat(*[p.reshape((-1,)) for p in parts], dim=0)
+        else:
+            import jax.numpy as jnp
+
+            packed = jnp.concatenate([p.reshape(-1) for p in parts])
+        ts = current_trace()
+        train = ts.train if ts is not None else ag.is_training()
+        args = [inputs, packed, states[0]]
+        if self._mode == "lstm":
+            args.append(states[1])
+        else:
+            args.append(None)
+        key = rnd.next_key() if (self._dropout > 0 and train) else None
+        res = F.RNN(*args, key, state_size=self._hidden_size,
+                    num_layers=self._num_layers, mode=self._mode,
+                    bidirectional=self._dir == 2, p=self._dropout,
+                    state_outputs=True, _train=train) \
+            if not is_nd else self._nd_rnn(args, key, train)
+        if self._mode == "lstm":
+            out, h, c = res
+            out_states = [h, c]
+        else:
+            out, h = res
+            out_states = [h]
+        if self._layout == "NTC":
+            out = F.swapaxes(out, dim1=0, dim2=1)
+        return out if skip_states else (out, out_states)
+
+    def _nd_rnn(self, args, key, train):
+        from ...ops.registry import invoke
+
+        return invoke("RNN", *args, key, state_size=self._hidden_size,
+                      num_layers=self._num_layers, mode=self._mode,
+                      bidirectional=self._dir == 2, p=self._dropout,
+                      state_outputs=True, _train=train)
+
+
+class RNN(_RNNLayer):
+    """ref: rnn_layer.py::RNN (mode rnn_relu|rnn_tanh)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         "rnn_relu" if activation == "relu" else "rnn_tanh",
+                         **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "lstm", **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "gru", **kwargs)

@@ -1,0 +1,166 @@
+"""Gluon Trainer (ref: python/mxnet/gluon/trainer.py): applies an Optimizer
+to a ParameterDict, syncing gradients through a KVStore.
+
+step(batch_size) = allreduce_grads() + update() — identical contract to the
+reference (CS2 in SURVEY.md).  On a sharded mesh the allreduce is in-graph
+(psum inserted by XLA via the parallel module); here the KVStore handles
+replica reduction + optional DCN sync.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from .. import kvstore as kvs_mod
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = [params[k] for k in sorted(params.keys())] \
+                if isinstance(params, dict) else list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a ParameterDict/dict/list")
+        self._params: List[Parameter] = []
+        self._param2idx: Dict[str, int] = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"invalid parameter {p!r}")
+            self._param2idx[p.name] = i
+            self._params.append(p)
+            p._trainer = self
+        optimizer_params = optimizer_params or {}
+        self._scale = optimizer_params.get("rescale_grad", 1.0)
+        self._init_optimizer(optimizer, optimizer_params)
+        self._compression_params = compression_params
+        self._kvstore_kind = kvstore
+        self._kvstore: Optional[kvs_mod.KVStore] = None
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = False
+        self._states_to_load = None
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params and set(optimizer_params) - {"rescale_grad"}:
+                raise MXNetError(
+                    "optimizer_params must be None when optimizer is an instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer, param_dict=param_dict,
+                                             **optimizer_params)
+        # one updater per context replica (ref: Trainer._updaters) — each
+        # replica must own its optimizer state; allocated lazily once the
+        # context list is known
+        self._updaters: List[opt_mod.Updater] = []
+
+    def _init_kvstore(self):
+        if self._kvstore_kind is None or self._kvstore_kind is False:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            kind = self._kvstore_kind if isinstance(self._kvstore_kind, str) \
+                else "device"
+            self._kvstore = self._kvstore_kind \
+                if isinstance(self._kvstore_kind, kvs_mod.KVStore) \
+                else kvs_mod.create(kind)
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(self._compression_params)
+            if self._update_on_kvstore is None:
+                # single-worker: local update is cheaper (no store copies)
+                self._update_on_kvstore = self._kvstore.type.startswith("dist")
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+        if self._states_to_load is not None:
+            self.load_states(self._states_to_load)
+            self._states_to_load = None
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size: int, ignore_stale_grad: bool = False):
+        """Forward through KVStore then optimizer (ref: Trainer.step)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            grads = p.list_grad()
+            if self._update_on_kvstore:
+                # server-side update: push grads, pull fresh weights
+                self._kvstore.pushpull(i, grads, out=p.list_data())
+            elif len(grads) > 1 or self._kvstore.type.startswith("dist"):
+                self._kvstore.push(i, grads)
+                self._kvstore.pull(i, out=grads)
+
+    def update(self, batch_size: int, ignore_stale_grad: bool = False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad: bool = False):
+        if self._update_on_kvstore:
+            return  # weights already refreshed by pushpull
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            for r, (data, grad) in enumerate(zip(p.list_data(),
+                                                 p.list_grad())):
+                while len(self._updaters) <= r:
+                    self._updaters.append(opt_mod.get_updater(self._optimizer))
+                self._updaters[r](i, grad, data)
+
+    def save_states(self, fname: str):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=False)
+        else:
+            if not self._updaters:
+                self._updaters.append(opt_mod.get_updater(self._optimizer))
+            with open(fname, "wb") as f:
+                f.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname: str):
+        if not self._kv_initialized:
+            self._states_to_load = fname
+            return
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            if not self._updaters:
+                self._updaters.append(opt_mod.get_updater(self._optimizer))
+            with open(fname, "rb") as f:
+                self._updaters[0].set_states(f.read())

@@ -1,0 +1,95 @@
+"""Gluon utilities (ref: python/mxnet/gluon/utils.py): split_and_load,
+split_data, clip_global_norm, check_sha1, download stub."""
+from __future__ import annotations
+
+import hashlib
+import math
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray.ndarray import NDArray, array as nd_array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True) -> List[NDArray]:
+    """Slice a batch along batch_axis into num_slice chunks
+    (ref: utils.py::split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}")
+    step = size // num_slice
+    if not even_split and size % num_slice != 0:
+        slices = []
+        for i in range(num_slice):
+            begin = int(round(i * size / num_slice))
+            end = int(round((i + 1) * size / num_slice))
+            idx = [slice(None)] * data.ndim
+            idx[batch_axis] = slice(begin, end)
+            slices.append(data[tuple(idx)])
+        return slices
+    out = []
+    for i in range(num_slice):
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(i * step, (i + 1) * step)
+        out.append(data[tuple(idx)])
+    return out
+
+
+def split_and_load(data, ctx_list: Sequence[Context], batch_axis: int = 0,
+                   even_split: bool = True) -> List[NDArray]:
+    """Slice and scatter across contexts (ref: utils.py::split_and_load) —
+    the Gluon data-parallel entry point."""
+    if not isinstance(data, NDArray):
+        data = nd_array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(c) for s, c in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: Sequence[NDArray], max_norm: float,
+                     check_isfinite: bool = True) -> float:
+    """Rescale grads so the global L2 norm <= max_norm
+    (ref: utils.py::clip_global_norm)."""
+    if not arrays:
+        raise MXNetError("no arrays given")
+    total = 0.0
+    for a in arrays:
+        n = a.norm().asscalar()
+        total += float(n) ** 2
+    total = math.sqrt(total)
+    if check_isfinite and not math.isfinite(total):
+        raise MXNetError(f"global norm is not finite ({total})")
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._data = a.data * scale
+    return total
+
+
+def check_sha1(filename: str, sha1_hash: str) -> bool:
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Zero-egress environment: downloads are unavailable; datasets must be
+    staged locally (ref: utils.py::download)."""
+    raise MXNetError(
+        "download() is unavailable in this offline build; place the file "
+        f"locally and pass its path (requested: {url})")

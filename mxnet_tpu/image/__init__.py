@@ -1,0 +1,291 @@
+"""Image IO + augmenters (ref: python/mxnet/image/image.py).
+
+The reference decodes with OpenCV; this container has no OpenCV, so
+decode/encode route through TensorFlow's CPU image codecs (installed),
+with a raw-npy fallback.  Augmenter classes mirror the reference's
+CreateAugmenter family; heavy ImageNet-scale decode belongs to the
+native pipeline.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array as nd_array
+
+__all__ = ["imread", "imdecode", "imdecode_np", "imencode", "imresize",
+           "resize_short", "fixed_crop", "center_crop", "random_crop",
+           "color_normalize", "CreateAugmenter", "Augmenter",
+           "ResizeAug", "ForceResizeAug", "RandomCropAug", "CenterCropAug",
+           "HorizontalFlipAug", "CastAug", "ColorNormalizeAug",
+           "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+           "RandomOrderAug"]
+
+_TF = None
+
+
+def _tf():
+    global _TF
+    if _TF is None:
+        import tensorflow as tf
+
+        tf.config.set_visible_devices([], "GPU")
+        _TF = tf
+    return _TF
+
+
+def imdecode_np(buf: bytes, iscolor: int = 1) -> np.ndarray:
+    """Decode JPEG/PNG bytes to an HWC uint8 numpy array."""
+    if len(buf) >= 6 and buf[:6] == b"\x93NUMPY":
+        import io
+
+        return np.load(io.BytesIO(buf))
+    tf = _tf()
+    img = tf.io.decode_image(buf, channels=3 if iscolor else 1,
+                             expand_animations=False)
+    return img.numpy()
+
+
+def imdecode(buf, flag: int = 1, to_rgb: int = 1, out=None) -> NDArray:
+    """ref: image.py::imdecode (flag 1=color, 0=gray)."""
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    return nd_array(imdecode_np(bytes(buf), flag))
+
+
+def imencode(img: np.ndarray, quality: int = 95, fmt: str = ".jpg") -> bytes:
+    if isinstance(img, NDArray):
+        img = img.asnumpy()
+    img = np.ascontiguousarray(img).astype(np.uint8)
+    tf = _tf()
+    if fmt in (".jpg", ".jpeg"):
+        return tf.io.encode_jpeg(img, quality=quality).numpy()
+    if fmt == ".png":
+        return tf.io.encode_png(img).numpy()
+    raise MXNetError(f"unsupported image format {fmt}")
+
+
+def imread(filename: str, flag: int = 1, to_rgb: int = 1) -> NDArray:
+    """ref: image.py::imread."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src, w: int, h: int, interp: int = 1) -> NDArray:
+    from ..gluon.data.vision.transforms import _resize_np
+
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    return nd_array(_resize_np(a, (w, h)))
+
+
+def resize_short(src, size: int, interp: int = 2) -> NDArray:
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = a.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(a, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2) -> NDArray:
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    out = a[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(out, size[0], size[1], interp)
+    return nd_array(out)
+
+
+def center_crop(src, size, interp=2):
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = a.shape[:2]
+    new_w, new_h = size
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    out = fixed_crop(a, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = a.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = np.random.randint(0, w - new_w + 1)
+    y0 = np.random.randint(0, h - new_h + 1)
+    out = fixed_crop(a, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None) -> NDArray:
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    a = a.astype("float32") - np.asarray(mean, dtype="float32")
+    if std is not None:
+        a = a / np.asarray(std, dtype="float32")
+    return nd_array(a)
+
+
+class Augmenter:
+    """ref: image.py::Augmenter."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if np.random.rand() < self.p:
+            return nd_array(src.asnumpy()[:, ::-1].copy())
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + np.random.uniform(-self.brightness, self.brightness)
+        return nd_array(src.asnumpy().astype("float32") * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        a = src.asnumpy().astype("float32")
+        alpha = 1.0 + np.random.uniform(-self.contrast, self.contrast)
+        gray = a.mean()
+        return nd_array(gray + alpha * (a - gray))
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        a = src.asnumpy().astype("float32")
+        alpha = 1.0 + np.random.uniform(-self.saturation, self.saturation)
+        gray = (a * np.array([0.299, 0.587, 0.114])).sum(-1, keepdims=True)
+        return nd_array(gray + alpha * (a - gray))
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for t in np.random.permutation(self.ts):
+            src = t(src)
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """ref: image.py::CreateAugmenter — the standard augmenter pipeline."""
+    auglist: List[Augmenter] = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    jitters = []
+    if brightness > 0:
+        jitters.append(BrightnessJitterAug(brightness))
+    if contrast > 0:
+        jitters.append(ContrastJitterAug(contrast))
+    if saturation > 0:
+        jitters.append(SaturationJitterAug(saturation))
+    if jitters:
+        auglist.append(RandomOrderAug(jitters))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist

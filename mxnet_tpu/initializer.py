@@ -1,0 +1,234 @@
+"""Weight initializers (ref: python/mxnet/initializer.py — Xavier, MSRAPrelu,
+Normal, Uniform, Orthogonal, Constant, Zero, One, Bilinear, Mixed, Load;
+registry + InitDesc attribute-based dispatch)."""
+from __future__ import annotations
+
+import math
+import re
+from typing import Optional
+
+import numpy as np
+
+from .base import MXNetError, Registry
+
+__all__ = ["Initializer", "register", "create", "Zero", "One", "Constant",
+           "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu",
+           "Bilinear", "LSTMBias", "Mixed", "InitDesc"]
+
+_REG: Registry = Registry("initializer")
+register = _REG.register
+
+
+class InitDesc(str):
+    """Parameter name carrying init attrs (ref: initializer.py::InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    """Base initializer; subclasses implement _init_weight(name, arr)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr):
+        """Dispatch by parameter-name convention (ref: Initializer.__call__):
+        *_bias/beta -> zero, gamma -> one, *_weight -> _init_weight, etc."""
+        if not isinstance(name, str):
+            name = str(name)
+        if isinstance(name, InitDesc):
+            attr_init = name.attrs.get("__init__")
+            if attr_init:
+                create(attr_init).init_array(name, arr)
+                return
+        n = name.lower()
+        if n.endswith("bias") or n.endswith("beta") or n.endswith("moving_mean"):
+            self._init_zero(name, arr)
+        elif n.endswith("gamma") or n.endswith("moving_var") or n.endswith("running_var"):
+            self._init_one(name, arr)
+        elif n.endswith("min") or n.endswith("max"):
+            self._init_zero(name, arr)
+        else:
+            self._init_weight(name, arr)
+
+    def init_array(self, name, arr):
+        """Unconditional init of `arr` with this initializer's distribution."""
+        self._init_weight(name, arr)
+
+    def _init_zero(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+    def dumps(self):
+        import json
+
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+
+def create(init, **kwargs) -> Initializer:
+    if isinstance(init, Initializer):
+        return init
+    if init is None:
+        return Uniform(0.07)
+    if isinstance(init, str):
+        return _REG.get(init)(**kwargs)
+    raise MXNetError(f"cannot create initializer from {init!r}")
+
+
+@register("zeros")
+@register("zero")
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+
+
+@register("ones")
+@register("one")
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 1.0
+
+
+@register("constant")
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr[:] = self.value
+
+
+@register("uniform")
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr[:] = np.random.uniform(-self.scale, self.scale, arr.shape)
+
+
+@register("normal")
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr[:] = np.random.normal(0.0, self.sigma, arr.shape)
+
+
+@register("orthogonal")
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = (self.scale * q).reshape(arr.shape)
+
+
+@register("xavier")
+class Xavier(Initializer):
+    """ref: initializer.py::Xavier (rnd_type uniform|gaussian,
+    factor_type avg|in|out, magnitude)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = float(np.prod(shape[2:])) if len(shape) > 2 else 1.0
+        fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw_scale
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError(f"bad factor_type {self.factor_type}")
+        scale = math.sqrt(self.magnitude / max(factor, 1.0))
+        if self.rnd_type == "uniform":
+            arr[:] = np.random.uniform(-scale, scale, shape)
+        elif self.rnd_type == "gaussian":
+            arr[:] = np.random.normal(0, scale, shape)
+        else:
+            raise MXNetError(f"bad rnd_type {self.rnd_type}")
+
+
+@register("msraprelu")
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register("bilinear")
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        weight = np.zeros(arr.shape, dtype="float32")
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight
+
+
+@register("lstmbias")
+class LSTMBias(Initializer):
+    """Forget-gate bias to 1 (ref: initializer.py::LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+        n = arr.shape[0] // 4
+        arr[n:2 * n] = self.forget_bias
+
+
+@register("mixed")
+class Mixed(Initializer):
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for pat, init in self.map:
+            if pat.match(str(name)):
+                init(name, arr)
+                return
+        raise MXNetError(f"parameter {name} did not match any pattern")

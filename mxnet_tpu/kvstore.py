@@ -1,0 +1,211 @@
+"""KVStore: the data-parallel gradient-sync layer.
+
+TPU-native counterpart of src/kvstore/** and python/mxnet/kvstore.py.
+The reference has three transports behind one API (in-process reduce,
+NCCL allreduce, ps-lite parameter server).  Here there is ONE collective
+substrate — XLA collectives — behind the same API:
+
+  * 'local' / 'device'  — in-process reduction across the NDArray replicas
+    the caller hands in (ref: src/kvstore/kvstore_local.cc + comm.h).
+  * 'xla' ('nccl' accepted as a compat alias — ref kvstore_nccl.h) —
+    same API; when running under an SPMD mesh (mxnet_tpu.parallel) the
+    reduction is an in-graph psum over ICI, which XLA fuses into the
+    step; eagerly it falls back to the local reduce.
+  * 'dist_sync' / 'dist_device_sync' / 'dist_async' — multi-process over
+    DCN via jax.distributed (see mxnet_tpu.parallel.dist); push/pull map
+    onto process-group allreduce.  dist_async is served by the same path
+    (documented emulation: sync semantics are a superset).
+
+set_optimizer/updater semantics (server-side optimizer when
+update_on_kvstore, ref kvstore_dist_server.h) are preserved.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+from . import optimizer as opt_mod
+
+__all__ = ["KVStore", "create"]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class KVStore:
+    def __init__(self, kind: str):
+        self._kind = kind
+        self._store: Dict[Union[int, str], NDArray] = {}
+        self._updater: Optional[Callable] = None
+        self._optimizer: Optional[opt_mod.Optimizer] = None
+        self._compression = {}
+
+    # ---- identity --------------------------------------------------------
+    @property
+    def type(self) -> str:
+        return self._kind
+
+    @property
+    def rank(self) -> int:
+        if self._kind.startswith("dist"):
+            return jax.process_index()
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        if self._kind.startswith("dist"):
+            return jax.process_count()
+        return 1
+
+    # ---- core API --------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            vv = v[0] if isinstance(v, list) else v
+            self._store[k] = vv.copy()
+
+    def push(self, key, value, priority: int = 0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            agg = self._reduce(_as_list(v))
+            if self._kind.startswith("dist"):
+                agg = self._dcn_allreduce(agg)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError(f"kvstore key {k} not initialized")
+                self._updater(_key_int(k), agg, self._store[k])
+            else:
+                self._store[k] = agg
+
+    def pull(self, key, out=None, priority: int = 0, ignore_sparse=True):
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"kvstore key {k} not initialized")
+            src = self._store[k]
+            for dst in _as_list(o):
+                dst._data = src.as_in_context(dst.ctx).data
+
+    def pushpull(self, key, value, out=None, priority: int = 0):
+        """Fused push+pull (ref: MXKVStorePushPullEx). Without an updater
+        this is a pure allreduce — the hot path for Trainer."""
+        keys, values = self._normalize(key, value)
+        _, outs = self._normalize(key, out if out is not None else value)
+        for k, v, o in zip(keys, values, outs):
+            agg = self._reduce(_as_list(v))
+            if self._kind.startswith("dist"):
+                agg = self._dcn_allreduce(agg)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError(f"kvstore key {k} not initialized")
+                self._updater(_key_int(k), agg, self._store[k])
+                agg = self._store[k]
+            for dst in _as_list(o):
+                dst._data = agg.as_in_context(dst.ctx).data
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Sparse pull emulation: dense pull then row gather
+        (ref: kvstore row_sparse_pull; TPU has no PS-sharded rows)."""
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        keys, outs = self._normalize(key, out)
+        rids = _as_list(row_ids)
+        for k, o in zip(keys, outs):
+            src = self._store[k]
+            for dst, rid in zip(_as_list(o), rids):
+                rows = src.data[rid.data.astype(jnp.int32)]
+                full = jnp.zeros(src.shape, src.data.dtype).at[
+                    rid.data.astype(jnp.int32)].set(rows)
+                dst._data = jax.device_put(full, dst.ctx.jax_device)
+
+    # ---- optimizer hookup -----------------------------------------------
+    def set_optimizer(self, optimizer: opt_mod.Optimizer):
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def set_updater(self, updater: Callable):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params: dict):
+        """2-bit gradient compression (ref: GradientCompression).
+        Accepted for API parity; XLA collectives run uncompressed over ICI
+        (see also EQuARX-style quantized allreduce as future work)."""
+        self._compression = dict(compression_params)
+
+    def save_optimizer_states(self, fname: str, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname: str):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        if self._kind.startswith("dist"):
+            from .parallel import dist
+
+            dist.barrier()
+
+    # ---- internals -------------------------------------------------------
+    def _reduce(self, vals: List[NDArray]) -> NDArray:
+        """Local reduction across device replicas (ref: comm.h CommDevice)."""
+        if len(vals) == 1:
+            return vals[0].copy()
+        acc = vals[0].data
+        dev = vals[0].ctx.jax_device
+        for v in vals[1:]:
+            d = v.data
+            if list(d.devices()) != [dev]:
+                d = jax.device_put(d, dev)
+            acc = acc + d
+        return NDArray(acc, ctx=vals[0].ctx)
+
+    def _dcn_allreduce(self, val: NDArray) -> NDArray:
+        from .parallel import dist
+
+        return dist.allreduce_nd(val)
+
+    def _normalize(self, key, value):
+        keys = _as_list(key)
+        if value is None:
+            return keys, [None] * len(keys)
+        if len(keys) == 1:
+            return keys, [value]
+        vals = _as_list(value)
+        if len(vals) != len(keys):
+            # grouped: values per key are lists
+            raise MXNetError("key/value length mismatch")
+        return keys, vals
+
+    def __repr__(self):
+        return f"KVStore(type={self._kind}, keys={len(self._store)})"
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return abs(hash(k)) % (2 ** 31)
+
+
+_VALID = {"local", "device", "xla", "nccl", "dist", "dist_sync", "dist_async",
+          "dist_device_sync"}
+
+
+def create(name: str = "local") -> KVStore:
+    """ref: kvstore.create / KVStore::Create factory."""
+    if name not in _VALID:
+        raise MXNetError(f"unknown kvstore type {name!r}; valid: {sorted(_VALID)}")
+    if name == "nccl":
+        name = "xla"  # compat alias: the ICI collective store
+    return KVStore(name)

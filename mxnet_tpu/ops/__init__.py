@@ -11,6 +11,7 @@ from .registry import (OP_REGISTRY, Operator, apply_pure, get_op, invoke,
 # registration side effects
 from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
+from . import rnn  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import random_ops  # noqa: F401
 

@@ -86,19 +86,36 @@ def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                    pad=(), adj=(), num_filter=0, num_group=1, no_bias=False,
                    target_shape=None, layout=None, workspace=1024,
                    cudnn_tune=None, cudnn_off=False):
+    """Transposed conv as lhs-dilated direct conv (full dilate/adj/groups/
+    target_shape support).  out = (in-1)*s - 2p + (k-1)*d + 1 + adj."""
     nd = len(kernel)
     stride = tuple(stride) if stride else (1,) * nd
     pad = tuple(pad) if pad else (0,) * nd
-    # MXNet deconv weight layout is (in, out/group, *k); with
-    # transpose_kernel=True jax swaps the I/O axes of the spec, so the spec
-    # must name them O,I for axes 0,1 to land on (in, out) correctly.
+    dilate = tuple(dilate) if dilate else (1,) * nd
+    k_eff = tuple((k - 1) * d + 1 for k, d in zip(kernel, dilate))
+    if target_shape:
+        adj = tuple(
+            t - ((data.shape[2 + i] - 1) * stride[i] - 2 * pad[i] + k_eff[i])
+            for i, t in enumerate(target_shape))
+    else:
+        adj = tuple(adj) if adj else (0,) * nd
+    # weight (in, out/g, *k) -> flipped, regrouped to (out, in/g, *k)
+    in_c = weight.shape[0]
+    out_g = weight.shape[1]
+    spatial = tuple(range(2, 2 + nd))
+    w = jnp.flip(weight, axis=spatial)
+    w = w.reshape((num_group, in_c // num_group, out_g) + tuple(kernel))
+    w = jnp.swapaxes(w, 1, 2)
+    w = w.reshape((num_group * out_g, in_c // num_group) + tuple(kernel))
     dn = {1: ("NCH", "OIH", "NCH"),
           2: ("NCHW", "OIHW", "NCHW"),
           3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
-    out = lax.conv_transpose(
-        data, weight, strides=stride,
-        padding=[(p, p) for p in pad],
-        dimension_numbers=dn, transpose_kernel=True)
+    pads = [(k_eff[i] - 1 - pad[i], k_eff[i] - 1 - pad[i] + adj[i])
+            for i in range(nd)]
+    out = lax.conv_general_dilated(
+        data, w, window_strides=(1,) * nd, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group)
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out
@@ -287,21 +304,38 @@ def _softmin(data, axis=-1):
     return jax.nn.softmax(-data, axis=axis)
 
 
-@jax.custom_vjp
-def _softmax_output_core(data, label):
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
+                         normalization):
     return jax.nn.softmax(data, axis=-1)
 
 
-def _softmax_output_fwd(data, label):
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        normalization):
     out = jax.nn.softmax(data, axis=-1)
     return out, (out, label)
 
 
-def _softmax_output_bwd(res, g):
+def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, normalization,
+                        res, g):
     out, label = res
-    onehot = jax.nn.one_hot(label.astype(jnp.int32), out.shape[-1], dtype=out.dtype)
-    # reference semantics: backward ignores upstream grad, emits CE grad
-    return ((out - onehot) / out.shape[0], jnp.zeros_like(label))
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), out.shape[-1],
+                            dtype=out.dtype)
+    # reference semantics (softmax_output-inl.h): backward ignores the
+    # upstream grad and emits (softmax - one_hot) * grad_scale, normalized
+    # per the `normalization` attr ('null' | 'batch' | 'valid')
+    grad = out - onehot
+    valid = None
+    if use_ignore:
+        keep = (label.astype(jnp.int32) != int(ignore_label))
+        grad = grad * keep[..., None].astype(grad.dtype)
+        valid = jnp.maximum(jnp.sum(keep), 1)
+    if normalization == "batch":
+        grad = grad / out.shape[0]
+    elif normalization == "valid":
+        denom = valid if valid is not None else out.shape[0]
+        grad = grad / denom
+    return (grad * grad_scale, jnp.zeros_like(label))
 
 
 _softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
@@ -312,8 +346,10 @@ def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1,
                     use_ignore=False, multi_output=False, preserve_shape=False,
                     normalization="null", out_grad=False, smooth_alpha=0.0):
     """Legacy symbolic loss head (ref: softmax_output-inl.h): forward =
-    softmax, backward = softmax - one_hot(label), via custom_vjp."""
-    return _softmax_output_core(data, label)
+    softmax, backward = (softmax - one_hot(label)) * grad_scale with the
+    requested normalization, via custom_vjp."""
+    return _softmax_output_core(data, label, grad_scale, ignore_label,
+                                use_ignore, normalization)
 
 
 # ---------------------------------------------------------------------------
@@ -371,7 +407,9 @@ def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
     blank = 0 if blank_label == "first" else alphabet - 1
     lab = label.astype(jnp.int32)
     L = lab.shape[1]
-    lab_valid = lab >= 0 if blank_label == "first" else lab > 0
+    # 'first': blank=0, real labels live in [1, alphabet); 0/-1 pad.
+    # 'last': blank=alphabet-1, real labels in [0, alphabet-1); -1 pads.
+    lab_valid = lab > 0 if blank_label == "first" else lab >= 0
     lab_len = (jnp.sum(lab_valid, axis=1) if not use_label_lengths
                else label_lengths.astype(jnp.int32))
     # extended label sequence with blanks: length 2L+1
@@ -393,9 +431,18 @@ def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
         merged = jnp.logaddexp(alpha, prev1)
         merged = jnp.where(allow_skip, jnp.logaddexp(merged, prev2), merged)
         emit = jnp.take_along_axis(logp_t, ext, axis=1)
-        return merged + emit, None
+        new_alpha = merged + emit
+        return new_alpha, new_alpha
 
-    alpha_T, _ = lax.scan(step, alpha0, logp[1:])
+    _, alpha_hist = lax.scan(step, alpha0, logp[1:])
+    alphas = jnp.concatenate([alpha0[None], alpha_hist], axis=0)  # (T, B, S)
+    if use_data_lengths and data_lengths is not None:
+        dl = jnp.clip(data_lengths.astype(jnp.int32), 1, seq_len)
+    else:
+        dl = jnp.full((batch,), seq_len, jnp.int32)
+    # per-sequence final alpha: alpha at t = len-1 (padding frames excluded)
+    alpha_T = jnp.take_along_axis(
+        alphas, (dl - 1).reshape(1, batch, 1), axis=0)[0]
     end1 = 2 * lab_len
     end2 = 2 * lab_len - 1
     a1 = jnp.take_along_axis(alpha_T, end1[:, None], axis=1)[:, 0]

@@ -1,0 +1,7 @@
+from .optimizer import (Optimizer, Updater, create, register, get_updater,
+                        SGD, NAG, Adam, AdaGrad, AdaDelta, Adamax, Nadam,
+                        RMSProp, Ftrl, Signum, SignSGD, LAMB, Test)
+
+__all__ = ["Optimizer", "Updater", "create", "register", "get_updater",
+           "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta", "Adamax", "Nadam",
+           "RMSProp", "Ftrl", "Signum", "SignSGD", "LAMB", "Test"]

@@ -38,18 +38,28 @@ class _State(threading.local):
 
 
 _STATE = _State()
-_GLOBAL = KeyProvider(jax.random.PRNGKey(0))
+_GLOBAL: Optional[KeyProvider] = None  # lazy: importing the package must
+# not initialize a jax backend (device selection happens at first use)
+_GLOBAL_LOCK = threading.Lock()
 
 
 def seed(seed_state: int, ctx=None):
     """ref: mx.random.seed — reset the global stream."""
     global _GLOBAL
-    _GLOBAL = KeyProvider(jax.random.PRNGKey(int(seed_state)))
+    with _GLOBAL_LOCK:
+        _GLOBAL = KeyProvider(jax.random.PRNGKey(int(seed_state)))
 
 
 def next_key():
+    global _GLOBAL
     p = _STATE.provider
-    return (p or _GLOBAL).next_key()
+    if p is not None:
+        return p.next_key()
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = KeyProvider(jax.random.PRNGKey(0))
+    return _GLOBAL.next_key()
 
 
 class key_provider:

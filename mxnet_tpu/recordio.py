@@ -1,0 +1,213 @@
+"""RecordIO: the framework's record-packed dataset format.
+
+Counterpart of python/mxnet/recordio.py + dmlc-core's RecordIO streams
+(ref: dmlc-core include/dmlc/recordio.h; src/io/iter_image_recordio_2.cc
+consumes these shards).  Format (little-endian):
+
+  each record: u32 kMagic (0x3ed7230a), u32 lrecord, data, pad to 4 bytes
+    lrecord = (cflag << 29) | length ; cflag 0 = whole record
+    (continuation flags 1/2/3 support records containing the magic —
+    written by the native writer; both readers handle them)
+
+  IRHeader (prefixed to image records, ref: recordio.py::IRHeader):
+    u32 flag, f32 label (or flag floats), u64 id, u64 id2
+
+The C++ pipeline (native/) reads the same files; this module is the
+authoring/interchange surface.
+"""
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+from collections import namedtuple
+from typing import Optional
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0x3ED7230A
+_CFLAG_BITS = 29
+_LEN_MASK = (1 << _CFLAG_BITS) - 1
+
+
+def _pad4(n):
+    return (4 - n % 4) % 4
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (ref: recordio.py::MXRecordIO)."""
+
+    def __init__(self, uri: str, flag: str):
+        self.uri = uri
+        self.flag = flag
+        self.record = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+        else:
+            raise MXNetError("flag must be 'r' or 'w'")
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.record.close()
+            self.is_open = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["record"] = None
+        d["is_open"] = False
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+        if self.flag == "r":
+            pass
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.record.tell()
+
+    def write(self, buf: bytes):
+        if self.flag != "w":
+            raise MXNetError("not opened for writing")
+        # split on embedded magics is unnecessary when escaping via cflag;
+        # we write whole records (cflag=0) since length is explicit
+        header = struct.pack("<II", _MAGIC, len(buf) & _LEN_MASK)
+        self.record.write(header)
+        self.record.write(buf)
+        self.record.write(b"\x00" * _pad4(len(buf)))
+
+    def read(self) -> Optional[bytes]:
+        if self.flag != "r":
+            raise MXNetError("not opened for reading")
+        parts = []
+        while True:
+            header = self.record.read(8)
+            if len(header) < 8:
+                return None if not parts else b"".join(parts)
+            magic, lrecord = struct.unpack("<II", header)
+            if magic != _MAGIC:
+                raise MXNetError(f"invalid record magic {magic:#x} in {self.uri}")
+            cflag = lrecord >> _CFLAG_BITS
+            length = lrecord & _LEN_MASK
+            data = self.record.read(length)
+            self.record.read(_pad4(length))
+            parts.append(data)
+            if cflag in (0, 3):  # whole record or last chunk
+                return b"".join(parts)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access reader/writer with a .idx sidecar
+    (ref: recordio.py::MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path: str, uri: str, flag: str, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.flag == "r" and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+        elif self.flag == "w":
+            self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        if getattr(self, "fidx", None) is not None and not self.fidx.closed:
+            self.fidx.close()
+        super().close()
+
+    def seek(self, idx):
+        self.record.seek(self.idx[idx])
+
+    def read_idx(self, idx) -> bytes:
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf: bytes):
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write(f"{idx}\t{pos}\n")
+        self.idx[idx] = pos
+        self.keys.append(idx)
+
+
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """ref: recordio.py::pack."""
+    label = header.label
+    if isinstance(label, numbers.Number):
+        header = header._replace(flag=0)
+        payload = b""
+    else:
+        label = np.asarray(label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        payload = label.tobytes()
+    return struct.pack(_IR_FORMAT, header.flag, float(header.label)
+                       if isinstance(header.label, numbers.Number) else 0.0,
+                       header.id, header.id2) + payload + s
+
+
+def unpack(s: bytes):
+    """ref: recordio.py::unpack."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header: IRHeader, img: np.ndarray, quality: int = 95,
+             img_fmt: str = ".jpg") -> bytes:
+    """ref: recordio.py::pack_img — encodes via TF (OpenCV is absent)."""
+    from .image import imencode
+
+    return pack(header, imencode(img, quality=quality, fmt=img_fmt))
+
+
+def unpack_img(s: bytes, iscolor: int = 1):
+    """ref: recordio.py::unpack_img."""
+    from .image import imdecode_np
+
+    header, raw = unpack(s)
+    return header, imdecode_np(raw, iscolor)

@@ -181,5 +181,6 @@ def test_softmax_output_legacy_grad():
     out.backward()
     sm = out.asnumpy()
     onehot = np.eye(4)[[0, 1, 2]]
-    np.testing.assert_allclose(data.grad.asnumpy(), (sm - onehot) / 3,
+    # normalization='null' (default): no batch division, scale 1
+    np.testing.assert_allclose(data.grad.asnumpy(), sm - onehot,
                                rtol=1e-5, atol=1e-6)

@@ -1,0 +1,291 @@
+"""Gluon tests (model: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize(init=mx.initializer.One(), ctx=mx.cpu())
+    assert p.data().shape == (3, 4)
+    assert p.data().asnumpy().sum() == 12
+    assert p.list_ctx() == [mx.cpu()]
+    assert p.grad().asnumpy().sum() == 0
+
+
+def test_parameter_deferred_and_error():
+    p = gluon.Parameter("w", shape=(0, 4), allow_deferred_init=True)
+    p.initialize(ctx=mx.cpu())
+    with pytest.raises(gluon.parameter.DeferredInitializationError):
+        p.data()
+    p.shape = (2, 4)
+    p._finish_deferred_init()
+    assert p.data().shape == (2, 4)
+    q = gluon.Parameter("q", shape=(3,))
+    with pytest.raises(mx.MXNetError):
+        q.data()
+
+
+def test_dense_shapes_and_flatten():
+    d = nn.Dense(5, in_units=3)
+    d.initialize()
+    assert d(nd.ones((2, 3))).shape == (2, 5)
+    d2 = nn.Dense(5)  # deferred
+    d2.initialize()
+    assert d2(nd.ones((4, 2, 3))).shape == (4, 5)  # flatten=True
+    d3 = nn.Dense(5, flatten=False)
+    d3.initialize()
+    assert d3(nd.ones((4, 2, 3))).shape == (4, 2, 5)
+
+
+def test_sequential_and_children():
+    net = nn.Sequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    assert len(net) == 2
+    net.initialize()
+    assert net(nd.ones((3, 7))).shape == (3, 2)
+
+
+def test_hybrid_matches_eager():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = nd.random.uniform(shape=(5, 8))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_gradients_match_eager():
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="tanh"), nn.Dense(1))
+        return net
+
+    mx.random.seed(7)
+    np.random.seed(7)
+    x = nd.random.uniform(shape=(4, 6))
+    grads = []
+    for hybridize in (False, True):
+        np.random.seed(7)
+        net = build()
+        net.initialize()
+        if hybridize:
+            net.hybridize()
+        with autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        p = list(net.collect_params().values())[0]
+        grads.append(p.grad(p.list_ctx()[0]).asnumpy())
+    np.testing.assert_allclose(grads[0], grads[1], rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_running_stats_and_eval():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = nd.random.normal(loc=5, scale=2, shape=(8, 3, 4, 4))
+    with autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert np.abs(rm).sum() > 0  # stats moved toward batch mean
+    out_eval = bn(x)  # eval mode uses running stats
+    assert out_eval.shape == x.shape
+
+
+def test_conv_pool_stack():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1, in_channels=2), nn.MaxPool2D(),
+            nn.GlobalAvgPool2D(), nn.Flatten())
+    net.initialize()
+    assert net(nd.ones((2, 2, 8, 8))).shape == (2, 4)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    out = emb(nd.array([[1, 2], [3, 4]]))
+    assert out.shape == (2, 2, 4)
+
+
+def test_losses():
+    l2 = gluon.loss.L2Loss()
+    pred = nd.array([[1.0], [2.0]])
+    label = nd.array([1.5, 1.0])
+    np.testing.assert_allclose(l2(pred, label).asnumpy(),
+                               [0.125, 0.5], rtol=1e-5)
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    logits = nd.array([[10.0, 0.0], [0.0, 10.0]])
+    labels = nd.array([0.0, 1.0])
+    assert ce(logits, labels).asnumpy().max() < 0.01
+    l1 = gluon.loss.L1Loss()
+    np.testing.assert_allclose(l1(pred, label).asnumpy(), [0.5, 1.0])
+
+
+def test_trainer_step_sgd():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(mx.initializer.One())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = nd.ones((1, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(1)
+    # w <- w - 0.1 * 1 = 0.9
+    np.testing.assert_allclose(net.weight.data().asnumpy(),
+                               [[0.9, 0.9]], rtol=1e-6)
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = nd.ones((1, 2))
+    with autograd.record():
+        net(x).sum().backward()
+    trainer.step(1)
+    f = str(tmp_path / "t.states")
+    trainer.save_states(f)
+    trainer.load_states(f)
+
+
+def test_mlp_training_converges():
+    np.random.seed(0)
+    mx.random.seed(0)
+    X = np.random.randn(256, 10).astype("float32")
+    w = np.random.randn(10, 3).astype("float32")
+    y = (X @ w).argmax(axis=1).astype("float32")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(3))
+    net.initialize(mx.initializer.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    data, label = nd.array(X), nd.array(y)
+    for _ in range(60):
+        with autograd.record():
+            loss = loss_fn(net(data), label)
+        loss.backward()
+        trainer.step(256)
+    acc = float((net(data).argmax(axis=1) == label).mean().asscalar())
+    assert acc > 0.9, f"accuracy {acc}"
+
+
+def test_save_load_parameters_structural(tmp_path):
+    f = str(tmp_path / "p.params")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net2.load_parameters(f)
+    x = nd.ones((1, 3))
+    np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy(), rtol=1e-6)
+
+
+def test_constant_parameter():
+    class Net(nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.const = self.params.get_constant(
+                "const", np.array([[1.0, 2.0]]))
+
+        def hybrid_forward(self, F, x, const):
+            return x + const
+
+    net = Net()
+    net.initialize()
+    out = net(nd.zeros((1, 2)))
+    np.testing.assert_allclose(out.asnumpy(), [[1, 2]])
+
+
+def test_lstm_layer_shapes_and_grad():
+    lstm = gluon.rnn.LSTM(8, num_layers=2, input_size=4)
+    lstm.initialize()
+    x = nd.random.uniform(shape=(6, 2, 4))
+    out, states = lstm(x, lstm.begin_state(batch_size=2))
+    assert out.shape == (6, 2, 8)
+    assert states[0].shape == (2, 2, 8) and states[1].shape == (2, 2, 8)
+    with autograd.record():
+        out, _ = lstm(x, lstm.begin_state(batch_size=2))
+        out.sum().backward()
+    p = lstm.l0_i2h_weight
+    assert float(p.grad(p.list_ctx()[0]).norm().asscalar()) > 0
+
+
+def test_gru_cell_vs_manual():
+    cell = gluon.rnn.GRUCell(4, input_size=4)
+    cell.initialize()
+    x = nd.random.uniform(shape=(2, 4))
+    h = nd.zeros((2, 4))
+    out, (h1,) = cell(x, [h])
+    assert out.shape == (2, 4)
+    np.testing.assert_allclose(out.asnumpy(), h1.asnumpy())
+
+
+def test_rnn_fused_matches_cell():
+    """Fused scan RNN == explicit cell unroll (rnn_relu, 1 layer)."""
+    mx.random.seed(3)
+    fused = gluon.rnn.RNN(5, num_layers=1, activation="relu", input_size=3)
+    fused.initialize()
+    x = nd.random.uniform(shape=(4, 2, 3))
+    out, _ = fused(x, fused.begin_state(batch_size=2))
+    wi = fused.l0_i2h_weight.data().asnumpy()
+    wh = fused.l0_h2h_weight.data().asnumpy()
+    bi = fused.l0_i2h_bias.data().asnumpy()
+    bh = fused.l0_h2h_bias.data().asnumpy()
+    h = np.zeros((2, 5), "float32")
+    outs = []
+    for t in range(4):
+        h = np.maximum(x.asnumpy()[t] @ wi.T + bi + h @ wh.T + bh, 0)
+        outs.append(h)
+    np.testing.assert_allclose(out.asnumpy(), np.stack(outs), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_split_and_load():
+    data = nd.arange(0, 12).reshape((6, 2))
+    parts = gluon.utils.split_and_load(data, [mx.cpu(0)])
+    assert parts[0].shape == (6, 2)
+    with pytest.raises(mx.MXNetError):
+        gluon.utils.split_data(nd.ones((5, 2)), 2)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((2,)) * 4]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    assert norm > 1.0
+    total = sum(float((a * a).sum().asscalar()) for a in arrays)
+    assert abs(total - 1.0) < 1e-3
+
+
+def test_dataloader_and_dataset():
+    X = np.arange(20, dtype="float32").reshape(10, 2)
+    y = np.arange(10, dtype="float32")
+    ds = gluon.data.ArrayDataset(X, y)
+    assert len(ds) == 10
+    loader = gluon.data.DataLoader(ds, batch_size=4, last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 2)
+    assert batches[2][0].shape == (2, 2)
+    loader2 = gluon.data.DataLoader(ds, batch_size=4, shuffle=True,
+                                    last_batch="discard", num_workers=2)
+    assert sum(1 for _ in loader2) == 2
+
+
+def test_model_zoo_smoke():
+    for name in ("resnet18_v1", "resnet18_v2", "mobilenet0.25",
+                 "squeezenet1.1"):
+        net = gluon.model_zoo.get_model(name, classes=4)
+        net.initialize()
+        out = net(nd.random.uniform(shape=(1, 3, 64, 64)))
+        assert out.shape == (1, 4), name
