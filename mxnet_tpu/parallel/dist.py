@@ -1,0 +1,124 @@
+"""Multi-host (DCN) bootstrap and collectives.
+
+TPU-native counterpart of the reference's ps-lite layer (SURVEY.md N11,
+CS5): instead of a ZMQ parameter server with scheduler/server/worker roles,
+multi-host jobs run one process per host, bootstrapped by jax.distributed's
+coordination service; gradient sync is collective (allreduce over DCN
+between slices, ICI within), which is the `dist_sync` semantics.  The
+`dist_async` mode of the reference is served by the same path (documented
+emulation — SURVEY.md §7 hard part 6).
+
+The launcher env contract is kept bilingual:
+  reference (tools/launch.py / dmlc tracker):
+      DMLC_ROLE=worker DMLC_PS_ROOT_URI=<ip> DMLC_PS_ROOT_PORT=<port>
+      DMLC_NUM_WORKER=<n> DMLC_WORKER_ID=<i>
+  jax-native:
+      COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID
+Either set initializes the same way.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["init", "initialized", "rank", "num_workers", "barrier",
+           "allreduce_nd", "allgather_np"]
+
+_INITIALIZED = False
+
+
+def _env(*names, default=None):
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None:
+            return v
+    return default
+
+
+def init(coordinator_address: Optional[str] = None,
+         num_processes: Optional[int] = None,
+         process_id: Optional[int] = None) -> None:
+    """Initialize the DCN coordination service (idempotent).
+
+    Reads the DMLC_* contract of the reference's launcher when explicit
+    args are absent.  Single-process (no env, no args) is a no-op so the
+    same training script runs unmodified on one host.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    if coordinator_address is None:
+        uri = _env("DMLC_PS_ROOT_URI")
+        port = _env("DMLC_PS_ROOT_PORT", default="9091")
+        if uri is not None:
+            coordinator_address = f"{uri}:{port}"
+        else:
+            coordinator_address = _env("COORDINATOR_ADDRESS")
+    if num_processes is None:
+        v = _env("DMLC_NUM_WORKER", "NUM_PROCESSES")
+        num_processes = int(v) if v is not None else None
+    if process_id is None:
+        v = _env("DMLC_WORKER_ID", "PROCESS_ID")
+        process_id = int(v) if v is not None else None
+    if coordinator_address is None:
+        _INITIALIZED = True  # single-process
+        return
+    role = _env("DMLC_ROLE", default="worker")
+    if role in ("scheduler", "server"):
+        # The jax coordination service (hosted by worker 0) subsumes the
+        # scheduler, and collectives subsume the parameter server.  These
+        # roles exist only so reference launchers (tools/launch.py spawning
+        # scheduler + servers + workers) run unmodified: they must NOT join
+        # the device cluster — worker 0 already owns process_id 0.
+        _INITIALIZED = True
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _INITIALIZED = True
+
+
+def initialized() -> bool:
+    return _INITIALIZED
+
+
+def rank() -> int:
+    return jax.process_index()
+
+
+def num_workers() -> int:
+    return jax.process_count()
+
+
+def barrier(name: str = "mxnet_tpu_barrier") -> None:
+    """Block until every worker arrives (ref: Postoffice::Barrier)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def allgather_np(value: np.ndarray) -> np.ndarray:
+    """Gather a host numpy value from every process -> stacked [n, ...]."""
+    if jax.process_count() == 1:
+        return np.asarray(value)[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(value))
+
+
+def allreduce_nd(val):
+    """Sum an NDArray across processes over DCN (eager path used by
+    KVStore('dist_*'); the SPMD path does this in-graph instead)."""
+    from ..ndarray.ndarray import NDArray
+
+    if jax.process_count() == 1:
+        return val
+    summed = allgather_np(np.asarray(val.data)).sum(axis=0)
+    return NDArray(jax.numpy.asarray(summed), ctx=val.ctx)

@@ -1,0 +1,128 @@
+"""Named-axis device meshes.
+
+TPU-native replacement for the reference's flat device lists
+(`ctx=[mx.gpu(i) for i in range(n)]`, kvstore 'device'; SURVEY.md §2d).
+A DeviceMesh arranges the slice's chips into a logical nd-grid with named
+axes; shardings over those axes tell XLA where to insert collectives, which
+then ride ICI (in-slice) or DCN (cross-slice).
+
+Canonical axis names (any subset, any order):
+    dp    data parallel (batch split; grads psum over this axis)
+    fsdp  fully-sharded data parallel (batch split + param/optimizer shard)
+    tp    tensor parallel (weight matrices split; activations all-reduced)
+    pp    pipeline parallel (layer stages; ppermute between neighbours)
+    sp    sequence/context parallel (ring attention over this axis)
+    ep    expert parallel (MoE experts split; all_to_all dispatch)
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from ..base import MXNetError
+
+__all__ = ["DeviceMesh", "make_mesh", "current_mesh", "get_mesh",
+           "AXIS_NAMES"]
+
+AXIS_NAMES = ("dp", "fsdp", "tp", "pp", "sp", "ep")
+
+
+class DeviceMesh:
+    """A jax.sharding.Mesh plus framework conveniences.
+
+    Axes with size 1 are kept in the mesh (they cost nothing and keep
+    PartitionSpecs stable as you scale an axis up), so model code can be
+    written once against the full axis vocabulary.
+    """
+
+    def __init__(self, axes: Dict[str, int],
+                 devices: Optional[Sequence] = None):
+        if not axes:
+            raise MXNetError("DeviceMesh needs at least one axis")
+        self.axis_sizes = dict(axes)
+        devices = list(devices) if devices is not None else jax.devices()
+        need = int(np.prod(list(axes.values())))
+        if need > len(devices):
+            raise MXNetError(
+                f"mesh {axes} needs {need} devices, only {len(devices)} "
+                "available")
+        grid = np.array(devices[:need]).reshape(tuple(axes.values()))
+        self.mesh = Mesh(grid, tuple(axes.keys()))
+
+    # ---- introspection ---------------------------------------------------
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return self.mesh.axis_names
+
+    def size(self, axis: Optional[str] = None) -> int:
+        if axis is None:
+            return self.mesh.size
+        return self.axis_sizes.get(axis, 1)
+
+    @property
+    def devices(self):
+        return list(self.mesh.devices.flat)
+
+    def __contains__(self, axis: str) -> bool:
+        return axis in self.axis_sizes
+
+    # ---- scoping ---------------------------------------------------------
+    def __enter__(self):
+        _STATE.stack.append(self)
+        self.mesh.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.stack.pop()
+        return self.mesh.__exit__(*exc)
+
+    def __repr__(self):
+        ax = ", ".join(f"{k}={v}" for k, v in self.axis_sizes.items())
+        return f"DeviceMesh({ax})"
+
+
+class _MeshState(threading.local):
+    def __init__(self):
+        self.stack: List[DeviceMesh] = []
+
+
+_STATE = _MeshState()
+
+
+def make_mesh(axes: Union[Dict[str, int], Sequence[Tuple[str, int]], None] = None,
+              devices: Optional[Sequence] = None,
+              **axis_kw: int) -> DeviceMesh:
+    """Build a DeviceMesh.
+
+    make_mesh(dp=8)                       # pure data parallel
+    make_mesh(dp=4, tp=2)                 # 2-way tensor parallel inside DP
+    make_mesh({"dp": 2, "sp": 4})         # ring-attention mesh
+
+    With no sizes given, all devices go onto a 1-D 'dp' mesh.
+    """
+    if axes is None:
+        axes = {}
+    elif not isinstance(axes, dict):
+        axes = dict(axes)
+    axes = {**axes, **axis_kw}
+    if not axes:
+        axes = {"dp": len(devices) if devices is not None else
+                jax.device_count()}
+    return DeviceMesh(axes, devices)
+
+
+def current_mesh() -> Optional[DeviceMesh]:
+    """The innermost active `with mesh:` scope, or None."""
+    return _STATE.stack[-1] if _STATE.stack else None
+
+
+def get_mesh() -> DeviceMesh:
+    m = current_mesh()
+    if m is None:
+        raise MXNetError("no DeviceMesh active; use `with make_mesh(...):`")
+    return m

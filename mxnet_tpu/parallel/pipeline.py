@@ -1,0 +1,111 @@
+"""Pipeline parallelism over the 'pp' mesh axis.
+
+Beyond-reference capability (SURVEY.md §2d: the reference's only model
+parallelism is manual `group2ctx` placement).  Here a stack of identical
+stages (e.g. transformer blocks) has its stacked parameters sharded over
+'pp' — device i holds stage i — and microbatches stream through the ring:
+each tick every device runs its stage on its current activation, then the
+activations `ppermute` one hop forward.  After n_micro + n_stages - 1
+ticks all microbatches have exited the last stage (GPipe schedule; bubble
+= (S-1)/(M+S-1)).
+
+The formulation is pure SPMD (shard_map + ppermute over ICI neighbours),
+so XLA overlaps the activation transfer with the next tick's compute.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..base import MXNetError
+from ._compat import shard_map_unchecked
+from .mesh import DeviceMesh, current_mesh
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(params_list):
+    """[{name: arr}, ...] per stage -> {name: arr[S, ...]} stacked pytree
+    (the layout whose leading dim shards over 'pp')."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def _pipeline_local(stage_params, x_micro, stage_fn, axis_name):
+    """Body inside shard_map.
+
+    stage_params: pytree with leading stage dim of size 1 (this device's
+        stage), i.e. {name: [1, ...]}.
+    x_micro: [M_local?…] — microbatches replicated along pp: [M, B, ...].
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    sparams = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+    m = x_micro.shape[0]
+    ticks = m + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    state = jnp.zeros_like(x_micro[0])               # current activation
+    outs = jnp.zeros_like(x_micro)                   # collected on last stage
+
+    def body(t, carry):
+        state, outs = carry
+        # stage 0 ingests microbatch t (if any) instead of the ring input
+        feed = x_micro[jnp.minimum(t, m - 1)]
+        x = jnp.where(idx == 0, jnp.where(t < m, feed, state), state)
+        y = stage_fn(sparams, x)
+        # last stage emits microbatch t - (n - 1)
+        out_i = t - (n - 1)
+        outs = jnp.where(
+            (idx == n - 1) & (out_i >= 0),
+            outs.at[jnp.maximum(out_i, 0)].set(y), outs)
+        state = lax.ppermute(y, axis_name, perm)
+        return state, outs
+
+    _, outs = lax.fori_loop(0, ticks, body, (state, outs))
+    # only the last stage's copy is meaningful — broadcast along pp via a
+    # masked psum so the result is replicated on every stage
+    outs = lax.psum(jnp.where(idx == n - 1, outs, jnp.zeros_like(outs)),
+                    axis_name)
+    return outs
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x,
+                   n_microbatch: int, *, mesh: Optional[DeviceMesh] = None,
+                   axis_name: str = "pp", batch_axes=("dp", "fsdp")):
+    """Run `x` [B, ...] through S pipelined stages.
+
+    stage_fn(params_i, x) -> y with y.shape == x.shape (homogeneous
+    stages — the transformer-block case).
+    stacked_params: pytree with leading dim S == mesh.size('pp').
+    """
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise MXNetError("pipeline_apply requires an active mesh")
+    n = mesh.size(axis_name)
+    first = jax.tree_util.tree_leaves(stacked_params)[0]
+    if first.shape[0] != n:
+        raise MXNetError(
+            f"stacked stage dim {first.shape[0]} != mesh '{axis_name}' size {n}")
+    if x.shape[0] % n_microbatch:
+        raise MXNetError(
+            f"batch {x.shape[0]} not divisible by n_microbatch {n_microbatch}")
+    if n == 1:
+        sparams = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+        return stage_fn(sparams, x)
+
+    mb = x.reshape((n_microbatch, x.shape[0] // n_microbatch) + x.shape[1:])
+    batch = tuple(a for a in batch_axes if a in mesh) or None
+    x_spec = P(None, batch, *([None] * (x.ndim - 1)))
+    p_spec = jax.tree_util.tree_map(
+        lambda a: P(axis_name, *([None] * (a.ndim - 1))), stacked_params)
+    fn = shard_map_unchecked(
+        functools.partial(_pipeline_local, stage_fn=stage_fn,
+                          axis_name=axis_name),
+        mesh=mesh.mesh, in_specs=(p_spec, x_spec), out_specs=x_spec)
+    out = fn(stacked_params, mb)
+    return out.reshape(x.shape)

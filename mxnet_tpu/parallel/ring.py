@@ -1,0 +1,123 @@
+"""Ring attention — sequence/context parallelism over the 'sp' mesh axis.
+
+Beyond-reference capability (SURVEY.md §5: the reference predates
+attention-era long context; its tools were RNN bucketing + grad mirroring).
+Here long sequences shard over the 'sp' axis: every device holds a
+[B, H, L/n, D] slice of Q/K/V, and K/V blocks rotate around the ring via
+`ppermute` (one ICI hop per step) while each device accumulates its
+queries' attention with an online (flash-style) softmax — so the full
+[L, L] score matrix never materializes and sequence length scales linearly
+with the number of chips.
+
+Pattern sources: Ring Attention (Liu et al.) / blockwise-parallel
+attention; the shard_map+ppermute formulation is the idiomatic TPU one
+(collectives ride ICI neighbours on the torus).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..base import MXNetError
+from ._compat import shard_map_unchecked
+from .mesh import DeviceMesh, current_mesh
+
+__all__ = ["ring_attention", "ring_attention_sharded", "local_attention"]
+
+
+def local_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    q_offset=0, k_offset=0):
+    """Plain blockwise attention [B,H,Lq,D]x[B,H,Lk,D] with optional causal
+    mask in GLOBAL coordinates (offsets give each block its position)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[2])[:, None]
+        kpos = k_offset + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(qpos >= kpos, s, jnp.finfo(s.dtype).min)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", *, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Per-shard body: call INSIDE shard_map/pjit with q,k,v already sharded
+    [B, H, L_local, D] along the sequence axis `axis_name`.
+
+    Online-softmax accumulation in float32; K/V rotate n-1 times.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    bq, hq, lq, d = q.shape
+    lk = k.shape[2]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    qf = q.astype(jnp.float32) * scale
+    neg = jnp.finfo(jnp.float32).min
+
+    def scores(k_blk, src):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32))
+        if causal:
+            qpos = idx * lq + jnp.arange(lq)[:, None]
+            kpos = src * lk + jnp.arange(lk)[None, :]
+            s = jnp.where(qpos >= kpos, s, neg)
+        return s
+
+    def block_update(carry, k_blk, v_blk, src):
+        o, m, l = carry
+        s = scores(k_blk, src)                       # [B,H,Lq,Lk]
+        m_new = jnp.maximum(m, s.max(axis=-1))       # [B,H,Lq]
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        # fully-masked rows produce exp(neg - neg)=1 garbage; zero them
+        if causal:
+            valid = s > neg / 2
+            p = jnp.where(valid, p, 0.0)
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        return o_new, m_new, l_new
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(i, carry):
+        (o, m, l), k_blk, v_blk = carry
+        src = (idx - i) % n            # global block index of current K/V
+        o, m, l = block_update((o, m, l), k_blk, v_blk, src)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (o, m, l), k_blk, v_blk
+
+    o0 = jnp.zeros((bq, hq, lq, d), jnp.float32)
+    m0 = jnp.full((bq, hq, lq), neg, jnp.float32)
+    l0 = jnp.zeros((bq, hq, lq), jnp.float32)
+    (o, m, l), _, _ = lax.fori_loop(0, n, body, ((o0, m0, l0), k, v))
+    l = jnp.maximum(l, 1e-20)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, *, mesh: Optional[DeviceMesh] = None,
+                           axis_name: str = "sp", causal: bool = False,
+                           scale: Optional[float] = None,
+                           batch_axes=("dp", "fsdp")):
+    """User entry: q,k,v are [B, H, L, D] global arrays; shards batch over
+    the data axes and sequence over `axis_name`, runs the ring."""
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise MXNetError("ring_attention_sharded requires an active mesh")
+    if axis_name not in mesh or mesh.size(axis_name) == 1:
+        return local_attention(q, k, v, causal=causal, scale=scale)
+    batch = tuple(a for a in batch_axes if a in mesh) or None
+    spec = P(batch, None, axis_name, None)
+    fn = shard_map_unchecked(
+        functools.partial(ring_attention, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh.mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

@@ -1,0 +1,166 @@
+"""Sharding rules: parameter-name-pattern -> PartitionSpec.
+
+Replaces the reference's placement model parallelism (`group2ctx` in
+Symbol.bind + the nnvm PlaceDevice pass, SURVEY.md §2d) with GSPMD
+annotations: a table of regex rules maps parameter names to PartitionSpecs
+over the active DeviceMesh, and XLA inserts the collectives.
+
+The default rules implement the standard Megatron-style transformer layout
+(column-parallel then row-parallel projections over 'tp', embeddings over
+'tp' vocab dim, everything batch-split over 'dp'/'fsdp') while degrading to
+full replication when an axis is absent or size 1.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from .mesh import DeviceMesh, current_mesh, get_mesh
+
+__all__ = ["ShardingRules", "named_sharding", "replicated", "shard_batch",
+           "constraint", "DEFAULT_RULES", "PartitionSpec"]
+
+PartitionSpec = P
+
+
+def _filter_spec(spec: P, mesh: DeviceMesh) -> P:
+    """Drop axes the mesh doesn't have (or has at size 1 it keeps — harmless);
+    unknown axis names in a rule are treated as replicated."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in mesh)
+            out.append(kept if kept else None)
+        else:
+            out.append(entry if entry in mesh else None)
+    return P(*out)
+
+
+def named_sharding(spec: P, mesh: Optional[DeviceMesh] = None) -> NamedSharding:
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise MXNetError("named_sharding requires an active DeviceMesh")
+    return NamedSharding(mesh.mesh, _filter_spec(spec, mesh))
+
+
+def replicated(mesh: Optional[DeviceMesh] = None) -> NamedSharding:
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh.mesh, P())
+
+
+def shard_batch(mesh: Optional[DeviceMesh] = None,
+                extra_dims: int = 0,
+                seq_axis: Optional[int] = None) -> NamedSharding:
+    """Sharding for a batch tensor: dim 0 split over every data-ish axis
+    present ('dp' and 'fsdp'), optionally a sequence dim over 'sp'."""
+    mesh = mesh or get_mesh()
+    batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh)
+    dims: List = [batch_axes if batch_axes else None]
+    for d in range(1, extra_dims + 1):
+        if seq_axis is not None and d == seq_axis and "sp" in mesh:
+            dims.append("sp")
+        else:
+            dims.append(None)
+    return NamedSharding(mesh.mesh, P(*dims))
+
+
+def constraint(value, spec: P, mesh: Optional[DeviceMesh] = None):
+    """with_sharding_constraint for use inside traced/hybridized code."""
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        return value
+    return jax.lax.with_sharding_constraint(
+        value, NamedSharding(mesh.mesh, _filter_spec(spec, mesh)))
+
+
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) table resolved per parameter name.
+
+    rules = ShardingRules([
+        (r".*attention.*qkv.*weight", P("tp", None)),
+        (r".*ffn.*up.*weight",        P("tp", None)),
+        (r".*ffn.*down.*weight",      P(None, "tp")),
+        (r".*embed.*weight",          P("tp", None)),
+    ])
+    First match wins; no match -> fully replicated (with 'fsdp' present,
+    unmatched params instead shard their largest dim over fsdp — the
+    ZeRO-3 layout the reference never had).
+    """
+
+    def __init__(self, rules: Sequence[Tuple[str, P]] = (),
+                 fsdp_min_size: int = 2 ** 14):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self.fsdp_min_size = fsdp_min_size
+
+    def spec_for(self, name: str, shape: Sequence[int],
+                 mesh: DeviceMesh) -> P:
+        for pat, spec in self.rules:
+            if pat.match(name):
+                s = _filter_spec(spec, mesh)
+                if self._divisible(shape, s, mesh):
+                    return s
+        if "fsdp" in mesh and mesh.size("fsdp") > 1 and shape:
+            n = 1
+            for d in shape:
+                n *= int(d)
+            if n >= self.fsdp_min_size:
+                # shard the largest evenly-divisible dim
+                order = sorted(range(len(shape)), key=lambda i: -shape[i])
+                for i in order:
+                    if shape[i] % mesh.size("fsdp") == 0:
+                        dims = [None] * len(shape)
+                        dims[i] = "fsdp"
+                        return P(*dims)
+        return P()
+
+    @staticmethod
+    def _divisible(shape, spec: P, mesh: DeviceMesh) -> bool:
+        for dim, entry in zip(shape, spec):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            k = 1
+            for a in axes:
+                k *= mesh.size(a)
+            if k > 1 and dim % k != 0:
+                return False
+        return True
+
+    def sharding_for(self, name: str, shape: Sequence[int],
+                     mesh: Optional[DeviceMesh] = None) -> NamedSharding:
+        mesh = mesh or get_mesh()
+        return NamedSharding(mesh.mesh, self.spec_for(name, shape, mesh))
+
+    def shard_params(self, params: Dict[str, jax.Array],
+                     mesh: Optional[DeviceMesh] = None) -> Dict[str, jax.Array]:
+        """device_put every param per its rule — the entry point used when
+        moving a replicated model onto a mesh."""
+        mesh = mesh or get_mesh()
+        return {n: jax.device_put(v, self.sharding_for(n, v.shape, mesh))
+                for n, v in params.items()}
+
+
+# Megatron-style transformer defaults + conv nets fall through to
+# replicated (DP) or fsdp.
+DEFAULT_RULES = ShardingRules([
+    # attention: fused qkv / separate q,k,v projections — column parallel
+    (r".*(qkv|query|key|value|q_proj|k_proj|v_proj).*weight$", P("tp", None)),
+    (r".*(qkv|query|key|value|q_proj|k_proj|v_proj).*bias$", P("tp")),
+    # attention output — row parallel
+    (r".*(out_proj|o_proj|proj_o|attn.*out).*weight$", P(None, "tp")),
+    # MLP up / gate — column parallel
+    (r".*(ffn.*(up|gate)|fc1|w1|wi|intermediate).*weight$", P("tp", None)),
+    (r".*(ffn.*(up|gate)|fc1|w1|wi|intermediate).*bias$", P("tp")),
+    # MLP down — row parallel
+    (r".*(ffn.*down|fc2|w2|wo|output.*dense).*weight$", P(None, "tp")),
+    # embeddings: vocab dim over tp
+    (r".*embed.*weight$", P("tp", None)),
+    # MoE experts: expert dim over ep
+    (r".*expert.*", P("ep", None, None)),
+])

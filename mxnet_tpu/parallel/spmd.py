@@ -1,0 +1,410 @@
+"""SPMDTrainer — the whole training step as ONE sharded XLA program.
+
+This is the TPU-native scale-out path that subsumes the reference's
+Trainer + KVStore pipeline (SURVEY.md CS2/CS5).  Where the reference does
+    forward (engine ops) -> backward (engine ops) -> kvstore push/pull
+    (NCCL allreduce or ps-lite) -> optimizer update ops
+as four separately-scheduled phases, here the entire step —
+forward, backward, gradient allreduce, optimizer update — is a single
+jitted program over a DeviceMesh.  XLA overlaps the gradient collectives
+with remaining backward compute (bucketing for free) and the collectives
+ride ICI; parameters/optimizer state stay resident in HBM in their sharded
+layout; buffers are donated so updates are in-place.
+
+Grad sync semantics: the loss is a mean over the GLOBAL batch, so the psum
+XLA inserts for the 'dp'/'fsdp' axes IS the gradient allreduce — identical
+math to KVStore('nccl') push/pull in the reference, one fused program here.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import optimizer as opt_mod
+from .. import random as rnd
+from .mesh import DeviceMesh, current_mesh, make_mesh
+from .sharding import ShardingRules, DEFAULT_RULES, shard_batch
+
+__all__ = ["SPMDTrainer", "functional_optimizer", "FunctionalOptimizer"]
+
+
+# ---------------------------------------------------------------------------
+# functional optimizers — pure (w, g, state, lr, t) -> (w', state') built on
+# the same registered update ops the imperative Optimizer classes use
+# (ops/optimizer_ops.py; ref src/operator/optimizer_op.cc)
+# ---------------------------------------------------------------------------
+
+class FunctionalOptimizer:
+    def __init__(self, n_state: int, update: Callable, wd: float = 0.0,
+                 clip_gradient: float = -1.0):
+        self.n_state = n_state
+        self._update = update
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+
+    def init(self, value: jax.Array) -> Tuple[jax.Array, ...]:
+        return tuple(jnp.zeros_like(value) for _ in range(self.n_state))
+
+    def apply(self, value, grad, state, lr, t, lr_mult=1.0, wd_mult=1.0):
+        return self._update(value, grad, state, lr * lr_mult,
+                            self.wd * wd_mult, self.clip_gradient, t)
+
+
+def _pure(name):
+    from ..ops.registry import apply_pure
+
+    return functools.partial(apply_pure, name)
+
+
+def functional_optimizer(opt) -> FunctionalOptimizer:
+    """Build the pure update for an Optimizer instance (or name)."""
+    if isinstance(opt, str):
+        opt = opt_mod.create(opt)
+    wd = float(opt.wd)
+    clip = float(opt.clip_gradient) if opt.clip_gradient is not None else -1.0
+    kind = type(opt).__name__
+
+    if kind in ("SGD", "NAG"):
+        momentum = float(getattr(opt, "momentum", 0.0))
+        if momentum == 0.0:
+            upd = _pure("sgd_update")
+
+            def update(w, g, s, lr, wd_, c, t):
+                return upd(w, g, lr=lr, wd=wd_, clip_gradient=c), ()
+            return FunctionalOptimizer(0, update, wd, clip)
+        op_name = "nag_mom_update" if kind == "NAG" else "sgd_mom_update"
+        upd = _pure(op_name)
+
+        def update(w, g, s, lr, wd_, c, t):
+            nw, nm = upd(w, g, s[0], lr=lr, momentum=momentum, wd=wd_,
+                         clip_gradient=c)
+            return nw, (nm,)
+        return FunctionalOptimizer(1, update, wd, clip)
+
+    if kind == "Adam":
+        b1, b2, eps = float(opt.beta1), float(opt.beta2), float(opt.epsilon)
+        upd = _pure("adam_update")
+
+        def update(w, g, s, lr, wd_, c, t):
+            # bias correction (ref: Adam.update computes coef host-side)
+            tt = t.astype(jnp.float32)
+            coef = jnp.sqrt(1.0 - b2 ** tt) / (1.0 - b1 ** tt)
+            nw, nm, nv = upd(w, g, s[0], s[1], lr=1.0, beta1=b1, beta2=b2,
+                             epsilon=eps, wd=wd_, clip_gradient=c)
+            # adam_update applies lr directly; redo with scaled lr instead
+            return w + (nw - w) * (lr * coef), (nm, nv)
+        return FunctionalOptimizer(2, update, wd, clip)
+
+    if kind == "RMSProp":
+        g1 = float(getattr(opt, "gamma1", 0.9))
+        g2 = float(getattr(opt, "gamma2", 0.9))
+        eps = float(getattr(opt, "epsilon", 1e-8))
+        if getattr(opt, "centered", False):
+            upd = _pure("rmspropalex_update")
+
+            def update(w, g, s, lr, wd_, c, t):
+                nw, nn, ng, ndel = upd(w, g, s[0], s[1], s[2], lr=lr,
+                                       gamma1=g1, gamma2=g2, epsilon=eps,
+                                       wd=wd_, clip_gradient=c)
+                return nw, (nn, ng, ndel)
+            return FunctionalOptimizer(3, update, wd, clip)
+        upd = _pure("rmsprop_update")
+
+        def update(w, g, s, lr, wd_, c, t):
+            nw, nn = upd(w, g, s[0], lr=lr, gamma1=g1, epsilon=eps, wd=wd_,
+                         clip_gradient=c)
+            return nw, (nn,)
+        return FunctionalOptimizer(1, update, wd, clip)
+
+    if kind == "AdaGrad":
+        eps = float(getattr(opt, "float_stable_eps",
+                            getattr(opt, "eps",
+                                    getattr(opt, "epsilon", 1e-7))))
+        upd = _pure("adagrad_update")
+
+        def update(w, g, s, lr, wd_, c, t):
+            nw, nh = upd(w, g, s[0], lr=lr, epsilon=eps, wd=wd_,
+                         clip_gradient=c)
+            return nw, (nh,)
+        return FunctionalOptimizer(1, update, wd, clip)
+
+    if kind in ("Signum", "SignSGD"):
+        momentum = float(getattr(opt, "momentum", 0.0))
+        if momentum == 0.0:
+            upd = _pure("signsgd_update")
+
+            def update(w, g, s, lr, wd_, c, t):
+                return upd(w, g, lr=lr, wd=wd_, clip_gradient=c), ()
+            return FunctionalOptimizer(0, update, wd, clip)
+        upd = _pure("signum_update")
+
+        def update(w, g, s, lr, wd_, c, t):
+            nw, nm = upd(w, g, s[0], lr=lr, momentum=momentum, wd=wd_,
+                         clip_gradient=c)
+            return nw, (nm,)
+        return FunctionalOptimizer(1, update, wd, clip)
+
+    if kind == "AdaDelta":
+        rho = float(opt.rho)
+        eps = float(opt.epsilon)
+        upd = _pure("adadelta_update")
+
+        def update(w, g, s, lr, wd_, c, t):
+            nw, na, nd = upd(w, g, s[0], s[1], lr=lr, rho=rho, epsilon=eps,
+                             wd=wd_, clip_gradient=c)
+            return nw, (na, nd)
+        return FunctionalOptimizer(2, update, wd, clip)
+
+    if kind == "Adamax":
+        b1, b2 = float(opt.beta1), float(opt.beta2)
+        upd = _pure("adamax_update")
+
+        def update(w, g, s, lr, wd_, c, t):
+            tt = t.astype(jnp.float32)
+            lr_t = lr / (1.0 - b1 ** tt)
+            nw, nm, nv = upd(w, g, s[0], s[1], lr=lr_t, beta1=b1, beta2=b2,
+                             wd=wd_, clip_gradient=c)
+            return nw, (nm, nv)
+        return FunctionalOptimizer(2, update, wd, clip)
+
+    if kind == "Ftrl":
+        lamda1 = float(opt.lamda1)
+        beta = float(opt.beta)
+        upd = _pure("ftrl_update")
+
+        def update(w, g, s, lr, wd_, c, t):
+            nw, nz, nn = upd(w, g, s[0], s[1], lr=lr, lamda1=lamda1,
+                             beta=beta, wd=wd_, clip_gradient=c)
+            return nw, (nz, nn)
+        return FunctionalOptimizer(2, update, wd, clip)
+
+    raise MXNetError(
+        f"no functional form for optimizer {kind}; supported: SGD, NAG, "
+        "Adam, RMSProp, AdaGrad, Signum, SignSGD, AdaDelta, Adamax, Ftrl")
+
+
+# ---------------------------------------------------------------------------
+# SPMDTrainer
+# ---------------------------------------------------------------------------
+
+class SPMDTrainer:
+    """One-program-per-step trainer over a DeviceMesh.
+
+    Parameters
+    ----------
+    block : an initialized gluon (Hybrid)Block — the model.
+    loss : callable applied as ``loss(out, *labels)`` inside the trace;
+        a gluon Loss block works (its forward runs traced).
+    optimizer : name or mxnet_tpu Optimizer instance.
+    mesh : DeviceMesh (defaults to the active one, else all-devices 'dp').
+    rules : ShardingRules mapping parameter names -> PartitionSpec.
+    batch_spec / label_spec : PartitionSpec for each data / label input
+        (defaults: dim 0 over dp/fsdp, rest replicated).
+
+    Usage::
+
+        mesh = parallel.make_mesh(dp=4, tp=2)
+        with mesh:
+            trainer = parallel.SPMDTrainer(net, loss, "sgd",
+                                           {"learning_rate": 0.1})
+            for data, label in batches:
+                l = trainer.step(data, label)      # async; one XLA program
+        trainer.sync_to_block()                    # params back to gluon
+    """
+
+    def __init__(self, block, loss: Callable, optimizer="sgd",
+                 optimizer_params: Optional[dict] = None,
+                 mesh: Optional[DeviceMesh] = None,
+                 rules: ShardingRules = DEFAULT_RULES,
+                 batch_spec: Optional[Sequence] = None,
+                 label_spec: Optional[Sequence] = None,
+                 n_labels: int = 1,
+                 donate: bool = True):
+        self.block = block
+        self.loss = loss
+        self.mesh = mesh or current_mesh() or make_mesh()
+        self.rules = rules
+        self._batch_spec = batch_spec
+        self._label_spec = label_spec
+        self.n_labels = n_labels
+        self._donate = donate
+
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+        elif optimizer_params:
+            raise MXNetError("optimizer_params must be None when optimizer "
+                             "is an instance")
+        self._optimizer = optimizer
+        self._fopt = functional_optimizer(optimizer)
+
+        self._plist = sorted(block.collect_params().items())
+        self._mults = {
+            n: (float(p.lr_mult), float(p.wd_mult)) for n, p in self._plist}
+        self._trainable = {n: p.grad_req != "null" for n, p in self._plist}
+
+        # shard parameters onto the mesh per the rules
+        self.params: Dict[str, jax.Array] = {}
+        self._shardings: Dict[str, NamedSharding] = {}
+        for n, p in self._plist:
+            v = p.data().data
+            sh = rules.sharding_for(n, v.shape, self.mesh)
+            self._shardings[n] = sh
+            self.params[n] = jax.device_put(v, sh)
+        self.opt_state = {
+            n: tuple(jax.device_put(s, self._shardings[n])
+                     for s in self._fopt.init(v))
+            for n, v in self.params.items() if self._trainable[n]}
+
+        self._step_fn = None
+        self._fwd_fn = None
+        self._aux_order: List = []
+        self._t = 0
+
+    # ---- the pure step ---------------------------------------------------
+    def _build_pure(self):
+        plist = self._plist
+        block, loss, fopt = self.block, self.loss, self._fopt
+        mults, trainable = self._mults, self._trainable
+        trainer = self
+
+        from ..gluon.block import ActiveTrace
+
+        name_of = {id(p): n for n, p in plist}
+
+        def pure_step(params, opt_state, inputs, labels, key, lr, t):
+            def loss_fn(pv):
+                trace = ActiveTrace(
+                    {id(p): pv[n] for n, p in plist}, train=True)
+                with trace, rnd.key_provider(rnd.KeyProvider(key)):
+                    out = block.forward(*inputs)
+                    outs = out if isinstance(out, (list, tuple)) else (out,)
+                    l = loss(outs[0], *labels)
+                lval = jnp.mean(l if not isinstance(l, (list, tuple))
+                                else l[0])
+                trainer._aux_order = list(trace.aux_params)
+                return lval, tuple(trace.aux_values)
+
+            (lval, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_state = {}, {}
+            for n, _ in plist:
+                if not trainable[n]:
+                    new_params[n] = params[n]
+                    continue
+                lm, wm = mults[n]
+                w, s = fopt.apply(params[n], grads[n], opt_state[n], lr, t,
+                                  lr_mult=lm, wd_mult=wm)
+                new_params[n] = w.astype(params[n].dtype)
+                new_state[n] = s
+            # aux state (BatchNorm moving stats) accumulates across steps:
+            # fold the traced updates back into the param dict so the next
+            # step's trace reads them (stop_gradient — not a learnable path)
+            for p, v in zip(trainer._aux_order, aux):
+                n = name_of[id(p)]
+                new_params[n] = lax.stop_gradient(v).astype(params[n].dtype)
+            return new_params, new_state, lval, aux
+
+        return pure_step
+
+    def _get_step(self):
+        if self._step_fn is None:
+            mesh = self.mesh
+            n_in = None  # resolved at first call via closure-free jit
+            psh = self._shardings
+            state_sh = {n: tuple(psh[n] for _ in s)
+                        for n, s in self.opt_state.items()}
+            repl = NamedSharding(mesh.mesh, P())
+            self._step_fn = jax.jit(
+                self._build_pure(),
+                in_shardings=(psh, state_sh, None, None, repl, repl, repl),
+                out_shardings=(psh, state_sh, repl, None),
+                donate_argnums=(0, 1) if self._donate else ())
+        return self._step_fn
+
+    # ---- data movement ---------------------------------------------------
+    def _spec_sharding(self, spec, arr):
+        if spec is None:
+            return shard_batch(self.mesh, extra_dims=arr.ndim - 1)
+        return NamedSharding(self.mesh.mesh, spec)
+
+    def _place(self, x, spec):
+        v = x.data if isinstance(x, NDArray) else jnp.asarray(x)
+        return jax.device_put(v, self._spec_sharding(spec, v))
+
+    # ---- public API ------------------------------------------------------
+    def step(self, *args) -> NDArray:
+        """Run one training step on a global batch; returns the loss
+        (async — only .asnumpy() blocks).  The last ``n_labels`` args are
+        labels, the rest model inputs."""
+        n_lab = self.n_labels
+        if n_lab == 0:
+            inputs, labels = args, ()
+        else:
+            inputs, labels = args[:-n_lab], args[-n_lab:]
+        bspecs = self._batch_spec or [None] * len(inputs)
+        lspecs = self._label_spec or [None] * len(labels)
+        ivals = tuple(self._place(x, s) for x, s in zip(inputs, bspecs))
+        lvals = tuple(self._place(x, s) for x, s in zip(labels, lspecs))
+        self._t += 1
+        self._optimizer._update_count(0)
+        lr = jnp.asarray(self._optimizer.learning_rate, jnp.float32)
+        t = jnp.asarray(self._t, jnp.int32)
+        key = rnd.next_key()
+        step = self._get_step()
+        self.params, self.opt_state, lval, aux = step(
+            self.params, self.opt_state, ivals, lvals, key, lr, t)
+        # rebind aux state (BatchNorm moving stats)
+        for p, v in zip(self._aux_order, aux):
+            nd = p.data()
+            nd._data = v
+        from ..context import current_context
+
+        return NDArray(lval, ctx=current_context())
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def sync_to_block(self):
+        """Copy the (sharded) params back into the gluon Parameters —
+        call before save_parameters()/export()."""
+        for n, p in self._plist:
+            v = self.params[n]
+            gathered = jax.device_get(v)
+            for c in list(p._data or {}):
+                p._data[c]._data = jnp.asarray(gathered)
+
+    def forward(self, *inputs) -> NDArray:
+        """Sharded inference with the trainer's current params."""
+        if self._fwd_fn is None:
+            from ..gluon.block import ActiveTrace
+
+            plist = self._plist
+            block = self.block
+
+            def fwd(params, ivals, key):
+                trace = ActiveTrace({id(p): params[n] for n, p in plist},
+                                    train=False)
+                with trace, rnd.key_provider(rnd.KeyProvider(key)):
+                    out = block.forward(*ivals)
+                return out
+
+            self._fwd_fn = jax.jit(fwd)
+        bspecs = self._batch_spec or [None] * len(inputs)
+        ivals = tuple(self._place(x, s) for x, s in zip(inputs, bspecs))
+        out = self._fwd_fn(self.params, ivals, rnd.next_key())
+        from ..context import current_context
+
+        ctx = current_context()
+        return jax.tree_util.tree_map(lambda v: NDArray(v, ctx=ctx), out)
