@@ -1,0 +1,92 @@
+"""Driver benchmark: ResNet-50 synthetic-data training throughput on one
+chip (the BASELINE.md north-star workload: images/sec/chip, target = MXNet
+ResNet-50 on 1xV100 ~= 375 img/s fp32).
+
+The whole train step (forward, backward, grad reduce, SGD update, BatchNorm
+stat update) is ONE jitted XLA program with donated buffers via
+parallel.SPMDTrainer over a single-device mesh; compute in bfloat16 for the
+MXU.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+V100_BASELINE_IMG_S = 375.0  # BASELINE.md: MXNet ResNet-50 fp32 on 1xV100
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--cpu-smoke", action="store_true",
+                    help="tiny shapes on the CPU backend (CI self-test)")
+    args = ap.parse_args()
+
+    if args.cpu_smoke:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        args.batch_size, args.image_size = 8, 64
+        args.steps, args.warmup = 3, 1
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.initializer.Xavier(magnitude=2.0), ctx=mx.cpu())
+    with mx.autograd.pause():   # resolve deferred shapes (cheap spatial dims)
+        net(mx.nd.zeros((1, 3, 32, 32), ctx=mx.cpu()))
+    if args.dtype != "float32":
+        net.cast(args.dtype)
+
+    rng = np.random.RandomState(0)
+    images = rng.rand(args.batch_size, 3, args.image_size,
+                      args.image_size).astype(args.dtype)
+    labels = rng.randint(0, 1000, size=(args.batch_size,)).astype(np.int32)
+
+    mesh = parallel.make_mesh(dp=1)
+    with mesh:
+        trainer = parallel.SPMDTrainer(
+            net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+
+        # synthetic-data convention (ref: image-classification --benchmark 1):
+        # the batch lives on device; we measure the train step, not the
+        # host link (which in this dev harness is a slow tunnel)
+        images = trainer._place(images, None)
+        labels = trainer._place(labels, None)
+
+        for _ in range(args.warmup):
+            loss = trainer.step(images, labels)
+        loss.asnumpy()
+
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            loss = trainer.step(images, labels)
+        lval = float(loss.asnumpy())  # blocks: full async chain done
+        dt = time.perf_counter() - t0
+
+    img_s = args.batch_size * args.steps / dt
+    assert np.isfinite(lval), f"non-finite loss {lval}"
+    print(json.dumps({
+        "metric": "resnet50_v1_train_throughput_per_chip",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / V100_BASELINE_IMG_S, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

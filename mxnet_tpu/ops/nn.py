@@ -65,14 +65,14 @@ def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
             else ("NHWC", "HWIO", "NHWC")
     else:
         dn_in, dn_k, dn_out = "NCDHW", "OIDHW", "NCDHW"
+    # NB: no preferred_element_type here — the MXU accumulates bf16 convs in
+    # fp32 internally, and an fp32 primal output would make the weight-grad
+    # transpose conv see mixed (bf16, fp32) operands, which lax rejects.
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
         dimension_numbers=(dn_in, dn_k, dn_out),
-        feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
+        feature_group_count=num_group)
     if bias is not None and not no_bias:
         if dn_out[-1] == "C":
             out = out + bias
@@ -183,19 +183,23 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
     shape = [1] * data.ndim
     shape[axis] = data.shape[axis]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
+    # mixed precision: stats/affine in the (fp32) stat dtype, output back in
+    # the activation dtype so bf16 stays bf16 through the net
+    odtype = data.dtype
+    x = data.astype(moving_mean.dtype)
     if _train and not use_global_stats:
         red = tuple(i for i in range(data.ndim) if i != axis)
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
-        out = (data - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + eps)
+        mean = jnp.mean(x, axis=red)
+        var = jnp.var(x, axis=red)
+        out = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + eps)
         out = out * g.reshape(shape) + beta.reshape(shape)
         n = np.prod([data.shape[i] for i in red])
         unbiased = var * (n / max(n - 1, 1))
         new_mean = momentum * moving_mean + (1 - momentum) * mean
         new_var = momentum * moving_var + (1 - momentum) * unbiased
-        return out, new_mean, new_var
-    out = (data - moving_mean.reshape(shape)) * lax.rsqrt(moving_var.reshape(shape) + eps)
-    return out * g.reshape(shape) + beta.reshape(shape)
+        return out.astype(odtype), new_mean, new_var
+    out = (x - moving_mean.reshape(shape)) * lax.rsqrt(moving_var.reshape(shape) + eps)
+    return (out * g.reshape(shape) + beta.reshape(shape)).astype(odtype)
 
 
 @register_op("LayerNorm", aliases=("layer_norm",))
