@@ -58,10 +58,11 @@ for _name, _fn in _UNARY.items():
 register_op("copy", aliases=("_copy",))(lambda x: jnp.copy(x))
 register_op("zeros_like")(lambda x: jnp.zeros_like(x))
 register_op("ones_like")(lambda x: jnp.ones_like(x))
+# int32 not int64: TPU-native narrowing (no x64 mode); reference returns i64
 register_op("shape_array", differentiable=False)(
-    lambda x: jnp.asarray(x.shape, jnp.int64))
+    lambda x: jnp.asarray(x.shape, jnp.int32))
 register_op("size_array", differentiable=False)(
-    lambda x: jnp.asarray(math.prod(x.shape) if x.shape else 1, jnp.int64))
+    lambda x: jnp.asarray(math.prod(x.shape) if x.shape else 1, jnp.int32))
 
 
 @register_op("cast", aliases=("Cast",))

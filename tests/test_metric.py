@@ -1,0 +1,82 @@
+"""Metric tests (model: tests/python/unittest/test_metric.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric, nd
+
+
+def test_accuracy():
+    m = metric.Accuracy()
+    pred = nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, value = m.get()
+    assert name == "accuracy"
+    assert value == pytest.approx(2.0 / 3.0)
+    m.reset()
+    assert np.isnan(m.get()[1])
+
+
+def test_topk_accuracy():
+    m = metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.1, 0.2, 0.7], [0.7, 0.2, 0.1]])
+    label = nd.array([1, 2])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_f1_and_mcc():
+    pred = nd.array([[0.7, 0.3], [0.2, 0.8], [0.1, 0.9], [0.6, 0.4]])
+    label = nd.array([0, 1, 0, 1])
+    f1 = metric.F1()
+    f1.update([label], [pred])
+    # tp=1 (idx1), fp=1 (idx2), fn=1 (idx3) -> precision=recall=0.5, f1=0.5
+    assert f1.get()[1] == pytest.approx(0.5)
+    mcc = metric.MCC()
+    mcc.update([label], [pred])
+    assert -1.0 <= mcc.get()[1] <= 1.0
+
+
+def test_mse_mae_rmse():
+    pred = nd.array([[1.0], [2.0], [3.0]])
+    label = nd.array([[2.0], [2.0], [5.0]])
+    for name, expect in [("mse", (1 + 0 + 4) / 3.0),
+                         ("mae", (1 + 0 + 2) / 3.0),
+                         ("rmse", np.sqrt((1 + 0 + 4) / 3.0))]:
+        m = metric.create(name)
+        m.update([label], [pred])
+        assert m.get()[1] == pytest.approx(expect), name
+
+
+def test_perplexity_and_cross_entropy():
+    pred = nd.array([[0.25, 0.75], [0.9, 0.1]])
+    label = nd.array([1, 0])
+    ce = metric.CrossEntropy()
+    ce.update([label], [pred])
+    expect = -(np.log(0.75) + np.log(0.9)) / 2
+    assert ce.get()[1] == pytest.approx(expect, rel=1e-5)
+    pp = metric.Perplexity(ignore_label=None)
+    pp.update([label], [pred])
+    assert pp.get()[1] == pytest.approx(np.exp(expect), rel=1e-5)
+
+
+def test_composite_and_custom():
+    comp = metric.CompositeEvalMetric([metric.Accuracy(), metric.MSE()])
+    pred = nd.array([[0.3, 0.7]])
+    label = nd.array([1])
+    comp.update([label], [pred])
+    names, values = comp.get()
+    assert "accuracy" in names[0]
+
+    cm = metric.CustomMetric(lambda l, p: float(np.mean(l)), name="mymetric")
+    cm.update([nd.array([1.0, 3.0])], [nd.array([0.0, 0.0])])
+    assert cm.get()[1] == pytest.approx(2.0)
+
+
+def test_create_from_string_and_loss():
+    m = metric.create("acc")
+    assert isinstance(m, metric.Accuracy)
+    loss = metric.Loss()
+    loss.update(None, [nd.array([1.0, 3.0])])
+    assert loss.get()[1] == pytest.approx(2.0)

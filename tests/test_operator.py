@@ -1,0 +1,462 @@
+"""Operator numeric test suite vs numpy oracle + finite-difference grads.
+
+Model: tests/python/unittest/test_operator.py in the reference (the ~9k-line
+per-op numeric suite, SURVEY.md §4). Forward results are checked against
+numpy; gradients against central finite differences via
+``test_utils.check_numeric_gradient``.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_backward,
+                                  check_symbolic_forward, rand_ndarray)
+
+RTOL, ATOL = 1e-5, 1e-6
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+# --------------------------------------------------------------------------
+# elementwise unary
+# --------------------------------------------------------------------------
+
+UNARY_CASES = [
+    ("exp", np.exp, (-2, 2)),
+    ("log", np.log, (0.1, 5)),
+    ("log2", np.log2, (0.1, 5)),
+    ("log10", np.log10, (0.1, 5)),
+    ("log1p", np.log1p, (-0.5, 5)),
+    ("expm1", np.expm1, (-2, 2)),
+    ("sqrt", np.sqrt, (0.01, 5)),
+    ("rsqrt", lambda x: 1 / np.sqrt(x), (0.1, 5)),
+    ("cbrt", np.cbrt, (-5, 5)),
+    ("rcbrt", lambda x: 1 / np.cbrt(x), (0.1, 5)),
+    ("square", np.square, (-3, 3)),
+    ("abs", np.abs, (-3, 3)),
+    ("sign", np.sign, (-3, 3)),
+    ("floor", np.floor, (-3, 3)),
+    ("ceil", np.ceil, (-3, 3)),
+    ("trunc", np.trunc, (-3, 3)),
+    ("rint", np.rint, (-3, 3)),
+    ("sin", np.sin, (-3, 3)),
+    ("cos", np.cos, (-3, 3)),
+    ("tan", np.tan, (-1, 1)),
+    ("arcsin", np.arcsin, (-0.9, 0.9)),
+    ("arccos", np.arccos, (-0.9, 0.9)),
+    ("arctan", np.arctan, (-3, 3)),
+    ("sinh", np.sinh, (-2, 2)),
+    ("cosh", np.cosh, (-2, 2)),
+    ("tanh", np.tanh, (-2, 2)),
+    ("arcsinh", np.arcsinh, (-3, 3)),
+    ("arccosh", np.arccosh, (1.1, 5)),
+    ("arctanh", np.arctanh, (-0.9, 0.9)),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), (-4, 4)),
+    ("relu", lambda x: np.maximum(x, 0), (-3, 3)),
+    ("softsign", lambda x: x / (1 + np.abs(x)), (-3, 3)),
+    ("reciprocal", lambda x: 1 / x, (0.2, 4)),
+    ("erf", None, (-2, 2)),
+    ("gamma", None, (0.5, 4)),
+    ("gammaln", None, (0.5, 4)),
+    ("degrees", np.degrees, (-3, 3)),
+    ("radians", np.radians, (-100, 100)),
+    ("negative", lambda x: -x, (-3, 3)),
+]
+
+
+@pytest.mark.parametrize("name,ref,rng", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary_forward(name, ref, rng):
+    a = np.random.uniform(rng[0], rng[1], size=(3, 4)).astype("float32")
+    got = _np(getattr(nd, name)(nd.array(a)))
+    if ref is None:
+        sp = pytest.importorskip("scipy.special")
+        ref = {"erf": sp.erf, "gamma": sp.gamma, "gammaln": sp.gammaln}[name]
+    assert_almost_equal(got, ref(a).astype("float32"), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name,rng", [
+    ("exp", (-1, 1)), ("log", (0.5, 3)), ("sqrt", (0.5, 3)),
+    ("tanh", (-1, 1)), ("sigmoid", (-2, 2)), ("square", (-2, 2)),
+    ("sin", (-2, 2)), ("reciprocal", (0.5, 3)),
+])
+def test_unary_grad(name, rng):
+    a = np.random.uniform(rng[0], rng[1], size=(2, 3)).astype("float32")
+    check_numeric_gradient(lambda x: getattr(nd, name)(x), [a])
+
+
+# --------------------------------------------------------------------------
+# binary / broadcast
+# --------------------------------------------------------------------------
+
+BINARY_CASES = [
+    ("broadcast_add", np.add), ("broadcast_sub", np.subtract),
+    ("broadcast_mul", np.multiply), ("broadcast_div", np.divide),
+    ("broadcast_maximum", np.maximum), ("broadcast_minimum", np.minimum),
+    ("broadcast_power", None), ("broadcast_hypot", np.hypot),
+]
+
+
+@pytest.mark.parametrize("name,ref", BINARY_CASES,
+                         ids=[c[0] for c in BINARY_CASES])
+def test_binary_broadcast_forward(name, ref):
+    a = np.random.uniform(0.5, 2, size=(2, 3, 4)).astype("float32")
+    b = np.random.uniform(0.5, 2, size=(1, 3, 1)).astype("float32")
+    if ref is None:
+        ref = np.power
+    got = _np(getattr(nd, name)(nd.array(a), nd.array(b)))
+    assert_almost_equal(got, ref(a, b).astype("float32"), rtol=1e-4, atol=1e-5)
+
+
+def test_binary_grad():
+    a = np.random.uniform(0.5, 2, size=(2, 3)).astype("float32")
+    b = np.random.uniform(0.5, 2, size=(2, 3)).astype("float32")
+    check_numeric_gradient(lambda x, y: nd.broadcast_mul(x, y), [a, b])
+    check_numeric_gradient(lambda x, y: nd.broadcast_div(x, y), [a, b])
+
+
+def test_comparison_and_logical():
+    a = np.array([[1.0, 2], [3, 4]], "float32")
+    b = np.array([[2.0, 2], [1, 5]], "float32")
+    x, y = nd.array(a), nd.array(b)
+    assert_almost_equal(_np(nd.broadcast_equal(x, y)), (a == b).astype("float32"))
+    assert_almost_equal(_np(nd.broadcast_greater(x, y)), (a > b).astype("float32"))
+    assert_almost_equal(_np(nd.broadcast_logical_and(x, y)),
+                        np.logical_and(a, b).astype("float32"))
+    assert_almost_equal(_np(nd.broadcast_logical_xor(x, y)),
+                        np.logical_xor(a, b).astype("float32"))
+    assert_almost_equal(_np(nd.logical_not(x)),
+                        np.logical_not(a).astype("float32"))
+
+
+# --------------------------------------------------------------------------
+# reductions
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name,ref", [
+    ("sum", np.sum), ("mean", np.mean), ("max", np.max), ("min", np.min),
+    ("prod", np.prod), ("nansum", np.nansum), ("nanprod", np.nanprod),
+])
+def test_reductions(name, ref):
+    a = np.random.randn(2, 3, 4).astype("float32")
+    if name.startswith("nan"):
+        a.ravel()[::5] = np.nan
+    x = nd.array(a)
+    assert_almost_equal(_np(getattr(nd, name)(x)), np.float32(ref(a)),
+                        rtol=1e-4, atol=1e-5)
+    assert_almost_equal(_np(getattr(nd, name)(x, axis=1)),
+                        ref(a, axis=1).astype("float32"), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(_np(getattr(nd, name)(x, axis=(0, 2), keepdims=True)),
+                        ref(a, axis=(0, 2), keepdims=True).astype("float32"),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_norm_cumsum_argminmax():
+    a = np.random.randn(3, 4).astype("float32")
+    x = nd.array(a)
+    assert_almost_equal(_np(nd.norm(x)), np.float32(np.linalg.norm(a)), rtol=1e-4)
+    assert_almost_equal(_np(nd.cumsum(x, axis=1)), np.cumsum(a, axis=1), rtol=1e-4)
+    assert_almost_equal(_np(nd.cumprod(x, axis=0)), np.cumprod(a, axis=0), rtol=1e-4)
+    assert int(_np(nd.argmax(x)).item()) == a.argmax()
+    assert_almost_equal(_np(nd.argmax(x, axis=1)), a.argmax(axis=1).astype("float32"))
+    assert_almost_equal(_np(nd.argmin(x, axis=0)), a.argmin(axis=0).astype("float32"))
+
+
+# --------------------------------------------------------------------------
+# shape manipulation
+# --------------------------------------------------------------------------
+
+def test_shape_ops():
+    a = np.arange(24, dtype="float32").reshape(2, 3, 4)
+    x = nd.array(a)
+    assert_almost_equal(_np(nd.reshape(x, shape=(4, 6))), a.reshape(4, 6))
+    assert_almost_equal(_np(nd.transpose(x, axes=(2, 0, 1))),
+                        a.transpose(2, 0, 1))
+    assert_almost_equal(_np(nd.flip(x, axis=1)), a[:, ::-1])
+    assert_almost_equal(_np(nd.tile(x, reps=(2, 1, 1))), np.tile(a, (2, 1, 1)))
+    assert_almost_equal(_np(nd.repeat(x, repeats=2, axis=1)),
+                        np.repeat(a, 2, axis=1))
+    assert_almost_equal(_np(nd.stack(x, x, axis=1)), np.stack([a, a], 1))
+    assert_almost_equal(_np(nd.concat(x, x, dim=2)),
+                        np.concatenate([a, a], 2))
+    outs = nd.split(x, num_outputs=3, axis=1)
+    for i, o in enumerate(outs):
+        assert_almost_equal(_np(o), a[:, i:i + 1, :])
+    assert_almost_equal(_np(nd.slice(x, begin=(0, 1, 1), end=(2, 3, 3))),
+                        a[0:2, 1:3, 1:3])
+    assert_almost_equal(_np(nd.slice_axis(x, axis=2, begin=0, end=2)),
+                        a[:, :, :2])
+    assert_almost_equal(_np(nd.pad(x.reshape((1, 2, 3, 4)), mode="constant",
+                                   pad_width=(0, 0, 0, 0, 1, 1, 2, 2),
+                                   constant_value=0)),
+                        np.pad(a.reshape(1, 2, 3, 4),
+                               ((0, 0), (0, 0), (1, 1), (2, 2))))
+    assert _np(nd.shape_array(x)).tolist() == [2, 3, 4]
+    assert int(_np(nd.size_array(x)).item()) == 24
+
+
+def test_space_depth_diag():
+    a = np.random.randn(1, 8, 2, 3).astype("float32")
+    x = nd.array(a)
+    d2s = _np(nd.depth_to_space(x, block_size=2))
+    assert d2s.shape == (1, 2, 4, 6)
+    assert_almost_equal(_np(nd.space_to_depth(nd.array(d2s), block_size=2)), a)
+    m = np.random.randn(4, 4).astype("float32")
+    assert_almost_equal(_np(nd.diag(nd.array(m))), np.diag(m))
+
+
+# --------------------------------------------------------------------------
+# indexing ops
+# --------------------------------------------------------------------------
+
+def test_indexing_ops():
+    w = np.random.randn(10, 4).astype("float32")
+    idx = np.array([1, 3, 5], "int32")
+    assert_almost_equal(_np(nd.take(nd.array(w), nd.array(idx))), w[idx])
+    assert_almost_equal(_np(nd.Embedding(nd.array(idx), nd.array(w),
+                                         input_dim=10, output_dim=4)), w[idx])
+    a = np.random.randn(3, 4).astype("float32")
+    pick_idx = np.array([0, 2, 1], "int32")
+    assert_almost_equal(_np(nd.pick(nd.array(a), nd.array(pick_idx), axis=1)),
+                        a[np.arange(3), pick_idx])
+    oh = _np(nd.one_hot(nd.array(pick_idx), depth=4))
+    assert_almost_equal(oh, np.eye(4, dtype="float32")[pick_idx])
+    data = np.random.randn(2, 3).astype("float32")
+    indices = np.array([[0, 1], [1, 2]], "int32")  # 2 points (0,1),(1,2)
+    got = _np(nd.gather_nd(nd.array(data), nd.array(indices)))
+    assert_almost_equal(got, data[indices[0], indices[1]])
+    got = _np(nd.where(nd.array(np.array([1.0, 0, 1], "float32")),
+                       nd.array(np.array([1.0, 2, 3], "float32")),
+                       nd.array(np.array([9.0, 8, 7], "float32"))))
+    assert_almost_equal(got, np.array([1, 8, 3], "float32"))
+
+
+def test_take_embedding_grad():
+    w = np.random.randn(6, 3).astype("float32")
+    idx = np.array([0, 2, 2, 5], "float32")
+
+    def f(weight):
+        return nd.take(weight, nd.array(idx.astype("int32")))
+
+    check_numeric_gradient(f, [w])
+
+
+def test_sort_topk():
+    a = np.random.randn(3, 5).astype("float32")
+    x = nd.array(a)
+    assert_almost_equal(_np(nd.sort(x, axis=1)), np.sort(a, axis=1))
+    assert_almost_equal(_np(nd.sort(x, axis=1, is_ascend=False)),
+                        -np.sort(-a, axis=1))
+    assert_almost_equal(_np(nd.argsort(x, axis=1)),
+                        np.argsort(a, axis=1).astype("float32"))
+    top2 = _np(nd.topk(x, axis=1, k=2, ret_typ="value"))
+    assert_almost_equal(top2, -np.sort(-a, axis=1)[:, :2])
+
+
+# --------------------------------------------------------------------------
+# nn ops
+# --------------------------------------------------------------------------
+
+def test_fully_connected():
+    x = np.random.randn(4, 5).astype("float32")
+    w = np.random.randn(3, 5).astype("float32")
+    b = np.random.randn(3).astype("float32")
+    got = _np(nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b),
+                                num_hidden=3))
+    assert_almost_equal(got, x @ w.T + b, rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(
+        lambda a, ww, bb: nd.FullyConnected(a, ww, bb, num_hidden=3),
+        [x, w, b], rtol=2e-2, atol=2e-2)
+
+
+def test_convolution_vs_torch():
+    torch = pytest.importorskip("torch")
+    x = np.random.randn(2, 3, 8, 8).astype("float32")
+    w = np.random.randn(4, 3, 3, 3).astype("float32")
+    b = np.random.randn(4).astype("float32")
+    got = _np(nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                             kernel=(3, 3), num_filter=4, stride=(2, 2),
+                             pad=(1, 1)))
+    ref = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(w),
+                                     torch.tensor(b), stride=2, padding=1)
+    assert_almost_equal(got, ref.numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_pooling_vs_torch():
+    torch = pytest.importorskip("torch")
+    x = np.random.randn(2, 3, 8, 8).astype("float32")
+    got = _np(nd.Pooling(nd.array(x), kernel=(2, 2), pool_type="max",
+                         stride=(2, 2)))
+    ref = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2)
+    assert_almost_equal(got, ref.numpy(), rtol=1e-5, atol=1e-6)
+    got = _np(nd.Pooling(nd.array(x), kernel=(2, 2), pool_type="avg",
+                         stride=(2, 2)))
+    ref = torch.nn.functional.avg_pool2d(torch.tensor(x), 2, 2)
+    assert_almost_equal(got, ref.numpy(), rtol=1e-5, atol=1e-6)
+    got = _np(nd.Pooling(nd.array(x), global_pool=True, pool_type="avg",
+                         kernel=(1, 1)))
+    assert_almost_equal(got, x.mean(axis=(2, 3), keepdims=True), rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_softmax_family():
+    a = np.random.randn(3, 5).astype("float32")
+    x = nd.array(a)
+    e = np.exp(a - a.max(1, keepdims=True))
+    sm = e / e.sum(1, keepdims=True)
+    assert_almost_equal(_np(nd.softmax(x)), sm, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(_np(nd.log_softmax(x)), np.log(sm), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(_np(nd.softmin(x)), _np(nd.softmax(-x)), rtol=1e-5,
+                        atol=1e-6)
+    check_numeric_gradient(lambda y: nd.softmax(y), [a], rtol=2e-2, atol=2e-2)
+
+
+def test_layer_norm():
+    a = np.random.randn(4, 6).astype("float32")
+    g = np.random.rand(6).astype("float32") + 0.5
+    b = np.random.randn(6).astype("float32")
+    got = _np(nd.LayerNorm(nd.array(a), nd.array(g), nd.array(b)))
+    mu, var = a.mean(-1, keepdims=True), a.var(-1, keepdims=True)
+    ref = (a - mu) / np.sqrt(var + 1e-5) * g + b
+    assert_almost_equal(got, ref, rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(
+        lambda x, gg, bb: nd.LayerNorm(x, gg, bb), [a, g, b],
+        rtol=3e-2, atol=3e-2)
+
+
+def test_batchnorm_inference_and_train():
+    a = np.random.randn(4, 3, 5, 5).astype("float32")
+    g = np.random.rand(3).astype("float32") + 0.5
+    b = np.random.randn(3).astype("float32")
+    mean = np.random.randn(3).astype("float32")
+    var = np.random.rand(3).astype("float32") + 0.5
+    got = _np(nd.BatchNorm(nd.array(a), nd.array(g), nd.array(b),
+                           nd.array(mean), nd.array(var)))
+    ref = ((a - mean[None, :, None, None]) /
+           np.sqrt(var[None, :, None, None] + 1e-5) *
+           g[None, :, None, None] + b[None, :, None, None])
+    assert_almost_equal(got, ref, rtol=1e-4, atol=1e-4)
+    # train mode updates moving stats in place
+    mm, mv = nd.array(mean), nd.array(var)
+    with mx.autograd.record():
+        nd.BatchNorm(nd.array(a), nd.array(g), nd.array(b), mm, mv)
+    batch_mean = a.mean(axis=(0, 2, 3))
+    assert_almost_equal(_np(mm), 0.9 * mean + 0.1 * batch_mean, rtol=1e-4,
+                        atol=1e-4)
+
+
+def test_activation_leakyrelu():
+    a = np.random.randn(3, 4).astype("float32")
+    x = nd.array(a)
+    assert_almost_equal(_np(nd.Activation(x, act_type="relu")),
+                        np.maximum(a, 0))
+    assert_almost_equal(_np(nd.Activation(x, act_type="softrelu")),
+                        np.log1p(np.exp(a)), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(_np(nd.LeakyReLU(x, act_type="leaky", slope=0.1)),
+                        np.where(a > 0, a, 0.1 * a))
+    elu = _np(nd.LeakyReLU(x, act_type="elu", slope=1.0))
+    assert_almost_equal(elu, np.where(a > 0, a, np.expm1(a)), rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_dropout_modes():
+    a = np.ones((1000,), "float32")
+    x = nd.array(a)
+    # inference: identity
+    assert_almost_equal(_np(nd.Dropout(x, p=0.5)), a)
+    with mx.autograd.record(train_mode=True):
+        y = _np(nd.Dropout(x, p=0.5))
+    kept = y > 0
+    assert 0.3 < kept.mean() < 0.7
+    assert_almost_equal(y[kept], np.full(kept.sum(), 2.0, "float32"))
+
+
+def test_softmax_output_and_smooth_l1():
+    a = np.random.randn(4, 5).astype("float32")
+    lbl = np.array([0, 1, 2, 3], "float32")
+    out = _np(nd.SoftmaxOutput(nd.array(a), nd.array(lbl)))
+    e = np.exp(a - a.max(1, keepdims=True))
+    assert_almost_equal(out, e / e.sum(1, keepdims=True), rtol=1e-5, atol=1e-6)
+    s = np.array([-2.0, -0.5, 0.5, 2.0], "float32")
+    got = _np(nd.smooth_l1(nd.array(s), scalar=1.0))
+    ref = np.where(np.abs(s) < 1, 0.5 * s ** 2, np.abs(s) - 0.5)
+    assert_almost_equal(got, ref)
+
+
+def test_sequence_ops():
+    # data layout (seq, batch, feat), ref: sequence_* ops
+    data = np.random.randn(4, 2, 3).astype("float32")
+    lens = np.array([2, 4], "float32")
+    masked = _np(nd.sequence_mask(nd.array(data), nd.array(lens),
+                                  use_sequence_length=True, value=-1.0))
+    assert_almost_equal(masked[2:, 0], np.full((2, 3), -1.0, "float32"))
+    assert_almost_equal(masked[:, 1], data[:, 1])
+    last = _np(nd.sequence_last(nd.array(data), nd.array(lens),
+                                use_sequence_length=True))
+    assert_almost_equal(last[0], data[1, 0])
+    assert_almost_equal(last[1], data[3, 1])
+    rev = _np(nd.sequence_reverse(nd.array(data), nd.array(lens),
+                                  use_sequence_length=True))
+    assert_almost_equal(rev[0, 0], data[1, 0])
+    assert_almost_equal(rev[:, 1], data[::-1, 1])
+
+
+# --------------------------------------------------------------------------
+# linalg / dot
+# --------------------------------------------------------------------------
+
+def test_dot_variants():
+    a = np.random.randn(3, 4).astype("float32")
+    b = np.random.randn(4, 5).astype("float32")
+    assert_almost_equal(_np(nd.dot(nd.array(a), nd.array(b))), a @ b,
+                        rtol=1e-4, atol=1e-5)
+    assert_almost_equal(_np(nd.dot(nd.array(a), nd.array(b.T),
+                                   transpose_b=True)), a @ b, rtol=1e-4,
+                        atol=1e-5)
+    ba = np.random.randn(2, 3, 4).astype("float32")
+    bb = np.random.randn(2, 4, 5).astype("float32")
+    assert_almost_equal(_np(nd.batch_dot(nd.array(ba), nd.array(bb))),
+                        np.einsum("bij,bjk->bik", ba, bb), rtol=1e-4,
+                        atol=1e-5)
+    check_numeric_gradient(lambda x, y: nd.dot(x, y), [a, b], rtol=2e-2,
+                           atol=2e-2)
+
+
+def test_linalg():
+    a = np.random.randn(3, 3).astype("float32")
+    spd = a @ a.T + 3 * np.eye(3, dtype="float32")
+    l = _np(nd.linalg_potrf(nd.array(spd)))
+    assert_almost_equal(l @ l.T, spd, rtol=1e-4, atol=1e-4)
+    x = np.random.randn(2, 4).astype("float32")
+    assert_almost_equal(_np(nd.linalg_syrk(nd.array(x))), x @ x.T, rtol=1e-4,
+                        atol=1e-5)
+    y = np.random.randn(4, 3).astype("float32")
+    assert_almost_equal(
+        _np(nd.linalg_gemm2(nd.array(x), nd.array(y), alpha=2.0)),
+        2 * (x @ y), rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# symbolic-style checkers round-trip through test_utils
+# --------------------------------------------------------------------------
+
+def test_check_symbolic_helpers():
+    a = np.random.randn(3, 4).astype("float32")
+    check_symbolic_forward(lambda x: nd.tanh(x), [a], [np.tanh(a)],
+                           rtol=1e-4, atol=1e-5)
+    check_symbolic_backward(lambda x: nd.tanh(x), [a], [np.ones_like(a)],
+                            [1 - np.tanh(a) ** 2], rtol=1e-4, atol=1e-4)
+
+
+def test_clip_cast_copy():
+    a = np.random.randn(3, 4).astype("float32") * 3
+    assert_almost_equal(_np(nd.clip(nd.array(a), a_min=-1, a_max=1)),
+                        np.clip(a, -1, 1))
+    assert _np(nd.Cast(nd.array(a), dtype="int32")).dtype == np.int32
+    b = nd.array(a)
+    c = nd.identity(b)
+    assert_almost_equal(_np(c), a)

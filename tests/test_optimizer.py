@@ -1,0 +1,151 @@
+"""Optimizer update math vs numpy reference implementations.
+
+Model: tests/python/unittest/test_optimizer.py in the reference (numpy
+mirror of each update rule, compared step by step).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, optimizer
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _setup(shape=(4, 3), seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(*shape).astype("float32")
+    g = rng.randn(*shape).astype("float32")
+    return w, g
+
+
+def test_sgd_plain_and_wd():
+    w, g = _setup()
+    opt = optimizer.create("sgd", learning_rate=0.1, wd=0.01)
+    weight, grad = nd.array(w), nd.array(g)
+    state = opt.create_state(0, weight)
+    opt.update(0, weight, grad, state)
+    ref = w - 0.1 * (g + 0.01 * w)
+    assert_almost_equal(weight, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_momentum_multiple_steps():
+    w, g = _setup()
+    opt = optimizer.create("sgd", learning_rate=0.1, momentum=0.9, wd=0.0)
+    weight = nd.array(w)
+    state = opt.create_state(0, weight)
+    mom = np.zeros_like(w)
+    cur = w.copy()
+    for step in range(3):
+        gi = g * (step + 1)
+        opt.update(0, weight, nd.array(gi), state)
+        mom = 0.9 * mom - 0.1 * gi
+        cur = cur + mom
+        assert_almost_equal(weight, cur, rtol=1e-4, atol=1e-5)
+
+
+def test_sgd_rescale_and_clip():
+    w, g = _setup()
+    opt = optimizer.create("sgd", learning_rate=0.1, rescale_grad=0.5,
+                           clip_gradient=0.2)
+    weight = nd.array(w)
+    opt.update(0, weight, nd.array(g), opt.create_state(0, weight))
+    ref = w - 0.1 * np.clip(g * 0.5, -0.2, 0.2)
+    assert_almost_equal(weight, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_adam():
+    w, g = _setup()
+    opt = optimizer.create("adam", learning_rate=0.01)
+    weight = nd.array(w)
+    state = opt.create_state(0, weight)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    cur = w.copy()
+    for t in range(1, 4):
+        opt.update(0, weight, nd.array(g), state)
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        lr_t = 0.01 * np.sqrt(1 - 0.999 ** t) / (1 - 0.9 ** t)
+        cur = cur - lr_t * m / (np.sqrt(v) + 1e-8)
+        assert_almost_equal(weight, cur, rtol=1e-4, atol=1e-5)
+
+
+def test_rmsprop():
+    w, g = _setup()
+    opt = optimizer.create("rmsprop", learning_rate=0.01, gamma1=0.9)
+    weight = nd.array(w)
+    state = opt.create_state(0, weight)
+    n = np.zeros_like(w)
+    opt.update(0, weight, nd.array(g), state)
+    n = 0.9 * n + 0.1 * g * g
+    ref = w - 0.01 * g / np.sqrt(n + 1e-8)
+    assert_almost_equal(weight, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_adagrad():
+    w, g = _setup()
+    opt = optimizer.create("adagrad", learning_rate=0.1)
+    weight = nd.array(w)
+    state = opt.create_state(0, weight)
+    opt.update(0, weight, nd.array(g), state)
+    hist = g * g
+    ref = w - 0.1 * g / np.sqrt(hist + 1e-7)
+    assert_almost_equal(weight, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_signum():
+    w, g = _setup()
+    opt = optimizer.create("signum", learning_rate=0.1, momentum=0.9)
+    weight = nd.array(w)
+    state = opt.create_state(0, weight)
+    opt.update(0, weight, nd.array(g), state)
+    # reference signum: mom = beta*mom + (1-beta)*rescaled_grad; w -= lr*sign(mom)
+    mom_ref = 0.9 * np.zeros_like(w) + 0.1 * g
+    ref = w - 0.1 * np.sign(mom_ref)
+    assert_almost_equal(weight, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_multi_precision_sgd():
+    w, g = _setup()
+    opt = optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                           multi_precision=True)
+    weight = nd.array(w).astype("float16")
+    grad = nd.array(g).astype("float16")
+    state = opt.create_state_multi_precision(0, weight)
+    opt.update_multi_precision(0, weight, grad, state)
+    assert str(weight.data.dtype) == "float16"
+    mom = -0.1 * g.astype(np.float16).astype(np.float32)
+    ref = (w + mom).astype("float16")
+    assert_almost_equal(weight.asnumpy().astype("float32"),
+                        ref.astype("float32"), rtol=1e-2, atol=1e-2)
+
+
+def test_lr_scheduler_integration():
+    from mxnet_tpu import lr_scheduler
+
+    sched = lr_scheduler.FactorScheduler(step=2, factor=0.5, base_lr=0.4)
+    opt = optimizer.create("sgd", learning_rate=0.4, lr_scheduler=sched)
+    w, g = _setup()
+    weight = nd.array(w)
+    lrs = []
+    for _ in range(5):
+        opt.update(0, weight, nd.array(g), None)
+        lrs.append(opt.learning_rate)
+    assert lrs[0] == pytest.approx(0.4)
+    assert lrs[-1] < 0.4
+
+
+def test_updater_serialization():
+    w, g = _setup()
+    opt = optimizer.create("adam", learning_rate=0.01)
+    updater = optimizer.get_updater(opt)
+    updater(0, nd.array(g), nd.array(w))
+    states = updater.get_states()
+    opt2 = optimizer.create("adam", learning_rate=0.01)
+    updater2 = optimizer.get_updater(opt2)
+    updater2.set_states(states)
+    # both updaters now produce identical next steps
+    w1, w2 = nd.array(w), nd.array(w)
+    updater(1, nd.array(g), w1)
+    updater2(1, nd.array(g), w2)
+    assert_almost_equal(w1, w2, rtol=1e-6, atol=1e-7)
