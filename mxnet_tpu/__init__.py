@@ -40,7 +40,21 @@ def __getattr__(name):
     lazy = {"gluon", "optimizer", "initializer", "metric", "kvstore",
             "lr_scheduler", "io", "image", "symbol", "module", "parallel",
             "callback", "model", "test_utils", "engine", "runtime",
-            "visualization", "recordio", "contrib"}
+            "visualization", "recordio", "contrib", "monitor", "name",
+            "attribute"}
+    if name == "sym":
+        mod = importlib.import_module(".symbol", __name__)
+        globals()["sym"] = mod
+        return mod
+    if name == "AttrScope":
+        from .attribute import AttrScope
+
+        globals()["AttrScope"] = AttrScope
+        return AttrScope
+    if name == "mon":
+        mod = importlib.import_module(".monitor", __name__)
+        globals()["mon"] = mod
+        return mod
     if name in lazy:
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod

@@ -1,0 +1,56 @@
+"""AttrScope: scoped symbol attributes (ref: python/mxnet/attribute.py).
+
+``with mx.AttrScope(ctx_group='dev1'):`` attaches attrs to symbols created
+inside the scope — the reference's mechanism behind group2ctx model
+parallelism (here attrs are carried for parity; device placement is done
+with mesh shardings, SURVEY.md §2d).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["AttrScope", "current"]
+
+
+class AttrScope:
+    _state = threading.local()
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("attributes must be strings")
+        self._attr = dict(kwargs)  # own attrs only; never mutated
+        self._old: Optional["AttrScope"] = None
+        self._effective: Optional[Dict[str, str]] = None
+
+    def _effective_attrs(self) -> Dict[str, str]:
+        """Own attrs merged over the enclosing scope's (computed on enter;
+        outside a with-block, just the own attrs)."""
+        return self._effective if self._effective is not None else self._attr
+
+    def get(self, attr: Optional[Dict[str, str]]) -> Dict[str, str]:
+        ret = dict(self._effective_attrs())
+        if attr:
+            ret.update(attr)
+        return ret
+
+    def __enter__(self):
+        self._old = current()
+        self._effective = dict(self._old._effective_attrs())
+        self._effective.update(self._attr)
+        AttrScope._state.scope = self
+        return self
+
+    def __exit__(self, *exc):
+        AttrScope._state.scope = self._old
+        self._effective = None
+        return False
+
+
+def current() -> AttrScope:
+    scope = getattr(AttrScope._state, "scope", None)
+    if scope is None:
+        scope = AttrScope()
+        AttrScope._state.scope = scope
+    return scope

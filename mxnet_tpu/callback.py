@@ -1,0 +1,111 @@
+"""Training callbacks (ref: python/mxnet/callback.py).
+
+`Speedometer` (throughput logging), `do_checkpoint` (epoch-end model
+save), `ProgressBar`, `log_train_metric` — consumed by `Module.fit` and
+user loops, same as the reference.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import sys
+import time
+from collections import namedtuple
+
+__all__ = ["Speedometer", "ProgressBar", "do_checkpoint",
+           "module_checkpoint", "log_train_metric", "BatchEndParam"]
+
+BatchEndParam = namedtuple("BatchEndParam",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+class Speedometer:
+    """Log samples/sec every `frequent` batches (ref: callback.Speedometer)."""
+
+    def __init__(self, batch_size: int, frequent: int = 50,
+                 auto_reset: bool = True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+
+    def __call__(self, param: BatchEndParam):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                if param.eval_metric is not None:
+                    name_value = param.eval_metric.get_name_value()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s"
+                    metrics = "\t".join(f"{n}={v:f}" for n, v in name_value)
+                    logging.info(msg, param.epoch, count, speed, metrics)
+                else:
+                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                                 param.epoch, count, speed)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+class ProgressBar:
+    """Text progress bar per batch (ref: callback.ProgressBar)."""
+
+    def __init__(self, total: int, length: int = 80):
+        self.total = total
+        self.bar_len = length
+
+    def __call__(self, param: BatchEndParam):
+        count = param.nbatch
+        filled_len = int(round(self.bar_len * count / float(self.total)))
+        percents = math.ceil(100.0 * count / float(self.total))
+        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
+        sys.stdout.write(f"[{prog_bar}] {percents}%\r")
+
+
+def do_checkpoint(prefix: str, period: int = 1):
+    """Epoch-end callback saving `prefix-symbol.json` +
+    `prefix-%04d.params` (ref: callback.do_checkpoint)."""
+    from .model import save_checkpoint
+
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym, arg, aux):
+        if (iter_no + 1) % period == 0:
+            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+
+    return _callback
+
+
+def module_checkpoint(mod, prefix: str, period: int = 1,
+                      save_optimizer_states: bool = False):
+    """ref: callback.module_checkpoint."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+
+    return _callback
+
+
+def log_train_metric(period: int, auto_reset: bool = False):
+    """ref: callback.log_train_metric."""
+
+    def _callback(param: BatchEndParam):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            for name, value in name_value:
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset()
+
+    return _callback

@@ -1,0 +1,74 @@
+"""Engine frontend knobs (ref: python/mxnet/engine.py).
+
+The reference exposes `bulk` (batch many engine pushes into one segment)
+and engine-type introspection.  In the TPU runtime, op-level bulking is
+XLA's job (everything under one jit is one program), so `bulk` here
+controls the *dispatch* layer: inside a bulk scope the imperative invoke
+path skips per-op synchronization entirely (it already does by default —
+PjRt async dispatch), and NaiveEngine-mode block_until_ready is deferred
+to scope exit.  The API contract (context manager, set_bulk_size) matches
+the reference.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import List
+
+import jax
+
+from .base import get_env
+
+__all__ = ["bulk", "set_bulk_size", "current_engine_type"]
+
+_STATE = threading.local()
+
+
+def _bulk_depth() -> int:
+    return getattr(_STATE, "depth", 0)
+
+
+def _track(arrays) -> None:
+    pend = getattr(_STATE, "pending", None)
+    if pend is not None:
+        pend.extend(arrays)
+
+
+def in_bulk() -> bool:
+    return _bulk_depth() > 0
+
+
+def current_engine_type() -> str:
+    """MXNET_ENGINE_TYPE compat: 'ThreadedEnginePerDevice' (async PjRt
+    dispatch, the default) or 'NaiveEngine' (synchronous)."""
+    return get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice", str)
+
+
+_bulk_size = 15  # parity default (MXNET_ENGINE bulking size)
+
+
+def set_bulk_size(size: int) -> int:
+    """ref: engine.set_bulk_size — returns the previous size."""
+    global _bulk_size
+    prev = _bulk_size
+    _bulk_size = int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size: int = 15):
+    """Bulking scope (ref: engine.bulk).  Defers NaiveEngine's synchronous
+    waits until scope exit; under the default async engine it is the
+    identity (PjRt already pipelines dispatches)."""
+    prev_depth = _bulk_depth()
+    prev_pending = getattr(_STATE, "pending", None)
+    _STATE.depth = prev_depth + 1
+    _STATE.pending = []
+    try:
+        yield
+    finally:
+        pending: List = _STATE.pending
+        _STATE.depth = prev_depth
+        _STATE.pending = prev_pending
+        if pending and current_engine_type() == "NaiveEngine":
+            jax.block_until_ready(pending)

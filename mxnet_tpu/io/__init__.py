@@ -1,0 +1,14 @@
+"""Legacy data-iterator API (ref: python/mxnet/io/io.py).
+
+`DataIter`/`DataBatch`/`DataDesc` plus the standard iterators
+(`NDArrayIter`, `CSVIter`, `MNISTIter`, `ImageRecordIter`).  In the
+reference these wrap C++ iterators (src/io/); here the host pipeline is
+Python/numpy feeding device arrays — the TPU transfer itself is the async
+`device_put` JAX performs on first use, playing the role of the engine's
+kCopyToGPU lane (SURVEY.md §2e).
+"""
+from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, CSVIter,
+                 MNISTIter, ImageRecordIter, ResizeIter, PrefetchingIter)
+
+__all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "CSVIter",
+           "MNISTIter", "ImageRecordIter", "ResizeIter", "PrefetchingIter"]

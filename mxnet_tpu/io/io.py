@@ -1,0 +1,536 @@
+"""DataIter family (ref: python/mxnet/io/io.py, src/io/*.cc).
+
+Design notes: the reference's C++ iterators decode/augment on worker
+threads and prefetch into pinned buffers (CS6 in SURVEY.md).  Here batches
+are assembled in numpy on the host; `PrefetchingIter` provides the
+double-buffering layer, and the device copy is JAX's async `device_put`.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from collections import namedtuple
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import cpu
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    """ref: io.DataDesc — named/typed description of one input."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+    @staticmethod
+    def get_batch_axis(layout: Optional[str]) -> int:
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """ref: io.DataBatch — one mini-batch of data+label."""
+
+    def __init__(self, data: List[NDArray], label: Optional[List[NDArray]] = None,
+                 pad: int = 0, index=None, bucket_key=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label if label is not None else []
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        shapes = [d.shape for d in self.data]
+        lshapes = [l.shape for l in self.label]
+        return f"DataBatch: data shapes: {shapes} label shapes: {lshapes}"
+
+
+class DataIter:
+    """Base iterator (ref: io.DataIter). Subclasses implement next()."""
+
+    def __init__(self, batch_size: int = 0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        raise NotImplementedError
+
+    def __next__(self) -> DataBatch:
+        return self.next()
+
+    # legacy piecewise interface (subclasses with their own cursoring
+    # override these four; the default buffers one batch from next())
+    _next_batch: Optional[DataBatch] = None
+
+    def iter_next(self) -> bool:
+        try:
+            self._next_batch = self.next()
+            return True
+        except StopIteration:
+            self._next_batch = None
+            return False
+
+    def getdata(self):
+        return self._next_batch.data
+
+    def getlabel(self):
+        return self._next_batch.label
+
+    def getindex(self):
+        return self._next_batch.index
+
+    def getpad(self):
+        return self._next_batch.pad
+
+    @property
+    def provide_data(self) -> List[DataDesc]:
+        raise NotImplementedError
+
+    @property
+    def provide_label(self) -> List[DataDesc]:
+        return []
+
+
+def _init_data(data, allow_empty, default_name) -> List:
+    """Normalise data into [(name, numpy)] (ref: io.py::_init_data)."""
+    if data is None:
+        if not allow_empty:
+            raise MXNetError("data must be provided")
+        return []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {f"_{i}_{default_name}": d for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        v = np.asarray(v)
+        if v.dtype == np.float64:
+            v = v.astype(np.float32)
+        out.append((k, v))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """In-memory iterator (ref: io.NDArrayIter): shuffle, pad/discard/
+    roll_over last-batch handling."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        if self.num_data < batch_size:
+            raise MXNetError("batch_size larger than dataset size")
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.idx = np.arange(self.num_data)
+        self.cursor = -batch_size
+        self._shuffle_if_needed()
+
+    def _shuffle_if_needed(self):
+        if self.shuffle:
+            np.random.shuffle(self.idx)
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        self._shuffle_if_needed()
+        if self.last_batch_handle == "roll_over" and \
+                self.cursor > self.num_data - self.batch_size:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) \
+                % self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self) -> bool:
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getindex(self):
+        return None
+
+    def next(self) -> DataBatch:
+        if not self.iter_next():
+            raise StopIteration
+        return DataBatch(data=self.getdata(), label=self.getlabel(),
+                         pad=self.getpad(), index=None,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def _take(self, src):
+        out = []
+        for _, v in src:
+            lo = self.cursor
+            hi = self.cursor + self.batch_size
+            sel = self.idx[lo:hi]
+            arr = v[sel]
+            if len(sel) < self.batch_size:  # pad by wrapping
+                extra = v[self.idx[:self.batch_size - len(sel)]]
+                arr = np.concatenate([arr, extra], axis=0)
+            out.append(nd.array(arr, ctx=cpu()))
+        return out
+
+    def getpad(self) -> int:
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (ref: src/io/iter_csv.cc CSVIter)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
+                               ndmin=2).reshape((-1,) + tuple(label_shape))
+            if label.shape[-1] == 1:
+                label = label.reshape(label.shape[:-1] or (-1,))
+        self._iter = NDArrayIter(
+            data, label, batch_size,
+            last_batch_handle="pad" if round_batch else "discard",
+            label_name="label")
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def reset(self):
+        self._iter.reset()
+
+    def next(self):
+        return self._iter.next()
+
+
+def _read_mnist_images(path):
+    import gzip
+
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise MXNetError(f"{path}: bad MNIST image magic {magic}")
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows, cols)
+
+
+def _read_mnist_labels(path):
+    import gzip
+
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise MXNetError(f"{path}: bad MNIST label magic {magic}")
+        return np.frombuffer(f.read(), dtype=np.uint8)
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format iterator (ref: src/io/iter_mnist.cc)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 silent=True, seed=None, **kwargs):
+        super().__init__(batch_size)
+        imgs = _read_mnist_images(image).astype(np.float32) / 255.0
+        lbls = _read_mnist_labels(label).astype(np.float32)
+        if flat:
+            imgs = imgs.reshape(len(imgs), -1)
+        else:
+            imgs = imgs[:, None, :, :]
+        self._iter = NDArrayIter(imgs, lbls, batch_size, shuffle=shuffle)
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def reset(self):
+        self._iter.reset()
+
+    def next(self):
+        return self._iter.next()
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator (ref: src/io/iter_image_recordio_2.cc).
+
+    Reads `.rec` files written by `tools/im2rec.py` (IRHeader + payload),
+    decodes and augments on the host, yields NCHW float batches.  The C++
+    pipeline (threaded decode, native augmenter) arrives with the native
+    layer; this is the functional reference implementation.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size=1, label_width=1,
+                 shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, rand_crop=False,
+                 rand_mirror=False, resize=-1, path_imgidx=None,
+                 round_batch=True, preprocess_threads=4, **kwargs):
+        super().__init__(batch_size)
+        from .. import recordio
+        from ..image import imdecode
+
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.resize = resize
+        self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self.std = np.array([std_r, std_g, std_b], np.float32)
+        self._rec = recordio.MXRecordIO(path_imgrec, "r")
+        self._records: List[bytes] = []
+        while True:
+            buf = self._rec.read()
+            if buf is None:
+                break
+            self._records.append(buf)
+        self._rec.close()
+        self._order = np.arange(len(self._records))
+        self._imdecode = imdecode
+        self._unpack = recordio.unpack
+        self.cursor = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self._order)
+        self.cursor = 0
+
+    def _load_one(self, i):
+        header, img_bytes = self._unpack(self._records[self._order[i]])
+        img = self._imdecode(img_bytes, to_rgb=True).asnumpy()
+        c, h, w = self.data_shape
+        if self.resize > 0:
+            img = _resize_short(img, self.resize)
+        img = _center_or_rand_crop(img, (h, w), self.rand_crop)
+        if self.rand_mirror and np.random.rand() < 0.5:
+            img = img[:, ::-1]
+        img = (img.astype(np.float32) - self.mean) / self.std
+        label = np.asarray(header.label, np.float32)
+        if label.ndim == 0:
+            label = label[None]
+        return img.transpose(2, 0, 1), label[:self.label_width]
+
+    def next(self) -> DataBatch:
+        n = len(self._records)
+        if self.cursor >= n:
+            raise StopIteration
+        imgs, labels = [], []
+        pad = 0
+        for b in range(self.batch_size):
+            i = self.cursor + b
+            if i >= n:
+                pad += 1
+                i = i % n
+            img, lbl = self._load_one(i)
+            imgs.append(img)
+            labels.append(lbl)
+        self.cursor += self.batch_size
+        data = nd.array(np.stack(imgs), ctx=cpu())
+        lab = np.stack(labels)
+        if self.label_width == 1:
+            lab = lab[:, 0]
+        return DataBatch([data], [nd.array(lab, ctx=cpu())], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+def _resize_short(img, size):
+    import math
+
+    h, w = img.shape[:2]
+    scale = size / min(h, w)
+    nh, nw = max(1, int(round(h * scale))), max(1, int(round(w * scale)))
+    ys = (np.arange(nh) * (h / nh)).astype(int).clip(0, h - 1)
+    xs = (np.arange(nw) * (w / nw)).astype(int).clip(0, w - 1)
+    return img[ys][:, xs]
+
+
+def _center_or_rand_crop(img, hw, rand):
+    h, w = img.shape[:2]
+    th, tw = hw
+    if h < th or w < tw:
+        img = _resize_short(img, max(th, tw))
+        h, w = img.shape[:2]
+    if rand:
+        y = np.random.randint(0, h - th + 1)
+        x = np.random.randint(0, w - tw + 1)
+    else:
+        y, x = (h - th) // 2, (w - tw) // 2
+    return img[y:y + th, x:x + tw]
+
+
+class ResizeIter(DataIter):
+    """Truncate/extend an iterator to a fixed number of batches
+    (ref: io.ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur >= self.size:
+            raise StopIteration
+        try:
+            batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            batch = self.data_iter.next()
+        self.cur += 1
+        return batch
+
+
+class PrefetchingIter(DataIter):
+    """Double-buffering prefetcher on a worker thread
+    (ref: src/io/iter_prefetcher.h PrefetcherIter, dmlc ThreadedIter)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        if len(iters) != 1:
+            raise MXNetError("PrefetchingIter here wraps a single iterator")
+        super().__init__(iters[0].batch_size)
+        self._it = iters[0]
+        self._thread: Optional[threading.Thread] = None
+        self._start()
+
+    def _start(self):
+        import queue as _q
+
+        self._stop = threading.Event()
+        self._queue: "_q.Queue" = _q.Queue(maxsize=2)  # double buffering
+        stop, q, it = self._stop, self._queue, self._it
+
+        def worker():
+            while not stop.is_set():
+                try:
+                    batch = it.next()
+                except StopIteration:
+                    batch = None
+                # bounded put that still observes stop requests
+                while not stop.is_set():
+                    try:
+                        q.put(batch, timeout=0.05)
+                        break
+                    except _q.Full:
+                        continue
+                if batch is None:
+                    return
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def _shutdown(self):
+        if self._thread is not None and self._thread.is_alive():
+            self._stop.set()
+            # unblock a worker stuck in put()
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except Exception:
+                pass
+            self._thread.join(timeout=5.0)
+        self._thread = None
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label
+
+    def reset(self):
+        self._shutdown()
+        self._it.reset()
+        self._exhausted = False
+        self._start()
+
+    def next(self):
+        if getattr(self, "_exhausted", False):
+            raise StopIteration
+        batch = self._queue.get()
+        if batch is None:
+            self._exhausted = True
+            raise StopIteration
+        return batch
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass

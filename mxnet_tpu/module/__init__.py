@@ -1,0 +1,8 @@
+"""Module API (ref: python/mxnet/module/)."""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from .executor_group import DataParallelExecutorGroup
+
+__all__ = ["BaseModule", "Module", "BucketingModule",
+           "DataParallelExecutorGroup"]

@@ -1,0 +1,190 @@
+"""BucketingModule: dynamic sequence lengths via per-bucket executors
+sharing parameters (ref: python/mxnet/module/bucketing_module.py; the
+reference's long-sequence mechanism, SURVEY.md §5).
+
+TPU note: each bucket is its own jitted XLA program (recompile-per-bucket,
+cached after first use) — exactly the XLA analogue of the reference's
+one-executor-per-bucket design.  All buckets share one master parameter
+dict; switching buckets loads the latest master into the bucket's
+executors.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, Optional
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen: Callable, default_bucket_key=None,
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None):
+        super().__init__(logger=logger)
+        if default_bucket_key is None:
+            raise MXNetError("please specify default_bucket_key")
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets: Dict[object, Module] = {}
+        self._curr_module: Optional[Module] = None
+        self._curr_bucket_key = None
+
+    @property
+    def symbol(self):
+        assert self.binded
+        return self._curr_module.symbol
+
+    @property
+    def data_names(self):
+        if self.binded:
+            return self._curr_module.data_names
+        return self._sym_gen(self._default_bucket_key)[1]
+
+    @property
+    def output_names(self):
+        if self.binded:
+            return self._curr_module.output_names
+        return self._sym_gen(self._default_bucket_key)[0].list_outputs()
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._curr_module.data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._curr_module.label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._curr_module.output_shapes
+
+    def _gen_module(self, bucket_key) -> Module:
+        sym, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(sym, data_names=data_names, label_names=label_names,
+                      logger=self.logger, context=self._context,
+                      fixed_param_names=self._fixed_param_names)
+
+    # ---- bind / params ---------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        module = self._gen_module(self._default_bucket_key)
+        module.bind(data_shapes, label_shapes, for_training=for_training,
+                    inputs_need_grad=inputs_need_grad, grad_req=grad_req)
+        self._buckets = {self._default_bucket_key: module}
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+        self._grad_req = grad_req
+        self._inputs_need_grad = inputs_need_grad
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """ref: BucketingModule.switch_bucket — lazily create+bind the
+        bucket's module, sharing the master params."""
+        assert self.binded, "call bind before switching buckets"
+        if bucket_key not in self._buckets:
+            module = self._gen_module(bucket_key)
+            module.bind(data_shapes, label_shapes,
+                        for_training=self.for_training,
+                        inputs_need_grad=self._inputs_need_grad,
+                        grad_req=self._grad_req)
+            # share master param dicts so updates propagate across buckets
+            default = self._buckets[self._default_bucket_key]
+            module._arg_params = default._arg_params
+            module._aux_params = default._aux_params
+            module.params_initialized = self.params_initialized
+            if self.params_initialized:
+                module._exec_group.set_params(module._arg_params,
+                                              module._aux_params)
+            if self.optimizer_initialized:
+                module._optimizer = self._curr_module._optimizer
+                module._updater = self._curr_module._updater
+                module._kvstore = self._curr_module._kvstore
+                module.optimizer_initialized = True
+            self._buckets[bucket_key] = module
+        prev = self._curr_module
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+        if prev is not self._curr_module and self.params_initialized:
+            # load latest master weights into this bucket's executors
+            self._curr_module._exec_group.set_params(
+                self._curr_module._arg_params, self._curr_module._aux_params)
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded
+        self._curr_module.init_params(initializer=initializer,
+                                      arg_params=arg_params,
+                                      aux_params=aux_params,
+                                      allow_missing=allow_missing,
+                                      force_init=force_init,
+                                      allow_extra=allow_extra)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.params_initialized
+        return self._curr_module.get_params()
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        self._curr_module.init_optimizer(kvstore, optimizer, optimizer_params,
+                                         force_init=force_init)
+        for mod in self._buckets.values():
+            if mod is not self._curr_module:
+                mod._optimizer = self._curr_module._optimizer
+                mod._updater = self._curr_module._updater
+                mod._kvstore = self._curr_module._kvstore
+                mod.optimizer_initialized = True
+        self.optimizer_initialized = True
+
+    # ---- execution -------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        bucket_key = data_batch.bucket_key
+        if bucket_key is None:
+            bucket_key = self._curr_bucket_key
+        self.switch_bucket(bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads=out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._curr_module.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        for mod in self._buckets.values():
+            mod.install_monitor(mon)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        # save under the default bucket's symbol (reference behavior)
+        default = self._buckets[self._default_bucket_key]
+        default.save_checkpoint(prefix, epoch, save_optimizer_states)

@@ -1,0 +1,159 @@
+"""DataParallelExecutorGroup: batch slicing across devices
+(ref: python/mxnet/module/executor_group.py).
+
+Compat-path data parallelism for the Module API: the batch is sliced
+across contexts, one GraphExecutor per context, gradients aggregated by
+the caller (Module.update via KVStore).  Each executor's forward/backward
+is ONE async XLA dispatch, so slices overlap on device even though Python
+drives them sequentially.  The TPU-idiomatic performance path is SPMD over
+a Mesh (mxnet_tpu.parallel.SPMDTrainer) — this group exists for API
+parity and multi-executor semantics (SURVEY.md §2d).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+from ..io import DataDesc
+from ..ndarray import NDArray
+from .. import ndarray as nd
+
+__all__ = ["DataParallelExecutorGroup"]
+
+
+def _split_slice(batch_size: int, n: int):
+    """Even slices of the batch axis (ref: executor_group._split_input_slice)."""
+    step = (batch_size + n - 1) // n
+    slices = []
+    for i in range(n):
+        lo = min(i * step, batch_size)
+        hi = min((i + 1) * step, batch_size)
+        slices.append(slice(lo, hi))
+    return slices
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts: Sequence[Context], data_shapes,
+                 label_shapes=None, param_names=None, for_training=True,
+                 inputs_need_grad=False, fixed_param_names=None,
+                 grad_req="write", logger=None):
+        self.symbol = symbol
+        self.contexts = list(contexts)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.param_names = list(param_names or [])
+        self.fixed_param_names = set(fixed_param_names or [])
+
+        self.data_names = [d.name for d in data_shapes]
+        self.label_names = [l.name for l in (label_shapes or [])]
+        self.batch_size = data_shapes[0].shape[0]
+        self.slices = _split_slice(self.batch_size, len(self.contexts))
+
+        arg_names = symbol.list_arguments()
+        self.arg_names = arg_names
+        self.aux_names = symbol.list_auxiliary_states()
+
+        req: Dict[str, str] = {}
+        for name in arg_names:
+            if name in self.fixed_param_names:
+                req[name] = "null"
+            elif name in self.data_names:
+                req[name] = "write" if inputs_need_grad else "null"
+            elif name in self.label_names:
+                req[name] = "null"
+            else:
+                req[name] = grad_req if for_training else "null"
+        self._grad_req = req
+
+        # infer full shapes once from the (whole-batch) descs, then rescale
+        # the batch axis per slice
+        shape_kwargs = {d.name: d.shape for d in data_shapes}
+        shape_kwargs.update({l.name: l.shape for l in (label_shapes or [])})
+        full_arg_shapes, _, full_aux_shapes = symbol.infer_shape(**shape_kwargs)
+        name2shape = dict(zip(arg_names, full_arg_shapes))
+
+        self.execs = []
+        for ctx, sl in zip(self.contexts, self.slices):
+            args = {}
+            nslice = sl.stop - sl.start
+            for name in arg_names:
+                shp = list(name2shape[name])
+                if name in self.data_names or name in self.label_names:
+                    shp[0] = nslice
+                args[name] = nd.zeros(tuple(shp), ctx=ctx)
+            aux = [nd.zeros(s, ctx=ctx) for s in full_aux_shapes]
+            self.execs.append(symbol.bind(ctx, args, grad_req=req,
+                                          aux_states=aux))
+
+    # ---- param sync ------------------------------------------------------
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        for ex in self.execs:
+            ex.copy_params_from(arg_params, aux_params,
+                                allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params: Dict[str, NDArray],
+                   aux_params: Dict[str, NDArray]):
+        """Copy averaged params out of the executors (first device wins —
+        they are kept in sync by update)."""
+        ex = self.execs[0]
+        for name in self.param_names:
+            if name in ex.arg_dict:
+                arg_params[name] = ex.arg_dict[name].copy()
+        for name, arr in ex.aux_dict.items():
+            aux_params[name] = arr.copy()
+
+    # ---- execution -------------------------------------------------------
+    def forward(self, data_batch, is_train: Optional[bool] = None):
+        if is_train is None:
+            is_train = self.for_training
+        for ex, sl in zip(self.execs, self.slices):
+            feed = {}
+            for name, arr in zip(self.data_names, data_batch.data):
+                feed[name] = arr[sl]
+            if is_train and data_batch.label:
+                for name, arr in zip(self.label_names, data_batch.label):
+                    feed[name] = arr[sl]
+            ex.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        for i, (ex, sl) in enumerate(zip(self.execs, self.slices)):
+            og = None
+            if out_grads is not None:
+                og = [g[sl] for g in out_grads]
+            ex.backward(out_grads=og)
+
+    def get_outputs(self, merge_multi_context=True):
+        if merge_multi_context:
+            n_out = len(self.execs[0].outputs)
+            return [nd.concatenate([ex.outputs[i].as_in_context(
+                self.contexts[0]) for ex in self.execs], axis=0)
+                for i in range(n_out)]
+        return [[ex.outputs[i] for ex in self.execs]
+                for i in range(len(self.execs[0].outputs))]
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not self.inputs_need_grad:
+            raise MXNetError("bind with inputs_need_grad=True first")
+        grads = []
+        for name in self.data_names:
+            per_dev = [ex.grad_dict[name] for ex in self.execs]
+            if merge_multi_context:
+                grads.append(nd.concatenate(
+                    [g.as_in_context(self.contexts[0]) for g in per_dev],
+                    axis=0))
+            else:
+                grads.append(per_dev)
+        return grads
+
+    def grad_arrays_of(self, name: str) -> List[NDArray]:
+        return [ex.grad_dict[name] for ex in self.execs
+                if ex.grad_dict.get(name) is not None]
+
+    def update_metric(self, eval_metric, labels):
+        # evaluate on merged outputs vs the whole-batch labels (the
+        # reference slices labels per device; merged is equivalent)
+        outs = self.get_outputs(merge_multi_context=True)
+        eval_metric.update(labels, outs)

@@ -1,0 +1,274 @@
+"""Module: symbolic intermediate-level trainer
+(ref: python/mxnet/module/module.py — bind/init_params/init_optimizer/
+forward/backward/update over DataParallelExecutorGroup; CS3 in SURVEY.md).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import initializer as init_mod
+from .. import kvstore as kvs_mod
+from .. import ndarray as nd
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from ..context import Context, cpu
+from ..io import DataDesc
+from ..ndarray import NDArray
+from .base_module import BaseModule, _check_input_names
+from .executor_group import DataParallelExecutorGroup
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = cpu()
+        self._context = [context] if isinstance(context, Context) \
+            else list(context)
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        _check_input_names(symbol, self._data_names, "data", True)
+        _check_input_names(symbol, self._label_names, "label", False)
+        _check_input_names(symbol, self._fixed_param_names, "fixed_param", True)
+
+        arg_names = symbol.list_arguments()
+        input_names = set(self._data_names) | set(self._label_names)
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._arg_params: Dict[str, NDArray] = {}
+        self._aux_params: Dict[str, NDArray] = {}
+        self._exec_group: Optional[DataParallelExecutorGroup] = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self._update_on_kvstore = False
+        self._data_shapes = None
+        self._label_shapes = None
+
+    # ---- properties ------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        outs = self._exec_group.get_outputs()
+        return list(zip(self.output_names, [o.shape for o in outs]))
+
+    # ---- bind ------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already binded, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.binded = True
+
+        data_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                       for d in data_shapes]
+        label_shapes = [l if isinstance(l, DataDesc) else DataDesc(*l)
+                        for l in (label_shapes or [])]
+        # keep only labels the symbol actually takes (ref behavior)
+        args = set(self._symbol.list_arguments())
+        label_shapes = [l for l in label_shapes if l.name in args]
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+
+        self._exec_group = DataParallelExecutorGroup(
+            self._symbol, self._context, data_shapes, label_shapes,
+            param_names=self._param_names, for_training=for_training,
+            inputs_need_grad=inputs_need_grad,
+            fixed_param_names=self._fixed_param_names, grad_req=grad_req,
+            logger=self.logger)
+        if self.params_initialized:
+            self._exec_group.set_params(self._arg_params, self._aux_params)
+
+    # ---- params ----------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing parameters"
+        if initializer is None and not (arg_params or aux_params):
+            initializer = init_mod.Uniform(0.01)
+
+        ex = self._exec_group.execs[0]
+        for name in self._param_names:
+            arr = ex.arg_dict[name]
+            if arg_params and name in arg_params:
+                arr._data = arg_params[name].as_in_context(arr.ctx).data
+            elif initializer is not None:
+                initializer(init_mod.InitDesc(name), arr)
+            elif not allow_missing:
+                raise MXNetError(f"parameter '{name}' missing and no "
+                                 f"initializer given")
+            self._arg_params[name] = arr.copy()
+        for name in self._aux_names:
+            arr = ex.aux_dict[name]
+            if aux_params and name in aux_params:
+                arr._data = aux_params[name].as_in_context(arr.ctx).data
+            else:
+                # BatchNorm var-style aux default to the initializer's
+                # aux rule: ones for *_var, zeros otherwise (ref init)
+                if name.endswith(("moving_var", "running_var")):
+                    arr._data = nd.ones(arr.shape, ctx=arr.ctx).data
+                else:
+                    arr._data = nd.zeros(arr.shape, ctx=arr.ctx).data
+            self._aux_params[name] = arr.copy()
+        # broadcast to every device executor
+        self._exec_group.set_params(self._arg_params, self._aux_params,
+                                    allow_extra=allow_extra)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.params_initialized
+        if self.binded:
+            self._exec_group.get_params(self._arg_params, self._aux_params)
+        return dict(self._arg_params), dict(self._aux_params)
+
+    # ---- optimizer -------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer = opt_mod.create(
+                optimizer, param_idx2name=idx2name,
+                **dict(optimizer_params or {}))
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+        if kvstore:
+            kv = kvs_mod.create(kvstore) if isinstance(kvstore, str) else kvstore
+            self._kvstore = kv
+            for i, name in enumerate(self._param_names):
+                if name in self._exec_group.execs[0].arg_dict:
+                    kv.init(i, self._arg_params[name])
+        self.optimizer_initialized = True
+
+    # ---- execution -------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        self._exec_group.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec_group.backward(out_grads=out_grads)
+
+    def update(self):
+        """Aggregate grads across devices and update every replica
+        (ref: Module.update → _update_params[_on_kvstore])."""
+        assert self.optimizer_initialized
+        group = self._exec_group
+        for i, name in enumerate(self._param_names):
+            grads = group.grad_arrays_of(name)
+            if not grads:
+                continue
+            if len(grads) == 1:
+                agg = grads[0]
+            elif self._kvstore is not None:
+                self._kvstore.push(i, grads)
+                agg = grads[0].copy()
+                self._kvstore.pull(i, out=agg)
+            else:
+                agg = grads[0].copy()
+                for g in grads[1:]:
+                    agg += g.as_in_context(agg.ctx)
+            master = self._arg_params[name]
+            self._updater(i, agg.as_in_context(master.ctx), master)
+            for ex in group.execs:
+                ex.arg_dict[name]._data = master.as_in_context(
+                    ex.arg_dict[name].ctx).data
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec_group.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._exec_group.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._exec_group.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        # Monitor taps intermediate arrays; graph internals are fused into
+        # one XLA program, so expose head outputs only (documented gap)
+        mon.install(self)
+
+    # ---- checkpointing ---------------------------------------------------
+    def save_checkpoint(self, prefix: str, epoch: int,
+                        save_optimizer_states=False):
+        from ..model import save_checkpoint as _save
+
+        arg_params, aux_params = self.get_params()
+        _save(prefix, epoch, self._symbol, arg_params, aux_params)
+        if save_optimizer_states:
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+
+    def save_optimizer_states(self, fname: str):
+        assert self.optimizer_initialized
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname: str):
+        assert self.optimizer_initialized
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    @staticmethod
+    def load(prefix: str, epoch: int, load_optimizer_states=False, **kwargs):
+        """ref: Module.load — from save_checkpoint files."""
+        from ..model import load_checkpoint
+
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
+        return mod
+
+    def init_params_from_loaded(self):
+        self.init_params(arg_params=self._arg_params,
+                         aux_params=self._aux_params, force_init=True)
+
+    def reshape(self, data_shapes, label_shapes=None):
+        """Re-bind with new shapes keeping params (ref: Module.reshape —
+        cheap here: a new jit specialization per shape)."""
+        assert self.binded
+        self.bind(data_shapes, label_shapes, for_training=self.for_training,
+                  force_rebind=True)
+        self._exec_group.set_params(self._arg_params, self._aux_params)

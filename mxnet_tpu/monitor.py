@@ -1,0 +1,87 @@
+"""Monitor: tensor-stats tapping during training
+(ref: python/mxnet/monitor.py).
+
+The reference installs executor monitor callbacks on every op output.  In
+the TPU design the bound graph is one fused XLA program, so interior
+activations are not observable without disabling fusion; the monitor taps
+the observable surface instead: parameters, gradients and head outputs of
+the installed module(s).  (Interior tapping = bind the symbol's
+``get_internals()`` — documented escape hatch, same as the reference's
+``Symbol.get_internals`` trick.)
+"""
+from __future__ import annotations
+
+import logging
+import re
+from typing import Callable, List, Optional, Tuple
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval: int, stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False):
+        if stat_func is None:
+            def stat_func(x):
+                return x.norm() / (x.size ** 0.5)  # ref default: mean |x|-ish
+
+        self.interval = interval
+        self.stat_func = stat_func
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self.queue: List[Tuple[int, str, NDArray]] = []
+        self._modules = []
+
+    def install(self, module):
+        self._modules.append(module)
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.activated = True
+            self.queue = []
+        self.step += 1
+
+    def toc(self) -> List[Tuple[int, str, str]]:
+        if not self.activated:
+            return []
+        self.activated = False
+        for mod in self._modules:
+            try:
+                args, auxs = mod.get_params()
+            except Exception:
+                continue
+            group = getattr(mod, "_exec_group", None)
+            for name, arr in args.items():
+                if self.re_pattern.match(name):
+                    self.queue.append((self.step, name, self.stat_func(arr)))
+            if group is not None:
+                for name in list(args):
+                    grads = group.grad_arrays_of(name)
+                    if grads and self.re_pattern.match(name + "_grad"):
+                        self.queue.append((self.step, name + "_grad",
+                                           self.stat_func(grads[0])))
+                try:
+                    for oname, out in zip(mod.output_names,
+                                          mod.get_outputs()):
+                        if self.re_pattern.match(oname):
+                            self.queue.append((self.step, oname,
+                                               self.stat_func(out)))
+                except Exception:
+                    pass
+        res = []
+        queue = sorted(self.queue, key=lambda x: x[1]) if self.sort \
+            else self.queue
+        for n, k, v in queue:
+            if isinstance(v, NDArray):
+                v = v.asnumpy()
+            res.append((n, k, str(v)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        for n, k, v in self.toc():
+            logging.info("Batch: %7d %30s %s", n, k, v)

@@ -1,0 +1,55 @@
+"""Name management for symbol auto-naming (ref: python/mxnet/name.py).
+
+`NameManager` assigns `opname%d` names; `Prefix` prepends a fixed prefix.
+The symbolic frontend consults the active manager when a node has no
+explicit name.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+
+class NameManager:
+    _state = threading.local()
+
+    def __init__(self):
+        self._counter: Dict[str, int] = {}
+        self._old: Optional["NameManager"] = None
+
+    def get(self, name: Optional[str], hint: str) -> str:
+        if name:
+            return name
+        n = self._counter.get(hint, 0)
+        self._counter[hint] = n + 1
+        return f"{hint}{n}"
+
+    def __enter__(self):
+        self._old = current()
+        NameManager._state.mgr = self
+        return self
+
+    def __exit__(self, *exc):
+        NameManager._state.mgr = self._old
+        return False
+
+
+class Prefix(NameManager):
+    """ref: name.Prefix — prepend `prefix` to every auto name."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)
+
+
+def current() -> NameManager:
+    mgr = getattr(NameManager._state, "mgr", None)
+    if mgr is None:
+        mgr = NameManager()
+        NameManager._state.mgr = mgr
+    return mgr

@@ -207,7 +207,13 @@ def invoke(op_name: str, *inputs, **attrs):
     with profile_op(op.name):
         out = jitted(op, attrs_key)(*arrays)
     if _NAIVE:
-        jax.block_until_ready(out)
+        from .. import engine as _engine
+
+        if _engine.in_bulk():
+            # bulking scope defers the synchronous wait to scope exit
+            _engine._track(out if isinstance(out, (tuple, list)) else [out])
+        else:
+            jax.block_until_ready(out)
     results = wrap_outputs(out, ctx)
     if op.differentiable and ag.is_recording():
         ag.record_op(op, attrs_key, inputs, arrays, results)

@@ -16,7 +16,7 @@ from typing import Optional
 
 import jax
 
-__all__ = ["seed", "next_key", "key_provider", "KeyProvider"]
+__all__ = ["seed", "next_key", "zero_key", "key_provider", "KeyProvider"]
 
 
 class KeyProvider:
@@ -60,6 +60,13 @@ def next_key():
             if _GLOBAL is None:
                 _GLOBAL = KeyProvider(jax.random.PRNGKey(0))
     return _GLOBAL.next_key()
+
+
+def zero_key():
+    """A fixed key for paths where randomness is unused (inference-mode
+    executors) — keeps executable signatures uniform without consuming
+    stream state."""
+    return jax.random.PRNGKey(0)
 
 
 class key_provider:

@@ -1,0 +1,42 @@
+"""Symbolic frontend (ref: python/mxnet/symbol/).
+
+``mx.sym.FullyConnected(...)`` etc. are synthesized lazily from the op
+registry (the counterpart of the reference's generated symbol wrappers,
+ref: python/mxnet/symbol/register.py::_make_symbol_function).
+"""
+from __future__ import annotations
+
+from .symbol import Group, Symbol, Variable, load, load_json, var
+from .executor import GraphExecutor
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "GraphExecutor", "zeros", "ones"]
+
+_CACHE = {}
+
+
+def zeros(shape, dtype="float32", name=None):
+    from . import symbol as _s
+
+    nm = name or _s._NAMER.next("zeros")
+    return __getattr__("zeros_like")(var(nm, shape=shape))
+
+
+def ones(shape, dtype="float32", name=None):
+    from . import symbol as _s
+
+    nm = name or _s._NAMER.next("ones")
+    return __getattr__("ones_like")(var(nm, shape=shape))
+
+
+def __getattr__(name):
+    from ..ops.registry import OP_REGISTRY
+    from .symbol import make_symbol_function
+
+    if name in _CACHE:
+        return _CACHE[name]
+    if name in OP_REGISTRY:
+        fn = make_symbol_function(name)
+        _CACHE[name] = fn
+        return fn
+    raise AttributeError(f"module 'mxnet_tpu.symbol' has no attribute {name!r}")

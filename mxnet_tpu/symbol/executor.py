@@ -1,0 +1,318 @@
+"""GraphExecutor: bound symbolic graph → one jitted XLA program.
+
+TPU-native counterpart of the reference's executor
+(ref: src/executor/graph_executor.cc — GraphExecutor::Init/Forward/Backward,
+nnvm PlanMemory/AttachOpExecs; python/mxnet/executor.py frontend).
+
+Design: instead of per-node engine ops with a memory plan, the bound graph
+is ONE pure jax function compiled per (train-mode, shapes).  The training
+path fuses forward AND backward (with default ones cotangents — the
+`backward()`-with-no-out_grads contract Module.fit uses) into a single XLA
+executable, so a symbolic train step is one fused device program — the
+reference's bulk-exec ideal (MXNET_EXEC_BULK_EXEC_TRAIN) taken to its
+limit.  Dropout masks are reproducible across forward/backward because the
+same PRNG key feeds both.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray import NDArray
+from ..ndarray import ndarray as _nd_mod
+from ..ops.registry import get_op
+from .symbol import KEYED_OPS, SCHEMAS, TRAIN_AWARE_OPS, Symbol
+
+__all__ = ["GraphExecutor"]
+
+
+class GraphExecutor:
+    def __init__(self, symbol: Symbol, ctx: Context,
+                 args: Union[List[NDArray], Dict[str, NDArray]],
+                 args_grad=None, grad_req="write", aux_states=None):
+        import jax
+
+        self._symbol = symbol
+        self._ctx = ctx
+        self._topo = symbol._topo()
+        self._heads = symbol._heads
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+
+        self.arg_arrays = self._as_list(args, self.arg_names, "args")
+        self.aux_arrays = self._as_list(aux_states, self.aux_names,
+                                        "aux_states", allow_none=True)
+
+        # grad_req: str | list | dict  (ref: Executor grad handling)
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self.arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null")
+                              for n in self.arg_names}
+        if args_grad is None:
+            self.grad_arrays = [
+                _nd_mod.zeros(a.shape, ctx=ctx, dtype=str(a.data.dtype))
+                if self._grad_req[n] != "null" else None
+                for n, a in zip(self.arg_names, self.arg_arrays)]
+        else:
+            self.grad_arrays = self._as_list(args_grad, self.arg_names,
+                                             "args_grad", allow_none=True,
+                                             pad=True)
+        self._diff_idx = [i for i, n in enumerate(self.arg_names)
+                          if self._grad_req[n] != "null"]
+
+        self.outputs: List[NDArray] = []
+        self._fwd_cache: Dict[bool, Any] = {}
+        self._train_step_fn = None
+        self._vjp_fn = None
+        self._pending_grads = None
+        self._last_key = None
+
+    # ---- construction helpers -------------------------------------------
+    def _as_list(self, vals, names, what, allow_none=False, pad=False):
+        if vals is None:
+            if allow_none and not names:
+                return []
+            if allow_none and what == "aux_states":
+                # aux default: zeros mean / ones var heuristics left to the
+                # caller (Module.init_params overwrites them)
+                return [_nd_mod.zeros(self._shape_of(n), ctx=self._ctx)
+                        for n in names]
+            if allow_none:
+                return [None] * len(names)
+            raise MXNetError(f"{what} must be provided")
+        if isinstance(vals, dict):
+            out = []
+            for n in names:
+                v = vals.get(n)
+                if v is None and not (allow_none or pad):
+                    raise MXNetError(f"{what} missing entry for '{n}'")
+                out.append(self._to_ctx(v))
+            return out
+        vals = [self._to_ctx(v) for v in vals]
+        if len(vals) != len(names):
+            raise MXNetError(f"{what}: expected {len(names)} entries "
+                             f"({names}), got {len(vals)}")
+        return vals
+
+    def _to_ctx(self, v):
+        if v is None:
+            return None
+        if not isinstance(v, NDArray):
+            v = _nd_mod.array(v, ctx=self._ctx)
+        return v.as_in_context(self._ctx)
+
+    def _shape_of(self, name):
+        # aux shapes via infer on current arg shapes
+        shapes = {n: a.shape for n, a in zip(self.arg_names, self.arg_arrays)}
+        _, _, aux_shapes = self._symbol._infer_shape_impl(True, **shapes)
+        for n, s in zip(self.aux_names, aux_shapes):
+            if n == name and s is not None:
+                return s
+        raise MXNetError(f"cannot infer shape of aux state '{name}'")
+
+    # ---- dicts -----------------------------------------------------------
+    @property
+    def arg_dict(self):
+        return dict(zip(self.arg_names, self.arg_arrays))
+
+    @property
+    def grad_dict(self):
+        return dict(zip(self.arg_names, self.grad_arrays))
+
+    @property
+    def aux_dict(self):
+        return dict(zip(self.aux_names, self.aux_arrays))
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for n, v in (arg_params or {}).items():
+            if n in self.arg_dict:
+                self.arg_dict[n]._data = self._to_ctx(v).data
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown argument '{n}'")
+        for n, v in (aux_params or {}).items():
+            if n in self.aux_dict:
+                self.aux_dict[n]._data = self._to_ctx(v).data
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux state '{n}'")
+
+    # ---- the pure graph function ----------------------------------------
+    def _raw_fn(self, arg_vals, aux_vals, key, train: bool):
+        """Evaluate the DAG on jax values. Returns (head_vals, new_aux)."""
+        import jax
+
+        vals = dict(zip(self.arg_names, arg_vals))
+        vals.update(zip(self.aux_names, aux_vals))
+        n_keyed = sum(1 for n in self._topo if n.op in KEYED_OPS)
+        keys = list(jax.random.split(key, n_keyed)) if n_keyed else []
+        ki = 0
+        env: Dict[Any, Any] = {}
+        new_aux: Dict[str, Any] = {}
+        for node in self._topo:
+            if node.op is None:
+                env[(id(node), 0)] = vals[node.name]
+                continue
+            op = get_op(node.op)
+            ins = [env[(id(inp), idx)] for (inp, idx) in node.inputs]
+            attrs = dict(node.attrs)
+            attrs.pop("name", None)
+            attrs = {k: v for k, v in attrs.items()
+                     if not k.startswith("__")}
+            if node.op in TRAIN_AWARE_OPS:
+                attrs["_train"] = train
+            if node.op in KEYED_OPS:
+                ins = [ins[0], keys[ki]] + ins[1:]
+                ki += 1
+            out = op.fn(*ins, **attrs)
+            if node.op == "BatchNorm" and isinstance(out, (tuple, list)) \
+                    and len(out) == 3 and node.num_outputs == 1:
+                out, nm, nv = out
+                # inputs 3,4 are the moving-stat aux vars (schema order)
+                new_aux[node.inputs[3][0].name] = nm
+                new_aux[node.inputs[4][0].name] = nv
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            for i, o in enumerate(outs):
+                env[(id(node), i)] = o
+        head_vals = [env[(id(n), i)] for (n, i) in self._heads]
+        aux_out = [new_aux.get(n, vals[n]) for n in self.aux_names]
+        return head_vals, aux_out
+
+    def _get_fwd(self, train: bool):
+        import jax
+
+        fn = self._fwd_cache.get(train)
+        if fn is None:
+            fn = jax.jit(functools.partial(self._raw_fn, train=train))
+            self._fwd_cache[train] = fn
+        return fn
+
+    def _get_train_step(self):
+        """Fused forward+backward with ones cotangents (the Module.fit
+        contract) — one XLA program per train step."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._train_step_fn is None:
+            diff_idx = tuple(self._diff_idx)
+
+            @jax.jit
+            def step(arg_vals, aux_vals, key):
+                def f(diff_vals):
+                    av = list(arg_vals)
+                    for i, j in enumerate(diff_idx):
+                        av[j] = diff_vals[i]
+                    heads, aux_out = self._raw_fn(tuple(av), aux_vals, key,
+                                                  train=True)
+                    return tuple(heads), aux_out
+
+                heads, vjp, aux_out = jax.vjp(
+                    f, tuple(arg_vals[j] for j in diff_idx), has_aux=True)
+                cts = tuple(jnp.ones_like(h) for h in heads)
+                grads = vjp(cts)[0]
+                return heads, aux_out, grads
+
+            self._train_step_fn = step
+        return self._train_step_fn
+
+    def _get_vjp(self):
+        """Explicit-cotangent backward (when backward(out_grads=...) is
+        used, e.g. MakeLoss-less custom heads)."""
+        import jax
+
+        if self._vjp_fn is None:
+            diff_idx = tuple(self._diff_idx)
+
+            @jax.jit
+            def bwd(arg_vals, aux_vals, key, cts):
+                def f(diff_vals):
+                    av = list(arg_vals)
+                    for i, j in enumerate(diff_idx):
+                        av[j] = diff_vals[i]
+                    heads, _ = self._raw_fn(tuple(av), aux_vals, key,
+                                            train=True)
+                    return tuple(heads)
+
+                _, vjp = jax.vjp(f, tuple(arg_vals[j] for j in diff_idx))
+                return vjp(tuple(cts))[0]
+
+            self._vjp_fn = bwd
+        return self._vjp_fn
+
+    # ---- public API ------------------------------------------------------
+    def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:
+        from .. import random as _random
+
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"unknown argument '{k}' in forward")
+            self.arg_dict[k]._data = self._to_ctx(v).data
+
+        arg_vals = tuple(a.data for a in self.arg_arrays)
+        aux_vals = tuple(a.data for a in self.aux_arrays)
+        key = _random.next_key() if is_train else _random.zero_key()
+        self._last_key = key
+        self._pending_grads = None
+
+        if is_train and self._diff_idx:
+            heads, aux_out, grads = self._get_train_step()(
+                arg_vals, aux_vals, key)
+            self._pending_grads = grads
+        else:
+            heads, aux_out = self._get_fwd(is_train)(arg_vals, aux_vals, key)
+        self.outputs = [NDArray(h, ctx=self._ctx) for h in heads]
+        if is_train:
+            for arr, new in zip(self.aux_arrays, aux_out):
+                arr._data = new
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        """Write/accumulate gradients into grad_arrays (ref:
+        Executor.backward).  With no out_grads, uses the fused train-step
+        result computed during forward(is_train=True)."""
+        if not self._diff_idx:
+            return
+        if out_grads is None:
+            if self._pending_grads is None:
+                raise MXNetError("backward() requires a prior "
+                                 "forward(is_train=True)")
+            grads = self._pending_grads
+        else:
+            if not isinstance(out_grads, (list, tuple)):
+                out_grads = [out_grads]
+            cts = tuple(self._to_ctx(g).data for g in out_grads)
+            arg_vals = tuple(a.data for a in self.arg_arrays)
+            aux_vals = tuple(a.data for a in self.aux_arrays)
+            grads = self._get_vjp()(arg_vals, aux_vals, self._last_key, cts)
+        for i, j in enumerate(self._diff_idx):
+            name = self.arg_names[j]
+            req = self._grad_req[name]
+            if req == "null":
+                continue
+            garr = self.grad_arrays[j]
+            if garr is None:
+                continue
+            if req == "add":
+                garr._data = garr.data + grads[i]
+            else:
+                garr._data = grads[i]
+
+    # ---- simple_bind -----------------------------------------------------
+    @staticmethod
+    def simple_bind(symbol: Symbol, ctx: Context, grad_req="write",
+                    **shape_kwargs) -> "GraphExecutor":
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shape_kwargs)
+        args = [_nd_mod.zeros(s, ctx=ctx) for s in arg_shapes]
+        aux = [_nd_mod.zeros(s, ctx=ctx) for s in aux_shapes]
+        return GraphExecutor(symbol, ctx, args, grad_req=grad_req,
+                             aux_states=aux)

@@ -1,0 +1,647 @@
+"""Symbol: the symbolic (declarative) frontend.
+
+TPU-native counterpart of the reference's Symbol/nnvm graph layer
+(ref: python/mxnet/symbol/symbol.py, 3rdparty/tvm/nnvm — Node/NodeEntry/
+Graph/Symbol, compose, InferShape, SaveJSON/LoadJSON).
+
+Design (idiomatic TPU, not a port): a Symbol is a lightweight DAG over the
+same pure-jax op registry the imperative path uses.  There is no separate
+graph IR with memory-planning passes — binding a Symbol compiles the WHOLE
+graph into one jitted XLA program (SURVEY.md §7: "graph path becomes
+trace → one jitted XLA program"); XLA does fusion, memory planning and
+layout.  MXNet conveniences are preserved:
+
+  * auto-created parameter variables (``sym.FullyConnected(x, num_hidden=5,
+    name='fc1')`` creates ``fc1_weight``/``fc1_bias``),
+  * auxiliary states (BatchNorm moving stats),
+  * bidirectional ``infer_shape`` (data shape in → weight shapes out) via
+    per-op parameter-shape rules + ``jax.eval_shape`` forward propagation,
+  * nnvm-style JSON save/load (``prefix-symbol.json`` files).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ops.registry import get_op
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
+
+
+class _Node:
+    """One graph node: a variable (op=None) or an op application."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "is_aux",
+                 "shape_hint", "__weakref__")
+
+    def __init__(self, op: Optional[str], name: str, attrs: Dict[str, Any],
+                 inputs: List[Tuple["_Node", int]], num_outputs: int = 1,
+                 is_aux: bool = False, shape_hint=None):
+        self.op = op
+        self.name = name
+        self.attrs = attrs
+        self.inputs = inputs
+        self.num_outputs = num_outputs
+        self.is_aux = is_aux
+        self.shape_hint = tuple(shape_hint) if shape_hint else None
+
+
+class _NameCounter:
+    """Delegates to the active mx.name.NameManager (supports Prefix)."""
+
+    @staticmethod
+    def next(hint: str) -> str:
+        from ..name import current
+
+        return current().get(None, hint)
+
+
+_NAMER = _NameCounter()
+
+
+# --------------------------------------------------------------------------
+# Op schemas: named array inputs, aux inputs, auto-created-parameter shape
+# rules.  This plays the role of nnvm's FListInputNames +
+# FInferShape-for-parameters (ref: src/operator/nn/*-inl.h InferShape).
+# --------------------------------------------------------------------------
+
+def _fc_shapes(ins, attrs):
+    d = ins.get("data")
+    if d is None:
+        return {}
+    flat = attrs.get("flatten", True)
+    in_dim = int(np.prod(d[1:])) if flat else d[-1]
+    nh = attrs["num_hidden"]
+    return {"weight": (nh, in_dim), "bias": (nh,)}
+
+
+def _conv_shapes(ins, attrs):
+    d = ins.get("data")
+    if d is None:
+        return {}
+    nf = attrs["num_filter"]
+    g = attrs.get("num_group", 1)
+    k = tuple(attrs.get("kernel", ()))
+    return {"weight": (nf, d[1] // g) + k, "bias": (nf,)}
+
+
+def _deconv_shapes(ins, attrs):
+    d = ins.get("data")
+    if d is None:
+        return {}
+    nf = attrs["num_filter"]
+    g = attrs.get("num_group", 1)
+    k = tuple(attrs.get("kernel", ()))
+    return {"weight": (d[1], nf // g) + k, "bias": (nf,)}
+
+
+def _chan_shapes(ins, attrs):
+    d = ins.get("data")
+    if d is None:
+        return {}
+    ax = attrs.get("axis", 1)
+    c = (d[ax],)
+    return {k: c for k in ("gamma", "beta", "moving_mean", "moving_var")}
+
+
+def _lastdim_shapes(ins, attrs):
+    d = ins.get("data")
+    if d is None:
+        return {}
+    ax = attrs.get("axis", -1)
+    c = (d[ax],)
+    return {"gamma": c, "beta": c}
+
+
+def _embed_shapes(ins, attrs):
+    return {"weight": (attrs["input_dim"], attrs["output_dim"])}
+
+
+def _label_shapes(ins, attrs):
+    d = ins.get("data")
+    if d is None:
+        return {}
+    return {"label": tuple(d[:-1])}
+
+
+class _Schema:
+    def __init__(self, inputs: Sequence[str], aux: Sequence[str] = (),
+                 optional: Sequence[str] = (), param_shapes=None,
+                 label_suffix: Optional[str] = None):
+        self.inputs = tuple(inputs)          # named graph inputs, in order
+        self.aux = frozenset(aux)            # subset that are aux states
+        self.optional = frozenset(optional)  # skipped when absent (no_bias)
+        self.param_shapes = param_shapes
+        self.label_suffix = label_suffix     # label vars named without prefix
+
+
+SCHEMAS: Dict[str, _Schema] = {
+    "FullyConnected": _Schema(("data", "weight", "bias"), optional=("bias",),
+                              param_shapes=_fc_shapes),
+    "Convolution": _Schema(("data", "weight", "bias"), optional=("bias",),
+                           param_shapes=_conv_shapes),
+    "Deconvolution": _Schema(("data", "weight", "bias"), optional=("bias",),
+                             param_shapes=_deconv_shapes),
+    "BatchNorm": _Schema(("data", "gamma", "beta", "moving_mean", "moving_var"),
+                         aux=("moving_mean", "moving_var"),
+                         param_shapes=_chan_shapes),
+    "LayerNorm": _Schema(("data", "gamma", "beta"),
+                         param_shapes=_lastdim_shapes),
+    "InstanceNorm": _Schema(("data", "gamma", "beta"),
+                            param_shapes=_chan_shapes),
+    "GroupNorm": _Schema(("data", "gamma", "beta"),
+                         param_shapes=_chan_shapes),
+    "RMSNorm": _Schema(("data", "gamma"), param_shapes=_lastdim_shapes),
+    "Embedding": _Schema(("data", "weight"), param_shapes=_embed_shapes),
+    "Dropout": _Schema(("data",)),  # PRNG key injected by the executor
+    "SoftmaxOutput": _Schema(("data", "label"), label_suffix="label",
+                             param_shapes=_label_shapes),
+    "LeakyReLU": _Schema(("data", "gamma"), optional=("gamma",)),
+}
+
+# ops whose kernels consult the train flag; the executor passes _train
+TRAIN_AWARE_OPS = {"BatchNorm", "Dropout"}
+# ops that consume a PRNG key injected at execution time
+KEYED_OPS = {"Dropout"}
+
+
+def _is_sym(x) -> bool:
+    return isinstance(x, Symbol)
+
+
+class Symbol:
+    """An entry (or group of entries) into the symbolic graph."""
+
+    __slots__ = ("_heads",)
+
+    def __init__(self, heads: List[Tuple[_Node, int]]):
+        self._heads = heads
+
+    # ---- identity --------------------------------------------------------
+    @property
+    def name(self) -> str:
+        if len(self._heads) == 1:
+            return self._heads[0][0].name
+        return "group"
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+    def __iter__(self):
+        for i in range(len(self._heads)):
+            yield self[i]
+
+    def __len__(self):
+        return len(self._heads)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            for i, nm in enumerate(self.list_outputs()):
+                if nm == idx:
+                    return Symbol([self._heads[i]])
+            raise MXNetError(f"no output named {idx!r}")
+        return Symbol([self._heads[idx]])
+
+    def attr(self, key):
+        return self._heads[0][0].attrs.get(key)
+
+    # ---- graph traversal -------------------------------------------------
+    def _topo(self) -> List[_Node]:
+        """Post-order DFS from heads, inputs first (nnvm::DFSVisit order)."""
+        seen = set()
+        order: List[_Node] = []
+
+        def visit(node: _Node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for (inp, _) in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for (n, _) in self._heads:
+            visit(n)
+        return order
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in self._topo() if n.op is None and not n.is_aux]
+
+    def list_outputs(self) -> List[str]:
+        out = []
+        for (n, i) in self._heads:
+            if n.num_outputs == 1:
+                out.append(f"{n.name}_output")
+            else:
+                out.append(f"{n.name}_output{i}")
+        return out
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [n.name for n in self._topo() if n.op is None and n.is_aux]
+
+    def get_internals(self) -> "Symbol":
+        heads = []
+        for n in self._topo():
+            for i in range(n.num_outputs):
+                heads.append((n, i))
+        return Symbol(heads)
+
+    def get_children(self) -> Optional["Symbol"]:
+        node = self._heads[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # ---- composition sugar ----------------------------------------------
+    def _binary(self, scalar_op, elem_op, other, reverse=False):
+        if _is_sym(other):
+            a, b = (other, self) if reverse else (self, other)
+            return _apply(elem_op, [a, b], {})
+        attrs = {"scalar": float(other)}
+        return _apply(scalar_op, [self], attrs)
+
+    def __add__(self, o):
+        return self._binary("_plus_scalar", "broadcast_add", o)
+
+    def __radd__(self, o):
+        return self.__add__(o)
+
+    def __sub__(self, o):
+        return self._binary("_minus_scalar", "broadcast_sub", o)
+
+    def __rsub__(self, o):
+        if _is_sym(o):
+            return self._binary(None, "broadcast_sub", o, reverse=True)
+        return _apply("_rminus_scalar", [self], {"scalar": float(o)})
+
+    def __mul__(self, o):
+        return self._binary("_mul_scalar", "broadcast_mul", o)
+
+    def __rmul__(self, o):
+        return self.__mul__(o)
+
+    def __truediv__(self, o):
+        return self._binary("_div_scalar", "broadcast_div", o)
+
+    def __rtruediv__(self, o):
+        if _is_sym(o):
+            return self._binary(None, "broadcast_div", o, reverse=True)
+        return _apply("_rdiv_scalar", [self], {"scalar": float(o)})
+
+    def __pow__(self, o):
+        return self._binary("_power_scalar", "broadcast_power", o)
+
+    def __neg__(self):
+        return _apply("negative", [self], {})
+
+    # common method sugar (subset of the reference's fluent API)
+    def reshape(self, shape):
+        return _apply("reshape", [self], {"shape": tuple(shape)})
+
+    def transpose(self, axes=None):
+        return _apply("transpose", [self], {"axes": tuple(axes) if axes else None})
+
+    def flatten(self):
+        return _apply("flatten", [self], {})
+
+    def sum(self, axis=None, keepdims=False):
+        return _apply("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return _apply("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+    def softmax(self, axis=-1):
+        return _apply("softmax", [self], {"axis": axis})
+
+    # ---- shape/type inference -------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """(arg_shapes, out_shapes, aux_shapes); raises on unknowns
+        (ref: Symbol.infer_shape over nnvm InferShape pass)."""
+        return self._infer_shape_impl(False, *args, **kwargs)
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        import jax
+        import jax.numpy as jnp
+
+        known: Dict[str, Tuple[int, ...]] = {}
+        arg_names = self.list_arguments()
+        if args:
+            for name, shp in zip(arg_names, args):
+                if shp is not None:
+                    known[name] = tuple(shp)
+        for k, v in kwargs.items():
+            known[k] = tuple(v)
+
+        shapes: Dict[Tuple[int, int], Optional[Tuple[int, ...]]] = {}
+        var_shapes: Dict[str, Optional[Tuple[int, ...]]] = {}
+        topo = self._topo()
+        for node in topo:
+            if node.op is None:
+                shp = known.get(node.name) or node.shape_hint
+                var_shapes[node.name] = tuple(shp) if shp else None
+                shapes[(id(node), 0)] = var_shapes[node.name]
+                continue
+            schema = SCHEMAS.get(node.op)
+            in_named = {}
+            if schema:
+                for (inp, idx), nm in zip(node.inputs, schema.inputs):
+                    in_named[nm] = shapes.get((id(inp), idx))
+                if schema.param_shapes:
+                    rules = schema.param_shapes(in_named, node.attrs)
+                    for (inp, idx), nm in zip(node.inputs, schema.inputs):
+                        if inp.op is None and shapes.get((id(inp), idx)) is None \
+                                and nm in rules:
+                            var_shapes[inp.name] = tuple(rules[nm])
+                            shapes[(id(inp), idx)] = var_shapes[inp.name]
+            in_shapes = [shapes.get((id(inp), idx)) for (inp, idx) in node.inputs]
+            if any(s is None for s in in_shapes):
+                for i in range(node.num_outputs):
+                    shapes[(id(node), i)] = None
+                continue
+            out_structs = _eval_node_shape(node, in_shapes)
+            for i in range(node.num_outputs):
+                shapes[(id(node), i)] = tuple(out_structs[i].shape)
+
+        arg_shapes = [var_shapes.get(n) for n in arg_names]
+        aux_shapes = [var_shapes.get(n) for n in self.list_auxiliary_states()]
+        out_shapes = [shapes.get((id(n), i)) for (n, i) in self._heads]
+        if not partial:
+            missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+            if missing or any(s is None for s in out_shapes):
+                raise MXNetError(
+                    f"infer_shape incomplete; unknown arguments: {missing}. "
+                    f"Provide their shapes explicitly.")
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        f32 = np.float32
+        return ([f32] * len(self.list_arguments()),
+                [f32] * len(self._heads),
+                [f32] * len(self.list_auxiliary_states()))
+
+    # ---- serialization (nnvm JSON-compatible layout) --------------------
+    def tojson(self) -> str:
+        topo = self._topo()
+        index = {id(n): i for i, n in enumerate(topo)}
+        nodes = []
+        for n in topo:
+            nodes.append({
+                "op": "null" if n.op is None else n.op,
+                "name": n.name,
+                # symmetric encoding: every attr value json.dumps'd on save
+                # and json.loads'd on load, so a round trip preserves types
+                "attrs": {k: json.dumps(v) for k, v in n.attrs.items()},
+                "inputs": [[index[id(inp)], idx, 0] for (inp, idx) in n.inputs],
+                "num_outputs": n.num_outputs,
+                "is_aux": bool(n.is_aux),
+                "shape_hint": list(n.shape_hint) if n.shape_hint else None,
+            })
+        return json.dumps({
+            "nodes": nodes,
+            "arg_nodes": [i for i, n in enumerate(topo) if n.op is None],
+            "heads": [[index[id(n)], i, 0] for (n, i) in self._heads],
+            "attrs": {"mxnet_version": ["str", "mxnet_tpu"]},
+        }, indent=2)
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):
+        from .executor import GraphExecutor
+
+        return GraphExecutor(self, ctx, args, args_grad=args_grad,
+                             grad_req=grad_req, aux_states=aux_states)
+
+    def simple_bind(self, ctx, grad_req="write", type_dict=None,
+                    **shape_kwargs):
+        from .executor import GraphExecutor
+
+        return GraphExecutor.simple_bind(self, ctx, grad_req=grad_req,
+                                         **shape_kwargs)
+
+    def eval(self, ctx=None, **kwargs):
+        from ..context import current_context
+
+        ctx = ctx or current_context()
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+
+def _eval_node_shape(node: _Node, in_shapes):
+    """Output ShapeDtypeStructs for one node via jax.eval_shape."""
+    import jax
+    import jax.numpy as jnp
+
+    op = get_op(node.op)
+    structs = [jax.ShapeDtypeStruct(s, jnp.float32) for s in in_shapes]
+    attrs = {k: v for k, v in node.attrs.items() if not k.startswith("__")}
+    if node.op in KEYED_OPS:
+        key_struct = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        structs = [structs[0], key_struct] + structs[1:]
+    out = jax.eval_shape(lambda *a: op.fn(*a, **attrs), *structs)
+    if not isinstance(out, (tuple, list)):
+        out = [out]
+    return list(out)
+
+
+# --------------------------------------------------------------------------
+# composition
+# --------------------------------------------------------------------------
+
+def _scope_attrs(user_attr: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """Attributes from the active mx.AttrScope merged with explicit ones,
+    stored as __key__ node attrs (ref: AttrScope.get in attribute.py)."""
+    from ..attribute import current as _current_scope
+
+    merged = _current_scope().get(user_attr)
+    return {f"__{k}__": v for k, v in merged.items()}
+
+
+def _apply(op_name: str, input_syms: List[Symbol], attrs: Dict[str, Any],
+           name: Optional[str] = None) -> Symbol:
+    op = get_op(op_name)
+    name = name or _NAMER.next(op_name.lower().lstrip("_"))
+    attrs = {**attrs, **_scope_attrs()}
+    heads: List[Tuple[_Node, int]] = []
+    for s in input_syms:
+        if len(s._heads) != 1:
+            raise MXNetError(
+                f"op {op_name} input must be single-output, got group")
+        heads.append(s._heads[0])
+    try:
+        nout = op.nout(attrs)
+    except Exception:
+        nout = 1
+    node = _Node(op_name, name, attrs, heads, num_outputs=nout)
+    return Symbol([(node, i) for i in range(nout)]) if nout > 1 \
+        else Symbol([(node, 0)])
+
+
+def make_symbol_function(op_name: str):
+    """Build the symbolic wrapper for a registered op (the counterpart of
+    the reference's generated symbol functions,
+    ref: python/mxnet/symbol/register.py::_make_symbol_function)."""
+    import inspect
+
+    op = get_op(op_name)
+    schema = SCHEMAS.get(op.name)
+    try:
+        sig_params = list(inspect.signature(op.fn).parameters)
+    except (TypeError, ValueError):
+        sig_params = []
+
+    def fn(*args, name: Optional[str] = None, attr=None, **kwargs):
+        node_name = name or _NAMER.next(op.name.lower().lstrip("_"))
+        sym_inputs: List[Optional[Symbol]] = []
+        attrs: Dict[str, Any] = {}
+
+        if schema is not None:
+            named: Dict[str, Symbol] = {}
+            pos = []
+            for a in args:
+                if _is_sym(a):
+                    pos.append(a)
+                else:
+                    raise TypeError(
+                        f"{op.name}: scalar/tuple parameters must be passed "
+                        f"by keyword (got positional {a!r})")
+            for i, s in enumerate(pos):
+                if i < len(schema.inputs):
+                    named[schema.inputs[i]] = s
+            for k in list(kwargs):
+                if _is_sym(kwargs[k]) and k in schema.inputs:
+                    named[k] = kwargs.pop(k)
+            attrs = {k: v for k, v in kwargs.items() if not _is_sym(v)}
+
+            def _wanted(nm: str) -> bool:
+                # optional inputs are auto-created only when the attrs say
+                # the op will use them (bias unless no_bias; PReLU slope)
+                if nm not in schema.optional:
+                    return True
+                if nm == "bias":
+                    return not attrs.get("no_bias", False)
+                if op.name == "LeakyReLU" and nm == "gamma":
+                    return attrs.get("act_type", "leaky") == "prelu"
+                return False
+
+            for nm in schema.inputs:
+                if nm in named:
+                    sym_inputs.append(named[nm])
+                elif _wanted(nm):
+                    sym_inputs.append(
+                        Symbol([(_Node(None, f"{node_name}_{nm}", {}, [],
+                                       is_aux=nm in schema.aux), 0)]))
+        else:
+            # generic op: positional args map onto the pure fn's signature
+            # in order — Symbols become graph inputs, scalars become attrs
+            # under the matching parameter name (mx.sym.expand_dims(x, 1)
+            # → axis=1), matching the generated-wrapper contract
+            slot: Dict[str, Symbol] = {}
+            pos = []
+            attrs = {}
+            for i, a in enumerate(args):
+                if _is_sym(a):
+                    pos.append(a)
+                elif i < len(sig_params):
+                    attrs[sig_params[i]] = a
+                else:
+                    raise TypeError(
+                        f"{op.name}: too many positional arguments")
+            for k in list(kwargs):
+                if _is_sym(kwargs[k]):
+                    slot[k] = kwargs.pop(k)
+            attrs.update(kwargs)
+            if slot:
+                ordered = [p for p in sig_params if p in slot]
+                pos = pos + [slot[p] for p in ordered]
+            sym_inputs = pos
+
+        ins = [s for s in sym_inputs if s is not None]
+        heads = []
+        for s in ins:
+            if len(s._heads) != 1:
+                raise MXNetError(f"{op.name}: group symbol not allowed as input")
+            heads.append(s._heads[0])
+        try:
+            nout = op.nout(attrs)
+        except Exception:
+            nout = 1
+        node = _Node(op.name, node_name, attrs, heads, num_outputs=nout)
+        node.attrs.update(_scope_attrs(attr))
+        return Symbol([(node, i) for i in range(nout)]) if nout > 1 \
+            else Symbol([(node, 0)])
+
+    fn.__name__ = op_name
+    fn.__qualname__ = op_name
+    fn.__doc__ = f"Symbolic wrapper for registered op '{op_name}'."
+    return fn
+
+
+# --------------------------------------------------------------------------
+# public constructors
+# --------------------------------------------------------------------------
+
+def var(name: str, shape=None, init=None, attr=None, dtype=None,
+        lr_mult=None, wd_mult=None, stype=None) -> Symbol:
+    """Create a symbolic variable (ref: symbol.var / sym.Variable)."""
+    attrs = _scope_attrs(attr)
+    if init is not None:
+        attrs["__init__"] = str(init)
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = str(wd_mult)
+    node = _Node(None, name, attrs, [], shape_hint=shape)
+    return Symbol([(node, 0)])
+
+
+Variable = var
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    heads = []
+    for s in symbols:
+        heads.extend(s._heads)
+    return Symbol(heads)
+
+
+def load_json(json_str: str) -> Symbol:
+    data = json.loads(json_str)
+
+    def _tuplify(v):
+        # JSON has no tuple; op attrs use tuples (kernel, stride, shape…)
+        if isinstance(v, list):
+            return tuple(_tuplify(x) for x in v)
+        return v
+
+    nodes: List[_Node] = []
+    for spec in data["nodes"]:
+        attrs = {}
+        for k, v in spec.get("attrs", {}).items():
+            try:
+                attrs[k] = _tuplify(json.loads(v))
+            except (json.JSONDecodeError, TypeError):
+                attrs[k] = v
+        if spec["op"] == "null":
+            node = _Node(None, spec["name"], attrs, [],
+                         is_aux=spec.get("is_aux", False),
+                         shape_hint=spec.get("shape_hint"))
+        else:
+            inputs = [(nodes[i], idx) for (i, idx, _) in spec["inputs"]]
+            node = _Node(spec["op"], spec["name"], attrs, inputs,
+                         num_outputs=spec.get("num_outputs", 1))
+        nodes.append(node)
+    heads = [(nodes[i], idx) for (i, idx, _) in data["heads"]]
+    return Symbol(heads)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())

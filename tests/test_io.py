@@ -1,0 +1,182 @@
+"""Data iterator tests (model: tests/python/unittest/test_io.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import (CSVIter, DataBatch, DataDesc, NDArrayIter,
+                          PrefetchingIter, ResizeIter)
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_ndarrayiter_basic():
+    data = np.arange(40, dtype="float32").reshape(10, 4)
+    label = np.arange(10, dtype="float32")
+    it = NDArrayIter(data, label, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 4)
+    assert_almost_equal(batches[0].data[0], data[:5])
+    assert_almost_equal(batches[1].label[0], label[5:])
+    # reset + reiterate
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_ndarrayiter_pad_and_discard():
+    data = np.arange(14, dtype="float32").reshape(7, 2)
+    it = NDArrayIter(data, None, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[1].pad == 1
+    assert batches[1].data[0].shape == (4, 2)  # padded by wrap-around
+    it = NDArrayIter(data, None, batch_size=4, last_batch_handle="discard")
+    assert len(list(it)) == 1
+
+
+def test_ndarrayiter_shuffle_covers_all():
+    data = np.arange(16, dtype="float32").reshape(16, 1)
+    it = NDArrayIter(data, None, batch_size=4, shuffle=True)
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert sorted(seen.tolist()) == list(range(16))
+
+
+def test_ndarrayiter_dict_input():
+    it = NDArrayIter({"a": np.zeros((6, 2), "float32"),
+                      "b": np.ones((6, 3), "float32")}, None, batch_size=3)
+    names = sorted(d.name for d in it.provide_data)
+    assert names == ["a", "b"]
+
+
+def test_provide_data_descs():
+    it = NDArrayIter(np.zeros((8, 3, 4, 4), "float32"),
+                     np.zeros(8, "float32"), batch_size=2)
+    d = it.provide_data[0]
+    assert isinstance(d, DataDesc)
+    assert d.shape == (2, 3, 4, 4)
+    assert it.provide_label[0].name == "softmax_label"
+
+
+def test_csviter(tmp_path):
+    data = np.random.rand(10, 6).astype("float32")
+    labels = np.arange(10, dtype="float32")
+    data_csv = str(tmp_path / "data.csv")
+    label_csv = str(tmp_path / "label.csv")
+    np.savetxt(data_csv, data, delimiter=",")
+    np.savetxt(label_csv, labels, delimiter=",")
+    it = CSVIter(data_csv=data_csv, data_shape=(6,), label_csv=label_csv,
+                 batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    assert_almost_equal(batches[0].data[0], data[:5], rtol=1e-5, atol=1e-6)
+
+
+def test_resizeiter():
+    data = np.zeros((8, 2), "float32")
+    base = NDArrayIter(data, None, batch_size=4)
+    it = ResizeIter(base, size=5)
+    assert len(list(it)) == 5  # wraps around the underlying 2 batches
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_prefetching_iter():
+    data = np.arange(24, dtype="float32").reshape(12, 2)
+    base = NDArrayIter(data, None, batch_size=4)
+    it = PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 3
+    assert_almost_equal(batches[0].data[0], data[:4])
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_record_iter(tmp_path):
+    cv2 = pytest.importorskip("cv2", reason="needs an image encoder")
+
+
+def test_image_record_iter_synthetic(tmp_path):
+    # pack synthetic images with the recordio writer + image.imencode
+    from mxnet_tpu import recordio
+    from mxnet_tpu import image as img_mod
+    from mxnet_tpu.io import ImageRecordIter
+
+    try:
+        enc = img_mod.imencode(np.zeros((8, 8, 3), np.uint8))
+    except Exception:
+        pytest.skip("no image encoder available in this environment")
+    path = str(tmp_path / "data.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(6):
+        arr = rng.randint(0, 255, size=(10, 12, 3), dtype=np.uint8)
+        packed = recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), arr, quality=90)
+        rec.write(packed)
+    rec.close()
+
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                         batch_size=3)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (3, 3, 8, 8)
+    assert batches[0].label[0].shape == (3,)
+
+
+def test_misc_api_modules():
+    """engine/runtime/visualization/name/attribute parity surfaces."""
+    import mxnet_tpu.engine as engine
+    import mxnet_tpu.runtime as runtime
+
+    with engine.bulk(10):
+        y = mx.nd.ones((2, 2)) + 1
+    assert y.asnumpy().sum() == 8
+    prev = engine.set_bulk_size(20)
+    engine.set_bulk_size(prev)
+
+    feats = runtime.Features()
+    assert feats.is_enabled("JAX")
+    assert any(f.name == "TPU" for f in runtime.feature_list())
+
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.name import Prefix
+
+    with Prefix("mynet_"):
+        s = sym.FullyConnected(sym.var("data"), num_hidden=3)
+    assert s.name.startswith("mynet_")
+
+    from mxnet_tpu.visualization import plot_network, print_summary
+
+    net = sym.FullyConnected(sym.var("data"), num_hidden=3, name="fc")
+    dot = plot_network(net)
+    assert "fc" in str(dot)
+    print_summary(net, shape={"data": (2, 5)})
+
+    from mxnet_tpu.attribute import AttrScope
+
+    with AttrScope(ctx_group="dev1") as scope:
+        assert scope.get(None) == {"ctx_group": "dev1"}
+
+
+def test_monitor_with_module():
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.module import Module
+    from mxnet_tpu.monitor import Monitor
+
+    x = np.random.randn(16, 5).astype("float32")
+    y = np.random.randint(0, 3, 16).astype("float32")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.var("data"), num_hidden=3, name="fc"),
+        name="softmax")
+    mod = Module(net, context=mx.cpu())
+    it = NDArrayIter(x, y, batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mon = Monitor(interval=1, pattern=".*weight.*")
+    mod.install_monitor(mon)
+    mon.tic()
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    stats = mon.toc()
+    assert any("fc_weight" in k for (_, k, _) in stats)

@@ -1,0 +1,203 @@
+"""Module API tests (model: tests/python/unittest/test_module.py)."""
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.io import DataBatch, DataDesc, NDArrayIter
+from mxnet_tpu.module import BucketingModule, Module
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp_sym(hidden=16, classes=4):
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy_data(n=256, dim=10, classes=4, seed=0):
+    """Linearly separable-ish synthetic classification data."""
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim, classes)
+    x = rng.randn(n, dim).astype("float32")
+    y = (x @ w).argmax(axis=1).astype("float32")
+    return x, y
+
+
+def test_module_bind_and_shapes():
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    assert mod.binded
+    mod.init_params()
+    assert mod.params_initialized
+    args, auxs = mod.get_params()
+    assert args["fc1_weight"].shape == (16, 10)
+    assert auxs == {}
+
+
+def test_module_fit_reduces_loss():
+    x, y = _toy_data()
+    train_iter = NDArrayIter(x, y, batch_size=32, shuffle=True)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    # note: SoftmaxOutput grads are summed over the batch (reference
+    # default normalization='null'), so keep lr modest
+    mod.fit(train_iter, num_epoch=12, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.1},
+            eval_metric="acc")
+    score = mod.score(NDArrayIter(x, y, batch_size=32), "acc")
+    acc = dict(score)["accuracy"]
+    assert acc > 0.8, f"accuracy {acc} too low after fit"
+
+
+def test_module_predict_and_outputs():
+    x, y = _toy_data(n=64)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    it = NDArrayIter(x, y, batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (64, 4)
+    probs = out.asnumpy()
+    assert_almost_equal(probs.sum(axis=1), np.ones(64, "float32"),
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_module_multi_device_matches_single():
+    # 2 virtual CPU devices slice the batch; same init -> same params after
+    # one update (the reference's DataParallelExecutorGroup contract)
+    x, y = _toy_data(n=32)
+    sym_net = _mlp_sym()
+
+    def run(ctxs, seed=7):
+        mx.random.seed(seed)
+        np.random.seed(seed)
+        mod = Module(sym_net, context=ctxs)
+        it = NDArrayIter(x, y, batch_size=32)
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        batch = next(iter(it))
+        mod.forward_backward(batch)
+        mod.update()
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    single = run(mx.cpu(0))
+    double = run([mx.cpu(0), mx.cpu(1)])
+    for k in single:
+        # grad aggregation across slices is summed; both runs see the same
+        # total batch, so params must match closely
+        assert_almost_equal(single[k], double[k], rtol=1e-4, atol=1e-5,
+                            names=(f"single:{k}", f"double:{k}"))
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    x, y = _toy_data(n=64)
+    prefix = str(tmp_path / "mlp")
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    it = NDArrayIter(x, y, batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.save_checkpoint(prefix, 3)
+
+    mod2 = Module.load(prefix, 3, context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    p1 = mod.get_params()[0]
+    p2 = mod2.get_params()[0]
+    for k in p1:
+        assert_almost_equal(p1[k], p2[k])
+    # loaded module produces identical predictions
+    o1 = mod.predict(it).asnumpy()
+    o2 = mod2.predict(it).asnumpy()
+    assert_almost_equal(o1, o2, rtol=1e-5, atol=1e-6)
+
+
+def test_module_optimizer_states_roundtrip(tmp_path):
+    x, y = _toy_data(n=32)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    it = NDArrayIter(x, y, batch_size=32)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    mod.update()
+    path = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(path)
+    mod.load_optimizer_states(path)
+
+
+def test_bucketing_module():
+    # variable-length "sequences": bucket_key = seq len; shared params
+    vocab, emb_dim, classes = 20, 8, 3
+
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        emb = sym.Embedding(data, input_dim=vocab, output_dim=emb_dim,
+                            name="embed")
+        pooled = emb.mean(axis=1)
+        fc = sym.FullyConnected(pooled, num_hidden=classes, name="fc")
+        out = sym.SoftmaxOutput(fc, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = BucketingModule(sym_gen, default_bucket_key=10, context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (4, 10))],
+             label_shapes=[DataDesc("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+
+    rng = np.random.RandomState(0)
+    for seq_len in (10, 5, 10, 7):
+        x = rng.randint(0, vocab, size=(4, seq_len)).astype("float32")
+        y = rng.randint(0, classes, size=(4,)).astype("float32")
+        batch = DataBatch(
+            data=[nd.array(x)], label=[nd.array(y)], bucket_key=seq_len,
+            provide_data=[DataDesc("data", (4, seq_len))],
+            provide_label=[DataDesc("softmax_label", (4,))])
+        mod.forward_backward(batch)
+        mod.update()
+        assert mod.get_outputs()[0].shape == (4, classes)
+    # params shared across buckets: embedding updated by all bucket steps
+    assert len(mod._buckets) == 3
+
+
+def test_feedforward_compat():
+    from mxnet_tpu.model import FeedForward
+
+    x, y = _toy_data(n=128)
+    ff = FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=3,
+                     numpy_batch_size=32,
+                     initializer=mx.initializer.Xavier(),
+                     optimizer_params={"learning_rate": 0.1})
+    ff.fit(x, y)
+    preds = ff.predict(x)
+    assert preds.shape == (128, 4)
+    acc = (preds.argmax(1) == y).mean()
+    assert acc > 0.6
+
+
+def test_fit_with_callbacks_and_eval(tmp_path, caplog):
+    from mxnet_tpu import callback
+
+    x, y = _toy_data(n=96)
+    train = NDArrayIter(x, y, batch_size=32)
+    val = NDArrayIter(x, y, batch_size=32)
+    prefix = str(tmp_path / "cb")
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    with caplog.at_level(logging.INFO):
+        mod.fit(train, eval_data=val, num_epoch=2,
+                optimizer_params={"learning_rate": 0.1},
+                batch_end_callback=callback.Speedometer(32, frequent=2),
+                epoch_end_callback=callback.do_checkpoint(prefix))
+    import os
+
+    assert os.path.exists(f"{prefix}-symbol.json")
+    assert os.path.exists(f"{prefix}-0002.params")

@@ -1,0 +1,207 @@
+"""Symbol + GraphExecutor tests.
+
+Model: tests/python/unittest/test_symbol.py, test_executor.py,
+test_infer_shape.py in the reference.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_compose_and_list_arguments():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+    assert out.list_auxiliary_states() == []
+
+
+def test_infer_shape_bidirectional():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(8, 10))
+    args = dict(zip(out.list_arguments(), arg_shapes))
+    assert args["fc1_weight"] == (16, 10)
+    assert args["fc1_bias"] == (16,)
+    assert args["fc2_weight"] == (4, 16)
+    assert args["softmax_label"] == (8,)
+    assert out_shapes == [(8, 4)]
+
+
+def test_infer_shape_conv():
+    data = sym.var("data")
+    c1 = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                         name="conv1")
+    p = sym.Pooling(c1, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="pool1")
+    arg_shapes, out_shapes, _ = p.infer_shape(data=(2, 3, 8, 8))
+    args = dict(zip(p.list_arguments(), arg_shapes))
+    assert args["conv1_weight"] == (8, 3, 3, 3)
+    assert args["conv1_bias"] == (8,)
+    assert out_shapes == [(2, 8, 4, 4)]
+
+
+def test_operators_on_symbols():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = (a + b) * 2.0 - b / 2.0
+    ex = c.bind(mx.cpu(), {"a": nd.array([1.0, 2.0]),
+                           "b": nd.array([3.0, 4.0])})
+    out = ex.forward()[0]
+    assert_almost_equal(out, np.array([6.5, 10.0], "float32"))
+
+
+def test_executor_forward_matches_numpy():
+    out = _mlp()
+    ex = out.simple_bind(mx.cpu(), data=(8, 10))
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 10).astype("float32")
+    w1 = rng.randn(16, 10).astype("float32") * 0.1
+    b1 = np.zeros(16, "float32")
+    w2 = rng.randn(4, 16).astype("float32") * 0.1
+    b2 = np.zeros(4, "float32")
+    ex.copy_params_from({"fc1_weight": w1, "fc1_bias": b1,
+                         "fc2_weight": w2, "fc2_bias": b2})
+    res = ex.forward(data=x)[0].asnumpy()
+    h = np.maximum(x @ w1.T + b1, 0)
+    logits = h @ w2.T + b2
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    assert_almost_equal(res, e / e.sum(1, keepdims=True), rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_executor_backward_softmax_grad():
+    out = _mlp()
+    ex = out.simple_bind(mx.cpu(), data=(4, 6))
+    rng = np.random.RandomState(1)
+    params = {"fc1_weight": rng.randn(16, 6).astype("float32") * 0.3,
+              "fc1_bias": rng.randn(16).astype("float32") * 0.1,
+              "fc2_weight": rng.randn(4, 16).astype("float32") * 0.3,
+              "fc2_bias": rng.randn(4).astype("float32") * 0.1}
+    ex.copy_params_from(params)
+    x = rng.randn(4, 6).astype("float32")
+    y = np.array([0, 1, 2, 3], "float32")
+    probs = ex.forward(is_train=True, data=x, softmax_label=y)[0].asnumpy()
+    ex.backward()
+    # SoftmaxOutput gradient wrt logits is (p - onehot)/... — check via the
+    # chain into fc2_bias: dL/db2 = sum_b (p - y_onehot)
+    onehot = np.eye(4, dtype="float32")[y.astype(int)]
+    expect_db2 = (probs - onehot).sum(0)
+    assert_almost_equal(ex.grad_dict["fc2_bias"], expect_db2, rtol=1e-3,
+                        atol=1e-4)
+    # data grad exists and label grad_req is honored
+    assert ex.grad_dict["data"] is not None
+
+
+def test_executor_explicit_out_grads():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = a * b
+    ex = c.bind(mx.cpu(), {"a": nd.array([1.0, 2.0]),
+                           "b": nd.array([3.0, 4.0])})
+    ex.forward(is_train=True)
+    ex.backward(out_grads=nd.array([10.0, 10.0]))
+    assert_almost_equal(ex.grad_dict["a"], np.array([30.0, 40.0], "float32"))
+    assert_almost_equal(ex.grad_dict["b"], np.array([10.0, 20.0], "float32"))
+
+
+def test_grad_req_add_and_null():
+    a = sym.var("a")
+    c = a * 2.0
+    ex = c.bind(mx.cpu(), {"a": nd.array([1.0])}, grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.forward(is_train=True)
+    ex.backward()
+    assert_almost_equal(ex.grad_dict["a"], np.array([4.0], "float32"))
+    ex2 = c.bind(mx.cpu(), {"a": nd.array([1.0])}, grad_req="null")
+    ex2.forward(is_train=True)
+    ex2.backward()
+    assert ex2.grad_dict["a"] is None
+
+
+def test_batchnorm_aux_states_update():
+    data = sym.var("data")
+    bn = sym.BatchNorm(data, name="bn0")
+    assert bn.list_arguments() == ["data", "bn0_gamma", "bn0_beta"]
+    assert bn.list_auxiliary_states() == ["bn0_moving_mean", "bn0_moving_var"]
+    ex = bn.simple_bind(mx.cpu(), data=(6, 3, 4, 4))
+    ex.copy_params_from({"bn0_gamma": np.ones(3, "float32"),
+                         "bn0_beta": np.zeros(3, "float32")})
+    x = np.random.randn(6, 3, 4, 4).astype("float32") + 2.0
+    before = ex.aux_dict["bn0_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True, data=x)
+    ex.backward()
+    after = ex.aux_dict["bn0_moving_mean"].asnumpy()
+    assert not np.allclose(before, after)
+    expect = 0.9 * before + 0.1 * x.mean(axis=(0, 2, 3))
+    assert_almost_equal(after, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_train_vs_inference():
+    data = sym.var("data")
+    d = sym.Dropout(data, p=0.5, name="drop0")
+    ex = d.bind(mx.cpu(), {"data": nd.ones((1000,))})
+    out = ex.forward()[0].asnumpy()
+    assert_almost_equal(out, np.ones(1000, "float32"))
+    out_t = ex.forward(is_train=True)[0].asnumpy()
+    kept = out_t > 0
+    assert 0.3 < kept.mean() < 0.7
+
+
+def test_group_and_getitem():
+    a = sym.var("a")
+    s1 = a * 2.0
+    s2 = a + 1.0
+    g = sym.Group([s1, s2])
+    assert len(g.list_outputs()) == 2
+    ex = g.bind(mx.cpu(), {"a": nd.array([1.0, 2.0])})
+    o1, o2 = ex.forward()
+    assert_almost_equal(o1, np.array([2.0, 4.0], "float32"))
+    assert_almost_equal(o2, np.array([2.0, 3.0], "float32"))
+    s = g[1]
+    assert s.list_outputs() == g.list_outputs()[1:2]
+
+
+def test_json_roundtrip(tmp_path):
+    out = _mlp()
+    path = str(tmp_path / "net-symbol.json")
+    out.save(path)
+    loaded = sym.load(path)
+    assert loaded.list_arguments() == out.list_arguments()
+    assert loaded.list_outputs() == out.list_outputs()
+    # loaded symbol still executes
+    ex = loaded.simple_bind(mx.cpu(), data=(2, 10))
+    ex.forward(data=np.random.randn(2, 10).astype("float32"))
+    assert ex.outputs[0].shape == (2, 4)
+
+
+def test_get_internals():
+    out = _mlp()
+    internals = out.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names
+    fc1 = internals["fc1_output"]
+    arg_shapes, out_shapes, _ = fc1.infer_shape(data=(2, 10))
+    assert out_shapes == [(2, 16)]
+
+
+def test_embedding_symbol():
+    data = sym.var("data")
+    emb = sym.Embedding(data, input_dim=20, output_dim=5, name="embed0")
+    assert emb.list_arguments() == ["data", "embed0_weight"]
+    arg_shapes, out_shapes, _ = emb.infer_shape(data=(3, 7))
+    assert dict(zip(emb.list_arguments(), arg_shapes))["embed0_weight"] == (20, 5)
+    assert out_shapes == [(3, 7, 5)]
