@@ -1,0 +1,12 @@
+"""Contrib namespace (ref: python/mxnet/contrib/).
+
+`mx.contrib.ndarray.MultiBoxPrior(...)` / `mx.contrib.symbol.*` proxy the
+contrib ops registered in mxnet_tpu.ops.contrib, mirroring the reference's
+`_contrib_*` generated namespaces.
+"""
+from . import ndarray
+from . import ndarray as nd
+from . import symbol
+from . import symbol as sym
+
+__all__ = ["ndarray", "nd", "symbol", "sym"]

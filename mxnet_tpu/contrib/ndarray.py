@@ -1,0 +1,9 @@
+"""mx.contrib.ndarray — imperative contrib op wrappers
+(ref: python/mxnet/ndarray/contrib.py generated namespace)."""
+from __future__ import annotations
+
+from ..ndarray import register as _register
+
+
+def __getattr__(name):
+    return _register.lookup(name)

@@ -1,0 +1,18 @@
+"""mx.contrib.symbol — symbolic contrib op wrappers
+(ref: python/mxnet/symbol/contrib.py generated namespace)."""
+from __future__ import annotations
+
+from ..ops.registry import OP_REGISTRY
+from ..symbol.symbol import make_symbol_function
+
+_CACHE = {}
+
+
+def __getattr__(name):
+    if name in _CACHE:
+        return _CACHE[name]
+    if name in OP_REGISTRY:
+        fn = make_symbol_function(name)
+        _CACHE[name] = fn
+        return fn
+    raise AttributeError(f"no contrib symbol op {name!r}")

@@ -14,6 +14,7 @@ from . import nn  # noqa: F401
 from . import rnn  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import random_ops  # noqa: F401
+from . import contrib  # noqa: F401
 
 __all__ = ["registry", "OP_REGISTRY", "Operator", "apply_pure", "get_op",
            "invoke", "list_ops", "register_op"]

@@ -50,13 +50,16 @@ class Operator:
 
     def __init__(self, name: str, fn: Callable, *, num_outputs=1,
                  differentiable: bool = True, mutate_inputs: Sequence[int] = (),
-                 aliases: Sequence[str] = ()):
+                 aliases: Sequence[str] = (), no_jit: bool = False):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs
         self.differentiable = differentiable
         self.mutate_inputs = tuple(mutate_inputs)
         self.aliases = tuple(aliases)
+        # eager-only op: output shape depends on input VALUES (boolean_mask)
+        # — cannot be traced/jitted; invoke calls fn on concrete arrays
+        self.no_jit = no_jit
 
     def nout(self, attrs: dict) -> int:
         if callable(self.num_outputs):
@@ -71,13 +74,15 @@ OP_REGISTRY: Registry[Operator] = Registry("operator", lowercase=False)
 
 
 def register_op(name: str, *, num_outputs=1, differentiable: bool = True,
-                mutate_inputs: Sequence[int] = (), aliases: Sequence[str] = ()):
+                mutate_inputs: Sequence[int] = (), aliases: Sequence[str] = (),
+                no_jit: bool = False):
     """Decorator: register a pure jax function as a framework op."""
 
     def _wrap(fn: Callable) -> Callable:
         op = Operator(name, fn, num_outputs=num_outputs,
                       differentiable=differentiable,
-                      mutate_inputs=mutate_inputs, aliases=aliases)
+                      mutate_inputs=mutate_inputs, aliases=aliases,
+                      no_jit=no_jit)
         OP_REGISTRY.register(name)(op)
         for a in aliases:
             OP_REGISTRY.register(a)(op)
@@ -205,7 +210,10 @@ def invoke(op_name: str, *inputs, **attrs):
             arrays.append(x)
     attrs_key = freeze_attrs(attrs)
     with profile_op(op.name):
-        out = jitted(op, attrs_key)(*arrays)
+        if op.no_jit:
+            out = op.fn(*arrays, **attrs)
+        else:
+            out = jitted(op, attrs_key)(*arrays)
     if _NAIVE:
         from .. import engine as _engine
 

@@ -1,0 +1,182 @@
+"""Contrib detection-op tests vs numpy references
+(model: tests/python/unittest/test_contrib_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _np_iou(a, b):
+    ix1 = max(a[0], b[0]); iy1 = max(a[1], b[1])
+    ix2 = min(a[2], b[2]); iy2 = min(a[3], b[3])
+    iw = max(0.0, ix2 - ix1); ih = max(0.0, iy2 - iy1)
+    inter = iw * ih
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_box_iou():
+    a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], "float32")
+    b = np.array([[0, 0, 2, 2], [2, 2, 4, 4], [0.5, 0.5, 1.5, 1.5]], "float32")
+    got = nd.box_iou(nd.array(a), nd.array(b)).asnumpy()
+    expect = np.array([[_np_iou(x, y) for y in b] for x in a], "float32")
+    assert_almost_equal(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_prior():
+    data = nd.zeros((1, 3, 4, 4))
+    anchors = nd.MultiBoxPrior(data, sizes=(0.5, 0.25), ratios=(1, 2))
+    # per pixel: len(sizes)+len(ratios)-1 = 3 anchors
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0]
+    # first anchor at first pixel: center (0.125, 0.125), size 0.5
+    assert_almost_equal(a[0], np.array([0.125 - 0.25, 0.125 - 0.25,
+                                        0.125 + 0.25, 0.125 + 0.25],
+                                       "float32"), rtol=1e-5, atol=1e-6)
+    # ratio-2 anchor: w = s*sqrt(2)/2, h = s/sqrt(2)/2 around same center
+    w = 0.5 * np.sqrt(2) / 2
+    h = 0.5 / np.sqrt(2) / 2
+    assert_almost_equal(a[2], np.array([0.125 - w, 0.125 - h,
+                                        0.125 + w, 0.125 + h], "float32"),
+                        rtol=1e-5, atol=1e-6)
+    # centers advance by 1/4
+    assert_almost_equal(a[3][:2], a[0][:2] + np.array([0.25, 0.0], "float32"),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_target_matching():
+    # 4 anchors, one clearly matching gt box
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0],
+                         [0.4, 0.4, 0.6, 0.6]]], "float32")
+    # one gt: class 1 at top-left quadrant; pad second row with -1
+    label = np.array([[[1, 0.05, 0.05, 0.45, 0.45],
+                       [-1, -1, -1, -1, -1]]], "float32")
+    cls_pred = np.zeros((1, 3, 4), "float32")
+    bt, bm, ct = nd.MultiBoxTarget(nd.array(anchors), nd.array(label),
+                                   nd.array(cls_pred))
+    ct = ct.asnumpy()[0]
+    bm = bm.asnumpy()[0].reshape(4, 4)
+    # anchor 0 matches gt (IoU ~0.64) -> class 1+1 = 2
+    assert ct[0] == 2.0
+    assert bm[0].sum() == 4.0
+    # far anchors are background with zero mask
+    assert ct[1] == 0.0
+    assert bm[1].sum() == 0.0
+    # encoded offsets for anchor 0: gt center (0.25,0.25) == anchor center
+    bt = bt.asnumpy()[0].reshape(4, 4)
+    assert_almost_equal(bt[0][:2], np.zeros(2, "float32"), rtol=1e-4,
+                        atol=1e-4)
+
+
+def test_multibox_target_negative_mining():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0],
+                         [0.5, 0.0, 1.0, 0.5]]], "float32")
+    label = np.array([[[0, 0.0, 0.0, 0.5, 0.5]]], "float32")
+    cls_pred = np.random.randn(1, 2, 4).astype("float32")
+    bt, bm, ct = nd.MultiBoxTarget(nd.array(anchors), nd.array(label),
+                                   nd.array(cls_pred),
+                                   negative_mining_ratio=1.0,
+                                   negative_mining_thresh=0.5)
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 1.0  # matched, class 0 -> target 1
+    # with ratio 1.0 and 1 positive, at most 1 hard negative kept as 0;
+    # the rest are ignore_label (-1)
+    assert (ct == -1.0).sum() >= 2
+
+
+def test_multibox_detection_and_nms():
+    # two anchors, classes: bg + 1 fg; both predict same box -> NMS keeps 1
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.12, 0.12, 0.42, 0.42],
+                         [0.6, 0.6, 0.9, 0.9]]], "float32")
+    cls_prob = np.array([[[0.1, 0.2, 0.1],     # background
+                          [0.9, 0.8, 0.9]]], "float32")  # class 0
+    loc_pred = np.zeros((1, 12), "float32")    # no offsets: boxes = anchors
+    out = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                               nd.array(anchors),
+                               nms_threshold=0.5).asnumpy()[0]
+    kept = out[out[:, 0] >= 0]
+    # anchor 0/1 overlap highly -> one suppressed; anchor 2 separate
+    assert kept.shape[0] == 2
+    scores = sorted(kept[:, 1].tolist(), reverse=True)
+    assert scores[0] == pytest.approx(0.9)
+    # suppressed rows are -1
+    assert (out[:, 0] < 0).sum() == 1
+
+
+def test_box_nms_vs_numpy():
+    rng = np.random.RandomState(0)
+    n = 20
+    boxes = rng.rand(n, 2) * 0.5
+    data = np.zeros((n, 6), "float32")
+    data[:, 2:4] = boxes
+    data[:, 4:6] = boxes + 0.3
+    data[:, 1] = rng.rand(n)  # scores
+    data[:, 0] = 0            # one class
+    got = nd.box_nms(nd.array(data), overlap_thresh=0.5,
+                     force_suppress=True).asnumpy()
+    # numpy greedy reference
+    order = np.argsort(-data[:, 1])
+    keep = []
+    for i in order:
+        if all(_np_iou(data[i, 2:6], data[j, 2:6]) <= 0.5 for j in keep):
+            keep.append(i)
+    kept_scores = sorted(got[got[:, 0] >= 0][:, 1].tolist(), reverse=True)
+    expect_scores = sorted(data[keep, 1].tolist(), reverse=True)
+    assert_almost_equal(np.array(kept_scores), np.array(expect_scores),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_bipartite_matching():
+    dist = np.array([[0.9, 0.1], [0.8, 0.7], [0.2, 0.6]], "float32")
+    rows, cols = nd.bipartite_matching(nd.array(dist), threshold=0.05)
+    rows, cols = rows.asnumpy(), cols.asnumpy()
+    # greedy: (0,0)=0.9 then (1,1)=0.7; row 2 unmatched
+    assert rows.tolist() == [0.0, 1.0, -1.0]
+    assert cols.tolist() == [0.0, 1.0]
+
+
+def test_roi_pooling_vs_torch():
+    torch = pytest.importorskip("torch")
+    tv = pytest.importorskip("torchvision")
+    x = np.random.randn(1, 2, 8, 8).astype("float32")
+    rois = np.array([[0, 0, 0, 7, 7], [0, 2, 2, 6, 6]], "float32")
+    got = nd.ROIPooling(nd.array(x), nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0).asnumpy()
+    ref = tv.ops.roi_pool(torch.tensor(x), torch.tensor(rois[:, :]),
+                          output_size=2, spatial_scale=1.0).numpy()
+    assert_almost_equal(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_roi_align_runs():
+    x = np.random.randn(1, 2, 8, 8).astype("float32")
+    rois = np.array([[0, 1, 1, 6, 6]], "float32")
+    out = nd.ROIAlign(nd.array(x), nd.array(rois), pooled_size=(3, 3),
+                      spatial_scale=1.0, sample_ratio=2)
+    assert out.shape == (1, 2, 3, 3)
+    # values bounded by input range (bilinear interpolation property)
+    assert out.asnumpy().max() <= x.max() + 1e-5
+    assert out.asnumpy().min() >= x.min() - 1e-5
+
+
+def test_boolean_mask():
+    data = np.arange(12, dtype="float32").reshape(4, 3)
+    index = np.array([1, 0, 1, 0], "float32")
+    out = nd.boolean_mask(nd.array(data), nd.array(index))
+    assert_almost_equal(out, data[[0, 2]])
+
+
+def test_contrib_namespaces():
+    import mxnet_tpu.contrib as contrib
+
+    x = nd.zeros((1, 3, 2, 2))
+    a = contrib.nd.MultiBoxPrior(x, sizes=(0.4,), ratios=(1.0,))
+    assert a.shape == (1, 4, 4)
+    s = contrib.sym.box_iou(mx.sym.var("a"), mx.sym.var("b"))
+    assert s.list_arguments() == ["a", "b"]

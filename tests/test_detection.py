@@ -1,0 +1,95 @@
+"""SSD detection model tests (BASELINE config 4 plumbing)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import Trainer
+from mxnet_tpu.gluon.model_zoo.detection import (SSD, SSDMultiBoxLoss,
+                                                 SSDTargetGenerator,
+                                                 get_detection_model,
+                                                 ssd_300_mobilenet1_0)
+
+
+@pytest.fixture(scope="module")
+def small_ssd():
+    net = get_detection_model("ssd_300_mobilenet1.0", classes=3)
+    net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    return net
+
+
+def test_ssd_forward_shapes(small_ssd):
+    x = nd.zeros((2, 3, 128, 128))
+    cls_preds, box_preds, anchors = small_ssd(x)
+    n = anchors.shape[1]
+    assert anchors.shape == (1, n, 4)
+    assert cls_preds.shape == (2, n, 4)   # 3 classes + background
+    assert box_preds.shape == (2, n, 4)
+    a = anchors.asnumpy()
+    assert a.min() >= 0.0 and a.max() <= 1.0  # clipped priors
+
+
+def test_ssd_train_step(small_ssd):
+    net = small_ssd
+    target_gen = SSDTargetGenerator(negative_mining_ratio=-1.0)
+    loss_fn = SSDMultiBoxLoss()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 1e-3})
+
+    x = nd.array(np.random.randn(2, 3, 128, 128).astype("float32"))
+    # one gt box per image: [cls, x1, y1, x2, y2]
+    labels = nd.array(np.array(
+        [[[0, 0.1, 0.1, 0.4, 0.4]], [[2, 0.5, 0.5, 0.9, 0.9]]], "float32"))
+
+    with autograd.record():
+        cls_preds, box_preds, anchors = net(x)
+        box_t, box_m, cls_t = target_gen(anchors, labels, cls_preds)
+        loss = loss_fn(cls_preds, box_preds, cls_t, box_t)
+    loss.backward()
+    trainer.step(2)
+    lval = loss.asnumpy()
+    assert lval.shape == (2,)
+    assert np.isfinite(lval).all()
+    # a second step decreases loss on the same batch (sanity: gradients flow)
+    with autograd.record():
+        cls_preds, box_preds, anchors = net(x)
+        box_t, box_m, cls_t = target_gen(anchors, labels, cls_preds)
+        loss2 = loss_fn(cls_preds, box_preds, cls_t, box_t)
+    loss2.backward()
+    trainer.step(2)
+    assert np.isfinite(loss2.asnumpy()).all()
+
+
+def test_ssd_detection_inference(small_ssd):
+    net = small_ssd
+    x = nd.array(np.random.randn(1, 3, 128, 128).astype("float32"))
+    cls_preds, box_preds, anchors = net(x)
+    cls_probs = nd.softmax(cls_preds, axis=-1)
+    out = nd.MultiBoxDetection(
+        nd.transpose(cls_probs, axes=(0, 2, 1)),
+        nd.reshape(box_preds, shape=(0, -1)),
+        anchors, nms_topk=50)
+    assert out.shape[2] == 6
+    o = out.asnumpy()
+    kept = o[0][o[0, :, 0] >= 0]
+    if kept.size:  # scores are valid probabilities
+        assert (kept[:, 1] >= 0).all() and (kept[:, 1] <= 1).all()
+
+
+def test_ssd_hybridize_matches_eager(small_ssd):
+    net = small_ssd
+    x = nd.array(np.random.randn(1, 3, 128, 128).astype("float32"))
+    eager = [o.asnumpy() for o in net(x)]
+    net.hybridize()
+    hybrid = [o.asnumpy() for o in net(x)]
+    for e, h in zip(eager, hybrid):
+        np.testing.assert_allclose(e, h, rtol=1e-4, atol=1e-4)
+    net.hybridize(active=False)
+
+
+def test_ssd_resnet50_constructs():
+    # construction + param structure only (forward is heavy for unit CI;
+    # the bench drives it on TPU)
+    net = get_detection_model("ssd_300_resnet50_v1", classes=20)
+    names = list(net.collect_params().keys())
+    assert any("cls" in n for n in names)
+    assert any("extra" in n for n in names)
