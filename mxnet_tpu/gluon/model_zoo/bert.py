@@ -1,0 +1,242 @@
+"""BERT models (BASELINE config 3: BERT-base pretraining).
+
+Counterpart of the GluonNLP BERT stack the reference ecosystem provides
+(ref: gluonnlp model/bert.py — BERTModel/BERTEncoder; the fused attention
+ops in src/operator/contrib/transformer.cc).
+
+TPU-first design: the encoder is a plain HybridBlock stack → hybridize
+compiles the whole network (embeddings → N layers → heads) into ONE XLA
+program in bf16-friendly form; attention goes through the registered
+`dot_product_attention` op (Pallas kernel on TPU, XLA fallback elsewhere
+— ops/pallas_attention.py); the MLM decoder ties the word-embedding
+matrix (shared Parameter), matching BERT's weight tying.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...base import MXNetError
+from .. import nn
+from ..block import HybridBlock
+
+__all__ = ["BERTModel", "BERTEncoder", "BERTEncoderCell",
+           "bert_12_768_12", "bert_24_1024_16", "get_bert_model"]
+
+
+class BERTSelfAttention(HybridBlock):
+    """Multi-head self-attention via the fused attention op."""
+
+    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise MXNetError(f"units {units} not divisible by heads {num_heads}")
+        self._units = units
+        self._num_heads = num_heads
+        self._dropout = dropout
+        with self.name_scope():
+            self.query = nn.Dense(units, flatten=False, prefix="query_")
+            self.key = nn.Dense(units, flatten=False, prefix="key_")
+            self.value = nn.Dense(units, flatten=False, prefix="value_")
+            self.proj = nn.Dense(units, flatten=False, prefix="proj_")
+            self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x, mask):
+        q = self.query(x)
+        k = self.key(x)
+        v = self.value(x)
+        # dropout on the attention probabilities (BERT convention) is
+        # threaded through the fused-attention frontend
+        out = F.dot_product_attention(q, k, v, mask,
+                                      num_heads=self._num_heads,
+                                      dropout=self._dropout)
+        out = self.proj(out)
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return out
+
+
+class BERTPositionwiseFFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ffn_1 = nn.Dense(hidden_size, flatten=False,
+                                  activation="gelu", prefix="ffn1_")
+            self.ffn_2 = nn.Dense(units, flatten=False, prefix="ffn2_")
+            self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        out = self.ffn_2(self.ffn_1(x))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return out
+
+
+class BERTEncoderCell(HybridBlock):
+    """Post-LN transformer encoder layer (BERT convention)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attention = BERTSelfAttention(units, num_heads, dropout,
+                                               prefix="attn_")
+            self.ln1 = nn.LayerNorm(epsilon=1e-12, prefix="ln1_")
+            self.ffn = BERTPositionwiseFFN(units, hidden_size, dropout,
+                                           prefix="ffn_")
+            self.ln2 = nn.LayerNorm(epsilon=1e-12, prefix="ln2_")
+
+    def hybrid_forward(self, F, x, mask):
+        x = self.ln1(x + self.attention(x, mask))
+        x = self.ln2(x + self.ffn(x))
+        return x
+
+
+class BERTEncoder(HybridBlock):
+    """N-layer transformer encoder (ref: gluonnlp BERTEncoder)."""
+
+    def __init__(self, num_layers=12, units=768, hidden_size=3072,
+                 num_heads=12, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        with self.name_scope():
+            self.layers = nn.HybridSequential(prefix="layers_")
+            for i in range(num_layers):
+                self.layers.add(BERTEncoderCell(units, hidden_size, num_heads,
+                                                dropout, prefix=f"layer{i}_"))
+
+    def hybrid_forward(self, F, x, mask):
+        for cell in self.layers._children.values():
+            x = cell(x, mask)
+        return x
+
+
+class _MLMDecoder(HybridBlock):
+    """MLM head: transform + LN + vocab projection with the TIED
+    word-embedding matrix.  A HybridBlock, so `net.mlm_decoder.hybridize()`
+    compiles the head into one XLA program too."""
+
+    def __init__(self, units, vocab_size, embed_weight, **kwargs):
+        super().__init__(**kwargs)
+        self._vocab_size = vocab_size
+        with self.name_scope():
+            self.transform = nn.Dense(units, flatten=False,
+                                      activation="gelu", prefix="transform_")
+            self.ln = nn.LayerNorm(epsilon=1e-12, prefix="ln_")
+            self.bias = self.params.get("bias", shape=(vocab_size,),
+                                        init="zeros")
+        # shared Parameter (weight tying): registering the embedding's own
+        # Parameter here makes it flow into hybrid_forward and the trace
+        self.embed_weight = embed_weight
+
+    def hybrid_forward(self, F, x, embed_weight, bias):
+        h = self.ln(self.transform(x))
+        return F.FullyConnected(h, embed_weight, bias,
+                                num_hidden=self._vocab_size, flatten=False)
+
+
+class BERTModel(HybridBlock):
+    """BERT with pooler, tied MLM decoder, and NSP classifier.
+
+    forward(inputs, token_types, valid_length) ->
+        (sequence_output (B, S, U), pooled_output (B, U))
+    `decode_mlm(sequence_output)` -> (B, S, vocab) scores (tied weights);
+    `classify_nsp(pooled_output)` -> (B, 2).  The heads are HybridBlocks —
+    hybridize() covers the encoder program; the heads compile as their own
+    programs when invoked (they run outside the encoder's forward).
+    """
+
+    def __init__(self, vocab_size=30522, token_type_vocab_size=2,
+                 units=768, hidden_size=3072, max_length=512,
+                 num_layers=12, num_heads=12, dropout=0.1,
+                 use_pooler=True, use_decoder=True, use_classifier=True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._vocab_size = vocab_size
+        self._use_pooler = use_pooler
+        self._use_decoder = use_decoder
+        self._use_classifier = use_classifier
+        with self.name_scope():
+            self.word_embed = nn.Embedding(vocab_size, units,
+                                           prefix="word_embed_")
+            self.token_type_embed = nn.Embedding(token_type_vocab_size, units,
+                                                 prefix="token_type_embed_")
+            self.position_weight = self.params.get(
+                "position_embed_weight", shape=(max_length, units),
+                init="normal")
+            self.embed_ln = nn.LayerNorm(epsilon=1e-12, prefix="embed_ln_")
+            self.embed_dropout = nn.Dropout(dropout) if dropout else None
+            self.encoder = BERTEncoder(num_layers, units, hidden_size,
+                                       num_heads, dropout, prefix="encoder_")
+            if use_pooler:
+                self.pooler = nn.Dense(units, flatten=False,
+                                       activation="tanh", prefix="pooler_")
+            if use_decoder:
+                self.mlm_decoder = _MLMDecoder(units, vocab_size,
+                                               self.word_embed.weight,
+                                               prefix="mlm_")
+            if use_classifier:
+                self.classifier = nn.Dense(2, flatten=False,
+                                           prefix="nsp_classifier_")
+
+    def hybrid_forward(self, F, inputs, token_types, valid_length,
+                       position_weight):
+        x = self.word_embed(inputs) + self.token_type_embed(token_types)
+        seq_len = inputs.shape[1]
+        pos = F.slice_axis(position_weight, axis=0, begin=0, end=seq_len)
+        x = F.broadcast_add(x, F.expand_dims(pos, axis=0))
+        x = self.embed_ln(x)
+        if self.embed_dropout is not None:
+            x = self.embed_dropout(x)
+        # key-validity mask (B, S) from valid_length
+        steps = F._arange_like(inputs, axis=1)
+        mask = F.cast(F.broadcast_lesser(
+            F.expand_dims(steps, axis=0),
+            F.expand_dims(valid_length, axis=-1)), dtype="float32")
+        seq = self.encoder(x, mask)
+        outputs = [seq]
+        if self._use_pooler:
+            cls_tok = F.squeeze(F.slice_axis(seq, axis=1, begin=0, end=1),
+                                axis=1)
+            outputs.append(self.pooler(cls_tok))
+        return tuple(outputs) if len(outputs) > 1 else outputs[0]
+
+    # ---- heads (hybridizable sub-programs) -------------------------------
+    def decode_mlm(self, sequence_output):
+        """MLM scores over every position with tied embedding weights."""
+        if not self._use_decoder:
+            raise MXNetError("model built with use_decoder=False")
+        return self.mlm_decoder(sequence_output)
+
+    def classify_nsp(self, pooled_output):
+        if not self._use_classifier:
+            raise MXNetError("model built with use_classifier=False")
+        return self.classifier(pooled_output)
+
+
+_BERT_SPECS = {
+    "bert_12_768_12": dict(num_layers=12, units=768, hidden_size=3072,
+                           num_heads=12),
+    "bert_24_1024_16": dict(num_layers=24, units=1024, hidden_size=4096,
+                            num_heads=16),
+}
+
+
+def get_bert_model(model_name="bert_12_768_12", vocab_size=30522,
+                   dropout=0.1, max_length=512, **kwargs):
+    if model_name not in _BERT_SPECS:
+        raise MXNetError(f"unknown BERT model {model_name}; have "
+                         f"{sorted(_BERT_SPECS)}")
+    spec = dict(_BERT_SPECS[model_name])
+    spec.update(kwargs)
+    return BERTModel(vocab_size=vocab_size, dropout=dropout,
+                     max_length=max_length, **spec)
+
+
+def bert_12_768_12(**kwargs):
+    """BERT-base (ref: gluonnlp bert_12_768_12)."""
+    return get_bert_model("bert_12_768_12", **kwargs)
+
+
+def bert_24_1024_16(**kwargs):
+    """BERT-large (ref: gluonnlp bert_24_1024_16)."""
+    return get_bert_model("bert_24_1024_16", **kwargs)

@@ -58,6 +58,23 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
     return res
 
 
+def dot_product_attention(query, key, value, valid_mask=None, num_heads=1,
+                          scale=None, dropout=0.0, **kw):
+    """Fused attention frontend — threads the PRNG key + train flag for
+    attention-probability dropout (ref: BERT dropout-on-softmax)."""
+    if valid_mask is None:
+        import numpy as _np
+
+        from .ndarray import array as _array
+
+        sk = key.shape[1] if key.ndim == 3 else key.shape[2]
+        valid_mask = _array(_np.ones((key.shape[0], sk), _np.float32),
+                            ctx=key.ctx)
+    return invoke("dot_product_attention", query, key, value, valid_mask,
+                  _random.next_key(), num_heads=num_heads, scale=scale,
+                  dropout=dropout, _train=autograd.is_training())
+
+
 def _make_random_wrapper(op_name: str):
     def fn(*args, ctx=None, **kwargs):
         out = invoke(op_name, _random.next_key(), *args, **kwargs)
@@ -74,6 +91,8 @@ _SPECIAL: Dict[str, Callable] = {
     "dropout": Dropout,
     "BatchNorm": BatchNorm,
     "batch_norm": BatchNorm,
+    "dot_product_attention": dot_product_attention,
+    "FusedAttention": dot_product_attention,
 }
 for _rn in ("_random_uniform", "_random_normal", "_random_randint",
             "_random_gamma", "_random_exponential", "_random_poisson",

@@ -15,6 +15,7 @@ from . import rnn  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import contrib  # noqa: F401
+from . import pallas_attention  # noqa: F401
 
 __all__ = ["registry", "OP_REGISTRY", "Operator", "apply_pure", "get_op",
            "invoke", "list_ops", "register_op"]

@@ -1,0 +1,217 @@
+"""Fused scaled-dot-product attention: Pallas TPU kernel + XLA fallback.
+
+The reference's counterpart is the fused attention path in later-1.x
+contrib (ref: src/operator/contrib/transformer.cc —
+_contrib_interleaved_matmul_selfatt_* used by GluonNLP BERT); this is the
+TPU-native equivalent per SURVEY.md §7 ("fused cells (RNN/attention) …
+in Pallas").
+
+Design:
+  * One Pallas kernel per (batch*head, q-block): the query block lives in
+    VMEM, keys/values for the whole sequence stream in as one block
+    (BERT-scale S·D fits VMEM easily; long-context goes through
+    parallel.ring instead), scores are computed on the MXU in fp32 and
+    never materialized in HBM — the flash-attention memory win.
+  * Backward = recompute-from-inputs via jax.vjp of the reference
+    (XLA) math under custom_vjp — XLA fuses it; activation memory stays
+    O(S·D) not O(S²).
+  * CPU backend (tests) and any Pallas lowering failure fall back to the
+    pure-XLA path with identical semantics; MXNET_USE_PALLAS=0 forces the
+    fallback.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import get_env
+from .registry import register_op
+
+__all__ = ["dot_product_attention_ref"]
+
+_PALLAS_STATE = {"enabled": None}  # resolved lazily; None = undecided
+
+
+def _pallas_wanted() -> bool:
+    """Decide once whether the Pallas path is usable: platform is not CPU
+    AND a tiny probe kernel COMPILES (catches Mosaic/backend rejections,
+    not just trace-time errors — a failure here permanently selects the
+    XLA fallback instead of breaking every attention call)."""
+    if _PALLAS_STATE["enabled"] is None:
+        if not get_env("MXNET_USE_PALLAS", True, bool):
+            _PALLAS_STATE["enabled"] = False
+            return False
+        try:
+            backend = jax.default_backend()
+        except Exception:
+            backend = "cpu"
+        if backend == "cpu" and not get_env("MXNET_PALLAS_INTERPRET",
+                                            False, bool):
+            _PALLAS_STATE["enabled"] = False
+            return False
+        try:
+            # representative shapes: head_dim 64 (BERT-style), one q block
+            q = jnp.zeros((2, 128, 64), jnp.float32)
+            m = jnp.ones((2, 128), jnp.float32)
+            jax.block_until_ready(
+                jax.jit(_attention_pallas, static_argnums=(4,))(
+                    q, q, q, m, 1.0))
+            _PALLAS_STATE["enabled"] = True
+        except Exception as e:  # lowering OR compile failure
+            import logging
+
+            logging.warning(
+                "Pallas attention probe failed (%s: %s); using the XLA "
+                "fallback. Set MXNET_USE_PALLAS=0 to silence.",
+                type(e).__name__, e)
+            _PALLAS_STATE["enabled"] = False
+    return _PALLAS_STATE["enabled"]
+
+
+def dot_product_attention_ref(q, k, v, mask, scale):
+    """Pure-XLA reference: q,k,v (BH, S, D); mask (BH, S) in {0,1} or None."""
+    s = jnp.einsum("bqd,bkd->bqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[:, None, :] > 0, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bqk,bkd->bqd", p, v)
+
+
+def _attention_pallas(q, k, v, mask, scale):
+    """Pallas kernel: grid (BH, S//bq); K/V whole-sequence blocks."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, s, d = q.shape
+    bq = min(128, s)
+    # pad S to a multiple of bq (masked out via the validity mask)
+    s_pad = ((s + bq - 1) // bq) * bq
+    if s_pad != s:
+        pad = s_pad - s
+        q = jnp.pad(q, ((0, 0), (0, pad), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    nq = s_pad // bq
+
+    def kernel(q_ref, k_ref, v_ref, m_ref, o_ref):
+        qb = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+        kb = k_ref[0].astype(jnp.float32)                  # (S, d)
+        vb = v_ref[0]                                      # (S, d)
+        sc = jax.lax.dot_general(
+            qb, kb, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bq, S)
+        valid = m_ref[0] > 0                               # (S,)
+        sc = jnp.where(valid[None, :], sc, -1e30)
+        p = jax.nn.softmax(sc, axis=-1).astype(vb.dtype)
+        o_ref[0] = jnp.dot(p, vb,
+                           preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad), lambda b, i: (b, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
+        interpret=get_env("MXNET_PALLAS_INTERPRET", False, bool),
+    )(q, k, v, mask)
+    return out[:, :s]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _attend(q, k, v, mask, scale):
+    if _pallas_wanted():
+        try:
+            return _attention_pallas(q, k, v, mask, scale)
+        except Exception:  # trace-time failure → permanent fallback
+            _PALLAS_STATE["enabled"] = False
+    return dot_product_attention_ref(q, k, v, mask, scale)
+
+
+def _attend_fwd(q, k, v, mask, scale):
+    return _attend(q, k, v, mask, scale), (q, k, v, mask)
+
+
+def _attend_bwd(scale, res, ct):
+    q, k, v, mask = res
+    # recompute-from-inputs backward through the XLA reference math
+    _, vjp = jax.vjp(lambda q_, k_, v_:
+                     dot_product_attention_ref(q_, k_, v_, mask, scale),
+                     q, k, v)
+    dq, dk, dv = vjp(ct)
+    return dq, dk, dv, jnp.zeros_like(mask)
+
+
+_attend.defvjp(_attend_fwd, _attend_bwd)
+
+
+def _attention_with_prob_dropout(q, k, v, mask, scale, p, rng_key):
+    """XLA path with dropout on the attention probabilities — the BERT /
+    reference training semantics (dropout on softmax(QK^T)).  Used when
+    dropout is active; XLA fuses it just as well, and the fused Pallas
+    kernel serves the dropout-free (inference / p=0) case."""
+    s = jnp.einsum("bqd,bkd->bqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[:, None, :] > 0, s, -1e30)
+    p_attn = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    keep = 1.0 - p
+    drop_mask = jax.random.bernoulli(rng_key, keep, p_attn.shape)
+    p_attn = p_attn * drop_mask.astype(p_attn.dtype) / keep
+    return jnp.einsum("bqk,bkd->bqd", p_attn, v)
+
+
+@register_op("dot_product_attention",
+             aliases=("FusedAttention", "_contrib_dot_product_attention"))
+def _dot_product_attention(query, key, value, valid_mask=None, rng_key=None,
+                           num_heads=1, scale=None, dropout=0.0,
+                           _train=False):
+    """Multi-head scaled-dot-product attention.
+
+    query/key/value: (B, S, U) with U = num_heads * head_dim, or already
+    head-split (B, H, S, D).  valid_mask: (B, S_k) 1/0 key-validity mask
+    (sequence lengths), or None.  dropout: rate applied to the attention
+    probabilities in train mode (key auto-threaded by the frontend).
+    Returns the same layout as the input.
+    """
+    packed = query.ndim == 3
+    if packed:
+        b, sq, u = query.shape
+        h = num_heads
+        d = u // h
+        def split(x):
+            bs, s, _ = x.shape
+            return x.reshape(bs, s, h, d).transpose(0, 2, 1, 3)
+        qh, kh, vh = split(query), split(key), split(value)
+    else:
+        qh, kh, vh = query, key, value
+        b, h, sq, d = qh.shape
+    sk = kh.shape[2]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    qf = qh.reshape(b * h, sq, d)
+    kf = kh.reshape(b * h, sk, d)
+    vf = vh.reshape(b * h, sk, d)
+    if valid_mask is None:
+        maskf = jnp.ones((b * h, sk), qf.dtype)
+    else:
+        maskf = jnp.repeat(valid_mask.astype(qf.dtype), h, axis=0)
+    if _train and dropout > 0.0 and rng_key is not None:
+        of = _attention_with_prob_dropout(qf, kf, vf, maskf, float(scale),
+                                          float(dropout), rng_key)
+    else:
+        of = _attend(qf, kf, vf, maskf, float(scale))
+    oh = of.reshape(b, h, sq, d)
+    if packed:
+        return oh.transpose(0, 2, 1, 3).reshape(b, sq, h * d)
+    return oh

@@ -61,6 +61,15 @@ register_op("ones_like")(lambda x: jnp.ones_like(x))
 # int32 not int64: TPU-native narrowing (no x64 mode); reference returns i64
 register_op("shape_array", differentiable=False)(
     lambda x: jnp.asarray(x.shape, jnp.int32))
+# arange from static shape info — creation op usable inside traces
+# (ref: contrib arange_like: axis=None → same-shape flat arange)
+@register_op("_arange_like", aliases=("arange_like",), differentiable=False)
+def _arange_like(x, axis=None, start=0.0, step=1.0, dtype="float32"):
+    dt = jnp.dtype(dtype)
+    if axis is None:
+        n = math.prod(x.shape) if x.shape else 1
+        return (start + step * jnp.arange(n, dtype=dt)).reshape(x.shape)
+    return start + step * jnp.arange(x.shape[axis], dtype=dt)
 register_op("size_array", differentiable=False)(
     lambda x: jnp.asarray(math.prod(x.shape) if x.shape else 1, jnp.int32))
 

@@ -1,0 +1,187 @@
+"""Fused attention + BERT tests (BASELINE config 3 plumbing)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import Trainer
+from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+from mxnet_tpu.gluon.model_zoo.bert import (BERTModel, bert_12_768_12,
+                                            get_bert_model)
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def _np_attention(q, k, v, mask, scale):
+    s = np.einsum("bqd,bkd->bqk", q, k) * scale
+    s = np.where(mask[:, None, :] > 0, s, -1e30)
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    return np.einsum("bqk,bkd->bqd", p, v)
+
+
+def test_attention_matches_numpy():
+    rng = np.random.RandomState(0)
+    b, h, s, d = 2, 4, 16, 8
+    q = rng.randn(b, s, h * d).astype("float32")
+    k = rng.randn(b, s, h * d).astype("float32")
+    v = rng.randn(b, s, h * d).astype("float32")
+    lengths = np.array([16, 9], "float32")
+    mask = (np.arange(s)[None, :] < lengths[:, None]).astype("float32")
+    got = nd.dot_product_attention(nd.array(q), nd.array(k), nd.array(v),
+                                   nd.array(mask), num_heads=h).asnumpy()
+    # numpy reference on head-split layout
+    def split(x):
+        return x.reshape(b, s, h, d).transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    ref = _np_attention(split(q), split(k), split(v),
+                        np.repeat(mask, h, axis=0), 1.0 / np.sqrt(d))
+    ref = ref.reshape(b, h, s, d).transpose(0, 2, 1, 3).reshape(b, s, h * d)
+    assert_almost_equal(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_attention_vs_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(1)
+    b, h, s, d = 2, 2, 8, 4
+    q = rng.randn(b, h, s, d).astype("float32")
+    k = rng.randn(b, h, s, d).astype("float32")
+    v = rng.randn(b, h, s, d).astype("float32")
+    got = nd.dot_product_attention(nd.array(q), nd.array(k),
+                                   nd.array(v)).asnumpy()
+    ref = torch.nn.functional.scaled_dot_product_attention(
+        torch.tensor(q), torch.tensor(k), torch.tensor(v)).numpy()
+    assert_almost_equal(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_attention_gradients():
+    rng = np.random.RandomState(2)
+    b, h, s, d = 1, 2, 4, 4
+    q = rng.randn(b, h, s, d).astype("float32") * 0.5
+    k = rng.randn(b, h, s, d).astype("float32") * 0.5
+    v = rng.randn(b, h, s, d).astype("float32") * 0.5
+    check_numeric_gradient(
+        lambda q_, k_, v_: nd.dot_product_attention(q_, k_, v_),
+        [q, k, v], rtol=3e-2, atol=3e-2)
+
+
+def test_pallas_kernel_interpret_matches_reference(monkeypatch):
+    """Validate the Pallas kernel body itself (interpret mode on CPU) —
+    covers the q-block grid and the sequence-padding path."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import pallas_attention as pa
+
+    monkeypatch.setenv("MXNET_PALLAS_INTERPRET", "1")
+    rng = np.random.RandomState(0)
+    for bh, s, d, lens in [(4, 40, 16, [40, 17, 40, 3]),
+                           (2, 200, 16, [200, 77])]:
+        q = rng.randn(bh, s, d).astype("float32")
+        k = rng.randn(bh, s, d).astype("float32")
+        v = rng.randn(bh, s, d).astype("float32")
+        mask = (np.arange(s)[None, :] <
+                np.array(lens)[:, None]).astype("float32")
+        got = np.asarray(pa._attention_pallas(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(mask), 0.25))
+        ref = np.asarray(pa.dot_product_attention_ref(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(mask), 0.25))
+        assert_almost_equal(got, ref, rtol=1e-4, atol=1e-4)
+
+
+@pytest.fixture(scope="module")
+def tiny_bert():
+    net = get_bert_model("bert_12_768_12", vocab_size=100, num_layers=2,
+                         units=32, hidden_size=64, num_heads=4,
+                         max_length=32, dropout=0.1)
+    net.initialize(mx.initializer.Normal(0.02), ctx=mx.cpu())
+    return net
+
+
+def test_bert_forward_shapes(tiny_bert):
+    net = tiny_bert
+    b, s = 2, 12
+    tokens = nd.array(np.random.randint(0, 100, (b, s)).astype("float32"))
+    segments = nd.zeros((b, s))
+    vlen = nd.array([12.0, 7.0])
+    seq, pooled = net(tokens, segments, vlen)
+    assert seq.shape == (b, s, 32)
+    assert pooled.shape == (b, 32)
+    mlm = net.decode_mlm(seq)
+    assert mlm.shape == (b, s, 100)
+    nsp = net.classify_nsp(pooled)
+    assert nsp.shape == (b, 2)
+
+
+def test_bert_padding_invariance(tiny_bert):
+    """Positions beyond valid_length must not affect valid positions."""
+    net = tiny_bert
+    b, s = 1, 10
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, 100, (b, s)).astype("float32")
+    toks2 = toks.copy()
+    toks2[:, 6:] = 99  # scramble the padding region
+    vlen = nd.array([6.0])
+    seg = nd.zeros((b, s))
+    s1, _ = net(nd.array(toks), seg, vlen)
+    s2, _ = net(nd.array(toks2), seg, vlen)
+    assert_almost_equal(s1.asnumpy()[:, :6], s2.asnumpy()[:, :6], rtol=1e-4,
+                        atol=1e-4)
+
+
+def test_bert_pretrain_step(tiny_bert):
+    net = tiny_bert
+    loss_fn = SoftmaxCrossEntropyLoss()
+    trainer = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-4})
+    b, s = 4, 16
+    rng = np.random.RandomState(3)
+    tokens = nd.array(rng.randint(0, 100, (b, s)).astype("float32"))
+    segments = nd.zeros((b, s))
+    vlen = nd.array([16.0] * b)
+    mlm_labels = nd.array(rng.randint(0, 100, (b, s)).astype("float32"))
+    nsp_labels = nd.array(rng.randint(0, 2, (b,)).astype("float32"))
+
+    losses = []
+    for _ in range(3):
+        with autograd.record():
+            seq, pooled = net(tokens, segments, vlen)
+            mlm_scores = net.decode_mlm(seq)
+            nsp_scores = net.classify_nsp(pooled)
+            l_mlm = loss_fn(mlm_scores, mlm_labels).mean()
+            l_nsp = loss_fn(nsp_scores, nsp_labels).mean()
+            loss = l_mlm + l_nsp
+        loss.backward()
+        trainer.step(b)
+        losses.append(float(loss.asnumpy()))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_bert_mlm_weights_tied(tiny_bert):
+    """decode_mlm projects with the word-embedding matrix (weight tying)."""
+    net = tiny_bert
+    seq = nd.array(np.random.randn(1, 3, 32).astype("float32"))
+    before = net.decode_mlm(seq).asnumpy()
+    w = net.word_embed.weight
+    w.set_data(w.data() * 2.0)
+    after = net.decode_mlm(seq).asnumpy()
+    assert not np.allclose(before, after)
+
+
+def test_bert_hybridize(tiny_bert):
+    net = tiny_bert
+    b, s = 2, 8
+    tokens = nd.array(np.random.randint(0, 100, (b, s)).astype("float32"))
+    segments = nd.zeros((b, s))
+    vlen = nd.array([8.0, 5.0])
+    eager = net(tokens, segments, vlen)[0].asnumpy()
+    net.hybridize()
+    hybrid = net(tokens, segments, vlen)[0].asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-4, atol=1e-4)
+    net.hybridize(active=False)
+
+
+def test_bert_base_constructs():
+    net = bert_12_768_12(vocab_size=1000)
+    params = net.collect_params()
+    n_layers = sum(1 for k in params if "layer11" in k)
+    assert n_layers > 0  # 12 encoder layers exist
