@@ -200,7 +200,8 @@ class _PureNamespace:
         return res
 
     def dot_product_attention(self, query, key, value, valid_mask=None,
-                              num_heads=1, scale=None, dropout=0.0, **kw):
+                              num_heads=1, scale=None, dropout=0.0,
+                              causal=False, **kw):
         """Fused attention — key + train flag threaded from the trace."""
         import jax.numpy as jnp
 
@@ -213,7 +214,8 @@ class _PureNamespace:
             valid_mask = jnp.ones((key.shape[0], sk), jnp.float32)
         return apply_pure("dot_product_attention", query, key, value,
                           valid_mask, rnd.next_key(), num_heads=num_heads,
-                          scale=scale, dropout=dropout, _train=train)
+                          scale=scale, dropout=dropout, causal=causal,
+                          _train=train)
 
     FusedAttention = dot_product_attention
 

@@ -20,47 +20,63 @@ from .. import nn
 from ..block import HybridBlock
 
 __all__ = ["BERTModel", "BERTEncoder", "BERTEncoderCell",
-           "bert_12_768_12", "bert_24_1024_16", "get_bert_model"]
+           "MultiHeadAttention", "bert_12_768_12", "bert_24_1024_16",
+           "get_bert_model"]
 
 
-class BERTSelfAttention(HybridBlock):
-    """Multi-head self-attention via the fused attention op."""
+class MultiHeadAttention(HybridBlock):
+    """Multi-head attention over the fused attention op; query and
+    key/value sources may differ (decoder cross-attention).  Dropout on
+    the attention probabilities is threaded through the keyed frontend."""
 
-    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+    def __init__(self, units, num_heads, dropout=0.0, causal=False,
+                 out_dropout=0.0, **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
             raise MXNetError(f"units {units} not divisible by heads {num_heads}")
         self._units = units
         self._num_heads = num_heads
         self._dropout = dropout
+        self._causal = causal
         with self.name_scope():
             self.query = nn.Dense(units, flatten=False, prefix="query_")
             self.key = nn.Dense(units, flatten=False, prefix="key_")
             self.value = nn.Dense(units, flatten=False, prefix="value_")
             self.proj = nn.Dense(units, flatten=False, prefix="proj_")
-            self.dropout = nn.Dropout(dropout) if dropout else None
+            self.dropout = nn.Dropout(out_dropout) if out_dropout else None
 
-    def hybrid_forward(self, F, x, mask):
+    def hybrid_forward(self, F, x, mem, mem_mask):
         q = self.query(x)
-        k = self.key(x)
-        v = self.value(x)
-        # dropout on the attention probabilities (BERT convention) is
-        # threaded through the fused-attention frontend
-        out = F.dot_product_attention(q, k, v, mask,
+        k = self.key(mem)
+        v = self.value(mem)
+        out = F.dot_product_attention(q, k, v, mem_mask,
                                       num_heads=self._num_heads,
-                                      dropout=self._dropout)
+                                      dropout=self._dropout,
+                                      causal=self._causal)
         out = self.proj(out)
         if self.dropout is not None:
             out = self.dropout(out)
         return out
 
 
+class BERTSelfAttention(MultiHeadAttention):
+    """Self-attention with BERT's output dropout."""
+
+    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+        super().__init__(units, num_heads, dropout=dropout,
+                         out_dropout=dropout, **kwargs)
+
+    def hybrid_forward(self, F, x, mask):
+        return super().hybrid_forward(F, x, x, mask)
+
+
 class BERTPositionwiseFFN(HybridBlock):
-    def __init__(self, units, hidden_size, dropout=0.0, **kwargs):
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu",
+                 **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.ffn_1 = nn.Dense(hidden_size, flatten=False,
-                                  activation="gelu", prefix="ffn1_")
+                                  activation=activation, prefix="ffn1_")
             self.ffn_2 = nn.Dense(units, flatten=False, prefix="ffn2_")
             self.dropout = nn.Dropout(dropout) if dropout else None
 

@@ -1,0 +1,285 @@
+"""Transformer NMT encoder-decoder (BASELINE config 5: Transformer en-de).
+
+Counterpart of the Sockeye/GluonNLP transformer stack the reference
+ecosystem provides (ref: gluonnlp model/transformer.py; Sockeye
+transformer layers; the reference's long-sequence mechanism is bucketing —
+BucketingModule, SURVEY.md §5).
+
+TPU-first design: one XLA program per sequence-length bucket (the jit
+cache keys on shapes — exactly the reference's executor-per-bucket
+design); attention runs through the fused `dot_product_attention` op
+(Pallas on TPU) with in-kernel causal masking for the decoder; sinusoidal
+position tables are baked as constants (folded by XLA).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...base import MXNetError
+from .. import nn
+from ..block import HybridBlock
+from ..loss import Loss
+from .bert import BERTPositionwiseFFN, MultiHeadAttention
+
+__all__ = ["Transformer", "TransformerEncoder", "TransformerDecoder",
+           "LabelSmoothedCELoss", "transformer_base", "transformer_big",
+           "get_transformer_model"]
+
+
+def _sinusoid_table(max_len: int, units: int) -> np.ndarray:
+    """Vaswani et al. sinusoidal position encoding table."""
+    pos = np.arange(max_len)[:, None].astype(np.float64)
+    dim = np.arange(units)[None, :].astype(np.float64)
+    angle = pos / np.power(10000.0, 2 * (dim // 2) / units)
+    table = np.where(dim % 2 == 0, np.sin(angle), np.cos(angle))
+    return table.astype(np.float32)
+
+
+class TransformerEncoderCell(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attention = MultiHeadAttention(units, num_heads, dropout,
+                                                prefix="attn_")
+            self.ln1 = nn.LayerNorm(prefix="ln1_")
+            self.ffn = BERTPositionwiseFFN(units, hidden_size, dropout,
+                                           activation="relu", prefix="ffn_")
+            self.ln2 = nn.LayerNorm(prefix="ln2_")
+            self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x, mask):
+        att = self.attention(x, x, mask)
+        if self.dropout is not None:
+            att = self.dropout(att)
+        x = self.ln1(x + att)
+        x = self.ln2(x + self.ffn(x))
+        return x
+
+
+class TransformerDecoderCell(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.self_attention = MultiHeadAttention(
+                units, num_heads, dropout, causal=True, prefix="self_attn_")
+            self.ln1 = nn.LayerNorm(prefix="ln1_")
+            self.cross_attention = MultiHeadAttention(
+                units, num_heads, dropout, prefix="cross_attn_")
+            self.ln2 = nn.LayerNorm(prefix="ln2_")
+            self.ffn = BERTPositionwiseFFN(units, hidden_size, dropout,
+                                           activation="relu", prefix="ffn_")
+            self.ln3 = nn.LayerNorm(prefix="ln3_")
+            self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x, tgt_mask, mem, mem_mask):
+        att = self.self_attention(x, x, tgt_mask)
+        if self.dropout is not None:
+            att = self.dropout(att)
+        x = self.ln1(x + att)
+        cross = self.cross_attention(x, mem, mem_mask)
+        if self.dropout is not None:
+            cross = self.dropout(cross)
+        x = self.ln2(x + cross)
+        x = self.ln3(x + self.ffn(x))
+        return x
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, num_layers=6, units=512, hidden_size=2048,
+                 num_heads=8, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.layers = nn.HybridSequential(prefix="layers_")
+            for i in range(num_layers):
+                self.layers.add(TransformerEncoderCell(
+                    units, hidden_size, num_heads, dropout,
+                    prefix=f"layer{i}_"))
+
+    def hybrid_forward(self, F, x, mask):
+        for cell in self.layers._children.values():
+            x = cell(x, mask)
+        return x
+
+
+class TransformerDecoder(HybridBlock):
+    def __init__(self, num_layers=6, units=512, hidden_size=2048,
+                 num_heads=8, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.layers = nn.HybridSequential(prefix="layers_")
+            for i in range(num_layers):
+                self.layers.add(TransformerDecoderCell(
+                    units, hidden_size, num_heads, dropout,
+                    prefix=f"layer{i}_"))
+
+    def hybrid_forward(self, F, x, tgt_mask, mem, mem_mask):
+        for cell in self.layers._children.values():
+            x = cell(x, tgt_mask, mem, mem_mask)
+        return x
+
+
+class Transformer(HybridBlock):
+    """Encoder-decoder transformer for NMT.
+
+    forward(src, tgt, src_valid, tgt_valid) -> logits (B, S_tgt, vocab).
+    Source/target embeddings and the output projection are TIED (shared
+    Parameter) when share_embed=True, the transformer-base convention for
+    joint BPE vocabularies.
+    """
+
+    def __init__(self, src_vocab_size, tgt_vocab_size=None, units=512,
+                 hidden_size=2048, num_layers=6, num_heads=8, dropout=0.1,
+                 max_length=512, share_embed=True, **kwargs):
+        super().__init__(**kwargs)
+        tgt_vocab_size = tgt_vocab_size or src_vocab_size
+        if share_embed and tgt_vocab_size != src_vocab_size:
+            raise MXNetError("share_embed requires equal vocab sizes")
+        self._units = units
+        self._tgt_vocab_size = tgt_vocab_size
+        self._scale = float(np.sqrt(units))
+        with self.name_scope():
+            self.src_embed = nn.Embedding(src_vocab_size, units,
+                                          prefix="src_embed_")
+            if share_embed:
+                self.tgt_embed = self.src_embed
+            else:
+                self.tgt_embed = nn.Embedding(tgt_vocab_size, units,
+                                              prefix="tgt_embed_")
+            self.pos_table = self.params.get_constant(
+                "pos_table", _sinusoid_table(max_length, units))
+            self.encoder = TransformerEncoder(num_layers, units, hidden_size,
+                                              num_heads, dropout,
+                                              prefix="enc_")
+            self.decoder = TransformerDecoder(num_layers, units, hidden_size,
+                                              num_heads, dropout,
+                                              prefix="dec_")
+            self.dropout = nn.Dropout(dropout) if dropout else None
+            # output projection tied to the target embedding
+            self.out_proj_bias = self.params.get(
+                "out_proj_bias", shape=(tgt_vocab_size,), init="zeros")
+            self.tied_weight = self.tgt_embed.weight
+
+    def _embed(self, F, embed, tokens, pos_table):
+        x = embed(tokens) * self._scale
+        seq_len = tokens.shape[1]
+        pos = F.slice_axis(pos_table, axis=0, begin=0, end=seq_len)
+        x = F.broadcast_add(x, F.expand_dims(pos, axis=0))
+        if self.dropout is not None:
+            x = self.dropout(x)
+        return x
+
+    def _valid_mask(self, F, tokens, valid_length):
+        steps = F._arange_like(tokens, axis=1)
+        return F.cast(F.broadcast_lesser(
+            F.expand_dims(steps, axis=0),
+            F.expand_dims(valid_length, axis=-1)), dtype="float32")
+
+    def hybrid_forward(self, F, src, tgt, src_valid, tgt_valid,
+                       pos_table, out_proj_bias, tied_weight):
+        src_mask = self._valid_mask(F, src, src_valid)
+        tgt_mask = self._valid_mask(F, tgt, tgt_valid)
+        enc = self.encoder(self._embed(F, self.src_embed, src, pos_table),
+                           src_mask)
+        dec = self.decoder(self._embed(F, self.tgt_embed, tgt, pos_table),
+                           tgt_mask, enc, src_mask)
+        return F.FullyConnected(dec, tied_weight, out_proj_bias,
+                                num_hidden=self._tgt_vocab_size,
+                                flatten=False)
+
+    # ---- inference stages ------------------------------------------------
+    def encode(self, src, src_valid):
+        """Run the encoder once; returns (memory, src_mask) for decoding."""
+        from ..block import F_ND as F
+
+        pos = self.pos_table.data(src.ctx)
+        src_mask = self._valid_mask(F, src, src_valid)
+        mem = self.encoder(self._embed(F, self.src_embed, src, pos), src_mask)
+        return mem, src_mask
+
+    def decode_logits(self, tgt, tgt_valid, mem, src_mask):
+        """Decoder + tied projection over an already-encoded source."""
+        from ... import nd
+        from ..block import F_ND as F
+
+        pos = self.pos_table.data(tgt.ctx)
+        tgt_mask = self._valid_mask(F, tgt, tgt_valid)
+        dec = self.decoder(self._embed(F, self.tgt_embed, tgt, pos),
+                           tgt_mask, mem, src_mask)
+        return nd.FullyConnected(dec, self.tied_weight.data(tgt.ctx),
+                                 self.out_proj_bias.data(tgt.ctx),
+                                 num_hidden=self._tgt_vocab_size,
+                                 flatten=False)
+
+    def greedy_decode(self, src, src_valid, max_len=32, bos_id=1, eos_id=2):
+        """Greedy autoregressive decoding.  The source is encoded ONCE;
+        the host loop reruns only the decoder, whose jit cache keys on the
+        target length (bucketed decoding, the reference pattern).  After a
+        sequence emits `eos_id` it keeps emitting `eos_id` (frozen)."""
+        import numpy as np
+
+        from ... import nd
+
+        b = src.shape[0]
+        mem, src_mask = self.encode(src, src_valid)
+        tgt = nd.full((b, 1), bos_id, ctx=src.ctx)
+        finished = np.zeros(b, bool)
+        for _ in range(max_len - 1):
+            tgt_valid = nd.full((b,), tgt.shape[1], ctx=src.ctx)
+            logits = self.decode_logits(tgt, tgt_valid, mem, src_mask)
+            nxt = logits[:, -1, :].argmax(axis=-1).asnumpy().astype("float32")
+            nxt = np.where(finished, float(eos_id), nxt)
+            finished |= nxt == eos_id
+            tgt = nd.concatenate(
+                [tgt, nd.array(nxt[:, None], ctx=src.ctx)], axis=1)
+            if finished.all():
+                break
+        return tgt
+
+
+class LabelSmoothedCELoss(Loss):
+    """Cross entropy with label smoothing (ref: gluonnlp LabelSmoothing +
+    Sockeye's smoothed CE — standard transformer training loss)."""
+
+    def __init__(self, smoothing=0.1, axis=-1, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._smoothing = smoothing
+        self._axis = axis
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        logp = F.log_softmax(pred, axis=self._axis)
+        nll = F.pick(logp, label, axis=self._axis) * -1.0
+        smooth = F.mean(logp, axis=self._axis) * -1.0
+        loss = (1.0 - self._smoothing) * nll + self._smoothing * smooth
+        if sample_weight is not None:
+            loss = F.broadcast_mul(loss, sample_weight)
+        return loss
+
+
+_TRANSFORMER_SPECS = {
+    "transformer_base": dict(units=512, hidden_size=2048, num_layers=6,
+                             num_heads=8),
+    "transformer_big": dict(units=1024, hidden_size=4096, num_layers=6,
+                            num_heads=16),
+}
+
+
+def get_transformer_model(model_name="transformer_base", src_vocab_size=32000,
+                          **kwargs):
+    if model_name not in _TRANSFORMER_SPECS:
+        raise MXNetError(f"unknown transformer {model_name}; have "
+                         f"{sorted(_TRANSFORMER_SPECS)}")
+    spec = dict(_TRANSFORMER_SPECS[model_name])
+    spec.update(kwargs)
+    return Transformer(src_vocab_size, **spec)
+
+
+def transformer_base(**kwargs):
+    """Vaswani et al. base config (ref: Sockeye/gluonnlp transformer_base)."""
+    return get_transformer_model("transformer_base", **kwargs)
+
+
+def transformer_big(**kwargs):
+    return get_transformer_model("transformer_big", **kwargs)

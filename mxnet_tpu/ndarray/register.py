@@ -59,7 +59,7 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
 
 
 def dot_product_attention(query, key, value, valid_mask=None, num_heads=1,
-                          scale=None, dropout=0.0, **kw):
+                          scale=None, dropout=0.0, causal=False, **kw):
     """Fused attention frontend — threads the PRNG key + train flag for
     attention-probability dropout (ref: BERT dropout-on-softmax)."""
     if valid_mask is None:
@@ -72,7 +72,8 @@ def dot_product_attention(query, key, value, valid_mask=None, num_heads=1,
                             ctx=key.ctx)
     return invoke("dot_product_attention", query, key, value, valid_mask,
                   _random.next_key(), num_heads=num_heads, scale=scale,
-                  dropout=dropout, _train=autograd.is_training())
+                  dropout=dropout, causal=causal,
+                  _train=autograd.is_training())
 
 
 def _make_random_wrapper(op_name: str):

@@ -54,12 +54,14 @@ def _pallas_wanted() -> bool:
             _PALLAS_STATE["enabled"] = False
             return False
         try:
-            # representative shapes: head_dim 64 (BERT-style), one q block
+            # representative shapes: head_dim 64 (BERT-style), one q block;
+            # probe BOTH variants — the causal path lowers extra iota/mask
+            # ops that Mosaic could reject independently
             q = jnp.zeros((2, 128, 64), jnp.float32)
             m = jnp.ones((2, 128), jnp.float32)
-            jax.block_until_ready(
-                jax.jit(_attention_pallas, static_argnums=(4,))(
-                    q, q, q, m, 1.0))
+            probe = jax.jit(_attention_pallas, static_argnums=(4, 5))
+            jax.block_until_ready(probe(q, q, q, m, 1.0, False))
+            jax.block_until_ready(probe(q, q, q, m, 1.0, True))
             _PALLAS_STATE["enabled"] = True
         except Exception as e:  # lowering OR compile failure
             import logging
@@ -72,42 +74,57 @@ def _pallas_wanted() -> bool:
     return _PALLAS_STATE["enabled"]
 
 
-def dot_product_attention_ref(q, k, v, mask, scale):
+def dot_product_attention_ref(q, k, v, mask, scale, causal=False):
     """Pure-XLA reference: q,k,v (BH, S, D); mask (BH, S) in {0,1} or None."""
     s = jnp.einsum("bqd,bkd->bqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = jnp.where(mask[:, None, :] > 0, s, -1e30)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        qpos = jnp.arange(sq)[:, None] + (sk - sq)  # align last q to last k
+        s = jnp.where(qpos >= jnp.arange(sk)[None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
     return jnp.einsum("bqk,bkd->bqd", p, v)
 
 
-def _attention_pallas(q, k, v, mask, scale):
+def _attention_pallas(q, k, v, mask, scale, causal=False):
     """Pallas kernel: grid (BH, S//bq); K/V whole-sequence blocks."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = q.shape
     bq = min(128, s)
-    # pad S to a multiple of bq (masked out via the validity mask)
+    # pad query len to a multiple of bq and key len to a tiling-friendly
+    # multiple of 8; padded keys are killed via the validity mask
     s_pad = ((s + bq - 1) // bq) * bq
     if s_pad != s:
-        pad = s_pad - s
-        q = jnp.pad(q, ((0, 0), (0, pad), (0, 0)))
-        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
-        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+        q = jnp.pad(q, ((0, 0), (0, s_pad - s), (0, 0)))
+    sk = k.shape[1]
+    sk_pad = ((sk + 7) // 8) * 8
+    if sk_pad != sk:
+        k = jnp.pad(k, ((0, 0), (0, sk_pad - sk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, sk_pad - sk), (0, 0)))
+        mask = jnp.pad(mask, ((0, 0), (0, sk_pad - sk)))
+    sk_len = sk_pad
     nq = s_pad // bq
+    causal_off = sk - s  # align last query to last key
 
     def kernel(q_ref, k_ref, v_ref, m_ref, o_ref):
         qb = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
-        kb = k_ref[0].astype(jnp.float32)                  # (S, d)
-        vb = v_ref[0]                                      # (S, d)
+        kb = k_ref[0].astype(jnp.float32)                  # (Sk, d)
+        vb = v_ref[0]                                      # (Sk, d)
         sc = jax.lax.dot_general(
             qb, kb, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # (bq, S)
-        valid = m_ref[0] > 0                               # (S,)
+            preferred_element_type=jnp.float32)            # (bq, Sk)
+        valid = m_ref[0] > 0                               # (Sk,)
         sc = jnp.where(valid[None, :], sc, -1e30)
+        if causal:
+            qi = pl.program_id(1)
+            qpos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, sk_len), 0) + causal_off
+            kpos = jax.lax.broadcasted_iota(jnp.int32, (bq, sk_len), 1)
+            sc = jnp.where(qpos >= kpos, sc, -1e30)
         p = jax.nn.softmax(sc, axis=-1).astype(vb.dtype)
         o_ref[0] = jnp.dot(p, vb,
                            preferred_element_type=jnp.float32).astype(o_ref.dtype)
@@ -117,9 +134,9 @@ def _attention_pallas(q, k, v, mask, scale):
         grid=(bh, nq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s_pad), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, sk_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk_len), lambda b, i: (b, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
@@ -128,25 +145,26 @@ def _attention_pallas(q, k, v, mask, scale):
     return out[:, :s]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def _attend(q, k, v, mask, scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _attend(q, k, v, mask, scale, causal=False):
     if _pallas_wanted():
         try:
-            return _attention_pallas(q, k, v, mask, scale)
+            return _attention_pallas(q, k, v, mask, scale, causal)
         except Exception:  # trace-time failure → permanent fallback
             _PALLAS_STATE["enabled"] = False
-    return dot_product_attention_ref(q, k, v, mask, scale)
+    return dot_product_attention_ref(q, k, v, mask, scale, causal)
 
 
-def _attend_fwd(q, k, v, mask, scale):
-    return _attend(q, k, v, mask, scale), (q, k, v, mask)
+def _attend_fwd(q, k, v, mask, scale, causal):
+    return _attend(q, k, v, mask, scale, causal), (q, k, v, mask)
 
 
-def _attend_bwd(scale, res, ct):
+def _attend_bwd(scale, causal, res, ct):
     q, k, v, mask = res
     # recompute-from-inputs backward through the XLA reference math
     _, vjp = jax.vjp(lambda q_, k_, v_:
-                     dot_product_attention_ref(q_, k_, v_, mask, scale),
+                     dot_product_attention_ref(q_, k_, v_, mask, scale,
+                                               causal),
                      q, k, v)
     dq, dk, dv = vjp(ct)
     return dq, dk, dv, jnp.zeros_like(mask)
@@ -155,7 +173,8 @@ def _attend_bwd(scale, res, ct):
 _attend.defvjp(_attend_fwd, _attend_bwd)
 
 
-def _attention_with_prob_dropout(q, k, v, mask, scale, p, rng_key):
+def _attention_with_prob_dropout(q, k, v, mask, scale, p, rng_key,
+                                 causal=False):
     """XLA path with dropout on the attention probabilities — the BERT /
     reference training semantics (dropout on softmax(QK^T)).  Used when
     dropout is active; XLA fuses it just as well, and the fused Pallas
@@ -164,6 +183,10 @@ def _attention_with_prob_dropout(q, k, v, mask, scale, p, rng_key):
                    preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = jnp.where(mask[:, None, :] > 0, s, -1e30)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        qpos = jnp.arange(sq)[:, None] + (sk - sq)
+        s = jnp.where(qpos >= jnp.arange(sk)[None, :], s, -1e30)
     p_attn = jax.nn.softmax(s, axis=-1).astype(v.dtype)
     keep = 1.0 - p
     drop_mask = jax.random.bernoulli(rng_key, keep, p_attn.shape)
@@ -175,7 +198,7 @@ def _attention_with_prob_dropout(q, k, v, mask, scale, p, rng_key):
              aliases=("FusedAttention", "_contrib_dot_product_attention"))
 def _dot_product_attention(query, key, value, valid_mask=None, rng_key=None,
                            num_heads=1, scale=None, dropout=0.0,
-                           _train=False):
+                           causal=False, _train=False):
     """Multi-head scaled-dot-product attention.
 
     query/key/value: (B, S, U) with U = num_heads * head_dim, or already
@@ -208,9 +231,10 @@ def _dot_product_attention(query, key, value, valid_mask=None, rng_key=None,
         maskf = jnp.repeat(valid_mask.astype(qf.dtype), h, axis=0)
     if _train and dropout > 0.0 and rng_key is not None:
         of = _attention_with_prob_dropout(qf, kf, vf, maskf, float(scale),
-                                          float(dropout), rng_key)
+                                          float(dropout), rng_key,
+                                          causal=causal)
     else:
-        of = _attend(qf, kf, vf, maskf, float(scale))
+        of = _attend(qf, kf, vf, maskf, float(scale), bool(causal))
     oh = of.reshape(b, h, sq, d)
     if packed:
         return oh.transpose(0, 2, 1, 3).reshape(b, sq, h * d)
