@@ -325,18 +325,30 @@ class ImageRecordIter(DataIter):
         self.resize = resize
         self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
         self.std = np.array([std_r, std_g, std_b], np.float32)
-        self._rec = recordio.MXRecordIO(path_imgrec, "r")
-        self._records: List[bytes] = []
-        while True:
-            buf = self._rec.read()
-            if buf is None:
-                break
-            self._records.append(buf)
-        self._rec.close()
-        self._order = np.arange(len(self._records))
+        # native streaming path (C++ prefetch reader, CS6's ThreadedIter
+        # role) when no shuffling is needed; otherwise load into memory for
+        # random access
+        from .. import lib as _native
+
+        self._stream = None
+        if not shuffle and _native.available():
+            self._stream = _native.NativePrefetchReader(path_imgrec)
+            self._records: List[bytes] = []
+            self._order = None
+        else:
+            rec = recordio.MXRecordIO(path_imgrec, "r")
+            self._records = []
+            while True:
+                buf = rec.read()
+                if buf is None:
+                    break
+                self._records.append(buf)
+            rec.close()
+            self._order = np.arange(len(self._records))
         self._imdecode = imdecode
         self._unpack = recordio.unpack
         self.cursor = 0
+        self._epoch_count = None  # records/epoch, learned on first pass
         self.reset()
 
     @property
@@ -350,12 +362,14 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", shape)]
 
     def reset(self):
+        if self._stream is not None:
+            self._stream.reset()
         if self.shuffle:
             np.random.shuffle(self._order)
         self.cursor = 0
 
-    def _load_one(self, i):
-        header, img_bytes = self._unpack(self._records[self._order[i]])
+    def _decode_record(self, raw: bytes):
+        header, img_bytes = self._unpack(raw)
         img = self._imdecode(img_bytes, to_rgb=True).asnumpy()
         c, h, w = self.data_shape
         if self.resize > 0:
@@ -369,21 +383,41 @@ class ImageRecordIter(DataIter):
             label = label[None]
         return img.transpose(2, 0, 1), label[:self.label_width]
 
-    def next(self) -> DataBatch:
+    def _next_raw(self) -> Optional[bytes]:
+        """One record from the native stream or the in-memory list."""
+        if self._stream is not None:
+            raw = self._stream.read()
+            if raw is None:
+                # records per epoch = consumed so far + this batch's part
+                self._epoch_count = self.cursor + self._batch_pos
+            return raw
         n = len(self._records)
-        if self.cursor >= n:
+        if self.cursor + self._batch_pos >= n:
+            return None
+        return self._records[self._order[self.cursor + self._batch_pos]]
+
+    def next(self) -> DataBatch:
+        if self._epoch_count is not None and \
+                self.cursor >= self._epoch_count and self._stream is not None:
             raise StopIteration
         imgs, labels = [], []
         pad = 0
+        first_of_batch = []
+        self._batch_pos = 0
         for b in range(self.batch_size):
-            i = self.cursor + b
-            if i >= n:
+            raw = self._next_raw()
+            if raw is None:
+                if b == 0:
+                    raise StopIteration
                 pad += 1
-                i = i % n
-            img, lbl = self._load_one(i)
+                raw = first_of_batch[b % len(first_of_batch)]
+            else:
+                first_of_batch.append(raw)
+                self._batch_pos += 1
+            img, lbl = self._decode_record(raw)
             imgs.append(img)
             labels.append(lbl)
-        self.cursor += self.batch_size
+        self.cursor += self._batch_pos
         data = nd.array(np.stack(imgs), ctx=cpu())
         lab = np.stack(labels)
         if self.label_width == 1:

@@ -1,0 +1,277 @@
+"""Native library loader: builds (if needed) and binds src/ via ctypes.
+
+Counterpart of the reference's `python/mxnet/base.py` `_LIB` loader +
+`check_call` over the flat C ABI (ref: include/mxnet/c_api.h; the
+reference also binds exclusively through ctypes — no pybind11).
+
+The library is built on demand from `src/*.cc` (g++ direct; the canonical
+CMake build in src/CMakeLists.txt produces the same .so) and cached in
+`build/`.  Everything degrades gracefully: `available()` is False when no
+toolchain exists, and pure-Python paths take over.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+from .base import MXNetError, get_env
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_REPO, "src")
+_BUILD = os.path.join(_REPO, "build")
+_SO = os.path.join(_BUILD, "libmxnet_tpu_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+EngineFnType = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def _sources() -> List[str]:
+    return sorted(
+        os.path.join(_SRC, f) for f in os.listdir(_SRC) if f.endswith(".cc"))
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    deps = _sources() + [os.path.join(_SRC, f) for f in os.listdir(_SRC)
+                         if f.endswith(".h")]
+    return any(os.path.getmtime(p) > so_mtime for p in deps)
+
+
+def _build() -> None:
+    os.makedirs(_BUILD, exist_ok=True)
+    cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-pthread",
+           "-Wall", "-o", _SO] + _sources()
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise MXNetError(
+            f"native build failed:\n{' '.join(cmd)}\n{proc.stderr[-4000:]}")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not get_env("MXNET_USE_NATIVE", True, bool):
+            return None
+        try:
+            if _needs_build():
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except Exception:
+            return None
+        lib.MXGetLastError.restype = ctypes.c_char_p
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def get() -> ctypes.CDLL:
+    lib = _load()
+    if lib is None:
+        raise MXNetError(
+            "native library unavailable (no toolchain or build failed); "
+            "set MXNET_USE_NATIVE=0 to silence native paths entirely")
+    return lib
+
+
+def check_call(ret: int) -> None:
+    """ref: base.py::check_call — raise MXNetError from the error ring."""
+    if ret != 0:
+        raise MXNetError(get().MXGetLastError().decode("utf-8", "replace"))
+
+
+# ---------------------------------------------------------------------------
+# Engine wrapper (ref: Engine::PushAsync contract, SURVEY.md CS1 async
+# boundary — here scheduling HOST-side work; device work rides PjRt)
+# ---------------------------------------------------------------------------
+
+class NativeEngine:
+    """Dependency-scheduled host task engine.
+
+    `push(fn, read=[v1], write=[v2])` runs `fn()` on a worker thread once
+    all hazards on the named variables clear; reads run concurrently,
+    writes are exclusive and FIFO — the reference ThreadedEngine contract.
+    `num_workers=0` gives the synchronous NaiveEngine (debug mode).
+    """
+
+    def __init__(self, num_workers: Optional[int] = None):
+        if num_workers is None:
+            if get_env("MXNET_ENGINE_TYPE", "", str) == "NaiveEngine":
+                num_workers = 0
+            else:
+                num_workers = get_env("MXNET_CPU_WORKER_NTHREADS",
+                                      max(2, (os.cpu_count() or 2)), int)
+        self._lib = get()
+        h = ctypes.c_void_p()
+        check_call(self._lib.MXEngineCreate(ctypes.c_int(num_workers),
+                                            ctypes.byref(h)))
+        self._h = h
+        self.num_workers = num_workers
+        # keep callback objects alive until executed
+        self._cb_lock = threading.Lock()
+        self._cbs = {}
+        self._next_id = 1  # never 0: ctypes maps a NULL void* to None
+
+        def _trampoline(arg):
+            key = int(arg or 0)
+            with self._cb_lock:
+                fn = self._cbs.pop(key)
+            try:
+                fn()
+            except Exception:  # worker threads must never unwind into C++
+                import traceback
+
+                traceback.print_exc()
+
+        self._tramp = EngineFnType(_trampoline)
+
+    def new_variable(self) -> int:
+        v = ctypes.c_int64()
+        check_call(self._lib.MXEngineNewVariable(self._h, ctypes.byref(v)))
+        return v.value
+
+    def delete_variable(self, var: int) -> None:
+        check_call(self._lib.MXEngineDeleteVariable(self._h,
+                                                    ctypes.c_int64(var)))
+
+    def push(self, fn, read=(), write=(), priority: int = 0) -> None:
+        with self._cb_lock:
+            key = self._next_id
+            self._next_id += 1
+            self._cbs[key] = fn
+        rv = (ctypes.c_int64 * len(read))(*read)
+        wv = (ctypes.c_int64 * len(write))(*write)
+        check_call(self._lib.MXEnginePushAsync(
+            self._h, self._tramp, ctypes.c_void_p(key), rv, len(read), wv,
+            len(write), ctypes.c_int(priority)))
+
+    def wait_for_var(self, var: int) -> None:
+        check_call(self._lib.MXEngineWaitForVar(self._h,
+                                                ctypes.c_int64(var)))
+
+    def wait_for_all(self) -> None:
+        check_call(self._lib.MXEngineWaitForAll(self._h))
+
+    def num_pending(self) -> int:
+        out = ctypes.c_int()
+        check_call(self._lib.MXEngineNumPending(self._h, ctypes.byref(out)))
+        return out.value
+
+    def var_version(self, var: int) -> int:
+        out = ctypes.c_uint64()
+        check_call(self._lib.MXEngineVarVersion(self._h, ctypes.c_int64(var),
+                                                ctypes.byref(out)))
+        return out.value
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.MXEngineFree(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# RecordIO wrappers (native fast path for mxnet_tpu/recordio.py)
+# ---------------------------------------------------------------------------
+
+class NativeRecordWriter:
+    def __init__(self, path: str):
+        self._lib = get()
+        h = ctypes.c_void_p()
+        check_call(self._lib.MXRecordIOWriterCreate(
+            path.encode(), ctypes.byref(h)))
+        self._h = h
+
+    def write(self, buf: bytes) -> int:
+        pos = ctypes.c_int64()
+        check_call(self._lib.MXRecordIOWriterWrite(
+            self._h, buf, ctypes.c_size_t(len(buf)), ctypes.byref(pos)))
+        return pos.value
+
+    def close(self):
+        if self._h:
+            check_call(self._lib.MXRecordIOWriterFree(self._h))
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _ReaderBase:
+    _create = _next = _reset = _free = None  # bound by subclass
+
+    def __init__(self, path: str, *extra):
+        self._lib = get()
+        h = ctypes.c_void_p()
+        check_call(self._create(path.encode(), *extra, ctypes.byref(h)))
+        self._h = h
+
+    def read(self) -> Optional[bytes]:
+        buf = ctypes.c_char_p()
+        length = ctypes.c_size_t()
+        eof = ctypes.c_int()
+        check_call(self._next(self._h, ctypes.byref(buf),
+                              ctypes.byref(length), ctypes.byref(eof)))
+        if eof.value:
+            return None
+        return ctypes.string_at(buf, length.value)
+
+    def reset(self):
+        check_call(self._reset(self._h))
+
+    def close(self):
+        if self._h:
+            check_call(self._free(self._h))
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeRecordReader(_ReaderBase):
+    def __init__(self, path: str):
+        lib = get()
+        self._create = lib.MXRecordIOReaderCreate
+        self._next = lib.MXRecordIOReaderNext
+        self._reset = lib.MXRecordIOReaderReset
+        self._free = lib.MXRecordIOReaderFree
+        super().__init__(path)
+
+    def seek(self, pos: int):
+        check_call(self._lib.MXRecordIOReaderSeek(self._h,
+                                                  ctypes.c_int64(pos)))
+
+
+class NativePrefetchReader(_ReaderBase):
+    """Background-thread prefetching record reader (dmlc ThreadedIter)."""
+
+    def __init__(self, path: str, capacity: int = 64):
+        lib = get()
+        self._create = lib.MXPrefetchReaderCreate
+        self._next = lib.MXPrefetchReaderNext
+        self._reset = lib.MXPrefetchReaderReset
+        self._free = lib.MXPrefetchReaderFree
+        super().__init__(path, ctypes.c_int(capacity))
