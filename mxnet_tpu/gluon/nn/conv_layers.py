@@ -164,7 +164,8 @@ class _Pooling(HybridBlock):
         self._kwargs = {
             "kernel": pool_size, "stride": strides, "pad": padding,
             "global_pool": global_pool, "pool_type": pool_type,
-            "pooling_convention": "full" if ceil_mode else "valid"}
+            "pooling_convention": "full" if ceil_mode else "valid",
+            "layout": layout}
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 

@@ -58,13 +58,13 @@ def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     stride = tuple(stride) if stride else (1,) * nd
     dilate = tuple(dilate) if dilate else (1,) * nd
     pad = tuple(pad) if pad else (0,) * nd
-    if nd == 1:
-        dn_in, dn_k, dn_out = "NCH", "OIH", "NCH"
-    elif nd == 2:
-        dn_in, dn_k, dn_out = ("NCHW", "OIHW", "NCHW") if layout in (None, "NCHW") \
-            else ("NHWC", "HWIO", "NHWC")
-    else:
-        dn_in, dn_k, dn_out = "NCDHW", "OIDHW", "NCDHW"
+    # weight stays (O, I/g, *k) for EVERY layout (param shapes / checkpoints
+    # are layout-independent); XLA's layout assignment folds the logical
+    # permutation into the conv, so NHWC costs nothing extra on TPU.
+    default = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[nd]
+    lay = layout or default
+    dn_in = dn_out = lay
+    dn_k = "OI" + default[2:]
     # NB: no preferred_element_type here — the MXU accumulates bf16 convs in
     # fp32 internally, and an fp32 primal output would make the weight-grad
     # transpose conv see mixed (bf16, fp32) operands, which lax rejects.
@@ -93,9 +93,10 @@ def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     pad = tuple(pad) if pad else (0,) * nd
     dilate = tuple(dilate) if dilate else (1,) * nd
     k_eff = tuple((k - 1) * d + 1 for k, d in zip(kernel, dilate))
+    sp0 = 1 if (layout and layout[-1] == "C") else 2   # first spatial axis
     if target_shape:
         adj = tuple(
-            t - ((data.shape[2 + i] - 1) * stride[i] - 2 * pad[i] + k_eff[i])
+            t - ((data.shape[sp0 + i] - 1) * stride[i] - 2 * pad[i] + k_eff[i])
             for i, t in enumerate(target_shape))
     else:
         adj = tuple(adj) if adj else (0,) * nd
@@ -107,9 +108,9 @@ def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     w = w.reshape((num_group, in_c // num_group, out_g) + tuple(kernel))
     w = jnp.swapaxes(w, 1, 2)
     w = w.reshape((num_group * out_g, in_c // num_group) + tuple(kernel))
-    dn = {1: ("NCH", "OIH", "NCH"),
-          2: ("NCHW", "OIHW", "NCHW"),
-          3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
+    default = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[nd]
+    lay = layout or default
+    dn = (lay, "OI" + default[2:], lay)
     pads = [(k_eff[i] - 1 - pad[i], k_eff[i] - 1 - pad[i] + adj[i])
             for i in range(nd)]
     out = lax.conv_general_dilated(
@@ -117,7 +118,10 @@ def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
         lhs_dilation=stride, rhs_dilation=dilate,
         dimension_numbers=dn, feature_group_count=num_group)
     if bias is not None and not no_bias:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        if lay[-1] == "C":
+            out = out + bias
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * nd)
     return out
 
 
@@ -130,26 +134,33 @@ def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
              global_pool=False, pooling_convention="valid", count_include_pad=True,
              cudnn_off=False, layout=None):
     nd = data.ndim - 2
+    channels_last = bool(layout) and layout[-1] == "C"
     if global_pool:
-        axes = tuple(range(2, data.ndim))
+        axes = (tuple(range(1, data.ndim - 1)) if channels_last
+                else tuple(range(2, data.ndim)))
         if pool_type == "max":
             return jnp.max(data, axis=axes, keepdims=True)
         return jnp.mean(data, axis=axes, keepdims=True)
     kernel = tuple(kernel)
     stride = tuple(stride) if stride else (1,) * nd
     pad = tuple(pad) if pad else (0,) * nd
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    sp0 = 1 if channels_last else 2   # first spatial axis
+
+    def _full(k, s, p):   # (kernel, strides, per-spatial padding) -> window
+        if channels_last:
+            return (1,) + k + (1,), (1,) + s + (1,), ((0, 0),) + p + ((0, 0),)
+        return (1, 1) + k, (1, 1) + s, ((0, 0), (0, 0)) + p
+
+    sp_pad = tuple((p, p) for p in pad)
     if pooling_convention == "full":
         # ceil-mode: extend padding on the right so ceil division is covered
         extra = []
         for i in range(nd):
-            in_sz = data.shape[2 + i] + 2 * pad[i]
+            in_sz = data.shape[sp0 + i] + 2 * pad[i]
             rem = (in_sz - kernel[i]) % stride[i]
             extra.append(0 if rem == 0 else stride[i] - rem)
-        padding = ((0, 0), (0, 0)) + tuple(
-            (p, p + e) for p, e in zip(pad, extra))
+        sp_pad = tuple((p, p + e) for p, e in zip(pad, extra))
+    window, strides, padding = _full(kernel, stride, sp_pad)
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         return lax.reduce_window(data, init, lax.max, window, strides, padding)

@@ -1,0 +1,105 @@
+"""NHWC (channels-last) layout support vs the NCHW default.
+
+TPU rationale: XLA's layout assignment makes NHWC the natural conv layout
+on the MXU; the framework keeps weights in (O, I/g, *k) for EVERY data
+layout so checkpoints are layout-independent (ref: Convolution layout param
+in src/operator/nn/convolution-inl.h).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _rand(*shape):
+    return np.random.RandomState(0).rand(*shape).astype("float32")
+
+
+def test_convolution_nhwc_matches_nchw():
+    x = _rand(2, 8, 10, 10)
+    w = _rand(16, 8, 3, 3)
+    b = _rand(16)
+    o1 = nd.Convolution(nd.array(x), nd.array(w), nd.array(b), kernel=(3, 3),
+                        stride=(2, 2), pad=(1, 1), num_filter=16).asnumpy()
+    o2 = nd.Convolution(nd.array(x.transpose(0, 2, 3, 1)), nd.array(w),
+                        nd.array(b), kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                        num_filter=16, layout="NHWC").asnumpy()
+    np.testing.assert_allclose(o1, o2.transpose(0, 3, 1, 2), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_deconvolution_nhwc_matches_nchw():
+    x = _rand(2, 8, 10, 10)
+    w = _rand(8, 4, 3, 3)
+    o1 = nd.Deconvolution(nd.array(x), nd.array(w), None, kernel=(3, 3),
+                          stride=(2, 2), pad=(1, 1), num_filter=4,
+                          no_bias=True).asnumpy()
+    o2 = nd.Deconvolution(nd.array(x.transpose(0, 2, 3, 1)), nd.array(w),
+                          None, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                          num_filter=4, no_bias=True,
+                          layout="NHWC").asnumpy()
+    np.testing.assert_allclose(o1, o2.transpose(0, 3, 1, 2), rtol=1e-5,
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+def test_pooling_nhwc_matches_nchw(pool_type):
+    x = _rand(2, 8, 11, 11)
+    kw = dict(kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type=pool_type,
+              pooling_convention="full")
+    o1 = nd.Pooling(nd.array(x), **kw).asnumpy()
+    o2 = nd.Pooling(nd.array(x.transpose(0, 2, 3, 1)), layout="NHWC",
+                    **kw).asnumpy()
+    np.testing.assert_allclose(o1, o2.transpose(0, 3, 1, 2), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_global_pooling_nhwc():
+    x = _rand(2, 8, 5, 5)
+    o1 = nd.Pooling(nd.array(x), global_pool=True, pool_type="avg",
+                    kernel=(1, 1)).asnumpy()
+    o2 = nd.Pooling(nd.array(x.transpose(0, 2, 3, 1)), global_pool=True,
+                    pool_type="avg", kernel=(1, 1), layout="NHWC").asnumpy()
+    np.testing.assert_allclose(o1, o2.transpose(0, 3, 1, 2), rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_resnet_nhwc_matches_nchw():
+    from mxnet_tpu.gluon.model_zoo import vision
+    x = _rand(2, 3, 32, 32)
+    outs = {}
+    for lay in ["NCHW", "NHWC"]:
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = vision.resnet18_v1(classes=10, layout=lay)
+        net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+        xi = x if lay == "NCHW" else x.transpose(0, 2, 3, 1)
+        with mx.autograd.pause():
+            outs[lay] = net(nd.array(xi)).asnumpy()
+    np.testing.assert_allclose(outs["NCHW"], outs["NHWC"], rtol=1e-4,
+                               atol=2e-4)
+
+
+def test_resnet_nhwc_trains():
+    """One SPMDTrainer step in NHWC — the bench.py configuration."""
+    from mxnet_tpu import parallel
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1(classes=10, layout="NHWC")
+    net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    with mx.autograd.pause():
+        net(nd.zeros((1, 16, 16, 3), ctx=mx.cpu()))
+    images = _rand(4, 16, 16, 3)
+    labels = np.array([0, 1, 2, 3], np.int32)
+    with parallel.make_mesh(dp=1):
+        trainer = parallel.SPMDTrainer(
+            net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.05})
+        l0 = float(trainer.step(images, labels).asnumpy())
+        for _ in range(5):
+            loss = trainer.step(images, labels)
+        l1 = float(loss.asnumpy())
+    assert np.isfinite(l0) and np.isfinite(l1)
+    assert l1 < l0, (l0, l1)
