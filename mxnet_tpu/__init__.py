@@ -46,6 +46,10 @@ def __getattr__(name):
         mod = importlib.import_module(".symbol", __name__)
         globals()["sym"] = mod
         return mod
+    if name == "kv":
+        mod = importlib.import_module(".kvstore", __name__)
+        globals()["kv"] = mod
+        return mod
     if name == "AttrScope":
         from .attribute import AttrScope
 

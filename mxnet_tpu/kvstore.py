@@ -84,17 +84,27 @@ class KVStore:
                 self._store[k] = agg
 
     def pull(self, key, out=None, priority: int = 0, ignore_sparse=True):
+        from .ndarray.sparse import BaseSparseNDArray
+
         keys, outs = self._normalize(key, out)
         for k, o in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError(f"kvstore key {k} not initialized")
             src = self._store[k]
             for dst in _as_list(o):
-                dst._data = src.as_in_context(dst.ctx).data
+                if isinstance(dst, BaseSparseNDArray):
+                    raise MXNetError(
+                        "pull with a sparse out is not supported; use "
+                        "row_sparse_pull (ref: KVStoreLocal::PullImpl)")
+                # ._data: the dense payload (for sparse src, .data is the
+                # values block — reference naming)
+                dst._data = src.as_in_context(dst.ctx)._data
 
     def pushpull(self, key, value, out=None, priority: int = 0):
         """Fused push+pull (ref: MXKVStorePushPullEx). Without an updater
         this is a pure allreduce — the hot path for Trainer."""
+        from .ndarray.sparse import BaseSparseNDArray
+
         keys, values = self._normalize(key, value)
         _, outs = self._normalize(key, out if out is not None else value)
         for k, v, o in zip(keys, values, outs):
@@ -107,22 +117,38 @@ class KVStore:
                 self._updater(_key_int(k), agg, self._store[k])
                 agg = self._store[k]
             for dst in _as_list(o):
-                dst._data = agg.as_in_context(dst.ctx).data
+                if isinstance(dst, BaseSparseNDArray):
+                    raise MXNetError(
+                        "pushpull with a sparse out is not supported; use "
+                        "push + row_sparse_pull")
+                dst._data = agg.as_in_context(dst.ctx)._data
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Sparse pull emulation: dense pull then row gather
-        (ref: kvstore row_sparse_pull; TPU has no PS-sharded rows)."""
+        """ref: kvstore row_sparse_pull — pull only the requested rows.
+
+        When `out` is a RowSparseNDArray the result is a real sparse pull:
+        its indices become the (sorted, deduplicated) row_ids and only
+        those rows carry values. Dense `out` gets the row-gathered dense
+        emulation."""
+        from .ndarray.sparse import RowSparseNDArray
+
         if row_ids is None:
             return self.pull(key, out, priority)
         keys, outs = self._normalize(key, out)
-        rids = _as_list(row_ids)
-        for k, o in zip(keys, outs):
+        _, rid_groups = self._normalize(key, row_ids)
+        for k, o, rid_group in zip(keys, outs, rid_groups):
+            if k not in self._store:
+                raise MXNetError(f"kvstore key {k} not initialized")
             src = self._store[k]
-            for dst, rid in zip(_as_list(o), rids):
-                rows = src.data[rid.data.astype(jnp.int32)]
-                full = jnp.zeros(src.shape, src.data.dtype).at[
-                    rid.data.astype(jnp.int32)].set(rows)
-                dst._data = jax.device_put(full, dst.ctx.jax_device)
+            for dst, rid in zip(_as_list(o), _as_list(rid_group)):
+                uniq = jnp.unique(rid._data.astype(jnp.int32))
+                rows = jnp.take(src._data, uniq, axis=0)
+                full = jnp.zeros(src.shape,
+                                 src._data.dtype).at[uniq].set(rows)
+                dev = dst.ctx.jax_device
+                dst._data = jax.device_put(full, dev)
+                if isinstance(dst, RowSparseNDArray):
+                    dst._aux = {"indices": jax.device_put(uniq, dev)}
 
     # ---- optimizer hookup -----------------------------------------------
     def set_optimizer(self, optimizer: opt_mod.Optimizer):
@@ -158,16 +184,26 @@ class KVStore:
 
     # ---- internals -------------------------------------------------------
     def _reduce(self, vals: List[NDArray]) -> NDArray:
-        """Local reduction across device replicas (ref: comm.h CommDevice)."""
+        """Local reduction across device replicas (ref: comm.h CommDevice;
+        row_sparse inputs reduce to a row_sparse with merged indices, like
+        the reference's sparse CommCPU path)."""
+        from .ndarray.sparse import RowSparseNDArray
+
         if len(vals) == 1:
             return vals[0].copy()
-        acc = vals[0].data
+        acc = vals[0].data if not isinstance(vals[0], RowSparseNDArray) \
+            else vals[0]._data
         dev = vals[0].ctx.jax_device
         for v in vals[1:]:
-            d = v.data
+            d = v._data if isinstance(v, RowSparseNDArray) else v.data
             if list(d.devices()) != [dev]:
                 d = jax.device_put(d, dev)
             acc = acc + d
+        if all(isinstance(v, RowSparseNDArray) for v in vals):
+            merged = jnp.sort(jnp.unique(jnp.concatenate(
+                [jax.device_put(v._aux["indices"], dev) for v in vals])))
+            return RowSparseNDArray(acc, {"indices": merged},
+                                    ctx=vals[0].ctx)
         return NDArray(acc, ctx=vals[0].ctx)
 
     def _dcn_allreduce(self, val: NDArray) -> NDArray:

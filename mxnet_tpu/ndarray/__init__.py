@@ -11,11 +11,15 @@ import numpy as _np
 from .ndarray import (NDArray, arange, array, concatenate, empty, from_jax,
                       full, ones, stack, wrap_outputs, zeros)
 from . import random
+from . import sparse
+from .sparse import (BaseSparseNDArray, CSRNDArray, RowSparseNDArray,
+                     cast_storage)
 from . import register as _register
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "concatenate", "stack", "from_jax", "random", "waitall", "save",
-           "load", "zeros_like", "ones_like"]
+           "load", "zeros_like", "ones_like", "sparse", "BaseSparseNDArray",
+           "CSRNDArray", "RowSparseNDArray", "cast_storage"]
 
 
 def waitall():

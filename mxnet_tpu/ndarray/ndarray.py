@@ -119,6 +119,14 @@ class NDArray:
     def stype(self) -> str:
         return "default"
 
+    def tostype(self, stype: str) -> "NDArray":
+        """Convert storage type (ref: ndarray.py::tostype / cast_storage)."""
+        if stype == "default":
+            return self
+        from .sparse import cast_storage
+
+        return cast_storage(self, stype)
+
     def __len__(self):
         if not self.shape:
             raise TypeError("len() of unsized object")

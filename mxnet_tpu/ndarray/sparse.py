@@ -1,0 +1,458 @@
+"""Sparse NDArrays: RowSparseNDArray and CSRNDArray.
+
+TPU-native counterpart of the reference's sparse frontend + storage types
+(ref: python/mxnet/ndarray/sparse.py — BaseSparseNDArray/RowSparseNDArray/
+CSRNDArray; include/mxnet/ndarray.h kRowSparseStorage/kCSRStorage;
+src/operator/tensor/cast_storage-inl.h, dot-inl.h, sparse_retain-inl.h).
+
+Design (TPU-first, not a port): XLA has no sparse storage — the MXU wants
+dense tiles — so a sparse array here is a **dense HBM backing plus explicit
+aux index arrays** kept in sync:
+
+  * the dense backing means every dense op/kernel keeps working and
+    conversion to/from 'default' storage is free of surprises;
+  * the aux arrays (`indices` for row_sparse; `indices`+`indptr` for csr)
+    carry the reference's *semantics* — which rows/positions are explicitly
+    stored — which is what retain/row_sparse_pull/lazy optimizer updates
+    and serialization actually need;
+  * hot sparse math (dot(csr, dense), sparse elemwise) lowers to gathers/
+    segment-sums on the dense backing — XLA-friendly static shapes, nnz
+    fixed per instance.
+
+An explicitly stored row may contain zeros, exactly like the reference:
+`indices` is authoritative, not derived from the values.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from .ndarray import NDArray, _resolve_dtype, array as _dense_array
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "csr_matrix", "row_sparse_array", "zeros", "empty", "array",
+           "cast_storage", "retain", "dot", "add", "subtract", "multiply",
+           "divide"]
+
+_STORAGE_TYPE_STR_TO_ID = {"undefined": -1, "default": 0, "row_sparse": 1,
+                           "csr": 2}
+_STORAGE_TYPE_ID_TO_STR = {v: k for k, v in _STORAGE_TYPE_STR_TO_ID.items()}
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base: dense jax backing + explicit aux index arrays."""
+
+    __slots__ = ("_aux",)
+
+    def __init__(self, dense, aux, ctx: Optional[Context] = None, dtype=None):
+        super().__init__(dense, ctx=ctx, dtype=dtype)
+        self._aux = aux  # dict of name -> jax int32/int64 array
+
+    # dense views --------------------------------------------------------
+    def tostype(self, stype: str):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return NDArray(self._data, ctx=self._ctx)
+        return cast_storage(self, stype)
+
+    def todense(self) -> NDArray:
+        return self.tostype("default")
+
+    def asnumpy(self):
+        return np.asarray(jax.device_get(self._data))
+
+    def copy(self):
+        return type(self)(jnp.copy(self._data),
+                          {k: jnp.copy(v) for k, v in self._aux.items()},
+                          ctx=self._ctx)
+
+    def astype(self, dtype, copy=True):
+        dt = _resolve_dtype(dtype)
+        if not copy and self._data.dtype == dt:
+            return self
+        return type(self)(self._data.astype(dt), dict(self._aux),
+                          ctx=self._ctx)
+
+    def as_in_context(self, ctx: Context):
+        if ctx == self._ctx:
+            return self
+        dev = ctx.jax_device
+        return type(self)(jax.device_put(self._data, dev),
+                          {k: jax.device_put(v, dev)
+                           for k, v in self._aux.items()}, ctx=ctx)
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            return self.as_in_context(other)
+        if isinstance(other, BaseSparseNDArray):
+            other._data = jax.device_put(self._data, other.ctx.jax_device)
+            other._aux = {k: jax.device_put(v, other.ctx.jax_device)
+                          for k, v in self._aux.items()}
+            return other
+        # sparse -> dense copy densifies (ref: CopyFromTo cross-stype)
+        other._data = jax.device_put(self._data, other.ctx.jax_device)
+        return other
+
+    def __repr__(self):
+        dims = "x".join(map(str, self.shape))
+        return (f"\n<{type(self).__name__} {dims} @{self._ctx}>")
+
+    def _deny(self, what):
+        raise MXNetError(f"{what} is not supported for {self.stype} storage; "
+                         f"call .tostype('default') first")
+
+    def __iadd__(self, o):
+        self._deny("inplace arithmetic")
+
+    def __setitem__(self, key, value):
+        if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
+            if isinstance(value, BaseSparseNDArray):
+                value.copyto(self)
+                return
+            if isinstance(value, NDArray):
+                fresh = cast_storage(value, self.stype)
+            else:
+                fresh = cast_storage(_dense_array(value, ctx=self._ctx),
+                                     self.stype)
+            fresh.copyto(self)
+            return
+        self._deny("sliced assignment")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """ref: RowSparseNDArray — values for a subset of rows.
+
+    aux: `indices` (sorted int64 row ids, shape (num_stored,)).
+    `.data` is the (num_stored, *row_shape) value block.
+    """
+
+    __slots__ = ()
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._aux["indices"], ctx=self._ctx)
+
+    @property
+    def data(self) -> NDArray:
+        # the stored-rows value block, gathered from the dense backing
+        return NDArray(jnp.take(self._data, self._aux["indices"], axis=0),
+                       ctx=self._ctx)
+
+    @property
+    def _values_jax(self):
+        return jnp.take(self._data, self._aux["indices"], axis=0)
+
+    def retain(self, rsp_indices):
+        return retain(self, rsp_indices)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """ref: CSRNDArray — compressed sparse row matrix.
+
+    aux: `indices` (column ids, shape (nnz,)), `indptr` (row pointers,
+    shape (rows+1,)).  `.data` is the (nnz,) value vector.
+    """
+
+    __slots__ = ()
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._aux["indices"], ctx=self._ctx)
+
+    @property
+    def indptr(self) -> NDArray:
+        return NDArray(self._aux["indptr"], ctx=self._ctx)
+
+    @property
+    def data(self) -> NDArray:
+        rows = self._row_ids()
+        cols = self._aux["indices"]
+        return NDArray(self._data[rows, cols], ctx=self._ctx)
+
+    def _row_ids(self):
+        """Per-nnz row id, from indptr (static nnz => static shapes)."""
+        indptr = self._aux["indptr"]
+        nnz = int(self._aux["indices"].shape[0])
+        counts = jnp.diff(indptr)
+        return jnp.repeat(jnp.arange(indptr.shape[0] - 1, dtype=jnp.int32),
+                          counts, total_repeat_length=nnz)
+
+    def asscipy(self):
+        import scipy.sparse as sps
+
+        return sps.csr_matrix(
+            (np.asarray(jax.device_get(self.data.data)),
+             np.asarray(jax.device_get(self._aux["indices"])),
+             np.asarray(jax.device_get(self._aux["indptr"]))),
+            shape=self.shape)
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            key = slice(key, key + 1)
+        if isinstance(key, slice):
+            dense = self._data[key]
+            return cast_storage(NDArray(dense, ctx=self._ctx), "csr")
+        raise MXNetError("CSRNDArray only supports int/slice row indexing")
+
+
+# ---------------------------------------------------------------------------
+# construction
+# ---------------------------------------------------------------------------
+
+def _to_jax_idx(x, dtype=jnp.int32):
+    if isinstance(x, NDArray):
+        x = x.data
+    return jnp.asarray(np.asarray(x), dtype=dtype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """ref: sparse.row_sparse_array — from (data, indices) or dense."""
+    ctx = ctx or current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 2 and not np.isscalar(arg1[0]):
+        values, indices = arg1
+        values = np.asarray(values if not isinstance(values, NDArray)
+                            else values.asnumpy())
+        if dtype is None:
+            dtype = "float32" if values.dtype == np.float64 else values.dtype
+        indices = np.asarray(indices, np.int64).reshape(-1)
+        order = np.argsort(indices)
+        indices = indices[order]
+        values = values[order]
+        if shape is None:
+            nrows = int(indices[-1]) + 1 if indices.size else 0
+            shape = (nrows,) + tuple(values.shape[1:])
+        dense = np.zeros(shape, dtype=np.asarray(values).dtype)
+        if indices.size:
+            dense[indices] = values
+        dev = ctx.jax_device
+        return RowSparseNDArray(
+            jax.device_put(jnp.asarray(dense, _resolve_dtype(dtype)), dev),
+            {"indices": jax.device_put(jnp.asarray(indices), dev)}, ctx=ctx)
+    # dense input (ndarray / NDArray / nested lists)
+    nd = arg1 if isinstance(arg1, NDArray) else _dense_array(
+        arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(nd, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """ref: sparse.csr_matrix — from (data, indices, indptr),
+    (data, (row, col)), a scipy.sparse matrix, or dense."""
+    ctx = ctx or current_context()
+    try:
+        import scipy.sparse as sps
+        if sps.issparse(arg1):
+            csr = arg1.tocsr()
+            return csr_matrix((csr.data, csr.indices, csr.indptr),
+                              shape=csr.shape, ctx=ctx, dtype=dtype)
+    except ImportError:
+        pass
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        values, indices, indptr = arg1
+        values = np.asarray(values if not isinstance(values, NDArray)
+                            else values.asnumpy())
+        if dtype is None:
+            dtype = "float32" if values.dtype == np.float64 else values.dtype
+        indices = np.asarray(indices, np.int64).reshape(-1)
+        indptr = np.asarray(indptr, np.int64).reshape(-1)
+        if shape is None:
+            ncols = int(indices.max()) + 1 if indices.size else 0
+            shape = (len(indptr) - 1, ncols)
+        dense = np.zeros(shape, dtype=values.dtype)
+        rows = np.repeat(np.arange(len(indptr) - 1), np.diff(indptr))
+        dense[rows, indices] = values
+        dev = ctx.jax_device
+        return CSRNDArray(
+            jax.device_put(jnp.asarray(dense, _resolve_dtype(dtype)), dev),
+            {"indices": jax.device_put(jnp.asarray(indices), dev),
+             "indptr": jax.device_put(jnp.asarray(indptr), dev)}, ctx=ctx)
+    if isinstance(arg1, tuple) and len(arg1) == 2 \
+            and isinstance(arg1[1], tuple):
+        values, (row, col) = arg1
+        import scipy.sparse as sps
+        m = sps.coo_matrix((np.asarray(values),
+                            (np.asarray(row), np.asarray(col))),
+                           shape=shape).tocsr()
+        return csr_matrix(m, shape=shape, ctx=ctx, dtype=dtype)
+    nd = arg1 if isinstance(arg1, NDArray) else _dense_array(
+        arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(nd, "csr")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    """ref: sparse.zeros — all-zero sparse array (nothing stored)."""
+    ctx = ctx or current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    dev = ctx.jax_device
+    dense = jax.device_put(jnp.zeros(shape, _resolve_dtype(dtype)), dev)
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            dense, {"indices": jax.device_put(jnp.zeros((0,), jnp.int32),
+                                              dev)}, ctx=ctx)
+    if stype == "csr":
+        return CSRNDArray(
+            dense,
+            {"indices": jax.device_put(jnp.zeros((0,), jnp.int32), dev),
+             "indptr": jax.device_put(jnp.zeros((shape[0] + 1,), jnp.int32),
+                                      dev)}, ctx=ctx)
+    if stype == "default":
+        return NDArray(dense, ctx=ctx)
+    raise MXNetError(f"unknown storage type {stype!r}")
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def array(source, ctx=None, dtype=None):
+    """ref: sparse.array — build from another sparse array (incl. scipy)."""
+    try:
+        import scipy.sparse as sps
+        if sps.issparse(source):
+            return csr_matrix(source, ctx=ctx, dtype=dtype)
+    except ImportError:
+        pass
+    if isinstance(source, BaseSparseNDArray):
+        out = source.copy()
+        if dtype is not None:
+            out = out.astype(dtype)
+        return out.as_in_context(ctx) if ctx is not None else out
+    raise MXNetError("sparse.array expects a sparse input; use nd.array for "
+                     "dense sources")
+
+
+# ---------------------------------------------------------------------------
+# storage casts / structural ops (ref: cast_storage-inl.h, sparse_retain)
+# ---------------------------------------------------------------------------
+
+def cast_storage(arr: NDArray, stype: str):
+    """ref: nd.cast_storage — convert between storage types.
+
+    Structure discovery (nonzero scan) happens host-side: storage casts are
+    an eager/etl operation, never inside a jitted step."""
+    if stype == arr.stype:
+        return arr
+    ctx = arr.ctx
+    dev = ctx.jax_device
+    dense_np = np.asarray(jax.device_get(arr._data))
+    if stype == "default":
+        return NDArray(arr._data, ctx=ctx)
+    if stype == "row_sparse":
+        if dense_np.ndim < 1:
+            raise MXNetError("row_sparse needs ndim >= 1")
+        nz_rows = np.flatnonzero(
+            dense_np.reshape(dense_np.shape[0], -1).any(axis=1))
+        return RowSparseNDArray(
+            arr._data, {"indices": jax.device_put(
+                jnp.asarray(nz_rows, jnp.int32), dev)}, ctx=ctx)
+    if stype == "csr":
+        if dense_np.ndim != 2:
+            raise MXNetError("csr storage requires a 2-D array")
+        import scipy.sparse as sps
+        if dense_np.dtype.name not in ("float32", "float64", "int32",
+                                       "int64", "int8", "uint8"):
+            # scipy rejects ml_dtypes (bfloat16/float16); only the nonzero
+            # STRUCTURE is needed, so discover it on a float32 view
+            m = sps.csr_matrix(dense_np.astype(np.float32))
+        else:
+            m = sps.csr_matrix(dense_np)
+        return CSRNDArray(
+            arr._data,
+            {"indices": jax.device_put(jnp.asarray(m.indices, jnp.int32),
+                                       dev),
+             "indptr": jax.device_put(jnp.asarray(m.indptr, jnp.int32),
+                                      dev)}, ctx=ctx)
+    raise MXNetError(f"unknown storage type {stype!r}")
+
+
+def retain(rsp: RowSparseNDArray, indices):
+    """ref: sparse_retain — keep only the requested rows of a row_sparse."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    keep = _to_jax_idx(indices)
+    mask = jnp.zeros((rsp.shape[0],), bool).at[keep].set(True)
+    dense = jnp.where(mask.reshape((-1,) + (1,) * (rsp.ndim - 1)),
+                      rsp._data, 0)
+    stored = rsp._aux["indices"]
+    stored_mask = jnp.zeros((rsp.shape[0],), bool).at[stored].set(True)
+    new_idx = keep[stored_mask[keep]] if keep.size else keep
+    new_idx = jnp.sort(new_idx)
+    return RowSparseNDArray(dense, {"indices": new_idx}, ctx=rsp.ctx)
+
+
+# ---------------------------------------------------------------------------
+# math (ref: dot-inl.h FComputeEx, elemwise sparse kernels)
+# ---------------------------------------------------------------------------
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """ref: nd.sparse.dot — dot(csr, dense) / dot(csr.T, dense).
+
+    Lowered to a dense matmul on the MXU: the dense backing makes this one
+    XLA HLO with no scatter/gather chains, the right call on TPU where
+    structured-sparse speedups don't exist."""
+    if isinstance(lhs, CSRNDArray):
+        a = lhs._data
+    elif isinstance(lhs, NDArray):
+        a = lhs.data
+    else:
+        raise MXNetError("sparse.dot lhs must be NDArray/CSRNDArray")
+    b = rhs._data if isinstance(rhs, BaseSparseNDArray) else rhs.data
+    if transpose_a:
+        a = a.T
+    if transpose_b:
+        b = b.T
+    return NDArray(jnp.matmul(a, b), ctx=lhs.ctx)
+
+
+def _ew(op, lhs, rhs):
+    lstype = getattr(lhs, "stype", "default")
+    rstype = getattr(rhs, "stype", "default")
+    ld = lhs._data if isinstance(lhs, NDArray) else jnp.asarray(lhs)
+    rd = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+    out = op(ld, rd)
+    ctx = lhs.ctx if isinstance(lhs, NDArray) else rhs.ctx
+    # same-stype elemwise keeps the stype, like the reference's FComputeEx
+    if lstype == rstype == "row_sparse" and out.shape == lhs.shape:
+        merged = jnp.sort(jnp.unique(
+            jnp.concatenate([lhs._aux["indices"], rhs._aux["indices"]])))
+        return RowSparseNDArray(out, {"indices": merged}, ctx=ctx)
+    if lstype == rstype == "csr" and out.shape == lhs.shape:
+        return cast_storage(NDArray(out, ctx=ctx), "csr")
+    return NDArray(out, ctx=ctx)
+
+
+def add(lhs, rhs):
+    return _ew(jnp.add, lhs, rhs)
+
+
+def subtract(lhs, rhs):
+    return _ew(jnp.subtract, lhs, rhs)
+
+
+def multiply(lhs, rhs):
+    return _ew(jnp.multiply, lhs, rhs)
+
+
+def divide(lhs, rhs):
+    return _ew(jnp.divide, lhs, rhs)
+
+
+def add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = add(out, a)
+    return out

@@ -204,7 +204,11 @@ def invoke(op_name: str, *inputs, **attrs):
     ctx = None
     for x in inputs:
         if isinstance(x, NDArray):
-            arrays.append(x.data)
+            # ._data: the dense jax payload — for sparse NDArrays .data is
+            # the values block (reference naming); generic ops see the
+            # densified view (ref: FCompute fallback densifies FComputeEx
+            # storage types)
+            arrays.append(x._data)
             ctx = ctx or x.ctx
         else:
             arrays.append(x)

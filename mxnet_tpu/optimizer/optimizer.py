@@ -136,15 +136,43 @@ class SGD(Optimizer):
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
         return zeros(weight.shape, ctx=weight.ctx, dtype=str(weight.data.dtype))
 
+    def _sparse_update(self, index, weight, grad, state, kw):
+        """Lazy update: only the rows present in the row_sparse gradient
+        are touched (ref: sgd_update FComputeEx on kRowSparseStorage +
+        SGDUpdateDnsRspImpl lazy_update path)."""
+        import jax.numpy as jnp
+
+        rows = grad._aux["indices"]
+        g = jnp.take(grad._data, rows, axis=0).astype(weight.data.dtype)
+        g = g * kw["rescale_grad"]
+        if kw["clip_gradient"] > 0:
+            g = jnp.clip(g, -kw["clip_gradient"], kw["clip_gradient"])
+        w_rows = jnp.take(weight.data, rows, axis=0)
+        g = g + kw["wd"] * w_rows
+        if state is None:
+            weight._data = weight.data.at[rows].add(-kw["lr"] * g)
+        else:
+            m_rows = jnp.take(state.data, rows, axis=0)
+            m_rows = self.momentum * m_rows - kw["lr"] * g
+            state._data = state.data.at[rows].set(m_rows)
+            weight._data = weight.data.at[rows].add(m_rows)
+
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
         self._update_count(index)
         kw = self._common(index)
+        if isinstance(grad, RowSparseNDArray):
+            if self.lazy_update:
+                return self._sparse_update(index, weight, grad, state, kw)
+            grad = NDArray(grad._data, ctx=grad.ctx)  # std_update: densify
         if state is None:
             _rebind([weight], invoke("sgd_update", weight, grad, **kw))
         else:
@@ -167,6 +195,12 @@ class SGD(Optimizer):
 @register("nag")
 class NAG(SGD):
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(grad, RowSparseNDArray):
+            # NAG has no lazy sparse kernel (ref: nag_mom_update is
+            # dense-only); densify = std_update semantics
+            grad = NDArray(grad._data, ctx=grad.ctx)
         self._update_count(index)
         kw = self._common(index)
         if state is None:

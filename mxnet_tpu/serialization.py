@@ -44,52 +44,110 @@ def _dtype_name(nd: NDArray) -> str:
     return str(nd.data.dtype)
 
 
-def _write_one(f, nd: NDArray):
-    name = _dtype_name(nd)
-    a = nd.asnumpy()
+_STYPE_ID = {"default": 0, "row_sparse": 1, "csr": 2}
+
+
+def _raw_bytes(a, name):
     if name == "bfloat16":
-        raw = a.astype(np.float32)  # numpy lacks bf16; use ml_dtypes view
         import ml_dtypes
 
-        raw = raw.astype(ml_dtypes.bfloat16)
-        data = raw.tobytes()
-        flag = _TYPE_FLAG["bfloat16"]
-    else:
-        flag = _TYPE_FLAG.get(name)
-        if flag is None:
-            raise MXNetError(f"cannot serialize dtype {name}")
-        data = np.ascontiguousarray(a).tobytes()
-    f.write(struct.pack("<II", NDARRAY_V2_MAGIC, 0))
-    f.write(struct.pack("<I", a.ndim))
-    f.write(struct.pack(f"<{a.ndim}q", *a.shape))
-    f.write(struct.pack("<ii", 1, 0))  # saved context: cpu(0), like reference
+        return a.astype(np.float32).astype(ml_dtypes.bfloat16).tobytes(), \
+            _TYPE_FLAG["bfloat16"]
+    flag = _TYPE_FLAG.get(name)
+    if flag is None:
+        raise MXNetError(f"cannot serialize dtype {name}")
+    return np.ascontiguousarray(a).tobytes(), flag
+
+
+def _write_one(f, nd: NDArray):
+    from .ndarray.sparse import BaseSparseNDArray, CSRNDArray
+
+    stype = _STYPE_ID[nd.stype]
+    f.write(struct.pack("<II", NDARRAY_V2_MAGIC, stype))
+    if stype == 0:
+        a = nd.asnumpy()
+        data, flag = _raw_bytes(a, _dtype_name(nd))
+        f.write(struct.pack("<I", a.ndim))
+        f.write(struct.pack(f"<{a.ndim}q", *a.shape))
+        f.write(struct.pack("<ii", 1, 0))  # saved ctx: cpu(0), like reference
+        f.write(struct.pack("<i", flag))
+        f.write(data)
+        return
+    # sparse record (ref: NDArray::Save sparse branch): full shape + ctx +
+    # dtype, then aux arrays (csr: [indptr, indices]; row_sparse:
+    # [indices]), then the stored-values block (NOT the dense backing).
+    f.write(struct.pack("<I", nd.ndim))
+    f.write(struct.pack(f"<{nd.ndim}q", *nd.shape))
+    f.write(struct.pack("<ii", 1, 0))
+    values = nd.data.asnumpy()
+    data, flag = _raw_bytes(values, str(nd.data.data.dtype))
     f.write(struct.pack("<i", flag))
+    auxes = ([nd.indptr, nd.indices] if isinstance(nd, CSRNDArray)
+             else [nd.indices])
+    f.write(struct.pack("<I", len(auxes)))
+    for aux in auxes:
+        a = aux.asnumpy().astype(np.int64)
+        f.write(struct.pack("<I", a.ndim))
+        f.write(struct.pack(f"<{a.ndim}q", *a.shape))
+        f.write(a.tobytes())
+    f.write(struct.pack("<I", values.ndim))
+    f.write(struct.pack(f"<{values.ndim}q", *values.shape))
     f.write(data)
 
 
-def _read_one(f) -> NDArray:
-    magic, stype = struct.unpack("<II", f.read(8))
-    if magic != NDARRAY_V2_MAGIC:
-        raise MXNetError(f"bad ndarray magic {magic:#x}")
-    if stype != 0:
-        raise MXNetError("sparse storage load not supported")
-    (ndim,) = struct.unpack("<I", f.read(4))
-    shape = struct.unpack(f"<{ndim}q", f.read(8 * ndim)) if ndim else ()
-    struct.unpack("<ii", f.read(8))
-    (flag,) = struct.unpack("<i", f.read(4))
+def _np_dtype_of_flag(flag):
     dtname = _FLAG_TYPE.get(flag)
     if dtname is None:
         raise MXNetError(f"unknown type flag {flag}")
     if dtname == "bfloat16":
         import ml_dtypes
 
-        npdt = np.dtype(ml_dtypes.bfloat16)
-    else:
-        npdt = np.dtype(dtname)
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(dtname)
+
+
+def _read_shape(f):
+    (ndim,) = struct.unpack("<I", f.read(4))
+    return struct.unpack(f"<{ndim}q", f.read(8 * ndim)) if ndim else ()
+
+
+def _read_raw(f, shape, npdt):
     n = int(np.prod(shape)) if shape else 1
     buf = f.read(n * npdt.itemsize)
-    a = np.frombuffer(buf, dtype=npdt).reshape(shape)
-    return nd_array(a, ctx=cpu(), dtype=npdt)
+    return np.frombuffer(buf, dtype=npdt).reshape(shape)
+
+
+def _read_one(f) -> NDArray:
+    magic, stype = struct.unpack("<II", f.read(8))
+    if magic != NDARRAY_V2_MAGIC:
+        raise MXNetError(f"bad ndarray magic {magic:#x}")
+    if stype == 0:
+        shape = _read_shape(f)
+        struct.unpack("<ii", f.read(8))
+        (flag,) = struct.unpack("<i", f.read(4))
+        npdt = _np_dtype_of_flag(flag)
+        return nd_array(_read_raw(f, shape, npdt), ctx=cpu(), dtype=npdt)
+    from .ndarray.sparse import csr_matrix, row_sparse_array
+
+    shape = _read_shape(f)
+    struct.unpack("<ii", f.read(8))
+    (flag,) = struct.unpack("<i", f.read(4))
+    npdt = _np_dtype_of_flag(flag)
+    (num_aux,) = struct.unpack("<I", f.read(4))
+    auxes = []
+    for _ in range(num_aux):
+        ashape = _read_shape(f)
+        auxes.append(_read_raw(f, ashape, np.dtype(np.int64)))
+    vshape = _read_shape(f)
+    values = _read_raw(f, vshape, npdt)
+    if stype == 1:
+        return row_sparse_array((values, auxes[0]), shape=shape, ctx=cpu(),
+                                dtype=npdt)
+    if stype == 2:
+        indptr, indices = auxes
+        return csr_matrix((values, indices, indptr), shape=shape, ctx=cpu(),
+                          dtype=npdt)
+    raise MXNetError(f"unknown storage type id {stype}")
 
 
 def save_ndarrays(fname: str, data) -> None:

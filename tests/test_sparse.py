@@ -1,0 +1,257 @@
+"""Sparse NDArray tests, modeled on the reference's
+tests/python/unittest/test_sparse_ndarray.py and test_sparse_operator.py
+(numpy/scipy as oracle)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def test_row_sparse_creation():
+    vals = np.arange(6, dtype=np.float32).reshape(2, 3)
+    rsp = sparse.row_sparse_array((vals, [4, 1]), shape=(6, 3))
+    assert rsp.stype == "row_sparse"
+    assert rsp.shape == (6, 3)
+    # indices come back sorted; data follows
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 4])
+    np.testing.assert_allclose(rsp.data.asnumpy(), vals[[1, 0]])
+    dense = rsp.todense().asnumpy()
+    expect = np.zeros((6, 3), np.float32)
+    expect[4], expect[1] = vals[0], vals[1]
+    np.testing.assert_allclose(dense, expect)
+
+
+def test_csr_creation_and_asscipy():
+    import scipy.sparse as sps
+
+    m = sps.random(8, 5, density=0.4, format="csr", dtype=np.float32,
+                   random_state=0)
+    csr = sparse.csr_matrix(m)
+    assert csr.stype == "csr"
+    assert csr.shape == (8, 5)
+    np.testing.assert_allclose(csr.todense().asnumpy(), m.toarray())
+    back = csr.asscipy()
+    np.testing.assert_allclose(back.toarray(), m.toarray())
+    # (data, indices, indptr) constructor
+    csr2 = sparse.csr_matrix((m.data, m.indices, m.indptr), shape=m.shape)
+    np.testing.assert_allclose(csr2.todense().asnumpy(), m.toarray())
+
+
+def test_cast_storage_round_trip():
+    rng = np.random.RandomState(0)
+    dense = rng.rand(6, 4).astype(np.float32)
+    dense[[1, 3]] = 0
+    x = nd.array(dense)
+    rsp = nd.cast_storage(x, "row_sparse")
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [0, 2, 4, 5])
+    np.testing.assert_allclose(rsp.todense().asnumpy(), dense)
+    csr = x.tostype("csr")
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.todense().asnumpy(), dense)
+    assert nd.cast_storage(csr, "default").stype == "default"
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (4, 3))
+    assert z.stype == "row_sparse" and z.indices.shape == (0,)
+    zc = sparse.zeros("csr", (4, 3))
+    assert zc.stype == "csr"
+    assert zc.indptr.shape == (5,)
+    np.testing.assert_allclose(zc.todense().asnumpy(), np.zeros((4, 3)))
+
+
+def test_retain():
+    vals = np.ones((3, 2), np.float32)
+    rsp = sparse.row_sparse_array((vals, [0, 2, 4]), shape=(6, 2))
+    out = sparse.retain(rsp, [2, 4, 5])
+    np.testing.assert_array_equal(out.indices.asnumpy(), [2, 4])
+    expect = np.zeros((6, 2), np.float32)
+    expect[2] = expect[4] = 1
+    np.testing.assert_allclose(out.todense().asnumpy(), expect)
+
+
+def test_sparse_elemwise_keeps_stype():
+    a = sparse.row_sparse_array((np.ones((2, 3), np.float32), [0, 2]),
+                                shape=(5, 3))
+    b = sparse.row_sparse_array((2 * np.ones((2, 3), np.float32), [2, 4]),
+                                shape=(5, 3))
+    out = sparse.add(a, b)
+    assert out.stype == "row_sparse"
+    np.testing.assert_array_equal(out.indices.asnumpy(), [0, 2, 4])
+    np.testing.assert_allclose(out.todense().asnumpy(),
+                               a.todense().asnumpy() + b.todense().asnumpy())
+
+
+def test_sparse_dot():
+    import scipy.sparse as sps
+
+    rng = np.random.RandomState(0)
+    m = sps.random(6, 4, density=0.5, format="csr", dtype=np.float32,
+                   random_state=1)
+    rhs = rng.rand(4, 3).astype(np.float32)
+    csr = sparse.csr_matrix(m)
+    out = sparse.dot(csr, nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), m.toarray() @ rhs, rtol=1e-5)
+    rhs2 = rng.rand(6, 3).astype(np.float32)
+    out_t = sparse.dot(csr, nd.array(rhs2), transpose_a=True)
+    np.testing.assert_allclose(out_t.asnumpy(), m.toarray().T @ rhs2,
+                               rtol=1e-5)
+
+
+def test_sparse_save_load(tmp_path):
+    fname = str(tmp_path / "sparse.params")
+    vals = np.arange(8, dtype=np.float32).reshape(2, 4)
+    rsp = sparse.row_sparse_array((vals, [1, 3]), shape=(5, 4))
+    import scipy.sparse as sps
+
+    m = sps.random(4, 6, density=0.4, format="csr", dtype=np.float32,
+                   random_state=0)
+    csr = sparse.csr_matrix(m)
+    dense = nd.array(np.arange(3, dtype=np.float32))
+    nd.save(fname, {"rsp": rsp, "csr": csr, "dense": dense})
+    loaded = nd.load(fname)
+    assert loaded["rsp"].stype == "row_sparse"
+    np.testing.assert_array_equal(loaded["rsp"].indices.asnumpy(), [1, 3])
+    np.testing.assert_allclose(loaded["rsp"].todense().asnumpy(),
+                               rsp.todense().asnumpy())
+    assert loaded["csr"].stype == "csr"
+    np.testing.assert_allclose(loaded["csr"].todense().asnumpy(),
+                               m.toarray())
+    assert loaded["dense"].stype == "default"
+    np.testing.assert_allclose(loaded["dense"].asnumpy(), [0, 1, 2])
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = np.random.RandomState(0).rand(8, 3).astype(np.float32)
+    kv.init("emb", nd.array(w))
+    out = sparse.zeros("row_sparse", (8, 3))
+    kv.row_sparse_pull("emb", out=out,
+                       row_ids=nd.array([5, 1, 5], dtype="int32"))
+    np.testing.assert_array_equal(out.indices.asnumpy(), [1, 5])
+    expect = np.zeros((8, 3), np.float32)
+    expect[[1, 5]] = w[[1, 5]]
+    np.testing.assert_allclose(out.todense().asnumpy(), expect, rtol=1e-6)
+
+
+def test_kvstore_push_row_sparse_reduce():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.zeros((6, 2)))
+    g1 = sparse.row_sparse_array((np.ones((1, 2), np.float32), [1]),
+                                 shape=(6, 2))
+    g2 = sparse.row_sparse_array((np.ones((2, 2), np.float32), [1, 4]),
+                                 shape=(6, 2))
+    kv.push("w", [g1, g2])
+    out = nd.zeros((6, 2))
+    kv.pull("w", out=out)
+    expect = np.zeros((6, 2), np.float32)
+    expect[1] = 2
+    expect[4] = 1
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+@pytest.mark.parametrize("momentum", [0.0, 0.9])
+def test_sgd_lazy_update_touches_only_grad_rows(momentum):
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=momentum, wd=0.01,
+                           lazy_update=True)
+    w0 = np.random.RandomState(0).rand(6, 3).astype(np.float32)
+    weight = nd.array(w0)
+    state = opt.create_state(0, weight)
+    gvals = np.ones((2, 3), np.float32)
+    grad = sparse.row_sparse_array((gvals, [1, 4]), shape=(6, 3))
+    opt.update(0, weight, grad, state)
+    w1 = weight.asnumpy()
+    untouched = [0, 2, 3, 5]
+    np.testing.assert_allclose(w1[untouched], w0[untouched])
+    # touched rows follow dense SGD math on those rows
+    g = gvals + 0.01 * w0[[1, 4]]
+    np.testing.assert_allclose(w1[[1, 4]], w0[[1, 4]] - 0.1 * g, rtol=1e-5)
+    if momentum:
+        # second step uses accumulated momentum on touched rows
+        opt.update(0, weight, grad, state)
+        w2 = weight.asnumpy()
+        np.testing.assert_allclose(w2[untouched], w0[untouched])
+        g2 = gvals + 0.01 * w1[[1, 4]]
+        m = -0.1 * g  # state after step 1
+        m2 = momentum * m - 0.1 * g2
+        np.testing.assert_allclose(w2[[1, 4]], w1[[1, 4]] + m2, rtol=1e-5)
+
+
+def test_sgd_std_update_with_sparse_grad():
+    """lazy_update=False densifies: wd decays every row."""
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.1, lazy_update=False)
+    w0 = np.ones((4, 2), np.float32)
+    weight = nd.array(w0)
+    grad = sparse.row_sparse_array((np.ones((1, 2), np.float32), [2]),
+                                   shape=(4, 2))
+    opt.update(0, weight, grad, None)
+    w1 = weight.asnumpy()
+    # untouched rows still decay by lr*wd*w
+    np.testing.assert_allclose(w1[0], w0[0] - 0.1 * 0.1 * w0[0], rtol=1e-5)
+    np.testing.assert_allclose(w1[2], w0[2] - 0.1 * (1 + 0.1 * w0[2]),
+                               rtol=1e-5)
+
+
+def test_sparse_setitem_and_copy():
+    rsp = sparse.zeros("row_sparse", (4, 2))
+    src = sparse.row_sparse_array((np.ones((1, 2), np.float32), [3]),
+                                  shape=(4, 2))
+    rsp[:] = src
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [3])
+    cp = rsp.copy()
+    assert cp.stype == "row_sparse"
+    with pytest.raises(mx.base.MXNetError):
+        rsp[1] = 5.0
+
+
+def test_row_sparse_pull_from_sparse_store_and_multi_key():
+    """Regression: sparse-valued store + per-key row_ids pairing."""
+    kv = mx.kv.create("local")
+    kv.init("a", nd.array(np.arange(12, dtype=np.float32).reshape(6, 2)))
+    kv.init("b", nd.array(-np.arange(12, dtype=np.float32).reshape(6, 2)))
+    # store a sparse value under 'c' via push without updater
+    kv.init("c", nd.zeros((6, 2)))
+    kv.push("c", sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), [3]), shape=(6, 2)))
+    oa, ob = nd.zeros((6, 2)), nd.zeros((6, 2))
+    kv.row_sparse_pull(["a", "b"], out=[oa, ob],
+                       row_ids=[nd.array([1], dtype="int32"),
+                                nd.array([4], dtype="int32")])
+    assert oa.asnumpy()[1].sum() != 0 and oa.asnumpy()[4].sum() == 0
+    assert ob.asnumpy()[4].sum() != 0 and ob.asnumpy()[1].sum() == 0
+    oc = sparse.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull("c", out=oc, row_ids=nd.array([3], dtype="int32"))
+    np.testing.assert_allclose(oc.todense().asnumpy()[3], [1, 1])
+
+
+def test_pull_sparse_out_raises():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.ones((4, 2)))
+    with pytest.raises(mx.base.MXNetError):
+        kv.pull("w", out=sparse.zeros("row_sparse", (4, 2)))
+
+
+def test_nag_and_adam_accept_sparse_grad():
+    for name in ("nag", "adam"):
+        opt = mx.optimizer.create(name, learning_rate=0.1)
+        if name == "nag":
+            opt.momentum = 0.9
+        w = nd.ones((4, 2))
+        state = opt.create_state(0, w)
+        g = sparse.row_sparse_array((np.ones((1, 2), np.float32), [2]),
+                                    shape=(4, 2))
+        opt.update(0, w, g, state)
+        assert np.isfinite(w.asnumpy()).all()
+
+
+def test_cast_storage_bf16_csr():
+    x = nd.array(np.eye(3, dtype=np.float32)).astype("bfloat16")
+    csr = x.tostype("csr")
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(
+        csr.todense().asnumpy().astype(np.float32), np.eye(3))
