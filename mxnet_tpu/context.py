@@ -74,8 +74,11 @@ class Context:
         """Resolve to a concrete jax.Device (lazy; import jax here)."""
         import jax
 
+        # device ids are PER-PROCESS (local): in a multi-process (DCN) job
+        # each worker addresses only its own devices — ctx cpu(0)/tpu(0)
+        # must never resolve to another process's buffer space
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = jax.devices("cpu")
+            devs = jax.local_devices(backend="cpu")
         else:
             devs = _accelerator_devices()
             if not devs:
@@ -94,7 +97,7 @@ def _accelerator_devices():
     import jax
 
     try:
-        devs = jax.devices()
+        devs = jax.local_devices()
     except RuntimeError:
         return []
     return [d for d in devs if d.platform != "cpu"]

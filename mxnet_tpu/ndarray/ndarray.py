@@ -51,11 +51,16 @@ def _ctx_of_jax(arr) -> Context:
         dev = list(arr.devices())[0]
     except Exception:
         return current_context()
+    # Context.device_id is a LOCAL (per-process) position, matching
+    # Context.jax_device's local_devices indexing — dev.id is a GLOBAL id
+    # and the two differ on non-zero workers of a multi-process job
     if dev.platform == "cpu":
-        return cpu(dev.id)
+        local = jax.local_devices(backend="cpu")
+        return cpu(next((i for i, d in enumerate(local) if d == dev), 0))
     from ..context import tpu
 
-    return tpu(dev.id)
+    local = [d for d in jax.local_devices() if d.platform != "cpu"]
+    return tpu(next((i for i, d in enumerate(local) if d == dev), 0))
 
 
 class NDArray:

@@ -77,6 +77,13 @@ def init(coordinator_address: Optional[str] = None,
         # the device cluster — worker 0 already owns process_id 0.
         _INITIALIZED = True
         return
+    try:
+        # CPU cross-process collectives need an explicit implementation
+        # (gloo ships in jaxlib); harmless for TPU where ICI/DCN transport
+        # is native (ref role: ps-lite ZMQVan -> gloo/ICI substrate)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
@@ -115,10 +122,26 @@ def allgather_np(value: np.ndarray) -> np.ndarray:
 
 def allreduce_nd(val):
     """Sum an NDArray across processes over DCN (eager path used by
-    KVStore('dist_*'); the SPMD path does this in-graph instead)."""
+    KVStore('dist_*'); the SPMD path does this in-graph instead).
+
+    row_sparse inputs stay row_sparse: the dense backing is summed and the
+    stored-row sets are unioned (via a fixed-size row mask, so workers may
+    hold different nnz)."""
     from ..ndarray.ndarray import NDArray
+    from ..ndarray.sparse import RowSparseNDArray
 
     if jax.process_count() == 1:
         return val
-    summed = allgather_np(np.asarray(val.data)).sum(axis=0)
-    return NDArray(jax.numpy.asarray(summed), ctx=val.ctx)
+    summed = allgather_np(np.asarray(val._data)).sum(axis=0)
+    out = jax.numpy.asarray(summed)
+    if isinstance(val, RowSparseNDArray):
+        mask = np.zeros((val.shape[0],), np.int32)
+        mask[np.asarray(val._aux["indices"])] = 1
+        union = allgather_np(mask).max(axis=0)
+        idx = jax.numpy.asarray(np.flatnonzero(union).astype(np.int32))
+        return RowSparseNDArray(out, {"indices": idx}, ctx=val.ctx)
+    if val.stype == "csr":
+        from ..ndarray.sparse import cast_storage
+
+        return cast_storage(NDArray(out, ctx=val.ctx), "csr")
+    return NDArray(out, ctx=val.ctx)

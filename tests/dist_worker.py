@@ -1,0 +1,115 @@
+"""Multi-process worker used by test_dist.py (not itself a test module).
+
+Modeled on the reference's tests/nightly/dist_sync_kvstore.py: launched N
+times (by tools/launch.py or the test harness) with the DMLC_* env
+contract; each worker asserts dist_sync semantics and prints DIST_OK.
+"""
+import os
+import sys
+
+# force the CPU backend before any jax backend touch (the axon TPU plugin
+# is process-global in this container; N workers cannot share one chip)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+from mxnet_tpu.parallel import dist  # noqa: E402
+
+
+def mode_kvstore():
+    """dist_sync push/pull/pushpull/row_sparse_pull across workers."""
+    dist.init()
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == int(os.environ["DMLC_NUM_WORKER"]), (nw, os.environ)
+
+    # push/pull: store ends at sum over workers (no updater => overwrite
+    # with the DCN-allreduced value)
+    kv.init("a", nd.zeros((4, 3)))
+    kv.push("a", nd.ones((4, 3)) * (rank + 1))
+    out = nd.zeros((4, 3))
+    kv.pull("a", out=out)
+    expect = sum(r + 1 for r in range(nw))
+    np.testing.assert_allclose(out.asnumpy(), expect * np.ones((4, 3)),
+                               rtol=1e-6)
+
+    # updater path: SGD lr=1 => weight -= sum(grads); every worker applies
+    # the same allreduced grad so stores stay consistent
+    kv2 = mx.kv.create("dist_sync")
+    kv2.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    kv2.init(0, nd.zeros((2, 2)))
+    kv2.push(0, nd.ones((2, 2)) * (rank + 1))
+    w = nd.zeros((2, 2))
+    kv2.pull(0, out=w)
+    np.testing.assert_allclose(w.asnumpy(), -expect * np.ones((2, 2)),
+                               rtol=1e-6)
+
+    # row_sparse grads across workers
+    from mxnet_tpu.ndarray import sparse
+    kv.init("rs", nd.zeros((6, 2)))
+    g = sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), [rank % 6]), shape=(6, 2))
+    kv.push("rs", g)
+    rs_out = sparse.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull("rs", out=rs_out,
+                       row_ids=nd.array([rank % 6], dtype="int32"))
+    np.testing.assert_allclose(rs_out.todense().asnumpy()[rank % 6], [1, 1])
+
+    kv.barrier()
+    print(f"DIST_OK rank={rank}/{nw}", flush=True)
+
+
+def mode_train():
+    """2-process data-parallel MLP convergence via Trainer(dist_sync)."""
+    dist.init()
+    from mxnet_tpu.gluon import nn, loss as gloss, Trainer
+
+    rank, nw = dist.rank(), dist.num_workers()
+    np.random.seed(0)
+    mx.random.seed(0)
+    # same init on every worker (same seed), different data shards
+    net = nn.Sequential()
+    net.add(nn.Dense(16, activation="relu", in_units=4))
+    net.add(nn.Dense(2, in_units=16))
+    net.initialize(mx.initializer.Xavier())
+
+    rng = np.random.RandomState(42)
+    X = rng.randn(256, 4).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.int32)
+    shard = slice(rank * 128 // nw * 2, (rank + 1) * 128 // nw * 2)
+    Xs, ys = X[shard], y[shard]
+
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1}, kvstore="dist_sync")
+    lfn = gloss.SoftmaxCrossEntropyLoss()
+    first = last = None
+    for epoch in range(30):
+        with mx.autograd.record():
+            out = net(nd.array(Xs))
+            loss = lfn(out, nd.array(ys))
+        loss.backward()
+        trainer.step(len(Xs) * nw)
+        last = float(loss.mean().asnumpy())
+        if first is None:
+            first = last
+    assert last < first * 0.5, (first, last)
+
+    # weights must be bit-identical across workers after sync training
+    w = net[0].weight.data().asnumpy()
+    gathered = dist.allgather_np(w)
+    for r in range(1, gathered.shape[0]):
+        np.testing.assert_allclose(gathered[r], gathered[0], rtol=0, atol=0)
+    print(f"DIST_OK rank={rank}/{nw} loss {first:.4f}->{last:.4f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    {"kvstore": mode_kvstore, "train": mode_train}[sys.argv[1]]()

@@ -1,0 +1,81 @@
+"""Multi-process DCN tests (ref: tests/nightly/dist_sync_kvstore.py run via
+tools/launch.py --launcher local).
+
+Spawns real worker processes on the CPU backend; jax.distributed's
+coordination service plays the scheduler role and gloo carries the
+cross-process collectives (the DCN stand-in on one host)."""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(_REPO, "tests", "dist_worker.py")
+_LAUNCH = os.path.join(_REPO, "tools", "launch.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env():
+    env = dict(os.environ)
+    # detach the axon TPU plugin: N workers cannot share the single-client
+    # chip tunnel; the CPU backend is the multi-process test substrate
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def _spawn_workers(mode, n):
+    port = str(_free_port())
+    procs = []
+    for i in range(n):
+        env = _worker_env()
+        env.update({"DMLC_ROLE": "worker", "DMLC_PS_ROOT_URI": "127.0.0.1",
+                    "DMLC_PS_ROOT_PORT": port, "DMLC_NUM_WORKER": str(n),
+                    "DMLC_WORKER_ID": str(i)})
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER, mode], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out))
+    return outs
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_dist_sync_kvstore_multiprocess(n):
+    outs = _spawn_workers("kvstore", n)
+    for rc, out in outs:
+        assert rc == 0, out[-2000:]
+        assert "DIST_OK" in out, out[-2000:]
+
+
+def test_dist_sync_training_two_process():
+    outs = _spawn_workers("train", 2)
+    for rc, out in outs:
+        assert rc == 0, out[-2000:]
+        assert "DIST_OK" in out, out[-2000:]
+
+
+def test_launch_py_local():
+    """The reference-style launcher end to end."""
+    env = _worker_env()
+    p = subprocess.run(
+        [sys.executable, _LAUNCH, "-n", "2", "-s", "1",
+         sys.executable, _WORKER, "kvstore"],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+    assert p.stdout.count("DIST_OK") == 2, p.stdout

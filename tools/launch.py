@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Distributed job launcher (ref: tools/launch.py + dmlc-core tracker).
+
+Spawns N worker processes with the reference's DMLC_* environment contract:
+
+    python tools/launch.py -n 2 python train.py --kv-store dist_sync
+
+Workers bootstrap through mxnet_tpu.parallel.dist.init(), which maps the
+DMLC_* variables onto jax.distributed's coordination service (worker 0
+hosts it — there is no separate scheduler process) and collective
+allreduce over DCN (there are no parameter-server processes; `-s` is
+accepted for command-line parity and ignored with a note).
+
+Only the `local` launcher (single machine, multi-process — the reference's
+`--launcher local` dmlc tracker) is implemented; ssh/mpi/yarn/slurm
+launchers raise with a pointer to run one process per host with the same
+env contract instead.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Launch a distributed mxnet_tpu job",
+        usage="launch.py [-h] -n NUM_WORKERS [-s NUM_SERVERS] "
+              "[--launcher local] command ...")
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference parity; no server "
+                         "processes are spawned (collectives subsume them)")
+    ap.add_argument("--launcher", default="local",
+                    choices=["local", "ssh", "mpi", "yarn", "slurm"])
+    ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    if not args.command:
+        ap.error("no command given")
+    if args.launcher != "local":
+        raise NotImplementedError(
+            f"launcher {args.launcher!r}: start one process per host with "
+            "DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT/DMLC_NUM_WORKER/"
+            "DMLC_WORKER_ID set (see mxnet_tpu.parallel.dist)")
+    if args.num_servers:
+        print("[launch] note: server roles are subsumed by collectives; "
+              f"-s {args.num_servers} ignored", file=sys.stderr)
+
+    port = os.environ.get("DMLC_PS_ROOT_PORT") or str(_free_port())
+    procs = []
+    try:
+        for i in range(args.num_workers):
+            env = dict(os.environ)
+            env.update({
+                "DMLC_ROLE": "worker",
+                "DMLC_PS_ROOT_URI": "127.0.0.1",
+                "DMLC_PS_ROOT_PORT": port,
+                "DMLC_NUM_WORKER": str(args.num_workers),
+                "DMLC_WORKER_ID": str(i),
+                "DMLC_NUM_SERVER": str(args.num_servers),
+            })
+            procs.append(subprocess.Popen(args.command, env=env))
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        return rc
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        return 130
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
