@@ -192,11 +192,17 @@ class NativeEngine:
 # ---------------------------------------------------------------------------
 
 class NativeRecordWriter:
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_chunk: int = 0):
+        # max_chunk=0 → the 29-bit wire default; smaller values exercise
+        # the cflag-chained chunk path without gigabyte fixtures
         self._lib = get()
         h = ctypes.c_void_p()
-        check_call(self._lib.MXRecordIOWriterCreate(
-            path.encode(), ctypes.byref(h)))
+        if max_chunk:
+            check_call(self._lib.MXRecordIOWriterCreateEx(
+                path.encode(), ctypes.c_size_t(max_chunk), ctypes.byref(h)))
+        else:
+            check_call(self._lib.MXRecordIOWriterCreate(
+                path.encode(), ctypes.byref(h)))
         self._h = h
 
     def write(self, buf: bytes) -> int:

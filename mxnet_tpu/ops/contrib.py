@@ -142,15 +142,25 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         best_gt = jnp.argmax(iou, axis=1)             # (N,)
         best_iou = jnp.max(iou, axis=1)
         matched = best_iou > overlap_threshold
-        # force-match: each valid gt claims its best anchor.  Invalid
-        # (padded) gt rows scatter to index n, which is out of bounds and
-        # dropped by XLA — they cannot collide with a valid gt's claim
-        best_anchor = jnp.argmax(iou, axis=0)         # (M,)
+        # force-match: sequential bipartite matching — each round claims
+        # the single globally-best (anchor, gt) pair among still-unclaimed
+        # rows/cols, then retires both.  Deterministic even when several
+        # gt share a best anchor (the reference resolves the same way:
+        # greedy global argmax, not a racy per-gt scatter).
         m = gt_boxes.shape[0]
-        scatter_idx = jnp.where(valid, best_anchor, n)
-        forced = jnp.zeros(n, bool).at[scatter_idx].set(True, mode="drop")
-        forced_gt = jnp.zeros(n, jnp.int32).at[scatter_idx].set(
-            jnp.arange(m, dtype=jnp.int32), mode="drop")
+
+        def bm_body(_, state):
+            iou_cur, f_gt, f_on = state
+            idx = jnp.argmax(iou_cur)
+            i, j = idx // m, idx % m
+            good = iou_cur[i, j] > 0.0  # padded gt cols sit at -1
+            f_gt2 = jnp.where(good, f_gt.at[i].set(j.astype(jnp.int32)), f_gt)
+            f_on2 = jnp.where(good, f_on.at[i].set(True), f_on)
+            iou2 = iou_cur.at[i, :].set(-1.0).at[:, j].set(-1.0)
+            return (jnp.where(good, iou2, iou_cur), f_gt2, f_on2)
+
+        _, forced_gt, forced = lax.fori_loop(
+            0, m, bm_body, (iou, jnp.zeros(n, jnp.int32), jnp.zeros(n, bool)))
         assigned_gt = jnp.where(forced, forced_gt, best_gt)
         pos = matched | forced
 
@@ -167,11 +177,12 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
 
         cls_t = jnp.where(pos, lab[assigned_gt, 0] + 1.0, 0.0)
         if negative_mining_ratio > 0:
-            # hard negatives ranked by background log-loss of cls_pred
+            # hard negatives: anchors whose best overlap is BELOW
+            # negative_mining_thresh (an IoU gate, not a loss gate),
+            # ranked hardest-first by background log-loss of cls_pred
             bg_prob = jax.nn.softmax(cpred, axis=0)[0]       # (N,)
             neg_loss = -jnp.log(jnp.clip(bg_prob, 1e-12, None))
-            neg_cand = (~pos) & (neg_loss >
-                                 -np.log(negative_mining_thresh))
+            neg_cand = (~pos) & (best_iou < negative_mining_thresh)
             num_pos = jnp.sum(pos)
             max_neg = jnp.maximum(
                 (negative_mining_ratio * num_pos).astype(jnp.int32),
@@ -194,20 +205,35 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
 # ---------------------------------------------------------------------------
 
 def _greedy_nms_keep(boxes, scores, ids, thresh, force_suppress):
-    """boxes (K,4) sorted by score desc; returns keep mask (K,)."""
+    """boxes (K,4) sorted by score desc; returns keep mask (K,).
+
+    Small K precomputes the K×K IoU matrix (one batched MXU-friendly op);
+    large K recomputes one IoU row per loop step so memory stays O(K) —
+    full-anchor NMS (SSD: K≈8732) must not materialize a K² matrix per
+    vmapped sample."""
     k = boxes.shape[0]
-    iou = _corner_iou(boxes, boxes)
-    same_cls = (ids[:, None] == ids[None, :]) if not force_suppress \
-        else jnp.ones((k, k), bool)
-    sup = (iou > thresh) & same_cls
     valid = scores > 0
+    idxs = jnp.arange(k)
+
+    if k <= 1024:
+        iou = _corner_iou(boxes, boxes)
+        same_cls = (ids[:, None] == ids[None, :]) if not force_suppress \
+            else jnp.ones((k, k), bool)
+        sup = (iou > thresh) & same_cls
+
+        def body(i, keep):
+            row = sup[i] & (idxs > i)
+            return jnp.where(keep[i], keep & ~row, keep)
+
+        return lax.fori_loop(0, k, body, valid)
 
     def body(i, keep):
-        row = sup[i] & (jnp.arange(k) > i)
+        row_iou = _corner_iou(boxes[i][None, :], boxes)[0]  # (K,)
+        same = jnp.ones(k, bool) if force_suppress else (ids == ids[i])
+        row = (row_iou > thresh) & same & (idxs > i)
         return jnp.where(keep[i], keep & ~row, keep)
 
-    keep = lax.fori_loop(0, k, body, valid)
-    return keep
+    return lax.fori_loop(0, k, body, valid)
 
 
 @register_op("MultiBoxDetection", aliases=("_contrib_MultiBoxDetection",),
@@ -223,7 +249,10 @@ def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
     va = jnp.asarray(variances, jnp.float32)
     anchors = anchor.reshape(-1, 4)
     ac = _corner_to_center(anchors)
-    topk = int(nms_topk) if nms_topk > 0 else min(n, 400)
+    # nms_topk caps the NMS candidate set only; the OUTPUT always carries
+    # all N anchor rows (suppressed rows -1) like the reference — no
+    # silent truncation to 400
+    topk = min(int(nms_topk), n) if nms_topk > 0 else n
 
     def one_sample(cp, lp):
         # class with best non-background prob per anchor
@@ -242,10 +271,14 @@ def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
         if clip:
             boxes = jnp.clip(boxes, 0.0, 1.0)
         score = jnp.where(score > threshold, score, 0.0)
-        # top-k by score then greedy NMS
-        order = jnp.argsort(-score)[:topk]
+        # sort all anchors by score; NMS runs on the top-k candidates,
+        # rows past the candidate cap are emitted suppressed (-1)
+        order = jnp.argsort(-score)
         sb, ss, si = boxes[order], score[order], cls_id[order]
-        keep = _greedy_nms_keep(sb, ss, si, nms_threshold, force_suppress)
+        keep = _greedy_nms_keep(sb[:topk], ss[:topk], si[:topk],
+                                nms_threshold, force_suppress)
+        if topk < n:
+            keep = jnp.concatenate([keep, jnp.zeros(n - topk, bool)])
         out = jnp.concatenate([si[:, None], ss[:, None], sb], axis=-1)
         return jnp.where(keep[:, None], out, -1.0)
 

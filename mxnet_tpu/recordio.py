@@ -88,15 +88,29 @@ class MXRecordIO:
     def tell(self):
         return self.record.tell()
 
+    # overridable so tests can exercise the chunked path without 512MB
+    _max_chunk = _LEN_MASK
+
     def write(self, buf: bytes):
         if self.flag != "w":
             raise MXNetError("not opened for writing")
-        # split on embedded magics is unnecessary when escaping via cflag;
-        # we write whole records (cflag=0) since length is explicit
-        header = struct.pack("<II", _MAGIC, len(buf) & _LEN_MASK)
+        # records longer than the 29-bit length field are chunk-chained
+        # (cflag 1 first / 2 middle / 3 last); read() rejoins them
+        if len(buf) <= self._max_chunk:
+            self._write_chunk(buf, 0)
+            return
+        off = 0
+        while off < len(buf):
+            n = min(len(buf) - off, self._max_chunk)
+            cflag = 1 if off == 0 else (3 if off + n == len(buf) else 2)
+            self._write_chunk(buf[off:off + n], cflag)
+            off += n
+
+    def _write_chunk(self, chunk: bytes, cflag: int):
+        header = struct.pack("<II", _MAGIC, (cflag << _CFLAG_BITS) | len(chunk))
         self.record.write(header)
-        self.record.write(buf)
-        self.record.write(b"\x00" * _pad4(len(buf)))
+        self.record.write(chunk)
+        self.record.write(b"\x00" * _pad4(len(chunk)))
 
     def read(self) -> Optional[bytes]:
         if self.flag != "r":
@@ -105,7 +119,10 @@ class MXRecordIO:
         while True:
             header = self.record.read(8)
             if len(header) < 8:
-                return None if not parts else b"".join(parts)
+                if parts:  # EOF inside a cflag chunk chain: corrupt file
+                    raise MXNetError(
+                        f"truncated chunked record at EOF in {self.uri}")
+                return None
             magic, lrecord = struct.unpack("<II", header)
             if magic != _MAGIC:
                 raise MXNetError(f"invalid record magic {magic:#x} in {self.uri}")

@@ -386,26 +386,37 @@ class Symbol:
 
     # ---- serialization (nnvm JSON-compatible layout) --------------------
     def tojson(self) -> str:
+        """Reference-format nnvm JSON: nodes carry ONLY op/name/attrs/inputs
+        (ref: nnvm Graph SaveJSON — num_outputs / aux-ness / shape hints are
+        never stored; loaders re-derive them from op schemas).  Attr values
+        are plain strings (``str(v)``) exactly as the reference writes them
+        ("(3, 3)", "64", "True", "relu"); shape hints ride in the
+        ``__shape__`` attr like reference variable nodes."""
         topo = self._topo()
         index = {id(n): i for i, n in enumerate(topo)}
         nodes = []
         for n in topo:
-            nodes.append({
+            attrs = {k: str(v) for k, v in n.attrs.items()}
+            if n.op is None and n.shape_hint:
+                attrs["__shape__"] = str(tuple(n.shape_hint))
+            spec = {
                 "op": "null" if n.op is None else n.op,
                 "name": n.name,
-                # symmetric encoding: every attr value json.dumps'd on save
-                # and json.loads'd on load, so a round trip preserves types
-                "attrs": {k: json.dumps(v) for k, v in n.attrs.items()},
                 "inputs": [[index[id(inp)], idx, 0] for (inp, idx) in n.inputs],
-                "num_outputs": n.num_outputs,
-                "is_aux": bool(n.is_aux),
-                "shape_hint": list(n.shape_hint) if n.shape_hint else None,
-            })
+            }
+            if attrs:
+                spec["attrs"] = attrs
+            nodes.append(spec)
+        # node_row_ptr: prefix sum of per-node output counts (nnvm IndexedGraph)
+        row_ptr = [0]
+        for n in topo:
+            row_ptr.append(row_ptr[-1] + n.num_outputs)
         return json.dumps({
             "nodes": nodes,
             "arg_nodes": [i for i, n in enumerate(topo) if n.op is None],
+            "node_row_ptr": row_ptr,
             "heads": [[index[id(n)], i, 0] for (n, i) in self._heads],
-            "attrs": {"mxnet_version": ["str", "mxnet_tpu"]},
+            "attrs": {"mxnet_version": ["int", 10700]},
         }, indent=2)
 
     def save(self, fname: str):
@@ -612,33 +623,67 @@ def Group(symbols: Sequence[Symbol]) -> Symbol:
     return Symbol(heads)
 
 
-def load_json(json_str: str) -> Symbol:
-    data = json.loads(json_str)
-
-    def _tuplify(v):
-        # JSON has no tuple; op attrs use tuples (kernel, stride, shape…)
-        if isinstance(v, list):
-            return tuple(_tuplify(x) for x in v)
+def _parse_attr_value(v):
+    """Reference attrs are strings ("(3, 3)", "64", "True", "relu"); parse
+    python literals, fall back to the raw string (the same contract the
+    reference's dmlc parameter parser implements per-op)."""
+    if not isinstance(v, str):
         return v
+    import ast
+
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def load_json(json_str: str) -> Symbol:
+    """Load reference-format nnvm JSON.  Nodes carry only op/name/attrs/
+    inputs (the genuine ``-symbol.json`` layout — attr key may also be
+    ``param``/``attr`` in older files; input entries may be 2- or 3-long);
+    num_outputs is re-derived from the op registry and aux-ness from
+    consumer schemas, exactly as nnvm re-derives them via FMutateInputs."""
+    data = json.loads(json_str)
 
     nodes: List[_Node] = []
     for spec in data["nodes"]:
-        attrs = {}
-        for k, v in spec.get("attrs", {}).items():
-            try:
-                attrs[k] = _tuplify(json.loads(v))
-            except (json.JSONDecodeError, TypeError):
-                attrs[k] = v
+        # legacy files split op params ("param") from user attrs ("attr");
+        # merge all three spellings, newest key winning
+        raw: Dict[str, Any] = {}
+        for key in ("param", "attr", "attrs"):
+            v = spec.get(key)
+            if v:
+                raw.update(v)
+        attrs = {k: _parse_attr_value(v) for k, v in raw.items()}
         if spec["op"] == "null":
+            shape_hint = attrs.pop("__shape__", None)
             node = _Node(None, spec["name"], attrs, [],
-                         is_aux=spec.get("is_aux", False),
-                         shape_hint=spec.get("shape_hint"))
+                         shape_hint=shape_hint)
         else:
-            inputs = [(nodes[i], idx) for (i, idx, _) in spec["inputs"]]
+            inputs = [(nodes[e[0]], e[1]) for e in spec["inputs"]]
+            # unknown ops still load (inspection: list_arguments, viz);
+            # they fail at bind time like the reference's deferred check
+            try:
+                nout = get_op(spec["op"]).nout(attrs)
+            except Exception:
+                nout = 1
             node = _Node(spec["op"], spec["name"], attrs, inputs,
-                         num_outputs=spec.get("num_outputs", 1))
+                         num_outputs=nout)
         nodes.append(node)
-    heads = [(nodes[i], idx) for (i, idx, _) in data["heads"]]
+
+    # aux-ness is structural: a variable feeding a schema aux slot
+    # (e.g. BatchNorm moving_mean/moving_var) is an auxiliary state
+    for node in nodes:
+        if node.op is None:
+            continue
+        schema = SCHEMAS.get(node.op)
+        if schema is None or not schema.aux:
+            continue
+        for (inp, _idx), nm in zip(node.inputs, schema.inputs):
+            if nm in schema.aux and inp.op is None:
+                inp.is_aux = True
+
+    heads = [(nodes[e[0]], e[1]) for e in data["heads"]]
     return Symbol(heads)
 
 

@@ -28,26 +28,47 @@ static size_t Pad4(size_t n) { return (4 - n % 4) % 4; }
 
 class RecordWriter {
  public:
-  explicit RecordWriter(const std::string& path) {
+  explicit RecordWriter(const std::string& path, size_t max_chunk = kLenMask)
+      : max_chunk_(max_chunk == 0 || max_chunk > kLenMask ? kLenMask
+                                                          : max_chunk) {
     f_ = std::fopen(path.c_str(), "wb");
     MXT_CHECK_MSG(f_ != nullptr, "cannot open for write: " + path);
   }
   ~RecordWriter() {
     if (f_) std::fclose(f_);
   }
-  // returns byte offset of the record start (for .idx sidecars)
+  // returns byte offset of the record start (for .idx sidecars).
+  // Records longer than the 29-bit length field are split into
+  // cflag-chained chunks (1 first / 2 middle / 3 last) that both readers
+  // rejoin transparently — no silent truncation at 2^29 bytes.
   int64_t Write(const char* buf, size_t len) {
     int64_t pos = std::ftell(f_);
-    uint32_t header[2] = {kMagic, static_cast<uint32_t>(len) & kLenMask};
-    std::fwrite(header, sizeof(uint32_t), 2, f_);
-    std::fwrite(buf, 1, len, f_);
-    static const char zeros[4] = {0, 0, 0, 0};
-    std::fwrite(zeros, 1, Pad4(len), f_);
+    if (len <= max_chunk_) {
+      WriteChunk(buf, len, 0);
+    } else {
+      size_t off = 0;
+      while (off < len) {
+        size_t n = len - off < max_chunk_ ? len - off : max_chunk_;
+        uint32_t cflag = off == 0 ? 1u : (off + n == len ? 3u : 2u);
+        WriteChunk(buf + off, n, cflag);
+        off += n;
+      }
+    }
     return pos;
   }
 
  private:
+  void WriteChunk(const char* buf, size_t len, uint32_t cflag) {
+    uint32_t header[2] = {
+        kMagic, (cflag << kCFlagBits) | static_cast<uint32_t>(len)};
+    std::fwrite(header, sizeof(uint32_t), 2, f_);
+    std::fwrite(buf, 1, len, f_);
+    static const char zeros[4] = {0, 0, 0, 0};
+    std::fwrite(zeros, 1, Pad4(len), f_);
+  }
+
   std::FILE* f_ = nullptr;
+  size_t max_chunk_;
 };
 
 class RecordReader {
@@ -68,7 +89,13 @@ class RecordReader {
     for (;;) {
       uint32_t header[2];
       size_t got = std::fread(header, sizeof(uint32_t), 2, f_);
-      if (got < 2) return !out->empty();
+      if (got < 2) {
+        // EOF inside a chunk chain means the file is corrupt — fail loud,
+        // never hand back a silently-shortened record
+        MXT_CHECK_MSG(out->empty(),
+                      "truncated chunked record at EOF in " + path_);
+        return false;
+      }
       MXT_CHECK_MSG(header[0] == kMagic,
                     "invalid record magic in " + path_);
       uint32_t cflag = header[1] >> kCFlagBits;
@@ -170,6 +197,13 @@ const char* MXGetLastError() { return mxt::LastError().c_str(); }
 int MXRecordIOWriterCreate(const char* path, void** out) {
   MXT_API_BEGIN();
   *out = new mxt::RecordWriter(path);
+  MXT_API_END();
+}
+
+// max_chunk below the 29-bit default exercises the chunked path in tests
+int MXRecordIOWriterCreateEx(const char* path, size_t max_chunk, void** out) {
+  MXT_API_BEGIN();
+  *out = new mxt::RecordWriter(path, max_chunk);
   MXT_API_END();
 }
 

@@ -180,3 +180,69 @@ def test_contrib_namespaces():
     assert a.shape == (1, 4, 4)
     s = contrib.sym.box_iou(mx.sym.var("a"), mx.sym.var("b"))
     assert s.list_arguments() == ["a", "b"]
+
+
+def test_multibox_detection_no_400_cap():
+    """Regression: output must carry ALL N anchor rows (reference shape
+    (B, N, 6)), not silently cap at min(N, 400)."""
+    n = 450
+    # non-overlapping tiny boxes on a grid -> NMS suppresses nothing
+    xs = (np.arange(n) % 30) / 30.0
+    ys = (np.arange(n) // 30) / 30.0
+    anchors = np.stack([xs, ys, xs + 0.02, ys + 0.02], -1)[None].astype("f4")
+    cls_prob = np.zeros((1, 2, n), "float32")
+    cls_prob[0, 0] = 0.1   # background
+    cls_prob[0, 1] = 0.9   # foreground, all above threshold
+    loc_pred = np.zeros((1, n * 4), "float32")
+    out = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                               nd.array(anchors)).asnumpy()[0]
+    assert out.shape == (n, 6)
+    assert (out[:, 0] >= 0).sum() == n  # every detection survives
+    # nms_topk still caps the candidate set (rows past it come back -1)
+    out2 = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                                nd.array(anchors), nms_topk=100).asnumpy()[0]
+    assert out2.shape == (n, 6)
+    assert (out2[:, 0] >= 0).sum() == 100
+
+
+def test_multibox_target_negative_mining_iou_gate():
+    """Regression: negative-mining eligibility is an IoU gate
+    (best_iou < negative_mining_thresh), not a background-loss gate."""
+    anchors = np.array([[[0.0, 0.1, 0.5, 0.6],    # B: IoU 1.0 with gt
+                         [0.0, 0.0, 0.5, 0.5],    # A: IoU ~0.667 with gt
+                         [0.8, 0.8, 1.0, 1.0]]],  # C: IoU 0
+                       "float32")
+    label = np.array([[[0, 0.0, 0.1, 0.5, 0.6]]], "float32")
+    # make A's background loss enormous (old loss-gate would keep it as a
+    # hard negative); C's background loss small
+    cls_pred = np.zeros((1, 2, 3), "float32")
+    cls_pred[0, 1, 1] = 20.0   # anchor A: huge fg logit -> tiny bg prob
+    bt, bm, ct = nd.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(cls_pred),
+        overlap_threshold=0.7, negative_mining_ratio=1.0,
+        negative_mining_thresh=0.5)
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 1.0   # B matched (class 0 -> target 1)
+    assert ct[1] == -1.0  # A: IoU 0.667 >= 0.5 -> ineligible, ignored
+    assert ct[2] == 0.0   # C: IoU 0 -> the one kept hard negative
+
+
+def test_multibox_target_bipartite_force_match():
+    """Regression: two gt boxes sharing a best anchor must be resolved by
+    sequential bipartite matching (deterministic), so BOTH gts end up
+    force-matched — the racy scatter could drop one."""
+    anchors = np.array([[[0.0, 0.0, 1.0, 1.0],      # A0
+                         [0.0, 0.0, 0.4, 1.0]]],    # A1
+                       "float32")
+    # both gts' best anchor is A0 (IoU 0.9 and 0.8)
+    label = np.array([[[1, 0.0, 0.0, 0.9, 1.0],
+                       [0, 0.0, 0.0, 0.8, 1.0]]], "float32")
+    cls_pred = np.zeros((1, 3, 2), "float32")
+    bt, bm, ct = nd.MultiBoxTarget(nd.array(anchors), nd.array(label),
+                                   nd.array(cls_pred),
+                                   overlap_threshold=0.95)
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 2.0  # A0 <- gt0 (class 1 -> 2): the global best pair
+    assert ct[1] == 1.0  # A1 <- gt1 (class 0 -> 1): second round
+    bm = bm.asnumpy()[0].reshape(2, 4)
+    assert bm.sum() == 8.0  # both anchors positive

@@ -180,3 +180,59 @@ def test_monitor_with_module():
     mod.forward(batch, is_train=True)
     stats = mon.toc()
     assert any("fc_weight" in k for (_, k, _) in stats)
+
+
+def test_recordio_chunked_large_records(tmp_path):
+    """Regression: records longer than the 29-bit length field must be
+    chunk-chained (cflag 1/2/3), not silently truncated.  A small
+    _max_chunk exercises the same code path without 512MB fixtures."""
+    from mxnet_tpu import recordio
+
+    path = str(tmp_path / "chunked.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w._max_chunk = 64
+    # payloads longer than the chunk size, incl. embedded magic bytes
+    magic = (0x3ED7230A).to_bytes(4, "little")
+    payloads = [b"x" * 200, magic * 50 + b"tail", b"short", b"y" * 64 * 3]
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+    r = recordio.MXRecordIO(path, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
+
+    # the native reader joins the same chunk chain
+    from mxnet_tpu import lib
+    if lib.available():
+        nr = lib.NativeRecordReader(path)
+        for p in payloads:
+            assert nr.read() == p
+        assert nr.read() is None
+        nr.close()
+
+
+def test_recordio_truncated_chunk_chain_raises(tmp_path):
+    """EOF mid-chunk-chain must fail loud, not hand back a partial record."""
+    from mxnet_tpu import lib, recordio
+    from mxnet_tpu.base import MXNetError
+
+    path = str(tmp_path / "trunc.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w._max_chunk = 16
+    w.write(b"z" * 50)  # 4 chunks: cflag 1,2,2,3
+    w.close()
+    # cut the file after the second chunk (2 * (8 + 16) bytes)
+    with open(path, "r+b") as f:
+        f.truncate(48)
+    r = recordio.MXRecordIO(path, "r")
+    with pytest.raises(MXNetError, match="truncated"):
+        r.read()
+    r.close()
+    if lib.available():
+        nr = lib.NativeRecordReader(path)
+        with pytest.raises(MXNetError, match="truncated"):
+            nr.read()
+        nr.close()

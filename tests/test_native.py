@@ -300,3 +300,29 @@ def test_runtime_reports_native():
 
     feats = runtime.Features()
     assert feats.is_enabled("NATIVE_ENGINE")
+
+
+def test_native_writer_chunked_records(tmp_path):
+    """Regression for the 29-bit length mask: the native writer chunk-chains
+    oversized records (cflag 1/2/3); both readers rejoin them."""
+    from mxnet_tpu import lib, recordio
+
+    path = str(tmp_path / "native_chunked.rec")
+    w = lib.NativeRecordWriter(path, max_chunk=32)
+    magic = (0x3ED7230A).to_bytes(4, "little")
+    payloads = [b"a" * 100, magic * 20, b"b" * 32 * 4, b"tiny"]
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+    nr = lib.NativeRecordReader(path)
+    for p in payloads:
+        assert nr.read() == p
+    assert nr.read() is None
+    nr.close()
+
+    pr = recordio.MXRecordIO(path, "r")
+    for p in payloads:
+        assert pr.read() == p
+    assert pr.read() is None
+    pr.close()

@@ -3,6 +3,8 @@
 Model: tests/python/unittest/test_symbol.py, test_executor.py,
 test_infer_shape.py in the reference.
 """
+import json
+
 import numpy as np
 import pytest
 
@@ -205,3 +207,97 @@ def test_embedding_symbol():
     arg_shapes, out_shapes, _ = emb.infer_shape(data=(3, 7))
     assert dict(zip(emb.list_arguments(), arg_shapes))["embed0_weight"] == (20, 5)
     assert out_shapes == [(3, 7, 5)]
+
+
+def test_load_reference_format_json():
+    """Regression: a GENUINE reference ``-symbol.json`` carries ONLY
+    op/name/attrs/inputs per node (attrs as plain strings, possibly under
+    the legacy ``param`` key) — num_outputs / aux-ness / shapes are never
+    stored and must be re-derived on load."""
+    ref_json = json.dumps({
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "null", "name": "conv_weight", "inputs": []},
+            {"op": "Convolution", "name": "conv",
+             "attrs": {"kernel": "(3, 3)", "num_filter": "8",
+                       "pad": "(1, 1)", "no_bias": "True"},
+             "inputs": [[0, 0, 0], [1, 0, 0]]},
+            {"op": "null", "name": "bn_gamma", "inputs": []},
+            {"op": "null", "name": "bn_beta", "inputs": []},
+            {"op": "null", "name": "bn_moving_mean", "inputs": []},
+            {"op": "null", "name": "bn_moving_var", "inputs": []},
+            {"op": "BatchNorm", "name": "bn",
+             # legacy key + legacy 2-long input entries
+             "param": {"eps": "0.001", "momentum": "0.9"},
+             "inputs": [[2, 0], [3, 0], [4, 0], [5, 0], [6, 0]]},
+            {"op": "relu", "name": "act", "inputs": [[7, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 3, 4, 5, 6],
+        "node_row_ptr": list(range(10)),
+        "heads": [[8, 0, 0]],
+        "attrs": {"mxnet_version": ["int", 10700]},
+    })
+    loaded = sym.load_json(ref_json)
+    # aux-ness re-derived from the BatchNorm schema, not from JSON fields
+    assert loaded.list_arguments() == ["data", "conv_weight", "bn_gamma",
+                                       "bn_beta"]
+    assert loaded.list_auxiliary_states() == ["bn_moving_mean",
+                                              "bn_moving_var"]
+    # attrs parsed from reference string form ("(3, 3)", "8", "True")
+    arg_shapes, out_shapes, aux_shapes = loaded.infer_shape(
+        data=(2, 3, 8, 8))
+    assert out_shapes == [(2, 8, 8, 8)]
+    assert aux_shapes == [(8,), (8,)]
+    # and it executes
+    ex = loaded.simple_bind(mx.cpu(), data=(2, 3, 8, 8))
+    ex.forward(data=np.random.randn(2, 3, 8, 8).astype("float32"))
+    assert ex.outputs[0].shape == (2, 8, 8, 8)
+
+
+def test_tojson_is_reference_format():
+    """Our own save must not leak non-reference node fields."""
+    out = _mlp()
+    data = json.loads(out.tojson())
+    for node in data["nodes"]:
+        assert set(node) <= {"op", "name", "attrs", "inputs"}
+        for v in node.get("attrs", {}).values():
+            assert isinstance(v, str)
+    assert "node_row_ptr" in data
+    # multi-output node round-trips via registry-derived num_outputs
+    x = sym.var("x")
+    s = sym.SliceChannel(x, num_outputs=3) if hasattr(sym, "SliceChannel") \
+        else None
+    if s is not None:
+        loaded = sym.load_json(s.tojson())
+        assert len(loaded.list_outputs()) == 3
+
+
+def test_load_json_merges_param_and_attr():
+    """Legacy nodes split op params ("param") from user attrs ("attr");
+    both must survive the load (e.g. __shape__ hints on variables)."""
+    ref_json = json.dumps({
+        "nodes": [
+            {"op": "null", "name": "x", "inputs": [],
+             "param": {}, "attr": {"__shape__": "(2, 5)"}},
+            {"op": "null", "name": "fc_weight", "inputs": []},
+            {"op": "null", "name": "fc_bias", "inputs": []},
+            {"op": "FullyConnected", "name": "fc",
+             "param": {"num_hidden": "3"}, "attr": {"__lr_mult__": "2.0"},
+             "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2],
+        "heads": [[3, 0, 0]],
+    })
+    loaded = sym.load_json(ref_json)
+    # __shape__ became the variable's hint; fc num_hidden parsed from param
+    arg_shapes, out_shapes, _ = loaded.infer_shape_partial()
+    assert out_shapes == [(2, 3)]
+    # unknown future op still loads for inspection, fails only at bind
+    alien = json.dumps({
+        "nodes": [{"op": "null", "name": "d", "inputs": []},
+                  {"op": "SomeFutureOp", "name": "f", "attrs": {},
+                   "inputs": [[0, 0, 0]]}],
+        "arg_nodes": [0], "heads": [[1, 0, 0]],
+    })
+    s2 = sym.load_json(alien)
+    assert s2.list_arguments() == ["d"]
