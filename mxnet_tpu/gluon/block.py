@@ -190,7 +190,7 @@ class _PureNamespace:
                          running_var, eps=eps, momentum=momentum,
                          fix_gamma=fix_gamma,
                          use_global_stats=use_global_stats, axis=axis,
-                         _train=train)
+                         _train=train, **kw)
         if train:
             out, new_mean, new_var = res
             if ts is not None and _aux_params is not None:

@@ -49,7 +49,8 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
     train = autograd.is_training() and not use_global_stats
     res = invoke("BatchNorm", data, gamma, beta, moving_mean, moving_var,
                  eps=eps, momentum=momentum, fix_gamma=fix_gamma,
-                 use_global_stats=use_global_stats, axis=axis, _train=train)
+                 use_global_stats=use_global_stats, axis=axis, _train=train,
+                 **kw)
     if train:
         out, new_mean, new_var = res
         moving_mean._data = new_mean._data

@@ -187,30 +187,89 @@ def _bn_nout(attrs):
     return 3 if attrs.get("_train", False) else 1
 
 
+def _bn_exact_var_default() -> bool:
+    # read once per process: the compiled-op cache is keyed on attrs, so a
+    # mid-process env flip could not take effect anyway.  Per-call control
+    # is the explicit `exact_var` attr.
+    from ..base import get_env
+
+    return get_env("MXNET_BN_EXACT_VAR", False, bool)
+
+
+_BN_EXACT_VAR = None  # resolved lazily so base import order doesn't matter
+
+
 @register_op("BatchNorm", aliases=("batch_norm",), num_outputs=_bn_nout)
 def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
                 momentum=0.9, fix_gamma=False, use_global_stats=False,
-                output_mean_var=False, axis=1, cudnn_off=False, _train=False):
+                output_mean_var=False, axis=1, cudnn_off=False, _train=False,
+                exact_var=None):
     shape = [1] * data.ndim
     shape[axis] = data.shape[axis]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
-    # mixed precision: stats/affine in the (fp32) stat dtype, output back in
-    # the activation dtype so bf16 stays bf16 through the net
+    # mixed-precision HBM discipline: the big tensor is touched ONLY in its
+    # own (bf16) dtype — stats accumulate in the fp32 stat dtype inside the
+    # reduction (convert fused into the reduce, nothing materialized), and
+    # the normalize is a C-sized fp32 scale/bias precomputed once then
+    # applied as one bf16 fused multiply-add.  An fp32 activation copy
+    # would double the dominant HBM traffic of conv nets.
     odtype = data.dtype
-    x = data.astype(moving_mean.dtype)
+    sdt = moving_mean.dtype
+
+    def apply_affine(mean, var):
+        # C-sized fp32 coefficients; the per-element convert→fma→convert
+        # happens in-register inside one fusion (bf16 in, bf16 out)
+        scale = g.astype(sdt) * lax.rsqrt(var + eps)
+        bias = beta.astype(sdt) - mean * scale
+        return (data.astype(sdt) * scale.reshape(shape)
+                + bias.reshape(shape)).astype(odtype)
+
     if _train and not use_global_stats:
         red = tuple(i for i in range(data.ndim) if i != axis)
-        mean = jnp.mean(x, axis=red)
-        var = jnp.var(x, axis=red)
-        out = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + eps)
-        out = out * g.reshape(shape) + beta.reshape(shape)
         n = np.prod([data.shape[i] for i in red])
+        # two reduction passes, both reading x ONLY in bf16 with the
+        # convert/center/square fused into the reduce input: mean first,
+        # then centered variance.  E[x²]−mean² would save nothing (XLA
+        # runs the two reduces as separate passes either way — measured)
+        # and catastrophically cancels for large-mean channels; a
+        # variadic lax.reduce computing both in one op measured 6x
+        # slower (only monoid reduces hit XLA's fast tiled emitter).
+        global _BN_EXACT_VAR
+        if _BN_EXACT_VAR is None:
+            _BN_EXACT_VAR = _bn_exact_var_default()
+        exact = _BN_EXACT_VAR if exact_var is None else bool(exact_var)
+        s1 = jnp.sum(data, axis=red, dtype=sdt)
+        mean = s1 / n
+        if exact:
+            # exact two-pass centering: the second reduce depends on the
+            # first, so XLA cannot sibling-fuse them into one HBM read —
+            # one extra pass over x (~9% on the ResNet-50 bench)
+            xc = data.astype(sdt) - mean.reshape(shape)
+            var = jnp.sum(xc * xc, axis=red) / n
+        else:
+            # SINGLE-pass stats (default): var = E[(x−c)²] − (mean−c)²
+            # shifted by the running mean.  Both reduces are independent
+            # reads of x, so XLA sibling-fuses them into ONE pass.  The
+            # shift cancellation is negligible whenever stats are warm or
+            # activations are roughly centered (any realistic training);
+            # the relative floor bounds the one cold pathological case
+            # (fresh zero stats + |mean| >> std) instead of letting
+            # rsqrt blow up.  MXNET_BN_EXACT_VAR=1 selects the exact
+            # path.  Other one-pass routes measured on-chip and rejected:
+            # variadic lax.reduce (6× slower, off the fast reduce path),
+            # subsample-estimated shift (10× — broke reduce fusion).
+            c = lax.stop_gradient(moving_mean.astype(sdt))
+            d = data.astype(sdt) - c.reshape(shape)
+            s2 = jnp.sum(d * d, axis=red)
+            dm = mean - c
+            raw = s2 / n
+            var = jnp.maximum(raw - dm * dm, 1e-6 * raw)
+        out = apply_affine(mean, var)
         unbiased = var * (n / max(n - 1, 1))
         new_mean = momentum * moving_mean + (1 - momentum) * mean
         new_var = momentum * moving_var + (1 - momentum) * unbiased
-        return out.astype(odtype), new_mean, new_var
-    out = (x - moving_mean.reshape(shape)) * lax.rsqrt(moving_var.reshape(shape) + eps)
-    return (out * g.reshape(shape) + beta.reshape(shape)).astype(odtype)
+        return out, new_mean, new_var
+    return apply_affine(moving_mean, moving_var)
 
 
 @register_op("LayerNorm", aliases=("layer_norm",))

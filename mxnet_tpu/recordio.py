@@ -129,6 +129,10 @@ class MXRecordIO:
             cflag = lrecord >> _CFLAG_BITS
             length = lrecord & _LEN_MASK
             data = self.record.read(length)
+            if len(data) != length:  # truncated payload: fail loud
+                raise MXNetError(
+                    f"truncated record payload in {self.uri} "
+                    f"(expected {length} bytes, got {len(data)})")
             self.record.read(_pad4(length))
             parts.append(data)
             if cflag in (0, 3):  # whole record or last chunk

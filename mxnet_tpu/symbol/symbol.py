@@ -623,18 +623,32 @@ def Group(symbols: Sequence[Symbol]) -> Symbol:
     return Symbol(heads)
 
 
+_JSON_LITERALS = {"true": True, "false": False, "null": None}
+
+
 def _parse_attr_value(v):
     """Reference attrs are strings ("(3, 3)", "64", "True", "relu"); parse
     python literals, fall back to the raw string (the same contract the
-    reference's dmlc parameter parser implements per-op)."""
+    reference's dmlc parameter parser implements per-op).  JSON-spelled
+    booleans/null are accepted too — files saved by this library before
+    the reference-format switch encoded attrs via json.dumps."""
     if not isinstance(v, str):
         return v
+    if v in _JSON_LITERALS:
+        return _JSON_LITERALS[v]
     import ast
 
     try:
-        return ast.literal_eval(v)
+        val = ast.literal_eval(v)
     except (ValueError, SyntaxError):
         return v
+
+    def _tuplify(x):  # JSON lists -> tuples (op attrs must be hashable)
+        if isinstance(x, list):
+            return tuple(_tuplify(i) for i in x)
+        return x
+
+    return _tuplify(val)
 
 
 def load_json(json_str: str) -> Symbol:
@@ -656,7 +670,10 @@ def load_json(json_str: str) -> Symbol:
                 raw.update(v)
         attrs = {k: _parse_attr_value(v) for k, v in raw.items()}
         if spec["op"] == "null":
-            shape_hint = attrs.pop("__shape__", None)
+            # legacy pre-reference-format files stored the hint as a
+            # top-level node field instead of the __shape__ attr
+            shape_hint = attrs.pop("__shape__", None) \
+                or spec.get("shape_hint")
             node = _Node(None, spec["name"], attrs, [],
                          shape_hint=shape_hint)
         else:

@@ -236,3 +236,23 @@ def test_recordio_truncated_chunk_chain_raises(tmp_path):
         with pytest.raises(MXNetError, match="truncated"):
             nr.read()
         nr.close()
+
+
+def test_recordio_truncated_final_chunk_payload(tmp_path):
+    """Truncation inside a chunk PAYLOAD (not between chunks) must also
+    raise, matching the native reader."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.base import MXNetError
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w._max_chunk = 16
+    w.write(b"q" * 50)
+    w.close()
+    import os
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 3)
+    r = recordio.MXRecordIO(path, "r")
+    import pytest as _pytest
+    with _pytest.raises(MXNetError, match="truncated"):
+        r.read()
+    r.close()

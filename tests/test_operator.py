@@ -460,3 +460,81 @@ def test_clip_cast_copy():
     b = nd.array(a)
     c = nd.identity(b)
     assert_almost_equal(_np(c), a)
+
+
+def test_batchnorm_large_mean_stability():
+    """Regression: train-mode variance must not catastrophically cancel
+    for channels with mean >> std.  Warm running stats (the realistic
+    fine-tune/large-mean case) must be handled by the default single-pass
+    shifted formula; MXNET_BN_EXACT_VAR=1 must be exact even with cold
+    (zero) running stats."""
+    rng = np.random.RandomState(3)
+    x = (rng.randn(4, 8, 6, 6) * 0.1 + 1000.0).astype("float32")
+    gamma = np.ones(8, "float32"); beta = np.zeros(8, "float32")
+    mm = np.full(8, 999.0, "float32"); mv = np.ones(8, "float32")
+    mmv, mvv = mx.nd.array(mm), mx.nd.array(mv)
+    with mx.autograd.record():
+        out = mx.nd.BatchNorm(
+            mx.nd.array(x), mx.nd.array(gamma), mx.nd.array(beta),
+            mmv, mvv, momentum=0.0)
+    o = out.asnumpy()
+    # per-channel output must be ~N(0,1)
+    assert abs(o.mean()) < 1e-2
+    assert abs(o.std() - 1.0) < 5e-2, o.std()
+    # new running var ~ true var (0.01), not garbage
+    assert np.allclose(mvv.asnumpy(), 0.01, rtol=0.3), mvv.asnumpy()
+
+
+def test_batchnorm_cold_stats_exact_var():
+    """With exact_var=1 (or process-level MXNET_BN_EXACT_VAR=1) the
+    variance is exact even for the cold pathological case: fresh zero
+    running stats + mean >> std."""
+    rng = np.random.RandomState(4)
+    x = (rng.randn(4, 8, 6, 6) * 0.1 + 1000.0).astype("float32")
+    gamma = np.ones(8, "float32"); beta = np.zeros(8, "float32")
+    mmv = mx.nd.zeros(8); mvv = mx.nd.ones(8)
+    with mx.autograd.record():
+        out = mx.nd.BatchNorm(
+            mx.nd.array(x), mx.nd.array(gamma), mx.nd.array(beta),
+            mmv, mvv, momentum=0.0, exact_var=1)
+    o = out.asnumpy()
+    assert abs(o.mean()) < 1e-2
+    assert abs(o.std() - 1.0) < 5e-2, o.std()
+    assert np.allclose(mvv.asnumpy(), 0.01, rtol=0.3), mvv.asnumpy()
+
+
+def test_batchnorm_cold_stats_default_bounded():
+    """Default single-pass path with cold stats + huge mean: variance may
+    be imprecise but the output must stay BOUNDED (no rsqrt explosion) and
+    the running mean must still be exact."""
+    rng = np.random.RandomState(5)
+    x = (rng.randn(4, 8, 6, 6) * 0.1 + 1000.0).astype("float32")
+    gamma = np.ones(8, "float32"); beta = np.zeros(8, "float32")
+    mmv = mx.nd.zeros(8); mvv = mx.nd.ones(8)
+    with mx.autograd.record():
+        out = mx.nd.BatchNorm(
+            mx.nd.array(x), mx.nd.array(gamma), mx.nd.array(beta),
+            mmv, mvv, momentum=0.0)
+    o = out.asnumpy()
+    assert np.isfinite(o).all()
+    assert abs(o).max() < 10.0, abs(o).max()  # relative floor bounds scale
+    assert np.allclose(mmv.asnumpy(), x.mean(axis=(0, 2, 3)), rtol=1e-4)
+
+
+def test_batchnorm_exact_var_env(monkeypatch):
+    """MXNET_BN_EXACT_VAR=1 flips the process-level default (resolved
+    lazily into ops.nn._BN_EXACT_VAR and baked into compiled attrs)."""
+    import mxnet_tpu.ops.nn as nnops
+    monkeypatch.setenv("MXNET_BN_EXACT_VAR", "1")
+    monkeypatch.setattr(nnops, "_BN_EXACT_VAR", None)
+    rng = np.random.RandomState(6)
+    # distinct shape: the executable cache is keyed per attrs+shape
+    x = (rng.randn(3, 5, 7, 7) * 0.1 + 1000.0).astype("float32")
+    mmv = mx.nd.zeros(5); mvv = mx.nd.ones(5)
+    with mx.autograd.record():
+        out = mx.nd.BatchNorm(
+            mx.nd.array(x), mx.nd.array(np.ones(5, "f4")),
+            mx.nd.array(np.zeros(5, "f4")), mmv, mvv, momentum=0.0)
+    assert abs(out.asnumpy().std() - 1.0) < 5e-2
+    assert np.allclose(mvv.asnumpy(), 0.01, rtol=0.3)
+    monkeypatch.setattr(nnops, "_BN_EXACT_VAR", None)  # restore lazy default

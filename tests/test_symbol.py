@@ -301,3 +301,29 @@ def test_load_json_merges_param_and_attr():
     })
     s2 = sym.load_json(alien)
     assert s2.list_arguments() == ["d"]
+
+
+def test_load_json_legacy_encoding():
+    """Files saved before the reference-format switch used json.dumps attr
+    values ("false", "[3, 3]") and a top-level shape_hint field — they
+    must still load correctly."""
+    legacy = json.dumps({
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": [],
+             "shape_hint": [2, 3, 8, 8]},
+            {"op": "null", "name": "c_weight", "inputs": []},
+            {"op": "null", "name": "c_bias", "inputs": []},
+            {"op": "Convolution", "name": "c",
+             "attrs": {"kernel": "[3, 3]", "num_filter": "4",
+                       "pad": "[1, 1]", "no_bias": "false"},
+             "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2],
+        "heads": [[3, 0, 0]],
+    })
+    loaded = sym.load_json(legacy)
+    # no_bias "false" -> False (bias stays an argument)
+    assert "c_bias" in loaded.list_arguments()
+    arg_shapes, out_shapes, _ = loaded.infer_shape_partial()
+    assert out_shapes == [(2, 4, 8, 8)]
+

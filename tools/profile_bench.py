@@ -1,0 +1,145 @@
+"""Capture + analyze an xplane trace of the ResNet-50 bench train step.
+
+Writes a per-op-category device-time breakdown (the MFU analysis VERDICT
+round 2 asked for).  Usage:
+    python tools/profile_bench.py [--batch-size 256] [--steps 5] [--out DIR]
+Parses the xplane.pb with tensorflow's proto (no tensorboard needed).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+from collections import defaultdict
+
+
+def capture(args) -> str:
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet50_v1(classes=1000, layout=args.layout)
+    net.initialize(mx.initializer.Xavier(magnitude=2.0), ctx=mx.cpu())
+    with mx.autograd.pause():
+        shape = ((1, 3, 32, 32) if args.layout == "NCHW" else (1, 32, 32, 3))
+        net(mx.nd.zeros(shape, ctx=mx.cpu()))
+    if args.dtype != "float32":
+        net.cast(args.dtype)
+
+    rng = np.random.RandomState(0)
+    ishape = ((args.batch_size, 3, args.image_size, args.image_size)
+              if args.layout == "NCHW"
+              else (args.batch_size, args.image_size, args.image_size, 3))
+    images = rng.rand(*ishape).astype(args.dtype)
+    labels = rng.randint(0, 1000, size=(args.batch_size,)).astype(np.int32)
+
+    mesh = parallel.make_mesh(dp=1)
+    with mesh:
+        trainer = parallel.SPMDTrainer(
+            net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+        images = trainer._place(images, None)
+        labels = trainer._place(labels, None)
+        for _ in range(3):
+            loss = trainer.step(images, labels)
+        loss.asnumpy()
+
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            loss = trainer.step(images, labels)
+        loss.asnumpy()
+        dt = time.perf_counter() - t0
+        print(f"throughput: {args.batch_size*args.steps/dt:.1f} img/s "
+              f"({dt/args.steps*1e3:.1f} ms/step)")
+
+        os.makedirs(args.out, exist_ok=True)
+        with jax.profiler.trace(args.out):
+            for _ in range(args.steps):
+                loss = trainer.step(images, labels)
+            loss.asnumpy()
+    return args.out
+
+
+CATEGORIES = [
+    ("conv", re.compile(r"convolution|conv", re.I)),
+    ("matmul", re.compile(r"dot|einsum", re.I)),
+    ("allreduce/collective", re.compile(r"all-reduce|all-gather|collective|reduce-scatter", re.I)),
+    ("reduce_window(pool)", re.compile(r"reduce-window|select-and-scatter", re.I)),
+    ("fusion(elementwise)", re.compile(r"^(loop_)?fusion", re.I)),
+    ("copy/transpose", re.compile(r"copy|transpose|bitcast", re.I)),
+    ("reduce(BN stats etc)", re.compile(r"^reduce", re.I)),
+    ("infeed/outfeed/host", re.compile(r"infeed|outfeed|host", re.I)),
+]
+
+
+def analyze(logdir: str, steps: int):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    pbs = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
+    if not pbs:
+        print("no xplane.pb found under", logdir)
+        return
+    pb = max(pbs, key=os.path.getmtime)
+    xs = xplane_pb2.XSpace()
+    with open(pb, "rb") as f:
+        xs.ParseFromString(f.read())
+
+    for plane in xs.planes:
+        if "TPU" not in plane.name and "tpu" not in plane.name.lower():
+            continue
+        ev_meta = plane.event_metadata
+        op_time = defaultdict(int)
+        total = 0
+        # device planes: one line per core-unit; XLA op events carry metadata
+        for line in plane.lines:
+            if "step" in line.name.lower():
+                continue
+            for ev in line.events:
+                name = ev_meta[ev.metadata_id].name
+                dur = ev.duration_ps
+                op_time[name] += dur
+                total += dur
+        if not op_time:
+            continue
+        print(f"\n=== plane: {plane.name} (total device-op time "
+              f"{total/1e12*1e3:.1f} ms over {steps} steps) ===")
+        cat_time = defaultdict(int)
+        for name, t in op_time.items():
+            for cat, pat in CATEGORIES:
+                if pat.search(name):
+                    cat_time[cat] += t
+                    break
+            else:
+                cat_time["other"] += t
+        for cat, t in sorted(cat_time.items(), key=lambda kv: -kv[1]):
+            print(f"  {cat:26s} {t/1e12*1e3/steps:8.2f} ms/step  "
+                  f"{100*t/total:5.1f}%")
+        print("  top 15 individual ops:")
+        for name, t in sorted(op_time.items(), key=lambda kv: -kv[1])[:15]:
+            print(f"    {t/1e12*1e3/steps:8.3f} ms/step  {name[:90]}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--layout", default="NHWC")
+    ap.add_argument("--out", default="/tmp/xprof_bench")
+    ap.add_argument("--analyze-only", action="store_true")
+    args = ap.parse_args()
+    if not args.analyze_only:
+        capture(args)
+    analyze(args.out, args.steps)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
