@@ -1,0 +1,144 @@
+"""End-to-end ImageNet-format training: im2rec shards -> native decode
+pipeline -> SPMDTrainer ResNet-50 (BASELINE.md config 2's real-data path).
+
+Counterpart of the reference's
+example/image-classification/train_imagenet.py: data arrives as
+RecordIO shards produced by tools/im2rec.py, is decoded+augmented by the
+native C++ pipeline (src/image_pipeline.cc), and feeds the one-program
+SPMD train step.
+
+With --synthetic-data it first builds a small fake ImageNet tree (N
+classes x M images) and packs it through the real im2rec path, so the
+whole flow is runnable anywhere:
+
+    python examples/imagenet_train.py --synthetic-data --epochs 2
+
+Point --rec-prefix at real ImageNet shards for the full run:
+
+    python tools/im2rec.py imagenet /data/imagenet/train --list --recursive
+    python tools/im2rec.py imagenet /data/imagenet/train --resize 256 \
+        --num-thread 16
+    python examples/imagenet_train.py --rec-prefix imagenet \
+        --batch-size 256 --image-size 224
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import parallel  # noqa: E402
+from mxnet_tpu.gluon import loss as gloss  # noqa: E402
+from mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
+from mxnet_tpu.io import ImageRecordIter  # noqa: E402
+
+
+def make_synthetic_imagenet(root: str, classes: int, per_class: int,
+                            size: int) -> None:
+    import cv2
+
+    rng = np.random.RandomState(0)
+    for c in range(classes):
+        d = os.path.join(root, f"class_{c:03d}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            img = rng.randint(0, 255, (size, size, 3), np.uint8)
+            img = cv2.GaussianBlur(img, (5, 5), 2)
+            cv2.imwrite(os.path.join(d, f"{i}.jpg"), img,
+                        [cv2.IMWRITE_JPEG_QUALITY, 90])
+
+
+def pack_with_im2rec(prefix: str, root: str, resize: int) -> None:
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "im2rec.py")
+    for extra in (["--list", "--recursive", "--shuffle"],
+                  ["--resize", str(resize), "--num-thread",
+                   str(os.cpu_count() or 1)]):
+        r = subprocess.run([sys.executable, tool, prefix, root] + extra,
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(f"im2rec failed: {r.stderr[-2000:]}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rec-prefix", default=None,
+                    help="prefix of .rec/.idx shards (from tools/im2rec.py)")
+    ap.add_argument("--synthetic-data", action="store_true",
+                    help="build + pack a small fake ImageNet tree first")
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--per-class", type=int, default=32)
+    ap.add_argument("--image-size", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--preprocess-threads", type=int,
+                    default=os.cpu_count() or 1)
+    args = ap.parse_args()
+
+    prefix = args.rec_prefix
+    if args.synthetic_data or prefix is None:
+        tmp = tempfile.mkdtemp(prefix="imagenet_synth_")
+        root = os.path.join(tmp, "train")
+        os.makedirs(root)
+        print(f"building synthetic ImageNet tree under {root} ...")
+        make_synthetic_imagenet(root, args.classes, args.per_class,
+                                args.image_size + 32)
+        prefix = os.path.join(tmp, "synth")
+        pack_with_im2rec(prefix, root, args.image_size + 16)
+    rec, idx = prefix + ".rec", prefix + ".idx"
+
+    hw = args.image_size
+    train_iter = ImageRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, hw, hw),
+        batch_size=args.batch_size, shuffle=True, rand_crop=True,
+        rand_mirror=True, resize=hw + 8,
+        mean_r=123.68, mean_g=116.78, mean_b=103.94,
+        std_r=58.4, std_g=57.12, std_b=57.38,
+        preprocess_threads=args.preprocess_threads)
+    engaged = "native C++ pipeline" if train_iter._pipe is not None \
+        else "python decode path"
+    print(f"data pipeline: {engaged}")
+
+    net = vision.resnet50_v1(classes=args.classes, layout="NHWC")
+    net.initialize(mx.initializer.Xavier(magnitude=2.0), ctx=mx.cpu())
+    with mx.autograd.pause():
+        net(mx.nd.zeros((1, 32, 32, 3), ctx=mx.cpu()))
+    if args.dtype != "float32":
+        net.cast(args.dtype)
+
+    mesh = parallel.make_mesh(dp=1)
+    with mesh:
+        trainer = parallel.SPMDTrainer(
+            net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": args.lr, "momentum": 0.9, "wd": 1e-4})
+        for epoch in range(args.epochs):
+            t0 = time.time()
+            n, loss = 0, None
+            train_iter.reset()
+            for batch in train_iter:
+                # NCHW float from the pipeline -> NHWC for the TPU net
+                x = batch.data[0].asnumpy().transpose(0, 2, 3, 1)
+                y = batch.label[0].asnumpy().astype(np.int32)
+                loss = trainer.step(x.astype(args.dtype), y)
+                n += x.shape[0] - batch.pad
+            lval = float(loss.asnumpy())
+            dt = time.time() - t0
+            print(f"epoch {epoch}: {n} images, {n / dt:.1f} img/s "
+                  f"end-to-end, loss {lval:.4f}")
+        assert np.isfinite(lval)
+    print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,37 +81,61 @@ class DataLoader:
         yield from self._threaded_iter()
 
     def _threaded_iter(self):
-        """Prefetching iterator: worker threads assemble batches ahead
-        (counterpart of the reference's PrefetcherIter double-buffering)."""
+        """Prefetching iterator with N REAL worker threads (reference
+        semantics: num_workers parallel batch producers).  Workers pull
+        batch indices from a shared queue and publish into a reorder
+        buffer keyed by batch position, so results stream strictly in
+        sampler order; numpy/cv2/TF decode inside `__getitem__` releases
+        the GIL, which is where the parallelism pays."""
         batches = list(self._batch_sampler)
-        out_q: "queue.Queue" = queue.Queue(maxsize=max(self._prefetch, 2))
+        n_workers = self._num_workers
+        window = max(self._prefetch, n_workers, 2)  # in-flight bound
+        task_q: "queue.Queue" = queue.Queue()
+        done: dict = {}
+        done_cv = threading.Condition()
         stop = threading.Event()
 
-        def producer():
-            try:
-                for indices in batches:
-                    if stop.is_set():
-                        return
-                    out_q.put(("ok", self._make_batch(indices)))
-                out_q.put(("done", None))
-            except BaseException as e:  # propagate to consumer
-                out_q.put(("err", e))
+        def worker():
+            while True:
+                item = task_q.get()
+                if item is None or stop.is_set():  # sentinel: shut down
+                    return
+                pos, indices = item
+                try:
+                    result = ("ok", self._make_batch(indices))
+                except BaseException as e:  # propagate to consumer
+                    result = ("err", e)
+                with done_cv:
+                    done[pos] = result
+                    done_cv.notify_all()
 
-        threads = [threading.Thread(target=producer, daemon=True)]
-        # single producer keeps order; extra workers would need reordering —
-        # the native pipeline (src/io) owns the truly parallel path
+        next_submit = min(window, len(batches))
+        for pos in range(next_submit):  # seed the prefetch window
+            task_q.put((pos, batches[pos]))
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(n_workers)]
         for t in threads:
             t.start()
         try:
-            while True:
-                kind, payload = out_q.get(timeout=self._timeout)
-                if kind == "done":
-                    return
+            for pos in range(len(batches)):
+                with done_cv:
+                    ok = done_cv.wait_for(lambda: pos in done,
+                                          timeout=self._timeout)
+                    if not ok:
+                        raise MXNetError(
+                            f"DataLoader worker timed out after "
+                            f"{self._timeout}s (batch {pos})")
+                    kind, payload = done.pop(pos)
                 if kind == "err":
                     raise payload
+                if next_submit < len(batches):  # top up the window
+                    task_q.put((next_submit, batches[next_submit]))
+                    next_submit += 1
                 yield payload
         finally:
             stop.set()
+            for _ in threads:
+                task_q.put(None)
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -312,7 +312,7 @@ class ImageRecordIter(DataIter):
                  shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, rand_crop=False,
                  rand_mirror=False, resize=-1, path_imgidx=None,
-                 round_batch=True, preprocess_threads=4, **kwargs):
+                 round_batch=True, preprocess_threads=4, seed=0, **kwargs):
         super().__init__(batch_size)
         from .. import recordio
         from ..image import imdecode
@@ -325,16 +325,34 @@ class ImageRecordIter(DataIter):
         self.resize = resize
         self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
         self.std = np.array([std_r, std_g, std_b], np.float32)
+        from .. import lib as _native
+
+        # FAST PATH: the C++ image pipeline (src/image_pipeline.cc) —
+        # `preprocess_threads` decode workers on the N1 engine, shuffle via
+        # the .idx sidecar, mean/std applied natively (f32 NCHW out).
+        self._pipe = None
+        self._stream = None
+        self._records: List[bytes] = []
+        self._order = None
+        if _native.image_available() and (not shuffle or path_imgidx):
+            c, h, w = self.data_shape
+            self._pipe = _native.NativeImagePipeline(
+                path_imgrec, path_imgidx,
+                batch=batch_size, channels=c, height=h, width=w,
+                label_width=label_width, resize_short=resize,
+                rand_crop=rand_crop, rand_mirror=rand_mirror,
+                shuffle=shuffle, normalize=True,
+                mean_r=mean_r, mean_g=mean_g, mean_b=mean_b,
+                std_r=std_r, std_g=std_g, std_b=std_b,
+                threads=preprocess_threads, seed=seed)
+            self.cursor = 0
+            self._epoch_count = None
+            return
         # native streaming path (C++ prefetch reader, CS6's ThreadedIter
         # role) when no shuffling is needed; otherwise load into memory for
         # random access
-        from .. import lib as _native
-
-        self._stream = None
         if not shuffle and _native.available():
             self._stream = _native.NativePrefetchReader(path_imgrec)
-            self._records: List[bytes] = []
-            self._order = None
         else:
             rec = recordio.MXRecordIO(path_imgrec, "r")
             self._records = []
@@ -362,6 +380,10 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", shape)]
 
     def reset(self):
+        if self._pipe is not None:
+            self._pipe.reset()
+            self.cursor = 0
+            return
         if self._stream is not None:
             self._stream.reset()
         if self.shuffle:
@@ -397,6 +419,17 @@ class ImageRecordIter(DataIter):
         return self._records[self._order[self.cursor + self._batch_pos]]
 
     def next(self) -> DataBatch:
+        if self._pipe is not None:
+            res = self._pipe.next()
+            if res is None:
+                raise StopIteration
+            data, label, pad = res
+            lab = label[:, 0] if self.label_width == 1 else label
+            self.cursor += self.batch_size - pad
+            return DataBatch([nd.array(data, ctx=cpu())],
+                             [nd.array(lab, ctx=cpu())], pad=pad,
+                             provide_data=self.provide_data,
+                             provide_label=self.provide_label)
         if self._epoch_count is not None and \
                 self.cursor >= self._epoch_count and self._stream is not None:
             raise StopIteration

@@ -25,63 +25,101 @@ _BUILD = os.path.join(_REPO, "build")
 _SO = os.path.join(_BUILD, "libmxnet_tpu_native.so")
 
 _lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_tried = False
 
 EngineFnType = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+# image_pipeline.cc links OpenCV and builds into its own .so (see below) —
+# the core library must stay dependency-free
+_CORE_EXCLUDE = {"image_pipeline.cc"}
 
 
 def _sources() -> List[str]:
     return sorted(
-        os.path.join(_SRC, f) for f in os.listdir(_SRC) if f.endswith(".cc"))
+        os.path.join(_SRC, f) for f in os.listdir(_SRC)
+        if f.endswith(".cc") and f not in _CORE_EXCLUDE)
 
 
-def _needs_build() -> bool:
-    if not os.path.exists(_SO):
-        return True
-    so_mtime = os.path.getmtime(_SO)
-    deps = _sources() + [os.path.join(_SRC, f) for f in os.listdir(_SRC)
-                         if f.endswith(".h")]
-    return any(os.path.getmtime(p) > so_mtime for p in deps)
+def _img_sources() -> List[str]:
+    return [os.path.join(_SRC, "image_pipeline.cc"),
+            os.path.join(_SRC, "engine.cc")]
 
 
-def _build() -> None:
-    os.makedirs(_BUILD, exist_ok=True)
-    cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-pthread",
-           "-Wall", "-o", _SO] + _sources()
-    proc = subprocess.run(cmd, capture_output=True, text=True)
-    if proc.returncode != 0:
-        raise MXNetError(
-            f"native build failed:\n{' '.join(cmd)}\n{proc.stderr[-4000:]}")
+class _NativeLib:
+    """One build-on-demand ctypes library: mtime staleness check, g++
+    fallback build, env gate, double-checked-lock load, error ring."""
+
+    def __init__(self, so_name: str, sources_fn, extra_flags: List[str],
+                 err_sym: str, what: str):
+        self.so_path = os.path.join(_BUILD, so_name)
+        self._sources_fn = sources_fn
+        self._flags = extra_flags
+        self._err_sym = err_sym
+        self._what = what
+        self._lib: Optional[ctypes.CDLL] = None
+        self._tried = False
+
+    def _needs_build(self) -> bool:
+        if not os.path.exists(self.so_path):
+            return True
+        mtime = os.path.getmtime(self.so_path)
+        deps = self._sources_fn() + [
+            os.path.join(_SRC, f) for f in os.listdir(_SRC)
+            if f.endswith(".h")]
+        return any(os.path.getmtime(p) > mtime for p in deps)
+
+    def _build(self) -> None:
+        os.makedirs(_BUILD, exist_ok=True)
+        cmd = (["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-pthread",
+                "-Wall", "-o", self.so_path] + self._sources_fn() +
+               self._flags)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise MXNetError(f"{self._what} build failed:\n"
+                             f"{' '.join(cmd)}\n{proc.stderr[-4000:]}")
+
+    def load(self) -> Optional[ctypes.CDLL]:
+        if self._lib is not None or self._tried:
+            return self._lib
+        with _lock:
+            if self._lib is not None or self._tried:
+                return self._lib
+            self._tried = True
+            if not get_env("MXNET_USE_NATIVE", True, bool):
+                return None
+            try:
+                if self._needs_build():
+                    self._build()
+                lib = ctypes.CDLL(self.so_path)
+            except Exception:
+                return None
+            getattr(lib, self._err_sym).restype = ctypes.c_char_p
+            self._lib = lib
+        return self._lib
+
+    def check(self, ret: int) -> None:
+        if ret != 0:
+            raise MXNetError(getattr(self._lib, self._err_sym)()
+                             .decode("utf-8", "replace"))
+
+
+_CORE = _NativeLib("libmxnet_tpu_native.so", _sources, [],
+                   "MXGetLastError", "native")
+_IMAGE = _NativeLib("libmxnet_tpu_image.so", _img_sources,
+                    ["-I/usr/include/opencv4", "-lopencv_core",
+                     "-lopencv_imgproc", "-lopencv_imgcodecs"],
+                    "MXImageGetLastError", "image pipeline")
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _tried
-    if _lib is not None or _tried:
-        return _lib
-    with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
-        if not get_env("MXNET_USE_NATIVE", True, bool):
-            return None
-        try:
-            if _needs_build():
-                _build()
-            lib = ctypes.CDLL(_SO)
-        except Exception:
-            return None
-        lib.MXGetLastError.restype = ctypes.c_char_p
-        _lib = lib
-    return _lib
+    return _CORE.load()
 
 
 def available() -> bool:
-    return _load() is not None
+    return _CORE.load() is not None
 
 
 def get() -> ctypes.CDLL:
-    lib = _load()
+    lib = _CORE.load()
     if lib is None:
         raise MXNetError(
             "native library unavailable (no toolchain or build failed); "
@@ -281,3 +319,89 @@ class NativePrefetchReader(_ReaderBase):
         self._reset = lib.MXPrefetchReaderReset
         self._free = lib.MXPrefetchReaderFree
         super().__init__(path, ctypes.c_int(capacity))
+
+
+# ---------------------------------------------------------------------------
+# Image pipeline (src/image_pipeline.cc, separate .so: links OpenCV like the
+# reference's image pipeline; absence degrades to the Python decode path)
+# ---------------------------------------------------------------------------
+
+def image_available() -> bool:
+    return _IMAGE.load() is not None
+
+
+def _load_image() -> Optional[ctypes.CDLL]:
+    return _IMAGE.load()
+
+
+def _img_check(lib, ret: int) -> None:
+    _IMAGE.check(ret)
+
+
+class NativeImagePipeline:
+    """Threaded decode+augment+batch pipeline over a .rec shard
+    (src/image_pipeline.cc; decode tasks run on the N1 engine)."""
+
+    def __init__(self, rec_path: str, idx_path: Optional[str], **cfg):
+        import numpy as np
+
+        self._np = np
+        self._lib = _load_image()
+        if self._lib is None:
+            raise MXNetError("native image pipeline unavailable "
+                             "(OpenCV toolchain missing?)")
+        self.cfg = cfg
+        cfg_s = ";".join(f"{k}={int(v) if isinstance(v, bool) else v}"
+                         for k, v in cfg.items())
+        h = ctypes.c_void_p()
+        _img_check(self._lib, self._lib.MXImagePipelineCreate(
+            rec_path.encode(), idx_path.encode() if idx_path else None,
+            cfg_s.encode(), ctypes.byref(h)))
+        self._h = h
+
+    def next(self):
+        """-> (data ndarray, label ndarray, pad) or None at epoch end.
+        data is u8 NHWC (default) or f32 NCHW (normalize=1)."""
+        np = self._np
+        batch_h = ctypes.c_void_p()
+        data_p = ctypes.POINTER(ctypes.c_uint8)()
+        label_p = ctypes.POINTER(ctypes.c_float)()
+        pad = ctypes.c_int()
+        _img_check(self._lib, self._lib.MXImagePipelineNext(
+            self._h, ctypes.byref(batch_h), ctypes.byref(data_p),
+            ctypes.byref(label_p), ctypes.byref(pad)))
+        if not batch_h.value:
+            return None
+        b = int(self.cfg.get("batch", 1))
+        c = int(self.cfg.get("channels", 3))
+        hh = int(self.cfg.get("height", 224))
+        ww = int(self.cfg.get("width", 224))
+        lw = int(self.cfg.get("label_width", 1))
+        norm = bool(self.cfg.get("normalize", False))
+        n_el = b * c * hh * ww
+        if norm:
+            fp = ctypes.cast(data_p, ctypes.POINTER(ctypes.c_float))
+            data = np.ctypeslib.as_array(fp, (n_el,)).reshape(
+                b, c, hh, ww).copy()
+        else:
+            data = np.ctypeslib.as_array(data_p, (n_el,)).reshape(
+                b, hh, ww, c).copy()
+        label = np.ctypeslib.as_array(label_p, (b * lw,)).reshape(
+            b, lw).copy()
+        _img_check(self._lib,
+                   self._lib.MXImagePipelineReleaseBatch(batch_h))
+        return data, label, pad.value
+
+    def reset(self):
+        _img_check(self._lib, self._lib.MXImagePipelineReset(self._h))
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.MXImagePipelineFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

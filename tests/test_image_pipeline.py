@@ -1,0 +1,254 @@
+"""Native image pipeline + im2rec tests
+(model: the reference's tests for iter_image_recordio_2 / tools/im2rec)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import lib, recordio
+from mxnet_tpu.io import ImageRecordIter
+
+pytestmark = pytest.mark.skipif(
+    not lib.image_available(),
+    reason="native image pipeline unavailable (no OpenCV toolchain)")
+
+
+def _make_rec(tmp_path, n=12, size=(24, 32), with_idx=True, seed=0):
+    """Synthetic shard: each image is a solid color encoding its label."""
+    import cv2
+
+    rng = np.random.RandomState(seed)
+    rec_path = str(tmp_path / "data.rec")
+    idx_path = str(tmp_path / "data.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    colors = []
+    for i in range(n):
+        color = rng.randint(30, 225, size=3)
+        img = np.full(size + (3,), color[::-1], np.uint8)  # BGR for cv2
+        ok, buf = cv2.imencode(".png", img)  # lossless: exact colors
+        assert ok
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 5), i, 0), buf.tobytes()))
+        colors.append(color)
+    rec.close()
+    return rec_path, idx_path, np.array(colors)
+
+
+def test_pipeline_decodes_and_orders(tmp_path):
+    rec_path, idx_path, colors = _make_rec(tmp_path)
+    pipe = lib.NativeImagePipeline(
+        rec_path, None, batch=4, channels=3, height=16, width=16,
+        label_width=1, threads=3)
+    seen = 0
+    while True:
+        res = pipe.next()
+        if res is None:
+            break
+        data, label, pad = res
+        assert data.shape == (4, 16, 16, 3) and data.dtype == np.uint8
+        for b in range(4 - pad):
+            i = seen + b
+            # solid-color image: every pixel equals the source color (RGB)
+            assert np.array_equal(data[b, 0, 0], colors[i])
+            assert np.array_equal(data[b], np.broadcast_to(
+                colors[i], (16, 16, 3)).astype(np.uint8))
+            assert label[b, 0] == float(i % 5)
+        seen += 4 - pad
+    assert seen == 12
+    pipe.close()
+
+
+def test_pipeline_reset_and_pad(tmp_path):
+    rec_path, _, _ = _make_rec(tmp_path, n=10)
+    pipe = lib.NativeImagePipeline(
+        rec_path, None, batch=4, channels=3, height=8, width=8,
+        label_width=1, threads=2)
+    for _ in range(2):  # two epochs
+        pads, batches = [], 0
+        while True:
+            res = pipe.next()
+            if res is None:
+                break
+            batches += 1
+            pads.append(res[2])
+        assert batches == 3           # ceil(10/4)
+        assert pads == [0, 0, 2]      # tail batch padded
+        pipe.reset()
+    pipe.close()
+
+
+def test_pipeline_shuffle_epochs_differ(tmp_path):
+    rec_path, idx_path, _ = _make_rec(tmp_path, n=12)
+    pipe = lib.NativeImagePipeline(
+        rec_path, idx_path, batch=12, channels=3, height=8, width=8,
+        label_width=1, threads=2, shuffle=True, seed=7)
+    first = pipe.next()[1][:, 0].copy()
+    pipe.reset()
+    second = pipe.next()[1][:, 0].copy()
+    assert sorted(first) == sorted(second)
+    assert not np.array_equal(first, second)  # reshuffled per epoch
+    pipe.close()
+
+
+def test_pipeline_normalize_matches_python(tmp_path):
+    """normalize=1 (f32 NCHW + mean/std) must match the Python decode."""
+    rec_path, _, colors = _make_rec(tmp_path, n=4, size=(16, 16))
+    pipe = lib.NativeImagePipeline(
+        rec_path, None, batch=4, channels=3, height=16, width=16,
+        label_width=1, threads=2, normalize=True,
+        mean_r=123.0, mean_g=117.0, mean_b=104.0,
+        std_r=58.0, std_g=57.0, std_b=57.0)
+    data, _, _ = pipe.next()
+    assert data.shape == (4, 3, 16, 16) and data.dtype == np.float32
+    mean = np.array([123.0, 117.0, 104.0], np.float32)
+    std = np.array([58.0, 57.0, 57.0], np.float32)
+    for b in range(4):
+        expect = (colors[b].astype(np.float32) - mean) / std
+        got = data[b, :, 0, 0]
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+    pipe.close()
+
+
+def test_image_record_iter_uses_native_pipeline(tmp_path):
+    rec_path, idx_path, colors = _make_rec(tmp_path, n=8, size=(20, 20))
+    it = ImageRecordIter(
+        path_imgrec=rec_path, path_imgidx=idx_path, data_shape=(3, 16, 16),
+        batch_size=4, mean_r=10.0, std_r=2.0, preprocess_threads=2)
+    assert it._pipe is not None  # fast path engaged
+    batch = next(iter(it))
+    d = batch.data[0].asnumpy()
+    assert d.shape == (4, 3, 16, 16)
+    np.testing.assert_allclose(
+        d[0, 0, 0, 0], (colors[0][0] - 10.0) / 2.0, rtol=1e-5)
+    assert batch.label[0].asnumpy()[1] == 1.0
+
+
+def test_pipeline_decode_error_is_loud(tmp_path):
+    rec_path = str(tmp_path / "bad.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    rec.write(recordio.pack(recordio.IRHeader(0, 0.0, 0, 0),
+                            b"not an image at all"))
+    rec.close()
+    pipe = lib.NativeImagePipeline(
+        rec_path, None, batch=1, channels=3, height=8, width=8,
+        label_width=1, threads=1)
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError, match="decode failed"):
+        for _ in range(4):  # error surfaces on a subsequent Next
+            if pipe.next() is None:
+                break
+    pipe.close()
+
+
+def test_im2rec_end_to_end(tmp_path):
+    """tools/im2rec.py --list + pack, then read back via ImageRecordIter."""
+    import cv2
+
+    root = tmp_path / "images"
+    for ci, cat in enumerate(["cat", "dog"]):
+        d = root / cat
+        d.mkdir(parents=True)
+        for i in range(3):
+            img = np.full((28, 28, 3), 40 * (ci * 3 + i) + 20, np.uint8)
+            cv2.imwrite(str(d / f"{i}.png"), img)
+    prefix = str(tmp_path / "ds")
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "im2rec.py")
+    env = dict(os.environ, PYTHONPATH=os.pathsep.join(
+        [os.path.dirname(tool)] + sys.path))
+    r = subprocess.run([sys.executable, tool, prefix, str(root), "--list",
+                        "--recursive"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert os.path.isfile(prefix + ".lst")
+    r = subprocess.run([sys.executable, tool, prefix, str(root),
+                        "--num-thread", "2", "--encoding", ".png"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert os.path.isfile(prefix + ".rec")
+    assert os.path.isfile(prefix + ".idx")
+
+    it = ImageRecordIter(path_imgrec=prefix + ".rec",
+                         path_imgidx=prefix + ".idx",
+                         data_shape=(3, 24, 24), batch_size=3,
+                         preprocess_threads=2)
+    labels = []
+    for batch in it:
+        labels.extend(batch.label[0].asnumpy()[:3 - batch.pad].tolist())
+    assert sorted(labels) == [0.0, 0.0, 0.0, 1.0, 1.0, 1.0]
+
+
+def test_dataloader_parallel_workers_ordered():
+    """num_workers>1 must give N real workers AND strict sampler order."""
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    x = np.arange(64, dtype=np.float32).reshape(64, 1)
+    ds = ArrayDataset(x)
+    dl = DataLoader(ds, batch_size=4, num_workers=3)
+    out = [b.asnumpy()[:, 0].tolist() for b in dl]
+    expect = [x[i:i + 4, 0].tolist() for i in range(0, 64, 4)]
+    assert out == expect
+
+
+def test_dataloader_worker_error_propagates():
+    from mxnet_tpu.gluon.data import DataLoader
+
+    class Bad:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            if i == 5:
+                raise ValueError("boom at 5")
+            return np.zeros(2, np.float32)
+
+    dl = DataLoader(Bad(), batch_size=2, num_workers=2)
+    with pytest.raises(ValueError, match="boom at 5"):
+        list(dl)
+
+
+def test_pipeline_resize_equals_short_edge_narrow_image(tmp_path):
+    """resize == the image's short edge but smaller than the crop: the
+    clamp must still upscale instead of cropping out of bounds."""
+    import cv2
+
+    rec_path = str(tmp_path / "narrow.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    img = np.full((10, 40, 3), 99, np.uint8)  # short edge 10
+    ok, buf = cv2.imencode(".png", img)
+    assert ok
+    rec.write(recordio.pack(recordio.IRHeader(0, 1.0, 0, 0), buf.tobytes()))
+    rec.close()
+    pipe = lib.NativeImagePipeline(
+        rec_path, None, batch=1, channels=3, height=24, width=24,
+        label_width=1, threads=1, resize_short=10)
+    data, label, pad = pipe.next()
+    assert data.shape == (1, 24, 24, 3)
+    assert (data == 99).all()
+    pipe.close()
+
+
+def test_pipeline_corrupt_label_count_is_loud(tmp_path):
+    """A record whose IRHeader claims more label floats than the record
+    holds must raise, not read out of bounds."""
+    import struct
+
+    rec_path = str(tmp_path / "corrupt.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    # flag=100000 labels claimed, 4 bytes of payload
+    hdr = struct.pack("<IfQQ", 100000, 0.0, 0, 0)
+    rec.write(hdr + b"\x00\x00\x00\x00")
+    rec.close()
+    pipe = lib.NativeImagePipeline(
+        rec_path, None, batch=1, channels=3, height=8, width=8,
+        label_width=1, threads=1)
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError, match="label count exceeds"):
+        for _ in range(4):
+            if pipe.next() is None:
+                break
+    pipe.close()

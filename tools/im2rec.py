@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""im2rec: pack an image directory / .lst file into RecordIO shards.
+
+Counterpart of the reference's tools/im2rec.py (list generation +
+multi-threaded packing into prefix.rec/prefix.idx).  Two modes:
+
+  1. List generation:
+       python tools/im2rec.py PREFIX ROOT --list [--recursive]
+           [--train-ratio R] [--test-ratio R] [--shuffle]
+     writes PREFIX.lst (and _train/_val/_test splits when ratios given):
+     one line per image: "<index>\t<label>\t<relative/path>".
+     Labels come from the top-level subdirectory index (sorted), exactly
+     like the reference's folder-name labeling.
+
+  2. Record packing:
+       python tools/im2rec.py PREFIX ROOT [--resize N] [--quality Q]
+           [--num-thread T] [--center-crop] [--color {-1,0,1}]
+           [--pack-label] [--no-shuffle]
+     reads every PREFIX*.lst and writes a .rec + .idx pair per list.
+     Images are re-encoded (optionally shorter-edge-resized / square
+     center-cropped) with T worker threads; records keep list order
+     (pass --shuffle at list time for shuffled shards).
+
+The output shards are read by io.ImageRecordIter — natively via
+src/image_pipeline.cc when built, else the Python decode path.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def list_images(root: str, recursive: bool):
+    """Yield (relpath, label) with labels = sorted top-level dir index."""
+    if recursive:
+        cats = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        label_of = {c: i for i, c in enumerate(cats)}
+        for cat in cats:
+            for dirpath, _, files in os.walk(os.path.join(root, cat)):
+                for f in sorted(files):
+                    if os.path.splitext(f)[1].lower() in _EXTS:
+                        yield (os.path.relpath(os.path.join(dirpath, f),
+                                               root), label_of[cat])
+    else:
+        for f in sorted(os.listdir(root)):
+            if os.path.splitext(f)[1].lower() in _EXTS:
+                yield (f, 0)
+
+
+def write_list(args):
+    items = list(list_images(args.root, args.recursive))
+    if args.shuffle:
+        random.seed(args.seed)
+        random.shuffle(items)
+    n = len(items)
+    n_train = int(n * args.train_ratio)
+    n_test = int(n * args.test_ratio)
+    chunks = {"": items}
+    if args.train_ratio < 1.0 or args.test_ratio > 0.0:
+        chunks = {"_train": items[:n_train],
+                  "_test": items[n_train:n_train + n_test],
+                  "_val": items[n_train + n_test:]}
+        chunks = {k: v for k, v in chunks.items() if v}
+    for suffix, chunk in chunks.items():
+        path = f"{args.prefix}{suffix}.lst"
+        with open(path, "w") as f:
+            for i, (rel, label) in enumerate(chunk):
+                f.write(f"{i}\t{label}\t{rel}\n")
+        print(f"wrote {path} ({len(chunk)} images)")
+
+
+def read_list(path: str):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            rel = parts[-1]
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels, rel
+
+
+def _encode_one(args, rel: str):
+    """Read + (resize/crop) + re-encode one image; returns encoded bytes."""
+    import cv2
+
+    path = os.path.join(args.root, rel)
+    flag = (cv2.IMREAD_COLOR if args.color == 1 else
+            cv2.IMREAD_GRAYSCALE if args.color == 0 else
+            cv2.IMREAD_UNCHANGED)
+    if args.pass_through:
+        with open(path, "rb") as f:
+            return f.read()
+    img = cv2.imread(path, flag)
+    if img is None:
+        raise IOError(f"cannot decode {path}")
+    if args.center_crop:
+        s = min(img.shape[:2])
+        y = (img.shape[0] - s) // 2
+        x = (img.shape[1] - s) // 2
+        img = img[y:y + s, x:x + s]
+    if args.resize > 0:
+        h, w = img.shape[:2]
+        scale = args.resize / min(h, w)
+        if scale != 1.0:
+            img = cv2.resize(
+                img, (max(1, round(w * scale)), max(1, round(h * scale))),
+                interpolation=cv2.INTER_AREA if scale < 1
+                else cv2.INTER_LINEAR)
+    ext = ".png" if args.encoding == ".png" else ".jpg"
+    params = [] if ext == ".png" else [cv2.IMWRITE_JPEG_QUALITY, args.quality]
+    ok, buf = cv2.imencode(ext, img, params)
+    if not ok:
+        raise IOError(f"cannot encode {path}")
+    return buf.tobytes()
+
+
+def pack_list(args, lst_path: str):
+    from mxnet_tpu import recordio
+
+    prefix = lst_path[:-4]
+    items = list(read_list(lst_path))
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    t0 = time.time()
+    n_done = 0
+    with ThreadPoolExecutor(max_workers=args.num_thread) as pool:
+        # encode in parallel (cv2 releases the GIL), write in list order
+        encoded = pool.map(lambda it: _encode_one(args, it[2]), items,
+                           chunksize=8)
+        for (idx, labels, _rel), payload in zip(items, encoded):
+            label = labels[0] if len(labels) == 1 and not args.pack_label \
+                else labels
+            header = recordio.IRHeader(0, label, idx, 0)
+            rec.write_idx(idx, recordio.pack(header, payload))
+            n_done += 1
+            if n_done % 1000 == 0:
+                print(f"{lst_path}: {n_done}/{len(items)} "
+                      f"({n_done / (time.time() - t0):.0f} img/s)")
+    rec.close()
+    print(f"wrote {prefix}.rec + .idx ({n_done} records, "
+          f"{time.time() - t0:.1f}s)")
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="pack images into RecordIO shards "
+                    "(counterpart of the reference tools/im2rec.py)")
+    ap.add_argument("prefix", help="output prefix (and .lst prefix)")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="generate .lst instead of packing")
+    ap.add_argument("--recursive", action="store_true",
+                    help="label by top-level subdirectory")
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--test-ratio", type=float, default=0.0)
+    ap.add_argument("--shuffle", action="store_true")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter edge before packing")
+    ap.add_argument("--center-crop", action="store_true")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--num-thread", type=int, default=os.cpu_count() or 1)
+    ap.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
+    ap.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    ap.add_argument("--pack-label", action="store_true",
+                    help="store the full (multi-)label vector")
+    ap.add_argument("--pass-through", action="store_true",
+                    help="pack original file bytes without re-encoding")
+    args = ap.parse_args()
+
+    if args.list:
+        write_list(args)
+        return 0
+    lsts = sorted(
+        p for p in (
+            f"{args.prefix}{s}" for s in
+            ("", "_train", "_val", "_test"))
+        if os.path.isfile(p + ".lst"))
+    if not lsts:
+        print(f"no .lst found for prefix {args.prefix}; "
+              f"run with --list first", file=sys.stderr)
+        return 1
+    for p in lsts:
+        pack_list(args, p + ".lst")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

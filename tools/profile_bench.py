@@ -67,16 +67,23 @@ def capture(args) -> str:
     return args.out
 
 
-CATEGORIES = [
-    ("conv", re.compile(r"convolution|conv", re.I)),
-    ("matmul", re.compile(r"dot|einsum", re.I)),
-    ("allreduce/collective", re.compile(r"all-reduce|all-gather|collective|reduce-scatter", re.I)),
-    ("reduce_window(pool)", re.compile(r"reduce-window|select-and-scatter", re.I)),
-    ("fusion(elementwise)", re.compile(r"^(loop_)?fusion", re.I)),
-    ("copy/transpose", re.compile(r"copy|transpose|bitcast", re.I)),
-    ("reduce(BN stats etc)", re.compile(r"^reduce", re.I)),
-    ("infeed/outfeed/host", re.compile(r"infeed|outfeed|host", re.I)),
+# categorize by the op's own label (lhs of " = "), NOT by substring over
+# the full event name — operand text would misattribute (e.g. "convert"
+# matching "conv", fusions quoting %copy-done operands)
+LABEL_CATEGORIES = [
+    ("conv+fusion (convs, BN-bwd dx)", re.compile(r"^fusion$")),
+    ("wgrad+update (add_convert)", re.compile(r"^add_convert_fusion$")),
+    ("BN stat reduces (convert_reduce)", re.compile(r"^convert_reduce_fusion$")),
+    ("relu/residual (maximum_add)", re.compile(r"^maximum_add_fusion$")),
+    ("pool", re.compile(r"^(select_and_scatter|reduce-window)")),
+    ("copies/slices", re.compile(r"^(copy|slice|bitcast)")),
+    ("other fusions", re.compile(r"fusion$")),
 ]
+
+
+def _label(name: str) -> str:
+    lhs = name.split(" = ")[0].lstrip("%")
+    return re.sub(r"[.\d]+$", "", lhs)
 
 
 def analyze(logdir: str, steps: int):
@@ -92,38 +99,34 @@ def analyze(logdir: str, steps: int):
         xs.ParseFromString(f.read())
 
     for plane in xs.planes:
-        if "TPU" not in plane.name and "tpu" not in plane.name.lower():
+        if "TPU" not in plane.name:
             continue
         ev_meta = plane.event_metadata
-        op_time = defaultdict(int)
-        total = 0
-        # device planes: one line per core-unit; XLA op events carry metadata
         for line in plane.lines:
-            if "step" in line.name.lower():
+            if line.name != "XLA Ops":  # the non-overlapped device timeline
                 continue
+            op_time = defaultdict(int)
+            total = 0
             for ev in line.events:
-                name = ev_meta[ev.metadata_id].name
-                dur = ev.duration_ps
-                op_time[name] += dur
-                total += dur
-        if not op_time:
-            continue
-        print(f"\n=== plane: {plane.name} (total device-op time "
-              f"{total/1e12*1e3:.1f} ms over {steps} steps) ===")
-        cat_time = defaultdict(int)
-        for name, t in op_time.items():
-            for cat, pat in CATEGORIES:
-                if pat.search(name):
-                    cat_time[cat] += t
-                    break
-            else:
-                cat_time["other"] += t
-        for cat, t in sorted(cat_time.items(), key=lambda kv: -kv[1]):
-            print(f"  {cat:26s} {t/1e12*1e3/steps:8.2f} ms/step  "
-                  f"{100*t/total:5.1f}%")
-        print("  top 15 individual ops:")
-        for name, t in sorted(op_time.items(), key=lambda kv: -kv[1])[:15]:
-            print(f"    {t/1e12*1e3/steps:8.3f} ms/step  {name[:90]}")
+                lab = _label(ev_meta[ev.metadata_id].name)
+                op_time[lab] += ev.duration_ps
+                total += ev.duration_ps
+            print(f"\n=== {plane.name} 'XLA Ops': "
+                  f"{total/1e12*1e3/steps:.1f} ms/step ===")
+            cat_time = defaultdict(int)
+            for lab, t in op_time.items():
+                for cat, pat in LABEL_CATEGORIES:
+                    if pat.search(lab):
+                        cat_time[cat] += t
+                        break
+                else:
+                    cat_time["other"] += t
+            for cat, t in sorted(cat_time.items(), key=lambda kv: -kv[1]):
+                print(f"  {cat:36s} {t/1e12*1e3/steps:8.2f} ms/step  "
+                      f"{100*t/total:5.1f}%")
+            print("  top 15 op labels:")
+            for lab, t in sorted(op_time.items(), key=lambda kv: -kv[1])[:15]:
+                print(f"    {t/1e12*1e3/steps:8.3f} ms/step  {lab}")
 
 
 def main():
