@@ -24,6 +24,7 @@ from . import autograd
 from . import random
 from . import profiler
 from . import serialization
+from . import operator
 
 __all__ = [
     "MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",

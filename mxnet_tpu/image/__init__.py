@@ -37,12 +37,34 @@ def _tf():
     return _TF
 
 
+def _cv2():
+    try:
+        import cv2
+
+        return cv2
+    except ImportError:
+        return None
+
+
 def imdecode_np(buf: bytes, iscolor: int = 1) -> np.ndarray:
-    """Decode JPEG/PNG bytes to an HWC uint8 numpy array."""
+    """Decode JPEG/PNG bytes to an HWC uint8 numpy array (RGB).
+    Prefers OpenCV (the reference's codec, ~10x faster than the TF
+    fallback) when installed."""
     if len(buf) >= 6 and buf[:6] == b"\x93NUMPY":
         import io
 
         return np.load(io.BytesIO(buf))
+    cv2 = _cv2()
+    if cv2 is not None:
+        img = cv2.imdecode(np.frombuffer(buf, np.uint8),
+                           cv2.IMREAD_COLOR if iscolor
+                           else cv2.IMREAD_GRAYSCALE)
+        if img is not None:
+            if iscolor:
+                img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+            else:
+                img = img[..., None]
+            return img
     tf = _tf()
     img = tf.io.decode_image(buf, channels=3 if iscolor else 1,
                              expand_animations=False)
@@ -60,6 +82,19 @@ def imencode(img: np.ndarray, quality: int = 95, fmt: str = ".jpg") -> bytes:
     if isinstance(img, NDArray):
         img = img.asnumpy()
     img = np.ascontiguousarray(img).astype(np.uint8)
+    cv2 = _cv2()
+    # cv2 fast path only for layouts whose channel semantics are clear
+    # (grayscale / RGB); RGBA etc fall through to the TF encoders
+    if cv2 is not None and fmt in (".jpg", ".jpeg", ".png") and (
+            img.ndim == 2 or img.shape[-1] in (1, 3)):
+        bgr = cv2.cvtColor(img, cv2.COLOR_RGB2BGR) if img.ndim == 3 \
+            and img.shape[-1] == 3 else img
+        params = [cv2.IMWRITE_JPEG_QUALITY, quality] \
+            if fmt != ".png" else []
+        ok, buf = cv2.imencode(".png" if fmt == ".png" else ".jpg", bgr,
+                               params)
+        if ok:
+            return buf.tobytes()
     tf = _tf()
     if fmt in (".jpg", ".jpeg"):
         return tf.io.encode_jpeg(img, quality=quality).numpy()

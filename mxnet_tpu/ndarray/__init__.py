@@ -15,6 +15,7 @@ from . import sparse
 from .sparse import (BaseSparseNDArray, CSRNDArray, RowSparseNDArray,
                      cast_storage)
 from . import register as _register
+from . import image
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "concatenate", "stack", "from_jax", "random", "waitall", "save",

@@ -28,9 +28,11 @@ def _make_wrapper(name: str) -> Callable:
             return out
         return res
 
+    op = OP_REGISTRY.get(name)
     fn.__name__ = name
     fn.__qualname__ = name
-    fn.__doc__ = f"Imperative wrapper for registered op '{name}'."
+    fn.__doc__ = (f"Imperative wrapper for registered op '{name}'.\n\n"
+                  f"{op.param_doc}")
     return fn
 
 
@@ -40,6 +42,50 @@ def Dropout(data, p=0.5, mode="training", axes=(), **kw):
     """ref: nd.Dropout — consults global train mode; key auto-threaded."""
     return invoke("Dropout", data, _random.next_key(), p=p, mode=mode,
                   axes=tuple(axes), _train=autograd.is_training())
+
+
+def Custom(*inputs, op_type=None, **kwargs):
+    """Eager frontend for user CustomOps (ref: nd.Custom over custom.cc).
+
+    Runs the user's forward/backward DIRECTLY on host numpy — works on
+    any device, including PJRT plugins without host-callback support
+    (this container's axon TPU tunnel is one).  Traced programs
+    (hybridize / Symbol / SPMDTrainer) instead hit the registry 'Custom'
+    op, which stages the same host code via jax.pure_callback."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .. import operator as _operator
+    from .ndarray import NDArray
+
+    prop = _operator.get_prop(op_type)(**kwargs)
+    np_ins = [x.asnumpy() for x in inputs]
+    structs = _operator.out_structs_for(
+        prop, [a.shape for a in np_ins], [a.dtype for a in np_ins])
+    np_outs = _operator.run_forward_host(prop, np_ins, structs,
+                                         is_train=autograd.is_training())
+    ctx = inputs[0].ctx if inputs else None
+    outs = tuple(NDArray(jnp.asarray(o), ctx=ctx) for o in np_outs)
+    if autograd.is_recording():
+        parents = [(autograd._node_of(x), x) for x in inputs]
+
+        def custom_backward(node_cts, _np_ins=np_ins, _np_outs=np_outs,
+                            _prop=prop):
+            import jax
+
+            np_cts = [np.asarray(jax.device_get(c)) if c is not None
+                      else np.zeros(o.shape, o.dtype)
+                      for c, o in zip(node_cts, _np_outs)]
+            grads = _operator.run_backward_host(_prop, _np_ins, _np_outs,
+                                                np_cts)
+            return [jnp.asarray(g) for g in grads]
+
+        node = autograd.TapeNode(None, None, [x.data for x in inputs],
+                                 parents, len(outs),
+                                 custom_backward=custom_backward)
+        for i, o in enumerate(outs):
+            o._ag_node = (node, i)
+    return outs[0] if len(outs) == 1 else list(outs)
 
 
 def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
@@ -95,6 +141,7 @@ _SPECIAL: Dict[str, Callable] = {
     "batch_norm": BatchNorm,
     "dot_product_attention": dot_product_attention,
     "FusedAttention": dot_product_attention,
+    "Custom": Custom,
 }
 for _rn in ("_random_uniform", "_random_normal", "_random_randint",
             "_random_gamma", "_random_exponential", "_random_poisson",

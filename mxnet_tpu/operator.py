@@ -1,0 +1,248 @@
+"""User-defined operators: CustomOp / CustomOpProp / register.
+
+Counterpart of the reference's custom-op machinery
+(ref: src/operator/custom/custom.cc + python/mxnet/operator.py).  The
+reference runs user Python forward/backward on the CPU via engine
+callbacks; the TPU-native equivalent is a host callback: the user code
+runs through ``jax.pure_callback`` under ``jax.custom_vjp``, so a Custom
+op works BOTH eagerly and inside traced/jitted programs (hybridize,
+Symbol bind, SPMDTrainer) — XLA treats it as an opaque host call, and
+gradients route back through the user's ``backward``.
+
+API parity with the reference::
+
+    class Sigmoid(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            y = 1.0 / (1.0 + mx.nd.exp(-in_data[0]))
+            self.assign(out_data[0], req[0], y)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            y = out_data[0]
+            self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+    @mx.operator.register("sigmoid")
+    class SigmoidProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Sigmoid()
+
+    y = mx.nd.Custom(x, op_type="sigmoid")
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+from .ops.registry import register_op
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop"]
+
+
+class CustomOp:
+    """Base class for user forward/backward (ref: operator.py::CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError(
+            "this CustomOp does not implement backward")
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Honor the write/add/null request (ref: CustomOp.assign)."""
+        if req in ("null", None):
+            return
+        if req == "add":
+            dst += src
+        else:  # write / inplace
+            dst[:] = src
+
+
+class CustomOpProp:
+    """Shape/type/operator factory (ref: operator.py::CustomOpProp)."""
+
+    def __init__(self, need_top_grad: bool = True, **kwargs):
+        self.need_top_grad_ = need_top_grad
+        self.kwargs = kwargs
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        return list(out_grad) + list(in_data) + list(out_data)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        raise NotImplementedError
+
+
+_PROPS: Dict[str, Type[CustomOpProp]] = {}
+
+
+def register(op_type: str):
+    """Decorator registering a CustomOpProp under ``op_type``
+    (ref: mx.operator.register)."""
+
+    def _wrap(cls: Type[CustomOpProp]) -> Type[CustomOpProp]:
+        if not issubclass(cls, CustomOpProp):
+            raise MXNetError(
+                f"@operator.register expects a CustomOpProp subclass, "
+                f"got {cls!r}")
+        _PROPS[op_type] = cls
+        return cls
+
+    return _wrap
+
+
+def get_prop(op_type: str) -> Type[CustomOpProp]:
+    if op_type not in _PROPS:
+        raise MXNetError(
+            f"unknown custom op_type {op_type!r}; registered: "
+            f"{sorted(_PROPS)}")
+    return _PROPS[op_type]
+
+
+# ---------------------------------------------------------------------------
+# the 'Custom' registry op — host-callback execution with custom_vjp
+# ---------------------------------------------------------------------------
+
+class _HostArray(np.ndarray):
+    """numpy view with the small NDArray-ish surface CustomOp code uses
+    (asnumpy; the arithmetic comes from ndarray itself)."""
+
+    def asnumpy(self):
+        return np.asarray(self)
+
+
+def _wrap_host(a: np.ndarray) -> _HostArray:
+    return np.ascontiguousarray(a).view(_HostArray)
+
+
+def _custom_nout(attrs) -> int:
+    prop_cls = get_prop(attrs["op_type"])
+    kw = {k: v for k, v in attrs.items() if k != "op_type"}
+    return len(prop_cls(**kw).list_outputs())
+
+
+def run_forward_host(prop: CustomOpProp, np_ins, out_structs,
+                     is_train: bool = True):
+    """Execute the user forward on host numpy arrays."""
+    op = prop.create_operator(None, [list(a.shape) for a in np_ins],
+                              [a.dtype for a in np_ins])
+    n_out = len(out_structs)
+    in_data = [_wrap_host(a) for a in np_ins]
+    outs = [np.zeros(s.shape, s.dtype).view(_HostArray)
+            for s in out_structs]
+    op.forward(is_train=is_train, req=["write"] * n_out,
+               in_data=in_data, out_data=outs, aux=[])
+    return tuple(np.asarray(o) for o in outs)
+
+
+def run_backward_host(prop: CustomOpProp, np_ins, np_outs, np_cts):
+    """Execute the user backward on host numpy arrays."""
+    op = prop.create_operator(None, [list(a.shape) for a in np_ins],
+                              [a.dtype for a in np_ins])
+    in_grad = [np.zeros(a.shape, a.dtype).view(_HostArray) for a in np_ins]
+    op.backward(req=["write"] * len(np_ins),
+                out_grad=[_wrap_host(c) for c in np_cts],
+                in_data=[_wrap_host(a) for a in np_ins],
+                out_data=[_wrap_host(o) for o in np_outs],
+                in_grad=in_grad, aux=[])
+    return tuple(np.asarray(g) for g in in_grad)
+
+
+def out_structs_for(prop: CustomOpProp, in_shapes, in_dtypes):
+    ishapes, oshapes, _aux = prop.infer_shape([list(s) for s in in_shapes])
+    itypes, otypes, _ = prop.infer_type(list(in_dtypes))
+    return tuple(jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
+                 for s, t in zip(oshapes, otypes))
+
+
+@functools.lru_cache(maxsize=None)
+def _build_custom(op_type: str, kw_items: tuple, in_shapes: tuple,
+                  in_dtypes: tuple, is_train: bool = True):
+    """One custom_vjp-wrapped host callback per (op_type, attrs,
+    signature, train-mode) — cached like any other compiled executable."""
+    kw = dict(kw_items)
+    prop = get_prop(op_type)(**kw)
+    n_in = len(in_shapes)
+    out_structs = out_structs_for(prop, in_shapes, in_dtypes)
+    n_out = len(out_structs)
+
+    def fwd_host(*ins):
+        return run_forward_host(prop, ins, out_structs, is_train=is_train)
+
+    def bwd_host(*args):
+        ins = args[:n_in]
+        outs = args[n_in:n_in + n_out]
+        cts = args[n_in + n_out:]
+        return run_backward_host(prop, ins, outs, cts)
+
+    @jax.custom_vjp
+    def run(*ins):
+        out = jax.pure_callback(fwd_host, out_structs, *ins)
+        return out if n_out > 1 else out[0]
+
+    def run_fwd(*ins):
+        out = jax.pure_callback(fwd_host, out_structs, *ins)
+        primal = out if n_out > 1 else out[0]
+        return primal, (ins, out)
+
+    def run_bwd(res, cts):
+        ins, outs = res
+        cts = cts if isinstance(cts, tuple) else (cts,)
+        grad_structs = tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in ins)
+        grads = jax.pure_callback(bwd_host, grad_structs,
+                                  *ins, *outs, *cts)
+        return tuple(grads)
+
+    run.defvjp(run_fwd, run_bwd)
+    return run
+
+
+@register_op("Custom", num_outputs=_custom_nout)
+def _custom(*arrays, op_type=None, _train=None, **kwargs):
+    """Dispatch to the registered CustomOpProp (ref: custom.cc Custom).
+    ``_train`` follows the OpContext convention: defaults to the global
+    autograd train mode at trace time."""
+    if op_type is None:
+        raise MXNetError("nd.Custom requires op_type=")
+    get_prop(op_type)  # loud unknown-type error before tracing
+    if _train is None:
+        from . import autograd as _ag
+
+        _train = _ag.is_training()
+    kw_items = tuple(sorted(kwargs.items()))
+    in_shapes = tuple(tuple(a.shape) for a in arrays)
+    in_dtypes = tuple(np.dtype(a.dtype) for a in arrays)
+    return _build_custom(op_type, kw_items, in_shapes, in_dtypes,
+                         bool(_train))(*arrays)

@@ -16,6 +16,9 @@ from . import optimizer_ops  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import contrib  # noqa: F401
 from . import pallas_attention  # noqa: F401
+from . import linalg  # noqa: F401
+from . import image_ops  # noqa: F401
+from . import quantization  # noqa: F401
 
 __all__ = ["registry", "OP_REGISTRY", "Operator", "apply_pure", "get_op",
            "invoke", "list_ops", "register_op"]

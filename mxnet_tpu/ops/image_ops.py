@@ -1,0 +1,127 @@
+"""Registry-level image operators (the _image_* family).
+
+TPU-native counterpart of src/operator/image/{resize,crop,image_random}.cc
+(to_tensor, normalize, resize, crop, flip_left_right/up_down and the
+random_* variants).  These are DEVICE ops — jax.image handles the
+interpolation on-accelerator — usable eagerly, hybridized, and inside the
+SPMD step; heavy JPEG decode stays in the native host pipeline.
+
+Layout convention follows the reference: image ops take HWC (or NHWC
+batched) uint8/float input; to_tensor produces CHW float scaled to [0,1].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+__all__ = []
+
+
+def _is_batched(x):
+    return x.ndim == 4
+
+
+@register_op("_image_to_tensor", aliases=("image_to_tensor",))
+def _to_tensor(x):
+    """HWC [0,255] -> CHW float32 [0,1] (ref: image/to_tensor)."""
+    perm = (0, 3, 1, 2) if _is_batched(x) else (2, 0, 1)
+    return jnp.transpose(x.astype(jnp.float32) / 255.0, perm)
+
+
+@register_op("_image_normalize", aliases=("image_normalize",))
+def _normalize(x, mean=(0.0,), std=(1.0,)):
+    """Channel-wise (x - mean) / std on CHW/NCHW float input."""
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    shape = (1, -1, 1, 1) if _is_batched(x) else (-1, 1, 1)
+    return (x - mean.reshape(shape)) / std.reshape(shape)
+
+
+@register_op("_image_resize", aliases=("image_resize",))
+def _resize(x, size=None, keep_ratio=False, interp=1):
+    """Resize HWC/NHWC to `size` ((w, h) or int shorter-edge when
+    keep_ratio) — bilinear (interp 1) or nearest (0)."""
+    if size is None:
+        raise ValueError("_image_resize requires size=")
+    h_ax = 1 if _is_batched(x) else 0
+    h, w = x.shape[h_ax], x.shape[h_ax + 1]
+    if isinstance(size, int):
+        if keep_ratio:
+            scale = size / min(h, w)
+            nh, nw = max(1, round(h * scale)), max(1, round(w * scale))
+        else:
+            nh = nw = size
+    else:
+        nw, nh = size  # reference passes (w, h)
+    shape = ((x.shape[0], nh, nw, x.shape[3]) if _is_batched(x)
+             else (nh, nw, x.shape[2]))
+    method = "nearest" if interp == 0 else "bilinear"
+    odtype = x.dtype
+    out = jax.image.resize(x.astype(jnp.float32), shape, method=method)
+    if jnp.issubdtype(odtype, jnp.integer):
+        out = jnp.clip(jnp.round(out), 0, 255)
+    return out.astype(odtype)
+
+
+@register_op("_image_crop", aliases=("image_crop",))
+def _crop(x, x0=0, y0=0, width=0, height=0):
+    """Fixed crop of HWC/NHWC (ref: image/crop.cc)."""
+    if _is_batched(x):
+        return x[:, y0:y0 + height, x0:x0 + width]
+    return x[y0:y0 + height, x0:x0 + width]
+
+
+@register_op("_image_flip_left_right", aliases=("image_flip_left_right",))
+def _flip_lr(x):
+    return jnp.flip(x, axis=-2)
+
+
+@register_op("_image_flip_up_down", aliases=("image_flip_up_down",))
+def _flip_ud(x):
+    return jnp.flip(x, axis=1 if _is_batched(x) else 0)
+
+
+def _keyed_coin(key):
+    return jax.random.bernoulli(key, 0.5)
+
+
+@register_op("_image_random_flip_left_right",
+             aliases=("image_random_flip_left_right",))
+def _random_flip_lr(x, key):
+    return jnp.where(_keyed_coin(key), jnp.flip(x, axis=-2), x)
+
+
+@register_op("_image_random_flip_up_down",
+             aliases=("image_random_flip_up_down",))
+def _random_flip_ud(x, key):
+    ax = 1 if _is_batched(x) else 0
+    return jnp.where(_keyed_coin(key), jnp.flip(x, axis=ax), x)
+
+
+@register_op("_image_random_brightness",
+             aliases=("image_random_brightness",))
+def _random_brightness(x, key, min_factor=0.5, max_factor=1.5):
+    f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return (x.astype(jnp.float32) * f).astype(x.dtype)
+
+
+@register_op("_image_random_contrast", aliases=("image_random_contrast",))
+def _random_contrast(x, key, min_factor=0.5, max_factor=1.5):
+    f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    xf = x.astype(jnp.float32)
+    # luminance-mean pivot (ref: image_random.cc contrast aug)
+    coef = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)
+    gray = jnp.mean(jnp.tensordot(xf, coef, axes=([-1], [0])))
+    return (gray * (1.0 - f) + xf * f).astype(x.dtype)
+
+
+@register_op("_image_random_saturation",
+             aliases=("image_random_saturation",))
+def _random_saturation(x, key, min_factor=0.5, max_factor=1.5):
+    f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    xf = x.astype(jnp.float32)
+    coef = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)
+    gray = jnp.tensordot(xf, coef, axes=([-1], [0]))[..., None]
+    return (gray * (1.0 - f) + xf * f).astype(x.dtype)

@@ -1,0 +1,175 @@
+"""Linear-algebra operators — the full linalg_* family.
+
+TPU-native counterpart of the reference's src/operator/tensor/la_op.cc
+(linalg_gemm/gemm2/potrf/potri/trmm/trsm/sumlogdiag/extractdiag/makediag/
+extracttrian/maketrian/syrk/gelqf/syevd/inverse/det/slogdet).  Everything
+lowers to XLA's native decompositions (cholesky/qr/eigh/triangular-solve
+run as XLA HLO custom-calls on TPU) and inherits jax's gradients; batch
+dimensions broadcast as in the reference (ops act on the last two axes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register_op
+
+__all__ = []
+
+
+def _T(a):
+    return jnp.swapaxes(a, -1, -2)
+
+
+@register_op("linalg_gemm")
+def _linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
+                 beta=1.0, axis=-2):
+    """alpha * op(A) @ op(B) + beta * C (ref: la_op.cc linalg_gemm).
+    ``axis`` selects the matrix-ROW axis within ND inputs (default -2,
+    the reference convention; other values move that axis into matrix
+    position and back)."""
+    move = axis not in (-2, a.ndim - 2)
+    if move:
+        a = jnp.moveaxis(a, axis, -2)
+        b = jnp.moveaxis(b, axis, -2)
+        c = jnp.moveaxis(c, axis, -2)
+    if transpose_a:
+        a = _T(a)
+    if transpose_b:
+        b = _T(b)
+    out = alpha * jnp.matmul(a, b) + beta * c
+    return jnp.moveaxis(out, -2, axis) if move else out
+
+
+@register_op("linalg_potri")
+def _linalg_potri(a):
+    """Inverse of a PD matrix from its Cholesky factor L (A = L L^T):
+    potri(L) = A^{-1} = L^{-T} L^{-1} (ref: la_op.cc linalg_potri)."""
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    linv = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+    return jnp.matmul(_T(linv), linv)
+
+
+@register_op("linalg_trmm")
+def _linalg_trmm(a, b, transpose=False, rightside=False, lower=True,
+                 alpha=1.0):
+    """Triangular matrix multiply: out = alpha * op(tri(A)) @ B
+    (or B @ op(tri(A)) when rightside)."""
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        tri = _T(tri)
+    out = jnp.matmul(b, tri) if rightside else jnp.matmul(tri, b)
+    return alpha * out
+
+
+@register_op("linalg_trsm")
+def _linalg_trsm(a, b, transpose=False, rightside=False, lower=True,
+                 alpha=1.0):
+    """Triangular solve: out = alpha * op(tri(A))^{-1} B
+    (or alpha * B op(tri(A))^{-1} when rightside)."""
+    solve = jax.scipy.linalg.solve_triangular
+    if rightside:
+        # X op(A) = B  <=>  op(A)^T X^T = B^T ; op(A)^T is A^T when not
+        # transposed (trans=1) and A itself when transposed (trans=0)
+        x = _T(solve(a, _T(b), lower=lower, trans=0 if transpose else 1))
+    else:
+        x = solve(a, b, lower=lower, trans=1 if transpose else 0)
+    return alpha * x
+
+
+@register_op("linalg_sumlogdiag")
+def _linalg_sumlogdiag(a):
+    d = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+@register_op("linalg_extractdiag")
+def _linalg_extractdiag(a, offset=0):
+    return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+@register_op("linalg_makediag")
+def _linalg_makediag(a, offset=0):
+    n = a.shape[-1] + abs(offset)
+    base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    r = idx + max(0, -offset)
+    c = idx + max(0, offset)
+    return base.at[..., r, c].set(a)
+
+
+def _trian_indices(n, offset, lower):
+    if lower:
+        r, c = np.tril_indices(n, k=offset)
+    else:
+        r, c = np.triu_indices(n, k=offset)
+    return r, c
+
+
+@register_op("linalg_extracttrian")
+def _linalg_extracttrian(a, offset=0, lower=True):
+    r, c = _trian_indices(a.shape[-1], offset, lower)
+    return a[..., r, c]
+
+
+@register_op("linalg_maketrian")
+def _linalg_maketrian(a, offset=0, lower=True):
+    # solve k = n(n+1)/2 - |offset| terms for n given the packed length
+    k = a.shape[-1]
+    n = 1
+    while True:
+        r, c = _trian_indices(n, offset, lower)
+        if len(r) == k:
+            break
+        n += 1
+        if n > 4096:
+            raise ValueError(f"cannot infer matrix size from {k} elements")
+    base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    return base.at[..., r, c].set(a)
+
+
+@register_op("linalg_syevd", num_outputs=2)
+def _linalg_syevd(a):
+    """Symmetric eigendecomposition: A = U^T diag(L) U with eigenvectors
+    as ROWS of U (the reference's convention; jnp.linalg.eigh returns
+    columns)."""
+    w, v = jnp.linalg.eigh(a)
+    return _T(v), w
+
+
+@register_op("linalg_gelqf", num_outputs=2)
+def _linalg_gelqf(a):
+    """LQ factorization of a full-rank m x n (m <= n): A = L Q with Q's
+    rows orthonormal (ref: la_op.cc linalg_gelqf).  Via QR of A^T."""
+    q, r = jnp.linalg.qr(_T(a))
+    return _T(r), _T(q)
+
+
+@register_op("linalg_inverse", aliases=("inverse",))
+def _linalg_inverse(a):
+    return jnp.linalg.inv(a)
+
+
+@register_op("linalg_det", aliases=("det",))
+def _linalg_det(a):
+    return jnp.linalg.det(a)
+
+
+@register_op("linalg_slogdet", aliases=("slogdet",), num_outputs=2)
+def _linalg_slogdet(a):
+    sign, logdet = jnp.linalg.slogdet(a)
+    return sign, logdet
+
+
+@register_op("linalg_solve", aliases=("solve",))
+def _linalg_solve(a, b):
+    return jnp.linalg.solve(a, b)
+
+
+@register_op("moments", num_outputs=2)
+def _moments(data, axes=None, keepdims=False):
+    mean = jnp.mean(data, axis=axes, keepdims=keepdims)
+    var = jnp.var(data, axis=axes, keepdims=keepdims)
+    return mean, var

@@ -1,0 +1,123 @@
+"""Quantization operators.
+
+TPU-native counterpart of src/operator/quantization/** (quantize.cc,
+quantize_v2.cc, dequantize.cc, requantize.cc, quantized_conv/fc/pool).
+
+The numeric core — quantize / quantize_v2 / dequantize / requantize —
+is implemented for real with the reference's affine int8/uint8 scheme
+(min/max calibration ranges carried alongside the payload).  The
+quantized COMPUTE kernels (quantized_conv, quantized_fully_connected,
+...) raise informatively: on TPU the MXU's native low-precision path is
+bfloat16/int8-with-fp32-accumulate chosen by XLA, and int8 inference
+graphs should be expressed through normal ops + these converters; there
+is no cuDNN-int8 analogue worth emulating op-by-op.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import register_op
+
+__all__ = []
+
+
+def _qrange(out_type: str):
+    if out_type == "uint8":
+        return 0.0, 255.0, jnp.uint8
+    if out_type == "int8":
+        return -127.0, 127.0, jnp.int8
+    raise MXNetError(f"unsupported quantized type {out_type!r} "
+                     "(uint8/int8)")
+
+
+@register_op("_contrib_quantize", aliases=("quantize",), num_outputs=3,
+             differentiable=False)
+def _quantize(data, min_range, max_range, out_type="uint8"):
+    """Affine-quantize fp32 into uint8/int8 given calibration ranges;
+    returns (q, out_min, out_max) (ref: quantization/quantize.cc)."""
+    qmin, qmax, qdt = _qrange(out_type)
+    rmin = jnp.minimum(min_range, 0.0).reshape(())
+    rmax = jnp.maximum(max_range, 0.0).reshape(())
+    if out_type == "int8":
+        # symmetric: scale by max |range| (ref quantize.cc int8 branch)
+        absmax = jnp.maximum(jnp.abs(rmin), jnp.abs(rmax))
+        scale = qmax / jnp.maximum(absmax, 1e-20)
+        q = jnp.clip(jnp.round(data * scale), qmin, qmax).astype(qdt)
+        return q, -absmax, absmax
+    scale = (qmax - qmin) / jnp.maximum(rmax - rmin, 1e-20)
+    q = jnp.clip(jnp.round((data - rmin) * scale) + qmin, qmin,
+                 qmax).astype(qdt)
+    return q, rmin, rmax
+
+
+@register_op("_contrib_quantize_v2", aliases=("quantize_v2",),
+             num_outputs=3, differentiable=False)
+def _quantize_v2(data, out_type="int8", min_calib_range=None,
+                 max_calib_range=None):
+    """Quantize with self-calibration when no ranges are given
+    (ref: quantize_v2.cc)."""
+    if min_calib_range is None or max_calib_range is None:
+        rmin = jnp.min(data)
+        rmax = jnp.max(data)
+    else:
+        rmin = jnp.asarray(min_calib_range, jnp.float32)
+        rmax = jnp.asarray(max_calib_range, jnp.float32)
+    return _quantize(data, rmin, rmax, out_type=out_type)
+
+
+@register_op("_contrib_dequantize", aliases=("dequantize",),
+             differentiable=False)
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    """Invert the affine quantization (ref: dequantize.cc)."""
+    rmin = min_range.reshape(())
+    rmax = max_range.reshape(())
+    if data.dtype == jnp.int8:
+        absmax = jnp.maximum(jnp.abs(rmin), jnp.abs(rmax))
+        return data.astype(jnp.float32) * (absmax / 127.0)
+    scale = (rmax - rmin) / 255.0
+    return data.astype(jnp.float32) * scale + rmin
+
+
+@register_op("_contrib_requantize", aliases=("requantize",), num_outputs=3,
+             differentiable=False)
+def _requantize(data, min_range, max_range, out_type="int8",
+                min_calib_range=None, max_calib_range=None):
+    """int32 accumulator -> int8 with recalibrated ranges
+    (ref: requantize.cc)."""
+    if data.dtype != jnp.int32:
+        raise MXNetError("requantize expects int32 input")
+    f = _dequantize_int32(data, min_range, max_range)
+    if min_calib_range is not None and max_calib_range is not None:
+        rmin = jnp.asarray(min_calib_range, jnp.float32)
+        rmax = jnp.asarray(max_calib_range, jnp.float32)
+    else:
+        rmin = jnp.min(f)
+        rmax = jnp.max(f)
+    return _quantize(f, rmin, rmax, out_type=out_type)
+
+
+def _dequantize_int32(data, min_range, max_range):
+    absmax = jnp.maximum(jnp.abs(min_range.reshape(())),
+                         jnp.abs(max_range.reshape(())))
+    return data.astype(jnp.float32) * (absmax / float(2 ** 31 - 1))
+
+
+def _register_quantized_stub(name: str):
+    def stub(*args, **kwargs):
+        raise MXNetError(
+            f"{name} is not provided as a standalone kernel on TPU: the "
+            "MXU's low-precision path is bf16 (or XLA-chosen int8 with "
+            "fp32 accumulate).  Express int8 inference as "
+            "quantize_v2 -> normal ops -> dequantize, or train/serve in "
+            "bfloat16 (net.cast('bfloat16')) for the native fast path.")
+
+    stub.__name__ = name
+    register_op(name, differentiable=False, no_jit=True)(stub)
+
+
+for _name in ("_contrib_quantized_conv", "_contrib_quantized_fully_connected",
+              "_contrib_quantized_pooling", "_contrib_quantized_flatten",
+              "_contrib_quantized_act", "_contrib_quantized_concat",
+              "_contrib_quantized_elemwise_add"):
+    _register_quantized_stub(_name)

@@ -60,6 +60,79 @@ class Operator:
         # eager-only op: output shape depends on input VALUES (boolean_mask)
         # — cannot be traced/jitted; invoke calls fn on concrete arrays
         self.no_jit = no_jit
+        self._build_descriptor()
+
+    # ---- typed attribute descriptor (the dmlc::Parameter role:
+    # DMLC_DECLARE_PARAMETER declares name/type/default per op attr and
+    # rejects unknown kwargs; here the descriptor is derived from the pure
+    # fn's signature — parameters with defaults are attrs, the rest are
+    # array inputs) -------------------------------------------------------
+    def _build_descriptor(self):
+        import inspect
+
+        self.attr_defaults: Dict[str, Any] = {}
+        self.input_names: List[str] = []
+        self.allow_any_attr = False
+        try:
+            sig = inspect.signature(self.fn)
+        except (TypeError, ValueError):
+            self.allow_any_attr = True
+            return
+        for p in sig.parameters.values():
+            if p.kind == inspect.Parameter.VAR_KEYWORD:
+                self.allow_any_attr = True
+            elif p.kind == inspect.Parameter.VAR_POSITIONAL:
+                self.input_names.append("*" + p.name)
+            elif p.default is inspect.Parameter.empty:
+                self.input_names.append(p.name)
+            else:
+                self.attr_defaults[p.name] = p.default
+
+    def validate_attrs(self, attrs: dict) -> dict:
+        """Reject unknown attributes loudly and coerce reference-style
+        string values ("(3, 3)", "64", "True") to the declared type.
+        Returns the (possibly coerced) attrs dict."""
+        if self.allow_any_attr:
+            return attrs
+        out = None
+        for k, v in attrs.items():
+            if k not in self.attr_defaults:
+                if k.startswith("__"):  # scope attrs (__lr_mult__ etc)
+                    continue
+                raise MXNetError(
+                    f"operator {self.name!r} has no attribute {k!r}; "
+                    f"valid attributes: {sorted(self.attr_defaults)} "
+                    f"(array inputs: {self.input_names})")
+            d = self.attr_defaults[k]
+            if isinstance(v, str) and d is not None \
+                    and not isinstance(d, str):
+                import ast
+
+                try:
+                    cv = ast.literal_eval(v)
+                except (ValueError, SyntaxError):
+                    raise MXNetError(
+                        f"operator {self.name!r} attribute {k!r}: cannot "
+                        f"parse {v!r} as {type(d).__name__}")
+                if out is None:
+                    out = dict(attrs)
+                out[k] = cv
+        return attrs if out is None else out
+
+    @property
+    def param_doc(self) -> str:
+        """Generated parameter section (ref: dmlc Parameter __DOC__)."""
+        lines = []
+        if self.input_names:
+            lines.append("Array inputs: " + ", ".join(self.input_names))
+        if self.attr_defaults:
+            lines.append("Attributes:")
+            for k, d in self.attr_defaults.items():
+                tname = type(d).__name__ if d is not None else "optional"
+                lines.append(f"    {k} : {tname}, default {d!r}")
+        if self.allow_any_attr:
+            lines.append("(accepts free-form keyword attributes)")
+        return "\n".join(lines)
 
     def nout(self, attrs: dict) -> int:
         if callable(self.num_outputs):
@@ -212,6 +285,7 @@ def invoke(op_name: str, *inputs, **attrs):
             ctx = ctx or x.ctx
         else:
             arrays.append(x)
+    attrs = op.validate_attrs(attrs)  # loud unknown-attr errors + coercion
     attrs_key = freeze_attrs(attrs)
     with profile_op(op.name):
         if op.no_jit:

@@ -580,6 +580,8 @@ def make_symbol_function(op_name: str):
             if len(s._heads) != 1:
                 raise MXNetError(f"{op.name}: group symbol not allowed as input")
             heads.append(s._heads[0])
+        # typo'd attributes fail at COMPOSITION time, not bind time
+        attrs = op.validate_attrs(attrs)
         try:
             nout = op.nout(attrs)
         except Exception:
@@ -591,7 +593,8 @@ def make_symbol_function(op_name: str):
 
     fn.__name__ = op_name
     fn.__qualname__ = op_name
-    fn.__doc__ = f"Symbolic wrapper for registered op '{op_name}'."
+    fn.__doc__ = (f"Symbolic wrapper for registered op '{op_name}'.\n\n"
+                  f"{op.param_doc}")
     return fn
 
 

@@ -152,13 +152,14 @@ class ImagePipeline {
     if (reader_.joinable()) reader_.join();
     engine_.WaitForAll();
     for (auto& kv : done_) delete kv.second;
+    if (f_) std::fclose(f_);
   }
 
   // next completed batch in order; nullptr at epoch end
   Batch* Next() {
     std::unique_lock<std::mutex> lk(m_);
     cv_out_.wait(lk, [this] {
-      return stop_ ||
+      return stop_ || !error_.empty() ||
              (!done_.empty() && done_.begin()->first == next_out_) ||
              (reader_eof_ && next_out_ == next_seq_);
     });
@@ -187,6 +188,7 @@ class ImagePipeline {
     done_.clear();
     stop_ = false;
     reader_eof_ = false;
+    error_.clear();  // a failed epoch must not poison the next one
     in_flight_ = 0;
     next_out_ = next_seq_ = 0;
     std::fseek(f_, 0, SEEK_SET);
@@ -326,7 +328,18 @@ class ImagePipeline {
       std::mt19937_64 rng(cfg_.seed + 0x9e3779b97f4a7c15ull * (epoch_ + 1));
       std::shuffle(order_.begin(), order_.end(), rng);
     }
-    reader_ = std::thread([this] { ReaderLoop(); });
+    reader_ = std::thread([this] {
+      // reader errors (corrupt shard: bad magic, truncation) surface as
+      // MXNetError from Next(), never std::terminate
+      try {
+        ReaderLoop();
+      } catch (const std::exception& e) {
+        std::lock_guard<std::mutex> lk(m_);
+        if (error_.empty()) error_ = e.what();
+        reader_eof_ = true;
+        cv_out_.notify_all();
+      }
+    });
   }
 
   bool ReadRecordAt(size_t pos_idx, std::string* out) {

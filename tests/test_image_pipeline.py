@@ -252,3 +252,49 @@ def test_pipeline_corrupt_label_count_is_loud(tmp_path):
             if pipe.next() is None:
                 break
     pipe.close()
+
+
+def test_pipeline_reset_clears_error(tmp_path):
+    """One bad epoch must not poison the pipeline after Reset."""
+    import cv2
+
+    rec_path = str(tmp_path / "mixed.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    rec.write(recordio.pack(recordio.IRHeader(0, 0.0, 0, 0), b"garbage"))
+    rec.close()
+    pipe = lib.NativeImagePipeline(
+        rec_path, None, batch=1, channels=3, height=8, width=8,
+        label_width=1, threads=1)
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError):
+        for _ in range(4):
+            if pipe.next() is None:
+                break
+    # rewrite the shard with a valid image, reset, read cleanly
+    ok, buf = cv2.imencode(".png", np.full((8, 8, 3), 7, np.uint8))
+    rec = recordio.MXRecordIO(rec_path, "w")
+    rec.write(recordio.pack(recordio.IRHeader(0, 2.0, 0, 0), buf.tobytes()))
+    rec.close()
+    pipe.reset()
+    data, label, pad = pipe.next()
+    assert label[0, 0] == 2.0
+    pipe.close()
+
+
+def test_pipeline_corrupt_shard_is_loud_not_fatal(tmp_path):
+    """Bad record magic mid-shard raises MXNetError (reader-thread errors
+    must never std::terminate the process)."""
+    rec_path = str(tmp_path / "badmagic.rec")
+    with open(rec_path, "wb") as f:
+        f.write(b"\x00" * 64)  # not a recordio stream at all
+    pipe = lib.NativeImagePipeline(
+        rec_path, None, batch=2, channels=3, height=8, width=8,
+        label_width=1, threads=1)
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError, match="magic|truncated"):
+        for _ in range(4):
+            if pipe.next() is None:
+                break
+    pipe.close()

@@ -1,0 +1,337 @@
+"""Tests for the round-3 op-surface push: linalg completion, CustomOp,
+image ops, quantization (model: tests/python/unittest/test_operator.py
+linalg section, test_operator.py::test_custom_op, test_image.py,
+test_quantization.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _rand_pd(n, rng):
+    a = rng.randn(n, n).astype("float32")
+    return a @ a.T + n * np.eye(n, dtype="float32")
+
+
+# ---------------------------------------------------------------------------
+# linalg
+# ---------------------------------------------------------------------------
+
+def test_linalg_gemm():
+    rng = np.random.RandomState(0)
+    a = rng.randn(3, 4).astype("float32")
+    b = rng.randn(4, 5).astype("float32")
+    c = rng.randn(3, 5).astype("float32")
+    got = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c),
+                         alpha=2.0, beta=0.5).asnumpy()
+    assert_almost_equal(got, 2.0 * a @ b + 0.5 * c, rtol=1e-5, atol=1e-5)
+    got_t = nd.linalg_gemm(nd.array(a.T), nd.array(b), nd.array(c),
+                           transpose_a=True).asnumpy()
+    assert_almost_equal(got_t, a @ b + c, rtol=1e-5, atol=1e-5)
+
+
+def test_linalg_potri_inverts():
+    rng = np.random.RandomState(1)
+    a = _rand_pd(5, rng)
+    l = nd.linalg_potrf(nd.array(a))
+    ainv = nd.linalg_potri(l).asnumpy()
+    assert_almost_equal(ainv @ a, np.eye(5, dtype="float32"), rtol=1e-3,
+                        atol=1e-3)
+
+
+def test_linalg_trmm_trsm_roundtrip():
+    rng = np.random.RandomState(2)
+    a = np.tril(rng.randn(4, 4).astype("float32")) + 4 * np.eye(4, dtype="f4")
+    b = rng.randn(4, 3).astype("float32")
+    prod = nd.linalg_trmm(nd.array(a), nd.array(b), alpha=2.0)
+    back = nd.linalg_trsm(nd.array(a), prod, alpha=0.5).asnumpy()
+    assert_almost_equal(back, b, rtol=1e-4, atol=1e-4)
+    # rightside: X = B @ tril(A); solve recovers B
+    prod_r = nd.linalg_trmm(nd.array(a), nd.array(b.T), rightside=True)
+    back_r = nd.linalg_trsm(nd.array(a), prod_r, rightside=True).asnumpy()
+    assert_almost_equal(back_r, b.T, rtol=1e-4, atol=1e-4)
+
+
+def test_linalg_det_inverse_slogdet():
+    rng = np.random.RandomState(3)
+    a = _rand_pd(4, rng)
+    det = float(nd.linalg_det(nd.array(a)).asnumpy())
+    assert det == pytest.approx(np.linalg.det(a), rel=1e-3)
+    inv = nd.linalg_inverse(nd.array(a)).asnumpy()
+    assert_almost_equal(inv @ a, np.eye(4, dtype="f4"), rtol=1e-3, atol=1e-3)
+    sign, logdet = nd.linalg_slogdet(nd.array(a))
+    assert float(sign.asnumpy()) == 1.0
+    assert float(logdet.asnumpy()) == pytest.approx(np.log(det), rel=1e-4)
+
+
+def test_linalg_syevd_reconstructs():
+    rng = np.random.RandomState(4)
+    a = _rand_pd(5, rng)
+    u, lam = nd.linalg_syevd(nd.array(a))
+    u, lam = u.asnumpy(), lam.asnumpy()
+    # reference convention: A = U^T diag(lam) U
+    assert_almost_equal(u.T @ np.diag(lam) @ u, a, rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_gelqf():
+    rng = np.random.RandomState(5)
+    a = rng.randn(3, 6).astype("float32")
+    l, q = nd.linalg_gelqf(nd.array(a))
+    l, q = l.asnumpy(), q.asnumpy()
+    assert_almost_equal(l @ q, a, rtol=1e-4, atol=1e-4)
+    assert_almost_equal(q @ q.T, np.eye(3, dtype="f4"), rtol=1e-4,
+                        atol=1e-4)
+    assert_almost_equal(l, np.tril(l), rtol=1e-4, atol=1e-4)
+
+
+def test_linalg_diag_trian_roundtrip():
+    rng = np.random.RandomState(6)
+    a = rng.randn(4, 4).astype("float32")
+    d = nd.linalg_extractdiag(nd.array(a)).asnumpy()
+    assert_almost_equal(d, np.diag(a))
+    md = nd.linalg_makediag(nd.array(d)).asnumpy()
+    assert_almost_equal(md, np.diag(np.diag(a)))
+    packed = nd.linalg_extracttrian(nd.array(a))
+    full = nd.linalg_maketrian(packed).asnumpy()
+    assert_almost_equal(full, np.tril(a), rtol=1e-6, atol=1e-6)
+    pd = _rand_pd(3, rng)
+    assert float(nd.linalg_sumlogdiag(nd.array(pd)).asnumpy()) == \
+        pytest.approx(np.sum(np.log(np.diag(pd))), rel=1e-4)
+
+
+def test_linalg_grad_flows():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    rng = np.random.RandomState(7)
+    a = _rand_pd(3, rng)
+    check_numeric_gradient(lambda x: nd.linalg_det(x), [a], rtol=5e-2,
+                           atol=5e-2)
+
+
+# ---------------------------------------------------------------------------
+# CustomOp
+# ---------------------------------------------------------------------------
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], 1.0 / (1.0 + np.exp(-x)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        self.assign(in_grad[0], req[0], out_grad[0].asnumpy() * y * (1 - y))
+
+
+@mx.operator.register("test_sigmoid")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Sigmoid()
+
+
+def test_custom_op_eager_forward_backward():
+    x = np.array([[-1.0, 0.0, 2.0]], "float32")
+    xn = nd.array(x)
+    xn.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(xn, op_type="test_sigmoid")
+    expect = 1.0 / (1.0 + np.exp(-x))
+    assert_almost_equal(y.asnumpy(), expect, rtol=1e-5, atol=1e-6)
+    y.backward()
+    assert_almost_equal(xn.grad.asnumpy(), expect * (1 - expect),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_custom_op_inside_jit():
+    """The host callback must work under jit/trace (hybridized nets)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.registry import apply_pure
+
+    @jax.jit
+    def f(v):
+        return apply_pure("Custom", v, op_type="test_sigmoid") * 2.0
+
+    v = jnp.asarray([0.0, 1.0], jnp.float32)
+    out = np.asarray(f(v))
+    assert_almost_equal(out, 2.0 / (1.0 + np.exp(-np.asarray(v))),
+                        rtol=1e-5, atol=1e-6)
+    g = jax.grad(lambda v: apply_pure(
+        "Custom", v, op_type="test_sigmoid").sum())(v)
+    s = 1.0 / (1.0 + np.exp(-np.asarray(v)))
+    assert_almost_equal(np.asarray(g), s * (1 - s), rtol=1e-5, atol=1e-6)
+
+
+class _TwoOut(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], x * 2)
+        self.assign(out_data[1], req[1], x + 1)
+
+
+@mx.operator.register("test_twoout")
+class _TwoOutProp(mx.operator.CustomOpProp):
+    def list_outputs(self):
+        return ["double", "plus1"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _TwoOut()
+
+
+def test_custom_op_multi_output():
+    x = np.arange(4, dtype="float32")
+    a, b = nd.Custom(nd.array(x), op_type="test_twoout")
+    assert_almost_equal(a.asnumpy(), x * 2)
+    assert_almost_equal(b.asnumpy(), x + 1)
+
+
+def test_custom_op_unknown_type_is_loud():
+    with pytest.raises(MXNetError, match="unknown custom op_type"):
+        nd.Custom(nd.zeros((2,)), op_type="never_registered")
+
+
+# ---------------------------------------------------------------------------
+# image ops
+# ---------------------------------------------------------------------------
+
+def test_image_to_tensor_and_normalize():
+    img = np.random.randint(0, 255, (8, 6, 3), np.uint8)
+    t = nd.image.to_tensor(nd.array(img)).asnumpy()
+    assert t.shape == (3, 8, 6)
+    assert_almost_equal(t, img.transpose(2, 0, 1).astype("f4") / 255.0)
+    n = nd.image.normalize(nd.array(t), mean=(0.5, 0.5, 0.5),
+                           std=(0.1, 0.2, 0.5)).asnumpy()
+    assert_almost_equal(n[1], (t[1] - 0.5) / 0.2, rtol=1e-5, atol=1e-6)
+
+
+def test_image_resize_crop_flip():
+    img = np.arange(4 * 6 * 3, dtype=np.uint8).reshape(4, 6, 3)
+    r = nd.image.resize(nd.array(img), size=(3, 2)).asnumpy()  # (w,h)
+    assert r.shape == (2, 3, 3)
+    c = nd.image.crop(nd.array(img), 1, 0, 4, 3).asnumpy()
+    assert c.shape == (3, 4, 3)
+    assert np.array_equal(c, img[0:3, 1:5])
+    f = nd.image.flip_left_right(nd.array(img)).asnumpy()
+    assert np.array_equal(f, img[:, ::-1])
+
+
+def test_image_random_ops_keyed():
+    mx.random.seed(0)
+    img = np.random.randint(0, 255, (6, 6, 3), np.uint8)
+    outs = {nd.image.random_flip_left_right(nd.array(img))
+            .asnumpy().tobytes() for _ in range(32)}
+    assert len(outs) == 2  # flipped and unflipped both occur
+    b = nd.image.random_brightness(nd.array(img), 0.5, 1.5).asnumpy()
+    assert b.shape == img.shape
+
+
+# ---------------------------------------------------------------------------
+# quantization
+# ---------------------------------------------------------------------------
+
+def test_quantize_dequantize_roundtrip_uint8():
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-3, 8, (4, 5)).astype("float32")
+    q, qmin, qmax = nd.quantize(nd.array(x), nd.array([x.min()]),
+                                nd.array([x.max()]), out_type="uint8")
+    assert q.asnumpy().dtype == np.uint8
+    back = nd.dequantize(q, qmin, qmax).asnumpy()
+    scale = (x.max() - min(x.min(), 0)) / 255.0
+    assert np.abs(back - x).max() <= scale + 1e-5
+
+
+def test_quantize_v2_int8_self_calibrated():
+    rng = np.random.RandomState(1)
+    x = rng.uniform(-2, 2, (64,)).astype("float32")
+    q, qmin, qmax = nd.quantize_v2(nd.array(x), out_type="int8")
+    assert q.asnumpy().dtype == np.int8
+    back = nd.dequantize(q, qmin, qmax).asnumpy()
+    assert np.abs(back - x).max() <= (2.0 / 127) + 1e-5
+
+
+def test_quantized_kernels_raise_informatively():
+    with pytest.raises(MXNetError, match="bf16"):
+        nd._contrib_quantized_conv(nd.zeros((1, 3, 4, 4)))
+
+
+# ---------------------------------------------------------------------------
+# typed op descriptors (the dmlc::Parameter role)
+# ---------------------------------------------------------------------------
+
+def test_unknown_attr_is_loud_eager():
+    x = nd.zeros((2, 3, 8, 8))
+    w = nd.zeros((4, 3, 3, 3))
+    with pytest.raises(MXNetError, match="no attribute 'kernal'"):
+        nd.Convolution(x, w, kernal=(3, 3), num_filter=4)  # typo'd kernel
+
+
+def test_unknown_attr_is_loud_symbol():
+    from mxnet_tpu import symbol as sym
+
+    d = sym.var("data")
+    with pytest.raises(MXNetError, match="no attribute"):
+        sym.Pooling(d, kernel=(2, 2), stridez=(2, 2))  # typo'd stride
+
+
+def test_string_attrs_coerced():
+    """Reference-style string attr values parse to the declared type."""
+    x = nd.array(np.random.randn(2, 12).astype("f4"))
+    got = nd.reshape(x, shape="(2, 3, 4)")
+    assert got.shape == (2, 3, 4)
+    bad = nd.zeros((2, 2))
+    with pytest.raises(MXNetError, match="cannot parse"):
+        nd.sum(bad, keepdims="not-a-bool(")
+
+
+def test_generated_docstrings():
+    assert "num_filter" in nd.Convolution.__doc__
+    assert "Attributes:" in nd.Convolution.__doc__
+    from mxnet_tpu import symbol as sym
+
+    assert "pool_type" in sym.Pooling.__doc__
+
+
+class _TrainAware(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0],
+                    in_data[0].asnumpy() + (1.0 if is_train else 0.0))
+
+
+@mx.operator.register("test_trainaware")
+class _TrainAwareProp(mx.operator.CustomOpProp):
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _TrainAware()
+
+
+def test_custom_op_sees_train_mode():
+    x = nd.zeros((2,))
+    assert nd.Custom(x, op_type="test_trainaware").asnumpy()[0] == 0.0
+    with mx.autograd.record():
+        y = nd.Custom(x, op_type="test_trainaware")
+    assert y.asnumpy()[0] == 1.0
+
+
+def test_linalg_gemm_axis():
+    rng = np.random.RandomState(8)
+    a = rng.randn(3, 2, 4).astype("f4")   # rows axis at 0
+    b = rng.randn(4, 2, 5).astype("f4")
+    c = rng.randn(3, 2, 5).astype("f4")
+    got = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c),
+                         axis=0).asnumpy()
+    expect = np.einsum("ibk,kbj->ibj", a, b) + c
+    assert_almost_equal(got, expect, rtol=1e-5, atol=1e-5)
