@@ -21,6 +21,7 @@ update_on_kvstore, ref kvstore_dist_server.h) are preserved.
 """
 from __future__ import annotations
 
+import functools
 import pickle
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
@@ -44,7 +45,7 @@ class KVStore:
         self._store: Dict[Union[int, str], NDArray] = {}
         self._updater: Optional[Callable] = None
         self._optimizer: Optional[opt_mod.Optimizer] = None
-        self._compression = {}
+        self._compression = None
 
     # ---- identity --------------------------------------------------------
     @property
@@ -75,7 +76,9 @@ class KVStore:
         for k, v in zip(keys, values):
             agg = self._reduce(_as_list(v))
             if self._kind.startswith("dist"):
-                agg = self._dcn_allreduce(agg)
+                agg = self._dcn_allreduce(agg, key=k)
+            elif self._check_compressible(agg):
+                agg = self._compress_roundtrip(k, agg)
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError(f"kvstore key {k} not initialized")
@@ -110,7 +113,9 @@ class KVStore:
         for k, v, o in zip(keys, values, outs):
             agg = self._reduce(_as_list(v))
             if self._kind.startswith("dist"):
-                agg = self._dcn_allreduce(agg)
+                agg = self._dcn_allreduce(agg, key=k)
+            elif self._check_compressible(agg):
+                agg = self._compress_roundtrip(k, agg)
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError(f"kvstore key {k} not initialized")
@@ -159,10 +164,20 @@ class KVStore:
         self._updater = updater
 
     def set_gradient_compression(self, compression_params: dict):
-        """2-bit gradient compression (ref: GradientCompression).
-        Accepted for API parity; XLA collectives run uncompressed over ICI
-        (see also EQuARX-style quantized allreduce as future work)."""
-        self._compression = dict(compression_params)
+        """2-bit gradient compression on the DCN (dist) push path
+        (ref: GradientCompression, gradient_compression.cc): quantize to
+        {0, ±threshold} with residual accumulation, 4 elements/byte on
+        the wire.  Unknown types raise.  The ICI/SPMD path keeps
+        uncompressed in-graph collectives by design."""
+        from . import kvstore_compression
+
+        if self._kind == "local":
+            # reference parity: KVStoreLocal rejects compression; device/
+            # dist stores accept it
+            raise MXNetError(
+                "gradient compression is not supported on 'local' "
+                "kvstore (ref: KVStoreLocal::SetGradientCompression)")
+        self._compression = kvstore_compression.create(compression_params)
 
     def save_optimizer_states(self, fname: str, dump_optimizer=False):
         if self._updater is None:
@@ -186,19 +201,20 @@ class KVStore:
     def _reduce(self, vals: List[NDArray]) -> NDArray:
         """Local reduction across device replicas (ref: comm.h CommDevice;
         row_sparse inputs reduce to a row_sparse with merged indices, like
-        the reference's sparse CommCPU path)."""
+        the reference's sparse CommCPU path).  Dense reduction is ONE
+        jitted balanced-tree sum, not a sequential add chain."""
         from .ndarray.sparse import RowSparseNDArray
 
         if len(vals) == 1:
             return vals[0].copy()
-        acc = vals[0].data if not isinstance(vals[0], RowSparseNDArray) \
-            else vals[0]._data
         dev = vals[0].ctx.jax_device
-        for v in vals[1:]:
+        parts = []
+        for v in vals:
             d = v._data if isinstance(v, RowSparseNDArray) else v.data
             if list(d.devices()) != [dev]:
                 d = jax.device_put(d, dev)
-            acc = acc + d
+            parts.append(d)
+        acc = _tree_sum(len(parts))(*parts)
         if all(isinstance(v, RowSparseNDArray) for v in vals):
             merged = jnp.sort(jnp.unique(jnp.concatenate(
                 [jax.device_put(v._aux["indices"], dev) for v in vals])))
@@ -206,9 +222,42 @@ class KVStore:
                                     ctx=vals[0].ctx)
         return NDArray(acc, ctx=vals[0].ctx)
 
-    def _dcn_allreduce(self, val: NDArray) -> NDArray:
+    def _compress_roundtrip(self, key, val: NDArray) -> NDArray:
+        """Quantize+dequantize on a device-style store — the wire effect
+        of 2-bit compression without a wire (ref: device-kvstore
+        inter-GPU compression)."""
+        import numpy as np
+
+        packed, shape = self._compression.compress(
+            key, np.asarray(jax.device_get(val.data)))
+        return NDArray(jnp.asarray(
+            self._compression.decompress(packed, shape)), ctx=val.ctx)
+
+    def _check_compressible(self, val) -> bool:
+        from .ndarray.sparse import BaseSparseNDArray
+
+        if self._compression is None:
+            return False
+        if isinstance(val, BaseSparseNDArray):
+            # reference parity: row_sparse + compression fails loud, it
+            # never silently sends full-size gradients
+            raise MXNetError(
+                "gradient compression does not support sparse gradients "
+                "(ref: GradientCompression row_sparse check)")
+        return True
+
+    def _dcn_allreduce(self, val: NDArray, key=None) -> NDArray:
         from .parallel import dist
 
+        if key is not None and self._check_compressible(val):
+            import numpy as np
+
+            packed, shape = self._compression.compress(
+                key, np.asarray(jax.device_get(val.data)))
+            gathered = dist.allgather_np(packed)
+            total = sum(self._compression.decompress(g, shape)
+                        for g in gathered)
+            return NDArray(jnp.asarray(total), ctx=val.ctx)
         return dist.allreduce_nd(val)
 
     def _normalize(self, key, value):
@@ -232,6 +281,21 @@ def _key_int(k):
         return int(k)
     except (TypeError, ValueError):
         return abs(hash(k)) % (2 ** 31)
+
+
+@functools.lru_cache(maxsize=None)
+def _tree_sum(n: int):
+    """One fused XLA program summing n same-shaped arrays pairwise."""
+
+    def balanced(xs):
+        while len(xs) > 1:
+            nxt = [xs[i] + xs[i + 1] for i in range(0, len(xs) - 1, 2)]
+            if len(xs) % 2:
+                nxt.append(xs[-1])
+            xs = nxt
+        return xs[0]
+
+    return jax.jit(lambda *xs: balanced(list(xs)))
 
 
 _VALID = {"local", "device", "xla", "nccl", "dist", "dist_sync", "dist_async",

@@ -62,7 +62,10 @@ def Custom(*inputs, op_type=None, **kwargs):
     np_ins = [x.asnumpy() for x in inputs]
     structs = _operator.out_structs_for(
         prop, [a.shape for a in np_ins], [a.dtype for a in np_ins])
-    np_outs = _operator.run_forward_host(prop, np_ins, structs,
+    # ONE operator instance shared forward->backward (user code may stash
+    # forward state on self for backward, reference lifetime semantics)
+    op_inst = _operator.make_operator(prop, np_ins)
+    np_outs = _operator.run_forward_host(op_inst, np_ins, structs,
                                          is_train=autograd.is_training())
     ctx = inputs[0].ctx if inputs else None
     outs = tuple(NDArray(jnp.asarray(o), ctx=ctx) for o in np_outs)
@@ -70,13 +73,13 @@ def Custom(*inputs, op_type=None, **kwargs):
         parents = [(autograd._node_of(x), x) for x in inputs]
 
         def custom_backward(node_cts, _np_ins=np_ins, _np_outs=np_outs,
-                            _prop=prop):
+                            _op=op_inst):
             import jax
 
             np_cts = [np.asarray(jax.device_get(c)) if c is not None
                       else np.zeros(o.shape, o.dtype)
                       for c, o in zip(node_cts, _np_outs)]
-            grads = _operator.run_backward_host(_prop, _np_ins, _np_outs,
+            grads = _operator.run_backward_host(_op, _np_ins, _np_outs,
                                                 np_cts)
             return [jnp.asarray(g) for g in grads]
 

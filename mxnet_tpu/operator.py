@@ -152,11 +152,17 @@ def _custom_nout(attrs) -> int:
     return len(prop_cls(**kw).list_outputs())
 
 
-def run_forward_host(prop: CustomOpProp, np_ins, out_structs,
+def make_operator(prop: CustomOpProp, np_ins) -> CustomOp:
+    return prop.create_operator(None, [list(a.shape) for a in np_ins],
+                                [a.dtype for a in np_ins])
+
+
+def run_forward_host(op: CustomOp, np_ins, out_structs,
                      is_train: bool = True):
-    """Execute the user forward on host numpy arrays."""
-    op = prop.create_operator(None, [list(a.shape) for a in np_ins],
-                              [a.dtype for a in np_ins])
+    """Execute the user forward on host numpy arrays.  The SAME op
+    instance must be passed to run_backward_host — the reference creates
+    one CustomOp per graph node and reuses it, so user code may stash
+    forward state on self for backward (mask patterns etc)."""
     n_out = len(out_structs)
     in_data = [_wrap_host(a) for a in np_ins]
     outs = [np.zeros(s.shape, s.dtype).view(_HostArray)
@@ -166,10 +172,9 @@ def run_forward_host(prop: CustomOpProp, np_ins, out_structs,
     return tuple(np.asarray(o) for o in outs)
 
 
-def run_backward_host(prop: CustomOpProp, np_ins, np_outs, np_cts):
-    """Execute the user backward on host numpy arrays."""
-    op = prop.create_operator(None, [list(a.shape) for a in np_ins],
-                              [a.dtype for a in np_ins])
+def run_backward_host(op: CustomOp, np_ins, np_outs, np_cts):
+    """Execute the user backward on host numpy arrays (same op instance
+    as the forward — see run_forward_host)."""
     in_grad = [np.zeros(a.shape, a.dtype).view(_HostArray) for a in np_ins]
     op.backward(req=["write"] * len(np_ins),
                 out_grad=[_wrap_host(c) for c in np_cts],
@@ -196,15 +201,22 @@ def _build_custom(op_type: str, kw_items: tuple, in_shapes: tuple,
     n_in = len(in_shapes)
     out_structs = out_structs_for(prop, in_shapes, in_dtypes)
     n_out = len(out_structs)
+    # one operator instance per compiled node, shared forward->backward
+    # (reference custom.cc lifetime; concurrent invocations of the same
+    # compiled node share it, as they do in the reference)
+    holder: Dict[str, CustomOp] = {}
 
     def fwd_host(*ins):
-        return run_forward_host(prop, ins, out_structs, is_train=is_train)
+        holder["op"] = make_operator(prop, ins)
+        return run_forward_host(holder["op"], ins, out_structs,
+                                is_train=is_train)
 
     def bwd_host(*args):
         ins = args[:n_in]
         outs = args[n_in:n_in + n_out]
         cts = args[n_in + n_out:]
-        return run_backward_host(prop, ins, outs, cts)
+        op = holder.get("op") or make_operator(prop, ins)
+        return run_backward_host(op, ins, outs, cts)
 
     @jax.custom_vjp
     def run(*ins):

@@ -29,6 +29,7 @@ from .mesh import DeviceMesh, make_mesh, current_mesh, get_mesh
 from .sharding import (ShardingRules, named_sharding, replicated,
                        shard_batch, constraint, DEFAULT_RULES)
 from .spmd import SPMDTrainer, functional_optimizer
+from .checkpoint import save_sharded, load_sharded
 from . import dist
 from . import ring
 from . import pipeline

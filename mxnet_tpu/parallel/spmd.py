@@ -318,7 +318,7 @@ class SPMDTrainer:
 
         self._step_fn = None
         self._fwd_fn = None
-        self._aux_order: List = []
+        self._param_by_name = {n: p for n, p in self._plist}
         self._t = 0
 
     # ---- the pure step ---------------------------------------------------
@@ -342,8 +342,12 @@ class SPMDTrainer:
                     l = loss(outs[0], *labels)
                 lval = jnp.mean(l if not isinstance(l, (list, tuple))
                                 else l[0])
-                trainer._aux_order = list(trace.aux_params)
-                return lval, tuple(trace.aux_values)
+                # aux (BatchNorm moving stats) keyed BY NAME in the traced
+                # outputs — no side-channel ordering that a retrace could
+                # skew (round-1 weak #10)
+                aux_named = {name_of[id(p)]: v for p, v in
+                             zip(trace.aux_params, trace.aux_values)}
+                return lval, aux_named
 
             (lval, aux), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
@@ -403,8 +407,7 @@ class SPMDTrainer:
             # aux state (BatchNorm moving stats) accumulates across steps:
             # fold the traced updates back into the param dict so the next
             # step's trace reads them (stop_gradient — not a learnable path)
-            for p, v in zip(trainer._aux_order, aux):
-                n = name_of[id(p)]
+            for n, v in aux.items():
                 new_params[n] = lax.stop_gradient(v).astype(params[n].dtype)
             return new_params, new_state, lval, aux
 
@@ -457,10 +460,9 @@ class SPMDTrainer:
         step = self._get_step()
         self.params, self.opt_state, lval, aux = step(
             self.params, self.opt_state, ivals, lvals, key, lr, t)
-        # rebind aux state (BatchNorm moving stats)
-        for p, v in zip(self._aux_order, aux):
-            nd = p.data()
-            nd._data = v
+        # rebind aux state (BatchNorm moving stats) by parameter NAME
+        for n, v in aux.items():
+            self._param_by_name[n].data()._data = v
         from ..context import current_context
 
         return NDArray(lval, ctx=current_context())
@@ -471,6 +473,18 @@ class SPMDTrainer:
 
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
+
+    def save_checkpoint(self, path: str):
+        """Sharded (tensorstore) checkpoint of params + optimizer state +
+        step; resumable on a different mesh (see parallel.checkpoint)."""
+        from .checkpoint import save_sharded
+
+        save_sharded(path, self)
+
+    def load_checkpoint(self, path: str):
+        from .checkpoint import load_sharded
+
+        load_sharded(path, self)
 
     def sync_to_block(self):
         """Copy the (sharded) params back into the gluon Parameters —

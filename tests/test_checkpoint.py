@@ -1,0 +1,122 @@
+"""Sharded checkpoint tests: save/restore of SPMDTrainer params +
+optimizer state in tensorstore layout, including resume across a mesh
+shape change (SURVEY.md §5 checkpoint/resume; VERDICT r2 ask #7)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.gluon import loss as gloss
+
+pytest.importorskip("orbax.checkpoint")
+
+
+def _make_net(seed=7):
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    # fixed prefix: checkpoint keys must not depend on how many nets
+    # were created earlier in the process
+    net = mx.gluon.nn.HybridSequential(prefix="net_")
+    with net.name_scope():
+        net.add(mx.gluon.nn.Dense(32, activation="relu"),
+                mx.gluon.nn.Dense(8))
+    net.initialize(ctx=mx.cpu())
+    net(nd.zeros((2, 16)))
+    return net
+
+
+def _gathered(trainer):
+    import jax
+
+    return {n: np.asarray(jax.device_get(v))
+            for n, v in trainer.params.items()}
+
+
+def test_sharded_roundtrip_same_mesh(tmp_path):
+    mesh = parallel.make_mesh(dp=8)
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 16).astype("f4")
+    y = (rng.rand(32) * 8).astype(np.int32)
+    with mesh:
+        tr = parallel.SPMDTrainer(_make_net(), gloss.SoftmaxCrossEntropyLoss(),
+                                  "sgd", {"learning_rate": 0.1,
+                                          "momentum": 0.9})
+        for _ in range(3):
+            tr.step(x, y)
+        tr.save_checkpoint(str(tmp_path / "ckpt"))
+        before = _gathered(tr)
+        t_before = tr._t
+        mom_before = {n: np.asarray(s[0]) for n, s in tr.opt_state.items()}
+
+        tr2 = parallel.SPMDTrainer(_make_net(seed=99),
+                                   gloss.SoftmaxCrossEntropyLoss(),
+                                   "sgd", {"learning_rate": 0.1,
+                                           "momentum": 0.9})
+        tr2.load_checkpoint(str(tmp_path / "ckpt"))
+        after = _gathered(tr2)
+        assert tr2._t == t_before
+        for n in before:
+            np.testing.assert_array_equal(before[n], after[n])
+        for n, m in mom_before.items():
+            np.testing.assert_array_equal(m, np.asarray(tr2.opt_state[n][0]))
+
+
+def test_resume_across_mesh_change_matches_uninterrupted(tmp_path):
+    """Save on an fsdp=8 mesh (params sharded), resume on dp=2 x fsdp=4
+    — the restored run must produce bit-identical training to an
+    uninterrupted run."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(32, 16).astype("f4")
+    y = (rng.rand(32) * 8).astype(np.int32)
+
+    # small fsdp threshold so the tiny test net actually shards
+    rules = parallel.ShardingRules(fsdp_min_size=64)
+
+    # uninterrupted reference: 6 steps on the SECOND mesh layout
+    with parallel.make_mesh(dp=2, fsdp=4):
+        ref = parallel.SPMDTrainer(_make_net(), gloss.SoftmaxCrossEntropyLoss(),
+                                   "sgd", {"learning_rate": 0.1,
+                                           "momentum": 0.9}, rules=rules)
+        ref_losses = [float(ref.step(x, y).asnumpy()) for _ in range(6)]
+        ref_params = _gathered(ref)
+
+    # interrupted: 3 steps on fsdp=8, checkpoint, resume on dp=2 x fsdp=4
+    with parallel.make_mesh(fsdp=8):
+        tr = parallel.SPMDTrainer(_make_net(), gloss.SoftmaxCrossEntropyLoss(),
+                                  "sgd", {"learning_rate": 0.1,
+                                          "momentum": 0.9}, rules=rules)
+        sharded = [n for n in tr.params
+                   if not tr._shardings[n].is_fully_replicated]
+        assert sharded, "fsdp mesh must actually shard some params"
+        for _ in range(3):
+            tr.step(x, y)
+        tr.save_checkpoint(str(tmp_path / "ckpt2"))
+
+    with parallel.make_mesh(dp=2, fsdp=4):
+        tr2 = parallel.SPMDTrainer(_make_net(seed=99),
+                                   gloss.SoftmaxCrossEntropyLoss(),
+                                   "sgd", {"learning_rate": 0.1,
+                                           "momentum": 0.9}, rules=rules)
+        tr2.load_checkpoint(str(tmp_path / "ckpt2"))
+        assert tr2._t == 3
+        resumed = [float(tr2.step(x, y).asnumpy()) for _ in range(3)]
+        res_params = _gathered(tr2)
+
+    np.testing.assert_allclose(resumed, ref_losses[3:], rtol=1e-5)
+    for n in ref_params:
+        np.testing.assert_allclose(res_params[n], ref_params[n],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_load_mismatched_params_is_loud(tmp_path):
+    with parallel.make_mesh(dp=8):
+        tr = parallel.SPMDTrainer(_make_net(), gloss.SoftmaxCrossEntropyLoss(),
+                                  "sgd", {"learning_rate": 0.1})
+        tr.save_checkpoint(str(tmp_path / "ckpt3"))
+        other = mx.gluon.nn.Dense(4)
+        other.initialize(ctx=mx.cpu())
+        other(nd.zeros((1, 16)))
+        tr2 = parallel.SPMDTrainer(other, gloss.SoftmaxCrossEntropyLoss(),
+                                   "sgd", {"learning_rate": 0.1})
+        with pytest.raises(Exception):
+            tr2.load_checkpoint(str(tmp_path / "ckpt3"))

@@ -1,0 +1,136 @@
+"""Gradient-compression tests (ref: tests for gradient_compression.cc /
+test_kvstore.py compression cases)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.kvstore_compression import TwoBitCompressor, create
+
+
+def test_two_bit_quantization_values():
+    c = TwoBitCompressor(threshold=0.5)
+    g = np.array([0.7, -0.6, 0.2, -0.1, 1.4, 0.0], "float32")
+    packed, shape = c.compress("k", g)
+    assert packed.dtype == np.uint8
+    assert len(packed) == 2  # 6 elems -> 2 bytes
+    out = c.decompress(packed, shape)
+    np.testing.assert_array_equal(
+        out, np.array([0.5, -0.5, 0.0, 0.0, 0.5, 0.0], "float32"))
+    # residual carries the quantization error
+    np.testing.assert_allclose(c._residual["k"],
+                               g - out, rtol=1e-6)
+
+
+def test_two_bit_residual_accumulates():
+    """Repeated small gradients must eventually emit via the residual."""
+    c = TwoBitCompressor(threshold=0.5)
+    g = np.full((8,), 0.2, "float32")
+    sent = np.zeros(8, "float32")
+    for _ in range(10):
+        packed, shape = c.compress("k", g)
+        sent += c.decompress(packed, shape)
+    # 10 * 0.2 = 2.0 total; sent must be within one threshold of that
+    np.testing.assert_allclose(sent, 2.0, atol=0.5)
+
+
+def test_compression_wire_size():
+    c = TwoBitCompressor()
+    g = np.random.randn(1000).astype("float32")
+    packed, _ = c.compress("k", g)
+    assert len(packed) == 250  # 16x smaller than fp32
+
+
+def test_create_unknown_type_is_loud():
+    with pytest.raises(MXNetError, match="unknown gradient compression"):
+        create({"type": "8bit"})
+    with pytest.raises(MXNetError, match="not implemented"):
+        create({"type": "1bit"})
+
+
+def test_kvstore_dist_push_applies_compression():
+    """dist kvstore + 2bit: the pushed value is the quantized gradient
+    (observable single-process: allgather degenerates to self)."""
+    kv = mx.kvstore.create("dist_sync")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(0, nd.zeros((4,)))
+    kv.push(0, nd.array(np.array([0.8, -0.9, 0.1, 0.0], "f4")))
+    out = nd.zeros((4,))
+    kv.pull(0, out)
+    np.testing.assert_array_equal(
+        out.asnumpy(), np.array([0.5, -0.5, 0.0, 0.0], "f4"))
+    # second push: residual (0.3, -0.4, 0.1, 0) + new grad crosses thresh
+    kv.push(0, nd.array(np.array([0.3, -0.2, 0.0, 0.0], "f4")))
+    kv.pull(0, out)
+    np.testing.assert_array_equal(
+        out.asnumpy(), np.array([0.5, -0.5, 0.0, 0.0], "f4"))
+
+
+def test_kvstore_local_compression_rejected():
+    kv = mx.kvstore.create("local")
+    with pytest.raises(MXNetError, match="not supported on 'local'"):
+        kv.set_gradient_compression({"type": "2bit"})
+
+
+class _StatefulReLU(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.mask = (x > 0).astype("float32")  # stashed for backward
+        self.assign(out_data[0], req[0], x * self.mask)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0].asnumpy() * self.mask)
+
+
+@mx.operator.register("test_stateful_relu")
+class _StatefulReLUProp(mx.operator.CustomOpProp):
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _StatefulReLU()
+
+
+def test_custom_op_state_shared_fwd_bwd():
+    """The standard mask pattern: forward stashes state on self, backward
+    reads it — the SAME operator instance must serve both."""
+    x = nd.array(np.array([-1.0, 2.0, -3.0, 4.0], "f4"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(x, op_type="test_stateful_relu")
+    y.backward()
+    np.testing.assert_array_equal(x.grad.asnumpy(), [0.0, 1.0, 0.0, 1.0])
+    # traced path shares the instance too
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import apply_pure
+
+    g = jax.grad(lambda v: apply_pure(
+        "Custom", v, op_type="test_stateful_relu").sum())(
+        jnp.asarray([-1.0, 2.0], jnp.float32))
+    np.testing.assert_array_equal(np.asarray(g), [0.0, 1.0])
+
+
+def test_device_store_compression_roundtrips():
+    """Reference parity: 'device' stores accept compression (only
+    'local' rejects); the pushed value is quantized."""
+    kv = mx.kvstore.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(0, nd.zeros((3,)))
+    kv.push(0, nd.array(np.array([0.9, -0.7, 0.1], "f4")))
+    out = nd.zeros((3,))
+    kv.pull(0, out)
+    np.testing.assert_array_equal(out.asnumpy(), [0.5, -0.5, 0.0])
+
+
+def test_sparse_plus_compression_is_loud():
+    from mxnet_tpu.ndarray import sparse as sp
+
+    kv = mx.kvstore.create("dist_sync")
+    kv.set_gradient_compression({"type": "2bit"})
+    rs = sp.row_sparse_array(
+        (np.ones((2, 3), "f4"), np.array([0, 2])), shape=(4, 3))
+    kv.init(0, nd.zeros((4, 3)))
+    with pytest.raises(MXNetError, match="sparse"):
+        kv.push(0, rs)

@@ -280,3 +280,54 @@ def test_spmd_trainer_bf16_master_weights():
         # master must have accumulated a visible decrease
         assert master.max() < 1.0 - 1e-3, master
         assert master.dtype == np.float32
+
+
+def test_spmd_trainer_retrace_on_shape_change():
+    """Mid-training input-shape change retraces the step; BN aux stats
+    must keep flowing correctly (aux is keyed by name in the traced
+    outputs, not by a trace-order side channel)."""
+    mesh = parallel.make_mesh(dp=1)
+    with mesh:
+        net = mx.gluon.nn.HybridSequential()
+        net.add(mx.gluon.nn.Dense(8), mx.gluon.nn.BatchNorm(),
+                mx.gluon.nn.Dense(4))
+        net.initialize(ctx=mx.cpu())
+        net(mx.nd.zeros((2, 6)))
+        trainer = parallel.SPMDTrainer(
+            net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1})
+        bn = [b for b in net._children.values()
+              if isinstance(b, mx.gluon.nn.BatchNorm)][0]
+        rng = np.random.RandomState(0)
+        for bs in (16, 16, 24, 16, 32):  # shape changes force retraces
+            x = (rng.randn(bs, 6) * 2 + 1).astype("f4")
+            y = (rng.rand(bs) * 4).astype(np.int32)
+            loss = trainer.step(x, y)
+            assert np.isfinite(float(loss.asnumpy()))
+        # moving stats moved off their init and stayed finite
+        mm = bn.running_mean.data().asnumpy()
+        mv = bn.running_var.data().asnumpy()
+        assert np.isfinite(mm).all() and np.isfinite(mv).all()
+        assert not np.allclose(mm, 0.0)
+        assert not np.allclose(mv, 1.0)
+
+
+def test_dist_async_emulation_pin():
+    """dist_async is served by the dist_sync path (documented emulation:
+    synchronous application is a legal schedule of async). Pin the
+    observable semantics so a behavioral change is caught."""
+    kv = mx.kvstore.create("dist_async")
+    assert kv.type == "dist_async"
+    assert kv.num_workers == 1  # single-process here
+    kv.init(0, mx.nd.zeros((3,)))
+    kv.push(0, mx.nd.array(np.array([1.0, 2.0, 3.0], "f4")))
+    out = mx.nd.zeros((3,))
+    kv.pull(0, out)
+    # same-result-as-sync pin: push overwrites the stored value
+    np.testing.assert_array_equal(out.asnumpy(), [1.0, 2.0, 3.0])
+    sync = mx.kvstore.create("dist_sync")
+    sync.init(0, mx.nd.zeros((3,)))
+    sync.push(0, mx.nd.array(np.array([1.0, 2.0, 3.0], "f4")))
+    out2 = mx.nd.zeros((3,))
+    sync.pull(0, out2)
+    np.testing.assert_array_equal(out.asnumpy(), out2.asnumpy())
