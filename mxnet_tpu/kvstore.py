@@ -222,14 +222,18 @@ class KVStore:
                                     ctx=vals[0].ctx)
         return NDArray(acc, ctx=vals[0].ctx)
 
+    def _compress_nd(self, key, val: NDArray):
+        """Quantize one dense NDArray -> (packed codes, shape)."""
+        import numpy as np
+
+        return self._compression.compress(
+            key, np.asarray(jax.device_get(val.data)))
+
     def _compress_roundtrip(self, key, val: NDArray) -> NDArray:
         """Quantize+dequantize on a device-style store — the wire effect
         of 2-bit compression without a wire (ref: device-kvstore
         inter-GPU compression)."""
-        import numpy as np
-
-        packed, shape = self._compression.compress(
-            key, np.asarray(jax.device_get(val.data)))
+        packed, shape = self._compress_nd(key, val)
         return NDArray(jnp.asarray(
             self._compression.decompress(packed, shape)), ctx=val.ctx)
 
@@ -250,10 +254,7 @@ class KVStore:
         from .parallel import dist
 
         if key is not None and self._check_compressible(val):
-            import numpy as np
-
-            packed, shape = self._compression.compress(
-                key, np.asarray(jax.device_get(val.data)))
+            packed, shape = self._compress_nd(key, val)
             gathered = dist.allgather_np(packed)
             total = sum(self._compression.decompress(g, shape)
                         for g in gathered)

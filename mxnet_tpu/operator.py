@@ -157,6 +157,34 @@ def make_operator(prop: CustomOpProp, np_ins) -> CustomOp:
                                 [a.dtype for a in np_ins])
 
 
+# live operator instances awaiting their backward, keyed by token.
+# Bounded: primal-only executions never pop theirs (no backward runs),
+# so the oldest entries are evicted instead of leaking.
+import collections
+import itertools
+import threading
+
+_LIVE_LOCK = threading.Lock()
+_LIVE_OPS: "collections.OrderedDict[int, CustomOp]" = \
+    collections.OrderedDict()
+_LIVE_CAP = 4096
+_NEXT_TOKEN = itertools.count(1)
+
+
+def _stash_op(op: CustomOp) -> int:
+    with _LIVE_LOCK:
+        tok = next(_NEXT_TOKEN) % (2 ** 31 - 1)
+        _LIVE_OPS[tok] = op
+        while len(_LIVE_OPS) > _LIVE_CAP:
+            _LIVE_OPS.popitem(last=False)
+    return tok
+
+
+def _pop_op(tok: int):
+    with _LIVE_LOCK:
+        return _LIVE_OPS.pop(tok, None)
+
+
 def run_forward_host(op: CustomOp, np_ins, out_structs,
                      is_train: bool = True):
     """Execute the user forward on host numpy arrays.  The SAME op
@@ -201,39 +229,42 @@ def _build_custom(op_type: str, kw_items: tuple, in_shapes: tuple,
     n_in = len(in_shapes)
     out_structs = out_structs_for(prop, in_shapes, in_dtypes)
     n_out = len(out_structs)
-    # one operator instance per compiled node, shared forward->backward
-    # (reference custom.cc lifetime; concurrent invocations of the same
-    # compiled node share it, as they do in the reference)
-    holder: Dict[str, CustomOp] = {}
+    # Each forward execution creates ONE operator instance whose id rides
+    # the custom_vjp residuals as a token, so the matching backward — and
+    # only it — gets that exact instance back (reference custom.cc
+    # lifetime: per-node state like forward-stashed masks stays paired
+    # even when the same compiled op runs many times before backprop).
+    out_structs_tok = out_structs + (
+        jax.ShapeDtypeStruct((), np.int32),)  # x64 is disabled
 
     def fwd_host(*ins):
-        holder["op"] = make_operator(prop, ins)
-        return run_forward_host(holder["op"], ins, out_structs,
-                                is_train=is_train)
+        op = make_operator(prop, ins)
+        outs = run_forward_host(op, ins, out_structs, is_train=is_train)
+        return outs + (np.int32(_stash_op(op)),)
 
-    def bwd_host(*args):
+    def bwd_host(tok, *args):
         ins = args[:n_in]
         outs = args[n_in:n_in + n_out]
         cts = args[n_in + n_out:]
-        op = holder.get("op") or make_operator(prop, ins)
+        op = _pop_op(int(tok)) or make_operator(prop, ins)
         return run_backward_host(op, ins, outs, cts)
 
     @jax.custom_vjp
     def run(*ins):
-        out = jax.pure_callback(fwd_host, out_structs, *ins)
+        out = jax.pure_callback(fwd_host, out_structs_tok, *ins)[:n_out]
         return out if n_out > 1 else out[0]
 
     def run_fwd(*ins):
-        out = jax.pure_callback(fwd_host, out_structs, *ins)
-        primal = out if n_out > 1 else out[0]
-        return primal, (ins, out)
+        *outs, tok = jax.pure_callback(fwd_host, out_structs_tok, *ins)
+        primal = tuple(outs) if n_out > 1 else outs[0]
+        return primal, (ins, tuple(outs), tok)
 
     def run_bwd(res, cts):
-        ins, outs = res
+        ins, outs, tok = res
         cts = cts if isinstance(cts, tuple) else (cts,)
         grad_structs = tuple(
             jax.ShapeDtypeStruct(a.shape, a.dtype) for a in ins)
-        grads = jax.pure_callback(bwd_host, grad_structs,
+        grads = jax.pure_callback(bwd_host, grad_structs, tok,
                                   *ins, *outs, *cts)
         return tuple(grads)
 

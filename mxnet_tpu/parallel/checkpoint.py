@@ -35,6 +35,18 @@ def _checkpointer():
     return ocp
 
 
+def _saved_param_names(ckptr, path: str):
+    """Param names recorded in the checkpoint's metadata, or None when
+    the metadata shape is not recognized (older orbax layouts)."""
+    try:
+        meta = ckptr.metadata(path)
+        tree = getattr(meta, "item_metadata", None) or getattr(
+            meta, "tree", None) or meta
+        return set(tree["params"].keys())
+    except Exception:
+        return None
+
+
 def _tree_of(trainer) -> Dict[str, Any]:
     return {
         "params": dict(trainer.params),
@@ -74,12 +86,16 @@ def load_sharded(path: str, trainer) -> None:
         "step": jax.ShapeDtypeStruct((), np.int64),
     }
     with ocp.StandardCheckpointer() as ckptr:
+        # friendly mismatch error BEFORE orbax's structural restore check
+        saved = _saved_param_names(ckptr, path)
+        if saved is not None and saved != set(trainer.params):
+            raise MXNetError(
+                "checkpoint parameter set does not match the model: "
+                f"missing from checkpoint "
+                f"{sorted(set(trainer.params) - saved)}, "
+                f"unexpected in checkpoint "
+                f"{sorted(saved - set(trainer.params))}")
         restored = ckptr.restore(path, abstract)
-    if set(restored["params"]) != set(trainer.params):
-        raise MXNetError(
-            "checkpoint parameter set does not match the model: "
-            f"missing {sorted(set(trainer.params) - set(restored['params']))}, "
-            f"unexpected {sorted(set(restored['params']) - set(trainer.params))}")
     trainer.params = dict(restored["params"])
     trainer.opt_state = {n: tuple(s)
                          for n, s in restored["opt_state"].items()}

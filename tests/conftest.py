@@ -18,7 +18,11 @@ os.environ.setdefault("MXNET_TEST_SEED", "0")
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+# MXNET_TEST_PLATFORM=tpu keeps the real accelerator visible for the
+# opt-in on-device suite (tests/test_tpu_device.py, run via
+# tools/run_tpu_tests.py); default pins the virtual-8-device CPU backend.
+if os.environ.get("MXNET_TEST_PLATFORM") != "tpu":
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -134,3 +134,23 @@ def test_sparse_plus_compression_is_loud():
     kv.init(0, nd.zeros((4, 3)))
     with pytest.raises(MXNetError, match="sparse"):
         kv.push(0, rs)
+
+
+def test_custom_op_instance_pairing_traced():
+    """Two uses of the same stateful custom op inside ONE traced function
+    must each get their own operator instance: backward(a) reads a's
+    mask, not b's (tokens through the custom_vjp residuals)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import apply_pure
+
+    def f(a, b):
+        ya = apply_pure("Custom", a, op_type="test_stateful_relu")
+        yb = apply_pure("Custom", b, op_type="test_stateful_relu")
+        return ya.sum() + yb.sum()
+
+    a = jnp.asarray([-1.0, 2.0], jnp.float32)
+    b = jnp.asarray([3.0, -4.0], jnp.float32)
+    ga, gb = jax.grad(f, argnums=(0, 1))(a, b)
+    np.testing.assert_array_equal(np.asarray(ga), [0.0, 1.0])
+    np.testing.assert_array_equal(np.asarray(gb), [1.0, 0.0])

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Run the opt-in REAL-DEVICE suite (tests/test_tpu_device.py) on the
+TPU and write a committed artifact with the results.
+
+Counterpart of the reference's GPU test lane
+(tests/python/gpu/test_operator_gpu.py in CI).  Usage:
+
+    python tools/run_tpu_tests.py [--out TPU_TESTS.json]
+
+Sets MXNET_TEST_PLATFORM=tpu so tests/conftest.py keeps the accelerator
+visible, runs pytest on the on-device module, and writes
+{passed, failed, skipped, duration_s, device, cases} as JSON.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(_REPO, "TPU_TESTS.json"))
+    ap.add_argument("--timeout", type=float, default=1500.0)
+    args = ap.parse_args()
+
+    env = dict(os.environ, MXNET_TEST_PLATFORM="tpu")
+    t0 = time.time()
+    try:
+        p = subprocess.run(
+            [sys.executable, "-m", "pytest",
+             os.path.join(_REPO, "tests", "test_tpu_device.py"),
+             "-v", "--tb=line", "-rN"],
+            capture_output=True, text=True, timeout=args.timeout, env=env,
+            cwd=_REPO)
+        out = p.stdout
+        rc = p.returncode
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) \
+            else (e.stdout or "")
+        rc = -1
+    dur = time.time() - t0
+
+    cases = {}
+    for ln in out.splitlines():
+        m = re.match(r"tests/test_tpu_device\.py::(\S+)\s+(PASSED|FAILED|"
+                     r"SKIPPED|ERROR)", ln)
+        if m:
+            cases[m.group(1)] = m.group(2)
+    tally = re.search(r"(\d+) passed", out)
+    failed = re.search(r"(\d+) failed", out)
+    skipped = re.search(r"(\d+) skipped", out)
+
+    device = "unknown"
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].device_kind)"],
+            capture_output=True, text=True, timeout=120)
+        if probe.returncode == 0:
+            device = probe.stdout.strip().splitlines()[-1]
+    except Exception:
+        pass
+
+    artifact = {
+        "suite": "tests/test_tpu_device.py",
+        "device": device,
+        "passed": int(tally.group(1)) if tally else 0,
+        "failed": int(failed.group(1)) if failed else 0,
+        "skipped": int(skipped.group(1)) if skipped else 0,
+        "duration_s": round(dur, 1),
+        "returncode": rc,
+        "cases": cases,
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({k: v for k, v in artifact.items() if k != "cases"}))
+    return 0 if rc == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
