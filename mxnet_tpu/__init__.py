@@ -25,6 +25,7 @@ from . import random
 from . import profiler
 from . import serialization
 from . import operator
+from . import storage
 
 __all__ = [
     "MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",

@@ -180,9 +180,14 @@ def _stash_op(op: CustomOp) -> int:
     return tok
 
 
-def _pop_op(tok: int):
+def _get_op(tok: int):
+    """Fetch WITHOUT popping (a vjp may be applied repeatedly); entries
+    age out of the bounded LRU instead."""
     with _LIVE_LOCK:
-        return _LIVE_OPS.pop(tok, None)
+        op = _LIVE_OPS.get(tok)
+        if op is not None:
+            _LIVE_OPS.move_to_end(tok)
+        return op
 
 
 def run_forward_host(op: CustomOp, np_ins, out_structs,
@@ -237,6 +242,12 @@ def _build_custom(op_type: str, kw_items: tuple, in_shapes: tuple,
     out_structs_tok = out_structs + (
         jax.ShapeDtypeStruct((), np.int32),)  # x64 is disabled
 
+    def fwd_host_plain(*ins):
+        # primal-only path: no backward will come, so nothing is stashed
+        # (stashing here would flood the LRU and evict grad-pending ops)
+        op = make_operator(prop, ins)
+        return run_forward_host(op, ins, out_structs, is_train=is_train)
+
     def fwd_host(*ins):
         op = make_operator(prop, ins)
         outs = run_forward_host(op, ins, out_structs, is_train=is_train)
@@ -246,12 +257,18 @@ def _build_custom(op_type: str, kw_items: tuple, in_shapes: tuple,
         ins = args[:n_in]
         outs = args[n_in:n_in + n_out]
         cts = args[n_in + n_out:]
-        op = _pop_op(int(tok)) or make_operator(prop, ins)
+        op = _get_op(int(tok))  # NOT popped: repeated vjp application
+        if op is None:
+            raise MXNetError(
+                f"Custom op {op_type!r}: the operator instance for this "
+                "backward was evicted (more than "
+                f"{_LIVE_CAP} grad-pending Custom forwards in flight) — "
+                "cannot silently rebuild stateful backward")
         return run_backward_host(op, ins, outs, cts)
 
     @jax.custom_vjp
     def run(*ins):
-        out = jax.pure_callback(fwd_host, out_structs_tok, *ins)[:n_out]
+        out = jax.pure_callback(fwd_host_plain, out_structs, *ins)
         return out if n_out > 1 else out[0]
 
     def run_fwd(*ins):

@@ -63,9 +63,20 @@ def init(coordinator_address: Optional[str] = None,
         v = _env("DMLC_NUM_WORKER", "NUM_PROCESSES")
         num_processes = int(v) if v is not None else None
     if process_id is None:
-        v = _env("DMLC_WORKER_ID", "PROCESS_ID")
+        # scheduler-provided ranks for the mpi/slurm launchers
+        # (tools/launch.py delegates placement to mpirun/srun)
+        v = _env("DMLC_WORKER_ID", "PROCESS_ID", "OMPI_COMM_WORLD_RANK",
+                 "PMI_RANK", "SLURM_PROCID")
         process_id = int(v) if v is not None else None
     if coordinator_address is None:
+        # mpi/slurm launchers delegate placement to mpirun/srun: the
+        # coordinator (rank 0's node) is unknowable at launch time, so
+        # jax's cluster auto-detection resolves it at runtime here
+        if _env("SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE",
+                "PMI_SIZE") is not None:
+            jax.distributed.initialize()
+            _INITIALIZED = True
+            return
         _INITIALIZED = True  # single-process
         return
     role = _env("DMLC_ROLE", default="worker")

@@ -154,3 +154,21 @@ def test_custom_op_instance_pairing_traced():
     ga, gb = jax.grad(f, argnums=(0, 1))(a, b)
     np.testing.assert_array_equal(np.asarray(ga), [0.0, 1.0])
     np.testing.assert_array_equal(np.asarray(gb), [1.0, 0.0])
+
+
+def test_custom_op_repeated_vjp_application():
+    """f_vjp applied twice must reuse the SAME stashed operator instance
+    (tokens are fetched, not popped)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import apply_pure
+
+    def f(v):
+        return apply_pure("Custom", v, op_type="test_stateful_relu").sum()
+
+    v = jnp.asarray([-1.0, 2.0], jnp.float32)
+    _, f_vjp = jax.vjp(f, v)
+    g1 = np.asarray(f_vjp(jnp.float32(1.0))[0])
+    g2 = np.asarray(f_vjp(jnp.float32(1.0))[0])
+    np.testing.assert_array_equal(g1, [0.0, 1.0])
+    np.testing.assert_array_equal(g2, g1)

@@ -79,3 +79,48 @@ def test_launch_py_local():
         env=env, capture_output=True, text=True, timeout=240)
     assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
     assert p.stdout.count("DIST_OK") == 2, p.stdout
+
+
+def test_launch_dry_run_launchers(tmp_path):
+    """The ssh/mpi/slurm launchers emit correct per-worker commands with
+    the DMLC_* contract (--dry-run; execution needs real hosts)."""
+    import subprocess
+    import sys
+
+    tool = _LAUNCH
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("nodeA\nnodeB  # trailing comment\n")
+
+    def run(*extra):
+        r = subprocess.run(
+            [sys.executable, tool, "-n", "4", "--dry-run", *extra,
+             "python", "train.py", "--kv-store", "dist_sync"],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        return r.stdout.strip().splitlines()
+
+    local = run()
+    assert len(local) == 4
+    assert "DMLC_WORKER_ID=3" in local[3]
+    assert "DMLC_NUM_WORKER=4" in local[0]
+
+    ssh = run("--launcher", "ssh", "-H", str(hostfile))
+    assert len(ssh) == 4
+    assert ssh[0].startswith("ssh ")
+    assert "nodeA" in ssh[0] and "nodeB" in ssh[1]
+    assert "nodeA" in ssh[2]  # round-robin wraps
+    assert "DMLC_PS_ROOT_URI=nodeA" in ssh[1]  # worker 0's host is root
+
+    mpi = run("--launcher", "mpi")
+    assert len(mpi) == 1
+    assert mpi[0].startswith("mpirun -n 4 env ")  # portable env prefix
+    assert "DMLC_NUM_WORKER=4" in mpi[0]
+    assert "DMLC_WORKER_ID" not in mpi[0]   # rank comes from MPI
+    # coordinator resolves at runtime on rank 0's node, NOT the launch
+    # host (which may be a login node)
+    assert "DMLC_PS_ROOT_URI" not in mpi[0]
+
+    slurm = run("--launcher", "slurm")
+    assert len(slurm) == 1
+    assert "srun --ntasks=4 env " in slurm[0]
+    assert "DMLC_PS_ROOT_URI" not in slurm[0]

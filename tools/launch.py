@@ -4,6 +4,9 @@
 Spawns N worker processes with the reference's DMLC_* environment contract:
 
     python tools/launch.py -n 2 python train.py --kv-store dist_sync
+    python tools/launch.py -n 8 -H hosts --launcher ssh python train.py
+    python tools/launch.py -n 8 --launcher mpi python train.py
+    python tools/launch.py -n 8 --launcher slurm python train.py
 
 Workers bootstrap through mxnet_tpu.parallel.dist.init(), which maps the
 DMLC_* variables onto jax.distributed's coordination service (worker 0
@@ -11,19 +14,31 @@ hosts it — there is no separate scheduler process) and collective
 allreduce over DCN (there are no parameter-server processes; `-s` is
 accepted for command-line parity and ignored with a note).
 
-Only the `local` launcher (single machine, multi-process — the reference's
-`--launcher local` dmlc tracker) is implemented; ssh/mpi/yarn/slurm
-launchers raise with a pointer to run one process per host with the same
-env contract instead.
+Launchers (the dmlc tracker family):
+  local  — N processes on this machine.
+  ssh    — one process per hostfile entry over `ssh host env ... cmd`
+           (round-robin when n > hosts; worker 0's host serves the
+           coordinator address).
+  mpi    — delegates process placement to `mpirun`; ranks come from
+           OMPI_COMM_WORLD_RANK / PMI_RANK at runtime.
+  slurm  — delegates to `srun`; ranks come from SLURM_PROCID.
+  yarn   — not supported (raises; the reference's YARN tracker has no
+           TPU-cluster counterpart — use your scheduler to start one
+           process per host with the DMLC_* contract).
+
+`--dry-run` prints the commands instead of executing (used by tests and
+for copy-paste into other schedulers).
 """
 from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import signal
 import socket
 import subprocess
 import sys
+from typing import List
 
 
 def _free_port() -> int:
@@ -32,47 +47,44 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def main(argv=None):
-    ap = argparse.ArgumentParser(
-        description="Launch a distributed mxnet_tpu job",
-        usage="launch.py [-h] -n NUM_WORKERS [-s NUM_SERVERS] "
-              "[--launcher local] command ...")
-    ap.add_argument("-n", "--num-workers", type=int, required=True,
-                    help="number of worker processes")
-    ap.add_argument("-s", "--num-servers", type=int, default=0,
-                    help="accepted for reference parity; no server "
-                         "processes are spawned (collectives subsume them)")
-    ap.add_argument("--launcher", default="local",
-                    choices=["local", "ssh", "mpi", "yarn", "slurm"])
-    ap.add_argument("-H", "--hostfile", default=None)
-    ap.add_argument("command", nargs=argparse.REMAINDER)
-    args = ap.parse_args(argv)
+def _read_hostfile(path: str) -> List[str]:
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            h = line.split("#", 1)[0].strip()
+            if h:
+                hosts.append(h.split()[0])
+    if not hosts:
+        raise SystemExit(f"hostfile {path} has no hosts")
+    return hosts
 
-    if not args.command:
-        ap.error("no command given")
-    if args.launcher != "local":
-        raise NotImplementedError(
-            f"launcher {args.launcher!r}: start one process per host with "
-            "DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT/DMLC_NUM_WORKER/"
-            "DMLC_WORKER_ID set (see mxnet_tpu.parallel.dist)")
-    if args.num_servers:
-        print("[launch] note: server roles are subsumed by collectives; "
-              f"-s {args.num_servers} ignored", file=sys.stderr)
 
-    port = os.environ.get("DMLC_PS_ROOT_PORT") or str(_free_port())
+def _worker_env(i: int, n: int, root_uri: str, port: str,
+                num_servers: int) -> dict:
+    return {
+        "DMLC_ROLE": "worker",
+        "DMLC_PS_ROOT_URI": root_uri,
+        "DMLC_PS_ROOT_PORT": port,
+        "DMLC_NUM_WORKER": str(n),
+        "DMLC_WORKER_ID": str(i),
+        "DMLC_NUM_SERVER": str(num_servers),
+    }
+
+
+def _run_procs(cmds, dry_run: bool) -> int:
+    """cmds: list of (argv, extra_env | None). Runs all, waits, cleans up."""
+    if dry_run:
+        for argv, env in cmds:
+            prefix = " ".join(f"{k}={v}" for k, v in (env or {}).items())
+            print((prefix + " " if prefix else "") +
+                  " ".join(shlex.quote(a) for a in argv))
+        return 0
     procs = []
     try:
-        for i in range(args.num_workers):
-            env = dict(os.environ)
-            env.update({
-                "DMLC_ROLE": "worker",
-                "DMLC_PS_ROOT_URI": "127.0.0.1",
-                "DMLC_PS_ROOT_PORT": port,
-                "DMLC_NUM_WORKER": str(args.num_workers),
-                "DMLC_WORKER_ID": str(i),
-                "DMLC_NUM_SERVER": str(args.num_servers),
-            })
-            procs.append(subprocess.Popen(args.command, env=env))
+        for argv, env in cmds:
+            full = dict(os.environ)
+            full.update(env or {})
+            procs.append(subprocess.Popen(argv, env=full))
         rc = 0
         for p in procs:
             rc = p.wait() or rc
@@ -86,6 +98,86 @@ def main(argv=None):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Launch a distributed mxnet_tpu job",
+        usage="launch.py [-h] -n NUM_WORKERS [-s NUM_SERVERS] "
+              "[--launcher local|ssh|mpi|slurm] [-H HOSTFILE] command ...")
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference parity; no server "
+                         "processes are spawned (collectives subsume them)")
+    ap.add_argument("--launcher", default="local",
+                    choices=["local", "ssh", "mpi", "yarn", "slurm"])
+    ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("--ssh-port", type=int, default=22)
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the per-worker commands, do not execute")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    if not args.command:
+        ap.error("no command given")
+    if args.num_servers:
+        print("[launch] note: server roles are subsumed by collectives; "
+              f"-s {args.num_servers} ignored", file=sys.stderr)
+    n = args.num_workers
+    port = os.environ.get("DMLC_PS_ROOT_PORT") or str(_free_port())
+
+    if args.launcher == "local":
+        cmds = [(list(args.command),
+                 _worker_env(i, n, "127.0.0.1", port, args.num_servers))
+                for i in range(n)]
+        return _run_procs(cmds, args.dry_run)
+
+    if args.launcher == "ssh":
+        if not args.hostfile:
+            ap.error("--launcher ssh requires -H/--hostfile")
+        hosts = _read_hostfile(args.hostfile)
+        root = hosts[0]
+        cwd = os.getcwd()
+        cmds = []
+        for i in range(n):
+            host = hosts[i % len(hosts)]
+            env = _worker_env(i, n, root, port, args.num_servers)
+            remote = "cd " + shlex.quote(cwd) + " && " + " ".join(
+                [f"{k}={shlex.quote(v)}" for k, v in env.items()] +
+                [shlex.quote(a) for a in args.command])
+            cmds.append((["ssh", "-o", "StrictHostKeyChecking=no",
+                          "-p", str(args.ssh_port), host, remote], None))
+        return _run_procs(cmds, args.dry_run)
+
+    if args.launcher in ("mpi", "slurm"):
+        # one mpirun/srun owns placement; rank AND coordinator address
+        # resolve at RUNTIME inside the workers (parallel.dist): rank
+        # from OMPI_COMM_WORLD_RANK / PMI_RANK / SLURM_PROCID, the
+        # coordinator via jax's cluster auto-detection (rank 0's node —
+        # NOT this launch host, which may be a login node).  An explicit
+        # DMLC_PS_ROOT_URI in the environment still wins.
+        env = {"DMLC_ROLE": "worker",
+               "DMLC_NUM_WORKER": str(n),
+               "DMLC_NUM_SERVER": str(args.num_servers)}
+        if os.environ.get("DMLC_PS_ROOT_URI"):
+            env["DMLC_PS_ROOT_URI"] = os.environ["DMLC_PS_ROOT_URI"]
+            env["DMLC_PS_ROOT_PORT"] = port
+        # `env K=V ... cmd` as the launched command: portable across
+        # Open MPI and MPICH/Hydra (no -x / -genv flag differences)
+        env_prefix = ["env"] + [f"{k}={v}" for k, v in env.items()]
+        if args.launcher == "mpi":
+            cmds = [(["mpirun", "-n", str(n)] + env_prefix +
+                     list(args.command), None)]
+        else:
+            cmds = [(["srun", f"--ntasks={n}"] + env_prefix +
+                     list(args.command), None)]
+        return _run_procs(cmds, args.dry_run)
+
+    raise NotImplementedError(
+        "launcher 'yarn' is not supported: start one process per host "
+        "with DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT/DMLC_NUM_WORKER/"
+        "DMLC_WORKER_ID set (see mxnet_tpu.parallel.dist)")
 
 
 if __name__ == "__main__":

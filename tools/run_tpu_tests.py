@@ -56,6 +56,7 @@ def main():
     tally = re.search(r"(\d+) passed", out)
     failed = re.search(r"(\d+) failed", out)
     skipped = re.search(r"(\d+) skipped", out)
+    errors = re.search(r"(\d+) errors?", out)
 
     device = "unknown"
     try:
@@ -74,10 +75,15 @@ def main():
         "passed": int(tally.group(1)) if tally else 0,
         "failed": int(failed.group(1)) if failed else 0,
         "skipped": int(skipped.group(1)) if skipped else 0,
+        "errors": int(errors.group(1)) if errors else 0,
         "duration_s": round(dur, 1),
         "returncode": rc,
         "cases": cases,
     }
+    if not cases and rc != 0:
+        # a broken run (collection/import error) must never read green
+        artifact["status"] = "BROKEN_RUN"
+        artifact["output_tail"] = out[-1500:]
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=2, sort_keys=True)
         f.write("\n")
