@@ -158,7 +158,15 @@ def export_model(sym, params, input_shapes: Sequence[Tuple[int, ...]],
                     f"onnx export: free variable {node.name!r} has no "
                     "shape (pass it in input_shapes) and no weight")
             continue
-        ins = [out_name[(id(inp), idx)] for (inp, idx) in node.inputs]
+        ins = []
+        for (inp, idx) in node.inputs:
+            name = out_name.get((id(inp), idx))
+            if name is None:
+                raise MXNetError(
+                    f"onnx export: {node.op} consumes output {idx} of "
+                    f"{inp.op} {inp.name!r}, which has no ONNX "
+                    f"equivalent (e.g. BatchNorm mean/var side outputs)")
+            ins.append(name)
         outs = [node.name if node.num_outputs == 1
                 else f"{node.name}_{i}" for i in range(node.num_outputs)]
         for i in range(node.num_outputs):
@@ -176,6 +184,11 @@ def export_model(sym, params, input_shapes: Sequence[Tuple[int, ...]],
         elif op == "BatchNorm":
             new = bn_node(node, ins, outs[:1])
             out_name[(id(node), 0)] = outs[0]
+            # ONNX BatchNormalization (inference) has one output; the
+            # mean/var side outputs (output_mean_var=1) have no ONNX
+            # name -> a consumer of them fails loudly at lookup above
+            for i in range(1, node.num_outputs):
+                out_name.pop((id(node), i), None)
         elif op == "Activation":
             new = act_node(node, ins, outs)
         elif op == "Pooling":
@@ -218,9 +231,14 @@ def export_model(sym, params, input_shapes: Sequence[Tuple[int, ...]],
     except Exception:
         out_shapes = [None] * len(sym._heads)
     for (n, i), oshape in zip(sym._heads, out_shapes):
+        head = out_name.get((id(n), i))
+        if head is None:
+            raise MXNetError(
+                f"onnx export: graph output {i} of {n.op} {n.name!r} "
+                f"has no ONNX equivalent (e.g. BatchNorm mean/var side "
+                f"outputs)")
         g.outputs.append(proto.ValueInfo(
-            out_name[(id(n), i)], proto.DT_FLOAT,
-            list(oshape) if oshape else []))
+            head, proto.DT_FLOAT, list(oshape) if oshape else []))
     model = proto.Model(graph=g)
     proto.save(model, onnx_file)
     return onnx_file
@@ -273,6 +291,16 @@ def import_model(model_file: str):
               "Div": "broadcast_div", "Identity": "identity",
               "Flatten": "flatten"}
 
+    def _weight_init(inits, node, i):
+        name = node.inputs[i]
+        if name not in inits:
+            raise MXNetError(
+                f"onnx import: {node.op_type} weight '{name}' is not a "
+                f"graph initializer (it is a graph input or produced by "
+                f"another node); only initializer-backed weights are "
+                f"supported")
+        return inits[name]
+
     for node in g.nodes:
         a = node.attrs
         op = node.op_type
@@ -282,7 +310,7 @@ def import_model(model_file: str):
             if pads[:len(kernel)] != pads[len(kernel):]:
                 raise MXNetError("onnx import: asymmetric Conv pads "
                                  "are not supported")
-            w = inits[node.inputs[1]]
+            w = _weight_init(inits, node, 1)
             res = sym_mod.Convolution(
                 var_for(node.inputs[0]), var_for(node.inputs[1]),
                 *( [var_for(node.inputs[2])] if len(node.inputs) > 2
@@ -299,7 +327,7 @@ def import_model(model_file: str):
                     a.get("alpha", 1.0) != 1.0 or a.get("beta", 1.0) != 1.0:
                 raise MXNetError("onnx import: general Gemm forms beyond "
                                  "Y = X W^T + b are not supported")
-            w = inits[node.inputs[1]]
+            w = _weight_init(inits, node, 1)
             res = sym_mod.FullyConnected(
                 var_for(node.inputs[0]), var_for(node.inputs[1]),
                 *( [var_for(node.inputs[2])] if len(node.inputs) > 2

@@ -168,6 +168,14 @@ _LIVE_LOCK = threading.Lock()
 _LIVE_OPS: "collections.OrderedDict[int, CustomOp]" = \
     collections.OrderedDict()
 _LIVE_CAP = 4096
+# ops whose backward already ran once: kept only so a REPEATED vjp
+# application (grad-of-grad, re-applied cached vjp) still finds them,
+# in a much smaller cache — steady-state training (one backward per
+# forward) therefore retains ~_DONE_CAP instances, not _LIVE_CAP,
+# even when user code stashes activation-sized state on self
+_DONE_OPS: "collections.OrderedDict[int, CustomOp]" = \
+    collections.OrderedDict()
+_DONE_CAP = 64
 _NEXT_TOKEN = itertools.count(1)
 
 
@@ -180,13 +188,24 @@ def _stash_op(op: CustomOp) -> int:
     return tok
 
 
-def _get_op(tok: int):
-    """Fetch WITHOUT popping (a vjp may be applied repeatedly); entries
-    age out of the bounded LRU instead."""
+def _get_op(tok: int, mark_done: bool = False):
+    """Fetch WITHOUT popping (a vjp may be applied repeatedly).
+    `mark_done=True` (the backward path) demotes the entry to the small
+    done-cache; never-backwarded entries age out of the big LRU."""
     with _LIVE_LOCK:
         op = _LIVE_OPS.get(tok)
         if op is not None:
-            _LIVE_OPS.move_to_end(tok)
+            if mark_done:
+                del _LIVE_OPS[tok]
+                _DONE_OPS[tok] = op
+                while len(_DONE_OPS) > _DONE_CAP:
+                    _DONE_OPS.popitem(last=False)
+            else:
+                _LIVE_OPS.move_to_end(tok)
+            return op
+        op = _DONE_OPS.get(tok)
+        if op is not None:
+            _DONE_OPS.move_to_end(tok)
         return op
 
 
@@ -257,13 +276,16 @@ def _build_custom(op_type: str, kw_items: tuple, in_shapes: tuple,
         ins = args[:n_in]
         outs = args[n_in:n_in + n_out]
         cts = args[n_in + n_out:]
-        op = _get_op(int(tok))  # NOT popped: repeated vjp application
+        # demoted to the done-cache, NOT popped: repeated vjp application
+        op = _get_op(int(tok), mark_done=True)
         if op is None:
             raise MXNetError(
                 f"Custom op {op_type!r}: the operator instance for this "
                 "backward was evicted (more than "
-                f"{_LIVE_CAP} grad-pending Custom forwards in flight) — "
-                "cannot silently rebuild stateful backward")
+                f"{_LIVE_CAP} grad-pending Custom forwards in flight, or "
+                f"more than {_DONE_CAP} completed backwards since this "
+                "one first ran) — cannot silently rebuild stateful "
+                "backward")
         return run_backward_host(op, ins, outs, cts)
 
     @jax.custom_vjp

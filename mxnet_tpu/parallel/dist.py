@@ -18,7 +18,9 @@ Either set initializes the same way.
 """
 from __future__ import annotations
 
+import functools
 import os
+import threading
 from typing import Optional
 
 import jax
@@ -27,7 +29,94 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["init", "initialized", "rank", "num_workers", "barrier",
-           "allreduce_nd", "allgather_np"]
+           "allreduce_nd", "allgather_np", "abort"]
+
+
+def abort(reason: str = "", code: int = 1) -> "None":
+    """Terminate this worker immediately (ref: ps-lite Van abort on
+    heartbeat loss).  Used after a collective raised MXNetError for a
+    dead peer: the normal interpreter exit would block ~100s in the
+    coordination service's shutdown barrier waiting for the dead task,
+    so skip it and exit hard."""
+    import sys as _sys
+
+    if reason:
+        print(f"[mxnet_tpu.dist] rank {jax.process_index()} aborting: "
+              f"{reason}", file=_sys.stderr, flush=True)
+    _sys.stderr.flush()
+    _sys.stdout.flush()
+    os._exit(code)
+
+#: Seconds a collective may block before the worker aborts loudly instead
+#: of hanging on a dead peer (ref role: ps-lite Van heartbeat timeout,
+#: env PS_HEARTBEAT_TIMEOUT).  0/unset = wait forever.
+_TIMEOUT_ENV = "MXNET_KVSTORE_TIMEOUT"
+
+#: Name of the collective that timed out; once set, every further
+#: collective refuses (this worker's sequence no longer matches peers').
+_POISONED: Optional[str] = None
+
+
+def _collective_timeout(timeout: Optional[float]) -> Optional[float]:
+    if timeout is not None:
+        return timeout if timeout > 0 else None
+    v = os.environ.get(_TIMEOUT_ENV)
+    if v:
+        try:
+            t = float(v)
+        except ValueError:
+            raise MXNetError(
+                f"{_TIMEOUT_ENV}={v!r} is not a number (expected seconds, "
+                f"e.g. {_TIMEOUT_ENV}=60)")
+        return t if t > 0 else None
+    return None
+
+
+def _run_with_watchdog(fn, timeout: Optional[float], what: str):
+    """Run a blocking collective; abort loudly if a peer never shows up.
+
+    gloo/the coordination service block indefinitely when a peer process
+    has died (the reference's ps-lite aborts via Van heartbeats instead —
+    SURVEY.md §5 failure detection).  The collective runs on a worker
+    thread; if it has not completed within `timeout` seconds the main
+    thread raises MXNetError so the training job fails fast instead of
+    deadlocking.  The stuck thread is daemonic — the expected reaction to
+    this error is process exit."""
+    global _POISONED
+    if _POISONED:
+        raise MXNetError(
+            f"collective '{what}' refused: a previous collective "
+            f"('{_POISONED}') timed out, so this worker is out of step "
+            f"with its peers. Abort the process (dist.abort()) and "
+            f"restart the job.")
+    timeout = _collective_timeout(timeout)
+    if timeout is None:
+        return fn()
+    result, error = [], []
+
+    def _target():
+        try:
+            result.append(fn())
+        except BaseException as e:  # surfaced on the main thread
+            error.append(e)
+
+    t = threading.Thread(target=_target, daemon=True,
+                         name=f"mxnet-collective-{what}")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        # the stuck thread may still complete the gloo collective later;
+        # poison all further collectives so a caller that swallows the
+        # error cannot silently desynchronize the collective sequence
+        _POISONED = what
+        raise MXNetError(
+            f"collective '{what}' timed out after {timeout:.1f}s on "
+            f"rank {jax.process_index()}/{jax.process_count()}: a peer "
+            f"worker is unreachable (dead or stalled). Aborting "
+            f"(set {_TIMEOUT_ENV}=0 to wait forever).")
+    if error:
+        raise error[0]
+    return result[0]
 
 _INITIALIZED = False
 
@@ -113,42 +202,109 @@ def num_workers() -> int:
     return jax.process_count()
 
 
-def barrier(name: str = "mxnet_tpu_barrier") -> None:
-    """Block until every worker arrives (ref: Postoffice::Barrier)."""
+def barrier(name: str = "mxnet_tpu_barrier",
+            timeout: Optional[float] = None) -> None:
+    """Block until every worker arrives (ref: Postoffice::Barrier).
+
+    `timeout` (seconds, or env MXNET_KVSTORE_TIMEOUT) turns a dead-peer
+    deadlock into a loud MXNetError."""
     if jax.process_count() == 1:
         return
     from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices(name)
+    _run_with_watchdog(
+        lambda: multihost_utils.sync_global_devices(name), timeout,
+        f"barrier:{name}")
 
 
-def allgather_np(value: np.ndarray) -> np.ndarray:
+def allgather_np(value: np.ndarray,
+                 timeout: Optional[float] = None) -> np.ndarray:
     """Gather a host numpy value from every process -> stacked [n, ...]."""
     if jax.process_count() == 1:
         return np.asarray(value)[None]
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(value))
+    return _run_with_watchdog(
+        lambda: np.asarray(multihost_utils.process_allgather(value)),
+        timeout, "allgather")
 
 
-def allreduce_nd(val):
+_DCN_MESH = None
+
+
+def _dcn_mesh():
+    """1-D mesh with ONE device per process, process-ordered — the DCN
+    reduction topology (each host contributes through a single lane)."""
+    global _DCN_MESH
+    if _DCN_MESH is None:
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        devs = [per_proc[p] for p in sorted(per_proc)]
+        _DCN_MESH = jax.sharding.Mesh(np.array(devs), ("proc",))
+    return _DCN_MESH
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_reduce(mesh, shape, dtype):
+    """AOT-compiled cross-process sum.  Compilation is peer-independent
+    (pure local XLA work, no collectives run), so it happens OUTSIDE the
+    watchdog window — only the actual collective execution is timed, and
+    a slow first-call compile cannot be mistaken for a dead peer."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    fn = jax.jit(lambda a: jax.numpy.sum(a, axis=0),
+                 out_shardings=NamedSharding(mesh, PartitionSpec()))
+    arg = jax.ShapeDtypeStruct(
+        shape, dtype, sharding=NamedSharding(mesh, PartitionSpec("proc")))
+    return fn.lower(arg).compile()
+
+
+def _allreduce_device(x, timeout: Optional[float] = None):
+    """True in-graph cross-process sum: each process contributes its value
+    as one shard of a global [n_proc, ...] array; the jitted sum with a
+    replicated output makes XLA emit a real AllReduce collective carried
+    by gloo over DCN (ring — O(1) per-worker bandwidth), replacing the
+    old allgather-then-host-sum path (O(n) bandwidth, host math).
+    Ref role: ps-lite ZPush/ZPull aggregation, kvstore_dist.h."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = _dcn_mesh()
+    n = int(mesh.devices.size)
+    mine = next(d for d in mesh.devices.flat
+                if d.process_index == jax.process_index())
+    shard = jax.device_put(jax.numpy.asarray(x)[None], mine)
+    garr = jax.make_array_from_single_device_arrays(
+        (n,) + tuple(shard.shape[1:]),
+        NamedSharding(mesh, PartitionSpec("proc")), [shard])
+    reduce = _compiled_reduce(mesh, garr.shape, garr.dtype)
+
+    def _go():
+        out = reduce(garr)
+        jax.block_until_ready(out)
+        return out.addressable_data(0)
+
+    return _run_with_watchdog(_go, timeout, "allreduce")
+
+
+def allreduce_nd(val, timeout: Optional[float] = None):
     """Sum an NDArray across processes over DCN (eager path used by
     KVStore('dist_*'); the SPMD path does this in-graph instead).
 
-    row_sparse inputs stay row_sparse: the dense backing is summed and the
-    stored-row sets are unioned (via a fixed-size row mask, so workers may
-    hold different nnz)."""
+    Dense values ride one in-graph gloo AllReduce (`_allreduce_device`).
+    row_sparse inputs stay row_sparse: the dense backing is summed the
+    same way and the stored-row sets are unioned via a fixed-size row
+    mask (workers may hold different nnz)."""
     from ..ndarray.ndarray import NDArray
     from ..ndarray.sparse import RowSparseNDArray
 
     if jax.process_count() == 1:
         return val
-    summed = allgather_np(np.asarray(val._data)).sum(axis=0)
-    out = jax.numpy.asarray(summed)
+    out = jax.numpy.asarray(_allreduce_device(val._data, timeout))
     if isinstance(val, RowSparseNDArray):
         mask = np.zeros((val.shape[0],), np.int32)
         mask[np.asarray(val._aux["indices"])] = 1
-        union = allgather_np(mask).max(axis=0)
+        union = np.asarray(_allreduce_device(mask, timeout))
         idx = jax.numpy.asarray(np.flatnonzero(union).astype(np.int32))
         return RowSparseNDArray(out, {"indices": idx}, ctx=val.ctx)
     if val.stype == "csr":

@@ -111,5 +111,33 @@ def mode_train():
           flush=True)
 
 
+def mode_peerloss():
+    """Failure detection: a worker whose peer died must abort loudly, not
+    hang (ref role: ps-lite Van heartbeat timeout -> SURVEY.md §5)."""
+    dist.init()
+    rank = dist.rank()
+    if rank == 1:
+        # die without ever reaching the barrier
+        print("DIST_OK rank=1 (exiting early, simulating peer death)",
+              flush=True)
+        os._exit(0)
+    import time
+
+    t0 = time.time()
+    try:
+        dist.barrier("peerloss", timeout=8)
+    except mx.MXNetError as e:
+        took = time.time() - t0
+        assert "timed out" in str(e) and "unreachable" in str(e), e
+        assert took < 60, took  # aborted promptly, did not deadlock
+        print(f"DIST_OK rank=0 peer-loss detected in {took:.1f}s",
+              flush=True)
+        # normal exit would hang ~100s in the coordination service's
+        # shutdown barrier (the peer can never arrive) -> fast abort
+        dist.abort(code=0)
+    raise AssertionError("barrier with a dead peer did not abort")
+
+
 if __name__ == "__main__":
-    {"kvstore": mode_kvstore, "train": mode_train}[sys.argv[1]]()
+    {"kvstore": mode_kvstore, "train": mode_train,
+     "peerloss": mode_peerloss}[sys.argv[1]]()

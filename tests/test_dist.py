@@ -70,6 +70,16 @@ def test_dist_sync_training_two_process():
         assert "DIST_OK" in out, out[-2000:]
 
 
+def test_peer_loss_aborts_not_hangs():
+    """Failure detection (SURVEY.md §5): worker 1 dies before the barrier;
+    worker 0 must raise MXNetError within its watchdog timeout instead of
+    deadlocking on the dead peer."""
+    outs = _spawn_workers("peerloss", 2)
+    for rc, out in outs:
+        assert rc == 0, out[-2000:]
+    assert any("peer-loss detected" in out for _, out in outs), outs
+
+
 def test_launch_py_local():
     """The reference-style launcher end to end."""
     env = _worker_env()

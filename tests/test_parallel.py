@@ -312,6 +312,41 @@ def test_spmd_trainer_retrace_on_shape_change():
         assert not np.allclose(mv, 1.0)
 
 
+def test_collective_watchdog():
+    """_run_with_watchdog: passes values/errors through, and converts a
+    never-completing collective into a loud MXNetError."""
+    import os
+    import time
+
+    from mxnet_tpu.parallel import dist
+
+    try:
+        assert dist._run_with_watchdog(lambda: 42, timeout=5,
+                                       what="x") == 42
+        with pytest.raises(ValueError):
+            dist._run_with_watchdog(lambda: (_ for _ in ()).throw(
+                ValueError("boom")), timeout=5, what="x")
+        with pytest.raises(mx.MXNetError, match="timed out.*unreachable"):
+            dist._run_with_watchdog(lambda: time.sleep(30), timeout=0.2,
+                                    what="hung")
+        # the timed-out collective may complete later on its stuck
+        # thread: all further collectives must refuse (sequence desync)
+        with pytest.raises(mx.MXNetError, match="refused"):
+            dist._run_with_watchdog(lambda: 1, timeout=5, what="next")
+        dist._POISONED = None
+        # env-var route (MXNET_KVSTORE_TIMEOUT)
+        os.environ[dist._TIMEOUT_ENV] = "0.2"
+        with pytest.raises(mx.MXNetError, match="timed out"):
+            dist._run_with_watchdog(lambda: time.sleep(30), timeout=None,
+                                    what="hung")
+        os.environ[dist._TIMEOUT_ENV] = "5m"
+        with pytest.raises(mx.MXNetError, match="MXNET_KVSTORE_TIMEOUT"):
+            dist._collective_timeout(None)
+    finally:
+        dist._POISONED = None
+        os.environ.pop(dist._TIMEOUT_ENV, None)
+
+
 def test_dist_async_emulation_pin():
     """dist_async is served by the dist_sync path (documented emulation:
     synchronous application is a legal schedule of async). Pin the

@@ -47,6 +47,27 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _probe_remote_port(host: str, ssh_port: int) -> "str | None":
+    """Ask `host` for a free TCP port (the coordinator binds there, not on
+    the launch host).  Returns None if the probe fails (no python on the
+    remote, ssh restricted, ...) — callers then keep the local guess."""
+    try:
+        r = subprocess.run(
+            ["ssh", "-o", "StrictHostKeyChecking=no", "-o",
+             "ConnectTimeout=10", "-p", str(ssh_port), host,
+             "python3 -c 'import socket;s=socket.socket();"
+             "s.bind((\"\",0));print(s.getsockname()[1])'"],
+            capture_output=True, text=True, timeout=30)
+        if r.returncode == 0 and r.stdout.strip().isdigit():
+            return r.stdout.strip()
+    except Exception:
+        pass
+    print(f"[launch] warning: could not probe a free port on {host}; "
+          f"using a port probed locally (set DMLC_PS_ROOT_PORT to pin)",
+          file=sys.stderr)
+    return None
+
+
 def _read_hostfile(path: str) -> List[str]:
     hosts = []
     with open(path) as f:
@@ -138,6 +159,13 @@ def main(argv=None):
             ap.error("--launcher ssh requires -H/--hostfile")
         hosts = _read_hostfile(args.hostfile)
         root = hosts[0]
+        if "DMLC_PS_ROOT_PORT" not in os.environ and not args.dry_run:
+            # the coordinator binds on hosts[0], not on this launch host,
+            # so probe for a free port THERE (the local _free_port()
+            # default only checked this machine)
+            p = _probe_remote_port(root, args.ssh_port)
+            if p is not None:
+                port = p
         cwd = os.getcwd()
         cmds = []
         for i in range(n):
@@ -162,7 +190,16 @@ def main(argv=None):
                "DMLC_NUM_SERVER": str(args.num_servers)}
         if os.environ.get("DMLC_PS_ROOT_URI"):
             env["DMLC_PS_ROOT_URI"] = os.environ["DMLC_PS_ROOT_URI"]
-            env["DMLC_PS_ROOT_PORT"] = port
+            if os.environ.get("DMLC_PS_ROOT_PORT"):
+                env["DMLC_PS_ROOT_PORT"] = port
+            else:
+                # `port` was probed on THIS (login) node — meaningless on
+                # the coordinator node; let dist.init use its documented
+                # default (9091) there instead of a random local guess
+                print("[launch] note: DMLC_PS_ROOT_URI set without "
+                      "DMLC_PS_ROOT_PORT; workers will use the default "
+                      "port 9091 on the coordinator (set "
+                      "DMLC_PS_ROOT_PORT to pin)", file=sys.stderr)
         # `env K=V ... cmd` as the launched command: portable across
         # Open MPI and MPICH/Hydra (no -x / -genv flag differences)
         env_prefix = ["env"] + [f"{k}={v}" for k, v in env.items()]
