@@ -26,6 +26,9 @@ from . import profiler
 from . import serialization
 from . import operator
 from . import storage
+from . import initialize as _initialize
+
+_initialize.initialize()
 
 __all__ = [
     "MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",
@@ -43,7 +46,7 @@ def __getattr__(name):
             "lr_scheduler", "io", "image", "symbol", "module", "parallel",
             "callback", "model", "test_utils", "engine", "runtime",
             "visualization", "recordio", "contrib", "monitor", "name",
-            "attribute"}
+            "attribute", "resource"}
     if name == "sym":
         mod = importlib.import_module(".symbol", __name__)
         globals()["sym"] = mod

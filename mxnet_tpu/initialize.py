@@ -1,0 +1,53 @@
+"""Library initialization: crash signal handlers and fork safety.
+
+TPU-native counterpart of the reference's `src/initialize.cc`
+(SURVEY.md N17): the reference installs SIGSEGV/SIGBUS handlers that
+print a C++ stack trace (gated by env `MXNET_USE_SIGNAL_HANDLER`) and
+`pthread_atfork` handlers that stop the engine before a fork so a
+DataLoader worker child is not born holding dead engine threads.
+
+Here the crash-trace role is played by :mod:`faulthandler` (dumps every
+Python thread's stack on SIGSEGV/SIGFPE/SIGABRT/SIGBUS/SIGILL — the
+useful trace for a ctypes/XLA crash is the Python side that issued the
+call), and fork safety by `os.register_at_fork` hooks installed in
+:mod:`mxnet_tpu.lib`: before a fork every live native object is
+quiesced (the engine drains its queues so no worker thread holds a
+mutex at the fork instant), and in the child, engines are rebuilt with
+fresh worker threads while file-backed readers/pipelines are
+invalidated so use raises a clear MXNetError instead of crashing on a
+handle whose threads/offsets did not survive.
+
+Runs once at package import (`mxnet_tpu/__init__.py`).
+"""
+from __future__ import annotations
+
+from .base import get_env
+
+__all__ = ["initialize", "signal_handlers_enabled"]
+
+_DONE = False
+_FAULTHANDLER_ENABLED = False
+
+
+def signal_handlers_enabled() -> bool:
+    return _FAULTHANDLER_ENABLED
+
+
+def initialize() -> None:
+    """Idempotent library init (signal handlers + fork hooks)."""
+    global _DONE, _FAULTHANDLER_ENABLED
+    if _DONE:
+        return
+    _DONE = True
+    if get_env("MXNET_USE_SIGNAL_HANDLER", True, bool):
+        try:
+            import faulthandler
+
+            if not faulthandler.is_enabled():
+                faulthandler.enable(all_threads=True)
+            _FAULTHANDLER_ENABLED = True
+        except Exception:  # pragma: no cover - e.g. no usable stderr
+            _FAULTHANDLER_ENABLED = False
+    from . import lib
+
+    lib.install_fork_handlers()

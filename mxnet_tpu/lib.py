@@ -15,6 +15,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import weakref
 from typing import List, Optional
 
 from .base import MXNetError, get_env
@@ -138,7 +139,75 @@ def check_call(ret: int) -> None:
 # boundary — here scheduling HOST-side work; device work rides PjRt)
 # ---------------------------------------------------------------------------
 
-class NativeEngine:
+# ---------------------------------------------------------------------------
+# Fork safety (ref role: src/initialize.cc pthread_atfork handlers —
+# quiesce engine threads before fork; don't let the child inherit handles
+# whose worker threads/mutexes did not survive the fork)
+# ---------------------------------------------------------------------------
+
+_FORK_REGISTRY: "weakref.WeakSet" = weakref.WeakSet()
+_FORK_HOOKS_INSTALLED = False
+
+
+def _register_fork_guard(obj) -> None:
+    _FORK_REGISTRY.add(obj)
+
+
+def _before_fork() -> None:
+    for obj in list(_FORK_REGISTRY):
+        try:
+            obj._quiesce_before_fork()
+        except Exception:
+            pass
+
+
+def _after_fork_child() -> None:
+    for obj in list(_FORK_REGISTRY):
+        try:
+            obj._after_fork_child()
+        except Exception:
+            pass
+
+
+def install_fork_handlers() -> None:
+    """Register atfork hooks (idempotent; runs on os.fork / the
+    multiprocessing 'fork' start method, NOT on subprocess spawn).
+    Does not load the native library."""
+    global _FORK_HOOKS_INSTALLED
+    if _FORK_HOOKS_INSTALLED or not hasattr(os, "register_at_fork"):
+        return
+    _FORK_HOOKS_INSTALLED = True
+    os.register_at_fork(before=_before_fork,
+                        after_in_child=_after_fork_child)
+
+
+class _HandleGuard:
+    """Mixin: `_hh()` returns the live native handle or raises loudly —
+    a closed or fork-invalidated handle must never reach C++ as NULL."""
+
+    _fork_invalid = False
+
+    def _hh(self) -> ctypes.c_void_p:
+        h = getattr(self, "_h", None)
+        if not h:
+            why = ("invalidated by fork (native threads/file offsets do "
+                   "not survive into the child; recreate the object)"
+                   if self._fork_invalid else "already closed")
+            raise MXNetError(
+                f"{type(self).__name__}: native handle {why}")
+        return h
+
+    def _quiesce_before_fork(self) -> None:  # overridden where needed
+        pass
+
+    def _after_fork_child(self) -> None:
+        # leak the C++ object on purpose: freeing it in the child would
+        # join worker threads that only exist in the parent
+        self._h = None
+        self._fork_invalid = True
+
+
+class NativeEngine(_HandleGuard):
     """Dependency-scheduled host task engine.
 
     `push(fn, read=[v1], write=[v2])` runs `fn()` on a worker thread once
@@ -177,14 +246,44 @@ class NativeEngine:
                 traceback.print_exc()
 
         self._tramp = EngineFnType(_trampoline)
+        _register_fork_guard(self)
+
+    def _quiesce_before_fork(self) -> None:
+        # drain all pending work so no worker thread holds an engine
+        # mutex at the instant of fork (the child inherits the mutexes
+        # but not the threads — a held lock would deadlock it forever)
+        if self._h:
+            self.wait_for_all()
+
+    def _after_fork_child(self) -> None:
+        # the parent's worker threads don't exist here; leak the old C++
+        # engine (freeing would join ghost threads) and mark for LAZY
+        # rebuild — a child that never touches the engine pays nothing
+        # (the reference likewise restarts its engine lazily after fork,
+        # src/initialize.cc role).  Pre-fork variable ids belong to the
+        # leaked engine and error loudly on the rebuilt one.
+        self._h = None
+        self._needs_rebuild = True
+
+    def _hh(self) -> ctypes.c_void_p:
+        if getattr(self, "_needs_rebuild", False):
+            self._needs_rebuild = False
+            h = ctypes.c_void_p()
+            check_call(self._lib.MXEngineCreate(
+                ctypes.c_int(self.num_workers), ctypes.byref(h)))
+            self._h = h
+            with self._cb_lock:
+                self._cbs.clear()
+        return super()._hh()
 
     def new_variable(self) -> int:
         v = ctypes.c_int64()
-        check_call(self._lib.MXEngineNewVariable(self._h, ctypes.byref(v)))
+        check_call(self._lib.MXEngineNewVariable(self._hh(),
+                                                 ctypes.byref(v)))
         return v.value
 
     def delete_variable(self, var: int) -> None:
-        check_call(self._lib.MXEngineDeleteVariable(self._h,
+        check_call(self._lib.MXEngineDeleteVariable(self._hh(),
                                                     ctypes.c_int64(var)))
 
     def push(self, fn, read=(), write=(), priority: int = 0) -> None:
@@ -195,24 +294,26 @@ class NativeEngine:
         rv = (ctypes.c_int64 * len(read))(*read)
         wv = (ctypes.c_int64 * len(write))(*write)
         check_call(self._lib.MXEnginePushAsync(
-            self._h, self._tramp, ctypes.c_void_p(key), rv, len(read), wv,
-            len(write), ctypes.c_int(priority)))
+            self._hh(), self._tramp, ctypes.c_void_p(key), rv, len(read),
+            wv, len(write), ctypes.c_int(priority)))
 
     def wait_for_var(self, var: int) -> None:
-        check_call(self._lib.MXEngineWaitForVar(self._h,
+        check_call(self._lib.MXEngineWaitForVar(self._hh(),
                                                 ctypes.c_int64(var)))
 
     def wait_for_all(self) -> None:
-        check_call(self._lib.MXEngineWaitForAll(self._h))
+        check_call(self._lib.MXEngineWaitForAll(self._hh()))
 
     def num_pending(self) -> int:
         out = ctypes.c_int()
-        check_call(self._lib.MXEngineNumPending(self._h, ctypes.byref(out)))
+        check_call(self._lib.MXEngineNumPending(self._hh(),
+                                                ctypes.byref(out)))
         return out.value
 
     def var_version(self, var: int) -> int:
         out = ctypes.c_uint64()
-        check_call(self._lib.MXEngineVarVersion(self._h, ctypes.c_int64(var),
+        check_call(self._lib.MXEngineVarVersion(self._hh(),
+                                                ctypes.c_int64(var),
                                                 ctypes.byref(out)))
         return out.value
 
@@ -229,7 +330,7 @@ class NativeEngine:
 # RecordIO wrappers (native fast path for mxnet_tpu/recordio.py)
 # ---------------------------------------------------------------------------
 
-class NativeRecordWriter:
+class NativeRecordWriter(_HandleGuard):
     def __init__(self, path: str, max_chunk: int = 0):
         # max_chunk=0 → the 29-bit wire default; smaller values exercise
         # the cflag-chained chunk path without gigabyte fixtures
@@ -242,11 +343,12 @@ class NativeRecordWriter:
             check_call(self._lib.MXRecordIOWriterCreate(
                 path.encode(), ctypes.byref(h)))
         self._h = h
+        _register_fork_guard(self)
 
     def write(self, buf: bytes) -> int:
         pos = ctypes.c_int64()
         check_call(self._lib.MXRecordIOWriterWrite(
-            self._h, buf, ctypes.c_size_t(len(buf)), ctypes.byref(pos)))
+            self._hh(), buf, ctypes.c_size_t(len(buf)), ctypes.byref(pos)))
         return pos.value
 
     def close(self):
@@ -261,7 +363,7 @@ class NativeRecordWriter:
             pass
 
 
-class _ReaderBase:
+class _ReaderBase(_HandleGuard):
     _create = _next = _reset = _free = None  # bound by subclass
 
     def __init__(self, path: str, *extra):
@@ -269,19 +371,20 @@ class _ReaderBase:
         h = ctypes.c_void_p()
         check_call(self._create(path.encode(), *extra, ctypes.byref(h)))
         self._h = h
+        _register_fork_guard(self)
 
     def read(self) -> Optional[bytes]:
         buf = ctypes.c_char_p()
         length = ctypes.c_size_t()
         eof = ctypes.c_int()
-        check_call(self._next(self._h, ctypes.byref(buf),
+        check_call(self._next(self._hh(), ctypes.byref(buf),
                               ctypes.byref(length), ctypes.byref(eof)))
         if eof.value:
             return None
         return ctypes.string_at(buf, length.value)
 
     def reset(self):
-        check_call(self._reset(self._h))
+        check_call(self._reset(self._hh()))
 
     def close(self):
         if self._h:
@@ -305,7 +408,7 @@ class NativeRecordReader(_ReaderBase):
         super().__init__(path)
 
     def seek(self, pos: int):
-        check_call(self._lib.MXRecordIOReaderSeek(self._h,
+        check_call(self._lib.MXRecordIOReaderSeek(self._hh(),
                                                   ctypes.c_int64(pos)))
 
 
@@ -338,7 +441,7 @@ def _img_check(lib, ret: int) -> None:
     _IMAGE.check(ret)
 
 
-class NativeImagePipeline:
+class NativeImagePipeline(_HandleGuard):
     """Threaded decode+augment+batch pipeline over a .rec shard
     (src/image_pipeline.cc; decode tasks run on the N1 engine)."""
 
@@ -358,6 +461,7 @@ class NativeImagePipeline:
             rec_path.encode(), idx_path.encode() if idx_path else None,
             cfg_s.encode(), ctypes.byref(h)))
         self._h = h
+        _register_fork_guard(self)
 
     def next(self):
         """-> (data ndarray, label ndarray, pad) or None at epoch end.
@@ -368,7 +472,7 @@ class NativeImagePipeline:
         label_p = ctypes.POINTER(ctypes.c_float)()
         pad = ctypes.c_int()
         _img_check(self._lib, self._lib.MXImagePipelineNext(
-            self._h, ctypes.byref(batch_h), ctypes.byref(data_p),
+            self._hh(), ctypes.byref(batch_h), ctypes.byref(data_p),
             ctypes.byref(label_p), ctypes.byref(pad)))
         if not batch_h.value:
             return None
@@ -393,7 +497,7 @@ class NativeImagePipeline:
         return data, label, pad.value
 
     def reset(self):
-        _img_check(self._lib, self._lib.MXImagePipelineReset(self._h))
+        _img_check(self._lib, self._lib.MXImagePipelineReset(self._hh()))
 
     def close(self):
         if getattr(self, "_h", None):

@@ -31,6 +31,11 @@ class KeyProvider:
             self._key, sub = jax.random.split(self._key)
         return sub
 
+    def reset(self, root_key):
+        """Restart the stream in place (handed-out references follow)."""
+        with self._lock:
+            self._key = root_key
+
 
 class _State(threading.local):
     def __init__(self):
@@ -38,28 +43,28 @@ class _State(threading.local):
 
 
 _STATE = _State()
-_GLOBAL: Optional[KeyProvider] = None  # lazy: importing the package must
-# not initialize a jax backend (device selection happens at first use)
-_GLOBAL_LOCK = threading.Lock()
 
 
 def seed(seed_state: int, ctx=None):
-    """ref: mx.random.seed — reset the global stream."""
-    global _GLOBAL
-    with _GLOBAL_LOCK:
-        _GLOBAL = KeyProvider(jax.random.PRNGKey(int(seed_state)))
+    """ref: mx.random.seed — reset every device stream; with `ctx`,
+    reset only that device's stream (MXRandomSeedContext).  Streams
+    live in the N15 resource manager (kRandom); eager sampling draws
+    from the current context's stream via `next_key()`."""
+    from .resource import resource_manager
+
+    if ctx is not None:
+        resource_manager().seed(int(seed_state), ctx)
+        return
+    resource_manager().seed(int(seed_state))
 
 
 def next_key():
-    global _GLOBAL
     p = _STATE.provider
     if p is not None:
         return p.next_key()
-    if _GLOBAL is None:
-        with _GLOBAL_LOCK:
-            if _GLOBAL is None:
-                _GLOBAL = KeyProvider(jax.random.PRNGKey(0))
-    return _GLOBAL.next_key()
+    from .resource import resource_manager
+
+    return resource_manager().random().next_key()
 
 
 def zero_key():

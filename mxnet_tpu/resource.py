@@ -1,0 +1,147 @@
+"""Per-device resource manager (SURVEY.md N15).
+
+TPU-native counterpart of the reference's `src/resource.cc`
+`ResourceManager` with its resource kinds:
+
+- ``kRandom`` — per-device PRNG state.  Here: a deterministic
+  :class:`~mxnet_tpu.random.KeyProvider` per :class:`Context`, derived
+  by folding the device id into the root seed (stateless threefry —
+  the TPU-native PRNG; no device-resident generator state to manage).
+- ``kParallelRandom`` — batched keys for ops that draw many independent
+  streams in one launch (the reference keeps one generator per OMP
+  thread; here one folded key per lane, vectorized).
+- ``kTempSpace`` — per-device scratch.  On TPU, *device* scratch is
+  XLA's job (allocated inside each executable; nothing to pool), so
+  the manager serves the remaining real need: reusable **host** staging
+  scratch for custom ops / IO paths.  Buffers are per-(context, thread)
+  and grow-only, the reference's temp-space discipline.
+- ``kCuDNNDropoutDesc`` has no TPU analogue (dropout is a fused
+  stateless op); requesting it raises with that explanation.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Tuple
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, current_context
+
+__all__ = ["ResourceManager", "resource_manager"]
+
+_KINDS = ("temp_space", "random", "parallel_random")
+
+
+class ResourceManager:
+    """Owns per-context resources; one process-wide instance
+    (``resource_manager()``)."""
+
+    def __init__(self, root_seed: int = 0):
+        self._lock = threading.Lock()
+        self._root_seed = int(root_seed)
+        self._rand: Dict[Tuple[str, int], "object"] = {}
+        self._tls = threading.local()
+
+    # -- kRandom ---------------------------------------------------------
+    def seed(self, seed_state: int, ctx: Context = None) -> None:
+        """Reseed the per-device streams (ref: MXRandomSeedContext).
+        With ``ctx`` only that device's stream is reset; without, all
+        streams restart from the new root.  Existing providers are reset
+        IN PLACE so references already handed out follow the reseed."""
+        with self._lock:
+            if ctx is None:
+                self._root_seed = int(seed_state)
+                for key, prov in self._rand.items():
+                    prov.reset(self._derive_key(key))
+            else:
+                key = (ctx.device_type, ctx.device_id)
+                root = self._derive_key(key, root=int(seed_state))
+                if key in self._rand:
+                    self._rand[key].reset(root)
+                else:
+                    from .random import KeyProvider
+
+                    self._rand[key] = KeyProvider(root)
+
+    def _derive_key(self, key: Tuple[str, int], root: int = None):
+        import zlib
+
+        import jax
+
+        root_key = jax.random.PRNGKey(
+            self._root_seed if root is None else root)
+        # fold device type+id in so every device gets an independent,
+        # reproducible stream (ref: per-device mshadow Random seeds);
+        # crc32, not hash(): stable across processes (PYTHONHASHSEED)
+        folded = jax.random.fold_in(
+            jax.random.fold_in(root_key,
+                               zlib.crc32(key[0].encode()) & 0x7FFFFFFF),
+            key[1])
+        return folded
+
+    def _make_provider(self, key: Tuple[str, int]):
+        from .random import KeyProvider
+
+        return KeyProvider(self._derive_key(key))
+
+    def random(self, ctx: Context = None):
+        """kRandom: the device's KeyProvider."""
+        ctx = ctx or current_context()
+        key = (ctx.device_type, ctx.device_id)
+        with self._lock:
+            if key not in self._rand:
+                self._rand[key] = self._make_provider(key)
+            return self._rand[key]
+
+    def parallel_random(self, n: int, ctx: Context = None):
+        """kParallelRandom: `n` independent keys in one draw
+        (shape [n, 2] uint32)."""
+        import jax
+
+        base = self.random(ctx).next_key()
+        return jax.random.split(base, int(n))
+
+    # -- kTempSpace ------------------------------------------------------
+    def temp_space(self, nbytes: int, ctx: Context = None) -> np.ndarray:
+        """Host staging scratch, reused across requests on the same
+        (context, thread) and grown monotonically — callers must not
+        assume contents survive the next request (ref temp-space
+        contract).  Returns a uint8 view of length `nbytes`."""
+        ctx = ctx or current_context()
+        key = (ctx.device_type, ctx.device_id)
+        pool = getattr(self._tls, "pool", None)
+        if pool is None:
+            pool = self._tls.pool = {}
+        buf = pool.get(key)
+        if buf is None or buf.nbytes < nbytes:
+            buf = pool[key] = np.empty((max(int(nbytes), 1),), np.uint8)
+        return buf[:nbytes]
+
+    # -- generic front door (reference Resource::Request style) ----------
+    def request(self, kind: str, ctx: Context = None, **kw):
+        if kind == "temp_space":
+            return self.temp_space(kw.get("nbytes", 0), ctx)
+        if kind == "random":
+            return self.random(ctx)
+        if kind == "parallel_random":
+            return self.parallel_random(kw.get("n", 1), ctx)
+        if kind == "cudnn_dropout_desc":
+            raise MXNetError(
+                "resource kind 'cudnn_dropout_desc' has no TPU analogue "
+                "(dropout is a fused stateless op; no descriptor state)")
+        raise MXNetError(
+            f"unknown resource kind {kind!r}; expected one of {_KINDS}")
+
+
+_MANAGER = None
+_MANAGER_LOCK = threading.Lock()
+
+
+def resource_manager() -> ResourceManager:
+    global _MANAGER
+    if _MANAGER is None:
+        with _MANAGER_LOCK:
+            if _MANAGER is None:
+                _MANAGER = ResourceManager()
+    return _MANAGER

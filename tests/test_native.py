@@ -328,3 +328,98 @@ def test_native_writer_chunked_records(tmp_path):
         assert pr.read() == p
     assert pr.read() is None
     pr.close()
+
+
+# ---------------------------------------------------------------------------
+# N17: signal handlers + fork safety (mxnet_tpu/initialize.py, lib.py
+# fork guards; ref role: src/initialize.cc)
+# ---------------------------------------------------------------------------
+
+def test_signal_handler_installed_on_import():
+    """faulthandler is armed by package import (MXNET_USE_SIGNAL_HANDLER
+    default on) and stays off when explicitly disabled."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    code = ("import mxnet_tpu, faulthandler;"
+            "print(faulthandler.is_enabled())")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert r.stdout.strip() == "True", r.stdout
+
+    env["MXNET_USE_SIGNAL_HANDLER"] = "0"
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert r.stdout.strip() == "False", r.stdout
+
+
+def test_use_after_close_raises_not_crashes(tmp_path):
+    """A closed native handle must raise MXNetError, not reach C++ as
+    NULL (the old behavior was a hard crash)."""
+    from mxnet_tpu import MXNetError
+
+    path = str(tmp_path / "x.rec")
+    w = native.NativeRecordWriter(path)
+    w.write(b"payload")
+    w.close()
+    with pytest.raises(MXNetError, match="closed"):
+        w.write(b"more")
+    r = native.NativeRecordReader(path)
+    assert r.read() == b"payload"
+    r.close()
+    with pytest.raises(MXNetError, match="closed"):
+        r.read()
+
+
+@pytest.mark.skipif(not hasattr(os, "fork"), reason="posix only")
+def test_fork_safety_engine_and_reader(tmp_path):
+    """Fork with a live engine + reader: the child gets a WORKING engine
+    (rebuilt threads) and a loudly-invalid reader; the parent is
+    untouched (ref: pthread_atfork engine shutdown, initialize.cc)."""
+    from mxnet_tpu import MXNetError
+
+    path = str(tmp_path / "f.rec")
+    w = native.NativeRecordWriter(path)
+    w.write(b"rec0")
+    w.close()
+
+    eng = native.NativeEngine(num_workers=2)
+    v = eng.new_variable()
+    hits = []
+    for _ in range(8):
+        eng.push(lambda: hits.append(1), write=[v])
+    rd = native.NativeRecordReader(path)
+
+    pid = os.fork()
+    if pid == 0:  # child
+        rc = 1
+        try:
+            # engine was rebuilt: usable with fresh worker threads
+            cv = eng.new_variable()
+            got = []
+            eng.push(lambda: got.append(1), write=[cv])
+            eng.wait_for_all()
+            assert got == [1]
+            # reader was invalidated: loud error, no crash
+            try:
+                rd.read()
+            except MXNetError as e:
+                assert "fork" in str(e)
+                rc = 0
+        except BaseException:
+            import traceback
+
+            traceback.print_exc()
+        os._exit(rc)
+    _, status = os.waitpid(pid, 0)
+    assert os.waitstatus_to_exitcode(status) == 0
+    # parent: pre-fork work all drained by the before-fork quiesce
+    assert len(hits) == 8
+    eng.wait_for_all()
+    assert rd.read() == b"rec0"
+    rd.close()

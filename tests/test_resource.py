@@ -1,0 +1,79 @@
+"""N15 resource manager: kRandom / kParallelRandom / kTempSpace
+(mxnet_tpu/resource.py; ref role: src/resource.cc ResourceManager)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.resource import resource_manager
+
+
+def test_per_device_random_streams_deterministic_and_independent():
+    rm = resource_manager()
+    rm.seed(7)
+    k_cpu0 = np.asarray(rm.random(mx.cpu(0)).next_key())
+    k_cpu1 = np.asarray(rm.random(mx.cpu(1)).next_key())
+    assert not np.array_equal(k_cpu0, k_cpu1)  # independent per device
+    rm.seed(7)  # same root seed -> identical streams
+    assert np.array_equal(np.asarray(rm.random(mx.cpu(0)).next_key()),
+                          k_cpu0)
+    assert np.array_equal(np.asarray(rm.random(mx.cpu(1)).next_key()),
+                          k_cpu1)
+    rm.seed(8)  # new root -> new streams
+    assert not np.array_equal(np.asarray(rm.random(mx.cpu(0)).next_key()),
+                              k_cpu0)
+
+
+def test_seed_single_context_only():
+    rm = resource_manager()
+    rm.seed(7)
+    k0 = np.asarray(rm.random(mx.cpu(0)).next_key())
+    k1 = np.asarray(rm.random(mx.cpu(1)).next_key())
+    rm.seed(99, ctx=mx.cpu(0))  # reseed ONE device (MXRandomSeedContext)
+    n0 = np.asarray(rm.random(mx.cpu(0)).next_key())
+    n1 = np.asarray(rm.random(mx.cpu(1)).next_key())
+    assert not np.array_equal(n0, k0)
+    assert not np.array_equal(n1, k1)  # stream advanced...
+    rm.seed(7)
+    rm.random(mx.cpu(1)).next_key()
+    again1 = np.asarray(rm.random(mx.cpu(1)).next_key())
+    assert np.array_equal(again1, n1)  # ...but along the same sequence
+
+
+def test_mx_random_seed_ctx_routes_to_manager():
+    mx.random.seed(5, ctx=mx.cpu(2))
+    a = np.asarray(resource_manager().random(mx.cpu(2)).next_key())
+    mx.random.seed(5, ctx=mx.cpu(2))
+    b = np.asarray(resource_manager().random(mx.cpu(2)).next_key())
+    assert np.array_equal(a, b)
+
+
+def test_parallel_random_shape_and_uniqueness():
+    rm = resource_manager()
+    keys = np.asarray(rm.parallel_random(8, mx.cpu(0)))
+    assert keys.shape[0] == 8
+    assert len({tuple(k) for k in keys}) == 8  # all lanes distinct
+
+
+def test_temp_space_grow_only_reuse():
+    rm = resource_manager()
+    a = rm.temp_space(128, mx.cpu(0))
+    assert a.nbytes == 128 and a.dtype == np.uint8
+    b = rm.temp_space(64, mx.cpu(0))
+    # same backing buffer reused for a smaller request
+    assert b.base is a.base or b.base is a or a.base is b.base
+    c = rm.temp_space(1024, mx.cpu(0))
+    assert c.nbytes == 1024
+    # per-device pools are separate
+    d = rm.temp_space(1024, mx.cpu(1))
+    assert d.ctypes.data != c.ctypes.data
+
+
+def test_request_front_door_and_unknown_kind():
+    rm = resource_manager()
+    assert rm.request("temp_space", nbytes=16).nbytes == 16
+    assert rm.request("random") is not None
+    assert np.asarray(rm.request("parallel_random", n=3)).shape[0] == 3
+    with pytest.raises(mx.MXNetError, match="no TPU analogue"):
+        rm.request("cudnn_dropout_desc")
+    with pytest.raises(mx.MXNetError, match="unknown resource kind"):
+        rm.request("warp_drive")
