@@ -8,5 +8,7 @@ from . import ndarray
 from . import ndarray as nd
 from . import symbol
 from . import symbol as sym
+from . import quantization
+from . import onnx
 
-__all__ = ["ndarray", "nd", "symbol", "sym"]
+__all__ = ["ndarray", "nd", "symbol", "sym", "quantization", "onnx"]

@@ -169,6 +169,14 @@ def _after_fork_child() -> None:
             pass
 
 
+def _after_fork_parent() -> None:
+    for obj in list(_FORK_REGISTRY):
+        try:
+            obj._after_fork_parent()
+        except Exception:
+            pass
+
+
 def install_fork_handlers() -> None:
     """Register atfork hooks (idempotent; runs on os.fork / the
     multiprocessing 'fork' start method, NOT on subprocess spawn).
@@ -178,6 +186,7 @@ def install_fork_handlers() -> None:
         return
     _FORK_HOOKS_INSTALLED = True
     os.register_at_fork(before=_before_fork,
+                        after_in_parent=_after_fork_parent,
                         after_in_child=_after_fork_child)
 
 
@@ -198,6 +207,9 @@ class _HandleGuard:
         return h
 
     def _quiesce_before_fork(self) -> None:  # overridden where needed
+        pass
+
+    def _after_fork_parent(self) -> None:  # overridden where needed
         pass
 
     def _after_fork_child(self) -> None:
@@ -251,9 +263,18 @@ class NativeEngine(_HandleGuard):
     def _quiesce_before_fork(self) -> None:
         # drain all pending work so no worker thread holds an engine
         # mutex at the instant of fork (the child inherits the mutexes
-        # but not the threads — a held lock would deadlock it forever)
+        # but not the threads — a held lock would deadlock it forever),
+        # then take the Python-side callback lock across the fork so the
+        # child cannot inherit it mid-acquire (standard atfork protocol)
         if self._h:
             self.wait_for_all()
+        self._cb_lock.acquire()
+
+    def _after_fork_parent(self) -> None:
+        try:
+            self._cb_lock.release()
+        except RuntimeError:
+            pass
 
     def _after_fork_child(self) -> None:
         # the parent's worker threads don't exist here; leak the old C++
@@ -264,6 +285,7 @@ class NativeEngine(_HandleGuard):
         # leaked engine and error loudly on the rebuilt one.
         self._h = None
         self._needs_rebuild = True
+        self._cb_lock = threading.Lock()  # fresh, never inherited-held
 
     def _hh(self) -> ctypes.c_void_p:
         if getattr(self, "_needs_rebuild", False):

@@ -64,15 +64,31 @@ def _ctx_of_jax(arr) -> Context:
 
 
 class NDArray:
-    """An imperative, device-resident n-dimensional array."""
+    """An imperative, device-resident n-dimensional array.
 
-    __slots__ = ("_data", "_ctx", "_ag_grad_req", "_ag_grad", "_ag_node",
-                 "_deferred_init", "__weakref__")
+    View semantics (ref: NDArray::Slice/Reshape/At aliasing,
+    src/ndarray/ndarray.cc): basic `x[i]`/`x[a:b]`, `x.reshape(...)`,
+    `x.slice(...)`, `x.slice_axis(...)` and `x.at(i)` return VIEWS in
+    eager mode — writes through a view land in the base array and are
+    visible to every overlapping view, like the reference.  Under the
+    hood jax arrays are immutable, so a view carries (base, index-spec):
+    reads re-derive lazily from the base's version counter, and writes
+    rewrite the base functionally (`base.at[key].set`).  Under
+    autograd.record these methods return recorded op outputs instead
+    (no aliasing) so the tape stays sound."""
+
+    __slots__ = ("_buf", "_ctx", "_ag_grad_req", "_ag_grad", "_ag_node",
+                 "_deferred_init", "_base", "_vspec", "_version",
+                 "_pversion", "__weakref__")
 
     # make NDArray win over numpy in mixed operators
     __array_priority__ = 1000.0
 
     def __init__(self, data, ctx: Optional[Context] = None, dtype=None):
+        self._base = None
+        self._vspec = None
+        self._version = 0
+        self._pversion = -1
         if isinstance(data, NDArray):
             data = data._data
         if not isinstance(data, jax.Array):
@@ -85,11 +101,66 @@ class NDArray:
                 data = jax.device_put(data, dev)
             elif not isinstance(data, jax.Array):
                 data = jax.device_put(data, dev)
-        self._data = data
+        self._buf = data
         self._ctx = ctx or _ctx_of_jax(data)
         self._ag_grad_req = "null"
         self._ag_grad = None
         self._ag_node = None
+
+    # ---- view plumbing ---------------------------------------------------
+    @property
+    def _data(self):
+        """The current jax value; views re-derive from their base when
+        the base has changed since the last read."""
+        if self._base is not None:
+            self._refresh()
+        return self._buf
+
+    @_data.setter
+    def _data(self, value):
+        base = self._base
+        if base is None:
+            self._buf = value
+            self._version += 1
+            return
+        kind, arg = self._vspec
+        pval = base._data  # refreshes the parent chain first
+        value = jnp.asarray(value)
+        if kind == "index":
+            base._data = pval.at[arg].set(value.astype(pval.dtype))
+        else:  # reshape
+            base._data = value.astype(pval.dtype).reshape(pval.shape)
+        self._pversion = -1  # force re-derive on next read
+        self._refresh()
+
+    def _refresh(self):
+        parent = self._base
+        pval = parent._data  # recursive: refreshes the whole chain
+        if self._pversion == parent._version:
+            return
+        kind, arg = self._vspec
+        self._buf = pval[arg] if kind == "index" else pval.reshape(arg)
+        self._pversion = parent._version
+        self._version += 1
+
+    def _make_view(self, kind: str, arg) -> "NDArray":
+        out = NDArray.__new__(NDArray)
+        out._base = self
+        out._vspec = (kind, arg)
+        out._version = 0
+        out._pversion = -1
+        out._ctx = self._ctx
+        out._ag_grad_req = "null"
+        out._ag_grad = None
+        out._ag_node = None
+        pval = self._data
+        out._buf = pval[arg] if kind == "index" else pval.reshape(arg)
+        out._pversion = self._version
+        return out
+
+    @property
+    def is_view(self) -> bool:
+        return self._base is not None
 
     # ---- core properties -------------------------------------------------
     @property
@@ -346,12 +417,51 @@ class NDArray:
 
     __hash__ = object.__hash__
 
+    @staticmethod
+    def _eager_views() -> bool:
+        """Views only outside autograd recording (the tape needs real op
+        nodes for gradient flow; ref: autograd + view interaction)."""
+        from ..autograd import is_recording
+
+        return not is_recording()
+
     # ---- shape ops -------------------------------------------------------
     def reshape(self, *shape, **kwargs):
         if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
             shape = tuple(shape[0])
         shape = kwargs.get("shape", shape)
-        return self._op("reshape", shape=tuple(shape))
+        shape = tuple(shape)
+        concrete = self._concrete_shape(shape)
+        if concrete is not None and self._eager_views():
+            return self._make_view("reshape", concrete)
+        return self._op("reshape", shape=shape)
+
+    def _concrete_shape(self, shape):
+        """Resolve 0 (copy dim) and a single -1 against the current
+        shape; None for the -2/-3/-4 special codes (op path)."""
+        cur = self.shape
+        out = []
+        for i, s in enumerate(shape):
+            if not isinstance(s, int) or s < -1:
+                return None
+            out.append(cur[i] if s == 0 and i < len(cur) else s)
+        total = 1
+        for d in cur:
+            total *= d
+        if out.count(-1) == 1:
+            known = 1
+            for d in out:
+                if d != -1:
+                    known *= d
+            if known == 0 or total % known:
+                return None
+            out[out.index(-1)] = total // known
+        elif -1 in out:
+            return None
+        prod = 1
+        for d in out:
+            prod *= d
+        return tuple(out) if prod == total else None
 
     def reshape_like(self, other):
         return self.reshape(other.shape)
@@ -399,11 +509,29 @@ class NDArray:
                         constant_value=constant_value)
 
     def slice(self, begin, end, step=None):
+        if self._eager_views():
+            key = tuple(slice(b, e, s) for b, e, s in
+                        zip(begin, end, step or (None,) * len(begin)))
+            return self._make_view("index", key)
         return self._op("slice", begin=tuple(begin), end=tuple(end),
                         step=tuple(step) if step else None)
 
     def slice_axis(self, axis, begin, end):
+        if self._eager_views():
+            ax = axis + self.ndim if axis < 0 else axis
+            key = tuple(slice(None) for _ in range(ax)) + \
+                (slice(begin, end),)
+            return self._make_view("index", key)
         return self._op("slice_axis", axis=axis, begin=begin, end=end)
+
+    def at(self, idx: int):
+        """View of row `idx` sharing storage (ref: NDArray::At); a
+        tape-backed copy under autograd.record, like the other views."""
+        if self._eager_views():
+            return self._make_view("index", int(idx))
+        row = self._op("slice_axis", axis=0, begin=int(idx),
+                       end=int(idx) + 1)
+        return row.reshape(self.shape[1:])
 
     def take(self, indices, axis=0, mode="clip"):
         return self._op("take", NDArray._pre(indices), axis=axis, mode=mode)
@@ -481,10 +609,22 @@ class NDArray:
         return self._op("dot", NDArray._pre(other), transpose_a=transpose_a,
                         transpose_b=transpose_b)
 
+    @staticmethod
+    def _is_basic_key(key) -> bool:
+        if isinstance(key, (int, slice)) or key is Ellipsis:
+            return True
+        if isinstance(key, tuple):
+            return all(isinstance(k, (int, slice)) or k is Ellipsis
+                       for k in key)
+        return False
+
     # ---- indexing --------------------------------------------------------
     def __getitem__(self, key):
         if isinstance(key, NDArray):
             key = key.data
+        if self._is_basic_key(key) and self._eager_views():
+            # basic indexing aliases the base (ref: NDArray::Slice/At)
+            return self._make_view("index", key)
         out = self._data[key]
         return NDArray(out, ctx=self._ctx)
 

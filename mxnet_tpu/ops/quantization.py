@@ -4,13 +4,16 @@ TPU-native counterpart of src/operator/quantization/** (quantize.cc,
 quantize_v2.cc, dequantize.cc, requantize.cc, quantized_conv/fc/pool).
 
 The numeric core — quantize / quantize_v2 / dequantize / requantize —
-is implemented for real with the reference's affine int8/uint8 scheme
-(min/max calibration ranges carried alongside the payload).  The
-quantized COMPUTE kernels (quantized_conv, quantized_fully_connected,
-...) raise informatively: on TPU the MXU's native low-precision path is
-bfloat16/int8-with-fp32-accumulate chosen by XLA, and int8 inference
-graphs should be expressed through normal ops + these converters; there
-is no cuDNN-int8 analogue worth emulating op-by-op.
+implements the reference's affine int8/uint8 scheme (min/max
+calibration ranges carried alongside the payload).  The contraction
+kernels — quantized_conv, quantized_fully_connected, quantized_pooling,
+quantized_flatten — are REAL int8 ops: the MXU (and XLA CPU) execute
+int8 dot/conv with int32 accumulate natively.  Only the quantized
+*elementwise* variants remain stubs by design: between dequantize and
+the next quantize, elementwise math runs in fp32/bf16 and XLA fuses the
+converts for free, so dedicated int8 elementwise kernels would buy
+nothing on TPU.  `contrib.quantization.quantize_model` is the
+calibrating graph rewriter over these ops.
 """
 from __future__ import annotations
 
@@ -75,6 +78,8 @@ def _dequantize(data, min_range, max_range, out_type="float32"):
     if data.dtype == jnp.int8:
         absmax = jnp.maximum(jnp.abs(rmin), jnp.abs(rmax))
         return data.astype(jnp.float32) * (absmax / 127.0)
+    if data.dtype == jnp.int32:  # accumulator from quantized_conv/fc
+        return _dequantize_int32(data, rmin, rmax)
     scale = (rmax - rmin) / 255.0
     return data.astype(jnp.float32) * scale + rmin
 
@@ -103,21 +108,164 @@ def _dequantize_int32(data, min_range, max_range):
     return data.astype(jnp.float32) * (absmax / float(2 ** 31 - 1))
 
 
+# ---------------------------------------------------------------------------
+# Real int8 compute kernels: the MXU (and XLA CPU) execute int8
+# contractions with int32 accumulate natively, so quantized_conv /
+# quantized_fully_connected are true int8 ops, not emulation
+# (ref: quantized_conv.cc / quantized_fully_connected.cc semantics:
+# int8 in -> int32 out, calibration ranges propagated alongside).
+# ---------------------------------------------------------------------------
+
+_INT32_MAX = float(2 ** 31 - 1)
+
+
+def _absmax(lo, hi):
+    return jnp.maximum(jnp.abs(lo.reshape(())), jnp.abs(hi.reshape(())))
+
+
+def _int32_range(min_a, max_a, min_b, max_b):
+    """Output range convention for int32 accumulators: the float value of
+    accumulator V is V * (absmax_a/127) * (absmax_b/127); represent the
+    range as the float magnitude of the int32 extreme so dequantize's
+    int32 branch (absmax/2^31-1 scale) round-trips exactly."""
+    scale = (_absmax(min_a, max_a) / 127.0) * (_absmax(min_b, max_b) / 127.0)
+    out = _INT32_MAX * scale
+    return -out, out
+
+
+@register_op("_contrib_quantized_conv", aliases=("quantized_conv",),
+             num_outputs=3, differentiable=False)
+def _quantized_conv(data, weight, min_data, max_data, min_weight,
+                    max_weight, kernel=(), stride=(), dilate=(), pad=(),
+                    num_filter=0, num_group=1, layout=None, no_bias=True,
+                    cudnn_tune=None, cudnn_off=False, workspace=1024):
+    """int8 convolution with int32 accumulate on the MXU
+    (ref: quantization/quantized_conv.cc; bias is applied in fp32 after
+    dequantization by the quantize_model rewriter)."""
+    from jax import lax
+
+    if data.dtype != jnp.int8 or weight.dtype != jnp.int8:
+        raise MXNetError("quantized_conv expects int8 data and weight")
+    nd = len(kernel) if kernel else data.ndim - 2
+    stride = tuple(stride) if stride else (1,) * nd
+    dilate = tuple(dilate) if dilate else (1,) * nd
+    pad = tuple(pad) if pad else (0,) * nd
+    default = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[nd]
+    lay = layout or default
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=(lay, "OI" + default[2:], lay),
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    omin, omax = _int32_range(min_data, max_data, min_weight, max_weight)
+    return out, omin, omax
+
+
+@register_op("_contrib_quantized_fully_connected",
+             aliases=("quantized_fully_connected",), num_outputs=3,
+             differentiable=False)
+def _quantized_fc(data, weight, min_data, max_data, min_weight,
+                  max_weight, num_hidden=0, no_bias=True, flatten=True):
+    """int8 x int8^T -> int32 matmul (ref: quantized_fully_connected.cc;
+    fp32 bias applied post-dequantize by the rewriter)."""
+    from jax import lax
+
+    if data.dtype != jnp.int8 or weight.dtype != jnp.int8:
+        raise MXNetError("quantized_fully_connected expects int8 inputs")
+    x = data.reshape((data.shape[0], -1)) if flatten else data
+    out = lax.dot_general(
+        x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    omin, omax = _int32_range(min_data, max_data, min_weight, max_weight)
+    return out, omin, omax
+
+
+@register_op("_contrib_quantized_pooling", aliases=("quantized_pooling",),
+             num_outputs=3, differentiable=False)
+def _quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
+                       stride=(), pad=(), global_pool=False,
+                       pooling_convention="valid", layout=None):
+    """Pooling directly on int8 (max: exact; avg: int32 accumulate then
+    round back — ref: quantized_pooling.cc).  Mirrors the fp32 Pooling
+    op's layout + pooling_convention semantics so quantized and fp32
+    paths agree shape-for-shape."""
+    from jax import lax
+
+    nd = data.ndim - 2
+    channels_last = bool(layout) and layout[-1] == "C"
+    sp = (list(range(1, data.ndim - 1)) if channels_last
+          else list(range(2, data.ndim)))
+    if not global_pool and len(tuple(kernel)) != nd:
+        raise MXNetError(
+            f"quantized_pooling: kernel must have {nd} dims for "
+            f"{data.ndim}-d input (got {tuple(kernel)!r})")
+    if global_pool:
+        window = [data.shape[i] if i in sp else 1 for i in range(data.ndim)]
+        strides = [1] * data.ndim
+        pads = [(0, 0)] * data.ndim
+    else:
+        kernel = tuple(kernel)
+        stride = tuple(stride) if stride else (1,) * nd
+        pad = tuple(pad) if pad else (0,) * nd
+        sp_pad = [(p, p) for p in pad]
+        if pooling_convention == "full":
+            # ceil-mode: extend right padding (matches fp32 Pooling)
+            for i in range(nd):
+                in_sz = data.shape[sp[i]] + 2 * pad[i]
+                rem = (in_sz - kernel[i]) % stride[i]
+                if rem:
+                    lo, hi = sp_pad[i]
+                    sp_pad[i] = (lo, hi + stride[i] - rem)
+        elif pooling_convention != "valid":
+            raise MXNetError("quantized_pooling: pooling_convention must "
+                             f"be valid/full (got {pooling_convention!r})")
+        window = [1] * data.ndim
+        strides = [1] * data.ndim
+        pads = [(0, 0)] * data.ndim
+        for i in range(nd):
+            window[sp[i]] = kernel[i]
+            strides[sp[i]] = stride[i]
+            pads[sp[i]] = sp_pad[i]
+    if pool_type == "max":
+        init = jnp.iinfo(data.dtype).min  # int8 AND uint8 inputs
+        out = lax.reduce_window(data, jnp.asarray(init, data.dtype),
+                                lax.max, window, strides, pads)
+        return out, min_data.reshape(()), max_data.reshape(())
+    if pool_type == "avg":
+        info = jnp.iinfo(data.dtype)
+        acc = lax.reduce_window(data.astype(jnp.int32), 0, lax.add,
+                                window, strides, pads)
+        n = 1
+        for w in window:
+            n *= w
+        out = jnp.clip(jnp.round(acc / n), info.min,
+                       info.max).astype(data.dtype)
+        return out, min_data.reshape(()), max_data.reshape(())
+    raise MXNetError(f"quantized_pooling: unsupported pool_type "
+                     f"{pool_type!r}")
+
+
+@register_op("_contrib_quantized_flatten", aliases=("quantized_flatten",),
+             num_outputs=3, differentiable=False)
+def _quantized_flatten(data, min_data, max_data):
+    return (data.reshape((data.shape[0], -1)), min_data.reshape(()),
+            max_data.reshape(()))
+
+
 def _register_quantized_stub(name: str):
     def stub(*args, **kwargs):
         raise MXNetError(
-            f"{name} is not provided as a standalone kernel on TPU: the "
-            "MXU's low-precision path is bf16 (or XLA-chosen int8 with "
-            "fp32 accumulate).  Express int8 inference as "
-            "quantize_v2 -> normal ops -> dequantize, or train/serve in "
-            "bfloat16 (net.cast('bfloat16')) for the native fast path.")
+            f"{name} is not provided as a standalone kernel on TPU: "
+            "int8 contractions/pooling are real ops here "
+            "(quantized_conv/fully_connected/pooling), and everything "
+            "elementwise should run in fp32/bf16 between dequantize and "
+            "the next quantize — XLA fuses the converts for free.")
 
     stub.__name__ = name
     register_op(name, differentiable=False, no_jit=True)(stub)
 
 
-for _name in ("_contrib_quantized_conv", "_contrib_quantized_fully_connected",
-              "_contrib_quantized_pooling", "_contrib_quantized_flatten",
-              "_contrib_quantized_act", "_contrib_quantized_concat",
+for _name in ("_contrib_quantized_act", "_contrib_quantized_concat",
               "_contrib_quantized_elemwise_add"):
     _register_quantized_stub(_name)

@@ -52,7 +52,7 @@ def seed(seed_state: int, ctx=None):
     from the current context's stream via `next_key()`."""
     from .resource import resource_manager
 
-    if ctx is not None:
+    if ctx is not None and ctx != "all":  # 'all' = reference default
         resource_manager().seed(int(seed_state), ctx)
         return
     resource_manager().seed(int(seed_state))

@@ -256,3 +256,54 @@ def test_recordio_truncated_final_chunk_payload(tmp_path):
     with _pytest.raises(MXNetError, match="truncated"):
         r.read()
     r.close()
+
+
+# ---------------------------------------------------------------------------
+# tools/: parse_log.py + bandwidth.py (ref: tools/parse_log.py,
+# tools/bandwidth/)
+# ---------------------------------------------------------------------------
+
+def test_parse_log_tool(tmp_path):
+    import subprocess
+    import sys
+
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO Epoch[0] Batch [50]\tSpeed: 2461.16 samples/sec\taccuracy=0.5\n"
+        "INFO Epoch[0] Batch [100]\tSpeed: 2400.00 samples/sec\taccuracy=0.6\n"
+        "INFO Epoch[0] Train-accuracy=0.612000\n"
+        "INFO Epoch[0] Validation-accuracy=0.587000\n"
+        "INFO Epoch[0] Time cost=12.345\n"
+        "INFO Epoch[1] Train-accuracy=0.701000\n"
+        "INFO Epoch[1] Time cost=11.000\n")
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "parse_log.py")
+    r = subprocess.run([sys.executable, tool, str(log), "--format", "csv"],
+                      capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert lines[0] == "epoch,speed,time-s,train-accuracy,val-accuracy"
+    assert lines[1].startswith("0,2430.58,12.345,0.612,0.587")
+    assert lines[2].startswith("1,,11,0.701,")
+    r = subprocess.run([sys.executable, tool, str(log)],
+                      capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0 and "| epoch" in r.stdout
+
+
+def test_bandwidth_tool_mesh():
+    """In-graph allreduce bandwidth across the virtual 8-device mesh."""
+    import subprocess
+    import sys
+
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "bandwidth.py")
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, tool, "--sizes", "1", "--iters", "2"],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "mesh-psum x8" in r.stdout

@@ -153,3 +153,87 @@ def test_random_reproducibility():
     np.testing.assert_array_equal(a, b)
     c = nd.random.normal(loc=2.0, scale=0.001, shape=(1000,)).asnumpy()
     assert abs(c.mean() - 2.0) < 0.01
+
+
+# ---------------------------------------------------------------------------
+# N2: view aliasing semantics (ref: NDArray::Slice/Reshape/At share
+# storage; writes through a view are visible in the base and siblings)
+# ---------------------------------------------------------------------------
+
+def test_view_write_through_slice():
+    x = mx.nd.zeros((4, 3))
+    v = x[1:3]
+    assert v.is_view and v.shape == (2, 3)
+    v[:] = 7.0
+    np.testing.assert_array_equal(x.asnumpy()[1:3], np.full((2, 3), 7.0))
+    np.testing.assert_array_equal(x.asnumpy()[0], np.zeros(3))
+    # base write visible through the view
+    x[:] = 1.0
+    np.testing.assert_array_equal(v.asnumpy(), np.ones((2, 3)))
+
+
+def test_view_reshape_aliases():
+    x = mx.nd.arange(6)
+    m = x.reshape((2, 3))
+    assert m.is_view
+    m[0, 0] = 100.0
+    assert float(x.asnumpy()[0]) == 100.0
+    x[5] = -1.0
+    assert float(m.asnumpy()[1, 2]) == -1.0
+
+
+def test_view_at_and_sibling_views():
+    x = mx.nd.zeros((3, 2))
+    a = x.at(0)
+    b = x[0]          # overlapping sibling view
+    a[:] = 5.0
+    np.testing.assert_array_equal(b.asnumpy(), np.full((2,), 5.0))
+
+
+def test_view_of_view_chain():
+    x = mx.nd.zeros((4, 4))
+    v1 = x[1:3]            # (2,4)
+    v2 = v1.reshape((8,))  # view of view
+    v2[0] = 9.0
+    assert float(x.asnumpy()[1, 0]) == 9.0
+    v3 = v2.reshape((2, 4))[1]
+    v3[:] = 4.0
+    np.testing.assert_array_equal(x.asnumpy()[2], np.full((4,), 4.0))
+
+
+def test_view_slice_axis_and_slice():
+    x = mx.nd.zeros((4, 6))
+    s = x.slice_axis(1, 2, 5)
+    assert s.is_view and s.shape == (4, 3)
+    s[:] = 3.0
+    assert float(x.asnumpy()[:, 2:5].min()) == 3.0
+    t = x.slice((0, 0), (2, 2))
+    t[:] = -2.0
+    assert float(x.asnumpy()[:2, :2].max()) == -2.0
+
+
+def test_view_iadd_writes_through():
+    x = mx.nd.ones((4,))
+    v = x[1:3]
+    v += 10.0
+    np.testing.assert_array_equal(x.asnumpy(), [1.0, 11.0, 11.0, 1.0])
+
+
+def test_advanced_indexing_still_copies():
+    x = mx.nd.zeros((4,))
+    idx = mx.nd.array(np.array([0, 2], np.int32))
+    g = x[idx]
+    assert not g.is_view  # advanced indexing -> copy (reference parity)
+
+
+def test_views_not_aliased_under_autograd():
+    """Inside record() these methods must produce tape-backed op outputs
+    so gradients flow; aliasing is an eager-mode-only contract."""
+    x = mx.nd.ones((2, 3))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = x.reshape((6,))
+        assert not y.is_view
+        z = (y * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.ones((2, 3)))

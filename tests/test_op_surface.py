@@ -262,8 +262,15 @@ def test_quantize_v2_int8_self_calibrated():
 
 
 def test_quantized_kernels_raise_informatively():
-    with pytest.raises(MXNetError, match="bf16"):
-        nd._contrib_quantized_conv(nd.zeros((1, 3, 4, 4)))
+    # conv/fc/pooling are REAL int8 kernels now (test_quantization.py);
+    # only the elementwise variants remain redundant-by-design stubs
+    with pytest.raises(MXNetError, match="fuses the converts"):
+        nd._contrib_quantized_act(nd.zeros((1, 3, 4, 4)))
+    with pytest.raises(MXNetError, match="int8 data and weight"):
+        nd.quantized_conv(
+            nd.zeros((1, 3, 4, 4)), nd.zeros((4, 3, 3, 3)),
+            nd.zeros((1,)), nd.zeros((1,)), nd.zeros((1,)),
+            nd.zeros((1,)), kernel=(3, 3), num_filter=4)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,209 @@
+"""INT8 quantization: real int8 kernels + calibration + quantize_model
+(mxnet_tpu/contrib/quantization.py, ops/quantization.py; ref:
+src/operator/quantization/**, python/mxnet/contrib/quantization.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib.quantization import (_get_optimal_threshold,
+                                            quantize_model)
+
+
+def _qdq(x, absmax):
+    q = np.clip(np.round(x * (127.0 / absmax)), -127, 127)
+    return q * (absmax / 127.0)
+
+
+def test_quantized_fc_matches_fp32_within_quant_error():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8).astype(np.float32)
+    w = rng.randn(16, 8).astype(np.float32)
+    ax, aw = float(np.abs(x).max()), float(np.abs(w).max())
+    xq = nd.array(np.clip(np.round(x * 127 / ax), -127, 127).astype(np.int8))
+    wq = nd.array(np.clip(np.round(w * 127 / aw), -127, 127).astype(np.int8))
+    y32, omin, omax = nd.quantized_fully_connected(
+        xq, wq, nd.array([-ax]), nd.array([ax]),
+        nd.array([-aw]), nd.array([aw]), num_hidden=16)
+    assert y32.dtype == np.int32
+    y = nd.dequantize(y32, omin, omax).asnumpy()
+    ref = _qdq(x, ax) @ _qdq(w, aw).T
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-4)
+
+
+def test_quantized_conv_matches_fp32_within_quant_error():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    ax, aw = float(np.abs(x).max()), float(np.abs(w).max())
+    xq = nd.array(np.clip(np.round(x * 127 / ax), -127, 127).astype(np.int8))
+    wq = nd.array(np.clip(np.round(w * 127 / aw), -127, 127).astype(np.int8))
+    y32, omin, omax = nd.quantized_conv(
+        xq, wq, nd.array([-ax]), nd.array([ax]),
+        nd.array([-aw]), nd.array([aw]),
+        kernel=(3, 3), num_filter=4, pad=(1, 1))
+    y = nd.dequantize(y32, omin, omax).asnumpy()
+    ref = mx.nd.Convolution(nd.array(_qdq(x, ax).astype(np.float32)),
+                            nd.array(_qdq(w, aw).astype(np.float32)),
+                            kernel=(3, 3), num_filter=4, pad=(1, 1),
+                            no_bias=True).asnumpy()
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-3)
+
+
+def test_quantized_pooling_int8():
+    rng = np.random.RandomState(2)
+    x8 = rng.randint(-127, 128, (1, 2, 4, 4)).astype(np.int8)
+    out, lo, hi = nd.quantized_pooling(
+        nd.array(x8), nd.array([-1.0]), nd.array([1.0]),
+        kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert out.dtype == np.int8
+    ref = x8.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_array_equal(out.asnumpy(), ref)
+
+
+def test_optimal_threshold_clips_outliers():
+    rng = np.random.RandomState(3)
+    vals = np.concatenate([rng.randn(100_000).astype(np.float32),
+                           np.array([100.0], np.float32)])  # one outlier
+    th = _get_optimal_threshold(vals)
+    assert 0 < th < 50.0  # outlier clipped, bulk preserved
+    assert th > 2.0  # but not clipping the gaussian bulk
+
+
+def _toy_convnet():
+    data = mx.sym.var("data")
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                            pad=(1, 1), name="conv1")
+    a1 = mx.sym.Activation(c1, act_type="relu", name="relu1")
+    p1 = mx.sym.Pooling(a1, kernel=(2, 2), stride=(2, 2),
+                        pool_type="max", name="pool1")
+    f1 = mx.sym.FullyConnected(p1, num_hidden=10, name="fc1")
+    return f1
+
+
+def _init_params(sym, data_shape):
+    rng = np.random.RandomState(4)
+    args, _, _ = sym.infer_shape(data=data_shape)
+    arg_params = {}
+    for name, shp in zip(sym.list_arguments(), args):
+        if name == "data":
+            continue
+        arg_params[name] = nd.array(
+            (rng.randn(*shp) * 0.1).astype(np.float32))
+    return arg_params
+
+
+@pytest.mark.parametrize("calib_mode", ["none", "naive", "entropy"])
+def test_quantize_model_end_to_end(calib_mode):
+    data_shape = (4, 3, 8, 8)
+    sym = _toy_convnet()
+    arg_params = _init_params(sym, data_shape)
+    rng = np.random.RandomState(5)
+    calib = [nd.array(rng.randn(*data_shape).astype(np.float32))
+             for _ in range(3)]
+
+    qsym, qargs, qaux = quantize_model(
+        sym, arg_params, {}, calib_mode=calib_mode,
+        calib_data=None if calib_mode == "none" else calib,
+        quantized_dtype="int8")
+    assert "conv1_weight_quantized" in qargs
+    assert "fc1_weight_quantized" in qargs
+    assert "conv1_weight" not in qargs
+    assert qargs["conv1_weight_quantized"].dtype == np.int8
+    # biases stay fp32
+    assert qargs["conv1_bias"].dtype == np.float32
+
+    x = nd.array(rng.randn(*data_shape).astype(np.float32))
+    ref = sym.bind(mx.cpu(), dict(arg_params, data=x),
+                   grad_req="null").forward()[0].asnumpy()
+    out = qsym.bind(mx.cpu(), dict(qargs, data=x),
+                    grad_req="null").forward()[0].asnumpy()
+    # int8 model tracks the fp32 model closely on in-distribution data
+    denom = np.abs(ref).max() or 1.0
+    rel = np.abs(out - ref).max() / denom
+    assert rel < 0.12, (calib_mode, rel)
+    corr = np.corrcoef(out.ravel(), ref.ravel())[0, 1]
+    assert corr > 0.99, (calib_mode, corr)
+
+
+def test_quantize_model_excluded_layers_stay_fp32():
+    data_shape = (2, 3, 8, 8)
+    sym = _toy_convnet()
+    arg_params = _init_params(sym, data_shape)
+    qsym, qargs, _ = quantize_model(
+        sym, arg_params, {}, calib_mode="none",
+        excluded_sym_names=("conv1",))
+    assert "conv1_weight" in qargs  # untouched
+    assert "conv1_weight_quantized" not in qargs
+    assert "fc1_weight_quantized" in qargs
+    rng = np.random.RandomState(6)
+    x = nd.array(rng.randn(*data_shape).astype(np.float32))
+    out = qsym.bind(mx.cpu(), dict(qargs, data=x),
+                    grad_req="null").forward()[0]
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_quantize_model_requires_targets_and_valid_mode():
+    data = mx.sym.var("data")
+    s = mx.sym.Activation(data, act_type="relu", name="r")
+    with pytest.raises(mx.MXNetError, match="no quantizable"):
+        quantize_model(s, {}, {}, calib_mode="none")
+    sym = _toy_convnet()
+    with pytest.raises(mx.MXNetError, match="calib_mode"):
+        quantize_model(sym, {}, {}, calib_mode="bogus")
+    with pytest.raises(mx.MXNetError, match="needs calib_data"):
+        quantize_model(sym, {}, {}, calib_mode="naive")
+
+
+def test_num_calib_examples_smaller_than_batch_still_calibrates():
+    data_shape = (4, 3, 8, 8)
+    sym = _toy_convnet()
+    arg_params = _init_params(sym, data_shape)
+    rng = np.random.RandomState(7)
+    calib = [nd.array(rng.randn(*data_shape).astype(np.float32))
+             for _ in range(4)]
+    qsym, qargs, _ = quantize_model(
+        sym, arg_params, {}, calib_mode="naive", calib_data=calib,
+        num_calib_examples=2)  # < first batch of 4: must still run
+    assert "conv1_weight_quantized" in qargs
+
+
+def test_tied_weight_shared_by_two_layers():
+    """A weight var consumed by TWO quantizable layers and by a non-target
+    op: quantized once, fp32 original kept for the non-target consumer."""
+    data = mx.sym.var("data")
+    w = mx.sym.var("w")
+    f1 = mx.sym.FullyConnected(data, weight=w, num_hidden=8,
+                               no_bias=True, name="fc1")
+    f2 = mx.sym.FullyConnected(f1, weight=w, num_hidden=8,
+                               no_bias=True, name="fc2")
+    # a non-target consumer of the same weight var
+    reg = mx.sym.sum(w * w, name="l2")
+    out = mx.sym.Group([f2, reg])
+    rng = np.random.RandomState(8)
+    arg_params = {"w": nd.array(rng.randn(8, 8).astype(np.float32) * 0.3)}
+    qsym, qargs, _ = quantize_model(out, arg_params, {}, calib_mode="none")
+    assert "w_quantized" in qargs
+    assert "w" in qargs  # kept: the l2 term still reads fp32 w
+    x = nd.array(rng.randn(2, 8).astype(np.float32))
+    res = qsym.bind(mx.cpu(), dict(qargs, data=x),
+                    grad_req="null").forward()
+    ref_w = arg_params["w"].asnumpy()
+    np.testing.assert_allclose(res[1].asnumpy(), (ref_w * ref_w).sum(),
+                               rtol=1e-5)
+
+
+def test_quantized_pooling_full_convention_matches_fp32_shape():
+    rng = np.random.RandomState(9)
+    x = rng.randn(1, 2, 7, 7).astype(np.float32)
+    x8 = np.clip(np.round(x * 63), -127, 127).astype(np.int8)
+    fp = mx.nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                       pool_type="max", pooling_convention="full")
+    q, _, _ = nd.quantized_pooling(
+        nd.array(x8), nd.array([-2.0]), nd.array([2.0]),
+        kernel=(3, 3), stride=(2, 2), pool_type="max",
+        pooling_convention="full")
+    assert q.shape == fp.shape  # ceil-mode shapes agree with fp32 path
+    with pytest.raises(mx.MXNetError, match="kernel must have"):
+        nd.quantized_pooling(nd.array(x8), nd.array([-2.0]),
+                             nd.array([2.0]), pool_type="max")

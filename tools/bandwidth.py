@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Collective-bandwidth benchmark (ref role: tools/bandwidth/measure.py).
+
+Measures what the gradient-sync substrate actually delivers:
+
+- **in-graph allreduce over the device mesh** (ICI on real multi-chip
+  TPU; host shared-memory on the virtual CPU mesh): a jitted psum over a
+  1-D mesh, reported as algorithm bandwidth `2*(n-1)/n * bytes / time`
+  (ring-allreduce convention, comparable to NCCL/horovod numbers).
+- **eager DCN allreduce** (`parallel.dist.allreduce_nd`, gloo) when run
+  under a multi-process launch (tools/launch.py).
+
+Usage:
+    python tools/bandwidth.py                        # single process
+    python tools/launch.py -n 2 python tools/bandwidth.py   # adds DCN
+    python tools/bandwidth.py --sizes 1,8,64 --devices 8
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mesh_allreduce_bw(sizes_mb, n_devices=None, iters=10):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    n = min(n_devices or len(devs), len(devs))
+    if n < 2:
+        print(f"[bandwidth] only {n} device(s): skipping mesh allreduce")
+        return []
+    mesh = Mesh(np.array(devs[:n]), ("x",))
+    rows = []
+    for mb in sizes_mb:
+        elems = int(mb * (1 << 20) / 4)
+        x = jnp.ones((n, max(elems // 1, 1)), jnp.float32)
+        x = jax.device_put(x, NamedSharding(mesh, P("x")))
+
+        @jax.jit
+        def allreduce(a):
+            return jnp.broadcast_to(jnp.sum(a, axis=0, keepdims=True),
+                                    a.shape)
+
+        out = allreduce(x)  # compile + warm
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = allreduce(x)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        nbytes = elems * 4
+        algo_bw = 2 * (n - 1) / n * nbytes / dt / 1e9
+        rows.append((f"mesh-psum x{n}", mb, dt * 1e3, algo_bw))
+    return rows
+
+
+def _dcn_allreduce_bw(sizes_mb, iters=5):
+    from mxnet_tpu import nd
+    from mxnet_tpu.parallel import dist
+
+    dist.init()
+    if dist.num_workers() < 2:
+        return []
+    rows = []
+    n = dist.num_workers()
+    for mb in sizes_mb:
+        elems = int(mb * (1 << 20) / 4)
+        v = nd.ones((elems,))
+        dist.allreduce_nd(v)  # warm (compile + gloo connect)
+        dist.barrier("bw")
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = dist.allreduce_nd(v)
+        out.wait_to_read()
+        dt = (time.perf_counter() - t0) / iters
+        algo_bw = 2 * (n - 1) / n * elems * 4 / dt / 1e9
+        rows.append((f"dcn-gloo x{n}", mb, dt * 1e3, algo_bw))
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sizes", default="1,16,64",
+                    help="comma-separated message sizes in MB")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="cap the mesh size (default: all local devices)")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args(argv)
+    sizes = [float(s) for s in args.sizes.split(",")]
+
+    rows = _mesh_allreduce_bw(sizes, args.devices, args.iters)
+    rows += _dcn_allreduce_bw(sizes)
+    if not rows:
+        print("nothing measured (1 device, 1 process)")
+        return 1
+    print(f"{'path':<16}{'MB':>8}{'ms':>10}{'algo GB/s':>12}")
+    for path, mb, ms, bw in rows:
+        print(f"{path:<16}{mb:>8g}{ms:>10.3f}{bw:>12.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (ref role: tools/parse_log.py).
+
+Extracts per-epoch train/validation metrics, time cost, and Speedometer
+throughput from logs produced by `Module.fit` / `Speedometer` /
+`Trainer` loops:
+
+    Epoch[3] Batch [50]\tSpeed: 2461.16 samples/sec\taccuracy=0.91
+    Epoch[3] Train-accuracy=0.912
+    Epoch[3] Validation-accuracy=0.887
+    Epoch[3] Time cost=12.345
+
+Usage:
+    python tools/parse_log.py train.log                  # markdown table
+    python tools/parse_log.py train.log --format csv
+    python tools/parse_log.py train.log --format json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from collections import defaultdict
+
+_SPEED = re.compile(
+    r"Epoch\[(\d+)\]\s+Batch\s*\[(\d+)\]\s+Speed:\s*([\d.]+)\s*samples/sec")
+_TRAIN = re.compile(r"Epoch\[(\d+)\]\s+Train-([\w-]+)=([-\d.einfa]+)")
+_VAL = re.compile(r"Epoch\[(\d+)\]\s+Validation-([\w-]+)=([-\d.einfa]+)")
+_TIME = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([\d.]+)")
+
+
+def parse(lines):
+    """-> {epoch: {column: value}} plus mean throughput per epoch."""
+    rows = defaultdict(dict)
+    speeds = defaultdict(list)
+    for line in lines:
+        m = _SPEED.search(line)
+        if m:
+            speeds[int(m.group(1))].append(float(m.group(3)))
+            continue
+        m = _TRAIN.search(line)
+        if m:
+            rows[int(m.group(1))][f"train-{m.group(2)}"] = float(m.group(3))
+            continue
+        m = _VAL.search(line)
+        if m:
+            rows[int(m.group(1))][f"val-{m.group(2)}"] = float(m.group(3))
+            continue
+        m = _TIME.search(line)
+        if m:
+            rows[int(m.group(1))]["time-s"] = float(m.group(2))
+    for ep, ss in speeds.items():
+        rows[ep]["speed"] = sum(ss) / len(ss)
+    return dict(rows)
+
+
+def render(rows, fmt: str) -> str:
+    epochs = sorted(rows)
+    cols = sorted({c for r in rows.values() for c in r})
+    if fmt == "json":
+        return json.dumps({str(e): rows[e] for e in epochs}, indent=2)
+    header = ["epoch"] + cols
+    table = [[str(e)] + [f"{rows[e][c]:.6g}" if c in rows[e] else ""
+                         for c in cols] for e in epochs]
+    if fmt == "csv":
+        return "\n".join(",".join(r) for r in [header] + table)
+    widths = [max(len(h), *(len(r[i]) for r in table)) if table else len(h)
+              for i, h in enumerate(header)]
+    def fmt_row(r):
+        return "| " + " | ".join(v.ljust(w) for v, w in zip(r, widths)) + " |"
+    sep = "|" + "|".join("-" * (w + 2) for w in widths) + "|"
+    return "\n".join([fmt_row(header), sep] + [fmt_row(r) for r in table])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("logfile", help="training log ('-' for stdin)")
+    ap.add_argument("--format", choices=["markdown", "csv", "json"],
+                    default="markdown")
+    args = ap.parse_args(argv)
+    lines = (sys.stdin if args.logfile == "-"
+             else open(args.logfile)).readlines()
+    rows = parse(lines)
+    if not rows:
+        print("no epoch records found", file=sys.stderr)
+        return 1
+    print(render(rows, args.format))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
