@@ -1,0 +1,244 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+TPU-native counterpart of the reference's control-flow ops
+(ref: src/operator/control_flow.cc; python/mxnet/ndarray/contrib.py
+foreach/while_loop/cond).  Where the reference builds subgraphs executed
+by a C++ loop executor, here the natural lowering IS the XLA structured
+primitive — `lax.scan` / `lax.while_loop` / `lax.cond` — so a hybridized
+block containing `foreach` compiles to ONE fused scan on the MXU instead
+of an unrolled chain (the whole point of SURVEY.md's "compiler-friendly
+control flow" design stance).
+
+Three execution regimes, chosen automatically:
+
+- **autograd.record**: a Python loop of tape-registered ops (slice/
+  stack), so gradients flow through the existing tape exactly like the
+  reference's imperative loop.
+- **eager (no grad)**: `lax.scan`/`lax.while_loop`/`lax.cond` over the
+  jax values — one compiled program per (body, shapes).
+- **inside a trace** (hybridize / CachedOp / symbolic executor): same
+  lax path; the tracer values compose into the enclosing program.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _as_list(x) -> Tuple[List, bool]:
+    if isinstance(x, (list, tuple)):
+        return list(x), True
+    return [x], False
+
+
+def _unlist(xs: List, was_list: bool):
+    return list(xs) if was_list else xs[0]
+
+
+def _values(nds: Sequence[NDArray]):
+    return [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+            for a in nds]
+
+
+def _wrap(vals, ctx) -> List[NDArray]:
+    # tracers can't answer .devices(): wrap without a ctx pin (the ctx
+    # of a traced value is decided by the enclosing program)
+    return [NDArray(v, ctx=None if isinstance(v, jax.core.Tracer)
+                    else ctx) for v in vals]
+
+
+def _recording() -> bool:
+    from ..autograd import is_recording
+
+    return is_recording()
+
+
+def foreach(body: Callable, data, init_states):
+    """Iterate `body(data_slice, states) -> (outputs, new_states)` over
+    axis 0 of `data`; returns (stacked outputs, final states)
+    (ref: mx.nd.contrib.foreach).  `data`/`outputs`/`states` may each be
+    an NDArray or a list of NDArrays."""
+    data_l, data_is_list = _as_list(data)
+    states_l, states_is_list = _as_list(init_states)
+    if not data_l:
+        raise MXNetError("foreach: data must contain at least one array")
+    length = data_l[0].shape[0]
+    for d in data_l:
+        if d.shape[0] != length:
+            raise MXNetError("foreach: all data arrays must share axis-0 "
+                             f"length (got {d.shape[0]} vs {length})")
+    ctx = data_l[0].ctx if isinstance(data_l[0], NDArray) else None
+
+    if _recording() and length > 0:
+        # tape-backed unrolled loop (gradient path); zero-length data
+        # falls to the scan path below (no iterations -> constant
+        # outputs, nothing for the tape to record)
+        outs: List[List[NDArray]] = []
+        states = list(states_l)
+        for i in range(length):
+            sl = [d.slice_axis(0, i, i + 1).reshape(d.shape[1:])
+                  for d in data_l]
+            o, states = body(_unlist(sl, data_is_list),
+                             _unlist(states, states_is_list))
+            states, _ = _as_list(states)
+            o_l, o_is_list = _as_list(o)
+            outs.append(o_l)
+        from .. import nd
+
+        stacked = [nd.stack(*[step[j] for step in outs], axis=0)
+                   for j in range(len(outs[0]))]
+        return (_unlist(stacked, o_is_list),
+                _unlist(states, states_is_list))
+
+    out_is_list = [None]
+    from .. import random as rnd
+
+    # the body must NOT split the ambient PRNG provider's key inside the
+    # scan trace (the side effect would leak an inner tracer into the
+    # outer scope); instead one key is drawn OUTSIDE and a per-iteration
+    # key folded from it is scoped around the body
+    base_key = rnd.next_key()
+
+    def scan_body(carry, xs):
+        i, carry = carry[0], carry[1:]
+        prov = rnd.KeyProvider(jax.random.fold_in(base_key, i))
+        with rnd.key_provider(prov):
+            o, new_states = body(
+                _unlist(_wrap(xs, ctx), data_is_list),
+                _unlist(_wrap(list(carry), ctx), states_is_list))
+        o_l, o_is = _as_list(o)
+        out_is_list[0] = o_is
+        ns_l, _ = _as_list(new_states)
+        return ((i + 1,) + tuple(_values(ns_l)), tuple(_values(o_l)))
+
+    carry, ys = lax.scan(
+        scan_body, (jnp.asarray(0),) + tuple(_values(states_l)),
+        tuple(_values(data_l)))
+    outs = _wrap(list(ys), ctx)
+    final = _wrap(list(carry[1:]), ctx)
+    return (_unlist(outs, out_is_list[0]),
+            _unlist(final, states_is_list))
+
+
+def while_loop(cond_fn: Callable, func: Callable, loop_vars,
+               max_iterations: int = None):
+    """`while cond_fn(*loop_vars): outputs, loop_vars = func(*loop_vars)`
+    (ref: mx.nd.contrib.while_loop).  Returns (stacked outputs, final
+    loop_vars); outputs are padded to `max_iterations` rows (the
+    reference's symbolic contract — XLA needs static shapes)."""
+    lv, _ = _as_list(loop_vars)
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations (static "
+                         "output shape on TPU)")
+    ctx = lv[0].ctx if lv and isinstance(lv[0], NDArray) else None
+
+    def _pred(*vars_):
+        p = cond_fn(*vars_)
+        if isinstance(p, NDArray):
+            return bool(p.asnumpy().reshape(()))
+        return bool(p)
+
+    concrete = all(not isinstance(v._data, jax.core.Tracer) for v in lv
+                   if isinstance(v, NDArray))
+    if _recording() or concrete:
+        # imperative loop: exact trip count, taped when recording
+        outs = []
+        o_is_list = False
+        n = 0
+        while n < max_iterations and _pred(*lv):
+            o, new_lv = func(*lv)
+            lv, _ = _as_list(new_lv)
+            o_l, o_is_list = _as_list(o)
+            outs.append(o_l)
+            n += 1
+        if not outs:
+            raise MXNetError("while_loop: cond was false on entry "
+                             "(no outputs to stack)")
+        from .. import nd
+
+        stacked = []
+        for j in range(len(outs[0])):
+            rows = nd.stack(*[step[j] for step in outs], axis=0)
+            if n < max_iterations:  # pad to the static contract
+                pad = nd.zeros((max_iterations - n,) + rows.shape[1:],
+                               dtype=rows.dtype)
+                rows = nd.concat(rows, pad, dim=0)
+            stacked.append(rows)
+        return (_unlist(stacked, o_is_list),
+                _unlist(lv, isinstance(loop_vars, (list, tuple))))
+
+    # traced: fixed-trip lax loop with padded output buffers
+    from .. import random as rnd
+
+    base_key = rnd.next_key()  # see foreach: keep body draws scan-local
+    with rnd.key_provider(rnd.KeyProvider(base_key)):
+        probe_o, _ = func(*lv)
+    probe_l, o_is_list = _as_list(probe_o)
+    bufs = tuple(jnp.zeros((max_iterations,) + tuple(p.shape),
+                           p._data.dtype) for p in probe_l)
+
+    def body(state):
+        i, vals, bufs_ = state
+        nds = _wrap(list(vals), ctx)
+        with rnd.key_provider(
+                rnd.KeyProvider(jax.random.fold_in(base_key, i))):
+            o, new_lv = func(*nds)
+        o_l, _ = _as_list(o)
+        new_l, _ = _as_list(new_lv)
+        bufs_ = tuple(b.at[i].set(v) for b, v in
+                      zip(bufs_, _values(o_l)))
+        return i + 1, tuple(_values(new_l)), bufs_
+
+    def keep_going(state):
+        i, vals, _ = state
+        ok = cond_fn(*_wrap(list(vals), ctx))
+        return jnp.logical_and(i < max_iterations,
+                               jnp.asarray(ok._data).reshape(()))
+
+    n, final, bufs = lax.while_loop(
+        keep_going, body, (jnp.asarray(0), tuple(_values(lv)), bufs))
+    return (_unlist(_wrap(list(bufs), ctx), o_is_list),
+            _unlist(_wrap(list(final), ctx),
+                    isinstance(loop_vars, (list, tuple))))
+
+
+def cond(pred, then_func: Callable, else_func: Callable):
+    """`then_func() if pred else else_func()` with both branches traced
+    on TPU (ref: mx.nd.contrib.cond)."""
+    pv = pred._data if isinstance(pred, NDArray) else jnp.asarray(pred)
+    if _recording() or not isinstance(pv, jax.core.Tracer):
+        take_then = bool(jnp.asarray(pv).reshape(()))
+        return then_func() if take_then else else_func()
+    ctx = pred.ctx if isinstance(pred, NDArray) else None
+    is_list = [False]
+    from .. import random as rnd
+
+    base_key = rnd.next_key()  # see foreach: keep branch draws local
+
+    def _branch(fn, salt):
+        def run(_):
+            prov = rnd.KeyProvider(jax.random.fold_in(base_key, salt))
+            with rnd.key_provider(prov):
+                o, o_is = _as_list(fn())
+            is_list[0] = o_is
+            return tuple(_values(o))
+        return run
+
+    # each branch traces exactly ONCE, inside lax.cond; a structure
+    # mismatch surfaces as lax.cond's TypeError, re-raised with context
+    try:
+        out = lax.cond(jnp.asarray(pv).reshape(()).astype(bool),
+                       _branch(then_func, 0), _branch(else_func, 1), None)
+    except TypeError as e:
+        raise MXNetError(
+            f"cond: branches must return the same structure "
+            f"(shapes/dtypes/arity): {e}")
+    return _unlist(_wrap(list(out), ctx), is_list[0])

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 from ..ndarray import register as _register
+from .control_flow import cond, foreach, while_loop  # noqa: F401
 
 
 def __getattr__(name):

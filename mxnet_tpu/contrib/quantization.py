@@ -75,18 +75,20 @@ def _get_optimal_threshold(samples: np.ndarray, num_bins: int = 2001,
         # candidate dist: the UNCLIPPED slice quantized to
         # num_quantized_bins and expanded back over occupied bins; the
         # mismatch against p's outlier-loaded last bin is the clipping
-        # cost the KL score must see
+        # cost the KL score must see.  Vectorized: contiguous partition
+        # of the i source bins, per-chunk sums/nonzero-counts via
+        # reduceat, expansion via the per-bin chunk index.
         sliced = hist[:i]
-        q = np.zeros(i, np.float64)
         factor = i / num_quantized_bins
-        for j in range(num_quantized_bins):
-            lo = int(np.floor(j * factor))
-            hi = min(int(np.ceil((j + 1) * factor)), i)
-            chunk = sliced[lo:hi]
-            total = chunk.sum()
-            nz = (chunk > 0).sum()
-            if nz:
-                q[lo:hi] = np.where(chunk > 0, total / nz, 0.0)
+        starts = np.floor(np.arange(num_quantized_bins)
+                          * factor).astype(np.int64)
+        chunk_of = np.searchsorted(starts, np.arange(i),
+                                   side="right") - 1
+        sums = np.add.reduceat(sliced, starts)
+        nz = np.add.reduceat((sliced > 0).astype(np.float64), starts)
+        fill = np.divide(sums, nz, out=np.zeros_like(sums),
+                         where=nz > 0)
+        q = np.where(sliced > 0, fill[chunk_of], 0.0)
         if q.sum() == 0:
             continue
         # smooth the RAW counts (every nonzero count is >= 1, so the
@@ -330,7 +332,7 @@ def quantize_model(sym, arg_params, aux_params=None, data_names=("data",),
         has_bias = (not node.attrs.get("no_bias", False)
                     and len(node.inputs) > 2)
         if has_bias:
-            bias = Symbol([(node.inputs[2][0], 0)])
+            bias = _sym_of(*node.inputs[2])
             if node.op == "Convolution":
                 lay = node.attrs.get("layout") or "NCHW"
                 ndim = len(node.attrs.get("kernel", ())) or 2

@@ -269,12 +269,18 @@ class NativeEngine(_HandleGuard):
         if self._h:
             self.wait_for_all()
         self._cb_lock.acquire()
+        self._cb_lock_held_for_fork = True
 
     def _after_fork_parent(self) -> None:
-        try:
-            self._cb_lock.release()
-        except RuntimeError:
-            pass
+        # only release what _quiesce_before_fork actually took: a bare
+        # release() could strip the lock from a thread inside push()
+        # when the quiesce raised before acquiring
+        if getattr(self, "_cb_lock_held_for_fork", False):
+            self._cb_lock_held_for_fork = False
+            try:
+                self._cb_lock.release()
+            except RuntimeError:
+                pass
 
     def _after_fork_child(self) -> None:
         # the parent's worker threads don't exist here; leak the old C++
@@ -286,6 +292,7 @@ class NativeEngine(_HandleGuard):
         self._h = None
         self._needs_rebuild = True
         self._cb_lock = threading.Lock()  # fresh, never inherited-held
+        self._cb_lock_held_for_fork = False
 
     def _hh(self) -> ctypes.c_void_p:
         if getattr(self, "_needs_rebuild", False):

@@ -95,7 +95,8 @@ class NDArray:
             data = jnp.asarray(np.asarray(data), dtype=dtype)
         elif dtype is not None and data.dtype != jnp.dtype(dtype):
             data = data.astype(dtype)
-        if ctx is not None:
+        if ctx is not None and not isinstance(data, jax.core.Tracer):
+            # (tracers have no placement — the enclosing trace decides)
             dev = ctx.jax_device
             if getattr(data, "devices", None) and list(data.devices()) != [dev]:
                 data = jax.device_put(data, dev)
@@ -437,14 +438,42 @@ class NDArray:
         return self._op("reshape", shape=shape)
 
     def _concrete_shape(self, shape):
-        """Resolve 0 (copy dim) and a single -1 against the current
-        shape; None for the -2/-3/-4 special codes (op path)."""
-        cur = self.shape
+        """Resolve every reference reshape code — 0 (copy dim), -1
+        (infer), -2 (copy rest), -3 (merge two), -4 (split) — against
+        the current shape, so aliasing does not depend on how the shape
+        is spelled.  None when unresolvable (falls to the op path)."""
+        cur = list(self.shape)
+        shape = list(shape)
         out = []
-        for i, s in enumerate(shape):
-            if not isinstance(s, int) or s < -1:
-                return None
-            out.append(cur[i] if s == 0 and i < len(cur) else s)
+        si = k = 0
+        try:
+            while k < len(shape):
+                s = shape[k]
+                if not isinstance(s, (int, np.integer)):
+                    return None
+                s = int(s)
+                if s == 0:
+                    out.append(cur[si]); si += 1
+                elif s == -2:
+                    out.extend(cur[si:]); si = len(cur)
+                elif s == -3:
+                    out.append(cur[si] * cur[si + 1]); si += 2
+                elif s == -4:
+                    a, b = int(shape[k + 1]), int(shape[k + 2])
+                    if a == -1:
+                        a = cur[si] // b
+                    if b == -1:
+                        b = cur[si] // a
+                    out.extend([a, b]); si += 1; k += 2
+                elif s < -4:
+                    return None
+                else:
+                    out.append(s)
+                    if s != -1:
+                        si += 1
+                k += 1
+        except (IndexError, ZeroDivisionError):
+            return None
         total = 1
         for d in cur:
             total *= d
@@ -611,11 +640,13 @@ class NDArray:
 
     @staticmethod
     def _is_basic_key(key) -> bool:
-        if isinstance(key, (int, slice)) or key is Ellipsis:
+        # np.integer counts: x[np.argmax(...)] must alias exactly like
+        # x[int(...)] — the index dtype must not flip the contract
+        if isinstance(key, (int, np.integer, slice)) or key is Ellipsis:
             return True
         if isinstance(key, tuple):
-            return all(isinstance(k, (int, slice)) or k is Ellipsis
-                       for k in key)
+            return all(isinstance(k, (int, np.integer, slice))
+                       or k is Ellipsis for k in key)
         return False
 
     # ---- indexing --------------------------------------------------------

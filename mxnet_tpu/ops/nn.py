@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..base import MXNetError
 from .registry import register_op
 
 
@@ -129,11 +130,45 @@ def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
 # Pooling (ref: src/operator/nn/pooling-inl.h)
 # ---------------------------------------------------------------------------
 
+def pool_window(data_shape, kernel, stride, pad, pooling_convention,
+                channels_last):
+    """Shared pooling geometry: (window, strides, padding) over the FULL
+    rank, honoring the valid/full (ceil-mode) convention.  Single source
+    of truth for fp32 Pooling AND quantized_pooling — their shapes must
+    agree exactly."""
+    nd = len(data_shape) - 2
+    kernel = tuple(kernel)
+    if len(kernel) != nd:
+        raise MXNetError(
+            f"pooling: kernel must have {nd} dims for "
+            f"{len(data_shape)}-d input (got {kernel!r})")
+    stride = tuple(stride) if stride else (1,) * nd
+    pad = tuple(pad) if pad else (0,) * nd
+    sp0 = 1 if channels_last else 2   # first spatial axis
+
+    sp_pad = tuple((p, p) for p in pad)
+    if pooling_convention == "full":
+        # ceil-mode: extend padding on the right so ceil division is covered
+        extra = []
+        for i in range(nd):
+            in_sz = data_shape[sp0 + i] + 2 * pad[i]
+            rem = (in_sz - kernel[i]) % stride[i]
+            extra.append(0 if rem == 0 else stride[i] - rem)
+        sp_pad = tuple((p, p + e) for p, e in zip(pad, extra))
+    elif pooling_convention != "valid":
+        raise MXNetError("pooling_convention must be valid/full "
+                         f"(got {pooling_convention!r})")
+    if channels_last:
+        return ((1,) + kernel + (1,), (1,) + stride + (1,),
+                ((0, 0),) + sp_pad + ((0, 0),))
+    return ((1, 1) + kernel, (1, 1) + stride,
+            ((0, 0), (0, 0)) + sp_pad)
+
+
 @register_op("Pooling", aliases=("pooling",))
 def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
              global_pool=False, pooling_convention="valid", count_include_pad=True,
              cudnn_off=False, layout=None):
-    nd = data.ndim - 2
     channels_last = bool(layout) and layout[-1] == "C"
     if global_pool:
         axes = (tuple(range(1, data.ndim - 1)) if channels_last
@@ -142,25 +177,8 @@ def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
             return jnp.max(data, axis=axes, keepdims=True)
         return jnp.mean(data, axis=axes, keepdims=True)
     kernel = tuple(kernel)
-    stride = tuple(stride) if stride else (1,) * nd
-    pad = tuple(pad) if pad else (0,) * nd
-    sp0 = 1 if channels_last else 2   # first spatial axis
-
-    def _full(k, s, p):   # (kernel, strides, per-spatial padding) -> window
-        if channels_last:
-            return (1,) + k + (1,), (1,) + s + (1,), ((0, 0),) + p + ((0, 0),)
-        return (1, 1) + k, (1, 1) + s, ((0, 0), (0, 0)) + p
-
-    sp_pad = tuple((p, p) for p in pad)
-    if pooling_convention == "full":
-        # ceil-mode: extend padding on the right so ceil division is covered
-        extra = []
-        for i in range(nd):
-            in_sz = data.shape[sp0 + i] + 2 * pad[i]
-            rem = (in_sz - kernel[i]) % stride[i]
-            extra.append(0 if rem == 0 else stride[i] - rem)
-        sp_pad = tuple((p, p + e) for p, e in zip(pad, extra))
-    window, strides, padding = _full(kernel, stride, sp_pad)
+    window, strides, padding = pool_window(
+        data.shape, kernel, stride, pad, pooling_convention, channels_last)
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         return lax.reduce_window(data, init, lax.max, window, strides, padding)
@@ -522,3 +540,120 @@ def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
     a1 = jnp.take_along_axis(alpha_T, end1[:, None], axis=1)[:, 0]
     a2 = jnp.take_along_axis(alpha_T, jnp.maximum(end2, 0)[:, None], axis=1)[:, 0]
     return -jnp.logaddexp(a1, a2)
+
+
+# ---------------------------------------------------------------------------
+# UpSampling + spatial transformer family
+# (ref: src/operator/nn/upsampling-inl.h, spatial_transformer-inl.h,
+#  bilinear_sampler-inl.h, grid_generator-inl.h)
+# ---------------------------------------------------------------------------
+
+@register_op("UpSampling", aliases=("upsampling",))
+def _upsampling(*datas, scale=1, sample_type="nearest", num_args=1,
+                num_filter=0, multi_input_mode="concat", workspace=512):
+    """Spatial upsampling, NCHW.  'nearest' repeats pixels; 'bilinear'
+    resizes with align-corners-false bilinear interpolation (played here
+    by jax.image.resize instead of the reference's fixed deconv
+    kernel).  Multiple inputs are each upsampled to the first input's
+    scaled size, then concatenated on channels (reference semantics)."""
+    import jax as _jax
+
+    scale = int(scale)
+    outs = []
+    n, _, h0, w0 = datas[0].shape
+    th, tw = h0 * scale, w0 * scale
+    for d in datas:
+        if sample_type == "nearest":
+            s = th // d.shape[2]
+            up = jnp.repeat(jnp.repeat(d, s, axis=2), tw // d.shape[3],
+                            axis=3)
+        elif sample_type == "bilinear":
+            up = _jax.image.resize(
+                d, d.shape[:2] + (th, tw), method="bilinear")
+        else:
+            raise MXNetError(f"UpSampling: unknown sample_type "
+                             f"{sample_type!r}")
+        outs.append(up)
+    if len(outs) == 1:
+        return outs[0]
+    if multi_input_mode == "sum":
+        out = outs[0]
+        for o in outs[1:]:
+            out = out + o
+        return out
+    return jnp.concatenate(outs, axis=1)
+
+
+def _grid_sample_bilinear(data, grid):
+    """Sample NCHW `data` at normalized grid coords (N, 2, Ho, Wo) in
+    [-1, 1] (x, y order), zero padding outside — the BilinearSampler
+    contract (ref: bilinear_sampler-inl.h)."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0   # (N, Ho, Wo)
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def tap(yi, xi):
+        inb = ((yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1))
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        # gather per batch: (N, C, Ho, Wo)
+        v = jax.vmap(lambda img, ys, xs: img[:, ys, xs])(data, yc, xc)
+        return v * inb[:, None].astype(data.dtype)
+
+    v00 = tap(y0, x0)
+    v01 = tap(y0, x0 + 1)
+    v10 = tap(y0 + 1, x0)
+    v11 = tap(y0 + 1, x0 + 1)
+    wx = wx[:, None].astype(data.dtype)
+    wy = wy[:, None].astype(data.dtype)
+    return ((1 - wy) * ((1 - wx) * v00 + wx * v01)
+            + wy * ((1 - wx) * v10 + wx * v11))
+
+
+@register_op("BilinearSampler", aliases=("bilinear_sampler",))
+def _bilinear_sampler(data, grid, cudnn_off=False):
+    return _grid_sample_bilinear(data, grid)
+
+
+@register_op("GridGenerator", aliases=("grid_generator",))
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Build a sampling grid: 'affine' from (N, 6) theta over
+    target_shape, 'warp' from (N, 2, H, W) pixel offsets
+    (ref: grid_generator-inl.h)."""
+    if transform_type == "affine":
+        th, tw = int(target_shape[0]), int(target_shape[1])
+        if th <= 0 or tw <= 0:
+            raise MXNetError("GridGenerator(affine) needs target_shape")
+        theta = data.reshape((-1, 2, 3)).astype(jnp.float32)
+        ys = jnp.linspace(-1.0, 1.0, th)
+        xs = jnp.linspace(-1.0, 1.0, tw)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx.ravel(), gy.ravel(),
+                          jnp.ones(th * tw)], axis=0)  # (3, HW)
+        out = theta @ base                              # (N, 2, HW)
+        return out.reshape((-1, 2, th, tw))
+    if transform_type == "warp":
+        n, _, h, w = data.shape
+        gy, gx = jnp.meshgrid(jnp.arange(h), jnp.arange(w), indexing="ij")
+        fx = (gx[None] + data[:, 0]) * 2.0 / max(w - 1, 1) - 1.0
+        fy = (gy[None] + data[:, 1]) * 2.0 / max(h - 1, 1) - 1.0
+        return jnp.stack([fx, fy], axis=1)
+    raise MXNetError(f"GridGenerator: unknown transform_type "
+                     f"{transform_type!r}")
+
+
+@register_op("SpatialTransformer", aliases=("spatial_transformer",))
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine",
+                         sampler_type="bilinear", cudnn_off=False):
+    """Affine spatial transformer network layer = GridGenerator +
+    BilinearSampler (ref: spatial_transformer-inl.h)."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise MXNetError("SpatialTransformer supports affine+bilinear")
+    grid = _grid_generator(loc, transform_type="affine",
+                           target_shape=target_shape)
+    return _grid_sample_bilinear(data, grid)

@@ -192,41 +192,22 @@ def _quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
     paths agree shape-for-shape."""
     from jax import lax
 
-    nd = data.ndim - 2
+    from .nn import pool_window
+
     channels_last = bool(layout) and layout[-1] == "C"
-    sp = (list(range(1, data.ndim - 1)) if channels_last
-          else list(range(2, data.ndim)))
-    if not global_pool and len(tuple(kernel)) != nd:
-        raise MXNetError(
-            f"quantized_pooling: kernel must have {nd} dims for "
-            f"{data.ndim}-d input (got {tuple(kernel)!r})")
     if global_pool:
-        window = [data.shape[i] if i in sp else 1 for i in range(data.ndim)]
+        sp = (range(1, data.ndim - 1) if channels_last
+              else range(2, data.ndim))
+        window = [data.shape[i] if i in sp else 1
+                  for i in range(data.ndim)]
         strides = [1] * data.ndim
         pads = [(0, 0)] * data.ndim
     else:
-        kernel = tuple(kernel)
-        stride = tuple(stride) if stride else (1,) * nd
-        pad = tuple(pad) if pad else (0,) * nd
-        sp_pad = [(p, p) for p in pad]
-        if pooling_convention == "full":
-            # ceil-mode: extend right padding (matches fp32 Pooling)
-            for i in range(nd):
-                in_sz = data.shape[sp[i]] + 2 * pad[i]
-                rem = (in_sz - kernel[i]) % stride[i]
-                if rem:
-                    lo, hi = sp_pad[i]
-                    sp_pad[i] = (lo, hi + stride[i] - rem)
-        elif pooling_convention != "valid":
-            raise MXNetError("quantized_pooling: pooling_convention must "
-                             f"be valid/full (got {pooling_convention!r})")
-        window = [1] * data.ndim
-        strides = [1] * data.ndim
-        pads = [(0, 0)] * data.ndim
-        for i in range(nd):
-            window[sp[i]] = kernel[i]
-            strides[sp[i]] = stride[i]
-            pads[sp[i]] = sp_pad[i]
+        # single source of truth with the fp32 Pooling op: shapes of the
+        # int8 and fp32 paths must agree exactly
+        window, strides, pads = pool_window(
+            data.shape, kernel, stride, pad, pooling_convention,
+            channels_last)
     if pool_type == "max":
         init = jnp.iinfo(data.dtype).min  # int8 AND uint8 inputs
         out = lax.reduce_window(data, jnp.asarray(init, data.dtype),

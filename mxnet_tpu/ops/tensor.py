@@ -420,7 +420,7 @@ def _where(cond, x, y):
                      else cond, x, y)
 
 
-@register_op("sequence_mask")
+@register_op("sequence_mask", aliases=("SequenceMask",))
 def _sequence_mask(data, sequence_length=None, use_sequence_length=False,
                    value=0.0, axis=0):
     if not use_sequence_length or sequence_length is None:
@@ -436,7 +436,7 @@ def _sequence_mask(data, sequence_length=None, use_sequence_length=False,
     return jnp.where(mask, data, jnp.asarray(value, data.dtype))
 
 
-@register_op("sequence_last")
+@register_op("sequence_last", aliases=("SequenceLast",))
 def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
     if not use_sequence_length or sequence_length is None:
         idx = [slice(None)] * data.ndim
@@ -448,7 +448,7 @@ def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0
         moved, last.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0)[0]
 
 
-@register_op("sequence_reverse")
+@register_op("sequence_reverse", aliases=("SequenceReverse",))
 def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
     if not use_sequence_length or sequence_length is None:
         return jnp.flip(data, axis=axis)

@@ -1,0 +1,216 @@
+"""Control-flow ops + spatial transformer family + UpSampling
+(mxnet_tpu/contrib/control_flow.py, ops/nn.py; ref:
+src/operator/control_flow.cc, spatial_transformer-inl.h,
+upsampling-inl.h)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib import ndarray as C
+
+XS = np.arange(12, dtype=np.float32).reshape(4, 3)
+
+
+def _body(x, s):
+    s2 = s + x
+    return s2, s2
+
+
+def test_foreach_eager_scan():
+    outs, final = C.foreach(_body, nd.array(XS), nd.zeros((3,)))
+    np.testing.assert_allclose(outs.asnumpy(), np.cumsum(XS, axis=0))
+    np.testing.assert_allclose(final.asnumpy(), XS.sum(0))
+
+
+def test_foreach_multiple_data_and_states():
+    d2 = nd.array(XS * 2)
+    outs, states = C.foreach(
+        lambda xs, ss: ((xs[0] + xs[1], xs[0]), (ss[0] + xs[1], ss[1])),
+        [nd.array(XS), d2], [nd.zeros((3,)), nd.ones((3,))])
+    np.testing.assert_allclose(outs[0].asnumpy(), XS * 3)
+    np.testing.assert_allclose(states[0].asnumpy(), (XS * 2).sum(0))
+    np.testing.assert_allclose(states[1].asnumpy(), np.ones(3))
+
+
+def test_foreach_gradient_through_tape():
+    w = nd.ones((3,))
+    w.attach_grad()
+    with mx.autograd.record():
+        o, _ = C.foreach(lambda x, s: (s + x * w, s + x * w),
+                         nd.array(XS), nd.zeros((3,)))
+        loss = o.sum()
+    loss.backward()
+    expect = (XS * np.arange(4, 0, -1)[:, None]).sum(0)
+    np.testing.assert_allclose(w.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_foreach_traced_composes_into_jit():
+    def fn(dv, sv):
+        o, f = C.foreach(_body, nd.NDArray(dv), nd.NDArray(sv))
+        return o.data, f.data
+
+    o, f = jax.jit(fn)(jnp.asarray(XS), jnp.zeros((3,)))
+    np.testing.assert_allclose(np.asarray(o), np.cumsum(XS, axis=0))
+
+
+def test_while_loop_eager_and_traced():
+    i0 = nd.array(np.array([0.0], np.float32))
+    outs, fin = C.while_loop(lambda i: i < 3, lambda i: (i * 2, i + 1),
+                             [i0], max_iterations=5)
+    np.testing.assert_allclose(fin[0].asnumpy(), [3.0])
+    np.testing.assert_allclose(outs.asnumpy().ravel(), [0, 2, 4, 0, 0])
+
+    def fn(iv):
+        o, fin = C.while_loop(
+            lambda i: i.reshape(()) < 3, lambda i: (i * 2, i + 1),
+            [nd.NDArray(iv)], max_iterations=5)
+        return o.data, fin[0].data
+
+    o, fv = jax.jit(fn)(jnp.array([0.0]))
+    np.testing.assert_allclose(np.asarray(fv), [3.0])
+    np.testing.assert_allclose(np.asarray(o).ravel(), [0, 2, 4, 0, 0])
+    with pytest.raises(mx.MXNetError, match="max_iterations"):
+        C.while_loop(lambda i: i < 3, lambda i: (i, i), [i0])
+
+
+def test_cond_eager_and_traced():
+    r = C.cond(nd.array(np.array([1.0])), lambda: nd.ones((2,)),
+               lambda: nd.zeros((2,)))
+    np.testing.assert_allclose(r.asnumpy(), [1, 1])
+
+    def fn(p):
+        return C.cond(nd.NDArray(p), lambda: nd.ones((2,)),
+                      lambda: nd.zeros((2,))).data
+
+    assert np.asarray(jax.jit(fn)(jnp.array(1.0))).tolist() == [1, 1]
+    assert np.asarray(jax.jit(fn)(jnp.array(0.0))).tolist() == [0, 0]
+
+
+# ---------------------------------------------------------------------------
+# UpSampling + SpatialTransformer family
+# ---------------------------------------------------------------------------
+
+def test_upsampling_nearest_and_bilinear():
+    x = nd.array(np.arange(2 * 1 * 4 * 4, np.float32).reshape(2, 1, 4, 4)
+                 if False else
+                 np.arange(32, dtype=np.float32).reshape(2, 1, 4, 4))
+    u = nd.UpSampling(x, scale=2, sample_type="nearest")
+    assert u.shape == (2, 1, 8, 8)
+    np.testing.assert_allclose(
+        u.asnumpy()[:, :, ::2, ::2], x.asnumpy())
+    b = nd.UpSampling(x, scale=2, sample_type="bilinear")
+    assert b.shape == (2, 1, 8, 8)
+    # bilinear preserves mean
+    np.testing.assert_allclose(b.asnumpy().mean(), x.asnumpy().mean(),
+                               rtol=0.05)
+
+
+def test_upsampling_multi_input_concat():
+    a = nd.ones((1, 2, 4, 4))
+    b = nd.ones((1, 3, 2, 2)) * 2
+    out = nd.UpSampling(a, b, scale=2, sample_type="nearest", num_args=2)
+    assert out.shape == (1, 5, 8, 8)
+    np.testing.assert_allclose(out.asnumpy()[:, 2:], 2 * np.ones((1, 3, 8, 8)))
+
+
+def test_spatial_transformer_identity_and_shift():
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    ident = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    out = nd.SpatialTransformer(x, ident, target_shape=(4, 4))
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy(), atol=1e-5)
+    # grid generator + sampler compose to the same thing
+    g = nd.GridGenerator(ident, transform_type="affine",
+                         target_shape=(4, 4))
+    np.testing.assert_allclose(nd.BilinearSampler(x, g).asnumpy(),
+                               x.asnumpy(), atol=1e-5)
+
+
+def test_bilinear_sampler_zero_padding_outside():
+    x = nd.ones((1, 1, 4, 4))
+    # shift far right: everything samples outside -> zeros
+    theta = nd.array(np.array([[1, 0, 10.0, 0, 1, 0]], np.float32))
+    out = nd.SpatialTransformer(x, theta, target_shape=(4, 4))
+    np.testing.assert_allclose(out.asnumpy(), np.zeros((1, 1, 4, 4)))
+
+
+def test_grid_generator_warp():
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    zero_flow = nd.zeros((1, 2, 4, 4))
+    g = nd.GridGenerator(zero_flow, transform_type="warp")
+    np.testing.assert_allclose(nd.BilinearSampler(x, g).asnumpy(),
+                               x.asnumpy(), atol=1e-5)
+
+
+def test_bilinear_sampler_gradient():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    x = nd.array(np.random.RandomState(0).randn(1, 1, 3, 3)
+                 .astype(np.float32))
+    theta = nd.array(np.array([[1, 0, 0.1, 0, 1, -0.1]], np.float32))
+    theta.attach_grad()
+    x.attach_grad()
+    with mx.autograd.record():
+        out = nd.SpatialTransformer(x, theta, target_shape=(3, 3))
+        loss = (out * out).sum()
+    loss.backward()
+    assert np.isfinite(theta.grad.asnumpy()).all()
+    assert np.abs(theta.grad.asnumpy()).sum() > 0
+    assert np.isfinite(x.grad.asnumpy()).all()
+
+
+def test_sequence_camelcase_aliases():
+    x = nd.array(np.ones((3, 2), np.float32))
+    sl = nd.array(np.array([1, 2], np.float32))
+    m = nd.SequenceMask(x, sl, use_sequence_length=True)
+    np.testing.assert_allclose(m.asnumpy(),
+                               [[1, 1], [0, 1], [0, 0]])
+    last = nd.SequenceLast(x, sl, use_sequence_length=True)
+    assert last.shape == (2,)
+    rev = nd.SequenceReverse(x, sl, use_sequence_length=True)
+    assert rev.shape == x.shape
+
+
+def test_foreach_in_hybridized_block_with_dropout():
+    """The hardest composition: a keyed op (Dropout) inside foreach
+    inside a hybridized block — body PRNG draws must stay scan-local
+    (one key folded per iteration), not contaminate the outer trace."""
+    from mxnet_tpu.gluon import nn, HybridBlock
+
+    class ScanRNN(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.cell = nn.Dense(8, in_units=8 + 4, activation="relu")
+            self.drop = nn.Dropout(0.3)
+            self.out = nn.Dense(2, in_units=8)
+
+        def forward(self, x):
+            init = nd.zeros((x.shape[1], 8), ctx=x.ctx)
+
+            def step(xt, h):
+                h2 = self.drop(self.cell(nd.concat(h, xt, dim=1)))
+                return h2, h2
+
+            _, final = C.foreach(step, x, init)
+            return self.out(final)
+
+    X = np.random.RandomState(0).randn(5, 4, 4).astype("f4")
+    net = ScanRNN()
+    net.initialize(mx.initializer.Xavier())
+    net.hybridize()
+    out = net(nd.array(X))
+    assert out.shape == (4, 2)
+    assert np.isfinite(out.asnumpy()).all()
+    out2 = net(nd.array(X))  # cached executable path
+    assert out2.shape == (4, 2)
+    # gradient through the eager (tape) path with the same net
+    net2 = ScanRNN()
+    net2.initialize(mx.initializer.Xavier())
+    with mx.autograd.record():
+        loss = (net2(nd.array(X)) ** 2).sum()
+    loss.backward()
+    g = net2.cell.weight.grad().asnumpy()
+    assert np.isfinite(g).all()

@@ -237,3 +237,23 @@ def test_views_not_aliased_under_autograd():
         z = (y * y).sum()
     z.backward()
     np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.ones((2, 3)))
+
+
+def test_view_numpy_int_index_aliases():
+    x = mx.nd.zeros((4, 3))
+    i = np.int64(1)
+    v = x[i]
+    assert v.is_view  # np.integer must behave exactly like int
+    v[:] = 2.0
+    np.testing.assert_array_equal(x.asnumpy()[1], np.full((3,), 2.0))
+
+
+def test_view_reshape_special_codes_alias():
+    x = mx.nd.zeros((2, 3, 4))
+    for spec, shape in ((( -3, 0), (6, 4)), ((0, -2), (2, 3, 4)),
+                        ((-4, 1, 2, -2), (1, 2, 3, 4)), ((0, 0, -1), (2, 3, 4))):
+        v = x.reshape(spec)
+        assert v.is_view and v.shape == shape, (spec, v.shape)
+        v[(0,) * len(shape)] = 5.0
+        assert float(x.asnumpy().ravel()[0]) == 5.0
+        x[:] = 0.0

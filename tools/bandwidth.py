@@ -5,8 +5,8 @@ Measures what the gradient-sync substrate actually delivers:
 
 - **in-graph allreduce over the device mesh** (ICI on real multi-chip
   TPU; host shared-memory on the virtual CPU mesh): a jitted psum over a
-  1-D mesh, reported as algorithm bandwidth `2*(n-1)/n * bytes / time`
-  (ring-allreduce convention, comparable to NCCL/horovod numbers).
+  1-D mesh, reported as BUS bandwidth `2*(n-1)/n * bytes / time` (the
+  nccl-tests `busbw` convention — hardware-limit comparable).
 - **eager DCN allreduce** (`parallel.dist.allreduce_nd`, gloo) when run
   under a multi-process launch (tools/launch.py).
 
@@ -39,8 +39,10 @@ def _mesh_allreduce_bw(sizes_mb, n_devices=None, iters=10):
     mesh = Mesh(np.array(devs[:n]), ("x",))
     rows = []
     for mb in sizes_mb:
+        # --sizes is the PER-RANK message size (the ring-allreduce
+        # convention: every device contributes an mb-MB buffer)
         elems = int(mb * (1 << 20) / 4)
-        x = jnp.ones((n, max(elems // 1, 1)), jnp.float32)
+        x = jnp.ones((n, max(elems, 1)), jnp.float32)
         x = jax.device_put(x, NamedSharding(mesh, P("x")))
 
         @jax.jit
@@ -56,8 +58,8 @@ def _mesh_allreduce_bw(sizes_mb, n_devices=None, iters=10):
         jax.block_until_ready(out)
         dt = (time.perf_counter() - t0) / iters
         nbytes = elems * 4
-        algo_bw = 2 * (n - 1) / n * nbytes / dt / 1e9
-        rows.append((f"mesh-psum x{n}", mb, dt * 1e3, algo_bw))
+        bus_bw = 2 * (n - 1) / n * nbytes / dt / 1e9
+        rows.append((f"mesh-psum x{n}", mb, dt * 1e3, bus_bw))
     return rows
 
 
@@ -80,8 +82,8 @@ def _dcn_allreduce_bw(sizes_mb, iters=5):
             out = dist.allreduce_nd(v)
         out.wait_to_read()
         dt = (time.perf_counter() - t0) / iters
-        algo_bw = 2 * (n - 1) / n * elems * 4 / dt / 1e9
-        rows.append((f"dcn-gloo x{n}", mb, dt * 1e3, algo_bw))
+        bus_bw = 2 * (n - 1) / n * elems * 4 / dt / 1e9
+        rows.append((f"dcn-gloo x{n}", mb, dt * 1e3, bus_bw))
     return rows
 
 
@@ -100,7 +102,7 @@ def main(argv=None) -> int:
     if not rows:
         print("nothing measured (1 device, 1 process)")
         return 1
-    print(f"{'path':<16}{'MB':>8}{'ms':>10}{'algo GB/s':>12}")
+    print(f"{'path':<16}{'MB':>8}{'ms':>10}{'bus GB/s':>12}")
     for path, mb, ms, bw in rows:
         print(f"{path:<16}{mb:>8g}{ms:>10.3f}{bw:>12.2f}")
     return 0
