@@ -8,6 +8,10 @@ example/ssd/train.py.
 Usage:
   python examples/ssd_train.py                 # TPU, resnet50 backbone
   python examples/ssd_train.py --cpu --small   # CPU smoke (CI)
+  python tools/im2rec.py voc train.lst /data/VOCdevkit --pack-label ...
+  python examples/ssd_train.py --rec voc.rec --epochs 10
+      # REAL-DATA path: RecordIO shards with packed object labels
+      # (im2rec --pack-label), decoded by image.ImageDetIter
 """
 from __future__ import annotations
 
@@ -24,6 +28,10 @@ def main():
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--classes", type=int, default=20)
     ap.add_argument("--no-hybridize", action="store_true")
+    ap.add_argument("--rec", default=None,
+                    help=".rec file with im2rec --pack-label object "
+                         "labels (real-data path via ImageDetIter)")
+    ap.add_argument("--epochs", type=int, default=1)
     args = ap.parse_args()
 
     if args.cpu:
@@ -51,14 +59,7 @@ def main():
     trainer = Trainer(net.collect_params(), "sgd",
                       {"learning_rate": 1e-3, "momentum": 0.9, "wd": 5e-4})
 
-    rng = np.random.RandomState(0)
-    x = nd.array(rng.randn(args.batch_size, 3, size, size).astype("float32"),
-                 ctx=ctx)
-    labels = nd.array(
-        np.stack([[[rng.randint(args.classes), 0.2, 0.2, 0.7, 0.7]]
-                  for _ in range(args.batch_size)]).astype("float32"), ctx=ctx)
-
-    for step in range(args.steps):
+    def train_step(x, labels, step):
         tic = time.time()
         with autograd.record():
             cls_preds, box_preds, anchors = net(x)
@@ -68,6 +69,39 @@ def main():
         trainer.step(args.batch_size)
         lval = float(loss.asnumpy().mean())
         print(f"step {step}: loss={lval:.4f} ({time.time() - tic:.2f}s)")
+        return cls_preds, box_preds, anchors
+
+    rng = np.random.RandomState(0)
+    if args.rec:
+        from mxnet_tpu.image import CreateDetAugmenter, ImageDetIter
+
+        it = ImageDetIter(
+            batch_size=args.batch_size, data_shape=(3, size, size),
+            path_imgrec=args.rec,
+            aug_list=CreateDetAugmenter((3, size, size),
+                                        rand_mirror=True, mean=True,
+                                        std=True))
+        step = 0
+        for _ in range(args.epochs):
+            it.reset()
+            for batch in it:
+                # packed labels are [cls, x1, y1, x2, y2] already in
+                # relative corner coords — the target generator's format
+                x = batch.data[0].as_in_context(ctx)
+                labels = batch.label[0].as_in_context(ctx)
+                cls_preds, box_preds, anchors = train_step(x, labels,
+                                                           step)
+                step += 1
+    else:
+        x = nd.array(
+            rng.randn(args.batch_size, 3, size, size).astype("float32"),
+            ctx=ctx)
+        labels = nd.array(
+            np.stack([[[rng.randint(args.classes), 0.2, 0.2, 0.7, 0.7]]
+                      for _ in range(args.batch_size)]).astype("float32"),
+            ctx=ctx)
+        for step in range(args.steps):
+            cls_preds, box_preds, anchors = train_step(x, labels, step)
 
     # decode detections for the final batch
     out = nd.MultiBoxDetection(

@@ -9,6 +9,9 @@ reused across epochs.  The reference-era equivalent is Sockeye's train.py
 Usage:
   python examples/transformer_nmt.py                # TPU, transformer-base
   python examples/transformer_nmt.py --cpu --small  # CPU smoke (CI)
+  python examples/transformer_nmt.py --src train.de --tgt train.en
+      # REAL-DATA path: parallel corpus, one sentence per line; vocabs
+      # built from the data, batches bucketed by source length
 """
 from __future__ import annotations
 
@@ -23,7 +26,13 @@ def main():
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--vocab", type=int, default=32000)
     ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--src", default=None,
+                    help="source-language text file (one sentence/line)")
+    ap.add_argument("--tgt", default=None,
+                    help="target-language text file, parallel to --src")
     args = ap.parse_args()
+    if bool(args.src) != bool(args.tgt):
+        ap.error("--src and --tgt must be given together")
 
     if args.cpu:
         import jax
@@ -56,32 +65,101 @@ def main():
     trainer = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
 
     rng = np.random.RandomState(0)
-    BOS = 1
+    PAD, BOS = 0, 1
 
-    def make_batch(seq_len):
-        """reverse-copy task: tgt = reversed(src)."""
-        b = args.batch_size
-        src = rng.randint(3, args.vocab, (b, seq_len)).astype("float32")
-        tgt_out = src[:, ::-1].copy()
-        tgt_in = np.concatenate([np.full((b, 1), BOS), tgt_out[:, :-1]],
-                                axis=1).astype("float32")
-        vlen = np.full(b, seq_len, "float32")
-        return (nd.array(src, ctx=ctx), nd.array(tgt_in, ctx=ctx),
-                nd.array(tgt_out, ctx=ctx), nd.array(vlen, ctx=ctx))
+    if args.src:
+        # ---- real-data path: parallel corpus, length-bucketed --------
+        def read_vocab(path):
+            from collections import Counter
+
+            counts = Counter()
+            lines = []
+            with open(path) as f:
+                for line in f:
+                    toks = line.split()
+                    lines.append(toks)
+                    counts.update(toks)
+            vocab = {w: i + 3 for i, (w, _) in enumerate(
+                counts.most_common(args.vocab - 3))}
+            return lines, vocab
+
+        src_lines, src_vocab = read_vocab(args.src)
+        tgt_lines, tgt_vocab = read_vocab(args.tgt)
+        if len(src_lines) != len(tgt_lines):
+            raise SystemExit("--src/--tgt line counts differ")
+        UNK = 2
+        pairs = []
+        for s_toks, t_toks in zip(src_lines, tgt_lines):
+            s = [src_vocab.get(w, UNK) for w in s_toks]
+            t = [tgt_vocab.get(w, UNK) for w in t_toks]
+            if s and t and len(s) <= buckets[-1] and len(t) <= buckets[-1]:
+                pairs.append((s, t))
+        by_bucket = {bk: [] for bk in buckets}
+        for s, t in pairs:
+            bk = next(bk for bk in buckets
+                      if len(s) <= bk and len(t) <= bk)
+            by_bucket[bk].append((s, t))
+
+        def batches():
+            for bk, items in by_bucket.items():
+                rng.shuffle(items)
+                for i in range(0, len(items) - args.batch_size + 1,
+                               args.batch_size):
+                    chunk = items[i:i + args.batch_size]
+                    b = len(chunk)
+                    src = np.full((b, bk), PAD, "float32")
+                    tgt_out = np.full((b, bk), PAD, "float32")
+                    tgt_in = np.full((b, bk), PAD, "float32")
+                    slen = np.zeros(b, "float32")
+                    tlen = np.zeros(b, "float32")
+                    for j, (s, t) in enumerate(chunk):
+                        src[j, :len(s)] = s
+                        tgt_out[j, :len(t)] = t
+                        tgt_in[j, 0] = BOS
+                        tgt_in[j, 1:len(t)] = t[:-1]
+                        slen[j], tlen[j] = len(s), len(t)
+                    # loss mask: only real target positions count (PAD
+                    # would otherwise dominate long buckets)
+                    mask = (np.arange(bk)[None, :]
+                            < tlen[:, None]).astype("float32")
+                    yield (nd.array(src, ctx=ctx),
+                           nd.array(tgt_in, ctx=ctx),
+                           nd.array(tgt_out, ctx=ctx),
+                           nd.array(slen, ctx=ctx),
+                           nd.array(tlen, ctx=ctx),
+                           nd.array(mask, ctx=ctx), int(tlen.sum()))
+    else:
+        # ---- synthetic reverse-copy task -----------------------------
+        def make_batch(seq_len):
+            b = args.batch_size
+            src = rng.randint(3, args.vocab, (b, seq_len)).astype("float32")
+            tgt_out = src[:, ::-1].copy()
+            tgt_in = np.concatenate([np.full((b, 1), BOS),
+                                     tgt_out[:, :-1]],
+                                    axis=1).astype("float32")
+            vlen = np.full(b, seq_len, "float32")
+            mask = nd.array(np.ones((b, seq_len), "float32"), ctx=ctx)
+            return (nd.array(src, ctx=ctx), nd.array(tgt_in, ctx=ctx),
+                    nd.array(tgt_out, ctx=ctx), nd.array(vlen, ctx=ctx),
+                    nd.array(vlen, ctx=ctx), mask, b * seq_len)
+
+        def batches():
+            for it in range(6):
+                yield make_batch(buckets[it % len(buckets)])
 
     for epoch in range(args.epochs):
-        total, tokens, tic = 0.0, 0, time.time()
-        for it in range(6):
-            seq_len = buckets[it % len(buckets)]  # rotate buckets
-            src, tgt_in, tgt_out, vlen = make_batch(seq_len)
+        total, tokens, steps, tic = 0.0, 0, 0, time.time()
+        for src, tgt_in, tgt_out, slen, tlen, mask, ntok in batches():
             with autograd.record():
-                logits = net(src, tgt_in, vlen, vlen)
-                loss = loss_fn(logits, tgt_out).mean()
+                logits = net(src, tgt_in, slen, tlen)
+                per = loss_fn(logits, tgt_out, mask)  # per-token (b, s)
+                loss = per.sum() / nd.maximum(mask.sum(), 1.0)
             loss.backward()
             trainer.step(args.batch_size)
             total += float(loss.asnumpy())
-            tokens += args.batch_size * seq_len
-        print(f"epoch {epoch}: avg-loss={total / 6:.4f} "
+            tokens += ntok
+            steps += 1
+        print(f"epoch {epoch}: avg-loss={total / max(steps, 1):.4f} "
               f"{tokens / (time.time() - tic):.0f} tok/s "
               f"(buckets {buckets})")
 

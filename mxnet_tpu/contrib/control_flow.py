@@ -159,10 +159,18 @@ def while_loop(cond_fn: Callable, func: Callable, loop_vars,
             o_l, o_is_list = _as_list(o)
             outs.append(o_l)
             n += 1
-        if not outs:
-            raise MXNetError("while_loop: cond was false on entry "
-                             "(no outputs to stack)")
         from .. import nd
+
+        if not outs:
+            # cond false on entry: zero-filled padded buffers, exactly
+            # like the traced lax.while_loop path (no eager/traced
+            # behavior split); shapes come from one probe call
+            probe_o, _ = func(*lv)
+            probe_l, o_is_list = _as_list(probe_o)
+            stacked = [nd.zeros((max_iterations,) + tuple(p.shape),
+                                dtype=p.dtype) for p in probe_l]
+            return (_unlist(stacked, o_is_list),
+                    _unlist(lv, isinstance(loop_vars, (list, tuple))))
 
         stacked = []
         for j in range(len(outs[0])):
@@ -200,8 +208,9 @@ def while_loop(cond_fn: Callable, func: Callable, loop_vars,
     def keep_going(state):
         i, vals, _ = state
         ok = cond_fn(*_wrap(list(vals), ctx))
-        return jnp.logical_and(i < max_iterations,
-                               jnp.asarray(ok._data).reshape(()))
+        # same coercion as the eager _pred: NDArray, jnp array, or bool
+        okv = ok._data if isinstance(ok, NDArray) else jnp.asarray(ok)
+        return jnp.logical_and(i < max_iterations, okv.reshape(()))
 
     n, final, bufs = lax.while_loop(
         keep_going, body, (jnp.asarray(0), tuple(_values(lv)), bufs))
@@ -232,13 +241,8 @@ def cond(pred, then_func: Callable, else_func: Callable):
             return tuple(_values(o))
         return run
 
-    # each branch traces exactly ONCE, inside lax.cond; a structure
-    # mismatch surfaces as lax.cond's TypeError, re-raised with context
-    try:
-        out = lax.cond(jnp.asarray(pv).reshape(()).astype(bool),
-                       _branch(then_func, 0), _branch(else_func, 1), None)
-    except TypeError as e:
-        raise MXNetError(
-            f"cond: branches must return the same structure "
-            f"(shapes/dtypes/arity): {e}")
+    # each branch traces exactly ONCE, inside lax.cond; structure
+    # mismatches (and user errors) surface with lax.cond's own message
+    out = lax.cond(jnp.asarray(pv).reshape(()).astype(bool),
+                   _branch(then_func, 0), _branch(else_func, 1), None)
     return _unlist(_wrap(list(out), ctx), is_list[0])

@@ -324,3 +324,292 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if mean is not None and std is not None:
         auglist.append(ColorNormalizeAug(mean, std))
     return auglist
+
+
+# ---------------------------------------------------------------------------
+# ImageIter / ImageDetIter — python-side image iterators over .rec shards
+# or .lst + raw files (ref: python/mxnet/image/image.py::ImageIter,
+# detection.py::ImageDetIter).  The NATIVE fast path is
+# io.ImageRecordIter (C++ decode pipeline); these are the flexible
+# python-augmenter iterators of the reference.
+# ---------------------------------------------------------------------------
+
+class ImageIter:
+    """Image data iterator with python augmenters
+    (ref: image.py::ImageIter).
+
+    Sources: `path_imgrec` (+ optional `path_imgidx` for shuffling) or
+    `path_imglist` + `path_root` (tab-separated .lst: idx\\tlabel...\\tpath).
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label",
+                 last_batch_handle="pad", seed=0, **kwargs):
+        from ..io import DataDesc
+
+        if len(data_shape) != 3 or data_shape[0] not in (1, 3):
+            raise MXNetError("data_shape must be (C, H, W) with C in "
+                             f"{{1,3}} (got {tuple(data_shape)})")
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        self.auglist = (aug_list if aug_list is not None
+                        else CreateAugmenter(data_shape))
+        self._rec = None
+        self._items = []   # (label ndarray, payload bytes|path)
+        if path_imgrec:
+            from .. import recordio as rio
+
+            idx_path = kwargs.get("path_imgidx")
+            rec = (rio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
+                   if idx_path else rio.MXRecordIO(path_imgrec, "r"))
+            while True:
+                s = rec.read()
+                if s is None:
+                    break
+                h, img = rio.unpack(s)
+                lab = np.atleast_1d(np.asarray(h.label, np.float32))
+                self._items.append((lab, img))
+            rec.close()
+        elif imglist is not None or path_imglist:
+            if path_imglist:
+                rows = []
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        if len(parts) < 3:
+                            continue
+                        rows.append((np.asarray(
+                            [float(x) for x in parts[1:-1]], np.float32),
+                            parts[-1]))
+            else:
+                rows = [(np.atleast_1d(np.asarray(l, np.float32)), p)
+                        for (l, p) in imglist]
+            root = path_root or "."
+            for lab, p in rows:
+                self._items.append((lab, os.path.join(root, p)))
+        else:
+            raise MXNetError("ImageIter needs path_imgrec, path_imglist "
+                             "or imglist")
+        if not self._items:
+            raise MXNetError("ImageIter: empty data source")
+        self._order = np.arange(len(self._items))
+        self.provide_data = [DataDesc(
+            data_name, (batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc(
+            label_name, (batch_size, label_width) if label_width > 1
+            else (batch_size,))]
+        self.reset()
+
+    def reset(self):
+        if self._shuffle:
+            self._rng.shuffle(self._order)
+        self._cursor = 0
+
+    def _decode(self, payload):
+        if isinstance(payload, (bytes, bytearray)):
+            return imdecode_np(bytes(payload))
+        with open(payload, "rb") as f:
+            return imdecode_np(f.read())
+
+    def _augment(self, img):
+        nd_img = nd_array(img.astype(np.float32))
+        for aug in self.auglist:
+            nd_img = aug(nd_img)
+        return nd_img.asnumpy()
+
+    def next_sample(self):
+        if self._cursor >= len(self._items):
+            raise StopIteration
+        lab, payload = self._items[self._order[self._cursor]]
+        self._cursor += 1
+        return lab, payload
+
+    def next(self):
+        from ..io import DataBatch
+        from ..ndarray import array as nd_array
+
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, c, h, w), np.float32)
+        label = np.zeros((self.batch_size, self.label_width), np.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                lab, payload = self.next_sample()
+                img = self._augment(self._decode(payload))
+                data[i] = img.transpose(2, 0, 1)  # HWC -> CHW
+                label[i, :lab.size] = lab[:self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = self.batch_size - i
+            for j in range(i, self.batch_size):  # pad with wrap
+                data[j] = data[j - i]
+                label[j] = label[j - i]
+        lbl = label if self.label_width > 1 else label[:, 0]
+        return DataBatch(data=[nd_array(data)], label=[nd_array(lbl)],
+                         pad=pad)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+
+class DetAugmenter:
+    """Detection augmenter: transforms (image, boxes) TOGETHER so labels
+    stay aligned (ref: image/detection.py DetAugmenter family)."""
+
+    def __call__(self, img, boxes):
+        raise NotImplementedError
+
+
+class DetForceResizeAug(DetAugmenter):
+    """Aspect-breaking resize to (w, h).  Relative [0,1] box coords are
+    invariant under a full-frame resize — labels pass through."""
+
+    def __init__(self, size, interp=2):
+        self.size = size  # (w, h)
+        self.interp = interp
+
+    def __call__(self, img, boxes):
+        return imresize(img, self.size[0], self.size[1],
+                        self.interp), boxes
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Random mirror: flips the image AND mirrors box x-coords."""
+
+    def __init__(self, p=0.5, seed=0):
+        self.p = p
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, img, boxes):
+        if self._rng.rand() < self.p:
+            img = img[:, ::-1]
+            boxes = boxes.copy()
+            x1 = boxes[:, 1].copy()
+            boxes[:, 1] = 1.0 - boxes[:, 3]
+            boxes[:, 3] = 1.0 - x1
+        return img, boxes
+
+
+class DetColorNormalizeAug(DetAugmenter):
+    def __init__(self, mean, std):
+        self._aug = ColorNormalizeAug(mean, std)
+
+    def __call__(self, img, boxes):
+        return self._aug(img), boxes
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_mirror=False, mean=None,
+                       std=None, inter_method=2):
+    """Detection pipeline (ref: detection.py::CreateDetAugmenter):
+    geometry-safe ops only — force-resize (labels invariant) and
+    box-aware flips; no crops that would clip unseen boxes."""
+    auglist: List[DetAugmenter] = [
+        DetForceResizeAug((data_shape[2], data_shape[1]), inter_method)]
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and std is not None:
+        auglist.append(DetColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: variable-count object labels per image
+    (ref: image/detection.py::ImageDetIter).
+
+    Labels follow the im2rec --pack-label object format:
+    ``[header_width, obj_width, (header...), obj0..., obj1...]`` with
+    each object ``[cls, xmin, ymin, xmax, ymax]`` in relative [0,1]
+    coords.  Batch label shape is (B, max_objects, obj_width), rows
+    padded with -1 (the detection losses' ignore marker).
+
+    Augmentation uses DetAugmenters, which transform image and boxes
+    together (plain Augmenters would silently misalign the labels)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 max_objects=None, aug_list=None, **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape)
+        if any(not isinstance(a, DetAugmenter) for a in aug_list):
+            raise MXNetError(
+                "ImageDetIter needs DetAugmenters (CreateDetAugmenter): "
+                "plain Augmenters transform the image without the boxes")
+        super().__init__(batch_size, data_shape, label_width=1,
+                         path_imgrec=path_imgrec, aug_list=[],
+                         **kwargs)
+        self.auglist = list(aug_list)
+        self._obj_width = None
+        widest = 0
+        parsed = []
+        for lab, payload in self._items:
+            objs = self._parse_det_label(lab)
+            widest = max(widest, objs.shape[0])
+            parsed.append((objs, payload))
+        self._items = parsed
+        self.max_objects = max_objects or widest
+        from ..io import DataDesc
+
+        self.provide_label = [DataDesc(
+            "label", (batch_size, self.max_objects, self._obj_width))]
+
+    def _parse_det_label(self, flat):
+        flat = np.asarray(flat, np.float32).ravel()
+        if flat.size < 2:
+            raise MXNetError("ImageDetIter: label is not in the packed "
+                             "object format (use im2rec --pack-label)")
+        hw = int(flat[0])
+        ow = int(flat[1])
+        if self._obj_width is None:
+            self._obj_width = ow
+        elif ow != self._obj_width:
+            raise MXNetError("ImageDetIter: inconsistent object widths "
+                             f"({ow} vs {self._obj_width})")
+        body = flat[hw:]
+        n = body.size // ow
+        return body[: n * ow].reshape(n, ow)
+
+    def next(self):
+        from ..io import DataBatch
+        from ..ndarray import array as nd_array
+
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, c, h, w), np.float32)
+        label = np.full((self.batch_size, self.max_objects,
+                         self._obj_width), -1.0, np.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                objs, payload = self.next_sample()
+                nd_img = nd_array(
+                    self._decode(payload).astype(np.float32))
+                aug_objs = np.asarray(objs, np.float32)
+                for aug in self.auglist:
+                    nd_img, aug_objs = aug(nd_img, aug_objs)
+                data[i] = nd_img.asnumpy().transpose(2, 0, 1)
+                n = min(aug_objs.shape[0], self.max_objects)
+                label[i, :n] = aug_objs[:n]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = self.batch_size - i
+            for j in range(i, self.batch_size):
+                data[j] = data[j - i]
+                label[j] = label[j - i]
+        return DataBatch(data=[nd_array(data)], label=[nd_array(label)],
+                         pad=pad)

@@ -8,7 +8,9 @@ Python/numpy feeding device arrays — the TPU transfer itself is the async
 kCopyToGPU lane (SURVEY.md §2e).
 """
 from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, CSVIter,
-                 MNISTIter, ImageRecordIter, ResizeIter, PrefetchingIter)
+                 MNISTIter, ImageRecordIter, ResizeIter, PrefetchingIter,
+                 LibSVMIter)
 
 __all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "CSVIter",
-           "MNISTIter", "ImageRecordIter", "ResizeIter", "PrefetchingIter"]
+           "MNISTIter", "ImageRecordIter", "ResizeIter", "PrefetchingIter",
+           "LibSVMIter"]

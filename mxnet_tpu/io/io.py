@@ -18,7 +18,7 @@ import numpy as np
 from ..base import MXNetError
 from ..context import cpu
 from .. import ndarray as nd
-from ..ndarray import NDArray
+from ..ndarray import NDArray, array
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -246,6 +246,110 @@ class CSVIter(DataIter):
 
     def next(self):
         return self._iter.next()
+
+
+class LibSVMIter(DataIter):
+    """libsvm text-format iterator producing CSR batches
+    (ref: src/io/iter_libsvm.cc LibSVMIter).
+
+    Lines are ``label [label...] idx:val idx:val ...`` (0-based feature
+    indices).  `data` of each batch is a CSRNDArray of shape
+    (batch_size, num_features) — the sparse input format for
+    `FullyConnected` over `sparse.dot`."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 **kwargs):
+        super().__init__(batch_size)
+        self._nfeat = int(data_shape[0] if isinstance(
+            data_shape, (tuple, list)) else data_shape)
+        rows, labels = self._parse(data_libsvm)
+        self._indptr = np.zeros(len(rows) + 1, np.int64)
+        for i, (idx, _) in enumerate(rows):
+            self._indptr[i + 1] = self._indptr[i] + len(idx)
+        self._indices = np.concatenate(
+            [np.asarray(idx, np.int64) for idx, _ in rows]) \
+            if rows else np.zeros((0,), np.int64)
+        self._values = np.concatenate(
+            [np.asarray(v, np.float32) for _, v in rows]) \
+            if rows else np.zeros((0,), np.float32)
+        if label_libsvm is not None:
+            _, labels = None, np.loadtxt(label_libsvm, dtype=np.float32,
+                                         ndmin=2)
+            labels = labels.reshape((-1,) + tuple(label_shape))
+            if labels.shape[-1] == 1:
+                labels = labels.reshape(labels.shape[:-1] or (-1,))
+        else:
+            labels = np.asarray(labels, np.float32)
+        self._labels = labels
+        self._n = len(self._indptr) - 1
+        self._round = round_batch
+        self.reset()
+
+    @staticmethod
+    def _parse(path):
+        rows, labels = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                lab = []
+                k = 0
+                while k < len(parts) and ":" not in parts[k]:
+                    lab.append(float(parts[k]))
+                    k += 1
+                idx, val = [], []
+                for tok in parts[k:]:
+                    i, v = tok.split(":")
+                    idx.append(int(i))
+                    val.append(float(v))
+                labels.append(lab[0] if len(lab) == 1 else lab)
+                rows.append((idx, val))
+        return rows, labels
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._nfeat))]
+
+    @property
+    def provide_label(self):
+        shp = np.asarray(self._labels).shape[1:]
+        return [DataDesc("label", (self.batch_size,) + tuple(shp))]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        from ..ndarray import sparse as _sp
+
+        if self._cursor >= self._n:
+            raise StopIteration
+        b0, b1 = self._cursor, min(self._cursor + self.batch_size,
+                                   self._n)
+        self._cursor += self.batch_size
+        pad = self.batch_size - (b1 - b0)
+        take = list(range(b0, b1))
+        if pad:
+            if not self._round:
+                raise StopIteration
+            take += list(range(pad))  # wrap like round_batch
+        indptr = [0]
+        indices = []
+        values = []
+        for r in take:
+            s, e = self._indptr[r], self._indptr[r + 1]
+            indices.append(self._indices[s:e])
+            values.append(self._values[s:e])
+            indptr.append(indptr[-1] + (e - s))
+        data = _sp.csr_matrix(
+            (np.concatenate(values) if values else np.zeros(0, np.float32),
+             np.concatenate(indices) if indices else np.zeros(0, np.int64),
+             np.asarray(indptr, np.int64)),
+            shape=(self.batch_size, self._nfeat))
+        label = array(np.asarray(self._labels)[[t for t in take]])
+        return DataBatch(data=[data], label=[label], pad=pad,
+                         index=np.asarray(take))
 
 
 def _read_mnist_images(path):

@@ -46,6 +46,29 @@ def load(fname: str):
     return load_ndarrays(fname)
 
 
+def _scalar_or_elemwise(broadcast_op, scalar_op):
+    """ref: python/mxnet/ndarray/ndarray.py maximum/minimum — dispatch on
+    operand kinds (array/array, array/scalar, scalar/scalar)."""
+    def fn(lhs, rhs):
+        from .register import lookup
+
+        l_nd = isinstance(lhs, NDArray)
+        r_nd = isinstance(rhs, NDArray)
+        if l_nd and r_nd:
+            return lookup(broadcast_op)(lhs, rhs)
+        if l_nd:
+            return lookup(scalar_op)(lhs, scalar=float(rhs))
+        if r_nd:
+            return lookup(scalar_op)(rhs, scalar=float(lhs))
+        return lookup(scalar_op)(array(_np.asarray([lhs], _np.float32)),
+                                 scalar=float(rhs))
+    return fn
+
+
+maximum = _scalar_or_elemwise("broadcast_maximum", "_maximum_scalar")
+minimum = _scalar_or_elemwise("broadcast_minimum", "_minimum_scalar")
+
+
 def __getattr__(name: str):
     try:
         return _register.lookup(name)

@@ -214,3 +214,36 @@ def test_foreach_in_hybridized_block_with_dropout():
     loss.backward()
     g = net2.cell.weight.grad().asnumpy()
     assert np.isfinite(g).all()
+
+
+def test_while_loop_false_on_entry_consistent():
+    """cond false on entry: eager and traced agree (zero-filled padded
+    buffers + unchanged loop vars), no eager-only exception."""
+    i0 = nd.array(np.array([5.0], np.float32))
+    outs, fin = C.while_loop(lambda i: i < 0, lambda i: (i * 2, i + 1),
+                             [i0], max_iterations=4)
+    np.testing.assert_allclose(outs.asnumpy(), np.zeros((4, 1)))
+    np.testing.assert_allclose(fin[0].asnumpy(), [5.0])
+
+    def fn(iv):
+        o, fin = C.while_loop(
+            lambda i: i.reshape(()) < 0, lambda i: (i * 2, i + 1),
+            [nd.NDArray(iv)], max_iterations=4)
+        return o.data, fin[0].data
+
+    o, fv = jax.jit(fn)(jnp.array([5.0]))
+    np.testing.assert_allclose(np.asarray(o), np.zeros((4, 1)))
+    np.testing.assert_allclose(np.asarray(fv), [5.0])
+
+
+def test_while_loop_plain_bool_cond_traced():
+    """cond_fn returning a raw jnp value (not NDArray) works when traced
+    — same coercion as the eager path."""
+    def fn(iv):
+        o, fin = C.while_loop(
+            lambda i: i.data.reshape(()) < 3, lambda i: (i * 2, i + 1),
+            [nd.NDArray(iv)], max_iterations=5)
+        return fin[0].data
+
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(fn)(jnp.array([0.0]))), [3.0])

@@ -1,0 +1,76 @@
+"""Example scripts end-to-end (CPU smoke of BASELINE configs 3-5 real-data
+paths; ref: example/ scripts).  Each runs the actual script in a
+subprocess the way a user would."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=420):
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable] + args, cwd=_REPO, env=env,
+                       capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-3000:]
+    return r.stdout
+
+
+def test_bert_pretrain_corpus(tmp_path):
+    rng = np.random.RandomState(0)
+    words = [f"w{i}" for i in range(150)]
+    corpus = tmp_path / "corpus.txt"
+    with open(corpus, "w") as f:
+        for _ in range(40):
+            sents = [" ".join(rng.choice(words, rng.randint(4, 9)))
+                     for _ in range(rng.randint(2, 4))]
+            f.write(". ".join(sents) + "\n")
+    out = _run(["examples/bert_pretrain.py", "--cpu", "--small",
+                "--corpus", str(corpus), "--steps", "2"])
+    assert "step 1: loss=" in out
+
+
+def test_ssd_train_rec(tmp_path):
+    from mxnet_tpu import recordio as rio
+
+    try:
+        from mxnet_tpu.image import imencode
+
+        _ = imencode(np.zeros((4, 4, 3), np.uint8))
+    except Exception:
+        pytest.skip("no image encoder available")
+    rng = np.random.RandomState(0)
+    rec_path = str(tmp_path / "det.rec")
+    rec = rio.MXRecordIO(rec_path, "w")
+    for i in range(8):
+        img = (rng.rand(140, 140, 3) * 255).astype(np.uint8)
+        objs = [float(i % 3), 0.1, 0.15, 0.6, 0.7]
+        h = rio.IRHeader(0, np.asarray([2, 5] + objs, np.float32), i, 0)
+        rec.write(rio.pack_img(h, img))
+    rec.close()
+    out = _run(["examples/ssd_train.py", "--cpu", "--small",
+                "--batch-size", "4", "--rec", rec_path, "--epochs", "1"],
+               timeout=560)
+    assert "decoded" in out and "loss=" in out
+
+
+def test_transformer_nmt_parallel_corpus(tmp_path):
+    rng = np.random.RandomState(1)
+    src, tgt = tmp_path / "train.src", tmp_path / "train.tgt"
+    with open(src, "w") as fs, open(tgt, "w") as ft:
+        for _ in range(80):
+            n = rng.randint(3, 12)
+            toks = [f"s{rng.randint(60)}" for _ in range(n)]
+            fs.write(" ".join(toks) + "\n")
+            ft.write(" ".join(t.replace("s", "t")
+                              for t in reversed(toks)) + "\n")
+    out = _run(["examples/transformer_nmt.py", "--cpu", "--small",
+                "--src", str(src), "--tgt", str(tgt), "--epochs", "1"])
+    assert "avg-loss=" in out

@@ -307,3 +307,160 @@ def test_bandwidth_tool_mesh():
         capture_output=True, text=True, timeout=240, env=env)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "mesh-psum x8" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# LibSVMIter (ref: src/io/iter_libsvm.cc) + ImageIter/ImageDetIter
+# (ref: python/mxnet/image/{image,detection}.py)
+# ---------------------------------------------------------------------------
+
+def test_libsvm_iter(tmp_path):
+    f = tmp_path / "train.libsvm"
+    f.write_text("1 0:1.5 3:2.0\n"
+                 "0 1:1.0\n"
+                 "1 2:0.5 3:0.5\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(f), data_shape=(4,),
+                          batch_size=2)
+    b1 = it.next()
+    assert b1.data[0].stype == "csr"
+    np.testing.assert_allclose(
+        b1.data[0].todense().asnumpy(),
+        [[1.5, 0, 0, 2.0], [0, 1.0, 0, 0]])
+    np.testing.assert_allclose(b1.label[0].asnumpy(), [1, 0])
+    b2 = it.next()  # wraps to fill the last batch
+    assert b2.pad == 1
+    np.testing.assert_allclose(
+        b2.data[0].todense().asnumpy()[0], [0, 0, 0.5, 0.5])
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    assert it.next().pad == 0
+    # sparse dot consumes the batch directly
+    w = mx.nd.ones((4, 3))
+    out = mx.nd.sparse.dot(b1.data[0], w)
+    np.testing.assert_allclose(out.asnumpy()[0], [3.5, 3.5, 3.5])
+
+
+def _write_img_rec(tmp_path, n=6, label_width=1, det=False):
+    from mxnet_tpu import recordio as rio
+    from mxnet_tpu.image import imencode
+
+    path = str(tmp_path / "data.rec")
+    rec = rio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = (rng.rand(12, 10, 3) * 255).astype(np.uint8)
+        if det:
+            # packed object labels: header(2) + one or two boxes of width 5
+            nobj = 1 + (i % 2)
+            objs = []
+            for b in range(nobj):
+                objs += [float(i % 3), 0.1, 0.1, 0.6, 0.7]
+            label = np.asarray([2, 5] + objs, np.float32)
+        else:
+            label = float(i % 3) if label_width == 1 else \
+                np.arange(label_width, dtype=np.float32)
+        h = rio.IRHeader(0, label, i, 0)
+        rec.write(rio.pack_img(h, img, quality=90))
+    rec.close()
+    return path
+
+
+def test_image_iter_rec(tmp_path):
+    try:
+        from mxnet_tpu.image import imencode  # noqa: F401
+        _ = imencode(np.zeros((4, 4, 3), np.uint8))
+    except Exception:
+        pytest.skip("no image encoder available")
+    from mxnet_tpu.image import CreateAugmenter, ImageIter
+
+    path = _write_img_rec(tmp_path)
+    it = ImageIter(batch_size=4, data_shape=(3, 8, 8),
+                   path_imgrec=path,
+                   aug_list=CreateAugmenter((3, 8, 8)))
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 8, 8)
+    assert batch.label[0].shape == (4,)
+    np.testing.assert_allclose(batch.label[0].asnumpy(), [0, 1, 2, 0])
+    b2 = it.next()  # 2 remaining + 2 pad
+    assert b2.pad == 2
+    it.reset()
+    assert it.next().pad == 0
+
+
+def test_image_iter_imglist(tmp_path):
+    try:
+        from mxnet_tpu.image import imencode
+        _ = imencode(np.zeros((4, 4, 3), np.uint8))
+    except Exception:
+        pytest.skip("no image encoder available")
+    from mxnet_tpu.image import ImageIter
+
+    rng = np.random.RandomState(1)
+    names = []
+    for i in range(3):
+        img = (rng.rand(9, 9, 3) * 255).astype(np.uint8)
+        from mxnet_tpu.image import imencode
+
+        (tmp_path / f"im{i}.jpg").write_bytes(imencode(img))
+        names.append(f"im{i}.jpg")
+    lst = tmp_path / "train.lst"
+    lst.write_text("".join(f"{i}\t{float(i)}\t{n}\n"
+                           for i, n in enumerate(names)))
+    it = ImageIter(batch_size=3, data_shape=(3, 8, 8),
+                   path_imglist=str(lst), path_root=str(tmp_path))
+    b = it.next()
+    assert b.data[0].shape == (3, 3, 8, 8)
+    np.testing.assert_allclose(b.label[0].asnumpy(), [0, 1, 2])
+
+
+def test_image_det_iter(tmp_path):
+    try:
+        from mxnet_tpu.image import imencode
+        _ = imencode(np.zeros((4, 4, 3), np.uint8))
+    except Exception:
+        pytest.skip("no image encoder available")
+    from mxnet_tpu.image import ImageDetIter
+
+    path = _write_img_rec(tmp_path, det=True)
+    it = ImageDetIter(batch_size=3, data_shape=(3, 8, 8),
+                      path_imgrec=path)
+    b = it.next()
+    assert b.data[0].shape == (3, 3, 8, 8)
+    lab = b.label[0].asnumpy()
+    assert lab.shape == (3, 2, 5)  # max 2 objects, width 5
+    # sample 0 has one object, row 1 padded with -1
+    np.testing.assert_allclose(lab[0, 0], [0, 0.1, 0.1, 0.6, 0.7],
+                               rtol=1e-5)
+    assert (lab[0, 1] == -1).all()
+    # sample 1 has two objects
+    assert (lab[1, 1] != -1).any()
+
+
+def test_det_augmenters_keep_boxes_aligned(tmp_path):
+    """DetHorizontalFlipAug mirrors boxes with the image; force-resize
+    leaves relative coords invariant (plain Augmenters are rejected)."""
+    from mxnet_tpu.image import (CreateDetAugmenter, DetHorizontalFlipAug,
+                                 DetForceResizeAug)
+    from mxnet_tpu.ndarray import array as nd_array
+
+    img = np.zeros((10, 20, 3), np.float32)
+    img[:, :10] = 1.0  # left half bright
+    boxes = np.array([[0.0, 0.1, 0.2, 0.4, 0.8]], np.float32)
+    flip = DetHorizontalFlipAug(p=1.1)  # always flip
+    out, fboxes = flip(nd_array(img), boxes)
+    # image mirrored: bright half now on the right
+    assert out.asnumpy()[0, -1, 0] == 1.0 and out.asnumpy()[0, 0, 0] == 0.0
+    np.testing.assert_allclose(fboxes[0], [0.0, 0.6, 0.2, 0.9, 0.8],
+                               rtol=1e-6)
+    rs = DetForceResizeAug((8, 8))
+    out2, rboxes = rs(nd_array(img), boxes)
+    assert out2.shape == (8, 8, 3)
+    np.testing.assert_allclose(rboxes, boxes)  # relative coords invariant
+    import pytest as _pytest
+
+    from mxnet_tpu.image import CenterCropAug, ImageDetIter
+    with _pytest.raises(Exception, match="DetAugmenter"):
+        ImageDetIter(batch_size=1, data_shape=(3, 8, 8),
+                     path_imgrec="/nonexistent.rec",
+                     aug_list=[CenterCropAug((8, 8))])
