@@ -149,10 +149,16 @@ _SPECIAL: Dict[str, Callable] = {
 for _rn in ("_random_uniform", "_random_normal", "_random_randint",
             "_random_gamma", "_random_exponential", "_random_poisson",
             "_random_bernoulli", "_sample_multinomial", "_shuffle",
-            "_random_gumbel", "_random_laplace", "_random_negative_binomial"):
+            "_random_gumbel", "_random_laplace", "_random_negative_binomial",
+            "_sample_uniform", "_sample_normal", "_sample_gamma",
+            "_sample_exponential", "_sample_poisson",
+            "_sample_negative_binomial",
+            "_sample_generalized_negative_binomial"):
     _SPECIAL[_rn] = _make_random_wrapper(_rn)
-_SPECIAL["sample_multinomial"] = _SPECIAL["_sample_multinomial"]
-_SPECIAL["shuffle"] = _SPECIAL["_shuffle"]
+    _SPECIAL[_rn.lstrip("_")] = _SPECIAL[_rn]  # e.g. nd.sample_gamma
+# legacy bare aliases (ref: nd.uniform/nd.normal over random_uniform)
+_SPECIAL["uniform"] = _SPECIAL["_random_uniform"]
+_SPECIAL["normal"] = _SPECIAL["_random_normal"]
 
 
 def lookup(name: str):

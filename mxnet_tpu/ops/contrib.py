@@ -476,3 +476,25 @@ def _boolean_mask(data, index, axis=0):
     # host sync is required to materialize the dynamic shape
     keep = np.nonzero(np.asarray(jax.device_get(idx)))[0]
     return jnp.take(data, jnp.asarray(keep), axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# contrib FFT (ref: src/operator/contrib/fft-inl.h): real input (n, d) ->
+# interleaved re/im output (n, 2d); ifft inverts WITHOUT 1/d
+# normalization (the reference's cuFFT convention — callers divide by d)
+# ---------------------------------------------------------------------------
+
+@register_op("_contrib_fft", aliases=("fft",))
+def _fft(data, compute_size=128):
+    spec = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    out = jnp.stack([spec.real, spec.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(jnp.float32)
+
+
+@register_op("_contrib_ifft", aliases=("ifft",))
+def _ifft(data, compute_size=128):
+    d = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (d, 2))
+    spec = pairs[..., 0] + 1j * pairs[..., 1]
+    return (jnp.fft.ifft(spec, axis=-1).real * d).astype(jnp.float32)

@@ -657,3 +657,261 @@ def _spatial_transformer(data, loc, target_shape=(0, 0),
     grid = _grid_generator(loc, transform_type="affine",
                            target_shape=target_shape)
     return _grid_sample_bilinear(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# activation parity batch + legacy regression loss heads
+# (ref: elemwise_unary_op, softmax_activation-inl.h, regression_output-inl.h)
+# ---------------------------------------------------------------------------
+
+@register_op("hard_sigmoid")
+def _hard_sigmoid(data, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+@register_op("hard_swish")
+def _hard_swish(data):
+    return data * jnp.clip(data / 6.0 + 0.5, 0.0, 1.0)
+
+
+@register_op("mish")
+def _mish(data):
+    return data * jnp.tanh(jax.nn.softplus(data))
+
+
+@register_op("SoftmaxActivation", aliases=("softmax_activation",))
+def _softmax_activation(data, mode="instance"):
+    """Deprecated standalone softmax (ref: softmax_activation-inl.h):
+    'instance' over the flattened trailing dims, 'channel' over dim 1."""
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    flat = data.reshape((data.shape[0], -1))
+    return jax.nn.softmax(flat, axis=-1).reshape(data.shape)
+
+
+def _regression_head(name, fwd, bwd_grad):
+    """Loss-head ops: forward is a transform of the scores; backward
+    IGNORES the upstream cotangent and emits grad_scale * residual —
+    the reference regression_output-inl.h contract."""
+
+    @partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def core(data, label, grad_scale):
+        return fwd(data)
+
+    def core_fwd(data, label, grad_scale):
+        out = fwd(data)
+        return out, (out, data, label)
+
+    def core_bwd(grad_scale, res, g):
+        out, data, label = res
+        lab = label.reshape(out.shape).astype(out.dtype)
+        # reference scaling: grad_scale / num_output where num_output =
+        # label.Size()/batch (per-sample output count, NOT batch size)
+        num_output = 1
+        for s in out.shape[1:]:
+            num_output *= s
+        grad = bwd_grad(out, lab) * (grad_scale / num_output)
+        return grad, jnp.zeros_like(label)
+
+    core.defvjp(core_fwd, core_bwd)
+
+    import re
+
+    snake = re.sub(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])",
+                   "_", name).lower()
+
+    @register_op(name, aliases=(snake,))
+    def head(data, label, grad_scale=1.0):
+        return core(data, label, float(grad_scale))
+
+    return head
+
+
+_regression_head("LinearRegressionOutput", lambda d: d,
+                 lambda out, lab: out - lab)
+_regression_head("MAERegressionOutput", lambda d: d,
+                 lambda out, lab: jnp.sign(out - lab))
+_regression_head("LogisticRegressionOutput", jax.nn.sigmoid,
+                 lambda out, lab: out - lab)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_core(data, label, margin, reg_coef, use_linear):
+    return data
+
+
+def _svm_fwd(data, label, margin, reg_coef, use_linear):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, reg_coef, use_linear, res, g):
+    scores, label = res
+    k = scores.shape[-1]
+    y = jax.nn.one_hot(label.astype(jnp.int32), k, dtype=scores.dtype)
+    s_y = (scores * y).sum(axis=-1, keepdims=True)
+    viol = jnp.maximum(0.0, margin - (s_y - scores)) * (1.0 - y)
+    if use_linear:  # L1-SVM hinge
+        gj = (viol > 0).astype(scores.dtype)
+    else:           # L2-SVM squared hinge (reference default)
+        gj = 2.0 * viol
+    grad = gj - y * gj.sum(axis=-1, keepdims=True)
+    return (reg_coef * grad / scores.shape[0],
+            jnp.zeros_like(label))
+
+
+_svm_core.defvjp(_svm_fwd, _svm_bwd)
+
+
+@register_op("SVMOutput", aliases=("svm_output",))
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False):
+    """Multiclass SVM loss head (ref: svm_output-inl.h): forward =
+    identity, backward = hinge (L2 by default) gradient."""
+    return _svm_core(data, label, float(margin),
+                     float(regularization_coefficient), bool(use_linear))
+
+
+# ---------------------------------------------------------------------------
+# im2col / col2im (ref: src/operator/nn/im2col.h) — patch extraction via
+# XLA's native conv_general_dilated_patches; col2im is its exact adjoint
+# (jax.vjp), which is also how the reference implements it (col2im is
+# im2col's backward).
+# ---------------------------------------------------------------------------
+
+def _im2col_impl(data, kernel, stride, dilate, pad):
+    nd_ = len(kernel)
+    patches = lax.conv_general_dilated_patches(
+        data, filter_shape=tuple(kernel),
+        window_strides=tuple(stride) if stride else (1,) * nd_,
+        padding=[(p, p) for p in (tuple(pad) if pad else (0,) * nd_)],
+        rhs_dilation=tuple(dilate) if dilate else (1,) * nd_)
+    # (N, C*prod(k), *out_spatial) -> (N, C*prod(k), prod(out_spatial))
+    return patches.reshape(patches.shape[0], patches.shape[1], -1)
+
+
+@register_op("im2col")
+def _im2col(data, kernel=(), stride=(), dilate=(), pad=()):
+    return _im2col_impl(data, kernel, stride, dilate, pad)
+
+
+@register_op("col2im")
+def _col2im(data, output_size=(), kernel=(), stride=(), dilate=(),
+            pad=()):
+    """Scatter columns back to an image: the adjoint of im2col
+    (overlapping patches SUM — ref: col2im in im2col.h)."""
+    n, ck, _ = data.shape
+    prod_k = 1
+    for k in kernel:
+        prod_k *= k
+    c = ck // prod_k
+    img_shape = (n, c) + tuple(output_size)
+    zero = jnp.zeros(img_shape, data.dtype)
+    _, vjp = jax.vjp(
+        lambda img: _im2col_impl(img, kernel, stride, dilate, pad), zero)
+    return vjp(data)[0]
+
+
+# ---------------------------------------------------------------------------
+# Correlation (ref: src/operator/correlation.cc — FlowNet cost volume):
+# for each displacement within max_displacement, the channel-mean dot
+# product of f1 and shifted f2.  The displacement set is static, so the
+# loop unrolls into a fused stack of elementwise multiplies + reductions.
+# ---------------------------------------------------------------------------
+
+@register_op("Correlation", aliases=("correlation",))
+def _correlation(data1, data2, kernel_size=1, max_displacement=1,
+                 stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    if kernel_size != 1 or stride1 != 1 or stride2 != 1:
+        raise MXNetError("Correlation: this build supports "
+                         "kernel_size=1, stride1=1, stride2=1")
+    n, c, h, w = data1.shape
+    d = int(max_displacement)
+    p = int(pad_size)
+    # reference output geometry (correlation-inl.h, stride1=1):
+    # out_spatial = in + 2*pad - 2*max_displacement
+    ho = h + 2 * p - 2 * d
+    wo = w + 2 * p - 2 * d
+    if ho <= 0 or wo <= 0:
+        raise MXNetError(
+            f"Correlation: non-positive output size {(ho, wo)}; "
+            f"pad_size must satisfy in + 2*pad > 2*max_displacement")
+    f1 = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    f2 = jnp.pad(data2, ((0, 0), (0, 0), (p, p), (p, p)))
+    base = lax.dynamic_slice(f1, (0, 0, d, d), (n, c, ho, wo))
+    outs = []
+    for dy in range(-d, d + 1):
+        for dx in range(-d, d + 1):
+            shifted = lax.dynamic_slice(
+                f2, (0, 0, d + dy, d + dx), (n, c, ho, wo))
+            if is_multiply:
+                outs.append((base * shifted).mean(axis=1))
+            else:
+                outs.append(jnp.abs(base - shifted).mean(axis=1))
+    return jnp.stack(outs, axis=1)  # (N, (2d+1)^2, Ho, Wo)
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution (ref: src/operator/contrib/deformable_convolution
+# .cc, DCN v1): each kernel tap samples the input at a learned offset via
+# bilinear interpolation, then the taps contract against the weight — on
+# TPU this is prod(k) grid-samples (reusing the BilinearSampler math)
+# feeding one dot_general, all fused by XLA.
+# ---------------------------------------------------------------------------
+
+@register_op("_contrib_DeformableConvolution",
+             aliases=("DeformableConvolution", "deformable_convolution"))
+def _deformable_convolution(data, offset, weight, bias=None, kernel=(),
+                            stride=(), dilate=(), pad=(), num_filter=0,
+                            num_group=1, num_deformable_group=1,
+                            no_bias=False, layout=None, workspace=1024):
+    if num_group != 1 or num_deformable_group != 1:
+        raise MXNetError("DeformableConvolution: this build supports "
+                         "num_group=num_deformable_group=1")
+    kh, kw = kernel
+    sh, sw = stride if stride else (1, 1)
+    dh, dw = dilate if dilate else (1, 1)
+    ph, pw = pad if pad else (0, 0)
+    n, c, h, w = data.shape
+    ho = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    wo = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    if offset.shape != (n, 2 * kh * kw, ho, wo):
+        raise MXNetError(
+            f"DeformableConvolution: offset must be "
+            f"{(n, 2 * kh * kw, ho, wo)} (N, 2*prod(kernel), out_h, "
+            f"out_w); got {tuple(offset.shape)}")
+    oy, ox = jnp.meshgrid(jnp.arange(ho) * sh - ph,
+                          jnp.arange(wo) * sw - pw, indexing="ij")
+
+    def bilinear(img, y, x):  # img (C,H,W); y/x (Ho,Wo) absolute coords
+        y0 = jnp.floor(y)
+        x0 = jnp.floor(x)
+        wy = (y - y0)[None]
+        wx = (x - x0)[None]
+
+        def tap(yi, xi):
+            inb = ((yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1))
+            yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            return img[:, yc, xc] * inb[None].astype(img.dtype)
+
+        return ((1 - wy) * ((1 - wx) * tap(y0, x0) + wx * tap(y0, x0 + 1))
+                + wy * ((1 - wx) * tap(y0 + 1, x0)
+                        + wx * tap(y0 + 1, x0 + 1)))
+
+    def one_image(img, off):  # off (2*kh*kw, Ho, Wo)
+        cols = []
+        for ki in range(kh):
+            for kj in range(kw):
+                t = ki * kw + kj
+                y = oy + ki * dh + off[2 * t]
+                x = ox + kj * dw + off[2 * t + 1]
+                cols.append(bilinear(img, y, x))   # (C, Ho, Wo)
+        return jnp.stack(cols, axis=1)             # (C, K, Ho, Wo)
+
+    cols = jax.vmap(one_image)(data, offset)       # (N, C, K, Ho, Wo)
+    wmat = weight.reshape(num_filter, -1)          # (O, C*K)
+    out = jnp.einsum("ock,nckhw->nohw",
+                     wmat.reshape(num_filter, c, kh * kw), cols)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1, 1, 1))
+    return out

@@ -171,23 +171,6 @@ def _nadam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
     return weight - lr * m_bar / (jnp.sqrt(v_hat) + epsilon), new_mean, new_var
 
 
-@register_op("lamb_update_phase1", num_outputs=1)
-def _lamb_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999, epsilon=1e-6,
-                 t=1, bias_correction=True, wd=0.0, rescale_grad=1.0,
-                 clip_gradient=-1.0):
-    g = grad * rescale_grad
-    if clip_gradient is not None and clip_gradient > 0:
-        g = jnp.clip(g, -clip_gradient, clip_gradient)
-    new_mean = beta1 * mean + (1 - beta1) * g
-    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
-    if bias_correction:
-        mh = new_mean / (1 - beta1 ** t)
-        vh = new_var / (1 - beta2 ** t)
-    else:
-        mh, vh = new_mean, new_var
-    return mh / (jnp.sqrt(vh) + epsilon) + wd * weight
-
-
 # multi-precision (fp16/bf16 weights with fp32 master copy;
 # ref: mp_sgd_update / mp_sgd_mom_update / mp_adam-like kernels)
 
@@ -221,3 +204,138 @@ def _mp_adam_update(weight, grad, mean, var, weight32, lr=0.001, beta1=0.9,
     new_var = beta2 * var + (1 - beta2) * jnp.square(g)
     new_w32 = weight32 - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
     return new_w32.astype(weight.dtype), new_mean, new_var, new_w32
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor fused updates (ref: optimizer_op.cc multi_sgd_update,
+# multi_sgd_mom_update, multi_mp_sgd_*, preloaded_multi_*, multi_sum_sq,
+# multi_lars — the Trainer's one-launch-many-weights path) and LAMB
+# (ref: lamb.cc lamb_update_phase1/2).
+#
+# Attrs `lrs`/`wds` are per-weight lists; the preloaded_* variants take
+# them as trailing tensor inputs instead (device-resident schedules).
+# ---------------------------------------------------------------------------
+
+def _chunk(arrays, n, per):
+    """Split the flat variadic input into n per-weight tuples using the
+    reference's INTERLEAVED convention (optimizer_op.cc /
+    _flatten_list(zip(weights, grads, ...))):
+    [w0, g0, (m0, ...), w1, g1, ...] -> [(w0, g0, ...), (w1, g1, ...)]."""
+    return [tuple(arrays[i * per:(i + 1) * per]) for i in range(n)]
+
+
+@register_op("multi_sum_sq", differentiable=False,
+             num_outputs=lambda attrs: int(attrs.get("num_arrays", 1)))
+def _multi_sum_sq(*arrays, num_arrays=1):
+    return tuple(jnp.sum(jnp.square(a.astype(jnp.float32))).reshape((1,))
+                 for a in arrays)
+
+
+@register_op("multi_sgd_update",
+             num_outputs=lambda attrs: int(attrs.get("num_weights", 1)))
+def _multi_sgd_update(*arrays, lrs=(), wds=(), rescale_grad=1.0,
+                      clip_gradient=-1.0, num_weights=1):
+    outs = []
+    for i, (w, g) in enumerate(_chunk(arrays, num_weights, 2)):
+        gg = _rescale_clip(g, rescale_grad, clip_gradient, wds[i], w)
+        outs.append(w - lrs[i] * gg)
+    return tuple(outs)
+
+
+@register_op("multi_sgd_mom_update",
+             num_outputs=lambda attrs: int(attrs.get("num_weights", 1)))
+def _multi_sgd_mom_update(*arrays, lrs=(), wds=(), momentum=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          num_weights=1):
+    outs = []
+    for i, (w, g, m) in enumerate(_chunk(arrays, num_weights, 3)):
+        gg = _rescale_clip(g, rescale_grad, clip_gradient, wds[i], w)
+        nm = momentum * m - lrs[i] * gg
+        outs.append(w + nm)
+    return tuple(outs)
+
+
+@register_op("multi_mp_sgd_update",
+             num_outputs=lambda attrs: int(attrs.get("num_weights", 1)))
+def _multi_mp_sgd_update(*arrays, lrs=(), wds=(), rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=1):
+    outs = []
+    for i, (w, g, w32) in enumerate(_chunk(arrays, num_weights, 3)):
+        gg = _rescale_clip(g.astype(jnp.float32), rescale_grad,
+                           clip_gradient, wds[i], w32)
+        outs.append((w32 - lrs[i] * gg).astype(w.dtype))
+    return tuple(outs)
+
+
+@register_op("multi_mp_sgd_mom_update",
+             num_outputs=lambda attrs: int(attrs.get("num_weights", 1)))
+def _multi_mp_sgd_mom_update(*arrays, lrs=(), wds=(), momentum=0.0,
+                             rescale_grad=1.0, clip_gradient=-1.0,
+                             num_weights=1):
+    outs = []
+    for i, (w, g, m, w32) in enumerate(_chunk(arrays, num_weights, 4)):
+        gg = _rescale_clip(g.astype(jnp.float32), rescale_grad,
+                           clip_gradient, wds[i], w32)
+        nm = momentum * m - lrs[i] * gg
+        outs.append((w32 + nm).astype(w.dtype))
+    return tuple(outs)
+
+
+@register_op("preloaded_multi_sgd_update",
+             num_outputs=lambda attrs: int(attrs.get("num_weights", 1)))
+def _preloaded_multi_sgd_update(*arrays, rescale_grad=1.0,
+                                clip_gradient=-1.0, num_weights=1):
+    """Like multi_sgd_update, but lrs/wds arrive as the two trailing
+    TENSOR inputs (device-resident schedules, no retrace per lr)."""
+    lrs, wds = arrays[-2], arrays[-1]
+    outs = []
+    for i, (w, g) in enumerate(_chunk(arrays[:-2], num_weights, 2)):
+        gg = _rescale_clip(g, rescale_grad, clip_gradient, wds[i], w)
+        outs.append(w - lrs[i] * gg)
+    return tuple(outs)
+
+
+@register_op("multi_lars", differentiable=False)
+def _multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+                eps=1e-8, rescale_grad=1.0):
+    """LARS local-lr schedule (ref: multi_lars.cc): per-layer lr scaled
+    by ||w|| / (||g|| + wd*||w|| + eps)."""
+    wn = jnp.sqrt(weights_sum_sq)
+    gn = jnp.sqrt(grads_sum_sq) * rescale_grad
+    ratio = eta * wn / (gn + wds * wn + eps)
+    return jnp.where(wn > 0, lrs * ratio, lrs)
+
+
+@register_op("lamb_update_phase1", num_outputs=3)
+def _lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                        epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    """LAMB phase 1 (ref: lamb.cc): adam-style direction g' =
+    m̂/(sqrt(v̂)+eps) + wd*w.  Returns (g', new_mean, new_var)."""
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    nm = beta1 * mean + (1 - beta1) * g
+    nv = beta2 * var + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        mh = nm / (1 - beta1 ** t)
+        vh = nv / (1 - beta2 ** t)
+    else:
+        mh, vh = nm, nv
+    direction = mh / (jnp.sqrt(vh) + epsilon) + wd * weight
+    return direction, nm, nv
+
+
+@register_op("lamb_update_phase2")
+def _lamb_update_phase2(weight, g, r1, r2, lr=0.001,
+                        lower_bound=-1.0, upper_bound=-1.0):
+    """LAMB phase 2 (ref: lamb.cc): apply with trust ratio r1/r2 where
+    r1=||w||, r2=||g'|| (computed by the caller, usually via norm)."""
+    r1v = r1.reshape(())
+    r2v = r2.reshape(())
+    if lower_bound is not None and lower_bound > 0:
+        r1v = jnp.maximum(r1v, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1v = jnp.minimum(r1v, upper_bound)
+    trust = jnp.where((r1v > 0) & (r2v > 0), r1v / r2v, 1.0)
+    return weight - lr * trust * g

@@ -101,3 +101,156 @@ def _neg_binomial(key, k=1, p=1.0, shape=(), dtype="float32"):
     k1, k2 = jax.random.split(key)
     lam = jax.random.gamma(k1, k, tuple(shape)) * (1 - p) / p
     return jax.random.poisson(k2, lam, tuple(shape)).astype(_dt(dtype))
+
+
+# ---------------------------------------------------------------------------
+# sample_* — per-row parameterized draws: params of shape S produce
+# output S + shape (ref: src/operator/random/sample_op.cc multisample)
+# ---------------------------------------------------------------------------
+
+def _multisample(key, shape, dtype, draw, *params):
+    shape = tuple(shape)
+    p0 = jnp.asarray(params[0])
+    flat = [jnp.asarray(p).reshape(-1).astype(jnp.float32)
+            for p in params]
+    n = flat[0].shape[0]
+    keys = jax.random.split(key, n)
+
+    def one(k, *ps):
+        return draw(k, shape, *ps)
+
+    out = jax.vmap(one)(keys, *flat)
+    return out.reshape(tuple(p0.shape) + shape).astype(_dt(dtype))
+
+
+@register_op("_sample_uniform", differentiable=False,
+             aliases=("sample_uniform",))
+def _sample_uniform(key, low, high, shape=(), dtype="float32"):
+    return _multisample(
+        key, shape, dtype,
+        lambda k, s, lo, hi: jax.random.uniform(k, s, jnp.float32, lo, hi),
+        low, high)
+
+
+@register_op("_sample_normal", differentiable=False,
+             aliases=("sample_normal",))
+def _sample_normal(key, mu, sigma, shape=(), dtype="float32"):
+    return _multisample(
+        key, shape, dtype,
+        lambda k, s, m, sd: m + sd * jax.random.normal(k, s),
+        mu, sigma)
+
+
+@register_op("_sample_gamma", differentiable=False,
+             aliases=("sample_gamma",))
+def _sample_gamma(key, alpha, beta, shape=(), dtype="float32"):
+    # beta is the SCALE parameter (reference convention)
+    return _multisample(
+        key, shape, dtype,
+        lambda k, s, a, b: b * jax.random.gamma(k, a, s),
+        alpha, beta)
+
+
+@register_op("_sample_exponential", differentiable=False,
+             aliases=("sample_exponential",))
+def _sample_exponential(key, lam, shape=(), dtype="float32"):
+    return _multisample(
+        key, shape, dtype,
+        lambda k, s, l: jax.random.exponential(k, s) / l, lam)
+
+
+@register_op("_sample_poisson", differentiable=False,
+             aliases=("sample_poisson",))
+def _sample_poisson(key, lam, shape=(), dtype="float32"):
+    return _multisample(
+        key, shape, dtype,
+        lambda k, s, l: jax.random.poisson(k, l, s).astype(jnp.float32),
+        lam)
+
+
+@register_op("_sample_negative_binomial", differentiable=False,
+             aliases=("sample_negative_binomial",))
+def _sample_negative_binomial(key, k, p, shape=(), dtype="float32"):
+    # NB(k, p) = Poisson(lambda), lambda ~ Gamma(k, (1-p)/p)
+    def draw(kk, s, kv, pv):
+        k1, k2 = jax.random.split(kk)
+        lam = jax.random.gamma(k1, kv, s) * (1.0 - pv) / pv
+        return jax.random.poisson(k2, lam, s).astype(jnp.float32)
+
+    return _multisample(key, shape, dtype, draw, k, p)
+
+
+@register_op("_sample_generalized_negative_binomial",
+             differentiable=False,
+             aliases=("sample_generalized_negative_binomial",))
+def _sample_gen_negative_binomial(key, mu, alpha, shape=(),
+                                  dtype="float32"):
+    # GNB(mu, alpha): Poisson with Gamma(1/alpha, mu*alpha) mixed rate
+    def draw(kk, s, m, a):
+        k1, k2 = jax.random.split(kk)
+        lam = jax.random.gamma(k1, 1.0 / a, s) * m * a
+        return jax.random.poisson(k2, lam, s).astype(jnp.float32)
+
+    return _multisample(key, shape, dtype, draw, mu, alpha)
+
+
+# ---------------------------------------------------------------------------
+# _random_pdf_* — evaluate the density of samples under row-wise
+# parameters (ref: src/operator/random/pdf_op.cc)
+# ---------------------------------------------------------------------------
+
+def _pdf(logpdf, sample, params, is_log):
+    sample = jnp.asarray(sample, jnp.float32)
+    ps = [jnp.asarray(p, jnp.float32) for p in params]
+    if ps and ps[0].ndim and ps[0].ndim < sample.ndim:
+        extra = sample.ndim - ps[0].ndim
+        ps = [p.reshape(p.shape + (1,) * extra) for p in ps]
+    out = logpdf(sample, *ps)
+    return out if is_log else jnp.exp(out)
+
+
+@register_op("_random_pdf_uniform", aliases=("random_pdf_uniform",))
+def _pdf_uniform(sample, low, high, is_log=False):
+    from jax.scipy.stats import uniform as U
+
+    return _pdf(lambda x, lo, hi: U.logpdf(x, lo, hi - lo), sample,
+                (low, high), is_log)
+
+
+@register_op("_random_pdf_normal", aliases=("random_pdf_normal",))
+def _pdf_normal(sample, mu, sigma, is_log=False):
+    from jax.scipy.stats import norm
+
+    return _pdf(norm.logpdf, sample, (mu, sigma), is_log)
+
+
+@register_op("_random_pdf_gamma", aliases=("random_pdf_gamma",))
+def _pdf_gamma(sample, alpha, beta, is_log=False):
+    from jax.scipy.stats import gamma
+
+    return _pdf(lambda x, a, b: gamma.logpdf(x, a, scale=b), sample,
+                (alpha, beta), is_log)
+
+
+@register_op("_random_pdf_exponential", aliases=("random_pdf_exponential",))
+def _pdf_exponential(sample, lam, is_log=False):
+    from jax.scipy.stats import expon
+
+    return _pdf(lambda x, l: expon.logpdf(x, scale=1.0 / l), sample,
+                (lam,), is_log)
+
+
+@register_op("_random_pdf_poisson", aliases=("random_pdf_poisson",))
+def _pdf_poisson(sample, lam, is_log=False):
+    from jax.scipy.stats import poisson
+
+    return _pdf(lambda x, l: poisson.logpmf(x, l), sample, (lam,),
+                is_log)
+
+
+@register_op("_random_pdf_negative_binomial", aliases=("random_pdf_negative_binomial",))
+def _pdf_negative_binomial(sample, k, p, is_log=False):
+    from jax.scipy.stats import nbinom
+
+    return _pdf(lambda x, kv, pv: nbinom.logpmf(x, kv, pv), sample,
+                (k, p), is_log)

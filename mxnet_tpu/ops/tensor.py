@@ -608,3 +608,93 @@ def _isinf(x):
 @register_op("isfinite", differentiable=False)
 def _isfinite(x):
     return jnp.isfinite(x).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# misc parity batch (ref: matrix_op.cc, elemwise_unary_op.cc, amp_cast.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("trace")
+def _trace(data, offset=0, axis1=0, axis2=1):
+    return jnp.trace(data, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_op("_ravel_multi_index", aliases=("ravel_multi_index",),
+             differentiable=False)
+def _ravel_multi_index(data, shape=()):
+    """data (d, n) of d-dim indices -> (n,) flat indices
+    (ref: ravel.cc)."""
+    strides = np.cumprod([1] + list(shape[::-1]))[::-1][1:]
+    s = jnp.asarray(strides.copy(), data.dtype)
+    return (data * s[:, None]).sum(axis=0)
+
+
+@register_op("_unravel_index", aliases=("unravel_index",),
+             differentiable=False)
+def _unravel_index(data, shape=()):
+    """(n,) flat indices -> (d, n) multi-indices (ref: ravel.cc)."""
+    out = jnp.stack(jnp.unravel_index(data.astype(jnp.int32),
+                                      tuple(shape)))
+    return out.astype(data.dtype)
+
+
+@register_op("digamma")
+def _digamma(data):
+    return jax.scipy.special.digamma(data)
+
+
+@register_op("bitwise_and", differentiable=False)
+def _bitwise_and(lhs, rhs):
+    return jnp.bitwise_and(lhs.astype(jnp.int64), rhs.astype(jnp.int64)) \
+        .astype(lhs.dtype)
+
+
+@register_op("bitwise_or", differentiable=False)
+def _bitwise_or(lhs, rhs):
+    return jnp.bitwise_or(lhs.astype(jnp.int64), rhs.astype(jnp.int64)) \
+        .astype(lhs.dtype)
+
+
+@register_op("bitwise_xor", differentiable=False)
+def _bitwise_xor(lhs, rhs):
+    return jnp.bitwise_xor(lhs.astype(jnp.int64), rhs.astype(jnp.int64)) \
+        .astype(lhs.dtype)
+
+
+@register_op("all_finite", differentiable=False)
+def _all_finite(data, init_output=True):
+    """-> (1,) float {0,1}: every element finite (ref: all_finite.cc,
+    the AMP gradient-overflow probe)."""
+    return jnp.isfinite(data).all().reshape((1,)).astype(jnp.float32)
+
+
+@register_op("multi_all_finite", differentiable=False)
+def _multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.isfinite(a).all())
+    return ok.reshape((1,)).astype(jnp.float32)
+
+
+@register_op("amp_cast")
+def _amp_cast(data, dtype="float32"):
+    """AMP-inserted cast (ref: amp_cast.cc) — identical to Cast but a
+    distinct node type so AMP graph passes can find/remove them.
+    float16 maps to bfloat16, the TPU-native half type (same documented
+    deviation as Cast)."""
+    dt = {"float16": jnp.bfloat16}.get(str(dtype), dtype)
+    return data.astype(dt)
+
+
+@register_op("amp_multicast",
+             num_outputs=lambda attrs: int(attrs.get("num_outputs", 1)))
+def _amp_multicast(*data, num_outputs=1, cast_narrow=False):
+    """Cast all inputs to a common dtype: widest by default, narrowest
+    with cast_narrow (ref: amp_cast.cc amp_multicast)."""
+    order = {jnp.dtype(jnp.bfloat16): 0, jnp.dtype(jnp.float32): 1,
+             jnp.dtype(jnp.float64): 2}
+    ranked = [order.get(jnp.dtype(d.dtype), 1) for d in data]
+    pick = min(range(len(data)), key=lambda i: ranked[i]) if cast_narrow \
+        else max(range(len(data)), key=lambda i: ranked[i])
+    target = data[pick].dtype
+    return tuple(d.astype(target) for d in data)

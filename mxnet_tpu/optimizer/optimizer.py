@@ -429,23 +429,20 @@ class LAMB(Optimizer):
         lr = self._get_lr(index)
         wd = self._get_wd(index)
         mean, var = state
-        g = invoke("lamb_update_phase1", weight, grad, mean, var,
-                   beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
-                   t=t, bias_correction=self.bias_correction, wd=wd,
-                   rescale_grad=self.rescale_grad,
-                   clip_gradient=self.clip_gradient or -1.0)
-        # phase1 also advanced mean/var functionally; recompute to rebind
-        gs = grad.data * self.rescale_grad
-        mean._data = self.beta1 * mean.data + (1 - self.beta1) * gs
-        var._data = self.beta2 * var.data + (1 - self.beta2) * jnp.square(gs)
-        r1 = float(weight.norm().asscalar())
-        if self.lower_bound:
-            r1 = max(r1, self.lower_bound)
-        if self.upper_bound:
-            r1 = min(r1, self.upper_bound)
-        r2 = float(g.norm().asscalar())
-        trust = r1 / r2 if r1 > 0 and r2 > 0 else 1.0
-        weight._data = weight.data - lr * trust * g.data
+        g, new_mean, new_var = invoke(
+            "lamb_update_phase1", weight, grad, mean, var,
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+            t=t, bias_correction=self.bias_correction, wd=wd,
+            rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient or -1.0)
+        mean._data = new_mean.data
+        var._data = new_var.data
+        r1 = weight.norm()
+        r2 = g.norm()
+        new_w = invoke("lamb_update_phase2", weight, g, r1, r2, lr=lr,
+                       lower_bound=self.lower_bound or -1.0,
+                       upper_bound=self.upper_bound or -1.0)
+        weight._data = new_w.data
 
 
 @register("test")

@@ -1,0 +1,290 @@
+"""Op parity batch: sample_*/pdf_* families, regression heads, AMP,
+multi-tensor optimizer ops, LAMB/LARS, im2col/col2im, Correlation,
+DeformableConvolution, fft, misc unary (ref: sample_op.cc, pdf_op.cc,
+regression_output-inl.h, optimizer_op.cc, lamb.cc, correlation.cc,
+deformable_convolution.cc, fft-inl.h)."""
+import numpy as np
+import pytest
+import scipy.stats as sstats
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_sample_family_shapes_and_stats():
+    mx.random.seed(0)
+    mu = nd.array(np.array([0.0, 10.0], np.float32))
+    sd = nd.array(np.array([1.0, 0.1], np.float32))
+    s = nd.sample_normal(mu, sd, shape=(2000,))
+    assert s.shape == (2, 2000)
+    a = s.asnumpy()
+    assert abs(a[0].mean()) < 0.15 and abs(a[1].mean() - 10) < 0.15
+    assert abs(a[0].std() - 1) < 0.1 and a[1].std() < 0.2
+
+    lam = nd.array(np.array([2.0, 20.0], np.float32))
+    p = nd.sample_poisson(lam, shape=(3000,)).asnumpy()
+    assert abs(p[0].mean() - 2) < 0.3 and abs(p[1].mean() - 20) < 1.0
+
+    al = nd.array(np.array([3.0], np.float32))
+    be = nd.array(np.array([2.0], np.float32))
+    g = nd.sample_gamma(al, be, shape=(4000,)).asnumpy()
+    assert abs(g.mean() - 6.0) < 0.5  # mean = alpha * beta (scale)
+
+    e = nd.sample_exponential(nd.array(np.array([4.0], np.float32)),
+                              shape=(4000,)).asnumpy()
+    assert abs(e.mean() - 0.25) < 0.05
+
+    nb = nd.sample_negative_binomial(
+        nd.array(np.array([5.0], np.float32)),
+        nd.array(np.array([0.5], np.float32)), shape=(4000,)).asnumpy()
+    assert abs(nb.mean() - 5.0) < 0.6  # mean = k(1-p)/p
+
+
+def test_pdf_family_matches_scipy():
+    x = np.array([[0.5, 1.5, 2.5]], np.float32)
+    mu = np.array([1.0], np.float32)
+    sd = np.array([0.5], np.float32)
+    out = nd.random_pdf_normal(nd.array(x), nd.array(mu),
+                               nd.array(sd)).asnumpy()
+    np.testing.assert_allclose(out[0], sstats.norm.pdf(x[0], 1.0, 0.5),
+                               rtol=1e-4)
+    a = np.array([2.0], np.float32)
+    b = np.array([3.0], np.float32)
+    out = nd.random_pdf_gamma(nd.array(x), nd.array(a),
+                              nd.array(b)).asnumpy()
+    np.testing.assert_allclose(out[0],
+                               sstats.gamma.pdf(x[0], 2.0, scale=3.0),
+                               rtol=1e-4)
+    k = np.array([4.0], np.float32)
+    lam = np.array([2.0], np.float32)
+    xs = np.array([[0.0, 1.0, 3.0]], np.float32)
+    out = nd.random_pdf_poisson(nd.array(xs), nd.array(lam),
+                                is_log=True).asnumpy()
+    np.testing.assert_allclose(out[0],
+                               sstats.poisson.logpmf(xs[0], 2.0),
+                               rtol=1e-4)
+
+
+def test_uniform_normal_bare_aliases():
+    mx.random.seed(1)
+    u = nd.uniform(low=2.0, high=3.0, shape=(500,))
+    a = u.asnumpy()
+    assert a.min() >= 2.0 and a.max() <= 3.0
+    n = nd.normal(loc=-1.0, scale=0.5, shape=(500,))
+    assert abs(n.asnumpy().mean() + 1.0) < 0.15
+
+
+def test_regression_heads_backward_semantics():
+    # batch=2, num_output=3 (distinct!): reference scales the backward
+    # by grad_scale/num_output (regression_output-inl.h), NOT 1/batch
+    rng = np.random.RandomState(0)
+    xv = rng.randn(2, 3).astype(np.float32)
+    yv = rng.randn(2, 3).astype(np.float32)
+    x = nd.array(xv)
+    y = nd.array(yv)
+    x.attach_grad()
+    with mx.autograd.record():
+        out = nd.LinearRegressionOutput(x, y)
+    out.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), (xv - yv) / 3,
+                               rtol=1e-6)
+    x.grad[:] = 0
+    with mx.autograd.record():
+        out = nd.MAERegressionOutput(x, y)
+    out.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.sign(xv - yv) / 3)
+    x.grad[:] = 0
+    with mx.autograd.record():
+        out = nd.logistic_regression_output(x, y)  # snake alias
+    out.backward()
+    sig = 1 / (1 + np.exp(-xv))
+    np.testing.assert_allclose(out.asnumpy(), sig, rtol=1e-5)
+    np.testing.assert_allclose(x.grad.asnumpy(), (sig - yv) / 3,
+                               rtol=1e-5)
+    # consecutive-capitals snake alias (MAE -> mae)
+    assert nd.mae_regression_output is not None
+
+
+def test_svm_output_hinge_gradient():
+    scores = nd.array(np.array([[2.0, 1.5, -1.0]], np.float32))
+    label = nd.array(np.array([0.0], np.float32))
+    scores.attach_grad()
+    with mx.autograd.record():
+        out = nd.SVMOutput(scores, label, margin=1.0, use_linear=True)
+    out.backward()
+    np.testing.assert_allclose(out.asnumpy(), scores.asnumpy())
+    # class 1 violates (2.0 - 1.5 < 1), class 2 does not (2.0-(-1) > 1)
+    np.testing.assert_allclose(scores.grad.asnumpy(), [[-1.0, 1.0, 0.0]])
+
+
+def test_misc_unary_and_amp():
+    x = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    np.testing.assert_allclose(nd.trace(x).asnumpy(), 5.0)
+    np.testing.assert_allclose(
+        nd.hard_sigmoid(nd.array(np.array([-10.0, 0.0, 10.0], np.float32)))
+        .asnumpy(), [0.0, 0.5, 1.0])
+    h = nd.hard_swish(nd.array(np.array([-4.0, 0.0, 4.0], np.float32)))
+    np.testing.assert_allclose(h.asnumpy(), [0.0, 0.0, 4.0])
+    m = nd.mish(nd.array(np.array([0.0], np.float32)))
+    np.testing.assert_allclose(m.asnumpy(), [0.0], atol=1e-6)
+    d = nd.digamma(nd.array(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(d.asnumpy(), [-0.5772157], rtol=1e-4)
+    assert float(nd.all_finite(x).asnumpy()[0]) == 1.0
+    bad = nd.array(np.array([np.inf], np.float32))
+    assert float(nd.all_finite(bad).asnumpy()[0]) == 0.0
+    oks = nd.multi_all_finite(x, bad, num_arrays=2)
+    assert float(oks.asnumpy()[0]) == 0.0
+    c = nd.amp_cast(x, dtype="float16")
+    assert "bfloat16" in str(c.dtype)
+    a, b = nd.amp_multicast(c, x, num_outputs=2)
+    assert a.dtype == np.float32 and b.dtype == np.float32
+
+
+def test_ravel_unravel_roundtrip():
+    idx = nd.array(np.array([[0, 1, 2], [3, 2, 1]], np.float32))
+    flat = nd.ravel_multi_index(idx, shape=(4, 5))
+    np.testing.assert_allclose(flat.asnumpy(), [3, 7, 11])
+    back = nd.unravel_index(flat, shape=(4, 5))
+    np.testing.assert_allclose(back.asnumpy(), idx.asnumpy())
+
+
+def test_fft_ifft_interleaved_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 8).astype(np.float32)
+    spec = nd.fft(nd.array(x))
+    assert spec.shape == (2, 16)
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(spec.asnumpy()[:, 0::2], ref.real,
+                               atol=1e-4)
+    np.testing.assert_allclose(spec.asnumpy()[:, 1::2], ref.imag,
+                               atol=1e-4)
+    back = nd.ifft(spec) / 8  # reference convention: unnormalized
+    np.testing.assert_allclose(back.asnumpy(), x, atol=1e-4)
+
+
+def test_multi_sgd_and_mp_updates():
+    w1 = nd.array(np.ones((3,), np.float32))
+    w2 = nd.array(np.full((2,), 2.0, np.float32))
+    g1 = nd.array(np.full((3,), 0.5, np.float32))
+    g2 = nd.array(np.full((2,), 1.0, np.float32))
+    # reference layout: interleaved (w0, g0, w1, g1) — optimizer_op.cc
+    o1, o2 = nd.multi_sgd_update(w1, g1, w2, g2, lrs=(0.1, 0.2),
+                                 wds=(0.0, 0.0), num_weights=2)
+    np.testing.assert_allclose(o1.asnumpy(), 0.95 * np.ones(3))
+    np.testing.assert_allclose(o2.asnumpy(), 1.8 * np.ones(2))
+    ss1, ss2 = nd.multi_sum_sq(w1, w2, num_arrays=2)
+    np.testing.assert_allclose(ss1.asnumpy(), [3.0])
+    np.testing.assert_allclose(ss2.asnumpy(), [8.0])
+    lrs = nd.array(np.array([0.1, 0.2], np.float32))
+    wds = nd.array(np.array([0.0, 0.0], np.float32))
+    p1, p2 = nd.preloaded_multi_sgd_update(w1, g1, w2, g2, lrs, wds,
+                                           num_weights=2)
+    np.testing.assert_allclose(p1.asnumpy(), o1.asnumpy())
+    np.testing.assert_allclose(p2.asnumpy(), o2.asnumpy())
+
+
+def test_lamb_and_lars():
+    w = nd.array(np.full((4,), 2.0, np.float32))
+    g = nd.array(np.full((4,), 0.1, np.float32))
+    m = nd.zeros((4,))
+    v = nd.zeros((4,))
+    d, nm, nv = nd.lamb_update_phase1(w, g, m, v, t=1, wd=0.01)
+    assert np.isfinite(d.asnumpy()).all()
+    r1 = nd.array(np.array([np.linalg.norm(w.asnumpy())], np.float32))
+    r2 = nd.array(np.array([np.linalg.norm(d.asnumpy())], np.float32))
+    w2 = nd.lamb_update_phase2(w, d, r1, r2, lr=0.01)
+    assert (w2.asnumpy() < w.asnumpy()).all()
+    # LARS: local lr = eta*||w|| / (||g|| + wd*||w|| + eps)
+    lrs = nd.array(np.array([1.0], np.float32))
+    wss = nd.array(np.array([16.0], np.float32))
+    gss = nd.array(np.array([0.04], np.float32))
+    wds = nd.array(np.array([0.0], np.float32))
+    out = nd.multi_lars(lrs, wss, gss, wds, eta=0.1, eps=0.0)
+    np.testing.assert_allclose(out.asnumpy(), [0.1 * 4.0 / 0.2],
+                               rtol=1e-5)
+
+
+def test_im2col_col2im():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+    cols = nd.im2col(nd.array(x), kernel=(2, 2), stride=(2, 2))
+    assert cols.shape == (1, 8, 4)  # C*k*k=8 rows, 4 patches
+    # patch (0,0) equals the first 2x2 block flattened channel-major
+    np.testing.assert_allclose(
+        cols.asnumpy()[0, :, 0],
+        x[0, :, :2, :2].reshape(2, -1).ravel(), rtol=1e-6)
+    img = nd.col2im(cols, output_size=(4, 4), kernel=(2, 2),
+                    stride=(2, 2))
+    np.testing.assert_allclose(img.asnumpy(), x, rtol=1e-6)  # no overlap
+
+
+def test_correlation_identity_peak():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 4, 6, 6).astype(np.float32)
+    out = nd.Correlation(nd.array(x), nd.array(x), max_displacement=1,
+                         pad_size=1)
+    assert out.shape == (1, 9, 6, 6)  # 6 + 2*1 - 2*1
+    a = out.asnumpy()[0]
+    # zero-displacement channel (index 4) is the exact self-correlation
+    np.testing.assert_allclose(a[4], (x[0] * x[0]).mean(axis=0),
+                               rtol=1e-5)
+    # displacement (-1, 0) channel (index 1) matches a hand shift
+    np.testing.assert_allclose(
+        a[1, 1:, :], (x[0, :, 1:, :] * x[0, :, :-1, :]).mean(axis=0),
+        rtol=1e-5)
+
+
+def test_deformable_convolution_zero_offset_equals_conv():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 7, 7).astype(np.float32)
+    w = rng.randn(5, 3, 3, 3).astype(np.float32)
+    off = np.zeros((2, 18, 7, 7), np.float32)
+    ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=5, pad=(1, 1), no_bias=True)
+    out = nd.DeformableConvolution(nd.array(x), nd.array(off),
+                                   nd.array(w), kernel=(3, 3),
+                                   num_filter=5, pad=(1, 1), no_bias=True)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_deformable_convolution_gradient():
+    rng = np.random.RandomState(3)
+    x = nd.array(rng.randn(1, 2, 5, 5).astype(np.float32))
+    off = nd.array((rng.randn(1, 8, 4, 4) * 0.1).astype(np.float32))
+    w = nd.array(rng.randn(3, 2, 2, 2).astype(np.float32))
+    for t in (x, off, w):
+        t.attach_grad()
+    with mx.autograd.record():
+        out = nd.DeformableConvolution(x, off, w, kernel=(2, 2),
+                                       num_filter=3, no_bias=True)
+        loss = (out * out).sum()
+    loss.backward()
+    for t in (x, off, w):
+        g = t.grad.asnumpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_correlation_reference_geometry():
+    x = nd.array(np.random.RandomState(4).randn(1, 2, 6, 6)
+                 .astype(np.float32))
+    out = nd.Correlation(x, x, max_displacement=1, pad_size=0)
+    assert out.shape == (1, 9, 4, 4)  # H + 2*pad - 2*d
+    out = nd.Correlation(x, x, max_displacement=1, pad_size=1)
+    assert out.shape == (1, 9, 6, 6)
+    with pytest.raises(mx.MXNetError, match="non-positive"):
+        nd.Correlation(x, x, max_displacement=4, pad_size=0)
+
+
+def test_pdf_ops_are_differentiable():
+    mu = nd.array(np.array([1.0], np.float32))
+    sd = nd.array(np.array([0.5], np.float32))
+    xs = nd.array(np.array([[0.5, 1.5]], np.float32))
+    mu.attach_grad()
+    sd.attach_grad()
+    with mx.autograd.record():
+        ll = nd.random_pdf_normal(xs, mu, sd, is_log=True).sum()
+    ll.backward()
+    # d/dmu sum logN(x; mu, sd) = sum (x-mu)/sd^2 = (-0.5 + 0.5)/0.25 = 0
+    np.testing.assert_allclose(mu.grad.asnumpy(), [0.0], atol=1e-5)
+    assert abs(float(sd.grad.asnumpy()[0])) > 0
