@@ -1,0 +1,183 @@
+"""Bucketed RNN language model via the SYMBOLIC Module path
+(ref: example/rnn/bucketing/lstm_bucketing.py — the reference's flagship
+BucketingModule workflow).
+
+Char-level LM: sentences are bucketed by length, one executor compiled
+per bucket (here: one XLA program per bucket, all sharing parameters —
+the executor-per-bucket design of the reference), trained with
+Module.fit over the fused RNN op.
+
+Usage:
+  python examples/rnn_bucketing.py                 # TPU, synthetic text
+  python examples/rnn_bucketing.py --cpu --small   # CPU smoke (CI)
+  python examples/rnn_bucketing.py --text corpus.txt --epochs 10
+      # REAL-DATA path: any plain-text file, one sentence per line
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+logging.basicConfig(level=logging.INFO, format="%(message)s")
+
+
+class BucketSentenceIter:
+    """Minimal bucketed iterator (ref: BucketSentenceIter in
+    example/rnn/bucketing): sentences of encoded ids grouped into the
+    smallest bucket that fits, batches padded to the bucket length."""
+
+    def __init__(self, sentences, batch_size, buckets, vocab_size,
+                 invalid_label=0):
+        import numpy as np
+
+        from mxnet_tpu.io import DataDesc
+
+        self.batch_size = batch_size
+        self.buckets = sorted(buckets)
+        self.vocab_size = vocab_size
+        self.data = {b: [] for b in self.buckets}
+        for s in sentences:
+            if len(s) < 2:
+                continue
+            bk = next((b for b in self.buckets if len(s) <= b + 1), None)
+            if bk is None:
+                continue
+            row = np.full(bk + 1, invalid_label, np.float32)
+            row[:len(s)] = s
+            self.data[bk].append(row)
+        self.default_bucket_key = self.buckets[-1]
+        self.provide_data = [DataDesc(
+            "data", (batch_size, self.default_bucket_key))]
+        self.provide_label = [DataDesc(
+            "softmax_label", (batch_size, self.default_bucket_key))]
+        self.reset()
+
+    def reset(self):
+        import numpy as np
+
+        self._plan = []
+        for bk, rows in self.data.items():
+            np.random.shuffle(rows)
+            for i in range(0, len(rows) - self.batch_size + 1,
+                           self.batch_size):
+                self._plan.append((bk, i))
+        np.random.shuffle(self._plan)
+        self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        import numpy as np
+
+        from mxnet_tpu import nd
+        from mxnet_tpu.io import DataBatch, DataDesc
+
+        if self._cursor >= len(self._plan):
+            raise StopIteration
+        bk, i = self._plan[self._cursor]
+        self._cursor += 1
+        rows = np.stack(self.data[bk][i:i + self.batch_size])
+        data, label = rows[:, :-1], rows[:, 1:]
+        return DataBatch(
+            data=[nd.array(data)], label=[nd.array(label)],
+            bucket_key=bk,
+            provide_data=[DataDesc("data", data.shape)],
+            provide_label=[DataDesc("softmax_label", label.shape)])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--small", action="store_true")
+    ap.add_argument("--text", default=None,
+                    help="plain-text file, one sentence per line")
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-hidden", type=int, default=200)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import mxnet_tpu as mx
+
+    np.random.seed(args.seed)  # Xavier init + bucket shuffles deterministic
+    mx.random.seed(args.seed)
+
+    if args.small:
+        args.batch_size, args.num_hidden, args.num_layers = 8, 32, 1
+        buckets = [8, 16]
+    else:
+        buckets = [10, 20, 30, 40, 60]
+
+    # ---- corpus -> encoded sentences -----------------------------------
+    if args.text:
+        with open(args.text) as f:
+            lines = [line.strip() for line in f if line.strip()]
+    else:  # synthetic: repeated alphabet runs are very learnable
+        rng = np.random.RandomState(0)
+        alpha = "abcdefghij"
+        lines = []
+        for _ in range(300 if args.small else 2000):
+            start = rng.randint(len(alpha))
+            n = rng.randint(4, (buckets[-1] - 1))
+            lines.append("".join(alpha[(start + k) % len(alpha)]
+                                 for k in range(n)))
+    chars = sorted(set("".join(lines)))
+    vocab = {c: i + 1 for i, c in enumerate(chars)}  # 0 = pad
+    vocab_size = len(vocab) + 1
+    sentences = [[vocab[c] for c in line] for line in lines]
+    train_iter = BucketSentenceIter(sentences, args.batch_size, buckets,
+                                    vocab_size)
+
+    # ---- symbol generator: one graph per bucket length -----------------
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                                 output_dim=args.num_hidden, name="embed")
+        rnn_in = mx.sym.transpose(embed, axes=(1, 0, 2))  # (T, N, H)
+        out = mx.sym.RNN(rnn_in, state_size=args.num_hidden,
+                         num_layers=args.num_layers, mode="lstm",
+                         state_outputs=False, name="lstm")
+        out = mx.sym.transpose(out, axes=(1, 0, 2))       # (N, T, H)
+        out = mx.sym.reshape(out, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(out, num_hidden=vocab_size,
+                                     name="pred")
+        label_f = mx.sym.reshape(label, shape=(-1,))
+        sm = mx.sym.SoftmaxOutput(pred, label_f, name="softmax")
+        return sm, ("data",), ("softmax_label",)
+
+    ctx = mx.cpu() if args.cpu else mx.tpu(0)
+    model = mx.module.BucketingModule(
+        sym_gen=sym_gen, default_bucket_key=train_iter.default_bucket_key,
+        context=ctx)
+
+    metric = mx.metric.Perplexity(ignore_label=0)
+    model.fit(train_iter, eval_metric=metric,
+              optimizer="adam",
+              optimizer_params={"learning_rate": args.lr},
+              initializer=mx.initializer.Xavier(),
+              num_epoch=args.epochs,
+              batch_end_callback=mx.callback.Speedometer(
+                  args.batch_size, 10))
+    train_iter.reset()
+    final = model.score(train_iter, mx.metric.Perplexity(ignore_label=0))
+    print(f"final {final[0][0]}={final[0][1]:.3f}")
+
+
+if __name__ == "__main__":
+    main()

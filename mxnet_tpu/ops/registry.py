@@ -78,6 +78,8 @@ class Operator:
         except (TypeError, ValueError):
             self.allow_any_attr = True
             return
+        self.param_order: List[str] = []
+        self.param_default: Dict[str, Any] = {}
         for p in sig.parameters.values():
             if p.kind == inspect.Parameter.VAR_KEYWORD:
                 self.allow_any_attr = True
@@ -85,8 +87,11 @@ class Operator:
                 self.input_names.append("*" + p.name)
             elif p.default is inspect.Parameter.empty:
                 self.input_names.append(p.name)
+                self.param_order.append(p.name)
             else:
                 self.attr_defaults[p.name] = p.default
+                self.param_order.append(p.name)
+                self.param_default[p.name] = p.default
 
     def validate_attrs(self, attrs: dict) -> dict:
         """Reject unknown attributes loudly and coerce reference-style
@@ -273,6 +278,21 @@ def invoke(op_name: str, *inputs, **attrs):
     from ..profiler import profile_op
 
     op = get_op(op_name)
+    # an OPTIONAL array input (state=None, bias=None) passed by keyword
+    # must become a positional input, not an attr — otherwise the array
+    # would be frozen into the jit cache key and crash inside the trace
+    nd_kw = {k: v for k, v in attrs.items() if isinstance(v, NDArray)}
+    if nd_kw and getattr(op, "param_order", None):
+        order = op.param_order
+        last = max(order.index(k) for k in nd_kw)
+        extra = []
+        for name in order[len(inputs):last + 1]:
+            if name in nd_kw:
+                attrs.pop(name)
+                extra.append(nd_kw[name])
+            else:  # gap: fill with the declared default (e.g. state=None)
+                extra.append(attrs.pop(name, op.param_default.get(name)))
+        inputs = tuple(inputs) + tuple(extra)
     arrays = []
     ctx = None
     for x in inputs:

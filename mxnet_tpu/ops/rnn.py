@@ -131,15 +131,21 @@ def _rnn_nout(attrs):
 
 
 @register_op("RNN", num_outputs=_rnn_nout)
-def _rnn(data, parameters, state, state_cell=None, key=None,
+def _rnn(data, parameters, state=None, state_cell=None, key=None,
          state_size=0, num_layers=1, mode="lstm", bidirectional=False,
          p=0.0, state_outputs=True, projection_size=None,
          lstm_state_clip_min=None, lstm_state_clip_max=None,
          lstm_state_clip_nan=False, use_sequence_length=False, _train=False):
-    """data: (T, N, I); state: (L*dirs, N, H); returns out (T, N, H*dirs)."""
+    """data: (T, N, I); state: (L*dirs, N, H); returns out (T, N, H*dirs).
+    Omitted state/state_cell default to zeros (the symbolic path's
+    begin_state contract — cudnn_rnn-inl.h starts from zeros too)."""
     T, N, I = data.shape
     H = state_size
     dirs = 2 if bidirectional else 1
+    if state is None:
+        state = jnp.zeros((num_layers * dirs, N, H), data.dtype)
+    if state_cell is None and mode == "lstm":
+        state_cell = jnp.zeros((num_layers * dirs, N, H), data.dtype)
     mats, biases = _unpack_params(parameters, mode, I, H, num_layers, dirs)
     x = data
     h_outs, c_outs = [], []

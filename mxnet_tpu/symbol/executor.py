@@ -172,7 +172,9 @@ class GraphExecutor:
             if node.op in TRAIN_AWARE_OPS:
                 attrs["_train"] = train
             if node.op in KEYED_OPS:
-                ins = [ins[0], keys[ki]] + ins[1:]
+                # by KEYWORD: the key param's position differs per op
+                # (Dropout: 2nd, RNN: 5th)
+                attrs["key"] = keys[ki]
                 ki += 1
             out = op.fn(*ins, **attrs)
             if node.op == "BatchNorm" and isinstance(out, (tuple, list)) \

@@ -127,6 +127,17 @@ def _label_shapes(ins, attrs):
     return {"label": tuple(d[:-1])}
 
 
+def _rnn_shapes(ins, attrs):
+    d = ins.get("data")  # (T, N, I)
+    if d is None:
+        return {}
+    from ..ops.rnn import rnn_param_size
+
+    return {"parameters": (rnn_param_size(
+        attrs.get("mode", "lstm"), d[2], attrs["state_size"],
+        attrs.get("num_layers", 1), attrs.get("bidirectional", False)),)}
+
+
 class _Schema:
     def __init__(self, inputs: Sequence[str], aux: Sequence[str] = (),
                  optional: Sequence[str] = (), param_shapes=None,
@@ -160,12 +171,16 @@ SCHEMAS: Dict[str, _Schema] = {
     "SoftmaxOutput": _Schema(("data", "label"), label_suffix="label",
                              param_shapes=_label_shapes),
     "LeakyReLU": _Schema(("data", "gamma"), optional=("gamma",)),
+    "RNN": _Schema(("data", "parameters", "state", "state_cell"),
+                   optional=("state", "state_cell"),
+                   param_shapes=_rnn_shapes),
 }
 
 # ops whose kernels consult the train flag; the executor passes _train
-TRAIN_AWARE_OPS = {"BatchNorm", "Dropout"}
-# ops that consume a PRNG key injected at execution time
-KEYED_OPS = {"Dropout"}
+TRAIN_AWARE_OPS = {"BatchNorm", "Dropout", "RNN"}
+# ops that consume a PRNG key injected at execution time (as `key=` —
+# its positional slot differs per op)
+KEYED_OPS = {"Dropout", "RNN"}
 
 
 def _is_sym(x) -> bool:
@@ -455,8 +470,11 @@ def _eval_node_shape(node: _Node, in_shapes):
     attrs = {k: v for k, v in node.attrs.items() if not k.startswith("__")}
     if node.op in KEYED_OPS:
         key_struct = jax.ShapeDtypeStruct((2,), jnp.uint32)
-        structs = [structs[0], key_struct] + structs[1:]
-    out = jax.eval_shape(lambda *a: op.fn(*a, **attrs), *structs)
+        out = jax.eval_shape(
+            lambda key, *a: op.fn(*a, key=key, **attrs), key_struct,
+            *structs)
+    else:
+        out = jax.eval_shape(lambda *a: op.fn(*a, **attrs), *structs)
     if not isinstance(out, (tuple, list)):
         out = [out]
     return list(out)
@@ -542,13 +560,29 @@ def make_symbol_function(op_name: str):
                     return attrs.get("act_type", "leaky") == "prelu"
                 return False
 
+            skipped: List[str] = []
             for nm in schema.inputs:
                 if nm in named:
+                    if skipped:
+                        # node.inputs bind POSITIONALLY downstream: a
+                        # later optional after a skipped one would
+                        # silently land in the wrong slot
+                        raise MXNetError(
+                            f"{op.name}: input {nm!r} given but earlier "
+                            f"optional input(s) {skipped} omitted — "
+                            f"pass them explicitly")
                     sym_inputs.append(named[nm])
                 elif _wanted(nm):
+                    if skipped:
+                        raise MXNetError(
+                            f"{op.name}: auto-created input {nm!r} "
+                            f"follows omitted optional input(s) "
+                            f"{skipped} — pass them explicitly")
                     sym_inputs.append(
                         Symbol([(_Node(None, f"{node_name}_{nm}", {}, [],
                                        is_aux=nm in schema.aux), 0)]))
+                else:
+                    skipped.append(nm)
         else:
             # generic op: positional args map onto the pure fn's signature
             # in order — Symbols become graph inputs, scalars become attrs

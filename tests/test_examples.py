@@ -20,7 +20,7 @@ def _run(args, timeout=420):
     r = subprocess.run([sys.executable] + args, cwd=_REPO, env=env,
                        capture_output=True, text=True, timeout=timeout)
     assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-3000:]
-    return r.stdout
+    return r.stdout + r.stderr  # logging writes to stderr
 
 
 def test_bert_pretrain_corpus(tmp_path):
@@ -74,3 +74,12 @@ def test_transformer_nmt_parallel_corpus(tmp_path):
     out = _run(["examples/transformer_nmt.py", "--cpu", "--small",
                 "--src", str(src), "--tgt", str(tgt), "--epochs", "1"])
     assert "avg-loss=" in out
+
+
+def test_rnn_bucketing_symbolic():
+    out = _run(["examples/rnn_bucketing.py", "--cpu", "--small",
+                "--epochs", "2"], timeout=560)
+    assert "Train-perplexity" in out and "final perplexity=" in out
+    # the synthetic alphabet task is very learnable
+    ppl = float(out.rsplit("final perplexity=", 1)[1].splitlines()[0])
+    assert ppl < 3.0, ppl

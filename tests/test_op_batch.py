@@ -288,3 +288,58 @@ def test_pdf_ops_are_differentiable():
     # d/dmu sum logN(x; mu, sd) = sum (x-mu)/sd^2 = (-0.5 + 0.5)/0.25 = 0
     np.testing.assert_allclose(mu.grad.asnumpy(), [0.0], atol=1e-5)
     assert abs(float(sd.grad.asnumpy()[0])) > 0
+
+
+def test_optional_array_input_by_keyword_routes_to_inputs():
+    """state=NDArray passed by keyword must be an array input, never a
+    frozen attr (registry keyword->positional routing)."""
+    rng = np.random.RandomState(5)
+    x = nd.array(rng.randn(4, 2, 3).astype(np.float32))
+    P = __import__("mxnet_tpu.ops.rnn", fromlist=["rnn_param_size"]) \
+        .rnn_param_size("lstm", 3, 5, 1, False)
+    params = nd.array((rng.randn(P) * 0.1).astype(np.float32))
+    h0 = nd.array(np.zeros((1, 2, 5), np.float32))
+    c0 = nd.array(np.zeros((1, 2, 5), np.float32))
+    out_kw = nd.RNN(x, params, state=h0, state_cell=c0, state_size=5,
+                    mode="lstm", state_outputs=False)
+    out_pos = nd.RNN(x, params, h0, c0, state_size=5, mode="lstm",
+                     state_outputs=False)
+    np.testing.assert_allclose(out_kw.asnumpy(), out_pos.asnumpy())
+    # gap: state_cell by keyword with state omitted -> zeros default fills
+    out_gap = nd.RNN(x, params, state_cell=c0, state_size=5, mode="lstm",
+                     state_outputs=False)
+    np.testing.assert_allclose(out_gap.asnumpy(), out_pos.asnumpy())
+
+
+def test_symbolic_rnn_dropout_is_live():
+    """p>0 must actually drop between layers when training (RNN is
+    train-aware + keyed in the symbolic executor)."""
+    import mxnet_tpu as mx
+
+    data = mx.sym.var("data")
+    out = mx.sym.RNN(data, state_size=6, num_layers=2, mode="lstm",
+                     p=0.9, state_outputs=False, name="l")
+    x = nd.array(np.random.RandomState(0).randn(4, 3, 2)
+                 .astype(np.float32))
+    shapes, _, _ = out.infer_shape(data=(4, 3, 2))
+    P = dict(zip(out.list_arguments(), shapes))["l_parameters"]
+    w = nd.array((np.random.RandomState(1).randn(*P) * 0.3)
+                 .astype(np.float32))
+    exe = out.bind(mx.cpu(), {"data": x, "l_parameters": w},
+                   grad_req="null")
+    train_o = exe.forward(is_train=True)[0].asnumpy()
+    eval_o = exe.forward(is_train=False)[0].asnumpy()
+    # dropout at 0.9 between layers must change the training output
+    assert not np.allclose(train_o, eval_o)
+    # and eval mode is deterministic
+    np.testing.assert_allclose(exe.forward(is_train=False)[0].asnumpy(),
+                               eval_o)
+
+
+def test_symbol_optional_gap_is_loud():
+    import mxnet_tpu as mx
+
+    data = mx.sym.var("data")
+    cell = mx.sym.var("c0")
+    with pytest.raises(mx.MXNetError, match="omitted"):
+        mx.sym.RNN(data, state_cell=cell, state_size=4, mode="lstm")

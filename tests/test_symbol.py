@@ -327,3 +327,33 @@ def test_load_json_legacy_encoding():
     arg_shapes, out_shapes, _ = loaded.infer_shape_partial()
     assert out_shapes == [(2, 4, 8, 8)]
 
+
+
+def test_symbolic_rnn_auto_params_and_grad():
+    """sym.RNN auto-creates the flat cudnn-style parameter vector
+    (schema) and trains through the fused executor."""
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    data = mx.sym.var("data")
+    out = mx.sym.RNN(data, state_size=8, num_layers=2, mode="lstm",
+                     state_outputs=False, name="lstm")
+    args = out.list_arguments()
+    assert "lstm_parameters" in args and "data" in args
+    shapes, outs, _ = out.infer_shape(data=(5, 4, 3))
+    d = dict(zip(args, shapes))
+    assert d["lstm_parameters"] == (rnn_param_size("lstm", 3, 8, 2,
+                                                   False),)
+    assert outs[0] == (5, 4, 8)
+    exe = out.bind(mx.cpu(), {
+        "data": mx.nd.array(np.random.RandomState(0)
+                            .randn(5, 4, 3).astype("f4")),
+        "lstm_parameters": mx.nd.array(
+            (np.random.RandomState(1).randn(d["lstm_parameters"][0])
+             * 0.1).astype("f4"))},
+        args_grad={"lstm_parameters": mx.nd.zeros(d["lstm_parameters"])},
+        grad_req={"data": "null", "lstm_parameters": "write"})
+    y = exe.forward(is_train=True)[0]
+    assert y.shape == (5, 4, 8)
+    exe.backward()
+    g = exe.grad_dict["lstm_parameters"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
