@@ -10,5 +10,7 @@ from . import symbol
 from . import symbol as sym
 from . import quantization
 from . import onnx
+from . import amp
 
-__all__ = ["ndarray", "nd", "symbol", "sym", "quantization", "onnx"]
+__all__ = ["ndarray", "nd", "symbol", "sym", "quantization", "onnx",
+           "amp"]

@@ -1,0 +1,154 @@
+"""Automatic mixed precision (ref: python/mxnet/contrib/amp/).
+
+TPU-native AMP differs from the reference's fp16 recipe in one decisive
+way: the half type here is **bfloat16**, which keeps fp32's exponent
+range — so gradients cannot underflow the way fp16 gradients do, and
+loss scaling is a NO-OP by default (scale=1).  What remains of the
+reference surface:
+
+- ``init()`` — select the target dtype (bfloat16) for subsequent
+  conversions; kept for script compatibility.
+- ``convert_hybrid_block(block)`` / ``convert_model(sym, arg, aux)`` —
+  cast parameters to the half type while keeping normalization-layer
+  params and aux stats in fp32 (the reference's FP32 "blacklist" role:
+  BN/LN statistics must accumulate in full precision).
+- ``scale_loss(loss, trainer)`` + ``init_trainer`` / ``unscale`` — the
+  dynamic loss-scaler protocol, functional for users who explicitly ask
+  for fp16-style scaling (overflow check via ``multi_all_finite``,
+  growth/backoff schedule), defaulting to the bf16 no-op.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Optional
+
+from ..base import MXNetError
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_model", "convert_hybrid_block", "LossScaler"]
+
+_FP32_PARAM_HINTS = ("gamma", "beta", "mean", "var", "moving", "running")
+
+_TARGET = {"dtype": None}
+
+
+def init(target_dtype: str = "bfloat16"):
+    """Select the AMP half type (ref: amp.init).  float16 requests map
+    to bfloat16 — the TPU-native half type."""
+    if target_dtype in ("float16", "fp16"):
+        target_dtype = "bfloat16"
+    if target_dtype not in ("bfloat16",):
+        raise MXNetError(f"amp.init: unsupported target {target_dtype!r} "
+                         "(bfloat16 is the TPU half type)")
+    _TARGET["dtype"] = target_dtype
+
+
+def _keep_fp32(name: str) -> bool:
+    return any(h in name for h in _FP32_PARAM_HINTS)
+
+
+def convert_hybrid_block(block, target_dtype: str = None):
+    """Cast a Block's parameters to the half type in place, keeping
+    normalization params/statistics fp32 (ref: amp.convert_hybrid_block).
+    Returns the block."""
+    dt = target_dtype or _TARGET["dtype"] or "bfloat16"
+    if dt in ("float16", "fp16"):
+        dt = "bfloat16"
+    for name, p in block.collect_params().items():
+        if _keep_fp32(name):
+            continue
+        p.cast(dt)
+    return block
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype: str = None):
+    """Cast a symbolic model's arg params to the half type (aux stats and
+    normalization params stay fp32) — ref: amp.convert_model.
+    Returns (sym, arg_params, aux_params)."""
+    dt = target_dtype or _TARGET["dtype"] or "bfloat16"
+    if dt in ("float16", "fp16"):
+        dt = "bfloat16"
+    new_args = {k: (v if _keep_fp32(k) else v.astype(dt))
+                for k, v in arg_params.items()}
+    return sym, new_args, dict(aux_params)
+
+
+class LossScaler:
+    """Dynamic loss scaler (ref: amp/loss_scaler.py).  On bf16 the safe
+    default is scale=1 (no underflow risk); the growth/backoff schedule
+    is only active when constructed with an explicit init_scale > 1."""
+
+    def __init__(self, init_scale: float = 1.0, scale_factor: float = 2.0,
+                 scale_window: int = 2000):
+        self.loss_scale = float(init_scale)
+        self._factor = scale_factor
+        self._window = scale_window
+        self._unskipped = 0
+        # scale=1 (the bf16 default) means DISABLED: the growth schedule
+        # must never self-activate out of the documented no-op state
+        self._dynamic = self.loss_scale > 1.0
+
+    def has_overflow(self, params) -> bool:
+        """True if any gradient is non-finite (multi_all_finite probe)."""
+        from .. import nd
+
+        grads = [p.grad() for p in params if p.grad_req != "null"]
+        if not grads:
+            return False
+        ok = nd.multi_all_finite(*grads, num_arrays=len(grads))
+        return float(ok.asnumpy()[0]) == 0.0
+
+    def update_scale(self, overflow: bool):
+        if not self._dynamic:
+            return
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._window:
+                self.loss_scale *= self._factor
+                self._unskipped = 0
+
+
+def init_trainer(trainer, init_scale: float = 1.0):
+    """Attach a LossScaler to a Trainer (ref: amp.init_trainer)."""
+    trainer._amp_loss_scaler = LossScaler(init_scale=init_scale)
+
+
+@contextmanager
+def scale_loss(loss, trainer):
+    """Scale the loss before backward (ref: amp.scale_loss):
+
+        with amp.scale_loss(loss, trainer) as scaled:
+            scaled.backward()
+        amp.unscale(trainer)          # before trainer.step
+    """
+    scaler: Optional[LossScaler] = getattr(trainer, "_amp_loss_scaler",
+                                           None)
+    if scaler is None or scaler.loss_scale == 1.0:
+        yield loss
+        return
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(trainer):
+    """Divide accumulated gradients by the loss scale and advance the
+    dynamic schedule; skips the division entirely at scale=1 (bf16)."""
+    scaler: Optional[LossScaler] = getattr(trainer, "_amp_loss_scaler",
+                                           None)
+    if scaler is None:
+        return
+    params = [p for p in trainer._params]
+    overflow = scaler.has_overflow(params) if scaler.loss_scale != 1.0 \
+        else False
+    if scaler.loss_scale != 1.0:
+        inv = 1.0 / scaler.loss_scale
+        for p in params:
+            if p.grad_req != "null":
+                g = p.grad()
+                g._data = g._data * inv
+    scaler.update_scale(overflow)

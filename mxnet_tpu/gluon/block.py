@@ -643,21 +643,145 @@ class HybridBlock(Block):
         return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
 
 
+def _eval_symbol_eager(outputs, feed):
+    """Evaluate a Symbol DAG node-by-node on eager NDArrays through the
+    generated frontends — so autograd tapes it, Dropout gets its key, and
+    BatchNorm updates its aux stats in place, exactly like hand-written
+    imperative code (ref role: CachedOp over an imported graph)."""
+    from .. import autograd as _ag
+    from .. import random as _rnd
+    from ..ndarray.register import _SPECIAL, lookup
+    from ..symbol.symbol import KEYED_OPS, TRAIN_AWARE_OPS
+
+    env = {}
+    for node in outputs._topo():
+        if node.op is None:
+            if node.name not in feed:
+                raise MXNetError(
+                    f"SymbolBlock: free variable {node.name!r} is neither "
+                    f"an input nor a loaded parameter")
+            env[(id(node), 0)] = feed[node.name]
+            continue
+        ins = [env[(id(i), ix)] for (i, ix) in node.inputs]
+        attrs = {k: v for k, v in node.attrs.items()
+                 if not k.startswith("__") and k != "name"}
+        if node.op not in _SPECIAL:
+            # ops without a dedicated frontend (e.g. RNN) still need
+            # their train flag / PRNG key threaded, like the executor
+            if node.op in TRAIN_AWARE_OPS:
+                attrs["_train"] = _ag.is_training()
+            if node.op in KEYED_OPS:
+                # as an NDArray so invoke routes it to the key INPUT
+                # slot (a raw jax array would be frozen as an attr)
+                from ..ndarray import NDArray as _ND
+
+                attrs["key"] = _ND(_rnd.next_key())
+        out = lookup(node.op)(*ins, **attrs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for i, o in enumerate(outs):
+            env[(id(node), i)] = o
+    res = [env[(id(n), i)] for (n, i) in outputs._heads]
+    return res[0] if len(res) == 1 else res
+
+
 class SymbolBlock(HybridBlock):
-    """Construct a block from a symbol graph (ref: block.py::SymbolBlock).
-    Implemented over mxnet_tpu.symbol's traced graphs."""
+    """Construct a Block from a symbol graph (ref: block.py::SymbolBlock):
+    the arg/aux vars that are not inputs become gluon Parameters, and
+    forward evaluates the graph imperatively through the op frontends
+    (taped under autograd; aux stats update in place)."""
 
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix="", params=params)
-        self._outputs = outputs
-        self._inputs = inputs
+        if isinstance(outputs, (list, tuple)):
+            from ..symbol import Group
+
+            outputs = Group(list(outputs))
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self._sb_outputs = outputs
+        self._sb_inputs = [i if isinstance(i, str) else i.name
+                           for i in inputs]
+        in_set = set(self._sb_inputs)
+        self._sb_args = [n for n in outputs.list_arguments()
+                         if n not in in_set]
+        self._sb_aux = list(outputs.list_auxiliary_states())
+        with self.name_scope():
+            for n in self._sb_args:
+                self._reg_params[n] = self.params.get(
+                    n, allow_deferred_init=True)
+            for n in self._sb_aux:
+                self._reg_params[n] = self.params.get(
+                    n, grad_req="null", allow_deferred_init=True)
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
-        raise MXNetError("SymbolBlock.imports: importing serialized symbol "
-                         "graphs is not yet supported in the TPU build")
+        """Load `prefix-symbol.json` (+ `.params`) into a ready Block
+        (ref: SymbolBlock.imports)."""
+        from .. import symbol as sym_mod
+        from ..serialization import load_ndarrays
 
-    def hybrid_forward(self, F, x, *args, **params):
-        from ..symbol.symbol import evaluate
+        sym = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        block = SymbolBlock(sym, list(input_names))
+        if param_file:
+            raw = load_ndarrays(param_file)
+            if not isinstance(raw, dict):
+                raise MXNetError("SymbolBlock.imports: params file must "
+                                 "hold a named dict")
+            # accept both checkpoint-style arg:/aux: tags and plain names
+            loaded = {(k.split(":", 1)[1] if ":" in k else k): v
+                      for k, v in raw.items()}
+            for name, p in block._collect_params_with_prefix().items():
+                if name not in loaded:
+                    raise MXNetError(
+                        f"SymbolBlock.imports: parameter {name!r} not "
+                        f"found in {param_file}")
+                v = loaded[name]
+                p.shape = tuple(v.shape)
+                p.initialize(ctx=ctx)
+                p.set_data(v if ctx is None else v.as_in_context(ctx))
+        return block
 
-        return evaluate(self._outputs, self._inputs, (x,) + args, params, F)
+    def _infer_param_shapes(self, *args):
+        # deferred init: resolve every parameter shape from the graph
+        shape_kwargs = {n: tuple(a.shape)
+                        for n, a in zip(self._sb_inputs, args)}
+        arg_shapes, _, aux_shapes = \
+            self._sb_outputs.infer_shape_partial(**shape_kwargs)
+        by_name = dict(zip(self._sb_outputs.list_arguments(), arg_shapes))
+        by_name.update(zip(self._sb_outputs.list_auxiliary_states(),
+                           aux_shapes))
+        for name, p in self._collect_params_with_prefix().items():
+            shp = by_name.get(name)
+            if p.shape in (None, ()) or any(s == 0 for s in (p.shape or ())):
+                if shp is None or any(s in (None, 0) for s in shp):
+                    raise MXNetError(
+                        f"SymbolBlock: cannot infer shape of parameter "
+                        f"{name!r} from input shapes {shape_kwargs}")
+                p.shape = tuple(shp)
+
+    def hybridize(self, active=True, **kwargs):
+        # no-op: the graph is already compiled; stays silent so a parent
+        # network's cascaded hybridize() (reference workflow: imported
+        # feature extractor inside a HybridSequential) keeps working
+        if active:
+            import warnings
+
+            warnings.warn("SymbolBlock is already a graph; hybridize() "
+                          "has no effect", stacklevel=2)
+
+    def forward(self, *args):
+        self._ensure_init(*args)
+        feed = dict(zip(self._sb_inputs, args))
+        for name, p in self._collect_params_with_prefix().items():
+            feed[name] = p.data(ctx=args[0].ctx if args else None)
+        return _eval_symbol_eager(self._sb_outputs, feed)
+
+    def _ensure_init(self, *args):
+        params = self._collect_params_with_prefix()
+        if any(p._data is None for p in params.values()):
+            self._infer_param_shapes(*args)
+            for p in params.values():
+                if p._data is None:
+                    p._finish_deferred_init()

@@ -284,15 +284,26 @@ def invoke(op_name: str, *inputs, **attrs):
     nd_kw = {k: v for k, v in attrs.items() if isinstance(v, NDArray)}
     if nd_kw and getattr(op, "param_order", None):
         order = op.param_order
-        last = max(order.index(k) for k in nd_kw)
-        extra = []
-        for name in order[len(inputs):last + 1]:
-            if name in nd_kw:
-                attrs.pop(name)
-                extra.append(nd_kw[name])
-            else:  # gap: fill with the declared default (e.g. state=None)
-                extra.append(attrs.pop(name, op.param_default.get(name)))
-        inputs = tuple(inputs) + tuple(extra)
+        unknown = [k for k in nd_kw if k not in order]
+        if unknown:
+            if op.allow_any_attr:
+                nd_kw = {k: v for k, v in nd_kw.items() if k in order}
+            else:
+                raise MXNetError(
+                    f"operator {op.name!r} has no input or attribute "
+                    f"{unknown[0]!r}; array inputs: {op.input_names}, "
+                    f"attributes: {sorted(op.attr_defaults)}")
+        if nd_kw:
+            last = max(order.index(k) for k in nd_kw)
+            extra = []
+            for name in order[len(inputs):last + 1]:
+                if name in nd_kw:
+                    attrs.pop(name)
+                    extra.append(nd_kw[name])
+                else:  # gap: fill the declared default (e.g. state=None)
+                    extra.append(attrs.pop(name,
+                                           op.param_default.get(name)))
+            inputs = tuple(inputs) + tuple(extra)
     arrays = []
     ctx = None
     for x in inputs:

@@ -133,6 +133,8 @@ def _rnn_shapes(ins, attrs):
         return {}
     from ..ops.rnn import rnn_param_size
 
+    if not attrs.get("state_size"):
+        raise MXNetError("RNN requires a positive state_size attribute")
     return {"parameters": (rnn_param_size(
         attrs.get("mode", "lstm"), d[2], attrs["state_size"],
         attrs.get("num_layers", 1), attrs.get("bidirectional", False)),)}

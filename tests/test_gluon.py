@@ -289,3 +289,154 @@ def test_model_zoo_smoke():
         net.initialize()
         out = net(nd.random.uniform(shape=(1, 3, 64, 64)))
         assert out.shape == (1, 4), name
+
+
+# ---------------------------------------------------------------------------
+# SymbolBlock (ref: gluon/block.py::SymbolBlock + imports)
+# ---------------------------------------------------------------------------
+
+def _sb_symbol():
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    return mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+
+
+def test_symbol_block_forward_and_grad():
+    from mxnet_tpu.gluon import SymbolBlock
+
+    sym = _sb_symbol()
+    blk = SymbolBlock(sym, [mx.sym.var("data")])
+    blk.initialize(mx.initializer.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 5).astype("f4"))
+    out = blk(x)
+    assert out.shape == (4, 3)
+    # autograd tapes the imperative evaluation
+    with mx.autograd.record():
+        loss = (blk(x) ** 2).sum()
+    loss.backward()
+    g = blk.params.get("fc1_weight").grad().asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+    # trainable end to end
+    from mxnet_tpu.gluon import Trainer
+
+    trainer = Trainer(blk.collect_params(), "sgd",
+                      {"learning_rate": 0.1})
+    before = float((blk(x) ** 2).sum().asnumpy())
+    for _ in range(5):
+        with mx.autograd.record():
+            loss = (blk(x) ** 2).sum()
+        loss.backward()
+        trainer.step(4)
+    after = float((blk(x) ** 2).sum().asnumpy())
+    assert after < before
+
+
+def test_symbol_block_imports_roundtrip(tmp_path):
+    from mxnet_tpu.gluon import SymbolBlock
+
+    sym = _sb_symbol()
+    # materialize params by binding once
+    rng = np.random.RandomState(1)
+    shapes, _, _ = sym.infer_shape(data=(2, 5))
+    args = {n: mx.nd.array(rng.randn(*s).astype("f4") * 0.2)
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n != "data"}
+    sym.save(str(tmp_path / "net-symbol.json"))
+    mx.nd.save(str(tmp_path / "net.params"),
+               {f"arg:{k}": v for k, v in args.items()})
+    blk = SymbolBlock.imports(str(tmp_path / "net-symbol.json"), "data",
+                              str(tmp_path / "net.params"))
+    x = mx.nd.array(rng.randn(2, 5).astype("f4"))
+    out = blk(x)
+    # matches the raw executor on the same weights
+    exe = sym.bind(mx.cpu(), dict(args, data=x), grad_req="null")
+    np.testing.assert_allclose(out.asnumpy(),
+                               exe.forward()[0].asnumpy(), rtol=1e-5)
+    with pytest.warns(UserWarning, match="no effect"):
+        blk.hybridize()  # cascaded hybridize must not crash parents
+
+
+def test_amp_convert_and_loss_scaler():
+    """contrib.amp: bf16 conversion keeps norm params fp32; the dynamic
+    loss scaler scales/unscales and backs off on overflow."""
+    from mxnet_tpu.contrib import amp
+    from mxnet_tpu.gluon import Trainer, nn
+
+    amp.init("float16")  # maps to bfloat16, the TPU half type
+    net = nn.Sequential()
+    net.add(nn.Dense(8, in_units=4))
+    net.add(nn.BatchNorm(in_channels=8))
+    net.initialize()
+    amp.convert_hybrid_block(net)
+    assert "bfloat16" in str(net[0].weight.data().dtype)
+    assert net[1].gamma.data().dtype == np.float32  # norm stays fp32
+    assert net[1].running_mean.data().dtype == np.float32
+
+    # scaler protocol on an fp32 net (explicit fp16-style scaling)
+    net2 = nn.Sequential()
+    net2.add(nn.Dense(2, in_units=3))
+    net2.initialize()
+    trainer = Trainer(net2.collect_params(), "sgd",
+                      {"learning_rate": 0.0})
+    amp.init_trainer(trainer, init_scale=128.0)
+    x = mx.nd.array(np.ones((2, 3), np.float32))
+    with mx.autograd.record():
+        loss = (net2(x) ** 2).sum()
+        with amp.scale_loss(loss, trainer) as scaled:
+            pass
+    scaled.backward()
+    g_scaled = net2[0].weight.grad().asnumpy().copy()
+    amp.unscale(trainer)
+    g = net2[0].weight.grad().asnumpy()
+    np.testing.assert_allclose(g, g_scaled / 128.0, rtol=1e-6)
+    assert trainer._amp_loss_scaler.loss_scale == 128.0
+
+    # overflow backs the scale off
+    net2[0].weight.grad()[:] = np.inf
+    amp.unscale(trainer)
+    assert trainer._amp_loss_scaler.loss_scale == 64.0
+
+
+def test_amp_convert_model_symbolic():
+    from mxnet_tpu.contrib import amp
+
+    sym = _sb_symbol()
+    rng = np.random.RandomState(0)
+    shapes, _, _ = sym.infer_shape(data=(2, 5))
+    args = {n: mx.nd.array(rng.randn(*s).astype("f4"))
+            for n, s in zip(sym.list_arguments(), shapes) if n != "data"}
+    _, qargs, _ = amp.convert_model(sym, args, {})
+    assert all("bfloat16" in str(v.dtype) for v in qargs.values())
+
+
+def test_symbol_block_rnn_dropout_live_and_scaler_stays_noop():
+    from mxnet_tpu.gluon import SymbolBlock
+
+    data = mx.sym.var("data")
+    out = mx.sym.RNN(data, state_size=6, num_layers=2, mode="lstm", p=0.9,
+                     state_outputs=False, name="l")
+    blk = SymbolBlock(out, [mx.sym.var("data")])
+    blk.initialize(mx.initializer.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 3, 2).astype("f4"))
+    with mx.autograd.record():  # train mode: dropout must fire
+        a = blk(x).asnumpy()
+    b = blk(x).asnumpy()  # eval mode: deterministic
+    c = blk(x).asnumpy()
+    assert not np.allclose(a, b)
+    np.testing.assert_allclose(b, c)
+
+    # bf16 default scaler must NEVER self-activate
+    from mxnet_tpu.contrib.amp import LossScaler
+
+    s = LossScaler()  # init_scale=1 -> disabled
+    for _ in range(3000):
+        s.update_scale(False)
+    assert s.loss_scale == 1.0
+
+
+def test_nd_kwarg_typo_is_loud():
+    x = mx.nd.zeros((2, 2, 3))
+    p = mx.nd.zeros((100,))
+    with pytest.raises(mx.MXNetError, match="no input or attribute"):
+        mx.nd.RNN(x, p, state_cel=mx.nd.zeros((1, 2, 4)), state_size=4)
