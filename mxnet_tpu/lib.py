@@ -322,9 +322,17 @@ class NativeEngine(_HandleGuard):
             self._cbs[key] = fn
         rv = (ctypes.c_int64 * len(read))(*read)
         wv = (ctypes.c_int64 * len(write))(*write)
-        check_call(self._lib.MXEnginePushAsync(
-            self._hh(), self._tramp, ctypes.c_void_p(key), rv, len(read),
-            wv, len(write), ctypes.c_int(priority)))
+        try:
+            check_call(self._lib.MXEnginePushAsync(
+                self._hh(), self._tramp, ctypes.c_void_p(key), rv,
+                len(read), wv, len(write), ctypes.c_int(priority)))
+        except BaseException:
+            # rejected push (duplicate-var check, dead handle): the
+            # trampoline will never pop the stash — do it here or the
+            # callable (and its closure) leaks on every retry
+            with self._cb_lock:
+                self._cbs.pop(key, None)
+            raise
 
     def wait_for_var(self, var: int) -> None:
         check_call(self._lib.MXEngineWaitForVar(self._hh(),

@@ -100,6 +100,22 @@ void Engine::DecWait(Opr* opr) {
 void Engine::PushAsyncVars(EngineFn fn, void* arg, std::vector<Var*> reads,
                            std::vector<Var*> writes, int priority,
                            bool delete_writes) {
+  // the reference contract (threaded_engine.cc CheckDuplicate): an op's
+  // read and write sets must be disjoint and duplicate-free — a var in
+  // both would deadlock the op against itself, silently
+  for (size_t i = 0; i < writes.size(); ++i) {
+    for (size_t j = i + 1; j < writes.size(); ++j)
+      MXT_CHECK_MSG(writes[i] != writes[j],
+                    "engine: duplicate variable in write set");
+    for (Var* r : reads)
+      MXT_CHECK_MSG(writes[i] != r,
+                    "engine: variable appears in BOTH read and write "
+                    "sets of one op (would deadlock)");
+  }
+  for (size_t i = 0; i < reads.size(); ++i)
+    for (size_t j = i + 1; j < reads.size(); ++j)
+      MXT_CHECK_MSG(reads[i] != reads[j],
+                    "engine: duplicate variable in read set");
   Opr* opr = new Opr();
   opr->fn = fn;
   opr->arg = arg;
