@@ -431,10 +431,18 @@ class Block:
 
 class CachedOp:
     def __init__(self, block: "HybridBlock", static_alloc=False,
-                 static_shape=False):
+                 static_shape=False, mirror=None):
         self.block = block
         # static_alloc/static_shape are accepted for API parity; XLA's
         # compiled programs are statically planned by construction.
+        # mirror: gradient mirroring (ref: MXNET_BACKWARD_DO_MIRROR /
+        # GraphExecutor recompute-to-save-memory) — on TPU this is
+        # jax.checkpoint: the backward recomputes activations instead of
+        # keeping them in HBM, trading MXU FLOPs for memory
+        from ..base import get_env
+
+        self.mirror = (get_env("MXNET_BACKWARD_DO_MIRROR", False, bool)
+                       if mirror is None else bool(mirror))
         self._pure: Dict[bool, Callable] = {}
         self._fwd: Dict[bool, Callable] = {}
         self._vjp: Dict[bool, Callable] = {}
@@ -479,6 +487,8 @@ class CachedOp:
                     flat, _aux = pure(pv, iv, key)
                     return flat
 
+                if self.mirror:
+                    f = jax.checkpoint(f)
                 _, vjp = jax.vjp(f, tuple(pvals), tuple(ivals))
                 pg, ig = vjp(tuple(cts))
                 return tuple(pg), tuple(ig)
@@ -607,7 +617,7 @@ class HybridBlock(Block):
             if self._cached_op is None:
                 self._cached_op = CachedOp(self, **{
                     k: v for k, v in self._flags.items()
-                    if k in ("static_alloc", "static_shape")})
+                    if k in ("static_alloc", "static_shape", "mirror")})
             return self._cached_op(x, *args)
 
         ctx = x.ctx

@@ -316,12 +316,14 @@ class NativeEngine(_HandleGuard):
                                                     ctypes.c_int64(var)))
 
     def push(self, fn, read=(), write=(), priority: int = 0) -> None:
+        # convert BEFORE stashing: a bad var id must not leak the
+        # callback into _cbs
+        rv = (ctypes.c_int64 * len(read))(*read)
+        wv = (ctypes.c_int64 * len(write))(*write)
         with self._cb_lock:
             key = self._next_id
             self._next_id += 1
             self._cbs[key] = fn
-        rv = (ctypes.c_int64 * len(read))(*read)
-        wv = (ctypes.c_int64 * len(write))(*write)
         try:
             check_call(self._lib.MXEnginePushAsync(
                 self._hh(), self._tramp, ctypes.c_void_p(key), rv,

@@ -17,6 +17,7 @@
 #include <atomic>
 #include <cassert>
 #include <chrono>
+#include <condition_variable>
 #include <functional>
 #include <cstdint>
 #include <cstdio>
@@ -145,17 +146,29 @@ static void test_wait_for_var_is_selective() {
   Engine eng(4);
   auto a = eng.NewVariable();
   auto b = eng.NewVariable();
+  // the op on b blocks on a latch the MAIN thread releases AFTER the
+  // selectivity assertion — no wall-clock race: if WaitForVar(a) also
+  // waited for b, this test would deadlock (and time out) rather than
+  // pass or flake
+  std::mutex latch_m;
+  std::condition_variable latch_cv;
+  bool release = false;
   std::atomic<bool> slow_done{false};
   Push(eng, [&] {
-    std::this_thread::sleep_for(std::chrono::milliseconds(300));
+    std::unique_lock<std::mutex> lk(latch_m);
+    latch_cv.wait(lk, [&] { return release; });
     slow_done = true;
   }, {}, {b}, 0);
   std::atomic<bool> fast_done{false};
   Push(eng, [&] { fast_done = true; }, {}, {a}, 0);
   eng.WaitForVar(a);
   assert(fast_done.load());
-  // the slow op on b must NOT have been waited for
-  assert(!slow_done.load());
+  assert(!slow_done.load());  // b's op is still parked on the latch
+  {
+    std::lock_guard<std::mutex> lk(latch_m);
+    release = true;
+  }
+  latch_cv.notify_all();
   eng.WaitForAll();
   assert(slow_done.load());
   std::printf("  WaitForVar selectivity: ok\n");

@@ -440,3 +440,44 @@ def test_nd_kwarg_typo_is_loud():
     p = mx.nd.zeros((100,))
     with pytest.raises(mx.MXNetError, match="no input or attribute"):
         mx.nd.RNN(x, p, state_cel=mx.nd.zeros((1, 2, 4)), state_size=4)
+
+
+def test_gradient_mirroring_remat():
+    """hybridize(mirror=True) (ref: MXNET_BACKWARD_DO_MIRROR) wraps the
+    backward in jax.checkpoint — identical gradients, recomputed
+    activations."""
+    import os
+
+    from mxnet_tpu.gluon import nn
+
+    def build(mirror):
+        np.random.seed(0)
+        net = nn.Sequential()
+        net.add(nn.Dense(16, activation="relu", in_units=8))
+        net.add(nn.Dense(4, in_units=16))
+        net.initialize(mx.initializer.Xavier())
+        net.hybridize(mirror=mirror)
+        return net
+
+    x = mx.nd.array(np.random.RandomState(1).randn(4, 8).astype("f4"))
+    grads = []
+    for mirror in (False, True):
+        net = build(mirror)
+        with mx.autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        grads.append(net[0].weight.grad().asnumpy())
+    np.testing.assert_allclose(grads[0], grads[1], rtol=1e-5)
+
+    # env-var route
+    os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
+    try:
+        net = build(None)
+        with mx.autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        assert net[0]._cached_op.mirror  # children hold the CachedOps
+        np.testing.assert_allclose(net[0].weight.grad().asnumpy(),
+                                   grads[0], rtol=1e-5)
+    finally:
+        del os.environ["MXNET_BACKWARD_DO_MIRROR"]
