@@ -462,6 +462,7 @@ class CachedOp:
         def fn(pvals: Tuple, ivals: Tuple, key):
             trace = ActiveTrace(
                 {id(p): v for (_, p), v in zip(plist, pvals)}, train)
+            trace.mirror = self.mirror  # per-sub-block remat segments
             with trace, rnd.key_provider(rnd.KeyProvider(key)):
                 outs = block.forward(*ivals)
             flat, treedef = jax.tree_util.tree_flatten(outs)
@@ -487,8 +488,6 @@ class CachedOp:
                     flat, _aux = pure(pv, iv, key)
                     return flat
 
-                if self.mirror:
-                    f = jax.checkpoint(f)
                 _, vjp = jax.vjp(f, tuple(pvals), tuple(ivals))
                 pg, ig = vjp(tuple(cts))
                 return tuple(pg), tuple(ig)
@@ -611,6 +610,18 @@ class HybridBlock(Block):
                     params[name] = ts.value_of(p)
                 else:
                     params[name] = p.data().data
+            if (ts is not None and getattr(ts, "mirror", False)
+                    and all(hasattr(a, "dtype") for a in args)):
+                # gradient mirroring: each sub-block is a remat SEGMENT —
+                # the backward recomputes this block's activations from
+                # its inputs instead of keeping them live across the
+                # whole program (a whole-function checkpoint would save
+                # nothing; segment boundaries are what shrink liveness).
+                # Blocks with non-array extra args are left unwrapped.
+                def seg(xx, pp, *targs):
+                    return self.hybrid_forward(F_PURE, xx, *targs, **pp)
+
+                return jax.checkpoint(seg)(x, params, *args)
             return self.hybrid_forward(F_PURE, x, *args, **params)
 
         if self._active:

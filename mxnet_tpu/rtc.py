@@ -1,0 +1,29 @@
+"""mx.rtc — CUDA runtime compilation (ref: python/mxnet/rtc.py).
+
+There is no NVRTC on TPU, and nothing to replace it with: pointwise
+fusion — the reason rtc exists in the reference — happens automatically
+in XLA (SURVEY.md N18, "free on TPU").  Custom kernels belong in Pallas
+(see ops/pallas_attention.py for the in-repo example).  The API is kept
+so reference code importing mx.rtc fails at USE with a clear message,
+not at import.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["CudaModule", "CudaKernel"]
+
+_MSG = ("mx.rtc compiles CUDA source at runtime; on TPU pointwise fusion "
+        "is performed by XLA automatically and custom kernels are "
+        "written in Pallas (jax.experimental.pallas) — see "
+        "mxnet_tpu/ops/pallas_attention.py for the pattern")
+
+
+class CudaModule:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)
+
+
+class CudaKernel:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)

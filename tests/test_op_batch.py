@@ -343,3 +343,71 @@ def test_symbol_optional_gap_is_loud():
     cell = mx.sym.var("c0")
     with pytest.raises(mx.MXNetError, match="omitted"):
         mx.sym.RNN(data, state_cell=cell, state_size=4, mode="lstm")
+
+
+@pytest.mark.parametrize("op_case", [
+    "upsampling_nearest", "upsampling_bilinear", "bilinear_sampler",
+    "grid_generator", "im2col", "col2im", "correlation", "hard_sigmoid",
+    "hard_swish", "mish", "trace", "digamma", "softmax_activation",
+])
+def test_new_op_numeric_gradients(op_case):
+    """Finite-difference gradient checks for the round's differentiable
+    op additions (the reference test_operator.py discipline)."""
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    import zlib
+
+    rng = np.random.RandomState(zlib.crc32(op_case.encode()))
+
+    def data(*shape):
+        return nd.array(rng.randn(*shape).astype(np.float32) * 0.5)
+
+    if op_case == "upsampling_nearest":
+        check_numeric_gradient(
+            lambda x: nd.UpSampling(x, scale=2, sample_type="nearest"),
+            [data(1, 2, 3, 3)])
+    elif op_case == "upsampling_bilinear":
+        check_numeric_gradient(
+            lambda x: nd.UpSampling(x, scale=2, sample_type="bilinear"),
+            [data(1, 2, 3, 3)])
+    elif op_case == "bilinear_sampler":
+        grid = nd.array((rng.rand(1, 2, 4, 4) * 1.2 - 0.6)
+                        .astype(np.float32))
+        check_numeric_gradient(
+            lambda x: nd.BilinearSampler(x, grid), [data(1, 2, 5, 5)])
+    elif op_case == "grid_generator":
+        check_numeric_gradient(
+            lambda t: nd.GridGenerator(t, transform_type="affine",
+                                       target_shape=(3, 3)),
+            [data(2, 6)])
+    elif op_case == "im2col":
+        check_numeric_gradient(
+            lambda x: nd.im2col(x, kernel=(2, 2), stride=(1, 1)),
+            [data(1, 2, 4, 4)])
+    elif op_case == "col2im":
+        check_numeric_gradient(
+            lambda c: nd.col2im(c, output_size=(4, 4), kernel=(2, 2),
+                                stride=(2, 2)),
+            [data(1, 8, 4)])
+    elif op_case == "correlation":
+        a, b = data(1, 2, 4, 4), data(1, 2, 4, 4)
+        check_numeric_gradient(
+            lambda x, y: nd.Correlation(x, y, max_displacement=1,
+                                        pad_size=1), [a, b])
+    elif op_case == "hard_sigmoid":
+        check_numeric_gradient(lambda x: nd.hard_sigmoid(x + 3.0),
+                               [data(3, 4)])
+    elif op_case == "hard_swish":
+        check_numeric_gradient(lambda x: nd.hard_swish(x + 8.0),
+                               [data(3, 4)])
+    elif op_case == "mish":
+        check_numeric_gradient(lambda x: nd.mish(x), [data(3, 4)])
+    elif op_case == "trace":
+        check_numeric_gradient(lambda x: nd.trace(x), [data(4, 4)])
+    elif op_case == "digamma":
+        check_numeric_gradient(lambda x: nd.digamma(x + 3.0),
+                               [data(3, 3)])
+    elif op_case == "softmax_activation":
+        check_numeric_gradient(
+            lambda x: nd.SoftmaxActivation(x, mode="channel"),
+            [data(2, 3, 2, 2)])

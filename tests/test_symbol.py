@@ -357,3 +357,39 @@ def test_symbolic_rnn_auto_params_and_grad():
     exe.backward()
     g = exe.grad_dict["lstm_parameters"].asnumpy()
     assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_infer_shape_more_ops():
+    """Shape inference across the auto-param schemas (the reference's
+    test_infer_shape.py tier)."""
+    d = mx.sym.var("data")
+    # Deconvolution: weight is (in, out/g, k, k)
+    dc = mx.sym.Deconvolution(d, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                              num_filter=8, name="up")
+    args, outs, _ = dc.infer_shape(data=(2, 16, 7, 7))
+    byname = dict(zip(dc.list_arguments(), args))
+    assert byname["up_weight"] == (16, 8, 4, 4)
+    assert outs[0] == (2, 8, 14, 14)
+    # BatchNorm: aux shapes follow channel axis
+    bn = mx.sym.BatchNorm(d, name="bn")
+    args, outs, aux = bn.infer_shape(data=(4, 6, 5, 5))
+    assert dict(zip(bn.list_auxiliary_states(), aux)) == {
+        "bn_moving_mean": (6,), "bn_moving_var": (6,)}
+    # Pooling 'full' convention: ceil-mode output size
+    p = mx.sym.Pooling(d, kernel=(3, 3), stride=(2, 2), pool_type="max",
+                       pooling_convention="full")
+    _, outs, _ = p.infer_shape(data=(1, 2, 8, 8))
+    assert outs[0] == (1, 2, 4, 4)  # ceil((8-3)/2)+1
+    # grouped conv divides input channels
+    gc = mx.sym.Convolution(d, kernel=(3, 3), num_filter=8, num_group=2,
+                            name="gconv")
+    args, _, _ = gc.infer_shape(data=(1, 4, 8, 8))
+    assert dict(zip(gc.list_arguments(), args))["gconv_weight"] == \
+        (8, 2, 3, 3)
+
+
+def test_rtc_stub_raises_at_use_not_import():
+    import mxnet_tpu.rtc as rtc
+
+    with pytest.raises(mx.MXNetError, match="Pallas"):
+        rtc.CudaModule("__global__ void k() {}")
