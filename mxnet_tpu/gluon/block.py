@@ -611,17 +611,35 @@ class HybridBlock(Block):
                 else:
                     params[name] = p.data().data
             if (ts is not None and getattr(ts, "mirror", False)
+                    and self._reg_params
                     and all(hasattr(a, "dtype") for a in args)):
-                # gradient mirroring: each sub-block is a remat SEGMENT —
-                # the backward recomputes this block's activations from
-                # its inputs instead of keeping them live across the
-                # whole program (a whole-function checkpoint would save
-                # nothing; segment boundaries are what shrink liveness).
-                # Blocks with non-array extra args are left unwrapped.
-                def seg(xx, pp, *targs):
-                    return self.hybrid_forward(F_PURE, xx, *targs, **pp)
+                # gradient mirroring: each PARAM-BEARING sub-block is a
+                # remat SEGMENT — the backward recomputes its activations
+                # from its inputs instead of keeping them live across the
+                # whole program.  Param-less containers are NOT wrapped
+                # (an outer whole-function checkpoint would only add a
+                # redundant full recompute), and blocks with non-array
+                # extra args are left unwrapped.  Aux updates (BatchNorm
+                # stats) made inside the segment are returned THROUGH the
+                # checkpoint boundary and replayed onto the outer trace —
+                # letting the inner tracers escape via the side channel
+                # would be an UnexpectedTracerError.
+                outer = ts
+                aux_params_cell = [()]
 
-                return jax.checkpoint(seg)(x, params, *args)
+                def seg(xx, pp, *targs):
+                    inner = ActiveTrace(outer.param_values, outer.train)
+                    inner.mirror = True
+                    with inner:
+                        out = self.hybrid_forward(F_PURE, xx, *targs,
+                                                  **pp)
+                    aux_params_cell[0] = tuple(inner.aux_params)
+                    return out, tuple(inner.aux_values)
+
+                out, aux_vals = jax.checkpoint(seg)(x, params, *args)
+                for p, v in zip(aux_params_cell[0], aux_vals):
+                    ts.add_aux_update(p, v)
+                return out
             return self.hybrid_forward(F_PURE, x, *args, **params)
 
         if self._active:

@@ -252,7 +252,14 @@ class SPMDTrainer:
                  batch_spec: Optional[Sequence] = None,
                  label_spec: Optional[Sequence] = None,
                  n_labels: int = 1,
-                 donate: bool = True):
+                 donate: bool = True,
+                 remat: bool = False):
+        #: remat: gradient mirroring for the fused train step — each
+        #: sub-block becomes a jax.checkpoint segment, so the backward
+        #: recomputes its activations instead of holding them in HBM
+        #: across the whole fwd+bwd+update program
+        #: (ref: MXNET_BACKWARD_DO_MIRROR role)
+        self.remat = bool(remat)
         self.block = block
         self.loss = loss
         self.mesh = mesh or current_mesh() or make_mesh()
@@ -336,6 +343,7 @@ class SPMDTrainer:
             def loss_fn(pv):
                 trace = ActiveTrace(
                     {id(p): pv[n] for n, p in plist}, train=True)
+                trace.mirror = trainer.remat  # per-sub-block segments
                 with trace, rnd.key_provider(rnd.KeyProvider(key)):
                     out = block.forward(*inputs)
                     outs = out if isinstance(out, (list, tuple)) else (out,)

@@ -484,7 +484,28 @@ def test_gradient_mirroring_remat():
         lambda p, i, k: pure(p, i, k))(pv, (x.data,), jnp.zeros(
             (2,), jnp.uint32)))
     assert "remat" in jaxpr or "checkpoint" in jaxpr
-    return
+
+
+def test_gradient_mirroring_with_batchnorm_aux():
+    """mirror=True with BatchNorm: aux updates cross the checkpoint
+    boundary (returned, not leaked) and moving stats still advance."""
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4))
+    net.add(nn.BatchNorm(in_channels=8))
+    net.add(nn.Dense(2, in_units=8))
+    net.initialize(mx.initializer.Xavier())
+    net.hybridize(mirror=True)
+    x = mx.nd.array(np.random.RandomState(0).randn(16, 4)
+                    .astype("f4") * 2 + 1)
+    before = net[1].running_mean.data().asnumpy().copy()
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    after = net[1].running_mean.data().asnumpy()
+    assert not np.allclose(before, after)  # aux stats advanced
+    assert np.isfinite(net[0].weight.grad().asnumpy()).all()
 
 
 def test_gradient_mirroring_env_route(monkeypatch):
