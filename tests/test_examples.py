@@ -83,3 +83,10 @@ def test_rnn_bucketing_symbolic():
     # the synthetic alphabet task is very learnable
     ppl = float(out.rsplit("final perplexity=", 1)[1].splitlines()[0])
     assert ppl < 3.0, ppl
+
+
+def test_quantize_model_example():
+    out = _run(["examples/quantize_model.py", "--cpu", "--small",
+                "--calib-mode", "entropy"], timeout=560)
+    assert "int8 (entropy): accuracy=" in out
+    assert "accuracy drop:" in out
