@@ -19,10 +19,14 @@ from __future__ import annotations
 import functools
 from typing import Tuple
 
+import math
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+from ..base import MXNetError
 
 from .registry import register_op
 
@@ -498,3 +502,151 @@ def _ifft(data, compute_size=128):
     pairs = data.reshape(data.shape[:-1] + (d, 2))
     spec = pairs[..., 0] + 1j * pairs[..., 1]
     return (jnp.fft.ifft(spec, axis=-1).real * d).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# box codecs + region proposals (ref: src/operator/contrib/
+# bounding_box.cc box_encode/box_decode, proposal.cc MultiProposal /
+# Proposal — the Faster R-CNN RPN head)
+# ---------------------------------------------------------------------------
+
+@register_op("_contrib_box_encode", aliases=("box_encode",),
+             num_outputs=2, differentiable=False)
+def _box_encode(samples, matches, anchors, refs, means=None, stds=None):
+    """Encode matched ground-truth boxes against anchors as (dx, dy, dw,
+    dh) regression targets + a validity mask (ref: box_encode).
+    samples (B, N) in {-1, 0, 1}; matches (B, N) gt indices; anchors
+    (B, N, 4) corner; refs (B, N, 4)? -> refs are gt boxes (B, M, 4)."""
+    means = jnp.asarray(means if means is not None
+                        else (0.0, 0.0, 0.0, 0.0), jnp.float32)
+    stds = jnp.asarray(stds if stds is not None
+                       else (1.0, 1.0, 1.0, 1.0), jnp.float32)
+
+    def one(s, m, a, r):
+        gt = r[jnp.clip(m.astype(jnp.int32), 0, r.shape[0] - 1)]
+        ax, ay = (a[:, 0] + a[:, 2]) / 2, (a[:, 1] + a[:, 3]) / 2
+        aw, ah = a[:, 2] - a[:, 0], a[:, 3] - a[:, 1]
+        gx, gy = (gt[:, 0] + gt[:, 2]) / 2, (gt[:, 1] + gt[:, 3]) / 2
+        gw, gh = gt[:, 2] - gt[:, 0], gt[:, 3] - gt[:, 1]
+        t = jnp.stack([(gx - ax) / jnp.maximum(aw, 1e-12),
+                       (gy - ay) / jnp.maximum(ah, 1e-12),
+                       jnp.log(jnp.maximum(gw, 1e-12)
+                               / jnp.maximum(aw, 1e-12)),
+                       jnp.log(jnp.maximum(gh, 1e-12)
+                               / jnp.maximum(ah, 1e-12))], axis=1)
+        t = (t - means) / stds
+        valid = (s > 0.5)[:, None].astype(jnp.float32)
+        return t * valid, jnp.broadcast_to(valid, t.shape)
+
+    targets, masks = jax.vmap(one)(samples, matches, anchors, refs)
+    return targets, masks
+
+
+@register_op("_contrib_box_decode", aliases=("box_decode",),
+             differentiable=False)
+def _box_decode(data, anchors, std0=1.0, std1=1.0, std2=1.0, std3=1.0,
+                clip=-1.0, format="corner"):
+    """Invert box_encode: deltas (B, N, 4) + anchors (1|B, N, 4) ->
+    corner boxes (ref: box_decode)."""
+    a = _corner_to_center(anchors) if format == "corner" else anchors
+    ax, ay, aw, ah = a[..., 0], a[..., 1], a[..., 2], a[..., 3]
+    dx = data[..., 0] * std0
+    dy = data[..., 1] * std1
+    dw = data[..., 2] * std2
+    dh = data[..., 3] * std3
+    cx = dx * aw + ax
+    cy = dy * ah + ay
+    w = jnp.exp(dw) * aw
+    h = jnp.exp(dh) * ah
+    out = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                    axis=-1)
+    if clip is not None and clip > 0:
+        out = jnp.clip(out, 0.0, clip)
+    return out
+
+
+@register_op("_contrib_Proposal",
+             aliases=("Proposal", "_contrib_MultiProposal",
+                      "MultiProposal"), differentiable=False)
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+              feature_stride=16, output_score=False,
+              iou_loss=False):
+    """RPN proposal generation (ref: proposal.cc / multi_proposal.cc):
+    sliding anchors + predicted deltas -> decoded boxes -> pre-NMS topk
+    -> NMS -> fixed post-NMS rows.  Static-shape XLA design: the output
+    is always (B, rpn_post_nms_top_n, 4|5) with suppressed rows zeroed."""
+    if iou_loss:
+        raise MXNetError("Proposal: iou_loss=True (direct corner-offset "
+                         "decoding) is not implemented in this build")
+    B, A2, H, W = cls_prob.shape
+    A = A2 // 2
+    if A != len(tuple(scales)) * len(tuple(ratios)):
+        raise MXNetError(
+            f"Proposal: cls_prob has {A} anchors per cell but "
+            f"scales x ratios = {len(tuple(scales))} x "
+            f"{len(tuple(ratios))} = "
+            f"{len(tuple(scales)) * len(tuple(ratios))}")
+    # base anchors with the reference's GenerateAnchors math
+    # (proposal.cc): base box (0,0,bs-1,bs-1), integer-rounded ratio
+    # widths/heights, then scaled — pretrained-RPN parity requires the
+    # exact rounding and the (bs-1)/2 center
+    stride = float(feature_stride)
+    bs = stride
+    ctr = (bs - 1.0) / 2.0
+    base = []
+    for r in ratios:
+        ws0 = round(math.sqrt(bs * bs / r))
+        hs0 = round(ws0 * r)
+        for s in scales:
+            w = ws0 * s
+            h = hs0 * s
+            base.append((ctr - (w - 1) / 2.0, ctr - (h - 1) / 2.0,
+                         ctr + (w - 1) / 2.0, ctr + (h - 1) / 2.0))
+    base = jnp.asarray(base, jnp.float32)          # (A, 4)
+    xs = jnp.arange(W) * stride
+    ys = jnp.arange(H) * stride
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    shifts = jnp.stack([gx, gy, gx, gy], axis=-1)   # (H, W, 4)
+    anchors = (shifts[:, :, None, :] + base[None, None]) \
+        .reshape(-1, 4)                             # (H*W*A, 4)
+
+    scores = cls_prob[:, A:].reshape(B, A, H, W)    # fg scores
+    scores = scores.transpose(0, 2, 3, 1).reshape(B, -1)
+    deltas = bbox_pred.reshape(B, A, 4, H, W) \
+        .transpose(0, 3, 4, 1, 2).reshape(B, -1, 4)
+
+    def one(sc, dl, info):
+        boxes = _box_decode(dl[None], anchors[None])[0]
+        boxes = jnp.clip(boxes, 0.0,
+                         jnp.stack([info[1], info[0], info[1],
+                                    info[0]]) - 1.0)
+        # legacy +1 width convention (proposal.cc FilterBox)
+        ws = boxes[:, 2] - boxes[:, 0] + 1.0
+        hs = boxes[:, 3] - boxes[:, 1] + 1.0
+        min_size = rpn_min_size * info[2]
+        keep = (ws >= min_size) & (hs >= min_size)
+        sc = jnp.where(keep, sc, -jnp.inf)
+        k = min(rpn_pre_nms_top_n, sc.shape[0])
+        top_sc, top_i = jax.lax.top_k(sc, k)
+        top_boxes = boxes[top_i]
+        keep_idx = _greedy_nms_keep(top_boxes, top_sc,
+                                    jnp.zeros_like(top_sc), threshold,
+                                    True)
+        order = jnp.argsort(~keep_idx)              # kept rows first
+        kept_boxes = top_boxes[order][:rpn_post_nms_top_n]
+        kept_sc = jnp.where(keep_idx, top_sc, 0.0)[order][
+            :rpn_post_nms_top_n]
+        pad = max(0, rpn_post_nms_top_n - kept_boxes.shape[0])
+        if pad:
+            kept_boxes = jnp.pad(kept_boxes, ((0, pad), (0, 0)))
+            kept_sc = jnp.pad(kept_sc, (0, pad))
+        valid = (kept_sc > 0).astype(jnp.float32)[:, None]
+        return kept_boxes * valid, kept_sc
+
+    boxes, sc = jax.vmap(one)(scores, deltas,
+                              jnp.asarray(im_info, jnp.float32))
+    if output_score:
+        return jnp.concatenate([boxes, sc[..., None]], axis=-1)
+    return boxes

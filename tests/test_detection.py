@@ -93,3 +93,49 @@ def test_ssd_resnet50_constructs():
     names = list(net.collect_params().keys())
     assert any("cls" in n for n in names)
     assert any("extra" in n for n in names)
+
+
+# ---------------------------------------------------------------------------
+# box_encode/box_decode + Proposal (ref: bounding_box.cc, proposal.cc)
+# ---------------------------------------------------------------------------
+
+def test_box_encode_decode_roundtrip():
+    anchors = np.array([[[10., 10, 30, 30], [40, 40, 80, 100]]],
+                       np.float32)
+    gt = np.array([[[12., 8, 28, 35], [35, 45, 90, 95]]], np.float32)
+    samples = np.array([[1., 1.]], np.float32)
+    matches = np.array([[0., 1.]], np.float32)
+    t, m = nd.box_encode(nd.array(samples), nd.array(matches),
+                         nd.array(anchors), nd.array(gt))
+    np.testing.assert_allclose(m.asnumpy(), np.ones((1, 2, 4)))
+    dec = nd.box_decode(t, nd.array(anchors))
+    np.testing.assert_allclose(dec.asnumpy(), gt, rtol=1e-4, atol=1e-3)
+    # unmatched rows (samples<=0.5) encode to zeroed targets + zero mask
+    t2, m2 = nd.box_encode(nd.array(np.array([[1., 0.]], np.float32)),
+                           nd.array(matches), nd.array(anchors),
+                           nd.array(gt))
+    assert (m2.asnumpy()[0, 1] == 0).all()
+    assert (t2.asnumpy()[0, 1] == 0).all()
+
+
+def test_proposal_rpn():
+    B, A, H, W = 1, 3, 8, 8
+    rng = np.random.RandomState(0)
+    cls = rng.rand(B, 2 * A, H, W).astype(np.float32) * 0.1
+    cls[0, A + 1, 4, 4] = 0.99  # one strong anchor
+    bbox = np.zeros((B, 4 * A, H, W), np.float32)
+    im_info = np.array([[128., 128., 1.0]], np.float32)
+    out = nd.Proposal(nd.array(cls), nd.array(bbox), nd.array(im_info),
+                      rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10,
+                      rpn_min_size=1, feature_stride=16,
+                      scales=(2,), ratios=(0.5, 1, 2), output_score=True)
+    o = out.asnumpy()[0]
+    assert o.shape == (10, 5)  # static post-NMS rows
+    assert o[0, 4] > 0.9       # the strong anchor leads
+    # boxes clipped into the image
+    assert (o[:, :4] >= 0).all() and (o[:, :4] <= 127).all()
+    # MultiProposal alias
+    out2 = nd.MultiProposal(nd.array(cls), nd.array(bbox),
+                            nd.array(im_info), rpn_post_nms_top_n=10,
+                            rpn_min_size=1, scales=(2,))
+    assert out2.shape == (1, 10, 4)

@@ -407,3 +407,36 @@ def test_spmd_trainer_remat_segments():
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
     assert "remat" not in jaxprs[0] and "checkpoint" not in jaxprs[0]
     assert "remat" in jaxprs[1] or "checkpoint" in jaxprs[1]
+
+
+def test_spmd_batchnorm_is_sync_bn():
+    """Under dp-sharded SPMD, BatchNorm statistics are computed over the
+    GLOBAL batch (GSPMD reduces over the full logical array), i.e.
+    SyncBatchNorm semantics come for free — pin it: per-shard stats
+    would differ from the global-batch oracle."""
+    from mxnet_tpu.gluon import nn, loss as gloss
+
+    mesh = parallel.make_mesh(dp=8)
+    rng = np.random.RandomState(0)
+    # make shards statistically DIFFERENT so per-shard stats would be
+    # visibly wrong: sample i's scale grows with its index
+    x = (rng.randn(64, 16) * np.linspace(0.5, 4.0, 64)[:, None]) \
+        .astype(np.float32)
+    y = (rng.rand(64) * 4).astype(np.int32)
+    with mesh:
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, in_units=16))
+        net.add(nn.BatchNorm(in_channels=8, momentum=0.0))  # stats=batch
+        net.add(nn.Dense(4, in_units=8))
+        net.initialize(mx.initializer.Xavier())
+        tr = parallel.SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                                  "sgd", {"learning_rate": 0.0})
+        tr.step(x, y)
+        tr.sync_to_block()
+        got_mean = net[1].running_mean.data().asnumpy()
+    # oracle: global-batch stats of the SAME pre-BN activations
+    w = net[0].weight.data().asnumpy()
+    b = net[0].bias.data().asnumpy()
+    pre = x @ w.T + b
+    np.testing.assert_allclose(got_mean, pre.mean(axis=0), rtol=1e-4,
+                               atol=1e-5)
