@@ -46,7 +46,7 @@ def __getattr__(name):
             "lr_scheduler", "io", "image", "symbol", "module", "parallel",
             "callback", "model", "test_utils", "engine", "runtime",
             "visualization", "recordio", "contrib", "monitor", "name",
-            "attribute", "resource", "rtc"}
+            "attribute", "resource", "rtc", "kvstore_server"}
     if name == "sym":
         mod = importlib.import_module(".symbol", __name__)
         globals()["sym"] = mod

@@ -1,0 +1,40 @@
+"""KVStore server entry (ref: python/mxnet/kvstore_server.py).
+
+The reference runs dedicated parameter-server processes
+(DMLC_ROLE=server) that apply optimizer updates server-side.  Here the
+collective substrate subsumes servers: gradients are allreduced in-graph
+(parallel/dist.py) and every worker applies the update locally, so a
+"server" has nothing to serve.  Launchers that still spawn server roles
+(tools/launch.py parity, reference cluster scripts) land in
+``_init_kvstore_server_module``, which parks the process until the job
+ends instead of crashing the launch.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """API-parity shim: run() blocks for the job's lifetime."""
+
+    def __init__(self, kvstore=None):
+        self.kvstore = kvstore
+
+    def run(self):  # pragma: no cover - exercised via launch parity
+        from .parallel import dist
+
+        dist.init()  # registers, then returns (server role is absorbed)
+        # nothing to serve: wait for the coordinator to wind down
+        try:
+            dist.barrier("server_park")
+        except Exception:
+            pass
+
+
+def _init_kvstore_server_module():
+    """ref: kvstore_server._init_kvstore_server_module — called by
+    reference launch scripts when DMLC_ROLE=server."""
+    if os.environ.get("DMLC_ROLE") == "server":
+        KVStoreServer().run()

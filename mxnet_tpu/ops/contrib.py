@@ -516,11 +516,12 @@ def _box_encode(samples, matches, anchors, refs, means=None, stds=None):
     """Encode matched ground-truth boxes against anchors as (dx, dy, dw,
     dh) regression targets + a validity mask (ref: box_encode).
     samples (B, N) in {-1, 0, 1}; matches (B, N) gt indices; anchors
-    (B, N, 4) corner; refs (B, N, 4)? -> refs are gt boxes (B, M, 4)."""
+    (B, N, 4) corner; refs are gt boxes (B, M, 4).  Default stds follow
+    the reference (0.1, 0.1, 0.2, 0.2) SSD normalization."""
     means = jnp.asarray(means if means is not None
                         else (0.0, 0.0, 0.0, 0.0), jnp.float32)
     stds = jnp.asarray(stds if stds is not None
-                       else (1.0, 1.0, 1.0, 1.0), jnp.float32)
+                       else (0.1, 0.1, 0.2, 0.2), jnp.float32)
 
     def one(s, m, a, r):
         gt = r[jnp.clip(m.astype(jnp.int32), 0, r.shape[0] - 1)]
@@ -567,7 +568,9 @@ def _box_decode(data, anchors, std0=1.0, std1=1.0, std2=1.0, std3=1.0,
 
 @register_op("_contrib_Proposal",
              aliases=("Proposal", "_contrib_MultiProposal",
-                      "MultiProposal"), differentiable=False)
+                      "MultiProposal"), differentiable=False,
+             num_outputs=lambda attrs: 2 if attrs.get("output_score")
+             else 1)
 def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
               rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
               scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
@@ -575,8 +578,11 @@ def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
               iou_loss=False):
     """RPN proposal generation (ref: proposal.cc / multi_proposal.cc):
     sliding anchors + predicted deltas -> decoded boxes -> pre-NMS topk
-    -> NMS -> fixed post-NMS rows.  Static-shape XLA design: the output
-    is always (B, rpn_post_nms_top_n, 4|5) with suppressed rows zeroed."""
+    -> NMS -> fixed post-NMS rows.  Output follows the reference ROI
+    contract: rois (B*rpn_post_nms_top_n, 5) = [batch_idx, x1, y1, x2,
+    y2] — directly feedable to ROIPooling/ROIAlign — plus a second
+    (B*rpn_post_nms_top_n, 1) score output when output_score=True;
+    suppressed rows are zeroed."""
     if iou_loss:
         raise MXNetError("Proposal: iou_loss=True (direct corner-offset "
                          "decoding) is not implemented in this build")
@@ -617,8 +623,23 @@ def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     deltas = bbox_pred.reshape(B, A, 4, H, W) \
         .transpose(0, 3, 4, 1, 2).reshape(B, -1, 4)
 
+    def legacy_decode(dl):
+        # BBoxTransformInv with the legacy +1 width convention
+        # (proposal.cc): w = x2-x1+1, center = x1 + 0.5*(w-1)
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        ax = anchors[:, 0] + 0.5 * (aw - 1.0)
+        ay = anchors[:, 1] + 0.5 * (ah - 1.0)
+        cx = dl[:, 0] * aw + ax
+        cy = dl[:, 1] * ah + ay
+        w = jnp.exp(dl[:, 2]) * aw
+        h = jnp.exp(dl[:, 3]) * ah
+        return jnp.stack([cx - 0.5 * (w - 1.0), cy - 0.5 * (h - 1.0),
+                          cx + 0.5 * (w - 1.0), cy + 0.5 * (h - 1.0)],
+                         axis=1)
+
     def one(sc, dl, info):
-        boxes = _box_decode(dl[None], anchors[None])[0]
+        boxes = legacy_decode(dl)
         boxes = jnp.clip(boxes, 0.0,
                          jnp.stack([info[1], info[0], info[1],
                                     info[0]]) - 1.0)
@@ -647,6 +668,89 @@ def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
 
     boxes, sc = jax.vmap(one)(scores, deltas,
                               jnp.asarray(im_info, jnp.float32))
+    batch_idx = jnp.repeat(jnp.arange(B, dtype=boxes.dtype),
+                           rpn_post_nms_top_n)[:, None]
+    rois = jnp.concatenate([batch_idx,
+                            boxes.reshape(-1, 4)], axis=1)
     if output_score:
-        return jnp.concatenate([boxes, sc[..., None]], axis=-1)
-    return boxes
+        return rois, sc.reshape(-1, 1)
+    return rois
+
+
+def _resize_axis_align_corners(x, axis, out_size):
+    """Align-corners bilinear along one axis: source coordinate of
+    output i is i*(in-1)/(out-1) — the reference bilinear_resize.cc
+    mapping (NOT jax.image.resize's half-pixel convention)."""
+    in_size = x.shape[axis]
+    if out_size == in_size:
+        return x
+    if in_size == 1 or out_size == 1:
+        coords = jnp.zeros((out_size,), jnp.float32)
+    else:
+        coords = jnp.arange(out_size, dtype=jnp.float32) \
+            * ((in_size - 1) / (out_size - 1))
+    i0 = jnp.clip(jnp.floor(coords).astype(jnp.int32), 0, in_size - 1)
+    i1 = jnp.clip(i0 + 1, 0, in_size - 1)
+    frac = (coords - i0).astype(x.dtype)
+    shape = [1] * x.ndim
+    shape[axis] = out_size
+    frac = frac.reshape(shape)
+    a = jnp.take(x, i0, axis=axis)
+    b = jnp.take(x, i1, axis=axis)
+    return a * (1 - frac) + b * frac
+
+
+@register_op("_contrib_BilinearResize2D", aliases=("BilinearResize2D",))
+def _bilinear_resize2d(data, like=None, height=0, width=0, scale_height=None,
+                       scale_width=None, mode="size"):
+    """Bilinear resize NCHW with ALIGN-CORNERS sampling
+    (ref: contrib/bilinear_resize.cc — the segmentation-net upsampler;
+    pretrained decoders require the (in-1)/(out-1) mapping).  `like`
+    mode takes the target spatial size from a second input."""
+    if mode not in ("size", "like"):
+        raise MXNetError(
+            f"BilinearResize2D: mode {mode!r} is not implemented "
+            "(supported: 'size', 'like'; the odd_scale/to_even_* "
+            "size policies of the reference are not)")
+    n, c, h, w = data.shape
+    if like is not None and mode == "like":
+        th, tw = like.shape[2], like.shape[3]
+    elif scale_height is not None and scale_width is not None:
+        th, tw = int(h * scale_height), int(w * scale_width)
+    else:
+        th, tw = int(height), int(width)
+    if th <= 0 or tw <= 0:
+        raise MXNetError("BilinearResize2D: target size must be positive "
+                         f"(got {(th, tw)})")
+    out = _resize_axis_align_corners(data, 2, th)
+    return _resize_axis_align_corners(out, 3, tw)
+
+
+@register_op("_contrib_AdaptiveAvgPooling2D",
+             aliases=("AdaptiveAvgPooling2D",))
+def _adaptive_avg_pooling2d(data, output_size=()):
+    """Adaptive average pooling to a fixed output size
+    (ref: contrib/adaptive_avg_pooling.cc)."""
+    n, c, h, w = data.shape
+    if not output_size:
+        th = tw = 1
+    elif isinstance(output_size, int):
+        th = tw = int(output_size)
+    elif len(output_size) == 1:
+        th = tw = int(output_size[0])
+    else:
+        th, tw = int(output_size[0]), int(output_size[1])
+    if h % th == 0 and w % tw == 0:
+        # exact: mean over equal windows
+        return data.reshape(n, c, th, h // th, tw, w // tw).mean((3, 5))
+    # general case: integral-image exact adaptive pooling
+    csum = jnp.pad(jnp.cumsum(jnp.cumsum(data, axis=2), axis=3),
+                   ((0, 0), (0, 0), (1, 0), (1, 0)))
+    y0 = (jnp.arange(th) * h) // th
+    y1 = -(-(jnp.arange(1, th + 1) * h) // th)
+    x0 = (jnp.arange(tw) * w) // tw
+    x1 = -(-(jnp.arange(1, tw + 1) * w) // tw)
+    area = ((y1 - y0)[:, None] * (x1 - x0)[None, :]).astype(data.dtype)
+    s = (csum[:, :, y1][:, :, :, x1] - csum[:, :, y0][:, :, :, x1]
+         - csum[:, :, y1][:, :, :, x0] + csum[:, :, y0][:, :, :, x0])
+    return s / area

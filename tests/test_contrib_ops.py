@@ -246,3 +246,55 @@ def test_multibox_target_bipartite_force_match():
     assert ct[1] == 1.0  # A1 <- gt1 (class 0 -> 1): second round
     bm = bm.asnumpy()[0].reshape(2, 4)
     assert bm.sum() == 8.0  # both anchors positive
+
+
+def test_bilinear_resize2d_modes():
+    x = nd.array(np.arange(2 * 3 * 4 * 6, dtype=np.float32)
+                 .reshape(2, 3, 4, 6))
+    r = nd.BilinearResize2D(x, height=8, width=12)
+    assert r.shape == (2, 3, 8, 12)
+    # align-corners mapping: output corners EQUAL input corners
+    xa = x.asnumpy()
+    ra = r.asnumpy()
+    np.testing.assert_allclose(ra[..., 0, 0], xa[..., 0, 0], rtol=1e-6)
+    np.testing.assert_allclose(ra[..., -1, -1], xa[..., -1, -1],
+                               rtol=1e-6)
+    with pytest.raises(mx.MXNetError, match="not implemented"):
+        nd.BilinearResize2D(x, scale_height=2.0, scale_width=2.0,
+                            mode="odd_scale")
+    rl = nd.BilinearResize2D(x, like=r, mode="like")
+    assert rl.shape == (2, 3, 8, 12)
+    rs = nd.BilinearResize2D(x, scale_height=2.0, scale_width=0.5)
+    assert rs.shape == (2, 3, 8, 3)
+    with pytest.raises(mx.MXNetError, match="positive"):
+        nd.BilinearResize2D(x)
+    # resize is differentiable (segmentation decoders train through it)
+    x.attach_grad()
+    with mx.autograd.record():
+        out = nd.BilinearResize2D(x, height=8, width=12)
+        loss = (out * out).sum()
+    loss.backward()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_adaptive_avg_pooling2d_exact_and_general():
+    x = nd.array(np.arange(2 * 3 * 4 * 6, dtype=np.float32)
+                 .reshape(2, 3, 4, 6))
+    a = nd.AdaptiveAvgPooling2D(x, output_size=(2, 3))
+    np.testing.assert_allclose(
+        a.asnumpy()[0, 0, 0, 0], x.asnumpy()[0, 0, :2, :2].mean(),
+        rtol=1e-6)
+    # non-divisible: matches the per-window mean oracle
+    b = nd.AdaptiveAvgPooling2D(x, output_size=(3, 4)).asnumpy()
+    xx = x.asnumpy()
+    for i in range(3):
+        for j in range(4):
+            y0, y1 = (i * 4) // 3, -((-(i + 1) * 4) // 3)
+            x0, x1 = (j * 6) // 4, -((-(j + 1) * 6) // 4)
+            np.testing.assert_allclose(
+                b[:, :, i, j], xx[:, :, y0:y1, x0:x1].mean((2, 3)),
+                rtol=1e-5)
+    # global (default) = GAP
+    g = nd.AdaptiveAvgPooling2D(x)
+    np.testing.assert_allclose(g.asnumpy()[:, :, 0, 0],
+                               xx.mean((2, 3)), rtol=1e-6)

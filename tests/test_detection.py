@@ -108,7 +108,9 @@ def test_box_encode_decode_roundtrip():
     t, m = nd.box_encode(nd.array(samples), nd.array(matches),
                          nd.array(anchors), nd.array(gt))
     np.testing.assert_allclose(m.asnumpy(), np.ones((1, 2, 4)))
-    dec = nd.box_decode(t, nd.array(anchors))
+    # reference-default stds: encode (0.1,0.1,0.2,0.2) <-> decode stdN
+    dec = nd.box_decode(t, nd.array(anchors), std0=0.1, std1=0.1,
+                        std2=0.2, std3=0.2)
     np.testing.assert_allclose(dec.asnumpy(), gt, rtol=1e-4, atol=1e-3)
     # unmatched rows (samples<=0.5) encode to zeroed targets + zero mask
     t2, m2 = nd.box_encode(nd.array(np.array([[1., 0.]], np.float32)),
@@ -125,17 +127,24 @@ def test_proposal_rpn():
     cls[0, A + 1, 4, 4] = 0.99  # one strong anchor
     bbox = np.zeros((B, 4 * A, H, W), np.float32)
     im_info = np.array([[128., 128., 1.0]], np.float32)
-    out = nd.Proposal(nd.array(cls), nd.array(bbox), nd.array(im_info),
-                      rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10,
-                      rpn_min_size=1, feature_stride=16,
-                      scales=(2,), ratios=(0.5, 1, 2), output_score=True)
-    o = out.asnumpy()[0]
-    assert o.shape == (10, 5)  # static post-NMS rows
-    assert o[0, 4] > 0.9       # the strong anchor leads
-    # boxes clipped into the image
-    assert (o[:, :4] >= 0).all() and (o[:, :4] <= 127).all()
-    # MultiProposal alias
+    rois, score = nd.Proposal(
+        nd.array(cls), nd.array(bbox), nd.array(im_info),
+        rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10,
+        rpn_min_size=1, feature_stride=16,
+        scales=(2,), ratios=(0.5, 1, 2), output_score=True)
+    r = rois.asnumpy()
+    assert r.shape == (10, 5)          # [batch_idx, x1, y1, x2, y2]
+    assert (r[:, 0] == 0).all()        # batch index first (ROI contract)
+    assert float(score.asnumpy()[0, 0]) > 0.9  # strong anchor leads
+    assert (r[:, 1:] >= 0).all() and (r[:, 1:] <= 127).all()
+    # rois feed ROIPooling directly (the Faster R-CNN wiring)
+    feat = nd.array(np.random.RandomState(1).randn(1, 4, 8, 8)
+                    .astype(np.float32))
+    pooled = nd.ROIPooling(feat, rois, pooled_size=(3, 3),
+                           spatial_scale=1.0 / 16)
+    assert pooled.shape == (10, 4, 3, 3)
+    # MultiProposal alias, single output without scores
     out2 = nd.MultiProposal(nd.array(cls), nd.array(bbox),
                             nd.array(im_info), rpn_post_nms_top_n=10,
                             rpn_min_size=1, scales=(2,))
-    assert out2.shape == (1, 10, 4)
+    assert out2.shape == (10, 5)
