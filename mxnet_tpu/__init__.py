@@ -10,6 +10,8 @@ Conventional import:  import mxnet_tpu as mx
 """
 from __future__ import annotations
 
+import os as _os
+
 __version__ = "0.1.0"
 
 from . import base
@@ -29,6 +31,12 @@ from . import storage
 from . import initialize as _initialize
 
 _initialize.initialize()
+
+if _os.environ.get("DMLC_ROLE") == "server":
+    # reference semantics: a server-role process parks inside the import
+    # (kvstore_server._init_kvstore_server_module) until the tracker
+    # ends the job — it must NOT fall through into the training script
+    from . import kvstore_server as _kvstore_server  # noqa: F401
 
 __all__ = [
     "MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",

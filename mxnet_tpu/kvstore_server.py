@@ -17,24 +17,33 @@ __all__ = ["KVStoreServer", "_init_kvstore_server_module"]
 
 
 class KVStoreServer:
-    """API-parity shim: run() blocks for the job's lifetime."""
+    """API-parity shim: run() PARKS for the job's lifetime — the tracker
+    that spawned the server terminates it when workers finish, exactly
+    like the reference (servers do not decide when the job ends).  Note
+    the server role does NOT join the device cluster (parallel/dist.py),
+    so there is no collective to wait on — the park is a plain sleep
+    loop interruptible by SIGTERM."""
 
     def __init__(self, kvstore=None):
         self.kvstore = kvstore
 
-    def run(self):  # pragma: no cover - exercised via launch parity
+    def run(self):  # pragma: no cover - park loop, killed by the tracker
+        import time
+
         from .parallel import dist
 
-        dist.init()  # registers, then returns (server role is absorbed)
-        # nothing to serve: wait for the coordinator to wind down
-        try:
-            dist.barrier("server_park")
-        except Exception:
-            pass
+        dist.init()  # no-op registration for the server role
+        while True:
+            time.sleep(60)
 
 
 def _init_kvstore_server_module():
-    """ref: kvstore_server._init_kvstore_server_module — called by
-    reference launch scripts when DMLC_ROLE=server."""
+    """ref: kvstore_server._init_kvstore_server_module — runs at import
+    of the package in a DMLC_ROLE=server process, so reference cluster
+    scripts (`python train.py` spawned as a server) park here instead of
+    executing the training script as a rogue extra worker."""
     if os.environ.get("DMLC_ROLE") == "server":
         KVStoreServer().run()
+
+
+_init_kvstore_server_module()

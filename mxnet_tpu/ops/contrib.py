@@ -37,19 +37,21 @@ __all__ = []
 # box utilities
 # ---------------------------------------------------------------------------
 
-def _corner_iou(a, b):
-    """Pairwise IoU of corner-format boxes a:(N,4) b:(M,4) -> (N,M)."""
+def _corner_iou(a, b, off=0.0):
+    """Pairwise IoU of corner-format boxes a:(N,4) b:(M,4) -> (N,M).
+    off=1.0 selects the legacy +1 pixel-area convention
+    (proposal.cc NMS)."""
     ax1, ay1, ax2, ay2 = a[:, 0:1], a[:, 1:2], a[:, 2:3], a[:, 3:4]
     bx1, by1, bx2, by2 = b[None, :, 0], b[None, :, 1], b[None, :, 2], b[None, :, 3]
     ix1 = jnp.maximum(ax1, bx1)
     iy1 = jnp.maximum(ay1, by1)
     ix2 = jnp.minimum(ax2, bx2)
     iy2 = jnp.minimum(ay2, by2)
-    iw = jnp.clip(ix2 - ix1, 0.0, None)
-    ih = jnp.clip(iy2 - iy1, 0.0, None)
+    iw = jnp.clip(ix2 - ix1 + off, 0.0, None)
+    ih = jnp.clip(iy2 - iy1 + off, 0.0, None)
     inter = iw * ih
-    area_a = jnp.clip(ax2 - ax1, 0.0, None) * jnp.clip(ay2 - ay1, 0.0, None)
-    area_b = jnp.clip(bx2 - bx1, 0.0, None) * jnp.clip(by2 - by1, 0.0, None)
+    area_a = jnp.clip(ax2 - ax1 + off, 0.0, None)         * jnp.clip(ay2 - ay1 + off, 0.0, None)
+    area_b = jnp.clip(bx2 - bx1 + off, 0.0, None)         * jnp.clip(by2 - by1 + off, 0.0, None)
     union = area_a + area_b - inter
     return jnp.where(union > 0, inter / union, 0.0)
 
@@ -208,7 +210,8 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
 # (ref: multibox_detection.cc, bounding_box.cc)
 # ---------------------------------------------------------------------------
 
-def _greedy_nms_keep(boxes, scores, ids, thresh, force_suppress):
+def _greedy_nms_keep(boxes, scores, ids, thresh, force_suppress,
+                     iou_off=0.0):
     """boxes (K,4) sorted by score desc; returns keep mask (K,).
 
     Small K precomputes the K×K IoU matrix (one batched MXU-friendly op);
@@ -220,7 +223,7 @@ def _greedy_nms_keep(boxes, scores, ids, thresh, force_suppress):
     idxs = jnp.arange(k)
 
     if k <= 1024:
-        iou = _corner_iou(boxes, boxes)
+        iou = _corner_iou(boxes, boxes, iou_off)
         same_cls = (ids[:, None] == ids[None, :]) if not force_suppress \
             else jnp.ones((k, k), bool)
         sup = (iou > thresh) & same_cls
@@ -232,7 +235,7 @@ def _greedy_nms_keep(boxes, scores, ids, thresh, force_suppress):
         return lax.fori_loop(0, k, body, valid)
 
     def body(i, keep):
-        row_iou = _corner_iou(boxes[i][None, :], boxes)[0]  # (K,)
+        row_iou = _corner_iou(boxes[i][None, :], boxes, iou_off)[0]  # (K,)
         same = jnp.ones(k, bool) if force_suppress else (ids == ids[i])
         row = (row_iou > thresh) & same & (idxs > i)
         return jnp.where(keep[i], keep & ~row, keep)
@@ -654,7 +657,7 @@ def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
         top_boxes = boxes[top_i]
         keep_idx = _greedy_nms_keep(top_boxes, top_sc,
                                     jnp.zeros_like(top_sc), threshold,
-                                    True)
+                                    True, iou_off=1.0)
         order = jnp.argsort(~keep_idx)              # kept rows first
         kept_boxes = top_boxes[order][:rpn_post_nms_top_n]
         kept_sc = jnp.where(keep_idx, top_sc, 0.0)[order][
@@ -754,3 +757,61 @@ def _adaptive_avg_pooling2d(data, output_size=()):
     s = (csum[:, :, y1][:, :, :, x1] - csum[:, :, y0][:, :, :, x1]
          - csum[:, :, y1][:, :, :, x0] + csum[:, :, y0][:, :, :, x0])
     return s / area
+
+
+@register_op("_contrib_PSROIPooling", aliases=("PSROIPooling",))
+def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=0,
+                   pooled_size=7, group_size=0):
+    """Position-sensitive ROI pooling (ref: contrib/psroi_pooling.cc —
+    the R-FCN head): input channels are output_dim * group^2 score maps;
+    output bin (i, j) of channel c AVERAGE-pools the (c, i, j) score map
+    over that bin's region.  rois (R, 5) [batch_idx, x1, y1, x2, y2]."""
+    k = int(pooled_size)
+    g = int(group_size) if group_size else k
+    if g != k:
+        raise MXNetError("PSROIPooling: group_size != pooled_size is not "
+                         "supported (the standard R-FCN configuration)")
+    b, cin, h, w = data.shape
+    od = int(output_dim)
+    if od * k * k != cin:
+        raise MXNetError(
+            f"PSROIPooling: data needs output_dim*pooled_size^2 = "
+            f"{od}*{k}*{k} = {od * k * k} channels (got {cin})")
+    maps = data.reshape(b, od, k, k, h, w)
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi):
+        bi = roi[0].astype(jnp.int32)
+        # reference rounds roi corners to the feature grid
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        img = maps[bi]  # (od, k, k, h, w)
+
+        def cell(py, px):
+            fy = py.astype(jnp.float32)
+            fx = px.astype(jnp.float32)
+            hstart = jnp.floor(y1 + fy * rh / k)
+            hend = jnp.ceil(y1 + (fy + 1) * rh / k)
+            wstart = jnp.floor(x1 + fx * rw / k)
+            wend = jnp.ceil(x1 + (fx + 1) * rw / k)
+            mask = ((ys[:, None] >= hstart) & (ys[:, None] < hend) &
+                    (xs[None, :] >= wstart) & (xs[None, :] < wend))
+            cnt = jnp.maximum(mask.sum(), 1)
+            sel = img[:, py, px]  # (od, h, w): the (py,px) score map
+            s = jnp.where(mask[None], sel, 0.0).sum(axis=(1, 2))
+            return s / cnt
+
+        # one vmapped cell over the bin grid (the _roi_pooling pattern),
+        # not k*k unrolled mask/reduce blocks in the trace
+        pys, pxs = jnp.meshgrid(jnp.arange(k, dtype=jnp.int32),
+                                jnp.arange(k, dtype=jnp.int32),
+                                indexing="ij")
+        grid = jax.vmap(jax.vmap(cell))(pys, pxs)  # (k, k, od)
+        return grid.transpose(2, 0, 1)
+
+    return jax.vmap(one_roi)(rois)

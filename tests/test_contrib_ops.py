@@ -298,3 +298,28 @@ def test_adaptive_avg_pooling2d_exact_and_general():
     g = nd.AdaptiveAvgPooling2D(x)
     np.testing.assert_allclose(g.asnumpy()[:, :, 0, 0],
                                xx.mean((2, 3)), rtol=1e-6)
+
+
+def test_psroi_pooling():
+    """R-FCN position-sensitive pooling: bin (i, j) reads score map
+    (c, i, j) only — constant-per-map input makes the oracle exact."""
+    od, k = 2, 3
+    b, h, w = 1, 9, 9
+    data = np.zeros((b, od * k * k, h, w), np.float32)
+    for c in range(od):
+        for i in range(k):
+            for j in range(k):
+                data[0, (c * k + i) * k + j] = c * 100 + i * 10 + j
+    rois = nd.array(np.array([[0, 0, 0, 8, 8]], np.float32))
+    out = nd.PSROIPooling(nd.array(data), rois, spatial_scale=1.0,
+                          output_dim=od, pooled_size=k)
+    assert out.shape == (1, od, k, k)
+    o = out.asnumpy()[0]
+    for c in range(od):
+        for i in range(k):
+            for j in range(k):
+                np.testing.assert_allclose(o[c, i, j],
+                                           c * 100 + i * 10 + j)
+    with pytest.raises(mx.MXNetError, match="channels"):
+        nd.PSROIPooling(nd.array(data[:, :17]), rois, output_dim=od,
+                        pooled_size=k)

@@ -134,3 +134,25 @@ def test_launch_dry_run_launchers(tmp_path):
     assert len(slurm) == 1
     assert "srun --ntasks=4 env " in slurm[0]
     assert "DMLC_PS_ROOT_URI" not in slurm[0]
+
+
+def test_server_role_parks_not_trains():
+    """A DMLC_ROLE=server process importing the package must PARK (the
+    reference kvstore_server semantics), not run the script body as a
+    rogue extra worker; the tracker terminates it."""
+    env = _worker_env()
+    env["DMLC_ROLE"] = "server"
+    p = subprocess.Popen(
+        [sys.executable, "-c",
+         "import mxnet_tpu; print('FELL_THROUGH', flush=True)"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        out, _ = p.communicate(timeout=20)
+        raise AssertionError(f"server did not park: {out[-500:]}")
+    except subprocess.TimeoutExpired:
+        pass  # parked, as it should
+    finally:
+        p.kill()
+        out, _ = p.communicate()
+    assert "FELL_THROUGH" not in out
