@@ -4,12 +4,93 @@ resnet18_v1 … resnet152_v2, BasicBlockV1/V2, BottleneckV1/V2).
 TPU notes: the architecture is identical to the reference's Gluon zoo;
 run with net.hybridize() so the whole model is one XLA program, and use
 net.cast('bfloat16') for MXU-native convs (BatchNorm stats stay fp32).
+
+MXNET_FUSED_CONVBN=1 reroutes the V1 residual blocks through the fused
+Conv+BN+ReLU Pallas units (ops/pallas_convbn.py): each conv reads its
+predecessor's RAW output and applies the BatchNorm affine + ReLU while
+reading, and BN statistics accumulate inside the conv epilogue, so the
+normalized activations are never materialized in HBM (the counterpart
+of the reference's MKLDNN conv+BN+ReLU subgraph fusion, ref:
+src/operator/subgraph/mkldnn/mkldnn_conv.cc).  The fused path needs
+NHWC layout and a trace scope (hybridize()/SPMDTrainer); eager calls
+and V2 (pre-activation) blocks keep the op-granular path.  Semantics —
+including the conv1/conv3 bias quirk of the gluon zoo bottleneck, the
+shifted single-pass variance, and running-stat updates — match the
+unfused path (tests/test_pallas_convbn.py, tests/test_fused_resnet.py).
 """
 from __future__ import annotations
 
-from ....base import MXNetError
-from ...block import HybridBlock
+from ....base import MXNetError, get_env
+from ...block import HybridBlock, current_trace
 from ... import nn
+
+
+def _fused_convbn_active(layout):
+    """Fused path is an opt-in traced-mode NHWC optimization.
+
+    MXNET_BN_EXACT_VAR=1 disables it: the fused statistics are
+    inherently single-pass (shifted variance inside the conv epilogue),
+    so honoring the exact two-pass variance knob means taking the
+    op-granular path rather than silently changing estimators.
+    """
+    return (layout == "NHWC"
+            and get_env("MXNET_FUSED_CONVBN", False, bool)
+            and not get_env("MXNET_BN_EXACT_VAR", False, bool)
+            and current_trace() is not None)
+
+
+def _fused_unit(F, ts, x, conv, bn, in_scale, in_bias, act_in, train):
+    """One fused conv step + this BN's C-sized affine math.
+
+    Returns (y_raw, scale, bias) where `scale`/`bias` map y_raw to the
+    normalized activation (conv bias folded in: y_raw*scale + bias ==
+    BN(conv_out + conv_bias)); queues the running-stat aux updates.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    kw = conv._kwargs
+    w = ts.value_of(conv.weight)
+    cb = None if kw.get("no_bias") else ts.value_of(conv.bias)
+    gamma = ts.value_of(bn.gamma)
+    beta = ts.value_of(bn.beta)
+    rm = ts.value_of(bn.running_mean)
+    rv = ts.value_of(bn.running_var)
+    sdt = rm.dtype
+    g = gamma.astype(sdt) if bn._scale else jnp.ones_like(gamma, sdt)
+    cbf = cb.astype(sdt) if cb is not None else None
+    want_stats = train and not bn._use_global_stats
+    # shift stays EXACTLY the stop-gradient running mean (parity with
+    # _batch_norm's c); the conv bias must NOT be folded into it — the
+    # kernel's shift input is a gradient dead-end, and hiding cb there
+    # kills one of the two analytically-cancelling d(var)/d(cb) terms,
+    # leaving a spurious conv-bias gradient (caught by
+    # test_fused_resnet).  cb enters through the differentiable C-sized
+    # algebra below instead.
+    y, s1, s2 = F.FusedConvUnit(
+        x, w, in_scale, in_bias, rm, kernel=kw["kernel"],
+        stride=kw["stride"], pad=kw["pad"], act_in=act_in,
+        want_stats=want_stats)
+    if want_stats:
+        n = y.size // y.shape[-1]
+        mean = s1 / n + (cbf if cbf is not None else 0.0)  # mean of y_full
+        dm = mean - rm
+        raw = s2 / n
+        if cbf is not None:
+            # E[(y+cb-rm)^2] = E[(y-rm)^2] + 2cb·E[y-rm] + cb^2
+            raw = raw + 2.0 * cbf * (s1 / n - rm) + cbf * cbf
+        # same shifted single-pass variance + relative floor as _batch_norm
+        var = jnp.maximum(raw - dm * dm, 1e-6 * raw)
+        unbiased = var * (n / max(n - 1, 1))
+        mom = bn._momentum
+        ts.add_aux_update(bn.running_mean, mom * rm + (1 - mom) * mean)
+        ts.add_aux_update(bn.running_var, mom * rv + (1 - mom) * unbiased)
+    else:
+        mean, var = rm, rv
+    scale = g * lax.rsqrt(var + bn._epsilon)
+    bias = beta.astype(sdt) + ((cbf if cbf is not None else 0.0)
+                               - mean) * scale
+    return y, scale, bias
 
 __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "BottleneckV1", "BottleneckV2", "resnet18_v1", "resnet34_v1",
@@ -32,6 +113,7 @@ class BasicBlockV1(HybridBlock):
                  layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         ax = _bn_axis(layout)
+        self._layout = layout
         self.body = nn.HybridSequential(prefix="")
         self.body.add(_conv3x3(channels, stride, in_channels, layout))
         self.body.add(nn.BatchNorm(axis=ax))
@@ -49,11 +131,34 @@ class BasicBlockV1(HybridBlock):
             self.downsample = None
 
     def hybrid_forward(self, F, x):
+        if _fused_convbn_active(self._layout):
+            return self._fused_forward(F, x)
         residual = x
         x = self.body(x)
         if self.downsample is not None:
             residual = self.downsample(residual)
         return F.Activation(residual + x, act_type="relu")
+
+    def _fused_forward(self, F, x):
+        import jax.numpy as jnp
+
+        ts = current_trace()
+        train = ts.train
+        b = self.body  # conv1, bn1, relu, conv2, bn2
+        y1, sc1, bi1 = _fused_unit(F, ts, x, b[0], b[1], None, None,
+                                   False, train)
+        y2, sc2, bi2 = _fused_unit(F, ts, y1, b[3], b[4], sc1, bi1,
+                                   True, train)
+        if self.downsample is not None:
+            yd, scd, bid = _fused_unit(F, ts, x, self.downsample[0],
+                                       self.downsample[1], None, None,
+                                       False, train)
+            shortcut = yd.astype(jnp.float32) * scd + bid
+        else:
+            shortcut = x.astype(jnp.float32)
+        out = jnp.maximum(y2.astype(jnp.float32) * sc2 + bi2 + shortcut,
+                          0.0)
+        return out.astype(x.dtype)
 
 
 class BottleneckV1(HybridBlock):
@@ -61,6 +166,7 @@ class BottleneckV1(HybridBlock):
                  layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         ax = _bn_axis(layout)
+        self._layout = layout
         self.body = nn.HybridSequential(prefix="")
         self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride,
                                 layout=layout))
@@ -83,11 +189,36 @@ class BottleneckV1(HybridBlock):
             self.downsample = None
 
     def hybrid_forward(self, F, x):
+        if _fused_convbn_active(self._layout):
+            return self._fused_forward(F, x)
         residual = x
         x = self.body(x)
         if self.downsample is not None:
             residual = self.downsample(residual)
         return F.Activation(x + residual, act_type="relu")
+
+    def _fused_forward(self, F, x):
+        import jax.numpy as jnp
+
+        ts = current_trace()
+        train = ts.train
+        b = self.body  # conv1, bn1, relu, conv2, bn2, relu, conv3, bn3
+        y1, sc1, bi1 = _fused_unit(F, ts, x, b[0], b[1], None, None,
+                                   False, train)
+        y2, sc2, bi2 = _fused_unit(F, ts, y1, b[3], b[4], sc1, bi1,
+                                   True, train)
+        y3, sc3, bi3 = _fused_unit(F, ts, y2, b[6], b[7], sc2, bi2,
+                                   True, train)
+        if self.downsample is not None:
+            yd, scd, bid = _fused_unit(F, ts, x, self.downsample[0],
+                                       self.downsample[1], None, None,
+                                       False, train)
+            shortcut = yd.astype(jnp.float32) * scd + bid
+        else:
+            shortcut = x.astype(jnp.float32)
+        out = jnp.maximum(y3.astype(jnp.float32) * sc3 + bi3 + shortcut,
+                          0.0)
+        return out.astype(x.dtype)
 
 
 class BasicBlockV2(HybridBlock):

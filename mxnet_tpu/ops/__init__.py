@@ -16,6 +16,7 @@ from . import optimizer_ops  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import contrib  # noqa: F401
 from . import pallas_attention  # noqa: F401
+from . import pallas_convbn  # noqa: F401
 from . import linalg  # noqa: F401
 from . import image_ops  # noqa: F401
 from . import quantization  # noqa: F401

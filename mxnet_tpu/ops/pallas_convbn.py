@@ -1,0 +1,387 @@
+"""Cross-layer fused Conv+BN+ReLU unit: Pallas TPU kernel + XLA fallback.
+
+The ResNet-50 train step is HBM-bound (PERF.md roofline: 85-95% of
+achievable bandwidth at op granularity), so the remaining headroom is
+activation *traffic*, not FLOPs.  The reference gets its version of this
+from cuDNN fused conv epilogues + MKLDNN subgraph fusion (ref:
+src/operator/subgraph/mkldnn/mkldnn_conv.cc fuses conv+BN+ReLU); the
+TPU-native equivalent is this kernel.
+
+The unit computes, for one conv layer k inside a conv->BN->ReLU chain:
+
+    u  = act(x * in_scale + in_bias)        # layer k-1's BatchNorm+ReLU,
+                                            # applied WHILE READING x (the
+                                            # raw conv_{k-1} output) so the
+                                            # normalized activation is never
+                                            # materialized in HBM
+    y  = conv(u, w)                         # this layer's conv (raw out)
+    s1 = sum_c(y); s2 = sum_c((y-shift)^2)  # BN statistics of y, folded
+                                            # into the conv epilogue so the
+                                            # separate stats pass disappears
+
+A chain of these units touches HBM twice per layer (read x, write y) vs
+~5 passes/layer for the op-granular path (conv write, stats read,
+normalize read+write, next-conv read).  `shift` is the running mean: the
+variance uses the same shifted single-pass formula as ops/nn.py
+`_batch_norm` (E[(y-c)^2] - (mean-c)^2, warm-stat exact, floor-bounded)
+so fused and unfused training see identical statistics semantics.
+
+Backward is hand-written XLA (not Pallas): dgrad/wgrad via
+jax.linear_transpose of the forward conv (exactly the transpose convs
+XLA autodiff would emit, with no forward recompute), the BN-stat
+cotangents folded into dy (dy_tot = dy + g_s1 + 2(y-shift)g_s2), and the
+input-affine/ReLU backward recomputed elementwise from x.  Residuals are
+(inputs, y): y is the layer activation that the op-granular path would
+have stored anyway, so fusion adds no activation memory.
+
+The Pallas path needs layout NHWC (channels on the 128-lane axis) and a
+TPU backend; everything else (CPU tests, NCHW, probe failure,
+MXNET_USE_PALLAS=0) takes the XLA fallback with identical semantics.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..base import get_env
+from .registry import register_op
+
+__all__ = ["fused_conv_unit"]
+
+_STATE = {"enabled": None}
+
+# VMEM working-set budget for choosing the per-program batch tile
+# (im2col block + double-buffered x/y grid blocks), leaving headroom for
+# the weight panel and Mosaic's own scratch inside the 16MB core VMEM.
+_COLS_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+def _pallas_wanted() -> bool:
+    """Pallas usable?  Decided once: not on CPU (unless interpret mode is
+    forced for tests) and only if a probe kernel actually compiles."""
+    if _STATE["enabled"] is None:
+        if not get_env("MXNET_USE_PALLAS", True, bool):
+            _STATE["enabled"] = False
+            return False
+        try:
+            backend = jax.default_backend()
+        except Exception:
+            backend = "cpu"
+        interp = get_env("MXNET_PALLAS_INTERPRET", False, bool)
+        if backend == "cpu" and not interp:
+            _STATE["enabled"] = False
+            return False
+        try:
+            x = jnp.zeros((2, 8, 8, 128), jnp.bfloat16)
+            w = jnp.zeros((128, 128, 3, 3), jnp.bfloat16)
+            sc = jnp.ones((128,), jnp.float32)
+            sh = jnp.zeros((128,), jnp.float32)
+            jax.eval_shape(functools.partial(
+                _pallas_unit, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                act_in=True, want_stats=True), x, w, sc, sc, sh)
+            if interp:
+                _STATE["enabled"] = True
+                return True
+            jax.jit(functools.partial(
+                _pallas_unit, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                act_in=True, want_stats=True)).lower(x, w, sc, sc, sh) \
+                .compile()
+            _STATE["enabled"] = True
+        except Exception:
+            _STATE["enabled"] = False
+    return _STATE["enabled"]
+
+
+def _batch_tile(n, h, w, ci, ho, wo, co, k_contract):
+    """Largest power-of-two batch tile dividing n whose whole VMEM
+    working set fits the budget: im2col block + double-buffered x and y
+    grid blocks (the y block dominates for 1x1 expansion convs where
+    co >> kh*kw*ci).  >=1 even when one image overflows it: the
+    56x56-stage im2col block is ~3.6MB and must still run."""
+    per_image = (ho * wo * k_contract      # cols
+                 + 2 * h * w * ci          # x block, double-buffered
+                 + 2 * ho * wo * co) * 2   # y block, double-buffered; bf16
+    nb = 1
+    while nb * 2 <= n and n % (nb * 2) == 0 \
+            and (nb * 2) * per_image <= _COLS_BUDGET_BYTES:
+        nb *= 2
+    return nb
+
+
+def _out_hw(h, w, kernel, stride, pad):
+    ho = (h + 2 * pad[0] - kernel[0]) // stride[0] + 1
+    wo = (w + 2 * pad[1] - kernel[1]) // stride[1] + 1
+    return ho, wo
+
+
+def _im2col(u, kernel, stride, pad, ho, wo):
+    """(NB,H,W,C) -> (NB*Ho*Wo, kh*kw*C) patches, (ky,kx,c) minor order —
+    must match the weight panel layout in `_weight_panel`."""
+    kh, kw = kernel
+    sh, sw = stride
+    if pad != (0, 0):
+        u = jnp.pad(u, ((0, 0), (pad[0], pad[0]), (pad[1], pad[1]), (0, 0)))
+    if (kh, kw) == (1, 1):
+        cols = u[:, ::sh, ::sw, :]
+    else:
+        slices = []
+        for ky in range(kh):
+            for kx in range(kw):
+                slices.append(
+                    u[:, ky:ky + (ho - 1) * sh + 1:sh,
+                      kx:kx + (wo - 1) * sw + 1:sw, :])
+        cols = jnp.concatenate(slices, axis=-1)
+    return cols.reshape(cols.shape[0] * ho * wo, -1)
+
+
+def _weight_panel(w):
+    """(Co, Ci, kh, kw) checkpoint layout -> (kh*kw*Ci, Co) matmul panel."""
+    return jnp.transpose(w, (2, 3, 1, 0)).reshape(-1, w.shape[0])
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward
+# ---------------------------------------------------------------------------
+
+def _pallas_unit(x, w, in_scale, in_bias, shift, *, kernel, stride, pad,
+                 act_in, want_stats):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, h, wd, ci = x.shape
+    co = w.shape[0]
+    ho, wo = _out_hw(h, wd, kernel, stride, pad)
+    nb = _batch_tile(n, h, wd, ci, ho, wo, co, kernel[0] * kernel[1] * ci)
+    wmat = _weight_panel(w)
+    out_dtype = x.dtype
+
+    def kern(x_ref, w_ref, sc_ref, bi_ref, sh_ref, y_ref, s1_ref, s2_ref):
+        xb = x_ref[...]
+        if act_in:
+            u = xb.astype(jnp.float32) * sc_ref[...] + bi_ref[...]
+            u = jnp.maximum(u, 0.0).astype(xb.dtype)
+        else:
+            u = xb
+        cols = _im2col(u, kernel, stride, pad, ho, wo)
+        y = jnp.dot(cols, w_ref[...], preferred_element_type=jnp.float32)
+        yc = y.astype(out_dtype)
+        y_ref[...] = yc.reshape(nb, ho, wo, co)
+        # the stat outputs must be written in EVERY mode — an output
+        # block left untouched returns whatever was in VMEM (the XLA
+        # fallback returns zeros for want_stats=False; match it)
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            s1_ref[...] = jnp.zeros_like(s1_ref)
+            s2_ref[...] = jnp.zeros_like(s2_ref)
+
+        if want_stats:
+            # stats of the STORED (cast) value, accumulated fp32 across
+            # the sequential grid — semantics identical to the unfused
+            # BatchNorm reading the bf16 activation back from HBM
+            yf = yc.astype(jnp.float32)
+            d = yf - sh_ref[...]
+            s1_ref[...] += jnp.sum(yf, axis=0, keepdims=True)
+            s2_ref[...] += jnp.sum(d * d, axis=0, keepdims=True)
+
+    grid = (n // nb,)
+    y, s1, s2 = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((nb, h, wd, ci), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((wmat.shape[0], co), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, ci), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, ci), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, co), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((nb, ho, wo, co), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, co), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, co), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, ho, wo, co), out_dtype),
+            jax.ShapeDtypeStruct((1, co), jnp.float32),
+            jax.ShapeDtypeStruct((1, co), jnp.float32),
+        ],
+        interpret=get_env("MXNET_PALLAS_INTERPRET", False, bool),
+    )(x, wmat, in_scale.reshape(1, ci), in_bias.reshape(1, ci),
+      shift.reshape(1, co))
+    return y, s1.reshape(co), s2.reshape(co)
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback (identical semantics) + shared backward
+# ---------------------------------------------------------------------------
+
+def _apply_in_affine(x, in_scale, in_bias, act_in):
+    if not act_in:
+        return x
+    u = (x.astype(jnp.float32) * in_scale.reshape(1, 1, 1, -1)
+         + in_bias.reshape(1, 1, 1, -1))
+    return jnp.maximum(u, 0.0).astype(x.dtype)
+
+
+def _conv_nhwc(u, w_hwio, stride, pad):
+    return lax.conv_general_dilated(
+        u, w_hwio, window_strides=stride,
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _xla_unit(x, w, in_scale, in_bias, shift, *, kernel, stride, pad,
+              act_in, want_stats):
+    u = _apply_in_affine(x, in_scale, in_bias, act_in)
+    y = _conv_nhwc(u, jnp.transpose(w, (2, 3, 1, 0)), stride, pad)
+    if want_stats:
+        yf = y.astype(jnp.float32)
+        s1 = jnp.sum(yf, axis=(0, 1, 2))
+        d = yf - shift.reshape(1, 1, 1, -1)
+        s2 = jnp.sum(d * d, axis=(0, 1, 2))
+    else:
+        co = y.shape[-1]
+        s1 = jnp.zeros((co,), jnp.float32)
+        s2 = jnp.zeros((co,), jnp.float32)
+    return y, s1, s2
+
+
+# Trace-time success does NOT imply the kernel will survive Mosaic
+# lowering (that happens later, when the enclosing jitted program
+# compiles, far outside any try/except here).  So each distinct
+# (shapes, statics) configuration is probe-COMPILED standalone once —
+# with fresh ShapeDtypeStructs, never tracers, so it is safe to do in
+# the middle of an outer trace — and configurations Mosaic rejects are
+# pinned to the XLA fallback.
+_SHAPE_OK: dict = {}
+
+
+def _shape_supported(x, w, kernel, stride, pad, act_in, want_stats) -> bool:
+    key = (x.shape, str(x.dtype), w.shape, kernel, stride, pad, act_in,
+           want_stats)
+    ok = _SHAPE_OK.get(key)
+    if ok is None:
+        if get_env("MXNET_PALLAS_INTERPRET", False, bool):
+            ok = True  # interpreter mode has no Mosaic stage
+        else:
+            try:
+                args = [jax.ShapeDtypeStruct(x.shape, x.dtype),
+                        jax.ShapeDtypeStruct(w.shape, w.dtype),
+                        jax.ShapeDtypeStruct((x.shape[-1],), jnp.float32),
+                        jax.ShapeDtypeStruct((x.shape[-1],), jnp.float32),
+                        jax.ShapeDtypeStruct((w.shape[0],), jnp.float32)]
+                jax.jit(functools.partial(
+                    _pallas_unit, kernel=kernel, stride=stride, pad=pad,
+                    act_in=act_in, want_stats=want_stats)) \
+                    .lower(*args).compile()
+                ok = True
+            except Exception:
+                ok = False
+        _SHAPE_OK[key] = ok
+    return ok
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _unit(x, w, in_scale, in_bias, shift, kernel, stride, pad, act_in,
+          want_stats):
+    if _pallas_wanted() and _shape_supported(x, w, kernel, stride, pad,
+                                             act_in, want_stats):
+        try:
+            return _pallas_unit(x, w, in_scale, in_bias, shift,
+                                kernel=kernel, stride=stride, pad=pad,
+                                act_in=act_in, want_stats=want_stats)
+        except Exception:
+            pass
+    return _xla_unit(x, w, in_scale, in_bias, shift, kernel=kernel,
+                     stride=stride, pad=pad, act_in=act_in,
+                     want_stats=want_stats)
+
+
+def _unit_fwd(x, w, in_scale, in_bias, shift, kernel, stride, pad, act_in,
+              want_stats):
+    out = _unit(x, w, in_scale, in_bias, shift, kernel, stride, pad,
+                act_in, want_stats)
+    # y rides along as a residual: it is the stored activation either way
+    return out, (x, w, in_scale, in_bias, shift, out[0])
+
+
+def _unit_bwd(kernel, stride, pad, act_in, want_stats, res, cots):
+    x, w, in_scale, in_bias, shift, y = res
+    gy, gs1, gs2 = cots
+    if want_stats:
+        # fold the BN-stat cotangents into dy: d(s1)/dy = 1,
+        # d(s2)/dy = 2(y - shift); all C-sized broadcasts, XLA fuses
+        # this into the transpose-conv input reads
+        gy_tot = (gy.astype(jnp.float32)
+                  + gs1.reshape(1, 1, 1, -1)
+                  + 2.0 * (y.astype(jnp.float32)
+                           - shift.reshape(1, 1, 1, -1))
+                  * gs2.reshape(1, 1, 1, -1)).astype(gy.dtype)
+    else:
+        gy_tot = gy
+    u = _apply_in_affine(x, in_scale, in_bias, act_in)
+    w_hwio = jnp.transpose(w, (2, 3, 1, 0))
+    # dgrad / wgrad as the EXACT transpose of the forward conv — no
+    # forward recompute (linear_transpose only traces abstractly)
+    du = jax.linear_transpose(
+        lambda l: _conv_nhwc(l, w_hwio, stride, pad), u)(gy_tot)[0]
+    dw_hwio = jax.linear_transpose(
+        lambda r: _conv_nhwc(u, r, stride, pad), w_hwio)(gy_tot)[0]
+    dw = jnp.transpose(dw_hwio, (3, 2, 0, 1)).astype(w.dtype)
+    if act_in:
+        uf = (x.astype(jnp.float32) * in_scale.reshape(1, 1, 1, -1)
+              + in_bias.reshape(1, 1, 1, -1))
+        mask = uf > 0.0
+        gu = jnp.where(mask, du.astype(jnp.float32), 0.0)
+        gx = (gu * in_scale.reshape(1, 1, 1, -1)).astype(x.dtype)
+        gscale = jnp.sum(gu * x.astype(jnp.float32), axis=(0, 1, 2))
+        gbias = jnp.sum(gu, axis=(0, 1, 2))
+    else:
+        gx = du.astype(x.dtype)
+        gscale = jnp.zeros_like(in_scale)
+        gbias = jnp.zeros_like(in_bias)
+    # shift is a running statistic (stop-gradient, like _batch_norm's c)
+    return gx, dw, gscale, gbias, jnp.zeros_like(shift)
+
+
+_unit.defvjp(_unit_fwd, _unit_bwd)
+
+
+@register_op("FusedConvUnit")
+def fused_conv_unit(data, weight, in_scale=None, in_bias=None, shift=None,
+                    kernel=(1, 1), stride=(1, 1), pad=(0, 0), act_in=False,
+                    want_stats=True):
+    """Fused (input-affine+ReLU) -> conv -> (BN stats) unit, NHWC.
+
+    data (N,H,W,Ci) raw previous-layer conv output; weight (Co,Ci,kh,kw)
+    in the layout-independent checkpoint layout; in_scale/in_bias the
+    fp32 per-channel affine that normalizes `data` (None = identity);
+    shift the fp32 variance shift for this layer's stats (the running
+    mean; None = zeros).  Returns (y_raw, s1, s2) with s1/s2 fp32
+    per-channel sum / shifted sum-of-squares of y_raw.
+    """
+    kernel = tuple(int(k) for k in kernel)
+    stride = tuple(int(s) for s in stride)
+    pad = tuple(int(p) for p in pad)
+    ci = data.shape[-1]
+    co = weight.shape[0]
+    if in_scale is None:
+        in_scale = jnp.ones((ci,), jnp.float32)
+    if in_bias is None:
+        in_bias = jnp.zeros((ci,), jnp.float32)
+    if shift is None:
+        shift = jnp.zeros((co,), jnp.float32)
+    return _unit(data, weight, in_scale.astype(jnp.float32),
+                 in_bias.astype(jnp.float32), shift.astype(jnp.float32),
+                 kernel, stride, pad, bool(act_in), bool(want_stats))

@@ -1,0 +1,157 @@
+"""Fused (MXNET_FUSED_CONVBN=1) vs op-granular ResNet V1 blocks.
+
+The fused path must be a pure optimization: same outputs, same gradients
+for every parameter, same BatchNorm running-stat updates — train and
+eval.  Runs on the CPU backend where FusedConvUnit takes its XLA
+fallback; the Pallas kernel itself is covered by test_pallas_convbn.py
+(interpret mode) and the on-chip lane.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon.model_zoo.vision.resnet import (BasicBlockV1,
+                                                     BottleneckV1)
+
+
+def _snapshot(net):
+    return {n: p.data().asnumpy().copy()
+            for n, p in net.collect_params().items()}
+
+
+def _restore(net, snap):
+    for n, p in net.collect_params().items():
+        p.set_data(mx.nd.array(snap[n]))
+
+
+def _run_train_step(net, xnp):
+    """One hybridized train forward+backward; returns out, grads, aux."""
+    net.hybridize()
+    x = mx.nd.array(xnp)
+    with autograd.record():
+        out = net(x)
+        loss = (out * out).sum()
+    loss.backward()
+    grads = {n: p.grad().asnumpy().copy()
+             for n, p in net.collect_params().items()
+             if p.grad_req != "null"}
+    aux = {n: p.data().asnumpy().copy()
+           for n, p in net.collect_params().items()
+           if "running" in n}
+    return out.asnumpy(), grads, aux
+
+
+def _block_case(block):
+    xnp = np.random.RandomState(3).randn(2, 8, 8, 16).astype(np.float32)
+    block.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    block(mx.nd.array(xnp))  # resolve deferred shapes eagerly
+    snap = _snapshot(block)
+
+    import os
+    os.environ.pop("MXNET_FUSED_CONVBN", None)
+    out_ref, g_ref, aux_ref = _run_train_step(block, xnp)
+
+    _restore(block, snap)
+    block.hybridize()  # drop the unfused CachedOp trace
+    os.environ["MXNET_FUSED_CONVBN"] = "1"
+    try:
+        out_f, g_f, aux_f = _run_train_step(block, xnp)
+    finally:
+        os.environ.pop("MXNET_FUSED_CONVBN", None)
+
+    np.testing.assert_allclose(out_f, out_ref, rtol=2e-4, atol=2e-4)
+    assert set(g_f) == set(g_ref)
+    for n in g_ref:
+        np.testing.assert_allclose(g_f[n], g_ref[n], rtol=2e-3, atol=2e-3,
+                                   err_msg=f"grad {n}")
+    for n in aux_ref:
+        np.testing.assert_allclose(aux_f[n], aux_ref[n], rtol=2e-4,
+                                   atol=2e-4, err_msg=f"aux {n}")
+
+
+def test_bottleneck_v1_fused_matches():
+    _block_case(BottleneckV1(16, 1, downsample=False, in_channels=16,
+                             layout="NHWC"))
+
+
+def test_bottleneck_v1_stride2_downsample_fused_matches():
+    _block_case(BottleneckV1(32, 2, downsample=True, in_channels=16,
+                             layout="NHWC"))
+
+
+def test_basic_block_v1_fused_matches():
+    _block_case(BasicBlockV1(16, 1, downsample=False, in_channels=16,
+                             layout="NHWC"))
+
+
+def test_basic_block_v1_stride2_downsample_fused_matches():
+    _block_case(BasicBlockV1(32, 2, downsample=True, in_channels=16,
+                             layout="NHWC"))
+
+
+def test_fused_eval_mode_matches():
+    import os
+    block = BottleneckV1(16, 1, downsample=False, in_channels=16,
+                         layout="NHWC")
+    xnp = np.random.RandomState(5).randn(2, 8, 8, 16).astype(np.float32)
+    block.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    block(mx.nd.array(xnp))
+    # warm the running stats so eval normalization is non-trivial
+    block.hybridize()
+    with autograd.record():
+        block(mx.nd.array(xnp))
+    out_ref = block(mx.nd.array(xnp)).asnumpy()  # eval (not recording)
+
+    block.hybridize()
+    os.environ["MXNET_FUSED_CONVBN"] = "1"
+    try:
+        out_f = block(mx.nd.array(xnp)).asnumpy()
+    finally:
+        os.environ.pop("MXNET_FUSED_CONVBN", None)
+    np.testing.assert_allclose(out_f, out_ref, rtol=2e-4, atol=2e-4)
+
+
+def test_fused_full_resnet_train_step():
+    """Tiny resnet50_v1 NHWC end-to-end: fused trainer step ≈ unfused."""
+    import os
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    xnp = np.random.RandomState(7).rand(2, 32, 32, 3).astype(np.float32)
+    ynp = np.array([1, 3], np.int32)
+
+    def one_step(fused):
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = vision.resnet18_v1(classes=10, layout="NHWC")
+        net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+        net(mx.nd.array(xnp))
+        net.hybridize()
+        if fused:
+            os.environ["MXNET_FUSED_CONVBN"] = "1"
+        try:
+            with autograd.record():
+                out = net(mx.nd.array(xnp))
+                loss = mx.gluon.loss.SoftmaxCrossEntropyLoss()(
+                    out, mx.nd.array(ynp)).sum()
+            loss.backward()
+        finally:
+            os.environ.pop("MXNET_FUSED_CONVBN", None)
+        # registration order is structural — stable across net instances
+        # even though the global name counters differ between them
+        grads = [(n, p.grad().asnumpy().copy())
+                 for n, p in net.collect_params().items()
+                 if p.grad_req != "null"]
+        return out.asnumpy(), float(loss.asnumpy()), grads
+
+    out_r, loss_r, g_r = one_step(False)
+    out_f, loss_f, g_f = one_step(True)
+    np.testing.assert_allclose(out_f, out_r, rtol=5e-4, atol=5e-4)
+    assert abs(loss_f - loss_r) < 1e-3 * max(1.0, abs(loss_r))
+    assert len(g_f) == len(g_r)
+    for (nr, gr), (nf, gf) in zip(g_r, g_f):
+        # atol scales with the tensor: deep-net fp32 grads reach ~1e3 and
+        # summation-order noise scales with them
+        atol = 5e-3 + 1e-5 * float(np.max(np.abs(gr)))
+        np.testing.assert_allclose(gf, gr, rtol=5e-3, atol=atol,
+                                   err_msg=f"grad {nr} / {nf}")

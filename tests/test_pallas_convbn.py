@@ -1,0 +1,148 @@
+"""Fused Conv+BN+ReLU unit (ops/pallas_convbn.py) vs the op-granular path.
+
+Oracle strategy (SURVEY.md §4): the composed XLA ops (Convolution +
+explicit affine/relu/stat math) are the reference; the fused unit must
+match in forward values, BN statistics, and every gradient.  The Pallas
+kernel itself runs under MXNET_PALLAS_INTERPRET on the CPU backend.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops import pallas_convbn as pcb
+
+
+def _rand(shape, dtype=np.float32, scale=1.0):
+    return (np.random.RandomState(hash(shape) % 2**31).randn(*shape)
+            * scale).astype(dtype)
+
+
+def _ref_unit(x, w, sc, bi, sh, kernel, stride, pad, act_in):
+    """Composed op-granular math (the oracle)."""
+    if act_in:
+        u = (x.astype(jnp.float32) * sc.reshape(1, 1, 1, -1)
+             + bi.reshape(1, 1, 1, -1))
+        u = jnp.maximum(u, 0.0).astype(x.dtype)
+    else:
+        u = x
+    y = jax.lax.conv_general_dilated(
+        u, jnp.transpose(w, (2, 3, 1, 0)), stride,
+        [(pad[0], pad[0]), (pad[1], pad[1])],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    yf = y.astype(jnp.float32)
+    s1 = jnp.sum(yf, axis=(0, 1, 2))
+    d = yf - sh.reshape(1, 1, 1, -1)
+    s2 = jnp.sum(d * d, axis=(0, 1, 2))
+    return y, s1, s2
+
+
+CASES = [
+    # (shape NHWC, Co, kernel, stride, pad, act_in)
+    ((4, 8, 8, 16), 16, (3, 3), (1, 1), (1, 1), True),
+    ((4, 8, 8, 16), 32, (1, 1), (1, 1), (0, 0), True),
+    ((4, 9, 9, 8), 16, (1, 1), (2, 2), (0, 0), False),
+    ((2, 8, 8, 8), 8, (3, 3), (2, 2), (1, 1), True),
+    ((1, 7, 7, 24), 12, (3, 3), (1, 1), (1, 1), False),
+]
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_fallback_matches_composed(case):
+    shape, co, kernel, stride, pad, act_in = case
+    x = jnp.asarray(_rand(shape))
+    w = jnp.asarray(_rand((co, shape[-1]) + kernel, scale=0.2))
+    sc = jnp.asarray(_rand((shape[-1],)) ** 2 + 0.5)
+    bi = jnp.asarray(_rand((shape[-1],)))
+    sh = jnp.asarray(_rand((co,)))
+    y, s1, s2 = pcb.fused_conv_unit(x, w, sc, bi, sh, kernel=kernel,
+                                    stride=stride, pad=pad, act_in=act_in)
+    yr, s1r, s2r = _ref_unit(x, w, sc, bi, sh, kernel, stride, pad, act_in)
+    np.testing.assert_allclose(y, yr, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(s1, s1r, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(s2, s2r, rtol=1e-4, atol=1e-3)
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_pallas_interpret_matches_fallback(case, monkeypatch):
+    shape, co, kernel, stride, pad, act_in = case
+    monkeypatch.setenv("MXNET_PALLAS_INTERPRET", "1")
+    x = jnp.asarray(_rand(shape))
+    w = jnp.asarray(_rand((co, shape[-1]) + kernel, scale=0.2))
+    sc = jnp.asarray(_rand((shape[-1],)) ** 2 + 0.5)
+    bi = jnp.asarray(_rand((shape[-1],)))
+    sh = jnp.asarray(_rand((co,)))
+    y, s1, s2 = pcb._pallas_unit(x, w, sc, bi, sh, kernel=kernel,
+                                 stride=stride, pad=pad, act_in=act_in,
+                                 want_stats=True)
+    yr, s1r, s2r = _ref_unit(x, w, sc, bi, sh, kernel, stride, pad, act_in)
+    np.testing.assert_allclose(y, yr, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(s1, s1r, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(s2, s2r, rtol=1e-4, atol=1e-3)
+
+
+def test_batch_tile_divides_and_respects_budget():
+    # 56x56x(9*64) one image ~3.6MB cols: admitted at the nb=1 floor
+    assert pcb._batch_tile(256, 56, 56, 64, 56, 56, 64, 9 * 64) == 1
+    nb = pcb._batch_tile(256, 7, 7, 512, 7, 7, 512, 9 * 512)
+    assert 256 % nb == 0 and nb >= 2
+    # 1x1 expansion conv: the y block (co=2048) dominates the working
+    # set — the budget must count it, not just the im2col block
+    nb = pcb._batch_tile(256, 7, 7, 512, 7, 7, 2048, 512)
+    per_image = (7 * 7 * 512 + 2 * 7 * 7 * 512 + 2 * 7 * 7 * 2048) * 2
+    assert nb == 1 or nb * per_image <= pcb._COLS_BUDGET_BYTES
+    # nb must divide n even for odd n
+    assert pcb._batch_tile(3, 8, 8, 16, 8, 8, 16, 16) in (1, 3)
+
+
+@pytest.mark.parametrize("act_in", [True, False])
+def test_gradients_match_composed(act_in):
+    shape, co, kernel, stride, pad = (2, 6, 6, 8), 8, (3, 3), (1, 1), (1, 1)
+    x = jnp.asarray(_rand(shape))
+    w = jnp.asarray(_rand((co, shape[-1]) + kernel, scale=0.2))
+    sc = jnp.asarray(_rand((shape[-1],)) ** 2 + 0.5)
+    bi = jnp.asarray(_rand((shape[-1],)))
+    sh = jnp.asarray(_rand((co,)))
+
+    # scalar losses touching y, s1 AND s2 so every cotangent path is live
+    def loss_fused(x, w, sc, bi):
+        y, s1, s2 = pcb.fused_conv_unit(x, w, sc, bi, sh, kernel=kernel,
+                                        stride=stride, pad=pad,
+                                        act_in=act_in)
+        return (jnp.sum(y * y) + jnp.sum(jnp.sin(s1)) + jnp.sum(s2 * 0.1))
+
+    def loss_ref(x, w, sc, bi):
+        y, s1, s2 = _ref_unit(x, w, sc, bi, sh, kernel, stride, pad, act_in)
+        return (jnp.sum(y * y) + jnp.sum(jnp.sin(s1)) + jnp.sum(s2 * 0.1))
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, w, sc, bi)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, w, sc, bi)
+    for a, b, name in zip(gf, gr, ("x", "w", "scale", "bias")):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4,
+                                   err_msg=f"grad {name}")
+
+
+def test_shift_gets_zero_gradient():
+    shape, co = (2, 4, 4, 8), 8
+    x = jnp.asarray(_rand(shape))
+    w = jnp.asarray(_rand((co, 8, 1, 1), scale=0.2))
+    sh = jnp.asarray(_rand((co,)))
+
+    def loss(sh):
+        _, _, s2 = pcb.fused_conv_unit(x, w, None, None, sh)
+        return jnp.sum(s2)
+
+    np.testing.assert_allclose(jax.grad(loss)(sh), np.zeros(co), atol=0)
+
+
+def test_defaults_are_identity():
+    x = jnp.asarray(_rand((2, 4, 4, 8)))
+    w = jnp.asarray(_rand((16, 8, 1, 1), scale=0.2))
+    y, s1, s2 = pcb.fused_conv_unit(x, w)
+    yr, s1r, s2r = _ref_unit(x, w, jnp.ones(8), jnp.zeros(8), jnp.zeros(16),
+                             (1, 1), (1, 1), (0, 0), False)
+    np.testing.assert_allclose(y, yr, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(s2, s2r, rtol=1e-4, atol=1e-3)
