@@ -98,7 +98,12 @@ class NDArray:
         if ctx is not None and not isinstance(data, jax.core.Tracer):
             # (tracers have no placement — the enclosing trace decides)
             dev = ctx.jax_device
-            if getattr(data, "devices", None) and list(data.devices()) != [dev]:
+            if (isinstance(data, jax.Array)
+                    and not data.is_fully_addressable):
+                pass  # global SPMD value: keeps its mesh sharding; the
+                #       single-device ctx is advisory only
+            elif getattr(data, "devices", None) \
+                    and list(data.devices()) != [dev]:
                 data = jax.device_put(data, dev)
             elif not isinstance(data, jax.Array):
                 data = jax.device_put(data, dev)
@@ -221,7 +226,13 @@ class NDArray:
 
     # ---- sync points (ref: Engine::WaitForVar / asnumpy) ----------------
     def asnumpy(self) -> np.ndarray:
-        return np.asarray(jax.device_get(self._data))
+        d = self._data
+        if (isinstance(d, jax.Array) and not d.is_fully_addressable
+                and d.sharding.is_fully_replicated):
+            # multi-process mesh: a replicated global array cannot be
+            # fetched whole, but any local shard IS the global value
+            return np.asarray(d.addressable_shards[0].data)
+        return np.asarray(jax.device_get(d))
 
     def asscalar(self):
         if self.size != 1:

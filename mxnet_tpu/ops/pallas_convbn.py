@@ -96,15 +96,16 @@ def _pallas_wanted() -> bool:
     return _STATE["enabled"]
 
 
-def _batch_tile(n, h, w, ci, ho, wo, co, k_contract):
+def _batch_tile(n, h, w, ci, ho, wo, co, k_contract, itemsize=2):
     """Largest power-of-two batch tile dividing n whose whole VMEM
     working set fits the budget: im2col block + double-buffered x and y
     grid blocks (the y block dominates for 1x1 expansion convs where
     co >> kh*kw*ci).  >=1 even when one image overflows it: the
-    56x56-stage im2col block is ~3.6MB and must still run."""
+    56x56-stage im2col block is ~3.6MB and must still run.  `itemsize`
+    is the activation dtype width (2 for bf16, 4 for fp32)."""
     per_image = (ho * wo * k_contract      # cols
                  + 2 * h * w * ci          # x block, double-buffered
-                 + 2 * ho * wo * co) * 2   # y block, double-buffered; bf16
+                 + 2 * ho * wo * co) * itemsize  # y block, double-buffered
     nb = 1
     while nb * 2 <= n and n % (nb * 2) == 0 \
             and (nb * 2) * per_image <= _COLS_BUDGET_BYTES:
@@ -155,7 +156,8 @@ def _pallas_unit(x, w, in_scale, in_bias, shift, *, kernel, stride, pad,
     n, h, wd, ci = x.shape
     co = w.shape[0]
     ho, wo = _out_hw(h, wd, kernel, stride, pad)
-    nb = _batch_tile(n, h, wd, ci, ho, wo, co, kernel[0] * kernel[1] * ci)
+    nb = _batch_tile(n, h, wd, ci, ho, wo, co, kernel[0] * kernel[1] * ci,
+                     itemsize=x.dtype.itemsize)
     wmat = _weight_panel(w)
     out_dtype = x.dtype
 

@@ -77,6 +77,26 @@ class FunctionalOptimizer:
                             self.wd * wd_mult, self.clip_gradient, t)
 
 
+def _global_put(v, sh):
+    """device_put that also works on multi-process meshes whose backend
+    has no cross-host transfers (CPU+gloo).
+
+    Host values: every process holds the same global value (the launcher
+    contract), so each device takes its shard locally via
+    make_array_from_callback.  Values that are ALREADY global jax arrays
+    (e.g. optimizer state computed from global params) cannot be pulled
+    to host; they reshard through a jitted identity, which moves data
+    with in-program collectives instead of host transfers."""
+    if getattr(sh, "is_fully_addressable", True):
+        return jax.device_put(v, sh)
+    if isinstance(v, jax.Array) and not v.is_fully_addressable:
+        if v.sharding == sh:
+            return v
+        return jax.jit(lambda x: x, out_shardings=sh)(v)
+    v = np.asarray(v)
+    return jax.make_array_from_callback(v.shape, sh, lambda idx: v[idx])
+
+
 def _pure(name):
     from ..ops.registry import apply_pure
 
@@ -289,9 +309,9 @@ class SPMDTrainer:
             v = p.data().data
             sh = rules.sharding_for(n, v.shape, self.mesh)
             self._shardings[n] = sh
-            self.params[n] = jax.device_put(v, sh)
+            self.params[n] = _global_put(v, sh)
         self.opt_state = {
-            n: tuple(jax.device_put(s, self._shardings[n])
+            n: tuple(_global_put(s, self._shardings[n])
                      for s in self._fopt.init(v))
             for n, v in self.params.items() if self._trainable[n]}
 
@@ -444,7 +464,7 @@ class SPMDTrainer:
 
     def _place(self, x, spec):
         v = x.data if isinstance(x, NDArray) else jnp.asarray(x)
-        return jax.device_put(v, self._spec_sharding(spec, v))
+        return _global_put(v, self._spec_sharding(spec, v))
 
     # ---- public API ------------------------------------------------------
     def step(self, *args) -> NDArray:

@@ -113,7 +113,8 @@ def test_fused_eval_mode_matches():
 
 
 def test_fused_full_resnet_train_step():
-    """Tiny resnet50_v1 NHWC end-to-end: fused trainer step ≈ unfused."""
+    """Tiny resnet18_v1 NHWC end-to-end: fused trainer step ≈ unfused
+    (BasicBlockV1 path; BottleneckV1 is covered block-level above)."""
     import os
     from mxnet_tpu.gluon.model_zoo import vision
 

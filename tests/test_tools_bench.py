@@ -1,0 +1,51 @@
+"""Smoke lane for the measurement tooling (bench_all / opperf /
+scaling_bench): each harness must produce a parseable JSON row on the
+CPU backend.  Real numbers come from the on-chip runs (BENCH_ALL.json,
+OPPERF.json, SCALING.json artifacts)."""
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cmd, timeout=420):
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.run(cmd, capture_output=True, text=True, cwd=_REPO,
+                       timeout=timeout, env=env)
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+    lines = [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    assert lines, p.stdout[-2000:]
+    return [json.loads(ln) for ln in lines]
+
+
+def test_opperf_subset():
+    rows = _run([sys.executable, "tools/opperf.py",
+                 "--ops", "softmax,FullyConnected",
+                 "--repeat", "2", "--number", "3"])
+    by_op = {r["op"]: r for r in rows}
+    assert set(by_op) == {"softmax", "FullyConnected"}
+    for r in rows:
+        assert r["eager_us"] > 0 and r["jit_fwd_us"] > 0
+        assert r["jit_bwd_us"] > 0
+
+
+def test_bench_all_mnist_smoke():
+    rows = _run([sys.executable, "bench_all.py", "--cpu-smoke",
+                 "--config", "mnist_mlp"])
+    assert rows[-1]["metric"] == "mnist_mlp_train_throughput"
+    assert rows[-1]["value"] > 0
+
+
+def test_scaling_bench_single_proc():
+    rows = _run([sys.executable, "tools/scaling_bench.py",
+                 "--model", "resnet18", "--procs", "1", "--steps", "2",
+                 "--warmup", "1", "--batch-per-device", "2",
+                 "--image-size", "32",
+                 "--out", "/tmp/scaling_test.json"])
+    assert rows[-1]["processes"] == 1
+    assert rows[-1]["efficiency_vs_1proc"] == 1.0

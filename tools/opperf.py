@@ -1,0 +1,223 @@
+"""Per-op micro-benchmark harness (counterpart of the reference's
+benchmark/opperf/ — per-operator forward/backward latency so op-level
+perf regressions show up in artifact diffs, SURVEY.md §6).
+
+For each covered op, three timings (median-of-runs, µs/call):
+  * eager   — the imperative NDArray path (CS1: python dispatch +
+              registry invoke + async jax dispatch), fwd only
+  * jit_fwd — the op compiled alone via jax.jit (what a traced program
+              pays, minus fusion with neighbors)
+  * jit_bwd — compiled VJP application (fwd+bwd program)
+
+Run on CPU (pinned, for regression diffs) or TPU (the real numbers):
+    python tools/opperf.py --out OPPERF.json          # current backend
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python tools/opperf.py
+    python tools/opperf.py --ops Convolution,dot      # subset
+
+The committed OPPERF.json is the baseline; CI-style usage is to re-run
+and diff `value` columns (>2x swings on the same backend are real).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _specs(np, large):
+    """op name -> (args, attrs). Shapes: `large` on accelerators
+    (bandwidth-visible), small on CPU (keeps the sweep under a minute).
+    Covers the hot families: MXU ops, normalization, elementwise,
+    reductions, indexing, optimizer updates, vision/detection."""
+    r = np.random.RandomState(0)
+
+    def f(*shape):
+        return r.rand(*shape).astype(np.float32)
+
+    B, C, H = (64, 128, 56) if large else (8, 32, 14)
+    S, U = (128, 768) if large else (16, 64)
+    N = (1024, 4096) if large else (128, 256)
+    sp = {
+        # MXU
+        "FullyConnected": ((f(B, N[0]), f(N[1], N[0]), f(N[1])),
+                           {"num_hidden": N[1]}),
+        "dot": ((f(N[0], N[0]), f(N[0], N[0])), {}),
+        "batch_dot": ((f(16, S, 64), f(16, 64, S)), {}),
+        "Convolution": ((f(B, C, H, H), f(C, C, 3, 3)),
+                        {"kernel": (3, 3), "pad": (1, 1), "num_filter": C,
+                         "no_bias": True}),
+        "Deconvolution": ((f(B, C, H // 2, H // 2), f(C, C, 2, 2)),
+                          {"kernel": (2, 2), "stride": (2, 2),
+                           "num_filter": C, "no_bias": True}),
+        # normalization / activation
+        "BatchNorm": ((f(B, C, H, H), f(C), f(C), f(C), f(C) + 1.0),
+                      {"_train": True}),
+        "LayerNorm": ((f(B, S, U), f(U), f(U)), {"axis": -1}),
+        "softmax": ((f(B, S, S),), {"axis": -1}),
+        "log_softmax": ((f(B, N[1]),), {"axis": -1}),
+        "Activation": ((f(B, C, H, H),), {"act_type": "relu"}),
+        "LeakyReLU": ((f(B, C, H, H),), {"act_type": "leaky"}),
+        # elementwise / broadcast
+        "broadcast_add": ((f(B, C, H, H), f(1, C, 1, 1)), {}),
+        "broadcast_mul": ((f(B, C, H, H), f(1, C, 1, 1)), {}),
+        "elemwise_add": ((f(B, C, H, H), f(B, C, H, H)), {}),
+        "exp": ((f(B, C, H, H),), {}),
+        "sqrt": ((f(B, C, H, H) + 1.0,), {}),
+        "clip": ((f(B, C, H, H),), {"a_min": 0.1, "a_max": 0.9}),
+        # reductions / shape
+        "sum": ((f(B, C, H, H),), {"axis": (0, 2, 3)}),
+        "mean": ((f(B, C, H, H),), {"axis": (0, 2, 3)}),
+        "max": ((f(B, C, H, H),), {"axis": (2, 3)}),
+        "argsort": ((f(B, N[0]),), {"axis": -1}),
+        "transpose": ((f(B, C, H, H),), {"axes": (0, 2, 3, 1)}),
+        "Reshape": ((f(B, C, H, H),), {"shape": (B, C * H * H)}),
+        "concat": ((f(B, C, H, H), f(B, C, H, H)), {"dim": 1}),
+        "slice": ((f(B, C, H, H),),
+                  {"begin": (0, 0, 1, 1), "end": (B, C, H - 1, H - 1)}),
+        # indexing / embedding
+        "take": ((f(N[1], U), r.randint(0, N[1], (B, S)).astype("int32")),
+                 {}),
+        "Embedding": ((r.randint(0, N[1], (B, S)).astype("int32"),
+                       f(N[1], U)),
+                      {"input_dim": N[1], "output_dim": U}),
+        "one_hot": ((r.randint(0, N[0], (B * 8,)).astype("int32"),),
+                    {"depth": N[0]}),
+        "gather_nd": ((f(N[0], N[0]),
+                       r.randint(0, N[0], (2, 64)).astype("int32")), {}),
+        # pooling
+        "Pooling": ((f(B, C, H, H),),
+                    {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"}),
+        # loss-ish
+        "smooth_l1": ((f(B, N[0]),), {"scalar": 1.0}),
+        "SoftmaxOutput": ((f(B, N[0]),
+                           r.randint(0, N[0], (B,)).astype("float32")), {}),
+        # optimizer updates (fwd only — not differentiable)
+        "sgd_mom_update": ((f(N[1], N[0]), f(N[1], N[0]), f(N[1], N[0])),
+                           {"lr": 0.1, "momentum": 0.9, "wd": 1e-4}),
+        "adam_update": ((f(N[1], N[0]), f(N[1], N[0]), f(N[1], N[0]),
+                         f(N[1], N[0])),
+                        {"lr": 1e-3, "beta1": 0.9, "beta2": 0.999,
+                         "epsilon": 1e-8, "wd": 0.0}),
+        # vision / detection
+        "BilinearResize2D": ((f(B, C, H, H),),
+                             {"height": H * 2, "width": H * 2}),
+        "box_iou": ((f(256, 4), f(256, 4)), {"format": "corner"}),
+        "box_nms": ((np.concatenate(
+            [r.rand(1, 512, 1), r.rand(1, 512, 1),
+             np.sort(r.rand(1, 512, 4), -1)], -1).astype(np.float32),),
+            {"overlap_thresh": 0.5, "topk": 100}),
+    }
+    return sp
+
+
+def _time_call(fn, sync, repeat, number):
+    """Median over `repeat` batches of `number` calls, µs/call."""
+    best = []
+    fn()  # warm (compile/caches)
+    sync()
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        for _ in range(number):
+            out = fn()
+        sync(out)
+        best.append((time.perf_counter() - t0) / number)
+    best.sort()
+    return best[len(best) // 2] * 1e6
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated subset (default: all covered)")
+    ap.add_argument("--repeat", type=int, default=5)
+    ap.add_argument("--number", type=int, default=10)
+    ap.add_argument("--large", action="store_true",
+                    help="accelerator-scale shapes (auto on non-CPU)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.ops.registry import get_op
+
+    backend = jax.default_backend()
+    large = args.large or backend != "cpu"
+    specs = _specs(np, large)
+    names = (args.ops.split(",") if args.ops else sorted(specs))
+
+    rows = []
+    for name in names:
+        if name not in specs:
+            print(f"# no spec for {name}", file=sys.stderr)
+            continue
+        arrs, attrs = specs[name]
+        op = get_op(name)
+        jarrs = [jnp.asarray(a) for a in arrs]
+        nds = [mx.nd.array(a) for a in arrs]
+
+        def sync(out=None):
+            if out is not None:
+                jax.block_until_ready(out)
+
+        row = {"op": name, "backend": backend,
+               "shape": "x".join(str(a.shape) for a in arrs)}
+        # eager (imperative NDArray dispatch; wait_to_read = CS1 sync)
+        ndout = [None]
+
+        # private attrs (_train, ...) are supplied by the nd wrapper
+        # itself on the eager path
+        eager_attrs = {k: v for k, v in attrs.items()
+                       if not k.startswith("_")}
+
+        def eager():
+            o = getattr(mx.nd, name)(*nds, **eager_attrs)
+            ndout[0] = o[0] if isinstance(o, (list, tuple)) else o
+            return ndout[0]
+
+        row["eager_us"] = round(_time_call(
+            lambda: eager(), lambda o=None: ndout[0].wait_to_read(),
+            args.repeat, args.number), 1)
+
+        jfn = jax.jit(lambda *xs: op.fn(*xs, **attrs))
+        row["jit_fwd_us"] = round(_time_call(
+            lambda: jfn(*jarrs), sync, args.repeat, args.number), 1)
+
+        if op.differentiable:
+            def scalar_fn(*xs):
+                o = op.fn(*xs, **attrs)
+                o = o[0] if isinstance(o, (list, tuple)) else o
+                return jnp.sum(o.astype(jnp.float32))
+
+            diff_idx = [i for i, a in enumerate(jarrs)
+                        if a.dtype.kind == "f"]
+            gfn = jax.jit(jax.grad(scalar_fn, argnums=tuple(diff_idx))) \
+                if diff_idx else None
+            if gfn is not None:
+                try:
+                    row["jit_bwd_us"] = round(_time_call(
+                        lambda: gfn(*jarrs), sync, args.repeat,
+                        args.number), 1)
+                except Exception as e:  # non-diff attr combos
+                    row["jit_bwd_us"] = None
+                    row["bwd_note"] = str(e).splitlines()[0][:80]
+        rows.append(row)
+        print(json.dumps(row))
+
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump({"when": time.strftime("%Y-%m-%d %H:%M:%S"),
+                       "backend": backend, "large_shapes": large,
+                       "repeat": args.repeat, "number": args.number,
+                       "rows": rows}, fh, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,228 @@
+"""Data-parallel scaling-efficiency harness (BASELINE scaling target:
+>=90% efficiency at 256 v5e chips).
+
+Runs the SPMD train step (one jitted fwd+bwd+allreduce+update program,
+parallel.SPMDTrainer) over {1..N} processes and reports global
+throughput, per-device throughput, and efficiency vs the 1-process run.
+Weak scaling: the per-device batch is fixed, so perfect scaling doubles
+global throughput when the process count doubles.
+
+On this dev box the transport is the CPU backend + gloo over localhost
+(one virtual device per process) — that validates the harness, the
+multi-process program, and the efficiency accounting, NOT real ICI/DCN
+bandwidth.  The identical command on a v5e pod (one process per host,
+libtpu discovers local chips, DCN carries cross-host collectives):
+
+    # on every host i of an N-host v5e pod:
+    DMLC_PS_ROOT_URI=<host0-ip> DMLC_PS_ROOT_PORT=9876 \
+    DMLC_NUM_WORKER=<N> DMLC_WORKER_ID=<i> \
+    python tools/scaling_bench.py --_worker --model resnet50 \
+        --batch-per-device 256 --image-size 224 --dtype bfloat16 --steps 50
+
+(tools/launch.py -n N --launcher ssh automates exactly this env
+contract; see docs/distributed.md.)  Dev-box sweep:
+
+    python tools/scaling_bench.py --procs 1,2,4 --model resnet18
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# worker (one process of the mesh)
+# ---------------------------------------------------------------------------
+
+def worker(args):
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.parallel import dist
+
+    dist.init()
+    import jax
+
+    n_dev = jax.device_count()
+    n_proc = jax.process_count()
+    bs_global = args.batch_per_device * n_dev
+
+    rng = np.random.RandomState(0)
+    if args.model.startswith("resnet"):
+        from mxnet_tpu.gluon.model_zoo import vision
+
+        net = getattr(vision, args.model + "_v1")(classes=1000,
+                                                  layout="NHWC")
+        net.initialize(mx.initializer.Xavier(magnitude=2.0), ctx=mx.cpu())
+        with mx.autograd.pause():
+            net(mx.nd.zeros((1, 32, 32, 3)))
+        if args.dtype != "float32":
+            net.cast(args.dtype)
+        s = args.image_size
+        data = rng.rand(bs_global, s, s, 3).astype(args.dtype)
+        label = rng.randint(0, 1000, (bs_global,)).astype(np.int32)
+        loss = gloss.SoftmaxCrossEntropyLoss()
+        opt, opt_args = "sgd", {"learning_rate": 0.1, "momentum": 0.9}
+    elif args.model == "bert":
+        from mxnet_tpu.gluon import nn
+        from mxnet_tpu.gluon.block import HybridBlock
+        from mxnet_tpu.gluon.model_zoo.bert import get_bert_model
+
+        seq, vocab = args.seq_len, 30522 if args.dtype != "float32" else 1000
+        small = args.image_size < 224  # dev-box shapes
+        kw = (dict(num_layers=2, units=64, hidden_size=128, num_heads=4,
+                   max_length=seq) if small else dict(max_length=512))
+        net = get_bert_model("bert_12_768_12", vocab_size=vocab, **kw)
+        net.initialize(mx.initializer.Normal(0.02), ctx=mx.cpu())
+        with mx.autograd.pause():
+            seq_o, pooled = net(mx.nd.zeros((1, seq)),
+                                mx.nd.zeros((1, seq)), mx.nd.array([seq]))
+            net.decode_mlm(seq_o)       # resolve the head params too —
+            net.classify_nsp(pooled)    # the trainer shards ALL of them
+        if args.dtype != "float32":
+            net.cast(args.dtype)
+        data = (rng.randint(5, vocab, (bs_global, seq)).astype(np.int32),
+                np.zeros((bs_global, seq), np.int32),
+                np.full((bs_global,), seq, np.float32))
+        label = rng.randint(0, 2, (bs_global,)).astype(np.int32)
+
+        class _NSPLoss:
+            """CLS-token 2-way loss — enough to drive the full encoder
+            (SPMDTrainer hands the loss the first output: (B,S,U))."""
+
+            def __call__(self, out, y):
+                import jax as _jax
+                import jax.numpy as jnp
+
+                cls = out[:, 0, :2].astype(jnp.float32)
+                lsm = _jax.nn.log_softmax(cls, -1)
+                return -jnp.take_along_axis(
+                    lsm, y[:, None].astype(jnp.int32), -1)[:, 0]
+
+        loss = _NSPLoss()
+        opt, opt_args = "adam", {"learning_rate": 1e-4}
+    else:
+        raise SystemExit(f"unknown model {args.model}")
+
+    if not isinstance(data, tuple):
+        data = (data,)
+    mesh = parallel.make_mesh(dp=n_dev)
+    with mesh:
+        trainer = parallel.SPMDTrainer(net, loss, opt, opt_args)
+        placed = [trainer._place(a, None) for a in data + (label,)]
+        # >=1 unmeasured call: keeps compilation out of the timed window
+        # and binds `lv` even for --warmup 0
+        for _ in range(max(args.warmup, 1)):
+            lv = trainer.step(*placed)
+        lv.asnumpy()
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            lv = trainer.step(*placed)
+        lval = float(lv.asnumpy())
+        dt = time.perf_counter() - t0
+
+    tp = bs_global * args.steps / dt
+    if jax.process_index() == 0:
+        print(json.dumps({
+            "model": args.model, "processes": n_proc, "devices": n_dev,
+            "batch_per_device": args.batch_per_device,
+            "global_throughput": round(tp, 2),
+            "per_device_throughput": round(tp / n_dev, 2),
+            "unit": "samples/s", "loss": round(lval, 4),
+        }), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: localhost sweep over process counts
+# ---------------------------------------------------------------------------
+
+def _spawn_sweep(args, n):
+    port = str(_free_port())
+    procs = []
+    for i in range(n):
+        env = dict(os.environ)
+        env["PALLAS_AXON_POOL_IPS"] = ""   # detach the single-client chip
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        env.update({"DMLC_ROLE": "worker", "DMLC_PS_ROOT_URI": "127.0.0.1",
+                    "DMLC_PS_ROOT_PORT": port, "DMLC_NUM_WORKER": str(n),
+                    "DMLC_WORKER_ID": str(i)})
+        cmd = [sys.executable, os.path.abspath(__file__), "--_worker",
+               "--model", args.model, "--steps", str(args.steps),
+               "--warmup", str(args.warmup),
+               "--batch-per-device", str(args.batch_per_device),
+               "--image-size", str(args.image_size),
+               "--seq-len", str(args.seq_len), "--dtype", args.dtype]
+        procs.append(subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT, text=True))
+    line = None
+    for p in procs:
+        out, _ = p.communicate(timeout=args.proc_timeout)
+        if p.returncode != 0:
+            tail = "\n".join(out.splitlines()[-12:])
+            raise RuntimeError(f"worker rc={p.returncode}:\n{tail}")
+        for ln in out.splitlines():
+            if ln.startswith("{"):
+                line = ln
+    return json.loads(line)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18",
+                    choices=["resnet18", "resnet50", "bert"])
+    ap.add_argument("--procs", default="1,2,4",
+                    help="comma-separated process counts for the sweep")
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--batch-per-device", type=int, default=8)
+    ap.add_argument("--image-size", type=int, default=64)
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--proc-timeout", type=float, default=900.0)
+    ap.add_argument("--out", default=os.path.join(_REPO, "SCALING.json"))
+    ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args._worker:
+        return worker(args)
+
+    results = []
+    counts = sorted({int(x) for x in args.procs.split(",")})
+    base = base_n = None
+    for n in counts:
+        res = _spawn_sweep(args, n)
+        if base is None:  # smallest count is the efficiency reference
+            base, base_n = res["per_device_throughput"], n
+        res[f"efficiency_vs_{base_n}proc"] = round(
+            res["per_device_throughput"] / base, 4)
+        results.append(res)
+        print(json.dumps(res))
+
+    with open(args.out, "w") as f:
+        json.dump({"when": time.strftime("%Y-%m-%d %H:%M:%S"),
+                   "backend": "cpu+gloo localhost (dev box)",
+                   "note": "validates harness+program, not ICI/DCN "
+                           "bandwidth; see docstring for the pod command",
+                   "sweep": results}, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
