@@ -20,6 +20,7 @@ Design notes (idiomatic TPU, not a port):
 """
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional, Sequence, Tuple, Union
 
 import jax
@@ -38,6 +39,110 @@ _DTYPE_ALIASES = {
     "int32": jnp.int32, "int64": jnp.int64, "bool": jnp.bool_,
     None: jnp.float32,
 }
+
+
+_INT32_MAX = 2 ** 31 - 1
+
+
+def _normalize_basic_key(pval, key):
+    """(starts, limits, strides, squeeze) tuples for a fully-basic key,
+    or None when the key has advanced components / negative steps."""
+    ks = key if isinstance(key, tuple) else (key,)
+    if len(ks) > pval.ndim or not all(
+            isinstance(k, (int, np.integer, slice)) for k in ks):
+        return None
+    starts, limits, strides, squeeze = [], [], [], []
+    for i, k in enumerate(ks):
+        n = pval.shape[i]
+        if isinstance(k, slice):
+            st, sp, stp = k.indices(n)
+            if stp <= 0 or sp < st:
+                return None
+            starts.append(st)
+            limits.append(sp)
+            strides.append(stp)
+        else:
+            k = int(k) + (n if int(k) < 0 else 0)
+            starts.append(k)
+            limits.append(k + 1)
+            strides.append(1)
+            squeeze.append(i)
+    for i in range(len(ks), pval.ndim):
+        starts.append(0)
+        limits.append(pval.shape[i])
+        strides.append(1)
+    return tuple(starts), tuple(limits), tuple(strides), tuple(squeeze)
+
+
+@functools.lru_cache(maxsize=256)
+def _big_slice_fn(starts, limits, strides, squeeze):
+    # one jitted fn per distinct slice spec: the lru_cache keeps the
+    # function identity stable so jax's own jit cache hits on repeat
+    return jax.jit(lambda x: jax.lax.squeeze(
+        jax.lax.slice(x, starts, limits, strides), squeeze))
+
+
+def _index_value(pval, key):
+    """pval[key], with a large-offset escape hatch: eager jax lowers even
+    static basic slices through dynamic_slice, whose runtime start
+    indices are int32 — any offset past 2^31 overflows (nightly
+    test_single_dim_beyond_2g_static_slice).  For overflow-risk BASIC
+    keys the slice runs as a jitted lax.slice instead, where the bounds
+    are static HLO attributes; the jitted fns are lru-cached per slice
+    spec so repeated reads (view refreshes) compile once."""
+    if max(pval.shape, default=0) <= _INT32_MAX:
+        return pval[key]
+    norm = _normalize_basic_key(pval, key)
+    if norm is None:
+        return pval[key]
+    return _big_slice_fn(*norm)(pval)
+
+
+@functools.lru_cache(maxsize=256)
+def _big_update_fn(shape, ax, norm):
+    starts, limits, strides, squeeze = norm
+    st, sp = starts[ax], limits[ax]
+    inner_key = tuple(
+        (0 if i in squeeze else slice(None)) if i == ax
+        else (starts[i] if i in squeeze else slice(starts[i], limits[i]))
+        for i in range(len(shape)))
+
+    def fn(x, v):
+        # static lax.slice bounds are int64-safe HLO attributes; the
+        # band's own dims are all < 2^31 so the normal scatter applies
+        pre = jax.lax.slice_in_dim(x, 0, st, axis=ax)
+        band = jax.lax.slice_in_dim(x, st, sp, axis=ax)
+        band = band.at[inner_key].set(v)
+        post = jax.lax.slice_in_dim(x, sp, shape[ax], axis=ax)
+        return jnp.concatenate([pre, band, post], axis=ax)
+
+    return jax.jit(fn)
+
+
+def _update_value(pval, key, value):
+    """Functional basic-key update (`pval.at[key].set(value)`) that stays
+    CORRECT on arrays with a dimension past 2^31-1.
+
+    jnp's eager scatter converts indices to int32 on the x32 default:
+    past-2^31 offsets raise OverflowError, and — measurably worse — even
+    SMALL-offset writes on a >2^31 dim are silently DROPPED (the clamp
+    arithmetic overflows).  Here the huge axis is handled by static
+    slicing the target band out, updating inside it (every dim small
+    again), and concatenating back; non-basic keys on such arrays get a
+    loud error instead of corruption."""
+    if max(pval.shape, default=0) <= _INT32_MAX:
+        return pval.at[key].set(value)
+    norm = _normalize_basic_key(pval, key)
+    big = [i for i, d in enumerate(pval.shape) if d > _INT32_MAX]
+    if norm is None or len(big) != 1 \
+            or any(s != 1 for s in norm[2]):
+        raise MXNetError(
+            "indexed assignment on an array with a dimension > 2^31-1 "
+            "supports only basic, step-1 indexing with one oversized "
+            f"dimension (shape {pval.shape}, key {key!r}); jax's int32 "
+            "index path would silently corrupt this write — reshape to "
+            "dims under 2^31 for advanced indexing")
+    return _big_update_fn(pval.shape, big[0], norm)(pval, value)
 
 
 def _resolve_dtype(dtype):
@@ -133,7 +238,8 @@ class NDArray:
         pval = base._data  # refreshes the parent chain first
         value = jnp.asarray(value)
         if kind == "index":
-            base._data = pval.at[arg].set(value.astype(pval.dtype))
+            base._data = _update_value(pval, arg,
+                                        value.astype(pval.dtype))
         else:  # reshape
             base._data = value.astype(pval.dtype).reshape(pval.shape)
         self._pversion = -1  # force re-derive on next read
@@ -145,7 +251,8 @@ class NDArray:
         if self._pversion == parent._version:
             return
         kind, arg = self._vspec
-        self._buf = pval[arg] if kind == "index" else pval.reshape(arg)
+        self._buf = _index_value(pval, arg) if kind == "index" \
+            else pval.reshape(arg)
         self._pversion = parent._version
         self._version += 1
 
@@ -160,7 +267,8 @@ class NDArray:
         out._ag_grad = None
         out._ag_node = None
         pval = self._data
-        out._buf = pval[arg] if kind == "index" else pval.reshape(arg)
+        out._buf = _index_value(pval, arg) if kind == "index" \
+            else pval.reshape(arg)
         out._pversion = self._version
         return out
 
@@ -667,7 +775,7 @@ class NDArray:
         if self._is_basic_key(key) and self._eager_views():
             # basic indexing aliases the base (ref: NDArray::Slice/At)
             return self._make_view("index", key)
-        out = self._data[key]
+        out = _index_value(self._data, key)
         return NDArray(out, ctx=self._ctx)
 
     def __setitem__(self, key, value):
@@ -680,7 +788,8 @@ class NDArray:
             v = jnp.broadcast_to(jnp.asarray(value, self._data.dtype), self.shape)
             self._data = jax.device_put(v, self._ctx.jax_device)
         else:
-            self._data = self._data.at[key].set(jnp.asarray(value, self._data.dtype))
+            self._data = _update_value(
+                self._data, key, jnp.asarray(value, self._data.dtype))
 
     def __iter__(self):
         for i in range(len(self)):

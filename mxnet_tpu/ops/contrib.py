@@ -415,13 +415,20 @@ def _roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
 @register_op("ROIAlign", aliases=("_contrib_ROIAlign",))
 def _roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
                sample_ratio=2, position_sensitive=False, aligned=False):
-    """Bilinear ROI align (ref: contrib/roi_align.cc)."""
-    if position_sensitive:
-        raise NotImplementedError(
-            "position-sensitive ROIAlign (R-FCN) is not implemented")
+    """Bilinear ROI align (ref: contrib/roi_align.cc).
+
+    position_sensitive=True is the R-FCN variant: input channels are
+    C = C_out * ph * pw score maps, and pooled cell (py, px) of output
+    channel c reads input channel c*ph*pw + py*pw + px (the reference's
+    channel indexing in roi_align.cc)."""
     ph, pw = pooled_size
     sr = max(int(sample_ratio), 1)
     b, c, h, w = data.shape
+    if position_sensitive and c % (ph * pw) != 0:
+        raise MXNetError(
+            f"position_sensitive ROIAlign needs channels divisible by "
+            f"pooled_h*pooled_w; got C={c}, pooled={ph}x{pw}")
+    c_out = c // (ph * pw) if position_sensitive else c
     off = 0.5 if aligned else 0.0
 
     def bilinear(img, y, x):
@@ -456,12 +463,17 @@ def _roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
             yg, xg = jnp.meshgrid(ys, xs, indexing="ij")
             vals = jax.vmap(lambda yy, xx: bilinear(img, yy, xx))(
                 yg.ravel(), xg.ravel())  # (sr*sr, C)
+            if position_sensitive:
+                # each output channel reads its (py,px)-specific score map
+                ch = (jnp.arange(c_out) * (ph * pw)
+                      + py.astype(jnp.int32) * pw + px.astype(jnp.int32))
+                vals = vals[:, ch]
             return vals.mean(axis=0)
 
         py, px = jnp.meshgrid(jnp.arange(ph, dtype=jnp.float32),
                               jnp.arange(pw, dtype=jnp.float32),
                               indexing="ij")
-        vals = jax.vmap(jax.vmap(cell))(py, px)  # (ph, pw, C)
+        vals = jax.vmap(jax.vmap(cell))(py, px)  # (ph, pw, C_out)
         return jnp.transpose(vals, (2, 0, 1))
 
     return jax.vmap(one_roi)(rois)

@@ -103,6 +103,15 @@ class ShardingRules:
         for pat, spec in self.rules:
             if pat.match(name):
                 s = _filter_spec(spec, mesh)
+                if (any(e is not None for e in spec)
+                        and self._split_factor(s, mesh) == 1):
+                    # the rule is vacuous on this mesh — its axes are
+                    # absent or size 1 (e.g. the embed->tp rule on a
+                    # dp/fsdp mesh, or tp=1): fall through so the fsdp
+                    # fallback can still shard the param.  An EXPLICIT
+                    # P() rule (deliberate replication) is not vacuous
+                    # and still pins.
+                    continue
                 if self._divisible(shape, s, mesh):
                     return s
         if "fsdp" in mesh and mesh.size("fsdp") > 1 and shape:
@@ -118,6 +127,17 @@ class ShardingRules:
                         dims[i] = "fsdp"
                         return P(*dims)
         return P()
+
+    @staticmethod
+    def _split_factor(spec: P, mesh: DeviceMesh) -> int:
+        """Total ways the spec actually splits data on this mesh."""
+        k = 1
+        for entry in spec:
+            if entry is None:
+                continue
+            for a in (entry if isinstance(entry, tuple) else (entry,)):
+                k *= mesh.size(a)
+        return k
 
     @staticmethod
     def _divisible(shape, spec: P, mesh: DeviceMesh) -> bool:

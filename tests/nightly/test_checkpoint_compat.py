@@ -1,0 +1,66 @@
+"""Checkpoint backwards-compatibility (ref:
+tests/nightly/model_backwards_compatibility_check): the committed
+fixtures under fixtures/ were written by an earlier era's serializers
+(tools/gen_compat_fixtures.py, run once and committed); every later
+round must still load them byte-for-byte and reproduce the recorded
+outputs exactly."""
+import json
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, model, nd
+
+FIX = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+def _expect():
+    with open(os.path.join(FIX, "expect.json")) as f:
+        return json.load(f)
+
+
+def test_symbolic_checkpoint_loads_and_reproduces():
+    exp = _expect()["symbolic"]
+    net, arg_params, aux_params = model.load_checkpoint(
+        os.path.join(FIX, "mlp"), 1)
+    assert aux_params == {}
+    for k, v in exp["arg_sample"].items():
+        np.testing.assert_allclose(
+            arg_params[k].asnumpy().ravel()[0], v, rtol=1e-6)
+    x = nd.array(np.array(exp["input"], np.float32))
+    ex = net.bind(mx.cpu(), {"data": x, **arg_params})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, np.array(exp["output"], np.float32),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gluon_parameters_load_and_reproduce():
+    exp = _expect()["gluon"]
+    net = gluon.nn.HybridSequential(prefix="compat_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(8, activation="relu", in_units=6))
+        net.add(gluon.nn.Dense(4, in_units=8))
+    net.load_parameters(os.path.join(FIX, "gluon_mlp.params"),
+                        ctx=mx.cpu())
+    x = nd.array(np.array(exp["input"], np.float32))
+    np.testing.assert_allclose(net(x).asnumpy(),
+                               np.array(exp["output"], np.float32),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_trainer_states_load():
+    exp = _expect()["trainer"]
+    net = gluon.nn.HybridSequential(prefix="compat_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(8, activation="relu", in_units=6))
+        net.add(gluon.nn.Dense(4, in_units=8))
+    net.load_parameters(os.path.join(FIX, "gluon_mlp_post_step.params"),
+                        ctx=mx.cpu())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    trainer.load_states(os.path.join(FIX, "trainer.states"))
+    x = nd.array(np.array(_expect()["gluon"]["input"], np.float32))
+    np.testing.assert_allclose(
+        net(x).asnumpy(), np.array(exp["post_step_output"], np.float32),
+        rtol=1e-5, atol=1e-6)

@@ -1,0 +1,101 @@
+"""Large-tensor boundary tests (ref: tests/nightly/test_large_array.py —
+arrays past 2^31 ELEMENTS, the int32-offset boundary).
+
+Layout mirrors the reference's regime: total size crosses 2^31 while
+every DIMENSION stays under 2^31 (its LARGE_X * SMALL_Y shapes) — the
+regime all indexing ops support on the x32 jax default.  Per-dimension
+sizes past 2^31 are a narrower surface: static slicing works at any
+offset, but dynamic indexing (take/gather) is capped per-dim by int32
+index arithmetic — asserted and documented here (docs/sparse.md notes
+the same class of ceiling; the reference gates the equivalent behind its
+USE_INT64_TENSOR_SIZE build flag, SURVEY §5 config tiers).
+
+int8 keeps each big array ~2.1GB so the lane runs in a dev-box RAM
+budget (~8GB peak) while still crossing the element-count boundary.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+# 2^16 * (2^15 + 8) = 2^31 + 2^19 elements > 2^31, dims < 2^31
+ROWS, COLS = 2 ** 16, 2 ** 15 + 8
+TOTAL = ROWS * COLS
+
+
+def test_total_elements_cross_2g_slice_and_reduce():
+    x = nd.zeros((ROWS, COLS), dtype="int8")
+    assert x.size == TOTAL > 2 ** 31
+    # plant values in the far corner (beyond flat offset 2^31)
+    x[ROWS - 1, COLS - 4:] = 3
+    tail = x[ROWS - 1, COLS - 8:].asnumpy()
+    np.testing.assert_array_equal(tail, [0, 0, 0, 0, 3, 3, 3, 3])
+    # row-reduction touching every element; int64 accumulator via dtype
+    s = x.sum(axis=1)
+    assert s.shape == (ROWS,)
+    assert int(s[ROWS - 1].asnumpy()) == 12
+    assert int(s[0].asnumpy()) == 0
+
+
+def test_take_rows_beyond_2g_flat_offset():
+    x = nd.zeros((ROWS, COLS), dtype="int8")
+    x[ROWS - 1, 0] = 7
+    got = nd.take(x, nd.array([0, ROWS - 1], dtype="int32"))
+    assert got.shape == (2, COLS)
+    assert int(got[1, 0].asnumpy()) == 7
+    assert int(got[0, 0].asnumpy()) == 0
+
+
+def test_argmax_and_broadcast_at_scale():
+    x = nd.zeros((ROWS, COLS), dtype="int8")
+    x[ROWS - 2, COLS - 2] = 5
+    am = nd.argmax(x.reshape((ROWS, COLS)), axis=0)
+    assert int(am[COLS - 2].asnumpy()) == ROWS - 2
+    y = nd.broadcast_add(x, nd.ones((1, COLS), dtype="int8"))
+    assert int(y[ROWS - 2, COLS - 2].asnumpy()) == 6
+    assert int(y[0, 0].asnumpy()) == 1
+
+
+def test_single_dim_beyond_2g_static_slice():
+    """>2^31 in ONE dim: allocation + static slicing work at any offset
+    (slice bounds are python ints, not device int32)."""
+    n = 2 ** 31 + 64
+    x = nd.zeros((n,), dtype="int8")
+    assert x.shape == (n,)
+    tail = x[n - 4:n].asnumpy()
+    np.testing.assert_array_equal(tail, [0, 0, 0, 0])
+    mid = x[2 ** 31: 2 ** 31 + 4]
+    assert mid.shape == (4,)
+
+
+def test_single_dim_beyond_2g_writes():
+    """Basic-key writes on a >2^31 dim must be CORRECT at every offset:
+    raw jnp silently DROPS even small-offset writes here (int32 clamp
+    overflow) and raises OverflowError past 2^31 — the NDArray update
+    path routes through static slice+concat instead.  Advanced-key
+    writes refuse loudly rather than corrupt."""
+    import pytest
+
+    n = 2 ** 31 + 64
+    x = nd.zeros((n,), dtype="int8")
+    x[5] = 1                       # raw jnp silently no-ops this one
+    x[n - 3] = 2                   # raw jnp raises OverflowError here
+    x[2 ** 31 + 4:2 ** 31 + 8] = 3
+    assert int(x[5].asnumpy()) == 1
+    assert int(x[4].asnumpy()) == 0
+    assert int(x[n - 3].asnumpy()) == 2
+    np.testing.assert_array_equal(
+        x[2 ** 31 + 2:2 ** 31 + 10].asnumpy(),
+        [0, 0, 3, 3, 3, 3, 0, 0])
+    with pytest.raises(mx.MXNetError, match="2\\^31"):
+        x[nd.array([1, 2], dtype="int32")] = 9
+
+
+def test_reshape_transpose_roundtrip_at_scale():
+    x = nd.zeros((ROWS, COLS), dtype="int8")
+    x[123, 456] = 9
+    y = x.reshape((COLS, ROWS))
+    z = y.reshape((ROWS, COLS))
+    assert int(z[123, 456].asnumpy()) == 9
+    t = nd.transpose(x, axes=(1, 0))
+    assert int(t[456, 123].asnumpy()) == 9

@@ -323,3 +323,30 @@ def test_psroi_pooling():
     with pytest.raises(mx.MXNetError, match="channels"):
         nd.PSROIPooling(nd.array(data[:, :17]), rois, output_dim=od,
                         pooled_size=k)
+
+
+def test_roi_align_position_sensitive():
+    """R-FCN ROIAlign: pooled cell (i, j) of output channel c reads score
+    map c*k*k + i*k + j only — constant-per-map input makes the oracle
+    exact regardless of sampling positions (bilinear of a constant)."""
+    od, k = 2, 3
+    b, h, w = 1, 9, 9
+    data = np.zeros((b, od * k * k, h, w), np.float32)
+    for c in range(od):
+        for i in range(k):
+            for j in range(k):
+                data[0, (c * k + i) * k + j] = c * 100 + i * 10 + j
+    rois = nd.array(np.array([[0, 0, 0, 8, 8]], np.float32))
+    out = nd.ROIAlign(nd.array(data), rois, pooled_size=(k, k),
+                      spatial_scale=1.0, sample_ratio=2,
+                      position_sensitive=True)
+    assert out.shape == (1, od, k, k)
+    o = out.asnumpy()[0]
+    for c in range(od):
+        for i in range(k):
+            for j in range(k):
+                np.testing.assert_allclose(o[c, i, j], c * 100 + i * 10 + j,
+                                           rtol=1e-5)
+    with pytest.raises(mx.MXNetError, match="divisible"):
+        nd.ROIAlign(nd.array(data[:, :17]), rois, pooled_size=(k, k),
+                    position_sensitive=True)

@@ -1,0 +1,110 @@
+"""Sparse/embedding SCALE story (SURVEY §7 hard part 4, VERDICT r3 #6).
+
+The reference scales big embeddings with PS-sharded row_sparse params
+(kvstore_dist_server holding row shards).  The TPU-native counterpart is
+GSPMD: the embedding table shards its vocab dim over the mesh ('tp'
+rule, or the fsdp fallback), XLA turns the lookup into a collective
+gather and the gradient into a scatter onto the owning shard — no
+parameter server.  These tests pin that whole path on the 8-virtual-
+device CPU mesh:
+
+  * the DEFAULT_RULES map `*embed*weight` onto a vocab-sharded layout,
+    so each device holds 1/8 of the table (the memory-scale claim:
+    a table 8x one device's dense capacity fits the mesh);
+  * a full SPMDTrainer step over the sharded table produces the same
+    loss trajectory as the replicated run (gather+scatter correctness).
+
+The dense-backed RowSparseNDArray stays a single-device parity surface
+(documented ceiling in docs/sparse.md) — scale goes through this path.
+"""
+import numpy as np
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+from mxnet_tpu.gluon import nn, loss as gloss
+from mxnet_tpu.gluon.block import HybridBlock
+from mxnet_tpu.parallel.sharding import DEFAULT_RULES, PartitionSpec as P
+
+VOCAB, DIM, BS, SEQ = 4096, 16, 8, 12
+
+
+class TinyLM(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        with self.name_scope():
+            self.embed = nn.Embedding(VOCAB, DIM)
+            self.head = nn.Dense(4, flatten=False)
+
+    def hybrid_forward(self, F, tokens):
+        x = self.embed(tokens)
+        return self.head(F.mean(x, axis=1))
+
+
+def _build():
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = TinyLM()
+    net.initialize(mx.initializer.Normal(0.02), ctx=mx.cpu())
+    with mx.autograd.pause():
+        net(mx.nd.zeros((1, SEQ)))
+    return net
+
+
+def _run_steps(mesh_axes, n_steps=3):
+    net = _build()
+    rng = np.random.RandomState(1)
+    toks = rng.randint(0, VOCAB, (BS, SEQ)).astype(np.int32)
+    labels = rng.randint(0, 4, (BS,)).astype(np.int32)
+    losses = []
+    with parallel.make_mesh(**mesh_axes):
+        trainer = parallel.SPMDTrainer(
+            net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.5})
+        t, y = trainer._place(toks, None), trainer._place(labels, None)
+        for _ in range(n_steps):
+            losses.append(float(trainer.step(t, y).asnumpy()))
+        emb_name = next(n for n in trainer.params if "embed" in n
+                        and n.endswith("weight"))
+        emb = trainer.params[emb_name]
+    return losses, emb
+
+
+def test_embed_rule_shards_vocab_dim():
+    with parallel.make_mesh(tp=8) as mesh:
+        spec = DEFAULT_RULES.spec_for("tinylm0_embedding0_weight",
+                                      (VOCAB, DIM), mesh)
+    assert spec == P("tp", None)
+
+
+def test_vocab_sharded_embedding_holds_one_eighth_per_device():
+    _, emb = _run_steps({"tp": 8}, n_steps=1)
+    shards = emb.addressable_shards
+    assert len(shards) == 8
+    # each device holds 1/8 of the rows: a table 8x one device's dense
+    # capacity fits this mesh — the PS-sharded row_sparse scale story
+    assert shards[0].data.shape == (VOCAB // 8, DIM)
+    rows = sorted(s.index[0].start or 0 for s in shards)
+    assert rows == [i * (VOCAB // 8) for i in range(8)]
+
+
+def test_size1_axis_rule_is_vacuous_falls_to_fsdp():
+    # tp EXISTS but at size 1: the embed->tp rule splits nothing, so the
+    # fsdp fallback must still shard the table
+    with parallel.make_mesh(tp=1, fsdp=4) as mesh:
+        spec = DEFAULT_RULES.spec_for("tinylm0_embedding0_weight",
+                                      (VOCAB, DIM), mesh)
+    assert spec == P("fsdp", None)
+
+
+def test_fsdp_fallback_also_shards_the_table():
+    _, emb = _run_steps({"dp": 2, "fsdp": 4}, n_steps=1)
+    sizes = {s.data.shape for s in emb.addressable_shards}
+    assert sizes == {(VOCAB // 4, DIM)}  # largest dim over fsdp=4
+
+
+def test_sharded_embedding_matches_replicated_training():
+    ref, _ = _run_steps({"dp": 1})         # replicated baseline
+    tp, _ = _run_steps({"tp": 8})          # vocab-sharded table
+    np.testing.assert_allclose(tp, ref, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,86 @@
+"""Generate the checkpoint backwards-compatibility fixtures
+(tests/nightly/fixtures/): a symbolic checkpoint (prefix-symbol.json +
+prefix-0001.params), a Gluon save_parameters file, Trainer optimizer
+states, and an expectations JSON with exact sampled values.
+
+Run ONCE per era and COMMIT the outputs — future rounds load these bytes
+to prove the serialization formats still read older-era files (ref:
+tests/nightly/model_backwards_compatibility_check).  Regenerating
+overwrites the era being guarded, so only do it intentionally.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+FIX = os.path.join(_REPO, "tests", "nightly", "fixtures")
+
+
+def main():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, model, nd, symbol as sym
+
+    os.makedirs(FIX, exist_ok=True)
+    np.random.seed(42)
+    mx.random.seed(42)
+    expect = {}
+
+    # ---- symbolic checkpoint (model.save_checkpoint format) ----
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    arg_params = {
+        "fc1_weight": nd.array(np.random.randn(8, 6).astype("float32")),
+        "fc1_bias": nd.array(np.random.randn(8).astype("float32")),
+        "fc2_weight": nd.array(np.random.randn(4, 8).astype("float32")),
+        "fc2_bias": nd.array(np.random.randn(4).astype("float32")),
+    }
+    prefix = os.path.join(FIX, "mlp")
+    model.save_checkpoint(prefix, 1, net, arg_params, {})
+    x = np.random.rand(2, 6).astype("float32")
+    ex = net.bind(mx.cpu(), {"data": nd.array(x), **arg_params})
+    out = ex.forward()[0].asnumpy()
+    expect["symbolic"] = {
+        "input": x.tolist(), "output": out.tolist(),
+        "arg_sample": {k: float(v.asnumpy().ravel()[0])
+                       for k, v in arg_params.items()},
+    }
+
+    # ---- gluon save_parameters ----
+    gnet = gluon.nn.HybridSequential(prefix="compat_")
+    with gnet.name_scope():
+        gnet.add(gluon.nn.Dense(8, activation="relu", in_units=6))
+        gnet.add(gluon.nn.Dense(4, in_units=8))
+    gnet.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    gnet(nd.array(x))
+    gpath = os.path.join(FIX, "gluon_mlp.params")
+    gnet.save_parameters(gpath)
+    expect["gluon"] = {"input": x.tolist(),
+                       "output": gnet(nd.array(x)).asnumpy().tolist()}
+
+    # ---- trainer optimizer states ----
+    trainer = gluon.Trainer(gnet.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    with autograd.record():
+        loss = (gnet(nd.array(x)) ** 2).sum()
+    loss.backward()
+    trainer.step(2)
+    spath = os.path.join(FIX, "trainer.states")
+    trainer.save_states(spath)
+    expect["trainer"] = {
+        "post_step_output": gnet(nd.array(x)).asnumpy().tolist()}
+    gnet.save_parameters(os.path.join(FIX, "gluon_mlp_post_step.params"))
+
+    with open(os.path.join(FIX, "expect.json"), "w") as f:
+        json.dump(expect, f, indent=1)
+    print(f"fixtures written to {FIX}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""CI-style runner for the nightly tier (ref: the reference's nightly
+Jenkins lane): large-array boundary tests + checkpoint backwards
+compatibility.  Writes NIGHTLY.json with the tally.
+
+    python tools/run_nightly.py [--out NIGHTLY.json]
+
+Memory: the large-array lane peaks around ~8GB host RAM (int8 arrays
+crossing the 2^31-element boundary).  Runtime: minutes, dominated by
+whole-array reductions on one core.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(_REPO, "NIGHTLY.json"))
+    ap.add_argument("--timeout", type=float, default=3600.0)
+    args = ap.parse_args()
+
+    env = dict(os.environ, MXNET_NIGHTLY="1")
+    t0 = time.time()
+    p = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/nightly", "-v",
+         "--tb=line"],
+        capture_output=True, text=True, timeout=args.timeout, cwd=_REPO,
+        env=env)
+    out = p.stdout
+    cases = dict(re.findall(
+        r"tests/nightly/\S+::(\S+)\s+(PASSED|FAILED|SKIPPED|ERROR)", out))
+    tally = {k: int(m.group(1)) if (m := re.search(rf"(\d+) {k}", out))
+             else 0 for k in ("passed", "failed", "skipped")}
+    artifact = {"when": time.strftime("%Y-%m-%d %H:%M:%S"),
+                "duration_s": round(time.time() - t0, 1),
+                "returncode": p.returncode, **tally, "cases": cases}
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(out.splitlines()[-1] if out.splitlines() else "")
+    print(f"wrote {args.out}")
+    return 0 if p.returncode == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,8 +83,9 @@ def worker(args):
         from mxnet_tpu.gluon.block import HybridBlock
         from mxnet_tpu.gluon.model_zoo.bert import get_bert_model
 
-        seq, vocab = args.seq_len, 30522 if args.dtype != "float32" else 1000
+        seq = args.seq_len
         small = args.image_size < 224  # dev-box shapes
+        vocab = 1000 if small else 30522
         kw = (dict(num_layers=2, units=64, hidden_size=128, num_heads=4,
                    max_length=seq) if small else dict(max_length=512))
         net = get_bert_model("bert_12_768_12", vocab_size=vocab, **kw)
@@ -172,14 +173,21 @@ def _spawn_sweep(args, n):
         procs.append(subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                                       stderr=subprocess.STDOUT, text=True))
     line = None
-    for p in procs:
-        out, _ = p.communicate(timeout=args.proc_timeout)
-        if p.returncode != 0:
-            tail = "\n".join(out.splitlines()[-12:])
-            raise RuntimeError(f"worker rc={p.returncode}:\n{tail}")
-        for ln in out.splitlines():
-            if ln.startswith("{"):
-                line = ln
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=args.proc_timeout)
+            if p.returncode != 0:
+                tail = "\n".join(out.splitlines()[-12:])
+                raise RuntimeError(f"worker rc={p.returncode}:\n{tail}")
+            for ln in out.splitlines():
+                if ln.startswith("{"):
+                    line = ln
+    finally:
+        # a dead rank leaves the siblings blocked in a collective; never
+        # leak them (they'd also hold the coordinator port)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     return json.loads(line)
 
 
