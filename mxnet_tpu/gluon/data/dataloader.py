@@ -2,14 +2,24 @@
 
 The reference uses fork()ed worker processes with NDArrays in POSIX shm
 (CPUSharedStorage) to parallelise decode/augment.  Forking a process that
-holds a PjRt/TPU client is unsafe, so this loader parallelises with a
-thread pool + double-buffered prefetch: batchify runs in numpy (releases
-the GIL for decode/augment-heavy datasets), and only the assembled batch
-is handed to the device.  The C++ RecordIO pipeline (src/io, see native/)
-is the high-throughput path for ImageNet-style training.
+holds a PjRt/TPU client is unsafe, so this loader offers two pools:
+
+  * worker_pool="thread" (default): N worker threads + double-buffered
+    prefetch.  Full speed when `__getitem__` releases the GIL
+    (numpy/cv2/PIL decode); a PURE-python transform serializes on the
+    GIL — measured crossover in docs/data.md.
+  * worker_pool="process": persistent spawn()-based process pool (spawn,
+    not fork, so no PjRt client is inherited; children run CPU-only
+    jax).  Escapes the GIL for python-heavy `__getitem__` at the cost of
+    one-time worker startup (a jax import per worker) and pickling the
+    batch across the pipe (the reference ships it through shm instead).
+
+The C++ RecordIO pipeline (src/io, see native/) remains the
+high-throughput path for ImageNet-style training.
 """
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Callable, Optional
@@ -21,6 +31,59 @@ from ...ndarray.ndarray import NDArray, array as nd_array
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 __all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def _numpy_batchify(data):
+    """Child-process batchify: same stacking/dtype rules as
+    default_batchify_fn but producing numpy (NDArray construction — and
+    with it any jax device touch — stays in the parent)."""
+    if isinstance(data[0], tuple):
+        return tuple(_numpy_batchify(list(d)) for d in zip(*data))
+    if isinstance(data[0], NDArray):
+        data = [d.asnumpy() for d in data]
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    if arr.dtype == np.int64:
+        arr = arr.astype(np.int32)
+    return arr
+
+
+# spawn-child globals (one dataset/batchify per worker process)
+_MP_STATE: dict = {}
+
+
+def _mp_init(dataset, batchify_fn):
+    # Runs in EVERY worker — including ones the Pool maintenance thread
+    # respawns later with the parent's normal env — so the TPU-safety
+    # pinning must happen here, not around Pool construction.  jax is
+    # already imported by the module bootstrap, but backends attach
+    # lazily; the config override below is what the test conftest uses
+    # for the same purpose and wins over plain env vars.
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    _MP_STATE["dataset"] = dataset
+    _MP_STATE["batchify"] = batchify_fn
+
+
+def _mp_make_batch(indices):
+    ds, bfn = _MP_STATE["dataset"], _MP_STATE["batchify"]
+    out = bfn([ds[i] for i in indices])
+
+    def dend(x):  # NDArray from a custom batchify -> cheap-pickling numpy
+        if isinstance(x, NDArray):
+            return x.asnumpy()
+        if isinstance(x, tuple):
+            return tuple(dend(e) for e in x)
+        return x
+
+    return dend(out)
 
 
 def default_batchify_fn(data):
@@ -46,9 +109,17 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False, timeout=120):
+                 thread_pool=False, timeout=120, worker_pool=None):
         self._dataset = dataset
         self._timeout = timeout
+        if worker_pool is None:
+            worker_pool = "thread"  # docs/data.md: default rationale
+        if thread_pool:
+            worker_pool = "thread"  # reference-compat flag
+        if worker_pool not in ("thread", "process"):
+            raise MXNetError("worker_pool must be 'thread' or 'process'")
+        self._worker_pool = worker_pool
+        self._pool = None  # persistent spawn pool (created lazily)
         if batch_sampler is None:
             if batch_size is None:
                 raise MXNetError("batch_size is required when batch_sampler "
@@ -78,7 +149,65 @@ class DataLoader:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
             return
-        yield from self._threaded_iter()
+        if self._worker_pool == "process":
+            yield from self._process_iter()
+        else:
+            yield from self._threaded_iter()
+
+    # ---- spawn-based process pool ---------------------------------------
+    def _get_pool(self):
+        if self._pool is None:
+            import multiprocessing as mp
+
+            ctx = mp.get_context("spawn")
+            bfn = self._batchify_fn
+            if bfn is default_batchify_fn:
+                bfn = _numpy_batchify  # NDArray assembly stays parent-side
+            # children must never attach the (single-client) TPU:
+            # _mp_init pins the CPU backend inside every worker (also
+            # the ones the pool respawns later), so no parent-side env
+            # juggling is needed here
+            self._pool = ctx.Pool(self._num_workers, initializer=_mp_init,
+                                  initargs=(self._dataset, bfn))
+        return self._pool
+
+    def _process_iter(self):
+        """Strict-order prefetching over the persistent spawn pool;
+        worker exceptions re-raise in the consumer (pickled through)."""
+        from collections import deque
+
+        pool = self._get_pool()
+        batches = list(self._batch_sampler)
+        window = max(self._prefetch, self._num_workers, 2)
+        pending: deque = deque()
+        it = iter(batches)
+        for _ in range(min(window, len(batches))):
+            pending.append(pool.apply_async(_mp_make_batch, (next(it),)))
+        while pending:
+            res = pending.popleft()
+            out = res.get(self._timeout)
+            try:
+                pending.append(pool.apply_async(_mp_make_batch,
+                                                (next(it),)))
+            except StopIteration:
+                pass
+            yield self._wrap_np(out)
+
+    @staticmethod
+    def _wrap_np(out):
+        if isinstance(out, tuple):
+            return tuple(DataLoader._wrap_np(o) for o in out)
+        if isinstance(out, np.ndarray):
+            return nd_array(out)
+        return out
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            try:
+                pool.terminate()
+            except Exception:
+                pass
 
     def _threaded_iter(self):
         """Prefetching iterator with N REAL worker threads (reference

@@ -56,8 +56,9 @@ def _normalize_basic_key(pval, key):
         n = pval.shape[i]
         if isinstance(k, slice):
             st, sp, stp = k.indices(n)
-            if stp <= 0 or sp < st:
+            if stp <= 0:
                 return None
+            sp = max(sp, st)  # x[10:5] is a valid EMPTY slice, not an error
             starts.append(st)
             limits.append(sp)
             strides.append(stp)
@@ -94,8 +95,20 @@ def _index_value(pval, key):
         return pval[key]
     norm = _normalize_basic_key(pval, key)
     if norm is None:
-        return pval[key]
+        # advanced/negative-step reads would go through jnp's eager
+        # int32 gather, whose clamp arithmetic overflows on a >2^31 dim
+        # and returns WRONG DATA silently — refuse loudly instead (the
+        # write path refuses symmetrically)
+        raise MXNetError(
+            "indexing an array with a dimension > 2^31-1 supports only "
+            f"basic, positive-step keys (shape {pval.shape}, key "
+            f"{key!r}); jax's int32 index path would silently return "
+            "corrupt data — reshape to dims under 2^31 for advanced "
+            "indexing")
     return _big_slice_fn(*norm)(pval)
+
+
+_BIG_CHUNK = 2 ** 30
 
 
 @functools.lru_cache(maxsize=256)
@@ -106,15 +119,33 @@ def _big_update_fn(shape, ax, norm):
         (0 if i in squeeze else slice(None)) if i == ax
         else (starts[i] if i in squeeze else slice(starts[i], limits[i]))
         for i in range(len(shape)))
+    # indexed (target) shape of the assignment, for value broadcasting;
+    # the big axis position among the value's (non-squeezed) dims
+    idx_shape = tuple(limits[i] - starts[i] for i in range(len(shape))
+                      if i not in squeeze)
+    axpos = sum(1 for i in range(ax) if i not in squeeze)
 
     def fn(x, v):
-        # static lax.slice bounds are int64-safe HLO attributes; the
-        # band's own dims are all < 2^31 so the normal scatter applies
-        pre = jax.lax.slice_in_dim(x, 0, st, axis=ax)
-        band = jax.lax.slice_in_dim(x, st, sp, axis=ax)
-        band = band.at[inner_key].set(v)
-        post = jax.lax.slice_in_dim(x, sp, shape[ax], axis=ax)
-        return jnp.concatenate([pre, band, post], axis=ax)
+        # static lax.slice bounds are int64-safe HLO attributes.  If the
+        # targeted band itself still spans > 2^31 rows (the key did not
+        # narrow the big axis, e.g. x[:, 2] = v), it is processed in
+        # <= 2^30-row chunks so every scatter sees small dims only —
+        # one band.at[].set past 2^31 would hit the exact int32 clamp
+        # overflow this helper exists to avoid.
+        pieces = [jax.lax.slice_in_dim(x, 0, st, axis=ax)]
+        if sp - st <= _INT32_MAX:
+            band = jax.lax.slice_in_dim(x, st, sp, axis=ax)
+            pieces.append(band.at[inner_key].set(v))
+        else:
+            vb = jnp.broadcast_to(jnp.asarray(v), idx_shape)
+            for cst in range(st, sp, _BIG_CHUNK):
+                cen = min(cst + _BIG_CHUNK, sp)
+                band = jax.lax.slice_in_dim(x, cst, cen, axis=ax)
+                vchunk = jax.lax.slice_in_dim(vb, cst - st, cen - st,
+                                              axis=axpos)
+                pieces.append(band.at[inner_key].set(vchunk))
+        pieces.append(jax.lax.slice_in_dim(x, sp, shape[ax], axis=ax))
+        return jnp.concatenate(pieces, axis=ax)
 
     return jax.jit(fn)
 

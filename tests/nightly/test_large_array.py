@@ -89,6 +89,26 @@ def test_single_dim_beyond_2g_writes():
         [0, 0, 3, 3, 3, 3, 0, 0])
     with pytest.raises(mx.MXNetError, match="2\\^31"):
         x[nd.array([1, 2], dtype="int32")] = 9
+    # advanced READS refuse too (they would silently return garbage)
+    with pytest.raises(mx.MXNetError, match="2\\^31"):
+        x[nd.array([1, 2], dtype="int32")]
+    # empty slices stay valid no-ops, not errors
+    assert x[10:5].shape == (0,)
+    x[10:5] = 4
+    assert int(x[5].asnumpy()) == 1
+
+
+def test_unnarrowed_big_axis_write_chunks():
+    """A write that does NOT narrow the >2^31 axis (x[:, 1] = v) must go
+    through the chunked band path — one scatter across the whole axis
+    would hit the int32 clamp overflow."""
+    n = 2 ** 31 + 64
+    x = nd.zeros((n, 2), dtype="int8")
+    x[:, 1] = 1
+    assert int(x[5, 1].asnumpy()) == 1
+    assert int(x[n - 7, 1].asnumpy()) == 1
+    assert int(x[5, 0].asnumpy()) == 0
+    assert int(x[n - 7, 0].asnumpy()) == 0
 
 
 def test_reshape_transpose_roundtrip_at_scale():
