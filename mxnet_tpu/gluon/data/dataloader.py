@@ -33,14 +33,9 @@ from .sampler import BatchSampler, RandomSampler, SequentialSampler
 __all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
 
 
-def _numpy_batchify(data):
-    """Child-process batchify: same stacking/dtype rules as
-    default_batchify_fn but producing numpy (NDArray construction — and
-    with it any jax device touch — stays in the parent)."""
-    if isinstance(data[0], tuple):
-        return tuple(_numpy_batchify(list(d)) for d in zip(*data))
-    if isinstance(data[0], NDArray):
-        data = [d.asnumpy() for d in data]
+def _stack_narrow(data):
+    """Shared stacking + dtype narrowing (float64->float32,
+    int64->int32) used by BOTH batchify variants — one policy."""
     arr = np.asarray(data)
     if arr.dtype == np.float64:
         arr = arr.astype(np.float32)
@@ -49,11 +44,120 @@ def _numpy_batchify(data):
     return arr
 
 
+def _numpy_batchify(data):
+    """Child-process batchify: same stacking/dtype rules as
+    default_batchify_fn but producing numpy (NDArray construction — and
+    with it any jax device touch — stays in the parent)."""
+    if isinstance(data[0], tuple):
+        return tuple(_numpy_batchify(list(d)) for d in zip(*data))
+    if isinstance(data[0], NDArray):
+        data = [d.asnumpy() for d in data]
+    return _stack_narrow(data)
+
+
+# ---------------------------------------------------------------------------
+# shared-memory batch transport (the CPUSharedStorage role, ref:
+# src/storage/cpu_shared_storage_manager.cc): worker processes place the
+# assembled batch in a POSIX shm segment and ship only its descriptor;
+# the parent maps it zero-copy.  vs pickling through the pool pipe this
+# removes the serialize+pipe+deserialize copies (measured in
+# DATALOADER_BENCH.json / docs/data.md).
+# ---------------------------------------------------------------------------
+
+def _shm_pack(out):
+    """numpy tree -> (shm_name, spec); spec mirrors the tuple structure
+    with ('a', shape, dtype_str, offset) leaves."""
+    from multiprocessing import shared_memory
+
+    flat = []
+
+    def walk(x):
+        if isinstance(x, tuple):
+            return ("t", tuple(walk(e) for e in x))
+        a = np.ascontiguousarray(x)
+        flat.append(a)
+        return ("a", a.shape, a.dtype.str, 0)
+
+    spec = walk(out)
+    total = max(sum(a.nbytes for a in flat), 1)
+    shm = shared_memory.SharedMemory(create=True, size=total)
+    off = 0
+    offs = []
+    for a in flat:
+        # write in place — tobytes() would add a full transient copy
+        np.ndarray(a.shape, a.dtype, buffer=shm.buf, offset=off)[...] = a
+        offs.append(off)
+        off += a.nbytes
+
+    it = iter(offs)
+
+    def fix(s):
+        if s[0] == "t":
+            return ("t", tuple(fix(e) for e in s[1]))
+        return ("a", s[1], s[2], next(it))
+
+    spec = fix(spec)
+    name = shm.name
+    # the parent owns the segment's lifetime: detach this process's
+    # resource-tracker registration so the child's exit doesn't unlink
+    # (nor warn about) a segment the parent is still reading
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+    shm.close()
+    return name, spec
+
+
+def _shm_unpack(name, spec):
+    """Attach, copy out into NDArrays (the jax device_put is the one
+    unavoidable copy), then unlink the segment."""
+    from multiprocessing import shared_memory
+
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        def walk(s):
+            if s[0] == "t":
+                return tuple(walk(e) for e in s[1])
+            _tag, shape, dt, off = s
+            view = np.ndarray(shape, dtype=np.dtype(dt), buffer=shm.buf,
+                              offset=off)
+            # explicit host copy BEFORE unlink: jax may alias a numpy
+            # buffer on the cpu backend, and the mapping dies below
+            return nd_array(np.array(view))
+
+        return walk(spec)
+    finally:
+        shm.close()
+        shm.unlink()
+
+
+def _drain_shm(pending):
+    """Reclaim shm segments from unconsumed in-flight pool results."""
+    from multiprocessing import shared_memory
+
+    for res in pending:
+        try:
+            out = res.get(10)
+        except Exception:
+            continue  # failed batches packed nothing
+        if isinstance(out, tuple) and len(out) == 3 \
+                and out[0] == "__shm__":
+            try:
+                seg = shared_memory.SharedMemory(name=out[1])
+                seg.close()
+                seg.unlink()
+            except Exception:
+                pass
+
+
 # spawn-child globals (one dataset/batchify per worker process)
 _MP_STATE: dict = {}
 
 
-def _mp_init(dataset, batchify_fn):
+def _mp_init(dataset, batchify_fn, transport="shm"):
     # Runs in EVERY worker — including ones the Pool maintenance thread
     # respawns later with the parent's normal env — so the TPU-safety
     # pinning must happen here, not around Pool construction.  jax is
@@ -70,20 +174,33 @@ def _mp_init(dataset, batchify_fn):
         pass
     _MP_STATE["dataset"] = dataset
     _MP_STATE["batchify"] = batchify_fn
+    _MP_STATE["transport"] = transport
 
 
 def _mp_make_batch(indices):
     ds, bfn = _MP_STATE["dataset"], _MP_STATE["batchify"]
     out = bfn([ds[i] for i in indices])
 
-    def dend(x):  # NDArray from a custom batchify -> cheap-pickling numpy
+    def dend(x):  # NDArray from a custom batchify -> plain numpy
         if isinstance(x, NDArray):
             return x.asnumpy()
         if isinstance(x, tuple):
             return tuple(dend(e) for e in x)
         return x
 
-    return dend(out)
+    out = dend(out)
+    if _MP_STATE.get("transport") == "shm" and _all_arrays(out):
+        try:
+            return ("__shm__",) + _shm_pack(out)
+        except Exception:
+            pass  # fall back to pickling through the pool pipe
+    return out
+
+
+def _all_arrays(x):
+    if isinstance(x, tuple):
+        return all(_all_arrays(e) for e in x)
+    return isinstance(x, np.ndarray)
 
 
 def default_batchify_fn(data):
@@ -94,12 +211,7 @@ def default_batchify_fn(data):
         return NDArray(jnp.stack([d.data for d in data]))
     if isinstance(data[0], tuple):
         return tuple(default_batchify_fn(list(d)) for d in zip(*data))
-    arr = np.asarray(data)
-    if arr.dtype == np.float64:
-        arr = arr.astype(np.float32)
-    if arr.dtype == np.int64:
-        arr = arr.astype(np.int32)
-    return nd_array(arr)
+    return nd_array(_stack_narrow(data))
 
 
 default_mp_batchify_fn = default_batchify_fn
@@ -109,7 +221,8 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False, timeout=120, worker_pool=None):
+                 thread_pool=False, timeout=120, worker_pool=None,
+                 worker_transport="shm"):
         self._dataset = dataset
         self._timeout = timeout
         if worker_pool is None:
@@ -118,7 +231,10 @@ class DataLoader:
             worker_pool = "thread"  # reference-compat flag
         if worker_pool not in ("thread", "process"):
             raise MXNetError("worker_pool must be 'thread' or 'process'")
+        if worker_transport not in ("shm", "pipe"):
+            raise MXNetError("worker_transport must be 'shm' or 'pipe'")
         self._worker_pool = worker_pool
+        self._worker_transport = worker_transport
         self._pool = None  # persistent spawn pool (created lazily)
         if batch_sampler is None:
             if batch_size is None:
@@ -167,13 +283,18 @@ class DataLoader:
             # _mp_init pins the CPU backend inside every worker (also
             # the ones the pool respawns later), so no parent-side env
             # juggling is needed here
-            self._pool = ctx.Pool(self._num_workers, initializer=_mp_init,
-                                  initargs=(self._dataset, bfn))
+            self._pool = ctx.Pool(
+                self._num_workers, initializer=_mp_init,
+                initargs=(self._dataset, bfn, self._worker_transport))
         return self._pool
 
     def _process_iter(self):
         """Strict-order prefetching over the persistent spawn pool;
-        worker exceptions re-raise in the consumer (pickled through)."""
+        worker exceptions re-raise in the consumer (pickled through).
+        In-flight shm results are reclaimed on ANY exit (early break,
+        worker error, timeout) — the workers detach their shm
+        registration, so an undrained descriptor would otherwise leak
+        its /dev/shm segment until reboot."""
         from collections import deque
 
         pool = self._get_pool()
@@ -181,21 +302,27 @@ class DataLoader:
         window = max(self._prefetch, self._num_workers, 2)
         pending: deque = deque()
         it = iter(batches)
-        for _ in range(min(window, len(batches))):
-            pending.append(pool.apply_async(_mp_make_batch, (next(it),)))
-        while pending:
-            res = pending.popleft()
-            out = res.get(self._timeout)
-            try:
+        try:
+            for _ in range(min(window, len(batches))):
                 pending.append(pool.apply_async(_mp_make_batch,
                                                 (next(it),)))
-            except StopIteration:
-                pass
-            yield self._wrap_np(out)
+            while pending:
+                res = pending.popleft()
+                out = res.get(self._timeout)
+                try:
+                    pending.append(pool.apply_async(_mp_make_batch,
+                                                    (next(it),)))
+                except StopIteration:
+                    pass
+                yield self._wrap_np(out)
+        finally:
+            _drain_shm(pending)
 
     @staticmethod
     def _wrap_np(out):
         if isinstance(out, tuple):
+            if len(out) == 3 and out[0] == "__shm__":
+                return _shm_unpack(out[1], out[2])
             return tuple(DataLoader._wrap_np(o) for o in out)
         if isinstance(out, np.ndarray):
             return nd_array(out)

@@ -48,6 +48,13 @@ def _normalize_basic_key(pval, key):
     """(starts, limits, strides, squeeze) tuples for a fully-basic key,
     or None when the key has advanced components / negative steps."""
     ks = key if isinstance(key, tuple) else (key,)
+    if any(k is Ellipsis for k in ks):
+        # expand a single Ellipsis to full slices (x[...], x[..., 0])
+        pos = next(i for i, k in enumerate(ks) if k is Ellipsis)
+        if any(k is Ellipsis for k in ks[pos + 1:]):
+            return None
+        fill = pval.ndim - (len(ks) - 1)
+        ks = ks[:pos] + (slice(None),) * fill + ks[pos + 1:]
     if len(ks) > pval.ndim or not all(
             isinstance(k, (int, np.integer, slice)) for k in ks):
         return None

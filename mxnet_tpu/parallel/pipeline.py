@@ -26,7 +26,119 @@ from ..base import MXNetError
 from ._compat import shard_map_unchecked
 from .mesh import DeviceMesh, current_mesh
 
-__all__ = ["pipeline_apply", "stack_stage_params"]
+__all__ = ["pipeline_apply", "stack_stage_params", "HeteroPipeline"]
+
+
+class HeteroPipeline:
+    """GPipe over HETEROGENEOUS stages — each stage has its own
+    parameter pytree, its own activation shapes, and its own device.
+
+    The SPMD ring (`pipeline_apply`) needs identical stages (stacked
+    params, shape-preserving activations) because every device must run
+    the same program.  Real models are not like that (ResNet stem ->
+    blocks -> head), so this variant runs one jitted program PER STAGE
+    on that stage's device and lets jax's async dispatch overlap the
+    pipeline: issuing stage i's microbatch j returns immediately, so
+    device i computes while device i+1 receives the previous microbatch
+    — the dependency-engine execution model, generalizing the
+    reference's `group2ctx` placement parallelism (SURVEY §2d) with
+    autodiff.
+
+    Backward is GPipe-with-rematerialization: each stage's backward
+    program recomputes its forward for the VJP (activation memory per
+    device stays O(one stage), the reference's mirror trade).
+
+        pipe = HeteroPipeline([f0, f1, f2], [p0, p1, p2])
+        y = pipe(x, n_microbatch=4)                       # inference
+        loss, grads = pipe.value_and_grad(loss_fn, x, labels,
+                                          n_microbatch=4)  # training
+    """
+
+    def __init__(self, stage_fns, stage_params, devices=None):
+        if len(stage_fns) != len(stage_params):
+            raise MXNetError("one params pytree per stage required")
+        self.n_stages = len(stage_fns)
+        if devices is None:
+            devs = jax.local_devices()
+            devices = [devs[i % len(devs)] for i in range(self.n_stages)]
+        if len(devices) != self.n_stages:
+            raise MXNetError(
+                f"{len(devices)} devices for {self.n_stages} stages")
+        self.devices = list(devices)
+        self.params = [
+            jax.device_put(p, d) for p, d in zip(stage_params, devices)]
+        self._fns = list(stage_fns)
+        self._fwd = [jax.jit(f) for f in stage_fns]
+
+        def make_bwd(f):
+            def bwd(p, a, g):
+                _y, vjp = jax.vjp(f, p, a)  # recompute-for-backward
+                return vjp(g)
+
+            return jax.jit(bwd)
+
+        self._bwd = [make_bwd(f) for f in stage_fns]
+        self._lgrad_cache: dict = {}  # loss_fn -> jitted value_and_grad
+
+    def _microbatches(self, x, n_microbatch):
+        if x.shape[0] % n_microbatch:
+            raise MXNetError(
+                f"batch {x.shape[0]} not divisible by {n_microbatch}")
+        m = x.shape[0] // n_microbatch
+        return [x[j * m:(j + 1) * m] for j in range(n_microbatch)]
+
+    def _forward_saved(self, x, n_microbatch):
+        """Run all microbatches through all stages; returns per-stage
+        INPUT activations (the remat residuals) and the outputs."""
+        acts = [self._microbatches(x, n_microbatch)]
+        for i in range(self.n_stages):
+            dev = self.devices[i]
+            ins = [jax.device_put(a, dev) for a in acts[i]]
+            acts[i] = ins  # keep the device-placed copy as residual
+            acts.append([self._fwd[i](self.params[i], a) for a in ins])
+        return acts
+
+    def __call__(self, x, n_microbatch=1):
+        acts = self._forward_saved(jnp.asarray(x), n_microbatch)
+        return jnp.concatenate(
+            [jax.device_put(y, self.devices[-1]) for y in acts[-1]], 0)
+
+    def value_and_grad(self, loss_fn, x, *labels, n_microbatch=1):
+        """Mean loss over the batch + per-stage parameter grads (each on
+        its stage's device).  loss_fn(y_micro, *labels_micro) -> scalar
+        mean over the microbatch."""
+        x = jnp.asarray(x)
+        acts = self._forward_saved(x, n_microbatch)
+        lab_mb = [self._microbatches(jnp.asarray(l), n_microbatch)
+                  for l in labels]
+        lgrad = self._lgrad_cache.get(loss_fn)
+        if lgrad is None:  # jit keys on fn identity: cache per loss_fn
+            lgrad = jax.jit(jax.value_and_grad(loss_fn, argnums=0))
+            self._lgrad_cache[loss_fn] = lgrad
+        losses, gys = [], []
+        for j, y in enumerate(acts[-1]):
+            lv, gy = lgrad(y, *[lm[j] for lm in lab_mb])
+            losses.append(lv)
+            gys.append(gy)
+        gparams = [None] * self.n_stages
+        for i in reversed(range(self.n_stages)):
+            dev = self.devices[i]
+            nxt = []
+            for j in range(n_microbatch):
+                gp, ga = self._bwd[i](self.params[i], acts[i][j],
+                                      jax.device_put(gys[j], dev))
+                gparams[i] = gp if gparams[i] is None else \
+                    jax.tree_util.tree_map(jnp.add, gparams[i], gp)
+                nxt.append(ga)
+            gys = nxt
+        # microbatch-mean: losses average; grads scale by 1/M (loss_fn
+        # is a per-microbatch mean, so the sum over microbatches must be
+        # averaged too)
+        scale = 1.0 / n_microbatch
+        gparams = [jax.tree_util.tree_map(lambda a: a * scale, gp)
+                   for gp in gparams]
+        loss = sum(jax.device_get(l) for l in losses) * scale
+        return float(loss), gparams
 
 
 def stack_stage_params(params_list):

@@ -66,6 +66,9 @@ def test_single_dim_beyond_2g_static_slice():
     np.testing.assert_array_equal(tail, [0, 0, 0, 0])
     mid = x[2 ** 31: 2 ** 31 + 4]
     assert mid.shape == (4,)
+    # Ellipsis is a basic key and must keep working on big arrays
+    assert x[...].shape == (n,)
+    assert x[..., 5:9].shape == (4,)
 
 
 def test_single_dim_beyond_2g_writes():

@@ -69,3 +69,43 @@ def test_process_pool_pure_python_dataset():
 def test_invalid_worker_pool_rejected():
     with pytest.raises(MXNetError, match="worker_pool"):
         DataLoader(_PurePython(), batch_size=2, worker_pool="greenlet")
+
+
+def test_process_pool_pipe_transport_matches_shm():
+    x = np.arange(48, dtype=np.float32).reshape(12, 4)
+    y = np.arange(12, dtype=np.float32)
+    for transport in ("shm", "pipe"):
+        dl = DataLoader(ArrayDataset(x, y), batch_size=3, num_workers=2,
+                        worker_pool="process", worker_transport=transport)
+        got = list(dl)
+        assert len(got) == 4
+        xa, ya = got[1]
+        np.testing.assert_array_equal(xa.asnumpy(), x[3:6])
+        np.testing.assert_array_equal(ya.asnumpy(), y[3:6])
+
+
+def test_invalid_worker_transport_rejected():
+    with pytest.raises(MXNetError, match="worker_transport"):
+        DataLoader(_PurePython(), batch_size=2, worker_transport="rdma")
+
+
+def test_shm_segments_reclaimed_on_early_break():
+    """Abandoning the iterator mid-epoch must not leak /dev/shm
+    segments from in-flight prefetched batches."""
+    import glob
+
+    def _segs():
+        return set(glob.glob("/dev/shm/psm_*"))
+
+    x = np.arange(160, dtype=np.float32).reshape(40, 4)
+    dl = DataLoader(ArrayDataset(x, x[:, 0]), batch_size=4,
+                    num_workers=2, worker_pool="process")
+    before = _segs()
+    it = iter(dl)
+    next(it)
+    it.close()  # generator finally -> _drain_shm
+    del it
+    import time
+    time.sleep(1)
+    leaked = _segs() - before
+    assert not leaked, leaked

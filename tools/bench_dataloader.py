@@ -63,12 +63,13 @@ class PurePython:
         return np.array([i, acc], np.float32)
 
 
-def _run(ds, batch_size, num_workers, worker_pool):
+def _run(ds, batch_size, num_workers, worker_pool, transport="shm"):
     from mxnet_tpu.gluon.data import DataLoader
 
     kw = {}
     if num_workers:
-        kw = dict(num_workers=num_workers, worker_pool=worker_pool)
+        kw = dict(num_workers=num_workers, worker_pool=worker_pool,
+                  worker_transport=transport)
     dl = DataLoader(ds, batch_size=batch_size, **kw)
     list(dl)  # warm (spawn pool startup / thread seeding out of timing)
     t0 = time.perf_counter()
@@ -91,11 +92,15 @@ def main():
     results = []
     for wl_name, ds in (("numpy_heavy", NumpyHeavy(args.n)),
                         ("pure_python", PurePython(args.n))):
-        for pool, nw in (("single", 0), ("thread", args.workers),
-                         ("process", args.workers)):
-            tp = _run(ds, args.batch_size, nw, pool)
+        cases = [("single", 0, "shm"), ("thread", args.workers, "shm"),
+                 ("process", args.workers, "shm"),
+                 ("process", args.workers, "pipe")]
+        for pool, nw, transport in cases:
+            tp = _run(ds, args.batch_size, nw, pool, transport)
             row = {"workload": wl_name, "pool": pool, "workers": nw,
                    "samples_per_s": round(tp, 1)}
+            if pool == "process":
+                row["transport"] = transport
             results.append(row)
             print(json.dumps(row))
 
