@@ -11,8 +11,11 @@ holds a PjRt/TPU client is unsafe, so this loader offers two pools:
   * worker_pool="process": persistent spawn()-based process pool (spawn,
     not fork, so no PjRt client is inherited; children run CPU-only
     jax).  Escapes the GIL for python-heavy `__getitem__` at the cost of
-    one-time worker startup (a jax import per worker) and pickling the
-    batch across the pipe (the reference ships it through shm instead).
+    one-time worker startup (a jax import per worker).  Batches travel
+    through POSIX shared memory by default (worker_transport="shm", the
+    reference's CPUSharedStorage role) — the worker writes arrays into
+    a segment and ships only the descriptor; "pipe" selects plain
+    pickling.
 
 The C++ RecordIO pipeline (src/io, see native/) remains the
 high-throughput path for ImageNet-style training.
@@ -59,14 +62,17 @@ def _numpy_batchify(data):
 # shared-memory batch transport (the CPUSharedStorage role, ref:
 # src/storage/cpu_shared_storage_manager.cc): worker processes place the
 # assembled batch in a POSIX shm segment and ship only its descriptor;
-# the parent maps it zero-copy.  vs pickling through the pool pipe this
+# the parent maps it with one explicit host copy (jax may alias numpy
+# buffers, and the segment is unlinked right after).  vs the pipe this
 # removes the serialize+pipe+deserialize copies (measured in
 # DATALOADER_BENCH.json / docs/data.md).
 # ---------------------------------------------------------------------------
 
 def _shm_pack(out):
     """numpy tree -> (shm_name, spec); spec mirrors the tuple structure
-    with ('a', shape, dtype_str, offset) leaves."""
+    with ('a', shape, dtype_str, offset) leaves.  A segment is reclaimed
+    immediately if packing fails partway — once the tracker registration
+    is detached below, an abandoned segment would outlive the process."""
     from multiprocessing import shared_memory
 
     flat = []
@@ -81,13 +87,22 @@ def _shm_pack(out):
     spec = walk(out)
     total = max(sum(a.nbytes for a in flat), 1)
     shm = shared_memory.SharedMemory(create=True, size=total)
-    off = 0
-    offs = []
-    for a in flat:
-        # write in place — tobytes() would add a full transient copy
-        np.ndarray(a.shape, a.dtype, buffer=shm.buf, offset=off)[...] = a
-        offs.append(off)
-        off += a.nbytes
+    try:
+        off = 0
+        offs = []
+        for a in flat:
+            # write in place — tobytes() would add a full transient copy
+            np.ndarray(a.shape, a.dtype, buffer=shm.buf,
+                       offset=off)[...] = a
+            offs.append(off)
+            off += a.nbytes
+    except Exception:
+        try:
+            shm.close()
+            shm.unlink()
+        except Exception:
+            pass
+        raise
 
     it = iter(offs)
 
@@ -134,13 +149,13 @@ def _shm_unpack(name, spec):
         shm.unlink()
 
 
-def _drain_shm(pending):
+def _drain_shm(pending, timeout=120):
     """Reclaim shm segments from unconsumed in-flight pool results."""
     from multiprocessing import shared_memory
 
     for res in pending:
         try:
-            out = res.get(10)
+            out = res.get(timeout)
         except Exception:
             continue  # failed batches packed nothing
         if isinstance(out, tuple) and len(out) == 3 \
@@ -308,7 +323,13 @@ class DataLoader:
                                                 (next(it),)))
             while pending:
                 res = pending.popleft()
-                out = res.get(self._timeout)
+                try:
+                    out = res.get(self._timeout)
+                except BaseException:
+                    # the popped result may still arrive later and hold
+                    # a shm segment — put it back so the drain sees it
+                    pending.appendleft(res)
+                    raise
                 try:
                     pending.append(pool.apply_async(_mp_make_batch,
                                                     (next(it),)))
@@ -316,7 +337,7 @@ class DataLoader:
                     pass
                 yield self._wrap_np(out)
         finally:
-            _drain_shm(pending)
+            _drain_shm(pending, self._timeout)
 
     @staticmethod
     def _wrap_np(out):

@@ -19,6 +19,8 @@ collectives over ICI within a slice, DCN across slices — under explicit
   dist      — multi-host bootstrap (jax.distributed) keeping the
               reference launcher's DMLC_* env contract, DCN allreduce,
               barrier
+  ulysses   — all-to-all sequence parallelism (DeepSpeed-Ulysses layout)
+  moe       — expert-parallel top-1 MoE over 'ep' (GShard dense dispatch)
   ring      — ring attention: sequence/context parallelism over the 'sp'
               mesh axis via shard_map + ppermute (beyond-reference)
   pipeline  — pipeline parallelism over the 'pp' axis (beyond-reference)
@@ -32,6 +34,8 @@ from .spmd import SPMDTrainer, functional_optimizer
 from .checkpoint import save_sharded, load_sharded
 from . import dist
 from . import ring
+from . import ulysses
+from . import moe
 from . import pipeline
 
 __all__ = [
@@ -39,5 +43,5 @@ __all__ = [
     "ShardingRules", "named_sharding", "replicated", "shard_batch",
     "constraint", "DEFAULT_RULES",
     "SPMDTrainer", "functional_optimizer",
-    "dist", "ring", "pipeline",
+    "dist", "ring", "ulysses", "moe", "pipeline",
 ]

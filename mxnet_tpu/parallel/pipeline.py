@@ -112,7 +112,12 @@ class HeteroPipeline:
         lab_mb = [self._microbatches(jnp.asarray(l), n_microbatch)
                   for l in labels]
         lgrad = self._lgrad_cache.get(loss_fn)
-        if lgrad is None:  # jit keys on fn identity: cache per loss_fn
+        if lgrad is None:  # jit keys on fn identity: cache per loss_fn.
+            # Pass a STABLE callable, not a fresh lambda per step — each
+            # new function object costs a trace+compile; the cache is
+            # capped so per-step lambdas degrade to slow, not unbounded.
+            if len(self._lgrad_cache) >= 8:
+                self._lgrad_cache.pop(next(iter(self._lgrad_cache)))
             lgrad = jax.jit(jax.value_and_grad(loss_fn, argnums=0))
             self._lgrad_cache[loss_fn] = lgrad
         losses, gys = [], []

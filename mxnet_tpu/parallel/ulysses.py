@@ -1,0 +1,84 @@
+"""Ulysses-style sequence parallelism — all-to-all over the 'sp' axis.
+
+The second of the two standard long-context layouts (the task's "ring
+attention OR all-to-all sequence/context parallelism"; pattern source:
+DeepSpeed-Ulysses).  Complements `parallel.ring`:
+
+  * ring: K/V blocks rotate (n-1 ppermute hops), O(L/n) memory per
+    device, score matrix never materializes — best for the longest
+    sequences.
+  * ulysses (this module): ONE all_to_all re-shards [B, H, L/n, D]
+    (sequence-sharded) into [B, H/n, L, D] (head-sharded), each device
+    runs ordinary full attention for its head group, and one all_to_all
+    re-shards back.  Two collectives total instead of n-1 hops, so it
+    wins when H >= n and L/n fits memory; it is also the layout that
+    composes directly with a head-sharded ('tp') attention projection.
+
+Both are pure-SPMD shard_map bodies, so XLA schedules the all_to_all on
+ICI and overlaps it with surrounding compute.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..base import MXNetError
+from ._compat import shard_map_unchecked
+from .mesh import DeviceMesh, current_mesh
+from .ring import local_attention
+
+__all__ = ["ulysses_attention", "ulysses_attention_sharded"]
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp", *,
+                      causal: bool = False,
+                      scale: Optional[float] = None):
+    """Per-shard body: call INSIDE shard_map with q,k,v sequence-sharded
+    [B, H, L_local, D] along `axis_name`.  Heads must divide the axis
+    size."""
+    n = lax.psum(1, axis_name)
+    h = q.shape[1]
+    # n is static inside shard_map over a concrete mesh axis
+    if h % int(n) != 0:
+        raise MXNetError(
+            f"ulysses_attention needs heads ({h}) divisible by the "
+            f"'{axis_name}' axis size ({int(n)}); use parallel.ring for "
+            "few-head models")
+
+    def seq_to_head(x):
+        # [B, H, L/n, D] -> [B, H/n, L, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def head_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    out = local_attention(qh, kh, vh, causal=causal, scale=scale)
+    return head_to_seq(out)
+
+
+def ulysses_attention_sharded(q, k, v, *, mesh: Optional[DeviceMesh] = None,
+                              axis_name: str = "sp", causal: bool = False,
+                              scale: Optional[float] = None,
+                              batch_axes=("dp", "fsdp")):
+    """User entry: q,k,v are [B, H, L, D] global arrays; shards batch
+    over the data axes and sequence over `axis_name`, re-shards to heads
+    with one all_to_all each way."""
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise MXNetError("ulysses_attention_sharded requires an active mesh")
+    if axis_name not in mesh or mesh.size(axis_name) == 1:
+        return local_attention(q, k, v, causal=causal, scale=scale)
+    batch = tuple(a for a in batch_axes if a in mesh) or None
+    spec = P(batch, None, axis_name, None)
+    fn = shard_map_unchecked(
+        functools.partial(ulysses_attention, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh.mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

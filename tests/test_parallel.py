@@ -494,3 +494,74 @@ def test_hetero_pipeline_matches_sequential():
     # stages really live on distinct devices
     devs = {list(p["w"].devices())[0] for p in pipe.params}
     assert len(devs) == 3
+
+
+def test_ulysses_attention_matches_dense_and_ring():
+    """All-to-all sequence parallelism: matches dense attention exactly
+    (and hence the ring variant) for plain and causal, including H == n
+    (one head per device)."""
+    mesh = parallel.make_mesh(sp=4)
+    rng = np.random.RandomState(3)
+    B, H, L, D = 2, 4, 32, 8
+    q = jnp.asarray(rng.randn(B, H, L, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, H, L, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, H, L, D).astype(np.float32))
+    for causal in (False, True):
+        ref = parallel.ring.local_attention(q, k, v, causal=causal)
+        with mesh:
+            out = parallel.ulysses.ulysses_attention_sharded(
+                q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = parallel.make_mesh(sp=8)
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.randn(1, 4, 32, 8).astype(np.float32))  # H=4 < sp=8
+    with mesh, pytest.raises(mx.MXNetError, match="divisible"):
+        parallel.ulysses.ulysses_attention_sharded(q, q, q)
+
+
+def _moe_oracle(ws, x, gl, capacity):
+    """Pure-numpy top-1 capacity MoE (GShard drop semantics)."""
+    t, e = gl.shape
+    probs = np.exp(gl - gl.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    pick = probs.argmax(-1)
+    gate = probs.max(-1)
+    counts = np.zeros(e, np.int64)
+    y = np.zeros((t, ws[0].shape[1]), np.float32)
+    for i in range(t):
+        ei = pick[i]
+        if counts[ei] < capacity:
+            y[i] = np.tanh(x[i] @ ws[ei]) * gate[i]
+            counts[ei] += 1
+    return y
+
+
+def test_moe_expert_parallel_matches_oracle():
+    import math
+    from mxnet_tpu.parallel import moe
+
+    rng = np.random.RandomState(5)
+    T, D, E, cf = 32, 8, 4, 1.25
+    x = rng.randn(T, D).astype(np.float32)
+    gl = rng.randn(T, E).astype(np.float32)
+    ws = [rng.randn(D, D).astype(np.float32) * 0.3 for _ in range(E)]
+    stacked = {"w": jnp.stack([jnp.asarray(w) for w in ws])}
+
+    def expert(p, tok):
+        return jnp.tanh(tok @ p["w"])
+
+    cap = max(1, math.ceil(T / E * cf))
+    ref = _moe_oracle(ws, x, gl, cap)
+    with parallel.make_mesh(ep=4):
+        y, aux = moe.moe_apply(expert, stacked, jnp.asarray(x),
+                               jnp.asarray(gl), capacity_factor=cf)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-5, atol=2e-5)
+    assert 0.0 <= float(aux["dropped_frac"]) < 1.0
+    # no-mesh fallback matches too
+    y2, _ = moe.moe_apply(expert, stacked, jnp.asarray(x),
+                          jnp.asarray(gl), capacity_factor=cf)
+    np.testing.assert_allclose(np.asarray(y2), ref, rtol=2e-5, atol=2e-5)
