@@ -46,7 +46,43 @@ BUILDER_CLAIMED_PROVENANCE = ("round 3, v5e, builder-measured with xplane "
 
 
 def run_benchmark(args) -> dict:
-    """The actual measurement. Runs inside the bounded child process."""
+    """The full measurement: the op-granular step, then (unless
+    --no-fused) the MXNET_FUSED_CONVBN Pallas path in the same process;
+    the official value is the better of the two, with both recorded.
+    A fused-path failure never costs the run — the unfused number is
+    already in hand and is reported with the failure reason."""
+    import os
+
+    if os.environ.get("MXNET_FUSED_CONVBN", "") not in ("", "0"):
+        # the caller already pinned the fused path (bench_all's
+        # fused_convbn variant, or MXNET_FUSED_CONVBN=1 python bench.py):
+        # measure exactly that, labeled — no comparison pass
+        out = _measure_once(args)
+        out["variant"] = "fused_convbn"
+        return out
+    base = _measure_once(args)
+    out = dict(base)
+    out["unfused_img_s"] = base["value"]
+    if not getattr(args, "no_fused", False):
+        # the unfused number is banked NOW: if the fused pass stalls and
+        # the parent kills this child, the parent still finds this line
+        print(json.dumps(base), flush=True)
+        os.environ["MXNET_FUSED_CONVBN"] = "1"
+        try:
+            fused = _measure_once(args)
+            out["fused_convbn_img_s"] = fused["value"]
+            if fused["value"] > base["value"]:
+                out["value"] = fused["value"]
+                out["vs_baseline"] = fused["vs_baseline"]
+                out["variant"] = "fused_convbn"
+        except Exception as e:  # keep the unfused number
+            out["fused_convbn_error"] = str(e).splitlines()[0][:200]
+        finally:
+            os.environ.pop("MXNET_FUSED_CONVBN", None)
+    return out
+
+
+def _measure_once(args) -> dict:
     if args.cpu_smoke:
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -144,9 +180,12 @@ def main():
                     help="tiny shapes on the CPU backend (CI self-test)")
     ap.add_argument("--init-timeout", type=float, default=240.0,
                     help="seconds allowed for TPU backend init probe")
-    ap.add_argument("--run-timeout", type=float, default=1200.0,
-                    help="seconds allowed for the measurement child")
+    ap.add_argument("--run-timeout", type=float, default=2000.0,
+                    help="seconds allowed for the measurement child "
+                         "(covers BOTH the unfused and fused passes)")
     ap.add_argument("--attempts", type=int, default=3)
+    ap.add_argument("--no-fused", action="store_true",
+                    help="skip the MXNET_FUSED_CONVBN comparison pass")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
 
@@ -173,11 +212,22 @@ def main():
                      "--steps", str(args.steps),
                      "--warmup", str(args.warmup),
                      "--dtype", args.dtype,
-                     "--layout", args.layout]
+                     "--layout", args.layout] \
+            + (["--no-fused"] if args.no_fused else [])
         try:
             p = subprocess.run(child_cmd, capture_output=True, text=True,
                                timeout=args.run_timeout)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
+            # the child banks the unfused JSON before the fused pass:
+            # salvage it rather than discarding a finished measurement
+            sout = e.stdout or ""
+            if isinstance(sout, bytes):
+                sout = sout.decode(errors="replace")
+            line = next((ln for ln in reversed(sout.splitlines())
+                         if ln.startswith("{")), None)
+            if line:
+                print(line)
+                return 0
             errors.append(f"run[{attempt}]: exceeded {args.run_timeout:.0f}s")
             continue
         line = next((ln for ln in reversed(p.stdout.splitlines())

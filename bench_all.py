@@ -123,6 +123,8 @@ def bench_resnet50(args):
         batch_size, image_size = 256, 224
         steps, warmup = args.steps, args.warmup
         dtype, layout = "bfloat16", "NHWC"
+        no_fused = True  # 'default' means the op-granular baseline; the
+        #                  fused_convbn variant is its own child run
 
     return bench_mod.run_benchmark(A())
 

@@ -150,12 +150,15 @@ def _shm_unpack(name, spec):
 
 
 def _drain_shm(pending, timeout=120):
-    """Reclaim shm segments from unconsumed in-flight pool results."""
+    """Reclaim shm segments from unconsumed in-flight pool results.
+    Per-result wait is capped low: this runs on teardown, often AFTER a
+    timeout error — a dead worker must not stall the exit for the full
+    loader timeout times the window size."""
     from multiprocessing import shared_memory
 
     for res in pending:
         try:
-            out = res.get(timeout)
+            out = res.get(min(timeout, 15))
         except Exception:
             continue  # failed batches packed nothing
         if isinstance(out, tuple) and len(out) == 3 \

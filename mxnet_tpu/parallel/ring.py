@@ -27,7 +27,8 @@ from ..base import MXNetError
 from ._compat import shard_map_unchecked
 from .mesh import DeviceMesh, current_mesh
 
-__all__ = ["ring_attention", "ring_attention_sharded", "local_attention"]
+__all__ = ["ring_attention", "ring_attention_sharded",
+           "sharded_seq_attention", "local_attention"]
 
 
 def local_attention(q, k, v, *, causal: bool = False,
@@ -103,21 +104,31 @@ def ring_attention(q, k, v, axis_name: str = "sp", *, causal: bool = False,
     return (o / l[..., None]).astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, *, mesh: Optional[DeviceMesh] = None,
-                           axis_name: str = "sp", causal: bool = False,
-                           scale: Optional[float] = None,
-                           batch_axes=("dp", "fsdp")):
-    """User entry: q,k,v are [B, H, L, D] global arrays; shards batch over
-    the data axes and sequence over `axis_name`, runs the ring."""
+def sharded_seq_attention(body, q, k, v, *,
+                          mesh: Optional[DeviceMesh] = None,
+                          axis_name: str = "sp", causal: bool = False,
+                          scale: Optional[float] = None,
+                          batch_axes=("dp", "fsdp"), entry_name="attention"):
+    """Shared entry-point plumbing for every sequence-parallel attention
+    layout (ring, ulysses): shard batch over the data axes and sequence
+    over `axis_name`, fall back to dense when the axis is absent/size 1,
+    and shard_map the per-shard `body`."""
     mesh = mesh or current_mesh()
     if mesh is None:
-        raise MXNetError("ring_attention_sharded requires an active mesh")
+        raise MXNetError(f"{entry_name} requires an active mesh")
     if axis_name not in mesh or mesh.size(axis_name) == 1:
         return local_attention(q, k, v, causal=causal, scale=scale)
     batch = tuple(a for a in batch_axes if a in mesh) or None
     spec = P(batch, None, axis_name, None)
     fn = shard_map_unchecked(
-        functools.partial(ring_attention, axis_name=axis_name,
+        functools.partial(body, axis_name=axis_name,
                           causal=causal, scale=scale),
         mesh=mesh.mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
+
+
+def ring_attention_sharded(q, k, v, **kw):
+    """User entry: q,k,v are [B, H, L, D] global arrays; shards batch over
+    the data axes and sequence over `axis_name`, runs the ring."""
+    return sharded_seq_attention(ring_attention, q, k, v,
+                                 entry_name="ring_attention_sharded", **kw)

@@ -19,16 +19,11 @@ ICI and overlaps it with surrounding compute.
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
-import jax
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
-from ._compat import shard_map_unchecked
-from .mesh import DeviceMesh, current_mesh
 from .ring import local_attention
 
 __all__ = ["ulysses_attention", "ulysses_attention_sharded"]
@@ -63,22 +58,12 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", *,
     return head_to_seq(out)
 
 
-def ulysses_attention_sharded(q, k, v, *, mesh: Optional[DeviceMesh] = None,
-                              axis_name: str = "sp", causal: bool = False,
-                              scale: Optional[float] = None,
-                              batch_axes=("dp", "fsdp")):
+def ulysses_attention_sharded(q, k, v, **kw):
     """User entry: q,k,v are [B, H, L, D] global arrays; shards batch
     over the data axes and sequence over `axis_name`, re-shards to heads
     with one all_to_all each way."""
-    mesh = mesh or current_mesh()
-    if mesh is None:
-        raise MXNetError("ulysses_attention_sharded requires an active mesh")
-    if axis_name not in mesh or mesh.size(axis_name) == 1:
-        return local_attention(q, k, v, causal=causal, scale=scale)
-    batch = tuple(a for a in batch_axes if a in mesh) or None
-    spec = P(batch, None, axis_name, None)
-    fn = shard_map_unchecked(
-        functools.partial(ulysses_attention, axis_name=axis_name,
-                          causal=causal, scale=scale),
-        mesh=mesh.mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    return fn(q, k, v)
+    from .ring import sharded_seq_attention
+
+    return sharded_seq_attention(
+        ulysses_attention, q, k, v,
+        entry_name="ulysses_attention_sharded", **kw)
