@@ -11,9 +11,11 @@ TPU attach in this container is demonstrably flaky (a single-client tunnel
 that can hang indefinitely in backend init), so the measurement runs in a
 bounded subprocess: the parent never imports jax, probes backend init with a
 timeout, retries up to --attempts times with staggered waits between failed
-attempts, and ALWAYS prints exactly one JSON line
+attempts, and always exits 0 with a parseable record: the LAST
+'{'-prefixed stdout line is the result
   {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
-exiting 0 so the driver records a parseable artifact either way.  If the
+(the child banks an unfused-only line before the fused comparison pass,
+so earlier JSON lines may precede the final record).  If the
 chip never came up, value is 0.0 and two extra fields are present:
 "error" ("infra-down: ..." with per-attempt reasons) and "last_good"
 ({value, vs_baseline, provenance} of the most recent driver-verified
@@ -51,8 +53,6 @@ def run_benchmark(args) -> dict:
     the official value is the better of the two, with both recorded.
     A fused-path failure never costs the run — the unfused number is
     already in hand and is reported with the failure reason."""
-    import os
-
     if os.environ.get("MXNET_FUSED_CONVBN", "") not in ("", "0"):
         # the caller already pinned the fused path (bench_all's
         # fused_convbn variant, or MXNET_FUSED_CONVBN=1 python bench.py):

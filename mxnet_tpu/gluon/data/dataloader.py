@@ -151,14 +151,15 @@ def _shm_unpack(name, spec):
 
 def _drain_shm(pending, timeout=120):
     """Reclaim shm segments from unconsumed in-flight pool results.
-    Per-result wait is capped low: this runs on teardown, often AFTER a
-    timeout error — a dead worker must not stall the exit for the full
-    loader timeout times the window size."""
+    `timeout` is per result: callers pass the full loader timeout on a
+    healthy teardown (a slow batch still packing must be waited out or
+    its segment leaks) and a short cap on the post-error path (a dead
+    worker must not stall the exit for timeout x window)."""
     from multiprocessing import shared_memory
 
     for res in pending:
         try:
-            out = res.get(min(timeout, 15))
+            out = res.get(timeout)
         except Exception:
             continue  # failed batches packed nothing
         if isinstance(out, tuple) and len(out) == 3 \
@@ -320,6 +321,7 @@ class DataLoader:
         window = max(self._prefetch, self._num_workers, 2)
         pending: deque = deque()
         it = iter(batches)
+        timed_out = False
         try:
             for _ in range(min(window, len(batches))):
                 pending.append(pool.apply_async(_mp_make_batch,
@@ -332,6 +334,7 @@ class DataLoader:
                     # the popped result may still arrive later and hold
                     # a shm segment — put it back so the drain sees it
                     pending.appendleft(res)
+                    timed_out = True
                     raise
                 try:
                     pending.append(pool.apply_async(_mp_make_batch,
@@ -340,7 +343,12 @@ class DataLoader:
                     pass
                 yield self._wrap_np(out)
         finally:
-            _drain_shm(pending, self._timeout)
+            # healthy teardown (early break / epoch end) waits out slow
+            # but live batches; after a worker timeout/crash, cap the
+            # wait — those results mostly never arrive
+            _drain_shm(pending,
+                       min(self._timeout, 15) if timed_out
+                       else self._timeout)
 
     @staticmethod
     def _wrap_np(out):

@@ -24,7 +24,7 @@ from typing import Optional
 from jax import lax
 
 from ..base import MXNetError
-from .ring import local_attention
+from .ring import local_attention, sharded_seq_attention
 
 __all__ = ["ulysses_attention", "ulysses_attention_sharded"]
 
@@ -62,8 +62,6 @@ def ulysses_attention_sharded(q, k, v, **kw):
     """User entry: q,k,v are [B, H, L, D] global arrays; shards batch
     over the data axes and sequence over `axis_name`, re-shards to heads
     with one all_to_all each way."""
-    from .ring import sharded_seq_attention
-
     return sharded_seq_attention(
         ulysses_attention, q, k, v,
         entry_name="ulysses_attention_sharded", **kw)

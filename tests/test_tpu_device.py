@@ -160,3 +160,108 @@ def test_train_step_converges_on_chip():
                                   "sgd", {"learning_rate": 0.5})
         losses = [float(tr.step(x, y).asnumpy()) for _ in range(60)]
     assert losses[-1] < losses[0] * 0.7, losses[::8]
+
+
+def test_fused_conv_unit_pallas_vs_xla_on_chip():
+    """The fused Conv+BN+ReLU unit's PALLAS kernel vs its XLA fallback
+    on the real chip: same outputs and statistics (the CPU suite can
+    only check interpret mode — this is the Mosaic-compiled kernel)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import pallas_convbn as pcb
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 16, 16, 128).astype("float32") * 0.5,
+                    jnp.bfloat16)
+    w = jnp.asarray(rng.randn(128, 128, 3, 3).astype("float32") * 0.05,
+                    jnp.bfloat16)
+    sc = jnp.asarray(rng.rand(128).astype("float32") + 0.5)
+    bi = jnp.asarray(rng.randn(128).astype("float32") * 0.1)
+    sh = jnp.asarray(rng.randn(128).astype("float32") * 0.1)
+    kw = dict(kernel=(3, 3), stride=(1, 1), pad=(1, 1), act_in=True,
+              want_stats=True)
+    y_p, s1_p, s2_p = pcb._pallas_unit(x, w, sc, bi, sh, **kw)
+    y_x, s1_x, s2_x = pcb._xla_unit(x, w, sc, bi, sh, **kw)
+    assert_almost_equal(np.asarray(y_p, np.float32),
+                        np.asarray(y_x, np.float32), rtol=2e-2, atol=2e-2)
+    n = y_p.size // y_p.shape[-1]
+    assert_almost_equal(np.asarray(s1_p) / n, np.asarray(s1_x) / n,
+                        rtol=2e-2, atol=2e-2)
+    assert_almost_equal(np.asarray(s2_p) / n, np.asarray(s2_x) / n,
+                        rtol=3e-2, atol=3e-2)
+
+
+def test_fused_resnet_block_matches_on_chip():
+    """Whole fused bottleneck (Pallas path live) vs the op-granular
+    block on the chip: train-mode forward + every gradient."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import BottleneckV1
+
+    rng = np.random.RandomState(1)
+    xnp = rng.randn(2, 8, 8, 16).astype("float32")
+    block = BottleneckV1(16, 1, downsample=False, in_channels=16,
+                         layout="NHWC")
+    block.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    block(mx.nd.array(xnp))
+    snap = {n_: p.data().asnumpy().copy()
+            for n_, p in block.collect_params().items()}
+
+    def run(fused):
+        for n_, p in block.collect_params().items():
+            p.set_data(mx.nd.array(snap[n_]))
+        block.hybridize()
+        if fused:
+            os.environ["MXNET_FUSED_CONVBN"] = "1"
+        try:
+            with autograd.record():
+                out = block(mx.nd.array(xnp))
+                loss = (out * out).sum()
+            loss.backward()
+        finally:
+            os.environ.pop("MXNET_FUSED_CONVBN", None)
+        grads = {n_: p.grad().asnumpy().copy()
+                 for n_, p in block.collect_params().items()
+                 if p.grad_req != "null"}
+        return out.asnumpy(), grads
+
+    out_r, g_r = run(False)
+    out_f, g_f = run(True)
+    assert_almost_equal(out_f, out_r, rtol=1e-3, atol=1e-3)
+    for n_ in g_r:
+        assert_almost_equal(g_f[n_], g_r[n_], rtol=5e-3, atol=5e-3)
+
+
+def test_pallas_attention_vs_xla_on_chip():
+    """Flash-attention Pallas kernel vs the XLA fallback on-chip (the
+    committed delta VERDICT asked for lives in BENCH_ALL's bert
+    variants; this is the correctness side)."""
+    from mxnet_tpu.ops import pallas_attention as pa
+    from mxnet_tpu.ops import registry as reg
+
+    rng = np.random.RandomState(2)
+    b, s, d = 2, 128, 64
+    q = nd.array(rng.randn(b, s, d).astype("float32") * 0.2)
+    k = nd.array(rng.randn(b, s, d).astype("float32") * 0.2)
+    v = nd.array(rng.randn(b, s, d).astype("float32") * 0.2)
+    mask = nd.array(np.ones((b, s), "float32"))
+    out_p = nd.dot_product_attention(q, k, v, mask, num_heads=1)
+    # Force the XLA path for the second call: flipping the state alone
+    # is NOT enough — the first call jit-compiled the op with the
+    # Pallas branch baked in, and an identical-shape call would hit the
+    # registry's jit cache without re-consulting _pallas_wanted().  A
+    # subprocess is off the table (the tunnel is single-client), so
+    # clear the op-level jit caches to force a retrace.
+    old = pa._PALLAS_STATE["enabled"]
+    pa._PALLAS_STATE["enabled"] = False
+    saved_jit = dict(reg._jit_cache)
+    saved_grad = dict(reg._grad_cache)
+    reg._jit_cache.clear()
+    reg._grad_cache.clear()
+    try:
+        out_x = nd.dot_product_attention(q, k, v, mask, num_heads=1)
+    finally:
+        pa._PALLAS_STATE["enabled"] = old
+        reg._jit_cache.update(saved_jit)
+        reg._grad_cache.update(saved_grad)
+    assert_almost_equal(out_p.asnumpy(), out_x.asnumpy(), rtol=2e-2,
+                        atol=2e-2)
