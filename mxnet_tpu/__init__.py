@@ -53,7 +53,7 @@ def __getattr__(name):
     lazy = {"gluon", "optimizer", "initializer", "metric", "kvstore",
             "lr_scheduler", "io", "image", "symbol", "module", "parallel",
             "callback", "model", "test_utils", "engine", "runtime",
-            "visualization", "recordio", "contrib", "monitor", "name",
+            "visualization", "recordio", "contrib", "monitor", "name", "rnn",
             "attribute", "resource", "rtc", "kvstore_server"}
     if name == "sym":
         mod = importlib.import_module(".symbol", __name__)
@@ -68,6 +68,11 @@ def __getattr__(name):
 
         globals()["AttrScope"] = AttrScope
         return AttrScope
+    if name in ("mod", "viz"):
+        target = {"mod": "module", "viz": "visualization"}[name]
+        mod = importlib.import_module(f".{target}", __name__)
+        globals()[name] = mod
+        return mod
     if name == "mon":
         mod = importlib.import_module(".monitor", __name__)
         globals()["mon"] = mod

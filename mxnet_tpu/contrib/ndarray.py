@@ -7,4 +7,13 @@ from .control_flow import cond, foreach, while_loop  # noqa: F401
 
 
 def __getattr__(name):
-    return _register.lookup(name)
+    # bare name first, then the '_contrib_' registry alias — the ONE
+    # lookup rule for every contrib namespace spelling (nd.contrib.X,
+    # mx.contrib.ndarray.X)
+    for cand in (name, f"_contrib_{name}"):
+        try:
+            return _register.lookup(cand)
+        except AttributeError:
+            continue
+    raise AttributeError(
+        f"no contrib op {name!r} (tried '_contrib_{name}' too)")

@@ -9,10 +9,15 @@ _CACHE = {}
 
 
 def __getattr__(name):
+    # bare name first, then the '_contrib_' registry alias — the ONE
+    # lookup rule for every contrib namespace spelling (sym.contrib.X,
+    # mx.contrib.symbol.X)
     if name in _CACHE:
         return _CACHE[name]
-    if name in OP_REGISTRY:
-        fn = make_symbol_function(name)
-        _CACHE[name] = fn
-        return fn
-    raise AttributeError(f"no contrib symbol op {name!r}")
+    for cand in (name, f"_contrib_{name}"):
+        if cand in OP_REGISTRY:
+            fn = make_symbol_function(cand)
+            _CACHE[name] = fn
+            return fn
+    raise AttributeError(
+        f"no contrib symbol op {name!r} (tried '_contrib_{name}' too)")

@@ -70,6 +70,14 @@ minimum = _scalar_or_elemwise("broadcast_minimum", "_minimum_scalar")
 
 
 def __getattr__(name: str):
+    if name == "contrib":
+        # nd.contrib IS mx.contrib.ndarray (one lookup implementation,
+        # ref: python/mxnet/ndarray/contrib.py)
+        import importlib
+
+        mod = importlib.import_module("..contrib.ndarray", __name__)
+        globals()["contrib"] = mod
+        return mod
     try:
         return _register.lookup(name)
     except AttributeError:

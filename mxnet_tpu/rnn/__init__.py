@@ -1,0 +1,13 @@
+"""Legacy mx.rnn namespace (ref: python/mxnet/rnn/): symbolic RNN cells
+for BucketingModule workflows + bucketed sentence IO.  New code should
+prefer gluon.rnn (imperative/hybridizable) or the fused `RNN` op; this
+surface exists so reference training scripts run unmodified."""
+from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       SequentialRNNCell, BidirectionalCell, DropoutCell,
+                       ResidualCell, FusedRNNCell)
+from .io import BucketSentenceIter, encode_sentences
+
+__all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ResidualCell", "FusedRNNCell", "BucketSentenceIter",
+           "encode_sentences"]

@@ -30,6 +30,15 @@ def ones(shape, dtype="float32", name=None):
 
 
 def __getattr__(name):
+    if name == "contrib":
+        # sym.contrib IS mx.contrib.symbol (one lookup implementation,
+        # ref: python/mxnet/symbol/contrib.py)
+        import importlib
+
+        mod = importlib.import_module("..contrib.symbol", __name__)
+        _CACHE["contrib"] = mod
+        globals()["contrib"] = mod
+        return mod
     from ..ops.registry import OP_REGISTRY
     from .symbol import make_symbol_function
 

@@ -1,0 +1,172 @@
+"""Legacy mx.rnn symbolic cell API (ref: python/mxnet/rnn/) —
+parameter-level parity with gluon.rnn cells (identical gate layouts) and
+the end-to-end BucketingModule language-model recipe it exists for."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _bind_run(out_sym, feed):
+    ex = out_sym.bind(mx.cpu(), {k: nd.array(v) for k, v in feed.items()})
+    return [o.asnumpy() for o in ex.forward()]
+
+
+def _gluon_unroll(cell_cls, kwargs, params_np, x):
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import rnn as grnn
+
+    cell = cell_cls(**kwargs)
+    cell.initialize(ctx=mx.cpu())
+    outs, _ = cell.unroll(x.shape[1], nd.array(x), layout="NTC",
+                          merge_outputs=True)
+    for name, p in cell.collect_params().items():
+        short = name.split("_", 1)[1] if "_" in name else name
+        for k, v in params_np.items():
+            if name.endswith(k):
+                p.set_data(nd.array(v))
+    outs, _ = cell.unroll(x.shape[1], nd.array(x), layout="NTC",
+                          merge_outputs=True)
+    return outs.asnumpy()
+
+
+@pytest.mark.parametrize("kind", ["rnn", "lstm", "gru"])
+def test_legacy_cell_matches_gluon(kind):
+    rng = np.random.RandomState(0)
+    N, T, C, H = 2, 5, 4, 6
+    x = rng.randn(N, T, C).astype("float32") * 0.5
+    mult = {"rnn": 1, "lstm": 4, "gru": 3}[kind]
+    params = {
+        "i2h_weight": rng.randn(mult * H, C).astype("float32") * 0.3,
+        "h2h_weight": rng.randn(mult * H, H).astype("float32") * 0.3,
+        "i2h_bias": rng.randn(mult * H).astype("float32") * 0.1,
+        "h2h_bias": rng.randn(mult * H).astype("float32") * 0.1,
+    }
+
+    from mxnet_tpu import rnn as legacy
+    from mxnet_tpu.gluon import rnn as grnn
+
+    cell = {"rnn": legacy.RNNCell, "lstm": legacy.LSTMCell,
+            "gru": legacy.GRUCell}[kind](H, prefix=f"{kind}0_")
+    data = mx.sym.Variable("data")
+    merged, _states = cell.unroll(T, data, layout="NTC")
+    feed = {"data": x}
+    feed.update({f"{kind}0_{k}": v for k, v in params.items()})
+    got = _bind_run(merged, feed)[0]
+
+    gcell_cls = {"rnn": grnn.RNNCell, "lstm": grnn.LSTMCell,
+                 "gru": grnn.GRUCell}[kind]
+    ref = _gluon_unroll(gcell_cls, {"hidden_size": H}, params, x)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_sequential_and_residual_and_dropout_stack():
+    from mxnet_tpu import rnn as legacy
+
+    rng = np.random.RandomState(1)
+    N, T, H = 2, 4, 6
+    x = rng.randn(N, T, H).astype("float32") * 0.5
+    stack = legacy.SequentialRNNCell()
+    stack.add(legacy.LSTMCell(H, prefix="l0_"))
+    stack.add(legacy.DropoutCell(0.0))
+    stack.add(legacy.ResidualCell(legacy.GRUCell(H, prefix="l1_")))
+    data = mx.sym.Variable("data")
+    merged, states = stack.unroll(T, data, layout="NTC")
+    assert len(states) == 3  # lstm h,c + gru h
+    feed = {"data": x}
+    for name in stack.params:
+        mult = 4 if name.startswith("l0_") else 3
+        cols = H if "h2h" in name or name.startswith("l1_") else H
+        shape = (mult * H, H) if "weight" in name else (mult * H,)
+        feed[name] = rng.randn(*shape).astype("float32") * 0.2
+    out = _bind_run(merged, feed)[0]
+    assert out.shape == (N, T, H)
+    assert np.isfinite(out).all()
+
+
+def test_bidirectional_unroll():
+    from mxnet_tpu import rnn as legacy
+
+    rng = np.random.RandomState(2)
+    N, T, C, H = 2, 4, 3, 5
+    x = rng.randn(N, T, C).astype("float32")
+    bi = legacy.BidirectionalCell(legacy.LSTMCell(H, prefix="fw_"),
+                                  legacy.LSTMCell(H, prefix="bw_"))
+    data = mx.sym.Variable("data")
+    merged, _ = bi.unroll(T, data, layout="NTC")
+    feed = {"data": x}
+    for name in bi.params:
+        shape = ((4 * H, C) if name.endswith("i2h_weight")
+                 else (4 * H, H) if name.endswith("h2h_weight")
+                 else (4 * H,))
+        feed[name] = rng.randn(*shape).astype("float32") * 0.2
+    out = _bind_run(merged, feed)[0]
+    assert out.shape == (N, T, 2 * H)
+
+
+def test_encode_sentences_and_bucket_iter():
+    from mxnet_tpu import rnn as legacy
+
+    sents = [["a", "b", "c"], ["a", "c"], ["b", "c", "a", "b"],
+             ["c", "a"], ["a", "b"], ["b", "c", "a"]]
+    coded, vocab = legacy.encode_sentences(sents, invalid_label=0,
+                                           start_label=1)
+    assert set(vocab.values()) >= {1, 2, 3}
+    it = legacy.BucketSentenceIter(coded, batch_size=2, buckets=[2, 4],
+                                   invalid_label=0)
+    n = 0
+    for batch in it:
+        n += 1
+        assert batch.bucket_key in (2, 4)
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        assert d.shape == (2, batch.bucket_key)
+        # label = data shifted left one step
+        np.testing.assert_array_equal(l[:, :-1], d[:, 1:])
+    assert n >= 2
+
+
+def test_fused_cell_unroll_runs():
+    from mxnet_tpu import rnn as legacy
+
+    rng = np.random.RandomState(3)
+    N, T, C, H = 2, 4, 3, 5
+    x = rng.randn(N, T, C).astype("float32")
+    cell = legacy.FusedRNNCell(H, num_layers=2, mode="lstm",
+                               prefix="f_")
+    data = mx.sym.Variable("data")
+    merged, _ = cell.unroll(T, data, layout="NTC")
+    # the flat weight blob carries the reference's name (checkpoints map)
+    assert cell.params == ["f_parameters"]
+    ex = merged.simple_bind(mx.cpu(), data=(N, T, C))
+    out = ex.forward(data=nd.array(x))[0]
+    assert out.shape == (N, T, H)
+
+
+def test_legacy_lstm_begin_state_passthrough():
+    """A non-zero begin_state must actually flow into the unroll (a
+    silently-ignored begin_state was a review finding)."""
+    from mxnet_tpu import rnn as legacy
+
+    rng = np.random.RandomState(4)
+    N, T, C, H = 2, 3, 4, 5
+    x = rng.randn(N, T, C).astype("float32") * 0.3
+    h0 = rng.randn(N, H).astype("float32")
+    c0 = rng.randn(N, H).astype("float32")
+    cell = legacy.LSTMCell(H, prefix="s_")
+    data = mx.sym.Variable("data")
+    bh = mx.sym.Variable("h0")
+    bc = mx.sym.Variable("c0")
+    merged, _ = cell.unroll(T, data, begin_state=[bh, bc], layout="NTC")
+    params = {
+        "s_i2h_weight": rng.randn(4 * H, C).astype("float32") * 0.3,
+        "s_h2h_weight": rng.randn(4 * H, H).astype("float32") * 0.3,
+        "s_i2h_bias": np.zeros(4 * H, "float32"),
+        "s_h2h_bias": np.zeros(4 * H, "float32"),
+    }
+    out1 = _bind_run(merged, {"data": x, "h0": h0, "c0": c0,
+                              **params})[0]
+    out2 = _bind_run(merged, {"data": x, "h0": h0 * 0, "c0": c0 * 0,
+                              **params})[0]
+    assert np.abs(out1 - out2).max() > 1e-4  # states mattered
