@@ -16,7 +16,8 @@ from typing import Optional
 
 import jax
 
-__all__ = ["seed", "next_key", "zero_key", "key_provider", "KeyProvider"]
+__all__ = ["seed", "next_key", "zero_key", "key_provider", "KeyProvider",
+           "uniform", "normal", "randint"]
 
 
 class KeyProvider:
@@ -89,3 +90,39 @@ class key_provider:
     def __exit__(self, *exc):
         _STATE.provider = self._old
         return False
+
+
+# ---------------------------------------------------------------------------
+# module-level samplers (ref: python/mxnet/random.py uniform/normal/randint
+# delegating to the nd.random namespace)
+# ---------------------------------------------------------------------------
+
+def _with_out(res, out):
+    """Reference `out=` semantics: fill in place and return `out`."""
+    if out is None:
+        return res
+    out._data = res.data
+    return out
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), dtype="float32", ctx=None,
+            out=None):
+    from . import ndarray as nd
+
+    return _with_out(nd.random.uniform(low=low, high=high, shape=shape,
+                                       dtype=dtype, ctx=ctx), out)
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None,
+           out=None):
+    from . import ndarray as nd
+
+    return _with_out(nd.random.normal(loc=loc, scale=scale, shape=shape,
+                                      dtype=dtype, ctx=ctx), out)
+
+
+def randint(low, high, shape=(1,), dtype="int32", ctx=None, out=None):
+    from . import ndarray as nd
+
+    return _with_out(nd.random.randint(low=low, high=high, shape=shape,
+                                       dtype=dtype, ctx=ctx), out)

@@ -22,6 +22,7 @@ from .context import Context, cpu, current_context
 from .ndarray import NDArray
 
 __all__ = [
+    "list_gpus",
     "default_context", "set_default_context", "assert_almost_equal",
     "almost_equal", "same", "rand_ndarray", "rand_shape_2d", "rand_shape_3d",
     "rand_shape_nd", "check_numeric_gradient", "check_symbolic_forward",
@@ -30,6 +31,15 @@ __all__ = [
 ]
 
 _DEFAULT_CTX: Optional[Context] = None
+
+
+def list_gpus():
+    """ref: test_utils.list_gpus — accelerator ordinals.  Here the
+    accelerators are TPU chips; returns their local indices (empty on a
+    CPU-only backend) so `if mx.test_utils.list_gpus():` gates work."""
+    from .context import num_gpus
+
+    return list(range(num_gpus()))
 
 
 def default_context() -> Context:

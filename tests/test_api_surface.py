@@ -1,0 +1,60 @@
+"""Reference API-surface probe: the spellings real MXNet-1.x scripts
+use must resolve (modules, aliases, namespaces, common helpers).  Pure
+attribute resolution — numeric behavior is covered elsewhere."""
+import mxnet_tpu as mx
+
+PROBES = [
+    # module aliases
+    "mx.nd", "mx.sym", "mx.mod.Module", "mx.viz.plot_network",
+    "mx.kv.create", "mx.rnn.LSTMCell", "mx.rnn.BucketSentenceIter",
+    # contrib namespaces
+    "mx.nd.contrib.box_nms", "mx.sym.contrib.BilinearResize2D",
+    "mx.contrib.ndarray.box_iou", "mx.contrib.symbol.ROIAlign",
+    # frequently-used helpers
+    "mx.metric.create", "mx.initializer.Uniform", "mx.initializer.Constant",
+    "mx.random.uniform", "mx.random.normal", "mx.random.randint",
+    "mx.random.seed", "mx.test_utils.list_gpus",
+    "mx.gluon.utils.split_and_load", "mx.gluon.utils.clip_global_norm",
+    "mx.gluon.nn.HybridLambda", "mx.gluon.rnn.ZoneoutCell",
+    "mx.gluon.loss.CTCLoss", "mx.callback.Speedometer",
+    "mx.io.NDArrayIter", "mx.io.PrefetchingIter",
+    "mx.image.imdecode", "mx.image.CreateAugmenter",
+    "mx.model.load_checkpoint", "mx.monitor.Monitor",
+    "mx.profiler.set_config", "mx.engine.bulk", "mx.attribute.AttrScope",
+    "mx.sym.MakeLoss", "mx.sym.BlockGrad", "mx.sym.Group",
+    "mx.nd.one_hot", "mx.nd.topk", "mx.nd.where", "mx.nd.random.uniform",
+]
+
+
+def test_reference_spellings_resolve():
+    missing = []
+    for p in PROBES:
+        obj = mx
+        try:
+            for part in p.split(".")[1:]:
+                obj = getattr(obj, part)
+        except AttributeError:
+            missing.append(p)
+    assert not missing, f"reference spellings missing: {missing}"
+
+
+def test_module_level_samplers():
+    mx.random.seed(7)
+    u = mx.random.uniform(-1, 1, shape=(3, 4))
+    n = mx.random.normal(2.0, 0.5, shape=(64,))
+    r = mx.random.randint(0, 5, shape=(32,))
+    a = u.asnumpy()
+    assert a.shape == (3, 4) and (a >= -1).all() and (a <= 1).all()
+    assert abs(float(n.asnumpy().mean()) - 2.0) < 0.5
+    rv = r.asnumpy()
+    assert rv.min() >= 0 and rv.max() < 5
+
+
+def test_sampler_out_kwarg_fills_in_place():
+    from mxnet_tpu import nd
+
+    arr = nd.zeros((4,))
+    ret = mx.random.uniform(1.0, 2.0, shape=(4,), out=arr)
+    assert ret is arr
+    a = arr.asnumpy()
+    assert (a >= 1.0).all() and (a <= 2.0).all()
