@@ -11,22 +11,43 @@ __all__ = ["uniform", "normal", "randn", "randint", "gamma", "exponential",
 seed = _random.seed
 
 
-def _sample(op, shape, dtype, ctx, **params):
+def _sample(op, shape, dtype, ctx, out=None, **params):
+    """One implementation of the sampler contract for every wrapper,
+    including the reference's in-place `out=` semantics: with `out`
+    given, shape/dtype/ctx default from it (and must agree when also
+    passed explicitly), the sample lands on out's device, and `out` is
+    filled and returned."""
+    from ..base import MXNetError
+
+    if out is not None:
+        if shape is not None and tuple(out.shape) != (
+                (shape,) if isinstance(shape, int) else tuple(shape)):
+            raise MXNetError(f"out shape {out.shape} != requested {shape}")
+        if dtype is not None and str(out.dtype) != str(dtype):
+            raise MXNetError(f"out dtype {out.dtype} != requested {dtype}")
+        shape = tuple(out.shape)
+        dtype = str(out.dtype)
+        ctx = ctx or out.ctx
     if shape is None:
         shape = (1,)
     if isinstance(shape, int):
         shape = (shape,)
-    out = invoke(op, _random.next_key(), shape=tuple(shape),
+    res = invoke(op, _random.next_key(), shape=tuple(shape),
                  dtype=dtype or "float32", **params)
-    return out.as_in_context(ctx) if ctx is not None else out
+    if ctx is not None:
+        res = res.as_in_context(ctx)
+    if out is not None:
+        out._data = res.data
+        return out
+    return res
 
 
 def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None):
-    return _sample("_random_uniform", shape, dtype, ctx, low=low, high=high)
+    return _sample("_random_uniform", shape, dtype, ctx, out=out, low=low, high=high)
 
 
 def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None):
-    return _sample("_random_normal", shape, dtype, ctx, loc=loc, scale=scale)
+    return _sample("_random_normal", shape, dtype, ctx, out=out, loc=loc, scale=scale)
 
 
 def randn(*shape, dtype=None, ctx=None):
@@ -34,35 +55,35 @@ def randn(*shape, dtype=None, ctx=None):
 
 
 def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
-    return _sample("_random_randint", shape, dtype, ctx, low=low, high=high)
+    return _sample("_random_randint", shape, dtype, ctx, out=out, low=low, high=high)
 
 
 def gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None, out=None):
-    return _sample("_random_gamma", shape, dtype, ctx, alpha=alpha, beta=beta)
+    return _sample("_random_gamma", shape, dtype, ctx, out=out, alpha=alpha, beta=beta)
 
 
 def exponential(scale=1.0, shape=None, dtype=None, ctx=None, out=None):
-    return _sample("_random_exponential", shape, dtype, ctx, lam=1.0 / scale)
+    return _sample("_random_exponential", shape, dtype, ctx, out=out, lam=1.0 / scale)
 
 
 def poisson(lam=1.0, shape=None, dtype=None, ctx=None, out=None):
-    return _sample("_random_poisson", shape, dtype, ctx, lam=lam)
+    return _sample("_random_poisson", shape, dtype, ctx, out=out, lam=lam)
 
 
 def negative_binomial(k=1, p=1.0, shape=None, dtype=None, ctx=None, out=None):
-    return _sample("_random_negative_binomial", shape, dtype, ctx, k=k, p=p)
+    return _sample("_random_negative_binomial", shape, dtype, ctx, out=out, k=k, p=p)
 
 
 def gumbel(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None):
-    return _sample("_random_gumbel", shape, dtype, ctx, loc=loc, scale=scale)
+    return _sample("_random_gumbel", shape, dtype, ctx, out=out, loc=loc, scale=scale)
 
 
 def laplace(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None):
-    return _sample("_random_laplace", shape, dtype, ctx, loc=loc, scale=scale)
+    return _sample("_random_laplace", shape, dtype, ctx, out=out, loc=loc, scale=scale)
 
 
 def bernoulli(p=0.5, shape=None, dtype=None, ctx=None, out=None):
-    return _sample("_random_bernoulli", shape, dtype, ctx, p=p)
+    return _sample("_random_bernoulli", shape, dtype, ctx, out=out, p=p)
 
 
 def multinomial(data, shape=(), get_prob=False, dtype="int32", **kw):

@@ -97,32 +97,22 @@ class key_provider:
 # delegating to the nd.random namespace)
 # ---------------------------------------------------------------------------
 
-def _with_out(res, out):
-    """Reference `out=` semantics: fill in place and return `out`."""
-    if out is None:
-        return res
-    out._data = res.data
-    return out
-
-
-def uniform(low=0.0, high=1.0, shape=(1,), dtype="float32", ctx=None,
-            out=None):
+def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None):
     from . import ndarray as nd
 
-    return _with_out(nd.random.uniform(low=low, high=high, shape=shape,
-                                       dtype=dtype, ctx=ctx), out)
+    return nd.random.uniform(low=low, high=high, shape=shape, dtype=dtype,
+                             ctx=ctx, out=out)
 
 
-def normal(loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None,
-           out=None):
+def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None):
     from . import ndarray as nd
 
-    return _with_out(nd.random.normal(loc=loc, scale=scale, shape=shape,
-                                      dtype=dtype, ctx=ctx), out)
+    return nd.random.normal(loc=loc, scale=scale, shape=shape, dtype=dtype,
+                            ctx=ctx, out=out)
 
 
-def randint(low, high, shape=(1,), dtype="int32", ctx=None, out=None):
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
     from . import ndarray as nd
 
-    return _with_out(nd.random.randint(low=low, high=high, shape=shape,
-                                       dtype=dtype, ctx=ctx), out)
+    return nd.random.randint(low=low, high=high, shape=shape, dtype=dtype,
+                             ctx=ctx, out=out)

@@ -1,6 +1,8 @@
 """Reference API-surface probe: the spellings real MXNet-1.x scripts
 use must resolve (modules, aliases, namespaces, common helpers).  Pure
 attribute resolution — numeric behavior is covered elsewhere."""
+import numpy as np
+
 import mxnet_tpu as mx
 
 PROBES = [
@@ -51,10 +53,24 @@ def test_module_level_samplers():
 
 
 def test_sampler_out_kwarg_fills_in_place():
+    import pytest
+
     from mxnet_tpu import nd
+    from mxnet_tpu.base import MXNetError
 
     arr = nd.zeros((4,))
     ret = mx.random.uniform(1.0, 2.0, shape=(4,), out=arr)
     assert ret is arr
     a = arr.asnumpy()
     assert (a >= 1.0).all() and (a <= 2.0).all()
+    # the reference idiom: shape defaults FROM out (no shape arg)
+    w = nd.zeros((100,))
+    mx.random.uniform(-1, 1, out=w)
+    assert w.shape == (100,) and float(np.abs(w.asnumpy()).max()) > 0
+    # nd.random spelling honors out= identically
+    v = nd.zeros((8,))
+    nd.random.normal(0.0, 1.0, out=v)
+    assert float(np.abs(v.asnumpy()).max()) > 0
+    # mismatched explicit shape/dtype refuse instead of corrupting out
+    with pytest.raises(MXNetError, match="shape"):
+        mx.random.uniform(shape=(3,), out=w)

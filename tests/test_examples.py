@@ -90,3 +90,12 @@ def test_quantize_model_example():
                 "--calib-mode", "entropy"], timeout=560)
     assert "int8 (entropy): accuracy=" in out
     assert "accuracy drop:" in out
+
+
+@pytest.mark.parametrize("method", ["ring", "ulysses"])
+def test_long_context_lm_example(method):
+    out = _run(["examples/long_context_lm.py", "--cpu", "--method", method,
+                "--dp", "2", "--sp", "4", "--steps", "5",
+                "--seq-len", "64", "--units", "32", "--heads", "4",
+                "--layers", "1", "--vocab", "128"])
+    assert "loss" in out and "sp=4" in out
