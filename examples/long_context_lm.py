@@ -48,8 +48,17 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
 
+    import jax
     import numpy as np
     import mxnet_tpu as mx
+
+    # clamp the mesh to the devices that actually exist (1-chip TPU:
+    # dp=1 sp=1 — the advertised single-chip invocation)
+    n_dev = jax.device_count()
+    while args.dp * args.sp > n_dev and args.sp > 1:
+        args.sp //= 2
+    while args.dp * args.sp > n_dev and args.dp > 1:
+        args.dp //= 2
     from mxnet_tpu import parallel
     from mxnet_tpu.gluon import nn
     from mxnet_tpu.gluon.block import HybridBlock

@@ -23,8 +23,16 @@ def _sample(op, shape, dtype, ctx, out=None, **params):
         if shape is not None and tuple(out.shape) != (
                 (shape,) if isinstance(shape, int) else tuple(shape)):
             raise MXNetError(f"out shape {out.shape} != requested {shape}")
-        if dtype is not None and str(out.dtype) != str(dtype):
-            raise MXNetError(f"out dtype {out.dtype} != requested {dtype}")
+        if dtype is not None:
+            import numpy as _np
+
+            try:
+                same = _np.dtype(dtype) == _np.dtype(out.dtype)
+            except TypeError:  # e.g. bfloat16 class spellings
+                same = str(out.dtype) == str(dtype)
+            if not same:
+                raise MXNetError(
+                    f"out dtype {out.dtype} != requested {dtype}")
         shape = tuple(out.shape)
         dtype = str(out.dtype)
         ctx = ctx or out.ctx
@@ -54,8 +62,13 @@ def randn(*shape, dtype=None, ctx=None):
     return normal(0.0, 1.0, shape or (1,), dtype=dtype, ctx=ctx)
 
 
-def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
-    return _sample("_random_randint", shape, dtype, ctx, out=out, low=low, high=high)
+def randint(low, high, shape=None, dtype=None, ctx=None, out=None):
+    # signature default must stay None: with out= given, dtype defaults
+    # FROM out (int64 out works); int32 only when neither is specified
+    if dtype is None and out is None:
+        dtype = "int32"
+    return _sample("_random_randint", shape, dtype, ctx, out=out,
+                   low=low, high=high)
 
 
 def gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None, out=None):

@@ -294,11 +294,27 @@ def _shape_supported(x, w, kernel, stride, pad, act_in, want_stats) -> bool:
     return ok
 
 
+def _multi_device_trace() -> bool:
+    """True when tracing under a multi-device mesh: GSPMD cannot
+    partition a pallas_call (that needs an explicit shard_map), so the
+    fused unit must take the XLA fallback there — the fallback is plain
+    XLA ops and partitions fine.  Single chip (the bench/dryrun dp=1
+    mesh) keeps the Pallas kernel."""
+    try:
+        from ..parallel.mesh import current_mesh
+
+        m = current_mesh()
+        return m is not None and m.mesh.size > 1
+    except Exception:
+        return False
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
 def _unit(x, w, in_scale, in_bias, shift, kernel, stride, pad, act_in,
           want_stats):
-    if _pallas_wanted() and _shape_supported(x, w, kernel, stride, pad,
-                                             act_in, want_stats):
+    if _pallas_wanted() and not _multi_device_trace() \
+            and _shape_supported(x, w, kernel, stride, pad,
+                                 act_in, want_stats):
         try:
             return _pallas_unit(x, w, in_scale, in_bias, shift,
                                 kernel=kernel, stride=stride, pad=pad,

@@ -364,7 +364,14 @@ class SPMDTrainer:
                 trace = ActiveTrace(
                     {id(p): pv[n] for n, p in plist}, train=True)
                 trace.mirror = trainer.remat  # per-sub-block segments
-                with trace, rnd.key_provider(rnd.KeyProvider(key)):
+                # the trainer's mesh scope is active for the whole
+                # traced step, wherever step() was called from — code
+                # consulting current_mesh() at trace time (ring/ulysses
+                # attention, the fused-conv multi-device gate, sharding
+                # constraints) sees THIS mesh, not the caller's ambient
+                # scope
+                with trainer.mesh, trace, \
+                        rnd.key_provider(rnd.KeyProvider(key)):
                     out = block.forward(*inputs)
                     outs = out if isinstance(out, (list, tuple)) else (out,)
                     l = loss(outs[0], *labels)
@@ -534,7 +541,14 @@ class SPMDTrainer:
             def fwd(params, ivals, key):
                 trace = ActiveTrace({id(p): params[n] for n, p in plist},
                                     train=False)
-                with trace, rnd.key_provider(rnd.KeyProvider(key)):
+                # the trainer's mesh scope is active for the whole
+                # traced step, wherever step() was called from — code
+                # consulting current_mesh() at trace time (ring/ulysses
+                # attention, the fused-conv multi-device gate, sharding
+                # constraints) sees THIS mesh, not the caller's ambient
+                # scope
+                with trainer.mesh, trace, \
+                        rnd.key_provider(rnd.KeyProvider(key)):
                     out = block.forward(*ivals)
                 return out
 

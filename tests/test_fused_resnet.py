@@ -156,3 +156,39 @@ def test_fused_full_resnet_train_step():
         atol = 5e-3 + 1e-5 * float(np.max(np.abs(gr)))
         np.testing.assert_allclose(gf, gr, rtol=5e-3, atol=atol,
                                    err_msg=f"grad {nr} / {nf}")
+
+
+def test_fused_flag_works_under_multi_device_mesh():
+    """MXNET_FUSED_CONVBN under a dp>1 SPMD mesh must compile (the
+    Pallas kernel is ungated to the XLA fallback there — GSPMD cannot
+    partition a pallas_call) and match the unfused trainer's loss."""
+    import os
+
+    from mxnet_tpu import parallel
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    def one_loss(fused):
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = vision.resnet18_v1(classes=4, layout="NHWC")
+        net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+        net(mx.nd.zeros((1, 32, 32, 3)))
+        x = np.random.RandomState(2).rand(8, 32, 32, 3).astype("float32")
+        y = (np.arange(8) % 4).astype("int32")
+        if fused:
+            os.environ["MXNET_FUSED_CONVBN"] = "1"
+        try:
+            with parallel.make_mesh(dp=2):
+                tr = parallel.SPMDTrainer(
+                    net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                    {"learning_rate": 0.1})
+                lv = float(tr.step(tr._place(x, None),
+                                   tr._place(y, None)).asnumpy())
+        finally:
+            os.environ.pop("MXNET_FUSED_CONVBN", None)
+        return lv
+
+    ref = one_loss(False)
+    got = one_loss(True)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)

@@ -146,3 +146,61 @@ def test_defaults_are_identity():
                              (1, 1), (1, 1), (0, 0), False)
     np.testing.assert_allclose(y, yr, rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(s2, s2r, rtol=1e-4, atol=1e-3)
+
+
+def test_multi_device_mesh_gate_selects_fallback(monkeypatch):
+    """Under a multi-device mesh the fused unit must take the XLA
+    fallback (GSPMD cannot partition a pallas_call); on a single-device
+    or no-mesh trace the Pallas path stays selected.  Also pins that
+    SPMDTrainer's traced step runs under ITS mesh scope even when
+    step() is called outside `with mesh:`."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+
+    monkeypatch.setenv("MXNET_PALLAS_INTERPRET", "1")
+    monkeypatch.setitem(pcb._STATE, "enabled", None)
+
+    calls = {"pallas": 0}
+    real = pcb._pallas_unit
+
+    def spy(*a, **k):
+        calls["pallas"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(pcb, "_pallas_unit", spy)
+    x = jnp.asarray(_rand((2, 4, 4, 8)))
+    w = jnp.asarray(_rand((8, 8, 1, 1), scale=0.2))
+
+    pcb.fused_conv_unit(x, w)   # warm-up (probe + first call both spy)
+    base = calls["pallas"]
+    pcb.fused_conv_unit(x, w)                      # no mesh: Pallas
+    assert calls["pallas"] == base + 1
+    with parallel.make_mesh(dp=2):
+        pcb.fused_conv_unit(x, w)                  # dp=2: fallback
+    assert calls["pallas"] == base + 1
+    with parallel.make_mesh(dp=1):
+        pcb.fused_conv_unit(x, w)                  # size-1 mesh: Pallas
+    assert calls["pallas"] == base + 2
+
+    # trainer path: mesh scope is pushed by the trace itself
+    mesh = parallel.make_mesh(dp=2)
+    assert parallel.current_mesh() is None
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    class Step(HybridBlock):
+        def hybrid_forward(self, F, a):
+            y, _s1, _s2 = F.FusedConvUnit(a, jnp.asarray(w))
+            return y.astype(jnp.float32).mean()
+
+    blk = Step()
+    blk.initialize(ctx=mx.cpu())
+
+    class _Id:
+        def __call__(self, out, *l):
+            return out
+
+    tr = parallel.SPMDTrainer(blk, _Id(), "sgd", {"learning_rate": 0.1},
+                              mesh=mesh, n_labels=0)
+    before = calls["pallas"]
+    tr.step(tr._place(np.asarray(x), None))        # OUTSIDE with mesh:
+    assert calls["pallas"] == before               # gate still engaged
