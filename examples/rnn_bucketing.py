@@ -103,6 +103,11 @@ def main():
     ap.add_argument("--num-hidden", type=int, default=200)
     ap.add_argument("--num-layers", type=int, default=2)
     ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--cells", action="store_true",
+                    help="build the graph with the legacy mx.rnn cell "
+                         "API (unrolled LSTMCell stack, the reference "
+                         "lstm_bucketing.py design) instead of the "
+                         "fused RNN op")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -149,11 +154,20 @@ def main():
         label = mx.sym.var("softmax_label")
         embed = mx.sym.Embedding(data, input_dim=vocab_size,
                                  output_dim=args.num_hidden, name="embed")
-        rnn_in = mx.sym.transpose(embed, axes=(1, 0, 2))  # (T, N, H)
-        out = mx.sym.RNN(rnn_in, state_size=args.num_hidden,
-                         num_layers=args.num_layers, mode="lstm",
-                         state_outputs=False, name="lstm")
-        out = mx.sym.transpose(out, axes=(1, 0, 2))       # (N, T, H)
+        if args.cells:
+            # legacy mx.rnn cell path (ref: lstm_bucketing.py): per-bucket
+            # unrolled LSTMCell stack; params shared across buckets by name
+            stack = mx.rnn.SequentialRNNCell()
+            for i in range(args.num_layers):
+                stack.add(mx.rnn.LSTMCell(args.num_hidden,
+                                          prefix=f"lstm_l{i}_"))
+            out, _states = stack.unroll(seq_len, embed, layout="NTC")
+        else:
+            rnn_in = mx.sym.transpose(embed, axes=(1, 0, 2))  # (T, N, H)
+            out = mx.sym.RNN(rnn_in, state_size=args.num_hidden,
+                             num_layers=args.num_layers, mode="lstm",
+                             state_outputs=False, name="lstm")
+            out = mx.sym.transpose(out, axes=(1, 0, 2))       # (N, T, H)
         out = mx.sym.reshape(out, shape=(-1, args.num_hidden))
         pred = mx.sym.FullyConnected(out, num_hidden=vocab_size,
                                      name="pred")

@@ -99,3 +99,9 @@ def test_long_context_lm_example(method):
                 "--seq-len", "64", "--units", "32", "--heads", "4",
                 "--layers", "1", "--vocab", "128"])
     assert "loss" in out and "sp=4" in out
+
+
+def test_rnn_bucketing_legacy_cells():
+    out = _run(["examples/rnn_bucketing.py", "--cpu", "--small",
+                "--cells"])
+    assert "perplexity" in out
