@@ -1,0 +1,72 @@
+"""Run the whole on-chip measurement queue in one command.
+
+The TPU tunnel in this container dies for hours at a time (see
+CHANGES_r04.md), so when a window opens, everything must land in one
+shot — run this the moment a probe succeeds:
+
+    python tools/run_tpu_queue.py [--round 4]
+
+Sequential bounded steps (the tunnel is single-client — nothing may run
+concurrently with this):
+  1. tools/run_tpu_tests.py      -> TPU_TESTS_r0N.json (29-case lane)
+  2. bench.py                    -> BENCH snapshot (unfused + fused in one run)
+  3. bench_all.py                -> BENCH_ALL.json (5 configs + variants)
+  4. tools/opperf.py --large     -> OPPERF_TPU.json
+Each step's outcome is recorded in TPU_QUEUE_RESULTS.json; a failed or
+timed-out step does not stop the rest.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, default=4)
+    ap.add_argument("--out",
+                    default=os.path.join(_REPO, "TPU_QUEUE_RESULTS.json"))
+    args = ap.parse_args()
+
+    n = args.round
+    steps = [
+        ("tpu_tests",
+         [sys.executable, "tools/run_tpu_tests.py",
+          "--out", f"TPU_TESTS_r{n:02d}.json"], 1800),
+        ("bench",
+         [sys.executable, "bench.py"], 2400),
+        ("bench_all",
+         [sys.executable, "bench_all.py"], 7200),
+        ("opperf_tpu",
+         [sys.executable, "tools/opperf.py", "--large",
+          "--out", "OPPERF_TPU.json"], 2400),
+    ]
+
+    results = []
+    for name, cmd, timeout in steps:
+        t0 = time.time()
+        try:
+            p = subprocess.run(cmd, cwd=_REPO, capture_output=True,
+                               text=True, timeout=timeout)
+            tail = "\n".join((p.stdout + p.stderr).splitlines()[-5:])
+            rec = {"step": name, "rc": p.returncode,
+                   "seconds": round(time.time() - t0, 1), "tail": tail}
+        except subprocess.TimeoutExpired:
+            rec = {"step": name, "rc": -1, "timeout_s": timeout,
+                   "seconds": round(time.time() - t0, 1)}
+        results.append(rec)
+        print(json.dumps(rec))
+        with open(args.out, "w") as f:
+            json.dump({"when": time.strftime("%Y-%m-%d %H:%M:%S"),
+                       "round": n, "results": results}, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
