@@ -267,6 +267,7 @@ def _xla_unit(x, w, in_scale, in_bias, shift, *, kernel, stride, pad,
 # the middle of an outer trace — and configurations Mosaic rejects are
 # pinned to the XLA fallback.
 _SHAPE_OK: dict = {}
+_PROBE_SPENT = [0.0]  # cumulative probe-compile seconds
 
 
 def _shape_supported(x, w, kernel, stride, pad, act_in, want_stats) -> bool:
@@ -274,9 +275,20 @@ def _shape_supported(x, w, kernel, stride, pad, act_in, want_stats) -> bool:
            want_stats)
     ok = _SHAPE_OK.get(key)
     if ok is None:
+        import time as _time
+
+        budget = get_env("MXNET_PALLAS_PROBE_BUDGET", 300.0, float)
         if get_env("MXNET_PALLAS_INTERPRET", False, bool):
             ok = True  # interpreter mode has no Mosaic stage
+        elif _PROBE_SPENT[0] >= budget:
+            # probe time is bounded: ~20+ unique ResNet shapes at
+            # ~10s/compile could otherwise eat the bench child's
+            # timeout; shapes past the budget take the safe XLA
+            # fallback (the traffic-heavy early layers probe first in
+            # trace order)
+            ok = False
         else:
+            _t0 = _time.perf_counter()
             try:
                 args = [jax.ShapeDtypeStruct(x.shape, x.dtype),
                         jax.ShapeDtypeStruct(w.shape, w.dtype),
@@ -290,6 +302,8 @@ def _shape_supported(x, w, kernel, stride, pad, act_in, want_stats) -> bool:
                 ok = True
             except Exception:
                 ok = False
+            finally:
+                _PROBE_SPENT[0] += _time.perf_counter() - _t0
         _SHAPE_OK[key] = ok
     return ok
 
