@@ -285,8 +285,10 @@ def _shape_supported(x, w, kernel, stride, pad, act_in, want_stats) -> bool:
             # ~10s/compile could otherwise eat the bench child's
             # timeout; shapes past the budget take the safe XLA
             # fallback (the traffic-heavy early layers probe first in
-            # trace order)
-            ok = False
+            # trace order).  NOT cached: 'never probed' must stay
+            # distinguishable from 'Mosaic rejected' so a later call
+            # with budget headroom can still probe this shape
+            return False
         else:
             _t0 = _time.perf_counter()
             try:

@@ -105,3 +105,26 @@ def test_rnn_bucketing_legacy_cells():
     out = _run(["examples/rnn_bucketing.py", "--cpu", "--small",
                 "--cells"])
     assert "perplexity" in out
+
+
+def test_mnist_gluon_example():
+    """The SURVEY minimum-slice script (examples/gluon/mnist.py): val
+    accuracy parsed from the output must clear the script's own bar."""
+    import re
+
+    out = _run(["examples/gluon/mnist.py", "--cpu", "--epochs", "1",
+                "--batch-size", "50"], timeout=420)
+    m = re.search(r"\[val\] accuracy=([0-9.]+)", out)
+    assert m, out[-500:]
+    assert float(m.group(1)) > 0.9
+
+
+def test_imagenet_train_synthetic():
+    import re
+
+    out = _run(["examples/imagenet_train.py", "--synthetic-data",
+                "--image-size", "32", "--per-class", "8", "--classes", "4",
+                "--batch-size", "8", "--epochs", "1"], timeout=420)
+    assert "data pipeline:" in out          # the native path engaged
+    m = re.search(r"([0-9.]+) img/s", out)
+    assert m and float(m.group(1)) > 0
