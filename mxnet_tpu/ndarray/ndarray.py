@@ -791,6 +791,35 @@ class NDArray:
     def round(self):
         return self._op("round")
 
+    def sign(self):
+        return self._op("sign")
+
+    def floor(self):
+        return self._op("floor")
+
+    def ceil(self):
+        return self._op("ceil")
+
+    def zeros_like(self):
+        return self._op("zeros_like")
+
+    def ones_like(self):
+        return self._op("ones_like")
+
+    def sort(self, axis=-1, is_ascend=True):
+        return self._op("sort", axis=axis, is_ascend=is_ascend)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return self._op("argsort", axis=axis, is_ascend=is_ascend)
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return self._op("topk", axis=axis, k=k, ret_typ=ret_typ,
+                        is_ascend=is_ascend)
+
+    def slice_like(self, shape_like, axes=()):
+        return self._op("slice_like", NDArray._pre(shape_like),
+                        axes=tuple(axes))
+
     def dot(self, other, transpose_a=False, transpose_b=False):
         return self._op("dot", NDArray._pre(other), transpose_a=transpose_a,
                         transpose_b=transpose_b)

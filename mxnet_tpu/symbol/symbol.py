@@ -225,6 +225,28 @@ class Symbol:
     def attr(self, key):
         return self._heads[0][0].attrs.get(key)
 
+    def list_attr(self):
+        """This node's string attrs (ref: Symbol.list_attr)."""
+        return {k: str(v) for k, v in self._heads[0][0].attrs.items()}
+
+    def attr_dict(self):
+        """{node_name: {attr: value}} over the whole graph
+        (ref: Symbol.attr_dict)."""
+        out = {}
+        for node in self._topo():
+            if node.attrs:
+                out[node.name] = {k: str(v) for k, v in node.attrs.items()}
+        return out
+
+    def debug_str(self):
+        """Readable graph dump (ref: Symbol.debug_str over nnvm)."""
+        lines = []
+        for node in self._topo():
+            op = node.op or "Variable"
+            ins = ", ".join(getattr(i[0], "name", "?") for i in node.inputs)
+            lines.append(f"{op} {node.name}({ins})")
+        return "\n".join(lines)
+
     # ---- graph traversal -------------------------------------------------
     def _topo(self) -> List[_Node]:
         """Post-order DFS from heads, inputs first (nnvm::DFSVisit order)."""

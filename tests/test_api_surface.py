@@ -40,6 +40,22 @@ def test_reference_spellings_resolve():
     assert not missing, f"reference spellings missing: {missing}"
 
 
+def test_ndarray_and_symbol_method_surface():
+    from mxnet_tpu import nd
+
+    x = nd.array(np.array([[3.0, 1.0, 2.0]], "float32"))
+    for m in ("sort", "argsort", "topk", "sign", "floor", "ceil",
+              "zeros_like", "ones_like", "slice_like"):
+        assert hasattr(x, m), m
+    np.testing.assert_array_equal(x.sort(axis=1).asnumpy(),
+                                  [[1.0, 2.0, 3.0]])
+    s = mx.sym.Variable("a")
+    fc = mx.sym.FullyConnected(s, num_hidden=4, name="fc")
+    assert fc.list_attr().get("num_hidden") == "4"
+    assert "fc" in fc.attr_dict()
+    assert "FullyConnected" in fc.debug_str()
+
+
 def test_module_level_samplers():
     mx.random.seed(7)
     u = mx.random.uniform(-1, 1, shape=(3, 4))
