@@ -809,12 +809,14 @@ class NDArray:
     def sort(self, axis=-1, is_ascend=True):
         return self._op("sort", axis=axis, is_ascend=is_ascend)
 
-    def argsort(self, axis=-1, is_ascend=True):
-        return self._op("argsort", axis=axis, is_ascend=is_ascend)
+    def argsort(self, axis=-1, is_ascend=True, dtype="float32"):
+        return self._op("argsort", axis=axis, is_ascend=is_ascend,
+                        dtype=dtype)
 
-    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+             dtype="float32"):
         return self._op("topk", axis=axis, k=k, ret_typ=ret_typ,
-                        is_ascend=is_ascend)
+                        is_ascend=is_ascend, dtype=dtype)
 
     def slice_like(self, shape_like, axes=()):
         return self._op("slice_like", NDArray._pre(shape_like),

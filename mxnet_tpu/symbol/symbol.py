@@ -189,6 +189,11 @@ def _is_sym(x) -> bool:
     return isinstance(x, Symbol)
 
 
+def _str_attrs(node):
+    """One attr-stringification rule for list_attr/attr_dict/tojson."""
+    return {k: str(v) for k, v in node.attrs.items()}
+
+
 class Symbol:
     """An entry (or group of entries) into the symbolic graph."""
 
@@ -227,16 +232,13 @@ class Symbol:
 
     def list_attr(self):
         """This node's string attrs (ref: Symbol.list_attr)."""
-        return {k: str(v) for k, v in self._heads[0][0].attrs.items()}
+        return _str_attrs(self._heads[0][0])
 
     def attr_dict(self):
         """{node_name: {attr: value}} over the whole graph
         (ref: Symbol.attr_dict)."""
-        out = {}
-        for node in self._topo():
-            if node.attrs:
-                out[node.name] = {k: str(v) for k, v in node.attrs.items()}
-        return out
+        return {node.name: _str_attrs(node) for node in self._topo()
+                if node.attrs}
 
     def debug_str(self):
         """Readable graph dump (ref: Symbol.debug_str over nnvm)."""
@@ -435,7 +437,7 @@ class Symbol:
         index = {id(n): i for i, n in enumerate(topo)}
         nodes = []
         for n in topo:
-            attrs = {k: str(v) for k, v in n.attrs.items()}
+            attrs = _str_attrs(n)
             if n.op is None and n.shape_hint:
                 attrs["__shape__"] = str(tuple(n.shape_hint))
             spec = {
