@@ -285,7 +285,7 @@ def test_dataloader_and_dataset():
 def test_model_zoo_smoke():
     for name in ("resnet18_v1", "resnet18_v2", "mobilenet0.25",
                  "squeezenet1.1", "vgg11", "alexnet", "densenet121",
-                 "inceptionv3"):
+                 "inceptionv3", "mobilenetv2_1.0", "vgg11_bn"):
         net = gluon.model_zoo.get_model(name, classes=4)
         net.initialize()
         # fixed global-pool geometries (same as the reference zoo):
