@@ -180,8 +180,13 @@ def bench_bert_base(args):
     mlm_weight = (rng.rand(bs, seq) < 0.15).astype(np.float32)
     nsp_labels = rng.randint(0, 2, (bs,)).astype(np.int32)
     with mx.autograd.pause():
+        # warm inputs pinned to the init ctx: on a TPU host the default
+        # context is tpu(0), and cpu-initialized params must not meet
+        # tpu-resident inputs in the eager warm pass
         seq_out, pooled = step_blk.bert(
-            mx.nd.array(tokens), mx.nd.array(segments), mx.nd.array(vlen))
+            mx.nd.array(tokens, ctx=mx.cpu()),
+            mx.nd.array(segments, ctx=mx.cpu()),
+            mx.nd.array(vlen, ctx=mx.cpu()))
         step_blk.bert.decode_mlm(seq_out)
         step_blk.bert.classify_nsp(pooled)
     if not args.cpu_smoke:
@@ -225,7 +230,8 @@ def bench_ssd_resnet50(args):
     rng = np.random.RandomState(0)
     x = rng.rand(bs, 3, size, size).astype(np.float32)
     with mx.autograd.pause():
-        n_anchors = int(step_blk.ssd(mx.nd.array(x[:1]))[0].shape[1])
+        n_anchors = int(step_blk.ssd(
+            mx.nd.array(x[:1], ctx=mx.cpu()))[0].shape[1])
     cls_t = rng.randint(-1, 21, (bs, n_anchors)).astype(np.float32)
     box_t = (rng.randn(bs, n_anchors, 4) * 0.1).astype(np.float32)
     if not args.cpu_smoke:
@@ -290,8 +296,10 @@ def bench_transformer_nmt(args):
     sv = np.full((bs,), slen, np.float32)
     tv = np.full((bs,), slen, np.float32)
     with mx.autograd.pause():
-        step_blk.net(mx.nd.array(src), mx.nd.array(tgt_in),
-                     mx.nd.array(sv), mx.nd.array(tv))
+        step_blk.net(mx.nd.array(src, ctx=mx.cpu()),
+                     mx.nd.array(tgt_in, ctx=mx.cpu()),
+                     mx.nd.array(sv, ctx=mx.cpu()),
+                     mx.nd.array(tv, ctx=mx.cpu()))
     if not args.cpu_smoke:
         step_blk.cast("bfloat16")
     trainer = _spmd_trainer(step_blk, "adam", {"learning_rate": 3e-4})

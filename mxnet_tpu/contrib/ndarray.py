@@ -7,10 +7,12 @@ from .control_flow import cond, foreach, while_loop  # noqa: F401
 
 
 def __getattr__(name):
-    # bare name first, then the '_contrib_' registry alias — the ONE
+    # '_contrib_' registry alias FIRST, bare name as fallback — the ONE
     # lookup rule for every contrib namespace spelling (nd.contrib.X,
-    # mx.contrib.ndarray.X)
-    for cand in (name, f"_contrib_{name}"):
+    # mx.contrib.ndarray.X).  Contrib-first so that if a plain op and a
+    # distinct contrib op ever share a name, the contrib namespace
+    # resolves to the contrib-registered one.
+    for cand in (f"_contrib_{name}", name):
         try:
             return _register.lookup(cand)
         except AttributeError:

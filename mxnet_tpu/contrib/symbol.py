@@ -9,12 +9,13 @@ _CACHE = {}
 
 
 def __getattr__(name):
-    # bare name first, then the '_contrib_' registry alias — the ONE
+    # '_contrib_' registry alias FIRST, bare name as fallback — the ONE
     # lookup rule for every contrib namespace spelling (sym.contrib.X,
-    # mx.contrib.symbol.X)
+    # mx.contrib.symbol.X); contrib-first so a name shared between a
+    # plain op and a distinct contrib op resolves to the contrib one
     if name in _CACHE:
         return _CACHE[name]
-    for cand in (name, f"_contrib_{name}"):
+    for cand in (f"_contrib_{name}", name):
         if cand in OP_REGISTRY:
             fn = make_symbol_function(cand)
             _CACHE[name] = fn

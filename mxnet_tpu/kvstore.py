@@ -303,10 +303,26 @@ _VALID = {"local", "device", "xla", "nccl", "dist", "dist_sync", "dist_async",
           "dist_device_sync"}
 
 
+_ASYNC_WARNED = [False]
+
+
 def create(name: str = "local") -> KVStore:
     """ref: kvstore.create / KVStore::Create factory."""
     if name not in _VALID:
         raise MXNetError(f"unknown kvstore type {name!r}; valid: {sorted(_VALID)}")
     if name == "nccl":
         name = "xla"  # compat alias: the ICI collective store
+    if name == "dist_async" and not _ASYNC_WARNED[0]:
+        # one-time, loud: the staleness semantics a dist_async user
+        # tuned for (hogwild-style non-blocking pushes) do not exist on
+        # this backend — updates are synchronous collectives (see
+        # docs/distributed.md, SURVEY.md §7 hard-part 6)
+        import warnings
+
+        warnings.warn(
+            "kvstore 'dist_async' is emulated as 'dist_sync' on the TPU "
+            "backend: pushes are synchronous XLA collectives, so there "
+            "is no gradient staleness. Convergence behavior tuned for "
+            "async PS training may differ.", UserWarning, stacklevel=2)
+        _ASYNC_WARNED[0] = True
     return KVStore(name)

@@ -14,6 +14,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import sys
 import threading
 import weakref
 from typing import List, Optional
@@ -29,9 +30,10 @@ _lock = threading.Lock()
 
 EngineFnType = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
 
-# image_pipeline.cc links OpenCV and builds into its own .so (see below) —
+# image_pipeline.cc links OpenCV and builds into its own .so (see below);
+# ndarray_capi.cc links libpython and builds into its own .so too —
 # the core library must stay dependency-free
-_CORE_EXCLUDE = {"image_pipeline.cc"}
+_CORE_EXCLUDE = {"image_pipeline.cc", "ndarray_capi.cc"}
 
 
 def _sources() -> List[str]:
@@ -103,12 +105,33 @@ class _NativeLib:
                              .decode("utf-8", "replace"))
 
 
+def _capi_sources() -> List[str]:
+    return [os.path.join(_SRC, "ndarray_capi.cc")]
+
+
+def _capi_flags() -> List[str]:
+    """Python embedding flags from sysconfig (no python3-config needed)."""
+    import sysconfig
+
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        f"{sys.version_info.major}.{sys.version_info.minor}"
+    flags = [f"-I{inc}"]
+    if libdir:
+        flags += [f"-L{libdir}", f"-Wl,-rpath,{libdir}"]
+    flags += [f"-lpython{ver}"]
+    return flags
+
+
 _CORE = _NativeLib("libmxnet_tpu_native.so", _sources, [],
                    "MXGetLastError", "native")
 _IMAGE = _NativeLib("libmxnet_tpu_image.so", _img_sources,
                     ["-I/usr/include/opencv4", "-lopencv_core",
                      "-lopencv_imgproc", "-lopencv_imgcodecs"],
                     "MXImageGetLastError", "image pipeline")
+_CAPI = _NativeLib("libmxnet_tpu_capi.so", _capi_sources, _capi_flags(),
+                   "MXCapiGetLastError", "ndarray c-api")
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -467,6 +490,28 @@ class NativePrefetchReader(_ReaderBase):
 # Image pipeline (src/image_pipeline.cc, separate .so: links OpenCV like the
 # reference's image pipeline; absence degrades to the Python decode path)
 # ---------------------------------------------------------------------------
+
+def capi_available() -> bool:
+    """The NDArray/op C ABI .so (src/ndarray_capi.cc) builds and loads.
+
+    RTLD_GLOBAL load path is in capi_get(): the library references
+    libpython symbols which, inside a Python process, resolve from the
+    interpreter already mapped into the process; standalone consumers
+    link -lpython explicitly."""
+    return _CAPI.load() is not None
+
+
+def capi_get() -> ctypes.CDLL:
+    lib = _CAPI.load()
+    if lib is None:
+        raise MXNetError("ndarray c-api library unavailable "
+                         "(no toolchain or build failed)")
+    return lib
+
+
+def capi_check(ret: int) -> None:
+    _CAPI.check(ret)
+
 
 def image_available() -> bool:
     return _IMAGE.load() is not None

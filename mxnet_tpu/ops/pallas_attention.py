@@ -117,7 +117,7 @@ def _attention_pallas(q, k, v, mask, scale, causal=False):
         sc = jax.lax.dot_general(
             qb, kb, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # (bq, Sk)
-        valid = m_ref[0] > 0                               # (Sk,)
+        valid = m_ref[0, 0] > 0                            # (Sk,)
         sc = jnp.where(valid[None, :], sc, -1e30)
         if causal:
             qi = pl.program_id(1)
@@ -136,12 +136,16 @@ def _attention_pallas(q, k, v, mask, scale, causal=False):
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, sk_len, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, sk_len, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk_len), lambda b, i: (b, 0)),
+            # mask rides as (BH, 1, Sk) so the block's LAST TWO dims
+            # equal the array's — Mosaic requires last-two either
+            # (8,128)-divisible or full-dimension (a 2-d (1, Sk) block
+            # over (BH, Sk) is rejected on current jax)
+            pl.BlockSpec((1, 1, sk_len), lambda b, i: (b, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
         interpret=get_env("MXNET_PALLAS_INTERPRET", False, bool),
-    )(q, k, v, mask)
+    )(q, k, v, mask[:, None, :])
     return out[:, :s]
 
 

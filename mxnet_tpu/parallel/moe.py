@@ -13,7 +13,8 @@ convention), and GSPMD inserts the all-to-all-equivalent collectives
 for the [T,D] -> [E,C,D] resharding.
 
     y, aux = moe_apply(expert_fn, stacked_params, x, gate_logits)
-    # aux: (gate_probs_mean, dropped_fraction) for load-balance losses
+    # aux: {"gate_probs": [T,E] router probabilities,
+    #       "dropped_frac": scalar} for load-balance losses
 """
 from __future__ import annotations
 

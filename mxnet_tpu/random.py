@@ -111,8 +111,10 @@ def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None):
                             ctx=ctx, out=out)
 
 
-def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
+def randint(low, high, shape=None, dtype=None, ctx=None, out=None):
     from . import ndarray as nd
 
+    # dtype passes through as None: nd.random.randint owns the
+    # defaulting (int32 only when out is also None, else from out)
     return nd.random.randint(low=low, high=high, shape=shape, dtype=dtype,
                              ctx=ctx, out=out)

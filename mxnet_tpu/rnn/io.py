@@ -34,8 +34,12 @@ def encode_sentences(sentences, vocab=None, invalid_label=-1,
                                          "frozen vocab and no unknown_token")
                     word = unknown_token
                     if word not in vocab:
-                        vocab[word] = idx
-                        idx += 1
+                        # a frozen vocab must already contain its
+                        # unknown_token; inserting it would silently
+                        # mutate a vocab the caller declared fixed
+                        raise MXNetError(
+                            f"unknown_token {unknown_token!r} is not in "
+                            "the provided (frozen) vocab")
                 else:
                     if idx == invalid_label:
                         idx += 1

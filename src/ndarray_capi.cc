@@ -1,0 +1,276 @@
+// Minimal NDArray/op C ABI (ref: include/mxnet/c_api.h — the
+// MXNDArrayCreate / MXNDArraySyncCopy{From,To}CPU / MXImperativeInvoke
+// family), sized for a cpp-package-style consumer: create / free /
+// copy-in / copy-out / shape / dtype / invoke-registered-op.
+//
+// TPU-native inversion of the reference's layering: there the C library
+// hosts the runtime and Python wraps it; here the runtime is the Python
+// process itself (JAX/PjRt owns device memory), so this layer
+// embeds-or-attaches to CPython and marshals into
+// mxnet_tpu.capi_bridge.  NDArray handles are opaque PyObject*
+// references owned by the caller (release with MXNDArrayFree).
+//
+// Thread contract: every entry point takes the GIL via PyGILState, so
+// any C thread may call in.  Errors: non-zero return; message via
+// MXCapiGetLastError() (thread-local, same convention as c_api.cc).
+//
+// Build: part of libmxnet_tpu_capi.so (lib.py), which links libpython.
+// A standalone consumer does:
+//   MXCapiInit();                       // starts CPython if needed
+//   void* a; MXNDArrayCreate(shape, 2, "float32", &a); ...
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+thread_local std::string g_err;
+PyObject* g_bridge = nullptr;  // mxnet_tpu.capi_bridge (owned ref)
+
+void set_err(const std::string& msg) { g_err = msg; }
+
+// capture the pending Python exception into the error ring
+void set_err_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  if (type != nullptr) {
+    PyObject* tn = PyObject_GetAttrString(type, "__name__");
+    if (tn != nullptr) {
+      const char* c = PyUnicode_AsUTF8(tn);
+      if (c != nullptr) msg = std::string(c) + ": " + msg;
+      Py_DECREF(tn);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_err(msg);
+}
+
+struct Gil {
+  PyGILState_STATE state;
+  Gil() : state(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state); }
+};
+
+// call bridge.<method>(args...); returns new ref or null (error set)
+PyObject* bridge_call(const char* method, PyObject* args) {
+  if (g_bridge == nullptr) {
+    set_err("MXCapiInit() has not been called");
+    return nullptr;
+  }
+  PyObject* fn = PyObject_GetAttrString(g_bridge, method);
+  if (fn == nullptr) {
+    set_err_from_python();
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  if (out == nullptr) set_err_from_python();
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXCapiGetLastError() { return g_err.c_str(); }
+
+// Start (or attach to) the interpreter and import the bridge.  Safe to
+// call more than once.  Returns 0 on success.
+int MXCapiInit() {
+  bool embedded = false;
+  if (!Py_IsInitialized()) {
+    // standalone C consumer: bring up an embedded interpreter
+    Py_InitializeEx(0);
+    embedded = true;
+  }
+  {
+    Gil gil;
+    if (g_bridge == nullptr) {
+      PyObject* mod = PyImport_ImportModule("mxnet_tpu.capi_bridge");
+      if (mod == nullptr) {
+        set_err_from_python();
+        return -1;
+      }
+      g_bridge = mod;
+    }
+  }
+  if (embedded) {
+    // Py_InitializeEx leaves the calling thread owning the GIL; release
+    // it so the thread contract ("any C thread may call in" via
+    // PyGILState_Ensure) holds — otherwise every OTHER thread deadlocks
+    PyEval_SaveThread();
+  }
+  return 0;
+}
+
+int MXNDArrayCreate(const int64_t* shape, int ndim, const char* dtype,
+                    void** out) {
+  Gil gil;
+  PyObject* pshape = PyTuple_New(ndim);
+  if (pshape == nullptr) { set_err_from_python(); return -1; }
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(pshape, i, PyLong_FromLongLong(shape[i]));
+  PyObject* args = Py_BuildValue("(Os)", pshape, dtype);
+  Py_DECREF(pshape);
+  if (args == nullptr) { set_err_from_python(); return -1; }
+  PyObject* nd = bridge_call("create", args);
+  Py_DECREF(args);
+  if (nd == nullptr) return -1;
+  *out = nd;  // ownership to the caller
+  return 0;
+}
+
+int MXNDArrayFree(void* handle) {
+  Gil gil;
+  Py_XDECREF(reinterpret_cast<PyObject*>(handle));
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(void* handle, const void* data,
+                             uint64_t nbytes) {
+  Gil gil;
+  PyObject* buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(nbytes));
+  if (buf == nullptr) { set_err_from_python(); return -1; }
+  PyObject* args = Py_BuildValue("(OO)",
+                                 reinterpret_cast<PyObject*>(handle), buf);
+  Py_DECREF(buf);
+  if (args == nullptr) { set_err_from_python(); return -1; }
+  PyObject* r = bridge_call("copy_from", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(void* handle, void* data, uint64_t nbytes) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  if (args == nullptr) { set_err_from_python(); return -1; }
+  PyObject* bytes = bridge_call("copy_to", args);
+  Py_DECREF(args);
+  if (bytes == nullptr) return -1;
+  char* src = nullptr;
+  Py_ssize_t got = 0;
+  if (PyBytes_AsStringAndSize(bytes, &src, &got) != 0) {
+    Py_DECREF(bytes);
+    set_err_from_python();
+    return -1;
+  }
+  if (static_cast<uint64_t>(got) != nbytes) {
+    Py_DECREF(bytes);
+    set_err("MXNDArraySyncCopyToCPU: buffer is " +
+            std::to_string(nbytes) + " bytes, array has " +
+            std::to_string(got));
+    return -1;
+  }
+  std::memcpy(data, src, got);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+// shape into caller buffer (up to max_ndim entries); *out_ndim gets the
+// true rank even when it exceeds max_ndim (call again with more room)
+int MXNDArrayGetShape(void* handle, int* out_ndim, int64_t* out_shape,
+                      int max_ndim) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  if (args == nullptr) { set_err_from_python(); return -1; }
+  PyObject* shp = bridge_call("shape_of", args);
+  Py_DECREF(args);
+  if (shp == nullptr) return -1;
+  Py_ssize_t n = PyTuple_Size(shp);
+  *out_ndim = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n && i < max_ndim; ++i)
+    out_shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(shp, i));
+  Py_DECREF(shp);
+  return 0;
+}
+
+int MXNDArrayGetDType(void* handle, char* buf, int buflen) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  if (args == nullptr) { set_err_from_python(); return -1; }
+  PyObject* dt = bridge_call("dtype_of", args);
+  Py_DECREF(args);
+  if (dt == nullptr) return -1;
+  const char* s = PyUnicode_AsUTF8(dt);
+  if (s == nullptr) {
+    Py_DECREF(dt);
+    set_err_from_python();
+    return -1;
+  }
+  std::strncpy(buf, s, buflen - 1);
+  buf[buflen - 1] = '\0';
+  Py_DECREF(dt);
+  return 0;
+}
+
+// Imperative op invoke: attrs as parallel key/value string arrays (the
+// reference's MXImperativeInvoke param convention).  Fills up to
+// max_outputs handles; *num_outputs gets the true count.
+int MXImperativeInvoke(const char* op_name, void** inputs, int num_inputs,
+                       const char** keys, const char** vals, int num_params,
+                       void** outputs, int* num_outputs, int max_outputs) {
+  Gil gil;
+  PyObject* pin = PyList_New(num_inputs);
+  if (pin == nullptr) { set_err_from_python(); return -1; }
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject* h = reinterpret_cast<PyObject*>(inputs[i]);
+    Py_INCREF(h);
+    PyList_SET_ITEM(pin, i, h);
+  }
+  PyObject* pattrs = PyDict_New();
+  if (pattrs == nullptr) {
+    Py_DECREF(pin);
+    set_err_from_python();
+    return -1;
+  }
+  for (int i = 0; i < num_params; ++i) {
+    PyObject* v = PyUnicode_FromString(vals[i]);
+    if (v == nullptr || PyDict_SetItemString(pattrs, keys[i], v) != 0) {
+      Py_XDECREF(v);
+      Py_DECREF(pin);
+      Py_DECREF(pattrs);
+      set_err_from_python();
+      return -1;
+    }
+    Py_DECREF(v);
+  }
+  PyObject* args = Py_BuildValue("(sOO)", op_name, pin, pattrs);
+  Py_DECREF(pin);
+  Py_DECREF(pattrs);
+  if (args == nullptr) { set_err_from_python(); return -1; }
+  PyObject* outs = bridge_call("invoke", args);
+  Py_DECREF(args);
+  if (outs == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(outs);
+  *num_outputs = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n && i < max_outputs; ++i) {
+    PyObject* h = PyList_GET_ITEM(outs, i);
+    Py_INCREF(h);
+    outputs[i] = h;
+  }
+  Py_DECREF(outs);
+  return 0;
+}
+
+}  // extern "C"

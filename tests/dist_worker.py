@@ -8,12 +8,23 @@ import os
 import sys
 
 # force the CPU backend before any jax backend touch (the axon TPU plugin
-# is process-global in this container; N workers cannot share one chip)
-os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                           + " --xla_force_host_platform_device_count=2")
+# is process-global in this container; N workers cannot share one chip).
+# The hybrid lane gives each process FOUR virtual devices (a 2-host pod
+# slice in miniature); other modes keep 2.  Script-mode only: pytest
+# IMPORTS this module (for hybrid_loss_and_data), and mutating the
+# parent's XLA_FLAGS there would shrink its conftest-pinned 8-device
+# backend.
+_IS_SCRIPT = __name__ == "__main__"
+_N_LOCAL = 4 if (_IS_SCRIPT and len(sys.argv) > 1
+                 and sys.argv[1] == "hybrid") else 2
+if _IS_SCRIPT:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={_N_LOCAL}")
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if _IS_SCRIPT:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 
@@ -138,6 +149,89 @@ def mode_peerloss():
     raise AssertionError("barrier with a dead peer did not abort")
 
 
+def hybrid_loss_and_data():
+    """Shared fixture for the hybrid DCN+ICI lane: a deterministic tiny
+    MLP (pure-jax params) + global batch, used by both the workers and
+    the single-process oracle in test_dist.py."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(7)
+    params = {
+        "w1": jnp.asarray(rng.randn(4, 8).astype(np.float32) * 0.5),
+        "b1": jnp.asarray(np.zeros(8, np.float32)),
+        "w2": jnp.asarray(rng.randn(8, 3).astype(np.float32) * 0.5),
+        "b2": jnp.asarray(np.zeros(3, np.float32)),
+    }
+    X = rng.randn(16, 4).astype(np.float32)
+    y = rng.randint(0, 3, (16,)).astype(np.int32)
+
+    def loss(p, xb, yb):
+        h = jnp.tanh(xb @ p["w1"] + p["b1"])
+        logits = h @ p["w2"] + p["b2"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(logp[jnp.arange(xb.shape[0]), yb])
+
+    return params, X, y, loss
+
+
+def mode_hybrid():
+    """The pod topology in miniature (2 hosts x 4 chips): inside each
+    process the gradient's batch reduction is an IN-GRAPH psum over a
+    4-device dp mesh (the ICI stand-in, inserted by GSPMD); across the
+    2 processes the per-process gradients ride the dist_sync KVStore
+    (gloo = the DCN stand-in).  Rank 0 prints the final gradient so the
+    parent test can assert equality with its single-process 8-device
+    oracle."""
+    import json
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mxnet_tpu import parallel
+
+    dist.init()
+    rank, nw = dist.rank(), dist.num_workers()
+    params, X, y, loss = hybrid_loss_and_data()
+    shard = X.shape[0] // nw
+    Xs, ys = X[rank * shard:(rank + 1) * shard], \
+        y[rank * shard:(rank + 1) * shard]
+
+    # the ICI mesh must be built over THIS process's addressable chips
+    # (jax.devices() is global after jax.distributed init — rank>0 would
+    # otherwise get rank 0's devices and produce non-addressable grads)
+    with parallel.make_mesh(dp=_N_LOCAL,
+                            devices=jax.local_devices()) as mesh:
+        xd = jax.device_put(jnp.asarray(Xs),
+                            NamedSharding(mesh.mesh, P("dp")))
+        yd = jax.device_put(jnp.asarray(ys),
+                            NamedSharding(mesh.mesh, P("dp")))
+        grads = jax.jit(jax.grad(loss))(params, xd, yd)
+
+    # DCN hop: push per-process grads through dist_sync (sum across
+    # workers), then renormalize the two half-batch means to the global
+    # mean: sum_r mean_r / nw == mean over the global batch
+    kv = mx.kv.create("dist_sync")
+    out = {}
+    for i, name in enumerate(sorted(grads)):
+        g = mx.nd.array(np.asarray(grads[name]))
+        kv.init(i, mx.nd.zeros(g.shape))
+        kv.push(i, g)
+        pulled = mx.nd.zeros(g.shape)
+        kv.pull(i, out=pulled)
+        out[name] = (pulled.asnumpy() / nw).tolist()
+
+    # every worker must end with the identical global gradient
+    flat = np.concatenate([np.asarray(v, np.float32).ravel()
+                           for _, v in sorted(out.items())])
+    gathered = dist.allgather_np(flat)
+    for r in range(1, gathered.shape[0]):
+        np.testing.assert_allclose(gathered[r], gathered[0],
+                                   rtol=0, atol=0)
+    if rank == 0:
+        print("HYBRID_GRADS " + json.dumps(out), flush=True)
+    print(f"DIST_OK rank={rank}/{nw}", flush=True)
+
+
 if __name__ == "__main__":
     {"kvstore": mode_kvstore, "train": mode_train,
-     "peerloss": mode_peerloss}[sys.argv[1]]()
+     "peerloss": mode_peerloss, "hybrid": mode_hybrid}[sys.argv[1]]()

@@ -70,6 +70,51 @@ def test_dist_sync_training_two_process():
         assert "DIST_OK" in out, out[-2000:]
 
 
+def test_hybrid_dcn_ici_grads_match_single_process():
+    """The real pod topology in miniature (round-4 verdict item #6):
+    2 processes (DCN stand-in: gloo dist_sync KVStore) x 4 virtual
+    devices each (ICI stand-in: in-graph GSPMD psum over a dp=4 mesh).
+    The combined gradient must equal the single-process 8-device run —
+    this pytest process IS that oracle (conftest pins cpu x8)."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mxnet_tpu import parallel
+    from tests.dist_worker import hybrid_loss_and_data
+
+    outs = _spawn_workers("hybrid", 2)
+    grads_line = None
+    for rc, out in outs:
+        assert rc == 0, out[-2000:]
+        assert "DIST_OK" in out, out[-2000:]
+        for ln in out.splitlines():
+            if ln.startswith("HYBRID_GRADS "):
+                grads_line = ln[len("HYBRID_GRADS "):]
+    assert grads_line, outs
+    worker_grads = {k: np.asarray(v, np.float32)
+                    for k, v in json.loads(grads_line).items()}
+
+    # single-process oracle: same loss/params/data, all 8 devices in one
+    # dp mesh, one in-graph psum — no DCN hop
+    params, X, y, loss = hybrid_loss_and_data()
+    with parallel.make_mesh(dp=8) as mesh:
+        xd = jax.device_put(jnp.asarray(X), NamedSharding(mesh.mesh,
+                                                          P("dp")))
+        yd = jax.device_put(jnp.asarray(y), NamedSharding(mesh.mesh,
+                                                          P("dp")))
+        oracle = jax.jit(jax.grad(loss))(params, xd, yd)
+
+    assert sorted(worker_grads) == sorted(oracle)
+    for name in oracle:
+        np.testing.assert_allclose(
+            worker_grads[name], np.asarray(oracle[name]),
+            rtol=1e-5, atol=1e-6, err_msg=f"grad {name}")
+
+
 def test_peer_loss_aborts_not_hangs():
     """Failure detection (SURVEY.md §5): worker 1 dies before the barrier;
     worker 0 must raise MXNetError within its watchdog timeout instead of

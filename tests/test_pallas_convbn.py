@@ -84,18 +84,29 @@ def test_pallas_interpret_matches_fallback(case, monkeypatch):
     np.testing.assert_allclose(s2, s2r, rtol=1e-4, atol=1e-3)
 
 
+def _per_image_bytes(h, w, ci, ho, wo, co, itemsize=2):
+    # mirror of the tap-accumulation working set in pcb._batch_tile
+    return ((h + 2) * (w + 2) * ci * itemsize + ho * wo * co * 4
+            + ho * wo * ci * itemsize + 2 * h * w * ci * itemsize
+            + 2 * ho * wo * co * itemsize)
+
+
 def test_batch_tile_divides_and_respects_budget():
-    # 56x56x(9*64) one image ~3.6MB cols: admitted at the nb=1 floor
-    assert pcb._batch_tile(256, 56, 56, 64, 56, 56, 64, 9 * 64) == 1
-    nb = pcb._batch_tile(256, 7, 7, 512, 7, 7, 512, 9 * 512)
+    # 56x56-stage image: a few MB — must be admitted (nb >= 1) and any
+    # nb > 1 must stay inside the budget
+    nb = pcb._batch_tile(256, 56, 56, 64, 56, 56, 64)
+    assert 256 % nb == 0 and nb >= 1
+    assert nb == 1 or nb * _per_image_bytes(56, 56, 64, 56, 56, 64) \
+        <= pcb._COLS_BUDGET_BYTES
+    nb = pcb._batch_tile(256, 7, 7, 512, 7, 7, 512)
     assert 256 % nb == 0 and nb >= 2
-    # 1x1 expansion conv: the y block (co=2048) dominates the working
-    # set — the budget must count it, not just the im2col block
-    nb = pcb._batch_tile(256, 7, 7, 512, 7, 7, 2048, 512)
-    per_image = (7 * 7 * 512 + 2 * 7 * 7 * 512 + 2 * 7 * 7 * 2048) * 2
-    assert nb == 1 or nb * per_image <= pcb._COLS_BUDGET_BYTES
+    # 1x1 expansion conv: the fp32 accumulator + y blocks (co=2048)
+    # dominate the working set — the budget must count them
+    nb = pcb._batch_tile(256, 7, 7, 512, 7, 7, 2048)
+    assert nb == 1 or nb * _per_image_bytes(7, 7, 512, 7, 7, 2048) \
+        <= pcb._COLS_BUDGET_BYTES
     # nb must divide n even for odd n
-    assert pcb._batch_tile(3, 8, 8, 16, 8, 8, 16, 16) in (1, 3)
+    assert pcb._batch_tile(3, 8, 8, 16, 8, 8, 16) in (1, 3)
 
 
 @pytest.mark.parametrize("act_in", [True, False])
@@ -148,10 +159,12 @@ def test_defaults_are_identity():
     np.testing.assert_allclose(s2, s2r, rtol=1e-4, atol=1e-3)
 
 
-def test_multi_device_mesh_gate_selects_fallback(monkeypatch):
-    """Under a multi-device mesh the fused unit must take the XLA
-    fallback (GSPMD cannot partition a pallas_call); on a single-device
-    or no-mesh trace the Pallas path stays selected.  Also pins that
+def test_multi_device_mesh_selects_sharded_pallas(monkeypatch):
+    """Under a multi-device mesh the fused unit now takes the
+    shard_map-wrapped per-shard Pallas kernel (round-4 verdict item #2:
+    the flagship optimization must survive dp>1); a single-device or
+    no-mesh trace keeps the direct Pallas path; a batch that doesn't
+    divide the dp shards falls back to XLA.  Also pins that
     SPMDTrainer's traced step runs under ITS mesh scope even when
     step() is called outside `with mesh:`."""
     import mxnet_tpu as mx
@@ -160,29 +173,42 @@ def test_multi_device_mesh_gate_selects_fallback(monkeypatch):
     monkeypatch.setenv("MXNET_PALLAS_INTERPRET", "1")
     monkeypatch.setitem(pcb._STATE, "enabled", None)
 
-    calls = {"pallas": 0}
+    calls = {"pallas": 0, "sharded": 0}
     real = pcb._pallas_unit
+    real_sh = pcb._pallas_unit_sharded
 
     def spy(*a, **k):
         calls["pallas"] += 1
         return real(*a, **k)
 
+    def spy_sh(*a, **k):
+        calls["sharded"] += 1
+        return real_sh(*a, **k)
+
     monkeypatch.setattr(pcb, "_pallas_unit", spy)
+    monkeypatch.setattr(pcb, "_pallas_unit_sharded", spy_sh)
     x = jnp.asarray(_rand((2, 4, 4, 8)))
     w = jnp.asarray(_rand((8, 8, 1, 1), scale=0.2))
 
     pcb.fused_conv_unit(x, w)   # warm-up (probe + first call both spy)
     base = calls["pallas"]
-    pcb.fused_conv_unit(x, w)                      # no mesh: Pallas
-    assert calls["pallas"] == base + 1
+    pcb.fused_conv_unit(x, w)                      # no mesh: direct Pallas
+    assert calls["pallas"] == base + 1 and calls["sharded"] == 0
     with parallel.make_mesh(dp=2):
-        pcb.fused_conv_unit(x, w)                  # dp=2: fallback
-    assert calls["pallas"] == base + 1
+        pcb.fused_conv_unit(x, w)                  # dp=2: sharded Pallas
+    assert calls["sharded"] == 1
     with parallel.make_mesh(dp=1):
-        pcb.fused_conv_unit(x, w)                  # size-1 mesh: Pallas
-    assert calls["pallas"] == base + 2
+        pcb.fused_conv_unit(x, w)                  # size-1 mesh: direct
+    assert calls["pallas"] >= base + 2 and calls["sharded"] == 1
+    with parallel.make_mesh(dp=8):
+        sh_before = calls["sharded"]
+        # batch 2 does not divide 8 dp shards -> XLA fallback, no crash
+        y, _, _ = pcb.fused_conv_unit(x, w)
+        assert y.shape == (2, 4, 4, 8)
+    assert calls["sharded"] == sh_before
 
-    # trainer path: mesh scope is pushed by the trace itself
+    # trainer path: mesh scope is pushed by the trace itself, so the
+    # sharded kernel engages even when step() runs outside `with mesh:`
     mesh = parallel.make_mesh(dp=2)
     assert parallel.current_mesh() is None
     from mxnet_tpu.gluon.block import HybridBlock
@@ -201,6 +227,53 @@ def test_multi_device_mesh_gate_selects_fallback(monkeypatch):
 
     tr = parallel.SPMDTrainer(blk, _Id(), "sgd", {"learning_rate": 0.1},
                               mesh=mesh, n_labels=0)
-    before = calls["pallas"]
+    before = calls["sharded"]
     tr.step(tr._place(np.asarray(x), None))        # OUTSIDE with mesh:
-    assert calls["pallas"] == before               # gate still engaged
+    assert calls["sharded"] > before
+
+
+@pytest.mark.parametrize("axes", [{"dp": 8}, {"dp": 2, "tp": 2, "sp": 2},
+                                  {"fsdp": 4, "tp": 2}])
+def test_sharded_pallas_matches_fallback_full(axes, monkeypatch):
+    """Round-4 verdict item #2 'Done' criterion: fused == unfused to
+    tolerance — outputs, ALL gradients, and the BN-stat aux — under the
+    8-device CPU mesh in interpret mode, across dp-only, mixed, and
+    fsdp batch-sharding layouts."""
+    from mxnet_tpu import parallel
+
+    shape, co, kernel, stride, pad = (8, 8, 8, 16), 32, (3, 3), (1, 1), (1, 1)
+    x = jnp.asarray(_rand(shape))
+    w = jnp.asarray(_rand((co, shape[-1]) + kernel, scale=0.2))
+    sc = jnp.asarray(_rand((shape[-1],)) ** 2 + 0.5)
+    bi = jnp.asarray(_rand((shape[-1],)))
+    sh = jnp.asarray(_rand((co,)))
+
+    def loss(x, w, sc, bi, sh):
+        y, s1, s2 = pcb.fused_conv_unit(
+            x, w, sc, bi, sh, kernel=kernel, stride=stride, pad=pad,
+            act_in=True)
+        return ((y.astype(jnp.float32) ** 2).sum()
+                + (s1 * s1).sum() * 1e-3 + s2.sum() * 1e-3)
+
+    def all_outputs():
+        y, s1, s2 = pcb.fused_conv_unit(
+            x, w, sc, bi, sh, kernel=kernel, stride=stride, pad=pad,
+            act_in=True)
+        g = jax.grad(loss, argnums=(0, 1, 2, 3))(x, w, sc, bi, sh)
+        return y, s1, s2, g
+
+    monkeypatch.setenv("MXNET_USE_PALLAS", "0")
+    monkeypatch.setitem(pcb._STATE, "enabled", None)
+    yr, s1r, s2r, gr = all_outputs()
+
+    monkeypatch.setenv("MXNET_USE_PALLAS", "1")
+    monkeypatch.setenv("MXNET_PALLAS_INTERPRET", "1")
+    monkeypatch.setitem(pcb._STATE, "enabled", None)
+    with parallel.make_mesh(**axes):
+        yf, s1f, s2f, gf = all_outputs()
+
+    np.testing.assert_allclose(yf, yr, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(s1f, s1r, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(s2f, s2r, rtol=1e-4, atol=1e-3)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)

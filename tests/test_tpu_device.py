@@ -201,7 +201,10 @@ def test_fused_resnet_block_matches_on_chip():
     xnp = rng.randn(2, 8, 8, 16).astype("float32")
     block = BottleneckV1(16, 1, downsample=False, in_channels=16,
                          layout="NHWC")
-    block.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    # params on the CHIP: eager inputs default to tpu(0) on this host,
+    # and cpu-resident params would raise a ctx mismatch (and the test
+    # exists to run the Pallas path on the device anyway)
+    block.initialize(mx.initializer.Xavier(), ctx=mx.tpu(0))
     block(mx.nd.array(xnp))
     snap = {n_: p.data().asnumpy().copy()
             for n_, p in block.collect_params().items()}

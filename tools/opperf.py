@@ -111,8 +111,61 @@ def _specs(np, large):
             [r.rand(1, 512, 1), r.rand(1, 512, 1),
              np.sort(r.rand(1, 512, 4), -1)], -1).astype(np.float32),),
             {"overlap_thresh": 0.5, "topk": 100}),
+        # ---- hot-family widening (round-4 verdict item #4) ----
+        # Convolution variants: the ResNet bottleneck trio (1x1 project,
+        # stride-2 downsample) + depthwise grouping
+        "Convolution@1x1": ((f(B, C * 2, H // 2, H // 2),
+                             f(C * 2, C * 2, 1, 1)),
+                            {"kernel": (1, 1), "num_filter": C * 2,
+                             "no_bias": True}),
+        "Convolution@s2": ((f(B, C, H, H), f(C * 2, C, 3, 3)),
+                           {"kernel": (3, 3), "stride": (2, 2),
+                            "pad": (1, 1), "num_filter": C * 2,
+                            "no_bias": True}),
+        "Convolution@dw": ((f(B, C, H, H), f(C, 1, 3, 3)),
+                           {"kernel": (3, 3), "pad": (1, 1),
+                            "num_filter": C, "num_group": C,
+                            "no_bias": True}),
+        # fused RNN op (scan-based lstm/gru) on a BERT-ish shape
+        "RNN@lstm": ((f(S, B // 2, U // 2),
+                      f(_rnn_psize("lstm", U // 2, U // 2, 1, False))),
+                     {"state_size": U // 2, "num_layers": 1,
+                      "mode": "lstm"}),
+        "RNN@gru": ((f(S, B // 2, U // 2),
+                     f(_rnn_psize("gru", U // 2, U // 2, 1, False))),
+                    {"state_size": U // 2, "num_layers": 1,
+                     "mode": "gru"}),
+        # fused attention (the Pallas kernel on TPU, XLA fallback on CPU)
+        "dot_product_attention": ((f(B // 4, S, U), f(B // 4, S, U),
+                                   f(B // 4, S, U),
+                                   np.ones((B // 4, S), np.float32)),
+                                  {"num_heads": U // 64}),
+        "dot_product_attention@causal": (
+            (f(B // 4, S, U), f(B // 4, S, U), f(B // 4, S, U),
+             np.ones((B // 4, S), np.float32)),
+            {"num_heads": U // 64, "causal": True}),
+        # fused Conv+BN+ReLU Pallas unit (XLA fallback on CPU) — NHWC
+        "FusedConvUnit": ((f(B, H, H, C), f(C, C, 3, 3), f(C) + 0.5,
+                           f(C), f(C)),
+                          {"kernel": (3, 3), "pad": (1, 1),
+                           "act_in": True, "want_stats": True}),
+        # remaining optimizer hot path
+        "lamb_update_phase1": ((f(N[1], N[0]), f(N[1], N[0]),
+                                f(N[1], N[0]), f(N[1], N[0])),
+                               {"beta1": 0.9, "beta2": 0.999,
+                                "epsilon": 1e-6, "wd": 0.01, "t": 1}),
+        "multi_sgd_update": ((f(N[0], N[0]), f(N[0], N[0])),
+                             {"lrs": (0.1,), "wds": (1e-4,),
+                              "num_weights": 1}),
     }
     return sp
+
+
+def _rnn_psize(mode, input_size, hidden, num_layers, bidirectional):
+    import importlib
+    rnn_ops = importlib.import_module("mxnet_tpu.ops.rnn")
+    return rnn_ops.rnn_param_size(mode, input_size, hidden, num_layers,
+                                  bidirectional)
 
 
 def _time_call(fn, sync, repeat, number):
@@ -130,6 +183,35 @@ def _time_call(fn, sync, repeat, number):
     return best[len(best) // 2] * 1e6
 
 
+def compare(current, against_path, fail_over):
+    """Regression gate: every row in `against` that also ran now, same
+    backend and shape, must not have slowed by more than `fail_over`
+    (fraction) in its jit columns.  A noise floor (20µs absolute AND
+    the relative threshold) keeps CPU timer jitter from failing runs.
+    Returns (regressions, compared_count)."""
+    with open(against_path) as f:
+        base = json.load(f)
+    if base.get("backend") != current["backend"]:
+        return [{"note": f"backend mismatch ({base.get('backend')} vs "
+                 f"{current['backend']}) — comparison skipped"}], 0
+    base_rows = {(r["op"], r.get("shape")): r for r in base["rows"]}
+    regressions, compared = [], 0
+    for row in current["rows"]:
+        b = base_rows.get((row["op"], row.get("shape")))
+        if b is None:
+            continue
+        for col in ("jit_fwd_us", "jit_bwd_us"):
+            was, now = b.get(col), row.get(col)
+            if not was or not now:
+                continue
+            compared += 1
+            if now - was > 20.0 and now > was * (1.0 + fail_over):
+                regressions.append(
+                    {"op": row["op"], "col": col, "was_us": was,
+                     "now_us": now, "ratio": round(now / was, 2)})
+    return regressions, compared
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--ops", default=None,
@@ -139,6 +221,12 @@ def main():
     ap.add_argument("--large", action="store_true",
                     help="accelerator-scale shapes (auto on non-CPU)")
     ap.add_argument("--out", default=None)
+    ap.add_argument("--against", default=None,
+                    help="baseline OPPERF json: exit 1 if any op's jit "
+                         "column regressed past --fail-over")
+    ap.add_argument("--fail-over", type=float, default=0.15,
+                    help="allowed slowdown fraction vs --against "
+                         "(default 0.15 = 15%%)")
     args = ap.parse_args()
 
     import numpy as np
@@ -158,7 +246,10 @@ def main():
             print(f"# no spec for {name}", file=sys.stderr)
             continue
         arrs, attrs = specs[name]
-        op = get_op(name)
+        # spec keys may carry an '@variant' suffix (e.g. Convolution@1x1)
+        # naming a shape/attr configuration of the same registry op
+        op_name = name.split("@")[0]
+        op = get_op(op_name)
         jarrs = [jnp.asarray(a) for a in arrs]
         nds = [mx.nd.array(a) for a in arrs]
 
@@ -177,7 +268,7 @@ def main():
                        if not k.startswith("_")}
 
         def eager():
-            o = getattr(mx.nd, name)(*nds, **eager_attrs)
+            o = getattr(mx.nd, op_name)(*nds, **eager_attrs)
             ndout[0] = o[0] if isinstance(o, (list, tuple)) else o
             return ndout[0]
 
@@ -210,12 +301,21 @@ def main():
         rows.append(row)
         print(json.dumps(row))
 
+    artifact = {"when": time.strftime("%Y-%m-%d %H:%M:%S"),
+                "backend": backend, "large_shapes": large,
+                "repeat": args.repeat, "number": args.number,
+                "rows": rows}
     if args.out:
         with open(args.out, "w") as fh:
-            json.dump({"when": time.strftime("%Y-%m-%d %H:%M:%S"),
-                       "backend": backend, "large_shapes": large,
-                       "repeat": args.repeat, "number": args.number,
-                       "rows": rows}, fh, indent=1)
+            json.dump(artifact, fh, indent=1)
+    if args.against:
+        regressions, compared = compare(artifact, args.against,
+                                        args.fail_over)
+        print(json.dumps({"against": args.against, "compared": compared,
+                          "fail_over": args.fail_over,
+                          "regressions": regressions}))
+        if any("op" in r for r in regressions):
+            return 1
     return 0
 
 

@@ -1,14 +1,22 @@
 """Run the whole on-chip measurement queue in one command.
 
 The TPU tunnel in this container dies for hours at a time (see
-CHANGES_r04.md), so when a window opens, everything must land in one
-shot — run this the moment a probe succeeds:
+CHANGES_r04.md / TUNNEL_LOG_r04.md), so when a window opens, everything
+must land in one shot — run this the moment a probe succeeds:
 
-    python tools/run_tpu_queue.py [--round 4]
+    python tools/run_tpu_queue.py [--round 5]
+
+Or, the self-firing mode (round-4 verdict item #1) — start it at round
+open and leave it running; it probes on the TUNNEL_LOG_r04 protocol
+(bounded fresh-process `jax.devices()`, one prober at a time, ~8.5 min
+spacing) and fires the full queue automatically on the FIRST successful
+probe, then exits:
+
+    python tools/run_tpu_queue.py --watch [--round 5]
 
 Sequential bounded steps (the tunnel is single-client — nothing may run
 concurrently with this):
-  1. tools/run_tpu_tests.py      -> TPU_TESTS_r0N.json (29-case lane)
+  1. tools/run_tpu_tests.py      -> TPU_TESTS_r0N.json (TPU-lane cases)
   2. bench.py                    -> BENCH snapshot (unfused + fused in one run)
   3. bench_all.py                -> BENCH_ALL.json (5 configs + variants)
   4. tools/opperf.py --large     -> OPPERF_TPU.json
@@ -27,13 +35,79 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+_PROBE_SRC = (
+    "import jax, time, json; t0 = time.time(); d = jax.devices(); "
+    "print(json.dumps({'ok': True, 'devices': [str(x) for x in d], "
+    "'init_s': round(time.time() - t0, 1)}))"
+)
+
+
+def probe(timeout=240):
+    """One bounded fresh-process tunnel probe (TUNNEL_LOG_r04 protocol).
+
+    Returns (ok: bool, detail: str). A fresh process is mandatory: a hung
+    backend init poisons the whole interpreter, and the axon plugin is
+    force-registered by sitecustomize, so in-process retry is impossible.
+    """
+    t0 = time.time()
+    try:
+        p = subprocess.run([sys.executable, "-c", _PROBE_SRC], cwd=_REPO,
+                           capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False, "backend init exceeded %ds (hung tunnel)" % timeout
+    if p.returncode != 0:
+        tail = "\n".join((p.stdout + p.stderr).splitlines()[-3:])
+        return False, "probe rc=%d: %s" % (p.returncode, tail)
+    for line in p.stdout.splitlines():
+        if line.startswith("{"):
+            return True, line.strip()
+    return False, "no probe output (%.0fs)" % (time.time() - t0)
+
+
+def watch(args):
+    """Probe until the tunnel answers, then fire the queue once and exit.
+
+    Round-4 verdict item #1: two full rounds were lost because the queue
+    required a human to notice the tunnel was up. This loop is that human.
+    Spacing ~8.5 min between failed probes, single prober at a time.
+    """
+    log_path = os.path.join(_REPO, "TUNNEL_LOG_r%02d.md" % args.round)
+    attempt = 0
+    while True:
+        attempt += 1
+        ok, detail = probe()
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+        with open(log_path, "a") as f:
+            f.write("- %s watch probe %d: %s %s\n"
+                    % (stamp, attempt, "OK" if ok else "FAIL", detail))
+        print("[watch] probe %d: %s %s" % (attempt, ok, detail), flush=True)
+        if ok:
+            return run_queue(args)
+        if args.max_probes and attempt >= args.max_probes:
+            print("[watch] giving up after %d probes" % attempt)
+            return 1
+        time.sleep(args.spacing)
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--round", type=int, default=4)
+    ap.add_argument("--round", type=int, default=5)
     ap.add_argument("--out",
                     default=os.path.join(_REPO, "TPU_QUEUE_RESULTS.json"))
+    ap.add_argument("--watch", action="store_true",
+                    help="probe until the tunnel answers, then fire the "
+                         "queue once and exit")
+    ap.add_argument("--spacing", type=float, default=510.0,
+                    help="seconds between failed watch probes (~8.5 min)")
+    ap.add_argument("--max-probes", type=int, default=0,
+                    help="watch gives up after this many probes (0 = never)")
     args = ap.parse_args()
+    if args.watch:
+        return watch(args)
+    return run_queue(args)
 
+
+def run_queue(args):
     n = args.round
     steps = [
         ("tpu_tests",
@@ -61,11 +135,11 @@ def main():
             rec = {"step": name, "rc": -1, "timeout_s": timeout,
                    "seconds": round(time.time() - t0, 1)}
         results.append(rec)
-        print(json.dumps(rec))
+        print(json.dumps(rec), flush=True)
         with open(args.out, "w") as f:
             json.dump({"when": time.strftime("%Y-%m-%d %H:%M:%S"),
                        "round": n, "results": results}, f, indent=1)
-    return 0
+    return 0 if all(r.get("rc") == 0 for r in results) else 1
 
 
 if __name__ == "__main__":

@@ -80,6 +80,13 @@ def main():
         "returncode": rc,
         "cases": cases,
     }
+    # keep the one-line tracebacks of failed cases in the artifact —
+    # the tunnel may be gone by the time anyone wants to debug them
+    fail_lines = [ln for ln in out.splitlines()
+                  if ln.startswith(("E ", "FAILED", "/root/repo", "/usr/"))
+                  and ("Error" in ln or "assert" in ln or "FAILED" in ln)]
+    if fail_lines:
+        artifact["failure_lines"] = fail_lines[:60]
     if not cases and rc != 0:
         # a broken run (collection/import error) must never read green
         artifact["status"] = "BROKEN_RUN"
