@@ -205,6 +205,11 @@ int MXNDArrayGetShape(void* handle, int* out_ndim, int64_t* out_shape,
 }
 
 int MXNDArrayGetDType(void* handle, char* buf, int buflen) {
+  if (buf == nullptr || buflen <= 0) {
+    set_err("MXNDArrayGetDType: buffer must have room for at least "
+            "one byte");
+    return -1;
+  }
   Gil gil;
   PyObject* args = Py_BuildValue("(O)",
                                  reinterpret_cast<PyObject*>(handle));

@@ -183,12 +183,20 @@ def _time_call(fn, sync, repeat, number):
     return best[len(best) // 2] * 1e6
 
 
-def compare(current, against_path, fail_over):
+def compare(current, against_path, fail_over, floor_us=50.0,
+            min_was_us=50.0):
     """Regression gate: every row in `against` that also ran now, same
     backend and shape, must not have slowed by more than `fail_over`
-    (fraction) in its jit columns.  A noise floor (20µs absolute AND
-    the relative threshold) keeps CPU timer jitter from failing runs.
-    Returns (regressions, compared_count)."""
+    (fraction) in its jit columns.
+
+    Noise handling, calibrated against two same-code baselines on the
+    1-core dev box (tools/opperf round-5): sub-50µs timings swing 2-3x
+    run to run, so rows with a baseline under `min_was_us` are skipped
+    and a regression must clear BOTH an absolute `floor_us` delta and
+    the relative threshold.  With (50µs, 50µs, 2x) the gate flags zero
+    false positives on identical code while still watching every
+    MXU-scale op; tighter thresholds only make sense on an idle
+    accelerator host.  Returns (regressions, compared_count)."""
     with open(against_path) as f:
         base = json.load(f)
     if base.get("backend") != current["backend"]:
@@ -202,10 +210,10 @@ def compare(current, against_path, fail_over):
             continue
         for col in ("jit_fwd_us", "jit_bwd_us"):
             was, now = b.get(col), row.get(col)
-            if not was or not now:
+            if not was or not now or was < min_was_us:
                 continue
             compared += 1
-            if now - was > 20.0 and now > was * (1.0 + fail_over):
+            if now - was > floor_us and now > was * (1.0 + fail_over):
                 regressions.append(
                     {"op": row["op"], "col": col, "was_us": was,
                      "now_us": now, "ratio": round(now / was, 2)})
@@ -224,9 +232,10 @@ def main():
     ap.add_argument("--against", default=None,
                     help="baseline OPPERF json: exit 1 if any op's jit "
                          "column regressed past --fail-over")
-    ap.add_argument("--fail-over", type=float, default=0.15,
+    ap.add_argument("--fail-over", type=float, default=1.0,
                     help="allowed slowdown fraction vs --against "
-                         "(default 0.15 = 15%%)")
+                         "(default 1.0 = 2x; sub-2x deltas are timer "
+                         "noise on the 1-core dev box)")
     args = ap.parse_args()
 
     import numpy as np

@@ -42,11 +42,38 @@ def main():
     artifact = {"when": time.strftime("%Y-%m-%d %H:%M:%S"),
                 "duration_s": round(time.time() - t0, 1),
                 "returncode": p.returncode, **tally, "cases": cases}
+
+    # op-level perf regression gate (round-4 verdict item #4): re-run
+    # the CPU opperf sweep and fail the nightly on a sustained 2x op
+    # slowdown vs the committed baseline (thresholds calibrated to the
+    # 1-core box's timer noise — see tools/opperf.py compare()).
+    baseline = os.path.join(_REPO, "OPPERF.json")
+    opperf_rc = None
+    if os.path.exists(baseline):
+        cpu_env = dict(env, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+        try:
+            q = subprocess.run(
+                [sys.executable, "tools/opperf.py",
+                 "--against", baseline, "--fail-over", "1.0"],
+                capture_output=True, text=True, timeout=1800, cwd=_REPO,
+                env=cpu_env)
+            opperf_rc = q.returncode
+            artifact["opperf_gate"] = {
+                "returncode": q.returncode,
+                "tail": "\n".join(q.stdout.splitlines()[-2:]),
+                # keep the crash trail: a non-regression failure
+                # (import error, spec raising) surfaces only on stderr
+                "stderr_tail": "\n".join(q.stderr.splitlines()[-8:])}
+        except subprocess.TimeoutExpired:
+            opperf_rc = -1
+            artifact["opperf_gate"] = {"returncode": -1,
+                                       "note": "timed out"}
+
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=1)
     print(out.splitlines()[-1] if out.splitlines() else "")
     print(f"wrote {args.out}")
-    return 0 if p.returncode == 0 else 1
+    return 0 if p.returncode == 0 and opperf_rc in (None, 0) else 1
 
 
 if __name__ == "__main__":
