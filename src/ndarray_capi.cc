@@ -223,8 +223,16 @@ int MXNDArrayGetDType(void* handle, char* buf, int buflen) {
     set_err_from_python();
     return -1;
   }
-  std::strncpy(buf, s, buflen - 1);
-  buf[buflen - 1] = '\0';
+  size_t need = std::strlen(s);
+  if (need >= static_cast<size_t>(buflen)) {
+    // a silently truncated dtype name ("flo") is worse than an error
+    set_err("MXNDArrayGetDType: dtype name needs " +
+            std::to_string(need + 1) + " bytes, buffer has " +
+            std::to_string(buflen));
+    Py_DECREF(dt);
+    return -1;
+  }
+  std::memcpy(buf, s, need + 1);
   Py_DECREF(dt);
   return 0;
 }

@@ -325,6 +325,15 @@ def main():
                           "regressions": regressions}))
         if any("op" in r for r in regressions):
             return 1
+        if compared == 0:
+            # fail CLOSED: a backend mismatch or zero overlapping rows
+            # means the gate checked nothing — a silent no-op here would
+            # let real regressions ship while the nightly stays green
+            print(json.dumps({"error": "regression gate compared 0 "
+                              "columns (backend mismatch or disjoint "
+                              "row keys) — regenerate the baseline on "
+                              "this backend"}))
+            return 1
     return 0
 
 

@@ -69,6 +69,7 @@ def main():
             artifact["opperf_gate"] = {"returncode": -1,
                                        "note": "timed out"}
 
+    artifact["duration_s"] = round(time.time() - t0, 1)  # incl. gate
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=1)
     print(out.splitlines()[-1] if out.splitlines() else "")

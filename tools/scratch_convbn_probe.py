@@ -111,7 +111,25 @@ def candidate_tap(x, w_taps, in_scale, in_bias, shift, *, kernel, stride,
       shift.reshape(1, co))
 
 
+def _time(fn, *args, reps=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1e6  # µs
+
+
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--time", action="store_true",
+                    help="also time fused kernel vs composed XLA on the "
+                         "REAL ResNet-50 BS-256 layer shapes")
+    args = ap.parse_args()
+
     print("backend:", jax.default_backend(), jax.devices())
     rng = np.random.RandomState(0)
     cases = [
@@ -121,6 +139,8 @@ def main():
         ((4, 16, 16, 256), 128, (1, 1), (1, 1), (0, 0)),
         ((4, 16, 16, 128), 128, (3, 3), (2, 2), (1, 1)),
     ]
+    if args.time:
+        return time_layers(rng)
     for shape, co, kernel, stride, pad in cases:
         n, h, wd, ci = shape
         x = jnp.asarray(rng.randn(*shape).astype("float32") * 0.5,
@@ -150,6 +170,55 @@ def main():
         except Exception as e:
             print(f"FAIL {shape} co={co} k={kernel} s={stride}: "
                   f"{type(e).__name__}: {str(e).splitlines()[0][:160]}")
+    return 0
+
+
+def time_layers(rng):
+    """Per-shape fused-Pallas vs composed-XLA forward timing on the
+    BS-256 ResNet-50 bottleneck shapes (the bench workload).  Uses the
+    PRODUCTION kernel via ops.pallas_convbn so probe results transfer."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import pallas_convbn as pcb
+
+    # (n, h, w, ci) -> co, kernel, stride, pad : stage-representative
+    layers = [
+        ((256, 56, 56, 64), 64, (3, 3), (1, 1), (1, 1)),
+        ((256, 56, 56, 64), 256, (1, 1), (1, 1), (0, 0)),
+        ((256, 56, 56, 256), 64, (1, 1), (1, 1), (0, 0)),
+        ((256, 28, 28, 128), 128, (3, 3), (1, 1), (1, 1)),
+        ((256, 28, 28, 128), 512, (1, 1), (1, 1), (0, 0)),
+        ((256, 14, 14, 256), 256, (3, 3), (1, 1), (1, 1)),
+        ((256, 14, 14, 1024), 256, (1, 1), (1, 1), (0, 0)),
+        ((256, 7, 7, 512), 512, (3, 3), (1, 1), (1, 1)),
+        ((256, 7, 7, 512), 2048, (1, 1), (1, 1), (0, 0)),
+    ]
+    for shape, co, kernel, stride, pad in layers:
+        n, h, wd, ci = shape
+        x = jnp.asarray(rng.randn(*shape).astype("float32") * 0.5,
+                        jnp.bfloat16)
+        w = jnp.asarray(rng.randn(co, ci, *kernel).astype("float32")
+                        * 0.05, jnp.bfloat16)
+        sc = jnp.asarray(rng.rand(ci).astype("float32") + 0.5)
+        bi = jnp.asarray(rng.randn(ci).astype("float32") * 0.1)
+        sh = jnp.asarray(rng.randn(co).astype("float32") * 0.1)
+        kw = dict(kernel=kernel, stride=stride, pad=pad, act_in=True,
+                  want_stats=True)
+        try:
+            pal = jax.jit(functools.partial(pcb._pallas_unit, **kw))
+            t_pal = _time(pal, x, w, sc, bi, sh)
+        except Exception as e:
+            t_pal = None
+            err = str(e).splitlines()[0][:100]
+        xla = jax.jit(functools.partial(pcb._xla_unit, **kw))
+        t_xla = _time(xla, x, w, sc, bi, sh)
+        if t_pal is None:
+            print(f"{shape} co={co} k={kernel}: pallas FAIL ({err}); "
+                  f"xla {t_xla:.0f}us")
+        else:
+            print(f"{shape} co={co} k={kernel} s={stride}: "
+                  f"pallas {t_pal:.0f}us  xla {t_xla:.0f}us  "
+                  f"ratio {t_pal / t_xla:.2f}")
     return 0
 
 
