@@ -68,6 +68,11 @@ def run_benchmark(args) -> dict:
         # the parent kills this child, the parent still finds this line
         print(json.dumps(base), flush=True)
         os.environ["MXNET_FUSED_CONVBN"] = "1"
+        # ~20 distinct fused-unit configs probe-compile at 3-17s each
+        # (round-5 on-chip data); the default 300s budget would cut off
+        # late-traced shapes and silently mix fallback layers into the
+        # A/B — give the comparison pass room to probe everything
+        os.environ.setdefault("MXNET_PALLAS_PROBE_BUDGET", "900")
         try:
             fused = _measure_once(args)
             out["fused_convbn_img_s"] = fused["value"]

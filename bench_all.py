@@ -324,7 +324,11 @@ CONFIGS = {
 RUNS = [
     ("mnist_mlp", "default", {}),
     ("resnet50", "default", {}),
-    ("resnet50", "fused_convbn", {"MXNET_FUSED_CONVBN": "1"}),
+    ("resnet50", "fused_convbn", {"MXNET_FUSED_CONVBN": "1",
+                                  # ~20 fused-unit configs probe-compile
+                                  # at 3-17s each; the 300s default
+                                  # would silently mix fallback layers
+                                  "MXNET_PALLAS_PROBE_BUDGET": "900"}),
     ("bert_base", "default", {}),
     ("bert_base", "no_pallas", {"MXNET_USE_PALLAS": "0"}),
     ("ssd_resnet50", "default", {}),
@@ -385,9 +389,13 @@ def main():
             cmd = [sys.executable, os.path.abspath(__file__), "--_child",
                    "--config", name, "--variant", variant,
                    "--steps", str(args.steps), "--warmup", str(args.warmup)]
+            # a raised probe budget must come with a raised child bound,
+            # or worst-case probing converts "some fallback layers" into
+            # "no fused number at all"
+            extra = float(env.get("MXNET_PALLAS_PROBE_BUDGET", 0))
             try:
                 p = subprocess.run(cmd, capture_output=True, text=True,
-                                   timeout=args.run_timeout,
+                                   timeout=args.run_timeout + extra,
                                    env={**os.environ, **env})
             except subprocess.TimeoutExpired:
                 results.append({"metric": name, "variant": variant,

@@ -239,6 +239,179 @@ def _pallas_unit(x, w, in_scale, in_bias, shift, *, kernel, stride, pad,
 
 
 # ---------------------------------------------------------------------------
+# Pallas backward (opt-in: MXNET_FUSED_CONVBN_BWD=1)
+# ---------------------------------------------------------------------------
+
+def _batch_tile_bwd(n, h, w, ci, ho, wo, co, kh, kw, itemsize=2):
+    """Batch tile for the backward kernel: the fp32 du accumulator and
+    the padded activation dominate; the fp32 dw tap accumulator is a
+    FIXED cost independent of nb and is subtracted from the budget
+    up front (512-channel stages overflow VMEM here and take the XLA
+    fallback via the compile probe)."""
+    fixed = kh * kw * ci * co * 4          # dw accumulator (f32)
+    budget = _COLS_BUDGET_BYTES - fixed
+    per_image = ((h + 2) * (w + 2) * ci * (itemsize + 4)  # u_pad + du_pad
+                 + 2 * h * w * ci * itemsize              # x block, dbuf
+                 + 3 * ho * wo * co * itemsize            # y + gy + dy
+                 + h * w * ci * itemsize)                 # gx out
+    nb = 1
+    while nb * 2 <= n and n % (nb * 2) == 0 \
+            and (nb * 2) * per_image <= max(budget, 0):
+        nb *= 2
+    return nb
+
+
+def _pallas_unit_bwd(x, w, in_scale, in_bias, shift, y, gy, gs1, gs2, *,
+                     kernel, stride, pad, act_in, want_stats):
+    """Single-pass fused backward: dy_tot (BN-stat cotangent fold) is
+    computed once in VMEM, then each kernel tap contributes one wgrad
+    matmul (Ci,Co) and one dgrad matmul (M,Ci) whose result is
+    accumulated into the padded input-grad buffer by a static pad —
+    dy and the recomputed activation are read from HBM exactly once,
+    where the XLA path's separate dgrad/wgrad convs read them twice.
+    Stride-1 only (the dgrad of a strided conv needs interior-dilated
+    pads, unproven under Mosaic; strided shapes take the XLA path)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, h, wd, ci = x.shape
+    co = w.shape[0]
+    kh, kw = kernel
+    ho, wo = _out_hw(h, wd, kernel, stride, pad)
+    hp, wp = h + 2 * pad[0], wd + 2 * pad[1]
+    nb = _batch_tile_bwd(n, h, wd, ci, ho, wo, co, kh, kw,
+                         itemsize=x.dtype.itemsize)
+    wtaps = _weight_taps(w)
+    gy_dtype = gy.dtype
+
+    def kern(x_ref, w_ref, sc_ref, bi_ref, sh_ref, y_ref, gy_ref,
+             gs1_ref, gs2_ref, gx_ref, dw_ref, gsc_ref, gbi_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            dw_ref[...] = jnp.zeros_like(dw_ref)
+            gsc_ref[...] = jnp.zeros_like(gsc_ref)
+            gbi_ref[...] = jnp.zeros_like(gbi_ref)
+
+        gyb = gy_ref[...].astype(jnp.float32)
+        if want_stats:
+            yf = y_ref[...].astype(jnp.float32)
+            dy = (gyb + gs1_ref[...].reshape(1, 1, 1, co)
+                  + 2.0 * (yf - sh_ref[...].reshape(1, 1, 1, co))
+                  * gs2_ref[...].reshape(1, 1, 1, co))
+        else:
+            dy = gyb
+        # match the XLA path's rounding: dy_tot is cast to gy.dtype
+        # before entering the transpose convs
+        dyf = dy.astype(gy_dtype).reshape(nb * ho * wo, co)
+
+        xb = x_ref[...]
+        if act_in:
+            uf32 = (xb.astype(jnp.float32) * sc_ref[...] + bi_ref[...])
+            u = jnp.maximum(uf32, 0.0).astype(xb.dtype)
+        else:
+            u = xb
+        if pad != (0, 0):
+            u = jnp.pad(u, ((0, 0), (pad[0], pad[0]),
+                            (pad[1], pad[1]), (0, 0)))
+
+        du_pad = jnp.zeros((nb, hp, wp, ci), jnp.float32)
+        for ky in range(kh):
+            for kx in range(kw):
+                sl = u[:, ky:ky + ho, kx:kx + wo, :] \
+                    .reshape(nb * ho * wo, ci)
+                # wgrad tap: (Ci, Co), contract the patch dim
+                dw_ref[ky, kx] += jax.lax.dot_general(
+                    sl, dyf, dimension_numbers=(((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                # dgrad tap: (M, Ci), contract Co
+                contrib = jax.lax.dot_general(
+                    dyf, w_ref[ky, kx],
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                du_pad = du_pad + jnp.pad(
+                    contrib.reshape(nb, ho, wo, ci),
+                    ((0, 0), (ky, hp - ho - ky), (kx, wp - wo - kx),
+                     (0, 0)))
+        du = du_pad[:, pad[0]:pad[0] + h, pad[1]:pad[1] + wd, :]
+        if act_in:
+            gu = jnp.where(uf32 > 0.0, du, 0.0)
+            gx_ref[...] = (gu * sc_ref[...]).astype(gx_ref.dtype)
+            gsc_ref[...] += jnp.sum(
+                gu * xb.astype(jnp.float32), axis=(0, 1, 2)) \
+                .reshape(1, ci)
+            gbi_ref[...] += jnp.sum(gu, axis=(0, 1, 2)).reshape(1, ci)
+        else:
+            gx_ref[...] = du.astype(gx_ref.dtype)
+
+    grid = (n // nb,)
+    cspec = lambda r, c: pl.BlockSpec((r, c), lambda i: (0, 0),
+                                      memory_space=pltpu.VMEM)
+    gx, dw_taps, gsc, gbi = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((nb, h, wd, ci), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kh, kw, ci, co), lambda i: (0, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            cspec(1, ci), cspec(1, ci), cspec(1, co),
+            pl.BlockSpec((nb, ho, wo, co), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((nb, ho, wo, co), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            cspec(1, co), cspec(1, co),
+        ],
+        out_specs=[
+            pl.BlockSpec((nb, h, wd, ci), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kh, kw, ci, co), lambda i: (0, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            cspec(1, ci), cspec(1, ci),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h, wd, ci), x.dtype),
+            jax.ShapeDtypeStruct((kh, kw, ci, co), jnp.float32),
+            jax.ShapeDtypeStruct((1, ci), jnp.float32),
+            jax.ShapeDtypeStruct((1, ci), jnp.float32),
+        ],
+        interpret=get_env("MXNET_PALLAS_INTERPRET", False, bool),
+    )(x, wtaps, in_scale.reshape(1, ci), in_bias.reshape(1, ci),
+      shift.reshape(1, co), y, gy,
+      gs1.reshape(1, co), gs2.reshape(1, co))
+    dw = jnp.transpose(dw_taps, (3, 2, 0, 1)).astype(w.dtype)
+    if act_in:
+        return gx, dw, gsc.reshape(ci), gbi.reshape(ci)
+    return gx, dw, jnp.zeros_like(in_scale), jnp.zeros_like(in_bias)
+
+
+def _bwd_wanted() -> bool:
+    return get_env("MXNET_FUSED_CONVBN_BWD", False, bool) \
+        and _pallas_wanted()
+
+
+def _bwd_shape_supported(x, w, kernel, stride, pad, act_in,
+                         want_stats) -> bool:
+    n, h, wd, ci = x.shape
+    co = w.shape[0]
+    ho, wo = _out_hw(h, wd, kernel, stride, pad)
+    key = ("bwd", x.shape, str(x.dtype), w.shape, kernel, stride, pad,
+           act_in, want_stats)
+    return _probe_ok(
+        key,
+        functools.partial(_pallas_unit_bwd, kernel=kernel, stride=stride,
+                          pad=pad, act_in=act_in, want_stats=want_stats),
+        [jax.ShapeDtypeStruct(x.shape, x.dtype),
+         jax.ShapeDtypeStruct(w.shape, w.dtype),
+         jax.ShapeDtypeStruct((ci,), jnp.float32),
+         jax.ShapeDtypeStruct((ci,), jnp.float32),
+         jax.ShapeDtypeStruct((co,), jnp.float32),
+         jax.ShapeDtypeStruct((n, ho, wo, co), x.dtype),
+         jax.ShapeDtypeStruct((n, ho, wo, co), x.dtype),
+         jax.ShapeDtypeStruct((co,), jnp.float32),
+         jax.ShapeDtypeStruct((co,), jnp.float32)])
+
+
+# ---------------------------------------------------------------------------
 # XLA fallback (identical semantics) + shared backward
 # ---------------------------------------------------------------------------
 
@@ -284,37 +457,35 @@ _SHAPE_OK: dict = {}
 _PROBE_SPENT = [0.0]  # cumulative probe-compile seconds
 
 
-def _shape_supported(x, w, kernel, stride, pad, act_in, want_stats) -> bool:
-    key = (x.shape, str(x.dtype), w.shape, kernel, stride, pad, act_in,
-           want_stats)
+def _probe_budget() -> float:
+    """Default probe-compile budget, scaled for the backward knob:
+    MXNET_FUSED_CONVBN_BWD=1 roughly doubles the number of distinct
+    configurations to probe (~20 fwd + ~20 bwd at 3-17s each on-chip),
+    so the default must grow with it — at the library layer, not per
+    launcher."""
+    dflt = 600.0 if get_env("MXNET_FUSED_CONVBN_BWD", False, bool) \
+        else 300.0
+    return get_env("MXNET_PALLAS_PROBE_BUDGET", dflt, float)
+
+
+def _probe_ok(key, fn, arg_structs) -> bool:
+    """Shared probe/budget/cache mechanism for fwd and bwd kernels.
+
+    Budget-exhausted is deliberately NOT cached: 'never probed' must
+    stay distinguishable from 'Mosaic rejected' so a later call with
+    budget headroom can still probe this configuration."""
     ok = _SHAPE_OK.get(key)
     if ok is None:
         import time as _time
 
-        budget = get_env("MXNET_PALLAS_PROBE_BUDGET", 300.0, float)
         if get_env("MXNET_PALLAS_INTERPRET", False, bool):
             ok = True  # interpreter mode has no Mosaic stage
-        elif _PROBE_SPENT[0] >= budget:
-            # probe time is bounded: ~20+ unique ResNet shapes at
-            # ~10s/compile could otherwise eat the bench child's
-            # timeout; shapes past the budget take the safe XLA
-            # fallback (the traffic-heavy early layers probe first in
-            # trace order).  NOT cached: 'never probed' must stay
-            # distinguishable from 'Mosaic rejected' so a later call
-            # with budget headroom can still probe this shape
+        elif _PROBE_SPENT[0] >= _probe_budget():
             return False
         else:
             _t0 = _time.perf_counter()
             try:
-                args = [jax.ShapeDtypeStruct(x.shape, x.dtype),
-                        jax.ShapeDtypeStruct(w.shape, w.dtype),
-                        jax.ShapeDtypeStruct((x.shape[-1],), jnp.float32),
-                        jax.ShapeDtypeStruct((x.shape[-1],), jnp.float32),
-                        jax.ShapeDtypeStruct((w.shape[0],), jnp.float32)]
-                jax.jit(functools.partial(
-                    _pallas_unit, kernel=kernel, stride=stride, pad=pad,
-                    act_in=act_in, want_stats=want_stats)) \
-                    .lower(*args).compile()
+                jax.jit(fn).lower(*arg_structs).compile()
                 ok = True
             except Exception:
                 ok = False
@@ -322,6 +493,20 @@ def _shape_supported(x, w, kernel, stride, pad, act_in, want_stats) -> bool:
                 _PROBE_SPENT[0] += _time.perf_counter() - _t0
         _SHAPE_OK[key] = ok
     return ok
+
+
+def _shape_supported(x, w, kernel, stride, pad, act_in, want_stats) -> bool:
+    key = (x.shape, str(x.dtype), w.shape, kernel, stride, pad, act_in,
+           want_stats)
+    return _probe_ok(
+        key,
+        functools.partial(_pallas_unit, kernel=kernel, stride=stride,
+                          pad=pad, act_in=act_in, want_stats=want_stats),
+        [jax.ShapeDtypeStruct(x.shape, x.dtype),
+         jax.ShapeDtypeStruct(w.shape, w.dtype),
+         jax.ShapeDtypeStruct((x.shape[-1],), jnp.float32),
+         jax.ShapeDtypeStruct((x.shape[-1],), jnp.float32),
+         jax.ShapeDtypeStruct((w.shape[0],), jnp.float32)])
 
 
 def _mesh_shard_plan():
@@ -426,6 +611,18 @@ def _unit_fwd(x, w, in_scale, in_bias, shift, kernel, stride, pad, act_in,
 def _unit_bwd(kernel, stride, pad, act_in, want_stats, res, cots):
     x, w, in_scale, in_bias, shift, y = res
     gy, gs1, gs2 = cots
+    if _bwd_wanted() and stride == (1, 1) \
+            and _mesh_shard_plan() is None \
+            and _bwd_shape_supported(x, w, kernel, stride, pad, act_in,
+                                     want_stats):
+        try:
+            gx, dw, gscale, gbias = _pallas_unit_bwd(
+                x, w, in_scale, in_bias, shift, y, gy, gs1, gs2,
+                kernel=kernel, stride=stride, pad=pad, act_in=act_in,
+                want_stats=want_stats)
+            return gx, dw, gscale, gbias, jnp.zeros_like(shift)
+        except Exception:
+            pass
     if want_stats:
         # fold the BN-stat cotangents into dy: d(s1)/dy = 1,
         # d(s2)/dy = 2(y - shift); all C-sized broadcasts, XLA fuses

@@ -367,7 +367,7 @@ class SPMDTrainer:
                 # the trainer's mesh scope is active for the whole
                 # traced step, wherever step() was called from — code
                 # consulting current_mesh() at trace time (ring/ulysses
-                # attention, the fused-conv multi-device gate, sharding
+                # attention, the fused-conv shard_map plan, sharding
                 # constraints) sees THIS mesh, not the caller's ambient
                 # scope
                 with trainer.mesh, trace, \
@@ -544,7 +544,7 @@ class SPMDTrainer:
                 # the trainer's mesh scope is active for the whole
                 # traced step, wherever step() was called from — code
                 # consulting current_mesh() at trace time (ring/ulysses
-                # attention, the fused-conv multi-device gate, sharding
+                # attention, the fused-conv shard_map plan, sharding
                 # constraints) sees THIS mesh, not the caller's ambient
                 # scope
                 with trainer.mesh, trace, \

@@ -277,3 +277,119 @@ def test_sharded_pallas_matches_fallback_full(axes, monkeypatch):
     np.testing.assert_allclose(s2f, s2r, rtol=1e-4, atol=1e-3)
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+BWD_CASES = [
+    # (shape NHWC, Co, kernel, pad, act_in, want_stats)
+    ((4, 8, 8, 16), 16, (3, 3), (1, 1), True, True),
+    ((2, 8, 8, 8), 24, (1, 1), (0, 0), True, True),
+    ((2, 6, 6, 8), 8, (3, 3), (1, 1), False, True),
+    ((2, 6, 6, 8), 8, (3, 3), (1, 1), True, False),
+]
+
+
+@pytest.mark.parametrize("case", BWD_CASES)
+def test_pallas_bwd_matches_xla_bwd(case, monkeypatch):
+    """MXNET_FUSED_CONVBN_BWD=1 single-pass backward kernel == the XLA
+    linear_transpose backward for every gradient, with a spy proving
+    the Pallas path actually engaged (an exception inside it silently
+    falls back, which would make this comparison vacuous)."""
+    shape, co, kernel, pad, act_in, want_stats = case
+    x = jnp.asarray(_rand(shape))
+    w = jnp.asarray(_rand((co, shape[-1]) + kernel, scale=0.2))
+    sc = jnp.asarray(_rand((shape[-1],)) ** 2 + 0.5)
+    bi = jnp.asarray(_rand((shape[-1],)))
+    sh = jnp.asarray(_rand((co,)))
+
+    def loss(x, w, sc, bi):
+        y, s1, s2 = pcb.fused_conv_unit(
+            x, w, sc, bi, sh, kernel=kernel, stride=(1, 1), pad=pad,
+            act_in=act_in, want_stats=want_stats)
+        return ((y.astype(jnp.float32) ** 2).sum()
+                + (s1 * s1).sum() * 1e-3 + s2.sum() * 1e-3)
+
+    monkeypatch.setenv("MXNET_PALLAS_INTERPRET", "1")
+    monkeypatch.setitem(pcb._STATE, "enabled", None)
+
+    monkeypatch.setenv("MXNET_FUSED_CONVBN_BWD", "0")
+    ref = jax.grad(loss, argnums=(0, 1, 2, 3))(x, w, sc, bi)
+
+    calls = {"bwd": 0}
+    real = pcb._pallas_unit_bwd
+
+    def spy(*a, **k):
+        calls["bwd"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(pcb, "_pallas_unit_bwd", spy)
+    monkeypatch.setenv("MXNET_FUSED_CONVBN_BWD", "1")
+    got = jax.grad(loss, argnums=(0, 1, 2, 3))(x, w, sc, bi)
+    assert calls["bwd"] == 1
+
+    for name, a, b in zip(("gx", "dw", "gscale", "gbias"), got, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"{name}")
+
+
+def test_pallas_bwd_strided_falls_back(monkeypatch):
+    """Strided units keep the XLA backward even with the knob on (the
+    dgrad of a strided conv needs interior-dilated pads)."""
+    monkeypatch.setenv("MXNET_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("MXNET_FUSED_CONVBN_BWD", "1")
+    monkeypatch.setitem(pcb._STATE, "enabled", None)
+    calls = {"bwd": 0}
+    real = pcb._pallas_unit_bwd
+
+    def spy(*a, **k):
+        calls["bwd"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(pcb, "_pallas_unit_bwd", spy)
+    x = jnp.asarray(_rand((2, 8, 8, 8)))
+    w = jnp.asarray(_rand((8, 8, 3, 3), scale=0.2))
+
+    def loss(x, w):
+        y, s1, s2 = pcb.fused_conv_unit(x, w, kernel=(3, 3),
+                                        stride=(2, 2), pad=(1, 1))
+        return (y.astype(jnp.float32) ** 2).sum() + s2.sum() * 1e-3
+
+    g = jax.grad(loss, argnums=(0, 1))(x, w)
+    assert calls["bwd"] == 0
+    assert all(np.isfinite(np.asarray(t)).all() for t in g)
+
+
+def test_pallas_bwd_multi_program_accumulation(monkeypatch):
+    """Force nb < n (tiny VMEM budget) so the cross-program accumulator
+    path — pl.when zero-init at program 0, += on dw/gscale/gbias across
+    the sequential grid — is actually executed, and still matches the
+    XLA backward.  The default budget admits every BWD_CASES batch in
+    one program, which would leave that path untested."""
+    monkeypatch.setenv("MXNET_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("MXNET_FUSED_CONVBN_BWD", "1")
+    monkeypatch.setitem(pcb._STATE, "enabled", None)
+    monkeypatch.setattr(pcb, "_COLS_BUDGET_BYTES", 1)  # nb floor = 1
+
+    shape, co, kernel, pad = (4, 6, 6, 8), 8, (3, 3), (1, 1)
+    assert pcb._batch_tile_bwd(shape[0], 6, 6, 8, 6, 6, co, 3, 3) == 1
+    x = jnp.asarray(_rand(shape))
+    w = jnp.asarray(_rand((co, shape[-1]) + kernel, scale=0.2))
+    sc = jnp.asarray(_rand((shape[-1],)) ** 2 + 0.5)
+    bi = jnp.asarray(_rand((shape[-1],)))
+    sh = jnp.asarray(_rand((co,)))
+
+    def loss(x, w, sc, bi):
+        y, s1, s2 = pcb.fused_conv_unit(
+            x, w, sc, bi, sh, kernel=kernel, stride=(1, 1), pad=pad,
+            act_in=True, want_stats=True)
+        return ((y.astype(jnp.float32) ** 2).sum()
+                + (s1 * s1).sum() * 1e-3 + s2.sum() * 1e-3)
+
+    got = jax.grad(loss, argnums=(0, 1, 2, 3))(x, w, sc, bi)
+
+    monkeypatch.setenv("MXNET_FUSED_CONVBN_BWD", "0")
+    ref = jax.grad(loss, argnums=(0, 1, 2, 3))(x, w, sc, bi)
+    for name, a, b in zip(("gx", "dw", "gscale", "gbias"), got, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"{name}")
