@@ -97,14 +97,15 @@ def _pallas_wanted() -> bool:
     return _STATE["enabled"]
 
 
-def _batch_tile(n, h, w, ci, ho, wo, co, itemsize=2):
+def _batch_tile(n, h, w, ci, ho, wo, co, itemsize=2, pad=(1, 1)):
     """Largest power-of-two batch tile dividing n whose whole VMEM
     working set (bytes) fits the budget.  Tap-accumulation working set:
     padded activation block u, fp32 accumulator, one tap slice, plus
     double-buffered x and y grid blocks.  >=1 even when one image
     overflows (the 56x56 stage must still run).  `itemsize` is the
     activation dtype width (2 for bf16, 4 for fp32)."""
-    per_image = ((h + 2) * (w + 2) * ci * itemsize   # u (padded)
+    hp, wp = h + 2 * pad[0], w + 2 * pad[1]
+    per_image = (hp * wp * ci * itemsize             # u (padded)
                  + ho * wo * co * 4                  # fp32 accumulator
                  + ho * wo * ci * itemsize           # tap slice temp
                  + 2 * h * w * ci * itemsize         # x block, dbuf
@@ -154,7 +155,7 @@ def _pallas_unit(x, w, in_scale, in_bias, shift, *, kernel, stride, pad,
     sh_, sw_ = stride
     ho, wo = _out_hw(h, wd, kernel, stride, pad)
     nb = _batch_tile(n, h, wd, ci, ho, wo, co,
-                     itemsize=x.dtype.itemsize)
+                     itemsize=x.dtype.itemsize, pad=pad)
     wtaps = _weight_taps(w)
     out_dtype = x.dtype
 
@@ -242,7 +243,8 @@ def _pallas_unit(x, w, in_scale, in_bias, shift, *, kernel, stride, pad,
 # Pallas backward (opt-in: MXNET_FUSED_CONVBN_BWD=1)
 # ---------------------------------------------------------------------------
 
-def _batch_tile_bwd(n, h, w, ci, ho, wo, co, kh, kw, itemsize=2):
+def _batch_tile_bwd(n, h, w, ci, ho, wo, co, kh, kw, itemsize=2,
+                    pad=(1, 1)):
     """Batch tile for the backward kernel: the fp32 du accumulator and
     the padded activation dominate; the fp32 dw tap accumulator is a
     FIXED cost independent of nb and is subtracted from the budget
@@ -250,7 +252,8 @@ def _batch_tile_bwd(n, h, w, ci, ho, wo, co, kh, kw, itemsize=2):
     fallback via the compile probe)."""
     fixed = kh * kw * ci * co * 4          # dw accumulator (f32)
     budget = _COLS_BUDGET_BYTES - fixed
-    per_image = ((h + 2) * (w + 2) * ci * (itemsize + 4)  # u_pad + du_pad
+    hp, wp = h + 2 * pad[0], w + 2 * pad[1]
+    per_image = (hp * wp * ci * (itemsize + 4)            # u_pad + du_pad
                  + 2 * h * w * ci * itemsize              # x block, dbuf
                  + 3 * ho * wo * co * itemsize            # y + gy + dy
                  + h * w * ci * itemsize)                 # gx out
@@ -280,7 +283,7 @@ def _pallas_unit_bwd(x, w, in_scale, in_bias, shift, y, gy, gs1, gs2, *,
     ho, wo = _out_hw(h, wd, kernel, stride, pad)
     hp, wp = h + 2 * pad[0], wd + 2 * pad[1]
     nb = _batch_tile_bwd(n, h, wd, ci, ho, wo, co, kh, kw,
-                         itemsize=x.dtype.itemsize)
+                         itemsize=x.dtype.itemsize, pad=pad)
     wtaps = _weight_taps(w)
     gy_dtype = gy.dtype
 
@@ -382,6 +385,36 @@ def _pallas_unit_bwd(x, w, in_scale, in_bias, shift, y, gy, gs1, gs2, *,
     if act_in:
         return gx, dw, gsc.reshape(ci), gbi.reshape(ci)
     return gx, dw, jnp.zeros_like(in_scale), jnp.zeros_like(in_bias)
+
+
+def _pallas_unit_bwd_sharded(x, w, in_scale, in_bias, shift, y, gy, gs1,
+                             gs2, *, mesh, axes, kernel, stride, pad,
+                             act_in, want_stats):
+    """Per-shard backward kernel over the batch axes; the batch-summed
+    cotangents (dw, gscale, gbias) are psum'd global, mirroring how
+    GSPMD reduces them for the XLA backward.  gx stays batch-sharded
+    like x."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel._compat import shard_map_unchecked
+
+    def per_shard(xs, ws, scs, bis, shs, ys, gys, g1s, g2s):
+        gx, dw, gsc, gbi = _pallas_unit_bwd(
+            xs, ws, scs, bis, shs, ys, gys, g1s, g2s, kernel=kernel,
+            stride=stride, pad=pad, act_in=act_in, want_stats=want_stats)
+        if axes:
+            dw = lax.psum(dw, axes)
+            gsc = lax.psum(gsc, axes)
+            gbi = lax.psum(gbi, axes)
+        return gx, dw, gsc, gbi
+
+    bspec = P(axes if axes else None)
+    rep = P()
+    fn = shard_map_unchecked(
+        per_shard, mesh=mesh.mesh,
+        in_specs=(bspec, rep, rep, rep, rep, bspec, bspec, rep, rep),
+        out_specs=(bspec, rep, rep, rep))
+    return fn(x, w, in_scale, in_bias, shift, y, gy, gs1, gs2)
 
 
 def _bwd_wanted() -> bool:
@@ -509,6 +542,28 @@ def _shape_supported(x, w, kernel, stride, pad, act_in, want_stats) -> bool:
          jax.ShapeDtypeStruct((w.shape[0],), jnp.float32)])
 
 
+def _dispatch_plan(x, shape_probe):
+    """ONE dispatch rule for fwd and bwd: returns
+    ('single', None, None)   — no multi-device mesh active,
+    ('sharded', mesh, axes)  — mesh active, batch divides the shards,
+                               and the PER-SHARD shape probe-compiles,
+    ('xla', None, None)      — mesh active but unsupported.
+    Keeping this in one place means forward and backward can never
+    silently disagree about when the Pallas path engages."""
+    plan = _mesh_shard_plan()
+    if plan is None:
+        return ("single", None, None)
+    mesh, axes = plan
+    nshard = 1
+    for a in axes:
+        nshard *= mesh.axis_sizes[a]
+    shard_shape = (x.shape[0] // nshard,) + tuple(x.shape[1:])
+    if x.shape[0] % nshard == 0 and shard_shape[0] > 0 \
+            and shape_probe(jax.ShapeDtypeStruct(shard_shape, x.dtype)):
+        return ("sharded", mesh, axes)
+    return ("xla", None, None)
+
+
 def _mesh_shard_plan():
     """(mesh, batch_axes) for the active multi-device mesh, else None.
 
@@ -567,34 +622,25 @@ def _pallas_unit_sharded(x, w, in_scale, in_bias, shift, *, mesh, axes,
 def _unit(x, w, in_scale, in_bias, shift, kernel, stride, pad, act_in,
           want_stats):
     if _pallas_wanted():
-        plan = _mesh_shard_plan()
-        if plan is None:
-            if _shape_supported(x, w, kernel, stride, pad,
-                                act_in, want_stats):
-                try:
-                    return _pallas_unit(x, w, in_scale, in_bias, shift,
-                                        kernel=kernel, stride=stride,
-                                        pad=pad, act_in=act_in,
-                                        want_stats=want_stats)
-                except Exception:
-                    pass
-        else:
-            mesh, axes = plan
-            nshard = 1
-            for a in axes:
-                nshard *= mesh.axis_sizes[a]
-            shard_x_shape = (x.shape[0] // nshard,) + tuple(x.shape[1:])
-            if x.shape[0] % nshard == 0 and shard_x_shape[0] > 0 \
-                    and _shape_supported(
-                        jax.ShapeDtypeStruct(shard_x_shape, x.dtype), w,
-                        kernel, stride, pad, act_in, want_stats):
-                try:
-                    return _pallas_unit_sharded(
-                        x, w, in_scale, in_bias, shift, mesh=mesh,
-                        axes=axes, kernel=kernel, stride=stride, pad=pad,
-                        act_in=act_in, want_stats=want_stats)
-                except Exception:
-                    pass
+        probe = lambda xs: _shape_supported(xs, w, kernel, stride, pad,
+                                            act_in, want_stats)
+        kind, mesh, axes = _dispatch_plan(x, probe)
+        if kind == "single" and probe(x):
+            try:
+                return _pallas_unit(x, w, in_scale, in_bias, shift,
+                                    kernel=kernel, stride=stride,
+                                    pad=pad, act_in=act_in,
+                                    want_stats=want_stats)
+            except Exception:
+                pass
+        elif kind == "sharded":
+            try:
+                return _pallas_unit_sharded(
+                    x, w, in_scale, in_bias, shift, mesh=mesh,
+                    axes=axes, kernel=kernel, stride=stride, pad=pad,
+                    act_in=act_in, want_stats=want_stats)
+            except Exception:
+                pass
     return _xla_unit(x, w, in_scale, in_bias, shift, kernel=kernel,
                      stride=stride, pad=pad, act_in=act_in,
                      want_stats=want_stats)
@@ -611,18 +657,29 @@ def _unit_fwd(x, w, in_scale, in_bias, shift, kernel, stride, pad, act_in,
 def _unit_bwd(kernel, stride, pad, act_in, want_stats, res, cots):
     x, w, in_scale, in_bias, shift, y = res
     gy, gs1, gs2 = cots
-    if _bwd_wanted() and stride == (1, 1) \
-            and _mesh_shard_plan() is None \
-            and _bwd_shape_supported(x, w, kernel, stride, pad, act_in,
-                                     want_stats):
-        try:
-            gx, dw, gscale, gbias = _pallas_unit_bwd(
-                x, w, in_scale, in_bias, shift, y, gy, gs1, gs2,
-                kernel=kernel, stride=stride, pad=pad, act_in=act_in,
-                want_stats=want_stats)
-            return gx, dw, gscale, gbias, jnp.zeros_like(shift)
-        except Exception:
-            pass
+    if _bwd_wanted() and stride == (1, 1):
+        probe = lambda xs: _bwd_shape_supported(xs, w, kernel, stride,
+                                                pad, act_in, want_stats)
+        kind, mesh, axes = _dispatch_plan(x, probe)
+        if kind == "single" and probe(x):
+            try:
+                gx, dw, gscale, gbias = _pallas_unit_bwd(
+                    x, w, in_scale, in_bias, shift, y, gy, gs1, gs2,
+                    kernel=kernel, stride=stride, pad=pad,
+                    act_in=act_in, want_stats=want_stats)
+                return gx, dw, gscale, gbias, jnp.zeros_like(shift)
+            except Exception:
+                pass
+        elif kind == "sharded":
+            try:
+                gx, dw, gscale, gbias = _pallas_unit_bwd_sharded(
+                    x, w, in_scale, in_bias, shift, y, gy, gs1, gs2,
+                    mesh=mesh, axes=axes, kernel=kernel,
+                    stride=stride, pad=pad, act_in=act_in,
+                    want_stats=want_stats)
+                return gx, dw, gscale, gbias, jnp.zeros_like(shift)
+            except Exception:
+                pass
     if want_stats:
         # fold the BN-stat cotangents into dy: d(s1)/dy = 1,
         # d(s2)/dy = 2(y - shift); all C-sized broadcasts, XLA fuses

@@ -393,3 +393,50 @@ def test_pallas_bwd_multi_program_accumulation(monkeypatch):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4,
                                    err_msg=f"{name}")
+
+
+@pytest.mark.parametrize("axes", [{"dp": 4}, {"dp": 2, "fsdp": 2, "tp": 2}])
+def test_sharded_pallas_bwd_matches_fallback(axes, monkeypatch):
+    """MXNET_FUSED_CONVBN_BWD under a multi-device mesh: the per-shard
+    backward kernel with psum'd dw/gscale/gbias must equal the XLA
+    backward on the unsharded oracle, spy-verified to have engaged."""
+    from mxnet_tpu import parallel
+
+    shape, co, kernel, pad = (8, 8, 8, 16), 16, (3, 3), (1, 1)
+    x = jnp.asarray(_rand(shape))
+    w = jnp.asarray(_rand((co, shape[-1]) + kernel, scale=0.2))
+    sc = jnp.asarray(_rand((shape[-1],)) ** 2 + 0.5)
+    bi = jnp.asarray(_rand((shape[-1],)))
+    sh = jnp.asarray(_rand((co,)))
+
+    def loss(x, w, sc, bi):
+        y, s1, s2 = pcb.fused_conv_unit(
+            x, w, sc, bi, sh, kernel=kernel, stride=(1, 1), pad=pad,
+            act_in=True, want_stats=True)
+        return ((y.astype(jnp.float32) ** 2).sum()
+                + (s1 * s1).sum() * 1e-3 + s2.sum() * 1e-3)
+
+    monkeypatch.setenv("MXNET_USE_PALLAS", "0")
+    monkeypatch.setitem(pcb._STATE, "enabled", None)
+    ref = jax.grad(loss, argnums=(0, 1, 2, 3))(x, w, sc, bi)
+
+    calls = {"sharded_bwd": 0}
+    real = pcb._pallas_unit_bwd_sharded
+
+    def spy(*a, **k):
+        calls["sharded_bwd"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(pcb, "_pallas_unit_bwd_sharded", spy)
+    monkeypatch.setenv("MXNET_USE_PALLAS", "1")
+    monkeypatch.setenv("MXNET_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("MXNET_FUSED_CONVBN_BWD", "1")
+    monkeypatch.setitem(pcb._STATE, "enabled", None)
+    with parallel.make_mesh(**axes):
+        got = jax.grad(loss, argnums=(0, 1, 2, 3))(x, w, sc, bi)
+    assert calls["sharded_bwd"] == 1
+
+    for name, a, b in zip(("gx", "dw", "gscale", "gbias"), got, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"{name}")
