@@ -3,6 +3,8 @@
 Writes a per-op-category device-time breakdown (the MFU analysis VERDICT
 round 2 asked for).  Usage:
     python tools/profile_bench.py [--batch-size 256] [--steps 5] [--out DIR]
+The fused paths profile through the same command via their env knobs:
+    MXNET_FUSED_CONVBN=1 [MXNET_FUSED_CONVBN_BWD=1] python tools/profile_bench.py
 Parses the xplane.pb with tensorflow's proto (no tensorboard needed).
 """
 from __future__ import annotations
