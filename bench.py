@@ -42,9 +42,11 @@ METRIC = "resnet50_v1_train_throughput_per_chip"
 # Update whenever a fresh driver-verified number lands (see PERF.md).
 LAST_GOOD_IMG_S = 2197.0
 LAST_GOOD_PROVENANCE = "round 2, v5e, driver-verified (BENCH_r02.json)"
-BUILDER_CLAIMED_IMG_S = 2455.0
-BUILDER_CLAIMED_PROVENANCE = ("round 3, v5e, builder-measured with xplane "
-                              "trace (PERF.md); not driver-verified")
+BUILDER_CLAIMED_IMG_S = 2509.0
+BUILDER_CLAIMED_PROVENANCE = ("round 5, v5e, measured by this bench via "
+                              "the on-chip queue in the round-open tunnel "
+                              "window (TPU_QUEUE_RESULTS.json, unfused "
+                              "pass); not yet driver-verified")
 
 
 def run_benchmark(args) -> dict:
