@@ -11,6 +11,7 @@ from . import symbol as sym
 from . import quantization
 from . import onnx
 from . import amp
+from . import deploy
 
 __all__ = ["ndarray", "nd", "symbol", "sym", "quantization", "onnx",
-           "amp"]
+           "amp", "deploy"]

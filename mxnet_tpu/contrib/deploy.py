@@ -1,0 +1,183 @@
+"""Portable model export via StableHLO — the TPU-native deployment path.
+
+Counterpart of the reference's deploy story (ref: save -symbol.json +
+.params, reload in the C++ predictor / another language via the C API,
+docs/faq/smart_device.md "deploy without Python").  On this stack the
+compiler IR *is* the portable artifact: `export_model` traces the
+block's eval-mode forward once and serializes it as versioned StableHLO
+(jax.export), which any later jax release — or any StableHLO-speaking
+runtime — can execute WITHOUT the model's Python class.  Weights ride
+alongside in the standard reference `.params` byte format
+(serialization.py), so they stay interchangeable with every other tool
+in this framework.
+
+The traced program is CachedOp's pure eval-mode function (the same
+functionalization hybridize() compiles), with the PRNG key as a real
+argument — stochastic eval-mode layers draw from the key you serve
+with instead of replaying a baked-in constant.
+
+Artifact layout (a directory):
+    model.stablehlo   versioned StableHLO bytes (jax.export.serialize)
+    model.params      the block's parameters, reference .params format
+    meta.json         input shapes/dtypes + param order + output arity
+
+    from mxnet_tpu.contrib import deploy
+    deploy.export_model(net, "deploy_dir", [nd.zeros((1, 3, 224, 224))])
+    ...
+    served = deploy.import_model("deploy_dir")   # no model code needed
+    y = served(x_nd)                             # NDArray in/out
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Sequence
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["export_model", "import_model", "ServedModel"]
+
+
+def export_model(block, path: str, example_inputs: Sequence) -> str:
+    """Trace `block` (initialized; deferred shapes are resolved with
+    one eager pass on `example_inputs` if needed) and write the
+    portable artifact directory.  Returns `path`."""
+    import jax
+    import jax.numpy as jnp
+
+    from jax import export as jexport
+
+    from .. import autograd
+    from ..gluon.block import CachedOp
+    from ..gluon.parameter import DeferredInitializationError
+
+    xs = [x.data if isinstance(x, NDArray) else jnp.asarray(x)
+          for x in example_inputs]
+    op = CachedOp(block)
+    plist = op._param_list()
+    if not plist:
+        raise MXNetError("export_model: block has no parameters; "
+                         "initialize it first")
+    try:
+        pvals = tuple(p.data().data for _, p in plist)
+    except DeferredInitializationError:
+        # we hold exactly the inputs needed to resolve deferred shapes
+        # (the CachedOp.__call__ resolve-and-retry pattern)
+        with autograd.pause():
+            block(*[NDArray(x) for x in xs])
+        op._pstruct = None
+        plist = op._param_list()
+        pvals = tuple(p.data().data for _, p in plist)
+
+    pure = op._make_pure(train=False)
+
+    def serve_fn(params, key, *inputs):
+        flat, _aux = pure(params, inputs, key)
+        return flat
+
+    structs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype) for v in pvals)
+    key_struct = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    in_structs = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs)
+    exp = jexport.export(jax.jit(serve_fn))(structs, key_struct,
+                                            *in_structs)
+    blob = exp.serialize()
+
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "model.stablehlo"), "wb") as f:
+        f.write(blob)
+    from ..serialization import save_ndarrays as nd_save
+
+    nd_save(os.path.join(path, "model.params"),
+            {name: p.data() for name, p in plist})
+    meta = {
+        "format": "mxnet_tpu.deploy/1",
+        "param_order": [name for name, _ in plist],
+        "param_shapes": {name: list(p.data().shape) for name, p in plist},
+        "param_dtypes": {name: str(p.data().dtype) for name, p in plist},
+        "inputs": [{"shape": list(x.shape), "dtype": str(x.dtype)}
+                   for x in xs],
+        "n_outputs": len(exp.out_avals),
+    }
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    return path
+
+
+class ServedModel:
+    """A reloaded artifact: callable NDArray-in/NDArray-out.
+
+    `params` may be swapped wholesale (same names/shapes/dtypes) with
+    `set_params`, e.g. after further training — the compiled program is
+    weight-agnostic because parameters are arguments, not constants.
+    Stochastic eval-mode layers draw from the per-call `seed`."""
+
+    def __init__(self, exported, params: dict, meta: dict):
+        self._exported = exported
+        self._meta = meta
+        self._order: List[str] = meta["param_order"]
+        self.set_params(params)
+
+    def set_params(self, params: dict) -> None:
+        """Validated atomically: a bad set leaves the old weights."""
+        missing = [n for n in self._order if n not in params]
+        if missing:
+            raise MXNetError(f"artifact params missing {missing[:5]}")
+        new = []
+        for n in self._order:
+            v = params[n].data if isinstance(params[n], NDArray) \
+                else params[n]
+            want_s = self._meta.get("param_shapes", {}).get(n)
+            want_d = self._meta.get("param_dtypes", {}).get(n)
+            if want_s is not None and list(v.shape) != want_s:
+                raise MXNetError(
+                    f"param {n}: shape {list(v.shape)} != exported "
+                    f"{want_s}")
+            if want_d is not None and str(v.dtype) != want_d:
+                raise MXNetError(
+                    f"param {n}: dtype {v.dtype} != exported {want_d}")
+            new.append(v)
+        self._pvals = tuple(new)
+
+    def __call__(self, *inputs, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        want = self._meta["inputs"]
+        if len(inputs) != len(want):
+            raise MXNetError(
+                f"artifact takes {len(want)} inputs, got {len(inputs)}")
+        ctx = next((x.ctx for x in inputs if isinstance(x, NDArray)),
+                   None) or current_context()
+        xs = []
+        for x, w in zip(inputs, want):
+            v = x.data if isinstance(x, NDArray) else jnp.asarray(x)
+            if list(v.shape) != w["shape"]:
+                raise MXNetError(
+                    f"input shape {list(v.shape)} != exported "
+                    f"{w['shape']} (StableHLO artifacts are fixed-shape)")
+            if str(v.dtype) != w["dtype"]:
+                raise MXNetError(
+                    f"input dtype {v.dtype} != exported {w['dtype']}")
+            xs.append(v)
+        key = jax.random.PRNGKey(seed)
+        outs = self._exported.call(self._pvals, key, *xs)
+        nds = [NDArray(o, ctx=ctx) for o in outs]
+        return nds[0] if len(nds) == 1 else nds
+
+
+def import_model(path: str) -> ServedModel:
+    """Reload an artifact directory — no model code, no block class."""
+    from jax import export as jexport
+
+    from ..serialization import load_ndarrays as nd_load
+
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("format") != "mxnet_tpu.deploy/1":
+        raise MXNetError(f"not a deploy artifact: {path}")
+    with open(os.path.join(path, "model.stablehlo"), "rb") as f:
+        exported = jexport.deserialize(f.read())
+    params = nd_load(os.path.join(path, "model.params"))
+    return ServedModel(exported, params, meta)

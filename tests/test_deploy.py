@@ -1,0 +1,169 @@
+"""Portable StableHLO deployment artifacts (contrib/deploy.py).
+
+The deployment claim is 'runs without the model's Python code', so the
+central test reloads the artifact in a SUBPROCESS that never imports
+the model class — the reference's C++-predictor story
+(ref: docs/faq/smart_device.md) re-expressed as versioned StableHLO.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import deploy
+from mxnet_tpu.gluon import nn
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mlp():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8))
+        net.add(nn.Dense(4, in_units=16))
+    net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    return net
+
+
+def test_roundtrip_same_process(tmp_path):
+    net = _mlp()
+    x = nd.array(np.random.RandomState(0).rand(2, 8).astype("float32"))
+    ref = net(x).asnumpy()
+    deploy.export_model(net, str(tmp_path), [x])
+    served = deploy.import_model(str(tmp_path))
+    np.testing.assert_allclose(served(x).asnumpy(), ref, rtol=1e-6)
+    # artifact layout is the documented one
+    assert sorted(os.listdir(tmp_path)) == [
+        "meta.json", "model.params", "model.stablehlo"]
+
+
+def test_reload_in_subprocess_without_model_code(tmp_path):
+    net = _mlp()
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.rand(2, 8).astype("float32"))
+    ref = net(x).asnumpy()
+    deploy.export_model(net, str(tmp_path), [x])
+    np.save(tmp_path / "x.npy", x.asnumpy())
+    np.save(tmp_path / "ref.npy", ref)
+    script = (
+        "import os\n"
+        "os.environ['XLA_FLAGS'] = "
+        "os.environ.get('XLA_FLAGS','')\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from mxnet_tpu.contrib import deploy\n"
+        f"served = deploy.import_model({str(tmp_path)!r})\n"
+        f"x = np.load({str(tmp_path / 'x.npy')!r})\n"
+        f"ref = np.load({str(tmp_path / 'ref.npy')!r})\n"
+        "got = served(x).asnumpy()\n"
+        "np.testing.assert_allclose(got, ref, rtol=1e-6)\n"
+        "print('SUBPROCESS_SERVE_OK')\n")
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    p = subprocess.run([sys.executable, "-c", script], env=env, cwd=_REPO,
+                       capture_output=True, text=True, timeout=240)
+    assert p.returncode == 0, (p.stdout + p.stderr)[-1500:]
+    assert "SUBPROCESS_SERVE_OK" in p.stdout
+
+
+def test_param_swap_changes_output(tmp_path):
+    net = _mlp()
+    x = nd.array(np.random.RandomState(2).rand(2, 8).astype("float32"))
+    deploy.export_model(net, str(tmp_path), [x])
+    served = deploy.import_model(str(tmp_path))
+    before = served(x).asnumpy()
+    # 'further training': scale one WEIGHT (biases start at zero, where
+    # scaling is a no-op), swap the whole set in
+    params = {n: p.data() for n, p in sorted(net.collect_params().items())}
+    wname = next(n for n in sorted(params) if n.endswith("weight"))
+    params[wname] = params[wname] * 2.0
+    served.set_params(params)
+    after = served(x).asnumpy()
+    assert not np.allclose(after, before)
+
+
+def test_shape_and_arity_validation(tmp_path):
+    net = _mlp()
+    x = nd.array(np.zeros((2, 8), "float32"))
+    deploy.export_model(net, str(tmp_path), [x])
+    served = deploy.import_model(str(tmp_path))
+    with pytest.raises(MXNetError, match="fixed-shape"):
+        served(nd.array(np.zeros((3, 8), "float32")))
+    with pytest.raises(MXNetError, match="takes 1 inputs"):
+        served(x, x)
+    # a non-artifact directory is rejected up front
+    (tmp_path / "empty").mkdir()
+    (tmp_path / "empty" / "meta.json").write_text(json.dumps({}))
+    with pytest.raises(MXNetError, match="not a deploy artifact"):
+        deploy.import_model(str(tmp_path / "empty"))
+
+
+def test_resnet_block_export(tmp_path):
+    """A conv/BN model exports too (running stats are parameters of the
+    eval-mode program like any other)."""
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import BasicBlockV1
+
+    net = BasicBlockV1(8, 1, downsample=False, in_channels=8,
+                       layout="NHWC")
+    net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    x = nd.array(np.random.RandomState(3).rand(1, 8, 8, 8)
+                 .astype("float32"))
+    net(x)  # resolve shapes
+    ref = net(x).asnumpy()
+    deploy.export_model(net, str(tmp_path), [x])
+    served = deploy.import_model(str(tmp_path))
+    np.testing.assert_allclose(served(x).asnumpy(), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_deferred_init_resolved_by_export(tmp_path):
+    """export_model holds example inputs, so it resolves deferred
+    shapes itself (the CachedOp resolve-and-retry pattern)."""
+    net = nn.Dense(4)  # no in_units
+    net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    x = nd.array(np.random.RandomState(5).rand(2, 6).astype("float32"))
+    deploy.export_model(net, str(tmp_path), [x])
+    served = deploy.import_model(str(tmp_path))
+    np.testing.assert_allclose(served(x).asnumpy(), net(x).asnumpy(),
+                               rtol=1e-6)
+
+
+def test_bad_param_swap_rejected_atomically(tmp_path):
+    net = _mlp()
+    x = nd.array(np.zeros((2, 8), "float32"))
+    deploy.export_model(net, str(tmp_path), [x])
+    served = deploy.import_model(str(tmp_path))
+    good = served(x).asnumpy()
+    params = {n: p.data() for n, p in sorted(net.collect_params().items())}
+    wname = next(n for n in sorted(params) if n.endswith("weight"))
+    bad = dict(params)
+    bad[wname] = nd.zeros((3, 3))
+    with pytest.raises(MXNetError, match="shape"):
+        served.set_params(bad)
+    # the failed swap must not have clobbered the working weights
+    np.testing.assert_allclose(served(x).asnumpy(), good, rtol=0, atol=0)
+    bad[wname] = nd.zeros(params[wname].shape, dtype="int32")
+    with pytest.raises(MXNetError, match="dtype"):
+        served.set_params(bad)
+
+
+def test_input_dtype_validated(tmp_path):
+    net = _mlp()
+    x = nd.array(np.zeros((2, 8), "float32"))
+    deploy.export_model(net, str(tmp_path), [x])
+    served = deploy.import_model(str(tmp_path))
+    with pytest.raises(MXNetError, match="dtype"):
+        served(np.zeros((2, 8), "int32"))
+
+
+def test_output_ctx_follows_input(tmp_path):
+    net = _mlp()
+    x = nd.array(np.zeros((2, 8), "float32"))
+    deploy.export_model(net, str(tmp_path), [x])
+    served = deploy.import_model(str(tmp_path))
+    assert served(x).ctx == x.ctx
