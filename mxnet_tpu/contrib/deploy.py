@@ -40,10 +40,47 @@ from ..ndarray.ndarray import NDArray
 __all__ = ["export_model", "import_model", "ServedModel"]
 
 
-def export_model(block, path: str, example_inputs: Sequence) -> str:
+def _encode_tree(t):
+    """Output-pytree template -> JSON (leaves are flat indices).
+    Returns None for exotic pytree nodes — serving then falls back to
+    the flat list."""
+    if isinstance(t, dict):
+        items = {k: _encode_tree(v) for k, v in t.items()}
+        if any(v is None for v in items.values()):
+            return None
+        return {"kind": "dict", "items": items}
+    if isinstance(t, (tuple, list)):
+        items = [_encode_tree(v) for v in t]
+        if any(v is None for v in items):
+            return None
+        return {"kind": "tuple" if isinstance(t, tuple) else "list",
+                "items": items}
+    if isinstance(t, int):
+        return {"kind": "leaf", "index": t}
+    return None
+
+
+def _decode_tree(t, leaves):
+    if t["kind"] == "leaf":
+        return leaves[t["index"]]
+    if t["kind"] == "dict":
+        return {k: _decode_tree(v, leaves) for k, v in t["items"].items()}
+    items = [_decode_tree(v, leaves) for v in t["items"]]
+    return tuple(items) if t["kind"] == "tuple" else items
+
+
+def export_model(block, path: str, example_inputs: Sequence,
+                 dynamic_batch: bool = False) -> str:
     """Trace `block` (initialized; deferred shapes are resolved with
     one eager pass on `example_inputs` if needed) and write the
-    portable artifact directory.  Returns `path`."""
+    portable artifact directory.  Returns `path`.
+
+    dynamic_batch=True exports dim 0 of every input as ONE shared
+    symbolic size (jax.export shape polymorphism): the served model
+    then accepts any batch, the serving analogue of BucketingModule
+    without the buckets.  Models whose forward needs a concrete batch
+    (reshape to literal sizes, batch-dependent control flow) must keep
+    the default fixed-shape export — the tracer raises loudly."""
     import jax
     import jax.numpy as jnp
 
@@ -64,9 +101,16 @@ def export_model(block, path: str, example_inputs: Sequence) -> str:
         pvals = tuple(p.data().data for _, p in plist)
     except DeferredInitializationError:
         # we hold exactly the inputs needed to resolve deferred shapes
-        # (the CachedOp.__call__ resolve-and-retry pattern)
-        with autograd.pause():
-            block(*[NDArray(x) for x in xs])
+        # (the CachedOp.__call__ resolve-and-retry pattern, including
+        # its _active guard — without it a hybridized block would
+        # jit-compile a throwaway program just to resolve shapes)
+        was_active = getattr(block, "_active", False)
+        block._active = False
+        try:
+            with autograd.pause():
+                block(*[NDArray(x) for x in xs])
+        finally:
+            block._active = was_active
         op._pstruct = None
         plist = op._param_list()
         pvals = tuple(p.data().data for _, p in plist)
@@ -79,7 +123,18 @@ def export_model(block, path: str, example_inputs: Sequence) -> str:
 
     structs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype) for v in pvals)
     key_struct = jax.ShapeDtypeStruct((2,), jnp.uint32)
-    in_structs = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs)
+    if dynamic_batch:
+        # 0-d side-inputs (scalars) have no batch dimension to free —
+        # they stay concrete rather than being fabricated into (b,)
+        # vectors (which would surface as a misleading broadcast error)
+        (b,) = jexport.symbolic_shape("b")
+        in_structs = tuple(
+            jax.ShapeDtypeStruct((b,) + tuple(x.shape[1:]), x.dtype)
+            if x.ndim >= 1 else jax.ShapeDtypeStruct((), x.dtype)
+            for x in xs)
+    else:
+        in_structs = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
+                           for x in xs)
     exp = jexport.export(jax.jit(serve_fn))(structs, key_struct,
                                             *in_structs)
     blob = exp.serialize()
@@ -96,9 +151,19 @@ def export_model(block, path: str, example_inputs: Sequence) -> str:
         "param_order": [name for name, _ in plist],
         "param_shapes": {name: list(p.data().shape) for name, p in plist},
         "param_dtypes": {name: str(p.data().dtype) for name, p in plist},
-        "inputs": [{"shape": list(x.shape), "dtype": str(x.dtype)}
+        "inputs": [{"shape": ([None] + list(x.shape[1:]))
+                    if dynamic_batch and x.ndim >= 1
+                    else list(x.shape), "dtype": str(x.dtype)}
                    for x in xs],
+        "dynamic_batch": bool(dynamic_batch),
         "n_outputs": len(exp.out_avals),
+        # the model's output pytree (dict/tuple nesting), JSON-encoded,
+        # so serving returns the same structure the block documents —
+        # not a flat list in tree-flatten order
+        "out_tree": _encode_tree(
+            jax.tree_util.tree_unflatten(
+                op._out_treedef[False],
+                list(range(op._out_treedef[False].num_leaves)))),
     }
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
@@ -153,17 +218,33 @@ class ServedModel:
         xs = []
         for x, w in zip(inputs, want):
             v = x.data if isinstance(x, NDArray) else jnp.asarray(x)
-            if list(v.shape) != w["shape"]:
+            got_s, want_s = list(v.shape), w["shape"]
+            fixed_ok = (len(got_s) == len(want_s)
+                        and all(ws is None or gs == ws
+                                for gs, ws in zip(got_s, want_s)))
+            if not fixed_ok:
                 raise MXNetError(
-                    f"input shape {list(v.shape)} != exported "
-                    f"{w['shape']} (StableHLO artifacts are fixed-shape)")
+                    f"input shape {got_s} != exported {want_s} "
+                    "(None = free batch dim; other dims are fixed-shape "
+                    "in a StableHLO artifact)")
             if str(v.dtype) != w["dtype"]:
                 raise MXNetError(
                     f"input dtype {v.dtype} != exported {w['dtype']}")
             xs.append(v)
+        if self._meta.get("dynamic_batch"):
+            sizes = {x.shape[0] for x in xs if x.ndim >= 1}
+            if len(sizes) > 1:
+                raise MXNetError(
+                    f"dynamic-batch artifact: all inputs must share one "
+                    f"batch size, got {sorted(sizes)}")
         key = jax.random.PRNGKey(seed)
         outs = self._exported.call(self._pvals, key, *xs)
         nds = [NDArray(o, ctx=ctx) for o in outs]
+        tree = self._meta.get("out_tree")
+        if tree is not None:
+            # the structure the block's forward documents (dict/tuple
+            # nesting), not a flat list in tree-flatten order
+            return _decode_tree(tree, nds)
         return nds[0] if len(nds) == 1 else nds
 
 

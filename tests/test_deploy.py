@@ -167,3 +167,77 @@ def test_output_ctx_follows_input(tmp_path):
     deploy.export_model(net, str(tmp_path), [x])
     served = deploy.import_model(str(tmp_path))
     assert served(x).ctx == x.ctx
+
+
+def test_dynamic_batch_export(tmp_path):
+    """dynamic_batch=True serves any batch size from one artifact (the
+    serving analogue of BucketingModule), including in a fresh process."""
+    net = _mlp()
+    x8 = nd.array(np.random.RandomState(7).rand(8, 8).astype("float32"))
+    deploy.export_model(net, str(tmp_path), [x8], dynamic_batch=True)
+    served = deploy.import_model(str(tmp_path))
+    for n in (1, 3, 32):
+        xn = nd.array(np.random.RandomState(n).rand(n, 8)
+                      .astype("float32"))
+        got = served(xn).asnumpy()
+        np.testing.assert_allclose(got, net(xn).asnumpy(), rtol=1e-6)
+    # non-batch dims stay fixed
+    with pytest.raises(MXNetError, match="free batch dim"):
+        served(nd.array(np.zeros((2, 9), "float32")))
+
+
+def test_output_pytree_structure_preserved(tmp_path):
+    """A block returning a nested dict/tuple serves the SAME structure,
+    not a flat list in tree-flatten order."""
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    class _Multi(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.d = nn.Dense(4, in_units=8)
+
+        def hybrid_forward(self, F, x):
+            y = self.d(x)
+            return {"logits": y, "extras": (y * 2, y + 1)}
+
+    net = _Multi()
+    net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    x = nd.array(np.random.RandomState(9).rand(2, 8).astype("float32"))
+    ref = net(x)
+    deploy.export_model(net, str(tmp_path), [x])
+    served = deploy.import_model(str(tmp_path))
+    got = served(x)
+    assert isinstance(got, dict) and set(got) == {"logits", "extras"}
+    assert isinstance(got["extras"], tuple) and len(got["extras"]) == 2
+    np.testing.assert_allclose(got["logits"].asnumpy(),
+                               ref["logits"].asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(got["extras"][0].asnumpy(),
+                               ref["extras"][0].asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(got["extras"][1].asnumpy(),
+                               ref["extras"][1].asnumpy(), rtol=1e-6)
+
+
+def test_dynamic_batch_scalar_side_input(tmp_path):
+    """0-d side-inputs stay concrete under dynamic_batch instead of
+    being fabricated into (b,) vectors."""
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    class _Scaled(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.d = nn.Dense(4, in_units=8)
+
+        def hybrid_forward(self, F, x, s):
+            return self.d(x) * s
+
+    net = _Scaled()
+    net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    x = nd.array(np.random.RandomState(11).rand(2, 8).astype("float32"))
+    s = nd.array(np.float32(2.0))
+    deploy.export_model(net, str(tmp_path), [x, s], dynamic_batch=True)
+    served = deploy.import_model(str(tmp_path))
+    x5 = nd.array(np.random.RandomState(12).rand(5, 8).astype("float32"))
+    np.testing.assert_allclose(served(x5, s).asnumpy(),
+                               net(x5, s).asnumpy(), rtol=1e-6)
