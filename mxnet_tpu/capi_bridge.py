@@ -24,7 +24,7 @@ import numpy as np
 from .base import MXNetError
 
 __all__ = ["create", "copy_from", "copy_to", "shape_of", "dtype_of",
-           "invoke"]
+           "invoke", "deploy_load", "deploy_run"]
 
 
 def _nd():
@@ -60,6 +60,28 @@ def shape_of(arr) -> tuple:
 
 def dtype_of(arr) -> str:
     return str(arr.dtype)
+
+
+def deploy_load(path: str):
+    """Open a contrib.deploy StableHLO artifact for C-side serving —
+    the full cpp-package-predictor equivalence (ref: c_predict_api.h
+    MXPredCreate): artifact in, opaque served-model handle out."""
+    from .contrib import deploy
+
+    return deploy.import_model(path)
+
+
+def deploy_run(served, inputs: List, seed: int = 0) -> List:
+    """Run a served model on NDArray inputs; outputs FLATTENED in
+    tree-flatten order (the C ABI is a flat-array surface — structure
+    lives in the artifact's meta.json for consumers that care).  `seed`
+    feeds the per-call PRNG key, so stochastic eval-mode layers draw
+    fresh samples from C too."""
+    import jax
+
+    out = served(*inputs, seed=int(seed))
+    flat, _ = jax.tree_util.tree_flatten(out)
+    return list(flat)
 
 
 def invoke(op_name: str, inputs: List, str_attrs: Dict[str, str]) -> List:

@@ -237,6 +237,43 @@ int MXNDArrayGetDType(void* handle, char* buf, int buflen) {
   return 0;
 }
 
+}  // extern "C"
+
+namespace {
+
+// shared handle marshalling (refcount discipline lives HERE only)
+PyObject* handles_to_pylist(void** handles, int n) {
+  PyObject* pin = PyList_New(n);
+  if (pin == nullptr) {
+    set_err_from_python();
+    return nullptr;
+  }
+  for (int i = 0; i < n; ++i) {
+    PyObject* h = reinterpret_cast<PyObject*>(handles[i]);
+    Py_INCREF(h);
+    PyList_SET_ITEM(pin, i, h);
+  }
+  return pin;
+}
+
+// consumes `outs` (DECREFs it); fills up to max_outputs INCREF'd handles
+int fill_output_handles(PyObject* outs, void** outputs, int* num_outputs,
+                        int max_outputs) {
+  Py_ssize_t n = PyList_Size(outs);
+  *num_outputs = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n && i < max_outputs; ++i) {
+    PyObject* h = PyList_GET_ITEM(outs, i);
+    Py_INCREF(h);
+    outputs[i] = h;
+  }
+  Py_DECREF(outs);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
 // Imperative op invoke: attrs as parallel key/value string arrays (the
 // reference's MXImperativeInvoke param convention).  Fills up to
 // max_outputs handles; *num_outputs gets the true count.
@@ -244,13 +281,8 @@ int MXImperativeInvoke(const char* op_name, void** inputs, int num_inputs,
                        const char** keys, const char** vals, int num_params,
                        void** outputs, int* num_outputs, int max_outputs) {
   Gil gil;
-  PyObject* pin = PyList_New(num_inputs);
-  if (pin == nullptr) { set_err_from_python(); return -1; }
-  for (int i = 0; i < num_inputs; ++i) {
-    PyObject* h = reinterpret_cast<PyObject*>(inputs[i]);
-    Py_INCREF(h);
-    PyList_SET_ITEM(pin, i, h);
-  }
+  PyObject* pin = handles_to_pylist(inputs, num_inputs);
+  if (pin == nullptr) return -1;
   PyObject* pattrs = PyDict_New();
   if (pattrs == nullptr) {
     Py_DECREF(pin);
@@ -275,15 +307,48 @@ int MXImperativeInvoke(const char* op_name, void** inputs, int num_inputs,
   PyObject* outs = bridge_call("invoke", args);
   Py_DECREF(args);
   if (outs == nullptr) return -1;
-  Py_ssize_t n = PyList_Size(outs);
-  *num_outputs = static_cast<int>(n);
-  for (Py_ssize_t i = 0; i < n && i < max_outputs; ++i) {
-    PyObject* h = PyList_GET_ITEM(outs, i);
-    Py_INCREF(h);
-    outputs[i] = h;
-  }
-  Py_DECREF(outs);
+  return fill_output_handles(outs, outputs, num_outputs, max_outputs);
+}
+
+// ---- deployment artifacts (ref: c_predict_api.h MXPredCreate /
+// MXPredForward family): load a contrib.deploy StableHLO artifact and
+// serve it — NDArray handles in, NDArray handles out. ----
+
+int MXDeployLoad(const char* path, void** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", path);
+  if (args == nullptr) { set_err_from_python(); return -1; }
+  PyObject* served = bridge_call("deploy_load", args);
+  Py_DECREF(args);
+  if (served == nullptr) return -1;
+  *out = served;  // ownership to the caller
   return 0;
+}
+
+int MXDeployFree(void* handle) {
+  Gil gil;
+  Py_XDECREF(reinterpret_cast<PyObject*>(handle));
+  return 0;
+}
+
+// outputs are FLAT (tree-flatten order); *num_outputs gets the true
+// count, up to max_outputs handles are filled.  `seed` feeds the
+// per-call PRNG key (stochastic eval-mode layers draw fresh samples).
+int MXDeployRun(void* handle, void** inputs, int num_inputs,
+                uint64_t seed, void** outputs, int* num_outputs,
+                int max_outputs) {
+  Gil gil;
+  PyObject* pin = handles_to_pylist(inputs, num_inputs);
+  if (pin == nullptr) return -1;
+  PyObject* args = Py_BuildValue(
+      "(OOK)", reinterpret_cast<PyObject*>(handle), pin,
+      static_cast<unsigned long long>(seed));
+  Py_DECREF(pin);
+  if (args == nullptr) { set_err_from_python(); return -1; }
+  PyObject* outs = bridge_call("deploy_run", args);
+  Py_DECREF(args);
+  if (outs == nullptr) return -1;
+  return fill_output_handles(outs, outputs, num_outputs, max_outputs);
 }
 
 }  // extern "C"

@@ -187,3 +187,45 @@ def test_standalone_c_consumer(tmp_path):
                        timeout=240, env=env)
     assert p.returncode == 0, (p.stdout + p.stderr)[-2000:]
     assert "CAPI_CONSUMER_OK" in p.stdout
+
+
+def test_deploy_serving_from_c(tmp_path):
+    """The full cpp-package-predictor equivalence: export an artifact
+    in Python, then load and serve it through the flat C ABI
+    (MXDeployLoad/Run) — NDArray handles in, handles out."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd as mxnd
+    from mxnet_tpu.contrib import deploy
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu", in_units=4))
+        net.add(nn.Dense(3, in_units=8))
+    net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    x_np = np.random.RandomState(0).rand(2, 4).astype("float32")
+    ref = net(mxnd.array(x_np)).asnumpy()
+    deploy.export_model(net, str(tmp_path), [mxnd.array(x_np)])
+
+    lib = _capi()
+    served = ctypes.c_void_p()
+    native.capi_check(lib.MXDeployLoad(str(tmp_path).encode(),
+                                       ctypes.byref(served)))
+    h = _create(lib, (2, 4))
+    native.capi_check(lib.MXNDArraySyncCopyFromCPU(
+        h, x_np.tobytes(), ctypes.c_uint64(x_np.nbytes)))
+    outs = (ctypes.c_void_p * 4)()
+    nout = ctypes.c_int()
+    native.capi_check(lib.MXDeployRun(
+        served, (ctypes.c_void_p * 1)(h), 1, ctypes.c_uint64(0), outs,
+        ctypes.byref(nout), 4))
+    assert nout.value == 1
+    buf = ctypes.create_string_buffer(ref.nbytes)
+    native.capi_check(lib.MXNDArraySyncCopyToCPU(
+        ctypes.c_void_p(outs[0]), buf, ctypes.c_uint64(ref.nbytes)))
+    np.testing.assert_allclose(
+        np.frombuffer(buf.raw, np.float32).reshape(ref.shape), ref,
+        rtol=1e-6)
+    for hh in (ctypes.c_void_p(outs[0]), h):
+        native.capi_check(lib.MXNDArrayFree(hh))
+    native.capi_check(lib.MXDeployFree(served))
