@@ -157,6 +157,23 @@ def _specs(np, large):
         "multi_sgd_update": ((f(N[0], N[0]), f(N[0], N[0])),
                              {"lrs": (0.1,), "wds": (1e-4,),
                               "num_weights": 1}),
+        # second widening pass: masking, layout, more indexing/reduction
+        # shapes the model zoo actually hits (Dropout is excluded: the
+        # raw op takes a key the frontend threads — not harness-callable)
+        "where": ((f(B, C, H, H), f(B, C, H, H), f(B, C, H, H)), {}),
+        "tile": ((f(B, S),), {"reps": (1, 4)}),
+        "SequenceMask": ((f(S, B, U),
+                          (r.rand(B) * S).astype(np.float32)),
+                         {"use_sequence_length": True, "value": 0.0}),
+        "SwapAxis": ((f(B, S, U),), {"dim1": 0, "dim2": 1}),
+        "pick": ((f(B, N[0]),
+                  r.randint(0, N[0], (B,)).astype("float32")), {}),
+        "topk": ((f(B, N[0]),), {"k": 5, "ret_typ": "value"}),
+        "norm": ((f(B, C, H, H),), {"ord": 2}),
+        "cumsum": ((f(B, N[0]),), {"axis": 1}),
+        "sgd_update": ((f(N[1], N[0]), f(N[1], N[0])),
+                       {"lr": 0.1, "wd": 1e-4}),
+        "L2Normalization": ((f(B, U),), {"mode": "instance"}),
     }
     return sp
 
