@@ -70,7 +70,8 @@ def _decode_tree(t, leaves):
 
 
 def export_model(block, path: str, example_inputs: Sequence,
-                 dynamic_batch: bool = False) -> str:
+                 dynamic_batch: bool = False,
+                 platforms: Sequence[str] = ("cpu", "tpu")) -> str:
     """Trace `block` (initialized; deferred shapes are resolved with
     one eager pass on `example_inputs` if needed) and write the
     portable artifact directory.  Returns `path`.
@@ -121,6 +122,12 @@ def export_model(block, path: str, example_inputs: Sequence,
         flat, _aux = pure(params, inputs, key)
         return flat
 
+    # default: lowered for BOTH backends, so an artifact exported on a
+    # CPU dev box serves on the TPU host (and vice versa) — jax.export
+    # pins the lowering platform otherwise.  Pass platforms=("tpu",)
+    # to skip the dual lowering when exporting and serving on one
+    # backend.
+    platforms = list(platforms)
     structs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype) for v in pvals)
     key_struct = jax.ShapeDtypeStruct((2,), jnp.uint32)
     if dynamic_batch:
@@ -135,8 +142,29 @@ def export_model(block, path: str, example_inputs: Sequence,
     else:
         in_structs = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
                            for x in xs)
-    exp = jexport.export(jax.jit(serve_fn))(structs, key_struct,
-                                            *in_structs)
+    try:
+        exp = jexport.export(jax.jit(serve_fn), platforms=platforms)(
+            structs, key_struct, *in_structs)
+    except Exception as e:
+        # only a PLATFORM-lowering failure (a Pallas/Mosaic kernel is
+        # platform-specific) warrants the single-platform retry; any
+        # other export error re-raises untouched — retrying would
+        # double time-to-error and misattribute the failure
+        msg = str(e).lower()
+        if len(platforms) <= 1 or not any(
+                s in msg for s in ("platform", "pallas", "mosaic")):
+            raise
+        import warnings
+
+        platforms = [jax.default_backend()]
+        warnings.warn(
+            f"export_model: multi-platform lowering failed "
+            f"({type(e).__name__}); the artifact is pinned to "
+            f"{platforms[0]!r} and will NOT serve on other backends. "
+            f"Cause: {str(e).splitlines()[0][:150]}", UserWarning,
+            stacklevel=2)
+        exp = jexport.export(jax.jit(serve_fn))(structs, key_struct,
+                                                *in_structs)
     blob = exp.serialize()
 
     os.makedirs(path, exist_ok=True)
@@ -156,6 +184,7 @@ def export_model(block, path: str, example_inputs: Sequence,
                     else list(x.shape), "dtype": str(x.dtype)}
                    for x in xs],
         "dynamic_batch": bool(dynamic_batch),
+        "platforms": list(platforms),
         "n_outputs": len(exp.out_avals),
         # the model's output pytree (dict/tuple nesting), JSON-encoded,
         # so serving returns the same structure the block documents —

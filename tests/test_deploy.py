@@ -241,3 +241,53 @@ def test_dynamic_batch_scalar_side_input(tmp_path):
     x5 = nd.array(np.random.RandomState(12).rand(5, 8).astype("float32"))
     np.testing.assert_allclose(served(x5, s).asnumpy(),
                                net(x5, s).asnumpy(), rtol=1e-6)
+
+
+def test_artifact_is_multi_platform(tmp_path):
+    """Artifacts are lowered for BOTH cpu and tpu, so a model exported
+    on the dev box serves on the accelerator host (jax.export would
+    otherwise pin the lowering platform)."""
+    net = _mlp()
+    x = nd.array(np.zeros((2, 8), "float32"))
+    deploy.export_model(net, str(tmp_path), [x])
+    with open(tmp_path / "meta.json") as f:
+        meta = json.load(f)
+    assert sorted(meta["platforms"]) == ["cpu", "tpu"]
+    from jax import export as jexport
+
+    with open(tmp_path / "model.stablehlo", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    assert sorted(exported.platforms) == ["cpu", "tpu"]
+
+
+def test_single_platform_opt_out(tmp_path):
+    net = _mlp()
+    x = nd.array(np.zeros((2, 8), "float32"))
+    deploy.export_model(net, str(tmp_path), [x], platforms=("cpu",))
+    with open(tmp_path / "meta.json") as f:
+        meta = json.load(f)
+    assert meta["platforms"] == ["cpu"]
+    served = deploy.import_model(str(tmp_path))
+    assert served(x).shape == (2, 4)
+
+
+def test_non_platform_export_error_not_retried(tmp_path, monkeypatch):
+    """An export failure unrelated to platform lowering re-raises
+    directly instead of burning a second trace on the fallback."""
+    from jax import export as jexport
+
+    calls = {"n": 0}
+    real = jexport.export
+
+    def spy(*a, **k):
+        calls["n"] += 1
+        if "platforms" in k:
+            raise ValueError("symbolic dimension mismatch in reshape")
+        return real(*a, **k)
+
+    monkeypatch.setattr("jax.export.export", spy)
+    net = _mlp()
+    x = nd.array(np.zeros((2, 8), "float32"))
+    with pytest.raises(ValueError, match="symbolic dimension"):
+        deploy.export_model(net, str(tmp_path), [x])
+    assert calls["n"] == 1  # no second lowering attempt
