@@ -128,6 +128,14 @@ def export_model(block, path: str, example_inputs: Sequence,
     # to skip the dual lowering when exporting and serving on one
     # backend.
     platforms = list(platforms)
+    known = {"cpu", "tpu", "cuda", "rocm"}
+    bad = [p for p in platforms if p not in known]
+    if bad:
+        # jax.export accepts arbitrary platform strings silently (the
+        # runtime just never selects them) — a typo would produce an
+        # artifact that can never serve anywhere it claims to
+        raise MXNetError(f"unknown platform(s) {bad}; known: "
+                         f"{sorted(known)}")
     structs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype) for v in pvals)
     key_struct = jax.ShapeDtypeStruct((2,), jnp.uint32)
     if dynamic_batch:
@@ -146,21 +154,25 @@ def export_model(block, path: str, example_inputs: Sequence,
         exp = jexport.export(jax.jit(serve_fn), platforms=platforms)(
             structs, key_struct, *in_structs)
     except Exception as e:
-        # only a PLATFORM-lowering failure (a Pallas/Mosaic kernel is
-        # platform-specific) warrants the single-platform retry; any
-        # other export error re-raises untouched — retrying would
-        # double time-to-error and misattribute the failure
+        # only a platform-SPECIFIC-KERNEL lowering failure (Pallas /
+        # Mosaic) warrants the single-backend retry, and only onto a
+        # backend the caller actually requested; everything else
+        # re-raises untouched — a generic "platform" substring match
+        # would swallow argument errors (a typo'd platform name) and
+        # misattribute unrelated failures while doubling time-to-error
         msg = str(e).lower()
-        if len(platforms) <= 1 or not any(
-                s in msg for s in ("platform", "pallas", "mosaic")):
+        backend = jax.default_backend()
+        if len(platforms) <= 1 or backend not in platforms \
+                or not any(s in msg for s in ("pallas", "mosaic")):
             raise
         import warnings
 
-        platforms = [jax.default_backend()]
+        platforms = [backend]
         warnings.warn(
-            f"export_model: multi-platform lowering failed "
-            f"({type(e).__name__}); the artifact is pinned to "
-            f"{platforms[0]!r} and will NOT serve on other backends. "
+            f"export_model: multi-platform lowering failed on a "
+            f"platform-specific kernel ({type(e).__name__}); the "
+            f"artifact is pinned to {backend!r} and will NOT serve on "
+            f"other backends. "
             f"Cause: {str(e).splitlines()[0][:150]}", UserWarning,
             stacklevel=2)
         exp = jexport.export(jax.jit(serve_fn))(structs, key_struct,

@@ -291,3 +291,15 @@ def test_non_platform_export_error_not_retried(tmp_path, monkeypatch):
     with pytest.raises(ValueError, match="symbolic dimension"):
         deploy.export_model(net, str(tmp_path), [x])
     assert calls["n"] == 1  # no second lowering attempt
+
+
+def test_unknown_platform_raises_not_degrades(tmp_path):
+    """A typo'd platform name raises up front (jax.export would accept
+    the string silently and produce an artifact that can never serve
+    where it claims to)."""
+    net = _mlp()
+    x = nd.array(np.zeros((2, 8), "float32"))
+    with pytest.raises(MXNetError, match="gpux"):
+        deploy.export_model(net, str(tmp_path), [x],
+                            platforms=("cpu", "gpux"))
+    assert not (tmp_path / "model.stablehlo").exists()
