@@ -12,6 +12,16 @@ with the number of chips.
 Pattern sources: Ring Attention (Liu et al.) / blockwise-parallel
 attention; the shard_map+ppermute formulation is the idiomatic TPU one
 (collectives ride ICI neighbours on the torus).
+
+Causal-compute note (a considered non-feature): zigzag/striped chunk
+orderings that "load-balance" causal ring attention do not help THIS
+formulation — it is SPMD, every device executes the same program, and
+masked blocks are computed-then-zeroed (XLA lowers data-dependent
+skips to select, running both sides).  Reordering chunks would shuffle
+which blocks are masked without removing their FLOPs.  The real win
+would be a Pallas blockwise kernel that skips intra-block triangles;
+until that exists, causal ring attention pays ~2x the unmasked FLOPs,
+like the public blockwise-parallel baselines.
 """
 from __future__ import annotations
 
