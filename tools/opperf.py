@@ -237,34 +237,16 @@ def compare(current, against_path, fail_over, floor_us=50.0,
     return regressions, compared
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--ops", default=None,
-                    help="comma-separated subset (default: all covered)")
-    ap.add_argument("--repeat", type=int, default=5)
-    ap.add_argument("--number", type=int, default=10)
-    ap.add_argument("--large", action="store_true",
-                    help="accelerator-scale shapes (auto on non-CPU)")
-    ap.add_argument("--out", default=None)
-    ap.add_argument("--against", default=None,
-                    help="baseline OPPERF json: exit 1 if any op's jit "
-                         "column regressed past --fail-over")
-    ap.add_argument("--fail-over", type=float, default=1.0,
-                    help="allowed slowdown fraction vs --against "
-                         "(default 1.0 = 2x; sub-2x deltas are timer "
-                         "noise on the 1-core dev box)")
-    args = ap.parse_args()
 
-    import numpy as np
+
+def run_rows(names, specs, args, backend, quiet=False):
+    """Measure one row per spec name (the shared sweep body, also used
+    by the retry-confirm pass with a subset of names)."""
+    import numpy as np  # noqa: F401  (specs were built from the caller)
     import jax
     import jax.numpy as jnp
     import mxnet_tpu as mx
     from mxnet_tpu.ops.registry import get_op
-
-    backend = jax.default_backend()
-    large = args.large or backend != "cpu"
-    specs = _specs(np, large)
-    names = (args.ops.split(",") if args.ops else sorted(specs))
 
     rows = []
     for name in names:
@@ -325,7 +307,44 @@ def main():
                     row["jit_bwd_us"] = None
                     row["bwd_note"] = str(e).splitlines()[0][:80]
         rows.append(row)
-        print(json.dumps(row))
+        if not quiet:
+            print(json.dumps(row))
+    return rows
+
+
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated subset (default: all covered)")
+    ap.add_argument("--repeat", type=int, default=5)
+    ap.add_argument("--number", type=int, default=10)
+    ap.add_argument("--large", action="store_true",
+                    help="accelerator-scale shapes (auto on non-CPU)")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--against", default=None,
+                    help="baseline OPPERF json: exit 1 if any op's jit "
+                         "column regressed past --fail-over")
+    ap.add_argument("--fail-over", type=float, default=1.0,
+                    help="allowed slowdown fraction vs --against "
+                         "(default 1.0 = 2x; sub-2x deltas are timer "
+                         "noise on the 1-core dev box)")
+    ap.add_argument("--no-retry", action="store_true",
+                    help="skip the retry-confirm pass on flagged ops "
+                         "(a regression is normally only reported if "
+                         "it reproduces in a targeted re-measure)")
+    args = ap.parse_args()
+
+    import numpy as np
+    import jax
+
+    backend = jax.default_backend()
+    large = args.large or backend != "cpu"
+    specs = _specs(np, large)
+    names = (args.ops.split(",") if args.ops else sorted(specs))
+
+    rows = run_rows(names, specs, args, backend)
 
     artifact = {"when": time.strftime("%Y-%m-%d %H:%M:%S"),
                 "backend": backend, "large_shapes": large,
@@ -337,8 +356,30 @@ def main():
     if args.against:
         regressions, compared = compare(artifact, args.against,
                                         args.fail_over)
+        flagged = sorted({r["op"] for r in regressions if "op" in r})
+        retried = []
+        if flagged and not args.no_retry:
+            # retry-confirm: a concurrent process (another build step, a
+            # tunnel probe's jax import) can slow a whole stretch of the
+            # sweep 2-3x on this 1-core box.  Re-measure ONLY the
+            # flagged ops; transient contention clears, a real
+            # regression persists in both measurements.
+            retried = flagged
+            retry_rows = run_rows([n for n in names if n in flagged],
+                                  specs, args, backend, quiet=True)
+            retry_art = dict(artifact, rows=retry_rows)
+            retry_reg, _ = compare(retry_art, args.against,
+                                   args.fail_over)
+            # confirm on (op, COLUMN): fresh noise tripping a different
+            # column of the same op must not rescue the original flag
+            confirmed = {(r["op"], r["col"]) for r in retry_reg
+                         if "op" in r}
+            regressions = [r for r in regressions
+                           if "op" not in r
+                           or (r["op"], r["col"]) in confirmed]
         print(json.dumps({"against": args.against, "compared": compared,
                           "fail_over": args.fail_over,
+                          "retried": retried,
                           "regressions": regressions}))
         if any("op" in r for r in regressions):
             return 1
