@@ -64,3 +64,17 @@ def test_trainer_states_load():
     np.testing.assert_allclose(
         net(x).asnumpy(), np.array(exp["post_step_output"], np.float32),
         rtol=1e-5, atol=1e-6)
+
+
+def test_deploy_artifact_era_stability():
+    """The round-5 committed deploy artifact (versioned StableHLO +
+    .params) must keep serving byte-identical outputs in every later
+    era — the deployment analogue of the checkpoint fixtures above."""
+    from mxnet_tpu.contrib import deploy
+
+    exp = _expect()["deploy"]
+    served = deploy.import_model(os.path.join(FIX, "deploy_mlp"))
+    x = np.array(exp["input"], np.float32)
+    got = served(x).asnumpy()
+    np.testing.assert_allclose(got, np.array(exp["output"], np.float32),
+                               rtol=1e-5, atol=1e-6)

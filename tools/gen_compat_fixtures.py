@@ -27,7 +27,16 @@ def main():
     os.makedirs(FIX, exist_ok=True)
     np.random.seed(42)
     mx.random.seed(42)
+    # --only-deploy adds the round-5 deploy fixture WITHOUT regenerating
+    # the era-guarded checkpoint fixtures (their bytes are the point)
+    only_deploy = "--only-deploy" in sys.argv
     expect = {}
+    expect_path = os.path.join(FIX, "expect.json")
+    if only_deploy and os.path.exists(expect_path):
+        with open(expect_path) as f:
+            expect = json.load(f)
+    if only_deploy:
+        return _gen_deploy(np, mx, gluon, nd, expect, expect_path)
 
     # ---- symbolic checkpoint (model.save_checkpoint format) ----
     data = sym.Variable("data")
@@ -76,9 +85,31 @@ def main():
         "post_step_output": gnet(nd.array(x)).asnumpy().tolist()}
     gnet.save_parameters(os.path.join(FIX, "gluon_mlp_post_step.params"))
 
-    with open(os.path.join(FIX, "expect.json"), "w") as f:
-        json.dump(expect, f, indent=1)
+    _gen_deploy(np, mx, gluon, nd, expect,
+                os.path.join(FIX, "expect.json"))
     print(f"fixtures written to {FIX}")
+    return 0
+
+
+def _gen_deploy(np, mx, gluon, nd, expect, expect_path):
+    """Deploy artifact fixture (round 5: the versioned-StableHLO promise
+    — future rounds must keep serving THESE bytes)."""
+    from mxnet_tpu.contrib import deploy
+
+    rng = np.random.RandomState(77)
+    dnet = gluon.nn.HybridSequential(prefix="deployfix_")
+    with dnet.name_scope():
+        dnet.add(gluon.nn.Dense(8, activation="relu", in_units=6))
+        dnet.add(gluon.nn.Dense(3, in_units=8))
+    dnet.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    dx = rng.rand(2, 6).astype("float32")
+    deploy.export_model(dnet, os.path.join(FIX, "deploy_mlp"),
+                        [nd.array(dx)])
+    expect["deploy"] = {"input": dx.tolist(),
+                        "output": dnet(nd.array(dx)).asnumpy().tolist()}
+    with open(expect_path, "w") as f:
+        json.dump(expect, f, indent=1)
+    print(f"deploy fixture written to {os.path.join(FIX, 'deploy_mlp')}")
     return 0
 
 
