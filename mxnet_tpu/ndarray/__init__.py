@@ -46,9 +46,12 @@ def load(fname: str):
     return load_ndarrays(fname)
 
 
-def _scalar_or_elemwise(broadcast_op, scalar_op):
-    """ref: python/mxnet/ndarray/ndarray.py maximum/minimum — dispatch on
-    operand kinds (array/array, array/scalar, scalar/scalar)."""
+def _scalar_or_elemwise(broadcast_op, scalar_op, rscalar_op=None):
+    """ref: python/mxnet/ndarray/ndarray.py _ufunc_helper — dispatch on
+    operand kinds (array/array, array/scalar, scalar/array, scalar/
+    scalar).  `rscalar_op` is the REVERSED scalar op for non-commutative
+    functions (scalar lhs: 2 ** a must not become a ** 2); commutative
+    ops omit it and reuse `scalar_op` with the operands exchanged."""
     def fn(lhs, rhs):
         from .register import lookup
 
@@ -59,7 +62,8 @@ def _scalar_or_elemwise(broadcast_op, scalar_op):
         if l_nd:
             return lookup(scalar_op)(lhs, scalar=float(rhs))
         if r_nd:
-            return lookup(scalar_op)(rhs, scalar=float(lhs))
+            return lookup(rscalar_op or scalar_op)(rhs,
+                                                   scalar=float(lhs))
         return lookup(scalar_op)(array(_np.asarray([lhs], _np.float32)),
                                  scalar=float(rhs))
     return fn
@@ -67,6 +71,26 @@ def _scalar_or_elemwise(broadcast_op, scalar_op):
 
 maximum = _scalar_or_elemwise("broadcast_maximum", "_maximum_scalar")
 minimum = _scalar_or_elemwise("broadcast_minimum", "_minimum_scalar")
+# same operand-kind dispatch for the remaining module-level binaries the
+# reference exposes (ref: ndarray.py power/modulo + logical_* family);
+# the non-commutative pair routes a scalar LHS through the _r* ops
+power = _scalar_or_elemwise("broadcast_power", "_power_scalar",
+                            "_rpower_scalar")
+modulo = _scalar_or_elemwise("broadcast_mod", "_mod_scalar",
+                             "_rmod_scalar")
+logical_and = _scalar_or_elemwise("broadcast_logical_and",
+                                  "_logical_and_scalar")
+logical_or = _scalar_or_elemwise("broadcast_logical_or",
+                                 "_logical_or_scalar")
+logical_xor = _scalar_or_elemwise("broadcast_logical_xor",
+                                  "_logical_xor_scalar")
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None):
+    """ref: ndarray.py linspace — evenly spaced values as an NDArray."""
+    a = _np.linspace(float(start), float(stop), int(num),
+                     endpoint=bool(endpoint)).astype(dtype or "float32")
+    return array(a, ctx=ctx)
 
 
 def __getattr__(name: str):

@@ -144,6 +144,7 @@ _SCALAR_CMP = {
     "_greater_scalar": jnp.greater, "_greater_equal_scalar": jnp.greater_equal,
     "_lesser_scalar": jnp.less, "_lesser_equal_scalar": jnp.less_equal,
     "_logical_and_scalar": jnp.logical_and, "_logical_or_scalar": jnp.logical_or,
+    "_logical_xor_scalar": jnp.logical_xor,
 }
 for _name, _fn in _SCALAR_CMP.items():
     register_op(_name, differentiable=False)(

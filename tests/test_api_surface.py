@@ -90,3 +90,28 @@ def test_sampler_out_kwarg_fills_in_place():
     # mismatched explicit shape/dtype refuse instead of corrupting out
     with pytest.raises(MXNetError, match="shape"):
         mx.random.uniform(shape=(3,), out=w)
+
+
+def test_module_level_binary_and_linspace():
+    """Reference nd module-level functions added round 5: power, modulo,
+    logical_and/or/xor (array/array, array/scalar, scalar/array) and
+    linspace (ref: python/mxnet/ndarray/ndarray.py)."""
+    import numpy as np
+
+    a = mx.nd.array(np.array([[2.0, 3.0]], "f4"))
+    b = mx.nd.array(np.array([[3.0, 2.0]], "f4"))
+    np.testing.assert_allclose(mx.nd.power(a, b).asnumpy(), [[8.0, 9.0]])
+    np.testing.assert_allclose(mx.nd.power(a, 2).asnumpy(), [[4.0, 9.0]])
+    # scalar LHS of a non-commutative op must NOT operand-swap
+    np.testing.assert_allclose(mx.nd.power(2, a).asnumpy(), [[4.0, 8.0]])
+    np.testing.assert_allclose(mx.nd.modulo(a, 2).asnumpy(), [[0.0, 1.0]])
+    np.testing.assert_allclose(mx.nd.modulo(7, a).asnumpy(), [[1.0, 1.0]])
+    t = mx.nd.array(np.array([1.0, 0.0], "f4"))
+    f = mx.nd.array(np.array([1.0, 1.0], "f4"))
+    np.testing.assert_allclose(mx.nd.logical_and(t, f).asnumpy(), [1, 0])
+    np.testing.assert_allclose(mx.nd.logical_or(t, 0).asnumpy(), [1, 0])
+    np.testing.assert_allclose(mx.nd.logical_xor(t, f).asnumpy(), [0, 1])
+    ls = mx.nd.linspace(0, 1, 5)
+    np.testing.assert_allclose(ls.asnumpy(), [0, 0.25, 0.5, 0.75, 1.0])
+    ls2 = mx.nd.linspace(0, 1, 4, endpoint=False)
+    np.testing.assert_allclose(ls2.asnumpy(), [0, 0.25, 0.5, 0.75])
