@@ -20,7 +20,9 @@ from . import image
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "concatenate", "stack", "from_jax", "random", "waitall", "save",
            "load", "zeros_like", "ones_like", "sparse", "BaseSparseNDArray",
-           "CSRNDArray", "RowSparseNDArray", "cast_storage"]
+           "CSRNDArray", "RowSparseNDArray", "cast_storage", "maximum",
+           "minimum", "power", "modulo", "logical_and", "logical_or",
+           "logical_xor", "linspace"]
 
 
 def waitall():

@@ -10,7 +10,8 @@ from .symbol import Group, Symbol, Variable, load, load_json, var
 from .executor import GraphExecutor
 
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
-           "GraphExecutor", "zeros", "ones"]
+           "GraphExecutor", "zeros", "ones", "maximum", "minimum",
+           "power", "modulo", "logical_and", "logical_or", "logical_xor"]
 
 _CACHE = {}
 
@@ -27,6 +28,38 @@ def ones(shape, dtype="float32", name=None):
 
     nm = name or _s._NAMER.next("ones")
     return __getattr__("ones_like")(var(nm, shape=shape))
+
+
+def _sym_scalar_or_elemwise(broadcast_op, scalar_op, rscalar_op=None):
+    """Module-level binary with operand-kind dispatch, the symbolic twin
+    of nd's (ref: symbol.py maximum/minimum/power/_ufunc_helper).
+    `rscalar_op` handles a scalar LHS of a non-commutative function."""
+    def fn(lhs, rhs):
+        l_s = isinstance(lhs, Symbol)
+        r_s = isinstance(rhs, Symbol)
+        if l_s and r_s:
+            return __getattr__(broadcast_op)(lhs, rhs)
+        if l_s:
+            return __getattr__(scalar_op)(lhs, scalar=float(rhs))
+        if r_s:
+            return __getattr__(rscalar_op or scalar_op)(
+                rhs, scalar=float(lhs))
+        raise TypeError("at least one operand must be a Symbol")
+    return fn
+
+
+maximum = _sym_scalar_or_elemwise("broadcast_maximum", "_maximum_scalar")
+minimum = _sym_scalar_or_elemwise("broadcast_minimum", "_minimum_scalar")
+power = _sym_scalar_or_elemwise("broadcast_power", "_power_scalar",
+                                "_rpower_scalar")
+modulo = _sym_scalar_or_elemwise("broadcast_mod", "_mod_scalar",
+                                 "_rmod_scalar")
+logical_and = _sym_scalar_or_elemwise("broadcast_logical_and",
+                                      "_logical_and_scalar")
+logical_or = _sym_scalar_or_elemwise("broadcast_logical_or",
+                                     "_logical_or_scalar")
+logical_xor = _sym_scalar_or_elemwise("broadcast_logical_xor",
+                                      "_logical_xor_scalar")
 
 
 def __getattr__(name):

@@ -393,3 +393,29 @@ def test_rtc_stub_raises_at_use_not_import():
 
     with pytest.raises(mx.MXNetError, match="Pallas"):
         rtc.CudaModule("__global__ void k() {}")
+
+
+def test_sym_module_level_binaries():
+    """mx.sym.maximum/power/modulo/logical_* with symbol/scalar operand
+    dispatch (ref: python/mxnet/symbol/symbol.py module functions) —
+    evaluated through bind to pin numeric semantics incl. the
+    non-commutative scalar-LHS cases."""
+    import numpy as np
+
+    a = mx.sym.Variable("a")
+    av = np.array([[2.0, 3.0]], "f4")
+
+    def ev(s):
+        ex = s.bind(mx.cpu(), {"a": mx.nd.array(av)})
+        return ex.forward()[0].asnumpy()
+
+    np.testing.assert_allclose(ev(mx.sym.maximum(a, 2.5)), [[2.5, 3.0]])
+    np.testing.assert_allclose(ev(mx.sym.power(a, 2)), [[4.0, 9.0]])
+    np.testing.assert_allclose(ev(mx.sym.power(2, a)), [[4.0, 8.0]])
+    np.testing.assert_allclose(ev(mx.sym.modulo(7, a)), [[1.0, 1.0]])
+    b = mx.sym.Variable("a")  # same input, symbol/symbol path
+    np.testing.assert_allclose(ev(mx.sym.minimum(a, b)), av)
+    t = np.array([1.0, 0.0], "f4")
+    s = mx.sym.Variable("a")
+    ex = mx.sym.logical_xor(s, 1.0).bind(mx.cpu(), {"a": mx.nd.array(t)})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [0.0, 1.0])
