@@ -54,7 +54,7 @@ def __getattr__(name):
             "lr_scheduler", "io", "image", "symbol", "module", "parallel",
             "callback", "model", "test_utils", "engine", "runtime",
             "visualization", "recordio", "contrib", "monitor", "name", "rnn",
-            "attribute", "resource", "rtc", "kvstore_server"}
+            "attribute", "resource", "rtc", "kvstore_server", "serving"}
     if name == "sym":
         mod = importlib.import_module(".symbol", __name__)
         globals()["sym"] = mod

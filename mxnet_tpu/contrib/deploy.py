@@ -40,6 +40,23 @@ from ..ndarray.ndarray import NDArray
 __all__ = ["export_model", "import_model", "ServedModel"]
 
 
+_NT_CACHE: dict = {}
+
+
+def _namedtuple_cls(name: str, fields: tuple):
+    """One reconstructed namedtuple class per (name, fields) — field
+    access by name survives the artifact round-trip even though the
+    original class is gone."""
+    key = (name, fields)
+    cls = _NT_CACHE.get(key)
+    if cls is None:
+        import collections
+
+        cls = collections.namedtuple(name, fields)
+        _NT_CACHE[key] = cls
+    return cls
+
+
 def _encode_tree(t):
     """Output-pytree template -> JSON (leaves are flat indices).
     Returns None for exotic pytree nodes — serving then falls back to
@@ -49,6 +66,14 @@ def _encode_tree(t):
         if any(v is None for v in items.values()):
             return None
         return {"kind": "dict", "items": items}
+    if isinstance(t, tuple) and hasattr(t, "_fields"):
+        # namedtuple: a plain-tuple encoding would silently break field
+        # access by name on the serving side (ADVICE round 5)
+        items = [_encode_tree(v) for v in t]
+        if any(v is None for v in items):
+            return None
+        return {"kind": "namedtuple", "name": type(t).__name__,
+                "fields": list(t._fields), "items": items}
     if isinstance(t, (tuple, list)):
         items = [_encode_tree(v) for v in t]
         if any(v is None for v in items):
@@ -66,6 +91,10 @@ def _decode_tree(t, leaves):
     if t["kind"] == "dict":
         return {k: _decode_tree(v, leaves) for k, v in t["items"].items()}
     items = [_decode_tree(v, leaves) for v in t["items"]]
+    if t["kind"] == "namedtuple":
+        cls = _namedtuple_cls(t.get("name", "ServedOutputs"),
+                              tuple(t["fields"]))
+        return cls(*items)
     return tuple(items) if t["kind"] == "tuple" else items
 
 
@@ -188,6 +217,10 @@ def export_model(block, path: str, example_inputs: Sequence,
             {name: p.data() for name, p in plist})
     meta = {
         "format": "mxnet_tpu.deploy/1",
+        # the serializer's era: jax.export guarantees a bounded
+        # backward-compat window, so a failed deserialize years later
+        # must be distinguishable from a corrupted artifact
+        "jax_version": jax.__version__,
         "param_order": [name for name, _ in plist],
         "param_shapes": {name: list(p.data().shape) for name, p in plist},
         "param_dtypes": {name: str(p.data().dtype) for name, p in plist},
@@ -224,6 +257,31 @@ class ServedModel:
         self._meta = meta
         self._order: List[str] = meta["param_order"]
         self.set_params(params)
+
+    @property
+    def meta(self) -> dict:
+        """The artifact's meta.json (read-only view for serving)."""
+        return dict(self._meta)
+
+    @property
+    def exported(self):
+        """The deserialized jax.export.Exported program — the serving
+        layer AOT-compiles per-bucket executables from it instead of
+        paying a re-trace on every `exported.call`."""
+        return self._exported
+
+    @property
+    def param_values(self) -> tuple:
+        """Current parameter leaves in export order (device arrays)."""
+        return self._pvals
+
+    def decode_outputs(self, leaves):
+        """Rebuild the block's documented output structure from flat
+        leaves (tree-flatten order) — shared with mxnet_tpu.serving."""
+        tree = self._meta.get("out_tree")
+        if tree is not None:
+            return _decode_tree(tree, leaves)
+        return leaves[0] if len(leaves) == 1 else leaves
 
     def set_params(self, params: dict) -> None:
         """Validated atomically: a bad set leaves the old weights."""
@@ -281,12 +339,9 @@ class ServedModel:
         key = jax.random.PRNGKey(seed)
         outs = self._exported.call(self._pvals, key, *xs)
         nds = [NDArray(o, ctx=ctx) for o in outs]
-        tree = self._meta.get("out_tree")
-        if tree is not None:
-            # the structure the block's forward documents (dict/tuple
-            # nesting), not a flat list in tree-flatten order
-            return _decode_tree(tree, nds)
-        return nds[0] if len(nds) == 1 else nds
+        # the structure the block's forward documents (dict/tuple/
+        # namedtuple nesting), not a flat list in tree-flatten order
+        return self.decode_outputs(nds)
 
 
 def import_model(path: str) -> ServedModel:

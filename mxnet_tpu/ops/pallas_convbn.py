@@ -507,11 +507,16 @@ def _probe_ok(key, fn, arg_structs) -> bool:
     Budget-exhausted is deliberately NOT cached: 'never probed' must
     stay distinguishable from 'Mosaic rejected' so a later call with
     budget headroom can still probe this configuration."""
+    # the interpret flag is part of the key: interpreter-mode ok=True
+    # says nothing about Mosaic, so a later non-interpret call in the
+    # same process must re-probe instead of reusing it (ADVICE round 5)
+    interpret = get_env("MXNET_PALLAS_INTERPRET", False, bool)
+    key = (key, interpret)
     ok = _SHAPE_OK.get(key)
     if ok is None:
         import time as _time
 
-        if get_env("MXNET_PALLAS_INTERPRET", False, bool):
+        if interpret:
             ok = True  # interpreter mode has no Mosaic stage
         elif _PROBE_SPENT[0] >= _probe_budget():
             return False

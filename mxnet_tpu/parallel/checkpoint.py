@@ -51,7 +51,10 @@ def _tree_of(trainer) -> Dict[str, Any]:
     return {
         "params": dict(trainer.params),
         "opt_state": {n: tuple(s) for n, s in trainer.opt_state.items()},
-        "step": np.int64(trainer._t),
+        # 0-d array, not np.int64 scalar: orbax's StandardCheckpointer
+        # validates leaves against (int, float, np.ndarray, jax.Array)
+        # and rejects numpy scalar types
+        "step": np.asarray(trainer._t, np.int64),
     }
 
 

@@ -127,28 +127,52 @@ Event = Task
 
 
 class Counter:
-    """ref: profiler.ProfileCounter."""
+    """ref: profiler.ProfileCounter.
+
+    Thread-safe: increment/decrement are atomic read-modify-writes (the
+    serving layer bumps counters from admission, batcher, and worker
+    threads concurrently).  Trace events are only recorded while the
+    profiler is running — a hot-path counter must not grow the event
+    buffer without bound in a long-lived server process; the live value
+    itself is always maintained and readable via `.value`.
+    """
 
     def __init__(self, name: str, domain: str = "user", value: int = 0):
-        self.name, self.domain, self.value = name, domain, value
-        self._emit()
+        self.name, self.domain = name, domain
+        self._value = value
+        self._vlock = threading.Lock()
+        self._emit(value)
 
-    def _emit(self):
+    def _emit(self, v):
+        if not _running:
+            return
         with _lock:
             _events.append({"name": self.name, "ph": "C", "cat": self.domain,
                             "ts": time.perf_counter() * 1e6,
                             "pid": os.getpid(),
-                            "args": {self.name: self.value}})
+                            "args": {self.name: v}})
+
+    @property
+    def value(self):
+        return self._value
+
+    @value.setter
+    def value(self, v):
+        self.set_value(v)
 
     def set_value(self, v):
-        self.value = v
-        self._emit()
+        with self._vlock:
+            self._value = v
+        self._emit(v)
 
     def increment(self, d=1):
-        self.set_value(self.value + d)
+        with self._vlock:
+            self._value += d
+            v = self._value
+        self._emit(v)
 
     def decrement(self, d=1):
-        self.set_value(self.value - d)
+        self.increment(-d)
 
     def __iadd__(self, d):
         self.increment(d)

@@ -1,0 +1,135 @@
+"""mxnet_tpu.serving — dynamic-batching inference serving on top of the
+StableHLO deploy path (contrib/deploy.py).
+
+The deploy story ends at `ServedModel`: one Python call, one request,
+re-traced dispatch every time.  This package is the production serving
+substrate above it:
+
+  * `ModelRepository` — loads/versions multiple deploy-dir artifacts
+    (reusing `contrib.deploy.import_model`), lazily, with per-bucket
+    AOT-compiled executables and an executor cache (hit/miss counters);
+  * `DynamicBatcher` — coalesces concurrent single-sample requests into
+    padded, shape-bucketed batches so each bucket hits ONE cached
+    compiled executable instead of paying per-request Python dispatch
+    (the Julia-to-TPU lesson: whole-program XLA makes dispatch the
+    bottleneck — amortize it server-side);
+  * `InferenceServer` — threaded, stdlib-only front end with a bounded
+    admission queue, per-request deadlines, backpressure
+    (reject-with-503 semantics instead of unbounded queueing), and
+    graceful drain on shutdown;
+  * per-model metrics (QPS, p50/p99 latency, batch occupancy, queue
+    depth, rejections) through the `profiler.Counter` API plus a
+    `dumps()`-style JSON snapshot.
+
+Quick start:
+
+    from mxnet_tpu import serving
+    repo = serving.ModelRepository()
+    repo.add("mlp", "deploy_dir")            # a contrib.deploy artifact
+    server = serving.InferenceServer(
+        repo, serving.ServingConfig(max_batch_size=32,
+                                    batch_timeout_ms=2.0))
+    y = server.infer("mlp", [x])             # single blocking call
+    fut = server.submit("mlp", [x])          # concurrent path
+    print(server.dumps())                    # metrics snapshot (JSON)
+    server.shutdown(drain=True)
+
+See docs/serving.md for artifact layout, batching knobs, backpressure
+semantics, and the metrics snapshot format.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..base import MXNetError
+
+__all__ = [
+    "ServingError", "ServerOverloaded", "DeadlineExceeded",
+    "ServerClosed", "ModelNotFound", "ServingConfig", "ModelRepository",
+    "DynamicBatcher", "InferenceServer", "serve_http",
+]
+
+
+class ServingError(MXNetError):
+    """Base class for serving failures; `status` maps to HTTP."""
+
+    status = 500
+
+
+class ServerOverloaded(ServingError):
+    """Admission queue full — the 503 backpressure signal.  Clients
+    should back off and retry; the server never queues unboundedly."""
+
+    status = 503
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline expired before execution (504)."""
+
+    status = 504
+
+
+class ServerClosed(ServingError):
+    """Submitted after shutdown began (503; drain rejects new work)."""
+
+    status = 503
+
+
+class ModelNotFound(ServingError):
+    """No such model name or version in the repository (404 — a client
+    routing mistake, not a server fault)."""
+
+    status = 404
+
+
+def default_bucket_ladder(max_batch_size: int) -> List[int]:
+    """Powers of two up to max_batch_size (always included): each
+    distinct padded batch size is one compiled executable, so the
+    ladder trades compile count against padding waste."""
+    ladder, b = [], 1
+    while b < max_batch_size:
+        ladder.append(b)
+        b *= 2
+    ladder.append(max_batch_size)
+    return ladder
+
+
+@dataclass
+class ServingConfig:
+    """Batching/admission knobs (one config serves every model; the
+    bucket ladder is clamped per-model to what its artifact allows).
+
+    max_batch_size    — coalesce at most this many rows per executable
+                        launch (fixed-shape artifacts clamp this to
+                        their exported batch).
+    batch_timeout_ms  — a non-full batch launches once its oldest
+                        request has waited this long (latency bound).
+    buckets           — explicit padded-batch ladder; default is powers
+                        of two up to max_batch_size.
+    max_queue         — bound on admitted-but-incomplete requests per
+                        server; beyond it submits fail ServerOverloaded.
+    default_timeout_ms — per-request deadline when the caller gives
+                        none; None = no deadline.
+    """
+
+    max_batch_size: int = 32
+    batch_timeout_ms: float = 5.0
+    buckets: Optional[List[int]] = None
+    max_queue: int = 256
+    default_timeout_ms: Optional[float] = None
+
+    def ladder(self) -> List[int]:
+        if self.buckets:
+            lad = sorted(set(int(b) for b in self.buckets))
+            if lad[0] < 1:
+                raise ServingError(f"bucket ladder {lad}: sizes must "
+                                   f"be >= 1")
+            return lad
+        return default_bucket_ladder(self.max_batch_size)
+
+
+from .repository import ModelRepository  # noqa: E402
+from .batcher import DynamicBatcher  # noqa: E402
+from .server import InferenceServer  # noqa: E402
+from .http import serve_http  # noqa: E402

@@ -1,0 +1,323 @@
+"""DynamicBatcher: coalesce concurrent requests into padded, bucketed
+batches.
+
+One batcher per (model, version).  Requests for the same *group* —
+identical non-batch input shapes/dtypes, identical scalar side-inputs,
+identical seed — are concatenated along dim 0, padded up to the next
+bucket on the ladder, and launched through the entry's ONE cached
+executable for that bucket.  A batch launches when it is full
+(`max_batch_size` rows) or when its oldest request has waited
+`batch_timeout_ms` (the latency bound); expired deadlines are failed
+with `DeadlineExceeded` *before* launch, never silently dropped.
+
+Padding is row-wise zeros and is sliced off the outputs, which is
+exactly output-preserving for batch-major programs — the entry's
+`coalescable()` check (every output leaf leading dim = the shared
+batch) gates coalescing; non-coalescable artifacts are served one
+request per launch with exact exported shapes.
+
+Stochastic caveat: the per-launch PRNG key is shared by every row of a
+coalesced batch, so a program that actually DRAWS from it (eval-mode
+sampling layers) sees draws that depend on its row offset and bucket —
+same-seed requests coalesce (the seed is part of the group key) but
+are not bitwise-reproducible against a solo call.  Callers needing
+exact single-call reproducibility for a stochastic model should give
+the request a unique seed, which by construction never shares a launch.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+from typing import Dict, List, Optional
+
+from . import (DeadlineExceeded, ServerClosed, ServingConfig,
+               ServingError)
+
+__all__ = ["DynamicBatcher"]
+
+
+class _Request:
+    __slots__ = ("xs", "rows", "seed", "future", "deadline", "enq")
+
+    def __init__(self, xs, rows, seed, deadline):
+        self.xs, self.rows, self.seed = xs, rows, seed
+        self.deadline = deadline
+        self.future: Future = Future()
+        self.enq = time.monotonic()
+
+
+class DynamicBatcher:
+    """Background-thread batcher over one repository entry."""
+
+    def __init__(self, entry, config: Optional[ServingConfig] = None):
+        self._entry = entry
+        self._config = config or ServingConfig()
+        self._buckets = entry.allowed_buckets(self._config.ladder())
+        # an empty ladder (fixed artifact, inconsistent input dims)
+        # serves exact-shape one-request launches only: the rows cap
+        # is meaningless there, exact shape match is the bound
+        self._max_rows = min(self._config.max_batch_size,
+                             self._buckets[-1]) if self._buckets \
+            else self._config.max_batch_size
+        self._timeout_s = self._config.batch_timeout_ms / 1e3
+        self._coalesce = entry.coalescable()
+        self._fixed = entry.fixed_batch()
+        self._specs = entry.input_specs()
+        self._cv = threading.Condition()
+        # group key -> FIFO of requests (OrderedDict: oldest group first)
+        self._groups: "OrderedDict[tuple, deque]" = OrderedDict()
+        self._closing = False
+        self._drain = True
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"mx-batcher-{entry.name}-v{entry.version}")
+        self._thread.start()
+
+    # ---- submission ---------------------------------------------------
+
+    def submit(self, inputs, seed: int = 0,
+               deadline: Optional[float] = None) -> Future:
+        """Enqueue one request (inputs carry their own leading batch
+        dim; most clients send 1 row).  Returns a Future resolving to
+        the model's documented output structure (NDArray leaves)."""
+        xs, rows = self._validate(inputs)
+        req = _Request(xs, rows, int(seed), deadline)
+        key = self._group_key(xs, req.seed)
+        with self._cv:
+            if self._closing:
+                raise ServerClosed(
+                    f"model {self._entry.name!r}: server is shutting "
+                    f"down, not accepting new requests")
+            self._groups.setdefault(key, deque()).append(req)
+            self._cv.notify()
+        return req.future
+
+    def _validate(self, inputs):
+        import jax.numpy as jnp
+
+        from ..ndarray.ndarray import NDArray
+
+        specs = self._specs
+        if len(inputs) != len(specs):
+            raise ServingError(
+                f"model {self._entry.name!r} takes {len(specs)} "
+                f"inputs, got {len(inputs)}")
+        xs, rows = [], None
+        for x, w in zip(inputs, specs):
+            v = x.data if isinstance(x, NDArray) else jnp.asarray(x)
+            want = w["shape"]
+            if str(v.dtype) != w["dtype"]:
+                raise ServingError(
+                    f"input dtype {v.dtype} != exported {w['dtype']}")
+            if len(want) == 0:
+                if v.ndim != 0:
+                    raise ServingError(
+                        f"input shape {list(v.shape)} != exported "
+                        f"scalar")
+            else:
+                got = list(v.shape)
+                if len(got) != len(want) or \
+                        any(ws is not None and i > 0 and gs != ws
+                            for i, (gs, ws) in enumerate(zip(got, want))) \
+                        or (want[0] is not None and not self._coalesce
+                            and got[0] != want[0]):
+                    raise ServingError(
+                        f"input shape {got} != exported {want} "
+                        f"(dim 0 = rows; other dims are fixed)")
+                if want[0] is None or self._coalesce:
+                    # exact-shape inputs of a non-coalescable fixed
+                    # artifact may legitimately disagree on dim 0
+                    # (e.g. a lookup table beside the data batch)
+                    if rows is None:
+                        rows = got[0]
+                    elif got[0] != rows:
+                        raise ServingError(
+                            f"all batchable inputs must share the row "
+                            f"count, got {rows} and {got[0]}")
+            xs.append(v)
+        rows = 1 if rows is None else rows
+        if rows < 1:
+            raise ServingError("request must carry at least one row")
+        if rows > self._max_rows:
+            raise ServingError(
+                f"request rows {rows} > max_batch_size "
+                f"{self._max_rows}; split the request")
+        return xs, rows
+
+    def _group_key(self, xs, seed):
+        parts: List[tuple] = [("seed", seed)]
+        for v, w in zip(xs, self._specs):
+            if len(w["shape"]) == 0:
+                # scalar side-inputs must match bitwise to share a
+                # launch (they are passed once per batch)
+                parts.append(("s", str(v.dtype), v.tobytes()))
+            else:
+                parts.append(("b", str(v.dtype), tuple(v.shape[1:])))
+        return tuple(parts)
+
+    # ---- batching loop ------------------------------------------------
+
+    def _loop(self):
+        while True:
+            expired: List[_Request] = []
+            batch = None
+            with self._cv:
+                while not self._groups and not self._closing:
+                    self._cv.wait()
+                if self._closing and not self._groups:
+                    return
+                now = time.monotonic()
+                expired = self._pop_expired_locked(now)
+                batch = self._take_due_locked(now)
+                if batch is None and not expired:
+                    wake = self._next_event_locked()
+                    if wake is not None:
+                        self._cv.wait(timeout=max(wake - now, 1e-4))
+            for r in expired:
+                try:
+                    r.future.set_exception(DeadlineExceeded(
+                        f"model {self._entry.name!r}: deadline expired "
+                        f"after {(time.monotonic() - r.enq) * 1e3:.1f}ms "
+                        f"in queue"))
+                except Exception:
+                    continue  # beaten by a concurrent Future.cancel()
+                self._entry.metrics.bump("deadline_expired")
+            if batch is not None:
+                self._run_batch(*batch)
+
+    def _pop_expired_locked(self, now) -> List[_Request]:
+        out: List[_Request] = []
+        for key in list(self._groups):
+            q = self._groups[key]
+            alive = deque(r for r in q
+                          if r.deadline is None or r.deadline > now)
+            out.extend(r for r in q
+                       if r.deadline is not None and r.deadline <= now)
+            if alive:
+                self._groups[key] = alive
+            else:
+                del self._groups[key]
+        return out
+
+    def _take_due_locked(self, now):
+        for key in list(self._groups):
+            q = self._groups[key]
+            full = sum(r.rows for r in q) >= self._max_rows
+            timed_out = q and (now - q[0].enq) >= self._timeout_s
+            # one request per launch anyway -> nothing to wait for
+            if not (full or timed_out or self._closing
+                    or not self._coalesce):
+                continue
+            take, taken_rows = [], 0
+            while q and taken_rows + q[0].rows <= self._max_rows:
+                if not self._coalesce and take:
+                    break  # one request per launch
+                r = q.popleft()
+                # transition PENDING -> RUNNING; once in a launch the
+                # future can no longer be cancelled, so result/exception
+                # delivery below never hits InvalidStateError.  False
+                # means the client cancelled while queued: drop the
+                # request, don't launch its rows.
+                if not r.future.set_running_or_notify_cancel():
+                    continue
+                take.append(r)
+                taken_rows += r.rows
+            if not q:
+                del self._groups[key]
+            if take:
+                return key, take, taken_rows
+        return None
+
+    def _next_event_locked(self) -> Optional[float]:
+        """Earliest future instant the loop must act on: a group's
+        flush-due time or a request deadline."""
+        t = None
+        for q in self._groups.values():
+            cand = q[0].enq + self._timeout_s
+            t = cand if t is None else min(t, cand)
+            for r in q:
+                if r.deadline is not None:
+                    t = r.deadline if t is None else min(t, r.deadline)
+        return t
+
+    def _run_batch(self, key, reqs: List[_Request], rows: int):
+        import jax.numpy as jnp
+
+        from ..context import current_context
+        from ..ndarray.ndarray import NDArray
+
+        entry = self._entry
+        m = entry.metrics
+        try:
+            # non-coalescable programs (outputs not batch-major) run at
+            # the EXACT exported/request shape: padding rows would leak
+            # into reduced outputs (a scalar mean over 4 rows != over
+            # 3).  For a fixed-shape artifact that exact shape is the
+            # exported batch, not the request's logical row count.
+            bucket = next(b for b in self._buckets if b >= rows) \
+                if self._coalesce else (self._fixed or rows)
+            xs = []
+            for i, w in enumerate(self._specs):
+                if len(w["shape"]) == 0:
+                    xs.append(reqs[0].xs[i])
+                    continue
+                cols = [r.xs[i] for r in reqs]
+                v = cols[0] if len(cols) == 1 else \
+                    jnp.concatenate(cols, axis=0)
+                if self._coalesce and bucket > rows:
+                    pad = jnp.zeros((bucket - rows,) + tuple(v.shape[1:]),
+                                    dtype=v.dtype)
+                    v = jnp.concatenate([v, pad], axis=0)
+                xs.append(v)
+            leaves = entry.execute(bucket, xs, seed=reqs[0].seed)
+            m.bump("batches")
+            m.bump("batched_rows", rows)
+            m.bump("padded_rows", bucket)
+            ctx = current_context()
+            off = 0
+            for r in reqs:
+                if self._coalesce:
+                    cut = [NDArray(o[off:off + r.rows], ctx=ctx)
+                           for o in leaves]
+                else:
+                    cut = [NDArray(o, ctx=ctx) for o in leaves]
+                off += r.rows
+                r.future.set_result(
+                    entry.served.decode_outputs(cut))
+        except BaseException as e:  # noqa: BLE001 — fail the futures
+            for r in reqs:
+                if not r.future.done():
+                    m.bump("failed")
+                    r.future.set_exception(e)
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def pending(self) -> int:
+        with self._cv:
+            return sum(len(q) for q in self._groups.values())
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Stop admission.  drain=True completes everything already
+        queued (in-flight batches always finish); drain=False fails
+        queued requests with ServerClosed."""
+        with self._cv:
+            if self._closing:
+                self._cv.notify_all()
+            self._closing = True
+            dropped: List[_Request] = []
+            if not drain:
+                for q in self._groups.values():
+                    dropped.extend(q)
+                self._groups.clear()
+            self._cv.notify_all()
+        for r in dropped:
+            try:
+                r.future.set_exception(ServerClosed(
+                    f"model {self._entry.name!r}: server shut down "
+                    f"before this request ran"))
+            except Exception:
+                pass  # already done or concurrently cancelled
+        self._thread.join(timeout)

@@ -1,0 +1,123 @@
+"""Stdlib HTTP front end over InferenceServer (http.server, JSON body).
+
+Deliberately dependency-free: the batching, backpressure, and deadline
+machinery live in InferenceServer — this layer only maps HTTP to it,
+including the status codes the backpressure contract promises
+(503 ServerOverloaded / 504 DeadlineExceeded / 503 after shutdown /
+404 unknown model or version).
+
+    POST /v1/models/<name>:predict
+    POST /v1/models/<name>/versions/<int>:predict
+         body: {"inputs": [<nested lists>, ...],
+                "seed": 0, "timeout_ms": 250}      (seed/timeout opt.)
+         resp: {"outputs": <model's documented structure>}
+               (arrays as nested lists; namedtuples/dicts as objects)
+    GET  /v1/models    -> {"models": {name: [versions]}}
+    GET  /v1/metrics   -> the InferenceServer.metrics() snapshot
+
+Use `serve_http(server, port=0)` for an ephemeral port; the returned
+`http.server.ThreadingHTTPServer` exposes `server_address` and is torn
+down with `.shutdown()`.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import ServingError
+
+__all__ = ["serve_http"]
+
+_PREDICT = re.compile(
+    r"^/v1/models/(?P<name>[^/:]+)"
+    r"(?:/versions/(?P<version>\d+))?:predict$")
+
+
+def _jsonable(out):
+    """Model outputs -> JSON: NDArray/device arrays to nested lists,
+    namedtuples to objects (their field names survive the deploy
+    round-trip, so the HTTP surface keeps them too)."""
+    if isinstance(out, dict):
+        return {k: _jsonable(v) for k, v in out.items()}
+    if isinstance(out, tuple) and hasattr(out, "_fields"):
+        return {f: _jsonable(v) for f, v in zip(out._fields, out)}
+    if isinstance(out, (tuple, list)):
+        return [_jsonable(v) for v in out]
+    if hasattr(out, "asnumpy"):
+        return out.asnumpy().tolist()
+    return out
+
+
+def _make_handler(server):
+    import numpy as np
+
+    class Handler(BaseHTTPRequestHandler):
+        # request logging goes through metrics, not stderr spam
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass
+
+        def _send(self, status: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            if self.path == "/v1/metrics":
+                return self._send(200, server.metrics())
+            if self.path == "/v1/models":
+                return self._send(
+                    200, {"models": server.repository.models()})
+            return self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):  # noqa: N802 — http.server API
+            m = _PREDICT.match(self.path)
+            if not m:
+                return self._send(404, {"error": f"no route {self.path}"})
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                name = m.group("name")
+                version = m.group("version")
+                entry = server.repository.get(
+                    name, int(version) if version else None)
+                # admission probe BEFORE input_specs(): specs lazily
+                # import the artifact, and shedding (503) must never
+                # wait behind a cold model's multi-second import
+                server.check_admission(entry)
+                specs = entry.input_specs()
+                raw = req.get("inputs")
+                if not isinstance(raw, list) or len(raw) != len(specs):
+                    return self._send(400, {
+                        "error": f"body.inputs must be a list of "
+                                 f"{len(specs)} arrays"})
+                xs = [np.asarray(v, dtype=w["dtype"])
+                      for v, w in zip(raw, specs)]
+                # pin the version we cast against: "latest" could move
+                # under a concurrent repo.add between here and infer
+                out = server.infer(
+                    name, xs, version=entry.version,
+                    seed=int(req.get("seed", 0)),
+                    timeout_ms=req.get("timeout_ms"))
+                return self._send(200, {"outputs": _jsonable(out)})
+            except ServingError as e:
+                return self._send(e.status, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — HTTP boundary
+                return self._send(400, {"error": str(e)})
+
+    return Handler
+
+
+def serve_http(server, host: str = "127.0.0.1", port: int = 8080):
+    """Start the HTTP front end on a daemon thread; returns the
+    ThreadingHTTPServer (stop with .shutdown()).  port=0 binds an
+    ephemeral port — read it back from `server_address`."""
+    httpd = ThreadingHTTPServer((host, port), _make_handler(server))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="mx-serving-http")
+    t.start()
+    return httpd

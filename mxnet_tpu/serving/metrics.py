@@ -1,0 +1,89 @@
+"""Per-model serving metrics.
+
+Live counters ride the existing `profiler.Counter` API (so a running
+profiler sees them as chrome-trace counter lanes under the "serving"
+domain); the snapshot side is a plain dict / JSON string in the spirit
+of `profiler.dumps()` — QPS, p50/p99 latency, batch occupancy, queue
+depth, rejections, executor-cache hits.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from .. import profiler
+
+# completed-request latencies kept for percentile estimates; a bounded
+# ring so a long-lived server's memory stays flat
+_LATENCY_RING = 4096
+
+
+def _percentile(sorted_vals, q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class ModelMetrics:
+    """One model-version's serving counters + latency ring."""
+
+    COUNTERS = (
+        "requests", "completed", "failed", "rejected",
+        "deadline_expired", "batches", "batched_rows", "padded_rows",
+        "cache_hits", "cache_misses", "queue_depth",
+    )
+
+    def __init__(self, model: str, version: int):
+        self.model, self.version = model, version
+        prefix = f"serving/{model}/v{version}"
+        self._c: Dict[str, profiler.Counter] = {
+            name: profiler.Counter(f"{prefix}/{name}", domain="serving")
+            for name in self.COUNTERS}
+        self._lock = threading.Lock()
+        self._lat = deque(maxlen=_LATENCY_RING)  # (done_t, latency_s)
+        self._started = time.perf_counter()
+
+    def bump(self, name: str, d: int = 1) -> None:
+        self._c[name].increment(d)
+
+    def gauge(self, name: str, v: int) -> None:
+        self._c[name].set_value(v)
+
+    def value(self, name: str) -> int:
+        return self._c[name].value
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._lat.append((time.perf_counter(), seconds))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            lat = list(self._lat)
+        now = time.perf_counter()
+        vals = sorted(s for _, s in lat)
+        # QPS over the ring's span (a full ring measures the recent
+        # window; a part-full ring measures since startup)
+        span = (now - (lat[0][0] if len(lat) == self._lat.maxlen
+                       else self._started)) or 1e-9
+        batched = self.value("batched_rows")
+        padded = self.value("padded_rows")
+        snap = {name: self.value(name) for name in self.COUNTERS}
+        snap.update({
+            "model": self.model,
+            "version": self.version,
+            "qps": round(len(lat) / span, 3),
+            "p50_latency_ms": None if not vals else
+            round(_percentile(vals, 0.50) * 1e3, 3),
+            "p99_latency_ms": None if not vals else
+            round(_percentile(vals, 0.99) * 1e3, 3),
+            # fraction of launched rows that were real requests (the
+            # rest was bucket padding); 1.0 = no padding waste
+            "batch_occupancy": None if not padded else
+            round(batched / padded, 4),
+            "mean_batch_rows": None if not snap["batches"] else
+            round(batched / snap["batches"], 2),
+        })
+        return snap

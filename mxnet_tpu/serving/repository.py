@@ -1,0 +1,238 @@
+"""ModelRepository: versioned deploy-dir artifacts + executor cache.
+
+Loads `contrib.deploy` artifact directories lazily (import_model on
+first use), keeps multiple versions per model name, and AOT-compiles
+ONE executable per padded-batch bucket via jax.jit(...).lower().compile()
+— `Exported.call` alone re-traces on every invocation, which is exactly
+the per-request Python dispatch cost serving exists to amortize.  The
+executor cache is keyed by bucket size; hits/misses are counted (the
+shape-bucketing tests assert each bucket compiles at most once).
+
+Directory conventions:
+    repo.add("mlp", "/path/to/artifact")           # explicit, version 1
+    repo.add("mlp", "/path/to/v2", version=2)
+    repo.scan("/models")   # /models/<name>/<int-version>/meta.json
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from . import ModelNotFound, ServingError
+from .metrics import ModelMetrics
+
+__all__ = ["ModelRepository", "_ModelEntry"]
+
+
+class _ModelEntry:
+    """One (model, version): lazily imported artifact + per-bucket
+    AOT-compiled executables."""
+
+    def __init__(self, name: str, version: int, path: str):
+        self.name, self.version, self.path = name, version, path
+        self.metrics = ModelMetrics(name, version)
+        self._lock = threading.Lock()
+        self._served = None
+        self._executables: Dict[int, object] = {}
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    # ---- lazy artifact ------------------------------------------------
+
+    @property
+    def served(self):
+        """The reloaded artifact (contrib.deploy.ServedModel), imported
+        on first touch — a repository of many models only pays for the
+        ones traffic actually hits."""
+        if self._served is None:
+            with self._lock:
+                if self._served is None:
+                    from ..contrib import deploy
+
+                    self._served = deploy.import_model(self.path)
+        return self._served
+
+    @property
+    def meta(self) -> dict:
+        return self.served.meta
+
+    @property
+    def dynamic_batch(self) -> bool:
+        return bool(self.meta.get("dynamic_batch"))
+
+    def input_specs(self) -> List[dict]:
+        """meta["inputs"]: [{"shape": [...], "dtype": ...}] — shape[0]
+        is None for a dynamic-batch artifact's batchable inputs."""
+        return self.meta["inputs"]
+
+    def fixed_batch(self) -> Optional[int]:
+        """The exported batch of a fixed-shape artifact (None when
+        dynamic, or when the artifact has no batchable input)."""
+        if self.dynamic_batch:
+            return None
+        sizes = {w["shape"][0] for w in self.input_specs()
+                 if len(w["shape"]) >= 1}
+        return sizes.pop() if len(sizes) == 1 else None
+
+    def coalescable(self) -> bool:
+        """Whether requests may share a launch: every output leaf must
+        be batch-major (leading dim = the shared batch), otherwise rows
+        cannot be handed back per request."""
+        exported = self.served.exported
+        fixed = self.fixed_batch()
+        if not self.dynamic_batch and fixed is None:
+            return False  # batchable inputs disagree on dim0
+        for aval in exported.out_avals:
+            if not aval.shape:
+                return False  # scalar output: no rows to split
+            d0 = aval.shape[0]
+            if isinstance(d0, int):
+                # dynamic export: an int leading dim did not come from
+                # the symbolic batch; fixed export: must equal it
+                if self.dynamic_batch or d0 != fixed:
+                    return False
+        return True
+
+    def allowed_buckets(self, ladder: List[int]) -> List[int]:
+        """Clamp the configured ladder to what the artifact can serve:
+        a fixed-shape artifact has exactly one executable shape.  A
+        fixed artifact whose inputs disagree on dim 0 has NO padded
+        buckets at all (empty ladder) — it is still servable, one
+        request per launch at the exact exported shapes."""
+        fixed = self.fixed_batch()
+        if self.dynamic_batch:
+            return list(ladder)
+        return [] if fixed is None else [fixed]
+
+    # ---- executor cache ----------------------------------------------
+
+    def executable(self, bucket: int):
+        """The AOT-compiled executable for `bucket` padded rows
+        (compiled once; later calls hit the cache)."""
+        with self._lock:
+            fn = self._executables.get(bucket)
+            if fn is not None:
+                self.cache_hits += 1
+                self.metrics.bump("cache_hits")
+                return fn
+        compiled = self._compile(bucket)  # compile OUTSIDE the lock
+        with self._lock:
+            # a concurrent compile of the same bucket may have won;
+            # keep the first so "compiles at most once" stays true for
+            # the sequential paths the cache counters are asserted on
+            fn = self._executables.setdefault(bucket, compiled)
+            self.cache_misses += 1
+            self.metrics.bump("cache_misses")
+        return fn
+
+    def _compile(self, bucket: int):
+        import jax
+        import jax.numpy as jnp
+
+        served = self.served
+        exported = served.exported
+        if not self.dynamic_batch:
+            fixed = self.fixed_batch()
+            if fixed is not None and bucket != fixed:
+                raise ServingError(
+                    f"model {self.name!r} v{self.version}: fixed-shape "
+                    f"artifact serves batch {fixed}, not {bucket}")
+        in_structs = []
+        for w in self.input_specs():
+            shape = list(w["shape"])
+            if len(shape) >= 1:
+                shape[0] = bucket if shape[0] is None else shape[0]
+            in_structs.append(
+                jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(w["dtype"])))
+        p_structs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                          for v in served.param_values)
+        key_struct = jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+        def fn(params, key, *xs):
+            return exported.call(params, key, *xs)
+
+        return jax.jit(fn).lower(p_structs, key_struct,
+                                 *in_structs).compile()
+
+    def execute(self, bucket: int, xs, seed: int = 0) -> list:
+        """Run one padded batch through the bucket's executable;
+        returns the FLAT output leaves (tree-flatten order)."""
+        import jax
+
+        fn = self.executable(bucket)
+        key = jax.random.PRNGKey(seed)
+        outs = fn(self.served.param_values, key, *xs)
+        return list(outs)
+
+    def warmup(self, ladder: Optional[List[int]] = None) -> None:
+        """Compile ahead of traffic: the smallest allowed bucket by
+        default (first-request latency otherwise includes a compile)."""
+        buckets = self.allowed_buckets(ladder or [1])
+        self.executable(buckets[0])
+
+
+class ModelRepository:
+    """Name -> version -> _ModelEntry.  Thread-safe; lookups default to
+    the latest version."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models: Dict[str, Dict[int, _ModelEntry]] = {}
+
+    def add(self, name: str, path: str,
+            version: Optional[int] = None) -> int:
+        if not os.path.exists(os.path.join(path, "meta.json")):
+            raise ServingError(f"{path!r} is not a deploy artifact "
+                               f"directory (no meta.json)")
+        with self._lock:
+            versions = self._models.setdefault(name, {})
+            if version is None:
+                version = max(versions, default=0) + 1
+            if version in versions:
+                raise ServingError(
+                    f"model {name!r} version {version} already loaded")
+            versions[version] = _ModelEntry(name, version, path)
+        return version
+
+    def scan(self, root: str) -> List[str]:
+        """Load `root/<name>/<int-version>/` artifact dirs; returns the
+        names added.  Non-integer or artifact-less subdirs are skipped
+        (a models dir often holds stray files)."""
+        added = []
+        for name in sorted(os.listdir(root)):
+            mdir = os.path.join(root, name)
+            if not os.path.isdir(mdir):
+                continue
+            for v in sorted(os.listdir(mdir)):
+                vdir = os.path.join(mdir, v)
+                if not v.isdigit() or \
+                        not os.path.exists(os.path.join(vdir, "meta.json")):
+                    continue
+                self.add(name, vdir, version=int(v))
+                added.append(f"{name}/{v}")
+        return added
+
+    def get(self, name: str, version: Optional[int] = None) -> _ModelEntry:
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise ModelNotFound(f"unknown model {name!r}; loaded: "
+                                    f"{sorted(self._models)}")
+            if version is None:
+                version = max(versions)
+            entry = versions.get(version)
+            if entry is None:
+                raise ModelNotFound(
+                    f"model {name!r} has versions {sorted(versions)}, "
+                    f"not {version}")
+        return entry
+
+    def entries(self) -> List[_ModelEntry]:
+        with self._lock:
+            return [e for vs in self._models.values()
+                    for _, e in sorted(vs.items())]
+
+    def models(self) -> Dict[str, List[int]]:
+        with self._lock:
+            return {n: sorted(vs) for n, vs in self._models.items()}

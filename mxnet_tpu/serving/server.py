@@ -1,0 +1,181 @@
+"""InferenceServer: bounded admission + per-model batchers + metrics.
+
+Threaded and stdlib-only.  The server owns one DynamicBatcher per
+(model, version) it has seen traffic for, and an admission bound over
+EVERYTHING it has accepted but not yet completed: at `max_queue` the
+next submit fails fast with ServerOverloaded (HTTP 503 semantics) —
+load-shedding at the door beats unbounded queueing, where every request
+eventually times out after burning queue memory (the reject-don't-block
+rule every production serving stack converges on).
+
+Deadlines: a request may carry `timeout_ms` (or inherit
+`config.default_timeout_ms`); if it expires while queued the caller
+gets DeadlineExceeded (504) and the rows never launch.
+
+Shutdown: `shutdown(drain=True)` stops admission immediately, lets
+every accepted request finish, then stops the batcher threads;
+`drain=False` fails queued requests with ServerClosed.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, Optional
+
+from . import ServerClosed, ServerOverloaded, ServingConfig
+from .batcher import DynamicBatcher
+from .repository import ModelRepository
+
+__all__ = ["InferenceServer"]
+
+
+class InferenceServer:
+    def __init__(self, repository: ModelRepository,
+                 config: Optional[ServingConfig] = None):
+        self.repository = repository
+        self.config = config or ServingConfig()
+        self._lock = threading.Lock()
+        self._batchers: Dict[tuple, DynamicBatcher] = {}
+        self._pending = 0
+        self._pending_per: Dict[tuple, int] = {}
+        self._closed = False
+
+    # ---- request path -------------------------------------------------
+
+    def _admit_locked(self, m) -> None:
+        """Raise the 503-class error a submit would get right now.
+        Caller holds self._lock; touches nothing on the (possibly
+        cold, not-yet-imported) artifact."""
+        if self._closed:
+            raise ServerClosed("server is shut down")
+        if self._pending >= self.config.max_queue:
+            if m is not None:
+                m.bump("rejected")
+            raise ServerOverloaded(
+                f"admission queue full ({self._pending} pending >= "
+                f"max_queue {self.config.max_queue}); retry with "
+                f"backoff")
+
+    def check_admission(self, entry=None) -> None:
+        """Cheap advisory fail-fast for front ends: raises
+        ServerClosed/ServerOverloaded exactly as submit() would,
+        WITHOUT importing the artifact.  Call it before any
+        per-request work that needs the model (input specs, dtype
+        casts) so load-shedding stays cheap for cold models; submit()
+        still re-checks authoritatively."""
+        with self._lock:
+            self._admit_locked(entry.metrics if entry is not None
+                               else None)
+
+    def submit(self, model: str, inputs, version: Optional[int] = None,
+               seed: int = 0,
+               timeout_ms: Optional[float] = None) -> Future:
+        """Admit one request; returns a Future of the model's output
+        structure.  Raises ServerOverloaded when the admission queue is
+        full and ServerClosed after shutdown begins."""
+        entry = self.repository.get(model, version)
+        m = entry.metrics
+        key = (entry.name, entry.version)
+        # admission first, import after: rejection (closed / queue
+        # full) needs only entry.metrics, so it must fail fast rather
+        # than wait behind a cold model's multi-second artifact import
+        with self._lock:
+            self._admit_locked(m)
+            self._pending += 1
+            self._pending_per[key] = self._pending_per.get(key, 0) + 1
+            m.bump("requests")
+            m.gauge("queue_depth", self._pending_per[key])
+
+        def _release():
+            with self._lock:
+                self._pending -= 1
+                self._pending_per[key] -= 1
+                m.gauge("queue_depth", self._pending_per[key])
+
+        t0 = time.monotonic()
+        if timeout_ms is None:
+            timeout_ms = self.config.default_timeout_ms
+        deadline = None if timeout_ms is None else t0 + timeout_ms / 1e3
+
+        try:
+            entry.served  # lazy artifact import, OUTSIDE every lock:
+            # a cold model's multi-second import must not stall other
+            # models' submits (the entry has its own import lock); the
+            # request holds its admitted slot while importing
+            with self._lock:
+                # re-checked: shutdown() may have snapshotted (and
+                # closed) the batcher map between the admission check
+                # and here — a batcher born after that snapshot would
+                # never be closed and would break the drain guarantee
+                if self._closed:
+                    raise ServerClosed("server is shut down")
+                batcher = self._batchers.get(key)
+                if batcher is None:
+                    # cheap here: the artifact is already imported above
+                    batcher = DynamicBatcher(entry, self.config)
+                    self._batchers[key] = batcher
+            fut = batcher.submit(inputs, seed=seed, deadline=deadline)
+        except BaseException:
+            _release()  # admitted but never enqueued: free the slot
+            raise
+
+        def _done(f: Future):
+            _release()
+            if f.cancelled() or f.exception() is not None:
+                # deadline_expired/failed are counted at the batcher,
+                # where the cause is known
+                return
+            m.bump("completed")
+            m.observe_latency(time.monotonic() - t0)
+
+        fut.add_done_callback(_done)
+        return fut
+
+    def infer(self, model: str, inputs, version: Optional[int] = None,
+              seed: int = 0, timeout_ms: Optional[float] = None):
+        """Blocking single call (submit + result)."""
+        return self.submit(model, inputs, version=version, seed=seed,
+                           timeout_ms=timeout_ms).result()
+
+    # ---- observability ------------------------------------------------
+
+    def pending(self) -> int:
+        with self._lock:
+            return self._pending
+
+    def metrics(self) -> dict:
+        """Per-model snapshot (QPS, p50/p99 latency, occupancy, queue
+        depth, rejections, executor-cache hits) — the `dumps()`-style
+        structure documented in docs/serving.md."""
+        models = [e.metrics.snapshot() for e in self.repository.entries()]
+        return {
+            "pending": self.pending(),
+            "max_queue": self.config.max_queue,
+            "closed": self._closed,
+            "models": models,
+        }
+
+    def dumps(self, indent: Optional[int] = 1) -> str:
+        """JSON metrics snapshot (profiler.dumps analogue)."""
+        return json.dumps(self.metrics(), indent=indent)
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop admission now; drain=True completes accepted work
+        (graceful), drain=False fails it with ServerClosed."""
+        with self._lock:
+            self._closed = True
+            batchers = list(self._batchers.values())
+        for b in batchers:
+            b.close(drain=drain, timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=True)
+        return False

@@ -256,12 +256,24 @@ PyObject* handles_to_pylist(void** handles, int n) {
   return pin;
 }
 
-// consumes `outs` (DECREFs it); fills up to max_outputs INCREF'd handles
+// consumes `outs` (DECREFs it); fills exactly n INCREF'd handles.
+// n > max_outputs is an ERROR (no-truncation policy, mirroring
+// MXNDArrayGetDType): silently dropping the extra outputs would be
+// unrecoverable — re-invoking re-executes the op, with side effects
+// such as fresh PRNG draws.  *num_outputs always gets the true count,
+// so the caller can retry with a large-enough buffer.
 int fill_output_handles(PyObject* outs, void** outputs, int* num_outputs,
                         int max_outputs) {
   Py_ssize_t n = PyList_Size(outs);
   *num_outputs = static_cast<int>(n);
-  for (Py_ssize_t i = 0; i < n && i < max_outputs; ++i) {
+  if (n > max_outputs) {
+    set_err("op produced " + std::to_string(n) + " outputs, buffer has "
+            "room for " + std::to_string(max_outputs) +
+            " — retry with a larger buffer (no outputs were returned)");
+    Py_DECREF(outs);
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject* h = PyList_GET_ITEM(outs, i);
     Py_INCREF(h);
     outputs[i] = h;
@@ -275,8 +287,9 @@ int fill_output_handles(PyObject* outs, void** outputs, int* num_outputs,
 extern "C" {
 
 // Imperative op invoke: attrs as parallel key/value string arrays (the
-// reference's MXImperativeInvoke param convention).  Fills up to
-// max_outputs handles; *num_outputs gets the true count.
+// reference's MXImperativeInvoke param convention).  *num_outputs gets
+// the true count; if it exceeds max_outputs the call FAILS with no
+// handles filled (no truncation) — retry with a larger buffer.
 int MXImperativeInvoke(const char* op_name, void** inputs, int num_inputs,
                        const char** keys, const char** vals, int num_params,
                        void** outputs, int* num_outputs, int max_outputs) {
@@ -332,8 +345,10 @@ int MXDeployFree(void* handle) {
 }
 
 // outputs are FLAT (tree-flatten order); *num_outputs gets the true
-// count, up to max_outputs handles are filled.  `seed` feeds the
-// per-call PRNG key (stochastic eval-mode layers draw fresh samples).
+// count.  If that count exceeds max_outputs the call FAILS with no
+// handles filled (no truncation) — retry with a larger buffer.  `seed`
+// feeds the per-call PRNG key (stochastic eval-mode layers draw fresh
+// samples).
 int MXDeployRun(void* handle, void** inputs, int num_inputs,
                 uint64_t seed, void** outputs, int* num_outputs,
                 int max_outputs) {

@@ -111,6 +111,40 @@ def test_imperative_invoke_with_attrs():
         native.capi_check(lib.MXNDArrayFree(hh))
 
 
+def test_output_overflow_errors_instead_of_truncating():
+    """More outputs than the caller's buffer is an ERROR (with the true
+    count reported) — not a silent DECREF of the overflow: re-invoking
+    re-executes the op, so dropped results would be unrecoverable."""
+    lib = _capi()
+    h = _create(lib, (2, 3))
+    data = np.arange(6, dtype=np.float32).reshape(2, 3)
+    native.capi_check(lib.MXNDArraySyncCopyFromCPU(
+        h, data.tobytes(), ctypes.c_uint64(data.nbytes)))
+    ins = (ctypes.c_void_p * 2)(h.value, h.value)
+    keys = (ctypes.c_char_p * 1)()
+    vals = (ctypes.c_char_p * 1)()
+    outs = (ctypes.c_void_p * 1)()
+    nout = ctypes.c_int()
+    rc = lib.MXImperativeInvoke(b"elemwise_add", ins, 2, keys, vals, 0,
+                                outs, ctypes.byref(nout), 0)
+    assert rc != 0
+    assert nout.value == 1  # the true count, so the caller can resize
+    lib.MXCapiGetLastError.restype = ctypes.c_char_p
+    msg = lib.MXCapiGetLastError().decode()
+    assert "larger buffer" in msg, msg
+    # retry with room succeeds and yields the actual result
+    rc = lib.MXImperativeInvoke(b"elemwise_add", ins, 2, keys, vals, 0,
+                                outs, ctypes.byref(nout), 1)
+    assert rc == 0 and nout.value == 1
+    got = ctypes.create_string_buffer(data.nbytes)
+    native.capi_check(lib.MXNDArraySyncCopyToCPU(
+        ctypes.c_void_p(outs[0]), got, ctypes.c_uint64(data.nbytes)))
+    np.testing.assert_allclose(
+        np.frombuffer(got.raw, np.float32).reshape(2, 3), data * 2)
+    for hh in (ctypes.c_void_p(outs[0]), h):
+        native.capi_check(lib.MXNDArrayFree(hh))
+
+
 def test_error_surface_is_loud():
     lib = _capi()
     h = _create(lib, (2, 2))

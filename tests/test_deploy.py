@@ -218,6 +218,56 @@ def test_output_pytree_structure_preserved(tmp_path):
                                ref["extras"][1].asnumpy(), rtol=1e-6)
 
 
+def test_output_namedtuple_fields_preserved(tmp_path):
+    """A block returning a namedtuple serves a NAMEDTUPLE back — field
+    access by name must survive the artifact round-trip (a plain-tuple
+    encoding would break consumers silently)."""
+    import collections
+
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    Out = collections.namedtuple("Out", ["logits", "hidden"])
+
+    class _NT(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.d = nn.Dense(4, in_units=8)
+
+        def hybrid_forward(self, F, x):
+            y = self.d(x)
+            return Out(logits=y, hidden=y * 2)
+
+    net = _NT()
+    net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    x = nd.array(np.random.RandomState(21).rand(2, 8).astype("float32"))
+    ref = net(x)
+    deploy.export_model(net, str(tmp_path), [x])
+    with open(tmp_path / "meta.json") as f:
+        tree = json.load(f)["out_tree"]
+    assert tree["kind"] == "namedtuple"
+    assert tree["fields"] == ["logits", "hidden"]
+    served = deploy.import_model(str(tmp_path))
+    got = served(x)
+    assert hasattr(got, "_fields") and got._fields == ("logits", "hidden")
+    np.testing.assert_allclose(got.logits.asnumpy(),
+                               ref.logits.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(got.hidden.asnumpy(),
+                               ref.hidden.asnumpy(), rtol=1e-6)
+
+
+def test_meta_records_exporting_jax_version(tmp_path):
+    """meta.json carries the exporter's jax version so a later-era
+    deserialization failure is attributable (nightly compat test)."""
+    import jax
+
+    net = _mlp()
+    x = nd.array(np.zeros((2, 8), "float32"))
+    deploy.export_model(net, str(tmp_path), [x])
+    with open(tmp_path / "meta.json") as f:
+        assert json.load(f)["jax_version"] == jax.__version__
+
+
 def test_dynamic_batch_scalar_side_input(tmp_path):
     """0-d side-inputs stay concrete under dynamic_batch instead of
     being fabricated into (b,) vectors."""

@@ -1,0 +1,545 @@
+"""mxnet_tpu.serving — dynamic-batching inference on the StableHLO
+deploy path.
+
+The contract under test (ISSUE 1 acceptance):
+  * coalesced batches return outputs identical to sequential single
+    calls (padding is sliced off, rows are row-independent);
+  * shape-bucketing compiles each bucket AT MOST once (executor-cache
+    hit/miss counters);
+  * a full admission queue REJECTS (ServerOverloaded, 503 semantics)
+    instead of blocking or queueing unboundedly;
+  * per-request deadline expiry returns DeadlineExceeded (504);
+  * graceful drain completes everything already admitted.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, serving
+from mxnet_tpu.contrib import deploy
+from mxnet_tpu.gluon import nn
+
+
+def _mlp(seed=0):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8))
+        net.add(nn.Dense(4, in_units=16))
+    net.initialize(mx.initializer.Xavier(rnd_type="gaussian"), ctx=mx.cpu())
+    return net
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    """One dynamic-batch artifact shared module-wide (export + the
+    first compile dominate test wall-time)."""
+    d = tmp_path_factory.mktemp("serve_dyn")
+    net = _mlp()
+    x = nd.array(np.random.RandomState(0).rand(8, 8).astype("float32"))
+    deploy.export_model(net, str(d), [x], dynamic_batch=True)
+    return str(d)
+
+
+def _server(artifact, **kw):
+    repo = serving.ModelRepository()
+    repo.add("mlp", artifact)
+    cfg = serving.ServingConfig(**kw)
+    return serving.InferenceServer(repo, cfg), repo
+
+
+def test_coalesced_outputs_match_sequential_single_calls(artifact):
+    srv, repo = _server(artifact, max_batch_size=8, batch_timeout_ms=50.0)
+    served = deploy.import_model(artifact)
+    xs = [nd.array(np.random.RandomState(i + 1).rand(1, 8)
+                   .astype("float32")) for i in range(8)]
+    futs = [srv.submit("mlp", [x]) for x in xs]
+    for f, x in zip(futs, xs):
+        np.testing.assert_allclose(f.result(timeout=120).asnumpy(),
+                                   served(x).asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+    snap = srv.metrics()["models"][0]
+    # the 8 submits really shared launches (coalescing happened)
+    assert snap["completed"] == 8
+    assert snap["batches"] < 8
+    assert snap["batched_rows"] == 8
+    srv.shutdown()
+
+
+def test_requests_with_multiple_rows_coalesce_too(artifact):
+    srv, _ = _server(artifact, max_batch_size=8, batch_timeout_ms=30.0)
+    served = deploy.import_model(artifact)
+    xs = [nd.array(np.random.RandomState(10 + i).rand(n, 8)
+                   .astype("float32")) for i, n in enumerate((3, 2, 3))]
+    futs = [srv.submit("mlp", [x]) for x in xs]
+    for f, x in zip(futs, xs):
+        np.testing.assert_allclose(f.result(timeout=120).asnumpy(),
+                                   served(x).asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+    srv.shutdown()
+
+
+def test_shape_buckets_compile_at_most_once(artifact):
+    """Distinct row counts map onto the bucket ladder; each bucket
+    compiles exactly once, repeats hit the executor cache."""
+    srv, repo = _server(artifact, max_batch_size=8, batch_timeout_ms=2.0,
+                        buckets=[1, 2, 4, 8])
+    entry = repo.get("mlp")
+    for rows in (3, 4, 2, 8, 3, 2):
+        x = nd.array(np.zeros((rows, 8), "float32"))
+        srv.infer("mlp", [x], timeout_ms=120000)
+    # buckets touched: 4 (rows 3,4,3), 2 (rows 2,2), 8 (rows 8)
+    assert entry.cache_misses == 3
+    assert entry.cache_hits == 3
+    snap = srv.metrics()["models"][0]
+    assert snap["cache_misses"] == 3 and snap["cache_hits"] == 3
+    srv.shutdown()
+
+
+def test_full_admission_queue_rejects_not_blocks(artifact):
+    srv, _ = _server(artifact, max_batch_size=64,
+                     batch_timeout_ms=60000.0, max_queue=2)
+    x = nd.array(np.zeros((1, 8), "float32"))
+    f1 = srv.submit("mlp", [x])
+    f2 = srv.submit("mlp", [x])
+    t0 = time.monotonic()
+    with pytest.raises(serving.ServerOverloaded):
+        srv.submit("mlp", [x])
+    # reject-fast, not block-until-room
+    assert time.monotonic() - t0 < 5.0
+    assert srv.metrics()["models"][0]["rejected"] == 1
+    srv.shutdown(drain=True)
+    assert f1.result(timeout=120).shape == (1, 4)
+    assert f2.result(timeout=120).shape == (1, 4)
+
+
+def test_deadline_expiry_returns_timeout_error(artifact):
+    srv, _ = _server(artifact, max_batch_size=64,
+                     batch_timeout_ms=60000.0)
+    x = nd.array(np.zeros((1, 8), "float32"))
+    fut = srv.submit("mlp", [x], timeout_ms=100)
+    with pytest.raises(serving.DeadlineExceeded):
+        fut.result(timeout=30)
+    assert srv.metrics()["models"][0]["deadline_expired"] == 1
+    srv.shutdown(drain=False)
+
+
+def test_graceful_drain_completes_in_flight(artifact):
+    srv, _ = _server(artifact, max_batch_size=64,
+                     batch_timeout_ms=60000.0)
+    x = nd.array(np.zeros((2, 8), "float32"))
+    futs = [srv.submit("mlp", [x]) for _ in range(3)]
+    srv.shutdown(drain=True)  # stops admission, completes the queue
+    for f in futs:
+        assert f.result(timeout=120).shape == (2, 4)
+    with pytest.raises(serving.ServerClosed):
+        srv.submit("mlp", [x])
+    assert srv.pending() == 0
+
+
+def test_shutdown_without_drain_fails_queued_requests(artifact):
+    srv, _ = _server(artifact, max_batch_size=64,
+                     batch_timeout_ms=60000.0)
+    x = nd.array(np.zeros((1, 8), "float32"))
+    fut = srv.submit("mlp", [x])
+    srv.shutdown(drain=False)
+    with pytest.raises(serving.ServerClosed):
+        fut.result(timeout=30)
+
+
+def test_cancel_while_queued_releases_slot_and_never_launches(artifact):
+    """A client that gives up (Future.cancel) while its request is still
+    queued must free its admission slot immediately, and its rows must
+    never launch — the remaining requests complete untouched."""
+    from concurrent.futures import CancelledError
+
+    srv, _ = _server(artifact, max_batch_size=8,
+                     batch_timeout_ms=60000.0, max_queue=2)
+    x = nd.array(np.random.RandomState(40).rand(1, 8).astype("float32"))
+    f1 = srv.submit("mlp", [x])
+    f2 = srv.submit("mlp", [x])
+    with pytest.raises(serving.ServerOverloaded):
+        srv.submit("mlp", [x])  # queue is full
+    assert f1.cancel()  # still queued (huge batch timeout) -> cancellable
+    # the done-callback released f1's slot: admission reopens
+    f3 = srv.submit("mlp", [x])
+    srv.shutdown(drain=True)
+    with pytest.raises(CancelledError):
+        f1.result(timeout=0)
+    assert f2.result(timeout=120).shape == (1, 4)
+    assert f3.result(timeout=120).shape == (1, 4)
+    snap = srv.metrics()["models"][0]
+    # only the two live requests launched; the cancelled rows never did
+    assert snap["completed"] == 2 and snap["batched_rows"] == 2
+    assert srv.pending() == 0
+
+
+def test_fixed_shape_artifact_pads_to_exported_batch(tmp_path):
+    """A fixed-shape artifact serves partial batches: rows are padded
+    up to the exported batch and sliced back off."""
+    net = _mlp()
+    deploy.export_model(net, str(tmp_path),
+                        [nd.array(np.zeros((4, 8), "float32"))])
+    repo = serving.ModelRepository()
+    repo.add("fixed", str(tmp_path))
+    assert repo.get("fixed").allowed_buckets([1, 2, 4, 8]) == [4]
+    srv = serving.InferenceServer(
+        repo, serving.ServingConfig(max_batch_size=8,
+                                    batch_timeout_ms=3.0))
+    x = nd.array(np.random.RandomState(5).rand(2, 8).astype("float32"))
+    np.testing.assert_allclose(srv.infer("fixed", [x]).asnumpy(),
+                               net(x).asnumpy(), rtol=1e-5, atol=1e-6)
+    srv.shutdown()
+
+
+def test_fixed_artifact_with_disagreeing_input_dims_still_serves(tmp_path):
+    """Inputs that disagree on dim 0 (a lookup table beside the data
+    batch) mean no padded buckets exist — but the artifact must still
+    serve, one request per launch at the exact exported shapes."""
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    class _Lut(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.d = nn.Dense(4, in_units=10)
+
+        def hybrid_forward(self, F, x, table):
+            return self.d(F.dot(x, F.transpose(table)))
+
+    net = _Lut()
+    net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    x = nd.array(np.random.RandomState(50).rand(4, 8).astype("float32"))
+    table = nd.array(np.random.RandomState(51).rand(10, 8)
+                     .astype("float32"))
+    deploy.export_model(net, str(tmp_path), [x, table])
+    repo = serving.ModelRepository()
+    repo.add("lut", str(tmp_path))
+    entry = repo.get("lut")
+    assert entry.allowed_buckets([1, 2, 4]) == []
+    assert not entry.coalescable()
+    srv = serving.InferenceServer(
+        repo, serving.ServingConfig(max_batch_size=8,
+                                    batch_timeout_ms=60000.0))
+    got = srv.infer("lut", [x, table], timeout_ms=120000)
+    np.testing.assert_allclose(got.asnumpy(), net(x, table).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    srv.shutdown()
+
+
+def test_rejection_is_cheap_for_cold_models(artifact, tmp_path):
+    """Backpressure must fail fast: rejecting a submit (queue full or
+    shut down) never pays a cold model's artifact import."""
+    import shutil
+
+    shutil.copytree(artifact, tmp_path / "cold_a")
+    shutil.copytree(artifact, tmp_path / "cold_b")
+    repo = serving.ModelRepository()
+    repo.add("hot", artifact)
+    repo.add("cold_a", str(tmp_path / "cold_a"))
+    repo.add("cold_b", str(tmp_path / "cold_b"))
+    srv = serving.InferenceServer(
+        repo, serving.ServingConfig(max_batch_size=64,
+                                    batch_timeout_ms=60000.0,
+                                    max_queue=1))
+    x = nd.array(np.zeros((1, 8), "float32"))
+    fut = srv.submit("hot", [x])
+    with pytest.raises(serving.ServerOverloaded):
+        srv.submit("cold_a", [x])
+    assert repo.get("cold_a")._served is None  # rejected, not imported
+    srv.shutdown(drain=True)
+    assert fut.result(timeout=120).shape == (1, 4)
+    with pytest.raises(serving.ServerClosed):
+        srv.submit("cold_b", [x])
+    assert repo.get("cold_b")._served is None
+
+
+def test_repository_versions_and_lazy_load(artifact, tmp_path):
+    net2 = _mlp()
+    deploy.export_model(net2, str(tmp_path),
+                        [nd.array(np.zeros((2, 8), "float32"))])
+    repo = serving.ModelRepository()
+    assert repo.add("mlp", artifact) == 1
+    assert repo.add("mlp", str(tmp_path)) == 2
+    assert repo.models() == {"mlp": [1, 2]}
+    # nothing imported until traffic touches an entry
+    assert repo.get("mlp", 1)._served is None
+    assert repo.get("mlp")._served is None  # default = latest (v2)
+    assert repo.get("mlp").version == 2
+    with pytest.raises(serving.ServingError, match="versions"):
+        repo.get("mlp", 7)
+    with pytest.raises(serving.ServingError, match="unknown model"):
+        repo.get("nope")
+    # touching .served imports exactly that version's artifact
+    x = nd.array(np.random.RandomState(3).rand(2, 8).astype("float32"))
+    srv = serving.InferenceServer(
+        repo, serving.ServingConfig(max_batch_size=4,
+                                    batch_timeout_ms=2.0))
+    np.testing.assert_allclose(
+        srv.infer("mlp", [x], version=2).asnumpy(),
+        net2(x).asnumpy(), rtol=1e-5, atol=1e-6)
+    assert repo.get("mlp", 1)._served is None
+    srv.shutdown()
+
+
+def test_repository_scan_layout(artifact, tmp_path):
+    import shutil
+
+    root = tmp_path / "models"
+    shutil.copytree(artifact, root / "mlp" / "1")
+    shutil.copytree(artifact, root / "mlp" / "3")
+    (root / "mlp" / "not_a_version").mkdir()
+    (root / "stray.txt").write_text("x")
+    repo = serving.ModelRepository()
+    assert repo.scan(str(root)) == ["mlp/1", "mlp/3"]
+    assert repo.models() == {"mlp": [1, 3]}
+
+
+def test_request_validation_errors(artifact):
+    srv, _ = _server(artifact, max_batch_size=4, batch_timeout_ms=2.0)
+    with pytest.raises(serving.ServingError, match="takes 1 inputs"):
+        srv.infer("mlp", [np.zeros((1, 8), "float32"),
+                          np.zeros((1, 8), "float32")])
+    with pytest.raises(serving.ServingError, match="dtype"):
+        srv.infer("mlp", [np.zeros((1, 8), "int32")])
+    with pytest.raises(serving.ServingError, match="!= exported"):
+        srv.infer("mlp", [np.zeros((1, 9), "float32")])
+    with pytest.raises(serving.ServingError, match="split the request"):
+        srv.infer("mlp", [np.zeros((5, 8), "float32")])
+    srv.shutdown()
+
+
+def test_metrics_snapshot_shape_and_json(artifact):
+    srv, _ = _server(artifact, max_batch_size=4, batch_timeout_ms=2.0)
+    x = nd.array(np.random.RandomState(2).rand(1, 8).astype("float32"))
+    for _ in range(3):
+        srv.infer("mlp", [x])
+    snap = json.loads(srv.dumps())
+    assert snap["pending"] == 0 and snap["closed"] is False
+    (mm,) = snap["models"]
+    assert mm["model"] == "mlp" and mm["version"] == 1
+    assert mm["requests"] == 3 and mm["completed"] == 3
+    assert mm["qps"] > 0
+    assert mm["p50_latency_ms"] > 0
+    assert mm["p99_latency_ms"] >= mm["p50_latency_ms"]
+    assert 0 < mm["batch_occupancy"] <= 1.0
+    assert mm["rejected"] == 0 and mm["deadline_expired"] == 0
+    srv.shutdown()
+
+
+def test_scalar_side_inputs_must_match_to_share_a_batch(tmp_path):
+    """Scalar (0-d) side-inputs are passed once per launch, so only
+    requests with bitwise-equal scalars coalesce — and the scalar is
+    honoured per request either way."""
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    class _Scaled(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.d = nn.Dense(4, in_units=8)
+
+        def hybrid_forward(self, F, x, s):
+            return self.d(x) * s
+
+    net = _Scaled()
+    net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    x = nd.array(np.random.RandomState(11).rand(2, 8).astype("float32"))
+    s = nd.array(np.float32(2.0))
+    deploy.export_model(net, str(tmp_path), [x, s], dynamic_batch=True)
+    repo = serving.ModelRepository()
+    repo.add("scaled", str(tmp_path))
+    srv = serving.InferenceServer(
+        repo, serving.ServingConfig(max_batch_size=8,
+                                    batch_timeout_ms=30.0))
+    x1 = nd.array(np.random.RandomState(12).rand(1, 8).astype("float32"))
+    f2 = srv.submit("scaled", [x1, nd.array(np.float32(2.0))])
+    f3 = srv.submit("scaled", [x1, nd.array(np.float32(3.0))])
+    np.testing.assert_allclose(
+        f2.result(timeout=120).asnumpy(),
+        net(x1, nd.array(np.float32(2.0))).asnumpy(),
+        rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        f3.result(timeout=120).asnumpy(),
+        net(x1, nd.array(np.float32(3.0))).asnumpy(),
+        rtol=1e-5, atol=1e-6)
+    # different scalars could NOT share a launch
+    assert srv.metrics()["models"][0]["batches"] == 2
+    srv.shutdown()
+
+
+def test_non_coalescable_outputs_never_include_padding(tmp_path):
+    """A dynamic-batch program whose output is NOT batch-major (scalar
+    mean head) must run at the exact request shape: padding rows up to
+    a bucket would leak zeros into the reduction."""
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    class _MeanHead(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.d = nn.Dense(4, in_units=8)
+
+        def hybrid_forward(self, F, x):
+            return self.d(x).mean()
+
+    net = _MeanHead()
+    net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    x8 = nd.array(np.random.RandomState(30).rand(8, 8).astype("float32"))
+    deploy.export_model(net, str(tmp_path), [x8], dynamic_batch=True)
+    repo = serving.ModelRepository()
+    repo.add("mean", str(tmp_path))
+    assert not repo.get("mean").coalescable()
+    srv = serving.InferenceServer(
+        repo, serving.ServingConfig(max_batch_size=8,
+                                    batch_timeout_ms=60000.0,
+                                    buckets=[1, 2, 4, 8]))
+    # rows=3 sits between buckets 2 and 4; padding to 4 would shift the
+    # mean.  The huge batch timeout also proves non-coalescable
+    # requests launch immediately instead of waiting for a batch.
+    x = nd.array(np.random.RandomState(31).rand(3, 8).astype("float32"))
+    t0 = time.monotonic()
+    got = srv.infer("mean", [x], timeout_ms=120000)
+    assert time.monotonic() - t0 < 30.0
+    np.testing.assert_allclose(got.asnumpy(), net(x).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    srv.shutdown()
+
+
+def test_fixed_shape_non_coalescable_artifact_serves(tmp_path):
+    """A fixed-shape export of a non-batch-major program (scalar mean
+    head) must still serve: the launch shape is the artifact's exported
+    batch, not the request's logical row count (which stays 1 because
+    non-coalescable rows are never split back per request)."""
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    class _MeanHead(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.d = nn.Dense(4, in_units=8)
+
+        def hybrid_forward(self, F, x):
+            return self.d(x).mean()
+
+    net = _MeanHead()
+    net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+    x4 = nd.array(np.random.RandomState(40).rand(4, 8).astype("float32"))
+    deploy.export_model(net, str(tmp_path), [x4])  # fixed batch of 4
+    repo = serving.ModelRepository()
+    repo.add("meanfix", str(tmp_path))
+    entry = repo.get("meanfix")
+    assert entry.fixed_batch() == 4 and not entry.coalescable()
+    srv = serving.InferenceServer(
+        repo, serving.ServingConfig(max_batch_size=8,
+                                    batch_timeout_ms=60000.0))
+    got = srv.infer("meanfix", [x4], timeout_ms=120000)
+    np.testing.assert_allclose(got.asnumpy(), net(x4).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    srv.shutdown()
+
+
+def test_http_sheds_load_without_importing_cold_model(artifact, tmp_path):
+    """The HTTP layer must honour the cheap-rejection contract too: a
+    503 shed never waits behind a cold model's artifact import (the
+    admission probe runs BEFORE input_specs touches the artifact)."""
+    import shutil
+
+    shutil.copytree(artifact, tmp_path / "cold")
+    repo = serving.ModelRepository()
+    repo.add("hot", artifact)
+    repo.add("cold", str(tmp_path / "cold"))
+    srv = serving.InferenceServer(
+        repo, serving.ServingConfig(max_batch_size=64,
+                                    batch_timeout_ms=60000.0,
+                                    max_queue=1))
+    httpd = serving.serve_http(srv, port=0)
+    try:
+        port = httpd.server_address[1]
+        fut = srv.submit("hot", [nd.array(np.zeros((1, 8), "float32"))])
+        body = json.dumps(
+            {"inputs": [np.zeros((1, 8)).tolist()]}).encode()
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/cold:predict",
+                data=body), timeout=30)
+            raise AssertionError("expected HTTPError")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        assert repo.get("cold")._served is None  # shed, not imported
+        assert repo.get("cold").metrics.snapshot()["rejected"] == 1
+    finally:
+        httpd.shutdown()
+        srv.shutdown(drain=True)
+    assert fut.result(timeout=120).shape == (1, 4)
+
+
+def test_http_front_end_predict_metrics_and_503(artifact):
+    srv, _ = _server(artifact, max_batch_size=8, batch_timeout_ms=2.0)
+    httpd = serving.serve_http(srv, port=0)
+    try:
+        port = httpd.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+        x = np.random.RandomState(9).rand(1, 8).astype("float32")
+        body = json.dumps({"inputs": [x.tolist()]}).encode()
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"{base}/v1/models/mlp:predict", data=body,
+            headers={"Content-Type": "application/json"}), timeout=120)
+        out = json.loads(r.read())
+        served = deploy.import_model(artifact)
+        np.testing.assert_allclose(np.array(out["outputs"]),
+                                   served(x).asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+        r = urllib.request.urlopen(f"{base}/v1/models", timeout=30)
+        assert json.loads(r.read())["models"] == {"mlp": [1]}
+        r = urllib.request.urlopen(f"{base}/v1/metrics", timeout=30)
+        assert json.loads(r.read())["models"][0]["completed"] >= 1
+        # unknown model is a clean 404 (client routing mistake, not a
+        # server fault), not a stack trace
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/v1/models/nope:predict", data=body), timeout=30)
+            raise AssertionError("expected HTTPError")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404 and "unknown model" in \
+                json.loads(e.read())["error"]
+    finally:
+        httpd.shutdown()
+        srv.shutdown()
+    # after shutdown the server rejects (503 ServerClosed semantics)
+    with pytest.raises(serving.ServerClosed):
+        srv.submit("mlp", [np.zeros((1, 8), "float32")])
+
+
+def test_concurrent_clients_all_get_correct_rows(artifact):
+    """Closed-loop hammering from many threads: every response must be
+    the right ROW (no cross-request mixing under concurrency)."""
+    srv, _ = _server(artifact, max_batch_size=8, batch_timeout_ms=2.0)
+    served = deploy.import_model(artifact)
+    refs, errs = {}, []
+
+    def client(i):
+        rng = np.random.RandomState(100 + i)
+        try:
+            for _ in range(5):
+                x = rng.rand(1, 8).astype("float32")
+                got = srv.infer("mlp", [x]).asnumpy()
+                np.testing.assert_allclose(got, served(x).asnumpy(),
+                                           rtol=1e-5, atol=1e-6)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(240)
+    assert not errs, errs[:1]
+    snap = srv.metrics()["models"][0]
+    assert snap["completed"] == 30
+    srv.shutdown()

@@ -34,6 +34,94 @@ def test_opperf_subset():
         assert r["jit_bwd_us"] > 0
 
 
+def _load_opperf():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "opperf_under_test", os.path.join(_REPO, "tools", "opperf.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_opperf_gate_flags_baseline_present_now_missing(tmp_path):
+    """An op that regresses from working to not-running-at-all (its jit
+    column is now None) must be REPORTED by the gate, not silently
+    skipped — that's the worst regression class (ADVICE round 5)."""
+    opperf = _load_opperf()
+    base = {"backend": "cpu", "rows": [
+        {"op": "dot", "shape": "s", "jit_fwd_us": 120.0,
+         "jit_bwd_us": 150.0},
+        {"op": "exp", "shape": "s", "jit_fwd_us": 80.0,
+         "jit_bwd_us": 90.0},
+    ]}
+    bpath = tmp_path / "base.json"
+    bpath.write_text(json.dumps(base))
+    current = {"backend": "cpu", "rows": [
+        # dot's backward no longer runs; forward is fine
+        {"op": "dot", "shape": "s", "jit_fwd_us": 125.0,
+         "jit_bwd_us": None},
+        {"op": "exp", "shape": "s", "jit_fwd_us": 82.0,
+         "jit_bwd_us": 91.0},
+    ]}
+    regressions, compared = opperf.compare(current, str(bpath),
+                                           fail_over=1.0)
+    assert compared == 4  # the missing column still counts as compared
+    assert [(r["op"], r["col"], r["now_us"]) for r in regressions] == \
+        [("dot", "jit_bwd_us", None)]
+    assert "missing" in regressions[0]["note"]
+    # a real slowdown and a missing column are both reported
+    current["rows"][1]["jit_fwd_us"] = 400.0
+    regressions, _ = opperf.compare(current, str(bpath), fail_over=1.0)
+    assert {(r["op"], r["col"]) for r in regressions} == \
+        {("dot", "jit_bwd_us"), ("exp", "jit_fwd_us")}
+
+
+def test_opperf_gate_flags_baseline_row_entirely_missing(tmp_path):
+    """An op whose ROW vanished from the current sweep (spec dropped,
+    crashed before measuring) is the same working-to-not-running class
+    as a missing column — reported, never a silent skip.  A deliberate
+    subset run opts out via expect_all_baseline_rows=False."""
+    opperf = _load_opperf()
+    base = {"backend": "cpu", "rows": [
+        {"op": "dot", "shape": "s", "jit_fwd_us": 120.0,
+         "jit_bwd_us": 150.0},
+        {"op": "exp", "shape": "s", "jit_fwd_us": 80.0},
+    ]}
+    bpath = tmp_path / "base.json"
+    bpath.write_text(json.dumps(base))
+    current = {"backend": "cpu", "rows": [
+        {"op": "exp", "shape": "s", "jit_fwd_us": 82.0},
+    ]}
+    regressions, _ = opperf.compare(current, str(bpath), fail_over=1.0)
+    assert {(r["op"], r["col"]) for r in regressions} == \
+        {("dot", "jit_fwd_us"), ("dot", "jit_bwd_us")}
+    assert all(r["now_us"] is None and r["row_missing"]
+               for r in regressions)
+    regressions, _ = opperf.compare(current, str(bpath), fail_over=1.0,
+                                    expect_all_baseline_rows=False)
+    assert regressions == []
+
+
+def test_bench_serving_smoke(tmp_path):
+    """CLI smoke only: the load generator runs and emits a well-formed
+    report.  The strict batched>unbatched throughput gate lives in
+    tests/nightly/test_bench_serving.py (perf lane)."""
+    out = tmp_path / "SERVING_BENCH.json"
+    rows = _run([sys.executable, "tools/bench_serving.py", "--no-gate",
+                 "--duration", "0.4", "--repeats", "1",
+                 "--max-batch-size", "4", "--in-units", "16",
+                 "--hidden", "32", "--out-units", "8",
+                 "--out", str(out)], timeout=420)
+    report = rows[-1]
+    for mode in ("unbatched", "batched"):
+        r = report[mode]
+        assert r["qps"] > 0 and r["p50_latency_ms"] > 0
+        assert r["p99_latency_ms"] >= r["p50_latency_ms"]
+    assert report["batched"]["concurrency"] >= 8
+    assert json.loads(out.read_text()) == report
+
+
 def test_bench_all_mnist_smoke():
     rows = _run([sys.executable, "bench_all.py", "--cpu-smoke",
                  "--config", "mnist_mlp"])

@@ -7,6 +7,12 @@ Run ONCE per era and COMMIT the outputs — future rounds load these bytes
 to prove the serialization formats still read older-era files (ref:
 tests/nightly/model_backwards_compatibility_check).  Regenerating
 overwrites the era being guarded, so only do it intentionally.
+
+The deploy fixture's meta.json records the exporting jax version
+(written by contrib.deploy.export_model): jax.export's serialized-
+artifact compat window is bounded, and the nightly uses the recorded
+version to tell "regenerate the fixture" (container's jax moved past
+the window) from a real deserialization regression.
 """
 from __future__ import annotations
 

@@ -201,7 +201,7 @@ def _time_call(fn, sync, repeat, number):
 
 
 def compare(current, against_path, fail_over, floor_us=50.0,
-            min_was_us=50.0):
+            min_was_us=50.0, expect_all_baseline_rows=True):
     """Regression gate: every row in `against` that also ran now, same
     backend and shape, must not have slowed by more than `fail_over`
     (fraction) in its jit columns.
@@ -227,13 +227,44 @@ def compare(current, against_path, fail_over, floor_us=50.0,
             continue
         for col in ("jit_fwd_us", "jit_bwd_us"):
             was, now = b.get(col), row.get(col)
-            if not was or not now or was < min_was_us:
+            if not was or was < min_was_us:
                 continue
             compared += 1
+            if not now:
+                # baseline-present / now-missing: the op regressed from
+                # working to failing-to-compile-or-run — the worst kind
+                # of regression, never a skip (ADVICE round 5)
+                regressions.append(
+                    {"op": row["op"], "col": col, "was_us": was,
+                     "now_us": None,
+                     "note": "timing present in baseline but missing "
+                             "now (op no longer compiles/runs?)"})
+                continue
             if now - was > floor_us and now > was * (1.0 + fail_over):
                 regressions.append(
                     {"op": row["op"], "col": col, "was_us": was,
                      "now_us": now, "ratio": round(now / was, 2)})
+    if expect_all_baseline_rows:
+        # the complement of the loop above: a baseline op whose ROW is
+        # entirely absent from the current sweep (spec dropped, sweep
+        # crashed before reaching it) is the same working-to-not-
+        # running-at-all class as a missing column — never a skip.
+        # row_missing=True exempts these from the retry-confirm pass,
+        # which cannot re-measure an op that produced no row.
+        cur_keys = {(r["op"], r.get("shape")) for r in current["rows"]}
+        for bkey, b in base_rows.items():
+            if bkey in cur_keys:
+                continue
+            for col in ("jit_fwd_us", "jit_bwd_us"):
+                was = b.get(col)
+                if not was or was < min_was_us:
+                    continue
+                regressions.append(
+                    {"op": b["op"], "col": col, "was_us": was,
+                     "now_us": None, "row_missing": True,
+                     "note": "row present in baseline but absent from "
+                             "the current sweep (op dropped or no "
+                             "longer runs)"})
     return regressions, compared
 
 
@@ -285,10 +316,16 @@ def run_rows(names, specs, args, backend, quiet=False):
             args.repeat, args.number), 1)
 
         jfn = jax.jit(lambda *xs: op.fn(*xs, **attrs))
-        row["jit_fwd_us"] = round(_time_call(
-            lambda: jfn(*jarrs), sync, args.repeat, args.number), 1)
+        try:
+            row["jit_fwd_us"] = round(_time_call(
+                lambda: jfn(*jarrs), sync, args.repeat, args.number), 1)
+        except Exception as e:  # keep the row: a None column is the
+            # signal the regression gate reports, a crashed sweep is a
+            # silent skip of every later op
+            row["jit_fwd_us"] = None
+            row["fwd_note"] = str(e).splitlines()[0][:80]
 
-        if op.differentiable:
+        if row["jit_fwd_us"] is not None and op.differentiable:
             def scalar_fn(*xs):
                 o = op.fn(*xs, **attrs)
                 o = o[0] if isinstance(o, (list, tuple)) else o
@@ -354,8 +391,11 @@ def main():
         with open(args.out, "w") as fh:
             json.dump(artifact, fh, indent=1)
     if args.against:
-        regressions, compared = compare(artifact, args.against,
-                                        args.fail_over)
+        # --ops runs a deliberate subset: absent baseline rows are then
+        # expected, not a regression signal
+        regressions, compared = compare(
+            artifact, args.against, args.fail_over,
+            expect_all_baseline_rows=args.ops is None)
         flagged = sorted({r["op"] for r in regressions if "op" in r})
         retried = []
         if flagged and not args.no_retry:
@@ -369,13 +409,17 @@ def main():
                                   specs, args, backend, quiet=True)
             retry_art = dict(artifact, rows=retry_rows)
             retry_reg, _ = compare(retry_art, args.against,
-                                   args.fail_over)
+                                   args.fail_over,
+                                   expect_all_baseline_rows=False)
             # confirm on (op, COLUMN): fresh noise tripping a different
-            # column of the same op must not rescue the original flag
+            # column of the same op must not rescue the original flag.
+            # row_missing flags stand as-is: an op that produced no row
+            # cannot be re-measured, so the retry cannot clear it.
             confirmed = {(r["op"], r["col"]) for r in retry_reg
                          if "op" in r}
             regressions = [r for r in regressions
                            if "op" not in r
+                           or r.get("row_missing")
                            or (r["op"], r["col"]) in confirmed]
         print(json.dumps({"against": args.against, "compared": compared,
                           "fail_over": args.fail_over,
