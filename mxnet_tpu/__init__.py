@@ -25,6 +25,7 @@ from .ndarray import NDArray, waitall
 from . import autograd
 from . import random
 from . import profiler
+from . import telemetry
 from . import serialization
 from . import operator
 from . import storage
@@ -41,7 +42,7 @@ if _os.environ.get("DMLC_ROLE") == "server":
 __all__ = [
     "MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",
     "num_gpus", "num_tpus", "nd", "ndarray", "NDArray", "waitall",
-    "autograd", "random", "profiler",
+    "autograd", "random", "profiler", "telemetry",
 ]
 
 

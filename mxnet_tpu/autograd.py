@@ -52,6 +52,7 @@ class _RecordingScope:
         self._rec = recording
         self._train = training
         self._prev: Tuple[bool, bool] = (False, False)
+        self._span = None
 
     def __enter__(self):
         self._prev = (_STATE.recording, _STATE.training)
@@ -59,10 +60,24 @@ class _RecordingScope:
             _STATE.recording = self._rec
         if self._train is not None:
             _STATE.training = self._train
+        # the record() scope IS the forward pass of a training step:
+        # span it so a step trace reads data-wait/forward/backward/...
+        if self._rec and not self._prev[0]:
+            from .telemetry import instruments as _ins
+            from .telemetry import tracing as _tracing
+
+            if _tracing.active():
+                self._span = _tracing.Span(
+                    "forward", cat="training",
+                    metric=_ins.training_phase_seconds("forward")
+                    if _tracing._ENABLED else None).attach()
         return self
 
     def __exit__(self, *exc):
         _STATE.recording, _STATE.training = self._prev
+        if self._span is not None:
+            self._span.finish()
+            self._span = None
         return False
 
 
@@ -172,6 +187,20 @@ def backward(outputs, out_grads=None, retain_graph: bool = False,
     Imperative::Backward.  Grad accumulation respects each leaf's grad_req
     ('write' | 'add' | 'null').
     """
+    from .telemetry import tracing as _tracing
+
+    if not _tracing.active():
+        return _backward_impl(outputs, out_grads, retain_graph, train_mode)
+    from .telemetry import instruments as _ins
+
+    with _tracing.span("backward", cat="training",
+                       metric=_ins.training_phase_seconds("backward")
+                       if _tracing._ENABLED else None):
+        return _backward_impl(outputs, out_grads, retain_graph, train_mode)
+
+
+def _backward_impl(outputs, out_grads=None, retain_graph: bool = False,
+                   train_mode: bool = True):
     from .ndarray.ndarray import NDArray
 
     if isinstance(outputs, NDArray):

@@ -25,15 +25,31 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from typing import Callable, Optional
 
 import numpy as np
 
 from ...base import MXNetError
 from ...ndarray.ndarray import NDArray, array as nd_array
+from ...telemetry import instruments as _ins
+from ...telemetry import tracing as _tracing
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 __all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def _observe_data_wait(t0: float) -> None:
+    """Record one consumer-side wait-for-batch: the data-wait gauge +
+    histogram (when telemetry is on) and a `data-wait` span in the
+    trace (while the profiler captures).  A training step whose
+    data-wait dominates is input-bound — the first thing step-time
+    attribution must show."""
+    dt = time.perf_counter() - t0
+    if _tracing._ENABLED:
+        _ins.data_wait_seconds().observe(dt)
+        _ins.data_wait_last_seconds().set(dt)
+    _tracing.record_complete("data-wait", "data", t0, dt)
 
 
 def _stack_narrow(data):
@@ -282,7 +298,13 @@ class DataLoader:
     def __iter__(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
-                yield self._make_batch(indices)
+                if not _tracing.active():
+                    yield self._make_batch(indices)
+                    continue
+                t0 = time.perf_counter()
+                batch = self._make_batch(indices)
+                _observe_data_wait(t0)
+                yield batch
             return
         if self._worker_pool == "process":
             yield from self._process_iter()
@@ -328,6 +350,7 @@ class DataLoader:
                                                 (next(it),)))
             while pending:
                 res = pending.popleft()
+                t0 = time.perf_counter() if _tracing.active() else None
                 try:
                     out = res.get(self._timeout)
                 except BaseException:
@@ -336,6 +359,8 @@ class DataLoader:
                     pending.appendleft(res)
                     timed_out = True
                     raise
+                if t0 is not None:
+                    _observe_data_wait(t0)
                 try:
                     pending.append(pool.apply_async(_mp_make_batch,
                                                     (next(it),)))
@@ -406,6 +431,7 @@ class DataLoader:
             t.start()
         try:
             for pos in range(len(batches)):
+                t0 = time.perf_counter() if _tracing.active() else None
                 with done_cv:
                     ok = done_cv.wait_for(lambda: pos in done,
                                           timeout=self._timeout)
@@ -416,6 +442,8 @@ class DataLoader:
                     kind, payload = done.pop(pos)
                 if kind == "err":
                     raise payload
+                if t0 is not None:
+                    _observe_data_wait(t0)
                 if next_submit < len(batches):  # top up the window
                     task_q.put((next_submit, batches[next_submit]))
                     next_submit += 1

@@ -13,9 +13,19 @@ from typing import Dict, List, Optional, Union
 from .. import kvstore as kvs_mod
 from .. import optimizer as opt_mod
 from ..base import MXNetError
+from ..telemetry import instruments as _ins
+from ..telemetry import tracing as _tracing
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
+
+
+def _phase_metric(phase: str):
+    """Histogram child for a step phase — None when telemetry is off
+    (a profiler-only capture must not register zero-count phantom
+    families in the scrape registry)."""
+    return _ins.training_phase_seconds(phase) if _tracing._ENABLED \
+        else None
 
 
 class Trainer:
@@ -102,13 +112,29 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        if not _tracing.active():  # disabled: one predicate check
+            self._allreduce_grads()
+            self._update(ignore_stale_grad)
+            return
+        with _tracing.span("step", cat="training"):
+            with _tracing.span("grad-allreduce", cat="training",
+                               metric=_phase_metric("grad-allreduce")):
+                self._allreduce_grads()
+            with _tracing.span("optimizer-update", cat="training",
+                               metric=_phase_metric("optimizer-update")):
+                self._update(ignore_stale_grad)
+        if _tracing._ENABLED:
+            _ins.training_steps_total().inc()
 
     def allreduce_grads(self):
         if not self._kv_initialized:
             self._init_kvstore()
-        self._allreduce_grads()
+        if not _tracing.active():
+            self._allreduce_grads()
+            return
+        with _tracing.span("grad-allreduce", cat="training",
+                           metric=_phase_metric("grad-allreduce")):
+            self._allreduce_grads()
 
     def _allreduce_grads(self):
         if self._kvstore is None:
@@ -128,7 +154,14 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._update(ignore_stale_grad)
+        if not _tracing.active():
+            self._update(ignore_stale_grad)
+            return
+        with _tracing.span("optimizer-update", cat="training",
+                           metric=_phase_metric("optimizer-update")):
+            self._update(ignore_stale_grad)
+        if _tracing._ENABLED:
+            _ins.training_steps_total().inc()
 
     def _update(self, ignore_stale_grad: bool = False):
         if self._update_on_kvstore:

@@ -30,8 +30,13 @@ import jax
 import numpy as np
 
 from ..base import MXNetError, Registry, get_env
+from .. import profiler as _profiler
+from ..telemetry import instruments as _tinstruments
+from ..telemetry import metrics as _tmetrics
+from ..telemetry import tracing as _tracing
 
-__all__ = ["Operator", "register_op", "get_op", "list_ops", "invoke", "apply_pure"]
+__all__ = ["Operator", "register_op", "get_op", "list_ops", "invoke",
+           "apply_pure", "dispatch"]
 
 
 class Operator:
@@ -266,6 +271,41 @@ def apply_pure(name: str, *arrays, **attrs):
 # Imperative invoke (ref: MXImperativeInvokeEx → Imperative::Invoke)
 # --------------------------------------------------------------------------
 
+def _op_dispatch_child(op: Operator):
+    """Counter child cached on the Operator, keyed by the registry
+    generation — enabled dispatch pays an attribute read + int compare
+    per call, not the instruments lock; a registry clear() invalidates
+    the cache via the generation bump."""
+    gen = _tmetrics.get_registry().generation
+    cached = getattr(op, "_tel_dispatch", None)
+    if cached is not None and cached[0] == gen:
+        return cached[1]
+    child = _tinstruments.op_dispatch_total(op.name)
+    op._tel_dispatch = (gen, child)
+    return child
+
+
+def dispatch(op: Operator, attrs_key: Tuple, arrays, attrs: dict):
+    """The dispatch hot section of `invoke`.
+
+    When neither the profiler nor telemetry is active this is ONE
+    predicate check ahead of the cached-executable call — no context
+    manager, no event append, no counter touch (the overhead gate in
+    tests/test_telemetry.py holds this to the seed dispatch cost).
+    """
+    if not (_profiler._running or _tracing._ENABLED):
+        if op.no_jit:
+            return op.fn(*arrays, **attrs)
+        return jitted(op, attrs_key)(*arrays)
+    with _profiler.profile_op(op.name):
+        if op.no_jit:
+            out = op.fn(*arrays, **attrs)
+        else:
+            out = jitted(op, attrs_key)(*arrays)
+    if _tracing._ENABLED:
+        _op_dispatch_child(op).inc()
+    return out
+
 def invoke(op_name: str, *inputs, **attrs):
     """Imperative op call on NDArrays → NDArray(s).
 
@@ -275,7 +315,6 @@ def invoke(op_name: str, *inputs, **attrs):
     """
     from ..ndarray.ndarray import NDArray, wrap_outputs
     from .. import autograd as ag
-    from ..profiler import profile_op
 
     op = get_op(op_name)
     # an OPTIONAL array input (state=None, bias=None) passed by keyword
@@ -318,11 +357,7 @@ def invoke(op_name: str, *inputs, **attrs):
             arrays.append(x)
     attrs = op.validate_attrs(attrs)  # loud unknown-attr errors + coercion
     attrs_key = freeze_attrs(attrs)
-    with profile_op(op.name):
-        if op.no_jit:
-            out = op.fn(*arrays, **attrs)
-        else:
-            out = jitted(op, attrs_key)(*arrays)
+    out = dispatch(op, attrs_key, arrays, attrs)
     if _NAIVE:
         from .. import engine as _engine
 

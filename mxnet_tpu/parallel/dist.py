@@ -27,9 +27,32 @@ import jax
 import numpy as np
 
 from ..base import MXNetError
+from ..telemetry import instruments as _ins
+from ..telemetry import tracing as _tracing
 
 __all__ = ["init", "initialized", "rank", "num_workers", "barrier",
            "allreduce_nd", "allgather_np", "abort"]
+
+
+def _collective_span(opname: str):
+    """Wrap a host-blocking collective with a trace span + the
+    mx_collective_seconds{op=...} histogram.  Blocking time HERE is
+    time the training step cannot overlap — exactly what step-time
+    attribution needs broken out per collective."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not _tracing.active():
+                return fn(*args, **kwargs)
+            with _tracing.span(opname, cat="collective",
+                               metric=_ins.collective_seconds(opname)
+                               if _tracing._ENABLED else None):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 
 def abort(reason: str = "", code: int = 1) -> "None":
@@ -202,6 +225,7 @@ def num_workers() -> int:
     return jax.process_count()
 
 
+@_collective_span("barrier")
 def barrier(name: str = "mxnet_tpu_barrier",
             timeout: Optional[float] = None) -> None:
     """Block until every worker arrives (ref: Postoffice::Barrier).
@@ -217,6 +241,7 @@ def barrier(name: str = "mxnet_tpu_barrier",
         f"barrier:{name}")
 
 
+@_collective_span("allgather")
 def allgather_np(value: np.ndarray,
                  timeout: Optional[float] = None) -> np.ndarray:
     """Gather a host numpy value from every process -> stacked [n, ...]."""
@@ -287,6 +312,7 @@ def _allreduce_device(x, timeout: Optional[float] = None):
     return _run_with_watchdog(_go, timeout, "allreduce")
 
 
+@_collective_span("allreduce")
 def allreduce_nd(val, timeout: Optional[float] = None):
     """Sum an NDArray across processes over DCN (eager path used by
     KVStore('dist_*'); the SPMD path does this in-graph instead).

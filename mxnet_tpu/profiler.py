@@ -22,9 +22,13 @@ from .base import get_env
 __all__ = [
     "set_config", "start", "stop", "dump", "dumps", "profile_op",
     "Task", "Event", "Counter", "scope", "start_xla_trace", "stop_xla_trace",
+    "append_event", "instant", "num_events",
 ]
 
 _lock = threading.Lock()
+_dump_lock = threading.Lock()  # serializes dump(): two concurrent
+# finished=True dumps must not each clear their snapshot's prefix
+# (events recorded between the snapshots would vanish from both files)
 _config = {
     "profile_all": False,
     "profile_symbolic": True,
@@ -41,6 +45,13 @@ _xla_trace_dir: Optional[str] = None
 
 
 def set_config(**kwargs):
+    """Set profiler config knobs; unknown keys raise (a typo like
+    ``profile_memroy`` must fail loudly, not silently no-op)."""
+    unknown = set(kwargs) - set(_config)
+    if unknown:
+        raise ValueError(
+            f"profiler.set_config: unknown key(s) "
+            f"{sorted(unknown)}; valid keys: {sorted(_config)}")
     _config.update(kwargs)
 
 
@@ -56,6 +67,33 @@ def stop():
 
 def is_running() -> bool:
     return _running
+
+
+def append_event(ev: dict) -> bool:
+    """Append one raw chrome-trace event while the profiler is running
+    (the hook the telemetry tracing layer emits spans through).
+    Returns whether the event was recorded."""
+    if not _running:
+        return False
+    with _lock:
+        _events.append(ev)
+    return True
+
+
+def num_events() -> int:
+    with _lock:
+        return len(_events)
+
+
+def instant(name: str, domain: str = "user",
+            args: Optional[dict] = None) -> bool:
+    """Record an instant marker (chrome ``"ph": "i"``, thread scope)."""
+    ev = {"name": name, "ph": "i", "s": "t", "cat": domain,
+          "ts": time.perf_counter() * 1e6, "pid": os.getpid(),
+          "tid": threading.get_ident()}
+    if args:
+        ev["args"] = args
+    return append_event(ev)
 
 
 if get_env("MXNET_PROFILER_AUTOSTART", 0, int):
@@ -123,7 +161,27 @@ class Task:
         self._t0 = None
 
 
-Event = Task
+class Event:
+    """ref: profiler.ProfileEvent — an INSTANT marker, not a duration.
+
+    ``Event("epoch").mark()`` drops a chrome-trace instant event
+    (``"ph": "i"``) at the current time.  ``start()``/``stop()`` are
+    kept for Task-style call sites but each records an instant marker
+    (tagged with the edge in ``args``) rather than accumulating a
+    duration — use ``Task`` for timed ranges.
+    """
+
+    def __init__(self, name: str, domain: str = "user"):
+        self.name, self.domain = name, domain
+
+    def mark(self, **args):
+        instant(self.name, self.domain, args or None)
+
+    def start(self):
+        instant(self.name, self.domain, {"edge": "start"})
+
+    def stop(self):
+        instant(self.name, self.domain, {"edge": "stop"})
 
 
 class Counter:
@@ -184,7 +242,11 @@ class Counter:
 
 
 def dumps(reset: bool = False) -> str:
-    """Aggregate per-op stats table (ref: AggregateStats::Dump)."""
+    """Aggregate per-op stats table (ref: AggregateStats::Dump).
+
+    ``reset=True`` clears the AGGREGATE table only — trace events are
+    untouched (their lifetime belongs to ``dump(finished=True)``).
+    """
     with _lock:
         rows = []
         for name, ts in sorted(_agg.items(), key=lambda kv: -sum(kv[1])):
@@ -200,12 +262,27 @@ def dumps(reset: bool = False) -> str:
 
 
 def dump(finished: bool = True, filename: Optional[str] = None):
-    """Write chrome://tracing JSON."""
+    """Write chrome://tracing JSON.
+
+    ``finished=True`` (the default) CLEARS the event buffer after the
+    write — a long-lived process that dumps periodically must not
+    re-dump an ever-growing buffer.  Pass ``finished=False`` to keep
+    accumulating into the same capture across dumps.
+    """
     fn = filename or _config["filename"]
-    with _lock:
-        data = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
-    with open(fn, "w") as f:
-        json.dump(data, f)
+    with _dump_lock:
+        with _lock:
+            data = {"traceEvents": list(_events),
+                    "displayTimeUnit": "ms"}
+        with open(fn, "w") as f:
+            json.dump(data, f)
+        if finished:
+            # clear only AFTER a successful write — a bad path/full
+            # disk must not destroy the capture (events recorded
+            # between the snapshot above and here land in the next
+            # dump)
+            with _lock:
+                del _events[:len(data["traceEvents"])]
     return fn
 
 

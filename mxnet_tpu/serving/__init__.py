@@ -18,8 +18,12 @@ substrate above it:
     (reject-with-503 semantics instead of unbounded queueing), and
     graceful drain on shutdown;
   * per-model metrics (QPS, p50/p99 latency, batch occupancy, queue
-    depth, rejections) through the `profiler.Counter` API plus a
-    `dumps()`-style JSON snapshot.
+    depth, rejections) on the `mxnet_tpu.telemetry` registry — one
+    Prometheus scrape (`GET /metrics` on the HTTP front end) sees every
+    model plus AOT-compile counters; `GET /healthz` is drain-aware
+    (200 serving / 503 draining); the `dumps()`-style JSON snapshot is
+    unchanged; with tracing on, each request carries one trace id
+    linking admission→queue-wait→batch-assembly→execute→respond spans.
 
 Quick start:
 

@@ -32,6 +32,9 @@ from collections import OrderedDict, deque
 from concurrent.futures import Future
 from typing import Dict, List, Optional
 
+from .. import profiler as _prof
+from ..telemetry import instruments as _ins
+from ..telemetry import tracing as _tracing
 from . import (DeadlineExceeded, ServerClosed, ServingConfig,
                ServingError)
 
@@ -39,13 +42,18 @@ __all__ = ["DynamicBatcher"]
 
 
 class _Request:
-    __slots__ = ("xs", "rows", "seed", "future", "deadline", "enq")
+    __slots__ = ("xs", "rows", "seed", "future", "deadline", "enq",
+                 "enq_pc", "trace")
 
-    def __init__(self, xs, rows, seed, deadline):
+    def __init__(self, xs, rows, seed, deadline, trace=None):
         self.xs, self.rows, self.seed = xs, rows, seed
         self.deadline = deadline
         self.future: Future = Future()
         self.enq = time.monotonic()
+        # perf_counter twin of enq: span timestamps must share the
+        # profiler's clock, monotonic stays the deadline clock
+        self.enq_pc = time.perf_counter()
+        self.trace = trace  # (trace_id, admission_span_id) or None
 
 
 class DynamicBatcher:
@@ -78,12 +86,14 @@ class DynamicBatcher:
     # ---- submission ---------------------------------------------------
 
     def submit(self, inputs, seed: int = 0,
-               deadline: Optional[float] = None) -> Future:
+               deadline: Optional[float] = None, trace=None) -> Future:
         """Enqueue one request (inputs carry their own leading batch
         dim; most clients send 1 row).  Returns a Future resolving to
-        the model's documented output structure (NDArray leaves)."""
+        the model's documented output structure (NDArray leaves).
+        `trace` is the request's (trace_id, admission_span_id) pair —
+        queue-wait/execute spans on the batcher thread link back to it."""
         xs, rows = self._validate(inputs)
-        req = _Request(xs, rows, int(seed), deadline)
+        req = _Request(xs, rows, int(seed), deadline, trace=trace)
         key = self._group_key(xs, req.seed)
         with self._cv:
             if self._closing:
@@ -91,6 +101,12 @@ class DynamicBatcher:
                     f"model {self._entry.name!r}: server is shutting "
                     f"down, not accepting new requests")
             self._groups.setdefault(key, deque()).append(req)
+            if trace is not None:
+                # flow arrow (enqueue here -> batch execution over
+                # there), emitted BEFORE the batcher thread can wake
+                # and emit the matching flow_end — end-before-start
+                # arrows get dropped by trace viewers
+                _tracing.flow_start(trace[0])
             self._cv.notify()
         return req.future
 
@@ -242,6 +258,36 @@ class DynamicBatcher:
                     t = r.deadline if t is None else min(t, r.deadline)
         return t
 
+    def _trace_batch_start(self, reqs: List[_Request], rows: int):
+        """Emit per-request queue-wait spans + flow ends, and open the
+        batch-assembly span.  The batch's spans ride the FIRST traced
+        request's trace id (its `traces` arg lists every member) — a
+        single-request batch therefore shows one trace id end-to-end:
+        admission → queue-wait → batch-assembly → execute → respond."""
+        # spans only exist in a capture: with telemetry on but no
+        # profiler running nothing here would record, so skip the
+        # whole machinery (metrics are handled by ModelMetrics)
+        if not _prof._running:
+            return None
+        now = time.perf_counter()
+        primary = None
+        member_traces = []
+        for r in reqs:
+            if r.trace is None:
+                continue
+            member_traces.append(r.trace[0])
+            if primary is None:
+                primary = r.trace
+            _tracing.record_complete(
+                "queue-wait", "serving", r.enq_pc, now - r.enq_pc,
+                trace_id=r.trace[0], parent_id=r.trace[1])
+            _tracing.flow_end(r.trace[0])
+        return _tracing.Span(
+            "batch-assembly", "serving",
+            trace_id=primary[0] if primary else None,
+            parent_id=primary[1] if primary else None,
+            args={"rows": rows, "traces": member_traces})
+
     def _run_batch(self, key, reqs: List[_Request], rows: int):
         import jax.numpy as jnp
 
@@ -250,6 +296,7 @@ class DynamicBatcher:
 
         entry = self._entry
         m = entry.metrics
+        phase = self._trace_batch_start(reqs, rows)
         try:
             # non-coalescable programs (outputs not batch-major) run at
             # the EXACT exported/request shape: padding rows would leak
@@ -271,10 +318,23 @@ class DynamicBatcher:
                                     dtype=v.dtype)
                     v = jnp.concatenate([v, pad], axis=0)
                 xs.append(v)
+            if phase is not None:
+                tr, par = phase.trace_id, phase.parent_id
+                phase.finish()
+                phase = _tracing.Span("execute", "serving", trace_id=tr,
+                                      parent_id=par,
+                                      args={"bucket": bucket})
             leaves = entry.execute(bucket, xs, seed=reqs[0].seed)
             m.bump("batches")
             m.bump("batched_rows", rows)
             m.bump("padded_rows", bucket)
+            _ins.serving_occupancy(entry.name, entry.version).set(
+                rows / bucket)
+            if phase is not None:
+                tr, par = phase.trace_id, phase.parent_id
+                phase.finish()
+                phase = _tracing.Span("respond", "serving", trace_id=tr,
+                                      parent_id=par)
             ctx = current_context()
             off = 0
             for r in reqs:
@@ -291,6 +351,9 @@ class DynamicBatcher:
                 if not r.future.done():
                     m.bump("failed")
                     r.future.set_exception(e)
+        finally:
+            if phase is not None:
+                phase.finish()
 
     # ---- lifecycle ----------------------------------------------------
 

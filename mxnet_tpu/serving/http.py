@@ -14,6 +14,13 @@ including the status codes the backpressure contract promises
                (arrays as nested lists; namedtuples/dicts as objects)
     GET  /v1/models    -> {"models": {name: [versions]}}
     GET  /v1/metrics   -> the InferenceServer.metrics() snapshot
+    GET  /metrics      -> Prometheus text exposition (the whole
+                          process's telemetry registry: request latency
+                          histograms, AOT-compile counters, ...)
+    GET  /healthz      -> 200 {"status": "serving"} while accepting,
+                          503 {"status": "draining"} once shutdown
+                          begins (drain-aware: LBs stop routing here
+                          while accepted work completes)
 
 Use `serve_http(server, port=0)` for an ephemeral port; the returned
 `http.server.ThreadingHTTPServer` exposes `server_address` and is torn
@@ -26,6 +33,7 @@ import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..telemetry import metrics as _tmetrics
 from . import ServingError
 
 __all__ = ["serve_http"]
@@ -58,15 +66,29 @@ def _make_handler(server):
         def log_message(self, fmt, *args):  # noqa: D102
             pass
 
-        def _send(self, status: int, payload: dict):
-            body = json.dumps(payload).encode()
+        def _send_text(self, status: int, text: str, content_type: str):
+            body = text.encode("utf-8")
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
 
+        def _send(self, status: int, payload: dict):
+            self._send_text(status, json.dumps(payload),
+                            "application/json")
+
         def do_GET(self):  # noqa: N802 — http.server API
+            if self.path == "/metrics":
+                # standard scrape target: the process-wide registry in
+                # Prometheus text format 0.0.4
+                return self._send_text(
+                    200, _tmetrics.get_registry().to_prometheus(),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            if self.path == "/healthz":
+                if server.draining:
+                    return self._send(503, {"status": "draining"})
+                return self._send(200, {"status": "serving"})
             if self.path == "/v1/metrics":
                 return self._send(200, server.metrics())
             if self.path == "/v1/models":

@@ -1,10 +1,24 @@
-"""Per-model serving metrics.
+"""Per-model serving metrics, riding the telemetry registry.
 
-Live counters ride the existing `profiler.Counter` API (so a running
-profiler sees them as chrome-trace counter lanes under the "serving"
-domain); the snapshot side is a plain dict / JSON string in the spirit
-of `profiler.dumps()` — QPS, p50/p99 latency, batch occupancy, queue
-depth, rejections, executor-cache hits.
+Every counter/gauge here is a `telemetry` registry child labeled
+`{model, version}` — so one Prometheus scrape (`GET /metrics` on the
+HTTP front end) sees every model's requests/rejections/cache hits, and
+request latency lands in a fixed-bucket histogram
+(`mx_serving_request_latency_seconds`).  The JSON `snapshot()` keeps
+its original dict shape (QPS, p50/p99 latency, batch occupancy, queue
+depth...) so existing dashboards and tests read on unchanged.
+
+While the profiler is capturing, updates are mirrored as chrome-trace
+counter lanes (`"ph": "C"`) under the "serving" category — the same
+lanes the seed emitted through `profiler.Counter`.
+
+Construction RESETS the label set's children: a new `_ModelEntry` for
+the same (model, version) is a lifecycle restart (the Prometheus
+counter-reset convention), which also keeps per-test counts hermetic.
+Corollary: the registry has ONE time series per (model, version) per
+process — two repositories serving the same model version in one
+process share (and reset) each other's series, exactly as two scrape
+targets behind one exporter would.  Run one repository per process.
 """
 from __future__ import annotations
 
@@ -13,7 +27,9 @@ import time
 from collections import deque
 from typing import Dict, Optional
 
-from .. import profiler
+from .. import profiler as _prof
+from ..telemetry import instruments as _ins
+from ..telemetry import tracing as _tracing
 
 # completed-request latencies kept for percentile estimates; a bounded
 # ring so a long-lived server's memory stays flat
@@ -35,27 +51,57 @@ class ModelMetrics:
         "deadline_expired", "batches", "batched_rows", "padded_rows",
         "cache_hits", "cache_misses", "queue_depth",
     )
+    # queue_depth is the one point-in-time value in the tuple — it maps
+    # to a gauge family; everything else is a monotone counter
+    _GAUGES = ("queue_depth",)
 
     def __init__(self, model: str, version: int):
         self.model, self.version = model, version
-        prefix = f"serving/{model}/v{version}"
-        self._c: Dict[str, profiler.Counter] = {
-            name: profiler.Counter(f"{prefix}/{name}", domain="serving")
-            for name in self.COUNTERS}
+        self._c: Dict[str, object] = {}
+        for name in self.COUNTERS:
+            if name in self._GAUGES:
+                child = _ins.serving_queue_depth(model, version)
+            else:
+                child = _ins.serving_counter(name, model, version)
+            child.reset()
+            self._c[name] = child
+        self._latency_hist = _ins.serving_request_latency(model, version)
+        self._latency_hist.reset()  # lifecycle restart covers the
+        # histogram too — requests_total=0 with a populated latency
+        # series would desync every rate-vs-histogram readout
         self._lock = threading.Lock()
         self._lat = deque(maxlen=_LATENCY_RING)  # (done_t, latency_s)
         self._started = time.perf_counter()
 
+    def _lane(self, name: str) -> str:
+        return f"serving/{self.model}/v{self.version}/{name}"
+
     def bump(self, name: str, d: int = 1) -> None:
-        self._c[name].increment(d)
+        c = self._c[name]
+        if not _prof._running:
+            c.inc(d)
+            return
+        # chrome counter lane while capturing: inc and emit under one
+        # lock so concurrent bumps cannot interleave into (later ts,
+        # smaller value) samples — the trace integrity gate asserts
+        # cumulative lanes are monotone in timestamp order
+        with self._lock:
+            v = c.inc(d)
+            _tracing.counter_event(self._lane(name), v, cat="serving")
 
     def gauge(self, name: str, v: int) -> None:
-        self._c[name].set_value(v)
+        if not _prof._running:
+            self._c[name].set(v)
+            return
+        with self._lock:
+            self._c[name].set(v)
+            _tracing.counter_event(self._lane(name), v, cat="serving")
 
     def value(self, name: str) -> int:
-        return self._c[name].value
+        return int(self._c[name].value)
 
     def observe_latency(self, seconds: float) -> None:
+        self._latency_hist.observe(seconds)
         with self._lock:
             self._lat.append((time.perf_counter(), seconds))
 

@@ -17,8 +17,11 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Dict, List, Optional
 
+from ..telemetry import instruments as _ins
+from ..telemetry import tracing as _tracing
 from . import ModelNotFound, ServingError
 from .metrics import ModelMetrics
 
@@ -127,6 +130,21 @@ class _ModelEntry:
         return fn
 
     def _compile(self, bucket: int):
+        t0 = time.perf_counter()
+        compiled = self._compile_impl(bucket)
+        dt = time.perf_counter() - t0
+        # always counted, never gated: a compile on the serving path is
+        # the silent TPU latency killer — each one must be visible in
+        # the next /metrics scrape
+        _ins.serving_compile_total(self.name, self.version).inc()
+        _ins.serving_compile_seconds(self.name, self.version).observe(dt)
+        _tracing.record_complete(
+            "aot-compile", "serving", t0, dt,
+            args={"model": self.name, "version": self.version,
+                  "bucket": bucket})
+        return compiled
+
+    def _compile_impl(self, bucket: int):
         import jax
         import jax.numpy as jnp
 

@@ -24,6 +24,8 @@ import time
 from concurrent.futures import Future
 from typing import Dict, Optional
 
+from .. import profiler as _prof
+from ..telemetry import tracing as _tracing
 from . import ServerClosed, ServerOverloaded, ServingConfig
 from .batcher import DynamicBatcher
 from .repository import ModelRepository
@@ -78,15 +80,30 @@ class InferenceServer:
         entry = self.repository.get(model, version)
         m = entry.metrics
         key = (entry.name, entry.version)
+        # a fresh trace root per request: every span this request
+        # produces — here, on the batcher thread, in the executor —
+        # carries ONE trace id (exposed on the returned Future)
+        adm = None
+        if _prof._running:  # spans record only during a capture —
+            # scrape-only telemetry must not pay per-request id/span
+            # machinery that lands nowhere
+            adm = _tracing.Span(
+                "admission", "serving", root=True,
+                args={"model": entry.name, "version": entry.version})
         # admission first, import after: rejection (closed / queue
         # full) needs only entry.metrics, so it must fail fast rather
         # than wait behind a cold model's multi-second artifact import
-        with self._lock:
-            self._admit_locked(m)
-            self._pending += 1
-            self._pending_per[key] = self._pending_per.get(key, 0) + 1
-            m.bump("requests")
-            m.gauge("queue_depth", self._pending_per[key])
+        try:
+            with self._lock:
+                self._admit_locked(m)
+                self._pending += 1
+                self._pending_per[key] = self._pending_per.get(key, 0) + 1
+                m.bump("requests")
+                m.gauge("queue_depth", self._pending_per[key])
+        except BaseException:
+            if adm is not None:
+                adm.finish()
+            raise
 
         def _release():
             with self._lock:
@@ -116,10 +133,17 @@ class InferenceServer:
                     # cheap here: the artifact is already imported above
                     batcher = DynamicBatcher(entry, self.config)
                     self._batchers[key] = batcher
-            fut = batcher.submit(inputs, seed=seed, deadline=deadline)
+            fut = batcher.submit(
+                inputs, seed=seed, deadline=deadline,
+                trace=(adm.trace_id, adm.span_id)
+                if adm is not None else None)
         except BaseException:
             _release()  # admitted but never enqueued: free the slot
             raise
+        finally:
+            if adm is not None:
+                adm.finish()  # admission span = submit-side machinery
+        fut.trace_id = adm.trace_id if adm is not None else None
 
         def _done(f: Future):
             _release()
@@ -144,6 +168,14 @@ class InferenceServer:
     def pending(self) -> int:
         with self._lock:
             return self._pending
+
+    @property
+    def draining(self) -> bool:
+        """True once shutdown has begun — the /healthz drain signal
+        (load balancers stop routing here while accepted work
+        finishes)."""
+        with self._lock:
+            return self._closed
 
     def metrics(self) -> dict:
         """Per-model snapshot (QPS, p50/p99 latency, occupancy, queue

@@ -1,0 +1,57 @@
+"""mxnet_tpu.telemetry — unified observability: metrics + span tracing.
+
+Two halves, one import:
+
+  * **metrics** — a process-wide registry of labeled `Counter`/`Gauge`/
+    `Histogram` (fixed exponential latency buckets, so p50/p95/p99 come
+    from bounded storage), rendered as Prometheus text exposition
+    (`to_prometheus()`, served at `GET /metrics` by the serving front
+    end) or a JSON snapshot (`snapshot()`).
+  * **tracing** — lightweight trace/span IDs with parent links; spans
+    land in the existing `profiler` chrome-trace buffer as `"X"` events
+    (plus flow arrows for cross-thread hand-offs), so ONE trace shows a
+    serving request flowing admission → queue-wait → batch-assembly →
+    execute → respond, and a training step shows data-wait → forward →
+    backward → grad-allreduce → optimizer-update.
+
+Enablement: `telemetry.enable()` (or env `MXNET_TELEMETRY=1`).  When
+disabled, every instrumented hot path pays a single predicate check.
+Trace events are only captured while `profiler.start()` is active —
+the capture window bounds the buffer; metrics are always live once
+enabled, so a long-lived server scrapes `/metrics` without tracing.
+
+Quick start:
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+
+    telemetry.enable()
+    mx.profiler.start()
+    ...train 3 steps / serve requests...
+    mx.profiler.dump(finished=True, filename="trace.json")
+    print(telemetry.get_registry().to_prometheus())
+    # then: python tools/trace_report.py trace.json
+
+See docs/observability.md for the metric naming scheme, bucket ladder,
+span semantics, and how to read the chrome + xplane traces together.
+"""
+from __future__ import annotations
+
+from .metrics import (Counter, Gauge, Histogram, MetricFamily,
+                      MetricsRegistry, get_registry,
+                      DEFAULT_LATENCY_BUCKETS, exponential_buckets)
+from .tracing import (Span, span, current_span, new_trace_id,
+                      record_complete, flow_start, flow_end,
+                      counter_event, enable, disable, enabled)
+from . import metrics
+from . import tracing
+from . import instruments
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricFamily", "MetricsRegistry",
+    "get_registry", "DEFAULT_LATENCY_BUCKETS", "exponential_buckets",
+    "Span", "span", "current_span", "new_trace_id", "record_complete",
+    "flow_start", "flow_end", "counter_event",
+    "enable", "disable", "enabled",
+    "metrics", "tracing",
+]

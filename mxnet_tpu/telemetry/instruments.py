@@ -1,0 +1,146 @@
+"""The framework's own metric families, in one place.
+
+Instrument sites (op dispatch, trainer, dataloader, collectives, the
+serving stack) get their families/children through these cached
+accessors so (a) every family is registered exactly once with one
+naming scheme, and (b) the per-event cost is a plain method call on a
+cached child object.  Naming scheme (docs/observability.md):
+
+    mx_<layer>_<what>_<unit-or-total>{label=...}
+
+Counters end in ``_total``; durations are histograms in seconds on the
+shared exponential ladder; point-in-time values are gauges.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from .metrics import MetricFamily, get_registry
+
+__all__ = [
+    "op_dispatch_total",
+    "training_phase_seconds", "training_steps_total",
+    "data_wait_seconds", "data_wait_last_seconds",
+    "collective_seconds",
+    "serving_counter", "serving_queue_depth", "serving_occupancy",
+    "serving_request_latency", "serving_compile_total",
+    "serving_compile_seconds",
+]
+
+_lock = threading.RLock()  # _child -> _family nests the acquisition
+_families: Dict[str, MetricFamily] = {}
+_children: Dict[tuple, object] = {}
+_generation = -1  # registry generation the caches were built against
+
+
+def _revalidate_locked() -> None:
+    """Drop the caches when the registry was clear()ed — otherwise
+    instrument sites would keep recording into orphaned children that
+    exposition never sees.  Caller holds _lock."""
+    global _generation
+    gen = get_registry().generation
+    if gen != _generation:
+        _families.clear()
+        _children.clear()
+        _generation = gen
+
+
+def _family(name: str, kind: str, help: str, labels=()) -> MetricFamily:
+    with _lock:
+        _revalidate_locked()
+        fam = _families.get(name)
+        if fam is None:
+            reg = get_registry()
+            fam = getattr(reg, kind)(name, help, labels=labels)
+            _families[name] = fam
+    return fam
+
+
+def _child(name: str, kind: str, help: str, labels=(), values=()):
+    key = (name,) + tuple(values)
+    with _lock:
+        _revalidate_locked()
+        child = _children.get(key)
+        if child is None:
+            child = _family(name, kind, help, labels).labels(*values)
+            _children[key] = child
+    return child
+
+
+# ---- op layer ---------------------------------------------------------
+
+def op_dispatch_total(op_name: str):
+    return _child("mx_op_dispatch_total", "counter",
+                  "Imperative op dispatches through "
+                  "ops.registry.invoke.", ("op",), (op_name,))
+
+
+# ---- training ---------------------------------------------------------
+
+def training_phase_seconds(phase: str):
+    return _child("mx_training_phase_seconds", "histogram",
+                  "Wall seconds per training-step phase.",
+                  ("phase",), (phase,))
+
+
+def training_steps_total():
+    return _child("mx_training_steps_total", "counter",
+                  "Optimizer steps taken.")
+
+
+def data_wait_seconds():
+    return _child("mx_data_wait_seconds", "histogram",
+                  "Seconds the training loop waited for the next batch.")
+
+
+def data_wait_last_seconds():
+    return _child("mx_data_wait_last_seconds", "gauge",
+                  "Most recent data-wait (seconds) — the live stall "
+                  "signal a dashboard watches.")
+
+
+def collective_seconds(op: str):
+    return _child("mx_collective_seconds", "histogram",
+                  "Host-blocking collective wall seconds.",
+                  ("op",), (op,))
+
+
+# ---- serving ----------------------------------------------------------
+
+def serving_counter(name: str, model: str, version) -> object:
+    return _child(f"mx_serving_{name}_total", "counter",
+                  f"Serving {name.replace('_', ' ')}.",
+                  ("model", "version"), (model, str(version)))
+
+
+def serving_queue_depth(model: str, version):
+    return _child("mx_serving_queue_depth", "gauge",
+                  "Admitted-but-incomplete requests per model version.",
+                  ("model", "version"), (model, str(version)))
+
+
+def serving_occupancy(model: str, version):
+    return _child("mx_serving_batch_occupancy", "gauge",
+                  "Real rows / launched rows of the last batch "
+                  "(1.0 = no padding waste).",
+                  ("model", "version"), (model, str(version)))
+
+
+def serving_request_latency(model: str, version):
+    return _child("mx_serving_request_latency_seconds", "histogram",
+                  "End-to-end served request latency.",
+                  ("model", "version"), (model, str(version)))
+
+
+def serving_compile_total(model: str, version):
+    return _child("mx_serving_compile_total", "counter",
+                  "AOT bucket compiles (TPU recompiles are the "
+                  "silent serving killer — watch this).",
+                  ("model", "version"), (model, str(version)))
+
+
+def serving_compile_seconds(model: str, version):
+    return _child("mx_serving_compile_seconds", "histogram",
+                  "Seconds spent in AOT bucket compilation.",
+                  ("model", "version"), (model, str(version)))

@@ -1,0 +1,445 @@
+"""mxnet_tpu.telemetry — metrics registry + span tracing (ISSUE 2).
+
+The contract under test:
+  * labeled Counter/Gauge/Histogram with fixed exponential buckets and
+    bucket-interpolated percentiles (bounded storage);
+  * Prometheus text exposition parses (one line per sample, # TYPE
+    headers) and the JSON snapshot is json.dumps-able;
+  * spans carry trace/span/parent ids into the profiler's chrome-trace
+    buffer; a 3-step training loop yields data-wait / forward /
+    backward / grad-allreduce / optimizer-update phases and
+    tools/trace_report.py renders + validates the dump;
+  * profiler satellites: dump(finished=True) clears the buffer, Event
+    is an instant marker, set_config rejects typo'd keys;
+  * disabled-instrumentation dispatch overhead is a single predicate
+    check (micro-benchmark gate vs the seed dispatch section).
+"""
+import importlib.util
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, profiler, telemetry
+from mxnet_tpu.gluon import Trainer, nn
+from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+from mxnet_tpu.telemetry import metrics as tmetrics
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report_under_test",
+        os.path.join(_REPO, "tools", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off_and_profiler_clean(tmp_path):
+    """Every test starts disabled with an empty capture buffer."""
+    telemetry.disable()
+    profiler.stop()
+    profiler.dump(finished=True, filename=str(tmp_path / "_flush.json"))
+    yield
+    telemetry.disable()
+    profiler.stop()
+    profiler.dump(finished=True, filename=str(tmp_path / "_flush2.json"))
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_basic_and_labels():
+    reg = tmetrics.MetricsRegistry()
+    c = reg.counter("t_requests_total", "requests", labels=("model",))
+    c.labels("a").inc()
+    c.labels("a").inc(2)
+    c.labels(model="b").inc()
+    assert c.labels("a").value == 3
+    assert c.labels("b").value == 1
+    with pytest.raises(ValueError):
+        c.labels("a").inc(-1)  # counters are monotone
+    g = reg.gauge("t_depth", "queue depth")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value == 4
+
+
+def test_registry_idempotent_and_kind_clash():
+    reg = tmetrics.MetricsRegistry()
+    a = reg.counter("t_x_total", labels=("op",))
+    b = reg.counter("t_x_total", labels=("op",))
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("t_x_total")  # kind clash
+    with pytest.raises(ValueError):
+        reg.counter("t_x_total", labels=("other",))  # label clash
+    with pytest.raises(ValueError):
+        reg.counter("0bad name")  # invalid prometheus name
+
+
+def test_histogram_fixed_buckets_and_quantiles():
+    reg = tmetrics.MetricsRegistry()
+    h = reg.histogram("t_lat_seconds", buckets=[0.001, 0.01, 0.1, 1.0])
+    solo = h.labels()
+    for _ in range(90):
+        solo.observe(0.005)   # lands in (0.001, 0.01]
+    for _ in range(10):
+        solo.observe(0.5)     # lands in (0.1, 1.0]
+    assert solo.count == 100
+    assert solo.sum == pytest.approx(90 * 0.005 + 10 * 0.5)
+    # p50 interpolates inside the (0.001, 0.01] bucket
+    p50 = solo.quantile(0.50)
+    assert 0.001 < p50 <= 0.01
+    # p99 crosses into the (0.1, 1.0] bucket
+    p99 = solo.quantile(0.99)
+    assert 0.1 < p99 <= 1.0
+    assert solo.quantile(0.5) is not None
+    # storage is the fixed ladder, not per-observation
+    assert len(solo._counts) == 5  # 4 bounds + overflow
+    empty = reg.histogram("t_empty_seconds").labels()
+    assert empty.quantile(0.5) is None
+
+
+def test_prometheus_exposition_parses():
+    reg = tmetrics.MetricsRegistry()
+    reg.counter("t_reqs_total", "total requests",
+                labels=("model",)).labels("m\"x\n").inc(7)
+    reg.gauge("t_gauge", "a gauge").set(1.5)
+    reg.histogram("t_h_seconds", "hist",
+                  buckets=[0.1, 1.0]).labels().observe(0.25)
+    text = reg.to_prometheus()
+    lines = text.strip().split("\n")
+    sample_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [0-9eE\.\+\-]+$|'
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? \+Inf$')
+    types = set()
+    for ln in lines:
+        if ln.startswith("# TYPE"):
+            types.add(ln.split()[2])
+            continue
+        if ln.startswith("#"):
+            continue
+        assert sample_re.match(ln), f"unparseable sample line: {ln!r}"
+    for fam in ("t_reqs_total", "t_gauge", "t_h_seconds"):
+        assert f"# TYPE {fam} " in text
+    # histogram expansion: buckets are cumulative and +Inf == count
+    assert 't_h_seconds_bucket{le="0.1"} 0' in text
+    assert 't_h_seconds_bucket{le="1"} 1' in text
+    assert 't_h_seconds_bucket{le="+Inf"} 1' in text
+    assert "t_h_seconds_count 1" in text
+    # label values escaped (quote + newline survive on one line)
+    assert 't_reqs_total{model="m\\"x\\n"} 7' in text
+
+
+def test_histogram_bucket_ladder_clash_raises():
+    reg = tmetrics.MetricsRegistry()
+    # first registration deliberately unsorted: idempotency must be
+    # order-insensitive in both directions
+    reg.histogram("t_h_seconds", buckets=[1.0, 0.1])
+    reg.histogram("t_h_seconds", buckets=[0.1, 1.0])  # same set: fine
+    reg.histogram("t_h_seconds", buckets=[1.0, 0.1])
+    reg.histogram("t_h_seconds")  # buckets unspecified: fine
+    with pytest.raises(ValueError, match="ladder"):
+        reg.histogram("t_h_seconds", buckets=[0.5, 1.0])
+
+
+def test_registry_clear_invalidates_instrument_caches():
+    """After clear(), instrument sites must resolve fresh children —
+    not keep recording into orphans exposition never sees."""
+    from mxnet_tpu.telemetry import instruments as ins
+
+    reg = telemetry.get_registry()
+    ins.training_steps_total().inc(5)
+    reg.clear()
+    ins.training_steps_total().inc()
+    fam = reg.get("mx_training_steps_total")
+    assert fam is not None and fam.value == 1  # fresh child, visible
+    assert "mx_training_steps_total 1" in reg.to_prometheus()
+
+
+def test_dump_write_failure_preserves_capture(tmp_path):
+    profiler.start()
+    with profiler.scope("survivor"):
+        pass
+    profiler.stop()
+    n = profiler.num_events()
+    assert n >= 1
+    with pytest.raises(OSError):
+        profiler.dump(finished=True,
+                      filename=str(tmp_path / "no" / "dir" / "t.json"))
+    assert profiler.num_events() == n  # failed write kept the events
+    ok = str(tmp_path / "ok.json")
+    profiler.dump(finished=True, filename=ok)
+    assert any(e["name"] == "survivor"
+               for e in json.load(open(ok))["traceEvents"])
+    assert profiler.num_events() == 0
+
+
+def test_snapshot_is_jsonable():
+    reg = tmetrics.MetricsRegistry()
+    reg.counter("t_c_total").inc(3)
+    reg.histogram("t_lat_seconds").labels().observe(0.02)
+    snap = reg.snapshot()
+    parsed = json.loads(json.dumps(snap))
+    assert parsed["t_c_total"]["samples"][0]["value"] == 3
+    h = parsed["t_lat_seconds"]["samples"][0]
+    assert h["count"] == 1 and h["p50"] is not None
+
+
+# ---------------------------------------------------------------------------
+# span tracing
+# ---------------------------------------------------------------------------
+
+def test_span_noop_when_disabled():
+    n0 = profiler.num_events()
+    with telemetry.span("nothing") as s:
+        assert s is None
+    assert profiler.num_events() == n0
+
+
+def test_spans_nest_with_parent_links(tmp_path):
+    profiler.start()
+    with telemetry.span("outer", cat="t") as outer:
+        with telemetry.span("inner", cat="t") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    profiler.stop()
+    fn = str(tmp_path / "t.json")
+    profiler.dump(finished=True, filename=fn)
+    evs = json.load(open(fn))["traceEvents"]
+    by_name = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert by_name["inner"]["args"]["parent_id"] == \
+        by_name["outer"]["args"]["span_id"]
+    assert by_name["inner"]["args"]["trace_id"] == \
+        by_name["outer"]["args"]["trace_id"]
+
+
+def test_span_root_breaks_inheritance():
+    profiler.start()
+    with telemetry.span("ambient") as amb:
+        root = telemetry.Span("fresh", root=True)
+        assert root.parent_id is None
+        assert root.trace_id != amb.trace_id
+        root.finish()
+    profiler.stop()
+
+
+# ---------------------------------------------------------------------------
+# profiler satellites
+# ---------------------------------------------------------------------------
+
+def test_dump_finished_clears_buffer(tmp_path):
+    profiler.start()
+    with profiler.scope("probe"):
+        pass
+    profiler.stop()
+    assert profiler.num_events() >= 1
+    a = str(tmp_path / "a.json")
+    profiler.dump(finished=False, filename=a)
+    assert profiler.num_events() >= 1  # kept accumulating
+    b = str(tmp_path / "b.json")
+    profiler.dump(finished=True, filename=b)
+    assert profiler.num_events() == 0  # finished: buffer cleared
+    assert len(json.load(open(b))["traceEvents"]) >= 1
+    c = str(tmp_path / "c.json")
+    profiler.dump(finished=True, filename=c)
+    assert json.load(open(c))["traceEvents"] == []
+
+
+def test_event_is_instant_marker(tmp_path):
+    profiler.start()
+    ev = profiler.Event("epoch-boundary", domain="train")
+    ev.mark(epoch=3)
+    ev.start()
+    ev.stop()
+    profiler.stop()
+    fn = str(tmp_path / "e.json")
+    profiler.dump(finished=True, filename=fn)
+    got = [e for e in json.load(open(fn))["traceEvents"]
+           if e["name"] == "epoch-boundary"]
+    assert len(got) == 3
+    assert all(e["ph"] == "i" for e in got), \
+        "profiler.Event must emit chrome instant events, not durations"
+    assert got[0]["args"] == {"epoch": 3}
+
+
+def test_set_config_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="profile_memroy"):
+        profiler.set_config(profile_memroy=True)  # the classic typo
+    profiler.set_config(profile_memory=False)  # known key: fine
+
+
+# ---------------------------------------------------------------------------
+# the 3-step training loop trace (tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+def _train_three_steps():
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    xs = np.random.RandomState(0).rand(12, 8).astype("float32")
+    ys = np.random.RandomState(1).rand(12, 4).astype("float32")
+    loader = DataLoader(ArrayDataset(nd.array(xs), nd.array(ys)),
+                        batch_size=4)
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1})
+    for x, y in loader:
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).sum()
+        loss.backward()
+        trainer.step(4)
+    mx.nd.waitall()
+
+
+PHASES = ("data-wait", "forward", "backward", "grad-allreduce",
+          "optimizer-update")
+
+
+def test_training_loop_trace_has_per_step_phases(tmp_path):
+    telemetry.enable()
+    profiler.start()
+    try:
+        _train_three_steps()
+    finally:
+        profiler.stop()
+        telemetry.disable()
+    fn = str(tmp_path / "train.json")
+    profiler.dump(finished=True, filename=fn)
+    evs = json.load(open(fn))["traceEvents"]
+    names = [e["name"] for e in evs if e["ph"] == "X"]
+    for phase in PHASES:
+        assert names.count(phase) == 3, \
+            f"expected 3 {phase!r} spans, got {names.count(phase)}"
+    tr = _load_trace_report()
+    assert tr.check_events(evs) == []
+    table = tr.render_table(evs)
+    for phase in PHASES:
+        assert phase in table
+    assert "training steps: 3" in table
+    # step phases also landed in the registry histogram
+    fam = telemetry.get_registry().get("mx_training_phase_seconds")
+    phases_seen = {v[0] for v, _ in fam.children()}
+    assert {"forward", "backward", "grad-allreduce",
+            "optimizer-update"} <= phases_seen
+    steps = telemetry.get_registry().get("mx_training_steps_total")
+    assert steps.value >= 3
+    wait = telemetry.get_registry().get("mx_data_wait_seconds")
+    assert wait.labels().count >= 3
+
+
+def test_trace_report_check_flags_corruption(tmp_path):
+    tr = _load_trace_report()
+    good = {"traceEvents": [
+        {"name": "a", "ph": "X", "ts": 0.0, "dur": 5.0, "pid": 1,
+         "args": {"trace_id": "t1", "span_id": "s1"}}]}
+    assert tr.check_events(good["traceEvents"]) == []
+    # missing pid, dangling parent, decreasing cumulative counter,
+    # dangling flow id
+    bad = [
+        {"name": "a", "ph": "X", "ts": 0.0, "dur": 1.0},
+        {"name": "b", "ph": "X", "ts": 0.0, "dur": 1.0, "pid": 1,
+         "args": {"trace_id": "t1", "span_id": "s2",
+                  "parent_id": "nope"}},
+        {"name": "c", "ph": "C", "ts": 0.0, "pid": 1,
+         "args": {"x_requests": 5}},
+        {"name": "c", "ph": "C", "ts": 1.0, "pid": 1,
+         "args": {"x_requests": 3}},
+        {"name": "request", "ph": "s", "ts": 0.0, "pid": 1,
+         "id": "ghost"},
+    ]
+    errs = tr.check_events(bad)
+    assert len(errs) == 4, errs
+    # the CLI surfaces the same verdicts
+    fn = str(tmp_path / "bad.json")
+    json.dump({"traceEvents": bad}, open(fn, "w"))
+    assert tr.main([fn, "--check"]) == 1
+    ok = str(tmp_path / "ok.json")
+    json.dump(good, open(ok, "w"))
+    assert tr.main([ok, "--check"]) == 0
+    assert tr.main([ok]) == 0  # table mode renders
+
+
+# ---------------------------------------------------------------------------
+# disabled-overhead micro-benchmark (acceptance: <5% vs the seed path)
+# ---------------------------------------------------------------------------
+
+def test_disabled_dispatch_overhead_within_5pct_of_seed():
+    """With telemetry off and no profiler, the instrumented dispatch
+    must cost no more than the SEED's dispatch section (jitted-call
+    under a profile_op contextmanager) + 5%.  The new fast path skips
+    the contextmanager entirely, so this holds with margin; min-of-N
+    timing over 2000-call loops keeps scheduler noise out."""
+    from mxnet_tpu.ops import registry
+
+    op = registry.get_op("elemwise_add")
+    a = nd.array(np.ones((8, 8), "float32"))._data
+    b = nd.array(np.ones((8, 8), "float32"))._data
+    attrs_key = registry.freeze_attrs({})
+    jit = registry.jitted(op, attrs_key)
+    jit(a, b)  # warm the executable cache
+
+    def seed_section():
+        with profiler.profile_op(op.name):
+            return jit(a, b)
+
+    def new_section():
+        return registry.dispatch(op, attrs_key, (a, b), {})
+
+    assert not telemetry.enabled() and not profiler.is_running()
+
+    def best_of(fn, loops=2000, reps=7):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(loops):
+                fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    seed_section(), new_section()  # warm both paths
+    import gc
+
+    gc.disable()  # a collection inside one side skews a 5% gate
+    try:
+        t_seed = best_of(seed_section)
+        t_new = best_of(new_section)
+    finally:
+        gc.enable()
+    assert t_new <= t_seed * 1.05, \
+        (f"disabled dispatch {t_new * 1e6 / 2000:.2f}us/call vs seed "
+         f"{t_seed * 1e6 / 2000:.2f}us/call — instrumentation is not "
+         f"a single predicate check anymore")
+    # and truly zero side effects: no events, no dispatch counts
+    n0 = profiler.num_events()
+    fam = telemetry.get_registry().get("mx_op_dispatch_total")
+    c0 = fam.labels(op.name).value if fam is not None else 0
+    for _ in range(10):
+        new_section()
+    assert profiler.num_events() == n0
+    fam = telemetry.get_registry().get("mx_op_dispatch_total")
+    assert (fam.labels(op.name).value if fam is not None else 0) == c0
+
+
+def test_enabled_dispatch_counts_ops():
+    fam0 = telemetry.get_registry().counter(
+        "mx_op_dispatch_total",
+        labels=("op",))
+    before = fam0.labels("broadcast_add").value
+    telemetry.enable()
+    try:
+        x = nd.array(np.ones((2, 2), "float32"))
+        y = x + x
+        mx.nd.waitall()
+    finally:
+        telemetry.disable()
+    assert fam0.labels("broadcast_add").value == before + 1

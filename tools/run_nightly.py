@@ -48,9 +48,9 @@ def main():
     # slowdown vs the committed baseline (thresholds calibrated to the
     # 1-core box's timer noise — see tools/opperf.py compare()).
     baseline = os.path.join(_REPO, "OPPERF.json")
+    cpu_env = dict(env, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
     opperf_rc = None
     if os.path.exists(baseline):
-        cpu_env = dict(env, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
         try:
             q = subprocess.run(
                 [sys.executable, "tools/opperf.py",
@@ -69,12 +69,32 @@ def main():
             artifact["opperf_gate"] = {"returncode": -1,
                                        "note": "timed out"}
 
+    # trace integrity gate: generate a real training trace through the
+    # telemetry layer and validate it (spans present, events well-formed,
+    # counter lanes monotone, flow/parent links resolve)
+    trace_rc = None
+    try:
+        r = subprocess.run(
+            [sys.executable, "tools/trace_report.py", "--selftest"],
+            capture_output=True, text=True, timeout=600, cwd=_REPO,
+            env=cpu_env)
+        trace_rc = r.returncode
+        artifact["trace_report"] = {
+            "returncode": r.returncode,
+            "tail": "\n".join(r.stdout.splitlines()[-3:]),
+            "stderr_tail": "\n".join(r.stderr.splitlines()[-8:])}
+    except subprocess.TimeoutExpired:
+        trace_rc = -1
+        artifact["trace_report"] = {"returncode": -1,
+                                    "note": "timed out"}
+
     artifact["duration_s"] = round(time.time() - t0, 1)  # incl. gate
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=1)
     print(out.splitlines()[-1] if out.splitlines() else "")
     print(f"wrote {args.out}")
-    return 0 if p.returncode == 0 and opperf_rc in (None, 0) else 1
+    return 0 if p.returncode == 0 and opperf_rc in (None, 0) \
+        and trace_rc in (None, 0) else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""Summarize a chrome-trace dump into a per-phase step-time table.
+
+The profiler + telemetry layer dumps one flat chrome://tracing JSON
+(`mx.profiler.dump(...)`).  This CLI turns it into the table a BENCH
+run attributes regressions with: per phase (data-wait, forward,
+backward, grad-allreduce, optimizer-update; admission, queue-wait,
+batch-assembly, execute, respond; per-op dispatch lanes), the count,
+total/mean/min/max milliseconds, and share of trace wall time.
+
+    python tools/trace_report.py trace.json            # table
+    python tools/trace_report.py trace.json --json     # machine-readable
+    python tools/trace_report.py trace.json --check    # integrity gate
+    python tools/trace_report.py --selftest            # generate+check
+
+`--check` validates trace integrity (the nightly lane runs it via
+`--selftest`): the JSON parses, every event carries name/ph/ts/pid,
+duration events carry dur, counter lanes that are cumulative counters
+are monotone, flow arrows reference span trace ids that exist, and
+span parent links resolve within their trace.  Exit 0 = clean,
+1 = violations (printed), 2 = usage/IO error.
+
+NOTE: --check expects a COMPLETE capture — dump at a quiescent point
+(no requests in flight).  A periodic `dump(finished=True)` that cuts
+a request mid-flight legitimately splits its flow/parent links across
+two dumps; check the concatenation, not the pieces.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+# counter-lane suffixes that are cumulative (monotone non-decreasing);
+# point-in-time lanes (queue_depth, occupancy, ...) are exempt
+MONOTONE_SUFFIXES = (
+    "requests", "completed", "failed", "rejected", "deadline_expired",
+    "batches", "batched_rows", "padded_rows", "cache_hits",
+    "cache_misses", "_total",
+)
+
+
+def load_trace(path: str) -> list:
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, list):  # chrome also accepts the bare array form
+        return data
+    events = data.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: no traceEvents array")
+    return events
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def phase_rows(events: list) -> list:
+    """[(cat, name, count, total_ms, mean_ms, min_ms, max_ms, share)]
+    over the X (complete) events, sorted by total time desc."""
+    groups: dict = defaultdict(list)
+    lo, hi = None, None
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        ts, dur = ev.get("ts", 0.0), ev.get("dur", 0.0)
+        lo = ts if lo is None else min(lo, ts)
+        hi = ts + dur if hi is None else max(hi, ts + dur)
+        groups[(ev.get("cat", ""), ev.get("name", ""))].append(dur)
+    wall_us = (hi - lo) if (lo is not None and hi is not None and
+                            hi > lo) else None
+    rows = []
+    for (cat, name), durs in groups.items():
+        tot = sum(durs)
+        rows.append((cat, name, len(durs), tot / 1e3,
+                     tot / len(durs) / 1e3, min(durs) / 1e3,
+                     max(durs) / 1e3,
+                     (tot / wall_us) if wall_us else None))
+    rows.sort(key=lambda r: -r[3])
+    return rows
+
+
+def render_table(events: list) -> str:
+    rows = phase_rows(events)
+    steps = sum(1 for ev in events
+                if ev.get("ph") == "X" and ev.get("name") == "step")
+    traces = {ev["args"]["trace_id"] for ev in events
+              if ev.get("ph") == "X"
+              and isinstance(ev.get("args"), dict)
+              and "trace_id" in ev["args"]}
+    out = [f"{'Category':<12s} {'Phase':<28s} {'Count':>7s} "
+           f"{'Total(ms)':>11s} {'Mean(ms)':>10s} {'Min(ms)':>9s} "
+           f"{'Max(ms)':>9s} {'%Wall':>7s}"]
+    out.append("-" * len(out[0]))
+    for cat, name, n, tot, mean, mn, mx, share in rows:
+        pct = f"{share * 100:6.1f}%" if share is not None else "      -"
+        out.append(f"{cat:<12.12s} {name:<28.28s} {n:>7d} {tot:>11.3f} "
+                   f"{mean:>10.4f} {mn:>9.4f} {mx:>9.4f} {pct:>7s}")
+    if not rows:
+        out.append("(no duration events)")
+    tail = [f"events: {len(events)}"]
+    if steps:
+        tail.append(f"training steps: {steps}")
+    if traces:
+        tail.append(f"distinct trace ids: {len(traces)}")
+    out.append("  ".join(tail))
+    return "\n".join(out)
+
+
+def report_json(events: list) -> dict:
+    return {
+        "phases": [
+            {"cat": cat, "name": name, "count": n,
+             "total_ms": round(tot, 3), "mean_ms": round(mean, 4),
+             "min_ms": round(mn, 4), "max_ms": round(mx, 4),
+             "wall_share": None if share is None else round(share, 4)}
+            for cat, name, n, tot, mean, mn, mx, share
+            in phase_rows(events)],
+        "num_events": len(events),
+    }
+
+
+# ---------------------------------------------------------------------------
+# integrity check
+# ---------------------------------------------------------------------------
+
+def check_events(events: list) -> list:
+    """Returns a list of violation strings (empty = clean)."""
+    errs = []
+    span_ids_by_trace = defaultdict(set)
+    trace_ids = set()
+    counters = defaultdict(list)  # lane name -> [(ts, value)]
+    for i, ev in enumerate(events):
+        ph = ev.get("ph")
+        for field in ("name", "ph", "ts", "pid"):
+            if field not in ev:
+                errs.append(f"event[{i}] missing {field!r}: {ev!r:.120}")
+                break
+        if ph == "X" and "dur" not in ev:
+            errs.append(f"event[{i}] ({ev.get('name')!r}): X event "
+                        f"without dur")
+        args = ev.get("args")
+        if ph == "X" and isinstance(args, dict) and "trace_id" in args:
+            trace_ids.add(args["trace_id"])
+            if "span_id" in args:
+                span_ids_by_trace[args["trace_id"]].add(args["span_id"])
+        if ph == "C" and isinstance(args, dict):
+            for lane, v in args.items():
+                if isinstance(v, (int, float)):
+                    counters[lane].append((ev.get("ts", 0.0), v))
+    # counter lanes expected monotone
+    for lane, samples in counters.items():
+        if not lane.endswith(MONOTONE_SUFFIXES):
+            continue
+        samples.sort(key=lambda sv: sv[0])
+        last = None
+        for ts, v in samples:
+            if last is not None and v < last:
+                errs.append(f"counter lane {lane!r} decreases "
+                            f"({last} -> {v}) but is cumulative")
+                break
+            last = v
+    # flow arrows must reference a span's trace id
+    for i, ev in enumerate(events):
+        if ev.get("ph") in ("s", "f"):
+            fid = ev.get("id")
+            if fid not in trace_ids:
+                errs.append(f"flow event[{i}] id {fid!r} references no "
+                            f"span trace_id in this dump")
+    # parent links resolve within their trace
+    for i, ev in enumerate(events):
+        args = ev.get("args")
+        if ev.get("ph") != "X" or not isinstance(args, dict):
+            continue
+        parent = args.get("parent_id")
+        if parent is None:
+            continue
+        tid = args.get("trace_id")
+        if parent not in span_ids_by_trace.get(tid, ()):
+            errs.append(f"event[{i}] ({ev.get('name')!r}) parent_id "
+                        f"{parent!r} not found in trace {tid!r}")
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# selftest: generate a real trace through the framework, then check it
+# ---------------------------------------------------------------------------
+
+def selftest(keep: bool = False) -> int:
+    import os
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, telemetry
+    from mxnet_tpu.gluon import nn, Trainer
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    telemetry.enable()
+    mx.profiler.start()
+    try:
+        net = nn.Dense(4, in_units=8)
+        net.initialize()
+        xs = np.random.RandomState(0).rand(12, 8).astype("float32")
+        ys = np.random.RandomState(1).rand(12, 4).astype("float32")
+        data = ArrayDataset(mx.nd.array(xs), mx.nd.array(ys))
+        loader = DataLoader(data, batch_size=4)
+        trainer = Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1})
+        for x, y in loader:
+            with autograd.record():
+                loss = ((net(x) - y) ** 2).sum()
+            loss.backward()
+            trainer.step(4)
+        mx.nd.waitall()
+    finally:
+        mx.profiler.stop()
+        telemetry.disable()
+    fd, path = tempfile.mkstemp(suffix=".json", prefix="mx_trace_")
+    os.close(fd)
+    mx.profiler.dump(finished=True, filename=path)
+    events = load_trace(path)
+    errs = check_events(events)
+    print(render_table(events))
+    names = {ev.get("name") for ev in events if ev.get("ph") == "X"}
+    for phase in ("data-wait", "forward", "backward", "grad-allreduce",
+                  "optimizer-update", "step"):
+        if phase not in names:
+            errs.append(f"selftest trace missing phase {phase!r}")
+    for e in errs:
+        print(f"CHECK FAIL: {e}", file=sys.stderr)
+    if not keep:
+        os.unlink(path)
+    else:
+        print(f"trace kept at {path}")
+    print(f"selftest: {len(events)} events, "
+          f"{'OK' if not errs else f'{len(errs)} violations'}")
+    return 1 if errs else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-phase summary + integrity check for "
+                    "chrome-trace dumps")
+    ap.add_argument("trace", nargs="?", help="profiler.dump() JSON file")
+    ap.add_argument("--check", action="store_true",
+                    help="validate trace integrity instead of printing "
+                         "the table")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON")
+    ap.add_argument("--selftest", action="store_true",
+                    help="generate a trace via a tiny training loop, "
+                         "then check it (nightly lane)")
+    ap.add_argument("--keep", action="store_true",
+                    help="with --selftest: keep the generated trace")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest(keep=args.keep)
+    if not args.trace:
+        ap.print_usage(sys.stderr)
+        return 2
+    try:
+        events = load_trace(args.trace)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.check:
+        errs = check_events(events)
+        for e in errs:
+            print(f"CHECK FAIL: {e}", file=sys.stderr)
+        print(f"{args.trace}: {len(events)} events, "
+              f"{'OK' if not errs else f'{len(errs)} violations'}")
+        return 1 if errs else 0
+    if args.json:
+        print(json.dumps(report_json(events), indent=1))
+    else:
+        print(render_table(events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
