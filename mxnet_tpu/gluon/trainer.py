@@ -5,9 +5,19 @@ step(batch_size) = allreduce_grads() + update() — identical contract to the
 reference (CS2 in SURVEY.md).  On a sharded mesh the allreduce is in-graph
 (psum inserted by XLA via the parallel module); here the KVStore handles
 replica reduction + optional DCN sync.
+
+The hot path is FUSED by default (``fuse_step``): the gradient allreduce
+runs through ``KVStore.pushpull_fused`` (one collective per ~4 MB bucket
+instead of one per key) and the optimizer update through
+``optimizer.FusedUpdater`` (the whole parameter pytree in one donated
+jit dispatch, see optimizer/fused.py).  Anything the fused path cannot
+express — kvstore-side updates, gradient compression, sparse gradients —
+falls back to the eager per-parameter loop transparently, per step.
 """
 from __future__ import annotations
 
+import pickle
+import warnings
 from typing import Dict, List, Optional, Union
 
 from .. import kvstore as kvs_mod
@@ -31,7 +41,7 @@ def _phase_metric(phase: str):
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, fuse_step=None):
         if isinstance(params, (dict, ParameterDict)):
             params = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -48,12 +58,23 @@ class Trainer:
         optimizer_params = optimizer_params or {}
         self._scale = optimizer_params.get("rescale_grad", 1.0)
         self._init_optimizer(optimizer, optimizer_params)
-        self._compression_params = compression_params
+        # normalized to None when falsy: _init_kvstore only configures
+        # compression for truthy values, and the fused-path gate must
+        # agree with it (a literal {} configures nothing)
+        self._compression_params = compression_params or None
         self._kvstore_kind = kvstore
         self._kvstore: Optional[kvs_mod.KVStore] = None
         self._update_on_kvstore = update_on_kvstore
         self._kv_initialized = False
         self._states_to_load = None
+        # None = auto: fuse when the optimizer has a fused path and
+        # nothing forces key-level treatment (resolved after kv init)
+        self._fuse_step = fuse_step
+        self._fuse_active: Optional[bool] = None
+        # separate latch for the UPDATE half only: an optimizer/dtype
+        # combination the fused updater can't express must not forfeit
+        # the (independent) bucketed gradient allreduce
+        self._fuse_update_ok = True
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -68,8 +89,36 @@ class Trainer:
                                              **optimizer_params)
         # one updater per context replica (ref: Trainer._updaters) — each
         # replica must own its optimizer state; allocated lazily once the
-        # context list is known
-        self._updaters: List[opt_mod.Updater] = []
+        # context list is known.  FusedUpdater extends Updater (same state
+        # dict, same serialized payload) and its inherited per-parameter
+        # __call__ is the eager fallback path.
+        self._updaters: List[opt_mod.FusedUpdater] = []
+
+    def _new_updater(self) -> opt_mod.FusedUpdater:
+        return opt_mod.FusedUpdater(self._optimizer)
+
+    def _fuse_resolved(self) -> bool:
+        """Whether the fused step path is engaged (decided once, after
+        the kvstore mode is known).  Explicit ``fuse_step=True`` against
+        an unfusable configuration falls back with one warning — the
+        fused path is a pure optimization, never a semantics change."""
+        if self._fuse_active is None:
+            allowed = (not self._update_on_kvstore
+                       and self._compression_params is None
+                       and self._optimizer.fused_static_key() is not None)
+            if self._fuse_step is None:
+                self._fuse_active = allowed
+            elif self._fuse_step and not allowed:
+                warnings.warn(
+                    "Trainer(fuse_step=True) requires a local update "
+                    "(no kvstore-side optimizer, no gradient "
+                    "compression) and an optimizer with a fused path; "
+                    "falling back to the eager per-parameter loop.",
+                    UserWarning, stacklevel=3)
+                self._fuse_active = False
+            else:
+                self._fuse_active = bool(self._fuse_step)
+        return self._fuse_active
 
     def _init_kvstore(self):
         if self._kvstore_kind is None or self._kvstore_kind is False:
@@ -139,6 +188,8 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        if self._fuse_resolved() and self._allreduce_grads_fused():
+            return
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
@@ -149,6 +200,27 @@ class Trainer:
             elif len(grads) > 1 or self._kvstore.type.startswith("dist"):
                 self._kvstore.push(i, grads)
                 self._kvstore.pull(i, out=grads)
+
+    def _allreduce_grads_fused(self) -> bool:
+        """One bucketed pushpull over every dense gradient; returns
+        False (caller runs the eager per-key loop) when a sparse
+        gradient needs key-level treatment this step."""
+        from ..ndarray.sparse import BaseSparseNDArray
+
+        dist = self._kvstore.type.startswith("dist")
+        keys, grads = [], []
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            g = p.list_grad()
+            if len(g) > 1 or dist:
+                if any(isinstance(x, BaseSparseNDArray) for x in g):
+                    return False
+                keys.append(i)
+                grads.append(g)
+        if keys:
+            self._kvstore.pushpull_fused(keys, grads, out=grads)
+        return True
 
     def update(self, batch_size: int, ignore_stale_grad: bool = False):
         if not self._kv_initialized:
@@ -166,25 +238,113 @@ class Trainer:
     def _update(self, ignore_stale_grad: bool = False):
         if self._update_on_kvstore:
             return  # weights already refreshed by pushpull
+        if self._fuse_resolved() and self._fuse_update_ok \
+                and self._update_fused():
+            return
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
             for r, (data, grad) in enumerate(zip(p.list_data(),
                                                  p.list_grad())):
                 while len(self._updaters) <= r:
-                    self._updaters.append(opt_mod.get_updater(self._optimizer))
+                    self._updaters.append(self._new_updater())
                 self._updaters[r](i, grad, data)
 
+    def _update_fused(self) -> bool:
+        """Single-dispatch update: one FusedUpdater.update_all per
+        replica.  Returns False (caller runs the eager loop) when this
+        step's gradients are sparse or the replica layout is ragged."""
+        from ..ndarray.sparse import BaseSparseNDArray
+
+        idxs: List[int] = []
+        plist: List[Parameter] = []
+        nrep = None
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            grads = p.list_grad()
+            if any(isinstance(g, BaseSparseNDArray) for g in grads):
+                return False
+            if nrep is None:
+                nrep = len(grads)
+            elif len(grads) != nrep:
+                return False  # ragged replica layout: eager handles it
+            idxs.append(i)
+            plist.append(p)
+        if not plist:
+            return True
+        ctxs = plist[0].list_ctx()
+        if any(p.list_ctx() != ctxs for p in plist[1:]):
+            return False  # mixed placement: one program per device
+                          # would be needed; eager handles it
+        while len(self._updaters) < nrep:
+            self._updaters.append(self._new_updater())
+        if not self._updaters[0].supports(
+                idxs, [p.list_data()[0] for p in plist]):
+            # static for the run (optimizer class + weight dtypes):
+            # latch the UPDATE half to eager — no per-step probe, no
+            # phantom fused-update span, no doomed retry — while the
+            # bucketed allreduce keeps running
+            self._fuse_update_ok = False
+            return False
+
+        def run():
+            for r in range(nrep):
+                self._updaters[r].update_all(
+                    idxs, [p.list_grad()[r] for p in plist],
+                    [p.list_data()[r] for p in plist])
+
+        try:
+            if not _tracing.active():
+                run()
+                return True
+            with _tracing.span("fused-update", cat="training",
+                               metric=_phase_metric("fused-update")):
+                run()
+        except opt_mod.FusedUnsupported:
+            # safety net (supports() should have caught it): replay
+            # eagerly and stop taking the fused update path
+            self._fuse_update_ok = False
+            return False
+        if _tracing._ENABLED:
+            _ins.fused_step_total().inc()
+        return True
+
     def save_states(self, fname: str):
+        """Persist optimizer state for EVERY replica updater.  One
+        replica keeps the reference single-payload format; multiple
+        replicas wrap the per-replica payloads (each replica owns its
+        own momentum/variance buffers — saving only replica 0 silently
+        dropped the rest)."""
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=False)
+            return
+        if not self._updaters:
+            self._updaters.append(self._new_updater())
+        if len(self._updaters) == 1:
+            payload = self._updaters[0].get_states(dump_optimizer=False)
         else:
-            if not self._updaters:
-                self._updaters.append(opt_mod.get_updater(self._optimizer))
-            with open(fname, "wb") as f:
-                f.write(self._updaters[0].get_states(dump_optimizer=False))
+            payload = pickle.dumps({"__mx_replica_states__": [
+                u.get_states(dump_optimizer=False)
+                for u in self._updaters]})
+        with open(fname, "wb") as f:
+            f.write(payload)
+
+    def _replica_ctxs(self):
+        """The context list the replica updaters map onto — the LONGEST
+        ctx list across trainable parameters, because updater r serves
+        replica r of every parameter that has one (ragged layouts run
+        the eager loop but share the same updater list).  None when no
+        trainable parameter is initialized yet."""
+        best = None
+        for p in self._params:
+            if p.grad_req != "null" and p._data is not None:
+                ctxs = p.list_ctx()
+                if best is None or len(ctxs) > len(best):
+                    best = ctxs
+        return best
 
     def load_states(self, fname: str):
         if not self._kv_initialized:
@@ -192,8 +352,32 @@ class Trainer:
             return
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
+            return
+        with open(fname, "rb") as f:
+            data = f.read()
+        obj = pickle.loads(data)
+        # size the updater list by the REPLICA count (knowable from the
+        # parameters), not by how many updaters happen to exist — a
+        # fresh trainer has none, and restoring fewer than the replica
+        # count would leave later replicas stepping from zero state
+        ctxs = self._replica_ctxs()
+        nrep = len(ctxs) if ctxs else max(len(self._updaters), 1)
+        while len(self._updaters) < nrep:
+            self._updaters.append(self._new_updater())
+        if isinstance(obj, dict) and "__mx_replica_states__" in obj:
+            blobs = obj["__mx_replica_states__"]
+            if len(blobs) != len(self._updaters):
+                raise MXNetError(
+                    f"checkpoint {fname!r} holds {len(blobs)} replica "
+                    f"states but this trainer runs "
+                    f"{len(self._updaters)} replicas — a partial "
+                    "restore would silently leave stale or zero "
+                    "optimizer state on some replicas")
+            for r, (u, blob) in enumerate(zip(self._updaters, blobs)):
+                u.set_states(blob, ctx=ctxs[r] if ctxs else None)
         else:
-            if not self._updaters:
-                self._updaters.append(opt_mod.get_updater(self._optimizer))
-            with open(fname, "rb") as f:
-                self._updaters[0].set_states(f.read())
+            # single-payload format: every replica gets the same state
+            # (replicas hold identical state when training is in sync),
+            # each placed on its own replica's device
+            for r, u in enumerate(self._updaters):
+                u.set_states(data, ctx=ctxs[r] if ctxs else None)

@@ -22,13 +22,14 @@ update_on_kvstore, ref kvstore_dist_server.h) are preserved.
 from __future__ import annotations
 
 import functools
+import math
 import pickle
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 
-from .base import MXNetError
+from .base import MXNetError, get_env
 from .ndarray.ndarray import NDArray
 from . import optimizer as opt_mod
 
@@ -74,10 +75,17 @@ class KVStore:
     def push(self, key, value, priority: int = 0):
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
-            agg = self._reduce(_as_list(v))
+            vlist = _as_list(v)
+            agg = self._reduce(vlist)
             if self._kind.startswith("dist"):
                 agg = self._dcn_allreduce(agg, key=k)
-            elif self._check_compressible(agg):
+            elif self._check_compressible(agg) and len(vlist) > 1:
+                # _check_compressible first: the loud sparse+compression
+                # rejection must fire even for one replica.  The lossy
+                # quantize/dequantize round-trip itself is skipped for a
+                # single replica + no DCN group — nothing is
+                # transmitted, so nothing may be degraded; it runs only
+                # when there is an (emulated) inter-device wire
                 agg = self._compress_roundtrip(k, agg)
             if self._updater is not None:
                 if k not in self._store:
@@ -111,10 +119,13 @@ class KVStore:
         keys, values = self._normalize(key, value)
         _, outs = self._normalize(key, out if out is not None else value)
         for k, v, o in zip(keys, values, outs):
-            agg = self._reduce(_as_list(v))
+            vlist = _as_list(v)
+            agg = self._reduce(vlist)
             if self._kind.startswith("dist"):
                 agg = self._dcn_allreduce(agg, key=k)
-            elif self._check_compressible(agg):
+            elif self._check_compressible(agg) and len(vlist) > 1:
+                # see push(): sparse rejection stays loud; the lossy
+                # round-trip is skipped when nothing is transmitted
                 agg = self._compress_roundtrip(k, agg)
             if self._updater is not None:
                 if k not in self._store:
@@ -126,6 +137,85 @@ class KVStore:
                     raise MXNetError(
                         "pushpull with a sparse out is not supported; use "
                         "push + row_sparse_pull")
+                dst._data = agg.as_in_context(dst.ctx)._data
+
+    def pushpull_fused(self, keys, values, out=None, priority: int = 0,
+                       bucket_bytes: Optional[int] = None):
+        """Bucketed allreduce over MANY keys: flatten the dense values
+        into ~4 MB dtype-homogeneous buckets and run ONE fused
+        reduce (and, on dist stores, one DCN allreduce) per bucket
+        instead of one per key — the launch-overhead half of the
+        EQuARX allreduce-efficiency argument (arXiv:2506.17615).
+
+        Same out-array semantics as calling ``pushpull(k, v, out=o)``
+        per key; the bucketed path additionally publishes each reduced
+        value to the store (the push contract), so a later ``pull``
+        observes the latest reduction just as it did under the eager
+        Trainer's push+pull loop.  Per-key treatment (server-side
+        updater, gradient compression with its per-key residuals,
+        sparse values) transparently falls back to the per-key loop.
+        ``bucket_bytes`` defaults to ``MXNET_FUSED_BUCKET_BYTES``
+        (4 MiB)."""
+        from .ndarray.sparse import BaseSparseNDArray
+
+        keys = list(keys)
+        vals = [_as_list(v) for v in values]
+        outs = vals if out is None else [_as_list(o) for o in out]
+        if len(vals) != len(keys) or len(outs) != len(keys):
+            raise MXNetError("pushpull_fused: key/value/out length mismatch")
+        if (self._updater is not None or self._compression is not None
+                or any(isinstance(x, BaseSparseNDArray)
+                       for v in vals for x in v)):
+            for k, v, o in zip(keys, vals, outs):
+                self.pushpull(k, v, out=o, priority=priority)
+            return
+        if bucket_bytes is None:
+            bucket_bytes = _BUCKET_BYTES
+        # order-preserving greedy packing into (dtype, n_replicas)-
+        # homogeneous buckets capped at bucket_bytes (always >= 1 key)
+        buckets: List[List[int]] = []
+        cur: List[int] = []
+        cur_sig, cur_bytes = None, 0
+        for pos, v in enumerate(vals):
+            d = v[0].data
+            sig = (str(d.dtype), len(v))
+            nbytes = d.size * d.dtype.itemsize
+            if cur and (sig != cur_sig or cur_bytes + nbytes > bucket_bytes):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(pos)
+            cur_sig, cur_bytes = sig, cur_bytes + nbytes
+        if cur:
+            buckets.append(cur)
+        dist = self._kind.startswith("dist")
+        for bucket in buckets:
+            self._bucket_allreduce(bucket, keys, vals, outs, dist)
+
+    def _bucket_allreduce(self, poss: List[int], keys, vals, outs,
+                          dist: bool):
+        """Reduce one bucket of keys: concat per-replica flats, one
+        balanced-tree sum (+ one DCN allreduce when dist), split back."""
+        first = vals[poss[0]][0]
+        nrep = len(vals[poss[0]])
+        dev = first.ctx.jax_device
+        shapes = tuple(tuple(vals[p][0].shape) for p in poss)
+        parts = []
+        for r in range(nrep):
+            for p in poss:
+                d = vals[p][r].data
+                if list(d.devices()) != [dev]:
+                    d = jax.device_put(d, dev)
+                parts.append(d)
+        if dist:
+            flat = _bucket_concat_sum(nrep, len(poss))(*parts)
+            flat = self._dcn_allreduce(NDArray(flat, ctx=first.ctx)).data
+            segs = _bucket_split(shapes)(flat)
+        else:
+            segs = _bucket_sum_split(nrep, shapes)(*parts)
+        for p, seg in zip(poss, segs):
+            agg = NDArray(seg, ctx=first.ctx)
+            self._store[keys[p]] = agg  # push contract: publish latest
+            for dst in _as_list(outs[p]):
                 dst._data = agg.as_in_context(dst.ctx)._data
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
@@ -284,19 +374,77 @@ def _key_int(k):
         return abs(hash(k)) % (2 ** 31)
 
 
+def _balanced_sum(xs):
+    """Pairwise (balanced-tree) sum of a list of same-shaped arrays."""
+    xs = list(xs)
+    while len(xs) > 1:
+        nxt = [xs[i] + xs[i + 1] for i in range(0, len(xs) - 1, 2)]
+        if len(xs) % 2:
+            nxt.append(xs[-1])
+        xs = nxt
+    return xs[0]
+
+
 @functools.lru_cache(maxsize=None)
 def _tree_sum(n: int):
     """One fused XLA program summing n same-shaped arrays pairwise."""
+    return jax.jit(lambda *xs: _balanced_sum(xs))
 
-    def balanced(xs):
-        while len(xs) > 1:
-            nxt = [xs[i] + xs[i + 1] for i in range(0, len(xs) - 1, 2)]
-            if len(xs) % 2:
-                nxt.append(xs[-1])
-            xs = nxt
-        return xs[0]
 
-    return jax.jit(lambda *xs: balanced(list(xs)))
+# ---- gradient bucketing (pushpull_fused) ---------------------------------
+#
+# One XLA program per bucket signature: variadic inputs arrive replica-
+# major ([r0k0, r0k1, ..., r1k0, ...]); each replica's segments are
+# flattened and concatenated, the replica flats are tree-summed, and the
+# reduced flat is sliced back into per-key shapes.  jax.jit retraces per
+# dtype/device automatically, so the lru key is structure only.
+
+_BUCKET_BYTES = get_env("MXNET_FUSED_BUCKET_BYTES", 4 << 20, int)
+
+
+def _flat_concat(seg):
+    fl = [x.reshape(-1) for x in seg]
+    return fl[0] if len(fl) == 1 else jnp.concatenate(fl)
+
+
+def _split_segments(flat, shapes):
+    segs, off = [], 0
+    for s in shapes:
+        size = math.prod(s) if s else 1
+        segs.append(flat[off:off + size].reshape(s))
+        off += size
+    return tuple(segs)
+
+
+@functools.lru_cache(maxsize=None)
+def _bucket_sum_split(nrep: int, shapes: tuple):
+    """concat + replica tree-sum + split, fused into one program (the
+    single-dispatch path for non-dist stores)."""
+    nk = len(shapes)
+
+    def f(*parts):
+        flats = [_flat_concat(parts[r * nk:(r + 1) * nk])
+                 for r in range(nrep)]
+        return _split_segments(_balanced_sum(flats), shapes)
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def _bucket_concat_sum(nrep: int, nk: int):
+    """concat + replica tree-sum -> one flat bucket (the DCN allreduce
+    payload for dist stores)."""
+
+    def f(*parts):
+        return _balanced_sum([_flat_concat(parts[r * nk:(r + 1) * nk])
+                              for r in range(nrep)])
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def _bucket_split(shapes: tuple):
+    return jax.jit(lambda flat: _split_segments(flat, shapes))
 
 
 _VALID = {"local", "device", "xla", "nccl", "dist", "dist_sync", "dist_async",

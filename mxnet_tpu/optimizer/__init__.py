@@ -1,7 +1,10 @@
 from .optimizer import (Optimizer, Updater, create, register, get_updater,
                         SGD, NAG, Adam, AdaGrad, AdaDelta, Adamax, Nadam,
                         RMSProp, Ftrl, Signum, SignSGD, LAMB, Test)
+from .fused import FusedUpdater, FusedUnsupported
 
-__all__ = ["Optimizer", "Updater", "create", "register", "get_updater",
-           "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta", "Adamax", "Nadam",
-           "RMSProp", "Ftrl", "Signum", "SignSGD", "LAMB", "Test"]
+__all__ = ["Optimizer", "Updater", "FusedUpdater", "FusedUnsupported",
+           "create", "register",
+           "get_updater", "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta",
+           "Adamax", "Nadam", "RMSProp", "Ftrl", "Signum", "SignSGD",
+           "LAMB", "Test"]

@@ -15,7 +15,7 @@ import numpy as np
 
 from ..base import MXNetError, Registry
 from ..ndarray.ndarray import NDArray, zeros
-from ..ops.registry import invoke
+from ..ops.registry import apply_pure, invoke
 
 __all__ = ["Optimizer", "Updater", "create", "register", "get_updater"]
 
@@ -105,14 +105,76 @@ class Optimizer:
                     clip_gradient=self.clip_gradient
                     if self.clip_gradient is not None else -1.0)
 
+    # ---- fused step (pure-function view of the update math) -------------
+    #
+    # The eager path above dispatches one registered update op per
+    # parameter.  The fused path (optimizer/fused.py) applies the SAME
+    # registered pure functions over the whole parameter pytree in one
+    # jitted program.  The split of responsibilities:
+    #
+    #   _FUSED_STATIC : names of the attrs the math reads at trace time
+    #       (momentum, betas, clip_gradient, ...).  They key the
+    #       executable cache; changing one retraces, which is correct.
+    #       None (the base default) marks an optimizer as not fusible.
+    #   fused_hyper   : per-step host-side scalars (lr with mults and
+    #       bias correction folded in, wd, rescale_grad, the step count
+    #       t where the kernel needs it).  These enter the program as
+    #       TRACED arguments, so set_learning_rate / a new
+    #       rescale_grad = scale/batch_size never retrigger a compile.
+    #   fused_apply   : the pure math, (weight, grad, state, hyper) ->
+    #       (new_weight, new_state) on raw jax values.
+
+    _FUSED_STATIC: Optional[Tuple[str, ...]] = None
+    # True when fused_hyper carries the raw step count "t" (bias
+    # correction computed INSIDE the kernel).  t participates in the
+    # per-parameter dtype cast, and half floats cannot represent
+    # integers past 256 (bf16) / 2048 (f16) — so these optimizers take
+    # the eager loop for half-precision weights without a multi-
+    # precision master copy (see FusedUpdater.update_all).
+    _FUSED_T_HYPER = False
+
+    def fused_static_key(self) -> Optional[Tuple]:
+        """Hashable fingerprint of the trace-time attrs, or None when
+        this optimizer has no fused path (fall back to the eager loop)."""
+        if self._FUSED_STATIC is None:
+            return None
+        return tuple((a, getattr(self, a)) for a in self._FUSED_STATIC)
+
+    def fused_hyper(self, index, t) -> Dict[str, float]:
+        """Per-step scalars for parameter `index` at update count `t`,
+        computed on the host and passed as traced jit arguments."""
+        return {"lr": float(self._get_lr(index)),
+                "wd": float(self._get_wd(index)),
+                "rescale_grad": float(self.rescale_grad)}
+
+    def _fused_clip(self) -> float:
+        return self.clip_gradient if self.clip_gradient is not None else -1.0
+
+    def _fused_common(self, hyper) -> Dict[str, Any]:
+        return dict(lr=hyper["lr"], wd=hyper["wd"],
+                    rescale_grad=hyper["rescale_grad"],
+                    clip_gradient=self._fused_clip())
+
+    def fused_apply(self, weight, grad, state, hyper):
+        """Pure update math on jax values: returns (new_weight, new_state)
+        with new_state mirroring the structure of `state`."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement the fused step")
+
     def update(self, index, weight, grad, state):
         raise NotImplementedError
 
+    def _mp_active(self, weight, state) -> bool:
+        """Whether `state` carries an fp32 master copy for a half-
+        precision weight — THE multi-precision predicate, shared by the
+        eager dispatch below and the fused path (optimizer/fused.py)."""
+        return (self.multi_precision and isinstance(state, tuple)
+                and isinstance(state[-1], NDArray)
+                and str(state[-1].data.dtype) == "float32"
+                and str(weight.data.dtype) in ("float16", "bfloat16"))
+
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and isinstance(state, tuple) and \
-                isinstance(state[-1], NDArray) and \
-                str(state[-1].data.dtype) == "float32" and \
-                str(weight.data.dtype) in ("float16", "bfloat16"):
+        if self._mp_active(weight, state):
             self._update_mp(index, weight, grad, state)
         else:
             self.update(index, weight, grad, state)
@@ -191,6 +253,15 @@ class SGD(Optimizer):
                     invoke("mp_sgd_mom_update", weight, grad, inner, w32,
                            momentum=self.momentum, **kw))
 
+    _FUSED_STATIC = ("momentum", "clip_gradient")
+
+    def fused_apply(self, weight, grad, state, hyper):
+        kw = self._fused_common(hyper)
+        if state is None:
+            return apply_pure("sgd_update", weight, grad, **kw), None
+        return apply_pure("sgd_mom_update", weight, grad, state,
+                          momentum=self.momentum, **kw)
+
 
 @register("nag")
 class NAG(SGD):
@@ -209,6 +280,13 @@ class NAG(SGD):
             _rebind([weight, state],
                     invoke("nag_mom_update", weight, grad, state,
                            momentum=self.momentum, **kw))
+
+    def fused_apply(self, weight, grad, state, hyper):
+        kw = self._fused_common(hyper)
+        if state is None:
+            return apply_pure("sgd_update", weight, grad, **kw), None
+        return apply_pure("nag_mom_update", weight, grad, state,
+                          momentum=self.momentum, **kw)
 
 
 @register("adam")
@@ -235,6 +313,23 @@ class Adam(Optimizer):
                        beta1=self.beta1, beta2=self.beta2,
                        epsilon=self.epsilon, **kw))
 
+    _FUSED_STATIC = ("beta1", "beta2", "epsilon", "clip_gradient")
+
+    def fused_hyper(self, index, t):
+        h = super().fused_hyper(index, t)
+        # same host-side bias-correction fold as the eager path — a new
+        # t only changes a traced scalar, never the program
+        h["lr"] *= (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
+        return h
+
+    def fused_apply(self, weight, grad, state, hyper):
+        mean, var = state
+        nw, nm, nv = apply_pure("adam_update", weight, grad, mean, var,
+                                beta1=self.beta1, beta2=self.beta2,
+                                epsilon=self.epsilon,
+                                **self._fused_common(hyper))
+        return nw, (nm, nv)
+
 
 @register("adagrad")
 class AdaGrad(Optimizer):
@@ -251,6 +346,13 @@ class AdaGrad(Optimizer):
         _rebind([weight, state],
                 invoke("adagrad_update", weight, grad, state,
                        epsilon=self.float_stable_eps, **kw))
+
+    _FUSED_STATIC = ("float_stable_eps", "clip_gradient")
+
+    def fused_apply(self, weight, grad, state, hyper):
+        return apply_pure("adagrad_update", weight, grad, state,
+                          epsilon=self.float_stable_eps,
+                          **self._fused_common(hyper))
 
 
 @register("adadelta")
@@ -273,6 +375,17 @@ class AdaDelta(Optimizer):
                 invoke("adadelta_update", weight, grad, acc_g, acc_d,
                        rho=self.rho, epsilon=self.epsilon, lr=1.0, **kw))
 
+    _FUSED_STATIC = ("rho", "epsilon", "clip_gradient")
+
+    def fused_apply(self, weight, grad, state, hyper):
+        acc_g, acc_d = state
+        nw, ng, ndel = apply_pure(
+            "adadelta_update", weight, grad, acc_g, acc_d, rho=self.rho,
+            epsilon=self.epsilon, lr=1.0, wd=hyper["wd"],
+            rescale_grad=hyper["rescale_grad"],
+            clip_gradient=self._fused_clip())
+        return nw, (ng, ndel)
+
 
 @register("adamax")
 class Adamax(Optimizer):
@@ -293,6 +406,21 @@ class Adamax(Optimizer):
         _rebind([weight, mean, var],
                 invoke("adamax_update", weight, grad, mean, var,
                        beta1=self.beta1, beta2=self.beta2, t=t, **kw))
+
+    _FUSED_STATIC = ("beta1", "beta2", "clip_gradient")
+    _FUSED_T_HYPER = True
+
+    def fused_hyper(self, index, t):
+        h = super().fused_hyper(index, t)
+        h["t"] = float(t)
+        return h
+
+    def fused_apply(self, weight, grad, state, hyper):
+        mean, var = state
+        nw, nm, nv = apply_pure("adamax_update", weight, grad, mean, var,
+                                beta1=self.beta1, beta2=self.beta2,
+                                t=hyper["t"], **self._fused_common(hyper))
+        return nw, (nm, nv)
 
 
 @register("nadam")
@@ -319,6 +447,24 @@ class Nadam(Optimizer):
                        beta1=self.beta1, beta2=self.beta2,
                        epsilon=self.epsilon, t=t,
                        schedule_decay=self.schedule_decay, **kw))
+
+    _FUSED_STATIC = ("beta1", "beta2", "epsilon", "schedule_decay",
+                     "clip_gradient")
+    _FUSED_T_HYPER = True
+
+    def fused_hyper(self, index, t):
+        h = super().fused_hyper(index, t)
+        h["t"] = float(t)
+        return h
+
+    def fused_apply(self, weight, grad, state, hyper):
+        mean, var = state
+        nw, nm, nv = apply_pure("nadam_update", weight, grad, mean, var,
+                                beta1=self.beta1, beta2=self.beta2,
+                                epsilon=self.epsilon, t=hyper["t"],
+                                schedule_decay=self.schedule_decay,
+                                **self._fused_common(hyper))
+        return nw, (nm, nv)
 
 
 @register("rmsprop")
@@ -354,6 +500,22 @@ class RMSProp(Optimizer):
                     invoke("rmsprop_update", weight, grad, state,
                            gamma1=self.gamma1, epsilon=self.epsilon, **kw))
 
+    _FUSED_STATIC = ("gamma1", "gamma2", "epsilon", "centered",
+                     "clip_weights", "clip_gradient")
+
+    def fused_apply(self, weight, grad, state, hyper):
+        kw = self._fused_common(hyper)
+        kw["clip_weights"] = self.clip_weights if self.clip_weights else -1.0
+        if self.centered:
+            n, g, delta = state
+            nw, nn, ng, nd = apply_pure(
+                "rmspropalex_update", weight, grad, n, g, delta,
+                gamma1=self.gamma1, gamma2=self.gamma2,
+                epsilon=self.epsilon, **kw)
+            return nw, (nn, ng, nd)
+        return apply_pure("rmsprop_update", weight, grad, state,
+                          gamma1=self.gamma1, epsilon=self.epsilon, **kw)
+
 
 @register("ftrl")
 class Ftrl(Optimizer):
@@ -373,6 +535,15 @@ class Ftrl(Optimizer):
         _rebind([weight, z, n],
                 invoke("ftrl_update", weight, grad, z, n, lamda1=self.lamda1,
                        beta=self.beta, **kw))
+
+    _FUSED_STATIC = ("lamda1", "beta", "clip_gradient")
+
+    def fused_apply(self, weight, grad, state, hyper):
+        z, n = state
+        nw, nz, nn = apply_pure("ftrl_update", weight, grad, z, n,
+                                lamda1=self.lamda1, beta=self.beta,
+                                **self._fused_common(hyper))
+        return nw, (nz, nn)
 
 
 @register("signum")
@@ -396,6 +567,15 @@ class Signum(Optimizer):
             _rebind([weight, state],
                     invoke("signum_update", weight, grad, state,
                            momentum=self.momentum, wd_lh=self.wd_lh, **kw))
+
+    _FUSED_STATIC = ("momentum", "wd_lh", "clip_gradient")
+
+    def fused_apply(self, weight, grad, state, hyper):
+        kw = self._fused_common(hyper)
+        if state is None:
+            return apply_pure("signsgd_update", weight, grad, **kw), None
+        return apply_pure("signum_update", weight, grad, state,
+                          momentum=self.momentum, wd_lh=self.wd_lh, **kw)
 
 
 @register("signsgd")
@@ -444,6 +624,31 @@ class LAMB(Optimizer):
                        upper_bound=self.upper_bound or -1.0)
         weight._data = new_w.data
 
+    _FUSED_STATIC = ("beta1", "beta2", "epsilon", "lower_bound",
+                     "upper_bound", "bias_correction", "clip_gradient")
+    _FUSED_T_HYPER = True
+
+    def fused_hyper(self, index, t):
+        h = super().fused_hyper(index, t)
+        h["t"] = float(t)
+        return h
+
+    def fused_apply(self, weight, grad, state, hyper):
+        mean, var = state
+        direction, nm, nv = apply_pure(
+            "lamb_update_phase1", weight, grad, mean, var,
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+            t=hyper["t"], bias_correction=self.bias_correction,
+            wd=hyper["wd"], rescale_grad=hyper["rescale_grad"],
+            clip_gradient=self._fused_clip())
+        r1 = apply_pure("norm", weight)
+        r2 = apply_pure("norm", direction)
+        nw = apply_pure("lamb_update_phase2", weight, direction, r1, r2,
+                        lr=hyper["lr"],
+                        lower_bound=self.lower_bound or -1.0,
+                        upper_bound=self.upper_bound or -1.0)
+        return nw, (nm, nv)
+
 
 @register("test")
 class Test(Optimizer):
@@ -452,6 +657,11 @@ class Test(Optimizer):
 
     def update(self, index, weight, grad, state):
         weight._data = (weight + grad * self.rescale_grad).data
+
+    _FUSED_STATIC = ()
+
+    def fused_apply(self, weight, grad, state, hyper):
+        return weight + grad * hyper["rescale_grad"], state
 
 
 class Updater:
@@ -484,24 +694,26 @@ class Updater:
                                  self.optimizer.__dict__.copy()))
         return pickle.dumps(payload)
 
-    def set_states(self, states):
+    def set_states(self, states, ctx=None):
+        """Restore a payload; `ctx` places the buffers on a specific
+        device — a replica updater's state must live WITH its replica,
+        not on the default device."""
         data = pickle.loads(states)
         if isinstance(data, tuple) and len(data) == 3:
             payload, _cls, _odict = data
         else:
             payload = data
-        # values are restored lazily onto the right ctx at first update
         self._pending = payload
         for k, v in payload.items():
-            self.states[k] = self._restore(v)
+            self.states[k] = self._restore(v, ctx)
 
-    def _restore(self, v):
+    def _restore(self, v, ctx=None):
         if isinstance(v, np.ndarray):
             from ..ndarray.ndarray import array
 
-            return array(v)
+            return array(v, ctx=ctx) if ctx is not None else array(v)
         if isinstance(v, tuple):
-            return tuple(self._restore(x) for x in v)
+            return tuple(self._restore(x, ctx) for x in v)
         return v
 
 

@@ -21,6 +21,7 @@ from .metrics import MetricFamily, get_registry
 __all__ = [
     "op_dispatch_total",
     "training_phase_seconds", "training_steps_total",
+    "fused_step_total", "fused_compile_seconds",
     "data_wait_seconds", "data_wait_last_seconds",
     "collective_seconds",
     "serving_counter", "serving_queue_depth", "serving_occupancy",
@@ -87,6 +88,19 @@ def training_phase_seconds(phase: str):
 def training_steps_total():
     return _child("mx_training_steps_total", "counter",
                   "Optimizer steps taken.")
+
+
+def fused_step_total():
+    return _child("mx_fused_step_total", "counter",
+                  "Trainer steps taken through the fused "
+                  "(single-dispatch) optimizer-update path.")
+
+
+def fused_compile_seconds():
+    return _child("mx_fused_compile_seconds", "histogram",
+                  "Seconds building one fused-step executable — the "
+                  "count is the no-recompile guarantee (an lr change "
+                  "must not grow it).")
 
 
 def data_wait_seconds():

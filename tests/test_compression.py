@@ -114,14 +114,78 @@ def test_custom_op_state_shared_fwd_bwd():
 
 def test_device_store_compression_roundtrips():
     """Reference parity: 'device' stores accept compression (only
-    'local' rejects); the pushed value is quantized."""
+    'local' rejects); a MULTI-replica push — the emulated inter-device
+    wire — is quantized."""
     kv = mx.kvstore.create("device")
     kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
     kv.init(0, nd.zeros((3,)))
-    kv.push(0, nd.array(np.array([0.9, -0.7, 0.1], "f4")))
+    kv.push(0, [nd.array(np.array([0.9, -0.7, 0.1], "f4")),
+                nd.array(np.array([0.0, 0.0, 0.5], "f4"))])
     out = nd.zeros((3,))
     kv.pull(0, out)
-    np.testing.assert_array_equal(out.asnumpy(), [0.5, -0.5, 0.0])
+    # quantize(sum) = quantize([0.9, -0.7, 0.6])
+    np.testing.assert_array_equal(out.asnumpy(), [0.5, -0.5, 0.5])
+
+
+def test_single_device_compression_is_bit_exact():
+    """One replica + no DCN group transmits nothing, so the lossy
+    quantize/dequantize round-trip must be SKIPPED: push/pushpull of a
+    single value is bit-exact even with compression params set."""
+    g = np.array([0.9, -0.7, 0.1, 0.24], "f4")
+    for op in ("push", "pushpull"):
+        kv = mx.kvstore.create("device")
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        kv.init(0, nd.zeros((4,)))
+        out = nd.zeros((4,))
+        if op == "push":
+            kv.push(0, nd.array(g))
+            kv.pull(0, out)
+        else:
+            kv.pushpull(0, nd.array(g), out=out)
+        np.testing.assert_array_equal(out.asnumpy(), g)
+
+
+def test_single_device_sparse_plus_compression_still_loud():
+    """Skipping the single-replica round-trip must NOT skip the sparse
+    rejection: the invalid config fails loud before the user scales."""
+    from mxnet_tpu.ndarray import sparse as sp
+
+    kv = mx.kvstore.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    rs = sp.row_sparse_array(
+        (np.ones((2, 3), "f4"), np.array([0, 2])), shape=(4, 3))
+    kv.init(0, nd.zeros((4, 3)))
+    with pytest.raises(MXNetError, match="sparse"):
+        kv.push(0, rs)
+
+
+def test_single_device_training_bit_exact_with_compression():
+    """End to end: a single-device Trainer configured with
+    compression_params trains bit-for-bit identically to one without —
+    nothing crosses a wire, so nothing may be degraded."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    def run(compression):
+        np.random.seed(7)
+        mx.random.seed(7)
+        net = nn.Dense(3, in_units=4)
+        net.initialize(mx.initializer.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9},
+                                kvstore="device",
+                                compression_params=compression)
+        x = nd.array(np.random.RandomState(3).randn(2, 4).astype("f4"))
+        for _ in range(3):
+            with autograd.record():
+                loss = (net(x) ** 2).sum()
+            loss.backward()
+            trainer.step(2)
+        return net.weight.data().asnumpy()
+
+    w_plain = run(None)
+    w_comp = run({"type": "2bit", "threshold": 0.5})
+    np.testing.assert_array_equal(w_comp, w_plain)
 
 
 def test_sparse_plus_compression_is_loud():

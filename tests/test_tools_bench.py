@@ -122,6 +122,29 @@ def test_bench_serving_smoke(tmp_path):
     assert json.loads(out.read_text()) == report
 
 
+def test_bench_fused_step_smoke(tmp_path):
+    """CLI smoke only: the fused-step bench runs and emits a
+    well-formed report with the compile count.  The strict
+    fused>=1.2x-eager gate lives in tests/nightly/
+    test_bench_fused_step.py (perf lane)."""
+    out = tmp_path / "FUSED_BENCH.json"
+    rows = _run([sys.executable, "tools/bench_fused_step.py",
+                 "--no-gate", "--params", "8", "--steps", "4",
+                 "--out", str(out)], timeout=420)
+    report = rows[-1]
+    assert report["metric"] == "fused_step_speedup"
+    assert set(report["sizes"]) == {"8"}
+    for row in report["sizes"].values():
+        assert row["eager_ms_per_step"] > 0
+        assert row["fused_ms_per_step"] > 0
+        # the no-recompile invariant is NOT noise-prone — a smoke run
+        # must already hold it (one executable per size, lr change
+        # included)
+        assert row["fused_compiles"] == 1
+    assert report["gate_params"] == 8
+    assert json.loads(out.read_text()) == report
+
+
 def test_bench_all_mnist_smoke():
     rows = _run([sys.executable, "bench_all.py", "--cpu-smoke",
                  "--config", "mnist_mlp"])

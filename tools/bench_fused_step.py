@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Fused vs eager training-step bench (ISSUE 3 gate).
+
+Builds a bag of N parameters (the shapes a smallish MLP/convnet head
+would own), drives two identical Trainers — ``fuse_step=True`` vs the
+eager per-parameter loop — through the same update schedule, and
+reports wall time per step.  The schedule includes a
+``set_learning_rate`` change and a batch-size change mid-run, so the
+report also carries the fused path's executable-build count: the
+no-recompile guarantee means it must be EXACTLY 1 per size.
+
+The claim under test is the single-dispatch thesis (arXiv:2004.13336's
+fused weight update): the eager loop pays one kernel launch per
+parameter per step, so at >= 100 parameters Python dispatch dominates
+and the fused path must win by >= 1.5x on accelerators (CPU CI gate
+1.2x to absorb shared-box noise).
+
+CPU smoke: JAX_PLATFORMS=cpu python tools/bench_fused_step.py --no-gate
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+# parameter shape ladder, cycled: mixes matrices, vectors (biases), and
+# small tensors so buckets and the fused program see realistic variety
+_SHAPES = [(64, 64), (64,), (32, 64), (32,), (16, 32, 3)]
+
+
+def _make_params(n: int, seed: int = 0):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.parameter import Parameter
+    from mxnet_tpu.ndarray.ndarray import array as nd_array
+
+    rng = np.random.RandomState(seed)
+    params = []
+    for i in range(n):
+        shp = _SHAPES[i % len(_SHAPES)]
+        p = Parameter(f"w{i}", shape=shp)
+        p.initialize(ctx=[mx.cpu()])
+        p.set_data(nd_array(rng.standard_normal(shp).astype("f4")))
+        params.append(p)
+    return params
+
+
+def _set_grads(params, seed: int = 42):
+    from mxnet_tpu.ndarray.ndarray import array as nd_array
+
+    rng = np.random.RandomState(seed)
+    for p in params:
+        g = rng.standard_normal(p.shape).astype("f4") * 1e-3
+        for gnd in p.list_grad():
+            gnd._data = nd_array(g, ctx=gnd.ctx).data
+
+
+def _block(params):
+    import jax
+
+    jax.block_until_ready([p.data().data for p in params])
+
+
+def _drive(trainer, params, steps: int, lr0: float):
+    """The measured schedule: lr change at 40%, batch-size change at
+    60% — the things a real training loop does between steps."""
+    for step in range(steps):
+        if step == int(steps * 0.4):
+            trainer.set_learning_rate(lr0 / 3)
+        trainer.step(2 if step < int(steps * 0.6) else 4)
+    _block(params)
+
+
+def bench_size(n_params: int, optimizer: str, steps: int, warmup: int,
+               lr: float, repeats: int = 3) -> dict:
+    from mxnet_tpu.gluon.trainer import Trainer
+    from mxnet_tpu.optimizer import fused as fused_mod
+
+    row: dict = {"params": n_params}
+    compiles0 = fused_mod.compile_stats()["count"]
+    opt_params = {"learning_rate": lr}
+    if optimizer in ("sgd", "nag", "signum"):
+        opt_params["momentum"] = 0.9  # stateful run; others carry
+        #                               their own state by default
+    for mode in ("eager", "fused"):
+        params = _make_params(n_params)
+        trainer = Trainer(params, optimizer, dict(opt_params),
+                          kvstore=None, fuse_step=(mode == "fused"))
+        _set_grads(params)
+        # warmup runs the IDENTICAL schedule so every (lr, batch-size)
+        # combination the timed region visits is already compiled for
+        # the eager path too — the timed region then measures
+        # steady-state dispatch, which is the claim under test.  (The
+        # fused compile counter still covers the whole run: exactly one
+        # executable despite the schedule changes.)
+        for _ in range(warmup):
+            _drive(trainer, params, steps, lr)
+            trainer.set_learning_rate(lr)
+        # best-of-N timed passes: this shared box stalls whole
+        # processes for seconds at a time, and best-of is the honest
+        # read of each path's real cost (bench_serving precedent)
+        best = None
+        for _ in range(max(repeats, 1)):
+            t0 = time.perf_counter()
+            _drive(trainer, params, steps, lr)
+            dt = time.perf_counter() - t0
+            trainer.set_learning_rate(lr)
+            best = dt if best is None else min(best, dt)
+        row[f"{mode}_ms_per_step"] = round(best / steps * 1e3, 4)
+    row["fused_compiles"] = \
+        fused_mod.compile_stats()["count"] - compiles0
+    row["speedup"] = round(
+        row["eager_ms_per_step"] / row["fused_ms_per_step"], 3)
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--params", default="10,100,500",
+                    help="comma-separated model sizes (parameter counts)")
+    ap.add_argument("--optimizer", default="sgd",
+                    help="sgd keeps the eager jit caches warm, so the "
+                         "comparison is pure dispatch overhead — the "
+                         "fairest read (adam-style optimizers also "
+                         "retrace eagerly on every lr fold, which "
+                         "inflates the win)")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=1,
+                    help="full-schedule warmup passes before timing")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="timed passes per mode; best-of wins (shared "
+                         "CI boxes stall; best-of is the honest read)")
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--min-speedup", type=float, default=1.2,
+                    help="gate threshold at the largest size >= 100 "
+                         "params (1.2 on CPU CI; the accelerator "
+                         "expectation is 1.5+)")
+    ap.add_argument("--out", default="FUSED_BENCH.json")
+    ap.add_argument("--no-gate", action="store_true",
+                    help="emit the report but exit 0 regardless "
+                         "(tier-1 CLI smoke lane)")
+    args = ap.parse_args()
+
+    sizes = [int(s) for s in args.params.split(",") if s]
+    report = {
+        "metric": "fused_step_speedup",
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+        "nproc": os.cpu_count(),
+        "optimizer": args.optimizer,
+        "steps": args.steps,
+        "schedule": "lr change @40%, batch-size change @60%",
+        "sizes": {},
+    }
+    for n in sizes:
+        print(f"benching {n} params ({args.optimizer}, {args.steps} "
+              f"steps) ...", file=sys.stderr)
+        row = bench_size(n, args.optimizer, args.steps, args.warmup,
+                         args.lr, repeats=args.repeats)
+        print(f"  eager {row['eager_ms_per_step']:9.3f} ms/step   "
+              f"fused {row['fused_ms_per_step']:9.3f} ms/step   "
+              f"x{row['speedup']}   compiles={row['fused_compiles']}",
+              file=sys.stderr)
+        report["sizes"][str(n)] = row
+
+    gate_sizes = [n for n in sizes if n >= 100] or [max(sizes)]
+    gate_n = max(gate_sizes)
+    gate_row = report["sizes"][str(gate_n)]
+    report["gate_params"] = gate_n
+    report["speedup_at_gate"] = gate_row["speedup"]
+    report["min_speedup"] = args.min_speedup
+
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+
+    ok = (gate_row["speedup"] >= args.min_speedup
+          and gate_row["fused_compiles"] == 1)
+    if not ok:
+        print(f"GATE {'SKIPPED' if args.no_gate else 'FAILED'}: need "
+              f"speedup >= {args.min_speedup} (got "
+              f"x{gate_row['speedup']}) and exactly 1 fused compile "
+              f"(got {gate_row['fused_compiles']}) at "
+              f"{gate_n} params", file=sys.stderr)
+        return 0 if args.no_gate else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
