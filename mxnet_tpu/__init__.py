@@ -16,6 +16,7 @@ __version__ = "0.1.0"
 
 from . import base
 from .base import MXNetError
+from . import util  # knob registry (util.env) — see docs/env_vars.md
 from . import context
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import ops

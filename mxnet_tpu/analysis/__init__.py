@@ -1,0 +1,35 @@
+"""mxlint — framework-aware static analysis for mxnet_tpu.
+
+An AST-based lint engine with rules grounded in real bug classes from
+this repo's history: silent recompiles in AOT-cached paths (MX001),
+host syncs inside the training hot loop (MX002), env knobs that bypass
+the central registry (MX003), unguarded module-level shared state
+(MX004), donated buffers read after donation (MX005), and op-registry
+contract breaks (MX006).
+
+Usage (CLI): ``python tools/mxlint.py mxnet_tpu --baseline
+MXLINT_BASELINE.json``; see docs/static_analysis.md for the rule
+catalogue, pragma syntax, and baseline workflow.
+
+This subpackage deliberately imports ONLY the standard library so the
+CLI can load it without paying the jax import (the full-package lint
+must finish in seconds, and tools/mxlint.py loads it standalone).
+"""
+from .engine import (
+    LintEngine, Violation, Rule, RULE_REGISTRY, register_rule,
+    load_baseline, diff_baseline, make_baseline,
+)
+# NOTE `from .rules import ...` (not `from . import rules`): the latter
+# routes through a full dotted __import__ that walks from the ROOT
+# package — defeating the standalone load and pulling in jax.
+from .rules import (  # noqa: F401  — registers the MX00x rules on import
+    RecompileHazard, HostSyncInHotPath, UntrackedEnvKnob,
+    UnguardedSharedState, DonationMisuse, OpRegistryContract,
+)
+from .reporters import render_text, render_json
+
+__all__ = [
+    "LintEngine", "Violation", "Rule", "RULE_REGISTRY", "register_rule",
+    "load_baseline", "diff_baseline", "make_baseline",
+    "render_text", "render_json",
+]

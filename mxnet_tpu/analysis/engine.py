@@ -1,0 +1,288 @@
+"""mxlint engine: rule registry, file walker, pragmas, baseline ratchet.
+
+Stdlib-only by design (see package docstring): `ast` for parsing, no
+framework imports.  The engine parses each file once and hands the same
+tree to every enabled rule; cross-file rules accumulate state and
+report from ``finalize()`` after the walk.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple, Type
+
+__all__ = [
+    "Violation", "FileContext", "Rule", "RULE_REGISTRY", "register_rule",
+    "LintEngine", "load_baseline", "diff_baseline", "make_baseline",
+]
+
+# `# mxlint: disable=MX001,MX004` suppresses those rules on that line;
+# `# mxlint: disable` (no codes) suppresses every rule on that line.
+_PRAGMA = re.compile(r"#\s*mxlint:\s*disable(?:=([A-Z0-9,\s]+))?")
+
+_ALL = "ALL"
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One finding.  ``fingerprint`` identifies it across line drift:
+    it hashes the rule, file, enclosing symbol, and the normalized
+    source line — NOT the line number — so unrelated edits above a
+    baselined violation do not un-baseline it."""
+
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    symbol: str = "<module>"
+    src: str = ""      # stripped source line the finding anchors to
+
+    @property
+    def fingerprint(self) -> str:
+        h = hashlib.sha1()
+        h.update("\0".join(
+            (self.rule, self.path, self.symbol, self.src)).encode())
+        return h.hexdigest()[:16]
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.symbol}] {self.message}")
+
+
+class FileContext:
+    """Per-file state shared by all rules: parsed tree, source lines,
+    pragma map, and a node→enclosing-symbol resolver."""
+
+    def __init__(self, path: str, relpath: str, source: str,
+                 tree: ast.Module):
+        self.path = path
+        self.relpath = relpath
+        self.lines = source.splitlines()
+        self.tree = tree
+        self._pragmas: Dict[int, Set[str]] = {}
+        for i, ln in enumerate(self.lines, 1):
+            m = _PRAGMA.search(ln)
+            if m:
+                codes = m.group(1)
+                self._pragmas[i] = (
+                    {c.strip() for c in codes.split(",") if c.strip()}
+                    if codes else {_ALL})
+        # symbol table: lineno span -> qualname, innermost wins.  The
+        # same single walk also buckets nodes by kind so each rule
+        # iterates a precomputed list instead of re-walking the tree
+        # (six full ast.walk passes per file blew the CLI's time budget).
+        self._spans: List[Tuple[int, int, str]] = []
+        self.functions: List[ast.AST] = []
+        self.classes: List[ast.ClassDef] = []
+        self.withs: List[ast.AST] = []
+        self.calls: List[ast.Call] = []
+        self.subscripts: List[ast.Subscript] = []
+        self._index_symbols(tree, [])
+
+    def _index_symbols(self, node: ast.AST, stack: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qual = ".".join(stack + [child.name])
+                end = getattr(child, "end_lineno", child.lineno)
+                self._spans.append((child.lineno, end, qual))
+                if isinstance(child, ast.ClassDef):
+                    self.classes.append(child)
+                else:
+                    self.functions.append(child)
+                self._index_symbols(child, stack + [child.name])
+            else:
+                if isinstance(child, ast.Call):
+                    self.calls.append(child)
+                elif isinstance(child, ast.Subscript):
+                    self.subscripts.append(child)
+                elif isinstance(child, (ast.With, ast.AsyncWith)):
+                    self.withs.append(child)
+                self._index_symbols(child, stack)
+
+    def symbol_at(self, lineno: int) -> str:
+        best = "<module>"
+        best_len = None
+        for lo, hi, qual in self._spans:
+            if lo <= lineno <= hi and (best_len is None
+                                       or hi - lo < best_len):
+                best, best_len = qual, hi - lo
+        return best
+
+    def suppressed(self, rule_id: str, lineno: int) -> bool:
+        codes = self._pragmas.get(lineno)
+        return bool(codes) and (_ALL in codes or rule_id in codes)
+
+    def violation(self, rule_id: str, node: ast.AST, message: str
+                  ) -> Violation:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        src = self.lines[line - 1].strip() if line <= len(self.lines) \
+            else ""
+        return Violation(rule=rule_id, path=self.relpath, line=line,
+                         col=col, message=message,
+                         symbol=self.symbol_at(line), src=src)
+
+
+class Rule:
+    """Base rule.  Subclasses set ``id``/``name``/``description`` and
+    implement ``check``; cross-file rules also override ``finalize``.
+    A fresh instance is built per engine run, so instance state is
+    safe for cross-file accumulation."""
+
+    id: str = "MX000"
+    name: str = "base"
+    description: str = ""
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        return ()
+
+    def finalize(self) -> Iterable[Violation]:
+        return ()
+
+
+RULE_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register_rule(cls: Type[Rule]) -> Type[Rule]:
+    if cls.id in RULE_REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    # import-time registration: single-threaded by the import lock
+    RULE_REGISTRY[cls.id] = cls  # mxlint: disable=MX004
+    return cls
+
+
+class LintEngine:
+    """Walk ``.py`` files, run enabled rules, apply pragmas.
+
+    Parameters
+    ----------
+    root : repo root used to relativize paths (fingerprints must be
+        machine-independent).
+    enable / disable : iterables of rule ids; ``enable`` (when given)
+        selects exactly those rules, ``disable`` subtracts.
+    """
+
+    def __init__(self, root: str = ".",
+                 enable: Optional[Sequence[str]] = None,
+                 disable: Optional[Sequence[str]] = None):
+        self.root = os.path.abspath(root)
+        ids = sorted(RULE_REGISTRY)
+        if enable:
+            unknown = set(enable) - set(ids)
+            if unknown:
+                raise ValueError(f"unknown rule ids: {sorted(unknown)}")
+            ids = [i for i in ids if i in set(enable)]
+        if disable:
+            unknown = set(disable) - set(RULE_REGISTRY)
+            if unknown:
+                raise ValueError(f"unknown rule ids: {sorted(unknown)}")
+            ids = [i for i in ids if i not in set(disable)]
+        self.rules: List[Rule] = [RULE_REGISTRY[i]() for i in ids]
+        self.errors: List[str] = []  # unparsable files (reported, not fatal)
+
+    def _files(self, paths: Sequence[str]) -> List[str]:
+        out: List[str] = []
+        for p in paths:
+            p = os.path.abspath(p)
+            if os.path.isfile(p):
+                out.append(p)
+                continue
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                out.extend(os.path.join(dirpath, f)
+                           for f in filenames if f.endswith(".py"))
+        return sorted(set(out))
+
+    def run(self, paths: Sequence[str]) -> List[Violation]:
+        violations: List[Violation] = []
+        for path in self._files(paths):
+            rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    source = f.read()
+                tree = ast.parse(source, filename=rel)
+            except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                self.errors.append(f"{rel}: {type(e).__name__}: {e}")
+                continue
+            ctx = FileContext(path, rel, source, tree)
+            for rule in self.rules:
+                for v in rule.check(ctx):
+                    if not ctx.suppressed(v.rule, v.line):
+                        violations.append(v)
+        for rule in self.rules:
+            # finalize() findings carry their own file context; pragma
+            # filtering already happened when the rule recorded the site
+            violations.extend(rule.finalize())
+        violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+        return violations
+
+
+# ---------------------------------------------------------------------------
+# Baseline: committed violations ratchet DOWN.  A baseline entry
+# suppresses exactly one occurrence of its fingerprint (multiset
+# semantics); new violations fail; entries whose violation disappeared
+# are reported stale so the file shrinks over time.
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> List[dict]:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "entries" not in data:
+        raise ValueError(f"{path}: not a mxlint baseline file")
+    return data["entries"]
+
+
+def make_baseline(violations: Sequence[Violation],
+                  justifications: Optional[Dict[str, str]] = None,
+                  default_justification: str = "baselined pre-existing "
+                  "violation; ratchet down, do not add") -> dict:
+    """Build the committed-baseline document.  ``justifications`` maps
+    a rule id or a fingerprint to a one-line reason (fingerprint wins)."""
+    justifications = justifications or {}
+    entries = []
+    for v in violations:
+        why = justifications.get(v.fingerprint) \
+            or justifications.get(v.rule) or default_justification
+        entries.append({
+            "fingerprint": v.fingerprint, "rule": v.rule, "path": v.path,
+            "symbol": v.symbol, "src": v.src, "justification": why,
+        })
+    entries.sort(key=lambda e: (e["path"], e["rule"], e["fingerprint"]))
+    return {"version": 1,
+            "comment": "mxlint suppression baseline — existing "
+                       "violations ratchet down; new ones fail. See "
+                       "docs/static_analysis.md.",
+            "entries": entries}
+
+
+def diff_baseline(violations: Sequence[Violation],
+                  entries: Sequence[dict]
+                  ) -> Tuple[List[Violation], List[Violation], List[dict]]:
+    """Returns (new, suppressed, stale): violations not covered by the
+    baseline, violations the baseline absorbs, and baseline entries
+    with no live violation (candidates for deletion)."""
+    budget: Dict[str, int] = {}
+    for e in entries:
+        budget[e["fingerprint"]] = budget.get(e["fingerprint"], 0) + 1
+    new: List[Violation] = []
+    suppressed: List[Violation] = []
+    for v in violations:
+        if budget.get(v.fingerprint, 0) > 0:
+            budget[v.fingerprint] -= 1
+            suppressed.append(v)
+        else:
+            new.append(v)
+    stale = []
+    seen: Dict[str, int] = dict(budget)
+    for e in entries:
+        if seen.get(e["fingerprint"], 0) > 0:
+            seen[e["fingerprint"]] -= 1
+            stale.append(e)
+    return new, suppressed, stale

@@ -1,0 +1,69 @@
+"""mxlint output: human text + machine JSON (the MXLINT.json artifact)."""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from .engine import RULE_REGISTRY, Violation
+
+__all__ = ["render_text", "render_json"]
+
+
+def _per_rule_counts(violations: Sequence[Violation]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for v in violations:
+        counts[v.rule] = counts.get(v.rule, 0) + 1
+    return counts
+
+
+def render_text(new: Sequence[Violation],
+                suppressed: Sequence[Violation] = (),
+                stale: Sequence[dict] = (),
+                errors: Sequence[str] = ()) -> str:
+    lines: List[str] = []
+    for v in new:
+        lines.append(v.format())
+    for e in errors:
+        lines.append(f"{e} (file skipped)")
+    if stale:
+        lines.append("")
+        lines.append(f"{len(stale)} stale baseline entr"
+                     f"{'y' if len(stale) == 1 else 'ies'} (violation "
+                     "fixed — delete from MXLINT_BASELINE.json):")
+        for e in stale:
+            lines.append(f"  {e['path']} {e['rule']} [{e['symbol']}] "
+                         f"{e['src'][:60]}")
+    lines.append("")
+    verdict = "FAIL" if new else "OK"
+    lines.append(f"mxlint: {verdict} — {len(new)} new violation(s), "
+                 f"{len(suppressed)} baselined, {len(stale)} stale "
+                 f"baseline entr{'y' if len(stale) == 1 else 'ies'}, "
+                 f"{len(errors)} unparsable file(s)")
+    return "\n".join(lines)
+
+
+def render_json(new: Sequence[Violation],
+                suppressed: Sequence[Violation] = (),
+                stale: Sequence[dict] = (),
+                errors: Sequence[str] = ()) -> dict:
+    """The MXLINT.json shape: per-rule counts first (the trajectory the
+    nightly tracks across PRs), then the full finding list."""
+    return {
+        "ok": not new,
+        "counts": {
+            "new": len(new),
+            "baselined": len(suppressed),
+            "stale_baseline": len(stale),
+            "errors": len(errors),
+        },
+        "new_per_rule": _per_rule_counts(new),
+        "baselined_per_rule": _per_rule_counts(suppressed),
+        "rules": {rid: {"name": cls.name, "description": cls.description}
+                  for rid, cls in sorted(RULE_REGISTRY.items())},
+        "new": [{
+            "rule": v.rule, "path": v.path, "line": v.line, "col": v.col,
+            "symbol": v.symbol, "message": v.message,
+            "fingerprint": v.fingerprint,
+        } for v in new],
+        "stale_baseline": list(stale),
+        "errors": list(errors),
+    }

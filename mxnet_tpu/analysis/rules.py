@@ -1,0 +1,632 @@
+"""The MX00x rule set.  Each rule is grounded in a bug class this repo
+actually shipped (see docs/static_analysis.md for the catalogue with
+the historical example behind every rule).
+
+All rules are heuristic AST passes: they favor precision over recall
+(a lint gate that cries wolf gets pragma'd into silence), and every
+false positive has an escape hatch — ``# mxlint: disable=MXnnn`` on
+the flagged line.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .engine import FileContext, Rule, Violation, register_rule
+
+__all__ = [
+    "RecompileHazard", "HostSyncInHotPath", "UntrackedEnvKnob",
+    "UnguardedSharedState", "DonationMisuse", "OpRegistryContract",
+]
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted name of a Name/Attribute chain ('jax.jit'), '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _terminal_name(node: ast.AST) -> str:
+    """Last component of a Name/Attribute chain ('self._jit_lock' ->
+    '_jit_lock')."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+_JIT_NAMES = re.compile(r"(^|\.)(jit|pjit|pmap)$")
+
+
+def _is_jit_callable(node: ast.AST) -> bool:
+    """Does this expression name a jit-like transform (jax.jit, jit,
+    pjit, jax.pmap, functools.partial(jax.jit, ...))?"""
+    chain = _attr_chain(node)
+    if chain and _JIT_NAMES.search(chain):
+        return True
+    if isinstance(node, ast.Call):
+        # partial(jax.jit, ...) / jax.jit(fn, static_argnums=...) used
+        # as a decorator factory
+        if _attr_chain(node.func).endswith("partial") and node.args:
+            return _is_jit_callable(node.args[0])
+        return _is_jit_callable(node.func)
+    return False
+
+
+def _walk_excluding_nested_classes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body including nested functions (they trace too)
+    but not nested classes."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.ClassDef):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _walk_same_scope(node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a statement WITHOUT descending into nested function/class
+    scopes — they are analyzed as scopes of their own (and re-walking
+    them from every enclosing level is quadratic)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# ---------------------------------------------------------------------------
+# MX001 — recompile hazard inside jit/AOT contexts
+# ---------------------------------------------------------------------------
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype"}
+_STATIC_CALLS = {"len", "range", "isinstance", "str", "repr", "type"}
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """Conservatively true when the expression is trace-static (built
+    from shapes, ranks, dtypes, len(), constants): coercing THOSE to a
+    Python scalar is fine inside a trace."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_ATTRS
+    if isinstance(node, ast.Subscript):
+        return _is_static_expr(node.value)
+    if isinstance(node, ast.Call):
+        fn = _terminal_name(node.func)
+        return fn in _STATIC_CALLS
+    if isinstance(node, ast.BinOp):
+        return _is_static_expr(node.left) and _is_static_expr(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand)
+    return False
+
+
+@register_rule
+class RecompileHazard(Rule):
+    """MX001: Python scalar coercion of a traced value inside a
+    ``jax.jit``/AOT-compiled function.  ``int(x)``, ``float(x)``,
+    ``bool(x)``, ``x.item()``, ``x.tolist()``, ``np.asarray(x)`` on a
+    traced value either raises a ``TracerError`` or — worse, with
+    ``static_argnums``/shape-dependent code — silently retraces and
+    recompiles per value, destroying the AOT no-recompile guarantee the
+    fused-step path is built on."""
+
+    id = "MX001"
+    name = "recompile-hazard"
+    description = ("Host scalar coercion or materialization inside a "
+                   "jit-compiled function (silent recompile / trace "
+                   "error).")
+
+    _COERCIONS = {"int", "float", "bool", "complex"}
+    _HOST_METHODS = {"item", "tolist", "asnumpy"}
+    _NP_FUNCS = {"asarray", "array"}
+    _NP_MODULES = {"np", "numpy", "onp"}
+
+    def _jit_functions(self, ctx: FileContext) -> List[ast.AST]:
+        jit_fns: List[ast.AST] = []
+        by_name: Dict[str, ast.AST] = {}
+        wrapped: Set[str] = set()
+        for node in ctx.functions:
+            by_name.setdefault(node.name, node)
+            if any(_is_jit_callable(d) for d in node.decorator_list):
+                jit_fns.append(node)
+        for node in ctx.calls:
+            if _is_jit_callable(node.func):
+                # jax.jit(fn) / jax.jit(fn, donate_argnums=...) on a
+                # locally defined function or lambda
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name):
+                        wrapped.add(arg.id)
+                    elif isinstance(arg, ast.Lambda):
+                        jit_fns.append(arg)
+        jit_fns.extend(fn for name, fn in by_name.items()
+                       if name in wrapped
+                       and not any(_is_jit_callable(d) for d in
+                                   getattr(fn, "decorator_list", ())))
+        return jit_fns
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        seen: Set[int] = set()
+        for fn in self._jit_functions(ctx):
+            for node in _walk_excluding_nested_classes(fn):
+                if id(node) in seen or not isinstance(node, ast.Call):
+                    continue
+                v = self._check_call(ctx, node)
+                if v is not None:
+                    seen.add(id(node))
+                    yield v
+
+    def _check_call(self, ctx: FileContext,
+                    node: ast.Call) -> Optional[Violation]:
+        fname = _terminal_name(node.func)
+        if isinstance(node.func, ast.Name) and fname in self._COERCIONS:
+            if len(node.args) == 1 and not _is_static_expr(node.args[0]):
+                return ctx.violation(
+                    self.id, node,
+                    f"{fname}() on a value inside a jit-compiled "
+                    "function forces a concrete host scalar — "
+                    "TracerError at best, silent per-value recompile "
+                    "at worst. Hoist it out of the traced function or "
+                    "derive it from .shape/.ndim.")
+        if isinstance(node.func, ast.Attribute):
+            if fname in self._HOST_METHODS and not node.args:
+                return ctx.violation(
+                    self.id, node,
+                    f".{fname}() inside a jit-compiled function "
+                    "materializes the value on the host — it cannot "
+                    "trace, and in AOT-cached paths it forces a "
+                    "recompile per distinct value.")
+            if fname in self._NP_FUNCS and \
+                    _terminal_name(node.func.value) in self._NP_MODULES:
+                return ctx.violation(
+                    self.id, node,
+                    f"numpy.{fname}() inside a jit-compiled function "
+                    "pulls the traced value to the host; use jnp "
+                    "inside traces.")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# MX002 — host sync in the training hot path
+# ---------------------------------------------------------------------------
+
+@register_rule
+class HostSyncInHotPath(Rule):
+    """MX002: ``.asnumpy()`` / ``np.asarray`` on NDArrays inside
+    ``autograd.record()`` blocks or the Trainer/Updater/KVStore step
+    chain.  Each such call is a device→host round-trip that stalls the
+    async dispatch pipeline — the exact class of bug that erases the
+    fused-step win (arxiv 2004.13336)."""
+
+    id = "MX002"
+    name = "hot-path-host-sync"
+    description = ("Device->host synchronization (.asnumpy()/np.asarray/"
+                   ".item()/.wait_to_read()) inside autograd.record() "
+                   "or the Trainer.step call chain.")
+
+    _SYNC_METHODS = {"asnumpy", "item", "wait_to_read"}
+    _NP_FUNCS = {"asarray", "array"}
+    _NP_MODULES = {"np", "numpy", "onp"}
+    # the step call chain: methods with these names on these classes
+    _HOT_CLASSES = re.compile(r"(Trainer|Updater|KVStore)")
+    _HOT_METHODS = {"step", "update", "_update", "update_all", "__call__",
+                    "allreduce_grads", "_allreduce_grads",
+                    "_allreduce_grads_fused", "_update_fused",
+                    "push", "pull", "pushpull", "pushpull_fused"}
+
+    def _record_blocks(self, ctx: FileContext) -> Iterable[ast.With]:
+        for node in ctx.withs:
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call) and \
+                        _terminal_name(expr.func) == "record":
+                    yield node
+                    break
+
+    def _hot_methods(self, ctx: FileContext) -> Iterable[ast.FunctionDef]:
+        for node in ctx.classes:
+            if self._HOT_CLASSES.search(node.name):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) and \
+                            item.name in self._HOT_METHODS:
+                        yield item
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        seen: Set[int] = set()
+        for scope, where in [
+            (b, "inside autograd.record()") for b in
+                self._record_blocks(ctx)] + [
+            (m, f"in the {m.name}() step chain") for m in
+                self._hot_methods(ctx)]:
+            for node in ast.walk(scope):
+                if id(node) in seen or not isinstance(node, ast.Call):
+                    continue
+                msg = None
+                fname = _terminal_name(node.func)
+                if isinstance(node.func, ast.Attribute):
+                    if fname in self._SYNC_METHODS and not node.args:
+                        msg = (f".{fname}() {where} blocks on a "
+                               "device->host transfer, stalling the "
+                               "async dispatch pipeline")
+                    elif fname in self._NP_FUNCS and \
+                            _terminal_name(node.func.value) in \
+                            self._NP_MODULES:
+                        msg = (f"numpy.{fname}() {where} synchronously "
+                               "materializes device data on the host")
+                if msg:
+                    seen.add(id(node))
+                    yield ctx.violation(
+                        self.id, node,
+                        msg + "; move it outside the hot loop or use "
+                        "an async metric hook.")
+
+
+# ---------------------------------------------------------------------------
+# MX003 — untracked env knob
+# ---------------------------------------------------------------------------
+
+@register_rule
+class UntrackedEnvKnob(Rule):
+    """MX003: a ``MXNET_*`` env var read that bypasses the central knob
+    registry (``mxnet_tpu.util.env``).  Untracked reads drift out of
+    docs/env_vars.md and a typo'd name silently returns its default
+    forever — the registry raises on undeclared names instead."""
+
+    id = "MX003"
+    name = "untracked-env-knob"
+    description = ("os.environ/get_env read of a MXNET_* name outside "
+                   "the mxnet_tpu.util.env knob registry.")
+
+    _RAW_READERS = {"getenv"}          # os.getenv
+    _ENVIRON_METHODS = {"get", "setdefault", "pop"}
+    _LEGACY = {"get_env"}              # mxnet_tpu.base.get_env
+
+    def _literal_knob(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and node.value.startswith("MXNET_"):
+            return node.value
+        return None
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        # the registry module itself is the one legitimate home of raw
+        # MXNET_* reads
+        if ctx.relpath.replace("\\", "/").endswith("mxnet_tpu/util/env.py"):
+            return
+        candidates: List[Tuple[ast.AST, Optional[str]]] = []
+        for node in ctx.calls:
+            fname = _terminal_name(node.func)
+            chain = _attr_chain(node.func)
+            if node.args:
+                knob = self._literal_knob(node.args[0])
+                if knob and (
+                        fname in self._RAW_READERS
+                        or (fname in self._ENVIRON_METHODS
+                            and chain.endswith("environ." + fname))
+                        or fname in self._LEGACY):
+                    candidates.append((node, knob))
+        for node in ctx.subscripts:
+            if _attr_chain(node.value).endswith("environ") and \
+                    not isinstance(node.slice, ast.Slice):
+                candidates.append((node, self._literal_knob(node.slice)))
+        for node, name in candidates:
+            if name:
+                yield ctx.violation(
+                    self.id, node,
+                    f"{name} read bypasses the knob registry; use "
+                    "mxnet_tpu.util.env.get_* so the knob is typed, "
+                    "documented, and typo-proof.")
+
+
+# ---------------------------------------------------------------------------
+# MX004 — unguarded module-level shared state
+# ---------------------------------------------------------------------------
+
+_LOCKISH = re.compile(r"lock|mutex", re.IGNORECASE)
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "pop", "popitem", "clear", "remove", "discard"}
+_CONTAINER_CALLS = {"dict", "list", "set", "defaultdict", "OrderedDict",
+                    "deque", "Counter"}
+
+
+@register_rule
+class UnguardedSharedState(Rule):
+    """MX004: mutation of a module-level dict/list/set from a function
+    body with no enclosing lock acquisition.  Module caches are shared
+    by serving threads, DataLoader workers, and the training loop; the
+    ``_jit_lock`` double-checked pattern in ``ops/registry.py`` is the
+    house style — follow it or justify the race in the baseline."""
+
+    id = "MX004"
+    name = "unguarded-shared-state"
+    description = ("Write to a module-level mutable container from a "
+                   "function body with no enclosing `with <lock>:`.")
+
+    def _module_containers(self, ctx: FileContext) -> Set[str]:
+        names: Set[str] = set()
+        for node in ctx.tree.body:
+            targets: List[ast.expr] = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            is_container = isinstance(
+                value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                        ast.ListComp, ast.SetComp)) or (
+                isinstance(value, ast.Call)
+                and _terminal_name(value.func) in _CONTAINER_CALLS)
+            if is_container:
+                names.update(t.id for t in targets
+                             if isinstance(t, ast.Name))
+        return names
+
+    def _check_function(self, ctx: FileContext, fn: ast.AST,
+                        tracked: Set[str]) -> Iterable[Violation]:
+
+        def visit(node: ast.AST, locked: bool) -> Iterable[Violation]:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                # nested function: fresh walk happens from the top level
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                holds = locked or any(
+                    _LOCKISH.search(_terminal_name(
+                        i.context_expr.func
+                        if isinstance(i.context_expr, ast.Call)
+                        else i.context_expr) or "")
+                    for i in node.items)
+                for child in node.body:
+                    yield from visit(child, holds)
+                return
+            yield from self._check_node(ctx, node, tracked, locked)
+            # With nodes returned above, so this descent never re-enters
+            # a lock scope
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, locked)
+
+        for stmt in fn.body:
+            yield from visit(stmt, False)
+
+    def _check_node(self, ctx: FileContext, node: ast.AST,
+                    tracked: Set[str], locked: bool
+                    ) -> Iterable[Violation]:
+        if locked:
+            return
+        name = None
+        how = None
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in tracked:
+                    name, how = t.value.id, f"{t.value.id}[...] ="
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in tracked:
+                    name, how = t.value.id, f"del {t.value.id}[...]"
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in tracked and \
+                node.func.attr in _MUTATORS:
+            name, how = node.func.value.id, \
+                f"{node.func.value.id}.{node.func.attr}(...)"
+        if name:
+            yield ctx.violation(
+                self.id, node,
+                f"`{how}` mutates module-level `{name}` with no "
+                "enclosing lock; serving/dataloader threads share this "
+                "module — guard it with the double-checked `with "
+                "<lock>:` pattern (ops/registry.py::jitted).")
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        tracked = self._module_containers(ctx)
+        if not tracked:
+            return
+        for node in ctx.functions:
+            yield from self._check_function(ctx, node, tracked)
+
+
+# ---------------------------------------------------------------------------
+# MX005 — donation misuse
+# ---------------------------------------------------------------------------
+
+@register_rule
+class DonationMisuse(Rule):
+    """MX005: an argument donated via ``donate_argnums`` is read again
+    after the call in the same scope.  XLA invalidates donated buffers;
+    the read returns garbage on TPU (and 'works' on CPU where donation
+    is a no-op — the worst kind of portability bug)."""
+
+    id = "MX005"
+    name = "donation-misuse"
+    description = ("Variable passed at a donated argument position is "
+                   "read after the donating call in the same scope.")
+
+    @staticmethod
+    def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+        for kw in call.keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                v = kw.value
+                if isinstance(v, ast.Constant) and \
+                        isinstance(v.value, int):
+                    return (v.value,)
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    out = []
+                    for e in v.elts:
+                        if isinstance(e, ast.Constant) and \
+                                isinstance(e.value, int):
+                            out.append(e.value)
+                    return tuple(out)
+                return ()  # dynamic donate spec: cannot track
+        return None
+
+    def _scan_scope(self, ctx: FileContext, body: Sequence[ast.stmt]
+                    ) -> Iterable[Violation]:
+        # jitted-callable name -> donated positions
+        donating: Dict[str, Tuple[int, ...]] = {}
+        # donated variable name -> (stmt index of donating call, lineno)
+        donated_at: Dict[str, Tuple[int, int]] = {}
+
+        def record_call(call: ast.Call, idx: int) -> None:
+            """If `call` donates buffers, mark plain-Name args at the
+            donated positions."""
+            positions: Optional[Tuple[int, ...]] = None
+            f = call.func
+            if isinstance(f, ast.Name) and f.id in donating:
+                positions = donating[f.id]
+            elif isinstance(f, ast.Call):
+                positions = self._donated_positions(f) \
+                    if _is_jit_callable(f.func) else None
+            if not positions:
+                return
+            for pos in positions:
+                if pos < len(call.args):
+                    arg = call.args[pos]
+                    if isinstance(arg, ast.Name):
+                        donated_at.setdefault(arg.id, (idx, call.lineno))
+
+        # statement-index semantics: reads flag only in statements
+        # STRICTLY AFTER the donating one (a donating statement's own
+        # argument list is a safe read), and any Store in or after the
+        # donating statement ends the lifetime — so the canonical
+        # rebind idiom `w = f(w, g)` never false-positives.
+        for idx, stmt in enumerate(body):
+            # 1) reads of names donated in an earlier statement
+            for node in _walk_same_scope(stmt):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        node.id in donated_at and \
+                        donated_at[node.id][0] < idx:
+                    _, line = donated_at.pop(node.id)
+                    yield ctx.violation(
+                        self.id, node,
+                        f"`{node.id}` was donated to the compiled call "
+                        f"on line {line}; its buffer is invalidated — "
+                        "reading it here returns garbage on TPU. Use "
+                        "the call's result instead.")
+            # 2) f = jax.jit(fn, donate_argnums=...) [.lower().compile()]
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call) and \
+                    len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name):
+                src = stmt.value
+                # unwrap .lower(...).compile() AOT chains
+                inner = src
+                while isinstance(inner, ast.Call) and \
+                        isinstance(inner.func, ast.Attribute):
+                    inner = inner.func.value
+                for cand in (src, inner):
+                    if isinstance(cand, ast.Call) and \
+                            _is_jit_callable(cand.func):
+                        pos = self._donated_positions(cand)
+                        if pos:
+                            donating[stmt.targets[0].id] = pos
+            # 3) any call in this statement that donates
+            for node in _walk_same_scope(stmt):
+                if isinstance(node, ast.Call):
+                    record_call(node, idx)
+            # 4) a Store rebinding a donated name ends its lifetime
+            #    (including a same-statement rebind, `w = f(w)`)
+            for node in _walk_same_scope(stmt):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Store) and \
+                        node.id in donated_at:
+                    del donated_at[node.id]
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        for node in ctx.functions:
+            yield from self._scan_scope(ctx, node.body)
+        yield from self._scan_scope(ctx, ctx.tree.body)
+
+
+# ---------------------------------------------------------------------------
+# MX006 — op-registry contract
+# ---------------------------------------------------------------------------
+
+@register_rule
+class OpRegistryContract(Rule):
+    """MX006: op-registry hygiene — duplicate ``register_op`` names
+    (the runtime registry raises, but only when both modules happen to
+    import) and registered ops with no docstring (`Operator.param_doc`
+    renders the attr table, but the semantic one-liner must come from
+    the kernel author)."""
+
+    id = "MX006"
+    name = "op-registry-contract"
+    description = ("Duplicate register_op name/alias, or a registered "
+                   "op missing a docstring.")
+
+    def __init__(self) -> None:
+        #: name -> (first path, line); duplicates reported at 2nd site
+        self._names: Dict[str, Tuple[str, int]] = {}
+        self._dups: List[Violation] = []
+
+    @staticmethod
+    def _register_calls(node: ast.AST) -> Iterable[ast.Call]:
+        for dec in getattr(node, "decorator_list", ()):
+            if isinstance(dec, ast.Call) and \
+                    _terminal_name(dec.func) == "register_op":
+                yield dec
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        for node in ctx.functions:
+            for call in self._register_calls(node):
+                names: List[str] = []
+                if call.args and isinstance(call.args[0], ast.Constant) \
+                        and isinstance(call.args[0].value, str):
+                    names.append(call.args[0].value)
+                for kw in call.keywords:
+                    if kw.arg == "aliases" and isinstance(
+                            kw.value, (ast.Tuple, ast.List)):
+                        names.extend(
+                            e.value for e in kw.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str))
+                for name in names:
+                    prev = self._names.get(name)
+                    if prev is not None and not ctx.suppressed(
+                            self.id, call.lineno):
+                        self._dups.append(ctx.violation(
+                            self.id, call,
+                            f"op name {name!r} already registered at "
+                            f"{prev[0]}:{prev[1]} — the runtime "
+                            "registry will raise when both modules "
+                            "import."))
+                    else:
+                        self._names.setdefault(
+                            name, (ctx.relpath, call.lineno))
+                if not ast.get_docstring(node):
+                    yield ctx.violation(
+                        self.id, node,
+                        f"registered op {node.name!r} has no docstring; "
+                        "the op catalogue renders it — state the "
+                        "semantic contract in one line.")
+
+    def finalize(self) -> Iterable[Violation]:
+        return self._dups

@@ -50,7 +50,9 @@ def get_env(name: str, default: Any = None, typ: Optional[type] = None) -> Any:
     equivalent (ref: docs/faq/env_var.md).
     """
     raw = os.environ.get(name)
-    if raw is None:
+    if raw is None or raw == "":
+        # empty string means unset: launchers commonly export every knob
+        # with VAR="" as the 'use the default' spelling
         return default
     if typ is None:
         typ = type(default) if default is not None else str
@@ -60,6 +62,12 @@ def get_env(name: str, default: Any = None, typ: Optional[type] = None) -> Any:
             return True
         if low in _FALSY:
             return False
+        try:
+            # reference knobs are int-typed booleans (MXNET_TELEMETRY=2
+            # historically meant true); keep any numeric value working
+            return bool(int(low))
+        except ValueError:
+            pass
         raise MXNetError(f"env var {name}={raw!r} is not a boolean")
     try:
         return typ(raw)

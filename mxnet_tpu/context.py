@@ -143,9 +143,9 @@ def current_context() -> Context:
     cur = getattr(Context._default_ctx, "value", None)
     if cur is not None:
         return cur
-    from .base import get_env
+    from .util import env
 
-    forced = get_env("MXNET_DEFAULT_CONTEXT", None, str)
+    forced = env.get_str("MXNET_DEFAULT_CONTEXT")
     if forced:
         return Context(forced, 0)
     return tpu(0) if num_tpus() > 0 else cpu(0)

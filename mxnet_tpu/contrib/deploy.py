@@ -30,6 +30,7 @@ Artifact layout (a directory):
 from __future__ import annotations
 
 import json
+import threading
 import os
 from typing import List, Sequence
 
@@ -41,19 +42,25 @@ __all__ = ["export_model", "import_model", "ServedModel"]
 
 
 _NT_CACHE: dict = {}
+_NT_LOCK = threading.Lock()
 
 
 def _namedtuple_cls(name: str, fields: tuple):
     """One reconstructed namedtuple class per (name, fields) — field
     access by name survives the artifact round-trip even though the
-    original class is gone."""
+    original class is gone.  Locked: concurrent serving requests hit
+    this on a cold model, and `isinstance`/identity checks downstream
+    require ONE class per key (mxlint MX004)."""
     key = (name, fields)
     cls = _NT_CACHE.get(key)
     if cls is None:
-        import collections
+        with _NT_LOCK:
+            cls = _NT_CACHE.get(key)
+            if cls is None:
+                import collections
 
-        cls = collections.namedtuple(name, fields)
-        _NT_CACHE[key] = cls
+                cls = collections.namedtuple(name, fields)
+                _NT_CACHE[key] = cls
     return cls
 
 

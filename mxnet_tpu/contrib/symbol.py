@@ -2,10 +2,13 @@
 (ref: python/mxnet/symbol/contrib.py generated namespace)."""
 from __future__ import annotations
 
+import threading
+
 from ..ops.registry import OP_REGISTRY
 from ..symbol.symbol import make_symbol_function
 
 _CACHE = {}
+_CACHE_LOCK = threading.Lock()  # module attrs resolve from any thread
 
 
 def __getattr__(name):
@@ -18,7 +21,8 @@ def __getattr__(name):
     for cand in (f"_contrib_{name}", name):
         if cand in OP_REGISTRY:
             fn = make_symbol_function(cand)
-            _CACHE[name] = fn
+            with _CACHE_LOCK:
+                fn = _CACHE.setdefault(name, fn)
             return fn
     raise AttributeError(
         f"no contrib symbol op {name!r} (tried '_contrib_{name}' too)")

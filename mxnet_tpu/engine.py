@@ -17,7 +17,7 @@ from typing import List
 
 import jax
 
-from .base import get_env
+from .util import env
 
 __all__ = ["bulk", "set_bulk_size", "current_engine_type"]
 
@@ -41,7 +41,7 @@ def in_bulk() -> bool:
 def current_engine_type() -> str:
     """MXNET_ENGINE_TYPE compat: 'ThreadedEnginePerDevice' (async PjRt
     dispatch, the default) or 'NaiveEngine' (synchronous)."""
-    return get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice", str)
+    return env.get_str("MXNET_ENGINE_TYPE")
 
 
 _bulk_size = 15  # parity default (MXNET_ENGINE bulking size)

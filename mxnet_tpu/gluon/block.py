@@ -439,9 +439,9 @@ class CachedOp:
         # GraphExecutor recompute-to-save-memory) — on TPU this is
         # jax.checkpoint: the backward recomputes activations instead of
         # keeping them in HBM, trading MXU FLOPs for memory
-        from ..base import get_env
+        from ..util import env
 
-        self.mirror = (get_env("MXNET_BACKWARD_DO_MIRROR", False, bool)
+        self.mirror = (env.get_bool("MXNET_BACKWARD_DO_MIRROR")
                        if mirror is None else bool(mirror))
         self._pure: Dict[bool, Callable] = {}
         self._fwd: Dict[bool, Callable] = {}

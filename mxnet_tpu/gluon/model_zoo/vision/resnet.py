@@ -20,7 +20,8 @@ unfused path (tests/test_pallas_convbn.py, tests/test_fused_resnet.py).
 """
 from __future__ import annotations
 
-from ....base import MXNetError, get_env
+from ....base import MXNetError
+from ....util import env
 from ...block import HybridBlock, current_trace
 from ... import nn
 
@@ -34,8 +35,8 @@ def _fused_convbn_active(layout):
     op-granular path rather than silently changing estimators.
     """
     return (layout == "NHWC"
-            and get_env("MXNET_FUSED_CONVBN", False, bool)
-            and not get_env("MXNET_BN_EXACT_VAR", False, bool)
+            and env.get_bool("MXNET_FUSED_CONVBN")
+            and not env.get_bool("MXNET_BN_EXACT_VAR")
             and current_trace() is not None)
 
 

@@ -21,7 +21,7 @@ Runs once at package import (`mxnet_tpu/__init__.py`).
 """
 from __future__ import annotations
 
-from .base import get_env
+from .util import env
 
 __all__ = ["initialize", "signal_handlers_enabled"]
 
@@ -39,7 +39,7 @@ def initialize() -> None:
     if _DONE:
         return
     _DONE = True
-    if get_env("MXNET_USE_SIGNAL_HANDLER", True, bool):
+    if env.get_bool("MXNET_USE_SIGNAL_HANDLER"):
         try:
             import faulthandler
 

@@ -29,7 +29,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 
-from .base import MXNetError, get_env
+from .base import MXNetError
+from .util import env
 from .ndarray.ndarray import NDArray
 from . import optimizer as opt_mod
 
@@ -399,7 +400,7 @@ def _tree_sum(n: int):
 # reduced flat is sliced back into per-key shapes.  jax.jit retraces per
 # dtype/device automatically, so the lru key is structure only.
 
-_BUCKET_BYTES = get_env("MXNET_FUSED_BUCKET_BYTES", 4 << 20, int)
+_BUCKET_BYTES = env.get_int("MXNET_FUSED_BUCKET_BYTES")
 
 
 def _flat_concat(seg):

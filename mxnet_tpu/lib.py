@@ -19,7 +19,8 @@ import threading
 import weakref
 from typing import List, Optional
 
-from .base import MXNetError, get_env
+from .base import MXNetError
+from .util import env
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC = os.path.join(_REPO, "src")
@@ -87,7 +88,7 @@ class _NativeLib:
             if self._lib is not None or self._tried:
                 return self._lib
             self._tried = True
-            if not get_env("MXNET_USE_NATIVE", True, bool):
+            if not env.get_bool("MXNET_USE_NATIVE"):
                 return None
             try:
                 if self._needs_build():
@@ -253,11 +254,12 @@ class NativeEngine(_HandleGuard):
 
     def __init__(self, num_workers: Optional[int] = None):
         if num_workers is None:
-            if get_env("MXNET_ENGINE_TYPE", "", str) == "NaiveEngine":
+            if env.get_str("MXNET_ENGINE_TYPE") == "NaiveEngine":
                 num_workers = 0
             else:
-                num_workers = get_env("MXNET_CPU_WORKER_NTHREADS",
-                                      max(2, (os.cpu_count() or 2)), int)
+                num_workers = env.get_int(
+                    "MXNET_CPU_WORKER_NTHREADS",
+                    default=max(2, (os.cpu_count() or 2)))
         self._lib = get()
         h = ctypes.c_void_p()
         check_call(self._lib.MXEngineCreate(ctypes.c_int(num_workers),

@@ -209,9 +209,9 @@ def _bn_exact_var_default() -> bool:
     # read once per process: the compiled-op cache is keyed on attrs, so a
     # mid-process env flip could not take effect anyway.  Per-call control
     # is the explicit `exact_var` attr.
-    from ..base import get_env
+    from ..util import env
 
-    return get_env("MXNET_BN_EXACT_VAR", False, bool)
+    return env.get_bool("MXNET_BN_EXACT_VAR")
 
 
 _BN_EXACT_VAR = None  # resolved lazily so base import order doesn't matter

@@ -22,18 +22,20 @@ Design:
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..base import get_env
+from ..util import env
 from .registry import register_op
 
 __all__ = ["dot_product_attention_ref"]
 
 _PALLAS_STATE = {"enabled": None}  # resolved lazily; None = undecided
+_PALLAS_LOCK = threading.Lock()  # first attention call races from serving threads (mxlint MX004)
 
 
 def _pallas_wanted() -> bool:
@@ -42,36 +44,40 @@ def _pallas_wanted() -> bool:
     not just trace-time errors — a failure here permanently selects the
     XLA fallback instead of breaking every attention call)."""
     if _PALLAS_STATE["enabled"] is None:
-        if not get_env("MXNET_USE_PALLAS", True, bool):
-            _PALLAS_STATE["enabled"] = False
-            return False
-        try:
-            backend = jax.default_backend()
-        except Exception:
-            backend = "cpu"
-        if backend == "cpu" and not get_env("MXNET_PALLAS_INTERPRET",
-                                            False, bool):
-            _PALLAS_STATE["enabled"] = False
-            return False
-        try:
-            # representative shapes: head_dim 64 (BERT-style), one q block;
-            # probe BOTH variants — the causal path lowers extra iota/mask
-            # ops that Mosaic could reject independently
-            q = jnp.zeros((2, 128, 64), jnp.float32)
-            m = jnp.ones((2, 128), jnp.float32)
-            probe = jax.jit(_attention_pallas, static_argnums=(4, 5))
-            jax.block_until_ready(probe(q, q, q, m, 1.0, False))
-            jax.block_until_ready(probe(q, q, q, m, 1.0, True))
-            _PALLAS_STATE["enabled"] = True
-        except Exception as e:  # lowering OR compile failure
-            import logging
-
-            logging.warning(
-                "Pallas attention probe failed (%s: %s); using the XLA "
-                "fallback. Set MXNET_USE_PALLAS=0 to silence.",
-                type(e).__name__, e)
-            _PALLAS_STATE["enabled"] = False
+        with _PALLAS_LOCK:
+            if _PALLAS_STATE["enabled"] is None:
+                _PALLAS_STATE["enabled"] = _decide_pallas()
     return _PALLAS_STATE["enabled"]
+
+
+def _decide_pallas() -> bool:
+    """One-time probe behind _pallas_wanted (caller holds _PALLAS_LOCK)."""
+    if not env.get_bool("MXNET_USE_PALLAS"):
+        return False
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    if backend == "cpu" and not env.get_bool("MXNET_PALLAS_INTERPRET"):
+        return False
+    try:
+        # representative shapes: head_dim 64 (BERT-style), one q block;
+        # probe BOTH variants — the causal path lowers extra iota/mask
+        # ops that Mosaic could reject independently
+        q = jnp.zeros((2, 128, 64), jnp.float32)
+        m = jnp.ones((2, 128), jnp.float32)
+        probe = jax.jit(_attention_pallas, static_argnums=(4, 5))
+        jax.block_until_ready(probe(q, q, q, m, 1.0, False))
+        jax.block_until_ready(probe(q, q, q, m, 1.0, True))
+        return True
+    except Exception as e:  # lowering OR compile failure
+        import logging
+
+        logging.warning(
+            "Pallas attention probe failed (%s: %s); using the XLA "
+            "fallback. Set MXNET_USE_PALLAS=0 to silence.",
+            type(e).__name__, e)
+        return False
 
 
 def dot_product_attention_ref(q, k, v, mask, scale, causal=False):
@@ -144,7 +150,7 @@ def _attention_pallas(q, k, v, mask, scale, causal=False):
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
-        interpret=get_env("MXNET_PALLAS_INTERPRET", False, bool),
+        interpret=env.get_bool("MXNET_PALLAS_INTERPRET"),
     )(q, k, v, mask[:, None, :])
     return out[:, :s]
 
@@ -155,7 +161,8 @@ def _attend(q, k, v, mask, scale, causal=False):
         try:
             return _attention_pallas(q, k, v, mask, scale, causal)
         except Exception:  # trace-time failure → permanent fallback
-            _PALLAS_STATE["enabled"] = False
+            with _PALLAS_LOCK:
+                _PALLAS_STATE["enabled"] = False
     return dot_product_attention_ref(q, k, v, mask, scale, causal)
 
 

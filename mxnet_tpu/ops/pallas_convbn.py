@@ -41,18 +41,22 @@ MXNET_USE_PALLAS=0) takes the XLA fallback with identical semantics.
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..base import get_env
+from ..util import env
 from .registry import register_op
 
 __all__ = ["fused_conv_unit"]
 
 _STATE = {"enabled": None}
+#: guards _STATE plus the probe cache/budget below — serving threads and
+#: the training loop race the first conv dispatch (mxlint MX004)
+_PROBE_LOCK = threading.Lock()
 
 # VMEM working-set budget for choosing the per-program batch tile
 # (padded activation + fp32 accumulator + double-buffered x/y grid
@@ -63,38 +67,46 @@ _COLS_BUDGET_BYTES = 8 * 1024 * 1024
 
 def _pallas_wanted() -> bool:
     """Pallas usable?  Decided once: not on CPU (unless interpret mode is
-    forced for tests) and only if a probe kernel actually compiles."""
+    forced for tests) and only if a probe kernel actually compiles.
+    Double-checked under _PROBE_LOCK: the first conv can arrive from
+    several serving threads at once, and an unguarded decide would race
+    the probe compile."""
     if _STATE["enabled"] is None:
-        if not get_env("MXNET_USE_PALLAS", True, bool):
-            _STATE["enabled"] = False
-            return False
-        try:
-            backend = jax.default_backend()
-        except Exception:
-            backend = "cpu"
-        interp = get_env("MXNET_PALLAS_INTERPRET", False, bool)
-        if backend == "cpu" and not interp:
-            _STATE["enabled"] = False
-            return False
-        try:
-            x = jnp.zeros((2, 8, 8, 128), jnp.bfloat16)
-            w = jnp.zeros((128, 128, 3, 3), jnp.bfloat16)
-            sc = jnp.ones((128,), jnp.float32)
-            sh = jnp.zeros((128,), jnp.float32)
-            jax.eval_shape(functools.partial(
-                _pallas_unit, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                act_in=True, want_stats=True), x, w, sc, sc, sh)
-            if interp:
-                _STATE["enabled"] = True
-                return True
-            jax.jit(functools.partial(
-                _pallas_unit, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                act_in=True, want_stats=True)).lower(x, w, sc, sc, sh) \
-                .compile()
-            _STATE["enabled"] = True
-        except Exception:
-            _STATE["enabled"] = False
+        with _PROBE_LOCK:
+            if _STATE["enabled"] is None:
+                _STATE["enabled"] = _decide_pallas()
     return _STATE["enabled"]
+
+
+def _decide_pallas() -> bool:
+    """The one-time probe behind _pallas_wanted (caller holds
+    _PROBE_LOCK)."""
+    if not env.get_bool("MXNET_USE_PALLAS"):
+        return False
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    interp = env.get_bool("MXNET_PALLAS_INTERPRET")
+    if backend == "cpu" and not interp:
+        return False
+    try:
+        x = jnp.zeros((2, 8, 8, 128), jnp.bfloat16)
+        w = jnp.zeros((128, 128, 3, 3), jnp.bfloat16)
+        sc = jnp.ones((128,), jnp.float32)
+        sh = jnp.zeros((128,), jnp.float32)
+        jax.eval_shape(functools.partial(
+            _pallas_unit, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+            act_in=True, want_stats=True), x, w, sc, sc, sh)
+        if interp:
+            return True
+        jax.jit(functools.partial(
+            _pallas_unit, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+            act_in=True, want_stats=True)).lower(x, w, sc, sc, sh) \
+            .compile()
+        return True
+    except Exception:
+        return False
 
 
 def _batch_tile(n, h, w, ci, ho, wo, co, itemsize=2, pad=(1, 1)):
@@ -233,7 +245,7 @@ def _pallas_unit(x, w, in_scale, in_bias, shift, *, kernel, stride, pad,
             jax.ShapeDtypeStruct((1, co), jnp.float32),
             jax.ShapeDtypeStruct((1, co), jnp.float32),
         ],
-        interpret=get_env("MXNET_PALLAS_INTERPRET", False, bool),
+        interpret=env.get_bool("MXNET_PALLAS_INTERPRET"),
     )(x, wtaps, in_scale.reshape(1, ci), in_bias.reshape(1, ci),
       shift.reshape(1, co))
     return y, s1.reshape(co), s2.reshape(co)
@@ -377,7 +389,7 @@ def _pallas_unit_bwd(x, w, in_scale, in_bias, shift, y, gy, gs1, gs2, *,
             jax.ShapeDtypeStruct((1, ci), jnp.float32),
             jax.ShapeDtypeStruct((1, ci), jnp.float32),
         ],
-        interpret=get_env("MXNET_PALLAS_INTERPRET", False, bool),
+        interpret=env.get_bool("MXNET_PALLAS_INTERPRET"),
     )(x, wtaps, in_scale.reshape(1, ci), in_bias.reshape(1, ci),
       shift.reshape(1, co), y, gy,
       gs1.reshape(1, co), gs2.reshape(1, co))
@@ -418,7 +430,7 @@ def _pallas_unit_bwd_sharded(x, w, in_scale, in_bias, shift, y, gy, gs1,
 
 
 def _bwd_wanted() -> bool:
-    return get_env("MXNET_FUSED_CONVBN_BWD", False, bool) \
+    return env.get_bool("MXNET_FUSED_CONVBN_BWD") \
         and _pallas_wanted()
 
 
@@ -496,9 +508,9 @@ def _probe_budget() -> float:
     configurations to probe (~20 fwd + ~20 bwd at 3-17s each on-chip),
     so the default must grow with it — at the library layer, not per
     launcher."""
-    dflt = 600.0 if get_env("MXNET_FUSED_CONVBN_BWD", False, bool) \
+    dflt = 600.0 if env.get_bool("MXNET_FUSED_CONVBN_BWD") \
         else 300.0
-    return get_env("MXNET_PALLAS_PROBE_BUDGET", dflt, float)
+    return env.get_float("MXNET_PALLAS_PROBE_BUDGET", default=dflt)
 
 
 def _probe_ok(key, fn, arg_structs) -> bool:
@@ -510,26 +522,30 @@ def _probe_ok(key, fn, arg_structs) -> bool:
     # the interpret flag is part of the key: interpreter-mode ok=True
     # says nothing about Mosaic, so a later non-interpret call in the
     # same process must re-probe instead of reusing it (ADVICE round 5)
-    interpret = get_env("MXNET_PALLAS_INTERPRET", False, bool)
+    interpret = env.get_bool("MXNET_PALLAS_INTERPRET")
     key = (key, interpret)
     ok = _SHAPE_OK.get(key)
-    if ok is None:
-        import time as _time
+    if ok is not None:
+        return ok
+    with _PROBE_LOCK:
+        ok = _SHAPE_OK.get(key)
+        if ok is None:
+            import time as _time
 
-        if interpret:
-            ok = True  # interpreter mode has no Mosaic stage
-        elif _PROBE_SPENT[0] >= _probe_budget():
-            return False
-        else:
-            _t0 = _time.perf_counter()
-            try:
-                jax.jit(fn).lower(*arg_structs).compile()
-                ok = True
-            except Exception:
-                ok = False
-            finally:
-                _PROBE_SPENT[0] += _time.perf_counter() - _t0
-        _SHAPE_OK[key] = ok
+            if interpret:
+                ok = True  # interpreter mode has no Mosaic stage
+            elif _PROBE_SPENT[0] >= _probe_budget():
+                return False
+            else:
+                _t0 = _time.perf_counter()
+                try:
+                    jax.jit(fn).lower(*arg_structs).compile()
+                    ok = True
+                except Exception:
+                    ok = False
+                finally:
+                    _PROBE_SPENT[0] += _time.perf_counter() - _t0
+            _SHAPE_OK[key] = ok
     return ok
 
 

@@ -29,7 +29,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from ..base import MXNetError, Registry, get_env
+from ..base import MXNetError, Registry
+from ..util import env
 from .. import profiler as _profiler
 from ..telemetry import instruments as _tinstruments
 from ..telemetry import metrics as _tmetrics
@@ -219,7 +220,7 @@ _grad_cache: Dict[Tuple, Callable] = {}
 
 # MXNET_ENGINE_TYPE=NaiveEngine → fully synchronous execution for debugging
 # (ref: src/engine/naive_engine.cc). Any other value = async (default).
-_NAIVE = get_env("MXNET_ENGINE_TYPE", "", str) == "NaiveEngine"
+_NAIVE = env.get_str("MXNET_ENGINE_TYPE") == "NaiveEngine"
 
 
 def jitted(op: Operator, attrs_key: Tuple) -> Callable:

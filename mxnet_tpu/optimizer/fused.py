@@ -195,7 +195,10 @@ class FusedUpdater(Updater):
         g_tup = tuple(g.data for g in grads)
         s_tup = tuple(_state_data(s) for s in states)
         # pack per-parameter scalars: one (n,) vector per hyper key
-        h_vecs = {k: np.asarray([h[k] for h in hypers], np.float32)
+        # packs HOST python floats (lr/wd/t), not device arrays — this
+        # is the 3-transfers-per-step design, not a device sync
+        h_vecs = {k: np.asarray([h[k] for h in hypers],  # mxlint: disable=MX002
+                                np.float32)
                   for k in hypers[0]}
 
         dev = weights[0].ctx.jax_device

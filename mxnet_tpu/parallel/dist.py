@@ -83,14 +83,10 @@ _POISONED: Optional[str] = None
 def _collective_timeout(timeout: Optional[float]) -> Optional[float]:
     if timeout is not None:
         return timeout if timeout > 0 else None
-    v = os.environ.get(_TIMEOUT_ENV)
-    if v:
-        try:
-            t = float(v)
-        except ValueError:
-            raise MXNetError(
-                f"{_TIMEOUT_ENV}={v!r} is not a number (expected seconds, "
-                f"e.g. {_TIMEOUT_ENV}=60)")
+    from ..util import env
+
+    t = env.get_float(_TIMEOUT_ENV)
+    if t is not None:
         return t if t > 0 else None
     return None
 

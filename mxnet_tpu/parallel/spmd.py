@@ -317,7 +317,7 @@ class SPMDTrainer:
 
         # replicated trainable params fuse into one flat update kernel per
         # (lr_mult, wd_mult) group; mesh-sharded params stay per-parameter
-        from ..base import get_env
+        from ..util import env
 
         self._has_master = {
             n: self._fopt.needs_master(v) for n, v in self.params.items()
@@ -328,7 +328,7 @@ class SPMDTrainer:
         # tiled layouts and donation aliasing, costing far more than the
         # per-param fusions it merges (162ms vs 113ms ResNet-50 step); the
         # per-param updates fuse into the wgrad epilogue anyway
-        flat_on = get_env("MXNET_FUSED_OPTIMIZER", False, bool)
+        flat_on = env.get_bool("MXNET_FUSED_OPTIMIZER")
         for n, p in self._plist:
             if not self._trainable[n]:
                 continue

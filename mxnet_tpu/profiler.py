@@ -17,7 +17,7 @@ import time
 from collections import defaultdict
 from typing import Dict, List, Optional
 
-from .base import get_env
+from .util import env
 
 __all__ = [
     "set_config", "start", "stop", "dump", "dumps", "profile_op",
@@ -52,7 +52,8 @@ def set_config(**kwargs):
         raise ValueError(
             f"profiler.set_config: unknown key(s) "
             f"{sorted(unknown)}; valid keys: {sorted(_config)}")
-    _config.update(kwargs)
+    with _lock:
+        _config.update(kwargs)
 
 
 def start():
@@ -96,7 +97,7 @@ def instant(name: str, domain: str = "user",
     return append_event(ev)
 
 
-if get_env("MXNET_PROFILER_AUTOSTART", 0, int):
+if env.get_bool("MXNET_PROFILER_AUTOSTART"):
     start()
 
 

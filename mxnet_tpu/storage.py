@@ -24,7 +24,8 @@ from __future__ import annotations
 import os
 from typing import Dict, Optional, Tuple
 
-from .base import MXNetError, get_env
+from .base import MXNetError
+from .util import env
 
 __all__ = ["memory_info", "memory_summary", "configure",
            "live_array_bytes"]
@@ -128,7 +129,7 @@ def configure(pool_reserve_pct: Optional[int] = None,
 
 def _env_pool_reserve_default() -> None:
     """Honor the reference env var spelling at import."""
-    reserve = get_env("MXNET_GPU_MEM_POOL_RESERVE", None, int)
+    reserve = env.get_int("MXNET_GPU_MEM_POOL_RESERVE")
     if reserve is not None and \
             "XLA_PYTHON_CLIENT_MEM_FRACTION" not in os.environ:
         os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = str(

@@ -6,6 +6,8 @@ ref: python/mxnet/symbol/register.py::_make_symbol_function).
 """
 from __future__ import annotations
 
+import threading as _threading
+
 from .symbol import Group, Symbol, Variable, load, load_json, var
 from .executor import GraphExecutor
 
@@ -14,6 +16,7 @@ __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
            "power", "modulo", "logical_and", "logical_or", "logical_xor"]
 
 _CACHE = {}
+_CACHE_LOCK = _threading.Lock()  # module attrs resolve from any thread
 
 
 def zeros(shape, dtype="float32", name=None):
@@ -69,7 +72,8 @@ def __getattr__(name):
         import importlib
 
         mod = importlib.import_module("..contrib.symbol", __name__)
-        _CACHE["contrib"] = mod
+        with _CACHE_LOCK:
+            _CACHE["contrib"] = mod
         globals()["contrib"] = mod
         return mod
     from ..ops.registry import OP_REGISTRY
@@ -79,6 +83,7 @@ def __getattr__(name):
         return _CACHE[name]
     if name in OP_REGISTRY:
         fn = make_symbol_function(name)
-        _CACHE[name] = fn
+        with _CACHE_LOCK:
+            fn = _CACHE.setdefault(name, fn)
         return fn
     raise AttributeError(f"module 'mxnet_tpu.symbol' has no attribute {name!r}")

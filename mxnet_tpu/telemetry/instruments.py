@@ -42,8 +42,8 @@ def _revalidate_locked() -> None:
     global _generation
     gen = get_registry().generation
     if gen != _generation:
-        _families.clear()
-        _children.clear()
+        _families.clear()  # mxlint: disable=MX004 — caller holds _lock
+        _children.clear()  # mxlint: disable=MX004 — caller holds _lock
         _generation = gen
 
 

@@ -32,7 +32,7 @@ import time
 from typing import Optional
 
 from .. import profiler as _prof
-from ..base import get_env
+from ..util import env
 
 __all__ = [
     "enable", "disable", "enabled", "Span", "span", "current_span",
@@ -40,7 +40,7 @@ __all__ = [
     "counter_event",
 ]
 
-_ENABLED = bool(get_env("MXNET_TELEMETRY", 0, int))
+_ENABLED = env.get_bool("MXNET_TELEMETRY")
 
 _span_ctx: "contextvars.ContextVar[Optional[Span]]" = \
     contextvars.ContextVar("mx_telemetry_span", default=None)

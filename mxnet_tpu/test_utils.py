@@ -12,7 +12,6 @@ operate on Symbols.
 """
 from __future__ import annotations
 
-import os
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -48,7 +47,9 @@ def default_context() -> Context:
     global _DEFAULT_CTX
     if _DEFAULT_CTX is not None:
         return _DEFAULT_CTX
-    name = os.environ.get("MXNET_TEST_DEFAULT_CONTEXT", "")
+    from .util import env
+
+    name = env.get_str("MXNET_TEST_DEFAULT_CONTEXT")
     if name.startswith("tpu"):
         from .context import tpu
 

@@ -1,0 +1,4 @@
+"""Framework-internal utilities (knob registry, shared helpers)."""
+from . import env
+
+__all__ = ["env"]

@@ -1,0 +1,214 @@
+"""Central registry of `MXNET_*` environment knobs.
+
+Every env-var knob the framework honors is DECLARED here once — name,
+type, default, and documentation — and read through the typed accessors
+(:func:`get_int`, :func:`get_bool`, :func:`get_str`, :func:`get_float`).
+Reading an undeclared ``MXNET_*`` name raises :class:`MXNetError`, so a
+typo'd knob dies at the read site instead of silently returning its
+default forever (the bug class mxlint rule MX003 exists to catch).
+
+The registry is the single source of truth for ``docs/env_vars.md``
+(generated via ``python tools/mxlint.py --env-docs``) and is fully
+populated at import time, so documentation can never trail the code.
+
+Declared defaults are what the accessor returns when the variable is
+unset; a call site may pass ``default=`` to override — used by knobs
+whose default is computed (worker counts, probe budgets), which declare
+``default=None`` and document the dynamic rule.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, NamedTuple, Optional
+
+from ..base import MXNetError
+from ..base import get_env as _raw_get_env  # the untyped low-level reader
+
+__all__ = [
+    "Knob", "declare", "knobs", "is_declared",
+    "get_int", "get_bool", "get_str", "get_float",
+    "generate_docs",
+]
+
+
+class Knob(NamedTuple):
+    name: str
+    typ: type
+    default: Any
+    doc: str
+
+
+_KNOBS: Dict[str, Knob] = {}
+_LOCK = threading.Lock()
+
+_UNSET = object()
+
+
+def declare(name: str, typ: type, default: Any, doc: str) -> Knob:
+    """Register a knob. Idempotent for identical declarations; a
+    conflicting re-declaration (different type or default) raises —
+    two call sites silently disagreeing about a knob's default is
+    exactly the drift this registry exists to prevent."""
+    if not name.startswith("MXNET_"):
+        raise MXNetError(
+            f"env knob {name!r} must use the MXNET_ prefix; other "
+            "process env vars are not framework knobs")
+    k = Knob(name, typ, default, doc)
+    with _LOCK:
+        prev = _KNOBS.get(name)
+        if prev is not None:
+            if prev.typ is not typ or prev.default != default:
+                raise MXNetError(
+                    f"env knob {name} re-declared with conflicting "
+                    f"type/default: {prev.typ.__name__}/{prev.default!r} "
+                    f"vs {typ.__name__}/{default!r}")
+            return prev
+        _KNOBS[name] = k
+    return k
+
+
+def is_declared(name: str) -> bool:
+    return name in _KNOBS
+
+
+def knobs() -> List[Knob]:
+    """All declared knobs, sorted by name (docs generation order)."""
+    with _LOCK:
+        return sorted(_KNOBS.values())
+
+
+def _get(name: str, typ: type, default: Any) -> Any:
+    knob = _KNOBS.get(name)
+    if knob is None:
+        raise MXNetError(
+            f"unregistered env knob {name!r} — declare it in "
+            f"mxnet_tpu/util/env.py (known: {sorted(_KNOBS)[:20]}...)")
+    if knob.typ is not typ:
+        raise MXNetError(
+            f"env knob {name} is declared as {knob.typ.__name__}, "
+            f"read as {typ.__name__}")
+    dflt = knob.default if default is _UNSET else default
+    return _raw_get_env(name, dflt, typ)
+
+
+def get_int(name: str, default: Any = _UNSET) -> Optional[int]:
+    return _get(name, int, default)
+
+
+def get_bool(name: str, default: Any = _UNSET) -> Optional[bool]:
+    return _get(name, bool, default)
+
+
+def get_str(name: str, default: Any = _UNSET) -> Optional[str]:
+    return _get(name, str, default)
+
+
+def get_float(name: str, default: Any = _UNSET) -> Optional[float]:
+    return _get(name, float, default)
+
+
+def generate_docs() -> str:
+    """Markdown reference for every declared knob (docs/env_vars.md)."""
+    lines = [
+        "# Environment variables",
+        "",
+        "Generated from the knob registry (`mxnet_tpu/util/env.py`) by",
+        "`python tools/mxlint.py --env-docs`.  **Do not edit by hand** —",
+        "a tier-1 test (`tests/test_mxlint.py`) fails when this file is",
+        "out of sync with the registry.",
+        "",
+        "| Variable | Type | Default | Description |",
+        "|---|---|---|---|",
+    ]
+    for k in knobs():
+        dflt = "*(dynamic)*" if k.default is None else f"`{k.default!r}`"
+        doc = " ".join(k.doc.split())
+        lines.append(f"| `{k.name}` | {k.typ.__name__} | {dflt} | {doc} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# The knob catalogue.  One declaration per knob the framework honors;
+# grouped by subsystem.  Keep alphabetical within each group.
+# ---------------------------------------------------------------------------
+
+# -- engine / dispatch ------------------------------------------------------
+declare("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
+        "Execution engine. 'ThreadedEnginePerDevice' (default) is the "
+        "async PjRt dispatch path; 'NaiveEngine' makes every op call "
+        "block_until_ready for debugging (ref: src/engine/naive_engine.cc).")
+declare("MXNET_CPU_WORKER_NTHREADS", int, None,
+        "Worker threads of the native dependency engine. Default is "
+        "computed: max(2, os.cpu_count()).")
+declare("MXNET_USE_NATIVE", bool, True,
+        "Load/build the native C++ modules (engine, RecordIO, image "
+        "pipeline). 0 forces the pure-Python fallbacks.")
+
+# -- contexts / memory ------------------------------------------------------
+declare("MXNET_DEFAULT_CONTEXT", str, None,
+        "Force the default device context ('cpu' or 'tpu'). Default is "
+        "computed: tpu(0) when an accelerator is visible, else cpu(0).")
+declare("MXNET_GPU_MEM_POOL_RESERVE", int, None,
+        "Percent of device memory kept OUT of the allocator pool "
+        "(reference spelling); mapped to XLA_PYTHON_CLIENT_MEM_FRACTION "
+        "at import. Unset = XLA default.")
+
+# -- training ---------------------------------------------------------------
+declare("MXNET_BACKWARD_DO_MIRROR", bool, False,
+        "Gradient mirroring: recompute activations in the backward "
+        "(jax.checkpoint) instead of keeping them in HBM — trades MXU "
+        "FLOPs for memory.")
+declare("MXNET_FUSED_BUCKET_BYTES", int, 4 << 20,
+        "Bucket size for the fused gradient allreduce "
+        "(KVStore.pushpull_fused): one collective per ~this many bytes "
+        "of dtype-homogeneous dense gradients.")
+declare("MXNET_FUSED_OPTIMIZER", bool, False,
+        "SPMD trainer: concatenate fully-replicated parameters into one "
+        "flat optimizer update. Default off — profiling showed the 1-D "
+        "concat destroys conv-weight tiled layouts and donation aliasing.")
+declare("MXNET_KVSTORE_TIMEOUT", float, None,
+        "Seconds a distributed collective may block before the worker "
+        "aborts loudly instead of hanging on a dead peer. Unset/0 = wait "
+        "forever.")
+
+# -- ops / kernels ----------------------------------------------------------
+declare("MXNET_BN_EXACT_VAR", bool, False,
+        "BatchNorm uses the exact two-pass variance instead of the "
+        "single-pass shifted estimator; also disables the fused Conv+BN "
+        "path (whose statistics are inherently single-pass).")
+declare("MXNET_FUSED_CONVBN", bool, False,
+        "Route ResNet V1 residual blocks through the fused Pallas "
+        "Conv+BN+ReLU kernels when tracing in NHWC layout.")
+declare("MXNET_FUSED_CONVBN_BWD", bool, False,
+        "Opt-in Pallas backward for the fused Conv+BN units (roughly "
+        "doubles the probe-compile surface; see "
+        "MXNET_PALLAS_PROBE_BUDGET).")
+declare("MXNET_PALLAS_INTERPRET", bool, False,
+        "Run Pallas kernels in interpreter mode (CPU testing): no "
+        "Mosaic compile, bit-accurate reference semantics.")
+declare("MXNET_PALLAS_PROBE_BUDGET", float, None,
+        "Cumulative seconds of probe-compiles allowed when deciding "
+        "whether a Pallas kernel supports a shape. Default is computed: "
+        "600 when MXNET_FUSED_CONVBN_BWD=1, else 300.")
+declare("MXNET_USE_PALLAS", bool, True,
+        "Master switch for Pallas kernels (flash attention, fused "
+        "Conv+BN). 0 selects the XLA fallbacks with identical "
+        "semantics.")
+
+# -- observability ----------------------------------------------------------
+declare("MXNET_PROFILER_AUTOSTART", bool, False,
+        "Start the chrome-trace profiler at import (ref: "
+        "MXNET_PROFILER_AUTOSTART).")
+declare("MXNET_TELEMETRY", bool, False,
+        "Enable telemetry span tracing at import (metrics are always "
+        "on; this turns on trace-event emission — see "
+        "docs/observability.md).")
+
+# -- init / test harness ----------------------------------------------------
+declare("MXNET_TEST_DEFAULT_CONTEXT", str, "",
+        "Test-suite context override: 'tpu' or 'cpu' "
+        "(ref: test_utils.default_context).")
+declare("MXNET_USE_SIGNAL_HANDLER", bool, True,
+        "Install faulthandler crash signal handlers at import (ref: "
+        "src/initialize.cc).")

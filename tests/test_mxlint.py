@@ -1,0 +1,274 @@
+"""Tier-1 mxlint gate (ISSUE 4): the framework must lint clean against
+the committed baseline, the baseline must ratchet (new violations
+fail), docs/env_vars.md must match the live knob registry, and the
+lint-driven thread-safety fixes must hold under contention."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis
+from mxnet_tpu.util import env
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BASELINE = os.path.join(_REPO, "MXLINT_BASELINE.json")
+_PKG = os.path.join(_REPO, "mxnet_tpu")
+
+
+_LINT_CACHE = []
+
+
+def _run_lint():
+    """One full-package lint shared by every assertion in this module
+    (each run costs ~4s of tier-1 budget)."""
+    if not _LINT_CACHE:
+        eng = analysis.LintEngine(root=_REPO)
+        t0 = time.perf_counter()
+        violations = eng.run([_PKG])
+        _LINT_CACHE.append((eng, violations, time.perf_counter() - t0))
+    return _LINT_CACHE[0]
+
+
+class TestSelfLintGate:
+    def test_package_lints_clean_against_baseline(self):
+        eng, violations, elapsed = _run_lint()
+        new, suppressed, stale = analysis.diff_baseline(
+            violations, analysis.load_baseline(_BASELINE))
+        assert eng.errors == [], f"unparsable files: {eng.errors}"
+        assert new == [], (
+            "NEW mxlint violations (fix them or — with a written "
+            "justification — add to MXLINT_BASELINE.json):\n"
+            + "\n".join(v.format() for v in new))
+        # acceptance criterion: full-package lint well under 15s
+        assert elapsed < 15.0, f"lint took {elapsed:.1f}s (budget 15s)"
+
+    def test_introducing_a_violation_fails_the_gate(self, tmp_path):
+        bad = tmp_path / "regression.py"
+        bad.write_text("_CACHE = {}\n\n"
+                       "def put(k, v):\n"
+                       "    _CACHE[k] = v\n")
+        eng = analysis.LintEngine(root=_REPO)
+        violations = eng.run([str(bad)])  # package itself is covered
+                                          # by the gate test above
+        new, _, _ = analysis.diff_baseline(
+            violations, analysis.load_baseline(_BASELINE))
+        assert [v.rule for v in new] == ["MX004"]
+
+    def test_baseline_entries_all_have_justifications(self):
+        entries = analysis.load_baseline(_BASELINE)
+        assert entries, "baseline unexpectedly empty"
+        bad = [e for e in entries
+               if not e.get("justification", "").strip()]
+        assert bad == []
+
+    def test_no_stale_baseline_entries(self):
+        _, violations, _ = _run_lint()
+        _, _, stale = analysis.diff_baseline(
+            violations, analysis.load_baseline(_BASELINE))
+        assert stale == [], (
+            "baseline entries whose violation was fixed — delete them "
+            "(ratchet down):\n" + json.dumps(stale, indent=1))
+
+
+class TestEnvDocsSync:
+    def test_env_vars_md_matches_registry(self):
+        committed = open(os.path.join(_REPO, "docs", "env_vars.md"),
+                         encoding="utf-8").read()
+        assert committed == env.generate_docs(), (
+            "docs/env_vars.md is stale — regenerate with "
+            "`python tools/mxlint.py --env-docs docs/env_vars.md`")
+
+    def test_every_mxnet_read_site_is_declared(self):
+        # the knob registry raises on undeclared names; a couple of
+        # spot checks that migrated call sites resolve
+        assert env.is_declared("MXNET_ENGINE_TYPE")
+        assert env.is_declared("MXNET_FUSED_BUCKET_BYTES")
+        with pytest.raises(mx.MXNetError):
+            env.get_bool("MXNET_TOTALLY_UNKNOWN_KNOB")
+
+    def test_numeric_bool_values_keep_working(self, monkeypatch):
+        # reference knobs are int-typed booleans: MXNET_TELEMETRY=2
+        # historically meant true — the registry migration must not
+        # turn that into an import-time crash
+        monkeypatch.setenv("MXNET_TELEMETRY", "2")
+        assert env.get_bool("MXNET_TELEMETRY") is True
+        monkeypatch.setenv("MXNET_TELEMETRY", "0")
+        assert env.get_bool("MXNET_TELEMETRY") is False
+        monkeypatch.setenv("MXNET_TELEMETRY", "banana")
+        with pytest.raises(mx.MXNetError):
+            env.get_bool("MXNET_TELEMETRY")
+
+    def test_empty_string_means_unset(self, monkeypatch):
+        # launchers export VAR="" as the 'use the default' spelling
+        monkeypatch.setenv("MXNET_KVSTORE_TIMEOUT", "")
+        assert env.get_float("MXNET_KVSTORE_TIMEOUT") is None
+        monkeypatch.setenv("MXNET_FUSED_BUCKET_BYTES", "")
+        assert env.get_int("MXNET_FUSED_BUCKET_BYTES") == 4 << 20
+
+    def test_conflicting_redeclaration_raises(self):
+        with pytest.raises(mx.MXNetError):
+            env.declare("MXNET_ENGINE_TYPE", int, 3, "conflict")
+        # identical re-declaration is idempotent
+        k = env.declare("MXNET_USE_PALLAS", bool, True,
+                        "Master switch for Pallas kernels (flash "
+                        "attention, fused Conv+BN). 0 selects the XLA "
+                        "fallbacks with identical semantics.")
+        assert k.default is True
+
+
+class TestLintDrivenHardening:
+    """Regression tests for the CONFIRMED MX004 findings fixed in this
+    PR: module caches shared with serving/dataloader threads now take
+    the double-checked-lock path."""
+
+    def test_pallas_convbn_decides_once_under_contention(self, monkeypatch):
+        from mxnet_tpu.ops import pallas_convbn as pc
+
+        calls = []
+
+        def slow_decide():
+            calls.append(1)
+            time.sleep(0.05)
+            return False
+
+        monkeypatch.setattr(pc, "_decide_pallas", slow_decide)
+        monkeypatch.setitem(pc._STATE, "enabled", None)
+        results = []
+        threads = [threading.Thread(
+            target=lambda: results.append(pc._pallas_wanted()))
+            for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(calls) == 1, "probe ran once despite 8 racing threads"
+        assert results == [False] * 8
+
+    def test_pallas_attention_decides_once_under_contention(
+            self, monkeypatch):
+        from mxnet_tpu.ops import pallas_attention as pa
+
+        calls = []
+
+        def slow_decide():
+            calls.append(1)
+            time.sleep(0.05)
+            return False
+
+        monkeypatch.setattr(pa, "_decide_pallas", slow_decide)
+        monkeypatch.setitem(pa._PALLAS_STATE, "enabled", None)
+        results = []
+        threads = [threading.Thread(
+            target=lambda: results.append(pa._pallas_wanted()))
+            for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(calls) == 1 and results == [False] * 8
+
+    def test_probe_cache_single_probe_per_key(self, monkeypatch):
+        from mxnet_tpu.ops import pallas_convbn as pc
+
+        monkeypatch.setattr(pc, "_SHAPE_OK", {})
+        monkeypatch.setattr(pc, "_PROBE_SPENT", [0.0])
+        monkeypatch.setattr(pc.env, "get_bool",
+                            lambda name, default=None: False)
+        compiles = []
+
+        class _FakeJit:
+            def lower(self, *a):
+                return self
+
+            def compile(self):
+                compiles.append(1)
+                time.sleep(0.05)
+                return self
+
+        monkeypatch.setattr(pc.jax, "jit", lambda fn: _FakeJit())
+        out = []
+        threads = [threading.Thread(
+            target=lambda: out.append(pc._probe_ok("k", None, ())))
+            for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(compiles) == 1, "one probe compile despite the race"
+        assert out == [True] * 8
+        assert pc._SHAPE_OK == {("k", False): True}
+
+    def test_deploy_namedtuple_cache_yields_one_class(self):
+        from mxnet_tpu.contrib import deploy
+
+        deploy._NT_CACHE.clear()
+        got = []
+        barrier = threading.Barrier(8)
+
+        def hit():
+            barrier.wait()
+            got.append(deploy._namedtuple_cls("Out", ("a", "b")))
+
+        threads = [threading.Thread(target=hit) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len({id(c) for c in got}) == 1, \
+            "identity-stable class per (name, fields) key"
+
+    def test_symbol_namespace_cache_identity(self):
+        import mxnet_tpu.symbol as sym
+
+        sym._CACHE.pop("relu", None)
+        got = []
+        barrier = threading.Barrier(8)
+
+        def hit():
+            barrier.wait()
+            got.append(getattr(sym, "relu"))
+
+        threads = [threading.Thread(target=hit) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # the lazily generated wrapper must resolve to ONE function
+        # object no matter which thread generated it
+        assert len({id(f) for f in got}) == 1
+
+    def test_profiler_set_config_is_lock_guarded(self):
+        # concurrent set_config must neither corrupt nor lose keys
+        before = dict(mx.profiler._config)
+        try:
+            threads = [threading.Thread(
+                target=mx.profiler.set_config,
+                kwargs={"aggregate_stats": bool(i % 2)})
+                for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert set(mx.profiler._config) == set(before)
+        finally:
+            mx.profiler.set_config(
+                aggregate_stats=before["aggregate_stats"])
+
+
+class TestCLISmoke:
+    def test_cli_exits_zero_on_shipped_tree(self):
+        import subprocess
+        import sys
+
+        p = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "mxlint.py"),
+             os.path.join(_REPO, "mxnet_tpu"),
+             "--baseline", _BASELINE, "--json"],
+            capture_output=True, text=True, timeout=120, cwd=_REPO)
+        assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+        report = json.loads(p.stdout)
+        assert report["ok"] and report["counts"]["new"] == 0
+        assert report["elapsed_seconds"] < 15.0

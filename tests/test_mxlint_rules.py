@@ -1,0 +1,414 @@
+"""Per-rule mxlint fixtures: at least one positive (flagged) and one
+negative (clean) program per rule, plus pragma suppression and the
+baseline ratchet (ISSUE 4)."""
+import textwrap
+
+import pytest
+
+from mxnet_tpu import analysis
+
+
+def lint_source(tmp_path, source, enable=None, name="fixture.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    eng = analysis.LintEngine(root=str(tmp_path), enable=enable)
+    return eng.run([str(f)])
+
+
+def rules_hit(violations):
+    return sorted({v.rule for v in violations})
+
+
+# ---------------------------------------------------------------------------
+# MX001 — recompile hazard
+# ---------------------------------------------------------------------------
+
+class TestMX001:
+    def test_flags_int_coercion_in_jitted_function(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x * int(x)
+            """, enable=["MX001"])
+        assert rules_hit(vs) == ["MX001"]
+        assert "int()" in vs[0].message
+
+    def test_flags_item_in_jit_wrapped_local_function(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import jax
+
+            def loss(x):
+                return x.item() + 1.0
+
+            loss_c = jax.jit(loss, donate_argnums=())
+            """, enable=["MX001"])
+        assert rules_hit(vs) == ["MX001"]
+
+    def test_flags_np_asarray_under_partial_jit_decorator(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import functools
+            import jax
+            import numpy as np
+
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def fwd(x, n):
+                return np.asarray(x) + n
+            """, enable=["MX001"])
+        assert rules_hit(vs) == ["MX001"]
+
+    def test_clean_shape_derived_scalars_and_unjitted_code(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x):
+                n = int(x.shape[0])
+                k = float(len(x.shape))
+                return x * n + k
+
+            def eager(x):
+                return int(x)  # not a jit context
+            """, enable=["MX001"])
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# MX002 — host sync in the hot path
+# ---------------------------------------------------------------------------
+
+class TestMX002:
+    def test_flags_asnumpy_inside_record_block(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            def train(net, x, autograd):
+                with autograd.record():
+                    y = net(x)
+                    v = y.asnumpy()
+                return v
+            """, enable=["MX002"])
+        assert rules_hit(vs) == ["MX002"]
+        assert "record()" in vs[0].message
+
+    def test_flags_np_asarray_in_trainer_step_chain(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import numpy as np
+
+            class MyTrainer:
+                def step(self, batch_size):
+                    g = self._grads[0]
+                    return np.asarray(g)
+            """, enable=["MX002"])
+        assert rules_hit(vs) == ["MX002"]
+
+    def test_clean_outside_hot_paths(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import numpy as np
+
+            class MyTrainer:
+                def save_states(self, fname):
+                    # serialization is a cold path
+                    return np.asarray(self._state)
+
+            def evaluate(y):
+                return y.asnumpy()
+            """, enable=["MX002"])
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# MX003 — untracked env knob
+# ---------------------------------------------------------------------------
+
+class TestMX003:
+    def test_flags_raw_reads_of_mxnet_names(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import os
+            from .base import get_env
+
+            a = os.environ.get("MXNET_FOO")
+            b = os.environ["MXNET_BAR"]
+            c = os.getenv("MXNET_BAZ", "0")
+            d = get_env("MXNET_QUX", 1, int)
+            """, enable=["MX003"])
+        assert len(vs) == 4
+        assert rules_hit(vs) == ["MX003"]
+
+    def test_clean_registry_reads_and_foreign_vars(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import os
+            from .util import env
+
+            a = env.get_bool("MXNET_FOO")
+            b = os.environ.get("DMLC_ROLE")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            """, enable=["MX003"])
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# MX004 — unguarded shared state
+# ---------------------------------------------------------------------------
+
+class TestMX004:
+    def test_flags_unguarded_cache_write(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            _CACHE = {}
+            _LOG = []
+
+            def put(k, v):
+                _CACHE[k] = v
+
+            def note(msg):
+                _LOG.append(msg)
+            """, enable=["MX004"])
+        assert len(vs) == 2
+        assert rules_hit(vs) == ["MX004"]
+
+    def test_clean_with_lock_and_module_level_init(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import threading
+
+            _CACHE = {}
+            _lock = threading.Lock()
+            _CACHE["seed"] = 1  # import-time init is single-threaded
+
+            def put(k, v):
+                with _lock:
+                    _CACHE[k] = v
+
+            def put_method(self, k, v):
+                with self._jit_lock:
+                    _CACHE[k] = v
+            """, enable=["MX004"])
+        assert vs == []
+
+    def test_local_shadowing_dict_is_clean(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            _CACHE = {}
+
+            def pure(k, v):
+                local = {}
+                local[k] = v
+                return local
+            """, enable=["MX004"])
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# MX005 — donation misuse
+# ---------------------------------------------------------------------------
+
+class TestMX005:
+    def test_flags_read_after_donating_call(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import jax
+
+            def run(fn, x, y):
+                f = jax.jit(fn, donate_argnums=(0,))
+                out = f(x, y)
+                return out + x
+            """, enable=["MX005"])
+        assert rules_hit(vs) == ["MX005"]
+        assert "`x`" in vs[0].message
+
+    def test_flags_inline_jit_donation(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import jax
+
+            def run(fn, w, g):
+                new_w = jax.jit(fn, donate_argnums=(0,))(w, g)
+                stale = w.sum()
+                return new_w, stale
+            """, enable=["MX005"])
+        assert rules_hit(vs) == ["MX005"]
+
+    def test_clean_same_statement_rebind_idiom(self, tmp_path):
+        # `state = step(state, batch)` is THE canonical donation
+        # pattern — it must never be flagged
+        vs = lint_source(tmp_path, """
+            import jax
+
+            def train(step_fn, w, batches):
+                f = jax.jit(step_fn, donate_argnums=(0,))
+                for b in batches:
+                    w = f(w, b)
+                return w
+            """, enable=["MX005"])
+        assert vs == []
+
+    def test_clean_undonated_and_rebound_reads(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import jax
+
+            def run(fn, x, y):
+                f = jax.jit(fn, donate_argnums=(0,))
+                out = f(x, y)
+                use = y + 1  # position 1 is NOT donated
+                x = out      # rebound: the old buffer is gone
+                return x + use
+            """, enable=["MX005"])
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# MX006 — op-registry contract
+# ---------------------------------------------------------------------------
+
+class TestMX006:
+    def test_flags_duplicate_name_and_missing_docstring(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            from .registry import register_op
+
+            @register_op("relu")
+            def relu(x):
+                return x
+
+            @register_op("relu6", aliases=("relu",))
+            def relu6(x):
+                \"\"\"Clipped relu.\"\"\"
+                return x
+            """, enable=["MX006"])
+        # relu: missing docstring; relu6's alias duplicates 'relu'
+        assert len(vs) == 2
+        assert any("no docstring" in v.message for v in vs)
+        assert any("already registered" in v.message for v in vs)
+
+    def test_duplicates_detected_across_files(self, tmp_path):
+        (tmp_path / "a.py").write_text(textwrap.dedent("""
+            @register_op("Conv")
+            def conv(x):
+                \"\"\"doc\"\"\"
+                return x
+            """))
+        (tmp_path / "b.py").write_text(textwrap.dedent("""
+            @register_op("Conv")
+            def conv2(x):
+                \"\"\"doc\"\"\"
+                return x
+            """))
+        eng = analysis.LintEngine(root=str(tmp_path), enable=["MX006"])
+        vs = eng.run([str(tmp_path)])
+        assert len(vs) == 1 and "already registered" in vs[0].message
+
+    def test_clean_unique_documented_op(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            @register_op("Softmax", aliases=("softmax",))
+            def softmax(x, axis=-1):
+                \"\"\"Normalized exponentials along `axis`.\"\"\"
+                return x
+            """, enable=["MX006"])
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# pragmas, enable/disable, baseline ratchet
+# ---------------------------------------------------------------------------
+
+class TestPragma:
+    def test_line_pragma_suppresses_named_rule(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            _CACHE = {}
+
+            def put(k, v):
+                _CACHE[k] = v  # mxlint: disable=MX004
+            """, enable=["MX004"])
+        assert vs == []
+
+    def test_pragma_with_other_code_does_not_suppress(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            _CACHE = {}
+
+            def put(k, v):
+                _CACHE[k] = v  # mxlint: disable=MX001
+            """, enable=["MX004"])
+        assert rules_hit(vs) == ["MX004"]
+
+    def test_bare_pragma_suppresses_everything(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import os
+
+            _CACHE = {}
+
+            def put(k):
+                _CACHE[k] = os.environ.get("MXNET_FOO")  # mxlint: disable
+            """)
+        assert vs == []
+
+
+class TestEngineConfig:
+    def test_enable_selects_exactly(self, tmp_path):
+        src = """
+            import os
+
+            _CACHE = {}
+
+            def put(k):
+                _CACHE[k] = os.environ.get("MXNET_FOO")
+            """
+        assert rules_hit(lint_source(tmp_path, src)) == ["MX003", "MX004"]
+        assert rules_hit(lint_source(
+            tmp_path, src, enable=["MX003"])) == ["MX003"]
+
+    def test_disable_subtracts(self, tmp_path):
+        f = tmp_path / "fixture.py"
+        f.write_text("_C = {}\n\ndef p(k, v):\n    _C[k] = v\n")
+        eng = analysis.LintEngine(root=str(tmp_path), disable=["MX004"])
+        assert eng.run([str(f)]) == []
+
+    def test_unknown_rule_id_raises(self):
+        with pytest.raises(ValueError):
+            analysis.LintEngine(enable=["MX999"])
+
+    def test_syntax_error_reported_not_fatal(self, tmp_path):
+        (tmp_path / "bad.py").write_text("def broken(:\n")
+        eng = analysis.LintEngine(root=str(tmp_path))
+        assert eng.run([str(tmp_path)]) == []
+        assert len(eng.errors) == 1 and "bad.py" in eng.errors[0]
+
+
+class TestBaseline:
+    SRC = """
+        _CACHE = {}
+
+        def put(k, v):
+            _CACHE[k] = v
+        """
+
+    def test_ratchet_suppresses_old_flags_new(self, tmp_path):
+        vs = lint_source(tmp_path, self.SRC, enable=["MX004"])
+        baseline = analysis.make_baseline(vs)["entries"]
+        # same tree: everything baselined
+        new, suppressed, stale = analysis.diff_baseline(vs, baseline)
+        assert (new, len(suppressed), stale) == ([], 1, [])
+        # a NEW violation elsewhere fails even with the baseline
+        vs2 = lint_source(tmp_path, self.SRC + """
+            def put2(k, v):
+                _CACHE[k] = v
+            """, enable=["MX004"], name="fixture2.py")
+        new, _, _ = analysis.diff_baseline(vs2, baseline)
+        assert len(new) == 2  # different file: neither matches baseline
+
+    def test_fixed_violation_reported_stale(self, tmp_path):
+        vs = lint_source(tmp_path, self.SRC, enable=["MX004"])
+        baseline = analysis.make_baseline(vs)["entries"]
+        new, suppressed, stale = analysis.diff_baseline([], baseline)
+        assert (new, suppressed) == ([], [])
+        assert len(stale) == 1
+
+    def test_fingerprint_survives_line_drift(self, tmp_path):
+        vs1 = lint_source(tmp_path, self.SRC, enable=["MX004"])
+        vs2 = lint_source(tmp_path, self.SRC, enable=["MX004"],
+                          name="fixture2.py")
+        f = tmp_path / "fixture.py"
+        f.write_text("\n\n\n" + textwrap.dedent(self.SRC))
+        eng = analysis.LintEngine(root=str(tmp_path), enable=["MX004"])
+        vs_shifted = eng.run([str(f)])
+        assert vs_shifted[0].line != vs1[0].line
+        assert vs_shifted[0].fingerprint == vs1[0].fingerprint
+        assert vs2[0].fingerprint != vs1[0].fingerprint  # path differs
+
+    def test_every_entry_carries_a_justification(self, tmp_path):
+        vs = lint_source(tmp_path, self.SRC, enable=["MX004"])
+        doc = analysis.make_baseline(vs, justifications={"MX004": "why"})
+        assert all(e["justification"] == "why" for e in doc["entries"])

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""mxlint CLI — framework-aware static analysis for mxnet_tpu.
+
+    python tools/mxlint.py mxnet_tpu --baseline MXLINT_BASELINE.json
+    python tools/mxlint.py mxnet_tpu --json --check --out MXLINT.json
+    python tools/mxlint.py --env-docs docs/env_vars.md
+    python tools/mxlint.py --list-rules
+
+Exit status: 0 when no NEW violations (baselined ones do not fail);
+non-zero when any new violation, unparsable file, or (with --check)
+stale baseline entry is found.
+
+The analysis package is loaded standalone — WITHOUT importing
+mxnet_tpu/__init__.py — so a full-package lint stays a few seconds of
+pure-AST work instead of paying the jax import.  Only --env-docs
+imports the framework (it reads the live knob registry).
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analysis():
+    """Load mxnet_tpu.analysis without executing mxnet_tpu/__init__.py.
+
+    Seeding sys.modules['mxnet_tpu.analysis'] first means the package's
+    internal relative imports resolve against it directly and never
+    consult the (absent) parent package.
+    """
+    if "mxnet_tpu.analysis" in sys.modules:
+        return sys.modules["mxnet_tpu.analysis"]
+    pkg_dir = os.path.join(_REPO, "mxnet_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "mxnet_tpu.analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["mxnet_tpu.analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _env_docs(out_path: str | None) -> int:
+    sys.path.insert(0, _REPO)
+    from mxnet_tpu.util import env
+
+    text = env.generate_docs()
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"wrote {out_path} ({len(env.knobs())} knobs)")
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="files/directories to lint (default: mxnet_tpu)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON; listed violations are "
+                    "suppressed (ratchet)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the JSON report instead of text")
+    ap.add_argument("--check", action="store_true",
+                    help="CI mode: also fail on stale baseline entries "
+                    "(forces the baseline to ratchet down)")
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON report to this file "
+                    "(the MXLINT.json artifact)")
+    ap.add_argument("--write-baseline", default=None, metavar="FILE",
+                    help="write every current violation to FILE as the "
+                    "new baseline and exit 0")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite --baseline with stale entries removed "
+                    "(never adds entries)")
+    ap.add_argument("--enable", default=None,
+                    help="comma-separated rule ids to run exclusively")
+    ap.add_argument("--disable", default=None,
+                    help="comma-separated rule ids to skip")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    ap.add_argument("--env-docs", nargs="?", const="", default=None,
+                    metavar="FILE",
+                    help="generate docs/env_vars.md content from the "
+                    "knob registry (to FILE, or stdout)")
+    args = ap.parse_args(argv)
+
+    if args.env_docs is not None:
+        return _env_docs(args.env_docs or None)
+
+    analysis = _load_analysis()
+
+    if args.list_rules:
+        for rid, cls in sorted(analysis.RULE_REGISTRY.items()):
+            print(f"{rid}  {cls.name:<24} {cls.description}")
+        return 0
+
+    paths = args.paths or [os.path.join(_REPO, "mxnet_tpu")]
+    t0 = time.perf_counter()
+    engine = analysis.LintEngine(
+        root=_REPO,
+        enable=[s.strip() for s in args.enable.split(",")]
+        if args.enable else None,
+        disable=[s.strip() for s in args.disable.split(",")]
+        if args.disable else None)
+    violations = engine.run(paths)
+    elapsed = time.perf_counter() - t0
+
+    if args.write_baseline:
+        doc = analysis.make_baseline(violations)
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.write_baseline}: {len(violations)} entries")
+        return 0
+
+    entries = analysis.load_baseline(args.baseline) if args.baseline \
+        else []
+    new, suppressed, stale = analysis.diff_baseline(violations, entries)
+
+    if args.update_baseline:
+        if not args.baseline:
+            ap.error("--update-baseline requires --baseline")
+        drop: dict = {}
+        for e in stale:
+            drop[e["fingerprint"]] = drop.get(e["fingerprint"], 0) + 1
+        kept = []
+        for e in entries:
+            if drop.get(e["fingerprint"], 0) > 0:
+                drop[e["fingerprint"]] -= 1
+            else:
+                kept.append(e)
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump({"version": 1, "comment":
+                       "mxlint suppression baseline — existing "
+                       "violations ratchet down; new ones fail. See "
+                       "docs/static_analysis.md.",
+                       "entries": kept}, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"pruned {len(stale)} stale entries from {args.baseline}")
+        stale = []
+
+    report = analysis.render_json(new, suppressed, stale, engine.errors)
+    report["elapsed_seconds"] = round(elapsed, 3)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        print(analysis.render_text(new, suppressed, stale, engine.errors))
+        print(f"({elapsed:.2f}s)")
+
+    failed = bool(new) or bool(engine.errors) or \
+        (args.check and bool(stale))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,13 +120,40 @@ def main():
         artifact["trace_report"] = {"returncode": -1,
                                     "note": "timed out"}
 
+    # static-analysis gate (ISSUE 4): lint the framework against the
+    # committed baseline; --check also fails on stale entries so the
+    # baseline ratchets down.  MXLINT.json records per-rule counts —
+    # the trajectory tracked across PRs.
+    mxlint_rc = None
+    try:
+        lr = subprocess.run(
+            [sys.executable, "tools/mxlint.py", "mxnet_tpu",
+             "--baseline", "MXLINT_BASELINE.json", "--json", "--check",
+             "--out", os.path.join(_REPO, "MXLINT.json")],
+            capture_output=True, text=True, timeout=300, cwd=_REPO,
+            env=cpu_env)
+        mxlint_rc = lr.returncode
+        gate = {"returncode": lr.returncode,
+                "stderr_tail": "\n".join(lr.stderr.splitlines()[-6:])}
+        try:
+            rep = json.loads(lr.stdout)
+            gate["counts"] = rep["counts"]
+            gate["new_per_rule"] = rep["new_per_rule"]
+        except (ValueError, KeyError):
+            pass
+        artifact["mxlint"] = gate
+    except subprocess.TimeoutExpired:
+        mxlint_rc = -1
+        artifact["mxlint"] = {"returncode": -1, "note": "timed out"}
+
     artifact["duration_s"] = round(time.time() - t0, 1)  # incl. gate
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=1)
     print(out.splitlines()[-1] if out.splitlines() else "")
     print(f"wrote {args.out}")
     return 0 if p.returncode == 0 and opperf_rc in (None, 0) \
-        and fused_rc in (None, 0) and trace_rc in (None, 0) else 1
+        and fused_rc in (None, 0) and trace_rc in (None, 0) \
+        and mxlint_rc in (None, 0) else 1
 
 
 if __name__ == "__main__":
