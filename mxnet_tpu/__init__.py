@@ -17,6 +17,21 @@ __version__ = "0.1.0"
 from . import base
 from .base import MXNetError
 from . import util  # knob registry (util.env) — see docs/env_vars.md
+
+# mxsan must engage BEFORE the submodule imports below so every
+# module-level lock and tracked cache the framework builds is
+# instrumented (enabling later only covers what is constructed later).
+# Known gap: locks constructed while importing `base`/`util` above
+# (e.g. the knob registry's own _LOCK) predate the patch and stay
+# uninstrumented — the registry must exist to read the knob at all.
+if util.env.get_bool("MXNET_SAN"):
+    from .analysis import sanitizer as _mxsan
+
+    _mxsan.enable(suppress=tuple(
+        s.strip() for s in
+        (util.env.get_str("MXNET_SAN_SUPPRESS") or "").split(",")
+        if s.strip()))
+
 from . import context
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import ops

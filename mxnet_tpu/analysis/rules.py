@@ -214,13 +214,21 @@ class HostSyncInHotPath(Rule):
     ``autograd.record()`` blocks or the Trainer/Updater/KVStore step
     chain.  Each such call is a device→host round-trip that stalls the
     async dispatch pipeline — the exact class of bug that erases the
-    fused-step win (arxiv 2004.13336)."""
+    fused-step win (arxiv 2004.13336).
+
+    One level interprocedural: a call FROM a hot scope to a same-module
+    helper (module-level function, or ``self.<method>`` on the same
+    class) whose body performs a sync is flagged at the call site —
+    wrapping the ``.asnumpy()`` in a logging helper must not hide it.
+    Exactly one level: helpers of helpers are out of scope (recall
+    traded for zero-false-positive precision)."""
 
     id = "MX002"
     name = "hot-path-host-sync"
     description = ("Device->host synchronization (.asnumpy()/np.asarray/"
                    ".item()/.wait_to_read()) inside autograd.record() "
-                   "or the Trainer.step call chain.")
+                   "or the Trainer.step call chain — direct, or one "
+                   "same-module call deep.")
 
     _SYNC_METHODS = {"asnumpy", "item", "wait_to_read"}
     _NP_FUNCS = {"asarray", "array"}
@@ -241,43 +249,112 @@ class HostSyncInHotPath(Rule):
                     yield node
                     break
 
-    def _hot_methods(self, ctx: FileContext) -> Iterable[ast.FunctionDef]:
+    def _hot_methods(self, ctx: FileContext
+                     ) -> Iterable[Tuple[ast.FunctionDef, ast.ClassDef]]:
         for node in ctx.classes:
             if self._HOT_CLASSES.search(node.name):
                 for item in node.body:
                     if isinstance(item, (ast.FunctionDef,
                                          ast.AsyncFunctionDef)) and \
                             item.name in self._HOT_METHODS:
-                        yield item
+                        yield item, node
+
+    def _direct_sync(self, node: ast.Call) -> Optional[str]:
+        """A short description when `node` is itself a host sync."""
+        fname = _terminal_name(node.func)
+        if isinstance(node.func, ast.Attribute):
+            if fname in self._SYNC_METHODS and not node.args:
+                return f".{fname}()"
+            if fname in self._NP_FUNCS and \
+                    _terminal_name(node.func.value) in self._NP_MODULES:
+                return f"numpy.{fname}()"
+        return None
+
+    def _helper_sync(self, ctx: FileContext, fn: ast.AST
+                     ) -> Optional[Tuple[str, int]]:
+        """First (unsuppressed) direct sync inside a helper body, as
+        (description, line) — the one level of interprocedural reach."""
+        cached = self._helper_cache.get(id(fn))
+        if cached is not None:
+            return cached[0]
+        found = None
+        for node in _walk_excluding_nested_classes(fn):
+            if isinstance(node, ast.Call):
+                desc = self._direct_sync(node)
+                if desc and not ctx.suppressed(self.id, node.lineno):
+                    found = (desc, node.lineno)
+                    break
+        self._helper_cache[id(fn)] = (found,)
+        return found
 
     def check(self, ctx: FileContext) -> Iterable[Violation]:
+        self._helper_cache: Dict[int, tuple] = {}
+        module_fns: Dict[str, ast.AST] = {
+            n.name: n for n in ctx.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        hot = list(self._hot_methods(ctx))
+        hot_ids = {id(m) for m, _ in hot}
         seen: Set[int] = set()
-        for scope, where in [
-            (b, "inside autograd.record()") for b in
-                self._record_blocks(ctx)] + [
-            (m, f"in the {m.name}() step chain") for m in
-                self._hot_methods(ctx)]:
+        # enclosing class per With node, so `self.<helper>()` resolves
+        # inside record() blocks written in methods (innermost class
+        # wins: ctx.classes lists outer classes before nested ones)
+        with_cls: Dict[int, ast.ClassDef] = {}
+        for cls_node in ctx.classes:
+            for item in cls_node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    for nd in ast.walk(item):
+                        if isinstance(nd, (ast.With, ast.AsyncWith)):
+                            with_cls[id(nd)] = cls_node
+        scopes = [(b, "inside autograd.record()", with_cls.get(id(b)))
+                  for b in self._record_blocks(ctx)] + \
+                 [(m, f"in the {m.name}() step chain", cls)
+                  for m, cls in hot]
+        for scope, where, cls in scopes:
+            methods = {} if cls is None else {
+                it.name: it for it in cls.body
+                if isinstance(it, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))}
             for node in ast.walk(scope):
                 if id(node) in seen or not isinstance(node, ast.Call):
                     continue
-                msg = None
-                fname = _terminal_name(node.func)
-                if isinstance(node.func, ast.Attribute):
-                    if fname in self._SYNC_METHODS and not node.args:
-                        msg = (f".{fname}() {where} blocks on a "
+                desc = self._direct_sync(node)
+                if desc:
+                    if desc.startswith("numpy."):
+                        msg = (f"{desc[:-2]}() {where} synchronously "
+                               "materializes device data on the host")
+                    else:
+                        msg = (f"{desc} {where} blocks on a "
                                "device->host transfer, stalling the "
                                "async dispatch pipeline")
-                    elif fname in self._NP_FUNCS and \
-                            _terminal_name(node.func.value) in \
-                            self._NP_MODULES:
-                        msg = (f"numpy.{fname}() {where} synchronously "
-                               "materializes device data on the host")
-                if msg:
                     seen.add(id(node))
                     yield ctx.violation(
                         self.id, node,
                         msg + "; move it outside the hot loop or use "
                         "an async metric hook.")
+                    continue
+                # one-level interprocedural: same-module helper calls
+                helper = None
+                f = node.func
+                if isinstance(f, ast.Name):
+                    helper = module_fns.get(f.id)
+                elif isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == "self":
+                    helper = methods.get(f.attr)
+                if helper is None or helper is scope or \
+                        id(helper) in hot_ids:
+                    continue  # hot methods are flagged at definition
+                sync = self._helper_sync(ctx, helper)
+                if sync:
+                    seen.add(id(node))
+                    yield ctx.violation(
+                        self.id, node,
+                        f"call {where} reaches {sync[0]} inside "
+                        f"helper {_terminal_name(f)}() (line {sync[1]})"
+                        " — a device->host sync one call deep; hoist "
+                        "the sync out of the hot path or make the "
+                        "helper async.")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,132 @@
+"""mxsan — runtime concurrency & dispatch sanitizer for mxnet_tpu.
+
+The static side (mxlint, this package's sibling) can pattern-match lock
+*syntax*; mxsan verifies lock *behaviour* at runtime.  Three detectors:
+
+* **lock-order graph** — every instrumented ``threading.Lock`` /
+  ``RLock`` / ``Condition`` acquire is recorded with thread id and call
+  site; a cycle in the acquisition-order graph (including the classic
+  2-lock inversion) is deadlock potential, reported with both stacks.
+* **Eraser-style lockset races** — ``mxsan.track(obj, name)``
+  annotations on module-level caches check every read/write against
+  the intersection of held locks; an empty candidate set after
+  cross-thread access means no lock consistently guards the data.
+* **recompile-storm detector** — the ops-registry jit cache, the
+  FusedUpdater AOT cache, and the serving bucket cache report each
+  executable build; a rebuilt signature or a per-site compile count
+  past warmup is the runtime ground truth static rule MX001 can only
+  guess at.
+
+Enablement (opt-in; zero overhead when off):
+
+* ``MXNET_SAN=1`` — ``mxnet_tpu`` enables the sanitizer at import,
+  before the framework's module-level locks and caches are built, so
+  everything first-party is instrumented.  The pytest plugin
+  (``tools/mxsan_pytest.py``, auto-registered by ``tests/conftest.py``)
+  then turns violations into test failures and writes ``MXSAN.json``.
+* ``mxsan.enable()`` — programmatic, same effect from that point on
+  (locks/caches created earlier stay uninstrumented).
+* ``mxsan.scope()`` — context manager giving a PRIVATE sanitizer
+  instance for tests: seeded violations land in the scoped instance,
+  not the session report.
+
+Stdlib-only, like the rest of ``mxnet_tpu.analysis``.  See
+docs/static_analysis.md ("Dynamic analysis") for the detector
+semantics and annotation how-to.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, List, Optional
+
+from . import core, locks, lockset, report
+from .core import Sanitizer, SanViolation, get_active
+from .lockset import track, is_tracked
+from .report import render_json, render_text, write_report
+
+__all__ = [
+    "Sanitizer", "SanViolation",
+    "enable", "disable", "enabled", "scope", "get_active",
+    "track", "is_tracked", "record_compile",
+    "violations", "clear_violations",
+    "render_json", "render_text", "write_report",
+]
+
+_default: Optional[Sanitizer] = None
+
+
+def enable(**config: Any) -> Sanitizer:
+    """Patch lock construction and activate the process-wide sanitizer
+    instance (created on first call; ``config`` forwards to
+    :class:`Sanitizer`).  Idempotent."""
+    global _default
+    if _default is None:
+        _default = Sanitizer(**config)
+    if core.get_active() is not _default:
+        locks.patch()
+        core.activate(_default)
+    return _default
+
+
+def disable() -> None:
+    """Deactivate and unpatch.  Locks already wrapped keep working as
+    plain locks (bookkeeping stops recording)."""
+    if core.get_active() is None:
+        return
+    core.activate(None)
+    locks.unpatch()
+
+
+def enabled() -> bool:
+    return core.get_active() is not None
+
+
+def default() -> Optional[Sanitizer]:
+    """The process-wide instance ``enable()`` manages (None before the
+    first enable).  Session accounting (the pytest plugin) reads THIS
+    — never the momentarily-active scoped instance of a test."""
+    return _default
+
+
+@contextlib.contextmanager
+def scope(**config: Any):
+    """A private sanitizer for one test: patches lock construction,
+    activates a fresh instance, and restores the previous activation
+    (session instance or none) on exit — seeded violations never leak
+    into the session report.
+
+    Known tradeoff: activation is process-global, so while a scope is
+    open, events from UNRELATED background threads also land in the
+    scoped instance and are discarded with it.  The detectors are
+    cumulative over the whole session and scope windows are short, so
+    a real defect re-fires outside them — but a scope is a detection
+    blind spot for exactly its duration.  Keep scopes tight."""
+    prev = core.get_active()
+    san = Sanitizer(**config)
+    locks.patch()
+    core.activate(san)
+    try:
+        yield san
+    finally:
+        core.activate(prev)
+        locks.unpatch()
+
+
+def record_compile(site: str, key: Any = None,
+                   seconds: float = 0.0) -> None:
+    """Hook for executable-cache miss paths (ops registry, fused
+    updater, serving buckets).  No-op unless a sanitizer is active."""
+    san = core.get_active()
+    if san is not None:
+        san.record_compile(site, key, seconds)
+
+
+def violations() -> List[SanViolation]:
+    san = core.get_active()
+    return san.violations() if san is not None else []
+
+
+def clear_violations() -> None:
+    san = core.get_active()
+    if san is not None:
+        san.clear_violations()

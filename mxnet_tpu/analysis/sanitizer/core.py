@@ -1,0 +1,425 @@
+"""mxsan core: sanitizer instances, the violation store, and the
+per-thread held-lock bookkeeping shared by every detector.
+
+Stdlib-only (the analysis-package contract): the sanitizer must be
+importable without jax so the pytest plugin and the CLI can reason
+about it cheaply.  The one framework touch point — the
+``mx_san_violations_total`` telemetry counter — is bridged lazily and
+only when ``mxnet_tpu.telemetry`` is already in ``sys.modules``.
+
+Activation model
+----------------
+Exactly one :class:`Sanitizer` instance is *active* at a time (module
+global ``_ACTIVE``).  Instrumented locks and tracked containers stay
+alive across activation changes: they maintain the per-thread held-lock
+list unconditionally but only RECORD (edges, locksets, violations) into
+whatever instance is active at event time.  This is what lets a test
+swap in a private instance (``mxsan.scope()``) under a session-wide
+``MXNET_SAN=1`` run without its seeded violations polluting the session
+report, and without double bookkeeping.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+import threading as _threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "SanViolation", "Sanitizer", "get_active", "activate",
+    "held_entries", "held_ids", "held_locks", "callsite",
+    "snapshot_stack",
+]
+
+# the REAL lock factory, captured before any patching can replace it —
+# the sanitizer's own synchronization must never be instrumented
+_REAL_LOCK = _threading.Lock
+
+_SKIP_FRAGMENTS = (
+    os.sep + "sanitizer" + os.sep,  # our own frames
+    os.sep + "threading.py",        # stdlib lock plumbing
+)
+
+
+def _keep_frame(filename: str) -> bool:
+    return not any(f in filename for f in _SKIP_FRAGMENTS)
+
+
+def callsite(depth: int = 2) -> str:
+    """``file:line`` of the nearest caller outside the sanitizer and
+    the threading module — the anchor every report points at."""
+    f = sys._getframe(depth)
+    while f is not None and not _keep_frame(f.f_code.co_filename):
+        f = f.f_back
+    if f is None:
+        return "<unknown>:0"
+    return f"{f.f_code.co_filename}:{f.f_lineno}"
+
+
+def snapshot_stack(depth: int = 2, limit: int = 8) -> List[str]:
+    """A short call stack (innermost first), sanitizer/threading frames
+    elided.  Captured only on state transitions and violations — never
+    on the per-acquire fast path."""
+    out: List[str] = []
+    f = sys._getframe(depth)
+    while f is not None and len(out) < limit:
+        if _keep_frame(f.f_code.co_filename):
+            out.append(f"{f.f_code.co_filename}:{f.f_lineno} "
+                       f"in {f.f_code.co_name}")
+        f = f.f_back
+    return out
+
+
+@dataclass(frozen=True)
+class SanViolation:
+    """One dynamic finding.  ``stacks`` maps a role ('acquire',
+    'prior-order', 'access', ...) to a captured stack, so lock-order
+    reports carry BOTH orders and race reports carry the access site."""
+
+    kind: str            # lock-order | lockset-race | recompile-storm
+    message: str
+    site: str            # primary call site "file:line"
+    thread: str
+    stacks: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+
+    @property
+    def fingerprint(self) -> str:
+        h = hashlib.sha1()
+        h.update("\0".join((self.kind, self.message)).encode())
+        return h.hexdigest()[:16]
+
+    def format(self) -> str:
+        lines = [f"mxsan: {self.kind}: {self.message}",
+                 f"  site: {self.site}  thread: {self.thread}"]
+        for role, stack in self.stacks.items():
+            lines.append(f"  {role}:")
+            lines.extend(f"    {fr}" for fr in stack)
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# per-thread held-lock bookkeeping (shared by lock-order and lockset)
+# ---------------------------------------------------------------------------
+
+_tls = _threading.local()
+
+
+def in_sanitizer() -> bool:
+    """True while THIS thread is inside sanitizer recording.  Lock
+    activity the sanitizer itself triggers (e.g. the telemetry
+    registry's locks while bumping ``mx_san_violations_total``) must
+    not feed back into the detectors — that reentrancy both pollutes
+    the order graph and can self-deadlock."""
+    return getattr(_tls, "in_san", False)
+
+
+class _reentry_guard:
+    """``with _reentry_guard():`` marks sanitizer-internal execution.
+    Nested guards are fine (only the outermost clears the flag)."""
+
+    __slots__ = ("_outer",)
+
+    def __enter__(self):
+        self._outer = not getattr(_tls, "in_san", False)
+        _tls.in_san = True
+        return self
+
+    def __exit__(self, *exc):
+        if self._outer:
+            _tls.in_san = False
+
+
+_thread_token_counter = [0]
+_thread_token_lock = _REAL_LOCK()
+
+
+def thread_token() -> int:
+    """A process-unique id for the current thread.  NOT ``get_ident()``:
+    CPython reuses idents as soon as a thread joins, which would make a
+    sequential cross-thread race look like one owner thread."""
+    tok = getattr(_tls, "token", None)
+    if tok is None:
+        with _thread_token_lock:
+            _thread_token_counter[0] += 1
+            tok = _tls.token = _thread_token_counter[0]
+    return tok
+
+
+def held_entries() -> List[list]:
+    """This thread's acquisition stack: ``[lock, count]`` pairs in
+    acquisition order (count > 1 = RLock reentrancy).
+
+    Entries whose lock was released by ANOTHER thread are pruned on
+    access: ``threading.Lock`` permits cross-thread release (handoff),
+    and a stale entry would fabricate order edges — and phantom cycles
+    — forever after."""
+    lst = getattr(_tls, "held", None)
+    if lst is None:
+        lst = _tls.held = []
+    elif lst:
+        tok = thread_token()
+        live = [e for e in lst if e[0]._holder == tok]
+        if len(live) != len(lst):
+            lst[:] = live
+    return lst
+
+
+def held_locks() -> List[Any]:
+    return [e[0] for e in held_entries()]
+
+
+def held_ids() -> Set[int]:
+    return {e[0].sid for e in held_entries()}
+
+
+# ---------------------------------------------------------------------------
+# Sanitizer instance
+# ---------------------------------------------------------------------------
+
+class Sanitizer:
+    """One detection context: lock-order graph, compile-site table, and
+    the violation store.  Tracked-object (lockset) state lives on the
+    tracked objects themselves; their violations land here."""
+
+    def __init__(self, recompile_warmup: int = 64,
+                 stack_limit: int = 8,
+                 suppress: Sequence[str] = ()):
+        #: distinct-signature compiles a site may accumulate before the
+        #: storm detector fires (per-site, process lifetime)
+        self.recompile_warmup = recompile_warmup
+        self.stack_limit = stack_limit
+        #: substrings; a violation whose message contains one is
+        #: dropped — the operational escape hatch (MXNET_SAN_SUPPRESS)
+        #: for a finding that is understood and accepted
+        self.suppress = tuple(s for s in suppress if s)
+        self._lock = _REAL_LOCK()
+        self._violations: List[SanViolation] = []
+        self._fingerprints: Set[str] = set()
+        # lock-order graph: edge (a, b) = "b acquired while holding a"
+        self.edges: Dict[Tuple[int, int], dict] = {}
+        self.adj: Dict[int, Set[int]] = {}
+        self.lock_names: Dict[int, str] = {}
+        self._cycles_seen: Set[frozenset] = set()
+        # recompile detector: site -> bookkeeping
+        self.compile_sites: Dict[str, dict] = {}
+
+    # ---- violations ---------------------------------------------------
+
+    def violations(self) -> List[SanViolation]:
+        with self._lock:
+            return list(self._violations)
+
+    def clear_violations(self) -> None:
+        with self._lock:
+            self._violations.clear()
+            self._fingerprints.clear()
+
+    def record(self, v: SanViolation) -> bool:
+        """Store a violation (deduplicated by fingerprint; suppressed
+        patterns dropped).  Returns True when it was new."""
+        if any(p in v.message for p in self.suppress):
+            return False
+        with self._lock:
+            if v.fingerprint in self._fingerprints:
+                return False
+            self._fingerprints.add(v.fingerprint)
+            self._violations.append(v)
+        with _reentry_guard():
+            _telemetry_count(v.kind)
+        return True
+
+    # ---- lock-order detector (fed by locks.py) ------------------------
+
+    def note_order(self, held: List[Any], acquiring: Any) -> None:
+        """Record held->acquiring edges; fire on any cycle the new edge
+        closes (a 2-cycle IS the classic inconsistent-ordering report).
+        Stacks: the current acquire plus the stack stored when each
+        edge on the closing path was first observed.
+
+        Gate-lock refinement: each edge remembers the OTHER locks held
+        when it was observed; a cycle whose edges all share a common
+        gate lock is serialized by that gate and cannot deadlock, so
+        it is not reported (the standard lock-order-tool filter)."""
+        b = acquiring.sid
+        tname = _threading.current_thread().name
+        held_sids = {x.sid for x in held}
+        fired: List[str] = []
+        with self._lock:
+            self.lock_names[b] = acquiring.name
+            for h in held:
+                a = h.sid
+                self.lock_names[a] = h.name
+                if a == b:
+                    continue
+                gates = frozenset(held_sids - {a})
+                existing = self.edges.get((a, b))
+                if existing is not None:
+                    # re-observation NARROWS the gate set: an order
+                    # first seen under a gate lock but later taken
+                    # without it loses its serialization alibi — the
+                    # cycle check must re-run when the set shrinks
+                    if gates >= existing["gates"]:
+                        continue
+                    existing["gates"] = existing["gates"] & gates
+                    gates = existing["gates"]
+                path = self._find_path(b, a)
+                if path is not None:
+                    common = gates
+                    for e in path:
+                        common = common & self.edges[e]["gates"]
+                    if not common:  # no shared gate: a real cycle
+                        kind = self._record_cycle_locked(
+                            h, acquiring, path, tname)
+                        if kind:
+                            fired.append(kind)
+                if existing is None:
+                    self.edges[(a, b)] = {
+                        "from": h.name, "to": acquiring.name,
+                        "thread": tname, "gates": gates,
+                        "stack": tuple(snapshot_stack(
+                            3, self.stack_limit)),
+                    }
+                    self.adj.setdefault(a, set()).add(b)
+        for kind in fired:  # telemetry strictly OUTSIDE self._lock
+            with _reentry_guard():
+                _telemetry_count(kind)
+
+    def _find_path(self, src: int, dst: int) -> Optional[List[Tuple[int, int]]]:
+        """DFS: edge path src -> ... -> dst in the acquisition graph."""
+        stack = [(src, [])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            for nxt in self.adj.get(node, ()):
+                if nxt == dst:
+                    return path + [(node, nxt)]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [(node, nxt)]))
+        return None
+
+    def _record_cycle_locked(self, held_lock, acquiring, path, tname
+                             ) -> Optional[str]:
+        """Caller holds self._lock.  Returns the violation kind when a
+        NEW violation was stored (the caller fires telemetry after
+        releasing the lock — never under it)."""
+        nodes = frozenset({held_lock.sid, acquiring.sid}
+                          | {n for e in path for n in e})
+        if nodes in self._cycles_seen:
+            return None
+        self._cycles_seen.add(nodes)
+        order = " -> ".join(self.lock_names.get(n, f"lock#{n}")
+                            for n in [held_lock.sid, acquiring.sid])
+        stacks: Dict[str, Tuple[str, ...]] = {
+            f"this acquire ({acquiring.name} while holding "
+            f"{held_lock.name})": tuple(snapshot_stack(4, self.stack_limit)),
+        }
+        for (a, c) in path:
+            e = self.edges.get((a, c))
+            if e is not None:
+                stacks[f"prior order ({e['from']} -> {e['to']}, "
+                       f"thread {e['thread']})"] = e["stack"]
+        v = SanViolation(
+            kind="lock-order",
+            message=(f"lock acquisition cycle (deadlock potential): "
+                     f"{order} inverts an order already observed; "
+                     f"{len(path)} prior edge(s) close the cycle"),
+            site=callsite(4), thread=tname, stacks=stacks)
+        # record() takes self._lock; we already hold it — inline the
+        # dedupe/suppression here instead
+        if any(p in v.message for p in self.suppress):
+            return None
+        if v.fingerprint not in self._fingerprints:
+            self._fingerprints.add(v.fingerprint)
+            self._violations.append(v)
+            return v.kind
+        return None
+
+    # ---- recompile detector -------------------------------------------
+
+    def record_compile(self, site: str, key: Any = None,
+                       seconds: float = 0.0) -> None:
+        """One executable build at ``site``.  A repeated ``key`` means
+        the framework cache failed to hit — a steady-state recompile;
+        more than ``recompile_warmup`` distinct signatures at one site
+        is a storm (the runtime ground truth MX001 can only guess at)."""
+        dup = storm = False
+        basis = 0
+        with self._lock:
+            rec = self.compile_sites.setdefault(
+                site, {"count": 0, "keys": set(), "dup_reported": set(),
+                       "seconds": 0.0, "stormed": False})
+            rec["count"] += 1
+            rec["seconds"] += seconds
+            if key is not None:
+                if key in rec["keys"]:
+                    if key not in rec["dup_reported"]:
+                        rec["dup_reported"].add(key)
+                        dup = True
+                else:
+                    rec["keys"].add(key)
+            # storm basis: DISTINCT signatures (the documented
+            # contract) — duplicate builds have their own detector and
+            # key=None builds (by-design concurrent losers) must not
+            # push a site over warmup.  Sites that never pass a key
+            # fall back to the raw build count.
+            basis = len(rec["keys"]) if rec["keys"] else rec["count"]
+            if basis > self.recompile_warmup and not rec["stormed"]:
+                rec["stormed"] = True
+                storm = True
+        tname = _threading.current_thread().name
+        if dup:
+            self.record(SanViolation(
+                kind="recompile-storm",
+                message=(f"{site}: recompiled an already-built signature "
+                         f"(key={key!r}) — the executable cache lost it; "
+                         "every steady-state step now pays a compile"),
+                site=callsite(3), thread=tname,
+                stacks={"compile": tuple(snapshot_stack(3,
+                                                        self.stack_limit))}))
+        if storm:
+            self.record(SanViolation(
+                kind="recompile-storm",
+                message=(f"{site}: {basis} distinct signatures exceed "
+                         f"the warmup budget ({self.recompile_warmup}) "
+                         "— signatures keep changing at this site "
+                         "(shape/attr churn defeats the cache)"),
+                site=callsite(3), thread=tname,
+                stacks={"compile": tuple(snapshot_stack(3,
+                                                        self.stack_limit))}))
+
+
+# ---------------------------------------------------------------------------
+# activation
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[Sanitizer] = None
+
+
+def get_active() -> Optional[Sanitizer]:
+    return _ACTIVE
+
+
+def activate(s: Optional[Sanitizer]) -> None:
+    global _ACTIVE
+    _ACTIVE = s
+
+
+# ---------------------------------------------------------------------------
+# telemetry bridge (lazy, optional)
+# ---------------------------------------------------------------------------
+
+def _telemetry_count(kind: str) -> None:
+    """Surface violations as ``mx_san_violations_total{kind=...}`` when
+    the framework's telemetry is loaded; stay silent otherwise (the
+    sanitizer must work standalone, e.g. under the bare pytest plugin)."""
+    if "mxnet_tpu.telemetry" not in sys.modules:
+        return
+    try:
+        from mxnet_tpu.telemetry import instruments
+
+        instruments.san_violations_total(kind).inc()
+    except Exception:
+        pass

@@ -1,0 +1,236 @@
+"""mxsan lock instrumentation: wrap ``threading.Lock`` / ``RLock`` /
+``Condition`` construction so every acquire/release is recorded with
+thread id and call site, feeding the lock-order graph.
+
+Scope: only locks CONSTRUCTED from first-party code are wrapped.  The
+patched factories inspect the caller's file and hand back the real
+primitive for stdlib and site-packages callers (jax, queue,
+concurrent.futures, ...) — instrumenting those would swamp the report
+with third-party internals and blow the <3x overhead budget.
+
+Conditions are real ``threading.Condition`` objects built over a
+wrapped lock: the stdlib's ``_release_save`` / ``_acquire_restore`` /
+``_is_owned`` protocol routes every ``wait()`` through our
+bookkeeping, so a thread parked in ``cv.wait()`` correctly shows as
+NOT holding the lock.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import threading as _threading
+from typing import Optional
+
+from . import core
+
+__all__ = ["patch", "unpatch", "patched", "SanLock", "SanRLock"]
+
+# real factories, captured at import (before any patch can land)
+_REAL_LOCK = _threading.Lock
+_REAL_RLOCK = _threading.RLock
+_REAL_CONDITION = _threading.Condition
+
+_sid_counter = itertools.count(1)
+
+# `/lib/python` covers both the stdlib and site-packages on every
+# layout we run (system python, conda, venv); `<` covers eval/exec
+# sources, which we DO instrument (test fixtures build locks there)
+_FOREIGN = (f"{os.sep}lib{os.sep}python", "site-packages",
+            f"{os.sep}importlib{os.sep}")
+
+# the mxnet_tpu package itself is ALWAYS first-party, even when it is
+# pip-installed under site-packages — otherwise an installed framework
+# would get real locks while track() proxies stay active, and every
+# correctly-locked access would read as an empty lockset
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))) + os.sep
+
+
+def _first_party(filename: str) -> bool:
+    if filename.startswith(_PKG_ROOT):
+        return True
+    return not any(f in filename for f in _FOREIGN)
+
+
+# ---------------------------------------------------------------------------
+# bookkeeping — held-list maintenance is UNCONDITIONAL (wrapped locks
+# outlive sanitizer activation windows); recording checks the active
+# instance at event time.
+# ---------------------------------------------------------------------------
+
+def _note_acquire(lock: "SanLock") -> None:
+    entries = core.held_entries()
+    for e in entries:
+        if e[0] is lock:  # RLock reentry: no new edges
+            e[1] += 1
+            return
+    san = core.get_active()
+    if san is not None and entries and not core.in_sanitizer():
+        with core._reentry_guard():
+            san.note_order([e[0] for e in entries], lock)
+    lock._holder = core.thread_token()
+    entries.append([lock, 1])
+
+
+def _note_release(lock: "SanLock") -> None:
+    entries = core.held_entries()
+    for i in range(len(entries) - 1, -1, -1):
+        if entries[i][0] is lock:
+            entries[i][1] -= 1
+            if entries[i][1] == 0:
+                del entries[i]
+                lock._holder = None
+            return
+    # cross-thread release (a legal Lock handoff) or a lock acquired
+    # before instrumentation: clear the holder so the OWNER's stale
+    # held entry prunes on its next access instead of fabricating
+    # order edges forever
+    lock._holder = None
+
+
+def _drop_all(lock: "SanLock") -> int:
+    """Remove the lock from the held list entirely (Condition.wait on
+    an RLock releases every recursion level at once); returns the
+    count so the restore path can put it back."""
+    entries = core.held_entries()
+    for i in range(len(entries) - 1, -1, -1):
+        if entries[i][0] is lock:
+            n = entries[i][1]
+            del entries[i]
+            lock._holder = None
+            return n
+    return 0
+
+
+def _restore_all(lock: "SanLock", count: int) -> None:
+    entries = core.held_entries()
+    san = core.get_active()
+    if san is not None and entries and not core.in_sanitizer():
+        # re-acquiring after a wait is a real acquisition order event
+        with core._reentry_guard():
+            san.note_order([e[0] for e in entries], lock)
+    lock._holder = core.thread_token()
+    entries.append([lock, max(count, 1)])
+
+
+class SanLock:
+    """Wrapper over a real lock: identical blocking semantics, plus
+    held-list/order bookkeeping on successful acquires."""
+
+    _KIND = "Lock"
+    __slots__ = ("_inner", "sid", "name", "_holder")
+
+    def __init__(self, inner=None, site: Optional[str] = None):
+        self._inner = inner if inner is not None else _REAL_LOCK()
+        self.sid = next(_sid_counter)
+        self.name = f"{self._KIND}@{site or core.callsite()}"
+        self._holder = None  # thread token of the current holder
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _note_acquire(self)
+        return got
+
+    def release(self) -> None:
+        _note_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<mxsan {self.name} wrapping {self._inner!r}>"
+
+
+class SanRLock(SanLock):
+    _KIND = "RLock"
+    __slots__ = ()
+
+    def __init__(self, inner=None, site: Optional[str] = None):
+        super().__init__(inner if inner is not None else _REAL_RLOCK(),
+                         site)
+
+    # Condition protocol: wait() releases ALL recursion levels.  The
+    # saved count is PER-THREAD (several threads park in wait() on the
+    # same condition at once), stashed in the thread-local alongside
+    # the held list.
+    def _release_save(self):
+        saved = core._tls.__dict__.setdefault("saved_counts", {})
+        saved[self.sid] = _drop_all(self)
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state) -> None:
+        self._inner._acquire_restore(state)
+        saved = core._tls.__dict__.setdefault("saved_counts", {})
+        _restore_all(self, saved.pop(self.sid, 1))
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+# ---------------------------------------------------------------------------
+# patching
+# ---------------------------------------------------------------------------
+
+_patch_depth = 0
+_patch_lock = _REAL_LOCK()
+
+
+def _san_lock_factory():
+    if not _first_party(sys._getframe(1).f_code.co_filename):
+        return _REAL_LOCK()
+    return SanLock(site=core.callsite())
+
+
+def _san_rlock_factory():
+    if not _first_party(sys._getframe(1).f_code.co_filename):
+        return _REAL_RLOCK()
+    return SanRLock(site=core.callsite())
+
+
+def _san_condition_factory(lock=None):
+    if lock is None:
+        if _first_party(sys._getframe(1).f_code.co_filename):
+            lock = SanRLock(site=core.callsite())
+        else:
+            return _REAL_CONDITION()
+    # a real Condition over a San lock routes wait()'s release/
+    # re-acquire through the wrapper's protocol methods
+    return _REAL_CONDITION(lock)
+
+
+def patch() -> None:
+    """Replace the threading lock factories (refcounted: nested
+    mxsan scopes under a session-wide enable are fine)."""
+    global _patch_depth
+    with _patch_lock:
+        if _patch_depth == 0:
+            _threading.Lock = _san_lock_factory
+            _threading.RLock = _san_rlock_factory
+            _threading.Condition = _san_condition_factory
+        _patch_depth += 1
+
+
+def unpatch() -> None:
+    global _patch_depth
+    with _patch_lock:
+        if _patch_depth == 0:
+            return
+        _patch_depth -= 1
+        if _patch_depth == 0:
+            _threading.Lock = _REAL_LOCK
+            _threading.RLock = _REAL_RLOCK
+            _threading.Condition = _REAL_CONDITION
+
+
+def patched() -> bool:
+    return _patch_depth > 0

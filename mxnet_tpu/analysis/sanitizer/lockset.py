@@ -1,0 +1,316 @@
+"""mxsan lockset (Eraser-style) race detection for annotated shared
+state.
+
+``track(obj, name)`` wraps a module-level cache (dict/list/set/deque)
+in a proxy that funnels reads and writes through the classic Eraser
+state machine [Savage et al., SOSP'97]:
+
+    virgin -> exclusive(first thread) -> shared -> shared-modified
+
+Once an object goes cross-thread, its *candidate lockset* — the
+intersection of instrumented locks held at every access — must stay
+non-empty; an empty candidate set in the shared-modified state means no
+single lock consistently guards the data: a race, reported with the
+access stack.
+
+``reads="unlocked-ok"`` is the escape hatch for the house
+double-checked-locking idiom (``ops/registry.py::jitted``): optimistic
+lock-free reads are the point of that pattern, so only WRITES feed the
+lockset there — a write outside the lock still fires.
+"""
+from __future__ import annotations
+
+import collections
+import threading as _threading
+from typing import Any
+
+from . import core
+from .core import SanViolation
+
+__all__ = ["track", "is_tracked", "TrackedDict", "TrackedList",
+           "TrackedSet", "TrackedDeque"]
+
+_VIRGIN, _EXCLUSIVE, _SHARED, _SHARED_MOD = range(4)
+_STATE_NAMES = {_VIRGIN: "virgin", _EXCLUSIVE: "exclusive",
+                _SHARED: "shared", _SHARED_MOD: "shared-modified"}
+
+
+class _TrackState:
+    __slots__ = ("name", "check_reads", "state", "owner", "lockset",
+                 "reported", "_slock")
+
+    def __init__(self, name: str, check_reads: bool):
+        self.name = name
+        self.check_reads = check_reads
+        self.state = _VIRGIN
+        self.owner = 0
+        self.lockset = None  # set of lock sids, None until shared
+        self.reported = False
+        self._slock = core._REAL_LOCK()
+
+
+def _access(st: _TrackState, write: bool) -> None:
+    san = core.get_active()
+    if san is None or core.in_sanitizer():
+        return
+    if not write and not st.check_reads:
+        return
+    tid = core.thread_token()
+    fire = False
+    with st._slock:
+        if st.state == _VIRGIN:
+            st.state = _EXCLUSIVE
+            st.owner = tid
+            return
+        if st.state == _EXCLUSIVE:
+            if st.owner == tid:
+                return
+            st.lockset = core.held_ids()
+            st.state = _SHARED_MOD if write else _SHARED
+        else:
+            st.lockset &= core.held_ids()
+            if write:
+                st.state = _SHARED_MOD
+        if st.state == _SHARED_MOD and not st.lockset \
+                and not st.reported:
+            st.reported = True
+            fire = True
+    if fire:
+        with core._reentry_guard():
+            san.record(SanViolation(
+                kind="lockset-race",
+                message=(f"tracked state {st.name!r}: candidate "
+                         "lockset is empty after cross-thread access "
+                         "— no lock consistently guards it (Eraser); "
+                         f"this {'write' if write else 'read'} races "
+                         "with the other thread's accesses"),
+                site=core.callsite(3),
+                thread=_threading.current_thread().name,
+                stacks={"access": tuple(core.snapshot_stack(3))}))
+
+
+def _read(self) -> None:
+    _access(self._san_st, False)
+
+
+def _write(self) -> None:
+    _access(self._san_st, True)
+
+
+class TrackedDict(dict):
+    __slots__ = ("_san_st",)
+
+    # reads
+    def __getitem__(self, k):
+        _read(self)
+        return dict.__getitem__(self, k)
+
+    def get(self, k, d=None):
+        _read(self)
+        return dict.get(self, k, d)
+
+    def __contains__(self, k):
+        _read(self)
+        return dict.__contains__(self, k)
+
+    def __iter__(self):
+        _read(self)
+        return dict.__iter__(self)
+
+    def keys(self):
+        _read(self)
+        return dict.keys(self)
+
+    def values(self):
+        _read(self)
+        return dict.values(self)
+
+    def items(self):
+        _read(self)
+        return dict.items(self)
+
+    # writes
+    def __setitem__(self, k, v):
+        _write(self)
+        dict.__setitem__(self, k, v)
+
+    def __delitem__(self, k):
+        _write(self)
+        dict.__delitem__(self, k)
+
+    def setdefault(self, k, d=None):
+        _write(self)
+        return dict.setdefault(self, k, d)
+
+    def pop(self, *a):
+        _write(self)
+        return dict.pop(self, *a)
+
+    def popitem(self):
+        _write(self)
+        return dict.popitem(self)
+
+    def clear(self):
+        _write(self)
+        dict.clear(self)
+
+    def update(self, *a, **kw):
+        _write(self)
+        dict.update(self, *a, **kw)
+
+
+class TrackedList(list):
+    __slots__ = ("_san_st",)
+
+    def __getitem__(self, i):
+        _read(self)
+        return list.__getitem__(self, i)
+
+    def __contains__(self, x):
+        _read(self)
+        return list.__contains__(self, x)
+
+    def __iter__(self):
+        _read(self)
+        return list.__iter__(self)
+
+    def __setitem__(self, i, v):
+        _write(self)
+        list.__setitem__(self, i, v)
+
+    def __delitem__(self, i):
+        _write(self)
+        list.__delitem__(self, i)
+
+    def append(self, x):
+        _write(self)
+        list.append(self, x)
+
+    def extend(self, it):
+        _write(self)
+        list.extend(self, it)
+
+    def insert(self, i, x):
+        _write(self)
+        list.insert(self, i, x)
+
+    def pop(self, *a):
+        _write(self)
+        return list.pop(self, *a)
+
+    def remove(self, x):
+        _write(self)
+        list.remove(self, x)
+
+    def clear(self):
+        _write(self)
+        list.clear(self)
+
+
+class TrackedSet(set):
+    __slots__ = ("_san_st",)
+
+    def __contains__(self, x):
+        _read(self)
+        return set.__contains__(self, x)
+
+    def __iter__(self):
+        _read(self)
+        return set.__iter__(self)
+
+    def add(self, x):
+        _write(self)
+        set.add(self, x)
+
+    def discard(self, x):
+        _write(self)
+        set.discard(self, x)
+
+    def remove(self, x):
+        _write(self)
+        set.remove(self, x)
+
+    def pop(self):
+        _write(self)
+        return set.pop(self)
+
+    def clear(self):
+        _write(self)
+        set.clear(self)
+
+    def update(self, *a):
+        _write(self)
+        set.update(self, *a)
+
+
+class TrackedDeque(collections.deque):
+    _san_st: Any  # deque disallows __slots__ with instance attrs
+
+    def __getitem__(self, i):
+        _read(self)
+        return collections.deque.__getitem__(self, i)
+
+    def __iter__(self):
+        _read(self)
+        return collections.deque.__iter__(self)
+
+    def append(self, x):
+        _write(self)
+        collections.deque.append(self, x)
+
+    def appendleft(self, x):
+        _write(self)
+        collections.deque.appendleft(self, x)
+
+    def pop(self):
+        _write(self)
+        return collections.deque.pop(self)
+
+    def popleft(self):
+        _write(self)
+        return collections.deque.popleft(self)
+
+    def extend(self, it):
+        _write(self)
+        collections.deque.extend(self, it)
+
+    def clear(self):
+        _write(self)
+        collections.deque.clear(self)
+
+
+def track(obj: Any, name: str, reads: str = "checked") -> Any:
+    """Annotate a shared container for lockset checking.  Returns a
+    tracked proxy while a sanitizer is active, the object unchanged
+    otherwise (zero overhead when mxsan is off — call sites simply
+    construct through ``mxsan.track({}, "...")``).
+
+    ``reads="unlocked-ok"`` exempts reads from the lockset (the
+    double-checked-lock idiom); writes are always checked.
+    """
+    # validate BEFORE the active check: a typo'd mode at a
+    # module-level annotation site must fail ordinary CI, not only the
+    # first MXNET_SAN=1 run
+    if reads not in ("checked", "unlocked-ok"):
+        raise ValueError(f"reads={reads!r}: use 'checked' or "
+                         "'unlocked-ok'")
+    if core.get_active() is None:
+        return obj
+    st = _TrackState(name, check_reads=(reads == "checked"))
+    if isinstance(obj, dict):
+        proxy = TrackedDict(obj)
+    elif isinstance(obj, list):
+        proxy = TrackedList(obj)
+    elif isinstance(obj, collections.deque):
+        proxy = TrackedDeque(obj, maxlen=obj.maxlen)
+    elif isinstance(obj, set):
+        proxy = TrackedSet(obj)
+    else:
+        return obj  # unsupported container: annotation is a no-op
+    proxy._san_st = st
+    return proxy
+
+
+def is_tracked(obj: Any) -> bool:
+    return isinstance(obj, (TrackedDict, TrackedList, TrackedSet,
+                            TrackedDeque))

@@ -1,0 +1,70 @@
+"""mxsan output: human text + machine JSON (the MXSAN.json artifact).
+
+Mirrors the mxlint reporter shape (counts first — the trajectory a
+nightly tracks — then the full finding list) so the two artifacts read
+the same way.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import List
+
+from .core import Sanitizer, SanViolation
+
+__all__ = ["render_json", "render_text", "write_report"]
+
+
+def render_json(san: Sanitizer) -> dict:
+    vs = san.violations()
+    # snapshot the live detector state under the instance lock —
+    # daemon threads (DataLoader workers, the serving batcher) may
+    # still be recording while a session-finish hook renders
+    with san._lock:
+        n_locks = len(san.lock_names)
+        n_edges = len(san.edges)
+        sites = {site: (rec["count"], len(rec["keys"]), rec["seconds"])
+                 for site, rec in san.compile_sites.items()}
+    per_kind = {}
+    for v in vs:
+        per_kind[v.kind] = per_kind.get(v.kind, 0) + 1
+    return {
+        "ok": not vs,
+        "counts": {"violations": len(vs), **per_kind},
+        "lock_graph": {
+            "locks": n_locks,
+            "edges": n_edges,
+        },
+        "compile_sites": {
+            site: {"count": count,
+                   "distinct_signatures": nkeys,
+                   "seconds": round(secs, 4)}
+            for site, (count, nkeys, secs) in sorted(sites.items())
+        },
+        "violations": [{
+            "kind": v.kind, "message": v.message, "site": v.site,
+            "thread": v.thread, "fingerprint": v.fingerprint,
+            "stacks": {role: list(stack)
+                       for role, stack in v.stacks.items()},
+        } for v in vs],
+    }
+
+
+def render_text(san: Sanitizer) -> str:
+    vs: List[SanViolation] = san.violations()
+    lines = [v.format() for v in vs]
+    verdict = "FAIL" if vs else "OK"
+    lines.append(f"mxsan: {verdict} — {len(vs)} violation(s), "
+                 f"{len(san.lock_names)} instrumented lock(s), "
+                 f"{len(san.edges)} order edge(s), "
+                 f"{len(san.compile_sites)} compile site(s)")
+    return "\n".join(lines)
+
+
+def write_report(path: str, san: Sanitizer) -> dict:
+    doc = render_json(san)
+    doc["when"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return doc

@@ -41,7 +41,11 @@ from ..ndarray.ndarray import NDArray
 __all__ = ["export_model", "import_model", "ServedModel"]
 
 
-_NT_CACHE: dict = {}
+# mxsan: lock-free first read (double-checked); writes hold _NT_LOCK
+from ..analysis import sanitizer as _mxsan
+
+_NT_CACHE: dict = _mxsan.track({}, "contrib.deploy._NT_CACHE",
+                               reads="unlocked-ok")
 _NT_LOCK = threading.Lock()
 
 

@@ -4,10 +4,13 @@ from __future__ import annotations
 
 import threading
 
+from ..analysis import sanitizer as _mxsan
 from ..ops.registry import OP_REGISTRY
 from ..symbol.symbol import make_symbol_function
 
-_CACHE = {}
+# mxsan: lock-free __getattr__ fast path; writes hold _CACHE_LOCK
+_CACHE = _mxsan.track({}, "contrib.symbol._CACHE",
+                      reads="unlocked-ok")
 _CACHE_LOCK = threading.Lock()  # module attrs resolve from any thread
 
 

@@ -30,6 +30,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ...analysis import sanitizer as _mxsan
 from ...base import MXNetError
 from ...ndarray.ndarray import NDArray, array as nd_array
 from ...telemetry import instruments as _ins
@@ -404,7 +405,11 @@ class DataLoader:
         n_workers = self._num_workers
         window = max(self._prefetch, n_workers, 2)  # in-flight bound
         task_q: "queue.Queue" = queue.Queue()
-        done: dict = {}
+        # mxsan: the reorder buffer is shared by every worker and the
+        # consumer; all access must hold done_cv (the tier-1 shutdown
+        # regression test runs this loop under the sanitizer)
+        done: dict = _mxsan.track(
+            {}, "gluon.data.DataLoader._threaded_iter.done")
         done_cv = threading.Condition()
         stop = threading.Event()
 

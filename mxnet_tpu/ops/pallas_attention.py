@@ -29,12 +29,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import sanitizer as _mxsan
 from ..util import env
 from .registry import register_op
 
 __all__ = ["dot_product_attention_ref"]
 
-_PALLAS_STATE = {"enabled": None}  # resolved lazily; None = undecided
+# resolved lazily; None = undecided.  mxsan: lock-free reads are the
+# double-checked idiom; writes hold _PALLAS_LOCK
+_PALLAS_STATE = _mxsan.track({"enabled": None},
+                             "ops.pallas_attention._PALLAS_STATE",
+                             reads="unlocked-ok")
 _PALLAS_LOCK = threading.Lock()  # first attention call races from serving threads (mxlint MX004)
 
 

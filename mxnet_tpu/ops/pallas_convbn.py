@@ -48,12 +48,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..analysis import sanitizer as _mxsan
 from ..util import env
 from .registry import register_op
 
 __all__ = ["fused_conv_unit"]
 
-_STATE = {"enabled": None}
+# mxsan: the enable latch is read lock-free (double-checked idiom);
+# writes must hold _PROBE_LOCK
+_STATE = _mxsan.track({"enabled": None}, "ops.pallas_convbn._STATE",
+                      reads="unlocked-ok")
 #: guards _STATE plus the probe cache/budget below — serving threads and
 #: the training loop race the first conv dispatch (mxlint MX004)
 _PROBE_LOCK = threading.Lock()
@@ -498,8 +502,10 @@ def _xla_unit(x, w, in_scale, in_bias, shift, *, kernel, stride, pad,
 # with fresh ShapeDtypeStructs, never tracers, so it is safe to do in
 # the middle of an outer trace — and configurations Mosaic rejects are
 # pinned to the XLA fallback.
-_SHAPE_OK: dict = {}
-_PROBE_SPENT = [0.0]  # cumulative probe-compile seconds
+_SHAPE_OK: dict = _mxsan.track({}, "ops.pallas_convbn._SHAPE_OK",
+                               reads="unlocked-ok")
+# cumulative probe-compile seconds; every access holds _PROBE_LOCK
+_PROBE_SPENT = _mxsan.track([0.0], "ops.pallas_convbn._PROBE_SPENT")
 
 
 def _probe_budget() -> float:

@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from ..analysis import sanitizer as _mxsan
 from ..base import MXNetError, Registry
 from ..util import env
 from .. import profiler as _profiler
@@ -215,8 +216,13 @@ def thaw_attrs(key: Tuple) -> dict:
 # --------------------------------------------------------------------------
 
 _jit_lock = threading.Lock()
-_jit_cache: Dict[Tuple, Callable] = {}
-_grad_cache: Dict[Tuple, Callable] = {}
+# mxsan annotations: reads are the optimistic half of the
+# double-checked idiom (deliberately lock-free); writes must stay
+# under _jit_lock — the sanitizer verifies exactly that at runtime
+_jit_cache: Dict[Tuple, Callable] = _mxsan.track(
+    {}, "ops.registry._jit_cache", reads="unlocked-ok")
+_grad_cache: Dict[Tuple, Callable] = _mxsan.track(
+    {}, "ops.registry._grad_cache", reads="unlocked-ok")
 
 # MXNET_ENGINE_TYPE=NaiveEngine → fully synchronous execution for debugging
 # (ref: src/engine/naive_engine.cc). Any other value = async (default).
@@ -233,6 +239,8 @@ def jitted(op: Operator, attrs_key: Tuple) -> Callable:
                 attrs = thaw_attrs(attrs_key)
                 fn = jax.jit(functools.partial(op.fn, **attrs))
                 _jit_cache[key] = fn
+                # per-op site: a storm means ONE op's signatures churn
+                _mxsan.record_compile(f"ops.jit:{op.name}", attrs_key)
     return fn
 
 
@@ -259,6 +267,8 @@ def grad_fn(op: Operator, attrs_key: Tuple, argnums: Tuple[int, ...]) -> Callabl
 
                 fn = jax.jit(_vjp)
                 _grad_cache[key] = fn
+                _mxsan.record_compile(f"ops.grad:{op.name}",
+                                      (attrs_key, argnums))
     return fn
 
 

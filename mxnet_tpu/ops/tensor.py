@@ -65,6 +65,8 @@ register_op("shape_array", differentiable=False)(
 # (ref: contrib arange_like: axis=None → same-shape flat arange)
 @register_op("_arange_like", aliases=("arange_like",), differentiable=False)
 def _arange_like(x, axis=None, start=0.0, step=1.0, dtype="float32"):
+    """Value-independent arange shaped like `x` (axis=None) or along one of
+    its axes (ref: contrib arange_like)."""
     dt = jnp.dtype(dtype)
     if axis is None:
         n = math.prod(x.shape) if x.shape else 1
@@ -76,11 +78,13 @@ register_op("size_array", differentiable=False)(
 
 @register_op("cast", aliases=("Cast",))
 def _cast(x, dtype="float32"):
+    """Elementwise dtype cast (ref: Cast)."""
     return x.astype(jnp.dtype(dtype))
 
 
 @register_op("clip")
 def _clip(x, a_min=None, a_max=None):
+    """Clamp every element to [a_min, a_max]."""
     return jnp.clip(x, a_min, a_max)
 
 
@@ -178,6 +182,7 @@ register_op("nanprod")(_red(jnp.nanprod))
 
 @register_op("norm")
 def _norm(x, ord=2, axis=None, keepdims=False):
+    """L1 or L2 norm reduction over `axis` (ord in {1, 2}, ref: norm)."""
     if ord == 1:
         return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
     return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
@@ -185,17 +190,21 @@ def _norm(x, ord=2, axis=None, keepdims=False):
 
 @register_op("argmax", differentiable=False)
 def _argmax(x, axis=None, keepdims=False):
+    """Index of the maximum along `axis`, returned as float32 (reference index
+    dtype)."""
     out = jnp.argmax(x, axis=axis, keepdims=keepdims)
     return out.astype(jnp.float32)
 
 
 @register_op("argmin", differentiable=False)
 def _argmin(x, axis=None, keepdims=False):
+    """Index of the minimum along `axis`, returned as float32."""
     return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.float32)
 
 
 @register_op("argmax_channel", differentiable=False)
 def _argmax_channel(x):
+    """Argmax over the trailing axis per leading position, as float32."""
     return jnp.argmax(x, axis=-1).astype(jnp.float32)
 
 
@@ -207,6 +216,8 @@ def _argmax_channel(x):
 def _reshape(x, shape=(), reverse=False):
     # supports the reference's special codes 0 (keep), -1 (infer),
     # -2 (copy rest), -3 (merge two), -4 (split)
+    """Reshape with the reference's special codes: 0 keep, -1 infer, -2
+    copy-rest, -3 merge-two, -4 split."""
     shape = list(shape)
     if not any(s in (0, -2, -3, -4) for s in shape):
         return jnp.reshape(x, tuple(shape))
@@ -239,38 +250,46 @@ def _reshape(x, shape=(), reverse=False):
 
 @register_op("transpose")
 def _transpose(x, axes=None):
+    """Permute axes (full reversal when `axes` is None)."""
     return jnp.transpose(x, axes)
 
 
 @register_op("flatten", aliases=("Flatten",))
 def _flatten(x):
+    """Collapse all trailing axes into one: (N, ...) -> (N, prod(...))."""
     return jnp.reshape(x, (x.shape[0], -1) if x.ndim > 1 else x.shape)
 
 
 @register_op("expand_dims")
 def _expand_dims(x, axis=0):
+    """Insert a size-1 axis at `axis`."""
     return jnp.expand_dims(x, axis)
 
 
 @register_op("squeeze")
 def _squeeze(x, axis=None):
+    """Drop size-1 axes (all of them when `axis` is None)."""
     return jnp.squeeze(x, axis)
 
 
 @register_op("broadcast_to")
 def _broadcast_to(x, shape=()):
     # reference semantics: 0 in target shape means keep source dim
+    """Broadcast to `shape`; a 0 entry keeps the source dimension (reference
+    semantics)."""
     tgt = tuple(s if s != 0 else x.shape[i] for i, s in enumerate(shape))
     return jnp.broadcast_to(x, tgt)
 
 
 @register_op("broadcast_like")
 def _broadcast_like(x, y):
+    """Broadcast `x` to the shape of `y`."""
     return jnp.broadcast_to(x, y.shape)
 
 
 @register_op("broadcast_axis", aliases=("broadcast_axes",))
 def _broadcast_axis(x, axis=(), size=()):
+    """Broadcast the named size-1 axes out to the requested sizes."""
     axes = (axis,) if isinstance(axis, int) else tuple(axis)
     sizes = (size,) if isinstance(size, int) else tuple(size)
     tgt = list(x.shape)
@@ -281,11 +300,13 @@ def _broadcast_axis(x, axis=(), size=()):
 
 @register_op("swapaxes", aliases=("SwapAxis",))
 def _swapaxes(x, dim1=0, dim2=0):
+    """Exchange axes `dim1` and `dim2` (ref: SwapAxis)."""
     return jnp.swapaxes(x, dim1, dim2)
 
 
 @register_op("slice")
 def _slice(x, begin=(), end=(), step=None):
+    """Multi-axis strided slice from per-axis begin/end/step tuples."""
     idx = []
     for i in range(len(begin)):
         st = step[i] if step else 1
@@ -295,6 +316,7 @@ def _slice(x, begin=(), end=(), step=None):
 
 @register_op("slice_axis")
 def _slice_axis(x, axis=0, begin=0, end=None):
+    """Slice [begin, end) along a single axis."""
     idx = [slice(None)] * x.ndim
     idx[axis] = slice(begin, end)
     return x[tuple(idx)]
@@ -302,6 +324,7 @@ def _slice_axis(x, axis=0, begin=0, end=None):
 
 @register_op("slice_like")
 def _slice_like(x, y, axes=()):
+    """Crop `x` to `y`'s extent along `axes` (every shared axis by default)."""
     axes = tuple(axes) if axes else tuple(range(min(x.ndim, y.ndim)))
     idx = [slice(None)] * x.ndim
     for a in axes:
@@ -311,11 +334,13 @@ def _slice_like(x, y, axes=()):
 
 @register_op("concat", aliases=("Concat",))
 def _concat(*xs, dim=1, num_args=None):
+    """Concatenate along `dim` (ref: Concat, channel axis by default)."""
     return jnp.concatenate(xs, axis=dim)
 
 
 @register_op("stack")
 def _stack(*xs, axis=0, num_args=None):
+    """Stack the inputs along a NEW axis."""
     return jnp.stack(xs, axis=axis)
 
 
@@ -325,6 +350,8 @@ def _split_nout(attrs):
 
 @register_op("split", aliases=("SliceChannel",), num_outputs=_split_nout)
 def _split(x, num_outputs=1, axis=1, squeeze_axis=False):
+    """Split into `num_outputs` equal parts along `axis`, optionally squeezing
+    it (ref: SliceChannel)."""
     parts = jnp.split(x, num_outputs, axis=axis)
     if squeeze_axis:
         parts = [jnp.squeeze(p, axis=axis) for p in parts]
@@ -333,17 +360,22 @@ def _split(x, num_outputs=1, axis=1, squeeze_axis=False):
 
 @register_op("tile")
 def _tile(x, reps=()):
+    """Repeat the whole array `reps` times per axis (numpy tile semantics)."""
     return jnp.tile(x, reps)
 
 
 @register_op("repeat")
 def _repeat(x, repeats=1, axis=None):
+    """Repeat each element `repeats` times along `axis` (flattens first when
+    `axis` is None)."""
     return jnp.repeat(x, repeats, axis=axis)
 
 
 @register_op("pad", aliases=("Pad",))
 def _pad(x, mode="constant", pad_width=(), constant_value=0.0):
     # reference pad_width is a flat tuple of (before, after) per axis
+    """Pad in constant/edge/reflect mode; `pad_width` is the reference's flat
+    (before, after)-per-axis tuple."""
     pw = list(pad_width)
     pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
     jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
@@ -354,12 +386,14 @@ def _pad(x, mode="constant", pad_width=(), constant_value=0.0):
 
 @register_op("reverse", aliases=("flip",))
 def _reverse(x, axis=()):
+    """Reverse element order along the given axes."""
     axes = (axis,) if isinstance(axis, int) else tuple(axis)
     return jnp.flip(x, axis=axes)
 
 
 @register_op("depth_to_space")
 def _depth_to_space(x, block_size=1):
+    """Rearrange NCHW channel blocks into spatial blocks: (C/b^2, H*b, W*b)."""
     b, c, h, w = x.shape
     bs = block_size
     y = x.reshape(b, bs, bs, c // (bs * bs), h, w)
@@ -369,6 +403,7 @@ def _depth_to_space(x, block_size=1):
 
 @register_op("space_to_depth")
 def _space_to_depth(x, block_size=1):
+    """Inverse of depth_to_space: fold b x b spatial blocks into channels."""
     b, c, h, w = x.shape
     bs = block_size
     y = x.reshape(b, c, h // bs, bs, w // bs, bs)
@@ -382,6 +417,8 @@ def _space_to_depth(x, block_size=1):
 
 @register_op("take")
 def _take(x, indices, axis=0, mode="clip"):
+    """Gather slices along `axis` by integer index, out-of-range entries
+    resolved per `mode`."""
     idx = indices.astype(jnp.int32)
     jmode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
     return jnp.take(x, idx, axis=axis, mode=jmode)
@@ -389,6 +426,7 @@ def _take(x, indices, axis=0, mode="clip"):
 
 @register_op("pick")
 def _pick(x, index, axis=-1, keepdims=False, mode="clip"):
+    """Select one element along `axis` per position of `index` (ref: pick)."""
     idx = jnp.clip(index.astype(jnp.int32), 0, x.shape[axis] - 1)
     out = jnp.take_along_axis(x, jnp.expand_dims(idx, axis=axis), axis=axis)
     if not keepdims:
@@ -398,18 +436,24 @@ def _pick(x, index, axis=-1, keepdims=False, mode="clip"):
 
 @register_op("one_hot", differentiable=False)
 def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    """Expand integer indices into depth-length one-hot vectors scaled to
+    on/off values."""
     return jax.nn.one_hot(indices.astype(jnp.int32), depth,
                           dtype=jnp.dtype(dtype)) * (on_value - off_value) + off_value
 
 
 @register_op("gather_nd")
 def _gather_nd(data, indices):
+    """Gather elements addressed by leading-axis multi-indices (ref:
+    gather_nd)."""
     idx = tuple(indices.astype(jnp.int32))
     return data[idx]
 
 
 @register_op("scatter_nd")
 def _scatter_nd(data, indices, shape=()):
+    """Scatter `data` into zeros of `shape` at multi-indices (colliding writes
+    pick one value)."""
     out = jnp.zeros(shape, data.dtype)
     idx = tuple(indices.astype(jnp.int32))
     return out.at[idx].set(data)
@@ -417,6 +461,7 @@ def _scatter_nd(data, indices, shape=()):
 
 @register_op("where")
 def _where(cond, x, y):
+    """Elementwise select: `x` where `cond` is nonzero, else `y`."""
     return jnp.where(cond.astype(bool) if jnp.issubdtype(cond.dtype, jnp.number)
                      else cond, x, y)
 
@@ -424,6 +469,8 @@ def _where(cond, x, y):
 @register_op("sequence_mask", aliases=("SequenceMask",))
 def _sequence_mask(data, sequence_length=None, use_sequence_length=False,
                    value=0.0, axis=0):
+    """Overwrite positions past each sequence's length with `value` along the
+    time axis (ref: SequenceMask)."""
     if not use_sequence_length or sequence_length is None:
         return data
     # data: (seq, batch, ...) if axis==0 else (batch, seq, ...)
@@ -439,6 +486,8 @@ def _sequence_mask(data, sequence_length=None, use_sequence_length=False,
 
 @register_op("sequence_last", aliases=("SequenceLast",))
 def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    """Select each sequence's last valid element along the time axis (ref:
+    SequenceLast)."""
     if not use_sequence_length or sequence_length is None:
         idx = [slice(None)] * data.ndim
         idx[axis] = -1
@@ -451,6 +500,8 @@ def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0
 
 @register_op("sequence_reverse", aliases=("SequenceReverse",))
 def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    """Reverse each sequence's valid prefix along the time axis, leaving
+    padding in place."""
     if not use_sequence_length or sequence_length is None:
         return jnp.flip(data, axis=axis)
     moved = jnp.moveaxis(data, axis, 0)
@@ -468,12 +519,15 @@ def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axi
 
 @register_op("sort", differentiable=False)
 def _sort(x, axis=-1, is_ascend=True):
+    """Sort values along `axis`; descending when is_ascend=False."""
     out = jnp.sort(x, axis=axis)
     return out if is_ascend else jnp.flip(out, axis=axis)
 
 
 @register_op("argsort", differentiable=False)
 def _argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    """Sorting permutation along `axis`, cast to `dtype` (the reference
+    returns float indices)."""
     out = jnp.argsort(x, axis=axis)
     if not is_ascend:
         out = jnp.flip(out, axis=axis)
@@ -487,6 +541,7 @@ def _topk_nout(attrs):
 
 @register_op("topk", differentiable=False, num_outputs=_topk_nout)
 def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """Top-k along `axis`; `ret_typ` selects indices, values, or both."""
     vals = -x if is_ascend else x
     if axis != -1 and axis != x.ndim - 1:
         moved = jnp.moveaxis(vals, axis, -1)
@@ -512,6 +567,8 @@ def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
 
 @register_op("dot")
 def _dot(a, b, transpose_a=False, transpose_b=False):
+    """Reference dot: contract a's LAST axis with b's FIRST (1-D operands
+    reduce to a scalar)."""
     if transpose_a:
         a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
     if transpose_b:
@@ -524,6 +581,8 @@ def _dot(a, b, transpose_a=False, transpose_b=False):
 
 @register_op("batch_dot")
 def _batch_dot(a, b, transpose_a=False, transpose_b=False):
+    """Batched matrix product over leading axes, with optional operand
+    transposes."""
     if transpose_a:
         a = jnp.swapaxes(a, -1, -2)
     if transpose_b:
@@ -533,11 +592,14 @@ def _batch_dot(a, b, transpose_a=False, transpose_b=False):
 
 @register_op("matmul")
 def _matmul(a, b):
+    """numpy-semantics matrix product."""
     return jnp.matmul(a, b)
 
 
 @register_op("khatri_rao")
 def _khatri_rao(*xs):
+    """Khatri-Rao product: per-column Kronecker crossing of the inputs'
+    leading axes."""
     out = xs[0]
     for x in xs[1:]:
         out = jnp.einsum("i...,j...->ij...", out, x).reshape((-1,) + out.shape[1:])
@@ -546,6 +608,8 @@ def _khatri_rao(*xs):
 
 @register_op("L2Normalization")
 def _l2norm(x, eps=1e-10, mode="instance"):
+    """L2-normalize each instance/channel/spatial slice (ref:
+    L2Normalization)."""
     if mode == "instance":
         axes = tuple(range(1, x.ndim))
     elif mode == "channel":
@@ -558,6 +622,7 @@ def _l2norm(x, eps=1e-10, mode="instance"):
 
 @register_op("smooth_l1")
 def _smooth_l1(x, scalar=1.0):
+    """Smooth (Huber-style) L1: quadratic below 1/scalar^2, linear beyond."""
     s2 = scalar * scalar
     return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * jnp.square(x),
                      jnp.abs(x) - 0.5 / s2)
@@ -565,6 +630,8 @@ def _smooth_l1(x, scalar=1.0):
 
 @register_op("diag")
 def _diag(x, k=0):
+    """k-th diagonal of a (batched) matrix, or the diagonal matrix of a
+    vector."""
     if x.ndim == 1:
         return jnp.diag(x, k)
     return jnp.diagonal(x, offset=k, axis1=-2, axis2=-1)
@@ -572,6 +639,7 @@ def _diag(x, k=0):
 
 @register_op("linalg_gemm2")
 def _linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
+    """alpha * a @ b with optional transposes (ref: linalg_gemm2)."""
     if transpose_a:
         a = jnp.swapaxes(a, -1, -2)
     if transpose_b:
@@ -581,11 +649,13 @@ def _linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
 
 @register_op("linalg_potrf")
 def _linalg_potrf(a):
+    """Lower Cholesky factor of a symmetric positive-definite matrix."""
     return jnp.linalg.cholesky(a)
 
 
 @register_op("linalg_syrk")
 def _linalg_syrk(a, transpose=False, alpha=1.0):
+    """Symmetric rank-k product: alpha * a @ a^T (a^T @ a when transpose)."""
     at = jnp.swapaxes(a, -1, -2)
     return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
 
@@ -598,16 +668,19 @@ register_op("cumprod")(lambda x, axis=None: jnp.cumprod(x, axis=axis))
 
 @register_op("isnan", differentiable=False)
 def _isnan(x):
+    """Elementwise NaN test as float32 {0, 1}."""
     return jnp.isnan(x).astype(jnp.float32)
 
 
 @register_op("isinf", differentiable=False)
 def _isinf(x):
+    """Elementwise infinity test as float32 {0, 1}."""
     return jnp.isinf(x).astype(jnp.float32)
 
 
 @register_op("isfinite", differentiable=False)
 def _isfinite(x):
+    """Elementwise finiteness test as float32 {0, 1}."""
     return jnp.isfinite(x).astype(jnp.float32)
 
 
@@ -617,6 +690,7 @@ def _isfinite(x):
 
 @register_op("trace")
 def _trace(data, offset=0, axis1=0, axis2=1):
+    """Sum of the (axis1, axis2) diagonal at `offset`."""
     return jnp.trace(data, offset=offset, axis1=axis1, axis2=axis2)
 
 
@@ -641,23 +715,30 @@ def _unravel_index(data, shape=()):
 
 @register_op("digamma")
 def _digamma(data):
+    """Elementwise digamma (logarithmic derivative of gamma)."""
     return jax.scipy.special.digamma(data)
 
 
 @register_op("bitwise_and", differentiable=False)
 def _bitwise_and(lhs, rhs):
+    """Elementwise bitwise AND of integer-coerced operands, returned in lhs
+    dtype."""
     return jnp.bitwise_and(lhs.astype(jnp.int64), rhs.astype(jnp.int64)) \
         .astype(lhs.dtype)
 
 
 @register_op("bitwise_or", differentiable=False)
 def _bitwise_or(lhs, rhs):
+    """Elementwise bitwise OR of integer-coerced operands, returned in lhs
+    dtype."""
     return jnp.bitwise_or(lhs.astype(jnp.int64), rhs.astype(jnp.int64)) \
         .astype(lhs.dtype)
 
 
 @register_op("bitwise_xor", differentiable=False)
 def _bitwise_xor(lhs, rhs):
+    """Elementwise bitwise XOR of integer-coerced operands, returned in lhs
+    dtype."""
     return jnp.bitwise_xor(lhs.astype(jnp.int64), rhs.astype(jnp.int64)) \
         .astype(lhs.dtype)
 
@@ -671,6 +752,8 @@ def _all_finite(data, init_output=True):
 
 @register_op("multi_all_finite", differentiable=False)
 def _multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    """-> (1,) float {0, 1}: every element of every input is finite (AMP
+    overflow probe)."""
     ok = jnp.asarray(True)
     for a in arrays:
         ok = jnp.logical_and(ok, jnp.isfinite(a).all())

@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import sanitizer as _mxsan
 from ..ndarray.ndarray import NDArray
 from ..telemetry import instruments as _ins
 from ..telemetry import tracing as _tracing
@@ -55,8 +56,11 @@ class FusedUnsupported(Exception):
 
 
 # process-wide executable cache: replicas (and trainers) with identical
-# signatures share one compiled program
-_CACHE: Dict[Tuple, Any] = {}
+# signatures share one compiled program.  mxsan: lock-free reads are
+# the design (update_all probes before compiling); writes stay under
+# _CACHE_LOCK — the sanitizer checks the write half at runtime.
+_CACHE: Dict[Tuple, Any] = _mxsan.track(
+    {}, "optimizer.fused._CACHE", reads="unlocked-ok")
 _CACHE_LOCK = threading.Lock()
 _COMPILES = 0
 _COMPILE_SECONDS = 0.0
@@ -239,4 +243,5 @@ class FusedUpdater(Updater):
         # recompile on the training hot path is the thing to watch
         _ins.fused_compile_seconds().observe(dt)
         _tracing.record_complete("fused-compile", "training", t0, dt)
+        _mxsan.record_compile("optimizer.fused_step", sig, dt)
         return compiled

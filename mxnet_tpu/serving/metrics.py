@@ -28,6 +28,7 @@ from collections import deque
 from typing import Dict, Optional
 
 from .. import profiler as _prof
+from ..analysis import sanitizer as _mxsan
 from ..telemetry import instruments as _ins
 from ..telemetry import tracing as _tracing
 
@@ -70,7 +71,10 @@ class ModelMetrics:
         # histogram too — requests_total=0 with a populated latency
         # series would desync every rate-vs-histogram readout
         self._lock = threading.Lock()
-        self._lat = deque(maxlen=_LATENCY_RING)  # (done_t, latency_s)
+        # (done_t, latency_s); mxsan: every access holds self._lock
+        self._lat = _mxsan.track(
+            deque(maxlen=_LATENCY_RING),
+            f"serving.metrics[{model}/v{version}]._lat")
         self._started = time.perf_counter()
 
     def _lane(self, name: str) -> str:

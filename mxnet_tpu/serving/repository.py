@@ -15,17 +15,24 @@ Directory conventions:
 """
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 import time
 from typing import Dict, List, Optional
 
+from ..analysis import sanitizer as _mxsan
 from ..telemetry import instruments as _ins
 from ..telemetry import tracing as _tracing
 from . import ModelNotFound, ServingError
 from .metrics import ModelMetrics
 
 __all__ = ["ModelRepository", "_ModelEntry"]
+
+# one mxsan compile-site per entry INSTANCE: a fresh repository
+# legitimately rebuilds every bucket — only a rebuild within one
+# entry's lifetime means its cache lost an executable
+_entry_seq = itertools.count(1)
 
 
 class _ModelEntry:
@@ -37,7 +44,12 @@ class _ModelEntry:
         self.metrics = ModelMetrics(name, version)
         self._lock = threading.Lock()
         self._served = None
-        self._executables: Dict[int, object] = {}
+        # mxsan: every bucket-cache access holds self._lock (reads too
+        # — the executable() fast path re-checks under the lock)
+        self._executables: Dict[int, object] = _mxsan.track(
+            {}, f"serving.repository[{name}/v{version}]._executables")
+        self._san_site = (f"serving.bucket:{name}/v{version}"
+                          f"#{next(_entry_seq)}")
         self.cache_hits = 0
         self.cache_misses = 0
 
@@ -127,6 +139,10 @@ class _ModelEntry:
             fn = self._executables.setdefault(bucket, compiled)
             self.cache_misses += 1
             self.metrics.bump("cache_misses")
+        # mxsan keys on the INSERT (losing a by-design concurrent
+        # duplicate build must not read as a cache failure)
+        _mxsan.record_compile(self._san_site,
+                              bucket if fn is compiled else None)
         return fn
 
     def _compile(self, bucket: int):
@@ -196,7 +212,9 @@ class ModelRepository:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._models: Dict[str, Dict[int, _ModelEntry]] = {}
+        # mxsan: every repository access holds self._lock
+        self._models: Dict[str, Dict[int, _ModelEntry]] = _mxsan.track(
+            {}, "serving.ModelRepository._models")
 
     def add(self, name: str, path: str,
             version: Optional[int] = None) -> int:

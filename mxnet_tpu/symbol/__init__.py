@@ -15,7 +15,11 @@ __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
            "GraphExecutor", "zeros", "ones", "maximum", "minimum",
            "power", "modulo", "logical_and", "logical_or", "logical_xor"]
 
-_CACHE = {}
+from ..analysis import sanitizer as _mxsan
+
+# mxsan: the __getattr__ fast path reads lock-free (double-checked);
+# writes hold _CACHE_LOCK
+_CACHE = _mxsan.track({}, "symbol._CACHE", reads="unlocked-ok")
 _CACHE_LOCK = _threading.Lock()  # module attrs resolve from any thread
 
 

@@ -27,6 +27,7 @@ __all__ = [
     "serving_counter", "serving_queue_depth", "serving_occupancy",
     "serving_request_latency", "serving_compile_total",
     "serving_compile_seconds",
+    "san_violations_total",
 ]
 
 _lock = threading.RLock()  # _child -> _family nests the acquisition
@@ -118,6 +119,16 @@ def collective_seconds(op: str):
     return _child("mx_collective_seconds", "histogram",
                   "Host-blocking collective wall seconds.",
                   ("op",), (op,))
+
+
+# ---- analysis ---------------------------------------------------------
+
+def san_violations_total(kind: str):
+    return _child("mx_san_violations_total", "counter",
+                  "mxsan sanitizer violations by detector kind "
+                  "(lock-order, lockset-race, recompile-storm). Any "
+                  "non-zero value is a finding — alert on it.",
+                  ("kind",), (kind,))
 
 
 # ---- serving ----------------------------------------------------------

@@ -200,6 +200,19 @@ declare("MXNET_USE_PALLAS", bool, True,
 declare("MXNET_PROFILER_AUTOSTART", bool, False,
         "Start the chrome-trace profiler at import (ref: "
         "MXNET_PROFILER_AUTOSTART).")
+declare("MXNET_SAN", bool, False,
+        "Enable mxsan, the runtime concurrency & dispatch sanitizer, "
+        "at import — lock-order graph, Eraser-style lockset races on "
+        "tracked caches, recompile-storm detection. Opt-in; see "
+        "docs/static_analysis.md (Dynamic analysis).")
+declare("MXNET_SAN_OUT", str, "MXSAN.json",
+        "Path the mxsan pytest plugin writes its JSON report to at "
+        "session end (relative to the working directory).")
+declare("MXNET_SAN_SUPPRESS", str, "",
+        "Comma-separated substrings; an mxsan violation whose message "
+        "contains one is dropped — the escape hatch for a finding "
+        "that is understood and accepted (document why where you set "
+        "it).")
 declare("MXNET_TELEMETRY", bool, False,
         "Enable telemetry span tracing at import (metrics are always "
         "on; this turns on trace-event emission — see "

@@ -28,6 +28,30 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from tier-1 (-m 'not slow'); the "
+        "nightly lanes run these")
+    # MXNET_SAN=1: importing mxnet_tpu (in every test) arms the
+    # sanitizer; this plugin turns any violation into a failure of the
+    # test it happened under and writes MXSAN.json at session end
+    # (tools/run_nightly.py archives it).  Truthiness mirrors
+    # base.get_env's bool parse WITHOUT importing the framework here
+    # (that must stay lazy for sessions that don't use the sanitizer).
+    _raw = os.environ.get("MXNET_SAN", "").strip().lower()
+    if _raw not in ("", "0", "false", "no", "off"):
+        import sys as _sys
+
+        _tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if _tools not in _sys.path:
+            _sys.path.insert(0, _tools)
+        import mxsan_pytest
+
+        config.pluginmanager.register(mxsan_pytest.MxsanPlugin(),
+                                      "mxsan")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     """with_seed-style reproducibility (ref: tests/python/unittest/common.py)."""

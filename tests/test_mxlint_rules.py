@@ -115,6 +115,97 @@ class TestMX002:
             """, enable=["MX002"])
         assert vs == []
 
+    # ---- one-level interprocedural (ISSUE 5) -------------------------
+
+    def test_flags_self_helper_sync_at_step_call_site(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            class MyTrainer:
+                def _log_grads(self):
+                    return self._grads[0].asnumpy()
+
+                def step(self, batch_size):
+                    self._log_grads()
+            """, enable=["MX002"])
+        assert rules_hit(vs) == ["MX002"]
+        # flagged at the CALL site inside step, naming the helper
+        assert vs[0].symbol == "MyTrainer.step"
+        assert "_log_grads()" in vs[0].message
+        assert "one call deep" in vs[0].message
+
+    def test_flags_module_helper_called_inside_record(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            def log_loss(y):
+                return y.asnumpy()
+
+            def train(net, x, autograd):
+                with autograd.record():
+                    v = log_loss(net(x))
+                return v
+            """, enable=["MX002"])
+        assert rules_hit(vs) == ["MX002"]
+        assert vs[0].symbol == "train"
+        assert "log_loss()" in vs[0].message
+
+    def test_clean_helper_without_sync_and_cold_callers(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            def pure_helper(y):
+                return y * 2
+
+            def syncing_helper(y):
+                return y.asnumpy()
+
+            class MyTrainer:
+                def step(self, batch_size):
+                    return pure_helper(self._g)  # no sync inside
+
+                def save_states(self, fname):
+                    return syncing_helper(self._g)  # cold path caller
+            """, enable=["MX002"])
+        assert vs == []
+
+    def test_helper_pragma_suppresses_the_call_site_too(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import numpy as np
+
+            class MyTrainer:
+                def _pack(self):
+                    # host floats, not device arrays
+                    return np.asarray(self._hypers)  # mxlint: disable=MX002
+
+                def step(self, batch_size):
+                    return self._pack()
+            """, enable=["MX002"])
+        assert vs == []
+
+    def test_flags_self_helper_in_record_block_inside_method(self, tmp_path):
+        # record() blocks written in methods resolve self.<helper> too
+        vs = lint_source(tmp_path, """
+            class Runner:
+                def _log(self):
+                    return self._y.asnumpy()
+
+                def fit(self, net, x, autograd):
+                    with autograd.record():
+                        self._y = net(x)
+                        self._log()
+            """, enable=["MX002"])
+        assert rules_hit(vs) == ["MX002"]
+        assert "_log()" in vs[0].message
+
+    def test_exactly_one_level_not_transitive(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            def inner(y):
+                return y.asnumpy()
+
+            def outer(y):
+                return inner(y)  # sync is TWO calls away from step
+
+            class MyTrainer:
+                def step(self, batch_size):
+                    return outer(self._g)
+            """, enable=["MX002"])
+        assert vs == []
+
 
 # ---------------------------------------------------------------------------
 # MX003 — untracked env knob

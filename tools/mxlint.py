@@ -21,10 +21,47 @@ import argparse
 import importlib.util
 import json
 import os
+import subprocess
 import sys
 import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _changed_files(ref: str, scope_paths):
+    """``.py`` files changed vs `ref` (plus untracked ones), filtered
+    to the requested scope — the <1s pre-commit loop behind ``--diff``."""
+    def _git(*args):
+        p = subprocess.run(["git", "-C", _REPO, *args],
+                           capture_output=True, text=True, timeout=30)
+        if p.returncode != 0:
+            raise RuntimeError(f"git {' '.join(args)}: "
+                               f"{p.stderr.strip() or p.returncode}")
+        return [ln for ln in p.stdout.splitlines() if ln.strip()]
+
+    names = set(_git("diff", "--name-only", ref, "--"))
+    names.update(_git("ls-files", "--others", "--exclude-standard"))
+    # a relative scope path that doesn't exist from the cwd resolves
+    # against the repo root — otherwise `mxlint mxnet_tpu --diff` run
+    # from any other directory silently matches nothing and exits 0
+    scope = []
+    for p in scope_paths:
+        ap = os.path.abspath(p)
+        if not os.path.exists(ap) and not os.path.isabs(p):
+            rp = os.path.join(_REPO, p)
+            if os.path.exists(rp):
+                ap = rp
+        scope.append(ap)
+    out = []
+    for rel in sorted(names):
+        if not rel.endswith(".py"):
+            continue
+        path = os.path.join(_REPO, rel)
+        if not os.path.isfile(path):
+            continue  # deleted in the working tree
+        if any(os.path.commonpath([path, s]) == s for s in scope):
+            out.append(path)
+    return out
 
 
 def _load_analysis():
@@ -83,6 +120,12 @@ def main(argv=None) -> int:
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite --baseline with stale entries removed "
                     "(never adds entries)")
+    ap.add_argument("--diff", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="lint only .py files changed vs REF (default "
+                    "HEAD) plus untracked ones — the <1s pre-commit "
+                    "loop. Stale-baseline reporting is disabled (a "
+                    "partial lint cannot judge staleness)")
     ap.add_argument("--enable", default=None,
                     help="comma-separated rule ids to run exclusively")
     ap.add_argument("--disable", default=None,
@@ -106,6 +149,16 @@ def main(argv=None) -> int:
         return 0
 
     paths = args.paths or [os.path.join(_REPO, "mxnet_tpu")]
+    if args.diff is not None:
+        try:
+            paths = _changed_files(args.diff, paths)
+        except RuntimeError as e:
+            print(f"mxlint --diff: {e}", file=sys.stderr)
+            return 2
+        if not paths:
+            print(f"mxlint --diff: no .py files changed vs "
+                  f"{args.diff} — OK")
+            return 0
     t0 = time.perf_counter()
     engine = analysis.LintEngine(
         root=_REPO,
@@ -127,6 +180,10 @@ def main(argv=None) -> int:
     entries = analysis.load_baseline(args.baseline) if args.baseline \
         else []
     new, suppressed, stale = analysis.diff_baseline(violations, entries)
+    if args.diff is not None:
+        # a subset lint sees only a slice of the tree: every baseline
+        # entry outside the changed files would read as "stale"
+        stale = []
 
     if args.update_baseline:
         if not args.baseline:

@@ -1,0 +1,93 @@
+"""pytest plugin: fail any test during which mxsan records a violation.
+
+Registered by ``tests/conftest.py`` when ``MXNET_SAN`` is set (the same
+knob that makes ``import mxnet_tpu`` enable the sanitizer before the
+framework's locks and caches are built).  Behaviour:
+
+* per test: the SESSION sanitizer's violation count is snapshotted at
+  setup; new violations at teardown raise ``MxsanViolationError`` with
+  the full formatted reports (stacks for both lock orders, the racing
+  access, or the recompiling site).  Tests that seed violations on
+  purpose use ``mxsan.scope()`` — scoped findings never touch the
+  session instance, so they do not trip this hook.
+* per session: the JSON report is written to ``MXNET_SAN_OUT``
+  (default ``MXSAN.json``) — the artifact ``tools/run_nightly.py``
+  archives for the violation trajectory across PRs.
+"""
+from __future__ import annotations
+
+import os
+
+import pytest
+
+__all__ = ["MxsanPlugin", "MxsanViolationError"]
+
+
+class MxsanViolationError(AssertionError):
+    """Raised in teardown so the violation fails the test it happened
+    under (closest attribution the plugin can give)."""
+
+
+def _sanitizer():
+    # lazy: importing the sanitizer package pulls mxnet_tpu, which the
+    # test process imports anyway — but never at plugin-import time
+    from mxnet_tpu.analysis import sanitizer
+
+    return sanitizer
+
+
+class MxsanPlugin:
+    name = "mxsan"
+
+    def __init__(self):
+        self._before = 0
+
+    def _session_violations(self):
+        # the SESSION instance, never a test's scoped one (a test that
+        # forgot to exit a scope must not swap the ledger out)
+        san = _sanitizer().default()
+        return san.violations() if san is not None else []
+
+    def pytest_runtest_setup(self, item):
+        self._before = len(self._session_violations())
+
+    @pytest.hookimpl(trylast=True)
+    def pytest_runtest_teardown(self, item):
+        # trylast: AFTER the runner's teardown has finalized fixtures —
+        # mxsan.scope() fixtures must exit before the ledger is read,
+        # and raising here must not preempt fixture finalization
+        vs = self._session_violations()
+        new = vs[self._before:]
+        if new:
+            self._before = len(vs)  # attribute each finding once
+            raise MxsanViolationError(
+                f"{len(new)} mxsan violation(s) during {item.nodeid}:\n"
+                + "\n".join(v.format() for v in new))
+
+    def pytest_sessionfinish(self, session, exitstatus):
+        sanitizer = _sanitizer()
+        san = sanitizer.default()
+        if san is None:
+            return
+        from mxnet_tpu.util import env
+
+        out = env.get_str("MXNET_SAN_OUT") or "MXSAN.json"
+        if not os.path.isabs(out):
+            out = os.path.join(os.getcwd(), out)
+        sanitizer.write_report(out, san)
+        n = len(san.violations())
+        tr = session.config.pluginmanager.get_plugin("terminalreporter")
+        if tr is not None:
+            tr.write_line(
+                f"mxsan: {n} violation(s), report written to {out}")
+        if n and exitstatus == 0:
+            # violations recorded OUTSIDE any test window (import/
+            # collection time, or a daemon thread after the last
+            # teardown) never raised in a teardown hook — a green exit
+            # would bury them.  session.exitstatus is read after the
+            # sessionfinish hooks run, so this flips the process rc.
+            session.exitstatus = 1
+            if tr is not None:
+                tr.write_line(
+                    "mxsan: failing the session — violation(s) were "
+                    "recorded outside any test window (see the report)")
