@@ -71,7 +71,8 @@ def __getattr__(name):
             "lr_scheduler", "io", "image", "symbol", "module", "parallel",
             "callback", "model", "test_utils", "engine", "runtime",
             "visualization", "recordio", "contrib", "monitor", "name", "rnn",
-            "attribute", "resource", "rtc", "kvstore_server", "serving"}
+            "attribute", "resource", "rtc", "kvstore_server", "serving",
+            "resilience"}
     if name == "sym":
         mod = importlib.import_module(".symbol", __name__)
         globals()["sym"] = mod

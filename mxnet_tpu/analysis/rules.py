@@ -18,6 +18,7 @@ from .engine import FileContext, Rule, Violation, register_rule
 __all__ = [
     "RecompileHazard", "HostSyncInHotPath", "UntrackedEnvKnob",
     "UnguardedSharedState", "DonationMisuse", "OpRegistryContract",
+    "SwallowedException",
 ]
 
 
@@ -640,6 +641,97 @@ class DonationMisuse(Rule):
         for node in ctx.functions:
             yield from self._scan_scope(ctx, node.body)
         yield from self._scan_scope(ctx, ctx.tree.body)
+
+
+# ---------------------------------------------------------------------------
+# MX007 — swallowed exception in a hot path
+# ---------------------------------------------------------------------------
+
+#: Modules whose call chains ARE the hot paths (Trainer step, KVStore
+#: sync, the serving request path, worker pools, the resilience layer
+#: itself) — a swallowed error here becomes a hang, a short epoch, or a
+#: silently-wrong gradient instead of a diagnosable failure.
+_HOT_PATHS = re.compile(
+    r"mxnet_tpu/(kvstore[^/]*|gluon/trainer|gluon/data/dataloader|"
+    r"optimizer/[^/]+|parallel/(dist|checkpoint)|serving/[^/]+|"
+    r"resilience/[^/]+)\.py$")
+
+#: Class names on the same chains, for files outside the module list
+#: (and for fixtures).
+_HOT_CLASS = re.compile(
+    r"(Trainer|Updater|KVStore|Server|Batcher|Repository|ModelEntry|"
+    r"DataLoader|Checkpoint|Breaker)")
+
+
+@register_rule
+class SwallowedException(Rule):
+    """MX007: a bare ``except:`` / ``except Exception:`` /
+    ``except BaseException:`` whose body only ``pass``\\ es (or
+    ``continue``\\ s / ``...``) inside a first-party hot path.  Broad
+    catch-and-drop turned real faults into the bug classes this PR
+    series keeps paying for: a dead DataLoader worker became a silent
+    short epoch, a failed collective became a deadlocked peer group.
+    Narrow catches (``except ValueError: pass``) are the legitimate
+    EAFP idiom and are not flagged; a broad handler that logs,
+    re-raises, cleans up, or returns a value is fine too — only
+    catch-everything-do-nothing is the bug."""
+
+    id = "MX007"
+    name = "swallowed-exception"
+    description = ("Bare except/except Exception with a pass-only body "
+                   "in Trainer/KVStore/serving/dataloader/resilience "
+                   "hot paths — errors must propagate, be transformed, "
+                   "or be loudly recorded.")
+
+    _BROAD = {"Exception", "BaseException"}
+
+    def _is_broad(self, handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True  # bare except:
+        if isinstance(t, ast.Tuple):
+            return any(_terminal_name(e) in self._BROAD for e in t.elts)
+        return _terminal_name(t) in self._BROAD
+
+    @staticmethod
+    def _swallows(handler: ast.ExceptHandler) -> bool:
+        for stmt in handler.body:
+            if isinstance(stmt, (ast.Pass, ast.Continue)):
+                continue
+            if isinstance(stmt, ast.Expr) and \
+                    isinstance(stmt.value, ast.Constant):
+                continue  # docstring / `...`
+            return False
+        return True
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        hot_file = bool(_HOT_PATHS.search(
+            ctx.relpath.replace("\\", "/")))
+        hot_spans: List[Tuple[int, int]] = []
+        if not hot_file:
+            for node in ctx.classes:
+                if _HOT_CLASS.search(node.name):
+                    end = getattr(node, "end_lineno", node.lineno)
+                    hot_spans.append((node.lineno, end))
+            if not hot_spans:
+                return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not hot_file and not any(
+                    lo <= node.lineno <= hi for lo, hi in hot_spans):
+                continue
+            if self._is_broad(node) and self._swallows(node):
+                what = "bare except:" if node.type is None else \
+                    f"except {_terminal_name(node.type) or 'Exception'}:"
+                yield ctx.violation(
+                    self.id, node,
+                    f"`{what}` with a pass-only body swallows every "
+                    "error on a hot path — a dead worker or failed "
+                    "collective becomes a silent hang or wrong result. "
+                    "Catch the specific exception, or handle/log/"
+                    "re-raise (baseline with a justification if the "
+                    "swallow is truly intended).")
 
 
 # ---------------------------------------------------------------------------

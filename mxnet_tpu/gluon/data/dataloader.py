@@ -33,11 +33,24 @@ import numpy as np
 from ...analysis import sanitizer as _mxsan
 from ...base import MXNetError
 from ...ndarray.ndarray import NDArray, array as nd_array
+from ...resilience import chaos as _chaos
 from ...telemetry import instruments as _ins
 from ...telemetry import tracing as _tracing
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
-__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+__all__ = ["DataLoader", "WorkerDied", "default_batchify_fn",
+           "default_mp_batchify_fn"]
+
+
+class WorkerDied(MXNetError):
+    """A DataLoader worker (thread or spawned process) exited
+    abnormally.  Raised in the CONSUMER with the worker's identity —
+    never a silent short epoch, never a hang until the full batch
+    timeout.  ``worker`` is the thread name or child pid."""
+
+    def __init__(self, msg: str, worker=None):
+        super().__init__(msg)
+        self.worker = worker
 
 
 def _observe_data_wait(t0: float) -> None:
@@ -193,7 +206,7 @@ def _drain_shm(pending, timeout=120):
 _MP_STATE: dict = {}
 
 
-def _mp_init(dataset, batchify_fn, transport="shm"):
+def _mp_init(dataset, batchify_fn, transport="shm", chaos_specs=()):
     # Runs in EVERY worker — including ones the Pool maintenance thread
     # respawns later with the parent's normal env — so the TPU-safety
     # pinning must happen here, not around Pool construction.  jax is
@@ -211,9 +224,18 @@ def _mp_init(dataset, batchify_fn, transport="shm"):
     _MP_STATE["dataset"] = dataset
     _MP_STATE["batchify"] = batchify_fn
     _MP_STATE["transport"] = transport
+    # chaos plans travel into the spawn child so worker-death injection
+    # fires INSIDE the worker (each child runs its own call counters)
+    _chaos.install_plans(list(chaos_specs))
 
 
 def _mp_make_batch(indices):
+    if _chaos._ACTIVE:
+        action = _chaos.check("dataloader.worker")
+        if action == "die":
+            # simulated abnormal worker death: the parent must raise a
+            # clear WorkerDied, not hang or return a short epoch
+            os._exit(17)
     ds, bfn = _MP_STATE["dataset"], _MP_STATE["batchify"]
     out = bfn([ds[i] for i in indices])
 
@@ -292,13 +314,25 @@ class DataLoader:
         self._num_workers = max(0, num_workers)
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
+        self._resume_from = 0
+
+    def resume_from(self, batch_idx: int) -> None:
+        """Preemption-resume contract: the NEXT ``__iter__`` starts at
+        `batch_idx` (0-based), skipping earlier batches without
+        building them.  One-shot — the following epoch starts at 0.
+        Determinism is the sampler's: with ``shuffle=True`` the caller
+        must restore the RNG first (resilience.AutoCheckpoint does)."""
+        self._resume_from = max(0, int(batch_idx))
 
     def _make_batch(self, indices):
         return self._batchify_fn([self._dataset[i] for i in indices])
 
     def __iter__(self):
+        start, self._resume_from = self._resume_from, 0
         if self._num_workers == 0:
-            for indices in self._batch_sampler:
+            for bi, indices in enumerate(self._batch_sampler):
+                if bi < start:
+                    continue
                 if not _tracing.active():
                     yield self._make_batch(indices)
                     continue
@@ -308,9 +342,9 @@ class DataLoader:
                 yield batch
             return
         if self._worker_pool == "process":
-            yield from self._process_iter()
+            yield from self._process_iter(start)
         else:
-            yield from self._threaded_iter()
+            yield from self._threaded_iter(start)
 
     # ---- spawn-based process pool ---------------------------------------
     def _get_pool(self):
@@ -327,10 +361,42 @@ class DataLoader:
             # juggling is needed here
             self._pool = ctx.Pool(
                 self._num_workers, initializer=_mp_init,
-                initargs=(self._dataset, bfn, self._worker_transport))
+                initargs=(self._dataset, bfn, self._worker_transport,
+                          _chaos.export_plans("dataloader.worker")
+                          if _chaos._ACTIVE else ()))
         return self._pool
 
-    def _process_iter(self):
+    def _result_or_dead(self, res, pool, worker_pids):
+        """``res.get`` sliced into short waits that watch worker
+        liveness: a dead child (its pid reaped from, or respawned out
+        of, ``pool._pool``) raises :class:`WorkerDied` NOW — its task
+        is lost and the result would otherwise only surface as an
+        opaque timeout a full ``self._timeout`` later."""
+        import multiprocessing as mp
+
+        deadline = time.monotonic() + self._timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            try:
+                return res.get(min(0.5, max(remaining, 0.01)))
+            except mp.TimeoutError:
+                current = {w.pid for w in pool._pool}
+                dead = sorted(
+                    (worker_pids - current)
+                    | {w.pid for w in pool._pool if not w.is_alive()})
+                if dead:
+                    raise WorkerDied(
+                        f"DataLoader worker process(es) {dead} died "
+                        f"abnormally; their in-flight batches are lost "
+                        f"(the pool is discarded — recreate the "
+                        f"iterator to continue)", worker=dead[0]) \
+                        from None
+                if remaining <= 0:
+                    raise MXNetError(
+                        f"DataLoader worker timed out after "
+                        f"{self._timeout}s") from None
+
+    def _process_iter(self, start: int = 0):
         """Strict-order prefetching over the persistent spawn pool;
         worker exceptions re-raise in the consumer (pickled through).
         In-flight shm results are reclaimed on ANY exit (early break,
@@ -340,11 +406,13 @@ class DataLoader:
         from collections import deque
 
         pool = self._get_pool()
-        batches = list(self._batch_sampler)
+        worker_pids = {w.pid for w in pool._pool}
+        batches = list(self._batch_sampler)[start:]
         window = max(self._prefetch, self._num_workers, 2)
         pending: deque = deque()
         it = iter(batches)
         timed_out = False
+        died = False
         try:
             for _ in range(min(window, len(batches))):
                 pending.append(pool.apply_async(_mp_make_batch,
@@ -353,12 +421,20 @@ class DataLoader:
                 res = pending.popleft()
                 t0 = time.perf_counter() if _tracing.active() else None
                 try:
-                    out = res.get(self._timeout)
-                except BaseException:
+                    out = self._result_or_dead(res, pool, worker_pids)
+                except BaseException as e:
                     # the popped result may still arrive later and hold
                     # a shm segment — put it back so the drain sees it
                     pending.appendleft(res)
                     timed_out = True
+                    if isinstance(e, WorkerDied):
+                        # the respawned pool would re-lose the dead
+                        # worker's task; start clean next iteration
+                        died = True
+                        try:
+                            pool.terminate()
+                        finally:
+                            self._pool = None
                     raise
                 if t0 is not None:
                     _observe_data_wait(t0)
@@ -371,9 +447,11 @@ class DataLoader:
         finally:
             # healthy teardown (early break / epoch end) waits out slow
             # but live batches; after a worker timeout/crash, cap the
-            # wait — those results mostly never arrive
+            # wait — those results mostly never arrive (and after a
+            # terminated pool they NEVER arrive: shortest cap)
             _drain_shm(pending,
-                       min(self._timeout, 15) if timed_out
+                       2 if died
+                       else min(self._timeout, 15) if timed_out
                        else self._timeout)
 
     @staticmethod
@@ -394,14 +472,20 @@ class DataLoader:
             except Exception:
                 pass
 
-    def _threaded_iter(self):
+    def _threaded_iter(self, start: int = 0):
         """Prefetching iterator with N REAL worker threads (reference
         semantics: num_workers parallel batch producers).  Workers pull
         batch indices from a shared queue and publish into a reorder
         buffer keyed by batch position, so results stream strictly in
         sampler order; numpy/cv2/TF decode inside `__getitem__` releases
-        the GIL, which is where the parallelism pays."""
-        batches = list(self._batch_sampler)
+        the GIL, which is where the parallelism pays.
+
+        A worker thread that dies without publishing (chaos-injected, or
+        a C extension taking the thread down) surfaces as
+        :class:`WorkerDied` at the consumer — the liveness check below —
+        instead of a full-timeout hang for a batch that can never
+        arrive."""
+        batches = list(self._batch_sampler)[start:]
         n_workers = self._num_workers
         window = max(self._prefetch, n_workers, 2)  # in-flight bound
         task_q: "queue.Queue" = queue.Queue()
@@ -419,6 +503,15 @@ class DataLoader:
                 if item is None or stop.is_set():  # sentinel: shut down
                     return
                 pos, indices = item
+                if _chaos._ACTIVE:
+                    try:
+                        if _chaos.check("dataloader.worker") == "die":
+                            return  # abnormal exit: publish NOTHING
+                    except BaseException as e:
+                        with done_cv:
+                            done[pos] = ("err", e)
+                            done_cv.notify_all()
+                        continue
                 try:
                     result = ("ok", self._make_batch(indices))
                 except BaseException as e:  # propagate to consumer
@@ -430,20 +523,32 @@ class DataLoader:
         next_submit = min(window, len(batches))
         for pos in range(next_submit):  # seed the prefetch window
             task_q.put((pos, batches[pos]))
-        threads = [threading.Thread(target=worker, daemon=True)
-                   for _ in range(n_workers)]
+        threads = [threading.Thread(target=worker, daemon=True,
+                                    name=f"mx-dataloader-worker-{i}")
+                   for i in range(n_workers)]
         for t in threads:
             t.start()
         try:
             for pos in range(len(batches)):
                 t0 = time.perf_counter() if _tracing.active() else None
+                deadline = time.monotonic() + self._timeout
                 with done_cv:
-                    ok = done_cv.wait_for(lambda: pos in done,
-                                          timeout=self._timeout)
-                    if not ok:
-                        raise MXNetError(
-                            f"DataLoader worker timed out after "
-                            f"{self._timeout}s (batch {pos})")
+                    while pos not in done:
+                        dead = [t.name for t in threads
+                                if not t.is_alive()]
+                        if dead:
+                            raise WorkerDied(
+                                f"DataLoader worker thread(s) "
+                                f"{dead} exited abnormally; batch "
+                                f"{pos + start} will never arrive",
+                                worker=dead[0])
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise MXNetError(
+                                f"DataLoader worker timed out after "
+                                f"{self._timeout}s (batch "
+                                f"{pos + start})")
+                        done_cv.wait(timeout=min(0.2, remaining))
                     kind, payload = done.pop(pos)
                 if kind == "err":
                     raise payload

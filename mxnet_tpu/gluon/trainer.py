@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Union
 from .. import kvstore as kvs_mod
 from .. import optimizer as opt_mod
 from ..base import MXNetError
+from ..resilience import chaos as _chaos
 from ..telemetry import instruments as _ins
 from ..telemetry import tracing as _tracing
 from .parameter import Parameter, ParameterDict
@@ -75,6 +76,9 @@ class Trainer:
         # combination the fused updater can't express must not forfeit
         # the (independent) bucketed gradient allreduce
         self._fuse_update_ok = True
+        # resilience.AutoCheckpoint attaches itself here; None costs
+        # one attribute check per step
+        self._auto_ckpt = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -142,7 +146,8 @@ class Trainer:
                     self._kvstore.init(i, p.data())
         self._kv_initialized = True
         if self._states_to_load is not None:
-            self.load_states(self._states_to_load)
+            fname, allow_resize = self._states_to_load
+            self.load_states(fname, allow_resize=allow_resize)
             self._states_to_load = None
 
     @property
@@ -157,23 +162,35 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     def step(self, batch_size: int, ignore_stale_grad: bool = False):
-        """Forward through KVStore then optimizer (ref: Trainer.step)."""
+        """Forward through KVStore then optimizer (ref: Trainer.step).
+
+        Resilience hooks: a chaos ``trainer.preempt`` plan sets the
+        preemption flag at step entry (the stand-in for an async
+        SIGTERM), and an attached AutoCheckpoint runs after the update
+        — so a preemption observed during step N checkpoints AT step N
+        and raises ``Preempted`` from the step-N boundary, never
+        mid-update."""
+        if _chaos._ACTIVE:
+            _chaos.check("trainer.preempt")
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         if not _tracing.active():  # disabled: one predicate check
             self._allreduce_grads()
             self._update(ignore_stale_grad)
-            return
-        with _tracing.span("step", cat="training"):
-            with _tracing.span("grad-allreduce", cat="training",
-                               metric=_phase_metric("grad-allreduce")):
-                self._allreduce_grads()
-            with _tracing.span("optimizer-update", cat="training",
-                               metric=_phase_metric("optimizer-update")):
-                self._update(ignore_stale_grad)
-        if _tracing._ENABLED:
-            _ins.training_steps_total().inc()
+        else:
+            with _tracing.span("step", cat="training"):
+                with _tracing.span("grad-allreduce", cat="training",
+                                   metric=_phase_metric("grad-allreduce")):
+                    self._allreduce_grads()
+                with _tracing.span("optimizer-update", cat="training",
+                                   metric=_phase_metric(
+                                       "optimizer-update")):
+                    self._update(ignore_stale_grad)
+            if _tracing._ENABLED:
+                _ins.training_steps_total().inc()
+        if self._auto_ckpt is not None:
+            self._auto_ckpt.on_step(self)
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -310,27 +327,37 @@ class Trainer:
             _ins.fused_step_total().inc()
         return True
 
-    def save_states(self, fname: str):
-        """Persist optimizer state for EVERY replica updater.  One
-        replica keeps the reference single-payload format; multiple
+    def _states_payload(self) -> bytes:
+        """The serialized optimizer state for EVERY replica updater
+        (the blob save_states writes and AutoCheckpoint snapshots).
+        One replica keeps the reference single-payload format; multiple
         replicas wrap the per-replica payloads (each replica owns its
         own momentum/variance buffers — saving only replica 0 silently
         dropped the rest)."""
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore:
-            self._kvstore.save_optimizer_states(fname, dump_optimizer=False)
-            return
+            raise MXNetError(
+                "optimizer state lives on the kvstore "
+                "(update_on_kvstore); use save_states/"
+                "kvstore.save_optimizer_states")
         if not self._updaters:
             self._updaters.append(self._new_updater())
         if len(self._updaters) == 1:
-            payload = self._updaters[0].get_states(dump_optimizer=False)
-        else:
-            payload = pickle.dumps({"__mx_replica_states__": [
-                u.get_states(dump_optimizer=False)
-                for u in self._updaters]})
+            return self._updaters[0].get_states(dump_optimizer=False)
+        return pickle.dumps({"__mx_replica_states__": [
+            u.get_states(dump_optimizer=False)
+            for u in self._updaters]})
+
+    def save_states(self, fname: str):
+        """Persist optimizer state (see :meth:`_states_payload`)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=False)
+            return
         with open(fname, "wb") as f:
-            f.write(payload)
+            f.write(self._states_payload())
 
     def _replica_ctxs(self):
         """The context list the replica updaters map onto — the LONGEST
@@ -346,9 +373,15 @@ class Trainer:
                     best = ctxs
         return best
 
-    def load_states(self, fname: str):
+    def load_states(self, fname: str, allow_resize: bool = False):
+        """Restore optimizer state.  ``allow_resize=True`` (the
+        preemption-resume path) accepts a checkpoint whose replica
+        count differs from this trainer's: sync data-parallel replicas
+        hold identical state, so restoring onto FEWER replicas takes a
+        prefix and onto more broadcasts replica 0.  The default stays
+        strict — outside resume, a count mismatch is a wiring bug."""
         if not self._kv_initialized:
-            self._states_to_load = fname
+            self._states_to_load = (fname, allow_resize)
             return
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
@@ -367,12 +400,17 @@ class Trainer:
         if isinstance(obj, dict) and "__mx_replica_states__" in obj:
             blobs = obj["__mx_replica_states__"]
             if len(blobs) != len(self._updaters):
-                raise MXNetError(
-                    f"checkpoint {fname!r} holds {len(blobs)} replica "
-                    f"states but this trainer runs "
-                    f"{len(self._updaters)} replicas — a partial "
-                    "restore would silently leave stale or zero "
-                    "optimizer state on some replicas")
+                if not allow_resize:
+                    raise MXNetError(
+                        f"checkpoint {fname!r} holds {len(blobs)} "
+                        f"replica states but this trainer runs "
+                        f"{len(self._updaters)} replicas — a partial "
+                        "restore would silently leave stale or zero "
+                        "optimizer state on some replicas (pass "
+                        "allow_resize=True on a preemption resume)")
+                n = len(self._updaters)
+                blobs = blobs[:n] if len(blobs) >= n \
+                    else blobs + [blobs[0]] * (n - len(blobs))
             for r, (u, blob) in enumerate(zip(self._updaters, blobs)):
                 u.set_states(blob, ctx=ctxs[r] if ctxs else None)
         else:

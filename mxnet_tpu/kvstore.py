@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 
 from .base import MXNetError
+from .resilience import chaos as _chaos
+from .resilience import retry as _retry
 from .util import env
 from .ndarray.ndarray import NDArray
 from . import optimizer as opt_mod
@@ -190,7 +192,20 @@ class KVStore:
             buckets.append(cur)
         dist = self._kind.startswith("dist")
         for bucket in buckets:
-            self._bucket_allreduce(bucket, keys, vals, outs, dist)
+            # chaos probe + retry per bucket — the retry policy is
+            # ALWAYS engaged (a transient-marked infra failure in the
+            # reduce retries in production too, not only under chaos).
+            # Retrying the whole bucket is safe: each attempt re-reads
+            # the unmodified gradients into fresh device copies, and
+            # the store/out writes happen only after the reduce
+            # succeeds.
+            def _attempt(b=bucket):
+                if _chaos._ACTIVE:
+                    _chaos.check("kvstore.pushpull")
+                self._bucket_allreduce(b, keys, vals, outs, dist)
+
+            _retry.default_policy().call(_attempt,
+                                         site="kvstore.pushpull_fused")
 
     def _bucket_allreduce(self, poss: List[int], keys, vals, outs,
                           dist: bool):

@@ -61,11 +61,19 @@ def _tree_of(trainer) -> Dict[str, Any]:
 def save_sharded(path: str, trainer, force: bool = True) -> None:
     """Write trainer params + optimizer state + step counter in sharded
     (tensorstore/zarr) layout.  Every process in a multi-host job calls
-    this with the same path; each writes only its own shards."""
+    this with the same path; each writes only its own shards.
+
+    The write runs under the resilience retry policy (``OSError`` is
+    transient — blob stores flake) so an auto-checkpoint cadence
+    survives a storage blip instead of killing the step."""
+    from ..resilience import retry as _retry
+
     ocp = _checkpointer()
     path = os.path.abspath(path)
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, _tree_of(trainer), force=force)
+        _retry.default_policy().call(
+            lambda: ckptr.save(path, _tree_of(trainer), force=force),
+            site="checkpoint.sharded_save", retry_on=(OSError,))
 
 
 def load_sharded(path: str, trainer) -> None:
@@ -98,7 +106,11 @@ def load_sharded(path: str, trainer) -> None:
                 f"{sorted(set(trainer.params) - saved)}, "
                 f"unexpected in checkpoint "
                 f"{sorted(saved - set(trainer.params))}")
-        restored = ckptr.restore(path, abstract)
+        from ..resilience import retry as _retry
+
+        restored = _retry.default_policy().call(
+            lambda: ckptr.restore(path, abstract),
+            site="checkpoint.sharded_load", retry_on=(OSError,))
     trainer.params = dict(restored["params"])
     trainer.opt_state = {n: tuple(s)
                          for n, s in restored["opt_state"].items()}

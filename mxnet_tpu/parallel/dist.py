@@ -27,6 +27,8 @@ import jax
 import numpy as np
 
 from ..base import MXNetError
+from ..resilience import chaos as _chaos
+from ..resilience import retry as _retry
 from ..telemetry import instruments as _ins
 from ..telemetry import tracing as _tracing
 
@@ -137,6 +139,37 @@ def _run_with_watchdog(fn, timeout: Optional[float], what: str):
         raise error[0]
     return result[0]
 
+
+def _resilient(fn, timeout: Optional[float], what: str, site: str):
+    """One collective under the full resilience stack: each ATTEMPT is
+    a chaos-probed collective under the watchdog; transient failures
+    (injected faults, or infra errors marked ``transient``) retry under
+    the default backoff policy with ``mx_retry_total{site}`` counted; a
+    watchdog timeout — which poisons the collective sequence — is NOT
+    transient and fails immediately.
+
+    The chaos probe runs INSIDE the watchdog window, so a ``hang``
+    plan stalls the collective exactly like a dead peer would and the
+    real timeout machinery (watchdog fire, sequence poisoning) is what
+    gets exercised."""
+
+    def probed():
+        if _chaos._ACTIVE:
+            _chaos.check("dist.collective")
+        return fn()
+
+    return _retry.default_policy().call(
+        lambda: _run_with_watchdog(probed, timeout, what), site=site)
+
+
+def _guard_single(site: str) -> None:
+    """Chaos + retry coverage for the single-process short-circuits, so
+    injection tests exercise the retry machinery without a multi-host
+    job.  Free when chaos is off (one falsy check)."""
+    if _chaos._ACTIVE:
+        _retry.default_policy().call(
+            lambda: _chaos.check("dist.collective"), site=site)
+
 _INITIALIZED = False
 
 
@@ -229,12 +262,13 @@ def barrier(name: str = "mxnet_tpu_barrier",
     `timeout` (seconds, or env MXNET_KVSTORE_TIMEOUT) turns a dead-peer
     deadlock into a loud MXNetError."""
     if jax.process_count() == 1:
+        _guard_single("dist.barrier")
         return
     from jax.experimental import multihost_utils
 
-    _run_with_watchdog(
+    _resilient(
         lambda: multihost_utils.sync_global_devices(name), timeout,
-        f"barrier:{name}")
+        f"barrier:{name}", "dist.barrier")
 
 
 @_collective_span("allgather")
@@ -242,12 +276,13 @@ def allgather_np(value: np.ndarray,
                  timeout: Optional[float] = None) -> np.ndarray:
     """Gather a host numpy value from every process -> stacked [n, ...]."""
     if jax.process_count() == 1:
+        _guard_single("dist.allgather")
         return np.asarray(value)[None]
     from jax.experimental import multihost_utils
 
-    return _run_with_watchdog(
+    return _resilient(
         lambda: np.asarray(multihost_utils.process_allgather(value)),
-        timeout, "allgather")
+        timeout, "allgather", "dist.allgather")
 
 
 _DCN_MESH = None
@@ -305,7 +340,7 @@ def _allreduce_device(x, timeout: Optional[float] = None):
         jax.block_until_ready(out)
         return out.addressable_data(0)
 
-    return _run_with_watchdog(_go, timeout, "allreduce")
+    return _resilient(_go, timeout, "allreduce", "dist.allreduce")
 
 
 @_collective_span("allreduce")
@@ -321,6 +356,7 @@ def allreduce_nd(val, timeout: Optional[float] = None):
     from ..ndarray.sparse import RowSparseNDArray
 
     if jax.process_count() == 1:
+        _guard_single("dist.allreduce")
         return val
     out = jax.numpy.asarray(_allreduce_device(val._data, timeout))
     if isinstance(val, RowSparseNDArray):

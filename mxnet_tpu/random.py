@@ -37,6 +37,11 @@ class KeyProvider:
         with self._lock:
             self._key = root_key
 
+    def get_key(self):
+        """Current stream position (checkpoint/resume snapshots)."""
+        with self._lock:
+            return self._key
+
 
 class _State(threading.local):
     def __init__(self):

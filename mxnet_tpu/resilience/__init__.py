@@ -1,0 +1,49 @@
+"""mxnet_tpu.resilience — the fault layer every scaling PR assumes.
+
+A production job on preemptible TPU slices sees worker death, dead
+collective peers, flaky artifact storage, and preemption as ROUTINE
+events.  This package makes each of them (a) injectable on demand, so
+the recovery path is testable, and (b) survivable:
+
+  * :mod:`~mxnet_tpu.resilience.chaos` — deterministic fault injection
+    behind a zero-overhead flag (``with chaos.inject("serving.execute",
+    at=2): ...``), wired into the DataLoader pools, dist collectives,
+    ``pushpull_fused``, the serving repository/executor, and the
+    Trainer's preemption hook;
+  * :mod:`~mxnet_tpu.resilience.retry` — ONE jittered-exponential-
+    backoff policy (budget-capped, ``mx_retry_total{site}``-counted)
+    applied at the collective, kvstore, checkpoint-I/O, and
+    serving-execute call sites; transient errors retry, everything
+    else fails fast;
+  * :mod:`~mxnet_tpu.resilience.autockpt` + :mod:`preemption` —
+    Trainer-integrated auto-checkpoint (async, atomic-rename,
+    keep-last-K) and the ``resume()`` contract: params + per-replica
+    optimizer state + RNG streams + data position restore
+    bit-consistent with an uninterrupted run, including onto a smaller
+    replica count;
+  * :mod:`~mxnet_tpu.resilience.breaker` — the per-model circuit
+    breaker serving uses to degrade (503 one model) instead of dying.
+
+See docs/resilience.md for the fault model, retry semantics, the
+resume contract, and breaker states.
+"""
+from __future__ import annotations
+
+from . import chaos
+from . import preemption
+from .autockpt import AutoCheckpoint, latest_step_dir
+from .breaker import CircuitBreaker
+from .chaos import FaultInjected
+from .preemption import Preempted
+from .retry import RetryExhausted, RetryPolicy, default_policy
+
+__all__ = [
+    "chaos", "preemption", "FaultInjected", "Preempted",
+    "AutoCheckpoint", "latest_step_dir", "CircuitBreaker",
+    "RetryPolicy", "RetryExhausted", "default_policy",
+]
+
+# env-driven activation (MXNET_CHAOS=1 + MXNET_CHAOS_SPEC) happens at
+# first import so subprocess experiments (nightly chaos stage, bench)
+# need no code changes in the script under test
+chaos._init_from_env()

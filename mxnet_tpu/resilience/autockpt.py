@@ -1,0 +1,287 @@
+"""Preemption-safe auto-checkpoint for the Gluon Trainer.
+
+The resume contract (docs/resilience.md): a training job killed at any
+step boundary restarts and continues BIT-CONSISTENT with the run that
+was never killed.  That requires checkpointing, atomically and off the
+step path, everything the next step depends on:
+
+  * parameters        — replica 0's values (data-parallel sync training
+                        keeps replicas identical; restore broadcasts)
+  * optimizer state   — the Trainer's per-replica updater payload
+                        (PR 3's save_states format, every replica)
+  * step counter      — the auto-checkpointer's own monotone counter
+  * RNG               — every device stream of the resource manager
+                        (``kRandom``), so dropout/augmentation streams
+                        continue instead of restarting
+  * data position     — an opaque JSON dict from the training loop's
+                        ``state_provider`` (epoch/batch), replayed into
+                        ``DataLoader.resume_from``
+
+Layout: ``<dir>/step-<N>/ {params.npz, trainer.states, meta.json}``.
+Writes land in ``<dir>/.tmp-step-<N>`` and are ``os.replace``d into
+place — a crash mid-write leaves a ``.tmp-`` dir that resume ignores
+and the next save sweeps, never a half-readable checkpoint.  The last
+``keep_last`` checkpoints are retained.  Checkpoint I/O runs through
+the retry policy (site ``checkpoint.save``, ``OSError`` transient) —
+blob stores flake, and a failed save must not kill the step that
+triggered it unless retries exhaust.
+
+Saves are asynchronous by default: the step path only snapshots state
+to host numpy (cheap at every-N-steps cadence) and hands the blob to a
+writer thread.  A PREEMPTION save is synchronous — the process is
+about to die, the write must complete before the grace window closes.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import pickle
+import queue
+import shutil
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from . import preemption
+from .preemption import Preempted
+from .retry import RetryPolicy
+
+__all__ = ["AutoCheckpoint", "latest_step_dir"]
+
+_STEP_PREFIX = "step-"
+_TMP_PREFIX = ".tmp-"
+
+
+def latest_step_dir(directory: str) -> Optional[str]:
+    """Newest complete checkpoint under `directory` (None when empty).
+    ``.tmp-`` dirs — interrupted writes — are ignored."""
+    best, best_step = None, -1
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    for name in names:
+        if not name.startswith(_STEP_PREFIX):
+            continue
+        try:
+            step = int(name[len(_STEP_PREFIX):])
+        except ValueError:
+            continue
+        if step > best_step:
+            best, best_step = os.path.join(directory, name), step
+    return best
+
+
+class AutoCheckpoint:
+    """Attach to a Trainer; it calls :meth:`on_step` after every
+    optimizer step (the hook is one ``is not None`` check when no
+    checkpointer is attached).
+
+        ck = resilience.AutoCheckpoint(dir, trainer, every_n_steps=50,
+                                       state_provider=lambda: pos)
+        ...
+        pos_meta = ck.resume()        # None on a fresh start
+        for epoch ...:
+            for i, batch in enumerate(loader):
+                pos = {"epoch": epoch, "next_batch": i + 1}
+                ... trainer.step(bs)  # a checkpoint cut inside this
+                #   step records `pos` — so set the position BEFORE
+                #   step() to where training resumes once THIS batch
+                #   has committed
+
+    On a preemption signal (real SIGTERM via ``preemption.install()``,
+    or injected chaos) the NEXT step boundary saves synchronously and
+    raises :class:`Preempted`."""
+
+    def __init__(self, directory: str, trainer,
+                 every_n_steps: Optional[int] = None,
+                 keep_last: Optional[int] = None,
+                 async_save: bool = True,
+                 state_provider: Optional[Callable[[], dict]] = None,
+                 retry: Optional[RetryPolicy] = None):
+        from ..util import env
+
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._trainer = trainer
+        self._every = every_n_steps if every_n_steps is not None \
+            else env.get_int("MXNET_CKPT_EVERY")
+        self._keep = keep_last if keep_last is not None \
+            else env.get_int("MXNET_CKPT_KEEP")
+        if self._keep < 1:
+            raise MXNetError("keep_last must be >= 1")
+        self._async = bool(async_save)
+        self._state_provider = state_provider
+        self._retry = retry or RetryPolicy()
+        self.step = 0
+        self.saves = 0          # completed checkpoint writes
+        self._q: "queue.Queue" = queue.Queue()
+        self._writer: Optional[threading.Thread] = None
+        self._writer_error: List[BaseException] = []
+        trainer._auto_ckpt = self
+
+    # ---- the step hook --------------------------------------------------
+
+    def on_step(self, trainer) -> None:
+        """Called by Trainer.step after the update.  Preemption wins
+        over cadence: save NOW (sync) and raise Preempted."""
+        self.step += 1
+        if preemption.triggered():
+            path = self.save(sync=True)
+            raise Preempted(
+                f"preempted ({preemption.reason()}); checkpoint for "
+                f"step {self.step} saved to {path}",
+                checkpoint_dir=path)
+        if self._every and self.step % self._every == 0:
+            self.save(sync=not self._async)
+
+    # ---- save path ------------------------------------------------------
+
+    def save(self, sync: bool = False) -> str:
+        """Snapshot now; write now (sync) or on the writer thread.
+        Returns the FINAL step-dir path (the one resume will find)."""
+        self._raise_writer_error()
+        snap = self._snapshot()
+        final = os.path.join(self._dir, f"{_STEP_PREFIX}{snap['step']:08d}")
+        if sync:
+            self.flush()
+            self._write(snap)
+        else:
+            self._ensure_writer()
+            self._q.put(snap)
+        return final
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until every queued async save is on disk."""
+        if self._writer is not None:
+            self._q.join()
+        self._raise_writer_error()
+
+    def _raise_writer_error(self) -> None:
+        if self._writer_error:
+            e = self._writer_error[0]
+            raise MXNetError(
+                f"async checkpoint writer failed: {e}") from e
+
+    def _ensure_writer(self) -> None:
+        if self._writer is None or not self._writer.is_alive():
+            self._writer = threading.Thread(
+                target=self._writer_loop, daemon=True,
+                name="mx-auto-checkpoint")
+            self._writer.start()
+
+    def _writer_loop(self) -> None:
+        while True:
+            snap = self._q.get()
+            try:
+                self._write(snap)
+            except BaseException as e:  # surfaced on the next step
+                self._writer_error.append(e)
+            finally:
+                self._q.task_done()
+
+    def _snapshot(self) -> Dict:
+        """Host-side copy of everything resume needs — the only work on
+        the step path.  Parameters come off replica 0 (sync data-
+        parallel replicas are identical; docs/resilience.md)."""
+        from ..resource import resource_manager
+
+        tr = self._trainer
+        # update_on_kvstore trainers are rejected by _states_payload()
+        # below — optimizer state lives server-side there
+        params = {}
+        for p in tr._params:
+            if p._data is None:
+                continue
+            params[p.name] = np.asarray(p.list_data()[0].asnumpy())
+        return {
+            "step": self.step,
+            "params": params,
+            "states": tr._states_payload(),
+            "rng": resource_manager().rng_state(),
+            "position": self._state_provider()
+            if self._state_provider is not None else None,
+        }
+
+    def _write(self, snap: Dict) -> None:
+        self._retry.call(lambda: self._write_once(snap),
+                         site="checkpoint.save", retry_on=(OSError,))
+
+    def _write_once(self, snap: Dict) -> None:
+        name = f"{_STEP_PREFIX}{snap['step']:08d}"
+        tmp = os.path.join(self._dir, _TMP_PREFIX + name)
+        final = os.path.join(self._dir, name)
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        buf = io.BytesIO()
+        np.savez(buf, **snap["params"])
+        with open(os.path.join(tmp, "params.npz"), "wb") as f:
+            f.write(buf.getvalue())
+        with open(os.path.join(tmp, "trainer.states"), "wb") as f:
+            f.write(snap["states"])
+        meta = {"step": snap["step"], "rng": snap["rng"],
+                "position": snap["position"],
+                "saved_unix": time.time()}
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=1)
+        if os.path.exists(final):
+            shutil.rmtree(final)  # re-save of the same step
+        os.replace(tmp, final)
+        self.saves += 1
+        self._prune()
+
+    def _prune(self) -> None:
+        steps = []
+        for name in os.listdir(self._dir):
+            if name.startswith(_TMP_PREFIX):
+                continue  # an in-flight or crashed write; not ours
+            if name.startswith(_STEP_PREFIX):
+                try:
+                    steps.append((int(name[len(_STEP_PREFIX):]), name))
+                except ValueError:
+                    continue
+        steps.sort()
+        for _, name in steps[:-self._keep]:
+            shutil.rmtree(os.path.join(self._dir, name),
+                          ignore_errors=True)
+
+    # ---- resume path ----------------------------------------------------
+
+    def resume(self) -> Optional[dict]:
+        """Restore the newest checkpoint into the attached trainer;
+        returns its meta dict ({"step", "position", ...}) or None when
+        the directory has no checkpoint (fresh start).  The restore
+        re-shards onto the trainer's CURRENT replica layout — resuming
+        onto fewer replicas than saved is first-class (the preempted
+        slice may come back smaller)."""
+        from ..ndarray.ndarray import array as nd_array
+        from ..resource import resource_manager
+
+        path = latest_step_dir(self._dir)
+        if path is None:
+            return None
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        tr = self._trainer
+        by_name = {p.name: p for p in tr._params}
+        with np.load(os.path.join(path, "params.npz")) as blob:
+            saved = set(blob.files)
+            have = {n for n, p in by_name.items() if p._data is not None}
+            if saved != have:
+                raise MXNetError(
+                    f"checkpoint {path!r} parameter set does not match "
+                    f"the model: missing {sorted(have - saved)}, "
+                    f"unexpected {sorted(saved - have)}")
+            for n in blob.files:
+                by_name[n].set_data(nd_array(blob[n]))
+        tr.load_states(os.path.join(path, "trainer.states"),
+                       allow_resize=True)
+        resource_manager().set_rng_state(meta["rng"])
+        self.step = int(meta["step"])
+        preemption.clear()
+        return meta

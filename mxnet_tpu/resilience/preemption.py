@@ -1,0 +1,86 @@
+"""Preemption signal: one process-wide flag between "the machine is
+going away" and "the training loop noticed".
+
+TPU slices get preempted with a grace window (SIGTERM, then the kill).
+The contract here is the smallest one that makes resume safe: a flag
+that is SET asynchronously (by a real signal handler installed via
+:func:`install`, or by the chaos harness's ``trainer.preempt`` action)
+and OBSERVED synchronously at a step boundary by the Trainer's
+auto-checkpoint hook, which saves and raises :class:`Preempted`.
+Nothing is interrupted mid-step — a checkpoint is only ever cut at a
+step boundary, which is what makes the resumed trajectory bit-equal to
+an uninterrupted run.
+"""
+from __future__ import annotations
+
+import signal as _signal
+import threading
+from typing import Optional
+
+from ..base import MXNetError
+
+__all__ = ["Preempted", "install", "trigger", "triggered", "reason",
+           "clear"]
+
+
+class Preempted(MXNetError):
+    """Raised at the step boundary after the preemption checkpoint is
+    on disk.  ``checkpoint_dir`` names the saved step directory (None
+    when no auto-checkpointer was attached)."""
+
+    def __init__(self, msg: str, checkpoint_dir: Optional[str] = None):
+        super().__init__(msg)
+        self.checkpoint_dir = checkpoint_dir
+
+
+_FLAG = threading.Event()
+# RLock, not Lock: a signal handler runs ON the main thread between
+# bytecodes — if it fires while clear() holds the lock, trigger() must
+# re-enter rather than deadlock against its own thread
+_LOCK = threading.RLock()
+_REASON = [""]  # last trigger reason; writes hold _LOCK
+_INSTALLED = [False]
+
+
+def install(signals=(getattr(_signal, "SIGTERM", None),)) -> None:
+    """Install signal handlers that set the preemption flag (idempotent;
+    main thread only — CPython restricts signal.signal to it).  The
+    previous handler is chained so a supervisor's own teardown still
+    runs."""
+    with _LOCK:
+        if _INSTALLED[0]:
+            return
+        _INSTALLED[0] = True
+    for sig in signals:
+        if sig is None:
+            continue
+        prev = _signal.getsignal(sig)
+
+        def _handler(signum, frame, _prev=prev):
+            trigger(reason=f"signal {signum}")
+            if callable(_prev):
+                _prev(signum, frame)
+
+        _signal.signal(sig, _handler)
+
+
+def trigger(reason: str = "simulated") -> None:
+    """Set the flag (signal handler / chaos / tests)."""
+    with _LOCK:
+        _REASON[0] = reason
+    _FLAG.set()
+
+
+def triggered() -> bool:
+    return _FLAG.is_set()
+
+
+def reason() -> str:
+    return _REASON[0]
+
+
+def clear() -> None:
+    """Reset after a handled preemption (resume() calls this)."""
+    with _LOCK:
+        _REASON[0] = ""
+    _FLAG.clear()

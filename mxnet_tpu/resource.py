@@ -102,6 +102,42 @@ class ResourceManager:
         base = self.random(ctx).next_key()
         return jax.random.split(base, int(n))
 
+    def rng_state(self) -> dict:
+        """JSON-able snapshot of every device stream's position plus
+        the root seed — the checkpoint/resume contract for kRandom
+        (resilience.AutoCheckpoint): a resumed job's draws continue the
+        interrupted stream instead of restarting it."""
+        import jax
+
+        with self._lock:
+            return {
+                "root_seed": self._root_seed,
+                "streams": {
+                    f"{k[0]}:{k[1]}": np.asarray(
+                        jax.device_get(p.get_key())).tolist()
+                    for k, p in self._rand.items()},
+            }
+
+    def set_rng_state(self, state: dict) -> None:
+        """Restore a :meth:`rng_state` snapshot.  Existing providers
+        reset IN PLACE (handed-out references follow); streams for
+        devices the snapshot has never seen derive from the restored
+        root seed as usual."""
+        import jax.numpy as jnp
+
+        from .random import KeyProvider
+
+        with self._lock:
+            self._root_seed = int(state["root_seed"])
+            for name, raw in state.get("streams", {}).items():
+                dev_type, _, dev_id = name.rpartition(":")
+                key = (dev_type, int(dev_id))
+                arr = jnp.asarray(np.asarray(raw, dtype=np.uint32))
+                if key in self._rand:
+                    self._rand[key].reset(arr)
+                else:
+                    self._rand[key] = KeyProvider(arr)
+
     # -- kTempSpace ------------------------------------------------------
     def temp_space(self, nbytes: int, ctx: Context = None) -> np.ndarray:
         """Host staging scratch, reused across requests on the same

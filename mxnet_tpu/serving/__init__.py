@@ -50,8 +50,9 @@ from ..base import MXNetError
 
 __all__ = [
     "ServingError", "ServerOverloaded", "DeadlineExceeded",
-    "ServerClosed", "ModelNotFound", "ServingConfig", "ModelRepository",
-    "DynamicBatcher", "InferenceServer", "serve_http",
+    "ServerClosed", "ModelNotFound", "ModelUnavailable",
+    "ServingConfig", "ModelRepository", "DynamicBatcher",
+    "InferenceServer", "serve_http",
 ]
 
 
@@ -87,6 +88,15 @@ class ModelNotFound(ServingError):
     status = 404
 
 
+class ModelUnavailable(ServingError):
+    """This model's circuit breaker is OPEN: its executor failed
+    `breaker_threshold` consecutive times, so requests for it answer
+    503 until a half-open probe succeeds.  Other models — and the
+    process, and /healthz — are unaffected: degrade, don't die."""
+
+    status = 503
+
+
 def default_bucket_ladder(max_batch_size: int) -> List[int]:
     """Powers of two up to max_batch_size (always included): each
     distinct padded batch size is one compiled executable, so the
@@ -115,6 +125,15 @@ class ServingConfig:
                         server; beyond it submits fail ServerOverloaded.
     default_timeout_ms — per-request deadline when the caller gives
                         none; None = no deadline.
+    drain_timeout_s   — hard deadline for shutdown(drain=True): past it
+                        still-queued requests fail with ServerClosed
+                        instead of the shutdown hanging on a wedged
+                        batch.  None = the MXNET_DRAIN_TIMEOUT_MS knob.
+    breaker_threshold / breaker_cooldown_ms — per-model circuit-breaker
+                        overrides (None = the MXNET_BREAKER_* knobs).
+    execute_retries   — max attempts for a TRANSIENT executor failure
+                        within a batch launch (deadline-aware); None =
+                        the MXNET_RETRY_MAX_ATTEMPTS knob.
     """
 
     max_batch_size: int = 32
@@ -122,6 +141,10 @@ class ServingConfig:
     buckets: Optional[List[int]] = None
     max_queue: int = 256
     default_timeout_ms: Optional[float] = None
+    drain_timeout_s: Optional[float] = None
+    breaker_threshold: Optional[int] = None
+    breaker_cooldown_ms: Optional[float] = None
+    execute_retries: Optional[int] = None
 
     def ladder(self) -> List[int]:
         if self.buckets:

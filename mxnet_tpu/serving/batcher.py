@@ -33,6 +33,7 @@ from concurrent.futures import Future
 from typing import Dict, List, Optional
 
 from .. import profiler as _prof
+from ..resilience import retry as _retry
 from ..telemetry import instruments as _ins
 from ..telemetry import tracing as _tracing
 from . import (DeadlineExceeded, ServerClosed, ServingConfig,
@@ -73,6 +74,12 @@ class DynamicBatcher:
         self._coalesce = entry.coalescable()
         self._fixed = entry.fixed_batch()
         self._specs = entry.input_specs()
+        # transient executor failures retry (deadline-aware) under this
+        # policy; ServingConfig.execute_retries overrides the env knob
+        self._retry_policy = _retry.RetryPolicy(
+            max_attempts=self._config.execute_retries) \
+            if self._config.execute_retries is not None \
+            else _retry.default_policy()
         self._cv = threading.Condition()
         # group key -> FIFO of requests (OrderedDict: oldest group first)
         self._groups: "OrderedDict[tuple, deque]" = OrderedDict()
@@ -324,7 +331,7 @@ class DynamicBatcher:
                 phase = _tracing.Span("execute", "serving", trace_id=tr,
                                       parent_id=par,
                                       args={"bucket": bucket})
-            leaves = entry.execute(bucket, xs, seed=reqs[0].seed)
+            leaves = self._execute_resilient(bucket, xs, reqs)
             m.bump("batches")
             m.bump("batched_rows", rows)
             m.bump("padded_rows", bucket)
@@ -355,6 +362,32 @@ class DynamicBatcher:
             if phase is not None:
                 phase.finish()
 
+    def _execute_resilient(self, bucket: int, xs, reqs: List[_Request]):
+        """The executor launch under the resilience stack: every
+        attempt's outcome feeds the entry's circuit breaker (that's how
+        consecutive failures trip it), and a TRANSIENT failure retries
+        with backoff while the batch's earliest request deadline allows
+        — a blip must cost one retry delay, not fail a whole coalesced
+        batch.  Non-transient errors (shape bugs, a poisoned artifact)
+        fail immediately; the breaker counts them all the same."""
+        entry = self._entry
+
+        def attempt():
+            leaves = entry.execute(bucket, xs, seed=reqs[0].seed)
+            entry.breaker.record_success()
+            return leaves
+
+        policy = self._retry_policy
+        deadline = min((r.deadline for r in reqs
+                        if r.deadline is not None), default=None)
+        try:
+            return policy.call(
+                attempt, site="serving.execute", deadline=deadline,
+                on_failure=lambda e: entry.breaker.record_failure())
+        except _retry.RetryExhausted:
+            entry.metrics.bump("retries_exhausted")
+            raise
+
     # ---- lifecycle ----------------------------------------------------
 
     def pending(self) -> int:
@@ -365,7 +398,13 @@ class DynamicBatcher:
               timeout: Optional[float] = None) -> None:
         """Stop admission.  drain=True completes everything already
         queued (in-flight batches always finish); drain=False fails
-        queued requests with ServerClosed."""
+        queued requests with ServerClosed.
+
+        `timeout` is a HARD drain deadline: if the batcher thread is
+        still busy past it (a wedged executor), every request still
+        QUEUED is failed with ServerClosed and close() returns — the
+        in-flight batch keeps its daemon thread, but shutdown never
+        hangs on it."""
         with self._cv:
             if self._closing:
                 self._cv.notify_all()
@@ -376,11 +415,29 @@ class DynamicBatcher:
                     dropped.extend(q)
                 self._groups.clear()
             self._cv.notify_all()
-        for r in dropped:
+        self._fail_requests(dropped, "server shut down before this "
+                            "request ran")
+        self._thread.join(timeout)
+        if self._thread.is_alive() and drain:
+            # drain deadline blown: a batch is wedged in the executor.
+            # Everything still queued can never run before the process
+            # exits — fail it loudly now instead of hanging forever.
+            with self._cv:
+                stuck: List[_Request] = []
+                for q in self._groups.values():
+                    stuck.extend(q)
+                self._groups.clear()
+                self._cv.notify_all()
+            self._entry.metrics.bump("drain_timeouts")
+            self._fail_requests(
+                stuck, f"drain deadline ({timeout:.1f}s) expired with "
+                f"a batch still executing; this queued request was "
+                f"abandoned")
+
+    def _fail_requests(self, reqs: List[_Request], why: str) -> None:
+        for r in reqs:
             try:
                 r.future.set_exception(ServerClosed(
-                    f"model {self._entry.name!r}: server shut down "
-                    f"before this request ran"))
+                    f"model {self._entry.name!r}: {why}"))
             except Exception:
                 pass  # already done or concurrently cancelled
-        self._thread.join(timeout)

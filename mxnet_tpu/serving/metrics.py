@@ -51,6 +51,10 @@ class ModelMetrics:
         "requests", "completed", "failed", "rejected",
         "deadline_expired", "batches", "batched_rows", "padded_rows",
         "cache_hits", "cache_misses", "queue_depth",
+        # resilience: transient-executor retries that exhausted their
+        # budget, 503s shed by an open circuit breaker, and drain
+        # deadlines that abandoned queued work at shutdown
+        "retries_exhausted", "breaker_rejected", "drain_timeouts",
     )
     # queue_depth is the one point-in-time value in the tuple — it maps
     # to a gauge family; everything else is a monotone counter

@@ -22,6 +22,8 @@ import time
 from typing import Dict, List, Optional
 
 from ..analysis import sanitizer as _mxsan
+from ..resilience import chaos as _chaos
+from ..resilience.breaker import CircuitBreaker
 from ..telemetry import instruments as _ins
 from ..telemetry import tracing as _tracing
 from . import ModelNotFound, ServingError
@@ -52,6 +54,9 @@ class _ModelEntry:
                           f"#{next(_entry_seq)}")
         self.cache_hits = 0
         self.cache_misses = 0
+        # degrade-don't-die: consecutive executor failures open this
+        # and the server 503s THIS model while the process serves on
+        self.breaker = CircuitBreaker(name, version)
 
     # ---- lazy artifact ------------------------------------------------
 
@@ -61,6 +66,11 @@ class _ModelEntry:
         on first touch — a repository of many models only pays for the
         ones traffic actually hits."""
         if self._served is None:
+            if _chaos._ACTIVE:
+                # artifact storage flaking (missing blob, torn read):
+                # the error must surface to THIS request and leave the
+                # entry importable for the next one
+                _chaos.check("serving.artifact")
             with self._lock:
                 if self._served is None:
                     from ..contrib import deploy
@@ -194,6 +204,8 @@ class _ModelEntry:
         returns the FLAT output leaves (tree-flatten order)."""
         import jax
 
+        if _chaos._ACTIVE:
+            _chaos.check("serving.execute")
         fn = self.executable(bucket)
         key = jax.random.PRNGKey(seed)
         outs = fn(self.served.param_values, key, *xs)

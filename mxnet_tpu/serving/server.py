@@ -26,7 +26,8 @@ from typing import Dict, Optional
 
 from .. import profiler as _prof
 from ..telemetry import tracing as _tracing
-from . import ServerClosed, ServerOverloaded, ServingConfig
+from . import (ModelUnavailable, ServerClosed, ServerOverloaded,
+               ServingConfig)
 from .batcher import DynamicBatcher
 from .repository import ModelRepository
 
@@ -43,6 +44,9 @@ class InferenceServer:
         self._pending = 0
         self._pending_per: Dict[tuple, int] = {}
         self._closed = False
+        # entries whose breaker already took this config's overrides
+        # (configure once, not per request on the hot path)
+        self._breaker_configured: set = set()
 
     # ---- request path -------------------------------------------------
 
@@ -60,16 +64,48 @@ class InferenceServer:
                 f"max_queue {self.config.max_queue}); retry with "
                 f"backoff")
 
+    def _breaker_gate(self, entry, consume: bool) -> None:
+        """Raise ModelUnavailable (503 this model, nothing else) while
+        the entry's circuit breaker refuses traffic.  `consume=True`
+        (the submit path) takes the half-open probe slot; the advisory
+        front-end check must not.  Config overrides land lazily — the
+        breaker exists before any batcher does."""
+        cfg = self.config
+        if cfg.breaker_threshold is not None \
+                or cfg.breaker_cooldown_ms is not None:
+            key = (entry.name, entry.version)
+            with self._lock:
+                needs_cfg = key not in self._breaker_configured
+                if needs_cfg:
+                    self._breaker_configured.add(key)
+            if needs_cfg:
+                entry.breaker.configure(
+                    threshold=cfg.breaker_threshold,
+                    cooldown_s=None if cfg.breaker_cooldown_ms is None
+                    else cfg.breaker_cooldown_ms / 1e3)
+        ok = entry.breaker.allow() if consume \
+            else entry.breaker.would_allow()
+        if not ok:
+            entry.metrics.bump("breaker_rejected")
+            raise ModelUnavailable(
+                f"model {entry.name!r} v{entry.version} is "
+                f"unavailable: circuit breaker is "
+                f"{entry.breaker.state()} after repeated executor "
+                f"failures; retry after the cooldown (the server "
+                f"itself is healthy)")
+
     def check_admission(self, entry=None) -> None:
         """Cheap advisory fail-fast for front ends: raises
-        ServerClosed/ServerOverloaded exactly as submit() would,
-        WITHOUT importing the artifact.  Call it before any
-        per-request work that needs the model (input specs, dtype
+        ServerClosed/ServerOverloaded/ModelUnavailable exactly as
+        submit() would, WITHOUT importing the artifact.  Call it before
+        any per-request work that needs the model (input specs, dtype
         casts) so load-shedding stays cheap for cold models; submit()
         still re-checks authoritatively."""
         with self._lock:
             self._admit_locked(entry.metrics if entry is not None
                                else None)
+        if entry is not None:
+            self._breaker_gate(entry, consume=False)
 
     def submit(self, model: str, inputs, version: Optional[int] = None,
                seed: int = 0,
@@ -80,6 +116,9 @@ class InferenceServer:
         entry = self.repository.get(model, version)
         m = entry.metrics
         key = (entry.name, entry.version)
+        # breaker first: an OPEN model's 503 must not consume an
+        # admission slot, and a half-open probe is granted HERE
+        self._breaker_gate(entry, consume=True)
         # a fresh trace root per request: every span this request
         # produces — here, on the batcher thread, in the executor —
         # carries ONE trace id (exposed on the returned Future)
@@ -101,6 +140,7 @@ class InferenceServer:
                 m.bump("requests")
                 m.gauge("queue_depth", self._pending_per[key])
         except BaseException:
+            entry.breaker.abandon_probe()  # never reached the executor
             if adm is not None:
                 adm.finish()
             raise
@@ -139,6 +179,7 @@ class InferenceServer:
                 if adm is not None else None)
         except BaseException:
             _release()  # admitted but never enqueued: free the slot
+            entry.breaker.abandon_probe()
             raise
         finally:
             if adm is not None:
@@ -198,12 +239,27 @@ class InferenceServer:
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None) -> None:
         """Stop admission now; drain=True completes accepted work
-        (graceful), drain=False fails it with ServerClosed."""
+        (graceful), drain=False fails it with ServerClosed.
+
+        The drain has a HARD deadline: `timeout`, else
+        config.drain_timeout_s, else the MXNET_DRAIN_TIMEOUT_MS knob.
+        One wedged batch (executor hang, driver stall) must not hang
+        shutdown forever — past the deadline every still-queued request
+        fails with ServerClosed and shutdown returns.  The deadline is
+        shared across batchers, not per batcher."""
+        from ..util import env
+
+        if timeout is None:
+            timeout = self.config.drain_timeout_s
+        if timeout is None:
+            timeout = env.get_float("MXNET_DRAIN_TIMEOUT_MS") / 1e3
+        deadline = time.monotonic() + timeout
         with self._lock:
             self._closed = True
             batchers = list(self._batchers.values())
         for b in batchers:
-            b.close(drain=drain, timeout=timeout)
+            b.close(drain=drain,
+                    timeout=max(deadline - time.monotonic(), 0.0))
 
     def __enter__(self):
         return self

@@ -24,6 +24,8 @@ __all__ = [
     "fused_step_total", "fused_compile_seconds",
     "data_wait_seconds", "data_wait_last_seconds",
     "collective_seconds",
+    "retry_total", "fault_injected_total",
+    "breaker_state", "breaker_open_total",
     "serving_counter", "serving_queue_depth", "serving_occupancy",
     "serving_request_latency", "serving_compile_total",
     "serving_compile_seconds",
@@ -119,6 +121,37 @@ def collective_seconds(op: str):
     return _child("mx_collective_seconds", "histogram",
                   "Host-blocking collective wall seconds.",
                   ("op",), (op,))
+
+
+# ---- resilience -------------------------------------------------------
+
+def retry_total(site: str):
+    return _child("mx_retry_total", "counter",
+                  "Transient-error retries by call site (collective, "
+                  "kvstore, checkpoint I/O, serving execute). Sustained "
+                  "growth means an infra fault is being papered over.",
+                  ("site",), (site,))
+
+
+def fault_injected_total(kind: str):
+    return _child("mx_fault_injected_total", "counter",
+                  "Faults injected by the chaos harness, by kind. "
+                  "Nonzero outside a chaos experiment means MXNET_CHAOS "
+                  "leaked into production.",
+                  ("kind",), (kind,))
+
+
+def breaker_state(model: str, version):
+    return _child("mx_breaker_state", "gauge",
+                  "Serving circuit-breaker state per model "
+                  "(0 closed / 1 half-open / 2 open).",
+                  ("model", "version"), (model, str(version)))
+
+
+def breaker_open_total(model: str, version):
+    return _child("mx_breaker_open_total", "counter",
+                  "Circuit-breaker trips (CLOSED/HALF-OPEN -> OPEN).",
+                  ("model", "version"), (model, str(version)))
 
 
 # ---- analysis ---------------------------------------------------------

@@ -196,6 +196,48 @@ declare("MXNET_USE_PALLAS", bool, True,
         "Conv+BN). 0 selects the XLA fallbacks with identical "
         "semantics.")
 
+# -- resilience -------------------------------------------------------------
+declare("MXNET_BREAKER_COOLDOWN_MS", float, 1000.0,
+        "Serving circuit breaker: milliseconds an OPEN breaker waits "
+        "before letting one half-open probe request through.")
+declare("MXNET_BREAKER_THRESHOLD", int, 5,
+        "Serving circuit breaker: consecutive executor failures that "
+        "open the breaker (that model answers 503 until a probe "
+        "succeeds; the process never dies).")
+declare("MXNET_CHAOS", bool, False,
+        "Master switch for the fault-injection harness "
+        "(resilience.chaos). Off = every injection site is a single "
+        "falsy flag check with zero behavior change.")
+declare("MXNET_CHAOS_SEED", int, 0,
+        "Seed for probabilistic chaos plans (kind@pF in "
+        "MXNET_CHAOS_SPEC) — schedules replay deterministically.")
+declare("MXNET_CHAOS_SPEC", str, "",
+        "Comma-separated chaos plans installed at import when "
+        "MXNET_CHAOS=1: 'kind@N' (fail Nth call), 'kind@xN' (next N), "
+        "'kind@pF' (probability F), optional ':action' "
+        "(error/die/hang/preempt). See docs/resilience.md.")
+declare("MXNET_CKPT_EVERY", int, 0,
+        "Auto-checkpoint cadence in optimizer steps (resilience."
+        "AutoCheckpoint default). 0 = only preemption-triggered saves.")
+declare("MXNET_CKPT_KEEP", int, 3,
+        "Auto-checkpoint retention: keep the last K step directories, "
+        "prune older ones after each successful save.")
+declare("MXNET_DRAIN_TIMEOUT_MS", float, 30000.0,
+        "Hard deadline for InferenceServer.shutdown(drain=True): past "
+        "it, still-queued requests fail with ServerClosed instead of "
+        "the shutdown hanging forever on a wedged batch.")
+declare("MXNET_RETRY_BASE_MS", float, 50.0,
+        "Retry policy: first backoff delay in milliseconds (doubles "
+        "per attempt, jittered ±50%, capped at MXNET_RETRY_MAX_MS).")
+declare("MXNET_RETRY_BUDGET_MS", float, 10000.0,
+        "Retry policy: hard wall-clock budget across all attempts of "
+        "one call, including backoff sleeps.")
+declare("MXNET_RETRY_MAX_ATTEMPTS", int, 3,
+        "Retry policy: total attempts per retryable call site "
+        "(1 = no retry). Only transient errors retry.")
+declare("MXNET_RETRY_MAX_MS", float, 2000.0,
+        "Retry policy: backoff delay ceiling in milliseconds.")
+
 # -- observability ----------------------------------------------------------
 declare("MXNET_PROFILER_AUTOSTART", bool, False,
         "Start the chrome-trace profiler at import (ref: "

@@ -393,6 +393,93 @@ class TestMX006:
 
 
 # ---------------------------------------------------------------------------
+# MX007 — swallowed exception in a hot path
+# ---------------------------------------------------------------------------
+
+class TestMX007:
+    def test_flags_bare_except_pass_in_hot_class(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            class Trainer:
+                def step(self, batch_size):
+                    try:
+                        self._update()
+                    except:
+                        pass
+            """, enable=["MX007"])
+        assert rules_hit(vs) == ["MX007"]
+        assert "bare except:" in vs[0].message
+
+    def test_flags_except_exception_continue(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            class KVStore:
+                def push(self, keys):
+                    for k in keys:
+                        try:
+                            self._send(k)
+                        except Exception:
+                            continue
+            """, enable=["MX007"])
+        assert rules_hit(vs) == ["MX007"]
+
+    def test_flags_broad_tuple_and_named_binding(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            class DynamicBatcher:
+                def _loop(self):
+                    try:
+                        self._run()
+                    except (ValueError, Exception) as e:
+                        pass
+            """, enable=["MX007"])
+        assert rules_hit(vs) == ["MX007"]
+
+    def test_clean_narrow_catch_is_eafp(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            class Trainer:
+                def step(self):
+                    try:
+                        del self._cache[0]
+                    except KeyError:
+                        pass
+            """, enable=["MX007"])
+        assert vs == []
+
+    def test_clean_handler_with_a_body(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            class InferenceServer:
+                def submit(self, x):
+                    try:
+                        return self._go(x)
+                    except Exception as e:
+                        self._metrics.bump("failed")
+                        raise
+            """, enable=["MX007"])
+        assert vs == []
+
+    def test_cold_path_code_is_out_of_scope(self, tmp_path):
+        # a fixture file with no hot class and a non-hot-path name:
+        # broad swallows elsewhere are some other linter's business
+        vs = lint_source(tmp_path, """
+            def viz_helper(fig):
+                try:
+                    fig.close()
+                except Exception:
+                    pass
+            """, enable=["MX007"])
+        assert vs == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            class ModelRepository:
+                def get(self, name):
+                    try:
+                        return self._m[name]
+                    except Exception:  # mxlint: disable=MX007
+                        pass
+            """, enable=["MX007"])
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
 # pragmas, enable/disable, baseline ratchet
 # ---------------------------------------------------------------------------
 

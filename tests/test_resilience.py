@@ -1,0 +1,751 @@
+"""mxnet_tpu.resilience — the chaos suite (ISSUE 6 acceptance).
+
+Every test here proves ONE contract: a specific injected fault produces
+exactly the designed recovery, and no injection produces zero behavior
+change.  The recoveries under test:
+
+  * transient collective/kvstore fault  -> retried within the backoff
+    budget, training result bit-equal to the uninjected twin; a
+    persistent fault hard-errors with every attempt in the message;
+  * preemption mid-epoch                -> checkpoint at the step
+    boundary, ``Trainer``+``AutoCheckpoint.resume()`` continues
+    BIT-CONSISTENT with an uninterrupted run (params, optimizer state,
+    RNG, data position), including onto a smaller replica count;
+  * DataLoader worker death             -> a clear ``WorkerDied`` with
+    the worker's identity, never a hang or a silent short epoch;
+  * serving executor failures           -> transient ones retry inside
+    the batch deadline, persistent ones open the per-model circuit
+    breaker (503 that model, process and /healthz stay up), a
+    half-open probe closes it again;
+  * wedged batch at shutdown            -> the drain deadline fails
+    queued work loudly instead of hanging forever.
+"""
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, resilience
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+from mxnet_tpu.gluon.data.dataloader import WorkerDied
+from mxnet_tpu.resilience import chaos, preemption
+from mxnet_tpu.resilience.breaker import CircuitBreaker
+from mxnet_tpu.resilience.retry import (RetryExhausted, RetryPolicy,
+                                        is_transient)
+from mxnet_tpu.telemetry import instruments as _ins
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    chaos.reset_stats()
+    preemption.clear()
+    yield
+    preemption.clear()
+
+
+# ---------------------------------------------------------------------------
+# training helpers: tiny deterministic 2-replica data-parallel job
+# ---------------------------------------------------------------------------
+
+_CTXS2 = [mx.cpu(0), mx.cpu(1)]
+
+
+def _make_net(prefix="rnet_", ctxs=_CTXS2, seed=3):
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    net = nn.Dense(4, in_units=6, prefix=prefix)
+    net.initialize(ctx=list(ctxs))
+    return net
+
+
+def _batches(n=6, rows=8):
+    rng = np.random.RandomState(0)
+    return [(rng.rand(rows, 6).astype("f4"),
+             rng.rand(rows, 4).astype("f4")) for _ in range(n)]
+
+
+def _one_step(net, trainer, xb, yb, ctxs):
+    """One data-parallel step: each replica takes its half-batch."""
+    half = len(xb) // len(ctxs) if len(ctxs) > 1 else len(xb)
+    losses = []
+    with autograd.record():
+        for r, c in enumerate(ctxs):
+            xs = nd.array(xb[r * half:(r + 1) * half] if len(ctxs) > 1
+                          else xb, ctx=c)
+            ys = nd.array(yb[r * half:(r + 1) * half] if len(ctxs) > 1
+                          else yb, ctx=c)
+            losses.append(((net(xs) - ys) ** 2).sum())
+    for l in losses:
+        l.backward()
+    trainer.step(len(xb))
+
+
+def _params_np(net):
+    return {p.name: p.list_data()[0].asnumpy().copy()
+            for p in net.collect_params().values()}
+
+
+# ---------------------------------------------------------------------------
+# chaos harness basics: disabled fast path, scoping, env-spec grammar
+# ---------------------------------------------------------------------------
+
+class TestChaosHarness:
+    def test_disabled_path_is_inert_and_training_unchanged(self):
+        assert chaos._ACTIVE is False
+        data = _batches(2)
+        net_a = _make_net("inert_a_")
+        tr_a = mx.gluon.Trainer(net_a.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        for xb, yb in data:
+            _one_step(net_a, tr_a, xb, yb, _CTXS2)
+        # chaos was never consulted: no site counters exist at all
+        assert chaos.stats() == {}
+
+        # entering AND exiting a scope restores the inert state, and a
+        # run with a no-op plan (at=999) is bit-identical
+        net_b = _make_net("inert_b_")
+        tr_b = mx.gluon.Trainer(net_b.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        with chaos.inject("kvstore.pushpull", at=999):
+            assert chaos._ACTIVE is True
+            for xb, yb in data:
+                _one_step(net_b, tr_b, xb, yb, _CTXS2)
+        assert chaos._ACTIVE is False
+        a, b = _params_np(net_a), _params_np(net_b)
+        for (na, va), (nb, vb) in zip(sorted(a.items()),
+                                      sorted(b.items())):
+            np.testing.assert_array_equal(va, vb)
+
+    def test_injection_scope_exits_on_exception(self):
+        with pytest.raises(chaos.FaultInjected):
+            with chaos.inject("dist.collective", times=99):
+                chaos.check("dist.collective")
+        assert chaos._ACTIVE is False
+
+    def test_env_spec_grammar(self):
+        plans = chaos._parse_spec(
+            "trainer.preempt@4, serving.execute@x3,"
+            "dist.collective@p0.5:hang, dataloader.worker@2", seed=7)
+        assert [p.kind for p in plans] == [
+            "trainer.preempt", "serving.execute", "dist.collective",
+            "dataloader.worker"]
+        assert plans[0].action == "preempt" and plans[0].at == 4
+        assert plans[1].action == "error" and plans[1].times == 3
+        assert plans[2].action == "hang" and plans[2].p == 0.5
+        assert plans[3].action == "die" and plans[3].at == 2
+        with pytest.raises(MXNetError):
+            chaos._parse_spec("no-selector-here", seed=0)
+
+    def test_resilience_errors_survive_pickling(self):
+        """Process-pool workers deliver exceptions through a pickle
+        pipe; a custom-args __init__ without a __reduce__ kills the
+        parent's result handler with TypeError instead — the consumer
+        would hang to the full timeout rather than see the fault."""
+        import pickle
+
+        e = pickle.loads(pickle.dumps(chaos.FaultInjected("k", 3)))
+        assert e.kind == "k" and e.nth == 3 and e.transient
+        r = pickle.loads(pickle.dumps(RetryExhausted(
+            "s", [chaos.FaultInjected("k", 1), ValueError("x")])))
+        assert r.site == "s" and r.attempts == 2
+        assert "attempt 2" in str(r)
+
+    def test_fault_counter_telemetry(self):
+        before = _ins.fault_injected_total("dist.collective").value
+        with chaos.inject("dist.collective", at=1):
+            with pytest.raises(chaos.FaultInjected):
+                chaos.check("dist.collective")
+        assert _ins.fault_injected_total("dist.collective").value \
+            == before + 1
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_transient_classification(self):
+        assert is_transient(chaos.FaultInjected("x", 1))
+        assert not is_transient(ValueError("boom"))
+        assert is_transient(OSError("flake"), retry_on=(OSError,))
+
+    def test_retries_then_succeeds_and_counts(self):
+        pol = RetryPolicy(max_attempts=3, base_s=0.001, max_s=0.002,
+                          budget_s=5.0)
+        calls = []
+        before = _ins.retry_total("t.site").value
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise chaos.FaultInjected("t", len(calls))
+            return "ok"
+
+        assert pol.call(flaky, site="t.site") == "ok"
+        assert len(calls) == 3
+        assert _ins.retry_total("t.site").value == before + 2
+
+    def test_non_transient_raises_immediately(self):
+        pol = RetryPolicy(max_attempts=5, base_s=0.001, budget_s=5.0)
+        calls = []
+
+        def broken():
+            calls.append(1)
+            raise ValueError("deterministic bug")
+
+        with pytest.raises(ValueError):
+            pol.call(broken, site="t.site2")
+        assert len(calls) == 1
+
+    def test_exhaustion_reports_every_attempt(self):
+        pol = RetryPolicy(max_attempts=2, base_s=0.001, max_s=0.002,
+                          budget_s=5.0)
+        with pytest.raises(RetryExhausted) as ei:
+            pol.call(lambda: (_ for _ in ()).throw(
+                chaos.FaultInjected("t", 0)), site="t.site3")
+        assert ei.value.attempts == 2
+        assert "attempt 1" in str(ei.value)
+        assert "attempt 2" in str(ei.value)
+
+    def test_budget_and_deadline_cut_retries_short(self):
+        pol = RetryPolicy(max_attempts=50, base_s=0.2, max_s=0.2,
+                          budget_s=0.05)
+
+        def always():
+            raise chaos.FaultInjected("t", 0)
+
+        t0 = time.monotonic()
+        with pytest.raises(RetryExhausted) as ei:
+            pol.call(always, site="t.budget")
+        assert time.monotonic() - t0 < 1.0
+        assert ei.value.attempts == 1  # first backoff already over budget
+
+        pol2 = RetryPolicy(max_attempts=50, base_s=0.2, max_s=0.2,
+                           budget_s=30.0)
+        with pytest.raises(RetryExhausted):
+            pol2.call(always, site="t.deadline",
+                      deadline=time.monotonic() + 0.05)
+
+
+# ---------------------------------------------------------------------------
+# collective / kvstore fault injection
+# ---------------------------------------------------------------------------
+
+class TestCollectiveFaults:
+    def test_injected_kvstore_fault_is_retried_bit_equal(self):
+        data = _batches(3)
+        net_a = _make_net("kv_a_")
+        tr_a = mx.gluon.Trainer(net_a.collect_params(), "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9})
+        for xb, yb in data:
+            _one_step(net_a, tr_a, xb, yb, _CTXS2)
+
+        net_b = _make_net("kv_b_")
+        tr_b = mx.gluon.Trainer(net_b.collect_params(), "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9})
+        chaos.reset_stats()
+        retries_before = _ins.retry_total("kvstore.pushpull_fused").value
+        with chaos.inject("kvstore.pushpull", at=2) as scope:
+            for xb, yb in data:
+                _one_step(net_b, tr_b, xb, yb, _CTXS2)
+            assert scope.fired == 1
+        assert chaos.stats()["kvstore.pushpull"]["injected"] == 1
+        assert _ins.retry_total("kvstore.pushpull_fused").value \
+            == retries_before + 1
+        for (na, va), (nb, vb) in zip(sorted(_params_np(net_a).items()),
+                                      sorted(_params_np(net_b).items())):
+            np.testing.assert_array_equal(va, vb)
+
+    def test_persistent_collective_fault_hard_errors_with_trail(self,
+                                                                monkeypatch):
+        from mxnet_tpu.parallel import dist
+        from mxnet_tpu.resilience import retry as retry_mod
+
+        # fast policy for the test: 2 attempts, ~ms backoff
+        monkeypatch.setattr(
+            retry_mod, "_DEFAULT",
+            RetryPolicy(max_attempts=2, base_s=0.001, max_s=0.002,
+                        budget_s=5.0))
+        v = nd.array(np.ones((3,), "f4"))
+        with chaos.inject("dist.collective", times=99):
+            with pytest.raises(RetryExhausted) as ei:
+                dist.allreduce_nd(v)
+        assert ei.value.attempts == 2
+        assert "attempt 2" in str(ei.value)
+
+    def test_single_process_collective_retry_succeeds(self):
+        from mxnet_tpu.parallel import dist
+
+        v = nd.array(np.arange(4, dtype="f4"))
+        with chaos.inject("dist.collective", at=1):
+            out = dist.allreduce_nd(v)  # retried, then the no-op path
+        np.testing.assert_array_equal(out.asnumpy(), v.asnumpy())
+        assert chaos.stats()["dist.collective"]["injected"] == 1
+
+    def test_injected_hang_trips_the_real_watchdog(self, monkeypatch):
+        """The chaos probe runs INSIDE the watchdog window: a `hang`
+        plan must stall the collective like a dead peer and fire the
+        real timeout machinery (watchdog error + sequence poisoning),
+        not sleep outside it and then succeed."""
+        from mxnet_tpu.parallel import dist
+
+        monkeypatch.setattr(dist, "_POISONED", None)
+        try:
+            with chaos.inject("dist.collective", at=1, action="hang",
+                              duration=5.0):
+                with pytest.raises(MXNetError, match="timed out"):
+                    dist._resilient(lambda: 42, timeout=0.2,
+                                    what="t", site="t.hang")
+            # the blown timeout poisoned the sequence, as a real dead
+            # peer would — further collectives refuse
+            with pytest.raises(MXNetError, match="refused"):
+                dist._run_with_watchdog(lambda: 1, 0.2, "t2")
+        finally:
+            monkeypatch.setattr(dist, "_POISONED", None)
+
+    def test_kvstore_bucket_retry_engages_without_chaos(self):
+        """The retry contract holds in PRODUCTION: a transient-marked
+        infra failure in a bucket reduce retries with chaos fully
+        disabled, not only under injection."""
+        from mxnet_tpu import kvstore as kvs
+
+        assert chaos._ACTIVE is False
+        store = kvs.create("device")
+        g0 = nd.array(np.ones((4,), "f4"))
+        g1 = nd.array(np.ones((4,), "f4") * 2)
+        store.init(0, g0)
+        real = kvs.KVStore._bucket_allreduce
+        fails = []
+
+        class _Blip(MXNetError):
+            transient = True
+
+        def flaky(self, *a, **kw):
+            if not fails:
+                fails.append(1)
+                raise _Blip("transient infra blip")
+            return real(self, *a, **kw)
+
+        before = _ins.retry_total("kvstore.pushpull_fused").value
+        try:
+            kvs.KVStore._bucket_allreduce = flaky
+            store.pushpull_fused([0], [[g0, g1]], out=[[g0, g1]])
+        finally:
+            kvs.KVStore._bucket_allreduce = real
+        np.testing.assert_array_equal(g0.asnumpy(),
+                                      np.full((4,), 3.0, "f4"))
+        assert fails == [1]
+        assert _ins.retry_total("kvstore.pushpull_fused").value \
+            == before + 1
+
+
+# ---------------------------------------------------------------------------
+# preemption-safe training: checkpoint, resume, bit-consistency
+# ---------------------------------------------------------------------------
+
+class TestPreemptionResume:
+    def test_preempt_resume_is_bit_consistent(self, tmp_path):
+        data = _batches(6)
+
+        # run A: never interrupted
+        net_a = _make_net("pre_a_")
+        tr_a = mx.gluon.Trainer(net_a.collect_params(), "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9})
+        for xb, yb in data:
+            _one_step(net_a, tr_a, xb, yb, _CTXS2)
+        final_a = _params_np(net_a)
+
+        # run B: preempted during step 4, auto-checkpointed, resumed
+        net_b = _make_net("pre_b_")
+        tr_b = mx.gluon.Trainer(net_b.collect_params(), "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9})
+        cursor = [0]
+        ck = resilience.AutoCheckpoint(
+            str(tmp_path / "ck"), tr_b, every_n_steps=2,
+            state_provider=lambda: {"next_batch": cursor[0]})
+        with chaos.inject("trainer.preempt", at=4):
+            with pytest.raises(resilience.Preempted) as ei:
+                for i, (xb, yb) in enumerate(data):
+                    # position BEFORE step(): the checkpoint is cut
+                    # inside it, and must record where to resume once
+                    # THIS batch's update has committed
+                    cursor[0] = i + 1
+                    _one_step(net_b, tr_b, xb, yb, _CTXS2)
+        assert ei.value.checkpoint_dir is not None
+        assert os.path.isdir(ei.value.checkpoint_dir)
+
+        # fresh process stand-in: new net (same param names), new
+        # trainer, restore, continue from the recorded data position
+        net_c = _make_net("pre_b_", seed=99)  # different init on purpose
+        tr_c = mx.gluon.Trainer(net_c.collect_params(), "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9})
+        ck2 = resilience.AutoCheckpoint(str(tmp_path / "ck"), tr_c)
+        meta = ck2.resume()
+        assert meta["step"] == 4
+        assert meta["position"] == {"next_batch": 4}
+        for xb, yb in data[meta["position"]["next_batch"]:]:
+            _one_step(net_c, tr_c, xb, yb, _CTXS2)
+        final_c = _params_np(net_c)
+        assert set(final_a.keys()) == {
+            k.replace("pre_b_", "pre_a_") for k in final_c}
+        for name_c, vc in sorted(final_c.items()):
+            va = final_a[name_c.replace("pre_b_", "pre_a_")]
+            np.testing.assert_array_equal(va, vc)
+
+    def test_resume_onto_smaller_replica_count(self, tmp_path):
+        data = _batches(3)
+        net2 = _make_net("small_")
+        tr2 = mx.gluon.Trainer(net2.collect_params(), "sgd",
+                               {"learning_rate": 0.05, "momentum": 0.9})
+        ck = resilience.AutoCheckpoint(str(tmp_path / "ck"), tr2)
+        for xb, yb in data:
+            _one_step(net2, tr2, xb, yb, _CTXS2)
+        ck.save(sync=True)
+        want = _params_np(net2)
+        mom2 = [np.asarray(s.asnumpy()) for s in
+                tr2._updaters[0].states[0]] \
+            if hasattr(tr2._updaters[0], "states") else None
+
+        # "the slice came back smaller": 1 replica instead of 2
+        net1 = _make_net("small_", ctxs=[mx.cpu(0)], seed=42)
+        tr1 = mx.gluon.Trainer(net1.collect_params(), "sgd",
+                               {"learning_rate": 0.05, "momentum": 0.9})
+        ck1 = resilience.AutoCheckpoint(str(tmp_path / "ck"), tr1)
+        meta = ck1.resume()
+        assert meta["step"] == 3
+        for name, v in _params_np(net1).items():
+            np.testing.assert_array_equal(v, want[name])
+        # and it trains on: the restored momentum drives the next step
+        _one_step(net1, tr1, *data[0], [mx.cpu(0)])
+        assert len(tr1._updaters) == 1
+
+    def test_rng_stream_snapshot_roundtrip(self):
+        from mxnet_tpu.resource import resource_manager
+
+        rm = resource_manager()
+        mx.random.seed(1234)
+        _ = rm.random(mx.cpu(0)).next_key()
+        state = rm.rng_state()
+        a = np.asarray(rm.random(mx.cpu(0)).next_key())
+        rm.set_rng_state(state)
+        b = np.asarray(rm.random(mx.cpu(0)).next_key())
+        np.testing.assert_array_equal(a, b)
+        # and the snapshot is JSON-able (it rides meta.json)
+        json.dumps(state)
+
+    def test_atomic_writes_and_keep_last_pruning(self, tmp_path):
+        d = str(tmp_path / "ck")
+        net = _make_net("prune_")
+        tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                              {"learning_rate": 0.05})
+        ck = resilience.AutoCheckpoint(d, tr, every_n_steps=1,
+                                       keep_last=2)
+        for xb, yb in _batches(5):
+            _one_step(net, tr, xb, yb, _CTXS2)
+        ck.flush()
+        names = sorted(os.listdir(d))
+        assert names == ["step-00000004", "step-00000005"]
+        assert not any(n.startswith(".tmp-") for n in names)
+
+        # a crashed writer's leftover .tmp dir must not confuse resume
+        os.makedirs(os.path.join(d, ".tmp-step-00000009"))
+        assert resilience.latest_step_dir(d).endswith("step-00000005")
+
+    def test_preemption_save_happens_at_step_boundary(self, tmp_path):
+        net = _make_net("bound_")
+        tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                              {"learning_rate": 0.05})
+        ck = resilience.AutoCheckpoint(str(tmp_path / "ck"), tr)
+        xb, yb = _batches(1)[0]
+        _one_step(net, tr, xb, yb, _CTXS2)
+        preemption.trigger(reason="test")
+        with pytest.raises(resilience.Preempted):
+            _one_step(net, tr, xb, yb, _CTXS2)
+        # the step that observed the signal COMPLETED, then saved
+        assert ck.step == 2
+        meta = json.load(open(os.path.join(
+            resilience.latest_step_dir(str(tmp_path / "ck")),
+            "meta.json")))
+        assert meta["step"] == 2
+
+
+# ---------------------------------------------------------------------------
+# DataLoader worker death
+# ---------------------------------------------------------------------------
+
+class TestWorkerDeath:
+    def _ds(self):
+        x = np.arange(48, dtype="f4").reshape(12, 4)
+        y = np.arange(12, dtype="i4")
+        return ArrayDataset(x, y)
+
+    def test_thread_worker_death_raises_workerdied_fast(self):
+        dl = DataLoader(self._ds(), batch_size=2, num_workers=1,
+                        timeout=60)
+        t0 = time.monotonic()
+        with chaos.inject("dataloader.worker", at=2, action="die"):
+            with pytest.raises(WorkerDied) as ei:
+                for _ in dl:
+                    pass
+        # detected via liveness, NOT by burning the 60s batch timeout
+        assert time.monotonic() - t0 < 10
+        assert "mx-dataloader-worker-0" in str(ei.value)
+        assert ei.value.worker == "mx-dataloader-worker-0"
+        # and the loader recovers: a clean epoch right after
+        assert sum(1 for _ in dl) == 6
+
+    def test_worker_error_still_propagates_not_workerdied(self):
+        class _Bad:
+            def __len__(self):
+                return 6
+
+            def __getitem__(self, i):
+                if i == 3:
+                    raise RuntimeError("decode failed")
+                return np.zeros((4,), "f4")
+
+        dl = DataLoader(_Bad(), batch_size=2, num_workers=1, timeout=60)
+        with pytest.raises(RuntimeError, match="decode failed"):
+            for _ in dl:
+                pass
+
+    def test_resume_from_skips_without_building(self):
+        calls = []
+
+        class _Tracking:
+            def __len__(self):
+                return 12
+
+            def __getitem__(self, i):
+                calls.append(i)
+                return np.full((4,), i, "f4")
+
+        dl = DataLoader(_Tracking(), batch_size=2, num_workers=0)
+        dl.resume_from(4)
+        out = [b.asnumpy()[0, 0] for b in dl]
+        assert out == [8.0, 10.0]
+        assert min(calls) == 8  # skipped batches were never built
+        # one-shot: the next epoch is full again
+        assert sum(1 for _ in dl) == 6
+
+
+@pytest.mark.slow  # spawn pool + per-child jax import ≈ 8s; the
+# thread-pool twin above keeps WorkerDied in tier-1, and the nightly
+# resilience stage (tools/run_nightly.py) runs this lane
+class TestWorkerDeathProcessPool:
+    def test_process_worker_death_raises_workerdied_with_pid(self):
+        x = np.arange(48, dtype="f4").reshape(12, 4)
+        dl = DataLoader(ArrayDataset(x, np.arange(12, dtype="i4")),
+                        batch_size=2, num_workers=1,
+                        worker_pool="process", timeout=120)
+        with chaos.inject("dataloader.worker", at=2, action="die"):
+            with pytest.raises(WorkerDied) as ei:
+                for _ in dl:
+                    pass
+        assert isinstance(ei.value.worker, int)  # the child pid
+        # the poisoned pool was discarded; a fresh epoch works
+        assert sum(1 for _ in dl) == 6
+
+    def test_process_worker_injected_error_crosses_the_pickle_pipe(self):
+        """action='error' inside a spawn child: the FaultInjected must
+        arrive in the consumer AS FaultInjected (it rides the pool's
+        pickle pipe — the __reduce__ regression), not hang the parent
+        or surface as a pickling TypeError."""
+        x = np.arange(48, dtype="f4").reshape(12, 4)
+        dl = DataLoader(ArrayDataset(x, np.arange(12, dtype="i4")),
+                        batch_size=2, num_workers=1,
+                        worker_pool="process", timeout=120)
+        with chaos.inject("dataloader.worker", at=2, action="error"):
+            with pytest.raises(chaos.FaultInjected) as ei:
+                for _ in dl:
+                    pass
+        assert ei.value.kind == "dataloader.worker"
+        assert sum(1 for _ in dl) == 6  # pool is still healthy
+
+
+# ---------------------------------------------------------------------------
+# serving: breaker, transient retry, artifact faults, drain deadline
+# ---------------------------------------------------------------------------
+
+from mxnet_tpu import serving  # noqa: E402
+from mxnet_tpu.contrib import deploy  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    d = tmp_path_factory.mktemp("resil_art")
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu", in_units=4),
+                nn.Dense(2, in_units=8))
+    net.initialize(ctx=mx.cpu())
+    x = nd.array(np.random.RandomState(0).rand(4, 4).astype("f4"))
+    deploy.export_model(net, str(d), [x], dynamic_batch=True)
+    return str(d)
+
+
+def _x1(seed=0):
+    return nd.array(np.random.RandomState(seed).rand(1, 4).astype("f4"))
+
+
+class TestServingResilience:
+    def test_transient_executor_failure_retries_within_deadline(
+            self, artifact):
+        repo = serving.ModelRepository()
+        repo.add("m", artifact)
+        srv = serving.InferenceServer(repo, serving.ServingConfig(
+            max_batch_size=4, batch_timeout_ms=2.0, execute_retries=3))
+        with chaos.inject("serving.execute", at=1):
+            y = srv.infer("m", [_x1()], timeout_ms=60000)
+        assert y.asnumpy().shape == (1, 2)
+        assert chaos.stats()["serving.execute"]["injected"] == 1
+        assert repo.get("m").breaker.state() == "closed"
+        srv.shutdown()
+
+    def test_breaker_opens_degrades_and_recovers(self, artifact):
+        repo = serving.ModelRepository()
+        repo.add("m", artifact)
+        srv = serving.InferenceServer(repo, serving.ServingConfig(
+            max_batch_size=4, batch_timeout_ms=2.0,
+            breaker_threshold=2, breaker_cooldown_ms=150.0,
+            execute_retries=1))
+        entry = repo.get("m")
+        srv.infer("m", [_x1()])  # warm compile outside the chaos scope
+        with chaos.inject("serving.execute", times=99):
+            for i in range(2):
+                with pytest.raises(MXNetError):
+                    srv.infer("m", [_x1()], timeout_ms=10000)
+            assert entry.breaker.state() == "open"
+            # while OPEN: instant 503 for this model, executor untouched
+            calls_when_open = chaos.stats()["serving.execute"]["calls"]
+            with pytest.raises(serving.ModelUnavailable):
+                srv.infer("m", [_x1()])
+            assert chaos.stats()["serving.execute"]["calls"] \
+                == calls_when_open
+            assert entry.metrics.value("breaker_rejected") == 1
+        # cooldown -> half-open probe -> success closes it
+        time.sleep(0.2)
+        y = srv.infer("m", [_x1()], timeout_ms=10000)
+        assert y.asnumpy().shape == (1, 2)
+        assert entry.breaker.state() == "closed"
+        srv.shutdown()
+
+    def test_breaker_trip_keeps_healthz_up_and_other_models_serving(
+            self, artifact, tmp_path):
+        repo = serving.ModelRepository()
+        repo.add("sick", artifact)
+        repo.add("healthy", artifact)
+        srv = serving.InferenceServer(repo, serving.ServingConfig(
+            max_batch_size=4, batch_timeout_ms=2.0,
+            breaker_threshold=1, breaker_cooldown_ms=60000.0,
+            execute_retries=1))
+        httpd = serving.serve_http(srv, port=0)
+        try:
+            port = httpd.server_address[1]
+            srv.infer("healthy", [_x1()])  # warm + close its breaker
+            with chaos.inject("serving.execute", times=99):
+                with pytest.raises(MXNetError):
+                    srv.infer("sick", [_x1()], timeout_ms=10000)
+            assert repo.get("sick").breaker.state() == "open"
+            with pytest.raises(serving.ModelUnavailable):
+                srv.infer("sick", [_x1()])
+            # the process is fine: healthz 200, the healthy model serves
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+                assert r.status == 200
+                assert json.loads(r.read())["status"] == "serving"
+            assert srv.infer("healthy", [_x1()]).asnumpy().shape == (1, 2)
+        finally:
+            httpd.shutdown()
+            srv.shutdown()
+
+    def test_artifact_load_fault_surfaces_then_recovers(self, artifact,
+                                                        tmp_path):
+        repo = serving.ModelRepository()
+        repo.add("m", artifact)
+        srv = serving.InferenceServer(repo)
+        with chaos.inject("serving.artifact", at=1):
+            with pytest.raises(chaos.FaultInjected):
+                srv.infer("m", [_x1()])
+        # the entry stayed importable; the next request succeeds
+        assert srv.infer("m", [_x1()]).asnumpy().shape == (1, 2)
+        srv.shutdown()
+
+    def test_drain_timeout_bounds_shutdown_on_wedged_batch(self,
+                                                           artifact):
+        repo = serving.ModelRepository()
+        repo.add("m", artifact)
+        srv = serving.InferenceServer(repo, serving.ServingConfig(
+            max_batch_size=1, batch_timeout_ms=1.0))
+        srv.infer("m", [_x1()])  # warm so the wedge is the only stall
+        entry = repo.get("m")
+        orig = entry.execute
+        entry.execute = lambda *a, **k: (time.sleep(120), orig(*a, **k))[1]
+        fut = srv.submit("m", [_x1()])       # wedges the batcher thread
+        time.sleep(0.2)
+        queued = srv.submit("m", [_x1(1)])   # stuck behind it
+        t0 = time.monotonic()
+        srv.shutdown(drain=True, timeout=1.0)
+        assert time.monotonic() - t0 < 5.0
+        with pytest.raises(serving.ServerClosed):
+            queued.result(timeout=5)
+        assert entry.metrics.value("drain_timeouts") == 1
+        entry.execute = orig
+
+    def test_default_drain_timeout_comes_from_config_knob(self,
+                                                          artifact):
+        repo = serving.ModelRepository()
+        repo.add("m", artifact)
+        srv = serving.InferenceServer(repo, serving.ServingConfig(
+            drain_timeout_s=0.5))
+        t0 = time.monotonic()
+        srv.shutdown(drain=True)  # nothing queued: instant either way
+        assert time.monotonic() - t0 < 5.0
+
+
+class TestCircuitBreakerUnit:
+    def test_state_machine(self):
+        br = CircuitBreaker("u", 1, threshold=2, cooldown_s=0.05)
+        assert br.state() == "closed" and br.allow()
+        br.record_failure()
+        assert br.state() == "closed"  # 1 < threshold
+        br.record_failure()
+        assert br.state() == "open"
+        assert not br.allow()
+        time.sleep(0.06)
+        assert br.allow()          # the half-open probe
+        assert br.state() == "half-open"
+        assert not br.allow()      # only ONE probe
+        br.record_failure()        # probe failed -> re-open
+        assert br.state() == "open"
+        time.sleep(0.06)
+        assert br.allow()
+        br.record_success()        # probe succeeded -> closed
+        assert br.state() == "closed"
+        assert br.allow()
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker("u2", 1, threshold=3, cooldown_s=60.0)
+        for _ in range(2):
+            br.record_failure()
+        br.record_success()
+        for _ in range(2):
+            br.record_failure()
+        assert br.state() == "closed"
+
+    def test_would_allow_does_not_consume_probe(self):
+        br = CircuitBreaker("u3", 1, threshold=1, cooldown_s=0.01)
+        br.record_failure()
+        time.sleep(0.02)
+        assert br.would_allow() and br.would_allow()
+        assert br.state() == "open"  # advisory checks changed nothing
+        assert br.allow()            # the real probe
+        assert not br.would_allow()
+        br.abandon_probe()
+        assert br.would_allow()

@@ -160,3 +160,25 @@ def test_scaling_bench_single_proc():
                  "--out", "/tmp/scaling_test.json"])
     assert rows[-1]["processes"] == 1
     assert rows[-1]["efficiency_vs_1proc"] == 1.0
+
+
+def test_bench_resilience_smoke(tmp_path):
+    """CLI smoke only: the resilience bench runs both scenarios and
+    emits a well-formed report.  The strict gate (bit-consistent
+    resume, breaker opened+recovered, healthz up) lives in
+    tests/nightly/test_bench_resilience.py."""
+    out = tmp_path / "RESILIENCE.json"
+    rows = _run([sys.executable, "tools/bench_resilience.py",
+                 "--no-gate", "--steps", "4", "--preempt-at", "3",
+                 "--trip-requests", "8", "--out", str(out)],
+                timeout=420)
+    report = rows[-1]
+    assert report["bench"] == "resilience"
+    rec = report["recovery"]
+    assert rec["recovery_time_to_first_step_s"] > 0
+    assert rec["preempted_checkpoint"].startswith("step-")
+    br = report["breaker"]
+    assert br["requests_during_trip"] == 8
+    assert br["requests_failed_pre_trip"] \
+        + br["requests_dropped_during_trip"] == 8
+    assert json.loads(out.read_text()) == report

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Resilience bench (ISSUE 6 gate): measure the recovery paths, don't
+just test them.
+
+Two scenarios, one report (stdout JSON line + RESILIENCE.json):
+
+  * recovery — train a small data-parallel job with auto-checkpointing,
+    inject a preemption mid-epoch, then measure RECOVERY TIME TO FIRST
+    STEP: constructing a fresh trainer, ``resume()``-ing the
+    checkpoint, and completing the first post-resume optimizer step.
+    Also verifies the resumed run reaches BIT-CONSISTENT parameters vs
+    an uninterrupted twin (``resume_bit_consistent``).
+
+  * breaker — serve a model whose executor is chaos-failed until the
+    per-model circuit breaker opens, keep firing requests during the
+    trip, and count what was DROPPED (fast 503s) vs the window; then
+    let the half-open probe close the breaker and verify the model
+    serves again and ``/healthz`` stayed 200 throughout
+    (``process_survived``).
+
+Gate (skipped with --no-gate, enforced in
+tests/nightly/test_bench_resilience.py): resume must be bit-consistent,
+recovery under --max-recovery-s (generous: CPU compile included),
+breaker must have opened and recovered, healthz must never have
+flapped.
+
+CPU smoke: JAX_PLATFORMS=cpu python tools/bench_resilience.py --no-gate
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _batches(n, rows, units):
+    rng = np.random.RandomState(0)
+    return [(rng.rand(rows, units).astype("f4"),
+             rng.rand(rows, 4).astype("f4")) for _ in range(n)]
+
+
+def _make_net(prefix, units, seed=3):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    net = nn.Dense(4, in_units=units, prefix=prefix)
+    net.initialize(ctx=mx.cpu())
+    return net
+
+
+def _one_step(net, trainer, xb, yb):
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+
+    with autograd.record():
+        loss = ((net(nd.array(xb, ctx=mx.cpu()))
+                 - nd.array(yb, ctx=mx.cpu())) ** 2).sum()
+    loss.backward()
+    trainer.step(len(xb))
+
+
+def _params(net):
+    return {p.name: p.list_data()[0].asnumpy().copy()
+            for p in net.collect_params().values()}
+
+
+def scenario_recovery(steps: int, preempt_at: int, units: int) -> dict:
+    import mxnet_tpu as mx
+    from mxnet_tpu import resilience
+    from mxnet_tpu.resilience import chaos
+
+    data = _batches(steps, 16, units)
+    opt = {"learning_rate": 0.05, "momentum": 0.9}
+
+    net_a = _make_net("bench_a_", units)
+    tr_a = mx.gluon.Trainer(net_a.collect_params(), "sgd", dict(opt))
+    for xb, yb in data:
+        _one_step(net_a, tr_a, xb, yb)
+    want = _params(net_a)
+
+    ckdir = tempfile.mkdtemp(prefix="mx-resil-bench-")
+    net_b = _make_net("bench_b_", units)
+    tr_b = mx.gluon.Trainer(net_b.collect_params(), "sgd", dict(opt))
+    cursor = [0]
+    resilience.AutoCheckpoint(ckdir, tr_b, every_n_steps=2,
+                              state_provider=lambda:
+                              {"next_batch": cursor[0]})
+    preempted_dir = None
+    with chaos.inject("trainer.preempt", at=preempt_at):
+        try:
+            for i, (xb, yb) in enumerate(data):
+                cursor[0] = i + 1
+                _one_step(net_b, tr_b, xb, yb)
+        except resilience.Preempted as e:
+            preempted_dir = e.checkpoint_dir
+
+    # --- the measured window: fresh trainer -> resume -> first step ---
+    t0 = time.perf_counter()
+    net_c = _make_net("bench_b_", units, seed=99)
+    tr_c = mx.gluon.Trainer(net_c.collect_params(), "sgd", dict(opt))
+    ck_c = resilience.AutoCheckpoint(ckdir, tr_c)
+    meta = ck_c.resume()
+    nxt = meta["position"]["next_batch"]
+    _one_step(net_c, tr_c, *data[nxt])
+    recovery_s = time.perf_counter() - t0
+
+    for xb, yb in data[nxt + 1:]:
+        _one_step(net_c, tr_c, xb, yb)
+    got = _params(net_c)
+    bit_consistent = all(
+        np.array_equal(want[k.replace("bench_b_", "bench_a_")], v)
+        for k, v in got.items())
+    return {
+        "preempted_at_step": meta["step"],
+        "preempted_checkpoint": os.path.basename(preempted_dir or ""),
+        "recovery_time_to_first_step_s": round(recovery_s, 3),
+        "resume_bit_consistent": bool(bit_consistent),
+    }
+
+
+def scenario_breaker(trip_requests: int, units: int) -> dict:
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, serving
+    from mxnet_tpu.contrib import deploy
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.resilience import chaos
+
+    art = tempfile.mkdtemp(prefix="mx-resil-art-")
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu", in_units=units),
+                nn.Dense(4, in_units=8))
+    net.initialize(ctx=mx.cpu())
+    x = nd.array(np.random.RandomState(0).rand(4, units).astype("f4"))
+    deploy.export_model(net, art, [x], dynamic_batch=True)
+
+    repo = serving.ModelRepository()
+    repo.add("m", art)
+    srv = serving.InferenceServer(repo, serving.ServingConfig(
+        max_batch_size=4, batch_timeout_ms=1.0,
+        breaker_threshold=3, breaker_cooldown_ms=300.0,
+        execute_retries=1))
+    httpd = serving.serve_http(srv, port=0)
+    port = httpd.server_address[1]
+
+    def healthz_ok():
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+                return r.status == 200
+        except Exception:  # noqa: BLE001 — a probe failure is a result
+            return False
+
+    x1 = nd.array(np.random.RandomState(1).rand(1, units).astype("f4"))
+    entry = repo.get("m")
+    out = {"process_survived": True}
+    try:
+        srv.infer("m", [x1])  # warm compile
+        healthz_always_up = healthz_ok()
+        dropped = failed = 0
+        t0 = time.perf_counter()
+        with chaos.inject("serving.execute", times=10_000):
+            for _ in range(trip_requests):
+                try:
+                    srv.infer("m", [x1], timeout_ms=10000)
+                except serving.ModelUnavailable:
+                    dropped += 1     # fast 503 from the open breaker
+                except Exception:    # noqa: BLE001 — counted result
+                    failed += 1      # executor failures pre-trip
+            healthz_always_up = healthz_always_up and healthz_ok()
+        trip_s = time.perf_counter() - t0
+        opened = entry.breaker.state() == "open"
+        time.sleep(0.35)             # cooldown -> half-open
+        y = srv.infer("m", [x1], timeout_ms=10000)
+        recovered = y is not None and entry.breaker.state() == "closed"
+        healthz_always_up = healthz_always_up and healthz_ok()
+        out.update({
+            "trip_window_s": round(trip_s, 3),
+            "requests_during_trip": trip_requests,
+            "requests_failed_pre_trip": failed,
+            "requests_dropped_during_trip": dropped,
+            "breaker_opened": bool(opened),
+            "breaker_recovered": bool(recovered),
+            "healthz_always_up": bool(healthz_always_up),
+            "breaker_rejected_metric":
+                entry.metrics.value("breaker_rejected"),
+        })
+    finally:
+        httpd.shutdown()
+        srv.shutdown(drain=True, timeout=10.0)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--preempt-at", type=int, default=5)
+    ap.add_argument("--trip-requests", type=int, default=12)
+    ap.add_argument("--units", type=int, default=6)
+    ap.add_argument("--max-recovery-s", type=float, default=60.0)
+    ap.add_argument("--no-gate", action="store_true",
+                    help="report only (tier-1 smoke); the strict gate "
+                    "runs in tests/nightly/test_bench_resilience.py")
+    ap.add_argument("--out", default=None,
+                    help="also write the report JSON here "
+                    "(RESILIENCE.json)")
+    args = ap.parse_args()
+
+    report = {
+        "bench": "resilience",
+        "backend": "cpu" if os.environ.get("JAX_PLATFORMS") == "cpu"
+        else "auto",
+        "recovery": scenario_recovery(args.steps, args.preempt_at,
+                                      args.units),
+        "breaker": scenario_breaker(args.trip_requests, args.units),
+    }
+    gate_ok = (
+        report["recovery"]["resume_bit_consistent"]
+        and report["recovery"]["recovery_time_to_first_step_s"]
+        < args.max_recovery_s
+        and report["breaker"]["breaker_opened"]
+        and report["breaker"]["breaker_recovered"]
+        and report["breaker"]["requests_dropped_during_trip"] > 0
+        and report["breaker"]["healthz_always_up"]
+        and report["breaker"]["process_survived"])
+    report["gate_ok"] = bool(gate_ok)
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if args.no_gate:
+        return 0
+    return 0 if gate_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
