@@ -72,7 +72,7 @@ def __getattr__(name):
             "callback", "model", "test_utils", "engine", "runtime",
             "visualization", "recordio", "contrib", "monitor", "name", "rnn",
             "attribute", "resource", "rtc", "kvstore_server", "serving",
-            "resilience"}
+            "resilience", "compile_cache"}
     if name == "sym":
         mod = importlib.import_module(".symbol", __name__)
         globals()["sym"] = mod

@@ -113,12 +113,17 @@ def scope(**config: Any):
 
 
 def record_compile(site: str, key: Any = None,
-                   seconds: float = 0.0) -> None:
+                   seconds: float = 0.0,
+                   provenance: str = "build") -> None:
     """Hook for executable-cache miss paths (ops registry, fused
-    updater, serving buckets).  No-op unless a sanitizer is active."""
+    updater, serving buckets).  No-op unless a sanitizer is active.
+
+    ``provenance="cache"`` records a persistent-compile-cache load:
+    tallied, but never counted toward the duplicate-key or storm
+    detectors (a warm restart is not a recompile storm)."""
     san = core.get_active()
     if san is not None:
-        san.record_compile(site, key, seconds)
+        san.record_compile(site, key, seconds, provenance=provenance)
 
 
 def violations() -> List[SanViolation]:

@@ -340,17 +340,30 @@ class Sanitizer:
     # ---- recompile detector -------------------------------------------
 
     def record_compile(self, site: str, key: Any = None,
-                       seconds: float = 0.0) -> None:
-        """One executable build at ``site``.  A repeated ``key`` means
+                       seconds: float = 0.0,
+                       provenance: str = "build") -> None:
+        """One executable acquisition at ``site``.  For a real build
+        (``provenance="build"``, the default) a repeated ``key`` means
         the framework cache failed to hit — a steady-state recompile;
         more than ``recompile_warmup`` distinct signatures at one site
-        is a storm (the runtime ground truth MX001 can only guess at)."""
+        is a storm (the runtime ground truth MX001 can only guess at).
+
+        ``provenance="cache"`` marks an executable that came out of the
+        persistent compile cache (disk or its memory tier) instead of
+        XLA: it is tallied (``cache_loads``) for the report but feeds
+        NEITHER the duplicate-key nor the storm detector — a restart
+        that warm-loads every executable from disk is the cache working,
+        not a recompile storm."""
         dup = storm = False
         basis = 0
         with self._lock:
             rec = self.compile_sites.setdefault(
                 site, {"count": 0, "keys": set(), "dup_reported": set(),
-                       "seconds": 0.0, "stormed": False})
+                       "seconds": 0.0, "stormed": False,
+                       "cache_loads": 0})
+            if provenance != "build":
+                rec["cache_loads"] += 1
+                return
             rec["count"] += 1
             rec["seconds"] += seconds
             if key is not None:

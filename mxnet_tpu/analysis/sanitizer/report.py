@@ -23,7 +23,8 @@ def render_json(san: Sanitizer) -> dict:
     with san._lock:
         n_locks = len(san.lock_names)
         n_edges = len(san.edges)
-        sites = {site: (rec["count"], len(rec["keys"]), rec["seconds"])
+        sites = {site: (rec["count"], len(rec["keys"]), rec["seconds"],
+                        rec.get("cache_loads", 0))
                  for site, rec in san.compile_sites.items()}
     per_kind = {}
     for v in vs:
@@ -38,8 +39,9 @@ def render_json(san: Sanitizer) -> dict:
         "compile_sites": {
             site: {"count": count,
                    "distinct_signatures": nkeys,
-                   "seconds": round(secs, 4)}
-            for site, (count, nkeys, secs) in sorted(sites.items())
+                   "seconds": round(secs, 4),
+                   "cache_loads": loads}
+            for site, (count, nkeys, secs, loads) in sorted(sites.items())
         },
         "violations": [{
             "kind": v.kind, "message": v.message, "site": v.site,

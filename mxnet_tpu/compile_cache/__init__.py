@@ -1,0 +1,35 @@
+"""Persistent compile cache: cross-process AOT executable store.
+
+Every executable the framework builds — serving's per-bucket
+executors, the fused optimizer step, the ops-registry jit/grad
+programs (opt-in) — can be persisted to a content-addressed on-disk
+store and reloaded by a *different process*, so a deploy, preemption
+restart, or autoscale-up serves its first request and takes its first
+training step without an XLA compile storm.
+
+One verb::
+
+    from mxnet_tpu import compile_cache as cc
+    key = cc.cache_key("serving.bucket", parts=(...),
+                       program_text=lowered.as_text())
+    exe, origin = cc.get_or_compile("serving:mlp", key, lowered.compile)
+
+Enable by setting ``MXNET_COMPILE_CACHE_DIR`` (optionally capped by
+``MXNET_COMPILE_CACHE_BYTES``; ``MXNET_COMPILE_CACHE_DISABLE=1`` is
+the kill switch).  Populate offline with ``tools/warm_cache.py``;
+measure with ``tools/bench_compile_cache.py``.  See
+docs/compile_cache.md for keying, tiers, invalidation, and the warmup
+workflow.
+"""
+from __future__ import annotations
+
+from .core import (CompileCache, enabled, get_cache, get_or_compile,
+                   reset, stats)
+from .key import CacheKey, cache_key, env_fingerprint, first_party
+from .store import DiskStore, StoreError
+
+__all__ = [
+    "CompileCache", "CacheKey", "DiskStore", "StoreError",
+    "cache_key", "env_fingerprint", "first_party",
+    "get_or_compile", "get_cache", "stats", "reset", "enabled",
+]

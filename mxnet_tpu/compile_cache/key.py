@@ -1,0 +1,151 @@
+"""Cache keys for the persistent compile cache.
+
+A :class:`CacheKey` names one executable *semantically*: everything
+that could change what XLA would build must be part of the digest, and
+nothing else.  The digest covers
+
+  * the caller's structured ``parts`` — avals/treedef reprs, static
+    config, donation spec, bucket, device string (each call site
+    documents its own tuple);
+  * the **lowered program text** (StableHLO) when the caller provides
+    it — the strongest signal: two sites that lower to the same module
+    share an entry, and any semantic change to the traced program
+    (a new op implementation, a jax lowering change) invalidates the
+    entry even when the structured parts are unchanged;
+  * the environment fingerprint: jax/jaxlib versions plus backend
+    platform and device kind.  A cache directory shared across a
+    heterogeneous fleet (or across an upgrade) never serves a stale
+    executable — the digest simply misses.
+
+Digests are content addresses: the disk store names each entry
+``<digest>.mxcc``, so two processes that race to warm the same program
+write equivalent entries to the same name (same payload, per-writer
+header timestamp) and ``os.replace`` resolves the race to either
+copy — both verify.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Optional, Tuple
+
+__all__ = ["CacheKey", "cache_key", "env_fingerprint"]
+
+_FP_LOCK = threading.Lock()
+_FP: Optional[Tuple[str, ...]] = None
+
+
+def env_fingerprint() -> Tuple[str, ...]:
+    """(framework, jax, jaxlib, platform, device_kind) — the portion of
+    the digest that pins an entry to one software + hardware
+    generation.  Computed once per process (the backend cannot change
+    after jax init).  The framework version matters because ALIAS keys
+    deliberately omit the lowered program text: a code change that
+    alters what a site lowers is invisible to them, so every release
+    invalidates the whole store (a warm-up re-run, not a correctness
+    risk)."""
+    global _FP
+    if _FP is None:
+        with _FP_LOCK:
+            if _FP is None:
+                import os
+                import sys
+
+                import jax
+                import jaxlib
+
+                from .. import __version__ as _mx_version
+
+                dev = jax.devices()[0]
+                _FP = (f"mxnet_tpu={_mx_version}",
+                       f"jax={jax.__version__}",
+                       f"jaxlib={jaxlib.__version__}",
+                       # exec-tier payloads are pickles: a cache dir
+                       # shared across interpreter versions must miss,
+                       # not quarantine-thrash on unpicklable entries
+                       f"python={sys.version_info.major}."
+                       f"{sys.version_info.minor}",
+                       f"platform={dev.platform}",
+                       f"device_kind={dev.device_kind}",
+                       # serialized executables embed the device
+                       # assignment: same-kind hosts with different
+                       # visible device counts must miss, not trade
+                       # mutually-unloadable entries
+                       f"devices={len(jax.devices())}",
+                       # compile-configuration inputs that change the
+                       # BUILT code without changing the StableHLO
+                       # text (jax's own persistent cache keys on its
+                       # compile options for the same reason)
+                       f"xla_flags={os.environ.get('XLA_FLAGS', '')}",
+                       f"libtpu={os.environ.get('LIBTPU_INIT_ARGS', '')}",
+                       f"matmul_precision="
+                       f"{jax.config.jax_default_matmul_precision}")
+    return _FP
+
+
+def first_party(module_name) -> bool:
+    """Whether ``module_name`` lives inside this package.  The
+    alias-eligibility policy: only first-party code — whose changes
+    bump the framework version in :func:`env_fingerprint` — may use
+    the cheap (program-text-free) alias keys; user code (custom ops,
+    Optimizer subclasses) must always key by the lowered program."""
+    mod = module_name or ""
+    return mod == "mxnet_tpu" or mod.startswith("mxnet_tpu.")
+
+
+def _canon(v: Any) -> str:
+    """Stable text form of one key part.  Tuples/lists/dicts recurse so
+    nesting order is explicit; everything else goes through ``repr``,
+    which is deterministic for the part types call sites use (str, int,
+    class objects, PyTreeDef, aval tuples)."""
+    if isinstance(v, (tuple, list)):
+        return "(" + ",".join(_canon(x) for x in v) + ")"
+    if isinstance(v, dict):
+        return "{" + ",".join(
+            f"{_canon(k)}:{_canon(x)}" for k, x in sorted(
+                v.items(), key=lambda kv: repr(kv[0]))) + "}"
+    if isinstance(v, bytes):
+        return "b" + hashlib.sha256(v).hexdigest()
+    return repr(v)
+
+
+class CacheKey:
+    """One executable's identity.  ``site`` is a stable family name
+    (``serving.bucket``, ``optimizer.fused_step``, ``ops.jit``) kept in
+    the entry header for operability — it is part of the digest too, so
+    two sites never collide even on identical programs (their calling
+    conventions may differ)."""
+
+    __slots__ = ("site", "parts", "program_text", "_digest")
+
+    def __init__(self, site: str, parts: Tuple, program_text: Optional[str] = None):
+        self.site = site
+        self.parts = parts
+        self.program_text = program_text
+        self._digest: Optional[str] = None
+
+    @property
+    def digest(self) -> str:
+        """sha256 hex over site + parts + program text + environment
+        fingerprint.  Computed once (program text can be megabytes)."""
+        if self._digest is None:
+            h = hashlib.sha256()
+            h.update(self.site.encode())
+            h.update(b"\x00")
+            h.update(_canon(self.parts).encode())
+            h.update(b"\x00")
+            h.update("\x1f".join(env_fingerprint()).encode())
+            h.update(b"\x00")
+            if self.program_text is not None:
+                h.update(self.program_text.encode())
+            self._digest = h.hexdigest()
+        return self._digest
+
+    def __repr__(self):
+        return f"CacheKey(site={self.site!r}, digest={self.digest[:12]}...)"
+
+
+def cache_key(site: str, parts: Tuple,
+              program_text: Optional[str] = None) -> CacheKey:
+    """Build a :class:`CacheKey` (the one constructor call sites use)."""
+    return CacheKey(site, tuple(parts), program_text)

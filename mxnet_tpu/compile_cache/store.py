@@ -1,0 +1,323 @@
+"""Content-addressed on-disk entry store for the compile cache.
+
+One entry per digest, one file per entry (``<digest>.mxcc``), flat in
+the cache directory.  The format is self-describing::
+
+    b"MXCC1\\n"                      magic (format version 1)
+    4-byte big-endian header length
+    header JSON                      tier, site, digest, payload sha256,
+                                     jax/jaxlib/platform, created
+    payload bytes                    tier "exec": pickled serialized
+                                     executable; tier "stablehlo": the
+                                     lowered module text (utf-8)
+
+Durability rules (the resilience conventions):
+
+  * **Writes are atomic** — ``<digest>.tmp-<pid>-<n>`` then
+    ``os.replace``.  Concurrent writers of one digest produce
+    equivalent entries (same payload; only the header timestamp
+    differs), so the race resolves to either copy and both verify; a
+    crash mid-write leaves only a ``.tmp-`` file, which readers never
+    open and the next eviction sweep removes.
+  * **Loads are digest-verified** — magic, header digest, and a sha256
+    over the payload must all match.  Any mismatch (torn write, bit
+    rot, truncation) quarantines the file (renamed ``*.corrupt``),
+    counts a miss, and the caller compiles fresh: corruption can cost a
+    compile, never a failed request.
+  * **Transient IO retries** — reads/writes run under the framework
+    retry policy with ``OSError`` whitelisted (the checkpoint-IO
+    precedent), and ``chaos.check("compile_cache.io")`` sits inside the
+    attempt so the chaos suite can prove both properties.
+
+Capacity: :meth:`DiskStore.evict` enforces ``MXNET_COMPILE_CACHE_BYTES``
+by removing least-recently-used entries (mtime order; a verified load
+touches the file, so hot entries survive).  Eviction runs after each
+write — the store can transiently exceed the cap by one entry, never
+grow without bound.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import itertools
+import json
+import os
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..resilience import chaos as _chaos
+from ..resilience import retry as _retry
+
+__all__ = ["DiskStore", "StoreError", "ENTRY_SUFFIX"]
+
+_MAGIC = b"MXCC1\n"
+ENTRY_SUFFIX = ".mxcc"
+_CORRUPT_SUFFIX = ".corrupt"
+_tmp_seq = itertools.count(1)
+
+
+class StoreError(Exception):
+    """An entry failed verification (reported to the caller as a miss;
+    the message says what was wrong for the quarantine log)."""
+
+
+def _io_policy() -> _retry.RetryPolicy:
+    # module-level singleton, built lazily so the env knobs are read
+    # once but never at import time
+    global _POLICY
+    if _POLICY is None:
+        with _POLICY_LOCK:
+            if _POLICY is None:
+                _POLICY = _retry.RetryPolicy()
+    return _POLICY
+
+
+_POLICY: Optional[_retry.RetryPolicy] = None
+_POLICY_LOCK = threading.Lock()
+
+
+def encode_entry(header: Dict, payload: bytes) -> bytes:
+    """Serialize one entry.  The payload sha256 is stamped here so the
+    caller cannot forget it."""
+    header = dict(header)
+    header["payload_sha256"] = hashlib.sha256(payload).hexdigest()
+    hjson = json.dumps(header, sort_keys=True).encode()
+    return b"".join([_MAGIC, struct.pack(">I", len(hjson)), hjson,
+                     payload])
+
+
+def decode_entry(blob: bytes, want_digest: str) -> Tuple[Dict, bytes]:
+    """Parse + verify one entry; raises :class:`StoreError` on any
+    mismatch (the caller quarantines)."""
+    if not blob.startswith(_MAGIC):
+        raise StoreError("bad magic (not a compile-cache entry)")
+    buf = io.BytesIO(blob[len(_MAGIC):])
+    raw_len = buf.read(4)
+    if len(raw_len) != 4:
+        raise StoreError("truncated header length")
+    (hlen,) = struct.unpack(">I", raw_len)
+    hjson = buf.read(hlen)
+    if len(hjson) != hlen:
+        raise StoreError("truncated header")
+    try:
+        header = json.loads(hjson)
+    except ValueError as e:
+        raise StoreError(f"unparseable header: {e}")
+    payload = buf.read()
+    if header.get("digest") != want_digest:
+        raise StoreError(
+            f"digest mismatch: header says {header.get('digest')!r}")
+    want_sha = header.get("payload_sha256")
+    got_sha = hashlib.sha256(payload).hexdigest()
+    if got_sha != want_sha:
+        raise StoreError(
+            f"payload sha256 mismatch (want {want_sha}, got {got_sha}) "
+            "— torn write or bit rot")
+    if header.get("tier") not in ("exec", "stablehlo", "alias"):
+        raise StoreError(f"unknown tier {header.get('tier')!r}")
+    return header, payload
+
+
+class DiskStore:
+    """The directory half of the cache.  Thread-safe; every public
+    method tolerates a concurrently-mutated directory (entries appear
+    and vanish under readers on a shared cache)."""
+
+    def __init__(self, root: str, cap_bytes: int = 0):
+        self.root = root
+        #: 0 = unbounded (the operator sized the volume instead)
+        self.cap_bytes = int(cap_bytes)
+        self._lock = threading.Lock()
+        self.evictions = 0
+        self.corrupt = 0
+        os.makedirs(root, exist_ok=True)
+
+    # ---- paths --------------------------------------------------------
+
+    def path(self, digest: str) -> str:
+        return os.path.join(self.root, digest + ENTRY_SUFFIX)
+
+    # ---- read ---------------------------------------------------------
+
+    def load(self, digest: str) -> Optional[Tuple[Dict, bytes]]:
+        """(header, payload) for ``digest``, or None on miss.  A failed
+        verification quarantines the entry and reports a miss."""
+        p = self.path(digest)
+
+        def attempt():
+            if _chaos._ACTIVE:
+                _chaos.check("compile_cache.io")
+            try:
+                with open(p, "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                return None
+
+        try:
+            blob = _io_policy().call(attempt, site="compile_cache.load",
+                                     retry_on=(OSError,))
+        except (_retry.RetryExhausted, OSError):
+            # persistent IO failure reads as a miss: the caller
+            # compiles fresh — slow, never broken
+            return None
+        if blob is None:
+            return None
+        try:
+            header, payload = decode_entry(blob, digest)
+        except StoreError:
+            self.quarantine(digest)
+            return None
+        try:
+            os.utime(p)  # LRU recency: verified hits stay resident
+        except OSError:
+            pass  # mxlint: disable=MX007 — recency refresh is advisory
+        return header, payload
+
+    def touch(self, digest: str) -> None:
+        """Refresh an entry's LRU recency (best-effort; missing entry
+        = nothing to refresh)."""
+        try:
+            os.utime(self.path(digest))
+        except OSError:
+            return
+
+    def quarantine(self, digest: str) -> None:
+        """Move a failed entry aside (``*.corrupt``) so the next lookup
+        misses cleanly instead of re-verifying the same bad bytes, and
+        so the operator can post-mortem what happened."""
+        p = self.path(digest)
+        with self._lock:
+            self.corrupt += 1
+        try:
+            os.replace(p, p + _CORRUPT_SUFFIX)
+        except OSError:
+            # already quarantined/removed by a concurrent reader; the
+            # miss still stands
+            return
+
+    # ---- write --------------------------------------------------------
+
+    def store(self, digest: str, header: Dict, payload: bytes) -> int:
+        """Atomically write one entry; returns the bytes written.  The
+        header's ``digest`` field is stamped from the argument."""
+        header = dict(header, digest=digest)
+        blob = encode_entry(header, payload)
+        final = self.path(digest)
+        tmp = os.path.join(
+            self.root, f".tmp-{os.getpid()}-{next(_tmp_seq)}")
+
+        def attempt():
+            if _chaos._ACTIVE:
+                _chaos.check("compile_cache.io")
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, final)
+
+        try:
+            _io_policy().call(attempt, site="compile_cache.store",
+                              retry_on=(OSError,))
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass  # mxlint: disable=MX007 — tmp cleanup is best-effort
+        return len(blob)
+
+    # ---- capacity -----------------------------------------------------
+
+    def entries(self) -> List[Tuple[str, int, float]]:
+        """(path, bytes, mtime) for every live entry (tmp/corrupt files
+        excluded)."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        for name in names:
+            if not name.endswith(ENTRY_SUFFIX):
+                continue
+            p = os.path.join(self.root, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue  # vanished under us (concurrent eviction)
+            out.append((p, st.st_size, st.st_mtime))
+        return out
+
+    def bytes_on_disk(self) -> int:
+        return sum(size for _, size, _ in self.entries())
+
+    def evict(self) -> Tuple[int, int]:
+        """Enforce the byte cap: drop least-recently-used entries until
+        under it.  Returns ``(entries_removed, live_bytes_after)`` from
+        ONE directory scan — the caller feeds the bytes gauge from it
+        instead of paying a second walk per write.
+
+        The same scan is the maintenance sweep: stale ``.tmp-`` litter
+        from crashed writers (>1h old) and quarantined ``*.corrupt``
+        files past their post-mortem window (>24h) are removed here, so
+        neither class accumulates outside the byte cap."""
+        now = time.time()
+        ents: List[Tuple[str, int, float]] = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return 0, 0
+        for name in names:
+            p = os.path.join(self.root, name)
+            if name.endswith(ENTRY_SUFFIX):
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue  # vanished under us
+                ents.append((p, st.st_size, st.st_mtime))
+                continue
+            stale_after = 3600.0 if name.startswith(".tmp-") else \
+                86400.0 if name.endswith(_CORRUPT_SUFFIX) else None
+            if stale_after is not None:
+                try:
+                    if now - os.stat(p).st_mtime > stale_after:
+                        os.remove(p)
+                except OSError:
+                    continue  # racing cleaner
+        total = sum(size for _, size, _ in ents)
+        removed = 0
+        if self.cap_bytes > 0 and total > self.cap_bytes:
+            for p, size, _ in sorted(ents, key=lambda e: e[2]):
+                if total <= self.cap_bytes:
+                    break
+                try:
+                    os.remove(p)
+                except OSError:
+                    continue  # concurrent eviction got it first
+                total -= size
+                removed += 1
+            if removed:
+                with self._lock:
+                    self.evictions += removed
+        return removed, total
+
+    # ---- maintenance --------------------------------------------------
+
+    def sweep_tmp(self, older_than_s: float = 3600.0) -> int:
+        """Remove stale ``.tmp-`` files a crashed writer left behind."""
+        removed = 0
+        now = time.time()
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return 0
+        for name in names:
+            if not name.startswith(".tmp-"):
+                continue
+            p = os.path.join(self.root, name)
+            try:
+                if now - os.stat(p).st_mtime > older_than_s:
+                    os.remove(p)
+                    removed += 1
+            except OSError:
+                continue  # racing writer/cleaner
+        return removed

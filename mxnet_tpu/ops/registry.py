@@ -23,6 +23,7 @@ Design (idiomatic TPU, not a port):
 from __future__ import annotations
 
 import functools
+import itertools
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -218,39 +219,229 @@ def thaw_attrs(key: Tuple) -> dict:
 _jit_lock = threading.Lock()
 # mxsan annotations: reads are the optimistic half of the
 # double-checked idiom (deliberately lock-free); writes must stay
-# under _jit_lock — the sanitizer verifies exactly that at runtime
-_jit_cache: Dict[Tuple, Callable] = _mxsan.track(
+# under _jit_lock — the sanitizer verifies exactly that at runtime.
+# Values are _CacheEntry cells (callable + LRU tick); both caches are
+# BOUNDED by MXNET_OP_CACHE_MAX so attr-churning workloads (dynamic
+# shapes through reshape/slice attrs) cannot grow them without bound.
+_jit_cache: Dict[Tuple, "_CacheEntry"] = _mxsan.track(
     {}, "ops.registry._jit_cache", reads="unlocked-ok")
-_grad_cache: Dict[Tuple, Callable] = _mxsan.track(
+_grad_cache: Dict[Tuple, "_CacheEntry"] = _mxsan.track(
     {}, "ops.registry._grad_cache", reads="unlocked-ok")
+_cache_ticks = itertools.count(1)
+# all three counters mutate under _jit_lock (the _AotDispatch per-sig
+# evictions re-acquire it after their instance lock just to count)
+_cache_evictions = {"ops_jit": 0, "ops_grad": 0, "ops_aot": 0}
 
 # MXNET_ENGINE_TYPE=NaiveEngine → fully synchronous execution for debugging
 # (ref: src/engine/naive_engine.cc). Any other value = async (default).
 _NAIVE = env.get_str("MXNET_ENGINE_TYPE") == "NaiveEngine"
 
+# MXNET_COMPILE_CACHE_OPS=1 routes per-op executables through the
+# persistent compile cache (AOT per input signature).  Read once, like
+# _NAIVE; tests toggle via _refresh_ops_aot().
+_OPS_AOT = env.get_bool("MXNET_COMPILE_CACHE_OPS")
+
+
+def _refresh_ops_aot() -> bool:
+    """Re-read the knob and drop cached callables built under the old
+    mode (test hook; production reads the knob once at import)."""
+    global _OPS_AOT
+    _OPS_AOT = env.get_bool("MXNET_COMPILE_CACHE_OPS")
+    with _jit_lock:
+        _jit_cache.clear()
+        _grad_cache.clear()
+    return _OPS_AOT
+
+
+class _CacheEntry:
+    """Cached jit/grad callable.  ``tick`` is LRU recency, refreshed by
+    a plain attribute write on the lock-free hit path; the eviction
+    scan under _jit_lock reads it."""
+
+    __slots__ = ("fn", "tick")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.tick = next(_cache_ticks)
+
+
+def _cache_hit(cache: Dict[Tuple, "_CacheEntry"], key: Tuple):
+    e = cache.get(key)
+    if e is None:
+        return None
+    e.tick = next(_cache_ticks)
+    return e.fn
+
+
+def _cache_insert_locked(cache: Dict[Tuple, "_CacheEntry"], key: Tuple,
+                         fn: Callable, store: str) -> None:
+    """Insert + bounded-LRU eviction.  Caller holds _jit_lock (both
+    caches share it, matching the existing locking discipline)."""
+    cache[key] = _CacheEntry(fn)
+    cap = env.get_int("MXNET_OP_CACHE_MAX")
+    evicted = 0
+    while cap and len(cache) > cap:
+        oldest = min(cache.items(), key=lambda kv: kv[1].tick)[0]
+        if oldest == key:
+            break  # never evict what we just inserted
+        del cache[oldest]
+        _cache_evictions[store] += 1  # mxlint: disable=MX004 — caller holds _jit_lock
+        evicted += 1
+    if evicted:
+        _tinstruments.compile_cache_evict_total(store).inc(evicted)
+
+
+def _first_party_fn(fn: Callable) -> bool:
+    """Whether a registered op's implementation lives in this package
+    (gates alias-key eligibility — see _AotDispatch and
+    compile_cache.first_party, the one policy implementation)."""
+    from ..compile_cache import first_party
+
+    return first_party(getattr(fn, "__module__", ""))
+
+
+def cache_info() -> Dict[str, int]:
+    """Sizes + eviction counts of the in-process op executable caches
+    (the bounded-cache tests assert on this).  ``aot_evictions``
+    aggregates per-signature drops across every _AotDispatch wrapper
+    (MXNET_COMPILE_CACHE_OPS=1)."""
+    with _jit_lock:
+        return {"jit_entries": len(_jit_cache),
+                "grad_entries": len(_grad_cache),
+                "jit_evictions": _cache_evictions["ops_jit"],
+                "grad_evictions": _cache_evictions["ops_grad"],
+                "aot_evictions": _cache_evictions["ops_aot"]}
+
+
+class _AotDispatch:
+    """Opt-in wrapper (MXNET_COMPILE_CACHE_OPS=1): dispatches through
+    AOT-compiled executables obtained from the persistent compile
+    cache, one per concrete input signature.  Falls back to the lazy
+    ``jax.jit`` callable whenever an argument is not a committed
+    concrete ``jax.Array`` (python scalars, numpy, tracers) — AOT needs
+    exact avals, and correctness beats persistence.
+
+    ``use_alias=False`` (user-registered ops, i.e. ``op.fn`` outside
+    the ``mxnet_tpu`` namespace) disables the cheap alias index: an
+    alias key cannot see the op's implementation, and unlike
+    first-party code a user edit does not bump the framework version
+    that invalidates the store — the full program-text key (built
+    after lower) stays the only disk key, so a changed implementation
+    can never be served a stale executable."""
+
+    __slots__ = ("_site", "_lazy", "_ckey", "_per_sig", "_lock",
+                 "_use_alias")
+
+    def __init__(self, site: str, lazy: Callable, ckey: Tuple,
+                 use_alias: bool = True):
+        self._site = site
+        self._lazy = lazy
+        self._ckey = ckey
+        self._per_sig: Dict[Tuple, "_CacheEntry"] = {}
+        self._lock = threading.Lock()
+        self._use_alias = use_alias
+
+    def _sig(self, args) -> Optional[Tuple]:
+        leaves = jax.tree_util.tree_leaves(args)
+        parts = []
+        for a in leaves:
+            if not isinstance(a, jax.Array) or \
+                    isinstance(a, jax.core.Tracer):
+                return None
+            parts.append((tuple(a.shape), str(a.dtype),
+                          bool(a.weak_type),
+                          tuple(sorted(str(d) for d in a.devices()))))
+        return (jax.tree_util.tree_structure(args), tuple(parts))
+
+    def __call__(self, *args):
+        sig = self._sig(args)
+        if sig is None:
+            return self._lazy(*args)
+        ent = self._per_sig.get(sig)  # GIL-atomic instance-dict read
+        if ent is not None:
+            ent.tick = next(_cache_ticks)
+            return ent.fn(*args)
+        evicted = 0
+        with self._lock:
+            ent = self._per_sig.get(sig)
+            if ent is None:
+                from .. import compile_cache as _cc
+
+                cell = {}
+
+                def lowered():
+                    low = cell.get("lowered")
+                    if low is None:
+                        low = cell["lowered"] = \
+                            self._lazy.lower(*args)
+                    return low
+
+                # alias: op identity + attrs + avals — no tracing; a
+                # warm process dispatches its first op without
+                # lowering it (first-party ops only, see class doc)
+                alias = _cc.cache_key(
+                    "ops.alias", parts=(self._ckey, sig)) \
+                    if self._use_alias else None
+                fn, origin = _cc.get_or_compile(
+                    self._site,
+                    lambda: _cc.cache_key(
+                        "ops", parts=(self._ckey, sig),
+                        program_text=lowered().as_text()),
+                    lambda: lowered().compile(), alias=alias)
+                _mxsan.record_compile(
+                    self._site, (self._ckey, sig),
+                    provenance="build" if origin == "compiled"
+                    else "cache")
+                ent = self._per_sig[sig] = _CacheEntry(fn)
+                # same bound as the (op, attrs) caches: per-signature
+                # executables must not grow without limit under
+                # dynamic-shape workloads
+                cap = env.get_int("MXNET_OP_CACHE_MAX")
+                while cap and len(self._per_sig) > cap:
+                    oldest = min(self._per_sig.items(),
+                                 key=lambda kv: kv[1].tick)[0]
+                    if oldest == sig:
+                        break
+                    del self._per_sig[oldest]
+                    evicted += 1
+        if evicted:  # counting/telemetry outside the instance lock
+            with _jit_lock:
+                _cache_evictions["ops_aot"] += evicted
+            _tinstruments.compile_cache_evict_total("ops_aot").inc(
+                evicted)
+        return ent.fn(*args)
+
 
 def jitted(op: Operator, attrs_key: Tuple) -> Callable:
     key = (op.name, attrs_key)
-    fn = _jit_cache.get(key)
+    fn = _cache_hit(_jit_cache, key)
     if fn is None:
         with _jit_lock:
-            fn = _jit_cache.get(key)
+            fn = _cache_hit(_jit_cache, key)
             if fn is None:
                 attrs = thaw_attrs(attrs_key)
                 fn = jax.jit(functools.partial(op.fn, **attrs))
-                _jit_cache[key] = fn
-                # per-op site: a storm means ONE op's signatures churn
-                _mxsan.record_compile(f"ops.jit:{op.name}", attrs_key)
+                if _OPS_AOT:
+                    # compiles (and records) per concrete signature
+                    # inside the wrapper instead of here
+                    fn = _AotDispatch(
+                        f"ops.jit:{op.name}", fn, (op.name, attrs_key),
+                        use_alias=_first_party_fn(op.fn))
+                else:
+                    # per-op site: a storm means ONE op's sigs churn
+                    _mxsan.record_compile(f"ops.jit:{op.name}",
+                                          attrs_key)
+                _cache_insert_locked(_jit_cache, key, fn, "ops_jit")
     return fn
 
 
 def grad_fn(op: Operator, attrs_key: Tuple, argnums: Tuple[int, ...]) -> Callable:
     """Jitted vjp: (inputs, cotangents) -> grads for `argnums` inputs."""
     key = (op.name, attrs_key, argnums)
-    fn = _grad_cache.get(key)
+    fn = _cache_hit(_grad_cache, key)
     if fn is None:
         with _jit_lock:
-            fn = _grad_cache.get(key)
+            fn = _cache_hit(_grad_cache, key)
             if fn is None:
                 attrs = thaw_attrs(attrs_key)
                 f = functools.partial(op.fn, **attrs)
@@ -266,9 +457,15 @@ def grad_fn(op: Operator, attrs_key: Tuple, argnums: Tuple[int, ...]) -> Callabl
                     return vjp(cts)
 
                 fn = jax.jit(_vjp)
-                _grad_cache[key] = fn
-                _mxsan.record_compile(f"ops.grad:{op.name}",
-                                      (attrs_key, argnums))
+                if _OPS_AOT:
+                    fn = _AotDispatch(
+                        f"ops.grad:{op.name}", fn,
+                        (op.name, attrs_key, argnums),
+                        use_alias=_first_party_fn(op.fn))
+                else:
+                    _mxsan.record_compile(f"ops.grad:{op.name}",
+                                          (attrs_key, argnums))
+                _cache_insert_locked(_grad_cache, key, fn, "ops_grad")
     return fn
 
 

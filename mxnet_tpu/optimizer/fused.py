@@ -41,10 +41,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import itertools
+
 from ..analysis import sanitizer as _mxsan
 from ..ndarray.ndarray import NDArray
 from ..telemetry import instruments as _ins
 from ..telemetry import tracing as _tracing
+from ..util import env as _env
+from .. import compile_cache as _cc
 from .optimizer import Optimizer, Updater
 
 __all__ = ["FusedUpdater", "FusedUnsupported", "compile_stats"]
@@ -59,20 +63,44 @@ class FusedUnsupported(Exception):
 # signatures share one compiled program.  mxsan: lock-free reads are
 # the design (update_all probes before compiling); writes stay under
 # _CACHE_LOCK — the sanitizer checks the write half at runtime.
-_CACHE: Dict[Tuple, Any] = _mxsan.track(
+# Values are _Entry cells (executable + LRU tick); the cache is BOUNDED
+# by MXNET_FUSED_CACHE_MAX — a long-lived trainer process cycling
+# through tree structures (eval loops, growing models) must not hold
+# every executable it ever built.
+_CACHE: Dict[Tuple, "_Entry"] = _mxsan.track(
     {}, "optimizer.fused._CACHE", reads="unlocked-ok")
 _CACHE_LOCK = threading.Lock()
 _COMPILES = 0
 _COMPILE_SECONDS = 0.0
+_CACHE_LOADS = 0
+_EVICTIONS = 0
+_TICKS = itertools.count(1)
+
+
+class _Entry:
+    """One cached executable.  ``tick`` is LRU recency — refreshed by
+    an attribute write on the hot path (no lock, no dict mutation; the
+    eviction scan under _CACHE_LOCK reads it)."""
+
+    __slots__ = ("fn", "tick")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.tick = next(_TICKS)
 
 
 def compile_stats() -> Dict[str, float]:
     """How many fused-step executables were built in this process (and
     the wall seconds spent building them).  The no-recompile guarantee
     is asserted against this counter — and against the
-    ``mx_fused_compile_seconds`` histogram, which mirrors it."""
+    ``mx_fused_compile_seconds`` histogram, which mirrors it.
+    ``cache_loads`` counts executables served by the persistent compile
+    cache instead of XLA; ``evictions`` counts LRU drops past
+    MXNET_FUSED_CACHE_MAX."""
     with _CACHE_LOCK:
-        return {"count": _COMPILES, "seconds_total": _COMPILE_SECONDS}
+        return {"count": _COMPILES, "seconds_total": _COMPILE_SECONDS,
+                "cache_loads": _CACHE_LOADS, "evictions": _EVICTIONS,
+                "size": len(_CACHE)}
 
 
 def _state_data(s):
@@ -213,8 +241,11 @@ class FusedUpdater(Updater):
                donate, str(dev), treedef,
                tuple(_leaf_aval(x) for x in leaves))
 
-        fn = _CACHE.get(sig)
-        if fn is None:
+        ent = _CACHE.get(sig)
+        if ent is not None:
+            ent.tick = next(_TICKS)  # LRU recency, lock-free
+            fn = ent.fn
+        else:
             fn = self._compile(sig, args, mp_flags, donate)
         new_w, new_s = fn(*args)
 
@@ -224,24 +255,75 @@ class FusedUpdater(Updater):
             _rebind_state(s, ns)
 
     def _compile(self, sig, args, mp_flags, donate):
-        global _COMPILES, _COMPILE_SECONDS
-        step = _build_step(self.optimizer, tuple(mp_flags))
-        jitted = jax.jit(step, donate_argnums=(0, 2) if donate else ())
+        global _COMPILES, _COMPILE_SECONDS, _CACHE_LOADS, _EVICTIONS
         t0 = time.perf_counter()
-        compiled = jitted.lower(*args).compile()
+        cell = {}
+
+        def build_lowered():
+            lowered = cell.get("lowered")
+            if lowered is None:
+                step = _build_step(self.optimizer, tuple(mp_flags))
+                jitted = jax.jit(
+                    step, donate_argnums=(0, 2) if donate else ())
+                lowered = cell["lowered"] = jitted.lower(*args)
+            return lowered
+
+        if _cc.enabled():
+            # persistent tier: a fresh process (preemption restart)
+            # takes its first fused step from disk, not from XLA.  The
+            # ALIAS key is the in-process sig (class/statics/treedef/
+            # avals/device — cheap, no tracing); a warm restart skips
+            # trace+lower entirely.  The full key (alias miss only)
+            # adds the lowered program text.  First-party optimizers
+            # only: the framework version in the key fingerprint pins
+            # THEIR math, but a user's Optimizer subclass can change
+            # without it — those always key by the lowered program.
+            alias = _cc.cache_key(
+                "optimizer.fused_step.alias", parts=(sig,)) \
+                if _cc.first_party(type(self.optimizer).__module__) \
+                else None
+
+            def full_key():
+                return _cc.cache_key(
+                    "optimizer.fused_step", parts=(sig,),
+                    program_text=build_lowered().as_text())
+
+            compiled, origin = _cc.get_or_compile(
+                "optimizer.fused_step", full_key,
+                lambda: build_lowered().compile(), alias=alias)
+        else:
+            compiled, origin = build_lowered().compile(), "compiled"
         dt = time.perf_counter() - t0
         with _CACHE_LOCK:
             # a concurrent compile of the same signature may have won;
             # keep the first so the compile count matches the cache
             prior = _CACHE.get(sig)
             if prior is not None:
-                return prior
-            _CACHE[sig] = compiled
-            _COMPILES += 1
-            _COMPILE_SECONDS += dt
-        # always counted, never gated (serving-compile precedent): a
-        # recompile on the training hot path is the thing to watch
-        _ins.fused_compile_seconds().observe(dt)
-        _tracing.record_complete("fused-compile", "training", t0, dt)
-        _mxsan.record_compile("optimizer.fused_step", sig, dt)
+                return prior.fn
+            _CACHE[sig] = _Entry(compiled)
+            if origin == "compiled":
+                _COMPILES += 1
+                _COMPILE_SECONDS += dt
+            else:
+                _CACHE_LOADS += 1
+            cap = _env.get_int("MXNET_FUSED_CACHE_MAX")
+            evicted = 0
+            while cap and len(_CACHE) > cap:
+                oldest = min(_CACHE.items(),
+                             key=lambda kv: kv[1].tick)[0]
+                if oldest == sig:
+                    break  # never evict what we just inserted
+                del _CACHE[oldest]
+                _EVICTIONS += 1
+                evicted += 1
+        if evicted:  # telemetry outside _CACHE_LOCK
+            _ins.compile_cache_evict_total("fused").inc(evicted)
+        if origin == "compiled":
+            # always counted, never gated (serving-compile precedent):
+            # a recompile on the training hot path is the thing to watch
+            _ins.fused_compile_seconds().observe(dt)
+            _tracing.record_complete("fused-compile", "training", t0, dt)
+        _mxsan.record_compile("optimizer.fused_step", sig, dt,
+                              provenance="build" if origin == "compiled"
+                              else "cache")
         return compiled

@@ -26,6 +26,7 @@ from ..resilience import chaos as _chaos
 from ..resilience.breaker import CircuitBreaker
 from ..telemetry import instruments as _ins
 from ..telemetry import tracing as _tracing
+from .. import compile_cache as _cc
 from . import ModelNotFound, ServingError
 from .metrics import ModelMetrics
 
@@ -57,6 +58,59 @@ class _ModelEntry:
         # degrade-don't-die: consecutive executor failures open this
         # and the server 503s THIS model while the process serves on
         self.breaker = CircuitBreaker(name, version)
+        # zero-downtime rollover bookkeeping: requests hold a use-count
+        # from admission to completion; a retired entry (no longer the
+        # default after ModelRepository.rollover) releases its artifact
+        # + executables when the LAST in-flight request finishes —
+        # never under one
+        self._inflight = 0
+        self._retired = False
+        self._program_fp: Optional[str] = None  # lazy content hash
+
+    # ---- rollover lifecycle -------------------------------------------
+
+    def begin_use(self) -> "_ModelEntry":
+        """One in-flight request starts on this entry (the server holds
+        a use across the request; execute() holds one per launch)."""
+        with self._lock:
+            self._inflight += 1
+        return self
+
+    def end_use(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+            if self._retired and self._inflight == 0:
+                self._release_locked()
+
+    def retire(self) -> None:
+        """This entry lost the default slot: release its executors as
+        soon as the in-flight requests drain (now, if none).  The entry
+        stays in the repository — an explicit-version request later
+        simply re-imports lazily."""
+        with self._lock:
+            self._retired = True
+            if self._inflight == 0:
+                self._release_locked()
+
+    def unretire(self) -> None:
+        with self._lock:
+            self._retired = False
+
+    @property
+    def retired(self) -> bool:
+        with self._lock:
+            return self._retired
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def _release_locked(self) -> None:
+        """Drop the imported artifact and every compiled executable
+        (caller holds self._lock).  With a persistent compile cache
+        configured, a comeback costs a disk load, not a compile."""
+        self._served = None
+        self._executables.clear()
 
     # ---- lazy artifact ------------------------------------------------
 
@@ -103,21 +157,45 @@ class _ModelEntry:
     def coalescable(self) -> bool:
         """Whether requests may share a launch: every output leaf must
         be batch-major (leading dim = the shared batch), otherwise rows
-        cannot be handed back per request."""
-        exported = self.served.exported
+        cannot be handed back per request.  Answered from the meta's
+        recorded output avals when present (so a warm process never
+        deserializes the StableHLO just to decide this); legacy
+        artifacts fall back to the exported program."""
         fixed = self.fixed_batch()
         if not self.dynamic_batch and fixed is None:
             return False  # batchable inputs disagree on dim0
-        for aval in exported.out_avals:
-            if not aval.shape:
+        outs = self.meta.get("outputs")
+        if outs is None:  # pre-"outputs" artifact: needs the program
+            outs = [{"shape": list(aval.shape)}
+                    for aval in self.served.exported.out_avals]
+        for o in outs:
+            shape = o["shape"]
+            if not shape:
                 return False  # scalar output: no rows to split
-            d0 = aval.shape[0]
+            d0 = shape[0]
             if isinstance(d0, int):
                 # dynamic export: an int leading dim did not come from
                 # the symbolic batch; fixed export: must equal it
                 if self.dynamic_batch or d0 != fixed:
                     return False
         return True
+
+    def _program_fingerprint(self) -> str:
+        """sha256 of the artifact's serialized program — the cheap
+        content identity the compile-cache ALIAS key uses (hashing the
+        bytes is milliseconds; deserializing them is the dominant
+        import cost the alias exists to skip)."""
+        fp = getattr(self, "_program_fp", None)
+        if fp is None:
+            import hashlib
+
+            h = hashlib.sha256()
+            with open(os.path.join(self.path, "model.stablehlo"),
+                      "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            fp = self._program_fp = h.hexdigest()
+        return fp
 
     def allowed_buckets(self, ladder: List[int]) -> List[int]:
         """Clamp the configured ladder to what the artifact can serve:
@@ -141,7 +219,7 @@ class _ModelEntry:
                 self.cache_hits += 1
                 self.metrics.bump("cache_hits")
                 return fn
-        compiled = self._compile(bucket)  # compile OUTSIDE the lock
+        compiled, origin = self._compile(bucket)  # OUTSIDE the lock
         with self._lock:
             # a concurrent compile of the same bucket may have won;
             # keep the first so "compiles at most once" stays true for
@@ -150,32 +228,40 @@ class _ModelEntry:
             self.cache_misses += 1
             self.metrics.bump("cache_misses")
         # mxsan keys on the INSERT (losing a by-design concurrent
-        # duplicate build must not read as a cache failure)
+        # duplicate build must not read as a cache failure); a
+        # persistent-cache load is provenance "cache" — a warm restart
+        # rebuilding every bucket from disk is not a recompile storm
         _mxsan.record_compile(self._san_site,
-                              bucket if fn is compiled else None)
+                              bucket if fn is compiled else None,
+                              provenance="build" if origin == "compiled"
+                              else "cache")
         return fn
 
     def _compile(self, bucket: int):
         t0 = time.perf_counter()
-        compiled = self._compile_impl(bucket)
+        compiled, origin = self._compile_impl(bucket)
         dt = time.perf_counter() - t0
-        # always counted, never gated: a compile on the serving path is
-        # the silent TPU latency killer — each one must be visible in
-        # the next /metrics scrape
-        _ins.serving_compile_total(self.name, self.version).inc()
-        _ins.serving_compile_seconds(self.name, self.version).observe(dt)
+        if origin == "compiled":
+            # always counted, never gated: a compile on the serving
+            # path is the silent TPU latency killer — each one must be
+            # visible in the next /metrics scrape
+            _ins.serving_compile_total(self.name, self.version).inc()
+            _ins.serving_compile_seconds(self.name,
+                                         self.version).observe(dt)
         _tracing.record_complete(
-            "aot-compile", "serving", t0, dt,
+            "aot-compile" if origin == "compiled" else "aot-cache-load",
+            "serving", t0, dt,
             args={"model": self.name, "version": self.version,
-                  "bucket": bucket})
-        return compiled
+                  "bucket": bucket, "origin": origin})
+        return compiled, origin
 
     def _compile_impl(self, bucket: int):
+        """(executable, origin) — origin "compiled" means XLA ran;
+        "memory"/"disk" mean the persistent compile cache served it."""
         import jax
         import jax.numpy as jnp
 
         served = self.served
-        exported = served.exported
         if not self.dynamic_batch:
             fixed = self.fixed_batch()
             if fixed is not None and bucket != fixed:
@@ -192,41 +278,103 @@ class _ModelEntry:
         p_structs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
                           for v in served.param_values)
         key_struct = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        in_avals = tuple((tuple(s.shape), str(s.dtype))
+                         for s in in_structs)
+        p_avals = tuple((tuple(s.shape), str(s.dtype))
+                        for s in p_structs)
 
-        def fn(params, key, *xs):
-            return exported.call(params, key, *xs)
+        cell = {}
 
-        return jax.jit(fn).lower(p_structs, key_struct,
-                                 *in_structs).compile()
+        def build_lowered():
+            lowered = cell.get("lowered")
+            if lowered is None:
+                # touching .exported deserializes the StableHLO — the
+                # cold path pays it once here, the alias-warm path
+                # never does
+                exported = served.exported
+
+                def fn(params, key, *xs):
+                    return exported.call(params, key, *xs)
+
+                lowered = cell["lowered"] = jax.jit(fn).lower(
+                    p_structs, key_struct, *in_structs)
+            return lowered
+
+        def compile_fn():
+            return build_lowered().compile()
+
+        if not _cc.enabled():
+            return compile_fn(), "compiled"
+
+        # content-addressed, deliberately name/version-free: the keys
+        # are the program + avals, so the same artifact deployed under
+        # a new version (rollover) or another name reuses the warmed
+        # executable.  The ALIAS key costs a file hash; the full key
+        # (built only when the alias misses) costs trace+lower.
+        alias = _cc.cache_key(
+            "serving.bucket.alias",
+            parts=(self._program_fingerprint(), bucket, in_avals,
+                   p_avals))
+
+        def full_key():
+            return _cc.cache_key(
+                "serving.bucket",
+                parts=(bucket, in_avals, p_avals),
+                program_text=build_lowered().as_text())
+
+        return _cc.get_or_compile(
+            f"serving:{self.name}/v{self.version}", full_key,
+            compile_fn, alias=alias)
 
     def execute(self, bucket: int, xs, seed: int = 0) -> list:
         """Run one padded batch through the bucket's executable;
-        returns the FLAT output leaves (tree-flatten order)."""
+        returns the FLAT output leaves (tree-flatten order).  Holds a
+        use-count for the launch so a concurrent rollover never
+        releases this entry's executors mid-flight."""
         import jax
 
-        if _chaos._ACTIVE:
-            _chaos.check("serving.execute")
-        fn = self.executable(bucket)
-        key = jax.random.PRNGKey(seed)
-        outs = fn(self.served.param_values, key, *xs)
-        return list(outs)
+        self.begin_use()
+        try:
+            if _chaos._ACTIVE:
+                _chaos.check("serving.execute")
+            fn = self.executable(bucket)
+            key = jax.random.PRNGKey(seed)
+            outs = fn(self.served.param_values, key, *xs)
+            return list(outs)
+        finally:
+            self.end_use()
 
     def warmup(self, ladder: Optional[List[int]] = None) -> None:
         """Compile ahead of traffic: the smallest allowed bucket by
-        default (first-request latency otherwise includes a compile)."""
-        buckets = self.allowed_buckets(ladder or [1])
-        self.executable(buckets[0])
+        default (first-request latency otherwise includes a compile).
+        Holds a use-count like a request, so a warmup racing a
+        rollover that retires this entry still ends with the entry
+        released (end_use re-runs the release once the warmup
+        finishes)."""
+        self.begin_use()
+        try:
+            buckets = self.allowed_buckets(ladder or [1])
+            self.executable(buckets[0])
+        finally:
+            self.end_use()
 
 
 class ModelRepository:
     """Name -> version -> _ModelEntry.  Thread-safe; lookups default to
-    the latest version."""
+    the latest version unless :meth:`rollover` pinned one."""
 
     def __init__(self):
         self._lock = threading.Lock()
         # mxsan: every repository access holds self._lock
         self._models: Dict[str, Dict[int, _ModelEntry]] = _mxsan.track(
             {}, "serving.ModelRepository._models")
+        # name -> pinned default version (rollover); absent = latest
+        self._default: Dict[str, int] = _mxsan.track(
+            {}, "serving.ModelRepository._default")
+        # serializes whole rollovers (pin + entry transitions): two
+        # racing rollovers must not interleave their retire/unretire
+        # calls, which would leave the winning default retired
+        self._rollover_lock = threading.Lock()
 
     def add(self, name: str, path: str,
             version: Optional[int] = None) -> int:
@@ -268,13 +416,72 @@ class ModelRepository:
                 raise ModelNotFound(f"unknown model {name!r}; loaded: "
                                     f"{sorted(self._models)}")
             if version is None:
-                version = max(versions)
+                version = self._default_version_locked(name, versions)
             entry = versions.get(version)
             if entry is None:
                 raise ModelNotFound(
                     f"model {name!r} has versions {sorted(versions)}, "
                     f"not {version}")
         return entry
+
+    def _default_version_locked(self, name: str, versions) -> int:
+        v = self._default.get(name)
+        # a pinned default that was since removed falls back to latest
+        return v if v is not None and v in versions else max(versions)
+
+    def default_version(self, name: str) -> int:
+        """The version a version-less request serves right now."""
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise ModelNotFound(f"unknown model {name!r}")
+            return self._default_version_locked(name, versions)
+
+    def rollover(self, name: str, version: Optional[int] = None) -> int:
+        """Zero-downtime version swap.  Atomically pins ``version``
+        (latest when None) as the default, so every new version-less
+        request lands on it — and because it is PINNED, a later
+        :meth:`add` of a newer version no longer shifts traffic until
+        the next rollover (the stage-then-swap deploy workflow).  Every
+        OTHER version keeps serving its in-flight requests on its
+        existing executors and releases them (artifact + compiled
+        buckets) once the last one finishes; explicit-version requests
+        for a retired version still work, re-importing lazily.
+
+        The swap itself is one dict write under the repository lock —
+        requests never observe a state with no default.  Rolling *back*
+        is the same call with the old version number.  Returns the new
+        default version.
+
+        Concurrent rollovers of one repository serialize on a
+        dedicated lock so their entry transitions cannot interleave
+        (last pin wins, and the entry states always match the final
+        pin)."""
+        with self._rollover_lock:
+            return self._rollover_locked(name, version)
+
+    def _rollover_locked(self, name: str,
+                         version: Optional[int]) -> int:
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise ModelNotFound(f"unknown model {name!r}; loaded: "
+                                    f"{sorted(self._models)}")
+            if version is None:
+                version = max(versions)
+            new = versions.get(version)
+            if new is None:
+                raise ModelNotFound(
+                    f"model {name!r} has versions {sorted(versions)}, "
+                    f"not {version}")
+            others = [e for v, e in versions.items() if v != version]
+            self._default[name] = version
+        # entry state transitions OUTSIDE the repository lock (each
+        # entry has its own lock; retire may release executors)
+        new.unretire()
+        for e in others:
+            e.retire()
+        return version
 
     def entries(self) -> List[_ModelEntry]:
         with self._lock:

@@ -144,12 +144,17 @@ class InferenceServer:
             if adm is not None:
                 adm.finish()
             raise
+        # rollover pin: this request finishes on THIS entry's executors
+        # even if a version swap retires it mid-flight (the entry only
+        # releases artifact+executables once its last use ends)
+        entry.begin_use()
 
         def _release():
             with self._lock:
                 self._pending -= 1
                 self._pending_per[key] -= 1
                 m.gauge("queue_depth", self._pending_per[key])
+            entry.end_use()  # outside self._lock (entry has its own)
 
         t0 = time.monotonic()
         if timeout_ms is None:

@@ -25,6 +25,9 @@ __all__ = [
     "data_wait_seconds", "data_wait_last_seconds",
     "collective_seconds",
     "retry_total", "fault_injected_total",
+    "compile_cache_hit_total", "compile_cache_miss_total",
+    "compile_cache_evict_total", "compile_cache_load_seconds",
+    "compile_cache_bytes",
     "breaker_state", "breaker_open_total",
     "serving_counter", "serving_queue_depth", "serving_occupancy",
     "serving_request_latency", "serving_compile_total",
@@ -152,6 +155,47 @@ def breaker_open_total(model: str, version):
     return _child("mx_breaker_open_total", "counter",
                   "Circuit-breaker trips (CLOSED/HALF-OPEN -> OPEN).",
                   ("model", "version"), (model, str(version)))
+
+
+# ---- compile cache ----------------------------------------------------
+
+def compile_cache_hit_total(site: str, tier: str):
+    return _child("mx_compile_cache_hit_total", "counter",
+                  "Persistent compile-cache hits by site and tier "
+                  "(memory / exec / stablehlo). An exec hit skipped an "
+                  "XLA compilation entirely.",
+                  ("site", "tier"), (site, tier))
+
+
+def compile_cache_miss_total(site: str):
+    return _child("mx_compile_cache_miss_total", "counter",
+                  "Persistent compile-cache misses (a fresh XLA "
+                  "compile ran). Sustained misses on a warmed fleet "
+                  "mean the key drifted — check jax/artifact versions.",
+                  ("site",), (site,))
+
+
+def compile_cache_evict_total(store: str):
+    return _child("mx_compile_cache_evict_total", "counter",
+                  "Compile-cache evictions by store (disk = the "
+                  "MXNET_COMPILE_CACHE_BYTES cap; memory = the "
+                  "in-process digest tier; fused / ops_jit / ops_grad "
+                  "/ ops_aot = the bounded per-site executable "
+                  "caches).",
+                  ("store",), (store,))
+
+
+def compile_cache_load_seconds():
+    return _child("mx_compile_cache_load_seconds", "histogram",
+                  "Seconds to load+deserialize one exec-tier entry "
+                  "from disk — the warm-start cost that replaces a "
+                  "compile.")
+
+
+def compile_cache_bytes():
+    return _child("mx_compile_cache_bytes", "gauge",
+                  "Bytes of live entries in the on-disk compile "
+                  "cache.")
 
 
 # ---- analysis ---------------------------------------------------------

@@ -196,6 +196,34 @@ declare("MXNET_USE_PALLAS", bool, True,
         "Conv+BN). 0 selects the XLA fallbacks with identical "
         "semantics.")
 
+# -- compile cache ----------------------------------------------------------
+declare("MXNET_COMPILE_CACHE_BYTES", int, 0,
+        "Byte cap for the on-disk compile cache; least-recently-used "
+        "entries are evicted past it. 0 = unbounded (size the volume "
+        "instead).")
+declare("MXNET_COMPILE_CACHE_DIR", str, "",
+        "Directory of the persistent (cross-process) AOT executable "
+        "cache. Empty = persistent cache off; call sites keep their "
+        "in-process caches either way. See docs/compile_cache.md.")
+declare("MXNET_COMPILE_CACHE_DISABLE", bool, False,
+        "Kill switch: 1 ignores MXNET_COMPILE_CACHE_DIR and compiles "
+        "everything fresh (e.g. when a shared cache volume is "
+        "suspected bad).")
+declare("MXNET_COMPILE_CACHE_OPS", bool, False,
+        "Opt-in: route the ops-registry jit/grad executables through "
+        "the persistent compile cache (AOT per input signature). "
+        "Serving buckets and the fused optimizer step use the cache "
+        "whenever MXNET_COMPILE_CACHE_DIR is set; eager per-op "
+        "programs are many and small, so they are opt-in.")
+declare("MXNET_FUSED_CACHE_MAX", int, 256,
+        "Entry cap of the in-process FusedUpdater executable cache "
+        "(LRU eviction past it). One entry per optimizer/tree/shape "
+        "signature per device.")
+declare("MXNET_OP_CACHE_MAX", int, 4096,
+        "Entry cap of each in-process ops-registry executable cache "
+        "(jit and grad, LRU eviction past it). One entry per "
+        "(op, attrs) — plus signature when MXNET_COMPILE_CACHE_OPS=1.")
+
 # -- resilience -------------------------------------------------------------
 declare("MXNET_BREAKER_COOLDOWN_MS", float, 1000.0,
         "Serving circuit breaker: milliseconds an OPEN breaker waits "

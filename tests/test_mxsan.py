@@ -325,6 +325,73 @@ class TestRecompile:
                 mxsan.record_compile("seed.unkeyed", key=None)
             assert kinds(s) == ["recompile-storm"]
 
+    def test_cache_provenance_is_never_a_storm(self):
+        """ISSUE 7: a persistent-compile-cache load (disk or memory
+        tier) repeats keys by DESIGN — a warm restart rebuilds every
+        executable from the store.  provenance="cache" must feed
+        neither the duplicate-key nor the warmup detector, while still
+        being tallied for the report."""
+        with mxsan.scope(recompile_warmup=3) as s:
+            mxsan.record_compile("seed.cache", key=("sig",))
+            for _ in range(5):  # warm reloads of the same signature
+                mxsan.record_compile("seed.cache", key=("sig",),
+                                     provenance="cache")
+            assert s.violations() == []
+            for i in range(10):  # bulk warm loads: not a storm either
+                mxsan.record_compile("seed.cache", key=(i,),
+                                     provenance="cache")
+            assert s.violations() == []
+            rec = s.compile_sites["seed.cache"]
+            assert rec["cache_loads"] == 15
+            assert rec["count"] == 1  # only the real build counted
+            # ...and a REAL duplicate build still fires
+            mxsan.record_compile("seed.cache", key=("sig",))
+            assert kinds(s) == ["recompile-storm"]
+
+    def test_cache_loads_surface_in_report(self):
+        from mxnet_tpu.analysis.sanitizer import report as sreport
+
+        with mxsan.scope() as s:
+            mxsan.record_compile("seed.rep", key=(1,))
+            mxsan.record_compile("seed.rep", key=(1,),
+                                 provenance="cache")
+            doc = sreport.render_json(s)
+        site = doc["compile_sites"]["seed.rep"]
+        assert site["count"] == 1 and site["cache_loads"] == 1
+
+    def test_serving_disk_hit_under_sanitizer_is_clean(self, tmp_path):
+        """Integration: rebuild a serving bucket from the persistent
+        cache (the eviction/rollover-release path) under an active
+        sanitizer — zero violations, and the cache load is visible at
+        the entry's compile site."""
+        import numpy as np
+
+        from mxnet_tpu import compile_cache as cc
+        from mxnet_tpu import nd, serving
+        from mxnet_tpu.contrib import deploy
+        from mxnet_tpu.gluon import nn
+
+        net = nn.Dense(4, in_units=6, prefix="sanccl_")
+        net.initialize(ctx=mx.cpu())
+        x = nd.array(np.random.RandomState(0).rand(2, 6).astype("f4"))
+        art = str(tmp_path / "art")
+        deploy.export_model(net, art, [x], dynamic_batch=True)
+        cc.reset(cc.CompileCache(disk_dir=str(tmp_path / "cache")))
+        try:
+            repo = serving.ModelRepository()
+            repo.add("m", art)
+            e = repo.get("m")
+            with mxsan.scope() as s:
+                e.execute(2, [x.data])       # real build
+                with e._lock:
+                    e._executables.clear()   # simulate release
+                e.execute(2, [x.data])       # cache reload, same key
+                assert s.violations() == []
+                rec = s.compile_sites[e._san_site]
+                assert rec["cache_loads"] == 1
+        finally:
+            cc.reset()
+
     def test_ops_registry_cache_loss_is_runtime_detected(self, san):
         # ground truth for what MX001 guesses statically: force the jit
         # cache to lose an entry and the SAME signature recompiles

@@ -543,3 +543,98 @@ def test_concurrent_clients_all_get_correct_rows(artifact):
     snap = srv.metrics()["models"][0]
     assert snap["completed"] == 30
     srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# zero-downtime version rollover (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+def test_rollover_pins_default_and_releases_old_version(artifact):
+    repo = serving.ModelRepository()
+    repo.add("m", artifact)           # v1
+    repo.add("m", artifact, version=2)
+    assert repo.default_version("m") == 2  # unpinned default = latest
+    e1, e2 = repo.get("m", 1), repo.get("m", 2)
+    x = nd.array(np.random.RandomState(5).rand(2, 8).astype("float32"))
+    e1.execute(2, [x.data])           # warm v1: artifact + executor
+    assert e1._served is not None and len(e1._executables) == 1
+
+    assert repo.rollover("m", 2) == 2
+    assert repo.get("m") is e2 and repo.default_version("m") == 2
+    # v1 had no traffic in flight: released immediately
+    assert e1.retired
+    assert e1._served is None and len(e1._executables) == 0
+    # explicit-version stragglers still work (lazy re-import)
+    out = e1.execute(2, [x.data])
+    assert np.asarray(out[0]).shape == (2, 4)
+    # pinned: adding a NEWER version must not shift traffic anymore
+    repo.add("m", artifact, version=3)
+    assert repo.get("m") is e2
+    # ...until the next rollover (here: a rollback to v1)
+    repo.rollover("m", 1)
+    assert repo.get("m") is e1 and not e1.retired and e2.retired
+
+
+def test_rollover_concurrent_swap_drains_then_releases(artifact):
+    """The concurrent-swap contract: a request in flight on the old
+    version finishes on the old version's executors; the release
+    happens after it completes, never under it."""
+    from mxnet_tpu.resilience import chaos
+
+    repo = serving.ModelRepository()
+    repo.add("m", artifact)
+    repo.add("m", artifact, version=2)
+    e1, e2 = repo.get("m", 1), repo.get("m", 2)
+    x = nd.array(np.random.RandomState(6).rand(2, 8).astype("float32"))
+    e1.execute(2, [x.data])  # warm v1
+    want = np.asarray(e1.execute(2, [x.data])[0])
+
+    results, entered = {}, threading.Event()
+
+    def long_request():
+        entered.set()
+        # the chaos hang keeps THIS request in flight while the swap
+        # lands on the main thread
+        results["out"] = e1.execute(2, [x.data])
+
+    with chaos.inject("serving.execute", at=1, action="hang",
+                      duration=0.6):
+        t = threading.Thread(target=long_request)
+        t.start()
+        entered.wait(10)
+        time.sleep(0.15)  # the request is inside the hang window
+        assert repo.rollover("m", 2) == 2
+        assert repo.get("m") is e2
+        # in flight: retired but NOT released
+        assert e1.retired and e1.inflight() == 1
+        assert e1._served is not None and len(e1._executables) == 1
+        t.join(30)
+    # the old request completed correctly on the old executors...
+    np.testing.assert_allclose(np.asarray(results["out"][0]), want,
+                               rtol=1e-6)
+    # ...and ONLY then was the entry released
+    assert e1.inflight() == 0
+    assert e1._served is None and len(e1._executables) == 0
+
+
+def test_rollover_through_server_requests(artifact):
+    """End to end through InferenceServer: version-less requests follow
+    the pinned default across a rollover; nothing errors or drops."""
+    repo = serving.ModelRepository()
+    repo.add("m", artifact)
+    repo.add("m", artifact, version=2)
+    srv = serving.InferenceServer(repo, serving.ServingConfig(
+        max_batch_size=4, batch_timeout_ms=1.0))
+    x = nd.array(np.random.RandomState(7).rand(1, 8).astype("float32"))
+    try:
+        assert srv.infer("m", [x]).asnumpy().shape == (1, 4)  # on v2
+        repo.rollover("m", 1)
+        assert srv.infer("m", [x]).asnumpy().shape == (1, 4)  # on v1
+        repo.rollover("m", 2)
+        out = srv.infer("m", [x]).asnumpy()
+        assert out.shape == (1, 4)
+        # the retired v1 entry drained (no pending requests) and
+        # released its resources
+        assert repo.get("m", 1)._served is None
+    finally:
+        srv.shutdown(drain=True, timeout=10.0)

@@ -182,3 +182,27 @@ def test_bench_resilience_smoke(tmp_path):
     assert br["requests_failed_pre_trip"] \
         + br["requests_dropped_during_trip"] == 8
     assert json.loads(out.read_text()) == report
+
+
+def test_bench_compile_cache_smoke(tmp_path):
+    """CLI smoke only: the warm-start bench runs a cold/warm
+    subprocess pair and emits a well-formed report.  One scenario at
+    tiny sizes — tier-1 runs near its wall-clock cap; the strict
+    both-scenario >=3x-speedup / zero-warm-compiles gate lives in
+    tests/nightly/test_bench_compile_cache.py."""
+    out = tmp_path / "COMPILE_CACHE.json"
+    rows = _run([sys.executable, "tools/bench_compile_cache.py",
+                 "--no-gate", "--scenarios", "fused",
+                 "--params", "4", "--fused-units", "8",
+                 "--repeats", "1", "--out", str(out)], timeout=420)
+    report = rows[-1]
+    assert report["bench"] == "compile_cache"
+    assert "serving" not in report  # subset run stays a subset
+    r = report["fused"]
+    assert r["cold_first_step_s"] > 0 and r["warm_first_step_s"] > 0
+    # the structural invariants hold even at smoke sizes: cold
+    # compiled, warm did not (it loaded from disk instead)
+    assert r["cold_xla_compiles"] > 0
+    assert r["warm_xla_compiles"] == 0
+    assert r["warm_disk_hits"] > 0
+    assert json.loads(out.read_text()) == report

@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""Compile-cache bench (ISSUE 7 gate): measure what a FRESH PROCESS
+pays before its first unit of work, cold vs warm.
+
+Two scenarios, each timed inside a child process (the restart is the
+thing being measured — in-process numbers would flatter the cache):
+
+  * serving — construct a repository over a deploy artifact and serve
+    the first request.  Cold: empty cache directory (artifact import +
+    trace/lower + XLA compile).  Warm: the directory the cold child
+    just populated (import + lower + disk load; zero XLA compiles,
+    asserted via the serving compile counter).
+  * fused — construct a FusedUpdater and take the first optimizer
+    step.  Same cold/warm pair, asserted via
+    ``optimizer.fused.compile_stats()``.
+
+The measured window starts AFTER ``import mxnet_tpu`` and jax backend
+init in the child: interpreter startup is identical cold and warm, and
+the metric of record is "first request/step latency once the process
+is up" — the number a deploy budget uses.
+
+Gate (skipped with --no-gate, enforced strictly in
+tests/nightly/test_bench_compile_cache.py and by the run_nightly
+stage): warm serving must be >= --min-speedup (default 3x) faster than
+cold, warm fused >= --min-fused-speedup (default 1.2x), and BOTH warm
+children must report zero XLA compiles with at least one disk hit.
+
+CPU smoke: JAX_PLATFORMS=cpu python tools/bench_compile_cache.py --no-gate
+Writes COMPILE_CACHE.json (one JSON line also on stdout).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import numpy as np  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# child halves (re-exec'd: `bench_compile_cache.py --child serving ...`)
+# ---------------------------------------------------------------------------
+
+def child_serving(artifact: str, cache_dir: str, bucket: int,
+                  units: int) -> dict:
+    from mxnet_tpu import compile_cache as cc
+    from mxnet_tpu import nd, serving
+    from mxnet_tpu.telemetry import instruments as ins
+
+    import jax
+
+    cc.reset(cc.CompileCache(disk_dir=cache_dir))
+    x = nd.array(np.random.RandomState(7).rand(
+        bucket, units).astype("float32"))
+    # pre-warm jax machinery the MODEL's executable does not own
+    # (PRNGKey program, dispatch plumbing): identical cold and warm,
+    # and not something a compile cache could ever save — the measured
+    # window is the model-attributable first-request latency
+    jax.block_until_ready(jax.random.PRNGKey(0))
+
+    t0 = time.perf_counter()
+    repo = serving.ModelRepository()
+    repo.add("bench", artifact)
+    entry = repo.get("bench")
+    out = entry.execute(bucket, [x.data])
+    jax.block_until_ready(out)  # the response really materialized
+    first_request_s = time.perf_counter() - t0
+
+    return {
+        "first_request_s": first_request_s,
+        "xla_compiles": ins.serving_compile_total("bench", 1).value,
+        "cache": cc.stats(),
+    }
+
+
+def child_fused(cache_dir: str, params: int, units: int) -> dict:
+    from mxnet_tpu import compile_cache as cc
+    from mxnet_tpu import nd, optimizer as opt_mod
+    from mxnet_tpu.optimizer import fused
+
+    cc.reset(cc.CompileCache(disk_dir=cache_dir))
+    rng = np.random.RandomState(3)
+    shapes = [(units, units)] * params
+
+    t0 = time.perf_counter()
+    opt = opt_mod.create("sgd", learning_rate=0.05, momentum=0.9)
+    updater = fused.FusedUpdater(opt)
+    weights = [nd.array(rng.rand(*s).astype("float32"))
+               for s in shapes]
+    grads = [nd.array(rng.rand(*s).astype("float32")) for s in shapes]
+    updater.update_all(list(range(params)), grads, weights)
+    weights[0].asnumpy()  # sync: the step really finished
+    first_step_s = time.perf_counter() - t0
+
+    return {
+        "first_step_s": first_step_s,
+        "xla_compiles": fused.compile_stats()["count"],
+        "cache": cc.stats(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# parent: build artifact, run cold/warm children, gate
+# ---------------------------------------------------------------------------
+
+def _make_artifact(units: int, hidden: int, depth: int) -> str:
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.contrib import deploy
+    from mxnet_tpu.gluon import nn
+
+    art = tempfile.mkdtemp(prefix="mx-ccbench-art-")
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(hidden, activation="relu", in_units=units))
+        for _ in range(depth - 2):
+            net.add(nn.Dense(hidden, activation="relu",
+                             in_units=hidden))
+        net.add(nn.Dense(4, in_units=hidden))
+    net.initialize(ctx=mx.cpu())
+    x = nd.array(np.random.RandomState(0).rand(2, units).astype("f4"))
+    deploy.export_model(net, art, [x], dynamic_batch=True)
+    return art
+
+
+def _run_child(kind: str, repeats: int, fresh_dir_each: bool = False,
+               **kw) -> dict:
+    """Best-of-N child runs (first-request latency is noisy on a
+    shared CPU box; the best run is the least-interfered one).
+
+    ``fresh_dir_each`` is REQUIRED for cold measurements: a cold child
+    populates its cache directory, so a second repeat against the same
+    directory would silently measure the warm path."""
+    best = None
+    for _ in range(repeats):
+        run_kw = dict(kw)
+        if fresh_dir_each:
+            run_kw["cache_dir"] = tempfile.mkdtemp(
+                prefix="mx-ccbench-cold-")
+        argv = [sys.executable, os.path.abspath(__file__),
+                "--child", kind]
+        for k, v in run_kw.items():
+            argv += [f"--{k.replace('_', '-')}", str(v)]
+        p = subprocess.run(argv, capture_output=True, text=True,
+                           cwd=_REPO, timeout=600)
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"child {kind} failed:\n{p.stdout[-2000:]}"
+                f"\n{p.stderr[-2000:]}")
+        row = json.loads([ln for ln in p.stdout.splitlines()
+                          if ln.startswith("{")][-1])
+        metric = row.get("first_request_s", row.get("first_step_s"))
+        if best is None or metric < best[0]:
+            best = (metric, row)
+    return best[1]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", default=None,
+                    choices=("serving", "fused"))
+    ap.add_argument("--artifact", default=None)
+    ap.add_argument("--cache-dir", default=None)
+    ap.add_argument("--bucket", type=int, default=4)
+    ap.add_argument("--units", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=192)
+    ap.add_argument("--depth", type=int, default=48,
+                    help="dense layers in the serving artifact")
+    ap.add_argument("--params", type=int, default=64,
+                    help="parameter tensors in the fused scenario")
+    ap.add_argument("--fused-units", type=int, default=32)
+    ap.add_argument("--repeats", type=int, default=1,
+                    help="children per measurement (best-of)")
+    ap.add_argument("--scenarios", default="serving,fused",
+                    help="comma subset of serving,fused (the tier-1 "
+                    "smoke runs one scenario to stay cheap; the "
+                    "nightly gate runs both)")
+    ap.add_argument("--min-speedup", type=float, default=3.0)
+    ap.add_argument("--min-fused-speedup", type=float, default=1.2)
+    ap.add_argument("--no-gate", action="store_true",
+                    help="report only (tier-1 smoke); the strict gate "
+                    "runs in tests/nightly/test_bench_compile_cache.py")
+    ap.add_argument("--out", default=None,
+                    help="also write the report JSON here "
+                    "(COMPILE_CACHE.json)")
+    args = ap.parse_args()
+
+    if args.child == "serving":
+        print(json.dumps(child_serving(args.artifact, args.cache_dir,
+                                       args.bucket, args.units)))
+        return 0
+    if args.child == "fused":
+        print(json.dumps(child_fused(args.cache_dir, args.params,
+                                     args.fused_units)))
+        return 0
+
+    scenarios = [s.strip() for s in args.scenarios.split(",")
+                 if s.strip()]
+    bad = [s for s in scenarios if s not in ("serving", "fused")]
+    if bad:
+        ap.error(f"unknown scenario(s) {bad}")
+
+    report = {
+        "bench": "compile_cache",
+        "backend": "cpu" if os.environ.get("JAX_PLATFORMS") == "cpu"
+        else "auto",
+        "gate": {"min_speedup": args.min_speedup,
+                 "min_fused_speedup": args.min_fused_speedup},
+    }
+    gate_ok = True
+
+    if "serving" in scenarios:
+        artifact = args.artifact or _make_artifact(
+            args.units, args.hidden, args.depth)
+        sv_dir = tempfile.mkdtemp(prefix="mx-ccbench-sv-")
+        # warm the shared dir once (timing discarded), THEN measure:
+        # cold children each get a fresh empty directory, warm
+        # children share the pre-populated one
+        _run_child("serving", 1, artifact=artifact, cache_dir=sv_dir,
+                   bucket=args.bucket, units=args.units)
+        sv_cold = _run_child("serving", args.repeats,
+                             fresh_dir_each=True, artifact=artifact,
+                             bucket=args.bucket, units=args.units)
+        sv_warm = _run_child("serving", args.repeats,
+                             artifact=artifact, cache_dir=sv_dir,
+                             bucket=args.bucket, units=args.units)
+        sv_speed = sv_cold["first_request_s"] / \
+            max(sv_warm["first_request_s"], 1e-9)
+        report["serving"] = {
+            "cold_first_request_s": round(
+                sv_cold["first_request_s"], 4),
+            "warm_first_request_s": round(
+                sv_warm["first_request_s"], 4),
+            "speedup": round(sv_speed, 2),
+            "cold_xla_compiles": sv_cold["xla_compiles"],
+            "warm_xla_compiles": sv_warm["xla_compiles"],
+            "warm_disk_hits": sv_warm["cache"].get("disk_hits", 0),
+        }
+        gate_ok = (gate_ok and sv_speed >= args.min_speedup
+                   and report["serving"]["cold_xla_compiles"] > 0
+                   and report["serving"]["warm_xla_compiles"] == 0
+                   and report["serving"]["warm_disk_hits"] > 0)
+
+    if "fused" in scenarios:
+        fu_dir = tempfile.mkdtemp(prefix="mx-ccbench-fu-")
+        _run_child("fused", 1, cache_dir=fu_dir, params=args.params,
+                   fused_units=args.fused_units)
+        fu_cold = _run_child("fused", args.repeats,
+                             fresh_dir_each=True, params=args.params,
+                             fused_units=args.fused_units)
+        fu_warm = _run_child("fused", args.repeats, cache_dir=fu_dir,
+                             params=args.params,
+                             fused_units=args.fused_units)
+        fu_speed = fu_cold["first_step_s"] / \
+            max(fu_warm["first_step_s"], 1e-9)
+        report["fused"] = {
+            "cold_first_step_s": round(fu_cold["first_step_s"], 4),
+            "warm_first_step_s": round(fu_warm["first_step_s"], 4),
+            "speedup": round(fu_speed, 2),
+            "cold_xla_compiles": fu_cold["xla_compiles"],
+            "warm_xla_compiles": fu_warm["xla_compiles"],
+            "warm_disk_hits": fu_warm["cache"].get("disk_hits", 0),
+        }
+        gate_ok = (gate_ok and fu_speed >= args.min_fused_speedup
+                   and report["fused"]["cold_xla_compiles"] > 0
+                   and report["fused"]["warm_xla_compiles"] == 0
+                   and report["fused"]["warm_disk_hits"] > 0)
+
+    report["gate_ok"] = bool(gate_ok)
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if args.no_gate:
+        return 0
+    return 0 if gate_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

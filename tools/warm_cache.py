@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Offline compile-cache warmup (ISSUE 7): populate the persistent
+AOT executable store BEFORE a process needs it, so a deploy /
+preemption restart / autoscale-up serves its first request and takes
+its first fused step with zero XLA compiles.
+
+Two warmup modes (combine freely across invocations — entries are
+content-addressed, re-warming is idempotent):
+
+  * serving — given a deploy artifact directory and a bucket ladder,
+    compile one executable per allowed bucket into the cache::
+
+        python tools/warm_cache.py --cache-dir /var/mx-cache \\
+            --artifact /models/mlp/3 --buckets 1,4,8,16
+
+  * optimizer — given an optimizer config and the parameter shapes of
+    a training job, compile the fused-step executable::
+
+        python tools/warm_cache.py --cache-dir /var/mx-cache \\
+            --optimizer sgd --opt-args learning_rate=0.1,momentum=0.9 \\
+            --shapes 256x128,128
+
+The warmer runs on the SAME backend the consumer will (the cache key
+pins jax/jaxlib versions, platform, and device kind): warm on a TPU
+host for TPU serving, on CPU for CPU tests.  Output is one JSON line —
+entries written, cache stats, bytes on disk — suitable for a deploy
+pipeline log.
+
+See docs/compile_cache.md for the full warmup workflow.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _parse_shapes(spec: str):
+    """"256x128,128" -> [(256, 128), (128,)]"""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if part:
+            out.append(tuple(int(d) for d in part.split("x")))
+    return out
+
+
+def _parse_opt_args(spec: str) -> dict:
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        try:
+            out[k] = float(v)
+        except ValueError:
+            out[k] = v
+    return out
+
+
+def warm_serving(artifact: str, buckets) -> dict:
+    from mxnet_tpu import serving
+
+    repo = serving.ModelRepository()
+    repo.add("__warm__", artifact)
+    entry = repo.get("__warm__")
+    allowed = entry.allowed_buckets(list(buckets))
+    done = []
+    for b in (allowed or [entry.fixed_batch() or 1]):
+        entry.executable(b)
+        done.append(b)
+    return {"artifact": artifact, "buckets_warmed": done}
+
+
+def warm_optimizer(name: str, opt_args: dict, shapes, dtype: str,
+                   multi_precision: bool) -> dict:
+    from mxnet_tpu import nd, optimizer as opt_mod
+    from mxnet_tpu.optimizer.fused import FusedUpdater
+
+    if multi_precision:
+        opt_args = dict(opt_args, multi_precision=True)
+    opt = opt_mod.create(name, **opt_args)
+    updater = FusedUpdater(opt)
+    rng = np.random.RandomState(0)
+    weights = [nd.array(rng.rand(*s).astype(dtype)) for s in shapes]
+    grads = [nd.array(np.zeros(s, dtype)) for s in shapes]
+    indices = list(range(len(weights)))
+    updater.update_all(indices, grads, weights)
+    return {"optimizer": name, "shapes": [list(s) for s in shapes],
+            "dtype": dtype}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cache-dir", required=True,
+                    help="the persistent compile-cache directory "
+                    "(MXNET_COMPILE_CACHE_DIR of the consumers)")
+    ap.add_argument("--cache-bytes", type=int, default=0,
+                    help="byte cap to enforce while warming "
+                    "(0 = unbounded)")
+    ap.add_argument("--artifact", default=None,
+                    help="deploy artifact directory to warm serving "
+                    "executables for")
+    ap.add_argument("--buckets", default="1,4,8",
+                    help="padded-batch bucket ladder to warm")
+    ap.add_argument("--optimizer", default=None,
+                    help="optimizer name to warm a fused-step "
+                    "executable for (e.g. sgd, adam)")
+    ap.add_argument("--opt-args", default="",
+                    help="optimizer kwargs, k=v comma-separated")
+    ap.add_argument("--shapes", default=None,
+                    help="parameter shapes, e.g. 256x128,128")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--multi-precision", action="store_true")
+    args = ap.parse_args()
+
+    if not args.artifact and not args.optimizer:
+        ap.error("nothing to warm: pass --artifact and/or --optimizer")
+    if args.optimizer and not args.shapes:
+        ap.error("--optimizer needs --shapes")
+
+    from mxnet_tpu import compile_cache as cc
+
+    cc.reset(cc.CompileCache(disk_dir=args.cache_dir,
+                             cap_bytes=args.cache_bytes))
+    report = {"tool": "warm_cache", "cache_dir": args.cache_dir}
+    if args.artifact:
+        buckets = [int(b) for b in args.buckets.split(",") if b.strip()]
+        report["serving"] = warm_serving(args.artifact, buckets)
+    if args.optimizer:
+        report["optimizer"] = warm_optimizer(
+            args.optimizer, _parse_opt_args(args.opt_args),
+            _parse_shapes(args.shapes), args.dtype,
+            args.multi_precision)
+    report["stats"] = cc.stats()
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
