@@ -26,10 +26,22 @@ from .rules import (  # noqa: F401  — registers the MX00x rules on import
     RecompileHazard, HostSyncInHotPath, UntrackedEnvKnob,
     UnguardedSharedState, DonationMisuse, OpRegistryContract,
 )
-from .reporters import render_text, render_json
+# mxflow: the interprocedural dataflow engine + MX008–MX012.  NOTE
+# `from .dataflow import X` (one level, non-empty fromlist), never
+# `from .dataflow.rules import X`: the two-level form makes the import
+# system load the intermediate package with an EMPTY fromlist, which
+# finishes by fetching the head package `mxnet_tpu` — absent in the
+# CLI's standalone (jax-free) load.
+from .dataflow import (  # noqa: F401  — registers MX008–MX012
+    BlockingUnderLock, TransitiveHostSync, ExceptionPathLeak,
+    RetryUnsafeSideEffect, InterproceduralDonation,
+)
+from .reporters import render_text, render_json, render_sarif
+from .drift import instrument_names, chaos_sites, drift_findings
 
 __all__ = [
     "LintEngine", "Violation", "Rule", "RULE_REGISTRY", "register_rule",
     "load_baseline", "diff_baseline", "make_baseline",
-    "render_text", "render_json",
+    "render_text", "render_json", "render_sarif",
+    "instrument_names", "chaos_sites", "drift_findings",
 ]

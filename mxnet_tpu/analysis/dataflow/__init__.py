@@ -1,0 +1,42 @@
+"""mxflow — the interprocedural dataflow engine under mxlint.
+
+Three layers (docs/static_analysis.md has the architecture section):
+
+  * :mod:`cfg` — per-function control-flow graphs with exception
+    edges, dominators/postdominators, reaching definitions;
+  * :mod:`summaries` — per-function *local* summaries (blocking calls,
+    host syncs, lock regions, donations, raises, symbolic call refs),
+    a pure function of file bytes and therefore cacheable by content
+    hash (``.mxflow_cache.json``);
+  * :mod:`project` — the whole-program index: first-party import
+    resolution, method lookup through the class hierarchy,
+    op-registry indirection, and the bottom-up fixpoint that turns
+    local summaries into transitive facts.
+
+:mod:`rules` plugs MX008–MX012 into the ordinary mxlint engine —
+pragmas, baseline ratchet, ``--diff``, reporters all apply unchanged.
+
+Stdlib-only, like the rest of ``mxnet_tpu.analysis``: the mxlint CLI
+loads this package standalone, and a full-package run must never pay
+the jax import.
+"""
+from .cfg import (  # noqa: F401
+    CFG, Block, build_cfg, dominators, postdominators, reaching_defs,
+)
+from .summaries import extract_module  # noqa: F401
+from .project import (  # noqa: F401
+    Project, FuncInfo, build_project, get_project, clear_memo,
+    CACHE_NAME,
+)
+from .rules import (  # noqa: F401  — registers MX008–MX012 on import
+    BlockingUnderLock, TransitiveHostSync, ExceptionPathLeak,
+    RetryUnsafeSideEffect, InterproceduralDonation,
+)
+
+__all__ = [
+    "CFG", "Block", "build_cfg", "dominators", "postdominators",
+    "reaching_defs", "extract_module", "Project", "FuncInfo",
+    "build_project", "get_project", "clear_memo", "CACHE_NAME",
+    "BlockingUnderLock", "TransitiveHostSync", "ExceptionPathLeak",
+    "RetryUnsafeSideEffect", "InterproceduralDonation",
+]

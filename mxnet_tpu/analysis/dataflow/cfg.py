@@ -1,0 +1,337 @@
+"""Per-function control-flow graph with exception edges, dominators,
+and reaching definitions — the intraprocedural half of mxflow.
+
+Statement-granularity: every statement is its own block (function
+bodies in this codebase are small; the simplicity is worth more than
+the constant factor).  The graph distinguishes a NORMAL exit from a
+RAISE exit so "must happen on every path out, including the exception
+path" questions (MX010's release obligation) are answerable.
+
+Exception modelling is deliberately coarse but sound *for the rules
+built on it*:
+
+  * a statement gets an exception edge only when it contains a
+    *potentially-raising* expression — a call outside the small
+    known-safe set, a ``raise``, or an ``assert``.  Attribute loads and
+    arithmetic are treated as non-raising (precision over recall: a
+    lint that flags ``x += 1`` as a leak path gets pragma'd to death);
+  * ``finally`` bodies are built once and joined onto both the normal
+    and the exceptional continuation, which over-approximates the path
+    set after a finally.  Rules that look for a *release inside* the
+    finally are unaffected by that imprecision.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = ["Block", "CFG", "build_cfg", "dominators", "postdominators",
+           "reaching_defs", "SAFE_CALLS", "can_raise"]
+
+#: Calls that cannot meaningfully fail for leak/ordering purposes —
+#: clock reads, size queries, type checks, pure constructors.
+SAFE_CALLS = {
+    "len", "range", "isinstance", "issubclass", "id", "repr", "str",
+    "int", "float", "bool", "type", "tuple", "list", "dict", "set",
+    "min", "max", "sorted", "enumerate", "zip", "getattr", "hasattr",
+    "monotonic", "perf_counter", "time", "print", "format",
+}
+
+
+def _terminal(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def can_raise(stmt: ast.stmt) -> bool:
+    """Does this statement contain a potentially-raising expression?"""
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return False  # a def/class statement's body does not run here
+    stack: List[ast.AST] = list(ast.iter_child_nodes(stmt))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue  # a nested def's body does not run here
+        if isinstance(node, (ast.Raise, ast.Assert)):
+            return True
+        if isinstance(node, ast.Call) and \
+                _terminal(node.func) not in SAFE_CALLS:
+            return True
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+class Block:
+    """One CFG node.  ``stmt`` is the AST statement (None for the
+    synthetic entry/exit blocks); ``kind`` is "stmt", "entry", "exit",
+    or "raise" (the exceptional exit)."""
+
+    __slots__ = ("id", "stmt", "kind", "succs", "preds")
+
+    def __init__(self, bid: int, stmt: Optional[ast.stmt], kind: str):
+        self.id = bid
+        self.stmt = stmt
+        self.kind = kind
+        self.succs: Set[int] = set()
+        self.preds: Set[int] = set()
+
+    def __repr__(self) -> str:  # debugging aid
+        what = self.kind if self.stmt is None else \
+            type(self.stmt).__name__ + f"@{self.stmt.lineno}"
+        return f"<Block {self.id} {what} -> {sorted(self.succs)}>"
+
+
+class CFG:
+    """blocks[0] is ENTRY; ``exit_id``/``raise_id`` are the two
+    terminal nodes (normal return / uncaught exception)."""
+
+    def __init__(self) -> None:
+        self.blocks: List[Block] = []
+        self.entry = self._new(None, "entry").id
+        self.exit_id = self._new(None, "exit").id
+        self.raise_id = self._new(None, "raise").id
+
+    def _new(self, stmt: Optional[ast.stmt], kind: str = "stmt") -> Block:
+        b = Block(len(self.blocks), stmt, kind)
+        self.blocks.append(b)
+        return b
+
+    def edge(self, a: int, b: int) -> None:
+        self.blocks[a].succs.add(b)
+        self.blocks[b].preds.add(a)
+
+    def stmt_blocks(self) -> Iterable[Block]:
+        return (b for b in self.blocks if b.kind == "stmt")
+
+    def block_of(self, stmt: ast.stmt) -> Optional[Block]:
+        for b in self.blocks:
+            if b.stmt is stmt:
+                return b
+        return None
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG for one function/method body."""
+    g = CFG()
+
+    def seq(stmts: List[ast.stmt], next_id: int, exc_id: int,
+            brk: Optional[int], cont: Optional[int],
+            ret_id: int) -> int:
+        """Wire ``stmts`` so falling off the end reaches ``next_id``;
+        returns the entry block id of the sequence."""
+        entry = next_id
+        for stmt in reversed(stmts):
+            entry = one(stmt, entry, exc_id, brk, cont, ret_id)
+        return entry
+
+    def one(stmt: ast.stmt, next_id: int, exc_id: int,
+            brk: Optional[int], cont: Optional[int],
+            ret_id: int) -> int:
+        b = g._new(stmt)
+        if isinstance(stmt, ast.Return):
+            g.edge(b.id, ret_id)
+            if can_raise(stmt):
+                g.edge(b.id, exc_id)
+            return b.id
+        if isinstance(stmt, ast.Raise):
+            g.edge(b.id, exc_id)
+            return b.id
+        if isinstance(stmt, ast.Break) and brk is not None:
+            g.edge(b.id, brk)
+            return b.id
+        if isinstance(stmt, ast.Continue) and cont is not None:
+            g.edge(b.id, cont)
+            return b.id
+        if isinstance(stmt, ast.If):
+            then = seq(stmt.body, next_id, exc_id, brk, cont, ret_id)
+            other = seq(stmt.orelse, next_id, exc_id, brk, cont, ret_id)
+            g.edge(b.id, then)
+            g.edge(b.id, other)
+            if can_raise(stmt):  # the test expression
+                g.edge(b.id, exc_id)
+            return b.id
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            after = seq(stmt.orelse, next_id, exc_id, brk, cont, ret_id)
+            body = seq(stmt.body, b.id, exc_id, next_id, b.id, ret_id)
+            g.edge(b.id, body)
+            g.edge(b.id, after)
+            if can_raise(stmt):
+                g.edge(b.id, exc_id)
+            return b.id
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            body = seq(stmt.body, next_id, exc_id, brk, cont, ret_id)
+            g.edge(b.id, body)
+            if can_raise(stmt):
+                g.edge(b.id, exc_id)
+            return b.id
+        if isinstance(stmt, ast.Try):
+            return try_stmt(stmt, b, next_id, exc_id, brk, cont, ret_id)
+        # plain statement
+        g.edge(b.id, next_id)
+        if can_raise(stmt):
+            g.edge(b.id, exc_id)
+        return b.id
+
+    def try_stmt(stmt: ast.Try, b: Block, next_id: int, exc_id: int,
+                 brk: Optional[int], cont: Optional[int],
+                 ret_id: int) -> int:
+        body_brk, body_cont = brk, cont
+        if stmt.finalbody:
+            # the finally body is CLONED per continuation (the
+            # textbook duplication): the normal-completion clone flows
+            # to `next`, the exceptional clone to the outer exception
+            # target, the return clone to the return target.  A single
+            # shared copy would create false normal->raise paths that
+            # break every "must happen on all exits" analysis.
+            fin_normal = seq(stmt.finalbody, next_id, exc_id, brk,
+                             cont, ret_id)
+            fin_exc = seq(stmt.finalbody, exc_id, exc_id, brk, cont,
+                          ret_id)
+            fin_ret = seq(stmt.finalbody, ret_id, exc_id, brk, cont,
+                          ret_id)
+            after_id, body_exc, body_ret = fin_normal, fin_exc, fin_ret
+            if brk is not None:
+                body_brk = seq(stmt.finalbody, brk, exc_id, brk, cont,
+                               ret_id)
+            if cont is not None:
+                body_cont = seq(stmt.finalbody, cont, exc_id, brk,
+                                cont, ret_id)
+        else:
+            after_id, body_exc, body_ret = next_id, exc_id, ret_id
+        if stmt.handlers:
+            # exceptions from the body dispatch to the handlers; an
+            # unmatched exception continues to the finally/outer —
+            # unless some handler catches everything (bare except /
+            # except BaseException), in which case there is no
+            # unmatched path
+            dispatch = g._new(None, "join")
+            catches_all = False
+            for h in stmt.handlers:
+                h_entry = seq(h.body, after_id, body_exc, body_brk,
+                              body_cont, body_ret)
+                g.edge(dispatch.id, h_entry)
+                t = h.type
+                if t is None or _terminal(t) == "BaseException":
+                    catches_all = True
+            if not catches_all:
+                g.edge(dispatch.id, body_exc)
+            body_exc_target = dispatch.id
+        else:
+            body_exc_target = body_exc
+        else_entry = seq(stmt.orelse, after_id, body_exc_target,
+                         body_brk, body_cont, body_ret) \
+            if stmt.orelse else after_id
+        body_entry = seq(stmt.body, else_entry, body_exc_target,
+                         body_brk, body_cont, body_ret)
+        g.edge(b.id, body_entry)
+        return b.id
+
+    body = getattr(fn, "body", [])
+    entry_stmt = seq(list(body), g.exit_id, g.raise_id, None, None,
+                     g.exit_id)
+    g.edge(g.entry, entry_stmt)
+    return g
+
+
+# ---------------------------------------------------------------------------
+# dominators / postdominators (iterative dataflow; graphs are tiny)
+# ---------------------------------------------------------------------------
+
+def dominators(g: CFG) -> Dict[int, Set[int]]:
+    """block id -> set of ids that dominate it (every path from entry
+    passes through them).  Unreachable blocks dominate nothing and map
+    to the full set (the conventional lattice top)."""
+    all_ids = {b.id for b in g.blocks}
+    dom: Dict[int, Set[int]] = {b.id: set(all_ids) for b in g.blocks}
+    dom[g.entry] = {g.entry}
+    changed = True
+    while changed:
+        changed = False
+        for b in g.blocks:
+            if b.id == g.entry:
+                continue
+            preds = [p for p in b.preds]
+            if not preds:
+                continue
+            new = set.intersection(*(dom[p] for p in preds)) | {b.id}
+            if new != dom[b.id]:
+                dom[b.id] = new
+                changed = True
+    return dom
+
+
+def postdominators(g: CFG) -> Dict[int, Set[int]]:
+    """block id -> ids on every path from it to BOTH exits.  Computed
+    against a virtual super-exit joining the normal and raise exits."""
+    all_ids = {b.id for b in g.blocks}
+    virtual = -1
+    succs = {b.id: set(b.succs) for b in g.blocks}
+    succs[g.exit_id].add(virtual)
+    succs[g.raise_id].add(virtual)
+    pdom: Dict[int, Set[int]] = {i: set(all_ids) for i in all_ids}
+    pdom[virtual] = {virtual}
+    changed = True
+    while changed:
+        changed = False
+        for b in g.blocks:
+            ss = succs[b.id]
+            if not ss:
+                continue
+            new = set.intersection(
+                *(pdom[s] if s != virtual else {virtual}
+                  for s in ss)) | {b.id}
+            new.discard(virtual)
+            if new != pdom[b.id]:
+                pdom[b.id] = new
+                changed = True
+    return pdom
+
+
+# ---------------------------------------------------------------------------
+# reaching definitions
+# ---------------------------------------------------------------------------
+
+def _defs_in(stmt: ast.stmt) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(node.name)
+        elif isinstance(node, ast.Name) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            out.add(node.id)
+    return out
+
+
+def reaching_defs(g: CFG) -> Dict[int, Set[Tuple[str, int]]]:
+    """block id -> set of (name, defining-block-id) definitions live on
+    ENTRY to the block.  A block defining ``name`` kills every other
+    definition of it."""
+    gen: Dict[int, Set[Tuple[str, int]]] = {}
+    kill_names: Dict[int, Set[str]] = {}
+    for b in g.blocks:
+        names = _defs_in(b.stmt) if b.stmt is not None else set()
+        gen[b.id] = {(n, b.id) for n in names}
+        kill_names[b.id] = names
+    in_: Dict[int, Set[Tuple[str, int]]] = {b.id: set() for b in g.blocks}
+    out: Dict[int, Set[Tuple[str, int]]] = {b.id: set() for b in g.blocks}
+    changed = True
+    while changed:
+        changed = False
+        for b in g.blocks:
+            new_in = set()
+            for p in b.preds:
+                new_in |= out[p]
+            new_out = gen[b.id] | {
+                (n, d) for (n, d) in new_in
+                if n not in kill_names[b.id]}
+            if new_in != in_[b.id] or new_out != out[b.id]:
+                in_[b.id], out[b.id] = new_in, new_out
+                changed = True
+    return in_

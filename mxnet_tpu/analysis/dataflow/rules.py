@@ -1,0 +1,675 @@
+"""The mxflow rule set (MX008–MX012): whole-program rules over the
+call graph + per-function CFG.
+
+Each rule is grounded in a bug class this repo shipped and fixed in
+PRs 6–7 (see docs/static_analysis.md for the catalogue):
+
+  * MX008 — blocking call reachable while a first-party lock is held
+    (the static complement of mxsan's dynamic lock-order detector,
+    for paths tests never execute);
+  * MX009 — transitive host sync in the Trainer/Updater/KVStore step
+    chain (MX002 made fully interprocedural);
+  * MX010 — resource acquired without a release on every exit path,
+    exception paths included (the ``abandon_probe``/use-count class);
+  * MX011 — caller-visible state mutated before the success point of a
+    ``RetryPolicy``-wrapped callable (a retry would replay the
+    mutation);
+  * MX012 — buffer donation flowing across helper functions (MX005
+    interprocedural): a caller's variable donated *inside* a callee.
+
+All five follow the house precision-over-recall policy: an
+unresolvable call contributes nothing, and every finding names the
+evidence (the call path to the blocking/syncing/donating site).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..engine import FileContext, Rule, Violation, register_rule
+# NOTE `from .cfg import ...`, never `from . import cfg`: the latter
+# routes through a full dotted __import__ from the ROOT package and
+# breaks the CLI's standalone (jax-free) load — see analysis/__init__.
+from .cfg import CFG as _CFG, Block as _Block, build_cfg, can_raise
+from .project import FuncInfo, Project, get_project
+from .summaries import _FnExtractor, _attr_text, _call_ref
+
+__all__ = ["BlockingUnderLock", "TransitiveHostSync",
+           "ExceptionPathLeak", "RetryUnsafeSideEffect",
+           "InterproceduralDonation"]
+
+
+class _Anchor:
+    """Minimal lineno/col carrier for ctx.violation()."""
+
+    __slots__ = ("lineno", "col_offset")
+
+    def __init__(self, lineno: int, col: int = 0):
+        self.lineno = lineno
+        self.col_offset = col
+
+
+def _ref_text(ref: Optional[List[str]]) -> str:
+    if not ref:
+        return "<call>"
+    kind = ref[0]
+    if kind == "n":
+        return f"{ref[1]}()"
+    if kind == "self":
+        return f"self.{ref[1]}()"
+    if kind == "sattr":
+        return f"self.{ref[1]}.{ref[2]}()"
+    if kind in ("a", "lv"):
+        return f"{ref[1]}.{ref[2]}()"
+    if kind == "c":
+        return f"{ref[1]}()"
+    return "<call>"
+
+
+class _ProjectRule(Rule):
+    """Base for the interprocedural rules: record every FileContext,
+    build (or share) the project in finalize()."""
+
+    def __init__(self) -> None:
+        self._ctxs: List[FileContext] = []
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        self._ctxs.append(ctx)
+        return ()
+
+    def finalize(self) -> Iterable[Violation]:
+        if not self._ctxs:
+            return ()
+        proj = get_project(self._ctxs)
+        out: List[Violation] = []
+        for ctx in self._ctxs:
+            mod = proj.path_mod.get(ctx.path)
+            if mod is None:
+                continue
+            for v in self._module_findings(proj, ctx, mod):
+                if not ctx.suppressed(self.id, v.line):
+                    out.append(v)
+        return out
+
+    def _module_findings(self, proj: Project, ctx: FileContext,
+                         mod: str) -> Iterable[Violation]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# MX008 — blocking call while a first-party lock is held
+# ---------------------------------------------------------------------------
+
+@register_rule
+class BlockingUnderLock(_ProjectRule):
+    """MX008: a blocking operation (XLA compile, executor launch,
+    collective, artifact/file IO, sleep/join/result/wait) executes —
+    directly or through any chain of first-party calls — inside a
+    ``with <lock>:`` region.  Every thread contending for that lock
+    stalls behind a multi-millisecond (or multi-second) operation: the
+    exact shape of the serving import stall and the compile-under-lock
+    classes mxsan can only catch on paths tests actually run."""
+
+    id = "MX008"
+    name = "blocking-under-lock"
+    description = ("Blocking call (compile/execute/collective/IO/"
+                   "sleep/join) reachable while holding a first-party "
+                   "lock — directly or through the call graph.")
+
+    def _module_findings(self, proj: Project, ctx: FileContext,
+                         mod: str) -> Iterable[Violation]:
+        for fn in proj.funcs_of_module(mod):
+            for entry, callees in fn.edges:
+                lock = entry.get("lock")
+                if not lock:
+                    continue
+                anchor = _Anchor(entry["line"])
+                direct = entry.get("block")
+                if direct:
+                    yield ctx.violation(
+                        self.id, anchor,
+                        f"{direct} inside `with {lock}:` — every "
+                        "thread contending for this lock stalls "
+                        "behind it; hoist the blocking work out of "
+                        "the lock (double-checked pattern, "
+                        "ops/registry.py::jitted).")
+                    continue
+                for g in callees:
+                    if g.t_blocks is None:
+                        continue
+                    path, _ = proj.witness_path(g.t_blocks, "blocks")
+                    yield ctx.violation(
+                        self.id, anchor,
+                        f"{_ref_text(entry.get('ref'))} inside `with "
+                        f"{lock}:` reaches a blocking operation "
+                        f"({path or 'blocking call'}) — blocking "
+                        "under a first-party lock serializes every "
+                        "contending thread; move the call outside "
+                        "the lock and publish the result under it.")
+                    break
+
+
+# ---------------------------------------------------------------------------
+# MX009 — transitive host sync in the hot path
+# ---------------------------------------------------------------------------
+
+@register_rule
+class TransitiveHostSync(_ProjectRule):
+    """MX009: a call made from the Trainer/Updater/KVStore step chain
+    (or inside an ``autograd.record()`` block) whose callee — any
+    number of first-party calls deep, across modules, through methods
+    and op-registry indirection — performs a device->host sync.  MX002
+    flags the sync written *directly* in the hot scope; this rule
+    follows the call graph, so wrapping ``.asnumpy()`` in two layers
+    of logging helpers no longer hides the stall."""
+
+    id = "MX009"
+    name = "transitive-host-sync"
+    description = ("Call from a Trainer/Updater/KVStore step-chain "
+                   "method or record() block that transitively "
+                   "reaches a device->host sync "
+                   "(.asnumpy()/.item()/np.asarray).")
+
+    def _module_findings(self, proj: Project, ctx: FileContext,
+                         mod: str) -> Iterable[Violation]:
+        for fn in proj.funcs_of_module(mod):
+            hot_fn = fn.hot
+            for entry, callees in fn.edges:
+                if not (hot_fn or entry.get("record")):
+                    continue
+                if entry.get("sync"):
+                    continue  # direct sync in the hot scope = MX002
+                where = "in the step chain" if hot_fn \
+                    else "inside autograd.record()"
+                for g in callees:
+                    if g.t_syncs is None or g.hot:
+                        continue  # hot callees are flagged themselves
+                    path, _ = proj.witness_path(g.t_syncs, "syncs")
+                    yield ctx.violation(
+                        self.id, _Anchor(entry["line"]),
+                        f"call {where} reaches a device->host sync: "
+                        f"{_ref_text(entry.get('ref'))} -> {path} — "
+                        "the transfer stalls the async dispatch "
+                        "pipeline; hoist the sync out of the hot "
+                        "path or make the helper async.")
+                    break
+
+
+# ---------------------------------------------------------------------------
+# MX010 — exception-path resource leak
+# ---------------------------------------------------------------------------
+
+#: acquire method -> matching release methods.  Only pairs with an
+#: unambiguous protocol; the breaker probe (allow/abandon_probe) spans
+#: threads and functions and is out of static scope.
+_PAIRS = {"begin_use": ("end_use",),
+          "acquire": ("release",)}
+
+
+@register_rule
+class ExceptionPathLeak(Rule):
+    """MX010: a use-count / semaphore / lock acquired via
+    ``X.begin_use()`` or ``X.acquire()`` with a matching release in
+    the same function, where some path from the acquire to a function
+    exit — **including the exception path** — misses the release.
+    The release must dominate every exit: put it in a ``finally`` (or
+    use a ``with``).  This is the PR 6/7 ``abandon_probe``/use-count
+    leak class: one exception between acquire and release wedges the
+    entry (or breaker, or pool slot) forever.
+
+    A release that lives inside a nested function counts at the point
+    that function is called *or escapes* (passed as a callback —
+    ``Future.add_done_callback`` style deferred release)."""
+
+    id = "MX010"
+    name = "exception-path-leak"
+    description = ("Resource acquire (begin_use/acquire) without a "
+                   "release on every exit path incl. exceptions — "
+                   "needs try/finally or with.")
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        # cheap text pregate: most files contain no acquire verbs at
+        # all, and building CFGs for them is pure waste
+        src = "\n".join(ctx.lines)
+        if not any(f".{name}(" in src for name in _PAIRS):
+            return
+        for fn in ctx.functions:
+            yield from self._check_fn(ctx, fn)
+
+    # ---- per-function analysis ---------------------------------------
+
+    def _check_fn(self, ctx: FileContext,
+                  fn: ast.AST) -> Iterable[Violation]:
+        with_exprs: Set[int] = set()
+        acquires: List[Tuple[ast.Call, str, str]] = []
+        releases: Dict[Tuple[str, str], List[ast.AST]] = {}
+        carriers: Set[str] = set()  # local defs performing a release
+        nested: Dict[str, ast.AST] = {}
+        for node in _same_scope_stmts(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    with_exprs.add(id(item.context_expr))
+        for node in _walk_scope(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested[node.name] = node
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) and \
+                            isinstance(sub.func, ast.Attribute) and \
+                            any(sub.func.attr in rel
+                                for rel in _PAIRS.values()):
+                        carriers.add(node.name)
+                        break
+                continue
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            meth = node.func.attr
+            recv = _attr_text(node.func.value)
+            if meth in _PAIRS and id(node) not in with_exprs:
+                acquires.append((node, recv, meth))
+            for acq, rels in _PAIRS.items():
+                if meth in rels:
+                    releases.setdefault((recv, acq), []).append(node)
+        if not acquires:
+            return
+        # transitive carriers: a local def that calls a releasing def
+        # releases too (`_done` -> `_release` -> entry.end_use())
+        changed = True
+        while changed:
+            changed = False
+            for name, node in nested.items():
+                if name in carriers:
+                    continue
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) and \
+                            isinstance(sub.func, ast.Name) and \
+                            sub.func.id in carriers:
+                        carriers.add(name)
+                        changed = True
+                        break
+        graph = build_cfg(fn)
+        for call, recv, meth in acquires:
+            key = (recv, meth)
+            if key not in releases and not carriers:
+                continue  # no local release: cross-function protocol
+            if self._leaks(graph, fn, call, recv, meth, carriers):
+                yield ctx.violation(
+                    self.id, call,
+                    f"`{recv}.{meth}()` has a path to a function exit "
+                    "(including the exception path) with no matching "
+                    f"`{recv}.{_PAIRS[meth][0]}()` — one exception "
+                    "between acquire and release leaks the resource "
+                    "forever. Release in a `finally:` (or use a "
+                    "`with` block).")
+
+    def _leaks(self, graph: "_CFG", fn: ast.AST, call: ast.Call,
+               recv: str, meth: str, carriers: Set[str]) -> bool:
+        rels = _PAIRS[meth]
+
+        def releases_here(stmt: ast.stmt) -> bool:
+            for n in _shallow_walk(stmt):
+                if isinstance(n, ast.Call):
+                    f = n.func
+                    if isinstance(f, ast.Attribute) and f.attr in rels \
+                            and _attr_text(f.value) == recv:
+                        return True
+                    # calling, or passing as a callback, a local def
+                    # that performs the release
+                    names = [a.id for a in n.args
+                             if isinstance(a, ast.Name)]
+                    if isinstance(f, ast.Name) and f.id in carriers:
+                        return True
+                    if any(nm in carriers for nm in names):
+                        return True
+            return False
+
+        start = None
+        for b in graph.blocks:
+            if b.stmt is not None and any(
+                    n is call for n in _shallow_walk(b.stmt)):
+                start = b
+                break
+        if start is None:
+            return False
+        seen: Set[int] = set()
+        # the acquire's OWN exception edge is not a leak path: if the
+        # acquire call itself raises, nothing was acquired
+        stack = [s for s in start.succs
+                 if s not in (graph.exit_id, graph.raise_id)]
+        while stack:
+            bid = stack.pop()
+            if bid in seen:
+                continue
+            seen.add(bid)
+            b = graph.blocks[bid]
+            if b.id in (graph.exit_id, graph.raise_id):
+                return True  # reached an exit still holding
+            if b.stmt is not None and releases_here(b.stmt):
+                continue  # this path released; stop tracing it
+            stack.extend(b.succs)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# MX011 — retry-unsafe side effects
+# ---------------------------------------------------------------------------
+
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "pop", "popitem", "clear", "remove", "discard"}
+
+
+@register_rule
+class RetryUnsafeSideEffect(Rule):
+    """MX011: the callable handed to ``RetryPolicy.call`` mutates
+    caller-visible state (``self.*``, closure/global names, containers
+    that outlive the attempt) *before* an operation that can still
+    fail.  A transient failure then replays the mutation: counters
+    double-bump, partial writes land twice, published values go stale.
+    The kvstore rule from PR 6: re-extract reads per attempt, write
+    results only after the last fallible operation."""
+
+    id = "MX011"
+    name = "retry-unsafe-side-effect"
+    description = ("RetryPolicy-wrapped callable mutates caller-"
+                   "visible state before its success point — a "
+                   "transient retry replays the mutation.")
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        # pregate: no `.call(` in the file -> no RetryPolicy call sites
+        src = "\n".join(ctx.lines)
+        if ".call(" not in src:
+            return
+        module_fns = {n.name: n for n in ctx.tree.body
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+        for fn in ctx.functions:
+            local_fns = dict(module_fns)
+            for node in _walk_scope(fn):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    local_fns[node.name] = node
+            for node in _walk_scope(fn):
+                if isinstance(node, ast.Call) and \
+                        self._is_retry_call(node):
+                    target = None
+                    if node.args and isinstance(node.args[0], ast.Name):
+                        target = local_fns.get(node.args[0].id)
+                    if target is not None:
+                        yield from self._check_attempt(ctx, target)
+
+    @staticmethod
+    def _is_retry_call(call: ast.Call) -> bool:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "call"):
+            return False
+        recv = f.value
+        recv_text = _attr_text(recv).lower()
+        if "policy" in recv_text or "retry" in recv_text:
+            return True
+        if isinstance(recv, ast.Call):
+            inner = _attr_text(recv.func).lower()
+            if "policy" in inner or "retry" in inner:
+                return True
+        # `.call(fn, site=...)` is the framework signature
+        return any(k.arg == "site" for k in call.keywords)
+
+    def _check_attempt(self, ctx: FileContext,
+                       fn: ast.AST) -> Iterable[Violation]:
+        if getattr(self, "_seen_attempts", None) is None:
+            self._seen_attempts: Set[int] = set()
+        if id(fn) in self._seen_attempts:
+            return
+        self._seen_attempts.add(id(fn))
+        local_names = {a.arg for a in fn.args.args}
+        declared: Set[str] = set()
+        for n in _walk_scope(fn):
+            if isinstance(n, (ast.Global, ast.Nonlocal)):
+                declared.update(n.names)
+            elif isinstance(n, ast.Name) and \
+                    isinstance(n.ctx, ast.Store):
+                local_names.add(n.id)
+        local_names -= declared
+        graph = build_cfg(fn)
+        for b in graph.stmt_blocks():
+            mut = self._mutation_in(b.stmt, local_names, declared)
+            if mut is None:
+                continue
+            if self._risky_after(graph, b):
+                node, what = mut
+                yield ctx.violation(
+                    self.id, node,
+                    f"`{what}` mutates caller-visible state before "
+                    "the retry success point — a transient failure "
+                    "after this line replays the mutation on the "
+                    "next attempt. Compute first, publish (write) "
+                    "only after the last fallible operation.")
+
+    def _mutation_in(self, stmt: ast.stmt, local_names: Set[str],
+                     declared: Set[str]):
+        # the statement node itself matters too: a bare Assign /
+        # AugAssign IS the mutation (shallow-walk yields only children)
+        for n in (stmt, *_shallow_walk(stmt)):
+            if isinstance(n, (ast.Assign, ast.AugAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+                for t in targets:
+                    root = _root_name(t)
+                    if isinstance(t, ast.Name):
+                        if t.id in declared:
+                            return n, f"{t.id} ="
+                    elif root is not None and root not in local_names:
+                        return n, f"{_attr_text(t) or root}[...] =" \
+                            if isinstance(t, ast.Subscript) \
+                            else f"{_attr_text(t)} ="
+            elif isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in _MUTATORS:
+                root = _root_name(n.func.value)
+                if root is not None and root not in local_names:
+                    return n, f"{_attr_text(n.func.value)}." \
+                              f"{n.func.attr}(...)"
+        return None
+
+    @staticmethod
+    def _risky_after(graph: "_CFG", block: "_Block") -> bool:
+        seen: Set[int] = set()
+        stack = list(block.succs)
+        while stack:
+            bid = stack.pop()
+            if bid in seen:
+                continue
+            seen.add(bid)
+            b = graph.blocks[bid]
+            if b.stmt is not None and can_raise(b.stmt):
+                return True
+            stack.extend(b.succs)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# MX012 — donation flow across helpers
+# ---------------------------------------------------------------------------
+
+@register_rule
+class InterproceduralDonation(_ProjectRule):
+    """MX012: a variable is passed to a first-party helper that —
+    directly or deeper in the call graph — donates that parameter to a
+    compiled call (``donate_argnums``), and is then read again.  XLA
+    invalidated the buffer inside the helper; the read returns garbage
+    on TPU and "works" on CPU.  MX005 catches the donation written in
+    the same scope; this rule follows it across functions, methods,
+    and modules."""
+
+    id = "MX012"
+    name = "interprocedural-donation"
+    description = ("Variable read after being passed to a helper "
+                   "whose call chain donates that parameter "
+                   "(donate_argnums) to a compiled function.")
+
+    def _module_findings(self, proj: Project, ctx: FileContext,
+                         mod: str) -> Iterable[Violation]:
+        # donor gate: without at least one donating function in the
+        # whole project (and its name in this file's text) there is
+        # nothing a per-function scan could ever find
+        if getattr(self, "_donor_proj", None) is not proj:
+            self._donors = {f.name for f in proj.funcs.values()
+                            if f.t_donates}
+            self._donor_proj = proj
+        donors = self._donors
+        if not donors:
+            return
+        src = "\n".join(ctx.lines)
+        if not any(f"{name}(" in src for name in donors):
+            return
+        for fn_node in ctx.functions:
+            qual = self._qual_for(proj, ctx, mod, fn_node)
+            fn = proj.funcs.get(qual) if qual else None
+            if fn is None:
+                continue
+            yield from self._scan(proj, ctx, fn, fn_node)
+
+    def _qual_for(self, proj: Project, ctx: FileContext, mod: str,
+                  fn_node: ast.AST) -> Optional[str]:
+        # top-level functions and methods only — nested defs have
+        # "<locals>" quals and are scanned as part of their parent's
+        # project record, not re-scanned here
+        sym = ctx.symbol_at(fn_node.lineno)
+        return None if sym == "<module>" else f"{mod}:{sym}"
+
+    def _scan(self, proj: Project, ctx: FileContext, fn: FuncInfo,
+              fn_node: ast.AST) -> Iterable[Violation]:
+        ext = _FnExtractor.__new__(_FnExtractor)
+        ext.rec = {"params": [], "blocks": None, "syncs": None,
+                   "raises": False, "donates": {}, "calls": [],
+                   "nested": {}}
+        ext.local_types = {}
+        ext.donating_vars = {}
+        ext._prescan(fn_node)
+        donated_at: Dict[str, Tuple[int, int, str]] = {}
+        body = list(fn_node.body)
+        for idx, stmt in enumerate(body):
+            # 1) reads of names donated in an earlier statement
+            for node in _shallow_walk_stmt_scope(stmt):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        node.id in donated_at and \
+                        donated_at[node.id][0] < idx:
+                    _, line, path = donated_at.pop(node.id)
+                    yield ctx.violation(
+                        self.id, node,
+                        f"`{node.id}` was donated inside the call on "
+                        f"line {line} ({path}); its buffer is "
+                        "invalidated — reading it here returns "
+                        "garbage on TPU. Use the helper's result "
+                        "instead.")
+            # 2) helper calls that donate one of their params
+            for node in _shallow_walk_stmt_scope(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                ref = _call_ref(node, ext.local_types)
+                for g in proj.resolve_call(fn, {"ref": ref}):
+                    if not g.t_donates:
+                        continue
+                    for pos in g.t_donates:
+                        if pos < len(node.args) and \
+                                isinstance(node.args[pos], ast.Name):
+                            name = node.args[pos].id
+                            path = self._donation_path(proj, g, pos)
+                            donated_at.setdefault(
+                                name, (idx, node.lineno, path))
+            # 3) stores end the donated lifetime (incl. same-statement
+            #    rebinds: `w = helper(w, g)` is the canonical idiom)
+            for node in _shallow_walk_stmt_scope(stmt):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Store) and \
+                        node.id in donated_at:
+                    del donated_at[node.id]
+
+    def _donation_path(self, proj: Project, g: FuncInfo,
+                       pos: int) -> str:
+        hops = [f"{g.name}() donates arg #{pos}"]
+        fact = g.t_donates.get(pos)
+        depth = 0
+        while fact and fact[0] == "call" and depth < 5:
+            callee = proj.funcs.get(fact[1])
+            if callee is None:
+                break
+            hops.append(f"-> {callee.name}() arg #{fact[3]}")
+            fact = callee.t_donates.get(fact[3])
+            depth += 1
+        if fact and fact[0] == "direct":
+            hops.append(f"-> donate_argnums at line {fact[1]}")
+        return " ".join(hops)
+
+
+# ---------------------------------------------------------------------------
+# scope-walk helpers (match the engine's conventions)
+# ---------------------------------------------------------------------------
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Root Name id of an Attribute/Subscript chain (``self._out[k]``
+    -> "self"); None when the chain doesn't bottom out at a Name."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+def _walk_scope(fn: ast.AST) -> Iterable[ast.AST]:
+    """All nodes in the function's own scope; nested defs are yielded
+    (so callers can index them) but not descended into."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _same_scope_stmts(fn: ast.AST) -> Iterable[ast.AST]:
+    for n in _walk_scope(fn):
+        if isinstance(n, ast.stmt):
+            yield n
+
+
+def _shallow_walk(stmt: ast.stmt) -> Iterable[ast.AST]:
+    """Nodes belonging to THIS statement only: for compound statements
+    just the header expressions, never the nested statement bodies or
+    nested function scopes."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return
+    headers: List[ast.AST] = []
+    if isinstance(stmt, (ast.If, ast.While)):
+        headers = [stmt.test]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        headers = [stmt.target, stmt.iter]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        headers = [i.context_expr for i in stmt.items]
+    elif isinstance(stmt, ast.Try):
+        headers = []
+    else:
+        headers = list(ast.iter_child_nodes(stmt))
+    stack = headers
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            yield n
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _shallow_walk_stmt_scope(stmt: ast.stmt) -> Iterable[ast.AST]:
+    """Every node under ``stmt`` except nested function/class scopes —
+    the MX005-style statement-index scan granularity."""
+    stack = [stmt]
+    while stack:
+        n = stack.pop()
+        if n is not stmt and isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                    ast.ClassDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))

@@ -1,0 +1,508 @@
+"""Per-function local summaries — the cacheable half of mxflow.
+
+One pass over a module's AST produces, for every function/method (and
+every nested def), a JSON-serializable record of the *local* facts the
+whole-program rules need:
+
+  * direct blocking calls (XLA ``.compile()``, executor launches,
+    collectives, file IO, ``sleep``/``join``/``result``/``wait``);
+  * direct host syncs (``.asnumpy()``/``.item()``/``np.asarray`` — the
+    MX002 set);
+  * locks acquired (``with <lockish>:`` regions) and, per call site,
+    the innermost lock held;
+  * direct buffer donations of the function's own parameters;
+  * every call site as a symbolic reference (resolved later against
+    the project index — resolution needs other modules, extraction
+    must not);
+  * ``raise`` reachability.
+
+Everything here is a pure function of the file's bytes, which is what
+makes the content-hash summary cache sound: same sha1 -> same record,
+no re-parse (the property ``--diff`` under 1s rests on).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+__all__ = ["extract_module", "blocking_desc", "sync_desc", "LOCKISH",
+           "HOT_CLASSES", "HOT_METHODS"]
+
+# a pragma ON the sync/blocking/donating line blesses that effect for
+# the whole transitive chain: nobody upstream should be flagged for
+# reaching a site the author explicitly suppressed.  Effects and the
+# rules whose pragmas kill them:
+_PRAGMA = re.compile(r"#\s*mxlint:\s*disable(?:=([A-Z0-9,\s]+))?")
+_EFFECT_RULES = {"syncs": {"MX002", "MX009"},
+                 "blocks": {"MX008"},
+                 "donates": {"MX005", "MX012"}}
+
+
+def pragma_lines(source: str) -> Dict[int, Set[str]]:
+    """line -> suppressed rule ids ({'ALL'} for a bare disable)."""
+    out: Dict[int, Set[str]] = {}
+    for i, ln in enumerate(source.splitlines(), 1):
+        m = _PRAGMA.search(ln)
+        if m:
+            codes = m.group(1)
+            out[i] = ({c.strip() for c in codes.split(",") if c.strip()}
+                      if codes else {"ALL"})
+    return out
+
+LOCKISH = re.compile(r"lock|mutex", re.IGNORECASE)
+
+#: the Trainer/Updater/KVStore step chain (mirrors MX002's hot set —
+#: MX009 is its interprocedural completion)
+HOT_CLASSES = re.compile(r"(Trainer|Updater|KVStore)")
+HOT_METHODS = {"step", "update", "_update", "update_all", "__call__",
+               "allreduce_grads", "_allreduce_grads",
+               "_allreduce_grads_fused", "_update_fused",
+               "push", "pull", "pushpull", "pushpull_fused"}
+
+_SYNC_METHODS = {"asnumpy", "item", "wait_to_read"}
+_NP_FUNCS = {"asarray", "array"}
+_NP_MODULES = {"np", "numpy", "onp"}
+
+_COLLECTIVES = {"allreduce", "allgather", "all_gather", "barrier",
+                "broadcast", "pushpull", "pushpull_fused", "psum",
+                "pmean", "all_reduce"}
+_ARTIFACT_IO = {"import_model", "export_model", "deserialize_and_load"}
+_OS_IO = {"makedirs", "replace", "remove", "rename", "unlink",
+          "listdir", "rmdir"}
+_SUBPROCESS = {"run", "check_call", "check_output", "Popen"}
+
+
+def _attr_text(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if isinstance(node, ast.Call):
+        inner = _attr_text(node.func)
+        return inner + "()" if inner else ""
+    return ""
+
+
+def _terminal(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def blocking_desc(call: ast.Call) -> Optional[str]:
+    """Short description when ``call`` is itself a blocking operation,
+    else None.  Mirrors the MX008 fault model: anything that can hold
+    the calling thread for milliseconds-to-seconds."""
+    f = call.func
+    name = _terminal(f)
+    chain = _attr_text(f)
+    nargs = len(call.args)
+    kwnames = {k.arg for k in call.keywords}
+    if isinstance(f, ast.Attribute):
+        if name == "compile" and nargs == 0 and not kwnames:
+            return "XLA compile (.compile())"
+        if name == "sleep":
+            return f"{chain or 'sleep'}() sleep"
+        if name == "join" and nargs == 0 and kwnames <= {"timeout"}:
+            return "thread join()"
+        if name == "result" and nargs <= 1:
+            return "future .result()"
+        if name == "wait" and nargs <= 1 and kwnames <= {"timeout"}:
+            return ".wait()"
+        if name == "execute":
+            return "executor launch (.execute())"
+        if name in _ARTIFACT_IO:
+            return f"artifact (de)serialization ({name})"
+        if name in _COLLECTIVES:
+            return f"collective ({name})"
+        if name in _OS_IO and _attr_text(f.value) in ("os", "shutil",
+                                                      "os.path"):
+            return f"file IO (os.{name})"
+        if name in _SUBPROCESS and _attr_text(f.value) == "subprocess":
+            return f"subprocess.{name}"
+    elif isinstance(f, ast.Name):
+        if name == "open":
+            return "file IO (open())"
+        if name == "sleep":
+            return "sleep()"
+    return None
+
+
+def sync_desc(call: ast.Call) -> Optional[str]:
+    """Short description when ``call`` is a device->host sync (the
+    MX002 set, plus jax.device_get)."""
+    f = call.func
+    name = _terminal(f)
+    if isinstance(f, ast.Attribute):
+        if name in _SYNC_METHODS and not call.args:
+            return f".{name}()"
+        if name in _NP_FUNCS and \
+                _terminal(f.value) in _NP_MODULES:
+            return f"numpy.{name}()"
+        if name == "device_get":
+            return "jax.device_get()"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# symbolic call references (resolved later by project.Project)
+# ---------------------------------------------------------------------------
+
+def _call_ref(call: ast.Call,
+              local_types: Dict[str, str]) -> Optional[List[str]]:
+    """Encode the callee as a resolvable symbolic reference:
+
+        ["n", name]            bare-name call (local def / import / class)
+        ["self", meth]         self.meth()
+        ["sattr", attr, meth]  self.<attr>.meth()  (attr type via class map)
+        ["lv", Cls, meth]      <local var of inferred type Cls>.meth()
+        ["a", base, meth]      <Name base>.meth()  (module alias / class)
+        ["c", dotted]          deeper chains, as one dotted string
+    """
+    f = call.func
+    if isinstance(f, ast.Name):
+        return ["n", f.id]
+    if not isinstance(f, ast.Attribute):
+        return None
+    meth = f.attr
+    recv = f.value
+    if isinstance(recv, ast.Name):
+        if recv.id == "self":
+            return ["self", meth]
+        t = local_types.get(recv.id)
+        if t is not None:
+            return ["lv", t, meth]
+        return ["a", recv.id, meth]
+    if isinstance(recv, ast.Attribute):
+        if isinstance(recv.value, ast.Name) and recv.value.id == "self":
+            return ["sattr", recv.attr, meth]
+        dotted = _attr_text(f)
+        if dotted and "()" not in dotted:
+            return ["c", dotted]
+    if isinstance(recv, ast.Call):
+        inner = _attr_text(recv.func)
+        if inner:
+            # e.g. _io_policy().call(...) / default_policy().call(...)
+            return ["lv", inner + "()", meth]
+    return None
+
+
+def _donated_positions(call: ast.Call) -> Tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(e.value for e in v.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, int))
+    return ()
+
+
+_JIT_NAMES = re.compile(r"(^|\.)(jit|pjit|pmap)$")
+
+
+def _is_jit(node: ast.AST) -> bool:
+    chain = _attr_text(node)
+    if chain and _JIT_NAMES.search(chain.replace("()", "")):
+        return True
+    if isinstance(node, ast.Call):
+        if _terminal(node.func) == "partial" and node.args:
+            return _is_jit(node.args[0])
+        return _is_jit(node.func)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the extraction walk
+# ---------------------------------------------------------------------------
+
+class _FnExtractor:
+    """Walks one function body (same scope only; nested defs become
+    child records) tracking the innermost held lock and record()
+    blocks."""
+
+    def __init__(self, fn: ast.AST, qual: str,
+                 pragmas: Optional[Dict[int, Set[str]]] = None):
+        self.fn = fn
+        self._pragmas = pragmas or {}
+        self.rec: Dict[str, Any] = {
+            "line": getattr(fn, "lineno", 1),
+            "params": [a.arg for a in
+                       (list(getattr(fn.args, "posonlyargs", []))
+                        + list(fn.args.args))]
+            if hasattr(fn, "args") else [],
+            "blocks": None, "syncs": None, "raises": False,
+            "donates": {}, "calls": [], "nested": {},
+        }
+        self.local_types: Dict[str, str] = {}
+        self.donating_vars: Dict[str, Tuple[int, ...]] = {}
+        self._prescan(fn)
+        for stmt in fn.body:
+            self._stmt(stmt, lock=None, record=False)
+        # decorator-level donation: @partial(jax.jit, donate_argnums=..)
+        for dec in getattr(fn, "decorator_list", ()):
+            if isinstance(dec, ast.Call) and _is_jit(dec) and \
+                    not self._suppressed("donates", dec.lineno):
+                for pos in _donated_positions(dec):
+                    self.rec["donates"].setdefault(
+                        str(pos), getattr(fn, "lineno", 1))
+
+    def _suppressed(self, effect: str, line: int) -> bool:
+        codes = self._pragmas.get(line)
+        return bool(codes) and ("ALL" in codes
+                                or bool(codes & _EFFECT_RULES[effect]))
+
+    def _prescan(self, fn: ast.AST) -> None:
+        """Local type inference (x = Cls(...)) and jit-donating local
+        names (f = jax.jit(g, donate_argnums=...)) — single forward
+        pass, last assignment wins."""
+        for node in _same_scope(fn):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            if not isinstance(t, ast.Name):
+                continue
+            v = node.value
+            if isinstance(v, ast.Call):
+                # unwrap .lower().compile() AOT chains for donation
+                inner = v
+                while isinstance(inner, ast.Call) and \
+                        isinstance(inner.func, ast.Attribute):
+                    inner = inner.func.value
+                for cand in (v, inner):
+                    if isinstance(cand, ast.Call) and _is_jit(cand.func):
+                        pos = _donated_positions(cand)
+                        if pos:
+                            self.donating_vars[t.id] = pos
+                callee = _attr_text(v.func)
+                leaf = callee.rsplit(".", 1)[-1] if callee else ""
+                if leaf[:1].isupper():
+                    self.local_types[t.id] = callee
+
+    def _with_lock(self, node: ast.AST, lock: Optional[str]
+                   ) -> Tuple[Optional[str], bool]:
+        """(new innermost lock, is_record_block) for a With node."""
+        is_record = False
+        for item in node.items:
+            expr = item.context_expr
+            target = expr.func if isinstance(expr, ast.Call) else expr
+            name = _terminal(target)
+            if name == "record":
+                is_record = True
+            elif name and LOCKISH.search(name):
+                lock = _attr_text(target) or name
+        return lock, is_record
+
+    def _stmt(self, stmt: ast.AST, lock: Optional[str],
+              record: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub = _FnExtractor(stmt, stmt.name, pragmas=self._pragmas)
+            self.rec["nested"][stmt.name] = sub.rec
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        if isinstance(stmt, ast.Raise):
+            self.rec["raises"] = True
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new_lock, is_rec = self._with_lock(stmt, lock)
+            for item in stmt.items:
+                self._exprs(item.context_expr, lock, record)
+            for child in stmt.body:
+                self._stmt(child, new_lock, record or is_rec)
+            return
+        # expressions in this statement, then compound bodies
+        for field in ast.iter_child_nodes(stmt):
+            if isinstance(field, ast.stmt):
+                self._stmt(field, lock, record)
+            elif isinstance(field, (ast.expr, ast.excepthandler,
+                                    ast.keyword)):
+                self._exprs(field, lock, record)
+
+    def _exprs(self, node: ast.AST, lock: Optional[str],
+               record: bool) -> None:
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(n, ast.excepthandler):
+                for child in n.body:
+                    self._stmt(child, lock, record)
+                continue
+            if isinstance(n, ast.Call):
+                self._call(n, lock, record)
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _call(self, call: ast.Call, lock: Optional[str],
+              record: bool) -> None:
+        rec = self.rec
+        b = blocking_desc(call)
+        s = sync_desc(call)
+        if b and self._suppressed("blocks", call.lineno):
+            b = None
+        if s and self._suppressed("syncs", call.lineno):
+            s = None
+        if b and rec["blocks"] is None:
+            rec["blocks"] = [b, call.lineno]
+        if s and rec["syncs"] is None:
+            rec["syncs"] = [s, call.lineno]
+        # direct param donation: param name at a donated position of a
+        # jit-donating call (inline or via a donating local)
+        positions: Tuple[int, ...] = ()
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in self.donating_vars:
+            positions = self.donating_vars[f.id]
+        elif isinstance(f, ast.Call) and _is_jit(f.func):
+            positions = _donated_positions(f)
+        params = rec["params"]
+        if positions and self._suppressed("donates", call.lineno):
+            positions = ()
+        for pos in positions:
+            if pos < len(call.args) and \
+                    isinstance(call.args[pos], ast.Name) and \
+                    call.args[pos].id in params:
+                rec["donates"].setdefault(
+                    str(params.index(call.args[pos].id)), call.lineno)
+        ref = _call_ref(call, self.local_types)
+        if ref is None and not b and not s:
+            return
+        entry: Dict[str, Any] = {"ref": ref, "line": call.lineno,
+                                 "args": [a.id if isinstance(a, ast.Name)
+                                          else None
+                                          for a in call.args]}
+        if lock:
+            entry["lock"] = lock
+        if record:
+            entry["record"] = True
+        if b:
+            entry["block"] = b
+        if s:
+            entry["sync"] = s
+        rec["calls"].append(entry)
+
+
+def _same_scope(fn: ast.AST):
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# ---------------------------------------------------------------------------
+# module-level extraction
+# ---------------------------------------------------------------------------
+
+def _import_map(tree: ast.Module, modname: str,
+                is_pkg: bool = False) -> Dict[str, List[str]]:
+    """alias -> ["mod", dotted] (a module object) or
+    ["sym", dotted-module, symbol] (a name imported from one)."""
+    out: Dict[str, List[str]] = {}
+    # the package relative imports resolve against: the module's own
+    # dotted name for a package __init__, its parent otherwise
+    pkg = modname.split(".") if is_pkg else modname.split(".")[:-1]
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    out[a.asname] = ["mod", a.name]
+                else:
+                    root = a.name.split(".")[0]
+                    out[root] = ["mod", root]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                up = node.level - 1
+                base = pkg[:len(pkg) - up] if up <= len(pkg) else []
+                prefix = ".".join(base + ([node.module]
+                                          if node.module else []))
+            else:
+                prefix = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = ["sym", prefix, a.name]
+    return out
+
+
+def extract_module(tree: ast.Module, modname: str,
+                   is_pkg: bool = False,
+                   source: Optional[str] = None) -> Dict[str, Any]:
+    """The per-file record the project index consumes (and the summary
+    cache stores verbatim).  ``source`` (when given) enables pragma
+    awareness: an effect suppressed at its own line is not recorded,
+    so nobody upstream is flagged for transitively reaching it."""
+    pragmas = pragma_lines(source) if source else {}
+    functions: Dict[str, Any] = {}
+    classes: Dict[str, Any] = {}
+    register_ops: Dict[str, str] = {}
+
+    def op_names(fn: ast.AST) -> List[str]:
+        names: List[str] = []
+        for dec in getattr(fn, "decorator_list", ()):
+            if isinstance(dec, ast.Call) and \
+                    _terminal(dec.func) == "register_op":
+                if dec.args and isinstance(dec.args[0], ast.Constant) \
+                        and isinstance(dec.args[0].value, str):
+                    names.append(dec.args[0].value)
+                for kw in dec.keywords:
+                    if kw.arg == "aliases" and isinstance(
+                            kw.value, (ast.Tuple, ast.List)):
+                        names.extend(e.value for e in kw.value.elts
+                                     if isinstance(e, ast.Constant)
+                                     and isinstance(e.value, str))
+        return names
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            functions[node.name] = _FnExtractor(
+                node, node.name, pragmas=pragmas).rec
+            for op in op_names(node):
+                register_ops.setdefault(op, node.name)
+        elif isinstance(node, ast.ClassDef):
+            hot_cls = bool(HOT_CLASSES.search(node.name))
+            methods: Dict[str, Any] = {}
+            attrs: Dict[str, str] = {}
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    rec = _FnExtractor(item, item.name,
+                                       pragmas=pragmas).rec
+                    if hot_cls and item.name in HOT_METHODS:
+                        rec["hot"] = True
+                    methods[item.name] = rec
+                    # self.<attr> = Cls(...) assignments type the attr
+                    for n in _same_scope(item):
+                        if isinstance(n, ast.Assign) and \
+                                len(n.targets) == 1 and \
+                                isinstance(n.targets[0], ast.Attribute) \
+                                and isinstance(n.targets[0].value,
+                                               ast.Name) and \
+                                n.targets[0].value.id == "self" and \
+                                isinstance(n.value, ast.Call):
+                            callee = _attr_text(n.value.func)
+                            leaf = callee.rsplit(".", 1)[-1] \
+                                if callee else ""
+                            if leaf[:1].isupper():
+                                attrs.setdefault(n.targets[0].attr,
+                                                 callee)
+            classes[node.name] = {
+                "bases": [b for b in (_attr_text(x) for x in node.bases)
+                          if b],
+                "methods": methods, "attrs": attrs,
+            }
+    return {"modname": modname,
+            "imports": _import_map(tree, modname, is_pkg=is_pkg),
+            "functions": functions, "classes": classes,
+            "register_ops": register_ops}

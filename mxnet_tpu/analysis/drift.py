@@ -1,0 +1,100 @@
+"""Cross-artifact drift checks — the cheap seventh pass.
+
+Telemetry and chaos are only useful if the operator-facing docs list
+what actually exists: an instrument nobody can find on a dashboard, or
+a chaos site missing from the fault-model table, is drift the same way
+a stale ``env_vars.md`` is.  These scanners are pure stdlib (AST +
+regex over file bytes, no framework import) so both the mxlint CLI and
+a tier-1 sync test can run them in milliseconds:
+
+  * every metric family name registered in
+    ``telemetry/instruments.py`` must appear in
+    ``docs/observability.md``;
+  * every ``chaos.check("<kind>")`` site in the package must appear in
+    ``docs/resilience.md``'s fault-model table.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Set
+
+__all__ = ["instrument_names", "chaos_sites", "drift_findings"]
+
+_CHAOS_RE = re.compile(r"chaos\.check\(\s*[\"']([a-z_.]+)[\"']")
+
+
+def instrument_names(instruments_path: str) -> Set[str]:
+    """Literal metric family names (``mx_*``) registered through the
+    ``_child``/``_family`` accessors.  Dynamically formatted families
+    (``f"mx_serving_{name}_total"``) are out of scope — their members
+    are documented as a group."""
+    with open(instruments_path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id in ("_child", "_family") and node.args:
+            a = node.args[0]
+            if isinstance(a, ast.Constant) and \
+                    isinstance(a.value, str) and \
+                    a.value.startswith("mx_"):
+                names.add(a.value)
+    return names
+
+
+def chaos_sites(pkg_dir: str) -> Set[str]:
+    """Every ``chaos.check("<kind>")`` literal in the package (the
+    injection sites the fault-model table must list)."""
+    sites: Set[str] = set()
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirpath, fname), "r",
+                          encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            sites.update(_CHAOS_RE.findall(text))
+    return sites
+
+
+def drift_findings(repo_root: str) -> List[str]:
+    """Human-readable drift findings ([] = in sync).  Missing docs
+    files are reported as findings, not errors — a deleted doc IS
+    drift."""
+    out: List[str] = []
+    ins_path = os.path.join(repo_root, "mxnet_tpu", "telemetry",
+                            "instruments.py")
+    obs_path = os.path.join(repo_root, "docs", "observability.md")
+    res_path = os.path.join(repo_root, "docs", "resilience.md")
+    pkg = os.path.join(repo_root, "mxnet_tpu")
+
+    def read(path: str) -> str:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            out.append(f"{os.path.relpath(path, repo_root)}: missing")
+            return ""
+
+    obs = read(obs_path)
+    if os.path.exists(ins_path):
+        for name in sorted(instrument_names(ins_path)):
+            if name not in obs:
+                out.append(
+                    f"instrument {name} (telemetry/instruments.py) is "
+                    f"not documented in docs/observability.md")
+    res = read(res_path)
+    for site in sorted(chaos_sites(pkg)):
+        if f"`{site}`" not in res and site not in res:
+            out.append(
+                f"chaos site {site!r} is not documented in "
+                f"docs/resilience.md's fault-model table")
+    return out

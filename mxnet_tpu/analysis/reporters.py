@@ -1,11 +1,12 @@
-"""mxlint output: human text + machine JSON (the MXLINT.json artifact)."""
+"""mxlint output: human text, machine JSON (the MXLINT.json artifact),
+and SARIF 2.1.0 for diff-annotation in code review UIs."""
 from __future__ import annotations
 
 from typing import Dict, List, Sequence
 
 from .engine import RULE_REGISTRY, Violation
 
-__all__ = ["render_text", "render_json"]
+__all__ = ["render_text", "render_json", "render_sarif"]
 
 
 def _per_rule_counts(violations: Sequence[Violation]) -> Dict[str, int]:
@@ -66,4 +67,51 @@ def render_json(new: Sequence[Violation],
         } for v in new],
         "stale_baseline": list(stale),
         "errors": list(errors),
+    }
+
+
+def render_sarif(new: Sequence[Violation],
+                 tool_version: str = "1.0") -> dict:
+    """SARIF 2.1.0 document over the NEW violations (baselined ones
+    are suppressed by definition — a diff annotator must only mark
+    what fails the gate).  ``partialFingerprints`` carries the same
+    line-drift-stable fingerprint the baseline uses, so review tools
+    dedupe across pushes exactly like the ratchet does."""
+    by_rule: Dict[str, dict] = {}
+    for rid, cls in sorted(RULE_REGISTRY.items()):
+        by_rule[rid] = {
+            "id": rid,
+            "name": cls.name,
+            "shortDescription": {"text": cls.description},
+            "helpUri": "docs/static_analysis.md",
+        }
+    results = []
+    for v in new:
+        results.append({
+            "ruleId": v.rule,
+            "level": "error",
+            "message": {"text": v.message},
+            "partialFingerprints": {"mxlint/v1": v.fingerprint},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": v.path},
+                    "region": {"startLine": v.line,
+                               "startColumn": max(v.col + 1, 1)},
+                },
+                "logicalLocations": [{"fullyQualifiedName": v.symbol}],
+            }],
+        })
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "mxlint",
+                "version": tool_version,
+                "informationUri": "docs/static_analysis.md",
+                "rules": list(by_rule.values()),
+            }},
+            "results": results,
+        }],
     }

@@ -217,19 +217,17 @@ class HostSyncInHotPath(Rule):
     async dispatch pipeline — the exact class of bug that erases the
     fused-step win (arxiv 2004.13336).
 
-    One level interprocedural: a call FROM a hot scope to a same-module
-    helper (module-level function, or ``self.<method>`` on the same
-    class) whose body performs a sync is flagged at the call site —
-    wrapping the ``.asnumpy()`` in a logging helper must not hide it.
-    Exactly one level: helpers of helpers are out of scope (recall
-    traded for zero-false-positive precision)."""
+    Direct syncs only: a sync reached *through* a call — any number of
+    levels deep, across modules — is MX009's job (the mxflow dataflow
+    engine follows the whole call graph; the one-level special case
+    this rule used to carry is gone)."""
 
     id = "MX002"
     name = "hot-path-host-sync"
     description = ("Device->host synchronization (.asnumpy()/np.asarray/"
-                   ".item()/.wait_to_read()) inside autograd.record() "
-                   "or the Trainer.step call chain — direct, or one "
-                   "same-module call deep.")
+                   ".item()/.wait_to_read()) written directly inside "
+                   "autograd.record() or the Trainer.step call chain "
+                   "(transitive reach is MX009).")
 
     _SYNC_METHODS = {"asnumpy", "item", "wait_to_read"}
     _NP_FUNCS = {"asarray", "array"}
@@ -271,91 +269,31 @@ class HostSyncInHotPath(Rule):
                 return f"numpy.{fname}()"
         return None
 
-    def _helper_sync(self, ctx: FileContext, fn: ast.AST
-                     ) -> Optional[Tuple[str, int]]:
-        """First (unsuppressed) direct sync inside a helper body, as
-        (description, line) — the one level of interprocedural reach."""
-        cached = self._helper_cache.get(id(fn))
-        if cached is not None:
-            return cached[0]
-        found = None
-        for node in _walk_excluding_nested_classes(fn):
-            if isinstance(node, ast.Call):
-                desc = self._direct_sync(node)
-                if desc and not ctx.suppressed(self.id, node.lineno):
-                    found = (desc, node.lineno)
-                    break
-        self._helper_cache[id(fn)] = (found,)
-        return found
-
     def check(self, ctx: FileContext) -> Iterable[Violation]:
-        self._helper_cache: Dict[int, tuple] = {}
-        module_fns: Dict[str, ast.AST] = {
-            n.name: n for n in ctx.tree.body
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
         hot = list(self._hot_methods(ctx))
-        hot_ids = {id(m) for m, _ in hot}
         seen: Set[int] = set()
-        # enclosing class per With node, so `self.<helper>()` resolves
-        # inside record() blocks written in methods (innermost class
-        # wins: ctx.classes lists outer classes before nested ones)
-        with_cls: Dict[int, ast.ClassDef] = {}
-        for cls_node in ctx.classes:
-            for item in cls_node.body:
-                if isinstance(item, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                    for nd in ast.walk(item):
-                        if isinstance(nd, (ast.With, ast.AsyncWith)):
-                            with_cls[id(nd)] = cls_node
-        scopes = [(b, "inside autograd.record()", with_cls.get(id(b)))
+        scopes = [(b, "inside autograd.record()")
                   for b in self._record_blocks(ctx)] + \
-                 [(m, f"in the {m.name}() step chain", cls)
-                  for m, cls in hot]
-        for scope, where, cls in scopes:
-            methods = {} if cls is None else {
-                it.name: it for it in cls.body
-                if isinstance(it, (ast.FunctionDef,
-                                   ast.AsyncFunctionDef))}
+                 [(m, f"in the {m.name}() step chain") for m, _ in hot]
+        for scope, where in scopes:
             for node in ast.walk(scope):
                 if id(node) in seen or not isinstance(node, ast.Call):
                     continue
                 desc = self._direct_sync(node)
-                if desc:
-                    if desc.startswith("numpy."):
-                        msg = (f"{desc[:-2]}() {where} synchronously "
-                               "materializes device data on the host")
-                    else:
-                        msg = (f"{desc} {where} blocks on a "
-                               "device->host transfer, stalling the "
-                               "async dispatch pipeline")
-                    seen.add(id(node))
-                    yield ctx.violation(
-                        self.id, node,
-                        msg + "; move it outside the hot loop or use "
-                        "an async metric hook.")
+                if not desc:
                     continue
-                # one-level interprocedural: same-module helper calls
-                helper = None
-                f = node.func
-                if isinstance(f, ast.Name):
-                    helper = module_fns.get(f.id)
-                elif isinstance(f, ast.Attribute) and \
-                        isinstance(f.value, ast.Name) and \
-                        f.value.id == "self":
-                    helper = methods.get(f.attr)
-                if helper is None or helper is scope or \
-                        id(helper) in hot_ids:
-                    continue  # hot methods are flagged at definition
-                sync = self._helper_sync(ctx, helper)
-                if sync:
-                    seen.add(id(node))
-                    yield ctx.violation(
-                        self.id, node,
-                        f"call {where} reaches {sync[0]} inside "
-                        f"helper {_terminal_name(f)}() (line {sync[1]})"
-                        " — a device->host sync one call deep; hoist "
-                        "the sync out of the hot path or make the "
-                        "helper async.")
+                if desc.startswith("numpy."):
+                    msg = (f"{desc[:-2]}() {where} synchronously "
+                           "materializes device data on the host")
+                else:
+                    msg = (f"{desc} {where} blocks on a "
+                           "device->host transfer, stalling the "
+                           "async dispatch pipeline")
+                seen.add(id(node))
+                yield ctx.violation(
+                    self.id, node,
+                    msg + "; move it outside the hot loop or use "
+                    "an async metric hook.")
 
 
 # ---------------------------------------------------------------------------

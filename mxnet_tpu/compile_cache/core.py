@@ -354,9 +354,15 @@ def get_cache() -> Optional[CompileCache]:
     global _ACTIVE
     a = _ACTIVE
     if a is None:
+        # build OUTSIDE the lock (mxflow MX008: DiskStore creation
+        # does directory IO, and every get_cache/reset/enabled call
+        # contends on _active_lock — op dispatch holds its own lock
+        # while calling in here).  Racing builders produce equivalent
+        # instances; the first to publish wins, the loser's instance
+        # holds no resources (makedirs is idempotent, no open fds).
+        built = _build_from_env()
         with _active_lock:
             if _ACTIVE is None:
-                built = _build_from_env()
                 _ACTIVE = built if built is not None \
                     else _DISABLED_SENTINEL
             a = _ACTIVE

@@ -46,6 +46,12 @@ class _ModelEntry:
         self.name, self.version, self.path = name, version, path
         self.metrics = ModelMetrics(name, version)
         self._lock = threading.Lock()
+        # artifact import serializes on its OWN lock (mxflow MX008):
+        # a multi-second import_model must never block begin_use/
+        # end_use/executable-cache lookups, which share the hot entry
+        # lock — a rollover draining an old version used to stall
+        # behind a cold import of the new one
+        self._import_lock = threading.Lock()
         self._served = None
         # mxsan: every bucket-cache access holds self._lock (reads too
         # — the executable() fast path re-checks under the lock)
@@ -125,11 +131,15 @@ class _ModelEntry:
                 # the error must surface to THIS request and leave the
                 # entry importable for the next one
                 _chaos.check("serving.artifact")
-            with self._lock:
+            with self._import_lock:
                 if self._served is None:
                     from ..contrib import deploy
 
-                    self._served = deploy.import_model(self.path)
+                    # single-flight by design: N racing requests must
+                    # pay ONE import, so holding the dedicated
+                    # import-only lock across the blocking load is the
+                    # point (the hot entry lock stays free)
+                    self._served = deploy.import_model(self.path)  # mxlint: disable=MX008
         return self._served
 
     @property

@@ -149,8 +149,15 @@ class InferenceServer:
         # releases artifact+executables once its last use ends)
         entry.begin_use()
 
+        released = []  # idempotence latch: the release may be reached
+        # from both the done-callback and the submit error path when a
+        # callback attached to an already-completed future raises
+
         def _release():
             with self._lock:
+                if released:
+                    return
+                released.append(True)
                 self._pending -= 1
                 self._pending_per[key] -= 1
                 m.gauge("queue_depth", self._pending_per[key])
@@ -160,6 +167,15 @@ class InferenceServer:
         if timeout_ms is None:
             timeout_ms = self.config.default_timeout_ms
         deadline = None if timeout_ms is None else t0 + timeout_ms / 1e3
+
+        def _done(f: Future):
+            _release()
+            if f.cancelled() or f.exception() is not None:
+                # deadline_expired/failed are counted at the batcher,
+                # where the cause is known
+                return
+            m.bump("completed")
+            m.observe_latency(time.monotonic() - t0)
 
         try:
             entry.served  # lazy artifact import, OUTSIDE every lock:
@@ -182,6 +198,11 @@ class InferenceServer:
                 inputs, seed=seed, deadline=deadline,
                 trace=(adm.trace_id, adm.span_id)
                 if adm is not None else None)
+            # hand the slot + use-count release to the done-callback
+            # INSIDE the guarded region (mxflow MX010): once the
+            # request is enqueued, no later failure — span teardown,
+            # trace bookkeeping — may strand the admission slot
+            fut.add_done_callback(_done)
         except BaseException:
             _release()  # admitted but never enqueued: free the slot
             entry.breaker.abandon_probe()
@@ -190,17 +211,6 @@ class InferenceServer:
             if adm is not None:
                 adm.finish()  # admission span = submit-side machinery
         fut.trace_id = adm.trace_id if adm is not None else None
-
-        def _done(f: Future):
-            _release()
-            if f.cancelled() or f.exception() is not None:
-                # deadline_expired/failed are counted at the batcher,
-                # where the cause is known
-                return
-            m.bump("completed")
-            m.observe_latency(time.monotonic() - t0)
-
-        fut.add_done_callback(_done)
         return fut
 
     def infer(self, model: str, inputs, version: Optional[int] = None,

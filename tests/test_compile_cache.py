@@ -12,6 +12,8 @@ import json
 import os
 import subprocess
 import sys
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -611,3 +613,67 @@ def test_warm_cache_tool_populates_for_subprocess(artifact, tmp_path):
     assert row["serving_compiles"] == 0
     # the tool warmed the 6x4,4 sgd shape = exactly the child's net
     assert row["fused_compiles"] == 0
+
+
+class TestMxflowHardening:
+    """ISSUE 8: the MX008 finding the dataflow rules surfaced in
+    compile_cache/ is FIXED — the env-configured cache (and its
+    DiskStore directory IO) is built OUTSIDE ``_active_lock``, so
+    get_cache/reset/enabled never stall behind filesystem work."""
+
+    def test_get_cache_builds_outside_the_active_lock(self, monkeypatch):
+        from mxnet_tpu.compile_cache import core
+
+        cc.reset(None)  # force the build path on next get_cache
+        started = threading.Event()
+        release = threading.Event()
+
+        def slow_build():
+            started.set()
+            release.wait(5.0)
+            return None
+
+        monkeypatch.setattr(core, "_build_from_env", slow_build)
+        t = threading.Thread(target=core.get_cache)
+        t.start()
+        try:
+            assert started.wait(5.0)
+            t0 = time.monotonic()
+            # takes _active_lock: must NOT wait for the slow build
+            cc.reset(disabled=True)
+            dt = time.monotonic() - t0
+            assert dt < 0.25, (
+                f"_active_lock held {dt:.3f}s across the cache build")
+            # the build that loses the publish race must not clobber
+            # the state reset() installed
+            release.set()
+            t.join(5.0)
+            assert cc.get_cache() is None
+        finally:
+            release.set()
+            t.join(5.0)
+
+    def test_concurrent_get_cache_publishes_one_instance(self, tmp_path,
+                                                         monkeypatch):
+        from mxnet_tpu.compile_cache import core
+
+        cc.reset(None)
+        barrier = threading.Barrier(2, timeout=5.0)
+
+        def build():
+            barrier.wait()
+            return cc.CompileCache(disk_dir=str(tmp_path / "d"))
+
+        monkeypatch.setattr(core, "_build_from_env", build)
+        out = []
+        threads = [threading.Thread(
+            target=lambda: out.append(core.get_cache()))
+            for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10.0)
+        assert len(out) == 2
+        # both racing builders resolve to the ONE published instance
+        assert out[0] is out[1]
+        assert core.get_cache() is out[0]

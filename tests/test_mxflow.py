@@ -1,0 +1,332 @@
+"""mxflow engine units (ISSUE 8): per-function CFG + dominators +
+reaching defs, whole-program call-graph resolution (methods through
+the class hierarchy, op-registry indirection, unresolvable-call
+conservatism), and the content-hash summary cache's invalidation
+behavior.  Pure AST work — the whole module must stay well under the
+dataflow tests' 20s budget."""
+import ast
+import json
+import os
+import textwrap
+
+import pytest
+
+from mxnet_tpu.analysis import dataflow as df
+from mxnet_tpu.analysis.dataflow import cfg as cfg_mod
+
+
+def _fn(source: str) -> ast.AST:
+    tree = ast.parse(textwrap.dedent(source).lstrip("\n"))
+    return tree.body[0]
+
+
+def _stmt_block(g, pred):
+    hits = [b for b in g.blocks
+            if b.stmt is not None and pred(b.stmt)]
+    assert hits, "statement not found in CFG"
+    return hits[0]
+
+
+class TestCFG:
+    def test_diamond_dominators(self):
+        g = df.build_cfg(_fn("""
+            def f(x):
+                a = source()
+                if a:
+                    b = 1
+                else:
+                    b = 2
+                return b
+            """))
+        dom = df.dominators(g)
+        header = _stmt_block(g, lambda s: isinstance(s, ast.If))
+        then = _stmt_block(
+            g, lambda s: isinstance(s, ast.Assign) and s.lineno == 4)
+        other = _stmt_block(
+            g, lambda s: isinstance(s, ast.Assign) and s.lineno == 6)
+        join = _stmt_block(g, lambda s: isinstance(s, ast.Return))
+        # the if-header dominates everything downstream; neither
+        # branch dominates the join
+        assert header.id in dom[join.id]
+        assert then.id not in dom[join.id]
+        assert other.id not in dom[join.id]
+        # the join postdominates both branches
+        pdom = df.postdominators(g)
+        assert join.id in pdom[then.id]
+        assert join.id in pdom[other.id]
+
+    def test_loop_has_back_edge_and_header_dominates_body(self):
+        g = df.build_cfg(_fn("""
+            def f(xs):
+                total = 0
+                for x in xs:
+                    total = work(total, x)
+                return total
+            """))
+        header = _stmt_block(g, lambda s: isinstance(s, ast.For))
+        body = _stmt_block(
+            g, lambda s: isinstance(s, ast.Assign) and s.lineno == 4)
+        assert header.id in body.succs  # the back edge
+        assert header.id in df.dominators(g)[body.id]
+
+    def test_exception_edges_only_for_raising_statements(self):
+        g = df.build_cfg(_fn("""
+            def f(entry):
+                n = 1
+                v = fetch()
+                return v + n
+            """))
+        plain = _stmt_block(
+            g, lambda s: isinstance(s, ast.Assign) and s.lineno == 2)
+        risky = _stmt_block(
+            g, lambda s: isinstance(s, ast.Assign) and s.lineno == 3)
+        assert g.raise_id not in plain.succs
+        assert g.raise_id in risky.succs
+
+    def test_finally_clones_keep_normal_and_raise_paths_apart(self):
+        # the duplication property: a normal completion must not be
+        # able to wander into the raise exit just because a finally
+        # exists (the single-shared-finally over-approximation)
+        g = df.build_cfg(_fn("""
+            def f(entry):
+                try:
+                    v = fetch()
+                finally:
+                    entry.log()
+                return v
+            """))
+        ret = _stmt_block(g, lambda s: isinstance(s, ast.Return))
+        # some finally clone flows to the return (normal), some to the
+        # raise exit (exceptional) — but never the same clone to both
+        fin_clones = [b for b in g.blocks
+                      if b.stmt is not None and b.stmt.lineno == 5]
+        assert len(fin_clones) >= 2
+        to_ret = [b for b in fin_clones if ret.id in b.succs]
+        to_raise = [b for b in fin_clones if g.raise_id in b.succs
+                    and ret.id not in b.succs]
+        assert to_ret and to_raise
+
+    def test_reaching_defs_kill_and_merge(self):
+        g = df.build_cfg(_fn("""
+            def f(c):
+                x = 1
+                if c:
+                    x = 2
+                y = use(x)
+                return y
+            """))
+        defs = df.reaching_defs(g)
+        use_block = _stmt_block(
+            g, lambda s: isinstance(s, ast.Assign) and s.lineno == 5)
+        x_defs = {d for (n, d) in defs[use_block.id] if n == "x"}
+        # both the initial def and the branch redefinition reach the
+        # use (the branch may not execute)
+        assert len(x_defs) == 2
+
+    def test_can_raise_ignores_nested_defs_and_safe_calls(self):
+        assert not cfg_mod.can_raise(ast.parse(
+            "def g():\n    boom()\n").body[0])
+        assert not cfg_mod.can_raise(ast.parse("n = len(xs)").body[0])
+        assert cfg_mod.can_raise(ast.parse("n = fetch(xs)").body[0])
+        assert cfg_mod.can_raise(ast.parse("assert x").body[0])
+
+
+# ---------------------------------------------------------------------------
+# call-graph resolution over a real (tmp) package
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def pkg(tmp_path):
+    """A small package exercising the resolution features: methods
+    through a base class, cross-module imports, register_op
+    indirection, and an unresolvable third-party call."""
+    root = tmp_path / "tpkg"
+    root.mkdir()
+    (root / "__init__.py").write_text("")
+    (root / "base.py").write_text(textwrap.dedent("""
+        class Base:
+            def log(self):
+                return self._v.asnumpy()
+        """))
+    (root / "impl.py").write_text(textwrap.dedent("""
+        from .base import Base
+
+        class ImplTrainer(Base):
+            def step(self, n):
+                self.log()          # resolves through the base class
+        """))
+    (root / "ops.py").write_text(textwrap.dedent("""
+        from .registry import register_op
+
+        @register_op("fancy_relu")
+        def fancy_relu(x):
+            \"\"\"doc\"\"\"
+            return x.item()
+        """))
+    (root / "registry.py").write_text(textwrap.dedent("""
+        def register_op(name):
+            def wrap(fn):
+                return fn
+            return wrap
+        """))
+    (root / "use.py").write_text(textwrap.dedent("""
+        import third_party_thing as tp
+
+        def go(F, x):
+            return F.fancy_relu(x)   # op-registry indirection
+
+        def mystery(x):
+            return tp.who_knows(x)   # unresolvable
+        """))
+    return root
+
+
+class TestResolution:
+    def test_method_resolves_through_class_hierarchy(self, pkg):
+        proj = df.build_project([str(pkg)], use_cache=False)
+        step = proj.funcs["tpkg.impl:ImplTrainer.step"]
+        [(entry, callees)] = [(e, c) for e, c in step.edges
+                              if e["ref"] == ["self", "log"]]
+        assert [c.qual for c in callees] == ["tpkg.base:Base.log"]
+        # and the transitive fact flows: step reaches the base's sync
+        assert step.t_syncs is not None
+        assert step.t_syncs[0] == "call"
+
+    def test_op_registry_indirection(self, pkg):
+        proj = df.build_project([str(pkg)], use_cache=False)
+        assert proj.ops["fancy_relu"] == "tpkg.ops:fancy_relu"
+        go = proj.funcs["tpkg.use:go"]
+        callees = [c.qual for e, c2 in go.edges for c in c2]
+        assert "tpkg.ops:fancy_relu" in callees
+        assert go.t_syncs is not None  # .item() two hops away
+
+    def test_unresolvable_call_contributes_nothing(self, pkg):
+        proj = df.build_project([str(pkg)], use_cache=False)
+        mystery = proj.funcs["tpkg.use:mystery"]
+        for entry, callees in mystery.edges:
+            assert callees == []
+        assert mystery.t_syncs is None and mystery.t_blocks is None
+
+    def test_constructor_call_resolves_to_init(self, tmp_path):
+        root = tmp_path / "cpkg"
+        root.mkdir()
+        (root / "__init__.py").write_text("")
+        (root / "store.py").write_text(textwrap.dedent("""
+            import os
+
+            class Store:
+                def __init__(self, d):
+                    os.makedirs(d)
+            """))
+        (root / "core.py").write_text(textwrap.dedent("""
+            from .store import Store
+
+            def build(d):
+                return Store(d)
+            """))
+        proj = df.build_project([str(root)], use_cache=False)
+        build = proj.funcs["cpkg.core:build"]
+        assert build.t_blocks is not None  # makedirs via __init__
+        path, _ = proj.witness_path(build.t_blocks, "blocks")
+        assert "makedirs" in path
+
+
+# ---------------------------------------------------------------------------
+# summary cache: content-hash keyed, invalidates on edit
+# ---------------------------------------------------------------------------
+
+class TestSummaryCache:
+    def _mk(self, tmp_path):
+        root = tmp_path / "kpkg"
+        root.mkdir()
+        (root / "__init__.py").write_text("")
+        (root / "a.py").write_text(textwrap.dedent("""
+            def helper():
+                return 1
+
+            def caller():
+                return helper()
+            """))
+        return root
+
+    def test_second_build_hits_the_cache(self, tmp_path):
+        root = self._mk(tmp_path)
+        p1 = df.build_project([str(root)])
+        assert p1.cache_misses == 2 and p1.cache_hits == 0
+        cache_file = tmp_path / df.CACHE_NAME
+        assert cache_file.exists()
+        p2 = df.build_project([str(root)])
+        assert p2.cache_hits == 2 and p2.cache_misses == 0
+
+    def test_editing_a_dependency_invalidates_its_summary(self, tmp_path):
+        root = self._mk(tmp_path)
+        p1 = df.build_project([str(root)])
+        assert p1.funcs["kpkg.a:caller"].t_syncs is None
+        # edit the DEPENDENCY: helper now syncs.  caller's own file is
+        # untouched, but its transitive fact must change (derived facts
+        # are recomputed every build; only local summaries are cached)
+        (root / "a.py").write_text(textwrap.dedent("""
+            def helper():
+                return thing.asnumpy()
+
+            def caller():
+                return helper()
+            """))
+        p2 = df.build_project([str(root)])
+        assert p2.cache_misses >= 1  # the edited file re-extracted
+        caller = p2.funcs["kpkg.a:caller"]
+        assert caller.t_syncs is not None
+        path, _ = p2.witness_path(caller.t_syncs, "syncs")
+        assert "asnumpy" in path
+
+    def test_cross_file_invalidation(self, tmp_path):
+        root = tmp_path / "xpkg"
+        root.mkdir()
+        (root / "__init__.py").write_text("")
+        (root / "util.py").write_text("def h():\n    return 1\n")
+        (root / "main.py").write_text(
+            "from .util import h\n\ndef top():\n    return h()\n")
+        p1 = df.build_project([str(root)])
+        assert p1.funcs["xpkg.main:top"].t_blocks is None
+        (root / "util.py").write_text(
+            "import time\n\ndef h():\n    time.sleep(1)\n")
+        p2 = df.build_project([str(root)])
+        # main.py came from the cache; its DERIVED fact still updated
+        top = p2.funcs["xpkg.main:top"]
+        assert top.t_blocks is not None
+        path, _ = p2.witness_path(top.t_blocks, "blocks")
+        assert "sleep" in path
+
+    def test_corrupt_cache_file_is_tolerated(self, tmp_path):
+        root = self._mk(tmp_path)
+        df.build_project([str(root)])
+        (tmp_path / df.CACHE_NAME).write_text("{definitely not json")
+        p = df.build_project([str(root)])
+        assert p.cache_misses == 2  # rebuilt from scratch, no crash
+        assert "kpkg.a:caller" in p.funcs
+
+    def test_cache_is_versioned_json(self, tmp_path):
+        root = self._mk(tmp_path)
+        df.build_project([str(root)])
+        doc = json.loads((tmp_path / df.CACHE_NAME).read_text())
+        assert isinstance(doc["version"], int)
+        assert set(doc["files"]) == {"kpkg/__init__.py", "kpkg/a.py"}
+        for ent in doc["files"].values():
+            assert len(ent["sha1"]) == 40
+
+
+class TestPragmaAwareSummaries:
+    def test_pragma_on_effect_line_kills_the_chain(self, tmp_path):
+        root = tmp_path / "ppkg"
+        root.mkdir()
+        (root / "__init__.py").write_text("")
+        (root / "m.py").write_text(textwrap.dedent("""
+            def blessed():
+                return x.asnumpy()  # mxlint: disable=MX002
+
+            def flagged():
+                return y.asnumpy()
+            """))
+        proj = df.build_project([str(root)], use_cache=False)
+        assert proj.funcs["ppkg.m:blessed"].t_syncs is None
+        assert proj.funcs["ppkg.m:flagged"].t_syncs is not None

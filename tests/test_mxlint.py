@@ -42,8 +42,10 @@ class TestSelfLintGate:
             "NEW mxlint violations (fix them or — with a written "
             "justification — add to MXLINT_BASELINE.json):\n"
             + "\n".join(v.format() for v in new))
-        # acceptance criterion: full-package lint well under 15s
-        assert elapsed < 15.0, f"lint took {elapsed:.1f}s (budget 15s)"
+        # acceptance criterion (ISSUE 8): full-package lint incl. the
+        # mxflow whole-program pass stays under 30s even with a cold
+        # summary cache (warm runs are ~6s)
+        assert elapsed < 30.0, f"lint took {elapsed:.1f}s (budget 30s)"
 
     def test_introducing_a_violation_fails_the_gate(self, tmp_path):
         bad = tmp_path / "regression.py"
@@ -262,6 +264,73 @@ class TestLintDrivenHardening:
                 aggregate_stats=before["aggregate_stats"])
 
 
+class TestDocDriftSync:
+    """Cross-artifact drift (ISSUE 8, the cheap seventh pass): the
+    operator-facing docs must list what actually exists — same shape
+    as the env_vars.md sync gate."""
+
+    def test_instruments_and_chaos_sites_are_documented(self):
+        assert analysis.drift_findings(_REPO) == [], (
+            "doc drift — every telemetry instrument belongs in "
+            "docs/observability.md, every chaos site in "
+            "docs/resilience.md (run `python tools/mxlint.py --drift`)")
+
+    def test_scanners_see_the_real_surfaces(self):
+        names = analysis.instrument_names(os.path.join(
+            _REPO, "mxnet_tpu", "telemetry", "instruments.py"))
+        assert "mx_retry_total" in names
+        assert "mx_compile_cache_hit_total" in names
+        assert len(names) >= 15
+        sites = analysis.chaos_sites(os.path.join(_REPO, "mxnet_tpu"))
+        assert {"serving.execute", "compile_cache.io",
+                "dataloader.worker"} <= sites
+
+    def test_missing_doc_row_is_reported(self, tmp_path):
+        # synthetic repo: one instrument, empty docs -> one finding
+        (tmp_path / "mxnet_tpu" / "telemetry").mkdir(parents=True)
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "mxnet_tpu" / "telemetry" / "instruments.py"
+         ).write_text('def x():\n    return _child("mx_shiny_total",'
+                      ' "counter", "h")\n')
+        (tmp_path / "docs" / "observability.md").write_text("# empty\n")
+        (tmp_path / "docs" / "resilience.md").write_text("# empty\n")
+        findings = analysis.drift_findings(str(tmp_path))
+        assert any("mx_shiny_total" in f for f in findings)
+
+
+class TestSarif:
+    def test_render_sarif_shape_and_fingerprints(self, tmp_path):
+        bad = tmp_path / "s.py"
+        bad.write_text("_C = {}\n\ndef p(k, v):\n    _C[k] = v\n")
+        eng = analysis.LintEngine(root=str(tmp_path))
+        vs = eng.run([str(bad)])
+        doc = analysis.render_sarif(vs)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert {"MX004", "MX008", "MX012"} <= rule_ids
+        [res] = [r for r in run["results"] if r["ruleId"] == "MX004"]
+        assert res["partialFingerprints"]["mxlint/v1"] == \
+            vs[0].fingerprint
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "s.py"
+        assert loc["region"]["startLine"] == 4
+
+    def test_cli_sarif_file_output(self, tmp_path):
+        import subprocess
+        import sys
+
+        out = tmp_path / "lint.sarif"
+        p = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "mxlint.py"),
+             os.path.join(_REPO, "mxnet_tpu", "analysis"),
+             "--baseline", _BASELINE, "--sarif", str(out)],
+            capture_output=True, text=True, timeout=120, cwd=_REPO)
+        assert p.returncode == 0, p.stdout[-1500:] + p.stderr[-1500:]
+        doc = json.loads(out.read_text())
+        assert doc["runs"][0]["results"] == []  # shipped tree is clean
+
+
 class TestCLISmoke:
     def test_cli_exits_zero_on_shipped_tree(self):
         import subprocess
@@ -275,7 +344,7 @@ class TestCLISmoke:
         assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
         report = json.loads(p.stdout)
         assert report["ok"] and report["counts"]["new"] == 0
-        assert report["elapsed_seconds"] < 15.0
+        assert report["elapsed_seconds"] < 30.0
 
     def test_diff_mode_flags_an_untracked_violating_file(self):
         import subprocess

@@ -115,9 +115,9 @@ class TestMX002:
             """, enable=["MX002"])
         assert vs == []
 
-    # ---- one-level interprocedural (ISSUE 5) -------------------------
-
-    def test_flags_self_helper_sync_at_step_call_site(self, tmp_path):
+    def test_helper_syncs_are_not_mx002s_job(self, tmp_path):
+        # the one-level special case moved to MX009 (mxflow follows
+        # the whole call graph); MX002 is direct-sync-only now
         vs = lint_source(tmp_path, """
             class MyTrainer:
                 def _log_grads(self):
@@ -126,11 +126,27 @@ class TestMX002:
                 def step(self, batch_size):
                     self._log_grads()
             """, enable=["MX002"])
-        assert rules_hit(vs) == ["MX002"]
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# MX009 — transitive host sync (mxflow)
+# ---------------------------------------------------------------------------
+
+class TestMX009:
+    def test_flags_self_helper_sync_at_step_call_site(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            class MyTrainer:
+                def _log_grads(self):
+                    return self._grads[0].asnumpy()
+
+                def step(self, batch_size):
+                    self._log_grads()
+            """, enable=["MX009"])
+        assert rules_hit(vs) == ["MX009"]
         # flagged at the CALL site inside step, naming the helper
         assert vs[0].symbol == "MyTrainer.step"
         assert "_log_grads()" in vs[0].message
-        assert "one call deep" in vs[0].message
 
     def test_flags_module_helper_called_inside_record(self, tmp_path):
         vs = lint_source(tmp_path, """
@@ -141,10 +157,28 @@ class TestMX002:
                 with autograd.record():
                     v = log_loss(net(x))
                 return v
-            """, enable=["MX002"])
-        assert rules_hit(vs) == ["MX002"]
+            """, enable=["MX009"])
+        assert rules_hit(vs) == ["MX009"]
         assert vs[0].symbol == "train"
         assert "log_loss()" in vs[0].message
+
+    def test_transitive_sync_two_calls_deep_is_flagged(self, tmp_path):
+        # exactly what MX002's one-level special case could not see
+        vs = lint_source(tmp_path, """
+            def inner(y):
+                return y.asnumpy()
+
+            def outer(y):
+                return inner(y)  # sync is TWO calls away from step
+
+            class MyTrainer:
+                def step(self, batch_size):
+                    return outer(self._g)
+            """, enable=["MX009"])
+        assert rules_hit(vs) == ["MX009"]
+        assert vs[0].symbol == "MyTrainer.step"
+        assert "outer()" in vs[0].message
+        assert "inner()" in vs[0].message  # the witness path
 
     def test_clean_helper_without_sync_and_cold_callers(self, tmp_path):
         vs = lint_source(tmp_path, """
@@ -160,10 +194,11 @@ class TestMX002:
 
                 def save_states(self, fname):
                     return syncing_helper(self._g)  # cold path caller
-            """, enable=["MX002"])
+            """, enable=["MX009"])
         assert vs == []
 
     def test_helper_pragma_suppresses_the_call_site_too(self, tmp_path):
+        # a pragma ON the sync line blesses the whole transitive chain
         vs = lint_source(tmp_path, """
             import numpy as np
 
@@ -174,7 +209,7 @@ class TestMX002:
 
                 def step(self, batch_size):
                     return self._pack()
-            """, enable=["MX002"])
+            """, enable=["MX009"])
         assert vs == []
 
     def test_flags_self_helper_in_record_block_inside_method(self, tmp_path):
@@ -188,22 +223,18 @@ class TestMX002:
                     with autograd.record():
                         self._y = net(x)
                         self._log()
-            """, enable=["MX002"])
-        assert rules_hit(vs) == ["MX002"]
+            """, enable=["MX009"])
+        assert rules_hit(vs) == ["MX009"]
         assert "_log()" in vs[0].message
 
-    def test_exactly_one_level_not_transitive(self, tmp_path):
+    def test_unresolvable_call_is_conservatively_clean(self, tmp_path):
         vs = lint_source(tmp_path, """
-            def inner(y):
-                return y.asnumpy()
-
-            def outer(y):
-                return inner(y)  # sync is TWO calls away from step
+            from some_third_party import mystery
 
             class MyTrainer:
                 def step(self, batch_size):
-                    return outer(self._g)
-            """, enable=["MX002"])
+                    return mystery(self._g)  # cannot resolve: no claim
+            """, enable=["MX009"])
         assert vs == []
 
 
@@ -476,6 +507,285 @@ class TestMX007:
                     except Exception:  # mxlint: disable=MX007
                         pass
             """, enable=["MX007"])
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# MX008 — blocking call while a first-party lock is held (mxflow)
+# ---------------------------------------------------------------------------
+
+class TestMX008:
+    def test_flags_direct_blocking_call_under_lock(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import threading
+            import time
+
+            _lock = threading.Lock()
+
+            def tick():
+                with _lock:
+                    time.sleep(0.5)
+            """, enable=["MX008"])
+        assert rules_hit(vs) == ["MX008"]
+        assert "_lock" in vs[0].message and "sleep" in vs[0].message
+
+    def test_flags_blocking_reached_through_helpers(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import threading
+
+            _lock = threading.Lock()
+
+            def _read_blob(path):
+                with open(path, "rb") as f:
+                    return f.read()
+
+            def _load(path):
+                return _read_blob(path)
+
+            def cached_get(path):
+                with _lock:
+                    return _load(path)  # blocks two calls deep
+            """, enable=["MX008"])
+        assert rules_hit(vs) == ["MX008"]
+        assert vs[0].symbol == "cached_get"
+        assert "_load()" in vs[0].message
+        assert "open()" in vs[0].message  # witness path to the IO
+
+    def test_clean_blocking_outside_lock_double_checked(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import threading
+            import time
+
+            _lock = threading.Lock()
+            _cache = {}
+
+            def get(key):
+                v = _cache.get(key)
+                if v is None:
+                    built = time.sleep(0.5) or 42  # OUTSIDE the lock
+                    with _lock:
+                        v = _cache.setdefault(key, built)
+                return v
+            """, enable=["MX008"])
+        assert vs == []
+
+    def test_condition_variables_are_not_lock_regions(self, tmp_path):
+        # `with self._cv: self._cv.wait()` RELEASES the lock — the
+        # batcher idiom must not be flagged
+        vs = lint_source(tmp_path, """
+            class Loop:
+                def run(self):
+                    with self._cv:
+                        self._cv.wait(0.5)
+            """, enable=["MX008"])
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# MX010 — exception-path resource leak (mxflow CFG)
+# ---------------------------------------------------------------------------
+
+class TestMX010:
+    def test_flags_release_not_reached_on_exception_path(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            def run(entry, work):
+                entry.begin_use()
+                out = work()        # may raise: end_use never runs
+                entry.end_use()
+                return out
+            """, enable=["MX010"])
+        assert rules_hit(vs) == ["MX010"]
+        assert "begin_use" in vs[0].message
+        assert "finally" in vs[0].message
+
+    def test_flags_manual_lock_acquire_without_finally(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            def update(lock, cache, key, build):
+                lock.acquire()
+                cache[key] = build()  # raising build() wedges the lock
+                lock.release()
+            """, enable=["MX010"])
+        assert rules_hit(vs) == ["MX010"]
+
+    def test_clean_try_finally_release(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            def run(entry, work):
+                entry.begin_use()
+                try:
+                    return work()
+                finally:
+                    entry.end_use()
+            """, enable=["MX010"])
+        assert vs == []
+
+    def test_clean_release_via_callback_escape(self, tmp_path):
+        # the serving submit shape: the release lives in a closure
+        # handed to add_done_callback, the error path releases inline
+        vs = lint_source(tmp_path, """
+            def submit(entry, batcher):
+                entry.begin_use()
+
+                def _release():
+                    entry.end_use()
+
+                try:
+                    fut = batcher.submit()
+                    fut.add_done_callback(lambda f: _release())
+                    fut.add_done_callback(_release)
+                except BaseException:
+                    _release()
+                    raise
+                return fut
+            """, enable=["MX010"])
+        assert vs == []
+
+    def test_acquire_without_any_local_release_is_out_of_scope(
+            self, tmp_path):
+        # cross-function protocols (acquire here, release elsewhere)
+        # are deliberately not guessed at
+        vs = lint_source(tmp_path, """
+            def pin(entry):
+                entry.begin_use()
+                return entry
+            """, enable=["MX010"])
+        assert vs == []
+
+    def test_with_block_acquire_is_clean(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            def run(sem, work):
+                with sem.acquire():
+                    return work()
+            """, enable=["MX010"])
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# MX011 — retry-unsafe side effects (mxflow CFG)
+# ---------------------------------------------------------------------------
+
+class TestMX011:
+    def test_flags_mutation_before_fallible_operation(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            def flush(self, policy, bucket, push):
+                def attempt():
+                    self.sent += 1       # replayed on every retry
+                    return push(bucket)
+
+                return policy.call(attempt, site="kv.bucket")
+            """, enable=["MX011"])
+        assert rules_hit(vs) == ["MX011"]
+        assert "self.sent" in vs[0].message
+        assert "retry" in vs[0].message
+
+    def test_flags_container_publish_before_risky_call(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            def save(results, policy, fetch):
+                def attempt():
+                    results.append("started")  # caller-visible
+                    return fetch()
+
+                return policy.call(attempt, site="ckpt.io")
+            """, enable=["MX011"])
+        assert rules_hit(vs) == ["MX011"]
+
+    def test_clean_compute_then_publish(self, tmp_path):
+        # the kvstore contract: write only after the last fallible op
+        vs = lint_source(tmp_path, """
+            def flush(self, policy, bucket, push):
+                def attempt():
+                    out = push(bucket)
+                    self.sent += 1       # after success: never replayed
+                    return out
+
+                return policy.call(attempt, site="kv.bucket")
+            """, enable=["MX011"])
+        assert vs == []
+
+    def test_clean_attempt_local_state(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            def load(policy, read):
+                def attempt():
+                    buf = []
+                    buf.append(read())   # attempt-local: retry-safe
+                    return buf
+
+                return policy.call(attempt, site="cache.load")
+            """, enable=["MX011"])
+        assert vs == []
+
+    def test_non_retry_callables_are_ignored(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            def run(self, executor, fetch):
+                def task():
+                    self.count += 1
+                    return fetch()
+
+                return executor.call(task)  # not a RetryPolicy site
+            """, enable=["MX011"])
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# MX012 — donation flow across helpers (mxflow)
+# ---------------------------------------------------------------------------
+
+class TestMX012:
+    # NOTE: indented like the per-test snippets it is concatenated
+    # with, so textwrap.dedent sees one consistent block
+    SRC = """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def _apply(w, g):
+                return w - g
+
+            def helper(w, g):
+                return _apply(w, g)
+            """
+
+    def test_flags_read_after_helper_donates(self, tmp_path):
+        vs = lint_source(tmp_path, self.SRC + """
+            def train(w, g):
+                new_w = helper(w, g)   # helper donates its arg #0
+                return new_w + w       # stale read of the donated buffer
+            """, enable=["MX012"])
+        assert rules_hit(vs) == ["MX012"]
+        assert "`w`" in vs[0].message
+        assert "helper()" in vs[0].message
+        assert "donate_argnums" in vs[0].message  # the witness chain
+
+    def test_flags_donation_two_helpers_deep(self, tmp_path):
+        vs = lint_source(tmp_path, self.SRC + """
+            def outer(w, g):
+                return helper(w, g)
+
+            def train(w, g):
+                new_w = outer(w, g)
+                return new_w + w
+            """, enable=["MX012"])
+        assert rules_hit(vs) == ["MX012"]
+
+    def test_clean_rebind_idiom_and_undonated_arg(self, tmp_path):
+        vs = lint_source(tmp_path, self.SRC + """
+            def train(w, g, batches):
+                for b in batches:
+                    w = helper(w, g)   # canonical rebind: never flagged
+                use = g + 1            # position 1 is NOT donated
+                return w, use
+            """, enable=["MX012"])
+        assert vs == []
+
+    def test_direct_donation_stays_mx005(self, tmp_path):
+        # the same-scope case is MX005's; MX012 must not double-flag
+        vs = lint_source(tmp_path, """
+            import jax
+
+            def run(fn, x, y):
+                f = jax.jit(fn, donate_argnums=(0,))
+                out = f(x, y)
+                return out + x
+            """, enable=["MX012"])
         assert vs == []
 
 

@@ -638,3 +638,87 @@ def test_rollover_through_server_requests(artifact):
         assert repo.get("m", 1)._served is None
     finally:
         srv.shutdown(drain=True, timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# mxflow-driven hardening (ISSUE 8): the MX008/MX010 findings the
+# dataflow rules surfaced in serving/ are FIXED, with the concurrency
+# regressions below pinning each fix.
+# ---------------------------------------------------------------------------
+
+def test_cold_import_does_not_block_entry_hot_lock(artifact, monkeypatch):
+    """MX008 fix: the lazy artifact import serializes on a dedicated
+    import lock — begin_use/end_use/inflight (the rollover drain path)
+    must stay responsive while another thread pays a slow import."""
+    repo = serving.ModelRepository()
+    repo.add("mlp", artifact)
+    entry = repo.get("mlp")
+    importing = threading.Event()
+    real_import = deploy.import_model
+
+    def slow_import(path):
+        importing.set()
+        time.sleep(0.5)
+        return real_import(path)
+
+    monkeypatch.setattr(deploy, "import_model", slow_import)
+    t = threading.Thread(target=lambda: entry.served)
+    t.start()
+    assert importing.wait(5.0)
+    t0 = time.monotonic()
+    entry.begin_use()
+    n = entry.inflight()
+    entry.end_use()
+    dt = time.monotonic() - t0
+    t.join()
+    assert n == 1
+    assert dt < 0.25, (
+        f"hot entry lock blocked {dt:.3f}s behind the artifact import")
+    assert entry._served is not None  # the import itself completed
+
+
+def test_submit_releases_slot_when_span_teardown_fails(artifact,
+                                                      monkeypatch,
+                                                      tmp_path):
+    """MX010 fix: once a request is enqueued, the admission slot and
+    the entry use-count are owned by the done-callback — a failure in
+    the submit path's OWN teardown (span bookkeeping) after enqueue
+    must not strand them.  Before the fix the callback was attached
+    after the finally, so a raising Span.finish leaked the slot
+    forever."""
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving import server as server_mod
+
+    srv, repo = _server(artifact, max_batch_size=4, batch_timeout_ms=1.0)
+    x = nd.array(np.random.RandomState(3).rand(1, 8).astype("float32"))
+    srv.infer("mlp", [x], timeout_ms=120000)  # warm compile first
+
+    real_tracing = server_mod._tracing
+
+    class _BoomSpan(real_tracing.Span):
+        def finish(self):
+            super().finish()
+            raise RuntimeError("span teardown boom")
+
+    class _Shim:
+        Span = _BoomSpan
+
+    monkeypatch.setattr(server_mod, "_tracing", _Shim)
+    profiler.start()
+    try:
+        with pytest.raises(RuntimeError, match="span teardown boom"):
+            srv.submit("mlp", [x], timeout_ms=120000)
+    finally:
+        profiler.stop()
+        profiler.dump(finished=True,
+                      filename=str(tmp_path / "_flush.json"))
+        monkeypatch.setattr(server_mod, "_tracing", real_tracing)
+    # the enqueued request still runs; its completion must release the
+    # admission slot AND the entry use-count via the done-callback
+    deadline = time.monotonic() + 60.0
+    while (srv.pending() or repo.get("mlp").inflight()) and \
+            time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert srv.pending() == 0, "admission slot leaked"
+    assert repo.get("mlp").inflight() == 0, "entry use-count leaked"
+    srv.shutdown(drain=True, timeout=10.0)

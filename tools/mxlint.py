@@ -114,6 +114,15 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default=None,
                     help="also write the JSON report to this file "
                     "(the MXLINT.json artifact)")
+    ap.add_argument("--sarif", default=None, metavar="FILE",
+                    help="also write the NEW violations as SARIF 2.1.0 "
+                    "to FILE (diff-annotation in review UIs); '-' "
+                    "prints to stdout instead of the text report")
+    ap.add_argument("--drift", action="store_true",
+                    help="cross-artifact drift check: telemetry "
+                    "instruments vs docs/observability.md, chaos "
+                    "sites vs docs/resilience.md; exits non-zero on "
+                    "drift")
     ap.add_argument("--write-baseline", default=None, metavar="FILE",
                     help="write every current violation to FILE as the "
                     "new baseline and exit 0")
@@ -142,6 +151,14 @@ def main(argv=None) -> int:
         return _env_docs(args.env_docs or None)
 
     analysis = _load_analysis()
+
+    if args.drift:
+        findings = analysis.drift_findings(_REPO)
+        for f in findings:
+            print(f"drift: {f}")
+        print(f"mxlint --drift: {'FAIL' if findings else 'OK'} — "
+              f"{len(findings)} drift finding(s)")
+        return 1 if findings else 0
 
     if args.list_rules:
         for rid, cls in sorted(analysis.RULE_REGISTRY.items()):
@@ -209,6 +226,15 @@ def main(argv=None) -> int:
 
     report = analysis.render_json(new, suppressed, stale, engine.errors)
     report["elapsed_seconds"] = round(elapsed, 3)
+    if args.sarif is not None:
+        sarif = analysis.render_sarif(new)
+        if args.sarif == "-":
+            json.dump(sarif, sys.stdout, indent=1, sort_keys=True)
+            sys.stdout.write("\n")
+        else:
+            with open(args.sarif, "w", encoding="utf-8") as f:
+                json.dump(sarif, f, indent=1, sort_keys=True)
+                f.write("\n")
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
             json.dump(report, f, indent=1, sort_keys=True)

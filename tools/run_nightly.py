@@ -139,8 +139,25 @@ def main():
             rep = json.loads(lr.stdout)
             gate["counts"] = rep["counts"]
             gate["new_per_rule"] = rep["new_per_rule"]
+            # the full per-rule trajectory incl. the mxflow rules
+            # (MX008–MX012): baselined counts are what ratchets down
+            # across PRs, so the nightly records them too
+            gate["baselined_per_rule"] = rep["baselined_per_rule"]
+            gate["stale_baseline"] = rep["counts"]["stale_baseline"]
         except (ValueError, KeyError):
             pass
+        # cross-artifact drift (the cheap seventh pass): telemetry
+        # instruments vs docs/observability.md, chaos sites vs
+        # docs/resilience.md — doc drift fails the nightly like a
+        # stale env_vars.md does
+        dr = subprocess.run(
+            [sys.executable, "tools/mxlint.py", "--drift"],
+            capture_output=True, text=True, timeout=120, cwd=_REPO,
+            env=cpu_env)
+        gate["drift_returncode"] = dr.returncode
+        gate["drift_tail"] = "\n".join(dr.stdout.splitlines()[-3:])
+        if mxlint_rc == 0 and dr.returncode != 0:
+            mxlint_rc = dr.returncode
         artifact["mxlint"] = gate
     except subprocess.TimeoutExpired:
         mxlint_rc = -1
