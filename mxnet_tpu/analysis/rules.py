@@ -737,3 +737,142 @@ class OpRegistryContract(Rule):
 
     def finalize(self) -> Iterable[Violation]:
         return self._dups
+
+
+# ---------------------------------------------------------------------------
+# MX013 — per-replica dispatch in step-chain code
+# ---------------------------------------------------------------------------
+
+@register_rule
+class PerReplicaDispatch(Rule):
+    """MX013: pmap-style per-replica dispatch in Trainer/Updater/KVStore
+    step-chain code — the pattern the unified SPMD spine (ISSUE 9,
+    optimizer/spmd.py) exists to replace.  Two shapes:
+
+      * a loop in a step-chain method that issues one dispatch per
+        replica/key (``update_all``/``pushpull``/``push``/``pull``/
+        ``device_put``/an ``_updaters[r](...)`` call): N kernel launches
+        where one mesh program would do;
+      * ``jax.device_put(x, <device>)`` with a raw device instead of a
+        sharding: data placed outside the mesh layout cannot
+        participate in GSPMD collective insertion.
+
+    Surviving legacy sites (the eager fallback loops, the classic
+    bucket reduce) are baselined with justifications; NEW step-chain
+    code must land on the SPMD spine."""
+
+    id = "MX013"
+    name = "per-replica-dispatch"
+    description = ("Per-replica dispatch loop, or device_put without a "
+                   "sharding, in Trainer/Updater/KVStore step-chain "
+                   "code — new code belongs on the one-program SPMD "
+                   "spine (optimizer/spmd.py).")
+
+    _HOT_CLASSES = re.compile(r"(Trainer|Updater|KVStore)")
+    _HOT_METHODS = {"step", "update", "_update", "update_all",
+                    "update_all_mesh", "_step_spmd", "__call__",
+                    "allreduce_grads", "_allreduce_grads",
+                    "_allreduce_grads_fused", "_update_fused",
+                    "push", "pull", "pushpull", "pushpull_fused",
+                    "_bucket_allreduce", "_bucket_allreduce_spmd",
+                    "_reduce", "_dcn_allreduce"}
+    _DISPATCH = {"update_all", "pushpull", "push", "pull", "device_put"}
+
+    def _hot_methods(self, ctx: FileContext):
+        for node in ctx.classes:
+            if self._HOT_CLASSES.search(node.name):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) and \
+                            item.name in self._HOT_METHODS:
+                        yield item
+
+    @staticmethod
+    def _is_updater_subscript_call(call: ast.Call) -> bool:
+        """self._updaters[r](...) — the eager per-replica dispatch."""
+        f = call.func
+        return isinstance(f, ast.Subscript) and \
+            _attr_chain(f.value).endswith("_updaters")
+
+    def _dispatch_desc(self, call: ast.Call) -> Optional[str]:
+        fname = _terminal_name(call.func)
+        if fname in self._DISPATCH:
+            return f"{fname}()"
+        if self._is_updater_subscript_call(call):
+            return "_updaters[r](...)"
+        return None
+
+    @staticmethod
+    def _sharding_expr(node: ast.AST) -> bool:
+        """Heuristic: the expression produces a sharding (a call to or
+        attribute of something sharding/spec-named)."""
+        if isinstance(node, ast.Call):
+            name = _terminal_name(node.func)
+            return "shard" in name.lower() or "spec" in name.lower()
+        chain = _attr_chain(node) if isinstance(
+            node, (ast.Attribute, ast.Name)) else ""
+        return "shard" in chain.lower() or "spec" in chain.lower()
+
+    @classmethod
+    def _sharded_locals(cls, method: ast.AST) -> Set[str]:
+        """Local names bound from a sharding-producing expression
+        (``sh = rules.sharding_for(...)``) — one-level flow, same
+        spirit as MX002's helper resolution."""
+        out: Set[str] = set()
+        for node in ast.walk(method):
+            if isinstance(node, ast.Assign) and \
+                    cls._sharding_expr(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    @classmethod
+    def _sharded_second_arg(cls, call: ast.Call,
+                            sharded_locals: Set[str]) -> bool:
+        """True when device_put's placement argument is a sharding."""
+        arg = call.args[1] if len(call.args) >= 2 else next(
+            (kw.value for kw in call.keywords
+             if kw.arg in ("device", "sharding")), None)
+        if arg is None:
+            # only **kwargs / unknown keywords left: benefit of doubt
+            return bool(call.keywords)
+        if isinstance(arg, ast.Name) and arg.id in sharded_locals:
+            return True
+        return cls._sharding_expr(arg)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        seen: Set[int] = set()
+        for method in self._hot_methods(ctx):
+            for node in ast.walk(method):
+                if isinstance(node, (ast.For, ast.While)):
+                    for inner in ast.walk(node):
+                        if not isinstance(inner, ast.Call) or \
+                                id(inner) in seen:
+                            continue
+                        desc = self._dispatch_desc(inner)
+                        if desc is None:
+                            continue
+                        seen.add(id(inner))
+                        yield ctx.violation(
+                            self.id, inner,
+                            f"{desc} inside a loop in the "
+                            f"{method.name}() step chain dispatches "
+                            "once per replica/key — one mesh program "
+                            "(SpmdUpdater.update_all_mesh / "
+                            "pushpull_fused's SPMD path) replaces the "
+                            "whole loop.")
+            sharded_locals = self._sharded_locals(method)
+            for node in ast.walk(method):
+                if isinstance(node, ast.Call) and id(node) not in seen \
+                        and _terminal_name(node.func) == "device_put" \
+                        and not self._sharded_second_arg(
+                            node, sharded_locals):
+                    seen.add(id(node))
+                    yield ctx.violation(
+                        self.id, node,
+                        f"device_put without a sharding in the "
+                        f"{method.name}() step chain pins data to one "
+                        "raw device; pass a NamedSharding (or build "
+                        "the global array per the mesh layout) so XLA "
+                        "can insert collectives.")

@@ -13,6 +13,14 @@ instead of one per key) and the optimizer update through
 jit dispatch, see optimizer/fused.py).  Anything the fused path cannot
 express — kvstore-side updates, gradient compression, sparse gradients —
 falls back to the eager per-parameter loop transparently, per step.
+
+Under ``MXNET_SPMD=1`` (or ``Trainer(spmd=True)``) the whole step tail
+unifies further: gradient reduce AND optimizer update run as ONE jit
+program over a named mesh spanning the replica devices (and, on dist
+kvstores with a local update, every process), with optimizer states
+sharded across the data axis (ZeRO-1) — see optimizer/spmd.py and
+docs/sharding.md.  The same transparent fallbacks apply, and states
+hand off losslessly when a step must take the per-replica path.
 """
 from __future__ import annotations
 
@@ -26,6 +34,7 @@ from ..base import MXNetError
 from ..resilience import chaos as _chaos
 from ..telemetry import instruments as _ins
 from ..telemetry import tracing as _tracing
+from ..util import env as _env
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
@@ -42,7 +51,7 @@ def _phase_metric(phase: str):
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None, fuse_step=None):
+                 update_on_kvstore=None, fuse_step=None, spmd=None):
         if isinstance(params, (dict, ParameterDict)):
             params = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -72,6 +81,14 @@ class Trainer:
         # nothing forces key-level treatment (resolved after kv init)
         self._fuse_step = fuse_step
         self._fuse_active: Optional[bool] = None
+        # None = follow MXNET_SPMD; True/False force.  When engaged,
+        # step() runs gradient reduce + optimizer apply as ONE program
+        # over the replica mesh with ZeRO-sharded states
+        # (optimizer/spmd.py); anything it cannot express falls back to
+        # the per-replica path below, states handed off losslessly.
+        self._spmd_step = spmd
+        self._spmd_active: Optional[bool] = None
+        self._spmd_updater = None
         # separate latch for the UPDATE half only: an optimizer/dtype
         # combination the fused updater can't express must not forfeit
         # the (independent) bucketed gradient allreduce
@@ -175,6 +192,12 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        if self._spmd_resolved() and self._step_spmd():
+            if _tracing._ENABLED:
+                _ins.training_steps_total().inc()
+            if self._auto_ckpt is not None:
+                self._auto_ckpt.on_step(self)
+            return
         if not _tracing.active():  # disabled: one predicate check
             self._allreduce_grads()
             self._update(ignore_stale_grad)
@@ -191,6 +214,139 @@ class Trainer:
                 _ins.training_steps_total().inc()
         if self._auto_ckpt is not None:
             self._auto_ckpt.on_step(self)
+
+    def _spmd_resolved(self) -> bool:
+        """Whether the unified SPMD step path is engaged (decided once,
+        after the kvstore mode is known).  Explicit ``spmd=True``
+        against an incompatible configuration falls back with one
+        warning — like the fused path, SPMD is a pure optimization,
+        never a semantics change."""
+        if self._spmd_active is None:
+            want = self._spmd_step if self._spmd_step is not None \
+                else _env.get_bool("MXNET_SPMD")
+            allowed = (not self._update_on_kvstore
+                       and self._compression_params is None
+                       and self._optimizer.fused_static_key() is not None)
+            if want and not allowed and self._spmd_step:
+                warnings.warn(
+                    "Trainer(spmd=True) requires a local update (no "
+                    "kvstore-side optimizer, no gradient compression) "
+                    "and an optimizer with a fused path; falling back "
+                    "to the per-replica step.", UserWarning,
+                    stacklevel=3)
+            self._spmd_active = bool(want) and allowed
+        return self._spmd_active
+
+    def _dense_uniform_params(self):
+        """Collect the (idxs, plist, nrep) that both single-dispatch
+        update paths (SPMD mesh step, per-replica fused) require:
+        every gradient dense, every param on the same replica count,
+        and one shared ctx list.  Returns None when any of that fails —
+        the caller falls back to its eager/per-replica route."""
+        from ..ndarray.sparse import BaseSparseNDArray
+
+        idxs: List[int] = []
+        plist: List[Parameter] = []
+        nrep = None
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            grads = p.list_grad()
+            if any(isinstance(g, BaseSparseNDArray) for g in grads):
+                return None
+            if nrep is None:
+                nrep = len(grads)
+            elif len(grads) != nrep:
+                return None  # ragged replica layout
+            idxs.append(i)
+            plist.append(p)
+        if plist:
+            ctxs = plist[0].list_ctx()
+            if any(p.list_ctx() != ctxs for p in plist[1:]):
+                return None  # mixed placement
+        return idxs, plist, nrep
+
+    def _step_spmd(self) -> bool:
+        """One-program step over the replica mesh: gradient reduce +
+        sharded optimizer apply in a single dispatch (optimizer/spmd).
+        Returns False (caller runs the per-replica path) when this
+        step's gradients are sparse, the layout is ragged/mixed, or the
+        optimizer/dtype combination cannot take the mesh program — the
+        latter disengages the path and hands the sharded states off to
+        the per-replica updaters losslessly."""
+        def bail() -> bool:
+            """Structural fallback.  Before the mesh ever engaged this
+            is a free per-step retry; once the SPMD updater owns the
+            (sharded) optimizer states, a fallback step would silently
+            run the per-replica path on FRESH zero states — so the
+            path disengages permanently, handing the states off."""
+            if self._spmd_updater is not None:
+                self._spmd_disengage()
+            return False
+
+        collected = self._dense_uniform_params()
+        if collected is None:
+            return bail()
+        idxs, plist, nrep = collected
+        if not plist:
+            return True
+        if nrep > 1 and self._kvstore is None:
+            # kvstore=None with replicas means the caller does NOT want
+            # cross-replica reduction; the mesh program always reduces
+            return bail()
+        dist = self._kvstore is not None \
+            and self._kvstore.type.startswith("dist")
+        if self._spmd_updater is None:
+            updater = opt_mod.SpmdUpdater(self._optimizer)
+            if not updater.supports(
+                    idxs, [p.list_data()[0] for p in plist]):
+                self._spmd_active = False
+                return False
+            if any(u.states for u in self._updaters):
+                # states accumulated on the per-replica path (a
+                # load_states, or steps before SPMD engaged): replica 0
+                # is canonical — re-shard it under the mesh
+                updater.set_states(
+                    self._updaters[0].get_states(dump_optimizer=False))
+            self._spmd_updater = updater
+
+        def run():
+            self._spmd_updater.update_all_mesh(
+                idxs, [p.list_grad() for p in plist],
+                [p.list_data() for p in plist], dist=dist)
+
+        try:
+            if not _tracing.active():
+                run()
+                return True
+            with _tracing.span("step", cat="training"):
+                with _tracing.span("spmd-step", cat="training",
+                                   metric=_phase_metric("spmd-step")):
+                    run()
+        except opt_mod.FusedUnsupported:
+            self._spmd_disengage()
+            return False
+        if _tracing._ENABLED:
+            _ins.spmd_step_total().inc()
+        return True
+
+    def _spmd_disengage(self):
+        """Leave the SPMD path permanently (for this trainer), handing
+        the sharded optimizer states off to the per-replica updaters so
+        the fallback resumes exactly where the mesh program stopped."""
+        updater, self._spmd_updater = self._spmd_updater, None
+        self._spmd_active = False
+        if updater is None or (not updater._bstate
+                               and not updater._pstate
+                               and not updater._pending):
+            return
+        payload = updater.get_states(dump_optimizer=False)
+        ctxs = self._replica_ctxs()
+        nrep = len(ctxs) if ctxs else 1
+        while len(self._updaters) < nrep:
+            self._updaters.append(self._new_updater())
+        for r, u in enumerate(self._updaters):
+            u.set_states(payload, ctx=ctxs[r] if ctxs else None)
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -255,6 +411,13 @@ class Trainer:
     def _update(self, ignore_stale_grad: bool = False):
         if self._update_on_kvstore:
             return  # weights already refreshed by pushpull
+        if self._spmd_updater is not None:
+            # manual allreduce_grads()+update() flow while the mesh
+            # path holds the (sharded) optimizer states: the mesh
+            # program would reduce the already-reduced grads again, and
+            # the per-replica updaters below would start from fresh
+            # zero states — hand the states off and stay per-replica
+            self._spmd_disengage()
         if self._fuse_resolved() and self._fuse_update_ok \
                 and self._update_fused():
             return
@@ -270,30 +433,14 @@ class Trainer:
     def _update_fused(self) -> bool:
         """Single-dispatch update: one FusedUpdater.update_all per
         replica.  Returns False (caller runs the eager loop) when this
-        step's gradients are sparse or the replica layout is ragged."""
-        from ..ndarray.sparse import BaseSparseNDArray
-
-        idxs: List[int] = []
-        plist: List[Parameter] = []
-        nrep = None
-        for i, p in enumerate(self._params):
-            if p.grad_req == "null":
-                continue
-            grads = p.list_grad()
-            if any(isinstance(g, BaseSparseNDArray) for g in grads):
-                return False
-            if nrep is None:
-                nrep = len(grads)
-            elif len(grads) != nrep:
-                return False  # ragged replica layout: eager handles it
-            idxs.append(i)
-            plist.append(p)
+        step's gradients are sparse, the replica layout is ragged, or
+        placement is mixed (one program per device would be needed)."""
+        collected = self._dense_uniform_params()
+        if collected is None:
+            return False
+        idxs, plist, nrep = collected
         if not plist:
             return True
-        ctxs = plist[0].list_ctx()
-        if any(p.list_ctx() != ctxs for p in plist[1:]):
-            return False  # mixed placement: one program per device
-                          # would be needed; eager handles it
         while len(self._updaters) < nrep:
             self._updaters.append(self._new_updater())
         if not self._updaters[0].supports(
@@ -341,6 +488,11 @@ class Trainer:
                 "optimizer state lives on the kvstore "
                 "(update_on_kvstore); use save_states/"
                 "kvstore.save_optimizer_states")
+        if self._spmd_updater is not None:
+            # gather-on-save: the SPMD updater emits the canonical
+            # single-payload format (full-shape host tensors), loadable
+            # onto ANY mesh shape or the per-replica paths
+            return self._spmd_updater.get_states(dump_optimizer=False)
         if not self._updaters:
             self._updaters.append(self._new_updater())
         if len(self._updaters) == 1:
@@ -389,6 +541,17 @@ class Trainer:
         with open(fname, "rb") as f:
             data = f.read()
         obj = pickle.loads(data)
+        if self._spmd_updater is not None:
+            # reshard-on-load: states re-shard lazily under whatever
+            # mesh the next step runs on.  A per-replica wrapped
+            # payload loads its replica 0 (replicas hold identical
+            # state in sync training; the SPMD program keeps ONE copy)
+            if isinstance(obj, dict) and "__mx_replica_states__" in obj:
+                self._spmd_updater.set_states(
+                    obj["__mx_replica_states__"][0])
+            else:
+                self._spmd_updater.set_states(data)
+            return
         # size the updater list by the REPLICA count (knowable from the
         # parameters), not by how many updaters happen to exist — a
         # fresh trainer has none, and restoring fewer than the replica

@@ -172,8 +172,10 @@ class KVStore:
             for k, v, o in zip(keys, vals, outs):
                 self.pushpull(k, v, out=o, priority=priority)
             return
+        spmd = env.get_bool("MXNET_SPMD")
         if bucket_bytes is None:
-            bucket_bytes = _BUCKET_BYTES
+            bucket_bytes = (env.get_int("MXNET_SPMD_BUCKET_BYTES")
+                            if spmd else 0) or _BUCKET_BYTES
         # order-preserving greedy packing into (dtype, n_replicas)-
         # homogeneous buckets capped at bucket_bytes (always >= 1 key)
         buckets: List[List[int]] = []
@@ -202,10 +204,74 @@ class KVStore:
             def _attempt(b=bucket):
                 if _chaos._ACTIVE:
                     _chaos.check("kvstore.pushpull")
-                self._bucket_allreduce(b, keys, vals, outs, dist)
+                if not (spmd and self._bucket_allreduce_spmd(
+                        b, keys, vals, outs, dist)):
+                    self._bucket_allreduce(b, keys, vals, outs, dist)
 
             _retry.default_policy().call(_attempt,
                                          site="kvstore.pushpull_fused")
+
+    def _bucket_allreduce_spmd(self, poss: List[int], keys, vals, outs,
+                               dist: bool) -> bool:
+        """MXNET_SPMD=1: reduce one bucket as ONE jit program over the
+        replica mesh — the per-replica grads are zero-copy shards of a
+        stacked global array, the sum with a replicated output
+        constraint makes XLA emit the AllReduce (ICI in-slice, gloo/DCN
+        across processes), and each replica's output shard rebinds
+        zero-copy.  Local replicas and multi-process (dist) stores are
+        the SAME code path here — only the mesh differs.  Returns False
+        (caller runs the classic gather/DCN path) when the bucket's
+        replica layout cannot form a mesh."""
+        from .parallel.mesh import replica_mesh
+        from .optimizer.spmd import _mesh_devices
+        from .optimizer.fused import FusedUnsupported
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        first = vals[poss[0]]
+        if len(first) == 1 and not dist:
+            return False  # nothing to reduce across
+        local_devs = [v.ctx.jax_device for v in first]
+        try:
+            mesh = replica_mesh(_mesh_devices(local_devs, dist))
+        except (MXNetError, FusedUnsupported):
+            return False
+        for p in poss[1:]:
+            if [v.ctx.jax_device for v in vals[p]] != local_devs:
+                return False  # replica->device layout differs per key
+        nrep = mesh.size("dp")
+        shapes = tuple(tuple(vals[p][0].shape) for p in poss)
+        args = []
+        for p in poss:
+            shp = tuple(vals[p][0].shape)
+            sh = NamedSharding(mesh.mesh, P("dp", *([None] * len(shp))))
+            shards = []
+            for v in vals[p]:
+                d = v.data
+                if list(d.devices()) != [v.ctx.jax_device]:
+                    # same normalization as the classic path: a buffer
+                    # that drifted off its ctx device must move before
+                    # it can shard the global array
+                    d = jax.device_put(d, v.ctx.jax_device)
+                shards.append(d[None])
+            args.append(jax.make_array_from_single_device_arrays(
+                (nrep,) + shp, sh, shards))
+        out_g = _mesh_reduce(mesh.mesh, shapes)(*args)
+        from .telemetry import tracing as _tracing
+        if _tracing._ENABLED:
+            from .telemetry import instruments as _ins
+
+            _ins.collective_bytes_total("all-reduce", "dp").inc(
+                sum(a.nbytes // nrep for a in args))
+        for p, og in zip(poss, out_g):
+            per_dev = {s.device: s.data for s in og.addressable_shards}
+            ctx0 = vals[p][0].ctx
+            agg = NDArray(per_dev[ctx0.jax_device], ctx=ctx0)
+            self._store[keys[p]] = agg  # push contract: publish latest
+            for dst in _as_list(outs[p]):
+                d = per_dev.get(dst.ctx.jax_device)
+                dst._data = d if d is not None \
+                    else agg.as_in_context(dst.ctx)._data
+        return True
 
     def _bucket_allreduce(self, poss: List[int], keys, vals, outs,
                           dist: bool):
@@ -461,6 +527,23 @@ def _bucket_concat_sum(nrep: int, nk: int):
 @functools.lru_cache(maxsize=None)
 def _bucket_split(shapes: tuple):
     return jax.jit(lambda flat: _split_segments(flat, shapes))
+
+
+@functools.lru_cache(maxsize=None)
+def _mesh_reduce(mesh, shapes: tuple):
+    """One program reducing a bucket of stacked [n_replica, ...] global
+    arrays over the mesh's dp axis, outputs replicated (XLA emits the
+    AllReduce; jax.Mesh is hashable, so the lru key is exact)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+
+    def f(*stacks):
+        return tuple(
+            jax.lax.with_sharding_constraint(jnp.sum(s, axis=0), repl)
+            for s in stacks)
+
+    return jax.jit(f)
 
 
 _VALID = {"local", "device", "xla", "nccl", "dist", "dist_sync", "dist_async",

@@ -2,8 +2,10 @@ from .optimizer import (Optimizer, Updater, create, register, get_updater,
                         SGD, NAG, Adam, AdaGrad, AdaDelta, Adamax, Nadam,
                         RMSProp, Ftrl, Signum, SignSGD, LAMB, Test)
 from .fused import FusedUpdater, FusedUnsupported
+from .spmd import SpmdUpdater
 
 __all__ = ["Optimizer", "Updater", "FusedUpdater", "FusedUnsupported",
+           "SpmdUpdater",
            "create", "register",
            "get_updater", "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta",
            "Adamax", "Nadam", "RMSProp", "Ftrl", "Signum", "SignSGD",

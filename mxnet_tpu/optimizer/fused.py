@@ -51,7 +51,8 @@ from ..util import env as _env
 from .. import compile_cache as _cc
 from .optimizer import Optimizer, Updater
 
-__all__ = ["FusedUpdater", "FusedUnsupported", "compile_stats"]
+__all__ = ["FusedUpdater", "FusedUnsupported", "ExecutableCache",
+           "apply_param", "compile_stats"]
 
 
 class FusedUnsupported(Exception):
@@ -59,34 +60,135 @@ class FusedUnsupported(Exception):
     BEFORE any state mutation) — the caller runs the eager loop."""
 
 
-# process-wide executable cache: replicas (and trainers) with identical
-# signatures share one compiled program.  mxsan: lock-free reads are
-# the design (update_all probes before compiling); writes stay under
-# _CACHE_LOCK — the sanitizer checks the write half at runtime.
-# Values are _Entry cells (executable + LRU tick); the cache is BOUNDED
-# by MXNET_FUSED_CACHE_MAX — a long-lived trainer process cycling
-# through tree structures (eval loops, growing models) must not hold
-# every executable it ever built.
-_CACHE: Dict[Tuple, "_Entry"] = _mxsan.track(
-    {}, "optimizer.fused._CACHE", reads="unlocked-ok")
-_CACHE_LOCK = threading.Lock()
-_COMPILES = 0
-_COMPILE_SECONDS = 0.0
-_CACHE_LOADS = 0
-_EVICTIONS = 0
 _TICKS = itertools.count(1)
 
 
 class _Entry:
     """One cached executable.  ``tick`` is LRU recency — refreshed by
     an attribute write on the hot path (no lock, no dict mutation; the
-    eviction scan under _CACHE_LOCK reads it)."""
+    eviction scan under the cache lock reads it)."""
 
     __slots__ = ("fn", "tick")
 
     def __init__(self, fn):
         self.fn = fn
         self.tick = next(_TICKS)
+
+
+class ExecutableCache:
+    """Bounded in-process executable cache + compile accounting for one
+    optimizer-step site, shared by the per-replica fused path (site
+    ``optimizer.fused_step``) and the mesh-wide SPMD path
+    (``optimizer.spmd_step``, optimizer/spmd.py).
+
+    mxsan: lock-free reads are the design (callers probe before
+    compiling); writes stay under ``lock`` — the sanitizer checks the
+    write half at runtime.  Values are _Entry cells (executable + LRU
+    tick); the cache is BOUNDED by MXNET_FUSED_CACHE_MAX — a long-lived
+    trainer process cycling through tree structures (eval loops,
+    growing models) must not hold every executable it ever built.
+
+    The persistent tier (PR 7) is consulted when enabled: the ALIAS key
+    is the cheap in-process ``sig`` (no tracing) for first-party
+    optimizers only — the framework version in the key fingerprint pins
+    THEIR math, but a user's Optimizer subclass can change without it,
+    so those always key by the lowered program text."""
+
+    def __init__(self, site: str, track_name: str, evict_store: str,
+                 span_name: str, metric):
+        self.site = site
+        self.data: Dict[Tuple, _Entry] = _mxsan.track(
+            {}, track_name, reads="unlocked-ok")
+        self.lock = threading.Lock()
+        self._evict_store = evict_store
+        self._span_name = span_name
+        self._metric = metric  # () -> histogram child, lazily resolved
+        self.compiles = 0
+        self.seconds = 0.0
+        self.cache_loads = 0
+        self.evictions = 0
+
+    def lookup(self, sig):
+        """Lock-free hit path; refreshes LRU recency."""
+        ent = self.data.get(sig)
+        if ent is None:
+            return None
+        ent.tick = next(_TICKS)
+        return ent.fn
+
+    def stats(self) -> Dict[str, float]:
+        with self.lock:
+            return {"count": self.compiles, "seconds_total": self.seconds,
+                    "cache_loads": self.cache_loads,
+                    "evictions": self.evictions, "size": len(self.data)}
+
+    def compile(self, sig, build_lowered, optimizer, alias_ok=True):
+        """Build (or load from the persistent store) the executable for
+        ``sig``; insert, LRU-evict past MXNET_FUSED_CACHE_MAX, count.
+        ``alias_ok=False`` forces the program-text key even for
+        first-party optimizers — required when the program embeds USER
+        code (e.g. the SPMD trainer's model forward), which the
+        framework version cannot pin."""
+        t0 = time.perf_counter()
+        if _cc.enabled():
+            alias = _cc.cache_key(
+                f"{self.site}.alias", parts=(sig,)) \
+                if alias_ok and _cc.first_party(
+                    type(optimizer).__module__) else None
+
+            def full_key():
+                return _cc.cache_key(
+                    self.site, parts=(sig,),
+                    program_text=build_lowered().as_text())
+
+            compiled, origin = _cc.get_or_compile(
+                self.site, full_key,
+                lambda: build_lowered().compile(), alias=alias)
+        else:
+            compiled, origin = build_lowered().compile(), "compiled"
+        dt = time.perf_counter() - t0
+        with self.lock:
+            # a concurrent compile of the same signature may have won;
+            # keep the first so the compile count matches the cache
+            prior = self.data.get(sig)
+            if prior is not None:
+                return prior.fn
+            self.data[sig] = _Entry(compiled)
+            if origin == "compiled":
+                self.compiles += 1
+                self.seconds += dt
+            else:
+                self.cache_loads += 1
+            cap = _env.get_int("MXNET_FUSED_CACHE_MAX")
+            evicted = 0
+            while cap and len(self.data) > cap:
+                oldest = min(self.data.items(),
+                             key=lambda kv: kv[1].tick)[0]
+                if oldest == sig:
+                    break  # never evict what we just inserted
+                del self.data[oldest]
+                self.evictions += 1
+                evicted += 1
+        if evicted:  # telemetry outside the cache lock
+            _ins.compile_cache_evict_total(self._evict_store).inc(evicted)
+        if origin == "compiled":
+            # always counted, never gated (serving-compile precedent):
+            # a recompile on the training hot path is the thing to watch
+            self._metric().observe(dt)
+            _tracing.record_complete(self._span_name, "training", t0, dt)
+        _mxsan.record_compile(self.site, sig, dt,
+                              provenance="build" if origin == "compiled"
+                              else "cache")
+        return compiled
+
+
+_FUSED_CACHE = ExecutableCache(
+    "optimizer.fused_step", "optimizer.fused._CACHE", "fused",
+    "fused-compile", lambda: _ins.fused_compile_seconds())
+# module-level aliases: process-wide executable cache — replicas (and
+# trainers) with identical signatures share one compiled program
+_CACHE = _FUSED_CACHE.data
+_CACHE_LOCK = _FUSED_CACHE.lock
 
 
 def compile_stats() -> Dict[str, float]:
@@ -97,10 +199,7 @@ def compile_stats() -> Dict[str, float]:
     ``cache_loads`` counts executables served by the persistent compile
     cache instead of XLA; ``evictions`` counts LRU drops past
     MXNET_FUSED_CACHE_MAX."""
-    with _CACHE_LOCK:
-        return {"count": _COMPILES, "seconds_total": _COMPILE_SECONDS,
-                "cache_loads": _CACHE_LOADS, "evictions": _EVICTIONS,
-                "size": len(_CACHE)}
+    return _FUSED_CACHE.stats()
 
 
 def _state_data(s):
@@ -131,6 +230,25 @@ def _leaf_aval(x):
     return type(x).__name__
 
 
+def apply_param(opt: Optimizer, w, g, s, mp: bool, h: Dict[str, Any]):
+    """One parameter's optimizer update on raw jax values, multi-
+    precision aware — THE traced inner math, shared by the per-replica
+    fused step below and the mesh-wide SPMD step (optimizer/spmd.py).
+
+    ``h`` maps hyper keys to 0-d float32 scalars.  Under mp the fp32
+    master weight is the last state element and is what the math runs
+    on (mp_* semantics); otherwise scalars cast to the weight dtype,
+    matching the eager path's weak-scalar promotion (a python-float
+    attr never upcasts an f16 kernel)."""
+    if mp:
+        inner, w32 = s
+        nw32, ninner = opt.fused_apply(w32, g.astype(jnp.float32),
+                                       inner, h)
+        return nw32.astype(w.dtype), (ninner, nw32)
+    h = {k: v.astype(w.dtype) for k, v in h.items()}
+    return opt.fused_apply(w, g, s, h)
+
+
 def _build_step(opt: Optimizer, mp_flags: Tuple[bool, ...]):
     """The traced program: apply the optimizer's pure math to every
     parameter.  Static hyperparams are read off `opt` at trace time and
@@ -139,24 +257,14 @@ def _build_step(opt: Optimizer, mp_flags: Tuple[bool, ...]):
     Per-step scalars arrive PACKED: one (n_params,) float32 vector per
     hyper key instead of n_params scalar buffers — three host->device
     transfers per step, not 3N (scalar transfer cost would otherwise
-    swamp the single-dispatch win).  Each parameter's slice is cast to
-    its computation dtype, matching the eager path's weak-scalar
-    promotion (a python-float attr never upcasts an f16 kernel)."""
+    swamp the single-dispatch win)."""
 
     def step(weights, grads, states, hyper_vecs):
         new_w, new_s = [], []
         for i, (w, g, s, mp) in enumerate(zip(weights, grads, states,
                                               mp_flags)):
-            if mp:
-                h = {k: v[i] for k, v in hyper_vecs.items()}
-                inner, w32 = s
-                nw32, ninner = opt.fused_apply(
-                    w32, g.astype(jnp.float32), inner, h)
-                nw, ns = nw32.astype(w.dtype), (ninner, nw32)
-            else:
-                h = {k: v[i].astype(w.dtype)
-                     for k, v in hyper_vecs.items()}
-                nw, ns = opt.fused_apply(w, g, s, h)
+            h = {k: v[i] for k, v in hyper_vecs.items()}
+            nw, ns = apply_param(opt, w, g, s, mp, h)
             new_w.append(nw)
             new_s.append(ns)
         return tuple(new_w), tuple(new_s)
@@ -241,11 +349,8 @@ class FusedUpdater(Updater):
                donate, str(dev), treedef,
                tuple(_leaf_aval(x) for x in leaves))
 
-        ent = _CACHE.get(sig)
-        if ent is not None:
-            ent.tick = next(_TICKS)  # LRU recency, lock-free
-            fn = ent.fn
-        else:
+        fn = _FUSED_CACHE.lookup(sig)
+        if fn is None:
             fn = self._compile(sig, args, mp_flags, donate)
         new_w, new_s = fn(*args)
 
@@ -255,8 +360,6 @@ class FusedUpdater(Updater):
             _rebind_state(s, ns)
 
     def _compile(self, sig, args, mp_flags, donate):
-        global _COMPILES, _COMPILE_SECONDS, _CACHE_LOADS, _EVICTIONS
-        t0 = time.perf_counter()
         cell = {}
 
         def build_lowered():
@@ -268,62 +371,4 @@ class FusedUpdater(Updater):
                 lowered = cell["lowered"] = jitted.lower(*args)
             return lowered
 
-        if _cc.enabled():
-            # persistent tier: a fresh process (preemption restart)
-            # takes its first fused step from disk, not from XLA.  The
-            # ALIAS key is the in-process sig (class/statics/treedef/
-            # avals/device — cheap, no tracing); a warm restart skips
-            # trace+lower entirely.  The full key (alias miss only)
-            # adds the lowered program text.  First-party optimizers
-            # only: the framework version in the key fingerprint pins
-            # THEIR math, but a user's Optimizer subclass can change
-            # without it — those always key by the lowered program.
-            alias = _cc.cache_key(
-                "optimizer.fused_step.alias", parts=(sig,)) \
-                if _cc.first_party(type(self.optimizer).__module__) \
-                else None
-
-            def full_key():
-                return _cc.cache_key(
-                    "optimizer.fused_step", parts=(sig,),
-                    program_text=build_lowered().as_text())
-
-            compiled, origin = _cc.get_or_compile(
-                "optimizer.fused_step", full_key,
-                lambda: build_lowered().compile(), alias=alias)
-        else:
-            compiled, origin = build_lowered().compile(), "compiled"
-        dt = time.perf_counter() - t0
-        with _CACHE_LOCK:
-            # a concurrent compile of the same signature may have won;
-            # keep the first so the compile count matches the cache
-            prior = _CACHE.get(sig)
-            if prior is not None:
-                return prior.fn
-            _CACHE[sig] = _Entry(compiled)
-            if origin == "compiled":
-                _COMPILES += 1
-                _COMPILE_SECONDS += dt
-            else:
-                _CACHE_LOADS += 1
-            cap = _env.get_int("MXNET_FUSED_CACHE_MAX")
-            evicted = 0
-            while cap and len(_CACHE) > cap:
-                oldest = min(_CACHE.items(),
-                             key=lambda kv: kv[1].tick)[0]
-                if oldest == sig:
-                    break  # never evict what we just inserted
-                del _CACHE[oldest]
-                _EVICTIONS += 1
-                evicted += 1
-        if evicted:  # telemetry outside _CACHE_LOCK
-            _ins.compile_cache_evict_total("fused").inc(evicted)
-        if origin == "compiled":
-            # always counted, never gated (serving-compile precedent):
-            # a recompile on the training hot path is the thing to watch
-            _ins.fused_compile_seconds().observe(dt)
-            _tracing.record_complete("fused-compile", "training", t0, dt)
-        _mxsan.record_compile("optimizer.fused_step", sig, dt,
-                              provenance="build" if origin == "compiled"
-                              else "cache")
-        return compiled
+        return _FUSED_CACHE.compile(sig, build_lowered, self.optimizer)

@@ -132,6 +132,14 @@ class Optimizer:
     # the eager loop for half-precision weights without a multi-
     # precision master copy (see FusedUpdater.update_all).
     _FUSED_T_HYPER = False
+    # True when fused_apply is purely ELEMENTWISE (every output element
+    # depends only on the matching input elements + scalars).  The SPMD
+    # step (optimizer/spmd.py) may then concatenate many parameters
+    # into one flat ZeRO bucket — one reduce-scatter/update/all-gather
+    # per bucket instead of per parameter.  Norm-based updates (LAMB's
+    # per-tensor trust ratio) must keep per-parameter tensors and set
+    # this False.
+    _FUSED_ELEMENTWISE = True
 
     def fused_static_key(self) -> Optional[Tuple]:
         """Hashable fingerprint of the trace-time attrs, or None when
@@ -627,6 +635,9 @@ class LAMB(Optimizer):
     _FUSED_STATIC = ("beta1", "beta2", "epsilon", "lower_bound",
                      "upper_bound", "bias_correction", "clip_gradient")
     _FUSED_T_HYPER = True
+    # the phase-2 trust ratio is per-TENSOR (norm(w)/norm(update)):
+    # concatenating params would corrupt the norms
+    _FUSED_ELEMENTWISE = False
 
     def fused_hyper(self, index, t):
         h = super().fused_hyper(index, t)

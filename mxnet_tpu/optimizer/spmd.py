@@ -1,0 +1,761 @@
+"""Unified SPMD optimizer step: ONE program over the replica mesh.
+
+The per-replica fused path (optimizer/fused.py) still dispatches pmap
+style: N replicas mean N AOT dispatches per step, plus separate bucket
+collectives, with every replica holding a full copy of the optimizer
+states.  ``SpmdUpdater`` collapses the whole step-chain tail — gradient
+reduce + optimizer apply — into a SINGLE donated ``jax.jit`` program
+compiled under a named 1-D ``dp`` mesh over the replica devices
+(``parallel.mesh.replica_mesh``), with ``NamedSharding`` annotations on
+grads and optimizer states so XLA inserts the collectives.
+
+Inside the program the parameters are grouped by a static **bucket
+plan** (the "bucketed reduce + fused apply" layout):
+
+  * **ZeRO buckets** — parameters ≥ ``MXNET_ZERO_MIN_SIZE`` elements
+    whose optimizer is elementwise concatenate (flat, padded to the
+    shard count) into dtype/mp-homogeneous buckets capped at
+    ``MXNET_SPMD_BUCKET_BYTES``.  Per bucket: one **reduce-scatter**
+    (replica sum constrained to the ``dp`` layout), one shard-local
+    **update** on 1/N of the elements with per-element hyper vectors,
+    one **all-gather** of the fresh weights.  Optimizer states live
+    flat-sharded — each device holds 1/N of every state tensor
+    (ZeRO-1 / cross-replica weight-update sharding, arXiv:2004.13336).
+  * **small group** — everything below the threshold reduces in one
+    concatenated **all-reduce**, then updates per-parameter on
+    replicated (original-shape) tensors: sharding a 64-element bias
+    would pay collective latency for nothing.
+  * **singles** — norm-based optimizers (LAMB) keep per-parameter
+    tensors (the trust ratio is per tensor) but still shard their
+    states and update across ``dp`` when big enough.
+
+Data-parallel local replicas, multi-process (DCN) layouts, and the
+single-device degenerate case are the same code path: only the mesh
+differs.  ``MXNET_ZERO_STATES=0`` keeps every state replicated (the
+collectives are then plain all-reduces, still one program).
+
+Hyper scalars stay TRACED (packed vectors, like the fused path), so lr
+schedules never recompile; the executable is AOT-compiled once per
+(optimizer class, statics, mesh layout, plan, tree/avals) and routed
+through the persistent compile cache (PR 7) so a fresh process
+warm-starts the mesh-wide program from disk.
+
+Per-replica t-skew note: the per-replica paths bump the shared update
+count once per replica, so replica r applies bias correction at
+``t = step*N - N + r + 1``.  One program produces one result; it uses
+the replica-0 trajectory (first bump) and keeps bumping N times per
+step so schedules stay aligned when paths mix mid-run.  For t-free
+optimizers the two paths are fp-tolerant identical; for t-optimizers
+the SPMD result equals the per-replica path's replica 0 (and keeps
+replicas exactly in sync, which the skewed path does not).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ndarray.ndarray import NDArray
+from ..telemetry import instruments as _ins
+from ..telemetry import tracing as _tracing
+from ..util import env as _env
+from .fused import (ExecutableCache, FusedUnsupported, _leaf_aval,
+                    apply_param)
+from .optimizer import Optimizer, Updater
+
+__all__ = ["SpmdUpdater", "compile_stats"]
+
+AXIS = "dp"
+
+_SPMD_CACHE = ExecutableCache(
+    "optimizer.spmd_step", "optimizer.spmd._CACHE", "spmd",
+    "spmd-compile", lambda: _ins.spmd_compile_seconds())
+
+
+def compile_stats() -> Dict[str, float]:
+    """SPMD-step executable builds in this process — the
+    one-executable-per-(mesh, layout) guarantee is asserted against
+    ``count`` (phased tracing variants are separate jit programs built
+    only while tracing is active and are not counted here)."""
+    return _SPMD_CACHE.stats()
+
+
+class _Meta(NamedTuple):
+    shape: Tuple[int, ...]
+    dtype: str
+    size: int     # prod(shape)
+    padded: int   # size rounded up to a multiple of the shard count
+
+
+class _Bucket(NamedTuple):
+    """One ZeRO bucket: concatenated flat-padded params, dp-sharded."""
+    pos: Tuple[int, ...]       # positions into the step's param list
+    offsets: Tuple[int, ...]   # each param's start in the concat flat
+    sizes: Tuple[int, ...]     # each param's padded length
+    total: int
+    mp: bool
+
+
+class _Small(NamedTuple):
+    """Sub-threshold params: one concatenated all-reduce, replicated
+    per-param updates."""
+    pos: Tuple[int, ...]
+    sizes: Tuple[int, ...]     # unpadded flat lengths (concat offsets)
+
+
+class _Plan(NamedTuple):
+    buckets: Tuple[_Bucket, ...]
+    smalls: Tuple[_Small, ...]
+    singles: Tuple[int, ...]   # per-param ZeRO (norm-based optimizers)
+
+
+def _padded(n: int, k: int) -> int:
+    return ((max(n, 1) + k - 1) // k) * k
+
+
+def _pad_flat(x, padded: int):
+    """Flatten and zero-pad to the shard-divisible length (traced)."""
+    f = x.reshape(-1)
+    if f.shape[0] == padded:
+        return f
+    return jnp.pad(f, (0, padded - f.shape[0]))
+
+
+def _tree_map(fn, tree):
+    """Map over a state tree (None | leaf | tuple), preserving shape."""
+    if tree is None:
+        return None
+    if isinstance(tree, tuple):
+        return tuple(_tree_map(fn, t) for t in tree)
+    return fn(tree)
+
+
+def _tree_multi(fn, trees):
+    """Zip same-structure state trees; fn receives the leaf list."""
+    if trees[0] is None:
+        return None
+    if isinstance(trees[0], tuple):
+        return tuple(_tree_multi(fn, [t[i] for t in trees])
+                     for i in range(len(trees[0])))
+    return fn(trees)
+
+
+def _mesh_devices(local_devices: List, dist: bool) -> List:
+    """The global replica device list: the local replicas, or — on a
+    multi-process (DCN) job — every process's matching local devices,
+    process-ordered, so the one program spans the whole job."""
+    if not dist or jax.process_count() == 1:
+        return list(local_devices)
+    nloc = len(local_devices)
+    groups: Dict[int, List] = {}
+    for d in jax.devices():
+        groups.setdefault(d.process_index, []).append(d)
+    out: List = []
+    for p in sorted(groups):
+        g = sorted(groups[p], key=lambda d: d.id)[:nloc]
+        if len(g) != nloc:
+            raise FusedUnsupported(
+                f"spmd: process {p} exposes {len(groups[p])} devices, "
+                f"need {nloc} per process for a rectangular mesh")
+        out.extend(g)
+    mine = [d for d in out if d.process_index == jax.process_index()]
+    if set(mine) != set(local_devices):
+        raise FusedUnsupported(
+            "spmd: this process's replica devices are not its first "
+            f"{nloc} local devices; the cross-process mesh would not "
+            "cover them")
+    return out
+
+
+class SpmdUpdater(Updater):
+    """Updater whose batch entry point (``update_all_mesh``) runs the
+    gradient reduce AND the whole parameter update as one compiled
+    program over the replica mesh, with optimizer states sharded across
+    it (ZeRO-1).  Extends the serializable ``Updater``:
+    ``get_states``/``set_states`` speak the identical single-payload
+    format (states are gathered to canonical full-shape numpy on save),
+    so checkpoints round-trip with the per-replica paths and resume
+    onto a DIFFERENT mesh shape re-shards on load."""
+
+    def __init__(self, optimizer: Optimizer,
+                 zero_states: Optional[bool] = None):
+        super().__init__(optimizer)
+        self._zero = _env.get_bool("MXNET_ZERO_STATES") \
+            if zero_states is None else bool(zero_states)
+        self._mesh = None            # parallel.mesh.DeviceMesh
+        self._layout = None          # mesh layout fingerprint
+        self._flat = False           # ZeRO sharding active (nshard > 1)
+        self._plan: Optional[_Plan] = None
+        self._plan_indices: Optional[Tuple[int, ...]] = None
+        # state storage mirrors the plan: one concatenated tree per
+        # bucket, one per-param tree for smalls/singles
+        self._bstate: Dict[int, Any] = {}    # bucket ordinal -> tree
+        self._pstate: Dict[int, Any] = {}    # param index -> tree
+        self._mp: Dict[int, bool] = {}
+        self._meta: Dict[int, _Meta] = {}
+        self._pending: Optional[Dict[int, Any]] = None  # numpy trees
+        self._phased = {}            # sig -> (reduce, update, gather)
+        # steady-state caches: the signature (treedef/avals never
+        # change while the param set is stable) and the replicated
+        # weight globals (last step's OUTPUT is next step's input when
+        # nothing rebound the buffers externally)
+        self._sig_cache: Optional[Tuple] = None
+        self._w_global: Dict[int, Tuple] = {}
+
+    # ---- mesh ------------------------------------------------------------
+    def _ensure_mesh(self, local_devices: List, dist: bool):
+        from ..parallel.mesh import layout_key, replica_mesh
+
+        devs = _mesh_devices(local_devices, dist)
+        if self._mesh is not None:
+            if list(self._mesh.devices) != devs:
+                raise FusedUnsupported(
+                    "spmd: replica device layout changed mid-run; "
+                    "falling back to the per-replica path")
+        else:
+            self._mesh = replica_mesh(devs)
+            self._layout = layout_key(self._mesh)
+            # ZeRO sharding only when there is something to shard
+            # ACROSS; the degenerate 1-shard mesh keeps original shapes
+            # (pad/slice copies would cost bandwidth and buy nothing)
+            self._flat = self._zero and self._mesh.size(AXIS) > 1
+        # re-set every step, not just at creation: tracing may enable
+        # after the mesh engaged, and gauges must reflect the layout
+        # of whichever trainer stepped last
+        if _tracing._ENABLED:
+            _ins.step_layout_axis_size(AXIS).set(self._mesh.size(AXIS))
+            _ins.step_state_shard_factor().set(self.shard_factor())
+        return self._mesh
+
+    @property
+    def nshard(self) -> int:
+        return self._mesh.size(AXIS) if self._mesh is not None else 1
+
+    def shard_factor(self) -> int:
+        """Ways the (bucketed) optimizer states split across devices."""
+        return self.nshard if self._flat else 1
+
+    # ---- plan ------------------------------------------------------------
+    def _build_plan(self, indices: List[int]) -> _Plan:
+        opt = self.optimizer
+        elementwise = bool(opt._FUSED_ELEMENTWISE)
+        zero_min = _env.get_int("MXNET_ZERO_MIN_SIZE") or 0
+        cap = _env.get_int("MXNET_SPMD_BUCKET_BYTES") \
+            or _env.get_int("MXNET_FUSED_BUCKET_BYTES")
+        buckets: List[_Bucket] = []
+        smalls: Dict[Tuple, List[int]] = {}
+        singles: List[int] = []
+        cur: List[int] = []
+        cur_key, cur_bytes = None, 0
+
+        def close():
+            nonlocal cur, cur_bytes
+            if cur:
+                sizes = tuple(self._meta[indices[q]].padded for q in cur)
+                offs, off = [], 0
+                for s in sizes:
+                    offs.append(off)
+                    off += s
+                buckets.append(_Bucket(tuple(cur), tuple(offs), sizes,
+                                       off, self._mp[indices[cur[0]]]))
+            cur, cur_bytes = [], 0
+
+        for p, i in enumerate(indices):
+            m = self._meta[i]
+            if not self._flat or m.size < zero_min:
+                smalls.setdefault((m.dtype, self._mp[i]),
+                                  []).append(p)
+                continue
+            if not elementwise:
+                singles.append(p)
+                continue
+            key = (m.dtype, self._mp[i])
+            nbytes = m.padded * np.dtype(m.dtype).itemsize
+            if cur and (key != cur_key or cur_bytes + nbytes > cap):
+                close()
+            cur.append(p)
+            cur_key, cur_bytes = key, cur_bytes + nbytes
+        close()
+        small_groups = tuple(
+            _Small(tuple(ps),
+                   tuple(self._meta[indices[p]].size for p in ps))
+            for _, ps in sorted(smalls.items()))
+        return _Plan(tuple(buckets), small_groups, tuple(singles))
+
+    # ---- sharding/data movement -----------------------------------------
+    def _shard(self, flat: bool) -> NamedSharding:
+        return NamedSharding(self._mesh.mesh, P(AXIS) if flat else P())
+
+    def _materialize_states(self, indices, weights0):
+        """Build the plan-shaped global state storage from the pending
+        payload and/or freshly created per-param states."""
+        from ..parallel.spmd import _global_put
+
+        opt = self.optimizer
+        pend = self._pending or {}
+
+        def host_tree(i, w):
+            if i in pend:
+                return _tree_map(np.asarray, pend[i])
+            tree = opt.create_state_multi_precision(i, w)
+            return _tree_map(
+                lambda leaf: np.asarray(jax.device_get(leaf.data)), tree)
+
+        host = {i: host_tree(i, w) for i, w in zip(indices, weights0)}
+        plan = self._plan
+        for bi, b in enumerate(plan.buckets):
+            trees = [host[indices[p]] for p in b.pos]
+
+            def cat(leaves, b=b):
+                flats = []
+                for leaf, p in zip(leaves, b.pos):
+                    m = self._meta[indices[p]]
+                    f = leaf.reshape(-1)
+                    if f.size != m.padded:
+                        f = np.pad(f, (0, m.padded - f.size))
+                    flats.append(f)
+                return _global_put(np.concatenate(flats),
+                                   self._shard(True))
+
+            self._bstate[bi] = _tree_multi(cat, trees)
+        for g in plan.smalls:
+            for p in g.pos:
+                i = indices[p]
+                self._pstate[i] = _tree_map(
+                    lambda leaf: _global_put(leaf, self._shard(False)),
+                    host[i])
+        for p in plan.singles:
+            i = indices[p]
+            m = self._meta[i]
+
+            def put_single(leaf, m=m):
+                f = np.asarray(leaf).reshape(-1)
+                if f.size != m.padded:
+                    f = np.pad(f, (0, m.padded - f.size))
+                return _global_put(f, self._shard(True))
+
+            self._pstate[i] = _tree_map(put_single, host[i])
+        self._pending = None
+
+    def _gather_np(self, garr) -> np.ndarray:
+        """Global (possibly sharded, possibly multi-process) array ->
+        host numpy."""
+        if not garr.is_fully_addressable:
+            garr = jax.jit(
+                lambda x: x,
+                out_shardings=NamedSharding(self._mesh.mesh, P()))(garr)
+            return np.asarray(garr.addressable_data(0))
+        return np.asarray(garr)
+
+    # ---- probes ----------------------------------------------------------
+    def supports(self, indices: List[int],
+                 weights: List[NDArray]) -> bool:
+        """Static-compatibility probe, mutation-free: False when this
+        parameter set must take a fallback path (same condition as the
+        fused updater: in-kernel bias correction cannot trace t in half
+        precision without a master copy)."""
+        opt = self.optimizer
+        if not opt._FUSED_T_HYPER:
+            return True
+        for w in weights:
+            if (str(w.data.dtype) in ("float16", "bfloat16")
+                    and not opt.multi_precision):
+                return False
+        return True
+
+    # ---- the step --------------------------------------------------------
+    def update_all_mesh(self, indices: List[int],
+                        grads: List[List[NDArray]],
+                        weights: List[List[NDArray]],
+                        dist: bool = False) -> None:
+        """One optimizer step for every parameter across every replica
+        in a single dispatch.  ``grads[p][r]`` / ``weights[p][r]`` index
+        parameter p's replica r; replica r of every parameter must live
+        on the same device (the Trainer guarantees this)."""
+        opt = self.optimizer
+        nrep = len(weights[0])  # LOCAL replicas (this process's shards)
+        local_devs = [w.ctx.jax_device for w in weights[0]]
+        mesh = self._ensure_mesh(local_devs, dist)
+        nshard = mesh.size(AXIS)  # GLOBAL replica count across the job
+
+        if opt._FUSED_T_HYPER and not opt.multi_precision and any(
+                str(w[0].data.dtype) in ("float16", "bfloat16")
+                for w in weights):
+            # raised before any count/state mutation (fused-path
+            # precedent): the traced t cannot live in half precision
+            raise FusedUnsupported(
+                f"{type(opt).__name__}: half-precision weights without "
+                "multi_precision need the eager loop")
+
+        for i, w in zip(indices, weights):
+            if i not in self._meta:
+                shp = tuple(w[0].shape)
+                n = int(np.prod(shp)) if shp else 1
+                self._meta[i] = _Meta(shp, str(w[0].data.dtype), n,
+                                      _padded(n, nshard))
+            self._mp[i] = bool(
+                opt.multi_precision
+                and str(w[0].data.dtype) in ("float16", "bfloat16"))
+        idx_key = tuple(indices)
+        if self._plan is None or self._plan_indices != idx_key:
+            if self._plan is not None:
+                # param set changed: round states through the canonical
+                # payload so the new plan re-shards them losslessly
+                self.set_states(self.get_states(dump_optimizer=False))
+            self._plan = self._build_plan(indices)
+            self._plan_indices = idx_key
+            self._materialize_states(indices,
+                                     [w[0] for w in weights])
+            self._sig_cache = None
+            # drop cached all-gathered weights: entries for indices no
+            # longer in the set would pin full-size device arrays for
+            # the process lifetime (survivors fail the identity check
+            # after the re-shard anyway and rebuild on first touch)
+            self._w_global.clear()
+
+        # shared-count parity with the per-replica paths: N bumps per
+        # step, hyper computed at the FIRST bump (replica-0 trajectory)
+        hypers = []
+        for i in indices:
+            opt._update_count(i)
+            t_first = opt._index_update_count[i]
+            for _ in range(nrep - 1):
+                opt._update_count(i)
+            hypers.append(opt.fused_hyper(i, t_first))
+        h_vecs = {k: np.asarray([h[k] for h in hypers],  # mxlint: disable=MX002
+                                np.float32)
+                  for k in hypers[0]}
+
+        w_sh = NamedSharding(mesh.mesh, P())
+        w_tup = []
+        for i, w in zip(indices, weights):
+            cached = self._w_global.get(i)
+            if cached is not None and len(cached[0]) == len(w) and all(
+                    a is r.data for a, r in zip(cached[0], w)):
+                # last step's all-gathered output IS this step's input
+                w_tup.append(cached[1])
+                continue
+            w_tup.append(jax.make_array_from_single_device_arrays(
+                self._meta[i].shape, w_sh, [r.data for r in w]))
+        w_tup = tuple(w_tup)
+        g_tup = tuple(
+            jax.make_array_from_single_device_arrays(
+                (nshard,) + self._meta[i].shape,
+                NamedSharding(mesh.mesh, P(AXIS, *(
+                    [None] * len(self._meta[i].shape)))),
+                [r.data[None] for r in g])
+            for i, g in zip(indices, grads))
+        plan = self._plan
+        s_tup = (tuple(self._bstate[bi]
+                       for bi in range(len(plan.buckets))),
+                 tuple(self._pstate[i] for i in indices
+                       if i in self._pstate))
+        mp_flags = tuple(self._mp[i] for i in indices)
+        metas = tuple(self._meta[i] for i in indices)
+
+        args = (w_tup, g_tup, s_tup, h_vecs)
+        donate = mesh.devices[0].platform not in ("cpu",)
+        sig_key = (idx_key, nrep, opt.fused_static_key(),
+                   tuple(m.dtype for m in metas),
+                   tuple(str(g[0].data.dtype) for g in grads),
+                   tuple(h_vecs))
+        if self._sig_cache is not None and self._sig_cache[0] == sig_key:
+            sig = self._sig_cache[1]
+        else:
+            leaves, treedef = jax.tree_util.tree_flatten(args)
+            # the layout fingerprint keys the PROGRAM; the concrete
+            # device ids pin the AOT device assignment (stable across a
+            # same-topology restart, so the persistent tier still warm-
+            # starts — but two trainers on disjoint device subsets must
+            # not share an executable bound to the wrong devices)
+            sig = (type(opt), opt.fused_static_key(), mp_flags, metas,
+                   plan, self._flat, donate, self._layout,
+                   tuple(str(d) for d in mesh.devices), treedef,
+                   tuple(_leaf_aval(x) for x in leaves))
+            self._sig_cache = (sig_key, sig)
+
+        if self._flat and _tracing.active():
+            new_w, new_s = self._run_phased(sig, args, mp_flags, metas)
+        else:
+            fn = _SPMD_CACHE.lookup(sig)
+            if fn is None:
+                fn = self._compile(sig, args, mp_flags, metas, donate)
+            new_w, new_s = fn(*args)
+        self._count_bytes(metas, plan)
+
+        for i, w, nw in zip(indices, weights, new_w):
+            per_dev = {s.device: s.data for s in nw.addressable_shards}
+            bound = []
+            for r in w:
+                r._data = per_dev[r.ctx.jax_device]
+                bound.append(r._data)
+            self._w_global[i] = (tuple(bound), nw)
+        nb_states, np_states = new_s
+        for bi, tree in enumerate(nb_states):
+            self._bstate[bi] = tree
+        pidx = [i for i in indices if i in self._pstate]
+        for i, tree in zip(pidx, np_states):
+            self._pstate[i] = tree
+
+    def _count_bytes(self, metas, plan):
+        if not _tracing._ENABLED:
+            return
+        def nbytes(pos):
+            return sum(metas[p].size * np.dtype(metas[p].dtype).itemsize
+                       for p in pos)
+        rs = sum(nbytes(b.pos) for b in plan.buckets) \
+            + nbytes(plan.singles)
+        ar = sum(nbytes(g.pos) for g in plan.smalls)
+        if rs:
+            _ins.collective_bytes_total("reduce-scatter", AXIS).inc(rs)
+            _ins.collective_bytes_total("all-gather", AXIS).inc(rs)
+        if ar:
+            _ins.collective_bytes_total("all-reduce", AXIS).inc(ar)
+
+    # ---- program builders ------------------------------------------------
+    def _stages(self, mp_flags, metas):
+        """The three stages of the step, split at the collective
+        boundaries.  ``_build_step`` composes them into ONE program;
+        the phased tracing variant runs them as three so trace_report
+        can attribute wall time per phase.
+
+        Stage contracts (all traced, all pure):
+          reduce(gstacks)                  -> reduced parts
+          update(weights, parts, states, hyper) -> (new flat/shaped
+                                              weights parts, new states)
+          gather(parts)                    -> per-param full weights
+        'parts' are plan-shaped: one concat flat per bucket (sharded),
+        one concat flat per small group (replicated), one flat per
+        single (sharded).
+        """
+        opt = self.optimizer
+        plan = self._plan
+        mesh = self._mesh
+        shard = NamedSharding(mesh.mesh, P(AXIS))
+        repl = NamedSharding(mesh.mesh, P())
+        csn = lax.with_sharding_constraint
+        # static per-bucket segment-id arrays (element -> param position
+        # in the hyper vector), built on the host ONCE.  A constant-index
+        # gather partitions cleanly; jnp.repeat inside the sharded
+        # program lowers to a dynamic gather the SPMD partitioner
+        # serializes catastrophically (measured ~6000x slower on CPU).
+        b_seg = [np.repeat(np.asarray(b.pos, np.int64),
+                           np.asarray(b.sizes)) for b in plan.buckets]
+
+        def reduce_stage(gstacks):
+            parts = []
+            for b in plan.buckets:
+                cat = jnp.concatenate(
+                    [_pad_flat(gstacks[p].reshape(
+                        gstacks[p].shape[0], -1).sum(axis=0),
+                        metas[p].padded) for p in b.pos])
+                parts.append(csn(cat, shard))      # reduce-scatter
+            for g in plan.smalls:
+                cat = jnp.concatenate(
+                    [gstacks[p].reshape(gstacks[p].shape[0], -1)
+                     for p in g.pos], axis=1).sum(axis=0)
+                parts.append(csn(cat, repl))       # one all-reduce
+            for p in plan.singles:
+                parts.append(csn(_pad_flat(
+                    gstacks[p].sum(axis=0), metas[p].padded), shard))
+            return tuple(parts)
+
+        def update_stage(weights, parts, states, hyper_vecs):
+            bstates, pstates = states
+            pstate_pos = [p for g in plan.smalls for p in g.pos] + \
+                list(plan.singles)
+            porder = {p: j for j, p in enumerate(sorted(pstate_pos))}
+            new_parts, new_b, new_p = [], [], {}
+            k = 0
+            for bi, b in enumerate(plan.buckets):
+                gf = parts[k]
+                wf = csn(jnp.concatenate(
+                    [_pad_flat(weights[p], metas[p].padded)
+                     for p in b.pos]), shard)
+                # per-element hyper: each param's scalar repeated over
+                # its padded segment via the static segment-id gather
+                h = {key: v[b_seg[bi]]
+                     for key, v in hyper_vecs.items()}
+                nwf, ns = apply_param(opt, wf, gf, bstates[bi],
+                                      b.mp, h)
+                new_parts.append(csn(nwf, shard))
+                new_b.append(_tree_map(lambda x: csn(x, shard), ns))
+                k += 1
+            for g in plan.smalls:
+                cat = parts[k]
+                off = 0
+                outs = []
+                for p in g.pos:
+                    m = metas[p]
+                    gi = lax.slice(cat, (off,),
+                                   (off + m.size,)).reshape(m.shape)
+                    off += m.size
+                    h = {key: v[p] for key, v in hyper_vecs.items()}
+                    nw, ns = apply_param(opt, weights[p], gi,
+                                         pstates[porder[p]],
+                                         mp_flags[p], h)
+                    outs.append(nw.reshape(-1))
+                    new_p[p] = _tree_map(lambda x: csn(x, repl), ns)
+                new_parts.append(csn(jnp.concatenate(outs), repl))
+                k += 1
+            for p in plan.singles:
+                m = metas[p]
+                gf = parts[k]
+                wf = csn(_pad_flat(weights[p], m.padded), shard)
+                h = {key: v[p] for key, v in hyper_vecs.items()}
+                nwf, ns = apply_param(opt, wf, gf,
+                                      pstates[porder[p]],
+                                      mp_flags[p], h)
+                new_parts.append(csn(nwf, shard))
+                new_p[p] = _tree_map(lambda x: csn(x, shard), ns)
+                k += 1
+            new_pstates = tuple(new_p[p] for p in sorted(new_p))
+            return tuple(new_parts), (tuple(new_b), new_pstates)
+
+        def gather_stage(parts, weights):
+            """parts -> per-param full-shape weights (original order);
+            `weights` only supplies dtypes."""
+            out: Dict[int, Any] = {}
+            k = 0
+            for b in plan.buckets:
+                full = csn(parts[k], repl)          # all-gather
+                for p, off, sz in zip(b.pos, b.offsets, b.sizes):
+                    m = metas[p]
+                    out[p] = lax.slice(full, (off,), (off + m.size,)) \
+                        .reshape(m.shape).astype(weights[p].dtype)
+                k += 1
+            for g in plan.smalls:
+                cat = parts[k]
+                off = 0
+                for p in g.pos:
+                    m = metas[p]
+                    out[p] = lax.slice(cat, (off,), (off + m.size,)) \
+                        .reshape(m.shape).astype(weights[p].dtype)
+                    off += m.size
+                k += 1
+            for p in plan.singles:
+                m = metas[p]
+                full = csn(parts[k], repl)          # all-gather
+                out[p] = lax.slice(full, (0,), (m.size,)) \
+                    .reshape(m.shape).astype(weights[p].dtype)
+                k += 1
+            return tuple(out[p] for p in range(len(metas)))
+
+        return reduce_stage, update_stage, gather_stage
+
+    def _build_step(self, mp_flags, metas):
+        reduce_stage, update_stage, gather_stage = self._stages(
+            mp_flags, metas)
+
+        def step(weights, gstacks, states, hyper_vecs):
+            parts = reduce_stage(gstacks)
+            new_parts, new_s = update_stage(weights, parts, states,
+                                            hyper_vecs)
+            return gather_stage(new_parts, weights), new_s
+
+        return step
+
+    def _compile(self, sig, args, mp_flags, metas, donate):
+        cell = {}
+
+        def build_lowered():
+            lowered = cell.get("lowered")
+            if lowered is None:
+                jitted = jax.jit(
+                    self._build_step(mp_flags, metas),
+                    donate_argnums=(2,) if donate else ())
+                lowered = cell["lowered"] = jitted.lower(*args)
+            return lowered
+
+        return _SPMD_CACHE.compile(sig, build_lowered, self.optimizer)
+
+    # ---- phased variant (tracing only) -----------------------------------
+    def _run_phased(self, sig, args, mp_flags, metas):
+        """Attribution mode: the same stages as the fused program run
+        as three dispatches with spans (`reduce-scatter`,
+        `shard-update`, `all-gather`), so ``trace_report`` shows where
+        scaling efficiency goes.  Built lazily per signature only while
+        tracing is active; the fast path stays ONE executable."""
+        def _phase_metric(phase):
+            return _ins.training_phase_seconds(phase) \
+                if _tracing._ENABLED else None
+
+        weights, gstacks, states, h_vecs = args
+        fns = self._phased.get(sig)
+        if fns is None:
+            reduce_stage, update_stage, gather_stage = self._stages(
+                mp_flags, metas)
+            fns = self._phased[sig] = (
+                jax.jit(reduce_stage), jax.jit(update_stage),
+                jax.jit(gather_stage))
+        reduce_fn, update_fn, gather_fn = fns
+        with _tracing.span("reduce-scatter", cat="training",
+                           metric=_phase_metric("reduce-scatter")):
+            parts = jax.block_until_ready(reduce_fn(gstacks))
+        with _tracing.span("shard-update", cat="training",
+                           metric=_phase_metric("shard-update")):
+            new_parts, new_s = jax.block_until_ready(
+                update_fn(weights, parts, states, h_vecs))
+        with _tracing.span("all-gather", cat="training",
+                           metric=_phase_metric("all-gather")):
+            new_w = jax.block_until_ready(gather_fn(new_parts, weights))
+        return new_w, new_s
+
+    # ---- serialization ---------------------------------------------------
+    def get_states(self, dump_optimizer=False):
+        """Gather-on-save: the payload holds canonical full-shape host
+        state tensors per parameter index — byte-compatible with
+        ``Updater.get_states``, so it loads into the per-replica paths
+        and onto any mesh shape."""
+        payload: Dict[int, Any] = {}
+        indices = list(self._plan_indices or ())
+        plan = self._plan
+        if plan is not None:
+            for bi, b in enumerate(plan.buckets):
+                if bi not in self._bstate:
+                    continue
+                host = _tree_map(self._gather_np, self._bstate[bi])
+                for p, off, sz in zip(b.pos, b.offsets, b.sizes):
+                    i = indices[p]
+                    m = self._meta[i]
+                    payload[i] = _tree_map(
+                        lambda leaf: leaf[off:off + m.size]
+                        .reshape(m.shape), host)
+            for i, tree in self._pstate.items():
+                m = self._meta[i]
+
+                def unflat(leaf, m=m):
+                    h = self._gather_np(leaf)
+                    if h.shape == m.shape:
+                        return h
+                    return h.reshape(-1)[:m.size].reshape(m.shape)
+
+                payload[i] = _tree_map(unflat, tree)
+        for i, tree in (self._pending or {}).items():
+            if i not in payload:  # loaded but never stepped: pass through
+                payload[i] = _tree_map(np.asarray, tree)
+        if dump_optimizer:
+            return pickle.dumps((payload,
+                                 self.optimizer.__class__.__name__,
+                                 self.optimizer.__dict__.copy()))
+        return pickle.dumps(payload)
+
+    def set_states(self, states, ctx=None):
+        """Reshard-on-load: the payload re-shards lazily under whatever
+        mesh/plan the next step runs on (``ctx`` is ignored — placement
+        is global here)."""
+        data = pickle.loads(states)
+        if isinstance(data, tuple) and len(data) == 3:
+            data = data[0]
+        self._pending = dict(data)
+        self._bstate.clear()
+        self._pstate.clear()
+        self._mp.clear()
+        self._plan = None
+        self._plan_indices = None
+        self._sig_cache = None

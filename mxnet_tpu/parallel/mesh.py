@@ -27,7 +27,7 @@ from jax.sharding import Mesh
 from ..base import MXNetError
 
 __all__ = ["DeviceMesh", "make_mesh", "current_mesh", "get_mesh",
-           "AXIS_NAMES"]
+           "replica_mesh", "layout_key", "AXIS_NAMES"]
 
 AXIS_NAMES = ("dp", "fsdp", "tp", "pp", "sp", "ep")
 
@@ -114,6 +114,43 @@ def make_mesh(axes: Union[Dict[str, int], Sequence[Tuple[str, int]], None] = Non
         axes = {"dp": len(devices) if devices is not None else
                 jax.device_count()}
     return DeviceMesh(axes, devices)
+
+
+_REPLICA_MESHES: Dict[Tuple, DeviceMesh] = {}
+_REPLICA_LOCK = threading.Lock()
+
+
+def replica_mesh(devices: Sequence) -> DeviceMesh:
+    """The 1-D data-parallel mesh over an explicit replica device list —
+    the layout the unified SPMD training step (Trainer/SpmdUpdater)
+    compiles under.  Cached per device tuple: every trainer with the
+    same replica layout shares one Mesh object, so jit programs keyed on
+    the mesh share executables too."""
+    devs = tuple(devices)
+    if len(set(devs)) != len(devs):
+        raise MXNetError(
+            f"replica_mesh: duplicate devices in {devs} — each replica "
+            "must own a distinct device")
+    with _REPLICA_LOCK:
+        m = _REPLICA_MESHES.get(devs)
+        if m is None:
+            m = _REPLICA_MESHES[devs] = DeviceMesh({"dp": len(devs)},
+                                                   devices=devs)
+    return m
+
+
+def layout_key(mesh: DeviceMesh) -> Tuple:
+    """Hashable fingerprint of a mesh's layout for executable cache
+    keys: axis names/sizes, device kinds, and the process span.  Two
+    meshes with the same fingerprint compile to interchangeable
+    programs (device *identity* is deliberately excluded so a restarted
+    process with the same topology warm-starts from the persistent
+    compile cache)."""
+    devs = list(mesh.mesh.devices.flat)
+    kinds = tuple(sorted({getattr(d, "device_kind", d.platform)
+                          for d in devs}))
+    return (tuple(mesh.axis_sizes.items()), kinds,
+            len({d.process_index for d in devs}), len(devs))
 
 
 def current_mesh() -> Optional[DeviceMesh]:

@@ -22,7 +22,8 @@ from ..base import MXNetError
 from .mesh import DeviceMesh, current_mesh, get_mesh
 
 __all__ = ["ShardingRules", "named_sharding", "replicated", "shard_batch",
-           "constraint", "DEFAULT_RULES", "PartitionSpec"]
+           "constraint", "zero_state_spec", "DEFAULT_RULES",
+           "PartitionSpec"]
 
 PartitionSpec = P
 
@@ -77,6 +78,48 @@ def constraint(value, spec: P, mesh: Optional[DeviceMesh] = None):
         return value
     return jax.lax.with_sharding_constraint(
         value, NamedSharding(mesh.mesh, _filter_spec(spec, mesh)))
+
+
+def _spec_axes(spec: P):
+    for entry in spec:
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, (tuple, list)) else (entry,)):
+            yield a
+
+
+def zero_state_spec(param_spec: P, shape: Sequence[int], mesh: DeviceMesh,
+                    axes: Sequence[str] = ("dp", "fsdp"),
+                    min_size: int = 2 ** 11) -> P:
+    """PartitionSpec for an optimizer-state tensor under ZeRO-1 weight-
+    update sharding (arXiv:2004.13336): states follow their parameter's
+    sharding, PLUS any data axis the parameter does not already use
+    splits the largest evenly-divisible remaining dim.  A parameter
+    replicated over ``dp`` thus gets dp-sharded momentum/variance —
+    1/N of the state bytes per device — while a tp-sharded matrix keeps
+    its tp split and adds dp on another dim when one divides.  Tensors
+    below ``min_size`` elements stay on the parameter's spec (sharding
+    a bias across 256 chips costs more in collective latency than it
+    saves in bytes)."""
+    used = set(_spec_axes(param_spec))
+    free = [a for a in axes
+            if a in mesh and mesh.size(a) > 1 and a not in used]
+    if not free or not shape:
+        return param_spec
+    n = 1
+    for d in shape:
+        n *= int(d)
+    if n < min_size:
+        return param_spec
+    k = 1
+    for a in free:
+        k *= mesh.size(a)
+    dims = list(param_spec) + [None] * (len(shape) - len(param_spec))
+    for i in sorted(range(len(shape)), key=lambda i: -shape[i]):
+        if dims[i] is None and shape[i] % k == 0:
+            dims[i] = tuple(free) if len(free) > 1 else free[0]
+            return P(*dims)
+    return param_spec
 
 
 class ShardingRules:

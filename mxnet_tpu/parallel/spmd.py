@@ -18,6 +18,7 @@ math to KVStore('nccl') push/pull in the reference, one fused program here.
 from __future__ import annotations
 
 import functools
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -28,12 +29,59 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
+from ..telemetry import instruments as _ins
+from ..telemetry import tracing as _tracing
 from .. import optimizer as opt_mod
 from .. import random as rnd
-from .mesh import DeviceMesh, current_mesh, make_mesh
-from .sharding import ShardingRules, DEFAULT_RULES, shard_batch
+from .mesh import DeviceMesh, current_mesh, layout_key, make_mesh
+from .sharding import (ShardingRules, DEFAULT_RULES, shard_batch,
+                       zero_state_spec)
 
-__all__ = ["SPMDTrainer", "functional_optimizer", "FunctionalOptimizer"]
+__all__ = ["SPMDTrainer", "functional_optimizer", "FunctionalOptimizer",
+           "step_compile_stats"]
+
+# mesh-wide fwd+bwd+update executables: routed through the persistent
+# compile cache (PR 7) so a same-topology restart warm-starts the step
+# without an XLA compile; program-text keys ONLY (the program embeds
+# the user's model forward, which no framework version can pin)
+_STEP_CACHE = opt_mod.fused.ExecutableCache(
+    "parallel.spmd_step", "parallel.spmd._STEP_CACHE", "spmd",
+    "spmd-compile", lambda: _ins.spmd_compile_seconds())
+
+
+def step_compile_stats():
+    """SPMDTrainer step-executable builds/loads in this process (same
+    shape as optimizer.fused.compile_stats)."""
+    return _STEP_CACHE.stats()
+
+
+# class qualname + param names + avals do NOT pin the model's forward
+# MATH (two same-shape nets can wire differently), so the in-process
+# sig carries a per-block token: only the same block instance short-
+# circuits the trace; a different block re-lowers and lets the
+# persistent tier dedupe by program text.  Weak-keyed so a dead block
+# releases its executables' cache slot identity.
+import itertools as _itertools
+import threading as _threading
+import weakref as _weakref
+
+# distinct input SHAPES a trainer keeps hot executables for (the
+# evicted ones stay reachable through _STEP_CACHE / the persistent
+# tier — eviction costs a sig rebuild, never an XLA compile)
+_STEP_FNS_MAX = 16
+
+_BLOCK_TOKENS: "_weakref.WeakKeyDictionary" = _weakref.WeakKeyDictionary()
+_BLOCK_TOKENS_LOCK = _threading.Lock()
+_BLOCK_TOKEN_NEXT = _itertools.count()
+
+
+def _block_token(block) -> int:
+    with _BLOCK_TOKENS_LOCK:
+        tok = _BLOCK_TOKENS.get(block)
+        if tok is None:
+            tok = next(_BLOCK_TOKEN_NEXT)
+            _BLOCK_TOKENS[block] = tok
+        return tok
 
 
 # ---------------------------------------------------------------------------
@@ -302,16 +350,31 @@ class SPMDTrainer:
             n: (float(p.lr_mult), float(p.wd_mult)) for n, p in self._plist}
         self._trainable = {n: p.grad_req != "null" for n, p in self._plist}
 
-        # shard parameters onto the mesh per the rules
+        # shard parameters onto the mesh per the rules; optimizer
+        # states get the ZeRO-1 layout (MXNET_ZERO_STATES, default on):
+        # states of a dp-replicated parameter shard across the data
+        # axes, so XLA turns the grad psum into reduce-scatter + the
+        # weight refresh into all-gather (arXiv:2004.13336) and each
+        # device holds 1/N of the state bytes
+        from ..util import env as _envmod
+
+        self._zero = bool(_envmod.get_bool("MXNET_ZERO_STATES"))
         self.params: Dict[str, jax.Array] = {}
         self._shardings: Dict[str, NamedSharding] = {}
+        self._state_shardings: Dict[str, NamedSharding] = {}
         for n, p in self._plist:
             v = p.data().data
-            sh = rules.sharding_for(n, v.shape, self.mesh)
+            spec = rules.spec_for(n, v.shape, self.mesh)
+            sh = NamedSharding(self.mesh.mesh, spec)
             self._shardings[n] = sh
+            sspec = zero_state_spec(
+                spec, v.shape, self.mesh,
+                min_size=_envmod.get_int("MXNET_ZERO_MIN_SIZE")) \
+                if self._zero else spec
+            self._state_shardings[n] = NamedSharding(self.mesh.mesh, sspec)
             self.params[n] = _global_put(v, sh)
         self.opt_state = {
-            n: tuple(_global_put(s, self._shardings[n])
+            n: tuple(_global_put(s, self._state_shardings[n])
                      for s in self._fopt.init(v))
             for n, v in self.params.items() if self._trainable[n]}
 
@@ -343,7 +406,11 @@ class SPMDTrainer:
         self._flat_groups = [(tuple(names), lm, wm)
                              for (lm, wm, _dt), names in sorted(groups.items())]
 
-        self._step_fn = None
+        # per-shape fast path over _STEP_CACHE; LRU-bounded because
+        # each value strong-refs a whole-step executable — an unbounded
+        # dict would outlive _STEP_CACHE's own eviction (ragged last
+        # batches / variable seq-len mint a new shape per epoch)
+        self._step_fns: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._fwd_fn = None
         self._param_by_name = {n: p for n, p in self._plist}
         self._t = 0
@@ -448,20 +515,68 @@ class SPMDTrainer:
 
         return pure_step
 
-    def _get_step(self):
-        if self._step_fn is None:
+    def _opt_static_fingerprint(self) -> Tuple:
+        """Hashable fingerprint of the optimizer attrs BAKED into the
+        traced program (wd, momentum, betas, ... — read at functional-
+        optimizer construction).  lr and rescale_grad stay out: they
+        are traced arguments and must never force a recompile."""
+        skip = {"lr", "rescale_grad", "num_update", "begin_num_update"}
+        return tuple(sorted(
+            (k, v) for k, v in self._optimizer.__dict__.items()
+            if k not in skip and isinstance(v, (int, float, bool, str))))
+
+    def _get_step(self, args, ikey):
+        if ikey not in self._step_fns:
             mesh = self.mesh
-            n_in = None  # resolved at first call via closure-free jit
             psh = self._shardings
-            state_sh = {n: tuple(psh[n] for _ in s)
+            state_sh = {n: tuple(self._state_shardings[n] for _ in s)
                         for n, s in self.opt_state.items()}
             repl = NamedSharding(mesh.mesh, P())
-            self._step_fn = jax.jit(
+            jitted = jax.jit(
                 self._build_pure(),
                 in_shardings=(psh, state_sh, None, None, repl, repl, repl),
                 out_shardings=(psh, state_sh, repl, None),
                 donate_argnums=(0, 1) if self._donate else ())
-        return self._step_fn
+            cell = {}
+
+            def build_lowered():
+                if "l" not in cell:
+                    cell["l"] = jitted.lower(*args)
+                return cell["l"]
+
+            leaves, treedef = jax.tree_util.tree_flatten(args)
+            block = self.block
+            # the in-process signature pins everything the closure
+            # bakes in: the block INSTANCE (class+param names don't pin
+            # forward math), optimizer statics, mults, layout, and the
+            # concrete devices (an executable is bound to its device
+            # assignment — two trainers on disjoint subsets of the same
+            # topology must not share one); the PERSISTENT key adds the
+            # lowered program text, which pins the actual model code
+            sig = ("spmd-train-step", _block_token(block),
+                   f"{type(block).__module__}.{type(block).__qualname__}",
+                   tuple(n for n, _ in self._plist),
+                   tuple(sorted(self._mults.items())),
+                   type(self._optimizer), self._opt_static_fingerprint(),
+                   tuple(self._flat_groups), self.remat,
+                   layout_key(self.mesh),
+                   tuple(str(d) for d in mesh.devices),
+                   self._zero, self._donate,
+                   treedef,
+                   tuple(opt_mod.fused._leaf_aval(x) for x in leaves))
+            fn = _STEP_CACHE.lookup(sig)
+            if fn is None:
+                fn = _STEP_CACHE.compile(sig, build_lowered,
+                                         self._optimizer, alias_ok=False)
+            # per-trainer fast path keyed by input avals: a batch-shape
+            # change rebuilds (AOT does not silently retrace), a repeat
+            # shape is one dict hit
+            self._step_fns[ikey] = fn
+            while len(self._step_fns) > _STEP_FNS_MAX:
+                self._step_fns.popitem(last=False)
+        else:
+            self._step_fns.move_to_end(ikey)
+        return self._step_fns[ikey]
 
     # ---- data movement ---------------------------------------------------
     def _spec_sharding(self, spec, arr):
@@ -492,9 +607,27 @@ class SPMDTrainer:
         lr = jnp.asarray(self._optimizer.learning_rate, jnp.float32)
         t = jnp.asarray(self._t, jnp.int32)
         key = rnd.next_key()
-        step = self._get_step()
-        self.params, self.opt_state, lval, aux = step(
-            self.params, self.opt_state, ivals, lvals, key, lr, t)
+        args = (self.params, self.opt_state, ivals, lvals, key, lr, t)
+        ikey = tuple((tuple(v.shape), str(v.dtype))
+                     for v in ivals + lvals)
+        step = self._get_step(args, ikey)
+        if not _tracing.active():
+            out = step(*args)
+        else:
+            if _tracing._ENABLED:
+                for ax, size in self.mesh.axis_sizes.items():
+                    _ins.step_layout_axis_size(ax).set(size)
+                factor = 1
+                if self._zero:
+                    for ax in ("dp", "fsdp"):
+                        factor *= self.mesh.size(ax)
+                _ins.step_state_shard_factor().set(factor)
+            with _tracing.span("spmd-step", cat="training",
+                               metric=_ins.training_phase_seconds(
+                                   "spmd-step")
+                               if _tracing._ENABLED else None):
+                out = step(*args)
+        self.params, self.opt_state, lval, aux = out
         # rebind aux state (BatchNorm moving stats) by parameter NAME
         for n, v in aux.items():
             self._param_by_name[n].data()._data = v

@@ -22,8 +22,10 @@ __all__ = [
     "op_dispatch_total",
     "training_phase_seconds", "training_steps_total",
     "fused_step_total", "fused_compile_seconds",
+    "spmd_step_total", "spmd_compile_seconds",
     "data_wait_seconds", "data_wait_last_seconds",
-    "collective_seconds",
+    "collective_seconds", "collective_bytes_total",
+    "step_layout_axis_size", "step_state_shard_factor",
     "retry_total", "fault_injected_total",
     "compile_cache_hit_total", "compile_cache_miss_total",
     "compile_cache_evict_total", "compile_cache_load_seconds",
@@ -109,6 +111,18 @@ def fused_compile_seconds():
                   "must not grow it).")
 
 
+def spmd_step_total():
+    return _child("mx_spmd_step_total", "counter",
+                  "Trainer steps taken through the unified SPMD "
+                  "(one-program-over-the-mesh) path.")
+
+
+def spmd_compile_seconds():
+    return _child("mx_spmd_compile_seconds", "histogram",
+                  "Seconds building one SPMD-step executable; the count "
+                  "is the one-executable-per-(mesh, layout) guarantee.")
+
+
 def data_wait_seconds():
     return _child("mx_data_wait_seconds", "histogram",
                   "Seconds the training loop waited for the next batch.")
@@ -124,6 +138,28 @@ def collective_seconds(op: str):
     return _child("mx_collective_seconds", "histogram",
                   "Host-blocking collective wall seconds.",
                   ("op",), (op,))
+
+
+def collective_bytes_total(op: str, axis: str):
+    return _child("mx_collective_bytes_total", "counter",
+                  "Logical payload bytes moved by collectives, by "
+                  "operation (reduce-scatter/all-gather/all-reduce) and "
+                  "mesh axis — the bytes-on-wire half of scaling-"
+                  "efficiency attribution.", ("op", "axis"), (op, axis))
+
+
+def step_layout_axis_size(axis: str):
+    return _child("mx_step_layout_axis_size", "gauge",
+                  "Size of each mesh axis the active training-step "
+                  "layout runs over (1 = axis unused).",
+                  ("axis",), (axis,))
+
+
+def step_state_shard_factor():
+    return _child("mx_step_state_shard_factor", "gauge",
+                  "Ways the optimizer states of the active step layout "
+                  "are sharded across the data axis (1 = fully "
+                  "replicated, N = ZeRO-1 over N shards).")
 
 
 # ---- resilience -------------------------------------------------------

@@ -171,6 +171,29 @@ declare("MXNET_KVSTORE_TIMEOUT", float, None,
         "Seconds a distributed collective may block before the worker "
         "aborts loudly instead of hanging on a dead peer. Unset/0 = wait "
         "forever.")
+declare("MXNET_SPMD", bool, False,
+        "Route Trainer.step through the unified GSPMD path: ONE donated "
+        "jit program over the replica mesh (gradient reduce + sharded "
+        "optimizer apply) instead of N per-replica dispatches. "
+        "Trainer(spmd=...) overrides per trainer. Transparent per-step "
+        "fallback to the per-replica path for sparse gradients, ragged "
+        "layouts, or optimizers without a fused form. See "
+        "docs/sharding.md.")
+declare("MXNET_ZERO_STATES", bool, True,
+        "Under the SPMD step path, shard optimizer states (and the "
+        "weight-update computation) across the data-parallel axis "
+        "(ZeRO-1 / arXiv:2004.13336): reduce-scatter grads, update the "
+        "local state shard, all-gather fresh weights. 0 keeps states "
+        "replicated (the collective is then a plain all-reduce).")
+declare("MXNET_ZERO_MIN_SIZE", int, 2048,
+        "Smallest parameter (elements) whose optimizer states shard "
+        "across the data axis under MXNET_ZERO_STATES: big tensors "
+        "carry the memory, tiny biases would pay collective latency "
+        "for nothing and stay replicated.")
+declare("MXNET_SPMD_BUCKET_BYTES", int, 0,
+        "Bucket size for the SPMD mesh-collective gradient reduce "
+        "(KVStore.pushpull_fused under MXNET_SPMD=1). 0 = inherit "
+        "MXNET_FUSED_BUCKET_BYTES.")
 
 # -- ops / kernels ----------------------------------------------------------
 declare("MXNET_BN_EXACT_VAR", bool, False,

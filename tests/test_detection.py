@@ -29,6 +29,7 @@ def test_ssd_forward_shapes(small_ssd):
     assert a.min() >= 0.0 and a.max() <= 1.0  # clipped priors
 
 
+@pytest.mark.slow  # ~20s: SSD target-gen + train step; nightly
 def test_ssd_train_step(small_ssd):
     net = small_ssd
     target_gen = SSDTargetGenerator(negative_mining_ratio=-1.0)

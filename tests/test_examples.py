@@ -37,6 +37,7 @@ def test_bert_pretrain_corpus(tmp_path):
     assert "step 1: loss=" in out
 
 
+@pytest.mark.slow  # ~35s: rec-file build + SSD train loop; nightly
 def test_ssd_train_rec(tmp_path):
     from mxnet_tpu import recordio as rio
 
@@ -76,6 +77,7 @@ def test_transformer_nmt_parallel_corpus(tmp_path):
     assert "avg-loss=" in out
 
 
+@pytest.mark.slow  # ~16s: 2-epoch bucketed RNN example; nightly
 def test_rnn_bucketing_symbolic():
     out = _run(["examples/rnn_bucketing.py", "--cpu", "--small",
                 "--epochs", "2"], timeout=560)
@@ -85,6 +87,7 @@ def test_rnn_bucketing_symbolic():
     assert ppl < 3.0, ppl
 
 
+@pytest.mark.slow  # ~15s: entropy calibration sweep; nightly
 def test_quantize_model_example():
     out = _run(["examples/quantize_model.py", "--cpu", "--small",
                 "--calib-mode", "entropy"], timeout=560)
@@ -101,6 +104,7 @@ def test_long_context_lm_example(method):
     assert "loss" in out and "sp=4" in out
 
 
+@pytest.mark.slow  # ~23s: legacy-cell RNN example; nightly
 def test_rnn_bucketing_legacy_cells():
     out = _run(["examples/rnn_bucketing.py", "--cpu", "--small",
                 "--cells"])
@@ -119,6 +123,7 @@ def test_mnist_gluon_example():
     assert float(m.group(1)) > 0.9
 
 
+@pytest.mark.slow  # ~34s: synthetic imagenet train loop; nightly
 def test_imagenet_train_synthetic():
     import re
 

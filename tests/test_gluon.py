@@ -282,6 +282,7 @@ def test_dataloader_and_dataset():
     assert sum(1 for _ in loader2) == 2
 
 
+@pytest.mark.slow  # ~37s: 10 model-zoo builds + fwd; nightly integration stage
 def test_model_zoo_smoke():
     for name in ("resnet18_v1", "resnet18_v2", "mobilenet0.25",
                  "squeezenet1.1", "vgg11", "alexnet", "densenet121",
@@ -295,6 +296,7 @@ def test_model_zoo_smoke():
         assert out.shape == (1, 4), name
 
 
+@pytest.mark.slow  # ~31s: bf16 train step across every zoo family; nightly
 def test_model_zoo_bf16_train_step():
     """Every family must survive a bf16 hybridized train step (the MXU
     dtype path used by the benchmarks)."""

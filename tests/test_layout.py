@@ -81,6 +81,7 @@ def test_resnet_nhwc_matches_nchw():
                                atol=2e-4)
 
 
+@pytest.mark.slow  # ~22s: full resnet NHWC train step; nightly
 def test_resnet_nhwc_trains():
     """One SPMDTrainer step in NHWC — the bench.py configuration."""
     from mxnet_tpu import parallel

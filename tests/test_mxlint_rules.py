@@ -900,3 +900,129 @@ class TestBaseline:
         vs = lint_source(tmp_path, self.SRC, enable=["MX004"])
         doc = analysis.make_baseline(vs, justifications={"MX004": "why"})
         assert all(e["justification"] == "why" for e in doc["entries"])
+
+
+# ---------------------------------------------------------------------------
+# MX013 — per-replica dispatch in step-chain code
+# ---------------------------------------------------------------------------
+
+class TestMX013:
+    def test_flags_per_replica_update_loop(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            class Trainer:
+                def _update_fused(self):
+                    for r in range(self.nrep):
+                        self._updaters[r].update_all(
+                            self.idxs, self.grads[r], self.weights[r])
+            """, enable=["MX013"])
+        assert rules_hit(vs) == ["MX013"]
+        assert "update_all()" in vs[0].message
+
+    def test_flags_updater_subscript_call_loop(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            class Trainer:
+                def _update(self):
+                    for r, grad in enumerate(self.grads):
+                        self._updaters[r](0, grad, self.data[r])
+            """, enable=["MX013"])
+        assert rules_hit(vs) == ["MX013"]
+        assert "_updaters[r](...)" in vs[0].message
+
+    def test_flags_per_key_pushpull_loop(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            class KVStore:
+                def pushpull_fused(self, keys, vals):
+                    for k, v in zip(keys, vals):
+                        self.pushpull(k, v)
+            """, enable=["MX013"])
+        assert rules_hit(vs) == ["MX013"]
+
+    def test_flags_raw_device_put_in_step_chain(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import jax
+
+            class KVStore:
+                def _reduce(self, vals):
+                    dev = vals[0].ctx.jax_device
+                    return [jax.device_put(v.data, dev) for v in vals]
+            """, enable=["MX013"])
+        assert rules_hit(vs) == ["MX013"]
+        assert "device_put" in vs[0].message
+
+    def test_flags_device_keyword_device_put(self, tmp_path):
+        """The keyword spelling of raw-device pinning is the same
+        violation — `device=` must not read as a sharding."""
+        vs = lint_source(tmp_path, """
+            import jax
+
+            class KVStore:
+                def _reduce(self, vals):
+                    dev = vals[0].ctx.jax_device
+                    return [jax.device_put(v.data, device=dev)
+                            for v in vals]
+            """, enable=["MX013"])
+        assert rules_hit(vs) == ["MX013"]
+
+    def test_sharding_keyword_device_put_is_clean(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            class Trainer:
+                def step(self, batch):
+                    return jax.device_put(batch, device=NamedSharding(
+                        self.mesh, PartitionSpec("dp")))
+            """, enable=["MX013"])
+        assert vs == []
+
+    def test_sharded_device_put_is_clean(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import jax
+
+            class Trainer:
+                def step(self, batch):
+                    sh = self.rules.sharding_for("w", batch.shape,
+                                                 self.mesh)
+                    return jax.device_put(batch, sh)
+            """, enable=["MX013"])
+        assert vs == []
+
+    def test_named_sharding_call_argument_is_clean(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            class SpmdUpdater:
+                def update_all_mesh(self, mesh, grads):
+                    return jax.device_put(
+                        grads, NamedSharding(mesh, PartitionSpec("dp")))
+            """, enable=["MX013"])
+        assert vs == []
+
+    def test_single_mesh_dispatch_is_clean(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            class Trainer:
+                def _step_spmd(self):
+                    self._spmd_updater.update_all_mesh(
+                        self.idxs, self.grads, self.weights)
+                    return True
+            """, enable=["MX013"])
+        assert vs == []
+
+    def test_loop_outside_hot_class_is_clean(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            class DataPipeline:
+                def step(self, batches):
+                    for b in batches:
+                        self.push(0, b)
+            """, enable=["MX013"])
+        assert vs == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            class Trainer:
+                def _update(self):
+                    for r, grad in enumerate(self.grads):
+                        self._updaters[r](0, grad, self.data[r])  # mxlint: disable=MX013
+            """, enable=["MX013"])
+        assert vs == []

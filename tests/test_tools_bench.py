@@ -153,13 +153,20 @@ def test_bench_all_mnist_smoke():
 
 
 def test_scaling_bench_single_proc():
+    """CLI smoke on the SPMD path (the unified spine — ISSUE 9) with
+    per-phase attribution; the multi-process sweep, the loss-parity
+    gate, and the replica-path comparison live in the run_nightly spmd
+    stage."""
     rows = _run([sys.executable, "tools/scaling_bench.py",
                  "--model", "resnet18", "--procs", "1", "--steps", "2",
                  "--warmup", "1", "--batch-per-device", "2",
-                 "--image-size", "32",
+                 "--image-size", "32", "--spmd", "--phases",
                  "--out", "/tmp/scaling_test.json"])
     assert rows[-1]["processes"] == 1
     assert rows[-1]["efficiency_vs_1proc"] == 1.0
+    assert rows[-1]["path"] == "spmd"
+    # attribution present (collected after the timed window)
+    assert rows[-1]["phase_seconds"].get("spmd-step", {}).get("count")
 
 
 def test_bench_resilience_smoke(tmp_path):

@@ -1,11 +1,31 @@
 """Data-parallel scaling-efficiency harness (BASELINE scaling target:
 >=90% efficiency at 256 v5e chips).
 
-Runs the SPMD train step (one jitted fwd+bwd+allreduce+update program,
-parallel.SPMDTrainer) over {1..N} processes and reports global
-throughput, per-device throughput, and efficiency vs the 1-process run.
-Weak scaling: the per-device batch is fixed, so perfect scaling doubles
-global throughput when the process count doubles.
+Three step paths share one harness (``--path``):
+
+  * ``replica`` — the per-replica pipeline: eager fwd/bwd (autograd),
+    ``KVStore.pushpull_fused`` bucketed gradient sync (DCN/gloo across
+    processes), per-replica ``FusedUpdater`` dispatches.
+  * ``spmd``    — the unified GSPMD step (ISSUE 9): same eager fwd/bwd,
+    but the gradient reduce + optimizer apply run as ONE jit program
+    over the cross-process mesh with ZeRO-sharded optimizer states
+    (``Trainer(spmd=True)``, optimizer/spmd.py).
+  * ``gspmd``   — the whole step (fwd+bwd+reduce+update) as one sharded
+    program (``parallel.SPMDTrainer``).
+
+Weak-scaling throughput: the per-device batch is fixed, so perfect
+scaling doubles global throughput when the process count doubles.
+
+**Loss parity** (ISSUE 9 satellite): the old sweep let the global batch
+grow with the process count, so the reported losses (one overfit run
+per count on DIFFERENT data) were incomparable — SCALING.json read
+0.035 → 1.26 → 2.40 and looked like a gradient-averaging bug.  The
+parity stage pins the GLOBAL batch and seed across process counts
+(same data, disjointly sharded by rank, gradients averaged over the
+global batch via ``step(global_batch)``) and asserts the loss curves
+agree; it runs on a BatchNorm-free MLP by default so the only
+tolerated noise is collective summation order.  A real averaging or
+sharding bug fails the gate.
 
 On this dev box the transport is the CPU backend + gloo over localhost
 (one virtual device per process) — that validates the harness, the
@@ -16,13 +36,14 @@ libtpu discovers local chips, DCN carries cross-host collectives):
     # on every host i of an N-host v5e pod:
     DMLC_PS_ROOT_URI=<host0-ip> DMLC_PS_ROOT_PORT=9876 \
     DMLC_NUM_WORKER=<N> DMLC_WORKER_ID=<i> \
-    python tools/scaling_bench.py --_worker --model resnet50 \
-        --batch-per-device 256 --image-size 224 --dtype bfloat16 --steps 50
+    python tools/scaling_bench.py --_worker --path spmd \
+        --model resnet50 --batch-per-device 256 --image-size 224 \
+        --dtype bfloat16 --steps 50
 
 (tools/launch.py -n N --launcher ssh automates exactly this env
 contract; see docs/distributed.md.)  Dev-box sweep:
 
-    python tools/scaling_bench.py --procs 1,2,4 --model resnet18
+    python tools/scaling_bench.py --procs 1,2 --path spmd --phases
 """
 from __future__ import annotations
 
@@ -37,6 +58,10 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+_PHASE_NAMES = ("grad-allreduce", "optimizer-update", "fused-update",
+                "spmd-step", "reduce-scatter", "shard-update",
+                "all-gather")
+
 
 def _free_port():
     with socket.socket() as s:
@@ -45,24 +70,30 @@ def _free_port():
 
 
 # ---------------------------------------------------------------------------
-# worker (one process of the mesh)
+# models
 # ---------------------------------------------------------------------------
 
-def worker(args):
+def _build_model(args, rng, bs_global):
+    """-> (net, data tuple, label, loss, opt, opt_args)."""
     import numpy as np
     import mxnet_tpu as mx
-    from mxnet_tpu import parallel
     from mxnet_tpu.gluon import loss as gloss
-    from mxnet_tpu.parallel import dist
 
-    dist.init()
-    import jax
+    if args.model == "mlp":
+        # BatchNorm-free: the parity gate's oracle (local BN statistics
+        # legitimately differ per process count; dense math does not)
+        from mxnet_tpu.gluon import nn
 
-    n_dev = jax.device_count()
-    n_proc = jax.process_count()
-    bs_global = args.batch_per_device * n_dev
-
-    rng = np.random.RandomState(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(64, activation="relu"),
+                nn.Dense(32, activation="relu"), nn.Dense(8))
+        net.initialize(mx.initializer.Xavier(), ctx=mx.cpu())
+        with mx.autograd.pause():
+            net(mx.nd.zeros((1, 16)))
+        data = rng.rand(bs_global, 16).astype(args.dtype)
+        label = rng.randint(0, 8, (bs_global,)).astype(np.int32)
+        return (net, (data,), label, gloss.SoftmaxCrossEntropyLoss(),
+                "sgd", {"learning_rate": 0.05, "momentum": 0.9})
     if args.model.startswith("resnet"):
         from mxnet_tpu.gluon.model_zoo import vision
 
@@ -76,11 +107,9 @@ def worker(args):
         s = args.image_size
         data = rng.rand(bs_global, s, s, 3).astype(args.dtype)
         label = rng.randint(0, 1000, (bs_global,)).astype(np.int32)
-        loss = gloss.SoftmaxCrossEntropyLoss()
-        opt, opt_args = "sgd", {"learning_rate": 0.1, "momentum": 0.9}
-    elif args.model == "bert":
-        from mxnet_tpu.gluon import nn
-        from mxnet_tpu.gluon.block import HybridBlock
+        return (net, (data,), label, gloss.SoftmaxCrossEntropyLoss(),
+                "sgd", {"learning_rate": 0.1, "momentum": 0.9})
+    if args.model == "bert":
         from mxnet_tpu.gluon.model_zoo.bert import get_bert_model
 
         seq = args.seq_len
@@ -103,8 +132,7 @@ def worker(args):
         label = rng.randint(0, 2, (bs_global,)).astype(np.int32)
 
         class _NSPLoss:
-            """CLS-token 2-way loss — enough to drive the full encoder
-            (SPMDTrainer hands the loss the first output: (B,S,U))."""
+            """CLS-token 2-way loss — enough to drive the full encoder."""
 
             def __call__(self, out, y):
                 import jax as _jax
@@ -115,38 +143,186 @@ def worker(args):
                 return -jnp.take_along_axis(
                     lsm, y[:, None].astype(jnp.int32), -1)[:, 0]
 
-        loss = _NSPLoss()
-        opt, opt_args = "adam", {"learning_rate": 1e-4}
-    else:
-        raise SystemExit(f"unknown model {args.model}")
+        return (net, data, label, _NSPLoss(), "adam",
+                {"learning_rate": 1e-4})
+    raise SystemExit(f"unknown model {args.model}")
 
-    if not isinstance(data, tuple):
-        data = (data,)
+
+def _phase_report():
+    """Per-phase wall seconds + collective bytes from the telemetry
+    registry (populated by the step spans when --phases is on)."""
+    from mxnet_tpu.telemetry import metrics
+
+    snap = metrics.get_registry().snapshot()
+    phases = {}
+    fam = snap.get("mx_training_phase_seconds", {})
+    for s in fam.get("samples", []):
+        ph = s["labels"].get("phase")
+        if ph in _PHASE_NAMES and s["count"]:
+            phases[ph] = {"seconds": round(s["sum"], 4),
+                          "count": s["count"]}
+    out = {"phase_seconds": phases, "collective_bytes": {}}
+    fam = snap.get("mx_collective_bytes_total", {})
+    for s in fam.get("samples", []):
+        key = "{op}@{axis}".format(**s["labels"])
+        out["collective_bytes"][key] = int(s["value"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# worker (one process of the job)
+# ---------------------------------------------------------------------------
+
+def worker(args):
+    import numpy as np
+
+    if args.path == "spmd":
+        os.environ.setdefault("MXNET_SPMD", "1")
+    else:
+        # pin the baseline: an MXNET_SPMD=1 inherited from the
+        # operator's shell must not turn the per-replica measurement
+        # into a second SPMD run (the nightly gate compares the two)
+        os.environ["MXNET_SPMD"] = "0"
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.parallel import dist
+
+    dist.init()
+    import jax
+
+    n_dev = jax.device_count()
+    n_proc = jax.process_count()
+    rank = jax.process_index()
+    n_local = jax.local_device_count()
+    bs_global = args.global_batch or args.batch_per_device * n_dev
+    if bs_global % n_dev:
+        raise SystemExit(f"global batch {bs_global} not divisible by "
+                         f"{n_dev} devices")
+
+    # THE loss-parity fix (ISSUE 9 satellite): every rank must
+    # initialize the SAME model.  The parameter init draws from the
+    # framework RNG, which seeds nondeterministically per process —
+    # unseeded, each rank trains a DIFFERENT model whose replicated
+    # params only pretend to agree, and the sweep's losses drift with
+    # the process count (SCALING.json 0.035 -> 1.26 -> 2.40).  Data
+    # stays rank-identical too (the launcher contract: every process
+    # generates the global batch, then shards it disjointly).
+    mx.random.seed(args.seed)
+    np.random.seed(args.seed)  # initializers draw from global numpy too
+    rng = np.random.RandomState(args.seed)
+    net, data, label, loss, opt, opt_args = _build_model(args, rng,
+                                                         bs_global)
+
+    if args.path == "gspmd":
+        lval, dt = _run_gspmd(args, mx, parallel, net, data, label,
+                              loss, opt, opt_args, n_dev)
+    else:
+        lval, dt = _run_trainer(args, mx, net, data, label, loss, opt,
+                                opt_args, bs_global, n_proc, rank,
+                                n_local)
+
+    tp = bs_global * args.steps / dt
+    if rank == 0:
+        row = {
+            "model": args.model, "path": args.path,
+            "processes": n_proc, "devices": n_dev,
+            "batch_per_device": bs_global // n_dev,
+            "global_batch": bs_global,
+            "global_throughput": round(tp, 2),
+            "per_device_throughput": round(tp / n_dev, 2),
+            "unit": "samples/s", "loss": round(lval, 4),
+        }
+        if args.phases:
+            row.update(_phase_report())
+        print(json.dumps(row), flush=True)
+    return 0
+
+
+def _attribution_steps(args, one_step):
+    """--phases: run a couple of EXTRA traced steps AFTER the timed
+    window — the phased SPMD variant and the span bookkeeping must
+    never distort the throughput/efficiency numbers the sweep gates
+    on (tracing serializes the step into per-phase dispatches)."""
+    if not args.phases:
+        return
+    from mxnet_tpu.telemetry import tracing
+
+    tracing.enable()
+    try:
+        for _ in range(2):
+            one_step()
+    finally:
+        tracing.disable()
+
+
+def _run_gspmd(args, mx, parallel, net, data, label, loss, opt,
+               opt_args, n_dev):
+    import time as _t
+
     mesh = parallel.make_mesh(dp=n_dev)
     with mesh:
-        trainer = parallel.SPMDTrainer(net, loss, opt, opt_args)
+        trainer = parallel.SPMDTrainer(net, loss, opt, dict(opt_args))
         placed = [trainer._place(a, None) for a in data + (label,)]
         # >=1 unmeasured call: keeps compilation out of the timed window
         # and binds `lv` even for --warmup 0
         for _ in range(max(args.warmup, 1)):
             lv = trainer.step(*placed)
         lv.asnumpy()
-        t0 = time.perf_counter()
+        t0 = _t.perf_counter()
         for _ in range(args.steps):
             lv = trainer.step(*placed)
         lval = float(lv.asnumpy())
-        dt = time.perf_counter() - t0
+        dt = _t.perf_counter() - t0
+        _attribution_steps(args,
+                           lambda: trainer.step(*placed).asnumpy())
+    return lval, dt
 
-    tp = bs_global * args.steps / dt
-    if jax.process_index() == 0:
-        print(json.dumps({
-            "model": args.model, "processes": n_proc, "devices": n_dev,
-            "batch_per_device": args.batch_per_device,
-            "global_throughput": round(tp, 2),
-            "per_device_throughput": round(tp / n_dev, 2),
-            "unit": "samples/s", "loss": round(lval, 4),
-        }), flush=True)
-    return 0
+
+def _run_trainer(args, mx, net, data, label, loss_fn, opt, opt_args,
+                 bs_global, n_proc, rank, n_local):
+    """The gluon Trainer paths (per-replica and unified SPMD): eager
+    fwd/bwd on this process's disjoint shard of the global batch, then
+    Trainer.step.  The loss reported is the GLOBAL batch mean (local
+    sums allreduced), so it is comparable across process counts."""
+    import time as _t
+
+    import numpy as np
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.trainer import Trainer
+    from mxnet_tpu.parallel import dist
+
+    # disjoint shard: rank r owns rows [r*per_proc, (r+1)*per_proc)
+    per_proc = bs_global // n_proc
+    sl = slice(rank * per_proc, (rank + 1) * per_proc)
+    local = [mx.nd.array(a[sl]) for a in data] + [mx.nd.array(label[sl])]
+    *xs, y = local
+
+    kv = "dist_sync" if n_proc > 1 else "device"
+    trainer = Trainer(net.collect_params(), opt, dict(opt_args),
+                      kvstore=kv, update_on_kvstore=False,
+                      spmd=(args.path == "spmd"))
+
+    def one_step():
+        with autograd.record():
+            out = net(*xs)
+            outs = out if isinstance(out, (list, tuple)) else (out,)
+            l = loss_fn(outs[0], y)
+        l.backward()
+        # sum-loss backward + step(global) = mean over the GLOBAL batch
+        trainer.step(bs_global)
+        return l
+
+    for _ in range(max(args.warmup, 1)):
+        l = one_step()
+    l.asnumpy()
+    t0 = _t.perf_counter()
+    for _ in range(args.steps):
+        l = one_step()
+    local_sum = float(l.asnumpy().sum())
+    dt = _t.perf_counter() - t0
+    gsum = float(dist.allgather_np(np.asarray(local_sum)).sum())
+    _attribution_steps(args, lambda: one_step().asnumpy())
+    return gsum / bs_global, dt
 
 
 # ---------------------------------------------------------------------------
@@ -165,11 +341,16 @@ def _spawn_sweep(args, n):
                     "DMLC_PS_ROOT_PORT": port, "DMLC_NUM_WORKER": str(n),
                     "DMLC_WORKER_ID": str(i)})
         cmd = [sys.executable, os.path.abspath(__file__), "--_worker",
-               "--model", args.model, "--steps", str(args.steps),
+               "--model", args.model, "--path", args.path,
+               "--steps", str(args.steps),
                "--warmup", str(args.warmup),
                "--batch-per-device", str(args.batch_per_device),
                "--image-size", str(args.image_size),
-               "--seq-len", str(args.seq_len), "--dtype", args.dtype]
+               "--seq-len", str(args.seq_len), "--dtype", args.dtype,
+               "--seed", str(args.seed),
+               "--global-batch", str(args.global_batch)]
+        if args.phases:
+            cmd.append("--phases")
         procs.append(subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                                       stderr=subprocess.STDOUT, text=True))
     line = None
@@ -191,22 +372,72 @@ def _spawn_sweep(args, n):
     return json.loads(line)
 
 
+def _parity_stage(args, counts):
+    """Same seed + same GLOBAL batch across process counts => the loss
+    curves must agree (the gradients are averaged over the same data,
+    only the sharding differs).  Returns the report dict; 'ok' is the
+    gate."""
+    gb = args.batch_per_device * max(counts)
+    rows = []
+    pa = argparse.Namespace(**vars(args))
+    pa.model = args.parity_model
+    pa.global_batch = gb
+    for n in counts:
+        rows.append(_spawn_sweep(pa, n))
+    losses = [r["loss"] for r in rows]
+    spread = max(losses) - min(losses)
+    ref = max(abs(losses[0]), 1e-6)
+    ok = spread / ref <= args.parity_tol
+    return {"model": pa.model, "global_batch": gb,
+            "steps": args.steps, "losses": losses,
+            "rel_spread": round(spread / ref, 6),
+            "tol": args.parity_tol, "ok": ok}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet18",
-                    choices=["resnet18", "resnet50", "bert"])
+                    choices=["mlp", "resnet18", "resnet50", "bert"])
+    ap.add_argument("--path", default="replica",
+                    choices=["replica", "spmd", "gspmd"])
+    ap.add_argument("--spmd", action="store_true",
+                    help="shorthand for --path spmd")
     ap.add_argument("--procs", default="1,2,4",
                     help="comma-separated process counts for the sweep")
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--batch-per-device", type=int, default=8)
+    ap.add_argument("--global-batch", type=int, default=0,
+                    help="pin the GLOBAL batch (loss parity across "
+                         "process counts); 0 = batch-per-device * n "
+                         "(weak scaling)")
     ap.add_argument("--image-size", type=int, default=64)
     ap.add_argument("--seq-len", type=int, default=64)
     ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="framework + data RNG seed (every rank MUST "
+                         "agree — see the parity note in worker())")
+    ap.add_argument("--phases", action="store_true",
+                    help="report per-phase step-time attribution + "
+                         "collective bytes, collected from 2 extra "
+                         "traced steps AFTER the timed window (the "
+                         "phased SPMD variant serializes dispatches; "
+                         "it must not distort the gated efficiency)")
+    ap.add_argument("--no-parity", action="store_true",
+                    help="skip the fixed-global-batch loss-parity gate")
+    ap.add_argument("--parity-model", default="mlp",
+                    help="model for the parity stage (default: the "
+                         "BatchNorm-free mlp — BN batch statistics "
+                         "legitimately vary with the local batch)")
+    ap.add_argument("--parity-tol", type=float, default=1e-3,
+                    help="max relative spread of final losses across "
+                         "process counts")
     ap.add_argument("--proc-timeout", type=float, default=900.0)
     ap.add_argument("--out", default=os.path.join(_REPO, "SCALING.json"))
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args.spmd:
+        args.path = "spmd"
 
     if args._worker:
         return worker(args)
@@ -223,13 +454,24 @@ def main():
         results.append(res)
         print(json.dumps(res))
 
+    report = {"when": time.strftime("%Y-%m-%d %H:%M:%S"),
+              "backend": "cpu+gloo localhost (dev box)",
+              "path": args.path,
+              "note": "validates harness+program, not ICI/DCN "
+                      "bandwidth; see docstring for the pod command",
+              "sweep": results}
+    rc = 0
+    if not args.no_parity and len(counts) > 1:
+        parity = _parity_stage(args, counts)
+        report["parity"] = parity
+        print(json.dumps({"parity": parity}))
+        if not parity["ok"]:
+            print("PARITY GATE FAILED: loss curves diverge across "
+                  "process counts", file=sys.stderr)
+            rc = 1
     with open(args.out, "w") as f:
-        json.dump({"when": time.strftime("%Y-%m-%d %H:%M:%S"),
-                   "backend": "cpu+gloo localhost (dev box)",
-                   "note": "validates harness+program, not ICI/DCN "
-                           "bandwidth; see docstring for the pod command",
-                   "sweep": results}, f, indent=1)
-    return 0
+        json.dump(report, f, indent=1)
+    return rc
 
 
 if __name__ == "__main__":
