@@ -26,17 +26,20 @@ _CHAOS_RE = re.compile(r"chaos\.check\(\s*[\"']([a-z_.]+)[\"']")
 
 
 def instrument_names(instruments_path: str) -> Set[str]:
-    """Literal metric family names (``mx_*``) registered through the
-    ``_child``/``_family`` accessors.  Dynamically formatted families
-    (``f"mx_serving_{name}_total"``) are out of scope — their members
-    are documented as a group."""
+    """Literal metric family names (``mx_*``) DECLARED in the
+    instruments module — the ``_spec(...)`` declaration table (plus
+    the legacy ``_child``/``_family`` literal form).  Names built by
+    the declaration loop (``f"mx_serving_{n}_total"``) are out of
+    AST reach here; the telemetry.catalog docs-sync test covers every
+    declared name including those."""
     with open(instruments_path, "r", encoding="utf-8") as f:
         tree = ast.parse(f.read())
     names: Set[str] = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Call) and \
                 isinstance(node.func, ast.Name) and \
-                node.func.id in ("_child", "_family") and node.args:
+                node.func.id in ("_spec", "_child", "_family") \
+                and node.args:
             a = node.args[0]
             if isinstance(a, ast.Constant) and \
                     isinstance(a.value, str) and \

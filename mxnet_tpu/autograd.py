@@ -15,6 +15,7 @@ unused forward recomputation inside the vjp.
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -53,6 +54,7 @@ class _RecordingScope:
         self._train = training
         self._prev: Tuple[bool, bool] = (False, False)
         self._span = None
+        self._t0 = None
 
     def __enter__(self):
         self._prev = (_STATE.recording, _STATE.training)
@@ -66,11 +68,16 @@ class _RecordingScope:
             from .telemetry import instruments as _ins
             from .telemetry import tracing as _tracing
 
-            if _tracing.active():
+            if _tracing.capture_active():
                 self._span = _tracing.Span(
                     "forward", cat="training",
                     metric=_ins.training_phase_seconds("forward")
                     if _tracing._ENABLED else None).attach()
+            elif _tracing._SINK is not None:
+                # mxprof sink only: measure on the minimal path (two
+                # clock reads, no ids/contextvars) so the always-on
+                # flight recorder stays within its overhead budget
+                self._t0 = _time.perf_counter()
         return self
 
     def __exit__(self, *exc):
@@ -78,6 +85,14 @@ class _RecordingScope:
         if self._span is not None:
             self._span.finish()
             self._span = None
+        elif self._t0 is not None:
+            from .telemetry import tracing as _tracing
+
+            snk = _tracing._SINK
+            if snk is not None:
+                snk.on_event("forward", "training",
+                             _time.perf_counter() - self._t0, None)
+            self._t0 = None
         return False
 
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import pickle
 import warnings
+import weakref
 from typing import Dict, List, Optional, Union
 
 from .. import kvstore as kvs_mod
@@ -33,6 +34,7 @@ from .. import optimizer as opt_mod
 from ..base import MXNetError
 from ..resilience import chaos as _chaos
 from ..telemetry import instruments as _ins
+from ..telemetry import mxprof as _mxprof
 from ..telemetry import tracing as _tracing
 from ..util import env as _env
 from .parameter import Parameter, ParameterDict
@@ -162,6 +164,18 @@ class Trainer:
                 if p.grad_req != "null":
                     self._kvstore.init(i, p.data())
         self._kv_initialized = True
+        # mxprof HBM accounting pulls the optimizer-state share through
+        # this provider at SAMPLE time (never per step); weakref so the
+        # process-global recorder cannot pin a dead trainer.  Last
+        # trainer to initialize wins — one training loop per process is
+        # the accounting model.
+        wself = weakref.ref(self)
+
+        def _state_bytes_provider():
+            t = wself()
+            return (None, 1) if t is None else t.optimizer_state_bytes()
+
+        _mxprof.set_state_bytes_provider(_state_bytes_provider)
         if self._states_to_load is not None:
             fname, allow_resize = self._states_to_load
             self.load_states(fname, allow_resize=allow_resize)
@@ -454,7 +468,12 @@ class Trainer:
 
         def run():
             for r in range(nrep):
-                self._updaters[r].update_all(
+                u = self._updaters[r]
+                # mxprof counts the program cost ONCE per step: every
+                # replica runs the same executable, and the MFU
+                # denominator is a single device's peak
+                u.mxprof_report_cost = r == 0
+                u.update_all(
                     idxs, [p.list_grad()[r] for p in plist],
                     [p.list_data()[r] for p in plist])
 
@@ -473,6 +492,33 @@ class Trainer:
         if _tracing._ENABLED:
             _ins.fused_step_total().inc()
         return True
+
+    def optimizer_state_bytes(self):
+        """(state_bytes, shard_factor) — the optimizer-state footprint
+        one device carries is ``state_bytes / shard_factor``.  On the
+        per-replica paths each replica holds a full copy (factor 1, the
+        bytes are one updater's); under SPMD+ZeRO the global states
+        split ``shard_factor`` ways.  mxprof's HBM sampling reads this
+        through the provider registered in :meth:`_init_kvstore`."""
+        def tree_bytes(s):
+            if s is None:
+                return 0
+            if isinstance(s, (tuple, list)):
+                return sum(tree_bytes(x) for x in s)
+            try:
+                return int(s.data.nbytes)  # NDArray leaf
+            except AttributeError:
+                return int(getattr(s, "nbytes", 0))
+
+        if self._spmd_updater is not None:
+            u = self._spmd_updater
+            total = sum(tree_bytes(t) for t in u._bstate.values()) \
+                + sum(tree_bytes(t) for t in u._pstate.values())
+            return total, u.shard_factor()
+        if not self._updaters:
+            return 0, 1
+        return sum(tree_bytes(s)
+                   for s in self._updaters[0].states.values()), 1
 
     def _states_payload(self) -> bytes:
         """The serialized optimizer state for EVERY replica updater

@@ -257,11 +257,16 @@ class KVStore:
                 (nrep,) + shp, sh, shards))
         out_g = _mesh_reduce(mesh.mesh, shapes)(*args)
         from .telemetry import tracing as _tracing
-        if _tracing._ENABLED:
-            from .telemetry import instruments as _ins
+        _snk = _tracing._SINK
+        if _tracing._ENABLED or _snk is not None:
+            payload = sum(a.nbytes // nrep for a in args)
+            if _tracing._ENABLED:
+                from .telemetry import instruments as _ins
 
-            _ins.collective_bytes_total("all-reduce", "dp").inc(
-                sum(a.nbytes // nrep for a in args))
+                _ins.collective_bytes_total("all-reduce",
+                                            "dp").inc(payload)
+            if _snk is not None:  # mxprof flight recorder
+                _snk.on_bytes("all-reduce", "dp", payload)
         for p, og in zip(poss, out_g):
             per_dev = {s.device: s.data for s in og.addressable_shards}
             ctx0 = vals[p][0].ctx

@@ -47,6 +47,7 @@ from ..analysis import sanitizer as _mxsan
 from ..ndarray.ndarray import NDArray
 from ..telemetry import instruments as _ins
 from ..telemetry import tracing as _tracing
+from ..telemetry.mxprof import costs as _costs
 from ..util import env as _env
 from .. import compile_cache as _cc
 from .optimizer import Optimizer, Updater
@@ -66,13 +67,17 @@ _TICKS = itertools.count(1)
 class _Entry:
     """One cached executable.  ``tick`` is LRU recency — refreshed by
     an attribute write on the hot path (no lock, no dict mutation; the
-    eviction scan under the cache lock reads it)."""
+    eviction scan under the cache lock reads it).  ``cost`` is the
+    executable's static cost analysis (mxprof MFU accounting), captured
+    once at insert time for fresh builds AND persistent-cache loads
+    alike — a warm restart keeps its cost metadata."""
 
-    __slots__ = ("fn", "tick")
+    __slots__ = ("fn", "tick", "cost")
 
-    def __init__(self, fn):
+    def __init__(self, fn, cost=None):
         self.fn = fn
         self.tick = next(_TICKS)
+        self.cost = cost
 
 
 class ExecutableCache:
@@ -116,6 +121,12 @@ class ExecutableCache:
         ent.tick = next(_TICKS)
         return ent.fn
 
+    def cost(self, sig):
+        """The cached executable's static cost (mxprof), or None —
+        lock-free like lookup (cost is written once at insert)."""
+        ent = self.data.get(sig)
+        return ent.cost if ent is not None else None
+
     def stats(self) -> Dict[str, float]:
         with self.lock:
             return {"count": self.compiles, "seconds_total": self.seconds,
@@ -147,13 +158,18 @@ class ExecutableCache:
         else:
             compiled, origin = build_lowered().compile(), "compiled"
         dt = time.perf_counter() - t0
+        # static cost analysis for MFU accounting — computed on the
+        # executable object, so a persistent-cache load (origin
+        # "memory"/"disk") carries the same metadata as a fresh build
+        cost = _costs.executable_cost(compiled)
+        _costs.note(self.site, repr(hash(sig)), cost)
         with self.lock:
             # a concurrent compile of the same signature may have won;
             # keep the first so the compile count matches the cache
             prior = self.data.get(sig)
             if prior is not None:
                 return prior.fn
-            self.data[sig] = _Entry(compiled)
+            self.data[sig] = _Entry(compiled, cost)
             if origin == "compiled":
                 self.compiles += 1
                 self.seconds += dt
@@ -353,6 +369,17 @@ class FusedUpdater(Updater):
         if fn is None:
             fn = self._compile(sig, args, mp_flags, donate)
         new_w, new_s = fn(*args)
+
+        snk = _tracing._SINK
+        if snk is not None and getattr(self, "mxprof_report_cost",
+                                       True):
+            # mxprof: this step ran these FLOPs.  The Trainer clears
+            # the flag on replicas > 0 — they run the SAME program, and
+            # counting it nrep times against one device's peak would
+            # inflate MFU by the replica count.
+            c = _FUSED_CACHE.cost(sig)
+            if c is not None:
+                snk.on_flops(_FUSED_CACHE.site, c)
 
         for w, nw in zip(weights, new_w):
             w._data = nw

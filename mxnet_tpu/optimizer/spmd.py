@@ -479,13 +479,21 @@ class SpmdUpdater(Updater):
                    tuple(_leaf_aval(x) for x in leaves))
             self._sig_cache = (sig_key, sig)
 
-        if self._flat and _tracing.active():
+        # the phased (3-dispatch) variant keys on capture_active(), NOT
+        # active(): the always-on mxprof sink must never serialize the
+        # one-program step it exists to measure
+        if self._flat and _tracing.capture_active():
             new_w, new_s = self._run_phased(sig, args, mp_flags, metas)
         else:
             fn = _SPMD_CACHE.lookup(sig)
             if fn is None:
                 fn = self._compile(sig, args, mp_flags, metas, donate)
             new_w, new_s = fn(*args)
+        snk = _tracing._SINK
+        if snk is not None:  # mxprof: this step ran these FLOPs
+            c = _SPMD_CACHE.cost(sig)
+            if c is not None:
+                snk.on_flops(_SPMD_CACHE.site, c)
         self._count_bytes(metas, plan)
 
         for i, w, nw in zip(indices, weights, new_w):
@@ -503,7 +511,8 @@ class SpmdUpdater(Updater):
             self._pstate[i] = tree
 
     def _count_bytes(self, metas, plan):
-        if not _tracing._ENABLED:
+        snk = _tracing._SINK
+        if not _tracing._ENABLED and snk is None:
             return
         def nbytes(pos):
             return sum(metas[p].size * np.dtype(metas[p].dtype).itemsize
@@ -512,10 +521,18 @@ class SpmdUpdater(Updater):
             + nbytes(plan.singles)
         ar = sum(nbytes(g.pos) for g in plan.smalls)
         if rs:
-            _ins.collective_bytes_total("reduce-scatter", AXIS).inc(rs)
-            _ins.collective_bytes_total("all-gather", AXIS).inc(rs)
+            if _tracing._ENABLED:
+                _ins.collective_bytes_total("reduce-scatter",
+                                            AXIS).inc(rs)
+                _ins.collective_bytes_total("all-gather", AXIS).inc(rs)
+            if snk is not None:
+                snk.on_bytes("reduce-scatter", AXIS, rs)
+                snk.on_bytes("all-gather", AXIS, rs)
         if ar:
-            _ins.collective_bytes_total("all-reduce", AXIS).inc(ar)
+            if _tracing._ENABLED:
+                _ins.collective_bytes_total("all-reduce", AXIS).inc(ar)
+            if snk is not None:
+                snk.on_bytes("all-reduce", AXIS, ar)
 
     # ---- program builders ------------------------------------------------
     def _stages(self, mp_flags, metas):

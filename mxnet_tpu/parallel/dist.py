@@ -217,8 +217,10 @@ def init(coordinator_address: Optional[str] = None,
                 "PMI_SIZE") is not None:
             jax.distributed.initialize()
             _INITIALIZED = True
+            _tracing.set_rank(jax.process_index())
             return
         _INITIALIZED = True  # single-process
+        _tracing.set_rank(jax.process_index())
         return
     role = _env("DMLC_ROLE", default="worker")
     if role in ("scheduler", "server"):
@@ -240,6 +242,9 @@ def init(coordinator_address: Optional[str] = None,
                                num_processes=num_processes,
                                process_id=process_id)
     _INITIALIZED = True
+    # spans emitted from here on carry args.rank — what trace_report
+    # --merge keys its per-rank attribution and clock alignment on
+    _tracing.set_rank(jax.process_index())
 
 
 def initialized() -> bool:

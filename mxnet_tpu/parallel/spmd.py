@@ -570,8 +570,9 @@ class SPMDTrainer:
                                          self._optimizer, alias_ok=False)
             # per-trainer fast path keyed by input avals: a batch-shape
             # change rebuilds (AOT does not silently retrace), a repeat
-            # shape is one dict hit
-            self._step_fns[ikey] = fn
+            # shape is one dict hit.  The executable's static cost
+            # rides along for mxprof's whole-step MFU.
+            self._step_fns[ikey] = (fn, _STEP_CACHE.cost(sig))
             while len(self._step_fns) > _STEP_FNS_MAX:
                 self._step_fns.popitem(last=False)
         else:
@@ -610,7 +611,7 @@ class SPMDTrainer:
         args = (self.params, self.opt_state, ivals, lvals, key, lr, t)
         ikey = tuple((tuple(v.shape), str(v.dtype))
                      for v in ivals + lvals)
-        step = self._get_step(args, ikey)
+        step, step_cost = self._get_step(args, ikey)
         if not _tracing.active():
             out = step(*args)
         else:
@@ -627,6 +628,15 @@ class SPMDTrainer:
                                    "spmd-step")
                                if _tracing._ENABLED else None):
                 out = step(*args)
+            snk = _tracing._SINK
+            if snk is not None and step_cost is not None:
+                # whole-step program: forward+backward+update FLOPs in
+                # one executable — the gspmd path's MFU counts
+                # everything.  AFTER the span: this step's record only
+                # closes when the NEXT spmd-step span arrives, so flops
+                # reported before the span would land one record early
+                # (and double the first closed record's MFU).
+                snk.on_flops(_STEP_CACHE.site, step_cost)
         self.params, self.opt_state, lval, aux = out
         # rebind aux state (BatchNorm moving stats) by parameter NAME
         for n, v in aux.items():

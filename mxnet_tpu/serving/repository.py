@@ -26,6 +26,7 @@ from ..resilience import chaos as _chaos
 from ..resilience.breaker import CircuitBreaker
 from ..telemetry import instruments as _ins
 from ..telemetry import tracing as _tracing
+from ..telemetry.mxprof import costs as _costs
 from .. import compile_cache as _cc
 from . import ModelNotFound, ServingError
 from .metrics import ModelMetrics
@@ -251,6 +252,10 @@ class _ModelEntry:
         t0 = time.perf_counter()
         compiled, origin = self._compile_impl(bucket)
         dt = time.perf_counter() - t0
+        # mxprof cost accounting: computed on the executable object, so
+        # persistent-cache loads keep their cost metadata too
+        _costs.note(f"serving:{self.name}/v{self.version}",
+                    f"bucket={bucket}", _costs.executable_cost(compiled))
         if origin == "compiled":
             # always counted, never gated: a compile on the serving
             # path is the silent TPU latency killer — each one must be

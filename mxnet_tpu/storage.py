@@ -27,8 +27,8 @@ from typing import Dict, Optional, Tuple
 from .base import MXNetError
 from .util import env
 
-__all__ = ["memory_info", "memory_summary", "configure",
-           "live_array_bytes"]
+__all__ = ["memory_info", "memory_summary", "memory_summaries",
+           "configure", "live_array_bytes"]
 
 
 def _device_of(ctx=None):
@@ -57,6 +57,30 @@ def live_array_bytes(ctx=None) -> Tuple[int, int]:
         except Exception:  # deleted/donated buffers
             continue
     return n, total
+
+
+def memory_summaries(devices=None) -> Dict[object, Tuple[int, int]]:
+    """Live-buffer accounting for MANY devices in ONE pass over
+    ``jax.live_arrays()`` -> {device: (n_live, total_bytes)}.  The
+    per-device :func:`live_array_bytes` rescans the whole live set per
+    call; telemetry's HBM sampling (mxprof) wants every local device
+    at once, so this amortizes the scan."""
+    import jax
+
+    devs = list(devices) if devices is not None else jax.local_devices()
+    acc: Dict[object, list] = {d: [0, 0] for d in devs}
+    for a in jax.live_arrays():
+        try:
+            adevs = a.devices()
+            share = a.nbytes // max(1, len(adevs))
+            for d in adevs:
+                slot = acc.get(d)
+                if slot is not None:
+                    slot[0] += 1
+                    slot[1] += share
+        except Exception:  # deleted/donated buffers
+            continue
+    return {d: (n, total) for d, (n, total) in acc.items()}
 
 
 def memory_info(ctx=None) -> Tuple[int, int]:

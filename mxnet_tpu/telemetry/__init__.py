@@ -42,10 +42,12 @@ from .metrics import (Counter, Gauge, Histogram, MetricFamily,
                       DEFAULT_LATENCY_BUCKETS, exponential_buckets)
 from .tracing import (Span, span, current_span, new_trace_id,
                       record_complete, flow_start, flow_end,
-                      counter_event, enable, disable, enabled)
+                      counter_event, enabled)
 from . import metrics
 from . import tracing
 from . import instruments
+from . import catalog
+from . import mxprof
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricFamily", "MetricsRegistry",
@@ -53,5 +55,36 @@ __all__ = [
     "Span", "span", "current_span", "new_trace_id", "record_complete",
     "flow_start", "flow_end", "counter_event",
     "enable", "disable", "enabled",
-    "metrics", "tracing",
+    "metrics", "tracing", "instruments", "catalog", "mxprof",
 ]
+
+
+# whether the mxprof sink was ALREADY attached when telemetry.enable()
+# ran (e.g. MXNET_MXPROF=1 at import) — disable() restores that state
+# instead of silencing a flight recorder the user enabled on their own
+_mxprof_pre_enabled = None
+
+
+def enable() -> None:
+    """Turn the whole observability layer on: metric side-effects +
+    span tracing (:mod:`.tracing`) AND the mxprof flight recorder
+    (:mod:`.mxprof`) — per-step attribution is part of "telemetry on".
+    """
+    global _mxprof_pre_enabled
+    if _mxprof_pre_enabled is None:
+        _mxprof_pre_enabled = mxprof.enabled()
+    tracing.enable()
+    mxprof.enable()
+
+
+def disable() -> None:
+    """Symmetric off — but only for what enable() itself attached: a
+    flight recorder that was already on (always-on MXNET_MXPROF=1 jobs
+    bracket telemetry captures all the time), or an UNPAIRED defensive
+    disable() with no prior enable(), leaves the sink alone.  Use
+    mxprof.disable() to stop the recorder itself."""
+    global _mxprof_pre_enabled
+    tracing.disable()
+    if _mxprof_pre_enabled is False:
+        mxprof.disable()
+    _mxprof_pre_enabled = None

@@ -1,0 +1,85 @@
+"""Metric catalogue generation: instruments._SPECS -> observability.md.
+
+The same registry-then-docs contract ``util/env.py`` keeps for
+``env_vars.md``: every metric family is declared once (in
+``telemetry/instruments.py``), the docs table is GENERATED from the
+declarations (``python tools/gen_metric_docs.py --write``), and a
+tier-1 sync test fails when the committed table drifts — so a PR that
+adds an instrument cannot silently ship undocumented.
+
+The generated block lives between the two marker comments inside
+``docs/observability.md``; prose outside the markers is hand-written
+and untouched by the generator.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional, Tuple
+
+from . import instruments as _ins
+
+__all__ = ["BEGIN_MARK", "END_MARK", "table_markdown", "render_block",
+           "apply_block", "docs_in_sync"]
+
+BEGIN_MARK = ("<!-- metric-catalog:begin — generated from "
+              "telemetry/instruments.py by "
+              "`python tools/gen_metric_docs.py --write`; "
+              "do not edit by hand -->")
+END_MARK = "<!-- metric-catalog:end -->"
+
+_WS = re.compile(r"\s+")
+
+
+def _cell(text: str) -> str:
+    return _WS.sub(" ", text).replace("|", "\\|").strip()
+
+
+def table_markdown() -> str:
+    """The metric table, one row per declared family, sorted by name."""
+    rows = ["| metric | type | labels | meaning |",
+            "|---|---|---|---|"]
+    sp = _ins.specs()
+    for name in sorted(sp):
+        s = sp[name]
+        labels = ", ".join(f"`{ln}`" for ln in s.labels) or "—"
+        rows.append(f"| `{s.name}` | {s.kind} | {labels} "
+                    f"| {_cell(s.help)} |")
+    return "\n".join(rows)
+
+
+def render_block() -> str:
+    return f"{BEGIN_MARK}\n\n{table_markdown()}\n\n{END_MARK}"
+
+
+def _default_path() -> str:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, "docs", "observability.md")
+
+
+def apply_block(path: Optional[str] = None,
+                write: bool = False) -> Tuple[bool, str]:
+    """(in_sync, new_text) for the docs file.  ``write=True`` rewrites
+    the file in place when out of sync.  Raises ValueError when the
+    marker pair is missing/garbled — a deleted marker IS drift."""
+    p = path or _default_path()
+    with open(p, "r", encoding="utf-8") as f:
+        text = f.read()
+    b = text.find(BEGIN_MARK)
+    e = text.find(END_MARK)
+    if b < 0 or e < 0 or e < b:
+        raise ValueError(
+            f"{p}: metric-catalog markers missing or out of order — "
+            f"restore them (see telemetry/catalog.py) and regenerate")
+    new = text[:b] + render_block() + text[e + len(END_MARK):]
+    ok = new == text
+    if write and not ok:
+        with open(p, "w", encoding="utf-8") as f:
+            f.write(new)
+    return ok, new
+
+
+def docs_in_sync(path: Optional[str] = None) -> bool:
+    ok, _ = apply_block(path, write=False)
+    return ok

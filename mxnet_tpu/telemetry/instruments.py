@@ -1,7 +1,7 @@
 """The framework's own metric families, in one place.
 
 Instrument sites (op dispatch, trainer, dataloader, collectives, the
-serving stack) get their families/children through these cached
+serving stack, mxprof) get their families/children through these cached
 accessors so (a) every family is registered exactly once with one
 naming scheme, and (b) the per-event cost is a plain method call on a
 cached child object.  Naming scheme (docs/observability.md):
@@ -10,11 +10,22 @@ cached child object.  Naming scheme (docs/observability.md):
 
 Counters end in ``_total``; durations are histograms in seconds on the
 shared exponential ladder; point-in-time values are gauges.
+
+Every family is DECLARED up front in ``_SPECS`` (name, kind, labels,
+help) and the accessors resolve through it — the declaration table is
+the single source of truth the metric catalogue in
+``docs/observability.md`` is generated from (``telemetry.catalog``,
+``tools/gen_metric_docs.py``), the same registry-then-docs contract
+``util/env.py`` keeps for ``env_vars.md``.  An accessor cannot create
+an undeclared family, so the docs can never trail the code.
 """
 from __future__ import annotations
 
+import os
+import sys
 import threading
-from typing import Dict
+import time
+from typing import Dict, NamedTuple, Tuple
 
 from .metrics import MetricFamily, get_registry
 
@@ -26,6 +37,10 @@ __all__ = [
     "data_wait_seconds", "data_wait_last_seconds",
     "collective_seconds", "collective_bytes_total",
     "step_layout_axis_size", "step_state_shard_factor",
+    "step_mfu", "step_last_seconds", "step_flops_total",
+    "step_roofline_total",
+    "hbm_used_bytes", "hbm_peak_bytes", "hbm_optimizer_state_bytes",
+    "build_info", "process_uptime_seconds", "process_rss_bytes",
     "retry_total", "fault_injected_total",
     "compile_cache_hit_total", "compile_cache_miss_total",
     "compile_cache_evict_total", "compile_cache_load_seconds",
@@ -35,12 +50,37 @@ __all__ = [
     "serving_request_latency", "serving_compile_total",
     "serving_compile_seconds",
     "san_violations_total",
+    "specs", "refresh_process_gauges",
 ]
 
 _lock = threading.RLock()  # _child -> _family nests the acquisition
 _families: Dict[str, MetricFamily] = {}
 _children: Dict[tuple, object] = {}
 _generation = -1  # registry generation the caches were built against
+
+
+class Spec(NamedTuple):
+    """One declared metric family — what the docs generator renders."""
+    name: str
+    kind: str
+    labels: Tuple[str, ...]
+    help: str
+
+
+_SPECS: Dict[str, Spec] = {}
+
+
+def _spec(name: str, kind: str, help: str, labels=()) -> str:
+    # only called from this module's top level: the import lock is the
+    # mutual exclusion, and the table is read-only afterwards
+    _SPECS[name] = Spec(name, kind, tuple(labels), help)  # mxlint: disable=MX004
+    return name
+
+
+def specs() -> Dict[str, Spec]:
+    """The declared catalogue (name -> Spec), the source of truth for
+    docs/observability.md's metric table and the scrape-coverage test."""
+    return dict(_SPECS)
 
 
 def _revalidate_locked() -> None:
@@ -55,230 +95,431 @@ def _revalidate_locked() -> None:
         _generation = gen
 
 
-def _family(name: str, kind: str, help: str, labels=()) -> MetricFamily:
+def _family(name: str) -> MetricFamily:
+    spec = _SPECS[name]
     with _lock:
         _revalidate_locked()
         fam = _families.get(name)
         if fam is None:
             reg = get_registry()
-            fam = getattr(reg, kind)(name, help, labels=labels)
+            fam = getattr(reg, spec.kind)(name, spec.help,
+                                          labels=spec.labels)
             _families[name] = fam
     return fam
 
 
-def _child(name: str, kind: str, help: str, labels=(), values=()):
+def _child(name: str, values=()):
     key = (name,) + tuple(values)
     with _lock:
         _revalidate_locked()
         child = _children.get(key)
         if child is None:
-            child = _family(name, kind, help, labels).labels(*values)
+            child = _family(name).labels(*values)
             _children[key] = child
     return child
 
 
 # ---- op layer ---------------------------------------------------------
 
+_spec("mx_op_dispatch_total", "counter",
+      "Imperative op dispatches through ops.registry.invoke.", ("op",))
+
+
 def op_dispatch_total(op_name: str):
-    return _child("mx_op_dispatch_total", "counter",
-                  "Imperative op dispatches through "
-                  "ops.registry.invoke.", ("op",), (op_name,))
+    return _child("mx_op_dispatch_total", (op_name,))
 
 
 # ---- training ---------------------------------------------------------
 
+_spec("mx_training_phase_seconds", "histogram",
+      "Wall seconds per training-step phase: forward / backward / "
+      "grad-allreduce / optimizer-update / fused-update (nested in "
+      "optimizer-update on the fused path); under MXNET_SPMD=1 the "
+      "step tail is spmd-step, attributed as reduce-scatter / "
+      "shard-update / all-gather while tracing.", ("phase",))
+_spec("mx_training_steps_total", "counter", "Optimizer steps taken.")
+_spec("mx_fused_step_total", "counter",
+      "Trainer steps taken through the fused (single-dispatch) "
+      "optimizer-update path.")
+_spec("mx_fused_compile_seconds", "histogram",
+      "Seconds building one fused-step executable — the count is the "
+      "no-recompile guarantee (an lr change must not grow it).")
+_spec("mx_spmd_step_total", "counter",
+      "Trainer steps taken through the unified SPMD "
+      "(one-program-over-the-mesh) path.")
+_spec("mx_spmd_compile_seconds", "histogram",
+      "Seconds building one SPMD-step executable; the count is the "
+      "one-executable-per-(mesh, layout) guarantee.")
+_spec("mx_data_wait_seconds", "histogram",
+      "Seconds the training loop waited for the next batch.")
+_spec("mx_data_wait_last_seconds", "gauge",
+      "Most recent data-wait (seconds) — the live stall signal a "
+      "dashboard watches.")
+_spec("mx_collective_seconds", "histogram",
+      "Host-blocking collective wall seconds (allreduce / allgather / "
+      "barrier).", ("op",))
+_spec("mx_collective_bytes_total", "counter",
+      "Logical payload bytes moved by collectives, by operation "
+      "(reduce-scatter/all-gather/all-reduce) and mesh axis — the "
+      "bytes-on-wire half of scaling-efficiency attribution.",
+      ("op", "axis"))
+_spec("mx_step_layout_axis_size", "gauge",
+      "Size of each mesh axis the active training-step layout runs "
+      "over (1 = axis unused).", ("axis",))
+_spec("mx_step_state_shard_factor", "gauge",
+      "Ways the optimizer states of the active step layout are sharded "
+      "across the data axis (1 = fully replicated, N = ZeRO-1 over N "
+      "shards).")
+
+
 def training_phase_seconds(phase: str):
-    return _child("mx_training_phase_seconds", "histogram",
-                  "Wall seconds per training-step phase.",
-                  ("phase",), (phase,))
+    return _child("mx_training_phase_seconds", (phase,))
 
 
 def training_steps_total():
-    return _child("mx_training_steps_total", "counter",
-                  "Optimizer steps taken.")
+    return _child("mx_training_steps_total")
 
 
 def fused_step_total():
-    return _child("mx_fused_step_total", "counter",
-                  "Trainer steps taken through the fused "
-                  "(single-dispatch) optimizer-update path.")
+    return _child("mx_fused_step_total")
 
 
 def fused_compile_seconds():
-    return _child("mx_fused_compile_seconds", "histogram",
-                  "Seconds building one fused-step executable — the "
-                  "count is the no-recompile guarantee (an lr change "
-                  "must not grow it).")
+    return _child("mx_fused_compile_seconds")
 
 
 def spmd_step_total():
-    return _child("mx_spmd_step_total", "counter",
-                  "Trainer steps taken through the unified SPMD "
-                  "(one-program-over-the-mesh) path.")
+    return _child("mx_spmd_step_total")
 
 
 def spmd_compile_seconds():
-    return _child("mx_spmd_compile_seconds", "histogram",
-                  "Seconds building one SPMD-step executable; the count "
-                  "is the one-executable-per-(mesh, layout) guarantee.")
+    return _child("mx_spmd_compile_seconds")
 
 
 def data_wait_seconds():
-    return _child("mx_data_wait_seconds", "histogram",
-                  "Seconds the training loop waited for the next batch.")
+    return _child("mx_data_wait_seconds")
 
 
 def data_wait_last_seconds():
-    return _child("mx_data_wait_last_seconds", "gauge",
-                  "Most recent data-wait (seconds) — the live stall "
-                  "signal a dashboard watches.")
+    return _child("mx_data_wait_last_seconds")
 
 
 def collective_seconds(op: str):
-    return _child("mx_collective_seconds", "histogram",
-                  "Host-blocking collective wall seconds.",
-                  ("op",), (op,))
+    return _child("mx_collective_seconds", (op,))
 
 
 def collective_bytes_total(op: str, axis: str):
-    return _child("mx_collective_bytes_total", "counter",
-                  "Logical payload bytes moved by collectives, by "
-                  "operation (reduce-scatter/all-gather/all-reduce) and "
-                  "mesh axis — the bytes-on-wire half of scaling-"
-                  "efficiency attribution.", ("op", "axis"), (op, axis))
+    return _child("mx_collective_bytes_total", (op, axis))
 
 
 def step_layout_axis_size(axis: str):
-    return _child("mx_step_layout_axis_size", "gauge",
-                  "Size of each mesh axis the active training-step "
-                  "layout runs over (1 = axis unused).",
-                  ("axis",), (axis,))
+    return _child("mx_step_layout_axis_size", (axis,))
 
 
 def step_state_shard_factor():
-    return _child("mx_step_state_shard_factor", "gauge",
-                  "Ways the optimizer states of the active step layout "
-                  "are sharded across the data axis (1 = fully "
-                  "replicated, N = ZeRO-1 over N shards).")
+    return _child("mx_step_state_shard_factor")
+
+
+# ---- mxprof: step attribution / MFU / HBM -----------------------------
+
+_spec("mx_step_mfu", "gauge",
+      "Model FLOP/s utilization of the last closed step: counted "
+      "program FLOPs / step wall seconds / per-device peak "
+      "(MXNET_PEAK_FLOPS or the device-kind table). Whole-step FLOPs "
+      "on the gspmd path; the AOT update tail on eager fwd/bwd paths. "
+      "Unknowable peak reports nothing rather than a made-up ratio.")
+_spec("mx_step_last_seconds", "gauge",
+      "Wall seconds of the last closed training step (the mxprof "
+      "flight recorder's live step-time signal).")
+_spec("mx_step_flops_total", "counter",
+      "Cumulative FLOPs of AOT-compiled programs dispatched on the "
+      "step path, from compiled.cost_analysis() captured at the "
+      "compile-cache sites (cached loads keep their cost metadata).")
+_spec("mx_step_roofline_total", "counter",
+      "Closed step records by roofline verdict: compute-bound / "
+      "comm-bound / input-bound / unattributed. The distribution is "
+      "the one-line answer to 'where did the step time go'.",
+      ("verdict",))
+_spec("mx_hbm_used_bytes", "gauge",
+      "Device memory in use per device, from the PjRt allocator stats "
+      "(bytes_in_use), sampled at step boundaries "
+      "(MXNET_MXPROF_HBM_EVERY) and on mxprof dumps.", ("device",))
+_spec("mx_hbm_peak_bytes", "gauge",
+      "Peak device memory per device: the allocator's high watermark "
+      "(peak_bytes_in_use) when reported, else the max sampled "
+      "used-bytes.", ("device",))
+_spec("mx_hbm_optimizer_state_bytes", "gauge",
+      "Per-device bytes held by optimizer states (total state bytes / "
+      "shard factor) — the share that proves the ZeRO-1 ~1/N state "
+      "claim on a real run.")
+
+
+def step_mfu():
+    return _child("mx_step_mfu")
+
+
+def step_last_seconds():
+    return _child("mx_step_last_seconds")
+
+
+def step_flops_total():
+    return _child("mx_step_flops_total")
+
+
+def step_roofline_total(verdict: str):
+    return _child("mx_step_roofline_total", (verdict,))
+
+
+def hbm_used_bytes(device: str):
+    return _child("mx_hbm_used_bytes", (device,))
+
+
+def hbm_peak_bytes(device: str):
+    return _child("mx_hbm_peak_bytes", (device,))
+
+
+def hbm_optimizer_state_bytes():
+    return _child("mx_hbm_optimizer_state_bytes")
+
+
+# ---- process identity (what is being scraped) -------------------------
+
+_spec("mx_build_info", "gauge",
+      "Info gauge (value always 1): framework version, jax version, "
+      "backend platform, and device kind as labels — /metrics "
+      "identifies what is being scraped.",
+      ("version", "jax", "platform", "device_kind"))
+_spec("mx_process_uptime_seconds", "gauge",
+      "Seconds since this process imported the framework, refreshed "
+      "at scrape time.")
+_spec("mx_process_rss_bytes", "gauge",
+      "Resident set size of this process, refreshed at scrape time "
+      "(/proc/self/statm; ru_maxrss fallback reports the peak).")
+
+
+_IMPORT_T0 = time.monotonic()
+_PAGESIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def _read_rss_bytes() -> float:
+    try:
+        with open("/proc/self/statm") as f:
+            return float(f.read().split()[1]) * _PAGESIZE
+    except (OSError, IndexError, ValueError):
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # ru_maxrss units are platform-defined: bytes on macOS, KiB on
+        # linux (where /proc normally answers first anyway)
+        return float(ru) * (1 if sys.platform == "darwin" else 1024)
+
+
+def build_info():
+    """The mx_build_info child for THIS process.  Device labels resolve
+    lazily (jax backends must not initialize at import); before the
+    backend exists they read 'uninitialized'."""
+    version = platform = kind = jaxver = "unknown"
+    try:
+        from .. import __version__ as version  # type: ignore
+    except Exception:
+        version = "unknown"
+    try:
+        import jax
+
+        jaxver = jax.__version__
+        try:
+            initialized = bool(jax._src.xla_bridge._backends)
+        except Exception:
+            # can't tell -> assume DOWN: the wrong guess here would
+            # make a Prometheus scrape initialize the TPU backend as a
+            # side effect (labels stay 'uninitialized' instead)
+            initialized = False
+        if initialized:
+            dev = jax.devices()[0]
+            platform, kind = dev.platform, dev.device_kind
+        else:
+            platform = kind = "uninitialized"
+    except Exception:
+        pass
+    return _child("mx_build_info", (str(version), str(jaxver),
+                                    str(platform), str(kind)))
+
+
+# the build-info labels last published; when the backend comes up the
+# labels flip (uninitialized -> real platform) and the stale identity
+# series must drop to 0, not linger at 1 beside the real one
+_build_info_last = None
+
+
+def refresh_process_gauges() -> None:
+    """The pre-scrape collector: build info (value 1), uptime, RSS."""
+    global _build_info_last
+    child = build_info()
+    prev = _build_info_last
+    if prev is not None and prev is not child:
+        prev.set(0)
+    # racing scrapes at worst re-run the 0/1 writes; both settle on the
+    # same newest child at 1
+    _build_info_last = child
+    child.set(1)
+    _child("mx_process_uptime_seconds").set(
+        time.monotonic() - _IMPORT_T0)
+    _child("mx_process_rss_bytes").set(_read_rss_bytes())
+
+
+get_registry().add_collector("process", refresh_process_gauges)
 
 
 # ---- resilience -------------------------------------------------------
 
+_spec("mx_retry_total", "counter",
+      "Transient-error retries by call site (collective, kvstore, "
+      "checkpoint I/O, serving execute, compile-cache IO). Sustained "
+      "growth means an infra fault is being papered over.", ("site",))
+_spec("mx_fault_injected_total", "counter",
+      "Faults injected by the chaos harness, by kind. Nonzero outside "
+      "a chaos experiment means MXNET_CHAOS leaked into production.",
+      ("kind",))
+_spec("mx_breaker_state", "gauge",
+      "Serving circuit-breaker state per model "
+      "(0 closed / 1 half-open / 2 open).", ("model", "version"))
+_spec("mx_breaker_open_total", "counter",
+      "Circuit-breaker trips (CLOSED/HALF-OPEN -> OPEN).",
+      ("model", "version"))
+
+
 def retry_total(site: str):
-    return _child("mx_retry_total", "counter",
-                  "Transient-error retries by call site (collective, "
-                  "kvstore, checkpoint I/O, serving execute). Sustained "
-                  "growth means an infra fault is being papered over.",
-                  ("site",), (site,))
+    return _child("mx_retry_total", (site,))
 
 
 def fault_injected_total(kind: str):
-    return _child("mx_fault_injected_total", "counter",
-                  "Faults injected by the chaos harness, by kind. "
-                  "Nonzero outside a chaos experiment means MXNET_CHAOS "
-                  "leaked into production.",
-                  ("kind",), (kind,))
+    return _child("mx_fault_injected_total", (kind,))
 
 
 def breaker_state(model: str, version):
-    return _child("mx_breaker_state", "gauge",
-                  "Serving circuit-breaker state per model "
-                  "(0 closed / 1 half-open / 2 open).",
-                  ("model", "version"), (model, str(version)))
+    return _child("mx_breaker_state", (model, str(version)))
 
 
 def breaker_open_total(model: str, version):
-    return _child("mx_breaker_open_total", "counter",
-                  "Circuit-breaker trips (CLOSED/HALF-OPEN -> OPEN).",
-                  ("model", "version"), (model, str(version)))
+    return _child("mx_breaker_open_total", (model, str(version)))
 
 
 # ---- compile cache ----------------------------------------------------
 
+_spec("mx_compile_cache_hit_total", "counter",
+      "Persistent compile-cache hits by site and tier (memory / exec / "
+      "stablehlo). An exec hit skipped an XLA compilation entirely.",
+      ("site", "tier"))
+_spec("mx_compile_cache_miss_total", "counter",
+      "Persistent compile-cache misses (a fresh XLA compile ran). "
+      "Sustained misses on a warmed fleet mean the key drifted — check "
+      "jax/artifact versions.", ("site",))
+_spec("mx_compile_cache_evict_total", "counter",
+      "Compile-cache evictions by store (disk = the "
+      "MXNET_COMPILE_CACHE_BYTES cap; memory = the in-process digest "
+      "tier; fused / spmd / ops_jit / ops_grad / ops_aot = the bounded "
+      "per-site executable caches).", ("store",))
+_spec("mx_compile_cache_load_seconds", "histogram",
+      "Seconds to load+deserialize one exec-tier entry from disk — "
+      "the warm-start cost that replaces a compile.")
+_spec("mx_compile_cache_bytes", "gauge",
+      "Bytes of live entries in the on-disk compile cache.")
+
+
 def compile_cache_hit_total(site: str, tier: str):
-    return _child("mx_compile_cache_hit_total", "counter",
-                  "Persistent compile-cache hits by site and tier "
-                  "(memory / exec / stablehlo). An exec hit skipped an "
-                  "XLA compilation entirely.",
-                  ("site", "tier"), (site, tier))
+    return _child("mx_compile_cache_hit_total", (site, tier))
 
 
 def compile_cache_miss_total(site: str):
-    return _child("mx_compile_cache_miss_total", "counter",
-                  "Persistent compile-cache misses (a fresh XLA "
-                  "compile ran). Sustained misses on a warmed fleet "
-                  "mean the key drifted — check jax/artifact versions.",
-                  ("site",), (site,))
+    return _child("mx_compile_cache_miss_total", (site,))
 
 
 def compile_cache_evict_total(store: str):
-    return _child("mx_compile_cache_evict_total", "counter",
-                  "Compile-cache evictions by store (disk = the "
-                  "MXNET_COMPILE_CACHE_BYTES cap; memory = the "
-                  "in-process digest tier; fused / ops_jit / ops_grad "
-                  "/ ops_aot = the bounded per-site executable "
-                  "caches).",
-                  ("store",), (store,))
+    return _child("mx_compile_cache_evict_total", (store,))
 
 
 def compile_cache_load_seconds():
-    return _child("mx_compile_cache_load_seconds", "histogram",
-                  "Seconds to load+deserialize one exec-tier entry "
-                  "from disk — the warm-start cost that replaces a "
-                  "compile.")
+    return _child("mx_compile_cache_load_seconds")
 
 
 def compile_cache_bytes():
-    return _child("mx_compile_cache_bytes", "gauge",
-                  "Bytes of live entries in the on-disk compile "
-                  "cache.")
+    return _child("mx_compile_cache_bytes")
 
 
 # ---- analysis ---------------------------------------------------------
 
+_spec("mx_san_violations_total", "counter",
+      "mxsan sanitizer violations by detector kind (lock-order, "
+      "lockset-race, recompile-storm). Any non-zero value is a "
+      "finding — alert on it.", ("kind",))
+
+
 def san_violations_total(kind: str):
-    return _child("mx_san_violations_total", "counter",
-                  "mxsan sanitizer violations by detector kind "
-                  "(lock-order, lockset-race, recompile-storm). Any "
-                  "non-zero value is a finding — alert on it.",
-                  ("kind",), (kind,))
+    return _child("mx_san_violations_total", (kind,))
 
 
 # ---- serving ----------------------------------------------------------
+# each serving counter is declared explicitly (not via an f-string
+# family) so the docs catalogue and the drift check see every name
+
+for _n, _h in (
+        ("requests", "Requests admitted."),
+        ("completed", "Requests completed successfully."),
+        ("failed", "Requests failed in execution."),
+        ("rejected", "Requests shed at admission (backpressure 503)."),
+        ("deadline_expired", "Requests dropped past their deadline."),
+        ("batches", "Batches launched."),
+        ("batched_rows", "Real rows launched across batches."),
+        ("padded_rows", "Padding rows launched (bucket waste)."),
+        ("cache_hits", "Bucket-executor cache hits."),
+        ("cache_misses", "Bucket-executor cache misses (a compile or "
+                         "cache load followed)."),
+        ("retries_exhausted", "Transient-executor retries that "
+                              "exhausted their budget."),
+        ("breaker_rejected", "503s shed by an open circuit breaker."),
+        ("drain_timeouts", "Drain deadlines that abandoned queued work "
+                           "at shutdown."),
+):
+    _spec(f"mx_serving_{_n}_total", "counter",
+          f"Serving: {_h}", ("model", "version"))
+
+_spec("mx_serving_queue_depth", "gauge",
+      "Admitted-but-incomplete requests per model version.",
+      ("model", "version"))
+_spec("mx_serving_batch_occupancy", "gauge",
+      "Real rows / launched rows of the last batch "
+      "(1.0 = no padding waste).", ("model", "version"))
+_spec("mx_serving_request_latency_seconds", "histogram",
+      "End-to-end served request latency.", ("model", "version"))
+_spec("mx_serving_compile_total", "counter",
+      "AOT bucket compiles (TPU recompiles are the silent serving "
+      "killer — watch this). Counts real XLA builds only: persistent-"
+      "compile-cache loads land in mx_compile_cache_hit_total instead.",
+      ("model", "version"))
+_spec("mx_serving_compile_seconds", "histogram",
+      "Seconds spent in AOT bucket compilation.", ("model", "version"))
+
 
 def serving_counter(name: str, model: str, version) -> object:
-    return _child(f"mx_serving_{name}_total", "counter",
-                  f"Serving {name.replace('_', ' ')}.",
-                  ("model", "version"), (model, str(version)))
+    return _child(f"mx_serving_{name}_total", (model, str(version)))
 
 
 def serving_queue_depth(model: str, version):
-    return _child("mx_serving_queue_depth", "gauge",
-                  "Admitted-but-incomplete requests per model version.",
-                  ("model", "version"), (model, str(version)))
+    return _child("mx_serving_queue_depth", (model, str(version)))
 
 
 def serving_occupancy(model: str, version):
-    return _child("mx_serving_batch_occupancy", "gauge",
-                  "Real rows / launched rows of the last batch "
-                  "(1.0 = no padding waste).",
-                  ("model", "version"), (model, str(version)))
+    return _child("mx_serving_batch_occupancy", (model, str(version)))
 
 
 def serving_request_latency(model: str, version):
-    return _child("mx_serving_request_latency_seconds", "histogram",
-                  "End-to-end served request latency.",
-                  ("model", "version"), (model, str(version)))
+    return _child("mx_serving_request_latency_seconds",
+                  (model, str(version)))
 
 
 def serving_compile_total(model: str, version):
-    return _child("mx_serving_compile_total", "counter",
-                  "AOT bucket compiles (TPU recompiles are the "
-                  "silent serving killer — watch this).",
-                  ("model", "version"), (model, str(version)))
+    return _child("mx_serving_compile_total", (model, str(version)))
 
 
 def serving_compile_seconds(model: str, version):
-    return _child("mx_serving_compile_seconds", "histogram",
-                  "Seconds spent in AOT bucket compilation.",
-                  ("model", "version"), (model, str(version)))
+    return _child("mx_serving_compile_seconds", (model, str(version)))

@@ -324,6 +324,27 @@ class MetricsRegistry:
         # dispatch) key their validity on it so a cleared registry
         # never keeps receiving samples into orphaned children
         self.generation = 0
+        # name -> zero-arg callable run before every exposition, for
+        # point-in-time process gauges (uptime, RSS, build info) that
+        # must be fresh at scrape time rather than at some event time
+        self._collectors: "OrderedDict[str, object]" = OrderedDict()
+
+    def add_collector(self, name: str, fn) -> None:
+        """Register (idempotently, by name) a pre-scrape refresher.  A
+        collector must be cheap and must never raise into a scrape —
+        failures are swallowed (the scrape serves stale/absent samples
+        instead of a 500)."""
+        with self._lock:
+            self._collectors[name] = fn
+
+    def _run_collectors(self) -> None:
+        with self._lock:
+            fns = list(self._collectors.values())
+        for fn in fns:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — scrape must not 500
+                pass
 
     def _get_or_make(self, name: str, kind: str, help: str,
                      labelnames: Sequence[str],
@@ -385,6 +406,9 @@ class MetricsRegistry:
         with self._lock:
             self._families.clear()
             self.generation += 1
+            # collectors survive: they are registered once per process
+            # (telemetry.instruments import) and re-create their
+            # families on the next scrape of the cleared registry
 
     # ---- exposition ----------------------------------------------------
 
@@ -392,6 +416,7 @@ class MetricsRegistry:
         """Prometheus text exposition format 0.0.4: `# HELP`/`# TYPE`
         headers, one line per sample, histogram `_bucket`/`_sum`/
         `_count` expansion."""
+        self._run_collectors()
         out: List[str] = []
         for fam in self.families():
             out.append(f"# HELP {fam.name} "
@@ -417,6 +442,7 @@ class MetricsRegistry:
     def snapshot(self) -> dict:
         """JSON-able mirror of the exposition (the `dumps()`-style
         surface the serving snapshot already speaks)."""
+        self._run_collectors()
         snap: Dict[str, dict] = {}
         for fam in self.families():
             samples = []

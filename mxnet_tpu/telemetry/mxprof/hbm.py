@@ -1,0 +1,102 @@
+"""Per-device HBM accounting: PjRt allocator stats lifted into gauges.
+
+``storage.memory_summary`` already exposes the allocator stats; this
+module turns them into the scrapeable per-device gauges
+(``mx_hbm_used_bytes`` / ``mx_hbm_peak_bytes``) plus the optimizer-
+state share (``mx_hbm_optimizer_state_bytes``) — the number that
+proves the ZeRO-1 ~1/N state claim on a real run, not just in tests.
+
+Two sampling costs, used deliberately:
+
+  * allocator stats (``device.memory_stats()``) — one cheap runtime
+    call per device; safe at step boundaries (MXNET_MXPROF_HBM_EVERY).
+  * live-array accounting (``storage.memory_summaries(live=True)``) —
+    a scan over every live jax array; the fallback for PJRT plugins
+    (and the CPU dev box) that report no allocator stats.  Only run on
+    explicit dumps/snapshots, never per step.
+
+Peak is the allocator's own high watermark (``peak_bytes_in_use``)
+when reported; otherwise the max of what this process sampled.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from .. import instruments as _ins
+
+__all__ = ["sample", "peaks", "reset_peaks"]
+
+_lock = threading.Lock()
+_peaks: Dict[str, float] = {}  # device -> max used bytes seen here
+
+
+def _devices():
+    import jax
+
+    return jax.local_devices()
+
+
+def sample(live: bool = False,
+           state_bytes: Optional[float] = None) -> Dict[str, dict]:
+    """One HBM sample across local devices -> {device: {used_bytes,
+    peak_bytes, limit_bytes, source}}.  Updates the gauges when
+    telemetry metrics are on and always maintains the local peak
+    watermark.  ``live=True`` adds the live-array fallback scan (dump
+    path only).  ``state_bytes`` is the per-device optimizer-state
+    share, when the caller (the flight recorder's provider) knows it.
+    """
+    out: Dict[str, dict] = {}
+    try:
+        devs = _devices()
+    except Exception:  # noqa: BLE001 — no backend, nothing to sample
+        return out
+    live_by_dev: Dict[str, int] = {}
+    if live:
+        from ... import storage
+
+        for d, (n, used) in storage.memory_summaries(devs).items():
+            live_by_dev[str(d)] = used
+    for dev in devs:
+        name = str(dev)
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:  # noqa: BLE001 — plugin without stats
+            stats = {}
+        used = stats.get("bytes_in_use")
+        source = "allocator"
+        if used is None:
+            used = live_by_dev.get(name)
+            source = "live_arrays" if used is not None else "none"
+        used = float(used or 0)
+        peak = stats.get("peak_bytes_in_use")
+        with _lock:
+            prev = _peaks.get(name, 0.0)
+            watermark = max(prev, used,
+                            float(peak) if peak is not None else 0.0)
+            _peaks[name] = watermark
+        row = {"used_bytes": int(used), "peak_bytes": int(watermark),
+               "source": source}
+        limit = stats.get("bytes_limit") \
+            or stats.get("bytes_reservable_limit")
+        if limit is not None:
+            row["limit_bytes"] = int(limit)
+        out[name] = row
+        # sampling is explicit/amortized (HBM_EVERY or a dump) — the
+        # gauges update regardless of the telemetry flag, as the
+        # catalogue documents for MXNET_MXPROF=1-only jobs
+        _ins.hbm_used_bytes(name).set(used)
+        _ins.hbm_peak_bytes(name).set(watermark)
+    if state_bytes is not None:
+        _ins.hbm_optimizer_state_bytes().set(float(state_bytes))
+    return out
+
+
+def peaks() -> Dict[str, float]:
+    with _lock:
+        return dict(_peaks)
+
+
+def reset_peaks() -> None:
+    with _lock:
+        _peaks.clear()

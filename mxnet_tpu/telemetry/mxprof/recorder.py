@@ -1,0 +1,324 @@
+"""The mxprof flight recorder: a bounded ring of per-step records.
+
+Attached as the tracing layer's *sink* (``tracing.set_sink``), the
+recorder receives every finished span — always, not just while the
+profiler captures — and folds them into one record per training step:
+
+  * phase seconds (forward / backward / grad-allreduce /
+    optimizer-update / fused-update / spmd-step / reduce-scatter /
+    shard-update / all-gather, plus host-blocking collectives);
+  * data-wait preceding the step (input-bound evidence);
+  * compile events that landed inside the step (the recompile smoking
+    gun with a timestamped step number attached);
+  * collective payload bytes (fed by the SPMD/kvstore byte counters);
+  * program FLOPs (fed by the compile-cache cost capture), from which
+    MFU = flops / wall / peak and the roofline verdict follow.
+
+A record closes when the ``step`` span finishes — or, on the gspmd
+whole-step path (no enclosing ``step`` span), when the NEXT
+``spmd-step`` arrives.  The ring (``MXNET_MXPROF_RING``) bounds
+memory; ``dump()`` snapshots it on demand (SIGUSR2 does the same from
+outside), and every BENCH-style harness embeds the snapshot.
+
+Verdict semantics (deliberately simple, deliberately stable):
+``input-bound`` when data-wait dominates both halves; else
+``comm-bound`` when collective time exceeds compute time; else
+``compute-bound``; ``unattributed`` when a step carried no phases.
+On the unphased SPMD path the one fused program hides its internal
+collectives, so its verdict leans compute-bound — run a phased
+capture (tracing on) to split it.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from .. import instruments as _ins
+from .. import tracing as _tracing
+from . import costs as _costs
+
+__all__ = ["FlightRecorder"]
+
+# phases accumulated into the pending step record
+_PHASES = frozenset((
+    "forward", "backward", "grad-allreduce", "optimizer-update",
+    "fused-update", "spmd-step", "reduce-scatter", "shard-update",
+    "all-gather",
+))
+# compile events (cat "training" or "serving") counted per step
+_COMPILES = frozenset(("fused-compile", "spmd-compile", "aot-compile"))
+# the communication half of the roofline split
+_COMM = ("grad-allreduce", "reduce-scatter", "all-gather")
+# compute half: optimizer-update CONTAINS fused-update (nested spans).
+# shard-update ranks BEFORE spmd-step: the phased SPMD capture nests
+# reduce-scatter/shard-update/all-gather inside spmd-step, and taking
+# spmd-step as compute would swallow the collectives into the compute
+# half — comm-bound would be unreachable exactly when the capture
+# exists to split it.  The unphased path has only spmd-step.
+_UPDATE_PREFERENCE = ("optimizer-update", "fused-update",
+                      "shard-update", "spmd-step")
+
+
+class _Pending:
+    __slots__ = ("phases", "collectives", "data_wait", "bytes",
+                 "flops", "bytes_accessed", "compiles", "compile_s")
+
+    def __init__(self):
+        self.phases: Dict[str, float] = {}
+        self.collectives: Dict[str, float] = {}
+        self.data_wait = 0.0
+        self.bytes: Dict[str, int] = {}
+        self.flops = 0.0
+        self.bytes_accessed = 0.0
+        self.compiles = 0
+        self.compile_s = 0.0
+
+    def empty(self) -> bool:
+        return not (self.phases or self.collectives or self.bytes
+                    or self.data_wait or self.compiles or self.flops)
+
+
+class FlightRecorder:
+    """Sink + ring buffer.  All mutation under one lock — events are
+    step-scale (a handful per step), never op-scale."""
+
+    def __init__(self, ring: int = 512):
+        self._lock = threading.Lock()
+        self._ring: "deque[dict]" = deque(maxlen=max(1, int(ring)))
+        self._pending = _Pending()
+        self._step = 0
+        self._t0 = time.time()
+        self._hbm_every = 0
+        self._state_provider = None  # () -> (total_bytes, shard_factor)
+        self._peak_cache: Optional[tuple] = None
+
+    # ---- wiring ------------------------------------------------------
+
+    def set_hbm_every(self, n: int) -> None:
+        self._hbm_every = max(0, int(n))
+
+    def set_state_bytes_provider(self, fn) -> None:
+        """``fn() -> (total_state_bytes, shard_factor)`` — pulled at
+        sample/dump time (never per step), so providing costs the
+        training loop nothing."""
+        self._state_provider = fn
+
+    def _peak(self):
+        if self._peak_cache is None:
+            peak, src = _costs.peak_flops()
+            if peak is None and not _costs.backend_initialized():
+                # provisional: an early dump (SIGUSR2 before any jax
+                # work) must not pin MFU to null for the process —
+                # re-resolve once the backend is up
+                return peak, src
+            self._peak_cache = (peak, src)
+        return self._peak_cache
+
+    def _state_share(self) -> Optional[float]:
+        fn = self._state_provider
+        if fn is None:
+            return None
+        try:
+            total, factor = fn()
+        except Exception:  # noqa: BLE001 — provider must not break a dump
+            return None
+        if total is None:
+            return None
+        return float(total) / max(1, int(factor or 1))
+
+    # ---- the sink protocol (called from tracing) ---------------------
+
+    def on_event(self, name: str, cat: str, duration: float,
+                 args) -> None:
+        if name in _COMPILES:
+            with self._lock:
+                self._pending.compiles += 1
+                self._pending.compile_s += duration
+            return
+        if cat == "training":
+            if name == "step":
+                self._close(duration)
+                return
+            if name not in _PHASES:
+                return
+            with self._lock:
+                p = self._pending
+                if name == "spmd-step" and "spmd-step" in p.phases:
+                    # gspmd whole-step path: no enclosing "step" span
+                    # ever closes the record — the NEXT spmd-step is
+                    # the boundary, and the previous one's duration IS
+                    # the previous step's wall time
+                    prev = p.phases["spmd-step"]
+                    self._close_locked(prev)
+                    p = self._pending
+                p.phases[name] = p.phases.get(name, 0.0) + duration
+            return
+        if cat == "data" and name == "data-wait":
+            with self._lock:
+                self._pending.data_wait += duration
+            return
+        if cat == "collective":
+            with self._lock:
+                c = self._pending.collectives
+                c[name] = c.get(name, 0.0) + duration
+
+    def on_bytes(self, op: str, axis: str, nbytes: int) -> None:
+        key = f"{op}@{axis}"
+        with self._lock:
+            b = self._pending.bytes
+            b[key] = b.get(key, 0) + int(nbytes)
+
+    def on_flops(self, site: str, cost) -> None:
+        with self._lock:
+            self._pending.flops += cost.flops
+            self._pending.bytes_accessed += cost.bytes_accessed
+
+    # ---- record closing ----------------------------------------------
+
+    def _close(self, wall_s: float) -> None:
+        with self._lock:
+            self._close_locked(wall_s)
+
+    def _close_locked(self, wall_s: float) -> None:
+        p, self._pending = self._pending, _Pending()
+        if p.empty() and wall_s <= 0.0:
+            return
+        self._step += 1
+        # the "step" span covers the reduce+update tail only; forward/
+        # backward are sibling spans — the record's wall is the whole
+        # step.  (The gspmd one-program path has them inside its single
+        # spmd-step span already, and records no forward/backward.)
+        wall_s += (p.phases.get("forward", 0.0)
+                   + p.phases.get("backward", 0.0))
+        compute = (p.phases.get("forward", 0.0)
+                   + p.phases.get("backward", 0.0))
+        for nm in _UPDATE_PREFERENCE:
+            if nm in p.phases:
+                compute += p.phases[nm]
+                break
+        comm = p.phases.get("grad-allreduce", 0.0)
+        if comm == 0.0:
+            comm = sum(p.phases.get(nm, 0.0) for nm in _COMM[1:]) \
+                or sum(p.collectives.values())
+        if not p.phases and not p.collectives and not p.data_wait:
+            verdict = "unattributed"
+        elif p.data_wait >= max(compute, comm, 1e-12):
+            verdict = "input-bound"
+        elif comm > compute:
+            verdict = "comm-bound"
+        else:
+            verdict = "compute-bound"
+        peak, _src = self._peak()
+        mfu = None
+        if peak and p.flops and wall_s > 0:
+            mfu = p.flops / wall_s / peak
+        rec = {
+            "step": self._step,
+            "t": time.time(),
+            "wall_s": round(wall_s, 6),
+            "data_wait_s": round(p.data_wait, 6),
+            "phases": {k: round(v, 6) for k, v in
+                       sorted(p.phases.items())},
+            "collectives": {k: round(v, 6) for k, v in
+                            sorted(p.collectives.items())},
+            "collective_bytes": dict(p.bytes),
+            "flops": p.flops,
+            "bytes_accessed": p.bytes_accessed,
+            "mfu": None if mfu is None else round(mfu, 6),
+            "compiles": p.compiles,
+            "compile_s": round(p.compile_s, 6),
+            "verdict": verdict,
+        }
+        self._ring.append(rec)
+        # mxprof's OWN gauges update whenever a record closes — the
+        # docs promise them in MXNET_MXPROF=1-only mode too (metrics
+        # exposition is always on; only the telemetry flag is not).
+        # A few child writes per step, well inside the overhead gate.
+        _ins.step_last_seconds().set(wall_s)
+        _ins.step_roofline_total(verdict).inc()
+        if p.flops:
+            _ins.step_flops_total().inc(p.flops)
+        if mfu is not None:
+            _ins.step_mfu().set(mfu)
+        if self._hbm_every and self._step % self._hbm_every == 0:
+            from . import hbm as _hbm
+
+            try:
+                _hbm.sample(live=False,
+                            state_bytes=self._state_share())
+            except Exception:  # noqa: BLE001 — sampling never breaks a step
+                pass
+
+    # ---- introspection -----------------------------------------------
+
+    def records(self):
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._pending = _Pending()
+            self._step = 0
+
+    def summary(self) -> dict:
+        recs = self.records()
+        out: dict = {"steps_recorded": len(recs)}
+        if not recs:
+            return out
+        walls = [r["wall_s"] for r in recs]
+        out["wall_s_total"] = round(sum(walls), 6)
+        out["wall_s_mean"] = round(sum(walls) / len(walls), 6)
+        phases: Dict[str, float] = {}
+        nbytes: Dict[str, int] = {}
+        verdicts: Dict[str, int] = {}
+        for r in recs:
+            for k, v in r["phases"].items():
+                phases[k] = phases.get(k, 0.0) + v
+            for k, v in r["collective_bytes"].items():
+                nbytes[k] = nbytes.get(k, 0) + v
+            verdicts[r["verdict"]] = verdicts.get(r["verdict"], 0) + 1
+        out["phase_seconds"] = {k: round(v, 6)
+                                for k, v in sorted(phases.items())}
+        out["collective_bytes"] = nbytes
+        out["verdicts"] = verdicts
+        out["data_wait_s_total"] = round(
+            sum(r["data_wait_s"] for r in recs), 6)
+        out["compiles"] = sum(r["compiles"] for r in recs)
+        mfus = [r["mfu"] for r in recs if r["mfu"] is not None]
+        out["mfu_mean"] = round(sum(mfus) / len(mfus), 6) if mfus \
+            else None
+        return out
+
+    def dump_dict(self, live_hbm: bool = True,
+                  include_records: bool = True) -> dict:
+        """The full flight-recorder snapshot (what ``mxprof.dump()``
+        writes and BENCH harnesses embed).  ``include_records=False``
+        drops the per-step ring and keeps the aggregates — the shape
+        committed bench artifacts embed so they stay reviewable."""
+        from . import hbm as _hbm
+
+        peak, src = self._peak()
+        state_share = self._state_share()
+        try:
+            hbm_now = _hbm.sample(live=live_hbm,
+                                  state_bytes=state_share)
+        except Exception:  # noqa: BLE001
+            hbm_now = {}
+        out = {
+            "pid": os.getpid(),
+            "rank": _tracing._RANK,
+            "when": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "uptime_s": round(time.time() - self._t0, 3),
+            "peak_flops": {"per_device": peak, "source": src},
+            "optimizer_state_bytes_per_device": state_share,
+            "summary": self.summary(),
+            "hbm": hbm_now,
+            "executable_costs": _costs.notes(),
+        }
+        if include_records:
+            out["records"] = self.records()
+        return out

@@ -37,10 +37,22 @@ from ..util import env
 __all__ = [
     "enable", "disable", "enabled", "Span", "span", "current_span",
     "new_trace_id", "record_complete", "flow_start", "flow_end",
-    "counter_event",
+    "counter_event", "capture_active", "set_sink", "set_rank",
 ]
 
 _ENABLED = env.get_bool("MXNET_TELEMETRY")
+
+# the mxprof flight recorder (telemetry/mxprof) registers itself here;
+# a non-None sink makes spans *measure* (active() below) even with the
+# telemetry flag off and no profiler capture — that is the "always-on"
+# half of step attribution.  Instrument sites read the module global
+# directly so the disabled cost stays one predicate check.
+_SINK = None
+
+# process rank (jax.process_index), stamped into span args once known
+# (parallel.dist.init sets it) so multi-rank trace dumps can be merged
+# and attributed per rank by tools/trace_report.py --merge.
+_RANK: Optional[int] = None
 
 _span_ctx: "contextvars.ContextVar[Optional[Span]]" = \
     contextvars.ContextVar("mx_telemetry_span", default=None)
@@ -69,8 +81,34 @@ def enabled() -> bool:
 
 def active() -> bool:
     """Whether instrumentation sites should do any work at all: the
-    telemetry flag OR a running profiler capture."""
+    telemetry flag, a running profiler capture, OR an attached mxprof
+    flight recorder (which needs phase durations even when nothing
+    else is on)."""
+    return _ENABLED or _prof.is_running() or _SINK is not None
+
+
+def capture_active() -> bool:
+    """Whether a *capture* (telemetry or profiler) is on — excludes the
+    mxprof sink.  Sites whose instrumented variant changes execution
+    shape (e.g. the SPMD phased step, which serializes one program
+    into three dispatches) key on this, so an always-on flight
+    recorder never distorts what it measures."""
     return _ENABLED or _prof.is_running()
+
+
+def set_sink(sink) -> None:
+    """Attach (or detach, with None) the mxprof flight recorder.  The
+    sink receives ``on_event(name, cat, duration_s, args)`` for every
+    finished span and retroactive record, on the finishing thread."""
+    global _SINK
+    _SINK = sink
+
+
+def set_rank(r: Optional[int]) -> None:
+    """Record this process's job rank; spans emitted from here on carry
+    ``args.rank`` so per-rank dumps can be clock-aligned and merged."""
+    global _RANK
+    _RANK = None if r is None else int(r)
 
 
 def new_trace_id() -> str:
@@ -140,9 +178,20 @@ def span(name: str, cat: str = "user", trace_id: Optional[str] = None,
          parent_id: Optional[str] = None, args: Optional[dict] = None,
          metric=None):
     """`with span("forward", cat="training"): ...` — no-op (yields
-    None) when neither telemetry nor the profiler is active."""
+    None) when neither telemetry nor the profiler is active.  With only
+    the mxprof sink attached, the span is measured on a minimal path
+    (two clock reads, no Span object, no ids, no context switch) so
+    always-on attribution stays within its overhead budget."""
     if not (_ENABLED or _prof.is_running()):
-        yield None
+        snk = _SINK
+        if snk is None:
+            yield None
+            return
+        t0 = time.perf_counter()
+        try:
+            yield None
+        finally:
+            snk.on_event(name, cat, time.perf_counter() - t0, args)
         return
     s = Span(name, cat, trace_id=trace_id, parent_id=parent_id,
              args=args, metric=metric).attach()
@@ -162,7 +211,13 @@ def record_complete(name: str, cat: str, t0: float, duration: float,
                     parent_id: Optional[str] = None,
                     args: Optional[dict] = None) -> None:
     """Append one already-measured X event (used for retroactive spans
-    like queue-wait, where the start is a stored timestamp)."""
+    like queue-wait, where the start is a stored timestamp).  The
+    mxprof sink — when attached — sees every event regardless of the
+    profiler capture window: that is what makes the flight recorder
+    always-on."""
+    snk = _SINK
+    if snk is not None:
+        snk.on_event(name, cat, duration, args)
     if not _prof.is_running():
         return
     a = dict(args) if args else {}
@@ -172,6 +227,8 @@ def record_complete(name: str, cat: str, t0: float, duration: float,
         a["span_id"] = span_id
     if parent_id is not None:
         a["parent_id"] = parent_id
+    if _RANK is not None:
+        a["rank"] = _RANK
     ev = {"name": name, "ph": "X", "cat": cat, "ts": t0 * 1e6,
           "dur": duration * 1e6, "pid": os.getpid(),
           "tid": threading.get_ident()}

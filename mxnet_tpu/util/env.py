@@ -310,6 +310,30 @@ declare("MXNET_TELEMETRY", bool, False,
         "Enable telemetry span tracing at import (metrics are always "
         "on; this turns on trace-event emission — see "
         "docs/observability.md).")
+declare("MXNET_MXPROF", bool, False,
+        "Enable the mxprof flight recorder at import: an always-on "
+        "(not capture-window-gated) ring buffer of per-step "
+        "attribution records — phase seconds, collective bytes, "
+        "data-wait, compile events, MFU, HBM. telemetry.enable() also "
+        "engages it; dump via mxprof.dump() or SIGUSR2. See "
+        "docs/observability.md (mxprof).")
+declare("MXNET_MXPROF_RING", int, 512,
+        "mxprof flight-recorder capacity: the last N step records are "
+        "kept in a bounded ring; older steps fall off. Memory is flat "
+        "no matter how long the job runs.")
+declare("MXNET_MXPROF_HBM_EVERY", int, 0,
+        "Sample per-device HBM allocator stats every N closed step "
+        "records (0 = only on dump/snapshot). Allocator stats are one "
+        "cheap PjRt call; the live-array fallback scan only runs on "
+        "explicit dumps.")
+declare("MXNET_MXPROF_DUMP", str, "",
+        "Path the SIGUSR2 handler writes the mxprof flight-recorder "
+        "dump to. Empty = mxprof-<pid>.json in the working directory.")
+declare("MXNET_PEAK_FLOPS", float, None,
+        "Per-device peak FLOP/s used as the MFU denominator "
+        "(mx_step_mfu). Unset = resolved from the device kind table "
+        "(known TPU generations); unknown devices report MFU as null "
+        "rather than a made-up ratio.")
 
 # -- init / test harness ----------------------------------------------------
 declare("MXNET_TEST_DEFAULT_CONTEXT", str, "",

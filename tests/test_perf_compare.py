@@ -1,0 +1,156 @@
+"""tools/perf_compare.py (ISSUE 10 satellite): the nightly bench-JSON
+regression gate — >10% throughput drop or a new trace-integrity
+failure vs the committed artifacts fails the run."""
+import importlib.util
+import json
+import os
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "perf_compare_under_test",
+        os.path.join(_REPO, "tools", "perf_compare.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+pc = _load()
+
+
+def _scaling(tp2=1.28, check_ok=True, parity_ok=True):
+    return {"sweep": [
+        {"path": "spmd", "processes": 1, "global_throughput": 1.0,
+         "trace_check_ok": True,
+         "merged_trace": {"check_ok": check_ok}},
+        {"path": "spmd", "processes": 2, "global_throughput": tp2},
+    ], "parity": {"ok": parity_ok}}
+
+
+class TestCompareArtifact:
+    def test_within_tolerance_ok(self):
+        res = pc.compare_artifact("SCALING.json", _scaling(1.28),
+                                  _scaling(1.20), tolerance=0.10)
+        assert res["ok"] and not res["regressions"]
+
+    def test_throughput_regression_fails(self):
+        res = pc.compare_artifact("SCALING.json", _scaling(1.28),
+                                  _scaling(1.0), tolerance=0.10)
+        assert not res["ok"]
+        assert "global_throughput" in res["regressions"][0]
+
+    def test_improvement_never_fails(self):
+        res = pc.compare_artifact("SCALING.json", _scaling(1.0),
+                                  _scaling(10.0), tolerance=0.10)
+        assert res["ok"]
+
+    def test_new_integrity_failure_fails(self):
+        res = pc.compare_artifact("SCALING.json", _scaling(),
+                                  _scaling(check_ok=False),
+                                  tolerance=0.10)
+        assert not res["ok"]
+        assert "merged_trace.check_ok" in \
+            res["new_integrity_failures"][0]
+
+    def test_preexisting_false_is_not_new(self):
+        res = pc.compare_artifact("SCALING.json",
+                                  _scaling(check_ok=False),
+                                  _scaling(check_ok=False),
+                                  tolerance=0.10)
+        assert res["ok"]
+
+    def test_fresh_only_check_lane_still_gates(self):
+        base = _scaling()
+        del base["parity"]
+        res = pc.compare_artifact("SCALING.json", base,
+                                  _scaling(parity_ok=False),
+                                  tolerance=0.10)
+        assert not res["ok"]
+        assert "parity.ok" in res["new_integrity_failures"][0]
+
+    def test_metric_only_on_one_side_skipped(self):
+        base = {"sweep": [{"path": "spmd", "processes": 4,
+                           "global_throughput": 9.0}]}
+        res = pc.compare_artifact("SCALING.json", base, _scaling(),
+                                  tolerance=0.10)
+        assert res["ok"] and res["metrics"] == []
+
+    def test_fused_and_compile_cache_extractors(self):
+        fused_b = {"sizes": {"100": {"speedup": 2.3}}}
+        fused_f = {"sizes": {"100": {"speedup": 1.5}}}
+        res = pc.compare_artifact("FUSED_BENCH.json", fused_b, fused_f,
+                                  tolerance=0.10)
+        assert not res["ok"]
+        cc_b = {"serving": {"speedup": 4.0}, "fused": {"speedup": 4.0},
+                "gate_ok": True}
+        cc_f = {"serving": {"speedup": 3.9}, "fused": {"speedup": 3.8},
+                "gate_ok": False}
+        res = pc.compare_artifact("COMPILE_CACHE.json", cc_b, cc_f,
+                                  tolerance=0.10)
+        assert not res["ok"]
+        assert "gate_ok" in res["new_integrity_failures"][0]
+
+    def test_serving_extractor(self):
+        b = {"unbatched": {"qps": 588.7}, "batched": {"qps": 987.9},
+             "batched_over_unbatched": 1.68}
+        f = {"unbatched": {"qps": 600.0}, "batched": {"qps": 700.0},
+             "batched_over_unbatched": 1.17}
+        res = pc.compare_artifact("SERVING_BENCH.json", b, f,
+                                  tolerance=0.10)
+        assert not res["ok"]
+        names = [r["metric"] for r in res["metrics"]
+                 if r.get("regression")]
+        assert "batched.qps" in names
+
+
+class TestCli:
+    def _dirs(self, tmp_path, base, fresh):
+        bd, fd = tmp_path / "base", tmp_path / "fresh"
+        bd.mkdir(), fd.mkdir()
+        for d, payload in ((bd, base), (fd, fresh)):
+            for name, doc in payload.items():
+                (d / name).write_text(json.dumps(doc))
+        return str(bd), str(fd)
+
+    def test_clean_run_rc0_and_report(self, tmp_path):
+        bd, fd = self._dirs(tmp_path,
+                            {"SCALING.json": _scaling()},
+                            {"SCALING.json": _scaling(1.25)})
+        out = str(tmp_path / "rep.json")
+        rc = pc.main(["--baseline-dir", bd, "--fresh-dir", fd,
+                      "--artifacts", "SCALING.json", "--out", out])
+        assert rc == 0
+        rep = json.load(open(out))
+        assert rep["ok"] and "SCALING.json" in rep["artifacts"]
+
+    def test_regression_rc1(self, tmp_path):
+        bd, fd = self._dirs(tmp_path,
+                            {"SCALING.json": _scaling()},
+                            {"SCALING.json": _scaling(0.5)})
+        assert pc.main(["--baseline-dir", bd, "--fresh-dir", fd,
+                        "--artifacts", "SCALING.json"]) == 1
+
+    def test_missing_artifact_skips_not_fails(self, tmp_path):
+        bd, fd = self._dirs(tmp_path, {},
+                            {"SCALING.json": _scaling()})
+        out = str(tmp_path / "rep.json")
+        rc = pc.main(["--baseline-dir", bd, "--fresh-dir", fd,
+                      "--artifacts", "SCALING.json", "--out", out])
+        assert rc == 0
+        assert json.load(open(out))["artifacts"]["SCALING.json"][
+            "skipped"]
+
+    def test_unknown_artifact_usage_error(self):
+        assert pc.main(["--artifacts", "NOPE.json"]) == 2
+
+    def test_git_baseline_against_head(self):
+        """The nightly invocation shape: committed artifacts vs the
+        work tree.  Committed == work tree unless a bench just ran, so
+        this asserts the plumbing, not a verdict."""
+        rc = pc.main(["--ref", "HEAD", "--fresh-dir", _REPO,
+                      "--artifacts", "FUSED_BENCH.json"])
+        assert rc in (0, 1)

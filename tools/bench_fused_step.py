@@ -146,6 +146,12 @@ def main():
                          "(tier-1 CLI smoke lane)")
     args = ap.parse_args()
 
+    # always-on attribution rides along (its 3% budget is tier-1
+    # gated, so it cannot skew the eager-vs-fused ratio): the report
+    # embeds the aggregate flight-recorder snapshot
+    from mxnet_tpu.telemetry import mxprof
+    mxprof.enable()
+
     sizes = [int(s) for s in args.params.split(",") if s]
     report = {
         "metric": "fused_step_speedup",
@@ -173,6 +179,8 @@ def main():
     report["gate_params"] = gate_n
     report["speedup_at_gate"] = gate_row["speedup"]
     report["min_speedup"] = args.min_speedup
+    report["mxprof"] = mxprof.snapshot(live_hbm=True,
+                                       include_records=False)
 
     with open(args.out, "w") as f:
         json.dump(report, f, indent=1)

@@ -202,6 +202,11 @@ def main():
         "batched": phases["batched"],
         "batched_over_unbatched": round(speedup, 3) if speedup else None,
     }
+    # aggregate mxprof snapshot: executable costs of the bucket
+    # programs + HBM watermark ride with the committed artifact
+    from mxnet_tpu.telemetry import mxprof
+    report["mxprof"] = mxprof.snapshot(live_hbm=True,
+                                       include_records=False)
     with open(args.out, "w") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report))

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Regenerate (or verify) the metric catalogue in docs/observability.md.
+
+Every metric family the framework can emit is DECLARED once in
+`mxnet_tpu/telemetry/instruments.py` (`_SPECS`); the table between the
+`metric-catalog` markers in docs/observability.md is GENERATED from
+those declarations — the same registry-then-docs contract `util/env.py`
+keeps for `env_vars.md` via `tools/mxlint.py --env-docs`.
+
+    python tools/gen_metric_docs.py           # check (exit 1 on drift)
+    python tools/gen_metric_docs.py --write   # rewrite the table
+
+A tier-1 sync test (tests/test_mxprof.py) runs the check, so a PR that
+adds an instrument cannot ship with a stale table.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--write", action="store_true",
+                    help="rewrite the generated block in place")
+    ap.add_argument("--path", default=None,
+                    help="docs file (default: docs/observability.md)")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from mxnet_tpu.telemetry import catalog
+
+    try:
+        ok, _ = catalog.apply_block(args.path, write=args.write)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if ok:
+        print("metric catalogue in sync")
+        return 0
+    if args.write:
+        print("metric catalogue regenerated")
+        return 0
+    print("metric catalogue OUT OF SYNC — run "
+          "`python tools/gen_metric_docs.py --write`", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

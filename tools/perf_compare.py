@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Perf-regression gate: diff freshly produced bench JSONs against the
+committed ones.
+
+The nightly refreshes the tracked bench artifacts (FUSED_BENCH.json,
+SCALING.json, SERVING_BENCH.json, COMPILE_CACHE.json) in the work
+tree; this tool compares each against the version committed at --ref
+(``git show REF:NAME``) and fails on
+
+  * a **throughput regression**: any tracked higher-is-better metric
+    (speedups, qps, samples/s) dropping more than ``--tolerance``
+    (default 10%) below its committed value, or
+  * a **new trace-integrity failure**: any ``trace_check_ok`` /
+    ``merged_trace.check_ok`` / ``parity.ok`` / ``gate_ok`` verdict
+    that was true in the committed artifact and is false in the fresh
+    one (a verdict already false at the baseline is pre-existing, not
+    new).
+
+Artifacts missing on either side are reported and skipped — a bench
+stage that timed out must fail the nightly through its own return
+code, not by making the diff un-runnable.  ``--baseline-dir`` swaps
+the git baseline for a directory of files (what the tests use).
+
+    python tools/perf_compare.py                      # HEAD vs work tree
+    python tools/perf_compare.py --tolerance 0.15 --out PERF_COMPARE.json
+    python tools/perf_compare.py --baseline-dir /tmp/old --fresh-dir .
+
+Exit: 0 clean, 1 regression / new integrity failure, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_ARTIFACTS = ("FUSED_BENCH.json", "SCALING.json",
+                     "SERVING_BENCH.json", "COMPILE_CACHE.json")
+
+
+# ---------------------------------------------------------------------------
+# per-artifact extractors: dict -> (higher_is_better metrics, bool checks)
+# ---------------------------------------------------------------------------
+
+def _fused(d) -> Tuple[Dict[str, float], Dict[str, bool]]:
+    m = {}
+    for n, row in d.get("sizes", {}).items():
+        if "speedup" in row:
+            m[f"sizes.{n}.speedup"] = row["speedup"]
+    return m, {}
+
+
+def _serving(d) -> Tuple[Dict[str, float], Dict[str, bool]]:
+    m = {}
+    for mode in ("unbatched", "batched"):
+        row = d.get(mode) or {}
+        if "qps" in row:
+            m[f"{mode}.qps"] = row["qps"]
+    if "batched_over_unbatched" in d:
+        m["batched_over_unbatched"] = d["batched_over_unbatched"]
+    return m, {}
+
+
+def _compile_cache(d) -> Tuple[Dict[str, float], Dict[str, bool]]:
+    m = {}
+    for site in ("serving", "fused"):
+        row = d.get(site) or {}
+        if "speedup" in row:
+            m[f"{site}.speedup"] = row["speedup"]
+    c = {}
+    if "gate_ok" in d:
+        c["gate_ok"] = bool(d["gate_ok"])
+    return m, c
+
+
+def _scaling(d) -> Tuple[Dict[str, float], Dict[str, bool]]:
+    m, c = {}, {}
+    for r in d.get("sweep", []):
+        key = f"{r.get('path', '?')}.{r.get('processes', '?')}proc"
+        if "global_throughput" in r:
+            m[f"{key}.global_throughput"] = r["global_throughput"]
+        if "trace_check_ok" in r:
+            c[f"{key}.trace_check_ok"] = bool(r["trace_check_ok"])
+        mt = r.get("merged_trace")
+        if isinstance(mt, dict) and "check_ok" in mt:
+            c[f"{key}.merged_trace.check_ok"] = bool(mt["check_ok"])
+    p = d.get("parity")
+    if isinstance(p, dict) and "ok" in p:
+        c["parity.ok"] = bool(p["ok"])
+    return m, c
+
+
+EXTRACTORS = {
+    "FUSED_BENCH.json": _fused,
+    "SERVING_BENCH.json": _serving,
+    "COMPILE_CACHE.json": _compile_cache,
+    "SCALING.json": _scaling,
+}
+
+
+# ---------------------------------------------------------------------------
+# comparison
+# ---------------------------------------------------------------------------
+
+def compare_artifact(name: str, base: dict, fresh: dict,
+                     tolerance: float) -> dict:
+    """One artifact's verdict: metric deltas + integrity transitions.
+    Only metrics present on BOTH sides gate (a renamed/new lane has no
+    baseline to regress from)."""
+    extract = EXTRACTORS[name]
+    bm, bc = extract(base)
+    fm, fc = extract(fresh)
+    regressions, rows = [], []
+    for k in sorted(set(bm) & set(fm)):
+        b, f = float(bm[k]), float(fm[k])
+        ratio = (f / b) if b else None
+        row = {"metric": k, "baseline": b, "fresh": f,
+               "ratio": None if ratio is None else round(ratio, 4)}
+        if b > 0 and f < b * (1.0 - tolerance):
+            row["regression"] = True
+            regressions.append(
+                f"{name}: {k} {b:g} -> {f:g} "
+                f"({(1 - f / b) * 100:.1f}% drop > "
+                f"{tolerance * 100:.0f}% tolerance)")
+        rows.append(row)
+    new_failures = []
+    for k in sorted(set(bc) & set(fc)):
+        if bc[k] and not fc[k]:
+            new_failures.append(f"{name}: {k} was true at baseline, "
+                                f"false in the fresh run")
+    # a check lane that only exists fresh (e.g. first --phases run)
+    # still hard-fails when false: integrity is never grandfathered in
+    for k in sorted(set(fc) - set(bc)):
+        if not fc[k]:
+            new_failures.append(f"{name}: {k} false in the fresh run "
+                                f"(no baseline)")
+    return {"metrics": rows, "regressions": regressions,
+            "new_integrity_failures": new_failures,
+            "ok": not regressions and not new_failures}
+
+
+def _load_git(ref: str, name: str, repo: str):
+    p = subprocess.run(["git", "-C", repo, "show", f"{ref}:{name}"],
+                       capture_output=True, text=True, timeout=60)
+    if p.returncode != 0:
+        return None, f"not in {ref}"
+    try:
+        return json.loads(p.stdout), None
+    except ValueError as e:
+        return None, f"unparsable at {ref}: {e}"
+
+
+def _load_file(path: str):
+    if not os.path.exists(path):
+        return None, "missing"
+    try:
+        with open(path) as f:
+            return json.load(f), None
+    except (OSError, ValueError) as e:
+        return None, str(e)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fail on bench-JSON throughput regressions vs the "
+                    "committed artifacts")
+    ap.add_argument("--artifacts",
+                    default=",".join(DEFAULT_ARTIFACTS),
+                    help="comma-separated artifact names to diff")
+    ap.add_argument("--ref", default="HEAD",
+                    help="git ref the committed baseline is read from")
+    ap.add_argument("--baseline-dir", default=None,
+                    help="read baselines from this directory instead "
+                         "of git (tests)")
+    ap.add_argument("--fresh-dir", default=_REPO,
+                    help="directory holding the freshly produced "
+                         "artifacts (default: repo root)")
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="max tolerated fractional throughput drop "
+                         "(default 0.10 = 10%%)")
+    ap.add_argument("--out", default=None,
+                    help="write the comparison report JSON here")
+    args = ap.parse_args(argv)
+
+    names = [n.strip() for n in args.artifacts.split(",") if n.strip()]
+    unknown = [n for n in names if n not in EXTRACTORS]
+    if unknown:
+        print(f"error: no extractor for {unknown} "
+              f"(known: {sorted(EXTRACTORS)})", file=sys.stderr)
+        return 2
+
+    report = {"ref": args.ref if args.baseline_dir is None
+              else args.baseline_dir,
+              "tolerance": args.tolerance, "artifacts": {}, "ok": True}
+    failures = []
+    for name in names:
+        fresh, ferr = _load_file(os.path.join(args.fresh_dir, name))
+        if args.baseline_dir is not None:
+            base, berr = _load_file(os.path.join(args.baseline_dir,
+                                                 name))
+        else:
+            base, berr = _load_git(args.ref, name, args.fresh_dir)
+        if base is None or fresh is None:
+            report["artifacts"][name] = {
+                "skipped": True,
+                "reason": f"baseline: {berr or 'ok'}; "
+                          f"fresh: {ferr or 'ok'}"}
+            continue
+        res = compare_artifact(name, base, fresh, args.tolerance)
+        report["artifacts"][name] = res
+        failures += res["regressions"] + res["new_integrity_failures"]
+    report["ok"] = not failures
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    for msg in failures:
+        print(f"PERF GATE FAIL: {msg}", file=sys.stderr)
+    compared = [n for n, r in report["artifacts"].items()
+                if not r.get("skipped")]
+    skipped = [n for n, r in report["artifacts"].items()
+               if r.get("skipped")]
+    print(f"perf_compare: {len(compared)} artifact(s) compared"
+          + (f", {len(skipped)} skipped ({', '.join(skipped)})"
+             if skipped else "")
+          + f" — {'OK' if report['ok'] else f'{len(failures)} failure(s)'}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

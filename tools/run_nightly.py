@@ -391,6 +391,46 @@ def main():
         artifact["heavy_integration"] = {"returncode": -1,
                                          "note": "timed out"}
 
+    # mxprof stage (ISSUE 10): the slow attribution tests (anything
+    # spawning worker processes — the scaling_bench --phases e2e) run
+    # here; tier-1 keeps the fast unit/gate coverage
+    mxprof_rc = None
+    try:
+        mp = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests/test_mxprof.py",
+             "-q", "-m", "slow", "-p", "no:cacheprovider"],
+            capture_output=True, text=True, timeout=900, cwd=_REPO,
+            env=cpu_env)
+        mxprof_rc = mp.returncode
+        artifact["mxprof"] = {
+            "returncode": mp.returncode,
+            "tail": "\n".join(mp.stdout.splitlines()[-1:])}
+    except subprocess.TimeoutExpired:
+        mxprof_rc = -1
+        artifact["mxprof"] = {"returncode": -1, "note": "timed out"}
+
+    # perf-compare gate (ISSUE 10): the bench artifacts this nightly
+    # just refreshed (FUSED/SCALING/COMPILE_CACHE; SERVING when its
+    # strict lane rewrote it) vs the committed versions — >10%
+    # throughput drop or a NEW trace-integrity failure fails the run.
+    # Runs LAST so every refresh above has landed in the work tree.
+    perf_rc = None
+    try:
+        pcr = subprocess.run(
+            [sys.executable, "tools/perf_compare.py", "--ref", "HEAD",
+             "--out", os.path.join(_REPO, "PERF_COMPARE.json")],
+            capture_output=True, text=True, timeout=120, cwd=_REPO,
+            env=cpu_env)
+        perf_rc = pcr.returncode
+        artifact["perf_compare"] = {
+            "returncode": pcr.returncode,
+            "tail": "\n".join(pcr.stdout.splitlines()[-1:]),
+            "stderr_tail": "\n".join(pcr.stderr.splitlines()[-8:])}
+    except subprocess.TimeoutExpired:
+        perf_rc = -1
+        artifact["perf_compare"] = {"returncode": -1,
+                                    "note": "timed out"}
+
     artifact["duration_s"] = round(time.time() - t0, 1)  # incl. gate
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=1)
@@ -400,7 +440,8 @@ def main():
         and fused_rc in (None, 0) and trace_rc in (None, 0) \
         and mxlint_rc in (None, 0) and san_rc in (None, 0) \
         and resil_rc in (None, 0) and cc_rc in (None, 0) \
-        and spmd_rc in (None, 0) and heavy_rc in (None, 0) else 1
+        and spmd_rc in (None, 0) and heavy_rc in (None, 0) \
+        and mxprof_rc in (None, 0) and perf_rc in (None, 0) else 1
 
 
 if __name__ == "__main__":

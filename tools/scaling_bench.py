@@ -57,10 +57,13 @@ import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
 
-_PHASE_NAMES = ("grad-allreduce", "optimizer-update", "fused-update",
-                "spmd-step", "reduce-scatter", "shard-update",
-                "all-gather")
+_PHASE_NAMES = ("forward", "backward", "grad-allreduce",
+                "optimizer-update", "fused-update", "spmd-step",
+                "reduce-scatter", "shard-update", "all-gather")
 
 
 def _free_port():
@@ -148,24 +151,42 @@ def _build_model(args, rng, bs_global):
     raise SystemExit(f"unknown model {args.model}")
 
 
-def _phase_report():
-    """Per-phase wall seconds + collective bytes from the telemetry
-    registry (populated by the step spans when --phases is on)."""
-    from mxnet_tpu.telemetry import metrics
+def _phase_report(trace_path):
+    """Per-phase wall seconds from this rank's trace dump — consumed
+    through tools/trace_report.py's machine-readable report (the same
+    `--json` document, integrity verdict included) instead of a
+    parallel metrics-table parse — plus collective bytes, per-step
+    MFU, and peak HBM per device from the mxprof flight recorder."""
+    import trace_report as tr
+    from mxnet_tpu.telemetry import mxprof
 
-    snap = metrics.get_registry().snapshot()
+    rep = tr.report_json(tr.load_trace(trace_path))
     phases = {}
-    fam = snap.get("mx_training_phase_seconds", {})
-    for s in fam.get("samples", []):
-        ph = s["labels"].get("phase")
-        if ph in _PHASE_NAMES and s["count"]:
-            phases[ph] = {"seconds": round(s["sum"], 4),
-                          "count": s["count"]}
-    out = {"phase_seconds": phases, "collective_bytes": {}}
-    fam = snap.get("mx_collective_bytes_total", {})
-    for s in fam.get("samples", []):
-        key = "{op}@{axis}".format(**s["labels"])
-        out["collective_bytes"][key] = int(s["value"])
+    for row in rep["phases"]:
+        if row["cat"] == "training" and row["name"] in _PHASE_NAMES \
+                and row["count"]:
+            phases[row["name"]] = {
+                "seconds": round(row["total_ms"] / 1e3, 4),
+                "count": row["count"]}
+    snap = mxprof.snapshot(live_hbm=True)
+    recs = snap["records"]
+    mfus = [r["mfu"] for r in recs]
+    out = {
+        "phase_seconds": phases,
+        "trace_check_ok": rep["check"]["ok"],
+        "collective_bytes": snap["summary"].get("collective_bytes", {}),
+        "mfu": {
+            "per_step": mfus,
+            "mean": snap["summary"].get("mfu_mean"),
+            "peak_flops": snap["peak_flops"],
+        },
+        "hbm_peak_bytes": {dev: row["peak_bytes"]
+                           for dev, row in snap["hbm"].items()},
+        "verdicts": snap["summary"].get("verdicts", {}),
+    }
+    state = snap.get("optimizer_state_bytes_per_device")
+    if state:
+        out["optimizer_state_bytes_per_device"] = state
     return out
 
 
@@ -214,14 +235,18 @@ def worker(args):
                                                          bs_global)
 
     if args.path == "gspmd":
-        lval, dt = _run_gspmd(args, mx, parallel, net, data, label,
-                              loss, opt, opt_args, n_dev)
+        lval, dt, trace = _run_gspmd(args, mx, parallel, net, data,
+                                     label, loss, opt, opt_args, n_dev,
+                                     rank)
     else:
-        lval, dt = _run_trainer(args, mx, net, data, label, loss, opt,
-                                opt_args, bs_global, n_proc, rank,
-                                n_local)
+        lval, dt, trace = _run_trainer(args, mx, net, data, label,
+                                       loss, opt, opt_args, bs_global,
+                                       n_proc, rank, n_local)
 
     tp = bs_global * args.steps / dt
+    # only rank 0 reports; the live-array HBM scan + trace parse in
+    # _phase_report is pure waste on the other ranks
+    phase_rep = _phase_report(trace) if trace and rank == 0 else None
     if rank == 0:
         row = {
             "model": args.model, "path": args.path,
@@ -232,31 +257,48 @@ def worker(args):
             "per_device_throughput": round(tp / n_dev, 2),
             "unit": "samples/s", "loss": round(lval, 4),
         }
-        if args.phases:
-            row.update(_phase_report())
+        if phase_rep:
+            row.update(phase_rep)
         print(json.dumps(row), flush=True)
     return 0
 
 
-def _attribution_steps(args, one_step):
-    """--phases: run a couple of EXTRA traced steps AFTER the timed
-    window — the phased SPMD variant and the span bookkeeping must
-    never distort the throughput/efficiency numbers the sweep gates
-    on (tracing serializes the step into per-phase dispatches)."""
+def _attribution_steps(args, one_step, rank):
+    """--phases: run a couple of EXTRA traced+profiled steps AFTER the
+    timed window — the phased SPMD variant and the span bookkeeping
+    must never distort the throughput/efficiency numbers the sweep
+    gates on (tracing serializes the step into per-phase dispatches).
+    Every rank dumps its own trace (for the parent's multi-rank merge)
+    and keeps the mxprof flight recorder attached for the MFU/HBM
+    numbers the row reports.  Returns this rank's trace path."""
     if not args.phases:
-        return
-    from mxnet_tpu.telemetry import tracing
+        return None
+    import tempfile
 
-    tracing.enable()
+    from mxnet_tpu import profiler, telemetry
+    from mxnet_tpu.telemetry import mxprof
+
+    telemetry.enable()  # span tracing + metrics + the mxprof recorder
+    mxprof.clear()      # attribute ONLY the steps below
+    profiler.start()
     try:
         for _ in range(2):
             one_step()
     finally:
-        tracing.disable()
+        profiler.stop()
+        telemetry.disable()
+    if args.trace_dir:
+        path = os.path.join(args.trace_dir, f"trace_rank{rank}.json")
+    else:
+        fd, path = tempfile.mkstemp(prefix="mx_scaling_trace_",
+                                    suffix=".json")
+        os.close(fd)
+    profiler.dump(finished=True, filename=path)
+    return path
 
 
 def _run_gspmd(args, mx, parallel, net, data, label, loss, opt,
-               opt_args, n_dev):
+               opt_args, n_dev, rank):
     import time as _t
 
     mesh = parallel.make_mesh(dp=n_dev)
@@ -273,9 +315,9 @@ def _run_gspmd(args, mx, parallel, net, data, label, loss, opt,
             lv = trainer.step(*placed)
         lval = float(lv.asnumpy())
         dt = _t.perf_counter() - t0
-        _attribution_steps(args,
-                           lambda: trainer.step(*placed).asnumpy())
-    return lval, dt
+        trace = _attribution_steps(
+            args, lambda: trainer.step(*placed).asnumpy(), rank)
+    return lval, dt, trace
 
 
 def _run_trainer(args, mx, net, data, label, loss_fn, opt, opt_args,
@@ -321,8 +363,8 @@ def _run_trainer(args, mx, net, data, label, loss_fn, opt, opt_args,
     local_sum = float(l.asnumpy().sum())
     dt = _t.perf_counter() - t0
     gsum = float(dist.allgather_np(np.asarray(local_sum)).sum())
-    _attribution_steps(args, lambda: one_step().asnumpy())
-    return gsum / bs_global, dt
+    trace = _attribution_steps(args, lambda: one_step().asnumpy(), rank)
+    return gsum / bs_global, dt, trace
 
 
 # ---------------------------------------------------------------------------
@@ -330,7 +372,12 @@ def _run_trainer(args, mx, net, data, label, loss_fn, opt, opt_args,
 # ---------------------------------------------------------------------------
 
 def _spawn_sweep(args, n):
+    import shutil
+    import tempfile
+
     port = str(_free_port())
+    trace_dir = tempfile.mkdtemp(prefix="mx_scaling_traces_") \
+        if args.phases else None
     procs = []
     for i in range(n):
         env = dict(os.environ)
@@ -340,6 +387,12 @@ def _spawn_sweep(args, n):
         env.update({"DMLC_ROLE": "worker", "DMLC_PS_ROOT_URI": "127.0.0.1",
                     "DMLC_PS_ROOT_PORT": port, "DMLC_NUM_WORKER": str(n),
                     "DMLC_WORKER_ID": str(i)})
+        if args.phases:
+            # dev-box MFU denominator: without a real accelerator the
+            # peak is unknowable — a nominal 1e12 keeps the MFU
+            # plumbing exercised; the row records the source as "env"
+            # so nobody mistakes it for hardware utilization
+            env.setdefault("MXNET_PEAK_FLOPS", "1e12")
         cmd = [sys.executable, os.path.abspath(__file__), "--_worker",
                "--model", args.model, "--path", args.path,
                "--steps", str(args.steps),
@@ -351,6 +404,8 @@ def _spawn_sweep(args, n):
                "--global-batch", str(args.global_batch)]
         if args.phases:
             cmd.append("--phases")
+        if trace_dir:
+            cmd += ["--trace-dir", trace_dir]
         procs.append(subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                                       stderr=subprocess.STDOUT, text=True))
     line = None
@@ -369,7 +424,46 @@ def _spawn_sweep(args, n):
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    return json.loads(line)
+    row = json.loads(line)
+    if trace_dir:
+        try:
+            row.update(_merge_rank_traces(args, trace_dir, n))
+        finally:
+            if args.keep_traces:
+                print(f"rank traces kept in {trace_dir}",
+                      file=sys.stderr)
+            else:
+                shutil.rmtree(trace_dir, ignore_errors=True)
+    return row
+
+
+def _merge_rank_traces(args, trace_dir, n):
+    """Clock-align + merge every rank's attribution trace and run the
+    integrity gate on the result (trace_report --merge --check
+    semantics, via the shared merge_loaded pipeline).  The merged
+    trace lands next to --out for multi-rank runs so a regression can
+    be inspected in Perfetto."""
+    import glob
+
+    import trace_report as tr
+
+    paths = sorted(glob.glob(os.path.join(trace_dir, "trace_rank*.json")))
+    if not paths:
+        return {}
+    loaded = [tr.load_trace(p) for p in paths]
+    dst = os.path.splitext(args.out)[0] + f"_trace_{n}proc.json" \
+        if len(loaded) > 1 and args.out else None
+    merged, info, errs = tr.merge_loaded(loaded, out=dst)
+    out = {"merged_trace": {
+        "ranks": len(loaded), "events": len(merged),
+        "check_ok": not errs,
+        "violations": errs[:5],
+        "offsets_us": info["offsets_us"],
+        "skew_top": info["skew"][:5],
+    }}
+    if dst:
+        out["merged_trace"]["path"] = os.path.basename(dst)
+    return out
 
 
 def _parity_stage(args, counts):
@@ -434,6 +528,11 @@ def main():
                          "process counts")
     ap.add_argument("--proc-timeout", type=float, default=900.0)
     ap.add_argument("--out", default=os.path.join(_REPO, "SCALING.json"))
+    ap.add_argument("--keep-traces", action="store_true",
+                    help="with --phases: keep each run's per-rank "
+                         "trace dir instead of deleting it after the "
+                         "merge")
+    ap.add_argument("--trace-dir", default="", help=argparse.SUPPRESS)
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     if args.spmd:

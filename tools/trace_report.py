@@ -12,6 +12,20 @@ total/mean/min/max milliseconds, and share of trace wall time.
     python tools/trace_report.py trace.json --json     # machine-readable
     python tools/trace_report.py trace.json --check    # integrity gate
     python tools/trace_report.py --selftest            # generate+check
+    python tools/trace_report.py --merge r0.json r1.json \
+        [--out merged.json]                            # multi-rank
+
+`--json` carries the integrity verdict alongside the per-phase rows,
+so harness consumers (scaling_bench --phases) read ONE machine
+format instead of re-parsing the table.
+
+`--merge` folds per-rank dumps (one profiler dump per process of an
+SPMD job) into a single trace: per-rank clocks are aligned on the
+collective spans — a blocking collective completes (nearly)
+simultaneously on every rank, so matching occurrences pin the offset
+— events are shifted onto rank 0's clock and re-homed to pid=rank,
+and a cross-rank per-phase table with straggler/skew columns is
+printed.  The merged trace passes `--check`.
 
 `--check` validates trace integrity (the nightly lane runs it via
 `--selftest`): the JSON parses, every event carries name/ph/ts/pid,
@@ -30,6 +44,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+from typing import Optional
 from collections import defaultdict
 
 # counter-lane suffixes that are cumulative (monotone non-decreasing);
@@ -109,6 +124,9 @@ def render_table(events: list) -> str:
 
 
 def report_json(events: list) -> dict:
+    """Machine-readable summary: per-phase rows + the integrity
+    verdict (what `--check` would have said) in one document."""
+    errs = check_events(events)
     return {
         "phases": [
             {"cat": cat, "name": name, "count": n,
@@ -118,6 +136,7 @@ def report_json(events: list) -> dict:
             for cat, name, n, tot, mean, mn, mx, share
             in phase_rows(events)],
         "num_events": len(events),
+        "check": {"ok": not errs, "violations": errs},
     }
 
 
@@ -148,17 +167,23 @@ def check_events(events: list) -> list:
         if ph == "C" and isinstance(args, dict):
             for lane, v in args.items():
                 if isinstance(v, (int, float)):
-                    counters[lane].append((ev.get("ts", 0.0), v))
+                    # keyed per process: in a merged multi-rank trace
+                    # each rank keeps its OWN cumulative lanes, and
+                    # clock-shifted cross-rank interleaving must not
+                    # read as a decrease
+                    counters[(ev.get("pid"), lane)].append(
+                        (ev.get("ts", 0.0), v))
     # counter lanes expected monotone
-    for lane, samples in counters.items():
+    for (pid, lane), samples in counters.items():
         if not lane.endswith(MONOTONE_SUFFIXES):
             continue
         samples.sort(key=lambda sv: sv[0])
         last = None
         for ts, v in samples:
             if last is not None and v < last:
-                errs.append(f"counter lane {lane!r} decreases "
-                            f"({last} -> {v}) but is cumulative")
+                errs.append(f"counter lane {lane!r} (pid {pid}) "
+                            f"decreases ({last} -> {v}) but is "
+                            f"cumulative")
                 break
             last = v
     # flow arrows must reference a span's trace id
@@ -181,6 +206,152 @@ def check_events(events: list) -> list:
             errs.append(f"event[{i}] ({ev.get('name')!r}) parent_id "
                         f"{parent!r} not found in trace {tid!r}")
     return errs
+
+
+# ---------------------------------------------------------------------------
+# multi-rank merge: clock-align per-rank dumps on their collective spans
+# ---------------------------------------------------------------------------
+
+def _rank_of(events: list, default: int) -> int:
+    """The rank a dump came from: args.rank stamped by dist.init (via
+    telemetry.tracing.set_rank), else the caller's file order."""
+    for ev in events:
+        args = ev.get("args")
+        if isinstance(args, dict) and "rank" in args:
+            try:
+                return int(args["rank"])
+            except (TypeError, ValueError):
+                break
+    return default
+
+
+def _sync_marks(events: list) -> dict:
+    """{(name, k): end_ts_us} for the k-th occurrence of each blocking
+    sync span — collectives, plus the in-graph SPMD phases that embed
+    a collective barrier (reduce-scatter / all-gather / spmd-step).
+    A blocking collective completes near-simultaneously on every rank,
+    so matched occurrences pin the per-rank clock offset."""
+    seen = defaultdict(int)
+    marks = {}
+    evs = [ev for ev in events if ev.get("ph") == "X"]
+    evs.sort(key=lambda ev: ev.get("ts", 0.0))
+    for ev in evs:
+        name, cat = ev.get("name"), ev.get("cat")
+        if cat == "collective" or name in ("reduce-scatter",
+                                           "all-gather", "spmd-step"):
+            k = seen[name]
+            seen[name] = k + 1
+            marks[(name, k)] = ev.get("ts", 0.0) + ev.get("dur", 0.0)
+    return marks
+
+
+def merge_traces(per_rank: list) -> tuple:
+    """[(rank, events), ...] -> (merged_events, info).
+
+    Clock alignment: for every sync mark present on ALL ranks, the
+    offset that maps rank r's end time onto rank 0's is averaged;
+    events are shifted by it and re-homed to ``pid = rank`` so the
+    merged trace shows one lane per rank.  info carries the applied
+    offsets and the cross-rank skew table."""
+    if not per_rank:
+        return [], {"ranks": 0, "offsets_us": {}, "skew": []}
+    ref_rank, ref_events = per_rank[0]
+    ref_marks = _sync_marks(ref_events)
+    offsets = {ref_rank: 0.0}
+    aligned_on = {}
+    for rank, events in per_rank[1:]:
+        marks = _sync_marks(events)
+        common = sorted(set(ref_marks) & set(marks))
+        if common:
+            offsets[rank] = sum(ref_marks[c] - marks[c]
+                                for c in common) / len(common)
+            aligned_on[rank] = len(common)
+        else:
+            offsets[rank] = 0.0  # nothing to align on: trust the clock
+            aligned_on[rank] = 0
+    merged = []
+    totals = defaultdict(lambda: defaultdict(float))  # (cat,name)->rank->ms
+    for rank, events in per_rank:
+        off = offsets[rank]
+        for ev in events:
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + off
+            ev["pid"] = rank
+            if ev.get("ph") == "X":
+                args = dict(ev.get("args") or {})
+                args.setdefault("rank", rank)
+                ev["args"] = args
+                totals[(ev.get("cat", ""), ev.get("name", ""))][rank] \
+                    += ev.get("dur", 0.0) / 1e3
+            merged.append(ev)
+    merged.sort(key=lambda ev: ev.get("ts", 0.0))
+    ranks = [r for r, _ in per_rank]
+    skew = []
+    for (cat, name), per in sorted(totals.items(),
+                                   key=lambda kv: -max(kv[1].values())):
+        vals = {r: per.get(r, 0.0) for r in ranks}
+        hi = max(vals, key=vals.get)
+        lo = min(vals, key=vals.get)
+        skew.append({
+            "cat": cat, "name": name,
+            "per_rank_ms": {str(r): round(v, 3)
+                            for r, v in vals.items()},
+            "skew_ms": round(vals[hi] - vals[lo], 3),
+            "straggler": hi,
+        })
+    info = {"ranks": len(per_rank),
+            "offsets_us": {str(r): round(o, 1)
+                           for r, o in offsets.items()},
+            "aligned_on_marks": {str(r): n
+                                 for r, n in aligned_on.items()},
+            "skew": skew}
+    return merged, info
+
+
+def merge_loaded(loaded: list, out: Optional[str] = None) -> tuple:
+    """The one merge pipeline both the CLI --merge branch and
+    scaling_bench's in-process merge run: rank detection (args.rank
+    tags, falling back to input order on duplicates), clock-aligned
+    merge, integrity check, and the optional merged-trace write.
+    ``loaded`` is a list of event lists; returns (merged, info, errs).
+    """
+    per_rank = [(_rank_of(evs, i), evs)
+                for i, evs in enumerate(loaded)]
+    # duplicate rank tags (e.g. two single-process dumps) fall back to
+    # input order so lanes never collide
+    if len({r for r, _ in per_rank}) != len(per_rank):
+        per_rank = [(i, evs) for i, evs in enumerate(loaded)]
+    per_rank.sort(key=lambda re: re[0])
+    merged, info = merge_traces(per_rank)
+    errs = check_events(merged)
+    if out:
+        with open(out, "w") as f:
+            json.dump({"traceEvents": merged,
+                       "displayTimeUnit": "ms"}, f)
+    return merged, info, errs
+
+
+def render_rank_table(info: dict) -> str:
+    ranks = sorted(int(r) for r in info["offsets_us"])
+    hdr = (f"{'Category':<12s} {'Phase':<24s} "
+           + " ".join(f"{'r%d(ms)' % r:>10s}" for r in ranks)
+           + f" {'Skew(ms)':>9s} {'Straggler':>9s}")
+    out = [hdr, "-" * len(hdr)]
+    for row in info["skew"]:
+        cells = " ".join(
+            f"{row['per_rank_ms'].get(str(r), 0.0):>10.3f}"
+            for r in ranks)
+        out.append(f"{row['cat']:<12.12s} {row['name']:<24.24s} "
+                   f"{cells} {row['skew_ms']:>9.3f} "
+                   f"{'rank %d' % row['straggler']:>9s}")
+    out.append("offsets(us): " + ", ".join(
+        f"rank {r}: {info['offsets_us'][str(r)]:+.1f}" for r in ranks)
+        + "  (aligned on " + ", ".join(
+            f"{info['aligned_on_marks'].get(str(r), '-')}"
+            for r in ranks if str(r) in info["aligned_on_marks"])
+        + " sync marks)")
+    return "\n".join(out)
 
 
 # ---------------------------------------------------------------------------
@@ -245,14 +416,22 @@ def selftest(keep: bool = False) -> int:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="per-phase summary + integrity check for "
-                    "chrome-trace dumps")
-    ap.add_argument("trace", nargs="?", help="profiler.dump() JSON file")
+        description="per-phase summary + integrity check + multi-rank "
+                    "merge for chrome-trace dumps")
+    ap.add_argument("trace", nargs="*",
+                    help="profiler.dump() JSON file(s); several only "
+                         "with --merge")
     ap.add_argument("--check", action="store_true",
                     help="validate trace integrity instead of printing "
                          "the table")
     ap.add_argument("--json", action="store_true",
-                    help="emit the summary as JSON")
+                    help="emit the summary as JSON (includes the "
+                         "integrity verdict)")
+    ap.add_argument("--merge", action="store_true",
+                    help="clock-align + merge per-rank dumps; prints "
+                         "the cross-rank skew table")
+    ap.add_argument("--out", default=None,
+                    help="with --merge: write the merged trace here")
     ap.add_argument("--selftest", action="store_true",
                     help="generate a trace via a tiny training loop, "
                          "then check it (nightly lane)")
@@ -266,15 +445,34 @@ def main(argv=None) -> int:
         ap.print_usage(sys.stderr)
         return 2
     try:
-        events = load_trace(args.trace)
+        loaded = [load_trace(t) for t in args.trace]
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+
+    if args.merge:
+        merged, info, errs = merge_loaded(loaded, out=args.out)
+        if args.json:
+            rep = report_json(merged)
+            rep["merge"] = info
+            print(json.dumps(rep, indent=1))
+        else:
+            print(render_rank_table(info))
+            for e in errs:
+                print(f"CHECK FAIL: {e}", file=sys.stderr)
+            print(f"merged {len(loaded)} ranks, {len(merged)} events, "
+                  f"{'OK' if not errs else f'{len(errs)} violations'}")
+        return 1 if errs else 0
+
+    if len(loaded) != 1:
+        print("error: multiple traces require --merge", file=sys.stderr)
+        return 2
+    events = loaded[0]
     if args.check:
         errs = check_events(events)
         for e in errs:
             print(f"CHECK FAIL: {e}", file=sys.stderr)
-        print(f"{args.trace}: {len(events)} events, "
+        print(f"{args.trace[0]}: {len(events)} events, "
               f"{'OK' if not errs else f'{len(errs)} violations'}")
         return 1 if errs else 0
     if args.json:
