@@ -200,9 +200,14 @@ class Trainer:
         SIGTERM), and an attached AutoCheckpoint runs after the update
         — so a preemption observed during step N checkpoints AT step N
         and raises ``Preempted`` from the step-N boundary, never
-        mid-update."""
+        mid-update.  A ``trainer.numerics`` plan poisons one gradient
+        bucket to NaN before the update — the mxhealth detection /
+        skip_step fixture (backward has run by step(), so the
+        gradients exist to corrupt)."""
         if _chaos._ACTIVE:
             _chaos.check("trainer.preempt")
+            if _chaos.check("trainer.numerics") == "corrupt":
+                self._corrupt_one_grad()
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
@@ -228,6 +233,19 @@ class Trainer:
                 _ins.training_steps_total().inc()
         if self._auto_ckpt is not None:
             self._auto_ckpt.on_step(self)
+
+    def _corrupt_one_grad(self) -> bool:
+        """Chaos ``trainer.numerics`` payload: NaN the first trainable
+        parameter's gradient (every replica — a real numerics fault
+        reduces into all of them).  Device-side multiply, no host
+        sync."""
+        for p in self._params:
+            if p.grad_req == "null" or p._grad is None:
+                continue
+            for g in p.list_grad():
+                g._data = g.data * float("nan")
+            return True
+        return False
 
     def _spmd_resolved(self) -> bool:
         """Whether the unified SPMD step path is engaged (decided once,

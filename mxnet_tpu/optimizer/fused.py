@@ -46,6 +46,7 @@ import itertools
 from ..analysis import sanitizer as _mxsan
 from ..ndarray.ndarray import NDArray
 from ..telemetry import instruments as _ins
+from ..telemetry import mxhealth as _mxhealth
 from ..telemetry import tracing as _tracing
 from ..telemetry.mxprof import costs as _costs
 from ..util import env as _env
@@ -265,7 +266,33 @@ def apply_param(opt: Optimizer, w, g, s, mp: bool, h: Dict[str, Any]):
     return opt.fused_apply(w, g, s, h)
 
 
-def _build_step(opt: Optimizer, mp_flags: Tuple[bool, ...]):
+def _tree_select(ok, new, old):
+    """Elementwise step/no-step selection over matching state trees —
+    the in-graph half of the skip_step policy (traced; `ok` is a
+    scalar bool)."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new, old)
+
+
+def _sq_norms(tensors):
+    """(n,) float32 vector of per-tensor sum-of-squares (traced)."""
+    f32 = jnp.float32
+    return jnp.stack([jnp.sum(jnp.square(t.astype(f32)))
+                      for t in tensors]) if tensors \
+        else jnp.zeros((0,), f32)
+
+
+def _nonfinite_count(tensors):
+    """Scalar float32 count of nonfinite values across tensors
+    (traced) — mxhealth's global nonfinite counter."""
+    total = jnp.float32(0)
+    for t in tensors:
+        total = total + jnp.sum((~jnp.isfinite(t)).astype(jnp.float32))
+    return total
+
+
+def _build_step(opt: Optimizer, mp_flags: Tuple[bool, ...],
+                health_mode=None):
     """The traced program: apply the optimizer's pure math to every
     parameter.  Static hyperparams are read off `opt` at trace time and
     are part of the cache key (Optimizer.fused_static_key).
@@ -273,7 +300,14 @@ def _build_step(opt: Optimizer, mp_flags: Tuple[bool, ...]):
     Per-step scalars arrive PACKED: one (n_params,) float32 vector per
     hyper key instead of n_params scalar buffers — three host->device
     transfers per step, not 3N (scalar transfer cost would otherwise
-    swamp the single-dispatch win)."""
+    swamp the single-dispatch win).
+
+    ``health_mode`` (part of the executable signature) grows the
+    program by mxhealth's numerics outputs — per-param grad/update/
+    param norm-squares and a global nonfinite count — as tiny extra
+    results of the SAME dispatch; ``"guard"`` additionally selects the
+    pre-step weights/states when any gradient value is nonfinite, so a
+    skipped step is bit-identical to not having stepped."""
 
     def step(weights, grads, states, hyper_vecs):
         new_w, new_s = [], []
@@ -283,7 +317,22 @@ def _build_step(opt: Optimizer, mp_flags: Tuple[bool, ...]):
             nw, ns = apply_param(opt, w, g, s, mp, h)
             new_w.append(nw)
             new_s.append(ns)
-        return tuple(new_w), tuple(new_s)
+        new_w, new_s = tuple(new_w), tuple(new_s)
+        if health_mode is None:
+            return new_w, new_s
+        f32 = jnp.float32
+        gn2 = _sq_norms(grads)
+        pn2 = _sq_norms(weights)
+        un2 = jnp.stack([
+            jnp.sum(jnp.square(nw.astype(f32) - w.astype(f32)))
+            for nw, w in zip(new_w, weights)]) if weights \
+            else jnp.zeros((0,), f32)
+        nonfinite = _nonfinite_count(grads)
+        if health_mode == "guard":
+            ok = nonfinite == 0
+            new_w = _tree_select(ok, new_w, weights)
+            new_s = _tree_select(ok, new_s, states)
+        return new_w, new_s, (gn2, un2, pn2, nonfinite)
 
     return step
 
@@ -357,18 +406,37 @@ class FusedUpdater(Updater):
                                 np.float32)
                   for k in hypers[0]}
 
+        hm = _mxhealth.mode() if _mxhealth._ACTIVE else None
         dev = weights[0].ctx.jax_device
-        donate = dev.platform not in ("cpu",)
+        # the raise policy disables donation: it promises params at
+        # their PRE-step values after the raise, which a donated input
+        # buffer cannot honor (the dispatch consumed it)
+        donate = dev.platform not in ("cpu",) and hm != "raise"
         args = (w_tup, g_tup, s_tup, h_vecs)
         leaves, treedef = jax.tree_util.tree_flatten(args)
         sig = (type(opt), opt.fused_static_key(), tuple(mp_flags),
-               donate, str(dev), treedef,
+               donate, str(dev), hm, treedef,
                tuple(_leaf_aval(x) for x in leaves))
 
         fn = _FUSED_CACHE.lookup(sig)
         if fn is None:
-            fn = self._compile(sig, args, mp_flags, donate)
-        new_w, new_s = fn(*args)
+            fn = self._compile(sig, args, mp_flags, donate, hm)
+        out = fn(*args)
+        if hm is not None:
+            new_w, new_s, health = out
+            if getattr(self, "mxprof_report_cost", True):
+                # replica-0-reports, like the FLOPs accounting below:
+                # replicas run the same program on the same reduced
+                # grads, so one replica's numerics speak for the step.
+                # Under policy "raise" this raises NonFiniteGradient
+                # BEFORE the writeback — params keep their pre-step
+                # buffers (donation is off on this path).
+                _mxhealth.monitor().on_step(_FUSED_CACHE.site, {
+                    "gn2": health[0], "un2": health[1],
+                    "pn2": health[2], "nonfinite": health[3],
+                    "guarded": hm == "guard"})
+        else:
+            new_w, new_s = out
 
         snk = _tracing._SINK
         if snk is not None and getattr(self, "mxprof_report_cost",
@@ -386,13 +454,14 @@ class FusedUpdater(Updater):
         for s, ns in zip(states, new_s):
             _rebind_state(s, ns)
 
-    def _compile(self, sig, args, mp_flags, donate):
+    def _compile(self, sig, args, mp_flags, donate, health_mode=None):
         cell = {}
 
         def build_lowered():
             lowered = cell.get("lowered")
             if lowered is None:
-                step = _build_step(self.optimizer, tuple(mp_flags))
+                step = _build_step(self.optimizer, tuple(mp_flags),
+                                   health_mode)
                 jitted = jax.jit(
                     step, donate_argnums=(0, 2) if donate else ())
                 lowered = cell["lowered"] = jitted.lower(*args)

@@ -62,9 +62,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ndarray.ndarray import NDArray
 from ..telemetry import instruments as _ins
+from ..telemetry import mxhealth as _mxhealth
 from ..telemetry import tracing as _tracing
 from ..util import env as _env
 from .fused import (ExecutableCache, FusedUnsupported, _leaf_aval,
+                    _nonfinite_count, _sq_norms, _tree_select,
                     apply_param)
 from .optimizer import Optimizer, Updater
 
@@ -458,12 +460,16 @@ class SpmdUpdater(Updater):
         mp_flags = tuple(self._mp[i] for i in indices)
         metas = tuple(self._meta[i] for i in indices)
 
+        hm = _mxhealth.mode() if _mxhealth._ACTIVE else None
         args = (w_tup, g_tup, s_tup, h_vecs)
-        donate = mesh.devices[0].platform not in ("cpu",)
+        # raise policy: donation off — pre-step state buffers must
+        # survive the raise (fused-path precedent)
+        donate = mesh.devices[0].platform not in ("cpu",) \
+            and hm != "raise"
         sig_key = (idx_key, nrep, opt.fused_static_key(),
                    tuple(m.dtype for m in metas),
                    tuple(str(g[0].data.dtype) for g in grads),
-                   tuple(h_vecs))
+                   tuple(h_vecs), hm)
         if self._sig_cache is not None and self._sig_cache[0] == sig_key:
             sig = self._sig_cache[1]
         else:
@@ -474,21 +480,36 @@ class SpmdUpdater(Updater):
             # starts — but two trainers on disjoint device subsets must
             # not share an executable bound to the wrong devices)
             sig = (type(opt), opt.fused_static_key(), mp_flags, metas,
-                   plan, self._flat, donate, self._layout,
+                   plan, self._flat, donate, self._layout, hm,
                    tuple(str(d) for d in mesh.devices), treedef,
                    tuple(_leaf_aval(x) for x in leaves))
             self._sig_cache = (sig_key, sig)
 
         # the phased (3-dispatch) variant keys on capture_active(), NOT
         # active(): the always-on mxprof sink must never serialize the
-        # one-program step it exists to measure
-        if self._flat and _tracing.capture_active():
+        # one-program step it exists to measure.  With mxhealth on, the
+        # unified program runs even while capturing — the numerics
+        # outputs (and the skip_step guard) live inside it, and a
+        # capture must not turn the guard off.
+        if self._flat and _tracing.capture_active() and hm is None:
             new_w, new_s = self._run_phased(sig, args, mp_flags, metas)
         else:
             fn = _SPMD_CACHE.lookup(sig)
             if fn is None:
-                fn = self._compile(sig, args, mp_flags, metas, donate)
-            new_w, new_s = fn(*args)
+                fn = self._compile(sig, args, mp_flags, metas, donate,
+                                   hm)
+            out = fn(*args)
+            if hm is not None:
+                new_w, new_s, health = out
+                # under policy "raise" this raises NonFiniteGradient
+                # BEFORE any writeback: weights/states keep their
+                # pre-step buffers (donation is off on this path)
+                _mxhealth.monitor().on_step(_SPMD_CACHE.site, {
+                    "gn2": health[0], "un2": health[1],
+                    "pn2": health[2], "nonfinite": health[3],
+                    "guarded": hm == "guard"})
+            else:
+                new_w, new_s = out
         snk = _tracing._SINK
         if snk is not None:  # mxprof: this step ran these FLOPs
             c = _SPMD_CACHE.cost(sig)
@@ -665,7 +686,7 @@ class SpmdUpdater(Updater):
 
         return reduce_stage, update_stage, gather_stage
 
-    def _build_step(self, mp_flags, metas):
+    def _build_step(self, mp_flags, metas, health_mode=None):
         reduce_stage, update_stage, gather_stage = self._stages(
             mp_flags, metas)
 
@@ -673,18 +694,41 @@ class SpmdUpdater(Updater):
             parts = reduce_stage(gstacks)
             new_parts, new_s = update_stage(weights, parts, states,
                                             hyper_vecs)
-            return gather_stage(new_parts, weights), new_s
+            new_w = gather_stage(new_parts, weights)
+            if health_mode is None:
+                return new_w, new_s
+            # mxhealth numerics, inside the SAME mesh program: grad
+            # norm-squares per bucket/group (the reduced parts — one
+            # NaN'd replica contribution poisons its sum, so the
+            # post-reduce view detects it), update/param norm-squares
+            # per parameter, and the global nonfinite count.  The
+            # reductions run over dp-sharded flats; XLA inserts the
+            # cross-shard combine — still one dispatch.
+            f32 = jnp.float32
+            gn2 = _sq_norms(parts)
+            pn2 = _sq_norms(weights)
+            un2 = jnp.stack([
+                jnp.sum(jnp.square(nw.astype(f32) - w.astype(f32)))
+                for nw, w in zip(new_w, weights)]) if weights \
+                else jnp.zeros((0,), f32)
+            nonfinite = _nonfinite_count(parts)
+            if health_mode == "guard":
+                ok = nonfinite == 0
+                new_w = _tree_select(ok, new_w, weights)
+                new_s = _tree_select(ok, new_s, states)
+            return new_w, new_s, (gn2, un2, pn2, nonfinite)
 
         return step
 
-    def _compile(self, sig, args, mp_flags, metas, donate):
+    def _compile(self, sig, args, mp_flags, metas, donate,
+                 health_mode=None):
         cell = {}
 
         def build_lowered():
             lowered = cell.get("lowered")
             if lowered is None:
                 jitted = jax.jit(
-                    self._build_step(mp_flags, metas),
+                    self._build_step(mp_flags, metas, health_mode),
                     donate_argnums=(2,) if donate else ())
                 lowered = cell["lowered"] = jitted.lower(*args)
             return lowered

@@ -30,6 +30,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from ..telemetry import instruments as _ins
+from ..telemetry import mxhealth as _mxhealth
 from ..telemetry import tracing as _tracing
 from .. import optimizer as opt_mod
 from .. import random as rnd
@@ -641,6 +642,11 @@ class SPMDTrainer:
         # rebind aux state (BatchNorm moving stats) by parameter NAME
         for n, v in aux.items():
             self._param_by_name[n].data()._data = v
+        if _mxhealth._ACTIVE:
+            # loss-spike detection feed: the device scalar is handed
+            # off as-is; the monitor's fetch thread syncs it, the step
+            # path never does
+            _mxhealth.observe_loss(lval)
         from ..context import current_context
 
         return NDArray(lval, ctx=current_context())

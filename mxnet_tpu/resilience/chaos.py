@@ -31,6 +31,10 @@ model):
     trainer.preempt     simulated preemption signal (SIGTERM-style)
                         observed by gluon/trainer.py's auto-checkpoint
                         hook at the next step boundary
+    trainer.numerics    numerics corruption at gluon/trainer.py step
+                        entry: one gradient bucket poisoned to NaN on
+                        the selected step (the mxhealth detection /
+                        skip_step bit-consistency fixture)
 
 Plans are installed via the :func:`inject` context manager (scoped,
 exception-safe) or — for subprocess experiments like the nightly chaos
@@ -92,7 +96,8 @@ _INJECTED: Dict[str, int] = {}  # per-kind fires
 _ENV_DONE = False
 
 _DEFAULT_ACTION = {"trainer.preempt": "preempt",
-                   "dataloader.worker": "die"}
+                   "dataloader.worker": "die",
+                   "trainer.numerics": "corrupt"}
 
 
 class _Plan:
@@ -107,9 +112,9 @@ class _Plan:
             # the natural action per kind: a preemption site preempts,
             # a worker site kills the worker, everything else errors
             action = _DEFAULT_ACTION.get(kind, "error")
-        if action not in ("error", "die", "hang", "preempt"):
+        if action not in ("error", "die", "hang", "preempt", "corrupt"):
             raise MXNetError(f"chaos action {action!r} unknown; expected "
-                             "error/die/hang/preempt")
+                             "error/die/hang/preempt/corrupt")
         if sum(x is not None for x in (at, times, p)) != 1:
             raise MXNetError(
                 "chaos plan needs exactly one selector: at=N (the Nth "
@@ -153,7 +158,10 @@ def check(kind: str) -> Optional[str]:
       * ``preempt`` — sets the preemption flag, returns ``"preempt"``;
       * ``die``     — returns ``"die"``: the CALLER performs the death
                       (a thread exits silently, a worker process
-                      ``os._exit``\\ s) because only it knows how.
+                      ``os._exit``\\ s) because only it knows how;
+      * ``corrupt`` — returns ``"corrupt"``: the CALLER poisons its
+                      own data (the trainer.numerics site NaNs one
+                      gradient bucket) because only it owns it.
 
     Returns None when nothing fired."""
     with _LOCK:
@@ -179,7 +187,7 @@ def check(kind: str) -> Optional[str]:
 
         preemption.trigger(reason=f"chaos at site '{kind}' call #{nth}")
         return "preempt"
-    return "die"
+    return action  # "die" / "corrupt": the caller performs it
 
 
 class inject:

@@ -21,6 +21,13 @@ including the status codes the backpressure contract promises
                           503 {"status": "draining"} once shutdown
                           begins (drain-aware: LBs stop routing here
                           while accepted work completes)
+    GET  /statusz      -> one human-readable page: build info, uptime,
+                          per-model serving counters, mxprof snapshot
+                          aggregates, and the currently-firing alerts
+                          (telemetry.alerts.default_engine, ticked at
+                          render time).  Drain-aware like /healthz:
+                          the status code flips to 503 while draining
+                          but the page still renders.
 
 Use `serve_http(server, port=0)` for an ephemeral port; the returned
 `http.server.ThreadingHTTPServer` exposes `server_address` and is torn
@@ -58,6 +65,96 @@ def _jsonable(out):
     return out
 
 
+def _render_statusz(server) -> str:
+    """The /statusz page body: everything an operator asks first, one
+    plain-text screen — no JS, no scrape stack, survives a pager.
+    Every block degrades to a stub rather than failing the render."""
+    import time
+
+    from ..telemetry import alerts as _alerts
+    from ..telemetry import instruments as _ins
+    from ..telemetry import mxhealth as _mxhealth
+    from ..telemetry import mxprof as _mxprof
+
+    lines = ["mxnet_tpu statusz", "================="]
+    try:
+        _ins.refresh_process_gauges()
+        child = _ins.build_info()
+        # the child's identity is its label values; recover them from
+        # the family for display
+        fam = _ins._family("mx_build_info")
+        labels = next((dict(zip(fam.labelnames, v))
+                       for v, c in fam.children() if c is child), {})
+        lines.append("build:   " + ", ".join(
+            f"{k}={v}" for k, v in labels.items()))
+        lines.append(
+            f"uptime:  {_ins._child('mx_process_uptime_seconds').value:.0f}s"
+            f"   rss: {_ins._child('mx_process_rss_bytes').value / 2**20:.0f}MB")
+    except Exception:  # noqa: BLE001 — statusz must always render
+        lines.append("build:   (unavailable)")
+    state = "DRAINING" if server.draining else "serving"
+    snap = server.metrics()
+    lines.append(f"state:   {state}   pending {snap['pending']}/"
+                 f"{snap['max_queue']}")
+    lines.append("")
+    lines.append("models:")
+    for m in snap["models"]:
+        lines.append(
+            f"  {m['model']} v{m['version']}: req {m['requests']} "
+            f"ok {m['completed']} fail {m['failed']} "
+            f"shed {m['rejected'] + m['breaker_rejected']} "
+            f"p99 {m['p99_latency_ms'] or '-'}ms "
+            f"qdepth {m['queue_depth']}")
+    if not snap["models"]:
+        lines.append("  (none)")
+    lines.append("")
+    try:
+        if _mxprof.enabled():
+            s = _mxprof.snapshot(live_hbm=False,
+                                 include_records=False)["summary"]
+            lines.append(
+                f"mxprof:  steps {s.get('steps_recorded', 0)} "
+                f"mean-step {s.get('wall_s_mean', '-')}s "
+                f"verdicts {s.get('verdicts', {})} "
+                f"mfu {s.get('mfu_mean', '-')}")
+        else:
+            lines.append("mxprof:  (recorder not attached)")
+    except Exception:  # noqa: BLE001
+        lines.append("mxprof:  (unavailable)")
+    try:
+        if _mxhealth.enabled():
+            # flush_timeout=0: render what is already fetched — the
+            # page must not stall behind a wedged device sync
+            r = _mxhealth.monitor().report(flush_timeout=0.0)
+            lines.append(
+                f"health:  {r['verdict']} — steps {r['steps_observed']} "
+                f"nonfinite {r['nonfinite_steps']} "
+                f"skipped {r['skipped_steps']} "
+                f"events {len(r['events'])}")
+        else:
+            lines.append("health:  (mxhealth not enabled)")
+    except Exception:  # noqa: BLE001
+        lines.append("health:  (unavailable)")
+    lines.append("")
+    lines.append("alerts:")
+    try:
+        eng = _alerts.default_engine()
+        eng.tick()  # render-time evaluation: the page never shows a
+        # stale verdict just because the background ticker is off
+        firing = eng.firing()
+        for a in firing:
+            lines.append(f"  FIRING [{a['severity']}] {a['name']}: "
+                         f"{a.get('description', '')} "
+                         f"(value {a.get('value')})")
+        if not firing:
+            lines.append("  (none firing)")
+    except Exception:  # noqa: BLE001
+        lines.append("  (engine unavailable)")
+    lines.append("")
+    lines.append(f"rendered {time.strftime('%Y-%m-%d %H:%M:%S')}")
+    return "\n".join(lines) + "\n"
+
+
 def _make_handler(server):
     import numpy as np
 
@@ -89,6 +186,14 @@ def _make_handler(server):
                 if server.draining:
                     return self._send(503, {"status": "draining"})
                 return self._send(200, {"status": "serving"})
+            if self.path == "/statusz":
+                # drain-aware like /healthz (an LB or a human can read
+                # the state off the code), but the page still renders
+                # so the operator sees WHAT is draining
+                return self._send_text(
+                    503 if server.draining else 200,
+                    _render_statusz(server),
+                    "text/plain; charset=utf-8")
             if self.path == "/v1/metrics":
                 return self._send(200, server.metrics())
             if self.path == "/v1/models":

@@ -48,6 +48,8 @@ from . import tracing
 from . import instruments
 from . import catalog
 from . import mxprof
+from . import mxhealth
+from . import alerts
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricFamily", "MetricsRegistry",
@@ -56,6 +58,7 @@ __all__ = [
     "flow_start", "flow_end", "counter_event",
     "enable", "disable", "enabled",
     "metrics", "tracing", "instruments", "catalog", "mxprof",
+    "mxhealth", "alerts",
 ]
 
 

@@ -40,6 +40,9 @@ __all__ = [
     "step_mfu", "step_last_seconds", "step_flops_total",
     "step_roofline_total",
     "hbm_used_bytes", "hbm_peak_bytes", "hbm_optimizer_state_bytes",
+    "grad_norm", "param_norm", "update_ratio", "nonfinite_total",
+    "health_events_total", "health_steps_skipped_total",
+    "alerts_firing", "alerts_total",
     "build_info", "process_uptime_seconds", "process_rss_bytes",
     "retry_total", "fault_injected_total",
     "compile_cache_hit_total", "compile_cache_miss_total",
@@ -280,6 +283,71 @@ def hbm_peak_bytes(device: str):
 
 def hbm_optimizer_state_bytes():
     return _child("mx_hbm_optimizer_state_bytes")
+
+
+# ---- mxhealth: numerics telemetry + alert engine ----------------------
+
+_spec("mx_grad_norm", "gauge",
+      "Global gradient L2 norm of the last mxhealth sample, computed "
+      "in-graph inside the fused/SPMD step program (no extra "
+      "dispatch) and fetched every MXNET_HEALTH_EVERY steps.")
+_spec("mx_param_norm", "gauge",
+      "Global parameter L2 norm of the last mxhealth sample "
+      "(pre-update weights), computed in-graph beside mx_grad_norm.")
+_spec("mx_update_ratio", "gauge",
+      "Update-norm / param-norm of the last mxhealth sample — how far "
+      "one optimizer step moved the parameters relative to their "
+      "magnitude; drift past MXNET_HEALTH_RATIO_MAX records an "
+      "update-ratio health event.")
+_spec("mx_nonfinite_total", "counter",
+      "Cumulative nonfinite (NaN/Inf) gradient values observed by "
+      "mxhealth's in-graph counter. Any growth is a numerics "
+      "emergency — alert on it.")
+_spec("mx_health_events_total", "counter",
+      "mxhealth detector firings by kind: nonfinite / grad-spike / "
+      "loss-spike / update-ratio / straggler.", ("kind",))
+_spec("mx_health_steps_skipped_total", "counter",
+      "Steps the skip_step policy rejected in-graph (params and "
+      "optimizer states left bit-identical to their pre-step values "
+      "because the gradients carried nonfinite values).")
+_spec("mx_alerts_firing", "gauge",
+      "1 while the named alert rule is firing, 0 otherwise "
+      "(telemetry.alerts.AlertEngine).", ("rule", "severity"))
+_spec("mx_alerts_total", "counter",
+      "Alert-rule firings (pending -> firing transitions) since "
+      "process start.", ("rule", "severity"))
+
+
+def grad_norm():
+    return _child("mx_grad_norm")
+
+
+def param_norm():
+    return _child("mx_param_norm")
+
+
+def update_ratio():
+    return _child("mx_update_ratio")
+
+
+def nonfinite_total():
+    return _child("mx_nonfinite_total")
+
+
+def health_events_total(kind: str):
+    return _child("mx_health_events_total", (kind,))
+
+
+def health_steps_skipped_total():
+    return _child("mx_health_steps_skipped_total")
+
+
+def alerts_firing(rule: str, severity: str):
+    return _child("mx_alerts_firing", (rule, severity))
+
+
+def alerts_total(rule: str, severity: str):
+    return _child("mx_alerts_total", (rule, severity))
 
 
 # ---- process identity (what is being scraped) -------------------------

@@ -1,0 +1,139 @@
+"""mxhealth — in-graph numerics telemetry + anomaly detection.
+
+mxprof (telemetry.mxprof) makes training *speed* observable; mxhealth
+watches whether training is *healthy*: a NaN'd gradient, a silently
+diverging loss, a step that moved the weights 40% of their magnitude —
+today those surface hours later as a bad number in a bench JSON.
+
+Three coupled pieces (docs/observability.md, "Training health"):
+
+  * **in-graph numerics** — with mxhealth enabled, the fused and SPMD
+    step programs (optimizer/fused.py, optimizer/spmd.py) emit
+    per-bucket grad/update/param norm-squares and a global nonfinite
+    count as tiny extra outputs of the already-donated jit program:
+    no extra dispatch, no host sync on the step path.  The device
+    arrays are fetched every ``MXNET_HEALTH_EVERY`` steps on a daemon
+    thread (:mod:`.monitor`).
+  * **policies** — ``MXNET_HEALTH_POLICY`` decides what a nonfinite
+    step does: ``record`` (event + metrics), ``raise``
+    (:class:`NonFiniteGradient` from ``Trainer.step``, params left at
+    their pre-step values), or ``skip_step`` (an in-graph guard keeps
+    params AND optimizer states bit-identical to the pre-step values
+    — the guard runs every step, on device, so no NaN ever lands in a
+    parameter buffer).
+  * **detectors** — rolling median/MAD loss- and grad-norm-spike
+    detection, update/param ratio drift, and per-rank straggler
+    detection on ``trace_report --merge`` output (:mod:`.detectors`).
+
+Enable with ``MXNET_HEALTH=1``, :func:`enable`, and read the state
+back with :func:`report` (embedded in HEALTH.json by
+``tools/health_report.py``).  The declared metric families
+(``mx_grad_norm``, ``mx_update_ratio``, ``mx_nonfinite_total``, ...)
+feed the alert engine (:mod:`..alerts`).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ...util import env as _env
+from .detectors import RollingMAD, ratio_drift, stragglers_from_merge
+from .monitor import POLICIES, HealthMonitor, NonFiniteGradient
+
+__all__ = [
+    "enable", "disable", "enabled", "mode", "monitor", "observe_loss",
+    "report", "flush", "HealthMonitor", "NonFiniteGradient",
+    "RollingMAD", "ratio_drift", "stragglers_from_merge", "POLICIES",
+]
+
+#: Fast-path flag: False means the step programs compile WITHOUT the
+#: health outputs and every ``if _mxhealth._ACTIVE:`` site is a single
+#: falsy check (the chaos/_ACTIVE precedent).
+_ACTIVE = False
+
+_lock = threading.Lock()
+_MONITOR: Optional[HealthMonitor] = None
+
+
+def _new_monitor(policy: Optional[str] = None,
+                 every: Optional[int] = None) -> HealthMonitor:
+    return HealthMonitor(
+        policy=policy or _env.get_str("MXNET_HEALTH_POLICY"),
+        every=every if every is not None
+        else _env.get_int("MXNET_HEALTH_EVERY"),
+        window=_env.get_int("MXNET_HEALTH_WINDOW"),
+        spike_k=_env.get_float("MXNET_HEALTH_SPIKE_K"),
+        ratio_max=_env.get_float("MXNET_HEALTH_RATIO_MAX"),
+        ring=_env.get_int("MXNET_HEALTH_RING"))
+
+
+def monitor() -> HealthMonitor:
+    """The process monitor (created from the knobs on first use)."""
+    global _MONITOR
+    with _lock:
+        if _MONITOR is None:
+            _MONITOR = _new_monitor()
+        return _MONITOR
+
+
+def enable(policy: Optional[str] = None, every: Optional[int] = None,
+           fresh: bool = False) -> HealthMonitor:
+    """Turn the numerics layer on.  ``policy``/``every`` override the
+    knobs; passing either (or ``fresh=True``) starts a fresh monitor —
+    a policy change alters what the step program compiles, so stale
+    windows/events must not carry over.  The already-enabled path with
+    no overrides is idempotent."""
+    global _MONITOR, _ACTIVE
+    with _lock:
+        if (_MONITOR is None or fresh or policy is not None
+                or every is not None):
+            _MONITOR = _new_monitor(policy=policy, every=every)
+        _ACTIVE = True
+        return _MONITOR
+
+
+def disable() -> None:
+    """Stop feeding the monitor (records already taken stay readable;
+    the next step recompiles the plain program)."""
+    global _ACTIVE
+    _ACTIVE = False
+
+
+def enabled() -> bool:
+    return _ACTIVE
+
+
+def mode() -> Optional[str]:
+    """What the step program should compile: None (health off),
+    ``"observe"`` (extra outputs only, the record policy),
+    ``"raise"`` (same program; the updater checks synchronously and
+    disables donation so pre-step buffers survive the raise), or
+    ``"guard"`` (outputs + the in-graph skip_step selection).  Part of
+    the executable signature — toggling costs exactly one recompile."""
+    if not _ACTIVE:
+        return None
+    return {"record": "observe", "raise": "raise",
+            "skip_step": "guard"}[monitor().policy]
+
+
+def observe_loss(value, step: Optional[int] = None) -> None:
+    """Feed one loss sample (device array or float) to the loss-spike
+    detector; a no-op while mxhealth is disabled."""
+    if _ACTIVE:
+        monitor().observe_loss(value, step=step)
+
+
+def flush(timeout: float = 30.0) -> bool:
+    """Wait for the async fetch queue to drain (tests, dumps)."""
+    with _lock:
+        mon = _MONITOR
+    return True if mon is None else mon.flush(timeout=timeout)
+
+
+def report() -> dict:
+    """The per-run health report (HEALTH.json's ``training`` block)."""
+    return monitor().report()
+
+
+if _env.get_bool("MXNET_HEALTH"):
+    enable()

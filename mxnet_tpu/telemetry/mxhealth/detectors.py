@@ -1,0 +1,130 @@
+"""mxhealth detectors: rolling median/MAD spikes, ratio drift, and
+per-rank straggler detection on merged traces.
+
+All detectors are pure host-side math over already-fetched floats —
+they run on the monitor's fetch thread (or inside tools), never on the
+step path.  The spike detector is deliberately robust statistics
+(median + median-absolute-deviation, not mean + stddev): one diverging
+loss sample must not drag the baseline toward itself before the next
+sample is judged against it.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["RollingMAD", "ratio_drift", "stragglers_from_merge"]
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    if n % 2:
+        return s[mid]
+    return 0.5 * (s[mid - 1] + s[mid])
+
+
+class RollingMAD:
+    """Rolling median/MAD spike detector over a bounded window.
+
+    ``update(x)`` returns a spike verdict for ``x`` judged against the
+    PRIOR window (x is appended afterwards, so a spike never softens
+    its own threshold), or None while the window holds fewer than
+    ``min_samples`` points.  The MAD is floored at ``rel_floor`` of the
+    median's magnitude so a perfectly flat warmup window (MAD == 0)
+    does not turn the first femto-scale wobble into a spike.
+    """
+
+    def __init__(self, window: int = 64, k: float = 8.0,
+                 min_samples: int = 8, rel_floor: float = 1e-3):
+        self._win: "deque[float]" = deque(maxlen=max(2, int(window)))
+        self.k = float(k)
+        self.min_samples = max(2, int(min_samples))
+        self.rel_floor = float(rel_floor)
+
+    def __len__(self) -> int:
+        return len(self._win)
+
+    def threshold(self) -> Optional[float]:
+        """The current spike boundary (median + k*MAD), or None while
+        the window is still warming up."""
+        if len(self._win) < self.min_samples:
+            return None
+        vals = list(self._win)
+        med = _median(vals)
+        mad = _median([abs(v - med) for v in vals])
+        mad = max(mad, abs(med) * self.rel_floor)
+        return med + self.k * mad
+
+    def update(self, x: float) -> Optional[dict]:
+        """Judge ``x`` against the prior window, then absorb it.
+        Returns ``{"value", "median", "threshold"}`` when x spikes,
+        None otherwise (including during warmup)."""
+        thr = self.threshold()
+        verdict = None
+        if thr is not None and x > thr:
+            verdict = {"value": float(x),
+                       "median": _median(list(self._win)),
+                       "threshold": float(thr)}
+        else:
+            # a spike is NOT absorbed: a diverging run keeps being
+            # judged against the last healthy window instead of
+            # normalizing its own explosion
+            self._win.append(float(x))
+        return verdict
+
+
+def ratio_drift(update_norm: float, param_norm: float,
+                ratio_max: float) -> Optional[dict]:
+    """Update/param-ratio drift: one optimizer step moving parameters
+    by more than ``ratio_max`` of their own magnitude.  Returns the
+    event payload or None (param_norm == 0 — a fresh zero-initialized
+    net — never drifts; ratio_max <= 0 disables)."""
+    if ratio_max <= 0 or param_norm <= 0:
+        return None
+    ratio = update_norm / param_norm
+    if ratio > ratio_max:
+        return {"ratio": float(ratio), "max": float(ratio_max),
+                "update_norm": float(update_norm),
+                "param_norm": float(param_norm)}
+    return None
+
+
+def stragglers_from_merge(info: dict, rel_threshold: float = 0.2,
+                          min_ms: float = 1.0,
+                          phases: Optional[tuple] = None) -> List[dict]:
+    """Per-rank straggler detection on ``trace_report --merge`` output.
+
+    ``info`` is the merge info dict (the ``skew`` table: per-phase
+    per-rank total milliseconds).  A rank straggles on a phase when its
+    time exceeds the median across ranks by more than
+    ``rel_threshold`` (and by at least ``min_ms`` absolute, so
+    microsecond phases on an idle box do not flag).  ``phases``
+    restricts the scan to named (cat-agnostic) phase names; default is
+    the training phases, where a straggler means every other rank
+    waits at the next collective.
+    """
+    if phases is None:
+        phases = ("forward", "backward", "grad-allreduce", "spmd-step",
+                  "reduce-scatter", "shard-update", "all-gather",
+                  "fused-update", "optimizer-update", "step")
+    out: List[dict] = []
+    for row in info.get("skew", []):
+        if row.get("name") not in phases:
+            continue
+        per: Dict[str, float] = row.get("per_rank_ms", {})
+        if len(per) < 2:
+            continue
+        med = _median(list(per.values()))
+        for rank, ms in sorted(per.items()):
+            if ms - med < min_ms:
+                continue
+            if med > 0 and (ms - med) / med > rel_threshold:
+                out.append({"phase": row["name"],
+                            "cat": row.get("cat", ""),
+                            "rank": int(rank),
+                            "ms": float(ms),
+                            "median_ms": float(med),
+                            "over": round((ms - med) / med, 4)})
+    return out

@@ -1,0 +1,320 @@
+"""The mxhealth monitor: where the in-graph numerics land.
+
+The fused/SPMD step programs emit tiny extra outputs (per-bucket
+grad/update/param norm-squares and a global nonfinite count — see
+optimizer/fused.py and optimizer/spmd.py) and hand the DEVICE arrays
+here via :meth:`HealthMonitor.on_step`.  The monitor:
+
+  * fetches them to host **asynchronously** on a daemon thread (the
+    step never blocks on a device sync; under the ``raise`` policy the
+    fetch is synchronous by design — that policy's whole point is to
+    stop the step);
+  * keeps a bounded ring of health samples and detector events;
+  * updates the declared metric families (``mx_grad_norm``,
+    ``mx_param_norm``, ``mx_update_ratio``, ``mx_nonfinite_total``,
+    ``mx_health_events_total``) — like mxprof's own gauges, these
+    update whenever a sample lands, telemetry flag or not;
+  * runs the rolling median/MAD spike detectors (grad norm, loss) and
+    the update/param ratio-drift check on the fetch thread.
+
+Locking: the producer-facing queue and the fetch-thread state live
+under separate locks, so the step path never waits behind detector
+math (see ``HealthMonitor.__init__``).  Samples are step-scale, never
+op-scale.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...base import MXNetError
+from .. import instruments as _ins
+from .detectors import RollingMAD, ratio_drift
+
+__all__ = ["HealthMonitor", "NonFiniteGradient", "POLICIES"]
+
+POLICIES = ("record", "raise", "skip_step")
+
+
+class NonFiniteGradient(MXNetError):
+    """Raised from the step under ``MXNET_HEALTH_POLICY=raise`` when
+    the in-graph counter saw nonfinite gradient values.  Raised BEFORE
+    the new weights/states are written back, so the parameters stay at
+    their pre-step values."""
+
+    def __init__(self, step: int, count: float, site: str):
+        super().__init__(
+            f"[mxhealth] {int(count)} nonfinite gradient value(s) at "
+            f"step {step} ({site}); params left at pre-step values")
+        self.step = step
+        self.count = count
+        self.site = site
+
+
+def _f(x) -> float:
+    """Device array / numpy / python scalar -> float (the host fetch)."""
+    return float(np.asarray(x))
+
+
+def _norm(sq_vec) -> float:
+    """sqrt(sum of norm-squares); a nonfinite contribution propagates
+    (a NaN'd bucket must show as a NaN norm, not be masked)."""
+    arr = np.asarray(sq_vec, dtype=np.float64)
+    return float(np.sqrt(arr.sum())) if arr.size else 0.0
+
+
+class HealthMonitor:
+    """Numerics telemetry sink + detector host.  One per process (the
+    package singleton in ``mxhealth.__init__``); tests build private
+    instances."""
+
+    def __init__(self, policy: str = "record", every: int = 1,
+                 window: int = 64, spike_k: float = 8.0,
+                 ratio_max: float = 0.1, ring: int = 512):
+        if policy not in POLICIES:
+            raise MXNetError(
+                f"mxhealth policy {policy!r} unknown; expected one of "
+                f"{POLICIES}")
+        self.policy = policy
+        self.every = max(1, int(every))
+        self.ratio_max = float(ratio_max)
+        # two locks by design: `_lock` guards ONLY the producer-facing
+        # queue/step counter (what the step path touches — appends and
+        # a counter bump, microseconds); `_state_lock` guards the
+        # rings/windows the fetch thread mutates with real work under
+        # it (a rolling-median sort).  One shared lock would stall the
+        # training step behind detector math — the overhead gate
+        # caught exactly that.
+        self._lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._samples: "deque[dict]" = deque(maxlen=max(1, int(ring)))
+        self._events: "deque[dict]" = deque(maxlen=max(1, int(ring)))
+        self._step = 0
+        self._grad_mad = RollingMAD(window=window, k=spike_k)
+        self._loss_mad = RollingMAD(window=window, k=spike_k)
+        self._nonfinite_steps = 0
+        self._skipped_steps = 0
+        # async fetch plumbing: payloads queue here, one daemon thread
+        # drains — holding the device arrays costs nothing until the
+        # fetch thread touches them, so the step path never syncs
+        self._queue: "deque[tuple]" = deque()
+        self._queue_cap = max(1, int(ring))
+        self._fetch_dropped = 0
+        self._cv = threading.Condition(self._lock)
+        self._worker: Optional[threading.Thread] = None
+        self._inflight = 0
+
+    # ---- the step-path entry points ----------------------------------
+
+    def on_step(self, site: str, payload: Dict[str, object]) -> None:
+        """One step's health outputs.  ``payload`` carries device (or
+        host) arrays: ``gn2``/``un2``/``pn2`` norm-square vectors,
+        ``nonfinite`` scalar, and ``guarded`` (True when the in-graph
+        skip_step guard selected the outputs).  Called once per step by
+        the reporting replica; everything heavier than an append
+        happens on the fetch thread — except under the ``raise``
+        policy, whose sync check is the contract.  The cadence gate
+        applies to the async policies only: ``raise`` promises params
+        at their pre-step values, which a cadence-skipped step could
+        silently violate (the NaN would be written back and the raise
+        would fire steps later), so it checks EVERY step.  Under
+        ``skip_step`` every payload is enqueued too — the guard runs
+        every step, and a skip on a non-sampled step must still be
+        counted — but the fetch thread discards clean off-cadence
+        samples without recording them, so the cadence still bounds
+        what lands in the ring."""
+        with self._lock:
+            self._step += 1
+            step = self._step
+            on_cadence = not (step - 1) % self.every
+            if self.policy == "record" and not on_cadence:
+                return
+        if self.policy == "raise":
+            self._ingest(site, step, payload)  # may raise
+            return
+        if not on_cadence:
+            payload = dict(payload, sample=False)
+        self._enqueue((site, step, payload))
+
+    def observe_loss(self, value, step: Optional[int] = None) -> None:
+        """Feed one loss sample (device array or float) to the
+        loss-spike detector; fetched on the async thread like the step
+        payloads."""
+        self._enqueue(("loss", step or self._step, {"loss": value}))
+
+    def _enqueue(self, item) -> None:
+        """Hand one payload to the fetch thread.  The queue is BOUNDED
+        by the ring size — if a sick device wedges the fetch thread's
+        sync (or steps outrun it), the newest samples are dropped and
+        counted rather than pinning device arrays without bound (the
+        flat-memory promise the ring already makes)."""
+        with self._lock:
+            if len(self._queue) >= self._queue_cap:
+                self._fetch_dropped += 1
+                return
+            self._queue.append(item)
+            self._inflight += 1
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._drain, name="mxhealth-fetch",
+                    daemon=True)
+                self._worker.start()
+            self._cv.notify()
+
+    # ---- the fetch thread --------------------------------------------
+
+    def _drain(self) -> None:
+        while True:
+            with self._lock:
+                while not self._queue:
+                    # idle fetch threads park; a 60s patience then exit
+                    # keeps a finished process from pinning the thread.
+                    # Condition.wait RELEASES the lock while parked —
+                    # the canonical CV idiom, not a held-lock block
+                    if not self._cv.wait(timeout=60.0):  # mxlint: disable=MX008
+                        return
+                site, step, payload = self._queue.popleft()
+            try:
+                self._ingest(site, step, payload)
+            except Exception:  # noqa: BLE001 — a fetch must never kill training
+                pass
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Block until every queued payload is ingested (tests, dumps).
+        Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self._queue or self._inflight:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                # Condition.wait releases the lock while parked (the
+                # canonical CV idiom — producers are never stalled)
+                self._cv.wait(timeout=left)  # mxlint: disable=MX008
+        return True
+
+    # ---- ingestion + detectors ---------------------------------------
+
+    def _event(self, kind: str, step: int, detail: dict) -> dict:
+        ev = {"t": time.time(), "step": step, "kind": kind, **detail}
+        self._events.append(ev)
+        _ins.health_events_total(kind).inc()
+        return ev
+
+    def _ingest(self, site: str, step: int,
+                payload: Dict[str, object]) -> None:
+        if site == "loss":
+            loss = _f(payload["loss"])
+            with self._state_lock:
+                if not math.isfinite(loss):
+                    self._event("loss-nonfinite", step,
+                                {"value": loss})
+                    return
+                spike = self._loss_mad.update(loss)
+                if spike is not None:
+                    self._event("loss-spike", step, spike)
+            return
+        nf = _f(payload.get("nonfinite", 0.0))
+        if not nf and not payload.get("sample", True):
+            # clean off-cadence payload (skip_step enqueues every step
+            # so a guard rejection is never invisible): nothing to
+            # record, the cadence still bounds the ring
+            return
+        gn = _norm(payload.get("gn2", ()))
+        un = _norm(payload.get("un2", ()))
+        pn = _norm(payload.get("pn2", ()))
+        guarded = bool(payload.get("guarded"))
+        sample = {"t": time.time(), "step": step, "site": site,
+                  "grad_norm": gn, "update_norm": un, "param_norm": pn,
+                  "nonfinite": nf, "guarded": guarded}
+        _ins.grad_norm().set(gn)
+        _ins.param_norm().set(pn)
+        if pn > 0 and math.isfinite(un):
+            _ins.update_ratio().set(un / pn)
+        if nf:
+            _ins.nonfinite_total().inc(nf)
+        with self._state_lock:
+            self._samples.append(sample)
+            if nf:
+                self._nonfinite_steps += 1
+                self._event("nonfinite", step,
+                            {"count": nf, "site": site,
+                             "action": self.policy})
+                if guarded:
+                    self._skipped_steps += 1
+                    _ins.health_steps_skipped_total().inc()
+                if self.policy == "raise":
+                    raise NonFiniteGradient(step, nf, site)
+                return  # NaN norms must not poison the spike windows
+            if math.isfinite(gn):
+                spike = self._grad_mad.update(gn)
+                if spike is not None:
+                    self._event("grad-spike", step, spike)
+            drift = ratio_drift(un, pn, self.ratio_max)
+            if drift is not None:
+                self._event("update-ratio", step, drift)
+
+    def record_straggler(self, step: int, detail: dict) -> None:
+        """Straggler findings come from merged traces (tools), not the
+        step path — recorded through the same event ring so one report
+        carries everything."""
+        with self._state_lock:
+            self._event("straggler", step, detail)
+
+    # ---- introspection -----------------------------------------------
+
+    def step_count(self) -> int:
+        with self._lock:
+            return self._step
+
+    def samples(self) -> List[dict]:
+        with self._state_lock:
+            return list(self._samples)
+
+    def events(self, kind: Optional[str] = None) -> List[dict]:
+        with self._state_lock:
+            evs = list(self._events)
+        return evs if kind is None else [e for e in evs
+                                         if e["kind"] == kind]
+
+    def verdict(self) -> str:
+        """One word: 'healthy' (no detector fired), 'degraded' (spikes
+        or drift, training continued), 'unhealthy' (nonfinite
+        gradients seen)."""
+        with self._state_lock:
+            if self._nonfinite_steps:
+                return "unhealthy"
+            return "degraded" if self._events else "healthy"
+
+    def report(self, flush_timeout: float = 5.0) -> dict:
+        """The per-run health report (what tools/health_report.py and
+        HEALTH.json embed).  ``flush_timeout=0`` renders from the
+        already-fetched state — the /statusz path uses it, because a
+        diagnostics page must not stall behind the wedged device sync
+        it exists to diagnose."""
+        if flush_timeout > 0:
+            self.flush(timeout=flush_timeout)
+        with self._state_lock:
+            last = self._samples[-1] if self._samples else None
+            return {
+                "policy": self.policy,
+                "every": self.every,
+                "steps_observed": self._step,
+                "samples_fetched": len(self._samples),
+                "fetch_dropped": self._fetch_dropped,
+                "nonfinite_steps": self._nonfinite_steps,
+                "skipped_steps": self._skipped_steps,
+                "last_sample": dict(last) if last else None,
+                "events": [dict(e) for e in self._events],
+                "verdict": ("unhealthy" if self._nonfinite_steps else
+                            "degraded" if self._events else "healthy"),
+            }

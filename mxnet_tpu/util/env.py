@@ -290,6 +290,46 @@ declare("MXNET_RETRY_MAX_MS", float, 2000.0,
         "Retry policy: backoff delay ceiling in milliseconds.")
 
 # -- observability ----------------------------------------------------------
+declare("MXNET_HEALTH", bool, False,
+        "Enable mxhealth, the in-graph numerics telemetry layer, at "
+        "import: the fused/SPMD step programs additionally emit "
+        "grad/update/param norms and a global nonfinite count as tiny "
+        "extra outputs of the already-compiled step (no extra "
+        "dispatch). mxhealth.enable() does the same at runtime. See "
+        "docs/observability.md (Training health).")
+declare("MXNET_HEALTH_ALERT_TICK_MS", float, 1000.0,
+        "Interval of the alert-engine background ticker "
+        "(telemetry.alerts.AlertEngine.start()) in milliseconds.")
+declare("MXNET_HEALTH_EVERY", int, 1,
+        "Host-fetch cadence of the mxhealth numerics outputs: every "
+        "Nth step's norms/nonfinite-count are handed to the monitor "
+        "(asynchronously — the step never blocks on the fetch). The "
+        "in-graph skip_step guard runs EVERY step regardless, and "
+        "the raise policy checks every step synchronously (a "
+        "cadence-skipped NaN step would otherwise be written back "
+        "before the raise).")
+declare("MXNET_HEALTH_POLICY", str, "record",
+        "What a nonfinite gradient step does: 'record' (event + "
+        "metrics only), 'raise' (NonFiniteGradient from Trainer.step, "
+        "params left at their pre-step values), or 'skip_step' "
+        "(in-graph guard keeps params AND optimizer states "
+        "bit-identical to the pre-step values, training continues).")
+declare("MXNET_HEALTH_RATIO_MAX", float, 0.1,
+        "Update/param-ratio drift threshold: a health sample whose "
+        "update-norm / param-norm exceeds this records an "
+        "'update-ratio' event (a healthy step moves parameters by a "
+        "small fraction of their magnitude). 0 disables the check.")
+declare("MXNET_HEALTH_RING", int, 512,
+        "mxhealth bounded history: the last N health samples and the "
+        "last N detector events are kept; memory is flat no matter "
+        "how long the job runs.")
+declare("MXNET_HEALTH_SPIKE_K", float, 8.0,
+        "Rolling median/MAD spike threshold: a loss or grad-norm "
+        "sample more than K median-absolute-deviations above the "
+        "rolling median records a spike event.")
+declare("MXNET_HEALTH_WINDOW", int, 64,
+        "Window (samples) of the rolling median/MAD spike detectors "
+        "for loss and grad-norm.")
 declare("MXNET_PROFILER_AUTOSTART", bool, False,
         "Start the chrome-trace profiler at import (ref: "
         "MXNET_PROFILER_AUTOSTART).")

@@ -22,13 +22,25 @@ def _load():
 pc = _load()
 
 
-def _scaling(tp2=1.28, check_ok=True, parity_ok=True):
+def _scaling(tp2=1.28, check_ok=True, parity_ok=True, mfu=None,
+             data_wait=None):
+    row2 = {"path": "spmd", "processes": 2, "global_throughput": tp2}
+    if mfu is not None:
+        row2["mfu"] = {"mean": mfu}
+    if data_wait is not None:
+        row2["data_wait_s"] = data_wait
     return {"sweep": [
         {"path": "spmd", "processes": 1, "global_throughput": 1.0,
          "trace_check_ok": True,
          "merged_trace": {"check_ok": check_ok}},
-        {"path": "spmd", "processes": 2, "global_throughput": tp2},
+        row2,
     ], "parity": {"ok": parity_ok}}
+
+
+def _health(gate_ok=True, skip_ok=True):
+    return {"gate_ok": gate_ok,
+            "stages": {"clean_run": {"ok": True},
+                       "nonfinite_skip": {"ok": skip_ok}}}
 
 
 class TestCompareArtifact:
@@ -93,6 +105,75 @@ class TestCompareArtifact:
                                   tolerance=0.10)
         assert not res["ok"]
         assert "gate_ok" in res["new_integrity_failures"][0]
+
+    def test_mfu_regression_fails_even_with_flat_throughput(self):
+        """ISSUE 11 satellite: an attribution regression (MFU drop)
+        fails the gate even when samples/s look unchanged."""
+        res = pc.compare_artifact("SCALING.json",
+                                  _scaling(mfu=0.40),
+                                  _scaling(mfu=0.20),
+                                  tolerance=0.10)
+        assert not res["ok"]
+        assert "mfu" in res["regressions"][0]
+
+    def test_mfu_collapse_to_zero_still_gates(self):
+        """0.0 is a collapse, not an absent lane — the falsy-zero trap
+        must not drop it from the extractor."""
+        res = pc.compare_artifact("SCALING.json",
+                                  _scaling(mfu=0.40),
+                                  _scaling(mfu=0.0),
+                                  tolerance=0.10)
+        assert not res["ok"]
+        assert "mfu" in res["regressions"][0]
+
+    def test_data_wait_growth_fails(self):
+        res = pc.compare_artifact("SCALING.json",
+                                  _scaling(data_wait=0.10),
+                                  _scaling(data_wait=0.50),
+                                  tolerance=0.10)
+        assert not res["ok"]
+        assert "data_wait_s" in res["regressions"][0]
+
+    def test_data_wait_noise_under_floor_passes(self):
+        """Microsecond-scale data-wait growth on an idle box must not
+        flap the gate: the absolute floor (0.05s) gates out timer
+        noise that is huge in relative terms."""
+        res = pc.compare_artifact("SCALING.json",
+                                  _scaling(data_wait=0.0001),
+                                  _scaling(data_wait=0.002),
+                                  tolerance=0.10)
+        assert res["ok"]
+
+    def test_data_wait_improvement_passes(self):
+        res = pc.compare_artifact("SCALING.json",
+                                  _scaling(data_wait=0.50),
+                                  _scaling(data_wait=0.10),
+                                  tolerance=0.10)
+        assert res["ok"]
+
+    def test_health_strict_never_grandfathered(self):
+        """HEALTH.json lanes are strict: a false verdict fails even
+        when the committed baseline was ALREADY false."""
+        res = pc.compare_artifact("HEALTH.json",
+                                  _health(gate_ok=False),
+                                  _health(gate_ok=False),
+                                  tolerance=0.10)
+        assert not res["ok"]
+        assert "strict health lane" in \
+            res["new_integrity_failures"][0]
+
+    def test_health_stage_lane_gates(self):
+        res = pc.compare_artifact("HEALTH.json", _health(),
+                                  _health(skip_ok=False),
+                                  tolerance=0.10)
+        assert not res["ok"]
+        assert "stages.nonfinite_skip.ok" in \
+            res["new_integrity_failures"][0]
+
+    def test_health_clean_passes(self):
+        res = pc.compare_artifact("HEALTH.json", _health(), _health(),
+                                  tolerance=0.10)
+        assert res["ok"]
 
     def test_serving_extractor(self):
         b = {"unbatched": {"qps": 588.7}, "batched": {"qps": 987.9},

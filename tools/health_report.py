@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""Per-run health verdict: exercise the mxhealth detection paths and
+the alert engine against known-answer scenarios, write HEALTH.json.
+
+The nightly runs this (tools/run_nightly.py, health stage) and
+perf_compare gates on it with STRICT lanes — a health stage that stops
+detecting is never grandfathered.  Stages:
+
+  * ``clean_run``       — a small healthy training run must come out
+                          verdict "healthy" with finite norms sampled;
+  * ``nonfinite_record``/``nonfinite_raise``/``nonfinite_skip`` — a
+                          chaos-seeded NaN gradient at a chosen step
+                          must be detected on EXACTLY that step under
+                          each policy; ``skip_step``'s params must be
+                          bit-identical (np.array_equal) to an
+                          uninterrupted twin trained without the
+                          corrupted batch;
+  * ``alert_engine``    — a synthetic metric scenario must fire after
+                          its for-duration and clear on recovery;
+  * ``straggler``       — the merged-trace straggler detector must
+                          flag a known straggling rank (synthetic skew
+                          table; pass ``--traces r0.json r1.json`` to
+                          analyze real per-rank dumps instead).
+
+    python tools/health_report.py --out HEALTH.json
+    python tools/health_report.py --no-gate        # tier-1 smoke
+    python tools/health_report.py --traces r0.json r1.json
+
+Exit: 0 when gate_ok (or --no-gate), 1 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+STEPS = 6
+INJECT_AT = 3
+
+
+def _train(policy, inject_at=None, drop=None, steps=STEPS,
+           lr=1e-3):
+    """One tiny fused-path run under mxhealth; returns (monitor,
+    raised_exc, params)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon import Trainer, nn
+    from mxnet_tpu.resilience import chaos
+    from mxnet_tpu.telemetry import mxhealth
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.Dense(4, in_units=16)
+    net.initialize()
+    tr = Trainer(net.collect_params(), "sgd",
+                 {"learning_rate": lr, "momentum": 0.9})
+    batches = [nd.array(np.random.rand(8, 16).astype("float32"))
+               for _ in range(steps)]
+    mon = mxhealth.enable(policy=policy, every=1, fresh=True)
+    err = None
+    scope = chaos.inject("trainer.numerics", at=inject_at) \
+        if inject_at else None
+    try:
+        if scope is not None:
+            scope.__enter__()
+        for i, x in enumerate(batches):
+            if drop is not None and i + 1 == drop:
+                continue  # the twin simply never sees this batch
+            with autograd.record():
+                loss = (net(x) ** 2).sum()
+            loss.backward()
+            try:
+                tr.step(8)
+            except mxhealth.NonFiniteGradient as e:
+                err = e
+                break
+    finally:
+        if scope is not None:
+            scope.__exit__(None, None, None)
+    mxhealth.flush()
+    params = [p.data().asnumpy()
+              for p in net.collect_params().values()]
+    return mon, err, params
+
+
+def stage_clean_run():
+    import math
+
+    mon, err, _ = _train("record")
+    rep = mon.report()
+    ok = (err is None and rep["verdict"] == "healthy"
+          and rep["samples_fetched"] == STEPS
+          and rep["last_sample"] is not None
+          and math.isfinite(rep["last_sample"]["grad_norm"]))
+    return {"ok": ok, "verdict": rep["verdict"],
+            "steps": rep["steps_observed"],
+            "last_sample": rep["last_sample"]}
+
+
+def stage_nonfinite(policy):
+    import numpy as np
+
+    mon, err, params = _train(policy, inject_at=INJECT_AT)
+    evs = mon.events("nonfinite")
+    detected_at = [e["step"] for e in evs]
+    out = {"policy": policy, "injected_at": INJECT_AT,
+           "detected_at": detected_at}
+    if policy == "raise":
+        out["ok"] = (err is not None and err.step == INJECT_AT
+                     and detected_at[:1] == [INJECT_AT])
+        out["raised_step"] = getattr(err, "step", None)
+        return out
+    detected_exact = bool(detected_at) and detected_at[0] == INJECT_AT
+    if policy == "skip_step":
+        # one detection, one skip, nothing after (the guard kept the
+        # NaN out of the params, so later steps are clean) — and the
+        # params are bit-identical to a twin that never saw the
+        # corrupted batch
+        _, _, twin = _train(policy, drop=INJECT_AT)
+        bit_ok = len(params) == len(twin) and all(
+            np.array_equal(a, b) for a, b in zip(params, twin))
+        out.update({
+            "ok": (detected_exact and detected_at == [INJECT_AT]
+                   and mon.report()["skipped_steps"] == 1 and bit_ok),
+            "skipped_steps": mon.report()["skipped_steps"],
+            "bit_consistent_with_twin": bit_ok})
+        return out
+    # record: detection starts at the injected step (and cascades —
+    # the NaN params keep producing NaN grads, which is the point of
+    # the policy spectrum)
+    out["ok"] = detected_exact and err is None
+    return out
+
+
+def stage_alert_engine():
+    from mxnet_tpu.telemetry import alerts, instruments as _ins
+
+    clock = [0.0]
+    eng = alerts.AlertEngine(clock=lambda: clock[0])
+    g = _ins.serving_queue_depth("health-report", 1)
+    g.set(0)
+    eng.add_rule("synthetic_queue", metric="mx_serving_queue_depth",
+                 labels={"model": "health-report"}, op=">",
+                 threshold=5, for_=2.0, severity="warning",
+                 description="synthetic HEALTH.json scenario")
+    fired_early = bool(eng.tick())
+    g.set(10)
+    pending_only = not eng.tick()         # true but inside for-window
+    clock[0] = 3.0
+    fired = [e for e in eng.tick() if e["state"] == "firing"]
+    firing_gauge = _ins.alerts_firing("synthetic_queue",
+                                      "warning").value
+    g.set(0)
+    resolved = [e for e in eng.tick() if e["state"] == "resolved"]
+    cleared_gauge = _ins.alerts_firing("synthetic_queue",
+                                       "warning").value
+    ok = (not fired_early and pending_only and len(fired) == 1
+          and firing_gauge == 1.0 and len(resolved) == 1
+          and cleared_gauge == 0.0)
+    return {"ok": ok, "events": eng.events()}
+
+
+def stage_straggler(trace_paths):
+    from mxnet_tpu.telemetry import mxhealth
+
+    if trace_paths:
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        import trace_report as tr
+
+        loaded = [tr.load_trace(p) for p in trace_paths]
+        _, info, errs = tr.merge_loaded(loaded)
+        found = mxhealth.stragglers_from_merge(info)
+        return {"ok": not errs, "traces": list(trace_paths),
+                "merge_violations": errs, "stragglers": found}
+    # synthetic known-answer skew table: rank 1 is 2x slower on the
+    # backward — the detector must flag exactly it
+    info = {"skew": [
+        {"cat": "training", "name": "backward",
+         "per_rank_ms": {"0": 100.0, "1": 200.0}, "skew_ms": 100.0,
+         "straggler": 1},
+        {"cat": "training", "name": "forward",
+         "per_rank_ms": {"0": 50.0, "1": 51.0}, "skew_ms": 1.0,
+         "straggler": 1},
+    ]}
+    found = mxhealth.stragglers_from_merge(info)
+    ok = (len(found) == 1 and found[0]["rank"] == 1
+          and found[0]["phase"] == "backward")
+    return {"ok": ok, "stragglers": found}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="exercise mxhealth + the alert engine, write the "
+                    "HEALTH.json verdict")
+    ap.add_argument("--out", default=os.path.join(_REPO, "HEALTH.json"))
+    ap.add_argument("--no-gate", action="store_true",
+                    help="write the artifact but exit 0 regardless "
+                         "(tier-1 smoke)")
+    ap.add_argument("--traces", nargs="*", default=None,
+                    help="per-rank trace dumps for a real straggler "
+                         "analysis (default: synthetic known-answer)")
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu.telemetry import mxhealth
+
+    t0 = time.time()
+    stages = {}
+    stages["clean_run"] = stage_clean_run()
+    for policy in ("record", "raise", "skip_step"):
+        key = f"nonfinite_{policy.replace('_step', '')}"
+        stages[key] = stage_nonfinite(policy)
+    stages["alert_engine"] = stage_alert_engine()
+    stages["straggler"] = stage_straggler(args.traces)
+    mxhealth.disable()
+
+    gate_ok = all(s.get("ok") for s in stages.values())
+    artifact = {
+        "metric": "training-health detection + alerting known-answer "
+                  "scenarios",
+        "when": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "duration_s": round(time.time() - t0, 1),
+        "stages": stages,
+        "gate_ok": gate_ok,
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({"gate_ok": gate_ok,
+                      "stages": {k: v["ok"]
+                                 for k, v in stages.items()}}))
+    print(f"wrote {args.out}")
+    if not gate_ok:
+        for k, v in stages.items():
+            if not v.get("ok"):
+                print(f"HEALTH GATE FAIL: stage {k}: {v}",
+                      file=sys.stderr)
+    return 0 if gate_ok or args.no_gate else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,18 +3,26 @@
 committed ones.
 
 The nightly refreshes the tracked bench artifacts (FUSED_BENCH.json,
-SCALING.json, SERVING_BENCH.json, COMPILE_CACHE.json) in the work
-tree; this tool compares each against the version committed at --ref
-(``git show REF:NAME``) and fails on
+SCALING.json, SERVING_BENCH.json, COMPILE_CACHE.json, HEALTH.json) in
+the work tree; this tool compares each against the version committed
+at --ref (``git show REF:NAME``) and fails on
 
   * a **throughput regression**: any tracked higher-is-better metric
-    (speedups, qps, samples/s) dropping more than ``--tolerance``
-    (default 10%) below its committed value, or
+    (speedups, qps, samples/s, MFU) dropping more than ``--tolerance``
+    (default 10%) below its committed value,
+  * an **attribution regression**: a lower-is-better metric (data-wait
+    seconds) growing more than the tolerance above its committed value
+    — so an input-pipeline stall fails the nightly even when
+    throughput happens to look flat,
   * a **new trace-integrity failure**: any ``trace_check_ok`` /
     ``merged_trace.check_ok`` / ``parity.ok`` / ``gate_ok`` verdict
     that was true in the committed artifact and is false in the fresh
     one (a verdict already false at the baseline is pre-existing, not
-    new).
+    new), or
+  * a **health failure** (HEALTH.json): ALL health check lanes are
+    strict — a false verdict fails even if the committed artifact was
+    already false.  A nonfinite step or a broken detection path is
+    never grandfathered.
 
 Artifacts missing on either side are reported and skipped — a bench
 stage that timed out must fail the nightly through its own return
@@ -34,27 +42,30 @@ import json
 import os
 import subprocess
 import sys
-from typing import Dict, Tuple
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 DEFAULT_ARTIFACTS = ("FUSED_BENCH.json", "SCALING.json",
-                     "SERVING_BENCH.json", "COMPILE_CACHE.json")
+                     "SERVING_BENCH.json", "COMPILE_CACHE.json",
+                     "HEALTH.json")
 
 
 # ---------------------------------------------------------------------------
-# per-artifact extractors: dict -> (higher_is_better metrics, bool checks)
+# per-artifact extractors: dict -> {"higher": {name: value},
+#   "lower": {name: value}, "checks": {name: bool}, "strict": bool}
+# "higher" gates on drops, "lower" on growth; "strict" checks fail on
+# ANY fresh false (health is never grandfathered).
 # ---------------------------------------------------------------------------
 
-def _fused(d) -> Tuple[Dict[str, float], Dict[str, bool]]:
+def _fused(d) -> dict:
     m = {}
     for n, row in d.get("sizes", {}).items():
         if "speedup" in row:
             m[f"sizes.{n}.speedup"] = row["speedup"]
-    return m, {}
+    return {"higher": m}
 
 
-def _serving(d) -> Tuple[Dict[str, float], Dict[str, bool]]:
+def _serving(d) -> dict:
     m = {}
     for mode in ("unbatched", "batched"):
         row = d.get(mode) or {}
@@ -62,10 +73,10 @@ def _serving(d) -> Tuple[Dict[str, float], Dict[str, bool]]:
             m[f"{mode}.qps"] = row["qps"]
     if "batched_over_unbatched" in d:
         m["batched_over_unbatched"] = d["batched_over_unbatched"]
-    return m, {}
+    return {"higher": m}
 
 
-def _compile_cache(d) -> Tuple[Dict[str, float], Dict[str, bool]]:
+def _compile_cache(d) -> dict:
     m = {}
     for site in ("serving", "fused"):
         row = d.get(site) or {}
@@ -74,15 +85,24 @@ def _compile_cache(d) -> Tuple[Dict[str, float], Dict[str, bool]]:
     c = {}
     if "gate_ok" in d:
         c["gate_ok"] = bool(d["gate_ok"])
-    return m, c
+    return {"higher": m, "checks": c}
 
 
-def _scaling(d) -> Tuple[Dict[str, float], Dict[str, bool]]:
-    m, c = {}, {}
+def _scaling(d) -> dict:
+    m, lo, c = {}, {}, {}
     for r in d.get("sweep", []):
         key = f"{r.get('path', '?')}.{r.get('processes', '?')}proc"
         if "global_throughput" in r:
             m[f"{key}.global_throughput"] = r["global_throughput"]
+        # attribution lanes: MFU and data-wait gate independently of
+        # throughput — a regression that hides behind a flat samples/s
+        # reading (e.g. bigger batches masking an input stall) still
+        # fails the nightly
+        mfu = (r.get("mfu") or {}).get("mean")
+        if mfu is not None:  # 0.0 is a collapse, not an absent lane
+            m[f"{key}.mfu"] = mfu
+        if r.get("data_wait_s") is not None:
+            lo[f"{key}.data_wait_s"] = r["data_wait_s"]
         if "trace_check_ok" in r:
             c[f"{key}.trace_check_ok"] = bool(r["trace_check_ok"])
         mt = r.get("merged_trace")
@@ -91,7 +111,19 @@ def _scaling(d) -> Tuple[Dict[str, float], Dict[str, bool]]:
     p = d.get("parity")
     if isinstance(p, dict) and "ok" in p:
         c["parity.ok"] = bool(p["ok"])
-    return m, c
+    return {"higher": m, "lower": lo, "checks": c}
+
+
+def _health(d) -> dict:
+    """HEALTH.json: check lanes only, ALL strict — a health failure is
+    never grandfathered by a bad baseline."""
+    c = {}
+    if "gate_ok" in d:
+        c["gate_ok"] = bool(d["gate_ok"])
+    for stage, row in (d.get("stages") or {}).items():
+        if isinstance(row, dict) and "ok" in row:
+            c[f"stages.{stage}.ok"] = bool(row["ok"])
+    return {"checks": c, "strict": True}
 
 
 EXTRACTORS = {
@@ -99,6 +131,7 @@ EXTRACTORS = {
     "SERVING_BENCH.json": _serving,
     "COMPILE_CACHE.json": _compile_cache,
     "SCALING.json": _scaling,
+    "HEALTH.json": _health,
 }
 
 
@@ -112,8 +145,11 @@ def compare_artifact(name: str, base: dict, fresh: dict,
     Only metrics present on BOTH sides gate (a renamed/new lane has no
     baseline to regress from)."""
     extract = EXTRACTORS[name]
-    bm, bc = extract(base)
-    fm, fc = extract(fresh)
+    be, fe = extract(base), extract(fresh)
+    bm, fm = be.get("higher", {}), fe.get("higher", {})
+    bl, fl = be.get("lower", {}), fe.get("lower", {})
+    bc, fc = be.get("checks", {}), fe.get("checks", {})
+    strict = fe.get("strict", False)
     regressions, rows = [], []
     for k in sorted(set(bm) & set(fm)):
         b, f = float(bm[k]), float(fm[k])
@@ -127,11 +163,32 @@ def compare_artifact(name: str, base: dict, fresh: dict,
                 f"({(1 - f / b) * 100:.1f}% drop > "
                 f"{tolerance * 100:.0f}% tolerance)")
         rows.append(row)
+    for k in sorted(set(bl) & set(fl)):
+        b, f = float(bl[k]), float(fl[k])
+        ratio = (f / b) if b else None
+        row = {"metric": k, "baseline": b, "fresh": f, "lower_is_better":
+               True, "ratio": None if ratio is None else round(ratio, 4)}
+        # lower-is-better (data-wait): growth past the tolerance fails;
+        # an absolute floor keeps microsecond noise on an idle box from
+        # flapping the gate (0.05s of NEW data-wait is a real stall)
+        if f > b * (1.0 + tolerance) and f - b > 0.05:
+            row["regression"] = True
+            regressions.append(
+                f"{name}: {k} {b:g} -> {f:g} "
+                f"({(f / b - 1) * 100:.1f}% growth > "
+                f"{tolerance * 100:.0f}% tolerance)" if b > 0 else
+                f"{name}: {k} {b:g} -> {f:g} (new stall)")
+        rows.append(row)
     new_failures = []
     for k in sorted(set(bc) & set(fc)):
         if bc[k] and not fc[k]:
             new_failures.append(f"{name}: {k} was true at baseline, "
                                 f"false in the fresh run")
+        elif strict and not fc[k]:
+            # health lanes: a false verdict fails even when the
+            # baseline was already false — never grandfathered
+            new_failures.append(f"{name}: {k} false in the fresh run "
+                                f"(strict health lane)")
     # a check lane that only exists fresh (e.g. first --phases run)
     # still hard-fails when false: integrity is never grandfathered in
     for k in sorted(set(fc) - set(bc)):

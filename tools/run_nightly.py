@@ -409,10 +409,47 @@ def main():
         mxprof_rc = -1
         artifact["mxprof"] = {"returncode": -1, "note": "timed out"}
 
+    # health stage (ISSUE 11): the slow mxhealth e2e (2-proc straggler
+    # detection on merged traces, alert-engine soak, real serving p99
+    # breach) plus the strict known-answer health run — HEALTH.json is
+    # the tracked artifact and perf_compare gates it with STRICT lanes
+    # (a broken detection path is never grandfathered)
+    health_rc = None
+    try:
+        hsl = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests/test_mxhealth.py",
+             "-q", "-m", "slow", "-p", "no:cacheprovider"],
+            capture_output=True, text=True, timeout=900, cwd=_REPO,
+            env=cpu_env)
+        hr = subprocess.run(
+            [sys.executable, "tools/health_report.py",
+             "--out", os.path.join(_REPO, "HEALTH.json")],
+            capture_output=True, text=True, timeout=600, cwd=_REPO,
+            env=cpu_env)
+        health_rc = hr.returncode if hr.returncode != 0 \
+            else hsl.returncode
+        gate = {"returncode": hr.returncode,
+                "slow_tests_returncode": hsl.returncode,
+                "slow_tests_tail":
+                    "\n".join(hsl.stdout.splitlines()[-1:]),
+                "stderr_tail": "\n".join(hr.stderr.splitlines()[-6:])}
+        try:
+            rep = json.loads([ln for ln in hr.stdout.splitlines()
+                              if ln.startswith("{")][-1])
+            gate["gate_ok"] = rep["gate_ok"]
+            gate["stages"] = rep["stages"]
+        except (IndexError, ValueError, KeyError):
+            pass
+        artifact["health"] = gate
+    except subprocess.TimeoutExpired:
+        health_rc = -1
+        artifact["health"] = {"returncode": -1, "note": "timed out"}
+
     # perf-compare gate (ISSUE 10): the bench artifacts this nightly
-    # just refreshed (FUSED/SCALING/COMPILE_CACHE; SERVING when its
-    # strict lane rewrote it) vs the committed versions — >10%
-    # throughput drop or a NEW trace-integrity failure fails the run.
+    # just refreshed (FUSED/SCALING/COMPILE_CACHE/HEALTH; SERVING when
+    # its strict lane rewrote it) vs the committed versions — >10%
+    # throughput drop, MFU/data-wait attribution regression, or a NEW
+    # trace-integrity/health failure fails the run.
     # Runs LAST so every refresh above has landed in the work tree.
     perf_rc = None
     try:
@@ -441,7 +478,8 @@ def main():
         and mxlint_rc in (None, 0) and san_rc in (None, 0) \
         and resil_rc in (None, 0) and cc_rc in (None, 0) \
         and spmd_rc in (None, 0) and heavy_rc in (None, 0) \
-        and mxprof_rc in (None, 0) and perf_rc in (None, 0) else 1
+        and mxprof_rc in (None, 0) and health_rc in (None, 0) \
+        and perf_rc in (None, 0) else 1
 
 
 if __name__ == "__main__":

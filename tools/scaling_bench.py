@@ -183,6 +183,10 @@ def _phase_report(trace_path):
         "hbm_peak_bytes": {dev: row["peak_bytes"]
                            for dev, row in snap["hbm"].items()},
         "verdicts": snap["summary"].get("verdicts", {}),
+        # attribution lane for perf_compare: input-pipeline stall
+        # seconds over the measured steps (lower is better; gates
+        # independently of throughput)
+        "data_wait_s": snap["summary"].get("data_wait_s_total", 0.0),
     }
     state = snap.get("optimizer_state_bytes_per_device")
     if state:
