@@ -167,6 +167,13 @@ class CompileCache:
         with self._lock:
             self._stats["misses"] += 1
         _ins.compile_cache_miss_total(site).inc()
+        # compile provenance (telemetry.mxtriage): every miss records
+        # WHICH signature component changed vs the nearest prior
+        # compile at this site — the recompile-storm diagnosis layer
+        # (record_miss never raises)
+        from ..telemetry.mxtriage import provenance as _prov
+
+        _prov.record_miss(site, key)
         compiled = compile_fn()
         self._mem_put(digest, compiled, touch=digest)
         if adig is not None:

@@ -114,15 +114,45 @@ class CacheKey:
     (``serving.bucket``, ``optimizer.fused_step``, ``ops.jit``) kept in
     the entry header for operability — it is part of the digest too, so
     two sites never collide even on identical programs (their calling
-    conventions may differ)."""
+    conventions may differ).
 
-    __slots__ = ("site", "parts", "program_text", "_digest")
+    ``components`` is an optional NAMED view of the same identity
+    (``{"avals": ..., "statics": ..., "donation": ...}``) consumed by
+    the compile-provenance layer (telemetry.mxtriage.provenance): a
+    cache miss diffs these against the nearest prior signature at the
+    same site so the recorded reason can say *which component* changed.
+    It never feeds the digest — ``parts`` (plus program text and the
+    env fingerprint) remain the sole identity."""
 
-    def __init__(self, site: str, parts: Tuple, program_text: Optional[str] = None):
+    __slots__ = ("site", "parts", "program_text", "components",
+                 "_digest")
+
+    def __init__(self, site: str, parts: Tuple,
+                 program_text: Optional[str] = None,
+                 components: Optional[dict] = None):
         self.site = site
         self.parts = parts
         self.program_text = program_text
+        self.components = components
         self._digest: Optional[str] = None
+
+    def component_digests(self) -> "dict[str, str]":
+        """Per-component content digests for provenance diffing.  The
+        named ``components`` when the call site provided them, else
+        positional ``part<i>`` names; the env fingerprint always rides
+        as ``env`` and the lowered program (when present) as
+        ``program`` — both are real miss causes (an upgrade, a code
+        change) a diff must be able to name."""
+        comps = dict(self.components) if self.components else {
+            f"part{i}": p for i, p in enumerate(self.parts)}
+        out = {name: hashlib.sha256(_canon(v).encode()).hexdigest()
+               for name, v in comps.items()}
+        out["env"] = hashlib.sha256(
+            "\x1f".join(env_fingerprint()).encode()).hexdigest()
+        if self.program_text is not None:
+            out["program"] = hashlib.sha256(
+                self.program_text.encode()).hexdigest()
+        return out
 
     @property
     def digest(self) -> str:
@@ -146,6 +176,8 @@ class CacheKey:
 
 
 def cache_key(site: str, parts: Tuple,
-              program_text: Optional[str] = None) -> CacheKey:
+              program_text: Optional[str] = None,
+              components: Optional[dict] = None) -> CacheKey:
     """Build a :class:`CacheKey` (the one constructor call sites use)."""
-    return CacheKey(site, tuple(parts), program_text)
+    return CacheKey(site, tuple(parts), program_text,
+                    components=components)

@@ -386,7 +386,8 @@ class _AotDispatch:
                     self._site,
                     lambda: _cc.cache_key(
                         "ops", parts=(self._ckey, sig),
-                        program_text=lowered().as_text()),
+                        program_text=lowered().as_text(),
+                        components={"op": self._ckey, "avals": sig}),
                     lambda: lowered().compile(), alias=alias)
                 _mxsan.record_compile(
                     self._site, (self._ckey, sig),

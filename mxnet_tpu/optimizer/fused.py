@@ -71,14 +71,17 @@ class _Entry:
     eviction scan under the cache lock reads it).  ``cost`` is the
     executable's static cost analysis (mxprof MFU accounting), captured
     once at insert time for fresh builds AND persistent-cache loads
-    alike — a warm restart keeps its cost metadata."""
+    alike — a warm restart keeps its cost metadata.  ``fingerprint``
+    is the HLO-module identity riding beside it (mxtriage regression
+    attribution: "did the compiled program change")."""
 
-    __slots__ = ("fn", "tick", "cost")
+    __slots__ = ("fn", "tick", "cost", "fingerprint")
 
-    def __init__(self, fn, cost=None):
+    def __init__(self, fn, cost=None, fingerprint=None):
         self.fn = fn
         self.tick = next(_TICKS)
         self.cost = cost
+        self.fingerprint = fingerprint
 
 
 class ExecutableCache:
@@ -128,20 +131,38 @@ class ExecutableCache:
         ent = self.data.get(sig)
         return ent.cost if ent is not None else None
 
+    def fingerprint(self, sig):
+        """The cached executable's HLO-module fingerprint, or None —
+        lock-free like cost (written once at insert)."""
+        ent = self.data.get(sig)
+        return ent.fingerprint if ent is not None else None
+
     def stats(self) -> Dict[str, float]:
         with self.lock:
             return {"count": self.compiles, "seconds_total": self.seconds,
                     "cache_loads": self.cache_loads,
                     "evictions": self.evictions, "size": len(self.data)}
 
-    def compile(self, sig, build_lowered, optimizer, alias_ok=True):
+    def compile(self, sig, build_lowered, optimizer, alias_ok=True,
+                components=None):
         """Build (or load from the persistent store) the executable for
         ``sig``; insert, LRU-evict past MXNET_FUSED_CACHE_MAX, count.
         ``alias_ok=False`` forces the program-text key even for
         first-party optimizers — required when the program embeds USER
         code (e.g. the SPMD trainer's model forward), which the
-        framework version cannot pin."""
+        framework version cannot pin.  ``components`` is the NAMED view
+        of ``sig`` for compile provenance — with the persistent cache
+        off (the default), the provenance diff is recorded here, since
+        reaching this method already means the site cache missed."""
         t0 = time.perf_counter()
+        cell = {}
+
+        def text():
+            t = cell.get("text")
+            if t is None:
+                t = cell["text"] = build_lowered().as_text()
+            return t
+
         if _cc.enabled():
             alias = _cc.cache_key(
                 f"{self.site}.alias", parts=(sig,)) \
@@ -150,27 +171,36 @@ class ExecutableCache:
 
             def full_key():
                 return _cc.cache_key(
-                    self.site, parts=(sig,),
-                    program_text=build_lowered().as_text())
+                    self.site, parts=(sig,), program_text=text(),
+                    components=components)
 
             compiled, origin = _cc.get_or_compile(
                 self.site, full_key,
                 lambda: build_lowered().compile(), alias=alias)
         else:
+            from ..telemetry.mxtriage import provenance as _prov
+
+            # record_miss never raises — diagnostics can't break a build
+            _prov.record_miss(self.site, _cc.cache_key(
+                self.site, parts=(sig,), components=components))
             compiled, origin = build_lowered().compile(), "compiled"
         dt = time.perf_counter() - t0
         # static cost analysis for MFU accounting — computed on the
         # executable object, so a persistent-cache load (origin
-        # "memory"/"disk") carries the same metadata as a fresh build
+        # "memory"/"disk") carries the same metadata as a fresh build;
+        # the HLO fingerprint rides beside it (rendered text is reused
+        # when the key path already produced it)
         cost = _costs.executable_cost(compiled)
-        _costs.note(self.site, repr(hash(sig)), cost)
+        fp = _costs.hlo_fingerprint(compiled,
+                                    program_text=cell.get("text"))
+        _costs.note(self.site, repr(hash(sig)), cost, fingerprint=fp)
         with self.lock:
             # a concurrent compile of the same signature may have won;
             # keep the first so the compile count matches the cache
             prior = self.data.get(sig)
             if prior is not None:
                 return prior.fn
-            self.data[sig] = _Entry(compiled, cost)
+            self.data[sig] = _Entry(compiled, cost, fp)
             if origin == "compiled":
                 self.compiles += 1
                 self.seconds += dt
@@ -467,4 +497,11 @@ class FusedUpdater(Updater):
                 lowered = cell["lowered"] = jitted.lower(*args)
             return lowered
 
-        return _FUSED_CACHE.compile(sig, build_lowered, self.optimizer)
+        # the NAMED sig view compile provenance diffs a miss against
+        # (sig layout: see the tuple built in update_multi)
+        components = {"optimizer": sig[0], "statics": sig[1],
+                      "mp": sig[2], "donation": sig[3],
+                      "device": sig[4], "health_mode": sig[5],
+                      "treedef": sig[6], "avals": sig[7]}
+        return _FUSED_CACHE.compile(sig, build_lowered, self.optimizer,
+                                    components=components)

@@ -733,7 +733,16 @@ class SpmdUpdater(Updater):
                 lowered = cell["lowered"] = jitted.lower(*args)
             return lowered
 
-        return _SPMD_CACHE.compile(sig, build_lowered, self.optimizer)
+        # named sig view for compile provenance (sig layout: the tuple
+        # built in update_multi above)
+        components = {"optimizer": sig[0], "statics": sig[1],
+                      "mp": sig[2], "metas": sig[3], "plan": sig[4],
+                      "flat": sig[5], "donation": sig[6],
+                      "layout": sig[7], "health_mode": sig[8],
+                      "devices": sig[9], "treedef": sig[10],
+                      "avals": sig[11]}
+        return _SPMD_CACHE.compile(sig, build_lowered, self.optimizer,
+                                   components=components)
 
     # ---- phased variant (tracing only) -----------------------------------
     def _run_phased(self, sig, args, mp_flags, metas):

@@ -567,8 +567,19 @@ class SPMDTrainer:
                    tuple(opt_mod.fused._leaf_aval(x) for x in leaves))
             fn = _STEP_CACHE.lookup(sig)
             if fn is None:
+                # named sig view for compile provenance (same order as
+                # the sig tuple above)
+                components = {
+                    "block": sig[1:4], "mults": sig[4],
+                    "optimizer": sig[5], "statics": sig[6],
+                    "flat_groups": sig[7], "remat": sig[8],
+                    "layout": sig[9], "devices": sig[10],
+                    "zero": sig[11], "donation": sig[12],
+                    "treedef": sig[13], "avals": sig[14]}
                 fn = _STEP_CACHE.compile(sig, build_lowered,
-                                         self._optimizer, alias_ok=False)
+                                         self._optimizer,
+                                         alias_ok=False,
+                                         components=components)
             # per-trainer fast path keyed by input avals: a batch-shape
             # change rebuilds (AOT does not silently retrace), a repeat
             # shape is one dict hit.  The executable's static cost

@@ -41,7 +41,6 @@ _config = {
 _running = False
 _events: List[dict] = []
 _agg: Dict[str, List[float]] = defaultdict(list)
-_xla_trace_dir: Optional[str] = None
 
 
 def set_config(**kwargs):
@@ -289,18 +288,21 @@ def dump(finished: bool = True, filename: Optional[str] = None):
 
 def start_xla_trace(logdir: str = "/tmp/mx_xla_trace"):
     """Capture the device-side timeline via JAX's profiler (xplane,
-    viewable in tensorboard-plugin-profile)."""
-    global _xla_trace_dir
-    import jax
+    viewable in tensorboard-plugin-profile).
 
-    jax.profiler.start_trace(logdir)
-    _xla_trace_dir = logdir
+    Routed through the mxtriage capture manager: the manual bracket
+    holds the SAME admission slot as ``mxtriage.deep_capture`` /
+    ``POST /profilez`` / SIGUSR1 / alert-triggered captures, so two
+    entry points can never stack jax profiler sessions (which corrupts
+    both traces) — and the capture lands in the mxtriage index with
+    its trigger recorded.  The manager owns the directory state
+    (``mxtriage.active()``); there is no module-level copy."""
+    from .telemetry import mxtriage
+
+    return mxtriage.start_manual(logdir)
 
 
 def stop_xla_trace():
-    global _xla_trace_dir
-    import jax
+    from .telemetry import mxtriage
 
-    jax.profiler.stop_trace()
-    d, _xla_trace_dir = _xla_trace_dir, None
-    return d
+    return mxtriage.stop_manual()

@@ -28,6 +28,12 @@ including the status codes the backpressure contract promises
                           render time).  Drain-aware like /healthz:
                           the status code flips to 503 while draining
                           but the page still renders.
+    POST /profilez     -> run one mxtriage deep capture and return its
+                          meta (body: {"seconds": S} or {"steps": N},
+                          both optional — default MXNET_TRIAGE_SECONDS).
+                          Admission-gated: 409 while another capture is
+                          in flight (captures never stack); drain-aware:
+                          503 once shutdown begins.
 
 Use `serve_http(server, port=0)` for an ephemeral port; the returned
 `http.server.ThreadingHTTPServer` exposes `server_address` and is torn
@@ -175,6 +181,37 @@ def _make_handler(server):
             self._send_text(status, json.dumps(payload),
                             "application/json")
 
+        def _profilez(self):
+            """POST /profilez: one mxtriage deep capture, blocking
+            until the bounded window closes; returns its meta."""
+            from ..telemetry import mxtriage
+
+            if server.draining:
+                # drain-aware: a terminating process must not start a
+                # multi-second profiler session it may not finish
+                return self._send(503, {"error": "draining"})
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}") \
+                    if n else {}
+                steps = req.get("steps")
+                seconds = req.get("seconds")
+                meta = mxtriage.deep_capture(
+                    steps=int(steps) if steps is not None else None,
+                    seconds=float(seconds) if seconds is not None
+                    else None,
+                    trigger="http", block=True)
+                if meta is None:
+                    return self._send(504, {
+                        "error": "capture did not complete in time"})
+                status = 200 if meta.get("status") != "error" else 500
+                return self._send(status, {"capture": meta})
+            except mxtriage.CaptureBusy as e:
+                # admission gate: captures never stack
+                return self._send(409, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — HTTP boundary
+                return self._send(400, {"error": str(e)})
+
         def do_GET(self):  # noqa: N802 — http.server API
             if self.path == "/metrics":
                 # standard scrape target: the process-wide registry in
@@ -202,6 +239,8 @@ def _make_handler(server):
             return self._send(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):  # noqa: N802 — http.server API
+            if self.path == "/profilez":
+                return self._profilez()
             m = _PREDICT.match(self.path)
             if not m:
                 return self._send(404, {"error": f"no route {self.path}"})

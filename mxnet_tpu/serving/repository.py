@@ -253,9 +253,16 @@ class _ModelEntry:
         compiled, origin = self._compile_impl(bucket)
         dt = time.perf_counter() - t0
         # mxprof cost accounting: computed on the executable object, so
-        # persistent-cache loads keep their cost metadata too
+        # persistent-cache loads keep their cost metadata too; the
+        # artifact's program fingerprint rides beside it (regression
+        # attribution: "did the served program change")
+        try:
+            fp = self._program_fingerprint()
+        except OSError:
+            fp = None
         _costs.note(f"serving:{self.name}/v{self.version}",
-                    f"bucket={bucket}", _costs.executable_cost(compiled))
+                    f"bucket={bucket}", _costs.executable_cost(compiled),
+                    fingerprint=fp)
         if origin == "compiled":
             # always counted, never gated: a compile on the serving
             # path is the silent TPU latency killer — each one must be
@@ -318,7 +325,29 @@ class _ModelEntry:
         def compile_fn():
             return build_lowered().compile()
 
+        # the named identity view compile provenance diffs a miss
+        # against — which of program / bucket / avals / params changed.
+        # The fingerprint read opens the artifact file: an unreadable
+        # artifact (racing rollover cleanup) degrades the provenance
+        # component, never the compile — the in-memory exported
+        # program can still build.
+        try:
+            program_fp = self._program_fingerprint()
+        except OSError:
+            program_fp = None
+        components = {"program": program_fp,
+                      "bucket": bucket, "avals": in_avals,
+                      "params": p_avals}
+
         if not _cc.enabled():
+            from ..telemetry.mxtriage import provenance as _prov
+
+            # record_miss never raises — diagnostics can't break a build
+            _prov.record_miss(
+                f"serving:{self.name}/v{self.version}",
+                _cc.cache_key("serving.bucket",
+                              parts=(bucket, in_avals, p_avals),
+                              components=components))
             return compile_fn(), "compiled"
 
         # content-addressed, deliberately name/version-free: the keys
@@ -335,7 +364,8 @@ class _ModelEntry:
             return _cc.cache_key(
                 "serving.bucket",
                 parts=(bucket, in_avals, p_avals),
-                program_text=build_lowered().as_text())
+                program_text=build_lowered().as_text(),
+                components=components)
 
         return _cc.get_or_compile(
             f"serving:{self.name}/v{self.version}", full_key,

@@ -49,6 +49,7 @@ from . import instruments
 from . import catalog
 from . import mxprof
 from . import mxhealth
+from . import mxtriage
 from . import alerts
 
 __all__ = [
@@ -58,7 +59,7 @@ __all__ = [
     "flow_start", "flow_end", "counter_event",
     "enable", "disable", "enabled",
     "metrics", "tracing", "instruments", "catalog", "mxprof",
-    "mxhealth", "alerts",
+    "mxhealth", "mxtriage", "alerts",
 ]
 
 

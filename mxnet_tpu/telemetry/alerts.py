@@ -143,6 +143,7 @@ class Rule:
                  labels: Optional[Dict[str, str]] = None,
                  agg: str = "sum", increase: bool = False,
                  predicate: Optional[Callable] = None,
+                 action: Optional[str] = None,
                  description: str = ""):
         if (metric is None) == (predicate is None):
             raise MXNetError(
@@ -154,6 +155,9 @@ class Rule:
         if agg not in ("sum", "max"):
             raise MXNetError(f"alert rule {name!r}: agg must be "
                              f"'sum' or 'max', got {agg!r}")
+        if action not in (None, "deep_capture"):
+            raise MXNetError(f"alert rule {name!r}: unknown action "
+                             f"{action!r} (known: 'deep_capture')")
         self.name = name
         self.severity = severity
         self.for_ = max(0.0, float(for_))
@@ -169,6 +173,10 @@ class Rule:
         # when the growth stops)
         self.increase = bool(increase)
         self.predicate = predicate
+        # action="deep_capture": a pending->firing transition triggers
+        # one rate-limited mxtriage deep capture whose artifact records
+        # this rule's name — the alert collects its own evidence
+        self.action = action
         self.description = description
         # evaluation state (owned by the engine's tick, under its lock)
         self.state = "inactive"      # inactive | pending | firing
@@ -179,6 +187,8 @@ class Rule:
     def spec(self) -> dict:
         out = {"name": self.name, "severity": self.severity,
                "for_s": self.for_, "description": self.description}
+        if self.action is not None:
+            out["action"] = self.action
         if self.metric is not None:
             out.update({"metric": self.metric, "op": self.op,
                         "threshold": self.threshold})
@@ -307,6 +317,23 @@ class AlertEngine:
                         out.append(self._emit(rule, "resolved", now))
                     rule.state = "inactive"
                     rule.pending_since = None
+        # rule actions dispatch OUTSIDE the engine lock (the capture
+        # manager takes its own locks, and a slow trigger must not
+        # stall other rules' evaluation).  Only the pending->firing
+        # transition dispatches — a rule that STAYS firing across
+        # ticks triggers nothing new; mxtriage additionally
+        # rate-limits across distinct firings.
+        for ev in out:
+            if ev["state"] == "firing" and ev["spec"].get("action") \
+                    == "deep_capture":
+                try:
+                    from . import mxtriage
+
+                    ev["action_status"] = mxtriage.trigger_from_alert(
+                        ev["rule"], severity=ev["severity"],
+                        value=ev.get("value"))
+                except Exception:  # noqa: BLE001 — diagnostics never break a tick
+                    ev["action_status"] = "error"
         return out
 
     def firing(self) -> List[dict]:
@@ -377,16 +404,19 @@ def serving_slo_rules(engine: AlertEngine,
                       p99_ms: float = 250.0,
                       queue_depth: int = 64,
                       for_s: float = 0.0,
-                      labels: Optional[Dict[str, str]] = None
-                      ) -> AlertEngine:
+                      labels: Optional[Dict[str, str]] = None,
+                      action: Optional[str] = None) -> AlertEngine:
     """The stock serving SLO table: p99 latency, queue depth, breaker
     state — all over families the serving layer already records, so
-    installing the rules is the only wiring."""
+    installing the rules is the only wiring.  ``action="deep_capture"``
+    makes the p99 rule collect its own evidence: the firing transition
+    triggers one rate-limited mxtriage deep capture."""
     labels = labels or {}
     engine.add_rule(
         "serving_p99_slo", severity="page", for_=for_s,
         metric="p99:mx_serving_request_latency_seconds",
         labels=labels, op=">", threshold=p99_ms / 1e3,
+        action=action,
         description=f"served p99 above {p99_ms:g}ms")
     engine.add_rule(
         "serving_queue_depth", severity="warning", for_=for_s,
@@ -405,7 +435,8 @@ def serving_slo_rules(engine: AlertEngine,
 
 
 def training_health_rules(engine: AlertEngine,
-                          for_s: float = 0.0) -> AlertEngine:
+                          for_s: float = 0.0,
+                          action: Optional[str] = None) -> AlertEngine:
     """The stock training-health table over mxhealth's families.
 
     All four rules are ``increase`` rules: the underlying families are
@@ -419,7 +450,7 @@ def training_health_rules(engine: AlertEngine,
     engine.add_rule(
         "nonfinite_gradients", severity="page", for_=for_s,
         metric="mx_nonfinite_total", op=">", threshold=0,
-        increase=True,
+        increase=True, action=action,
         description="NaN/Inf gradient values observed by the in-graph "
                     "counter since the last tick")
     engine.add_rule(

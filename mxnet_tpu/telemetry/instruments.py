@@ -47,7 +47,9 @@ __all__ = [
     "retry_total", "fault_injected_total",
     "compile_cache_hit_total", "compile_cache_miss_total",
     "compile_cache_evict_total", "compile_cache_load_seconds",
-    "compile_cache_bytes",
+    "compile_cache_bytes", "compile_reason_total",
+    "triage_captures_total", "triage_suppressed_total",
+    "triage_capture_active",
     "breaker_state", "breaker_open_total",
     "serving_counter", "serving_queue_depth", "serving_occupancy",
     "serving_request_latency", "serving_compile_total",
@@ -512,6 +514,44 @@ def compile_cache_load_seconds():
 
 def compile_cache_bytes():
     return _child("mx_compile_cache_bytes")
+
+
+# ---- mxtriage: compile provenance + on-demand deep capture ------------
+
+_spec("mx_compile_reason_total", "counter",
+      "Compile-cache misses by site and the signature component that "
+      "changed vs the nearest prior compile at that site (avals / "
+      "statics / donation / device / program / env / first / ...). A "
+      "recompile storm names its cause here instead of just its count "
+      "(mxtriage compile provenance).", ("site", "component"))
+_spec("mx_triage_captures_total", "counter",
+      "mxtriage deep captures completed, by trigger (manual / http / "
+      "sigusr1 / alert / step).", ("trigger",))
+_spec("mx_triage_suppressed_total", "counter",
+      "mxtriage deep-capture triggers suppressed by the admission "
+      "gate, by reason (busy = a capture was already in flight; "
+      "rate-limited = inside MXNET_TRIAGE_ALERT_INTERVAL_S; error = "
+      "the profiler backend refused to start).", ("reason",))
+_spec("mx_triage_capture_active", "gauge",
+      "1 while an mxtriage deep capture holds the admission slot "
+      "(armed or recording), 0 otherwise — at most one capture can be "
+      "in flight per process.")
+
+
+def compile_reason_total(site: str, component: str):
+    return _child("mx_compile_reason_total", (site, component))
+
+
+def triage_captures_total(trigger: str):
+    return _child("mx_triage_captures_total", (trigger,))
+
+
+def triage_suppressed_total(reason: str):
+    return _child("mx_triage_suppressed_total", (reason,))
+
+
+def triage_capture_active():
+    return _child("mx_triage_capture_active")
 
 
 # ---- analysis ---------------------------------------------------------

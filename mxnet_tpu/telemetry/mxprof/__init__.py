@@ -45,7 +45,8 @@ from .recorder import FlightRecorder
 __all__ = [
     "enable", "disable", "enabled", "recorder", "dump", "snapshot",
     "records", "clear", "set_state_bytes_provider", "install_sigusr2",
-    "costs", "hbm", "FlightRecorder",
+    "add_step_listener", "remove_step_listener",
+    "default_dump_path", "costs", "hbm", "FlightRecorder",
 ]
 
 _lock = threading.Lock()
@@ -81,10 +82,18 @@ def enable(ring: Optional[int] = None) -> FlightRecorder:
                 else _env.get_int("MXNET_MXPROF_HBM_EVERY") or 0)
             if prev is not None:
                 # a resize must not lose what the Trainer registered —
-                # dumps would silently report optimizer state as null
+                # dumps would silently report optimizer state as null —
+                # nor the step listeners an armed deep capture needs
                 rec.set_state_bytes_provider(prev._state_provider)
+                rec._listeners = prev._listeners
     _tracing.set_sink(rec)
     install_sigusr2()
+    # enabling observability arms both diagnostic signals: SIGUSR2
+    # dumps the flight recorder, SIGUSR1 runs an mxtriage deep capture
+    # (best effort, main thread only)
+    from .. import mxtriage as _mxtriage
+
+    _mxtriage.install_sigusr1()
     return rec
 
 
@@ -112,6 +121,19 @@ def set_state_bytes_provider(fn) -> None:
     recorder().set_state_bytes_provider(fn)
 
 
+def add_step_listener(fn) -> None:
+    """Register ``fn(step)`` on the CURRENT recorder.  Use these
+    module-level helpers rather than a held FlightRecorder reference:
+    ``enable(ring=N)`` swaps in a fresh recorder (carrying the
+    listener set), and a removal issued against the stale object would
+    silently leave the listener live on the active one."""
+    recorder().add_step_listener(fn)
+
+
+def remove_step_listener(fn) -> None:
+    recorder().remove_step_listener(fn)
+
+
 def snapshot(live_hbm: bool = True, include_records: bool = True) -> dict:
     """The flight-recorder dump as a dict (what BENCH harnesses embed
     under their ``"mxprof"`` key; they pass ``include_records=False``
@@ -120,11 +142,27 @@ def snapshot(live_hbm: bool = True, include_records: bool = True) -> dict:
                                 include_records=include_records)
 
 
+def default_dump_path() -> str:
+    """``MXNET_MXPROF_DUMP`` when set; else rank-qualified when the
+    process knows its job rank (``dist.init`` stamped it), pid-
+    qualified otherwise.  Containerized multi-host jobs all run as
+    pid 1 — a pid-only default on a shared filesystem would have every
+    rank clobber the same file."""
+    p = _env.get_str("MXNET_MXPROF_DUMP")
+    if p:
+        return p
+    rank = _tracing._RANK
+    if rank is not None:
+        return f"mxprof-rank{rank}.json"
+    return f"mxprof-{os.getpid()}.json"
+
+
 def dump(path: Optional[str] = None, live_hbm: bool = True) -> str:
     """Write the snapshot as JSON; returns the path written.  Default
-    path: ``MXNET_MXPROF_DUMP`` or ``mxprof-<pid>.json``."""
-    p = path or _env.get_str("MXNET_MXPROF_DUMP") \
-        or f"mxprof-{os.getpid()}.json"
+    path: :func:`default_dump_path` (``MXNET_MXPROF_DUMP``, else
+    ``mxprof-rank<r>.json`` under an initialized dist job, else
+    ``mxprof-<pid>.json``)."""
+    p = path or default_dump_path()
     data = snapshot(live_hbm=live_hbm)
     tmp = f"{p}.tmp-{os.getpid()}"
     with open(tmp, "w") as f:

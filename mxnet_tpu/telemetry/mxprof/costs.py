@@ -23,7 +23,7 @@ from typing import Dict, NamedTuple, Optional, Tuple
 from ...util import env as _env
 
 __all__ = ["Cost", "executable_cost", "peak_flops",
-           "backend_initialized", "note", "notes"]
+           "backend_initialized", "note", "notes", "hlo_fingerprint"]
 
 
 class Cost(NamedTuple):
@@ -112,17 +112,45 @@ _notes_lock = threading.Lock()
 _notes: Dict[str, Dict[str, dict]] = {}
 
 
-def note(site: str, key: str, cost: Optional[Cost]) -> None:
-    """Remember one executable's cost under (site, key) for dumps —
-    bounded per site so long-lived processes stay flat."""
-    if cost is None:
+def note(site: str, key: str, cost: Optional[Cost],
+         fingerprint: Optional[str] = None) -> None:
+    """Remember one executable's cost (and, when known, its HLO-module
+    fingerprint) under (site, key) for dumps — bounded per site so
+    long-lived processes stay flat.  The fingerprint rides beside the
+    cost so perf attribution can say "the compiled program did (not)
+    change" across runs."""
+    if cost is None and fingerprint is None:
         return
     with _notes_lock:
         per = _notes.setdefault(site, {})
         if key not in per and len(per) >= _NOTES_MAX:
             per.pop(next(iter(per)))
-        per[key] = {"flops": cost.flops,
-                    "bytes_accessed": cost.bytes_accessed}
+        row = {}
+        if cost is not None:
+            row = {"flops": cost.flops,
+                   "bytes_accessed": cost.bytes_accessed}
+        if fingerprint is not None:
+            row["hlo_fingerprint"] = fingerprint
+        per[key] = row
+
+
+def hlo_fingerprint(compiled, program_text: Optional[str] = None
+                    ) -> Optional[str]:
+    """sha256 identity of one executable's HLO module: the lowered
+    program text when the caller has it (free — it was rendered for
+    the cache key), else the compiled module's own text, else None
+    (deserialized payloads may not render)."""
+    import hashlib
+
+    text = program_text
+    if text is None:
+        try:
+            text = compiled.as_text()
+        except Exception:  # noqa: BLE001 — best effort on loaded payloads
+            return None
+    if not text:
+        return None
+    return hashlib.sha256(text.encode()).hexdigest()
 
 
 def notes() -> Dict[str, Dict[str, dict]]:

@@ -64,7 +64,8 @@ _UPDATE_PREFERENCE = ("optimizer-update", "fused-update",
 
 class _Pending:
     __slots__ = ("phases", "collectives", "data_wait", "bytes",
-                 "flops", "bytes_accessed", "compiles", "compile_s")
+                 "flops", "bytes_accessed", "compiles", "compile_s",
+                 "compile_reasons")
 
     def __init__(self):
         self.phases: Dict[str, float] = {}
@@ -75,10 +76,16 @@ class _Pending:
         self.bytes_accessed = 0.0
         self.compiles = 0
         self.compile_s = 0.0
+        # provenance diffs of the compile-cache misses that landed in
+        # this step ({"site": ..., "components": [...]}) — bounded: a
+        # storm's first handful names the cause, the counter has the
+        # count
+        self.compile_reasons: list = []
 
     def empty(self) -> bool:
         return not (self.phases or self.collectives or self.bytes
-                    or self.data_wait or self.compiles or self.flops)
+                    or self.data_wait or self.compiles or self.flops
+                    or self.compile_reasons)
 
 
 class FlightRecorder:
@@ -94,6 +101,10 @@ class FlightRecorder:
         self._hbm_every = 0
         self._state_provider = None  # () -> (total_bytes, shard_factor)
         self._peak_cache: Optional[tuple] = None
+        # step-boundary listeners (mxtriage deep-capture windows);
+        # an immutable tuple so notification never takes the lock —
+        # and the empty-tuple fast path costs one truthiness check
+        self._listeners: tuple = ()
 
     # ---- wiring ------------------------------------------------------
 
@@ -105,6 +116,28 @@ class FlightRecorder:
         sample/dump time (never per step), so providing costs the
         training loop nothing."""
         self._state_provider = fn
+
+    def add_step_listener(self, fn) -> None:
+        """``fn(step)`` runs on the recording thread after each record
+        closes (mxtriage uses it for step-boundary capture windows).
+        Listeners must be cheap and must never raise into the step."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners = self._listeners + (fn,)
+
+    def remove_step_listener(self, fn) -> None:
+        with self._lock:
+            self._listeners = tuple(f for f in self._listeners
+                                    if f is not fn)
+
+    def _notify(self, step: Optional[int]) -> None:
+        if step is None or not self._listeners:
+            return
+        for fn in self._listeners:
+            try:
+                fn(step)
+            except Exception:  # noqa: BLE001 — a listener never breaks a step
+                pass
 
     def _peak(self):
         if self._peak_cache is None:
@@ -140,10 +173,11 @@ class FlightRecorder:
             return
         if cat == "training":
             if name == "step":
-                self._close(duration)
+                self._notify(self._close(duration))
                 return
             if name not in _PHASES:
                 return
+            closed = None
             with self._lock:
                 p = self._pending
                 if name == "spmd-step" and "spmd-step" in p.phases:
@@ -152,9 +186,10 @@ class FlightRecorder:
                     # the boundary, and the previous one's duration IS
                     # the previous step's wall time
                     prev = p.phases["spmd-step"]
-                    self._close_locked(prev)
+                    closed = self._close_locked(prev)
                     p = self._pending
                 p.phases[name] = p.phases.get(name, 0.0) + duration
+            self._notify(closed)
             return
         if cat == "data" and name == "data-wait":
             with self._lock:
@@ -176,16 +211,30 @@ class FlightRecorder:
             self._pending.flops += cost.flops
             self._pending.bytes_accessed += cost.bytes_accessed
 
+    def on_compile_reason(self, site: str, components) -> None:
+        """Provenance feed (telemetry.mxtriage.provenance): the diff of
+        one compile-cache miss that landed inside this step.  Bounded —
+        a storm's first handful names the cause; its size lives in the
+        ``compiles`` count and the reason counter."""
+        with self._lock:
+            reasons = self._pending.compile_reasons
+            if len(reasons) < 16:
+                reasons.append({"site": site,
+                                "components": list(components)})
+
     # ---- record closing ----------------------------------------------
 
-    def _close(self, wall_s: float) -> None:
+    def _close(self, wall_s: float) -> Optional[int]:
         with self._lock:
-            self._close_locked(wall_s)
+            return self._close_locked(wall_s)
 
-    def _close_locked(self, wall_s: float) -> None:
+    def _close_locked(self, wall_s: float) -> Optional[int]:
+        """Close the pending record; returns the closed step number
+        (None when nothing closed) so callers can notify the step
+        listeners OUTSIDE the lock."""
         p, self._pending = self._pending, _Pending()
         if p.empty() and wall_s <= 0.0:
-            return
+            return None
         self._step += 1
         # the "step" span covers the reduce+update tail only; forward/
         # backward are sibling spans — the record's wall is the whole
@@ -232,6 +281,8 @@ class FlightRecorder:
             "compile_s": round(p.compile_s, 6),
             "verdict": verdict,
         }
+        if p.compile_reasons:
+            rec["compile_reasons"] = p.compile_reasons
         self._ring.append(rec)
         # mxprof's OWN gauges update whenever a record closes — the
         # docs promise them in MXNET_MXPROF=1-only mode too (metrics
@@ -251,6 +302,7 @@ class FlightRecorder:
                             state_bytes=self._state_share())
             except Exception:  # noqa: BLE001 — sampling never breaks a step
                 pass
+        return self._step
 
     # ---- introspection -----------------------------------------------
 
@@ -288,6 +340,14 @@ class FlightRecorder:
         out["data_wait_s_total"] = round(
             sum(r["data_wait_s"] for r in recs), 6)
         out["compiles"] = sum(r["compiles"] for r in recs)
+        reasons: Dict[str, Dict[str, int]] = {}
+        for r in recs:
+            for cr in r.get("compile_reasons", ()):
+                per = reasons.setdefault(cr["site"], {})
+                for comp in cr["components"]:
+                    per[comp] = per.get(comp, 0) + 1
+        if reasons:
+            out["compile_reasons"] = reasons
         mfus = [r["mfu"] for r in recs if r["mfu"] is not None]
         out["mfu_mean"] = round(sum(mfus) / len(mfus), 6) if mfus \
             else None
@@ -301,6 +361,8 @@ class FlightRecorder:
         committed bench artifacts embed so they stay reviewable."""
         from . import hbm as _hbm
 
+        from ...util import env as _env
+
         peak, src = self._peak()
         state_share = self._state_share()
         try:
@@ -308,6 +370,17 @@ class FlightRecorder:
                                   state_bytes=state_share)
         except Exception:  # noqa: BLE001
             hbm_now = {}
+        # the knob surface of the run: env-SET values by name (the
+        # attribution diff can say WHICH knob changed) plus a
+        # fingerprint over the full resolved table (a changed code
+        # default still flips it)
+        try:
+            table = _env.resolved()
+            knobs = {name: v for name, v in table.items()
+                     if name in os.environ}
+            knob_fp = _env.fingerprint()
+        except Exception:  # noqa: BLE001 — a dump never fails on a bad knob
+            knobs, knob_fp = {}, None
         out = {
             "pid": os.getpid(),
             "rank": _tracing._RANK,
@@ -318,6 +391,8 @@ class FlightRecorder:
             "summary": self.summary(),
             "hbm": hbm_now,
             "executable_costs": _costs.notes(),
+            "knobs": knobs,
+            "knob_fingerprint": knob_fp,
         }
         if include_records:
             out["records"] = self.records()

@@ -27,7 +27,7 @@ from ..base import get_env as _raw_get_env  # the untyped low-level reader
 __all__ = [
     "Knob", "declare", "knobs", "is_declared",
     "get_int", "get_bool", "get_str", "get_float",
-    "generate_docs",
+    "resolved", "fingerprint", "generate_docs",
 ]
 
 
@@ -105,6 +105,35 @@ def get_str(name: str, default: Any = _UNSET) -> Optional[str]:
 
 def get_float(name: str, default: Any = _UNSET) -> Optional[float]:
     return _get(name, float, default)
+
+
+def resolved() -> Dict[str, Any]:
+    """Every declared knob's RESOLVED value (env override or declared
+    default; dynamic defaults resolve to None).  This is the
+    performance-relevant configuration surface of the process — what a
+    bench artifact records so `perf_compare` can say "a knob changed"
+    instead of just "it got slower"."""
+    _GET = {int: get_int, bool: get_bool, str: get_str,
+            float: get_float}
+    out = {}
+    for k in knobs():
+        try:
+            out[k.name] = _GET[k.typ](k.name)
+        except Exception:  # noqa: BLE001 — one bad value must not hide the rest
+            out[k.name] = "<unreadable>"
+    return out
+
+
+def fingerprint() -> str:
+    """sha256 over the sorted resolved knob table — the one-line
+    "did any registered knob change" answer regression attribution
+    compares across runs."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for name, value in sorted(resolved().items()):
+        h.update(f"{name}={value!r}\x1f".encode())
+    return h.hexdigest()
 
 
 def generate_docs() -> str:
@@ -368,7 +397,32 @@ declare("MXNET_MXPROF_HBM_EVERY", int, 0,
         "explicit dumps.")
 declare("MXNET_MXPROF_DUMP", str, "",
         "Path the SIGUSR2 handler writes the mxprof flight-recorder "
-        "dump to. Empty = mxprof-<pid>.json in the working directory.")
+        "dump to. Empty = mxprof-rank<r>.json in the working "
+        "directory once dist.init() stamped the process rank "
+        "(containerized multi-host ranks share pids and must not "
+        "clobber on a shared filesystem), else mxprof-<pid>.json.")
+declare("MXNET_TRIAGE_DIR", str, "mxtriage",
+        "Base directory mxtriage deep-capture artifacts land in (one "
+        "subdirectory per capture, indexed in index.json beside them). "
+        "Relative paths resolve against the working directory.")
+declare("MXNET_TRIAGE_SECONDS", float, 3.0,
+        "Default wall-clock window of a deep capture when the caller "
+        "passes neither steps= nor seconds= (SIGUSR1 and bare "
+        "POST /profilez use it).")
+declare("MXNET_TRIAGE_ALERT_INTERVAL_S", float, 600.0,
+        "Minimum seconds between alert-triggered deep captures "
+        "(action='deep_capture' rules): a flapping alert must not turn "
+        "the profiler into a DoS on its own process. Suppressed "
+        "triggers are counted in mx_triage_suppressed_total.")
+declare("MXNET_TRIAGE_STEP_TIMEOUT_S", float, 60.0,
+        "Watchdog for steps=N deep captures: if the expected step "
+        "boundaries stop arriving (training stalled or finished), the "
+        "capture force-stops after this many seconds instead of "
+        "holding the admission slot forever.")
+declare("MXNET_TRIAGE_HISTORY", int, 64,
+        "Entries kept in the mxtriage capture index (index.json); "
+        "older capture records rotate out of the index (their artifact "
+        "directories are left on disk).")
 declare("MXNET_PEAK_FLOPS", float, None,
         "Per-device peak FLOP/s used as the MFU denominator "
         "(mx_step_mfu). Unset = resolved from the device kind table "

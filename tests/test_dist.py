@@ -181,6 +181,9 @@ def test_launch_dry_run_launchers(tmp_path):
     assert "DMLC_PS_ROOT_URI" not in slurm[0]
 
 
+@pytest.mark.slow  # 20s multi-process spawn; scheduler-role parking is
+# infra-level coverage redundant with the other tier-1 dist spawns —
+# runs nightly (heavy-integration stage)
 def test_server_role_parks_not_trains():
     """A DMLC_ROLE=server process importing the package must PARK (the
     reference kvstore_server semantics), not run the script body as a

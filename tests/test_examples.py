@@ -62,6 +62,8 @@ def test_ssd_train_rec(tmp_path):
     assert "decoded" in out and "loss=" in out
 
 
+@pytest.mark.slow  # 9s example train loop; mnist/long-context keep
+# tier-1 example coverage, the heavy-integration stage runs this nightly
 def test_transformer_nmt_parallel_corpus(tmp_path):
     rng = np.random.RandomState(1)
     src, tgt = tmp_path / "train.src", tmp_path / "train.tgt"

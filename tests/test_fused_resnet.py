@@ -158,6 +158,9 @@ def test_fused_full_resnet_train_step():
                                    err_msg=f"grad {nr} / {nf}")
 
 
+@pytest.mark.slow  # 19s: the multi-device-mesh twin of the tier-1
+# single-device fused ResNet tests (same kernels, sharded); the sharded
+# pallas parity tests keep mesh coverage in tier-1 — runs nightly
 def test_fused_flag_works_under_multi_device_mesh():
     """MXNET_FUSED_CONVBN under a dp>1 SPMD mesh must compile and match
     the unfused trainer's loss.  (Since round 5 the kernel engages via

@@ -235,3 +235,82 @@ class TestCli:
         rc = pc.main(["--ref", "HEAD", "--fresh-dir", _REPO,
                       "--artifacts", "FUSED_BENCH.json"])
         assert rc in (0, 1)
+
+
+def _scaling_attr(tp=1.3, gar=0.5, knob=0):
+    """A SCALING doc whose row carries the mxtriage attribution lanes
+    (what scaling_bench._phase_report now embeds)."""
+    d = _scaling(tp)
+    row = d["sweep"][1]
+    row["phase_seconds"] = {
+        "grad-allreduce": {"seconds": gar, "count": 3},
+        "forward": {"seconds": 1.0, "count": 3}}
+    row["data_wait_s"] = 0.01
+    row["compiles"] = 1
+    row["knobs"] = {"MXNET_SPMD_BUCKET_BYTES": knob}
+    row["knob_fingerprint"] = f"kf-{knob}"
+    row["hlo_fingerprints"] = ["aaa"]
+    return d
+
+
+class TestSuspects:
+    """Regression attribution (ISSUE 13): a failing lane emits a
+    ranked suspects section instead of failing mutely."""
+
+    def test_failing_lane_emits_ranked_suspects(self, tmp_path):
+        bd, fd = tmp_path / "b", tmp_path / "f"
+        bd.mkdir(), fd.mkdir()
+        (bd / "SCALING.json").write_text(
+            json.dumps(_scaling_attr(tp=1.3, gar=0.5)))
+        (fd / "SCALING.json").write_text(
+            json.dumps(_scaling_attr(tp=0.8, gar=1.5, knob=4096)))
+        out = str(tmp_path / "rep.json")
+        rc = pc.main(["--baseline-dir", str(bd), "--fresh-dir",
+                      str(fd), "--artifacts", "SCALING.json",
+                      "--out", out])
+        assert rc == 1
+        rep = json.load(open(out))
+        sus = rep["suspects"]
+        # top suspect names the regressed phase; the knob change rides
+        # along with its old -> new values
+        assert sus[0]["kind"] == "phase"
+        assert sus[0]["name"] == "grad-allreduce"
+        assert sus[0]["rank"] == 1
+        assert sus[0]["artifact"] == "SCALING.json"
+        knob = next(s for s in sus if s["kind"] == "knob")
+        assert knob["name"] == "MXNET_SPMD_BUCKET_BYTES"
+        per = rep["artifacts"]["SCALING.json"]
+        assert per["suspects"][0]["name"] == "grad-allreduce"
+        assert any("program fingerprints stable" in c
+                   for c in per["context"])
+
+    def test_clean_run_has_no_suspects_section(self, tmp_path):
+        bd, fd = tmp_path / "b", tmp_path / "f"
+        bd.mkdir(), fd.mkdir()
+        (bd / "SCALING.json").write_text(json.dumps(_scaling_attr()))
+        (fd / "SCALING.json").write_text(json.dumps(_scaling_attr()))
+        out = str(tmp_path / "rep.json")
+        assert pc.main(["--baseline-dir", str(bd), "--fresh-dir",
+                        str(fd), "--artifacts", "SCALING.json",
+                        "--out", out]) == 0
+        rep = json.load(open(out))
+        assert "suspects" not in rep
+        assert "suspects" not in rep["artifacts"]["SCALING.json"]
+
+    def test_failing_lane_without_aggregates_still_reports(
+            self, tmp_path):
+        """Old-format artifacts (no embedded aggregates): the gate
+        still fails normally, suspects just come back empty."""
+        bd, fd = tmp_path / "b", tmp_path / "f"
+        bd.mkdir(), fd.mkdir()
+        (bd / "FUSED_BENCH.json").write_text(
+            json.dumps({"sizes": {"100": {"speedup": 2.0}}}))
+        (fd / "FUSED_BENCH.json").write_text(
+            json.dumps({"sizes": {"100": {"speedup": 1.0}}}))
+        out = str(tmp_path / "rep.json")
+        rc = pc.main(["--baseline-dir", str(bd), "--fresh-dir",
+                      str(fd), "--artifacts", "FUSED_BENCH.json",
+                      "--out", out])
+        assert rc == 1
+        rep = json.load(open(out))
+        assert rep["artifacts"]["FUSED_BENCH.json"]["suspects"] == []

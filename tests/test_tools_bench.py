@@ -7,6 +7,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -152,6 +154,8 @@ def test_bench_all_mnist_smoke():
     assert rows[-1]["value"] > 0
 
 
+@pytest.mark.slow  # 12s CLI smoke of a tool the nightly spmd stage
+# already runs for real (scaling_bench --spmd --phases) — runs nightly
 def test_scaling_bench_single_proc():
     """CLI smoke on the SPMD path (the unified spine — ISSUE 9) with
     per-phase attribution; the multi-process sweep, the loss-parity

@@ -29,6 +29,16 @@ stage that timed out must fail the nightly through its own return
 code, not by making the diff un-runnable.  ``--baseline-dir`` swaps
 the git baseline for a directory of files (what the tests use).
 
+**Regression attribution (mxtriage).**  A failing artifact does not
+fail mutely: the mxprof aggregates embedded on both sides (per-phase
+seconds, collective bytes, data-wait, MFU, compile counts, HLO
+fingerprints, registered-knob values) are diffed into a ranked
+``suspects`` section — per artifact and merged at the report top level
+— so PERF_COMPARE.json says "grad-allreduce +38%, bucket-bytes knob
+changed, program fingerprint stable" instead of just "-12%".  The
+ranker is ``mxnet_tpu/telemetry/mxtriage/attribution.py`` (stdlib-only,
+loaded by file path so this tool never imports the framework/jax).
+
     python tools/perf_compare.py                      # HEAD vs work tree
     python tools/perf_compare.py --tolerance 0.15 --out PERF_COMPARE.json
     python tools/perf_compare.py --baseline-dir /tmp/old --fresh-dir .
@@ -48,6 +58,28 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_ARTIFACTS = ("FUSED_BENCH.json", "SCALING.json",
                      "SERVING_BENCH.json", "COMPILE_CACHE.json",
                      "HEALTH.json")
+
+_ATTRIBUTION_PATH = os.path.join(
+    _REPO, "mxnet_tpu", "telemetry", "mxtriage", "attribution.py")
+_attribution_cache = []
+
+
+def _attribution():
+    """The mxtriage suspect ranker, loaded by file path (stdlib-only
+    module — no framework/jax import).  None when unavailable; the
+    gate itself never depends on it."""
+    if not _attribution_cache:
+        try:
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "mxtriage_attribution", _ATTRIBUTION_PATH)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _attribution_cache.append(mod)
+        except Exception:  # noqa: BLE001 — attribution is additive
+            _attribution_cache.append(None)
+    return _attribution_cache[0]
 
 
 # ---------------------------------------------------------------------------
@@ -269,13 +301,40 @@ def main(argv=None) -> int:
             continue
         res = compare_artifact(name, base, fresh, args.tolerance)
         report["artifacts"][name] = res
-        failures += res["regressions"] + res["new_integrity_failures"]
+        fails = res["regressions"] + res["new_integrity_failures"]
+        if fails:
+            attr = _attribution()
+            if attr is not None:
+                # a failing lane never fails mutely: rank what moved
+                # in the embedded mxprof aggregates
+                try:
+                    suspects, context = attr.rank_suspects(base, fresh)
+                except Exception:  # noqa: BLE001 — attribution is additive
+                    suspects, context = [], []
+                res["suspects"] = suspects
+                res["context"] = context
+        failures += fails
+    # merged, re-ranked view across the failing artifacts — the first
+    # thing a human reads in PERF_COMPARE.json
+    merged = []
+    for name, res in report["artifacts"].items():
+        for s in res.get("suspects", ()):
+            merged.append(dict(s, artifact=name))
+    merged.sort(key=lambda s: -s["score"])
+    for i, s in enumerate(merged):
+        s["rank"] = i + 1
+    if merged:
+        report["suspects"] = merged
     report["ok"] = not failures
     if args.out:
         with open(args.out, "w") as f:
             json.dump(report, f, indent=1)
     for msg in failures:
         print(f"PERF GATE FAIL: {msg}", file=sys.stderr)
+    for s in report.get("suspects", ())[:5]:
+        print(f"PERF SUSPECT #{s['rank']} [{s['artifact']}] "
+              f"{s['kind']}:{s['name']} {s['change']} "
+              f"(score {s['score']})", file=sys.stderr)
     compared = [n for n, r in report["artifacts"].items()
                 if not r.get("skipped")]
     skipped = [n for n, r in report["artifacts"].items()

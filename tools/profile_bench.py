@@ -6,6 +6,11 @@ round 2 asked for).  Usage:
 The fused paths profile through the same command via their env knobs:
     MXNET_FUSED_CONVBN=1 [MXNET_FUSED_CONVBN_BWD=1] python tools/profile_bench.py
 Parses the xplane.pb with tensorflow's proto (no tensorboard needed).
+
+The capture window runs through ``telemetry.mxtriage`` (the one
+deep-capture path every surface shares), so the run is admission-gated,
+indexed, and leaves an ``mxprof.json`` aggregate + ``meta.json``
+beside the xplane files.
 """
 from __future__ import annotations
 
@@ -21,11 +26,11 @@ from collections import defaultdict
 
 def capture(args) -> str:
     import numpy as np
-    import jax
     import mxnet_tpu as mx
     from mxnet_tpu import parallel
     from mxnet_tpu.gluon import loss as gloss
     from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.telemetry import mxtriage
 
     net = vision.resnet50_v1(classes=1000, layout=args.layout)
     net.initialize(mx.initializer.Xavier(magnitude=2.0), ctx=mx.cpu())
@@ -62,10 +67,15 @@ def capture(args) -> str:
               f"({dt/args.steps*1e3:.1f} ms/step)")
 
         os.makedirs(args.out, exist_ok=True)
-        with jax.profiler.trace(args.out):
+        # the one deep-capture path (admission-gated + indexed):
+        # manual bracket around exactly the measured steps
+        mxtriage.start_manual(args.out)
+        try:
             for _ in range(args.steps):
                 loss = trainer.step(images, labels)
             loss.asnumpy()
+        finally:
+            mxtriage.stop_manual()
     return args.out
 
 

@@ -370,16 +370,17 @@ def main():
                                     "note": "timed out"}
 
     # heavy integration smokes: the slow-marked model-zoo / example /
-    # layout / detection train-loop tests excluded from tier-1 for
-    # wall-clock (tier-1 sits just under the 870s cap) — the coverage
-    # must still run every night
+    # layout / detection / dist / fused-resnet / tool-smoke tests
+    # excluded from tier-1 for wall-clock (tier-1 sits just under the
+    # 870s cap) — the coverage must still run every night
     heavy_rc = None
     try:
         hv = subprocess.run(
             [sys.executable, "-m", "pytest", "tests/test_gluon.py",
              "tests/test_examples.py", "tests/test_layout.py",
-             "tests/test_detection.py", "-q", "-m", "slow",
-             "-p", "no:cacheprovider"],
+             "tests/test_detection.py", "tests/test_dist.py",
+             "tests/test_fused_resnet.py", "tests/test_tools_bench.py",
+             "-q", "-m", "slow", "-p", "no:cacheprovider"],
             capture_output=True, text=True, timeout=1800, cwd=_REPO,
             env=cpu_env)
         heavy_rc = hv.returncode
@@ -445,6 +446,28 @@ def main():
         health_rc = -1
         artifact["health"] = {"returncode": -1, "note": "timed out"}
 
+    # triage stage (ISSUE 13): the deep-capture e2e (a REAL firing
+    # alert triggers one rate-limited jax.profiler capture whose
+    # artifact records the rule and step) and the perf_compare
+    # attribution smoke (a synthetic regressed artifact must produce a
+    # suspects ranking naming the seeded phase).  Runs BEFORE the
+    # perf-compare stage: if attribution is broken, the gate below
+    # would fail mutely again.
+    triage_rc = None
+    try:
+        tg = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests/test_mxtriage.py",
+             "-q", "-m", "slow", "-p", "no:cacheprovider"],
+            capture_output=True, text=True, timeout=900, cwd=_REPO,
+            env=cpu_env)
+        triage_rc = tg.returncode
+        artifact["triage"] = {
+            "returncode": tg.returncode,
+            "tail": "\n".join(tg.stdout.splitlines()[-1:])}
+    except subprocess.TimeoutExpired:
+        triage_rc = -1
+        artifact["triage"] = {"returncode": -1, "note": "timed out"}
+
     # perf-compare gate (ISSUE 10): the bench artifacts this nightly
     # just refreshed (FUSED/SCALING/COMPILE_CACHE/HEALTH; SERVING when
     # its strict lane rewrote it) vs the committed versions — >10%
@@ -479,7 +502,7 @@ def main():
         and resil_rc in (None, 0) and cc_rc in (None, 0) \
         and spmd_rc in (None, 0) and heavy_rc in (None, 0) \
         and mxprof_rc in (None, 0) and health_rc in (None, 0) \
-        and perf_rc in (None, 0) else 1
+        and triage_rc in (None, 0) and perf_rc in (None, 0) else 1
 
 
 if __name__ == "__main__":

@@ -187,7 +187,21 @@ def _phase_report(trace_path):
         # seconds over the measured steps (lower is better; gates
         # independently of throughput)
         "data_wait_s": snap["summary"].get("data_wait_s_total", 0.0),
+        # mxtriage regression-attribution lanes: compile counts (with
+        # provenance reasons when any miss was diffed), the compiled
+        # programs' identities, and the registered-knob surface — so a
+        # failing nightly can name its suspect instead of a bare %
+        "compiles": snap["summary"].get("compiles", 0),
+        "hlo_fingerprints": sorted({
+            row["hlo_fingerprint"]
+            for per in snap.get("executable_costs", {}).values()
+            for row in per.values() if row.get("hlo_fingerprint")}),
+        "knobs": snap.get("knobs", {}),
+        "knob_fingerprint": snap.get("knob_fingerprint"),
     }
+    reasons = snap["summary"].get("compile_reasons")
+    if reasons:
+        out["compile_reasons"] = reasons
     state = snap.get("optimizer_state_bytes_per_device")
     if state:
         out["optimizer_state_bytes_per_device"] = state
