@@ -34,6 +34,7 @@ from .. import optimizer as opt_mod
 from ..base import MXNetError
 from ..resilience import chaos as _chaos
 from ..telemetry import instruments as _ins
+from ..telemetry import mxgoodput as _goodput
 from ..telemetry import mxprof as _mxprof
 from ..telemetry import tracing as _tracing
 from ..util import env as _env
@@ -208,6 +209,11 @@ class Trainer:
             _chaos.check("trainer.preempt")
             if _chaos.check("trainer.numerics") == "corrupt":
                 self._corrupt_one_grad()
+        if _goodput._ACTIVE:
+            # goodput wiring: the FIRST step entry after a preemption
+            # resume closes the recovery window — training is doing
+            # useful work again (one falsy check when disabled)
+            _goodput.on_step_entry()
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size

@@ -30,6 +30,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from ..telemetry import instruments as _ins
+from ..telemetry import mxgoodput as _goodput
 from ..telemetry import mxhealth as _mxhealth
 from ..telemetry import tracing as _tracing
 from .. import optimizer as opt_mod
@@ -606,6 +607,10 @@ class SPMDTrainer:
         """Run one training step on a global batch; returns the loss
         (async — only .asnumpy() blocks).  The last ``n_labels`` args are
         labels, the rest model inputs."""
+        if _goodput._ACTIVE:
+            # first post-resume step entry closes the goodput
+            # preemption-recovery window (one falsy check when off)
+            _goodput.on_step_entry()
         n_lab = self.n_labels
         if n_lab == 0:
             inputs, labels = args, ()

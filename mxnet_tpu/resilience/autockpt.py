@@ -122,6 +122,10 @@ class AutoCheckpoint:
         self._q: "queue.Queue" = queue.Queue()
         self._writer: Optional[threading.Thread] = None
         self._writer_error: List[BaseException] = []
+        # set on the preemption branch of on_step so the sync save's
+        # meta records WHY (and when) it was cut — resume() uses it to
+        # open the goodput preemption-recovery window cross-process
+        self._preempt_info: Optional[dict] = None
         trainer._auto_ckpt = self
 
     # ---- the step hook --------------------------------------------------
@@ -131,6 +135,15 @@ class AutoCheckpoint:
         over cadence: save NOW (sync) and raise Preempted."""
         self.step += 1
         if preemption.triggered():
+            from ..telemetry import mxgoodput as _goodput
+
+            if _goodput._ACTIVE:
+                # recovery starts where the step boundary OBSERVES the
+                # trigger (never from the signal handler itself)
+                _goodput.on_preemption_trigger()
+            t = preemption.trigger_time()
+            self._preempt_info = {"reason": preemption.reason(),
+                                  "t_unix": t[0] if t else time.time()}
             path = self.save(sync=True)
             raise Preempted(
                 f"preempted ({preemption.reason()}); checkpoint for "
@@ -143,8 +156,17 @@ class AutoCheckpoint:
 
     def save(self, sync: bool = False) -> str:
         """Snapshot now; write now (sync) or on the writer thread.
-        Returns the FINAL step-dir path (the one resume will find)."""
+        Returns the FINAL step-dir path (the one resume will find).
+
+        Timing contract (``mx_ckpt_seconds`` + the goodput ledger):
+        everything this method does BLOCKS the step path and is
+        observed as ``mode="sync"`` — for an async save that is just
+        the host snapshot + enqueue; the daemon thread's disk time
+        overlaps training and lands in ``mode="async"`` instead
+        (recorded, never badput)."""
         self._raise_writer_error()
+        retry_mark = self._retry_backoff_mark()
+        t0 = time.monotonic()
         snap = self._snapshot()
         final = os.path.join(self._dir, f"{_STEP_PREFIX}{snap['step']:08d}")
         if sync:
@@ -153,7 +175,39 @@ class AutoCheckpoint:
         else:
             self._ensure_writer()
             self._q.put(snap)
+        self._record_blocking("save", time.monotonic() - t0, retry_mark)
         return final
+
+    @staticmethod
+    def _retry_backoff_mark() -> float:
+        from ..telemetry import mxgoodput as _goodput
+
+        # THIS thread's total: the blocking save/restore retries run
+        # on the calling thread, and a concurrent daemon writer's
+        # sleeps must not be deducted from this interval
+        return _goodput.retry_backoff_this_thread() \
+            if _goodput._ACTIVE else 0.0
+
+    def _record_blocking(self, op: str, dt: float,
+                         retry_mark: float) -> None:
+        """One blocking checkpoint interval: observe the histogram and
+        feed the goodput ledger.  Retry backoff that slept INSIDE this
+        interval (checkpoint I/O retries) keeps its own category — it
+        is deducted here, and its step-overlap credit is cancelled
+        (the sleep was inside a checkpoint, not a step)."""
+        from ..telemetry import instruments as _ins
+        from ..telemetry import mxgoodput as _goodput
+
+        _ins.ckpt_seconds(op, "sync").observe(dt)
+        if not _goodput._ACTIVE:
+            return
+        backoff = min(max(
+            0.0, _goodput.retry_backoff_this_thread()
+            - retry_mark), dt)
+        if backoff:
+            _goodput.consume_overlap(backoff)
+        cat = "checkpoint_save" if op == "save" else "checkpoint_restore"
+        _goodput.record_badput(cat, max(0.0, dt - backoff))
 
     def flush(self, timeout: Optional[float] = None) -> None:
         """Block until every queued async save is on disk."""
@@ -177,8 +231,15 @@ class AutoCheckpoint:
     def _writer_loop(self) -> None:
         while True:
             snap = self._q.get()
+            t0 = time.monotonic()
             try:
                 self._write(snap)
+                # daemon disk time: recorded (mode="async") but never
+                # badput — it overlapped training
+                from ..telemetry import instruments as _ins
+
+                _ins.ckpt_seconds("save", "async").observe(
+                    time.monotonic() - t0)
             except BaseException as e:  # surfaced on the next step
                 self._writer_error.append(e)
             finally:
@@ -198,7 +259,7 @@ class AutoCheckpoint:
             if p._data is None:
                 continue
             params[p.name] = np.asarray(p.list_data()[0].asnumpy())
-        return {
+        snap = {
             "step": self.step,
             "params": params,
             "states": tr._states_payload(),
@@ -206,6 +267,10 @@ class AutoCheckpoint:
             "position": self._state_provider()
             if self._state_provider is not None else None,
         }
+        if self._preempt_info is not None:
+            snap["preempt"] = dict(self._preempt_info)
+            self._preempt_info = None
+        return snap
 
     def _write(self, snap: Dict) -> None:
         self._retry.call(lambda: self._write_once(snap),
@@ -227,6 +292,11 @@ class AutoCheckpoint:
         meta = {"step": snap["step"], "rng": snap["rng"],
                 "position": snap["position"],
                 "saved_unix": time.time()}
+        if "preempt" in snap:
+            # this checkpoint was cut BY a preemption: resume() uses
+            # the trigger time to open the goodput recovery window —
+            # even in a fresh process, the downtime is measured
+            meta["preempt"] = snap["preempt"]
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f, indent=1)
         if os.path.exists(final):
@@ -262,11 +332,28 @@ class AutoCheckpoint:
         from ..ndarray.ndarray import array as nd_array
         from ..resource import resource_manager
 
+        from ..telemetry import mxgoodput as _goodput
+
         path = latest_step_dir(self._dir)
         if path is None:
             return None
         with open(os.path.join(path, "meta.json")) as f:
             meta = json.load(f)
+        if isinstance(meta.get("preempt"), dict):
+            if _goodput._ACTIVE:
+                # open the recovery window BEFORE the restore work so
+                # the restore seconds (attributed below) are deducted
+                # from it rather than double-counted; in-process the
+                # trigger already opened it and this is a no-op
+                _goodput.on_preemption_resume(
+                    meta["preempt"].get("t_unix"))
+            # the stamp is CONSUMED by this resume: a later resume
+            # from the same checkpoint (crash after hours of resumed
+            # training) must not re-open a window back to the original
+            # SIGTERM and attribute the interim to recovery
+            self._consume_preempt_stamp(path, meta)
+        retry_mark = self._retry_backoff_mark()
+        t0 = time.monotonic()
         tr = self._trainer
         by_name = {p.name: p for p in tr._params}
         with np.load(os.path.join(path, "params.npz")) as blob:
@@ -283,5 +370,23 @@ class AutoCheckpoint:
                        allow_resize=True)
         resource_manager().set_rng_state(meta["rng"])
         self.step = int(meta["step"])
+        self._record_blocking("restore", time.monotonic() - t0,
+                              retry_mark)
         preemption.clear()
         return meta
+
+    def _consume_preempt_stamp(self, path: str, meta: Dict) -> None:
+        """Rewrite meta.json with the preempt stamp demoted to
+        ``preempt_consumed`` (forensics stay; the trigger never
+        re-opens a recovery window).  Atomic like every checkpoint
+        write; best-effort — a read-only filesystem must not fail the
+        resume itself."""
+        on_disk = dict(meta)
+        on_disk["preempt_consumed"] = on_disk.pop("preempt")
+        tmp = os.path.join(path, ".tmp-meta.json")
+        try:
+            with open(tmp, "w") as f:
+                json.dump(on_disk, f, indent=1)
+            os.replace(tmp, os.path.join(path, "meta.json"))
+        except OSError:
+            pass

@@ -20,7 +20,7 @@ from typing import Optional
 from ..base import MXNetError
 
 __all__ = ["Preempted", "install", "trigger", "triggered", "reason",
-           "clear"]
+           "trigger_time", "clear"]
 
 
 class Preempted(MXNetError):
@@ -39,6 +39,11 @@ _FLAG = threading.Event()
 # re-enter rather than deadlock against its own thread
 _LOCK = threading.RLock()
 _REASON = [""]  # last trigger reason; writes hold _LOCK
+# when the trigger fired: (time.time(), time.monotonic()) — the start
+# mark of the mxgoodput preemption_recovery window (SIGTERM -> first
+# post-resume step).  The unix half is persisted into the preemption
+# checkpoint's meta so a FRESH process can still measure the downtime.
+_TRIGGER_T: list = [None]
 _INSTALLED = [False]
 
 
@@ -66,8 +71,12 @@ def install(signals=(getattr(_signal, "SIGTERM", None),)) -> None:
 
 def trigger(reason: str = "simulated") -> None:
     """Set the flag (signal handler / chaos / tests)."""
+    import time as _time
+
     with _LOCK:
         _REASON[0] = reason
+        if _TRIGGER_T[0] is None:  # first trigger wins: the window
+            _TRIGGER_T[0] = (_time.time(), _time.monotonic())
     _FLAG.set()
 
 
@@ -79,8 +88,16 @@ def reason() -> str:
     return _REASON[0]
 
 
+def trigger_time():
+    """``(unix_seconds, monotonic_seconds)`` of the first trigger, or
+    None — what opens the goodput recovery window and what the
+    preemption checkpoint meta persists."""
+    return _TRIGGER_T[0]
+
+
 def clear() -> None:
     """Reset after a handled preemption (resume() calls this)."""
     with _LOCK:
         _REASON[0] = ""
+        _TRIGGER_T[0] = None
     _FLAG.clear()

@@ -12,7 +12,11 @@ and serving execute (``serving/batcher.py``).  The contract:
     poisoned collective sequence — re-raises immediately: retrying a
     deterministic bug just triples its latency.
   * each retry bumps ``mx_retry_total{site}`` so a dashboard sees retry
-    pressure per site before it becomes an outage.
+    pressure per site before it becomes an outage, and every backoff
+    sleep bumps ``mx_retry_backoff_seconds_total{site}`` — the sleeps
+    were invisible wall-clock before; now they are measured whether or
+    not the mxgoodput ledger is enabled (when it is, they also land in
+    the ``retry_backoff`` badput category).
   * the budget is HARD.  After ``max_attempts`` attempts or once the
     next backoff would overrun ``budget_s`` (or the caller's deadline),
     :class:`RetryExhausted` is raised chained to the last error, with
@@ -145,7 +149,22 @@ class RetryPolicy:
                 if over_budget or past_deadline:
                     raise RetryExhausted(site, errors) from e
                 _ins.retry_total(site).inc()
+                # the sleep is real wall-clock the job is NOT training:
+                # measure it always (the counter is free), attribute it
+                # when the goodput ledger is on.  overlaps_step=True:
+                # a retry under a collective sleeps INSIDE the step's
+                # wall, and the ledger must not count those seconds
+                # again as comm-stall/productive.
+                t_sleep = time.monotonic()
                 time.sleep(delay)
+                slept = time.monotonic() - t_sleep
+                _ins.retry_backoff_seconds_total(site).inc(slept)
+                from ..telemetry import mxgoodput as _goodput
+
+                if _goodput._ACTIVE:
+                    _goodput.record_badput("retry_backoff", slept,
+                                           site=site,
+                                           overlaps_step=True)
 
 
 _DEFAULT = None

@@ -23,7 +23,8 @@ including the status codes the backpressure contract promises
                           while accepted work completes)
     GET  /statusz      -> one human-readable page: build info, uptime,
                           per-model serving counters, mxprof snapshot
-                          aggregates, and the currently-firing alerts
+                          aggregates, the mxgoodput ratio/badput line,
+                          and the currently-firing alerts
                           (telemetry.alerts.default_engine, ticked at
                           render time).  Drain-aware like /healthz:
                           the status code flips to 503 while draining
@@ -79,6 +80,7 @@ def _render_statusz(server) -> str:
 
     from ..telemetry import alerts as _alerts
     from ..telemetry import instruments as _ins
+    from ..telemetry import mxgoodput as _mxgoodput
     from ..telemetry import mxhealth as _mxhealth
     from ..telemetry import mxprof as _mxprof
 
@@ -141,6 +143,20 @@ def _render_statusz(server) -> str:
             lines.append("health:  (mxhealth not enabled)")
     except Exception:  # noqa: BLE001
         lines.append("health:  (unavailable)")
+    try:
+        if _mxgoodput.enabled():
+            g = _mxgoodput.snapshot()
+            top = sorted(((c, s) for c, s in g["badput_s"].items()
+                          if s > 0), key=lambda kv: -kv[1])[:3]
+            bad = ", ".join(f"{c} {s:.1f}s" for c, s in top) or "none"
+            lines.append(
+                f"goodput: {g['goodput_ratio']:.3f} over "
+                f"{g['wall_s']:.0f}s wall — badput: {bad}; "
+                f"unattributed {g['unattributed_s']:.1f}s")
+        else:
+            lines.append("goodput: (mxgoodput not enabled)")
+    except Exception:  # noqa: BLE001
+        lines.append("goodput: (unavailable)")
     lines.append("")
     lines.append("alerts:")
     try:

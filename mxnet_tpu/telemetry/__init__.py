@@ -48,6 +48,7 @@ from . import tracing
 from . import instruments
 from . import catalog
 from . import mxprof
+from . import mxgoodput
 from . import mxhealth
 from . import mxtriage
 from . import alerts
@@ -59,7 +60,7 @@ __all__ = [
     "flow_start", "flow_end", "counter_event",
     "enable", "disable", "enabled",
     "metrics", "tracing", "instruments", "catalog", "mxprof",
-    "mxhealth", "mxtriage", "alerts",
+    "mxgoodput", "mxhealth", "mxtriage", "alerts",
 ]
 
 

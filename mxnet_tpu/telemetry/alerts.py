@@ -33,9 +33,10 @@ Predicates come in two forms:
   * **callable** — ``predicate=lambda m: ...`` over a
     :class:`MetricView` for anything the comparison form cannot say.
 
-``serving_slo_rules`` and ``training_health_rules`` install the stock
-rule tables (serving p99 / queue depth / breaker state; nonfinite and
-spike events) on any engine — the same engine serves both, which is
+``serving_slo_rules``, ``training_health_rules`` and ``goodput_rules``
+install the stock rule tables (serving p99 / queue depth / breaker
+state; nonfinite and spike events; goodput-ratio floor and preemption
+recovery) on any engine — the same engine serves them all, which is
 the point: one alert surface for the whole process.
 """
 from __future__ import annotations
@@ -54,7 +55,7 @@ from .metrics import MetricsRegistry, get_registry
 
 __all__ = [
     "MetricView", "Rule", "AlertEngine", "default_engine",
-    "serving_slo_rules", "training_health_rules",
+    "serving_slo_rules", "training_health_rules", "goodput_rules",
 ]
 
 _OPS: Dict[str, Callable[[float, float], bool]] = {
@@ -473,4 +474,45 @@ def training_health_rules(engine: AlertEngine,
         increase=True,
         description="update/param ratio drift past "
                     "MXNET_HEALTH_RATIO_MAX")
+    return engine
+
+
+def goodput_rules(engine: AlertEngine,
+                  min_ratio: Optional[float] = None,
+                  for_s: float = 30.0,
+                  action: Optional[str] = None) -> AlertEngine:
+    """The stock goodput table over mxgoodput's families — surfaced on
+    ``/statusz`` next to the mxhealth verdict like every other stock
+    table on the default engine.
+
+    * ``goodput_below_min`` — ``mx_goodput_ratio`` under the floor
+      (``min_ratio`` or ``MXNET_GOODPUT_MIN``) for ``for_s`` seconds.
+      The for-duration matters here more than anywhere: the ratio is
+      legitimately low for the first seconds of a job (compile wall),
+      and a preemption recovery dents it transiently — only a
+      SUSTAINED dip should page.  The rule stays inactive until the
+      ledger publishes its first ratio (an absent family is None, not
+      zero).
+    * ``preemption_recovery`` — ``increase=`` delta semantics over the
+      monotone ``mx_badput_seconds_total{category=preemption_recovery}``
+      counter: fires when recovery seconds are being ADDED (a
+      preemption just cost wall-clock), resolves when the growth
+      stops — a raw-value rule would page forever after the first
+      preemption of the job's life."""
+    if min_ratio is None:
+        min_ratio = _env.get_float("MXNET_GOODPUT_MIN")
+    engine.add_rule(
+        "goodput_below_min", severity="page", for_=for_s,
+        metric="mx_goodput_ratio", op="<", threshold=min_ratio,
+        action=action,
+        description=f"job goodput ratio below {min_ratio:g} "
+                    f"(badput categories name where the wall-clock "
+                    f"went — see /statusz or the mxprof dump)")
+    engine.add_rule(
+        "preemption_recovery", severity="warning", for_=0.0,
+        metric="mx_badput_seconds_total",
+        labels={"category": "preemption_recovery"},
+        op=">", threshold=0, increase=True,
+        description="preemption recovery seconds grew since the last "
+                    "tick (a preemption just cost wall-clock)")
     return engine

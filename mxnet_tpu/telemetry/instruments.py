@@ -43,6 +43,8 @@ __all__ = [
     "grad_norm", "param_norm", "update_ratio", "nonfinite_total",
     "health_events_total", "health_steps_skipped_total",
     "alerts_firing", "alerts_total",
+    "goodput_ratio", "job_wall_seconds", "badput_seconds_total",
+    "retry_backoff_seconds_total", "ckpt_seconds",
     "build_info", "process_uptime_seconds", "process_rss_bytes",
     "retry_total", "fault_injected_total",
     "compile_cache_hit_total", "compile_cache_miss_total",
@@ -350,6 +352,59 @@ def alerts_firing(rule: str, severity: str):
 
 def alerts_total(rule: str, severity: str):
     return _child("mx_alerts_total", (rule, severity))
+
+
+# ---- mxgoodput: job-level goodput/badput accounting --------------------
+
+_spec("mx_goodput_ratio", "gauge",
+      "Productive training seconds / job wall-clock seconds of the "
+      "mxgoodput ledger (0..1). The one number a fleet operator "
+      "watches; MXNET_GOODPUT_MIN is the alert floor "
+      "(telemetry.alerts.goodput_rules).")
+_spec("mx_job_wall_seconds", "gauge",
+      "Wall-clock seconds the mxgoodput ledger has been accounting "
+      "for (since enable(); extended back to the preemption trigger "
+      "on a fresh-process resume). The denominator of "
+      "mx_goodput_ratio — the ledger's closure invariant guarantees "
+      "productive + badput + unattributed == this value.")
+_spec("mx_badput_seconds_total", "counter",
+      "Non-productive wall seconds attributed by the mxgoodput "
+      "ledger, by category: compile / data_wait / checkpoint_save "
+      "(step-path-blocking only) / checkpoint_restore / "
+      "preemption_recovery / retry_backoff / comm_stall. Categories "
+      "are disjoint — a data-wait second is never also counted as "
+      "comm_stall.", ("category",))
+_spec("mx_retry_backoff_seconds_total", "counter",
+      "Backoff sleep seconds of the retry policy, by call site — "
+      "previously invisible wall-clock. Bumped around the actual "
+      "time.sleep independent of whether mxgoodput is enabled.",
+      ("site",))
+_spec("mx_ckpt_seconds", "histogram",
+      "Checkpoint save/restore wall seconds. mode='sync' is the "
+      "step-path-BLOCKING portion (sync saves, the snapshot half of "
+      "async saves, and every restore); mode='async' is the daemon "
+      "writer's disk time, which overlaps training and is therefore "
+      "recorded but never counted as badput.", ("op", "mode"))
+
+
+def goodput_ratio():
+    return _child("mx_goodput_ratio")
+
+
+def job_wall_seconds():
+    return _child("mx_job_wall_seconds")
+
+
+def badput_seconds_total(category: str):
+    return _child("mx_badput_seconds_total", (category,))
+
+
+def retry_backoff_seconds_total(site: str):
+    return _child("mx_retry_backoff_seconds_total", (site,))
+
+
+def ckpt_seconds(op: str, mode: str):
+    return _child("mx_ckpt_seconds", (op, mode))
 
 
 # ---- process identity (what is being scraped) -------------------------

@@ -1,0 +1,215 @@
+"""mxgoodput — job-level goodput/badput accounting.
+
+mxprof (PR 10) explains a *step*, mxhealth (PR 11) judges the *math*,
+mxtriage (PR 12) explains a *regression* — mxgoodput answers the
+fleet-operator question none of them can: **what fraction of this
+job's wall-clock was productive training, and where did the rest go?**
+
+One ledger (:mod:`.ledger`) decomposes elapsed time into ``productive``
+versus named badput categories — ``compile``, ``data_wait``,
+``checkpoint_save`` (blocking portion only), ``checkpoint_restore``,
+``preemption_recovery``, ``retry_backoff{site}``, ``comm_stall`` —
+plus a computed ``unattributed`` remainder, under the closure
+invariant *everything sums to wall-clock; nothing silently vanishes*.
+
+Feeds are the existing seams, not new timers:
+
+  * a flight-recorder **step listener** (``mxprof.add_step_listener``)
+    consumes per-step records: step wall becomes productive after
+    compile / comm-stall are peeled off, data-wait rides beside it;
+  * ``RetryPolicy`` reports every backoff sleep (site-labeled);
+  * ``AutoCheckpoint`` reports blocking save and restore seconds and
+    stamps preemption saves so a resume can open the recovery window;
+  * the Trainer / SPMD step entry closes the recovery window at the
+    first post-resume step.
+
+Enable with ``MXNET_GOODPUT=1`` or :func:`enable` (rides along with
+the mxprof recorder); read back via :func:`snapshot`, the ``goodput``
+block of ``mxprof.dump()`` / SIGUSR2 dumps, ``/statusz``, or the
+``mx_goodput_ratio`` / ``mx_badput_seconds_total{category}`` /
+``mx_job_wall_seconds`` families on ``/metrics``.
+``tools/goodput_report.py`` rolls rank-qualified dumps into one
+job-level GOODPUT.json and runs the chaos known-answer gate.
+
+Disabled cost: every hook is a single falsy check on ``_ACTIVE`` (the
+chaos/mxhealth precedent); no listener is registered, so the step path
+pays nothing (tier-1 overhead gate).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ...util import env as _env
+from . import ledger as _ledger_mod
+from .ledger import CATEGORIES, GoodputLedger
+
+__all__ = [
+    "enable", "disable", "enabled", "ledger", "snapshot",
+    "record_badput", "category_seconds", "retry_backoff_this_thread",
+    "consume_overlap", "on_step_entry",
+    "on_preemption_trigger", "on_preemption_resume",
+    "CATEGORIES", "GoodputLedger",
+]
+
+#: Fast-path flag: False means every hook site is one falsy check and
+#: the mxprof step listener is not registered.
+_ACTIVE = False
+
+_lock = threading.Lock()
+_LEDGER: Optional[GoodputLedger] = None
+
+
+def ledger() -> GoodputLedger:
+    """The process ledger (created on first use; :func:`enable` is
+    what starts the clock/feed)."""
+    global _LEDGER
+    with _lock:
+        if _LEDGER is None:
+            _LEDGER = GoodputLedger()
+        return _LEDGER
+
+
+def _on_step(step: int) -> None:
+    """The flight-recorder step listener: fold newly closed records
+    into the ledger.  Registered through the module-level mxprof
+    helpers so an ``enable(ring=N)`` recorder swap carries it (and a
+    later :func:`disable` removes it from the LIVE recorder)."""
+    led = _LEDGER
+    if led is None or not _ACTIVE:
+        return
+    from .. import mxprof as _mxprof
+
+    try:
+        led.consume(_mxprof.recorder())
+    except Exception:  # noqa: BLE001 — accounting never breaks a step
+        pass
+
+
+def enable(fresh: bool = False) -> GoodputLedger:
+    """Start (or resume) goodput accounting: attach the mxprof flight
+    recorder (the span feed the ledger consumes) and register the step
+    listener.  ``fresh=True`` starts a new ledger — a new job's wall
+    clock must not inherit the previous one's.  Idempotent."""
+    global _LEDGER, _ACTIVE
+    from .. import mxprof as _mxprof
+
+    rec = _mxprof.recorder()
+    with _lock:
+        if _LEDGER is None or fresh:
+            # a fresh ledger accounts from NOW: records the recorder
+            # closed before this instant belong to wall-clock the
+            # ledger never saw — consuming them would over-attribute
+            # (closure error).  The high-water mark is set BEFORE the
+            # ledger is published: with a listener already live, a
+            # step closing concurrently must never see the fresh
+            # ledger at mark 0 and back-attribute the whole ring.
+            led = GoodputLedger()
+            led.set_record_high_water(rec.current_step())
+            _LEDGER = led
+        led = _LEDGER
+        _ACTIVE = True
+    _mxprof.enable()
+    _mxprof.add_step_listener(_on_step)
+    return led
+
+
+def disable() -> None:
+    """Stop accounting: deregister the step listener from the live
+    recorder (module-level remove — a held recorder reference would
+    miss an ``enable(ring=N)`` swap) and drop the hook flag.  The
+    ledger stays readable; the mxprof recorder is left as it was."""
+    global _ACTIVE
+    _ACTIVE = False
+    from .. import mxprof as _mxprof
+
+    _mxprof.remove_step_listener(_on_step)
+
+
+def enabled() -> bool:
+    return _ACTIVE
+
+
+def snapshot() -> dict:
+    """The ledger snapshot (consumes any records the listener has not
+    seen yet first, so a dump taken mid-step-stream is current)."""
+    led = ledger()
+    if _ACTIVE:
+        from .. import mxprof as _mxprof
+
+        try:
+            led.consume(_mxprof.recorder())
+        except Exception:  # noqa: BLE001 — a dump never fails on the feed
+            pass
+    return led.snapshot()
+
+
+def record_badput(category: str, seconds: float,
+                  site: Optional[str] = None,
+                  overlaps_step: bool = False) -> None:
+    """Interval feed for the attribution hooks (retry / autockpt);
+    a no-op while mxgoodput is disabled."""
+    if _ACTIVE:
+        ledger().record_badput(category, seconds, site=site,
+                               overlaps_step=overlaps_step)
+
+
+def category_seconds(category: str) -> float:
+    """Cumulative seconds attributed to one category (0.0 while
+    disabled with no ledger)."""
+    led = _LEDGER
+    return led.category_seconds(category) if led is not None else 0.0
+
+
+def retry_backoff_this_thread() -> float:
+    """Retry-backoff seconds slept on the calling thread — the mark
+    autockpt brackets a blocking save/restore with (a concurrent
+    daemon writer's sleeps must not be deducted from it)."""
+    led = _LEDGER
+    return led.retry_backoff_this_thread() if led is not None else 0.0
+
+
+def consume_overlap(seconds: float) -> None:
+    if _ACTIVE:
+        ledger().consume_overlap(seconds)
+
+
+def on_step_entry() -> None:
+    """Hook at Trainer/SPMD step entry: the FIRST step after a resume
+    stamps the recovery window with 'training resumed HERE'.  The
+    window closes when that step's record is consumed, at
+    min(this stamp, the record's start) — the stamp alone would
+    overlap the record (gluon's forward/backward siblings run before
+    Trainer.step), the record start alone could drift on the gspmd
+    next-boundary close; together they pin the end mark."""
+    led = _LEDGER
+    if led is not None and _ACTIVE:
+        led.mark_step_entry()
+
+
+def on_preemption_trigger() -> None:
+    """Hook where the step boundary OBSERVES the preemption flag
+    (AutoCheckpoint.on_step), before the sync save: opens the recovery
+    window at the trigger instant.  Never called from a signal
+    handler."""
+    if not _ACTIVE:
+        return
+    from ...resilience import preemption as _preemption
+
+    t = _preemption.trigger_time()
+    ledger().open_recovery(t0_mono=t[1] if t else None)
+
+
+def on_preemption_resume(t_unix: Optional[float] = None) -> None:
+    """Hook in ``AutoCheckpoint.resume`` when the restored checkpoint
+    was a preemption save: opens the recovery window (idempotent when
+    the trigger already opened it in-process).  ``t_unix`` is the
+    trigger time persisted in the checkpoint meta — a fresh process
+    extends its wall back to it so the downtime is measured, not
+    forgotten."""
+    if _ACTIVE:
+        ledger().open_recovery(t0_unix=t_unix)
+
+
+if _env.get_bool("MXNET_GOODPUT"):
+    enable()

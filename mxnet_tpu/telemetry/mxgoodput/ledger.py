@@ -1,0 +1,375 @@
+"""The goodput ledger: decompose a job's wall-clock into productive
+training versus named badput categories.
+
+One invariant rules this module — **closure**:
+
+    productive + sum(badput categories) + unattributed == wall clock
+
+Nothing silently vanishes: every second of elapsed time lands in
+exactly one bucket, and whatever the feeds could not attribute is
+*visible* as ``unattributed`` instead of being absorbed into a
+flattering ratio.  The ledger therefore never lets the attributed
+total exceed the wall (every feed is capped against the time that is
+actually left), and the snapshot reports the closure error when the
+caps had to engage.
+
+Categories (``CATEGORIES``):
+
+  * ``compile``              — XLA compile seconds that landed inside a
+                               step (mxprof compile events);
+  * ``data_wait``            — seconds the training loop waited on the
+                               input pipeline (the data-wait span);
+  * ``checkpoint_save``      — step-path-BLOCKING checkpoint save
+                               seconds (sync saves, and the snapshot
+                               portion of async saves; the daemon
+                               writer overlaps training and is metric-
+                               recorded but not badput);
+  * ``checkpoint_restore``   — restore seconds on resume;
+  * ``preemption_recovery``  — SIGTERM observation -> first post-resume
+                               step, minus the checkpoint/retry seconds
+                               inside that window (they keep their own
+                               categories);
+  * ``retry_backoff``        — backoff sleeps of the retry policy, with
+                               a per-site breakdown;
+  * ``comm_stall``           — the communication half of a step (the
+                               same comm split the mxprof roofline
+                               verdict uses);
+  * ``unattributed``         — the remainder (computed, never fed).
+
+Feeds come from the existing seams, not new timers: a flight-recorder
+step listener consumes mxprof per-step records (productive / compile /
+data_wait / comm_stall), while ``RetryPolicy``, ``AutoCheckpoint`` and
+the preemption module call :meth:`GoodputLedger.record_badput` /
+the recovery-window hooks with directly measured interval seconds.
+
+Category precedence inside one step record (the double-count guard):
+external interval badput that occurred during the step (retry sleeps
+inside a collective) is peeled off the record's COMM half first —
+those seconds are already in their own category, and a sleep inside a
+step can only have happened inside a retry-instrumented collective;
+credit beyond the comm half belongs to between-step sleeps (outside
+every record's wall) and is discarded rather than peeled off genuine
+compute — then ``compile``, then ``data_wait`` rides beside the step
+(the record's wall does not include it), then ``comm_stall``, and
+only the remainder is productive.  A data-wait second can therefore
+never also be counted as comm_stall, and a retry sleep never doubles
+as comm time.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from .. import instruments as _ins
+
+__all__ = ["CATEGORIES", "GoodputLedger"]
+
+#: every badput category the ledger can attribute (the docs taxonomy;
+#: ``unattributed`` is computed at snapshot time, never fed)
+CATEGORIES = (
+    "compile", "data_wait", "checkpoint_save", "checkpoint_restore",
+    "preemption_recovery", "retry_backoff", "comm_stall",
+)
+
+# comm half of a step record, mirroring the roofline split in
+# mxprof/recorder.py: grad-allreduce when present, else the phased
+# SPMD collectives, else the host-blocking collective spans
+_COMM_PHASES = ("reduce-scatter", "all-gather")
+
+
+def _record_comm_s(rec: dict) -> float:
+    phases = rec.get("phases") or {}
+    comm = phases.get("grad-allreduce", 0.0)
+    if comm == 0.0:
+        comm = sum(phases.get(nm, 0.0) for nm in _COMM_PHASES) \
+            or sum((rec.get("collectives") or {}).values())
+    return comm
+
+
+class GoodputLedger:
+    """Accumulates the decomposition; all mutation under one lock (the
+    feeds are step-scale and interval-scale, never op-scale)."""
+
+    def __init__(self, clock=time.monotonic):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._t0 = clock()
+        self._t0_unix = time.time()
+        self._productive = 0.0
+        self._steps = 0
+        self._badput: Dict[str, float] = {c: 0.0 for c in CATEGORIES}
+        self._retry_sites: Dict[str, float] = {}
+        # per-thread retry-backoff totals: autockpt deducts the sleeps
+        # that happened inside ITS blocking save/restore — which run on
+        # the calling thread — and must not see a concurrent daemon
+        # writer's sleeps (one small entry per thread that ever slept)
+        self._retry_by_thread: Dict[int, float] = {}
+        # mxprof record consumption state
+        self._last_step = 0
+        self._last_consume_mono: Optional[float] = None
+        # interval badput recorded since the last record consume that
+        # OVERLAPS step wall time (retry sleeps inside a collective) —
+        # peeled off the next record so it is not counted twice
+        self._overlap_since_consume = 0.0
+        # open preemption-recovery window:
+        # {"t0": mono, "mark": badput-at-open for the subtracted cats}
+        self._recovery: Optional[dict] = None
+
+    # ---- interval feeds ----------------------------------------------
+
+    def record_badput(self, category: str, seconds: float,
+                      site: Optional[str] = None,
+                      overlaps_step: bool = False) -> None:
+        """Attribute ``seconds`` of directly measured wall time to one
+        badput category.  ``overlaps_step=True`` marks seconds that may
+        fall INSIDE a step's wall (retry sleeps under a collective):
+        they are peeled off the next consumed step record so the step
+        decomposition cannot count them again."""
+        if category not in self._badput:
+            raise ValueError(f"unknown badput category {category!r} "
+                             f"(known: {CATEGORIES})")
+        s = max(0.0, float(seconds))
+        if s == 0.0:
+            return
+        with self._lock:
+            self._badput[category] += s
+            if category == "retry_backoff":
+                if site is not None:
+                    self._retry_sites[site] = \
+                        self._retry_sites.get(site, 0.0) + s
+                tid = threading.get_ident()
+                self._retry_by_thread[tid] = \
+                    self._retry_by_thread.get(tid, 0.0) + s
+            if overlaps_step:
+                self._overlap_since_consume += s
+        _ins.badput_seconds_total(category).inc(s)
+
+    def consume_overlap(self, seconds: float) -> None:
+        """Un-mark ``seconds`` of overlap credit: a caller that already
+        subtracted interval badput from its OWN measurement (autockpt
+        deducting retry sleeps from a save) tells the ledger those
+        seconds did not land inside a step after all."""
+        with self._lock:
+            self._overlap_since_consume = max(
+                0.0, self._overlap_since_consume - max(0.0, seconds))
+
+    def category_seconds(self, category: str) -> float:
+        with self._lock:
+            return self._badput.get(category, 0.0)
+
+    def retry_backoff_this_thread(self) -> float:
+        """Cumulative retry-backoff seconds slept on the CALLING
+        thread — the mark/delta autockpt uses so a concurrent daemon
+        writer's sleeps are never deducted from a sync save."""
+        with self._lock:
+            return self._retry_by_thread.get(threading.get_ident(),
+                                             0.0)
+
+    def set_record_high_water(self, step: int) -> None:
+        """Skip mxprof records at or below ``step``: they closed before
+        this ledger's clock started (a fresh ledger on a live recorder
+        must not back-attribute the previous job's steps)."""
+        with self._lock:
+            self._last_step = max(0, int(step))
+
+    # ---- preemption recovery window ----------------------------------
+
+    def _recovery_mark_locked(self) -> float:
+        # the categories the recovery window must NOT swallow: they are
+        # measured directly and keep their own attribution
+        return (self._badput["checkpoint_save"]
+                + self._badput["checkpoint_restore"]
+                + self._badput["retry_backoff"])
+
+    def open_recovery(self, t0_mono: Optional[float] = None,
+                      t0_unix: Optional[float] = None) -> None:
+        """Open the preemption-recovery window.  ``t0_mono`` is the
+        trigger instant on this process's monotonic clock; a resume in
+        a FRESH process passes ``t0_unix`` (the trigger time persisted
+        in the checkpoint meta) and the window — and the job wall —
+        extend back to it: the downtime between the preempted process
+        and this one is exactly what the category exists to expose."""
+        now = self._clock()
+        with self._lock:
+            if self._recovery is not None:
+                return  # first open wins (trigger beats resume)
+            t0 = t0_mono
+            if t0 is None and t0_unix is not None:
+                t0 = now - max(0.0, time.time() - float(t0_unix))
+            if t0 is None:
+                t0 = now
+            # never let the window reach back over already-attributed
+            # steps: recovery starts no earlier than the last closed
+            # step (the step SIGTERM interrupted stays productive)
+            if self._last_consume_mono is not None:
+                t0 = max(t0, self._last_consume_mono)
+            if t0 < self._t0:
+                # fresh-process resume: the job conceptually started at
+                # the preemption — stretch the wall so the downtime is
+                # inside it (closure still holds: it lands in
+                # preemption_recovery below)
+                self._t0 = t0
+                self._t0_unix = min(self._t0_unix,
+                                    t0_unix or self._t0_unix)
+            self._recovery = {"t0": t0,
+                              "mark": self._recovery_mark_locked()}
+
+    def mark_step_entry(self) -> None:
+        """Stamp the open recovery window with 'a training step has
+        ENTERED' (Trainer/SPMD step-entry hook).  The window does not
+        close here — the gluon step's forward/backward siblings ran
+        BEFORE Trainer.step, so closing now would overlap the record
+        that is about to close — but the stamp caps the close: the
+        consume below ends the window at min(step entry, record
+        start), so a record whose implied start drifts (gspmd's
+        next-boundary close) can never stretch recovery past the
+        moment training demonstrably resumed."""
+        with self._lock:
+            win = self._recovery
+            if win is not None and "entered" not in win:
+                win["entered"] = self._clock()
+
+    def close_recovery(self, end_mono: Optional[float] = None) -> float:
+        """Close the window at ``end_mono`` (default: now).  Returns
+        the recovery seconds attributed."""
+        now = self._clock() if end_mono is None else end_mono
+        with self._lock:
+            before = self._badput["preemption_recovery"]
+            self._close_recovery_locked(now)
+            return self._badput["preemption_recovery"] - before
+
+    def recovery_open(self) -> bool:
+        with self._lock:
+            return self._recovery is not None
+
+    # ---- the step-record feed ----------------------------------------
+
+    def consume(self, recorder) -> int:
+        """Fold every mxprof record newer than the last consumed one
+        into the ledger (the flight-recorder step listener calls this
+        after each record closes).  Returns how many were consumed."""
+        with self._lock:
+            last = self._last_step
+        recs = recorder.records_since(last)
+        if not recs and recorder.current_step() < last:
+            # the recorder was clear()ed/swapped: its step counter
+            # restarted below our high-water mark
+            with self._lock:
+                self._last_step = 0
+            recs = recorder.records_since(0)
+        if not recs:
+            return 0
+        now = self._clock()
+        with self._lock:
+            # re-filter against the CURRENT mark: a snapshot() consume
+            # racing the listener's must not fold the same records
+            # twice (both read the mark before either advanced it)
+            recs = [r for r in recs if r["step"] > self._last_step]
+            if not recs:
+                return 0
+            if self._recovery is not None:
+                # first post-resume record: close the window at the
+                # step's START (its wall reaches back over the
+                # forward/backward siblings) so the step itself stays
+                # productive; the step-entry stamp caps it from above
+                wall0 = float(recs[0].get("wall_s") or 0.0)
+                end = now - wall0
+                entered = self._recovery.get("entered")
+                if entered is not None:
+                    end = min(end, entered)
+                self._close_recovery_locked(max(end,
+                                                self._recovery["t0"]))
+            for rec in recs:
+                self._consume_one_locked(rec)
+            self._last_step = recs[-1]["step"]
+            self._last_consume_mono = now
+            wall = now - self._t0
+            ratio = (self._productive / wall) if wall > 0 else 0.0
+        _ins.job_wall_seconds().set(wall)
+        _ins.goodput_ratio().set(ratio)
+        return len(recs)
+
+    def _close_recovery_locked(self, end_mono: float) -> None:
+        win = self._recovery
+        if win is None:
+            return
+        self._recovery = None
+        already = self._recovery_mark_locked() - win["mark"]
+        s = max(0.0, (end_mono - win["t0"]) - max(0.0, already))
+        if s:
+            self._badput["preemption_recovery"] += s
+            # counter bump under the lock is fine here: instruments'
+            # RLock never calls back into the ledger
+            _ins.badput_seconds_total("preemption_recovery").inc(s)
+
+    def _consume_one_locked(self, rec: dict) -> None:
+        wall = max(0.0, float(rec.get("wall_s") or 0.0))
+        # precedence: (1) peel interval badput already attributed
+        # elsewhere OUT OF THE COMM HALF — a retry sleep that fell
+        # inside this step's wall can only have slept inside a
+        # retry-instrumented collective, so it shows up there; credit
+        # beyond the comm half belongs to sleeps BETWEEN steps (their
+        # wall is outside every record) and is discarded, never peeled
+        # off genuine compute; (2) compile; (3) comm; remainder
+        # productive.  data_wait rides BESIDE the wall (the record's
+        # wall excludes the between-step wait).
+        avail = wall
+        comm_raw = max(0.0, _record_comm_s(rec))
+        overlap = min(self._overlap_since_consume, comm_raw, avail)
+        self._overlap_since_consume = 0.0  # drained: older credit
+        # cannot belong to a future step's wall
+        avail -= overlap
+        compile_s = min(max(0.0, float(rec.get("compile_s") or 0.0)),
+                        avail)
+        avail -= compile_s
+        comm_s = min(comm_raw - overlap, avail)
+        avail -= comm_s
+        dwait = max(0.0, float(rec.get("data_wait_s") or 0.0))
+        self._steps += 1
+        self._productive += avail
+        if compile_s:
+            self._badput["compile"] += compile_s
+            _ins.badput_seconds_total("compile").inc(compile_s)
+        if comm_s:
+            self._badput["comm_stall"] += comm_s
+            _ins.badput_seconds_total("comm_stall").inc(comm_s)
+        if dwait:
+            self._badput["data_wait"] += dwait
+            _ins.badput_seconds_total("data_wait").inc(dwait)
+
+    # ---- snapshot -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The ledger as one JSON-able dict; closure holds by
+        construction (``unattributed`` is the clamped remainder, and
+        ``closure.error_s`` exposes any over-attribution instead of
+        hiding it)."""
+        now = self._clock()
+        with self._lock:
+            wall = max(0.0, now - self._t0)
+            badput = {c: round(v, 6) for c, v in self._badput.items()}
+            accounted = self._productive + sum(self._badput.values())
+            unattributed = wall - accounted
+            ratio = (self._productive / wall) if wall > 0 else 0.0
+            out = {
+                "started_unix": self._t0_unix,
+                "wall_s": round(wall, 6),
+                "steps": self._steps,
+                "productive_s": round(self._productive, 6),
+                "badput_s": badput,
+                "retry_backoff_by_site": {
+                    k: round(v, 6)
+                    for k, v in sorted(self._retry_sites.items())},
+                "unattributed_s": round(max(0.0, unattributed), 6),
+                "goodput_ratio": round(min(1.0, max(0.0, ratio)), 6),
+                "closure": {
+                    "accounted_s": round(accounted, 6),
+                    "error_s": round(min(0.0, unattributed), 6),
+                    "ok": unattributed >= -1e-3 * max(wall, 1.0),
+                },
+                "recovery_open": self._recovery is not None,
+            }
+        _ins.job_wall_seconds().set(wall)
+        _ins.goodput_ratio().set(out["goodput_ratio"])
+        return out

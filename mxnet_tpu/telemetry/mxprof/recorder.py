@@ -310,6 +310,28 @@ class FlightRecorder:
         with self._lock:
             return list(self._ring)
 
+    def records_since(self, step: int):
+        """Records with ``step`` strictly greater than the given
+        high-water mark, oldest first — the incremental read the
+        mxgoodput ledger consumes per step close.  Scans from the
+        ring's tail, so the per-step cost is the handful of new
+        records, not the whole ring."""
+        with self._lock:
+            out = []
+            for rec in reversed(self._ring):
+                if rec["step"] <= step:
+                    break
+                out.append(rec)
+        out.reverse()
+        return out
+
+    def current_step(self) -> int:
+        """The last closed step number (0 before any record closes;
+        restarts at 0 on clear() — consumers use it to notice a
+        recorder swap)."""
+        with self._lock:
+            return self._step
+
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
@@ -394,6 +416,17 @@ class FlightRecorder:
             "knobs": knobs,
             "knob_fingerprint": knob_fp,
         }
+        # the goodput ledger rides every dump (mxprof.dump(), SIGUSR2,
+        # embedded bench snapshots): a per-rank dump is what
+        # tools/goodput_report.py --merge rolls into the job-level
+        # GOODPUT.json
+        try:
+            from .. import mxgoodput as _goodput
+
+            if _goodput.enabled():
+                out["goodput"] = _goodput.snapshot()
+        except Exception:  # noqa: BLE001 — a dump never fails on the ledger
+            pass
         if include_records:
             out["records"] = self.records()
         return out

@@ -1,0 +1,117 @@
+"""mxtriage — the "why" layer on top of mxprof.
+
+mxprof (PR 10) and mxhealth (PR 11) say *that* a step is slow or a
+nightly regressed; mxtriage says *why*, three ways:
+
+  * **On-demand deep capture** (:mod:`.capture`): one bounded-window,
+    admission-gated ``jax.profiler`` capture API —
+    ``deep_capture(steps=N | seconds=S)`` — invocable from training
+    (step-boundary window), serving (``POST /profilez``), the shell
+    (``kill -USR1``), and a firing alert rule
+    (``action="deep_capture"``, rate-limited).  Artifacts are indexed
+    beside the mxprof dump with the triggering rule/step recorded.
+    The legacy manual bracket (``profiler.start_xla_trace``) and
+    ``tools/profile_bench.py`` are refolded onto this path.
+  * **Compile provenance** (:mod:`.provenance`): every compile-cache
+    miss records which signature component changed vs the nearest
+    prior compile at the same site (avals / statics / donation /
+    program / env), into ``mx_compile_reason_total{site,component}``
+    and the mxprof compile-event stream.
+  * **Regression attribution** (:mod:`.attribution`): diff the mxprof
+    aggregates embedded in fresh-vs-baseline bench artifacts into a
+    ranked ``suspects`` list — what ``tools/perf_compare.py`` emits
+    when a lane fails.
+
+See docs/observability.md ("Deep capture" and "Why did it recompile /
+why did it regress").
+"""
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Optional
+
+from . import attribution, capture, provenance
+from .capture import CaptureBusy, manager
+
+__all__ = [
+    "deep_capture", "start_manual", "stop_manual",
+    "trigger_from_alert", "active", "index", "install_sigusr1",
+    "CaptureBusy", "manager",
+    "attribution", "capture", "provenance",
+]
+
+
+def deep_capture(steps: Optional[int] = None,
+                 seconds: Optional[float] = None,
+                 trigger: str = "manual",
+                 rule: Optional[str] = None,
+                 severity: Optional[str] = None,
+                 block: bool = True,
+                 timeout: Optional[float] = None) -> Optional[dict]:
+    """One bounded deep capture through the process manager; see
+    :meth:`.capture.CaptureManager.deep_capture`."""
+    return manager().deep_capture(steps=steps, seconds=seconds,
+                                  trigger=trigger, rule=rule,
+                                  severity=severity, block=block,
+                                  timeout=timeout)
+
+
+def start_manual(logdir: Optional[str] = None) -> str:
+    """Open-ended capture holding the admission slot until
+    :func:`stop_manual` (what ``profiler.start_xla_trace`` calls)."""
+    return manager().start_manual(logdir)
+
+
+def stop_manual() -> Optional[str]:
+    return manager().stop_manual()
+
+
+def trigger_from_alert(rule: str, severity: Optional[str] = None,
+                       value=None) -> str:
+    """Rate-limited, non-blocking capture trigger for
+    ``action="deep_capture"`` alert rules."""
+    return manager().trigger_from_alert(rule, severity=severity,
+                                        value=value)
+
+
+def active() -> Optional[dict]:
+    return manager().active()
+
+
+def index() -> list:
+    """The capture index (newest last)."""
+    return manager().index()
+
+
+_sig_lock = threading.Lock()
+_SIG_INSTALLED = False
+
+
+def _on_sigusr1(signum, frame):  # pragma: no cover — exercised via kill
+    # same discipline as mxprof's SIGUSR2: NEVER work inline in the
+    # handler (the interrupted frame may hold the very locks the
+    # capture path needs) — a daemon thread runs the capture
+    def run():
+        try:
+            deep_capture(trigger="sigusr1", block=True)
+        except Exception:  # noqa: BLE001 — incl. CaptureBusy: signal is advisory
+            pass
+
+    threading.Thread(target=run, name="mxtriage-sigusr1",
+                     daemon=True).start()
+
+
+def install_sigusr1() -> bool:
+    """Install the SIGUSR1 deep-capture handler (main thread only,
+    best effort).  Returns whether the handler is installed."""
+    global _SIG_INSTALLED
+    with _sig_lock:
+        if _SIG_INSTALLED:
+            return True
+        try:
+            signal.signal(signal.SIGUSR1, _on_sigusr1)
+        except (ValueError, OSError, AttributeError):
+            return False  # non-main thread / platform without SIGUSR1
+        _SIG_INSTALLED = True
+        return True

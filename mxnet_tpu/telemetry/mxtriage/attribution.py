@@ -1,0 +1,254 @@
+"""Perf-regression attribution: turn a red gate into ranked suspects.
+
+``tools/perf_compare.py`` knows *that* a lane regressed ("throughput
+-12%"); this module reads the mxprof aggregates embedded in the same
+bench artifacts (per-phase seconds, collective bytes, data-wait, MFU,
+compile counts, HLO fingerprints, the registered-knob fingerprint) on
+BOTH sides of the diff and answers *what moved*:
+
+    suspects = rank_suspects(baseline_artifact, fresh_artifact)
+    # [{"kind": "phase", "name": "grad-allreduce", "base_s": 0.8,
+    #   "fresh_s": 1.1, "change": "+38%", "score": ...}, ...]
+
+Deliberately **stdlib-only with no package-relative imports**:
+``perf_compare`` is a dependency-light nightly tool and loads this
+file directly (``importlib`` by path) — importing the framework (and
+jax) to rank a JSON diff would be absurd.  It also imports normally as
+``mxnet_tpu.telemetry.mxtriage.attribution``.
+
+Scoring is deliberately simple and stable: each suspect's score is its
+relative change scaled by a kind weight (a phase that grew 200% ranks
+above a knob that changed, which ranks above a 12% byte-count drift).
+Qualitative findings that cannot regress by themselves (a knob change,
+a program-fingerprint change) surface as suspects with flat scores;
+stable fingerprints land in ``context`` notes so "the program did NOT
+change" is stated, not implied.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["collect_aggregates", "rank_suspects"]
+
+# dict nodes carrying at least one of these keys are mxprof aggregate
+# blocks (a SCALING sweep row, an embedded snapshot summary, ...)
+_SIGNAL_KEYS = ("phase_seconds", "collective_bytes", "data_wait_s",
+                "data_wait_s_total", "mfu", "compiles",
+                "compile_reasons", "knobs", "knob_fingerprint",
+                "hlo_fingerprints", "badput_seconds", "goodput_ratio")
+
+# kind weights: how alarming a 1.0 (=100%) relative change of each
+# signal is relative to the others
+_WEIGHTS = {"phase": 1.0, "data-wait": 1.0, "mfu": 1.0, "badput": 1.0,
+            "goodput": 1.0, "compiles": 0.9, "collective-bytes": 0.5}
+# flat scores for qualitative suspects (no meaningful magnitude)
+_FLAT = {"knob": 0.75, "program": 0.8}
+
+# ignore sub-floor noise: seconds for phases/data-wait, fraction
+# for relative changes
+_ABS_FLOOR_S = 0.02
+_REL_FLOOR = 0.10
+
+
+def _node_id(node: dict, idx: int) -> str:
+    """A stable label for a list element (SCALING sweep rows carry
+    path/processes); falls back to the index."""
+    bits = [str(node[k]) for k in ("path", "model", "processes", "name")
+            if k in node and not isinstance(node[k], (dict, list))]
+    return ".".join(bits) if bits else str(idx)
+
+
+def collect_aggregates(doc) -> Dict[str, dict]:
+    """Walk one bench-artifact JSON tree; return {path: node} for every
+    dict node that carries mxprof aggregate keys."""
+    out: Dict[str, dict] = {}
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            if any(k in node for k in _SIGNAL_KEYS):
+                out[path or "."] = node
+            for k, v in node.items():
+                walk(v, f"{path}.{k}" if path else k)
+        elif isinstance(node, list):
+            for i, v in enumerate(node):
+                if isinstance(v, dict):
+                    walk(v, f"{path}[{_node_id(v, i)}]")
+    walk(doc, "")
+    return out
+
+
+def _phase_s(v) -> Optional[float]:
+    """phase_seconds values come flat (float) or as
+    {"seconds": x, "count": n} (scaling_bench rows)."""
+    if isinstance(v, dict):
+        v = v.get("seconds")
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def _pct(base: float, fresh: float) -> str:
+    if base <= 0:
+        return "new"
+    d = (fresh / base - 1.0) * 100.0
+    return f"{d:+.0f}%"
+
+
+def _diff_node(where: str, base: dict, fresh: dict,
+               suspects: List[dict], context: List[str]) -> None:
+    # per-phase seconds: the primary "where did the time go" signal
+    bp, fp = base.get("phase_seconds") or {}, \
+        fresh.get("phase_seconds") or {}
+    for name in sorted(set(bp) | set(fp)):
+        b, f = _phase_s(bp.get(name, 0.0)), _phase_s(fp.get(name, 0.0))
+        if b is None or f is None:
+            continue
+        if f > b and (f - b) > _ABS_FLOOR_S and \
+                (b == 0 or f / b - 1.0 > _REL_FLOOR):
+            rel = (f / b - 1.0) if b > 0 else 1.0
+            suspects.append({
+                "kind": "phase", "name": name, "where": where,
+                "base_s": round(b, 4), "fresh_s": round(f, 4),
+                "change": _pct(b, f),
+                "score": round(rel * _WEIGHTS["phase"], 4)})
+    # data-wait growth: the input pipeline as suspect (scaling rows
+    # say data_wait_s; embedded mxprof summaries say data_wait_s_total)
+    bw = base.get("data_wait_s", base.get("data_wait_s_total"))
+    fw = fresh.get("data_wait_s", fresh.get("data_wait_s_total"))
+    if isinstance(bw, (int, float)) and isinstance(fw, (int, float)) \
+            and fw > bw and fw - bw > _ABS_FLOOR_S:
+        rel = (fw / bw - 1.0) if bw > 0 else 1.0
+        suspects.append({
+            "kind": "data-wait", "name": "data-wait", "where": where,
+            "base_s": round(float(bw), 4), "fresh_s": round(float(fw), 4),
+            "change": _pct(float(bw), float(fw)),
+            "score": round(rel * _WEIGHTS["data-wait"], 4)})
+    # MFU drop (an efficiency collapse with flat wall time)
+    bm = (base.get("mfu") or {}).get("mean") \
+        if isinstance(base.get("mfu"), dict) else base.get("mfu")
+    fm = (fresh.get("mfu") or {}).get("mean") \
+        if isinstance(fresh.get("mfu"), dict) else fresh.get("mfu")
+    if isinstance(bm, (int, float)) and isinstance(fm, (int, float)) \
+            and bm > 0 and fm < bm * (1.0 - _REL_FLOOR):
+        rel = 1.0 - fm / bm
+        suspects.append({
+            "kind": "mfu", "name": "mfu", "where": where,
+            "base": round(float(bm), 6), "fresh": round(float(fm), 6),
+            "change": _pct(float(bm), float(fm)),
+            "score": round(rel * _WEIGHTS["mfu"], 4)})
+    # badput-category growth (mxgoodput): a category that grew names
+    # where the lost wall-clock went — the same shape as a phase
+    # suspect, but at job altitude
+    bbp, fbp = base.get("badput_seconds") or {}, \
+        fresh.get("badput_seconds") or {}
+    for name in sorted(set(bbp) | set(fbp)):
+        try:
+            b, f = float(bbp.get(name, 0.0)), float(fbp.get(name, 0.0))
+        except (TypeError, ValueError):
+            continue
+        if f > b and (f - b) > _ABS_FLOOR_S and \
+                (b == 0 or f / b - 1.0 > _REL_FLOOR):
+            rel = (f / b - 1.0) if b > 0 else 1.0
+            suspects.append({
+                "kind": "badput", "name": name, "where": where,
+                "base_s": round(b, 4), "fresh_s": round(f, 4),
+                "change": _pct(b, f),
+                "score": round(rel * _WEIGHTS["badput"], 4)})
+    # goodput-ratio drop (the job-level efficiency collapse; the
+    # badput suspects above say WHERE it went)
+    bg, fg = base.get("goodput_ratio"), fresh.get("goodput_ratio")
+    if isinstance(bg, (int, float)) and isinstance(fg, (int, float)) \
+            and bg > 0 and fg < bg * (1.0 - _REL_FLOOR):
+        rel = 1.0 - fg / bg
+        suspects.append({
+            "kind": "goodput", "name": "goodput_ratio", "where": where,
+            "base": round(float(bg), 6), "fresh": round(float(fg), 6),
+            "change": _pct(float(bg), float(fg)),
+            "score": round(rel * _WEIGHTS["goodput"], 4)})
+    # collective bytes drift (a bucket-plan / quantization change
+    # shows up here before anywhere else)
+    bb, fb = base.get("collective_bytes") or {}, \
+        fresh.get("collective_bytes") or {}
+    for name in sorted(set(bb) | set(fb)):
+        b, f = float(bb.get(name, 0) or 0), float(fb.get(name, 0) or 0)
+        if b <= 0 and f <= 0:
+            continue
+        rel = abs(f - b) / max(b, f)
+        if rel > _REL_FLOOR:
+            suspects.append({
+                "kind": "collective-bytes", "name": name,
+                "where": where, "base": int(b), "fresh": int(f),
+                "change": _pct(b, f),
+                "score": round(rel * _WEIGHTS["collective-bytes"], 4)})
+    # compile-count growth = a recompile storm; name its cause when
+    # the provenance aggregates rode along
+    bc, fc = base.get("compiles"), fresh.get("compiles")
+    if isinstance(bc, (int, float)) and isinstance(fc, (int, float)) \
+            and fc > bc:
+        rel = (fc / bc - 1.0) if bc > 0 else 1.0
+        sus = {"kind": "compiles", "name": "compiles", "where": where,
+               "base": int(bc), "fresh": int(fc),
+               "change": _pct(float(bc), float(fc)),
+               "score": round(min(rel, 4.0) * _WEIGHTS["compiles"], 4)}
+        reasons = fresh.get("compile_reasons")
+        if isinstance(reasons, dict) and reasons:
+            sus["reasons"] = reasons
+        suspects.append(sus)
+    # registered knobs: a changed value is a first-class suspect
+    bk, fk = base.get("knobs") or {}, fresh.get("knobs") or {}
+    for name in sorted(set(bk) | set(fk)):
+        if bk.get(name) != fk.get(name):
+            suspects.append({
+                "kind": "knob", "name": name, "where": where,
+                "base": bk.get(name), "fresh": fk.get(name),
+                "change": f"{bk.get(name)!r} -> {fk.get(name)!r}",
+                "score": _FLAT["knob"]})
+    bkf, fkf = base.get("knob_fingerprint"), \
+        fresh.get("knob_fingerprint")
+    if bkf and fkf:
+        if bkf != fkf and not any(s["kind"] == "knob"
+                                  and s["where"] == where
+                                  for s in suspects):
+            suspects.append({
+                "kind": "knob", "name": "knob_fingerprint",
+                "where": where, "base": bkf[:12], "fresh": fkf[:12],
+                "change": "registered-knob fingerprint changed "
+                          "(value-level diff not recorded)",
+                "score": _FLAT["knob"]})
+        elif bkf == fkf:
+            context.append(f"{where}: registered-knob fingerprint "
+                           f"stable")
+    # HLO program fingerprints: did the compiled program change?
+    bf = base.get("hlo_fingerprints")
+    ff = fresh.get("hlo_fingerprints")
+    if isinstance(bf, list) and isinstance(ff, list) and (bf or ff):
+        if set(bf) != set(ff):
+            suspects.append({
+                "kind": "program", "name": "hlo_fingerprints",
+                "where": where,
+                "base": sorted(x[:12] for x in bf),
+                "fresh": sorted(x[:12] for x in ff),
+                "change": "compiled program fingerprints changed",
+                "score": _FLAT["program"]})
+        else:
+            context.append(f"{where}: program fingerprints stable")
+
+
+def rank_suspects(base_doc, fresh_doc) -> Tuple[List[dict], List[str]]:
+    """Diff the mxprof aggregates of two bench artifacts; returns
+    ``(suspects, context)`` with suspects ranked most-suspicious
+    first.  Aggregate blocks pair by their JSON path; a block present
+    on only one side contributes nothing (a renamed lane has no
+    baseline to diff)."""
+    base_nodes = collect_aggregates(base_doc)
+    fresh_nodes = collect_aggregates(fresh_doc)
+    suspects: List[dict] = []
+    context: List[str] = []
+    for path in sorted(set(base_nodes) & set(fresh_nodes)):
+        _diff_node(path, base_nodes[path], fresh_nodes[path],
+                   suspects, context)
+    suspects.sort(key=lambda s: (-s["score"], s["kind"], s["name"]))
+    for i, s in enumerate(suspects):
+        s["rank"] = i + 1
+    return suspects, context

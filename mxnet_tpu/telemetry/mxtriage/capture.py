@@ -1,0 +1,487 @@
+"""On-demand deep capture: one bounded ``jax.profiler`` window.
+
+The always-on layers (mxprof, mxhealth) are cheap because they stay at
+step granularity; the *op-level* XLA timeline is expensive and used to
+require manually bracketing ``profiler.start_xla_trace`` around the
+right code.  This module makes the deep capture an on-demand,
+admission-gated action every surface can invoke through ONE path:
+
+    mxtriage.deep_capture(steps=3)      # training: step-boundary window
+    mxtriage.deep_capture(seconds=2.0)  # serving / any process
+    POST /profilez                      # the HTTP front end
+    kill -USR1 <pid>                    # from outside
+    alerts.Rule(..., action="deep_capture")   # a firing alert
+
+Admission: at most ONE capture per process may be armed or recording —
+a second request answers ``CaptureBusy`` (HTTP 409) instead of
+stacking jax profiler sessions (which corrupts both traces).  Alert
+triggers are additionally rate-limited (MXNET_TRIAGE_ALERT_INTERVAL_S)
+so a flapping rule cannot turn the profiler into a DoS on its own
+process.
+
+Every capture lands in its own directory under MXNET_TRIAGE_DIR:
+the xplane trace, an ``mxprof.json`` aggregate snapshot of the same
+window, and a ``meta.json`` recording the trigger, the firing rule,
+and the step span — and is indexed in ``index.json`` beside them, so
+"what captured this and why" is answerable weeks later.
+
+``steps=N`` windows arm on the next mxprof step boundary and stop N
+boundaries later (the flight recorder's step listeners drive both
+edges); a watchdog (MXNET_TRIAGE_STEP_TIMEOUT_S) force-stops a window
+whose boundaries never arrive.  ``seconds=S`` windows start
+immediately and a timer stops them.  The legacy manual bracket
+(``profiler.start_xla_trace``/``stop_xla_trace``) is refolded onto
+:func:`start_manual`/:func:`stop_manual` — same admission slot, same
+index.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from ...base import MXNetError
+from ...util import env as _env
+from .. import instruments as _ins
+from .. import tracing as _tracing
+
+__all__ = ["CaptureBusy", "CaptureManager", "manager"]
+
+_SEQ = itertools.count(1)
+
+
+class CaptureBusy(MXNetError):
+    """A deep capture is already armed or recording in this process
+    (the admission gate; retry after it completes)."""
+
+
+# ---------------------------------------------------------------------------
+# profiler backend (separated so tests stub it; jax imports stay lazy)
+# ---------------------------------------------------------------------------
+
+def _start_backend(logdir: str) -> None:
+    import jax
+
+    jax.profiler.start_trace(logdir)
+
+
+def _stop_backend() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+def _current_step() -> Optional[int]:
+    """The mxprof flight recorder's step counter right now — stamped
+    into every capture's meta so even a seconds-window (alert, http)
+    capture records WHICH training steps it covered."""
+    try:
+        from .. import mxprof
+
+        return mxprof.recorder()._step
+    except Exception:  # noqa: BLE001 — meta stays None-steps, capture proceeds
+        return None
+
+
+class _Session:
+    """One capture's lifecycle state (owned by the manager's lock)."""
+
+    def __init__(self, trigger: str, mode: str, want, out_dir: str,
+                 rule: Optional[str], severity: Optional[str]):
+        self.trigger = trigger
+        self.mode = mode              # "steps" | "seconds" | "manual"
+        self.want = want              # N steps / S seconds / None
+        self.dir = out_dir
+        self.rule = rule
+        self.severity = severity
+        self.t_request = time.time()
+        self.t_start: Optional[float] = None
+        self.step_begin: Optional[int] = None
+        self.step_end: Optional[int] = None
+        self.started = False
+        self.status = "pending"
+        self.done = threading.Event()
+        self.meta: Optional[dict] = None
+        # serializes the WINDOW EDGES (backend start vs stop): a step
+        # listener starting the trace must not race a watchdog that
+        # already closed the window — the loser would leave the jax
+        # profiler running forever, poisoning every later capture
+        self.edge = threading.Lock()
+
+
+class CaptureManager:
+    """The process capture slot + artifact index.  One instance per
+    process (:func:`manager`); tests build private ones with stubbed
+    backends."""
+
+    def __init__(self, base_dir: Optional[str] = None,
+                 start_backend=None, stop_backend=None):
+        self._lock = threading.Lock()
+        self._session: Optional[_Session] = None
+        self._last_alert_t: Optional[float] = None
+        self._base_dir = base_dir
+        self._start = start_backend or _start_backend
+        self._stop = stop_backend or _stop_backend
+
+    # ---- paths -------------------------------------------------------
+
+    def base_dir(self) -> str:
+        return self._base_dir or _env.get_str("MXNET_TRIAGE_DIR") \
+            or "mxtriage"
+
+    @staticmethod
+    def _who() -> str:
+        """Rank-qualified process identity for artifact names on a
+        SHARED base dir (same lesson as mxprof's default dump path:
+        containerized multi-host ranks all run as pid 1, so pid alone
+        collides — the job rank, once dist stamped it, does not)."""
+        rank = _tracing._RANK
+        return f"r{rank}" if rank is not None else f"p{os.getpid()}"
+
+    def _new_dir(self, trigger: str) -> str:
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        d = os.path.join(
+            self.base_dir(),
+            f"deep-{stamp}-{trigger}-{self._who()}-{next(_SEQ)}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    # ---- admission ---------------------------------------------------
+
+    def active(self) -> Optional[dict]:
+        """The in-flight capture's public view, or None."""
+        with self._lock:
+            s = self._session
+            if s is None:
+                return None
+            return {"trigger": s.trigger, "mode": s.mode,
+                    "dir": s.dir, "status": s.status,
+                    "started": s.started}
+
+    def _admit(self, trigger: str, mode: str, want, rule, severity,
+               out_dir: Optional[str] = None) -> _Session:
+        # take the slot under the lock; do the directory IO OUTSIDE it
+        # (a slow filesystem must not serialize every admission probe)
+        with self._lock:
+            if self._session is not None:
+                _ins.triage_suppressed_total("busy").inc()
+                raise CaptureBusy(
+                    f"deep capture already in flight "
+                    f"({self._session.trigger}, {self._session.dir}); "
+                    f"one capture per process")
+            s = self._session = _Session(trigger, mode, want, "",
+                                         rule, severity)
+        try:
+            s.dir = out_dir or self._new_dir(trigger)
+        except OSError:
+            with self._lock:
+                self._session = None  # an unwritable base dir must
+            raise                     # not wedge the slot
+        _ins.triage_capture_active().set(1)
+        return s
+
+    # ---- the one public verb -----------------------------------------
+
+    def deep_capture(self, steps: Optional[int] = None,
+                     seconds: Optional[float] = None,
+                     trigger: str = "manual",
+                     rule: Optional[str] = None,
+                     severity: Optional[str] = None,
+                     block: bool = True,
+                     timeout: Optional[float] = None) -> Optional[dict]:
+        """Run one bounded deep capture; returns the capture's
+        ``meta.json`` dict (``block=True``) or the armed session's
+        public view.  Raises :class:`CaptureBusy` when the slot is
+        taken and :class:`MXNetError` on a nonsensical window."""
+        if steps is not None and seconds is not None:
+            raise MXNetError("deep_capture: pass steps= OR seconds=, "
+                             "not both")
+        if steps is None and seconds is None:
+            seconds = _env.get_float("MXNET_TRIAGE_SECONDS")
+        if steps is not None and steps <= 0:
+            raise MXNetError(f"deep_capture: steps must be >= 1, "
+                             f"got {steps}")
+        if seconds is not None and seconds <= 0:
+            raise MXNetError(f"deep_capture: seconds must be > 0, "
+                             f"got {seconds}")
+
+        if steps is not None:
+            s = self._admit(trigger, "steps", int(steps), rule,
+                            severity)
+            self._arm_steps(s)
+            wait_s = timeout if timeout is not None else (
+                _env.get_float("MXNET_TRIAGE_STEP_TIMEOUT_S") + 10.0)
+        else:
+            s = self._admit(trigger, "seconds", float(seconds), rule,
+                            severity)
+            if not self._begin(s):
+                return s.meta
+            t = threading.Thread(
+                target=self._seconds_runner, args=(s,),
+                name="mxtriage-capture-window", daemon=True)
+            t.start()
+            wait_s = timeout if timeout is not None else seconds + 30.0
+        if not block:
+            return self.active()
+        s.done.wait(wait_s)
+        return s.meta
+
+    # ---- manual bracket (profiler.start_xla_trace refold) ------------
+
+    def start_manual(self, logdir: Optional[str] = None,
+                     trigger: str = "manual") -> str:
+        """Open-ended capture: starts now, runs until
+        :meth:`stop_manual`.  Returns the artifact directory."""
+        s = self._admit(trigger, "manual", None, None, None,
+                        out_dir=logdir)
+        os.makedirs(s.dir, exist_ok=True)
+        if not self._begin(s):
+            raise MXNetError(
+                f"deep capture backend failed to start "
+                f"({s.meta and s.meta.get('error')})")
+        return s.dir
+
+    def stop_manual(self) -> Optional[str]:
+        """Close the manual capture; returns its directory (None when
+        no manual capture is open)."""
+        with self._lock:
+            s = self._session
+        if s is None or s.mode != "manual":
+            return None
+        self._finish(s, "complete")
+        return s.dir
+
+    # ---- alert trigger (rate-limited, never blocks the ticker) -------
+
+    def trigger_from_alert(self, rule: str,
+                           severity: Optional[str] = None,
+                           value=None) -> str:
+        """Entry point for ``action="deep_capture"`` alert rules.
+        Non-blocking: the capture runs on a daemon thread.  Returns
+        ``"started"`` or ``"suppressed:<reason>"``."""
+        interval = _env.get_float("MXNET_TRIAGE_ALERT_INTERVAL_S")
+        now = time.monotonic()
+        with self._lock:
+            if self._session is not None:
+                reason = "busy"
+            elif self._last_alert_t is not None and \
+                    now - self._last_alert_t < interval:
+                reason = "rate-limited"
+            else:
+                reason = None
+                self._last_alert_t = now
+        if reason is not None:
+            _ins.triage_suppressed_total(reason).inc()
+            return f"suppressed:{reason}"
+
+        def run():
+            try:
+                self.deep_capture(trigger="alert", rule=rule,
+                                  severity=severity, block=True)
+            except CaptureBusy:
+                pass  # lost the admission race; already counted
+            except Exception:  # noqa: BLE001 — diagnostics never kill the host
+                pass
+
+        threading.Thread(target=run, name="mxtriage-alert-capture",
+                         daemon=True).start()
+        return "started"
+
+    # ---- window edges ------------------------------------------------
+
+    def _begin(self, s: _Session) -> bool:
+        """Start the profiler backend for ``s``; on failure the slot is
+        released and the session finishes with status ``error``.
+        Holds the session's edge lock and re-checks the status under
+        it: a window the watchdog (or a racing finish) already closed
+        must NOT start the backend — nothing would ever stop it."""
+        with s.edge:
+            with self._lock:
+                if self._session is not s or s.status != "pending":
+                    return False
+            try:
+                self._start(s.dir)
+            except Exception as e:  # noqa: BLE001 — backend may be busy already
+                _ins.triage_suppressed_total("error").inc()
+                self._finish(s, "error", error=repr(e),
+                             backend_up=False)
+                return False
+            s.started = True
+        s.t_start = time.time()
+        if s.step_begin is None:
+            s.step_begin = _current_step()
+        return True
+
+    def _seconds_runner(self, s: _Session) -> None:
+        time.sleep(s.want)
+        self._finish(s, "complete")
+
+    def _arm_steps(self, s: _Session) -> None:
+        """steps=N: start at the NEXT mxprof step boundary, stop N
+        boundaries later.  Enables the flight recorder (idempotent) —
+        the boundaries come from its step listeners, and the capture's
+        mxprof.json should attribute the same window anyway.
+
+        Listener (de)registration goes through the MODULE helpers so
+        it always targets the live recorder — ``mxprof.enable(ring=N)``
+        mid-capture swaps recorders (carrying the listener set), and a
+        removal against the stale object would leak the listener."""
+        from .. import mxprof
+
+        mxprof.enable()
+
+        def on_step(step: int) -> None:
+            # runs on the training thread at a step boundary: the only
+            # work is the two window edges, each once per capture
+            if not s.started:
+                if not self._begin(s):
+                    mxprof.remove_step_listener(on_step)
+                    return
+                s.step_begin = step
+                return
+            if step - s.step_begin >= s.want:
+                mxprof.remove_step_listener(on_step)
+                s.step_end = step
+                self._finish(s, "complete")
+
+        mxprof.add_step_listener(on_step)
+
+        def watchdog():
+            wait = _env.get_float("MXNET_TRIAGE_STEP_TIMEOUT_S")
+            if not s.done.wait(wait):
+                mxprof.remove_step_listener(on_step)
+                self._finish(s, "timeout")
+
+        threading.Thread(target=watchdog, name="mxtriage-watchdog",
+                         daemon=True).start()
+
+    def _finish(self, s: _Session, status: str,
+                error: Optional[str] = None,
+                backend_up: Optional[bool] = None) -> None:
+        """Close ``s``: stop the backend, write meta + mxprof snapshot,
+        index the artifact, release the slot.  Idempotent — the
+        watchdog and the step listener may race to close the same
+        window."""
+        with self._lock:
+            if self._session is not s or s.status not in ("pending",):
+                return
+            s.status = status
+        if backend_up is None:
+            # the stop edge: taken under the session's edge lock so a
+            # mid-flight _begin start completes (or aborts on the
+            # status flip above) before we decide whether to stop.
+            # _begin's own failure path passes backend_up=False and
+            # never reaches here — it already HOLDS the edge lock.
+            with s.edge:
+                backend_up = s.started
+                if backend_up:
+                    try:
+                        self._stop()
+                    except Exception as e:  # noqa: BLE001
+                        if error is None:
+                            error = repr(e)
+            if backend_up and s.step_end is None:
+                s.step_end = _current_step()
+        meta = {
+            "trigger": s.trigger,
+            "mode": s.mode,
+            "requested": ({"steps": s.want} if s.mode == "steps" else
+                          {"seconds": s.want} if s.mode == "seconds"
+                          else {}),
+            "rule": s.rule,
+            "severity": s.severity,
+            "step_begin": s.step_begin,
+            "step_end": s.step_end,
+            "status": status,
+            "when": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "t_request": s.t_request,
+            "t_start": s.t_start,
+            "t_stop": time.time(),
+            "pid": os.getpid(),
+            "rank": _tracing._RANK,
+            "dir": s.dir,
+        }
+        if error is not None:
+            meta["error"] = error
+        if status != "error":
+            try:
+                from .. import mxprof
+
+                meta["mxprof"] = os.path.basename(mxprof.dump(
+                    os.path.join(s.dir, "mxprof.json"),
+                    live_hbm=False))
+            except Exception:  # noqa: BLE001 — the trace alone still has value
+                meta["mxprof"] = None
+        try:
+            tmp = os.path.join(s.dir, f".meta-{os.getpid()}.tmp")
+            with open(tmp, "w") as f:
+                json.dump(meta, f, indent=1)
+            os.replace(tmp, os.path.join(s.dir, "meta.json"))
+            self._index(meta)
+        except OSError:
+            pass  # an unwritable dir must not wedge the slot
+        s.meta = meta
+        with self._lock:
+            self._session = None
+        _ins.triage_capture_active().set(0)
+        if status != "error":
+            _ins.triage_captures_total(s.trigger).inc()
+        s.done.set()
+
+    # ---- the index ---------------------------------------------------
+
+    def index_path(self) -> str:
+        """Per-rank index file once dist is initialized: the index is
+        read-modify-write, so ranks sharing a base dir must not
+        interleave rewrites of one file (entries would vanish)."""
+        rank = _tracing._RANK
+        name = "index.json" if rank is None else f"index-rank{rank}.json"
+        return os.path.join(self.base_dir(), name)
+
+    def index(self) -> list:
+        try:
+            with open(self.index_path()) as f:
+                return json.load(f)["captures"]
+        except (OSError, ValueError, KeyError):
+            return []
+
+    def _index(self, meta: dict) -> None:
+        """Append one capture record to index.json (bounded,
+        atomic rewrite).  The index lives beside the capture dirs —
+        and beside any mxprof dumps written to the same tree — so one
+        listing answers 'what captured here and why'."""
+        keep = _env.get_int("MXNET_TRIAGE_HISTORY") or 64
+        entries = self.index()
+        entries.append({k: meta.get(k) for k in (
+            "dir", "trigger", "rule", "severity", "status",
+            "step_begin", "step_end", "when", "pid", "rank")})
+        entries = entries[-keep:]
+        path = self.index_path()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"captures": entries}, f, indent=1)
+        os.replace(tmp, path)
+
+
+_manager_lock = threading.Lock()
+_MANAGER: Optional[CaptureManager] = None
+
+
+def manager() -> CaptureManager:
+    """The process capture manager (created on first use)."""
+    global _MANAGER
+    with _manager_lock:
+        if _MANAGER is None:
+            _MANAGER = CaptureManager()
+        return _MANAGER
+
+
+def _reset(m: Optional[CaptureManager] = None) -> None:
+    """Swap the process manager (tests)."""
+    global _MANAGER
+    with _manager_lock:
+        _MANAGER = m

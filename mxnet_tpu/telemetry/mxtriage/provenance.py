@@ -1,0 +1,115 @@
+"""Compile provenance: *why* did this site compile again?
+
+mxprof (PR 10) counts compiles per step and mxsan (PR 5) flags
+recompile storms — but a count is not a cause.  This module turns
+every compile-cache miss into a structured *diff against the nearest
+prior signature at the same site*: which named component of the
+executable's identity changed (avals / statics / donation / device /
+program text / env fingerprint / ...).
+
+Call sites name their components on the :class:`CacheKey`
+(``cache_key(..., components={"avals": ..., "donation": ...})``); a
+miss lands in three places:
+
+  * the per-site history kept here (``history(site)``) — what the
+    provenance tests and ``mxtriage`` reports read;
+  * ``mx_compile_reason_total{site,component}`` — the operational
+    counter a dashboard slices a recompile storm by;
+  * the mxprof compile-event stream — the flight recorder's pending
+    step record grows a ``compile_reasons`` entry, so a dump shows the
+    storm's cause on the exact step it hit.
+
+"Nearest prior" is the retained signature sharing the most component
+digests with the new one — a site that alternates between two shapes
+is diffed against its own shape-family, not whatever compiled last.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+from .. import instruments as _ins
+from .. import tracing as _tracing
+
+__all__ = ["record_miss", "history", "clear"]
+
+# prior signatures retained per site; small — provenance needs the
+# recent shape families at a site, not its lifetime history
+_SITE_KEEP = 8
+
+_lock = threading.Lock()
+_HISTORY: Dict[str, "deque[dict]"] = {}
+_REASONS: Dict[str, List[dict]] = {}
+_REASONS_KEEP = 64
+
+
+def record_miss(site: str, key) -> dict:
+    """Record one compile-cache miss for ``key`` (a CacheKey) at
+    ``site``; returns the structured reason::
+
+        {"site": ..., "components": ["avals"], "first": False,
+         "against": <index of the nearest prior sig>}
+
+    ``components`` is ``["first"]`` for a site's first-ever compile
+    (nothing to diff against) and ``["unknown"]`` when every tracked
+    component matched the nearest prior signature (the identity
+    differs only in untracked parts — still recorded, never silent).
+
+    Never raises: the callers sit on compile paths, and diagnostics
+    must not be able to break a build.
+    """
+    try:
+        sig = key.component_digests()
+    except Exception:  # noqa: BLE001 — a component repr may refuse to render
+        sig = {"undigestable": "?"}
+    with _lock:
+        hist = _HISTORY.get(site)
+        if hist is None:
+            hist = _HISTORY[site] = deque(maxlen=_SITE_KEEP)
+        nearest = None
+        nearest_i = None
+        best = -1
+        for i, prev in enumerate(hist):
+            overlap = sum(1 for name, dig in sig.items()
+                          if prev.get(name) == dig)
+            if overlap > best:
+                best, nearest, nearest_i = overlap, prev, i
+        if nearest is None:
+            changed = ["first"]
+        else:
+            changed = sorted(
+                name for name in set(sig) | set(nearest)
+                if sig.get(name) != nearest.get(name)) or ["unknown"]
+        hist.append(dict(sig))
+        reason = {"site": site, "components": changed,
+                  "first": nearest is None, "against": nearest_i}
+        per = _REASONS.setdefault(site, [])
+        per.append(reason)
+        del per[:-_REASONS_KEEP]
+    # telemetry + the mxprof stream OUTSIDE the provenance lock (the
+    # instrument accessors and the recorder hold their own locks)
+    for comp in changed:
+        _ins.compile_reason_total(site, comp).inc()
+    snk = _tracing._SINK
+    if snk is not None:
+        on_reason = getattr(snk, "on_compile_reason", None)
+        if on_reason is not None:
+            on_reason(site, changed)
+    return reason
+
+
+def history(site: Optional[str] = None):
+    """Recorded miss reasons — for one site (list) or all sites
+    (dict).  Bounded per site; newest last."""
+    with _lock:
+        if site is not None:
+            return [dict(r) for r in _REASONS.get(site, ())]
+        return {s: [dict(r) for r in rs] for s, rs in _REASONS.items()}
+
+
+def clear() -> None:
+    """Drop all provenance state (tests)."""
+    with _lock:
+        _HISTORY.clear()
+        _REASONS.clear()

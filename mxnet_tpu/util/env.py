@@ -319,6 +319,24 @@ declare("MXNET_RETRY_MAX_MS", float, 2000.0,
         "Retry policy: backoff delay ceiling in milliseconds.")
 
 # -- observability ----------------------------------------------------------
+declare("MXNET_GOODPUT", bool, False,
+        "Enable mxgoodput, the job-level goodput/badput wall-clock "
+        "ledger, at import: productive step seconds vs compile / "
+        "data_wait / checkpoint / preemption-recovery / retry-backoff "
+        "/ comm-stall badput, summing to wall-clock. Rides the mxprof "
+        "flight recorder; mxgoodput.enable() does the same at "
+        "runtime. See docs/observability.md (Goodput accounting).")
+declare("MXNET_GOODPUT_MIN", float, 0.9,
+        "Goodput-ratio alert floor: the stock goodput_rules table "
+        "(telemetry.alerts) pages when mx_goodput_ratio drops below "
+        "this for the rule's for_-duration. Also the default "
+        "production bar tools/goodput_report.py documents.")
+declare("MXNET_GOODPUT_UNATTRIBUTED_MAX", float, 0.5,
+        "Clean-run noise floor for the goodput known-answer gate "
+        "(tools/goodput_report.py): the fraction of wall-clock a "
+        "clean run may leave unattributed (host-side Python between "
+        "spans) before the gate fails. Production jobs with real "
+        "step times sit far below it.")
 declare("MXNET_HEALTH", bool, False,
         "Enable mxhealth, the in-graph numerics telemetry layer, at "
         "import: the fused/SPMD step programs additionally emit "

@@ -43,6 +43,15 @@ def _health(gate_ok=True, skip_ok=True):
                        "nonfinite_skip": {"ok": skip_ok}}}
 
 
+def _goodput(gate_ok=True, preempt_ok=True, ratio=0.85):
+    return {"gate_ok": gate_ok and preempt_ok,
+            "stages": {
+                "clean_run": {"ok": True, "goodput_ratio": ratio},
+                "preemption": {"ok": preempt_ok},
+                "multi_rank_merge": {
+                    "ok": True, "job": {"goodput_ratio": 0.7}}}}
+
+
 class TestCompareArtifact:
     def test_within_tolerance_ok(self):
         res = pc.compare_artifact("SCALING.json", _scaling(1.28),
@@ -175,6 +184,40 @@ class TestCompareArtifact:
                                   tolerance=0.10)
         assert res["ok"]
 
+    def test_goodput_strict_never_grandfathered(self):
+        """GOODPUT.json lanes follow the HEALTH policy: a false stage
+        fails even when the committed baseline was ALREADY false."""
+        res = pc.compare_artifact("GOODPUT.json",
+                                  _goodput(preempt_ok=False),
+                                  _goodput(preempt_ok=False),
+                                  tolerance=0.10)
+        assert not res["ok"]
+        assert any("stages.preemption.ok" in f
+                   for f in res["new_integrity_failures"])
+
+    def test_goodput_ratio_gates_through_stage_not_pct_lane(self):
+        """The ratio gates via the strict clean_run.ok check (absolute
+        floor inside the report), NOT a relative-tolerance lane — the
+        chaos scenarios' ratios are noise-dominated by design and a
+        %-drop lane would flake the nightly."""
+        res = pc.compare_artifact("GOODPUT.json", _goodput(ratio=0.9),
+                                  _goodput(ratio=0.6),
+                                  tolerance=0.10)
+        assert res["ok"]  # both runs' stages ok: no flake on noise
+        base = _goodput()
+        fresh = _goodput()
+        fresh["stages"]["clean_run"]["ok"] = False  # floor breached
+        res = pc.compare_artifact("GOODPUT.json", base, fresh,
+                                  tolerance=0.10)
+        assert not res["ok"]
+        assert any("stages.clean_run.ok" in f
+                   for f in res["new_integrity_failures"])
+
+    def test_goodput_clean_passes(self):
+        res = pc.compare_artifact("GOODPUT.json", _goodput(),
+                                  _goodput(), tolerance=0.10)
+        assert res["ok"]
+
     def test_serving_extractor(self):
         b = {"unbatched": {"qps": 588.7}, "batched": {"qps": 987.9},
              "batched_over_unbatched": 1.68}
@@ -256,6 +299,36 @@ def _scaling_attr(tp=1.3, gar=0.5, knob=0):
 class TestSuspects:
     """Regression attribution (ISSUE 13): a failing lane emits a
     ranked suspects section instead of failing mutely."""
+
+    def test_badput_category_shift_ranked_as_suspect(self, tmp_path):
+        """ISSUE 14: scaling rows embed goodput_ratio/badput_seconds;
+        a category that grew (and a ratio that collapsed) must rank
+        among the suspects of a failing lane."""
+        def doc(tp, retry_s, ratio):
+            d = _scaling_attr(tp=tp)
+            row = d["sweep"][1]
+            row["goodput_ratio"] = ratio
+            row["badput_seconds"] = {"retry_backoff": retry_s,
+                                     "comm_stall": 0.1}
+            return d
+
+        bd, fd = tmp_path / "b", tmp_path / "f"
+        bd.mkdir(), fd.mkdir()
+        (bd / "SCALING.json").write_text(
+            json.dumps(doc(1.3, 0.0, 0.9)))
+        (fd / "SCALING.json").write_text(
+            json.dumps(doc(0.8, 2.0, 0.3)))
+        out = str(tmp_path / "rep.json")
+        rc = pc.main(["--baseline-dir", str(bd), "--fresh-dir",
+                      str(fd), "--artifacts", "SCALING.json",
+                      "--out", out])
+        assert rc == 1
+        rep = json.load(open(out))
+        kinds = {(s["kind"], s["name"]) for s in rep["suspects"]}
+        assert ("badput", "retry_backoff") in kinds
+        assert ("goodput", "goodput_ratio") in kinds
+        # unchanged comm_stall is not a suspect
+        assert ("badput", "comm_stall") not in kinds
 
     def test_failing_lane_emits_ranked_suspects(self, tmp_path):
         bd, fd = tmp_path / "b", tmp_path / "f"

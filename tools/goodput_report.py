@@ -1,0 +1,429 @@
+#!/usr/bin/env python
+"""Per-run goodput verdict: chaos known-answer scenarios for the
+mxgoodput ledger plus the multi-rank rollup, written to GOODPUT.json.
+
+The nightly runs this (tools/run_nightly.py, goodput stage, BEFORE the
+perf-compare stage so the artifact is fresh) and ``perf_compare``
+gates it with STRICT lanes — a goodput ratio, like a health verdict,
+is never grandfathered.  Stages:
+
+  * ``clean_run``        — a small healthy run must attribute its time
+                           as productive: every badput category ~0,
+                           goodput ratio above the floor, unattributed
+                           under the noise ceiling
+                           (``MXNET_GOODPUT_UNATTRIBUTED_MAX``);
+  * ``retry_storm``      — chaos-injected transient failures at a REAL
+                           retryable seam (the dist.collective
+                           single-process short-circuit) must land
+                           their backoff sleeps in ``retry_backoff``
+                           at the *computed* magnitude (chaos pins the
+                           jitter seed, so the expected ladder replays
+                           exactly);
+  * ``forced_checkpoint``— a sync every-step checkpoint cadence with a
+                           known per-save blocking delay must land
+                           ~saves x delay in ``checkpoint_save``;
+  * ``preemption``       — an injected preemption with a known
+                           downtime between ``Preempted`` and resume
+                           must land the downtime in
+                           ``preemption_recovery`` (checkpoint seconds
+                           keep their own categories);
+  * ``multi_rank_merge`` — two REAL worker processes write
+                           rank-qualified mxprof dumps (the goodput
+                           block rides every dump); the merge must
+                           produce one job-level ledger and a per-rank
+                           badput skew table naming the rank that ate
+                           the injected retry storm.  (The categories
+                           are durations, so — unlike trace merging —
+                           no clock alignment is needed; ranks pair on
+                           the rank stamp ``dist.init`` wrote, the
+                           same identity ``trace_report --merge``
+                           aligns on.)
+
+Every stage also asserts the ledger **closure invariant**: productive
++ badput + unattributed == wall-clock, nothing silently vanishes.
+
+    python tools/goodput_report.py --out GOODPUT.json
+    python tools/goodput_report.py --no-gate --quick   # tier-1 smoke
+    python tools/goodput_report.py --merge mxprof-rank*.json
+
+Exit: 0 when gate_ok (or --no-gate), 1 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+import time
+import zlib
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+STEPS = 6
+# known-answer magnitudes
+CKPT_DELAY_S = 0.05          # per-save blocking delay (state_provider)
+PREEMPT_DOWNTIME_S = 0.35    # sleep between Preempted and resume
+RETRY_FAILURES = 2           # injected transient failures (one call)
+# scheduling slack: sleeps/timers only ever run LONG on a loaded box
+SLACK_S = 0.35
+
+
+def _closure_ok(snap) -> bool:
+    return bool(snap["closure"]["ok"])
+
+
+def _fresh_run(steps=STEPS, warmup=2, between_steps=None,
+               preempt_at=None, ckpt=None, ckpt_every=0,
+               ckpt_delay=0.0):
+    """One tiny training run over a FRESH ledger; warmup (and its
+    compiles) stay outside the accounting window.  ``ckpt`` attaches
+    an AutoCheckpoint (sync saves every ``ckpt_every`` steps, each
+    padded by ``ckpt_delay`` blocking seconds — the known answer);
+    ``preempt_at`` injects a preemption at that step, sleeps the known
+    downtime, resumes, and trains two more steps.  Returns
+    (snapshot, extras dict)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd, resilience
+    from mxnet_tpu.gluon import Trainer, nn
+    from mxnet_tpu.resilience import chaos, preemption
+    from mxnet_tpu.telemetry import mxgoodput
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.Dense(32, in_units=64)
+    net.initialize()
+    tr = Trainer(net.collect_params(), "sgd",
+                 {"learning_rate": 1e-3, "momentum": 0.9})
+    x = nd.array(np.random.rand(64, 64).astype("float32"))
+
+    def one_step():
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(64)
+
+    for _ in range(warmup):
+        one_step()
+    mxgoodput.enable(fresh=True)
+    extras = {}
+    if ckpt is not None:
+        provider = None
+        if ckpt_delay:
+            provider = lambda: (time.sleep(ckpt_delay),  # noqa: E731
+                                {"epoch": 0})[1]
+        extras["ckpt"] = resilience.AutoCheckpoint(
+            ckpt, tr, every_n_steps=ckpt_every, async_save=False,
+            state_provider=provider)
+    if preempt_at is not None:
+        try:
+            with chaos.inject("trainer.preempt", at=preempt_at):
+                for _ in range(steps):
+                    one_step()
+        except preemption.Preempted as e:
+            extras["preempted_dir"] = e.checkpoint_dir
+            time.sleep(PREEMPT_DOWNTIME_S)  # the known downtime
+            ck2 = resilience.AutoCheckpoint(ckpt, tr, every_n_steps=0)
+            ck2.resume()
+            for _ in range(2):
+                one_step()
+    else:
+        for _ in range(steps):
+            one_step()
+            if between_steps is not None:
+                between_steps()
+    return mxgoodput.snapshot(), extras
+
+
+def stage_clean_run():
+    from mxnet_tpu.util import env as _env
+
+    snap, _ = _fresh_run()
+    max_un = _env.get_float("MXNET_GOODPUT_UNATTRIBUTED_MAX")
+    un_frac = snap["unattributed_s"] / max(snap["wall_s"], 1e-9)
+    spurious = {c: s for c, s in snap["badput_s"].items() if s > 0.05}
+    ok = (_closure_ok(snap) and not spurious
+          and snap["goodput_ratio"] >= 0.5 and un_frac <= max_un
+          and snap["steps"] == STEPS)
+    return {"ok": ok, "goodput_ratio": snap["goodput_ratio"],
+            "unattributed_frac": round(un_frac, 4),
+            "spurious_badput": spurious, "closure": snap["closure"],
+            "steps": snap["steps"]}
+
+
+def _expected_backoff(site: str, failures: int) -> float:
+    """Replay the retry ladder: under an active chaos plan the jitter
+    rng is seeded by the site name alone (bit-identical replay is the
+    chaos contract), so the injected badput magnitude is computable,
+    not just bounded."""
+    from mxnet_tpu.resilience import retry
+
+    pol = retry.default_policy()
+    rng = random.Random(zlib.crc32(site.encode()))
+    return sum(pol.delay_s(i, rng) for i in range(1, failures + 1))
+
+
+def stage_retry_storm():
+    from mxnet_tpu.parallel import dist
+    from mxnet_tpu.resilience import chaos
+    from mxnet_tpu.telemetry import mxgoodput
+
+    expected = _expected_backoff("dist.barrier", RETRY_FAILURES)
+
+    def storm():
+        with chaos.inject("dist.collective", times=RETRY_FAILURES):
+            dist.barrier()
+
+    snap, _ = _fresh_run(between_steps=storm)
+    got = snap["badput_s"]["retry_backoff"]
+    by_site = snap["retry_backoff_by_site"]
+    want = STEPS * expected
+    ok = (_closure_ok(snap)
+          and want <= got <= want + STEPS * SLACK_S
+          and abs(by_site.get("dist.barrier", 0.0) - got) < 1e-6)
+    mxgoodput.disable()
+    return {"ok": ok,
+            "injected_failures_per_step": RETRY_FAILURES,
+            "expected_backoff_s": round(want, 4),
+            "attributed_s": round(got, 4),
+            "by_site": by_site, "closure": snap["closure"]}
+
+
+def stage_forced_checkpoint():
+    with tempfile.TemporaryDirectory() as d:
+        snap, extras = _fresh_run(ckpt=d, ckpt_every=1,
+                                  ckpt_delay=CKPT_DELAY_S)
+        saves = extras["ckpt"].saves
+    got = snap["badput_s"]["checkpoint_save"]
+    expected = saves * CKPT_DELAY_S
+    ok = (_closure_ok(snap) and saves == STEPS
+          and expected <= got <= expected + saves * SLACK_S)
+    return {"ok": ok, "saves": saves,
+            "expected_blocking_s_min": round(expected, 4),
+            "attributed_s": round(got, 4), "closure": snap["closure"]}
+
+
+def stage_preemption():
+    with tempfile.TemporaryDirectory() as d:
+        snap, extras = _fresh_run(preempt_at=3, ckpt=d)
+    bad = snap["badput_s"]
+    got = bad["preemption_recovery"]
+    dominant = max(bad, key=lambda c: bad[c])
+    ok = (_closure_ok(snap) and "preempted_dir" in extras
+          and PREEMPT_DOWNTIME_S - 0.02 <= got
+          <= PREEMPT_DOWNTIME_S + SLACK_S
+          and dominant == "preemption_recovery")
+    return {"ok": ok, "injected_downtime_s": PREEMPT_DOWNTIME_S,
+            "attributed_s": round(got, 4),
+            "dominant_category": dominant,
+            "checkpoint_save_s": bad["checkpoint_save"],
+            "checkpoint_restore_s": bad["checkpoint_restore"],
+            "closure": snap["closure"]}
+
+
+# ---------------------------------------------------------------------------
+# multi-rank rollup
+# ---------------------------------------------------------------------------
+
+def merge_dumps(paths):
+    """Fold rank-qualified mxprof dumps (their ``goodput`` blocks) into
+    one job-level ledger + a per-rank badput skew table.  Categories
+    are durations, so no clock alignment is needed — ranks pair on the
+    rank stamp, the identity ``trace_report --merge`` aligns on."""
+    ranks = []
+    for p in paths:
+        with open(p) as f:
+            d = json.load(f)
+        g = d.get("goodput")
+        if not isinstance(g, dict):
+            raise ValueError(f"{p}: no goodput block in the dump "
+                             f"(was mxgoodput enabled in that rank?)")
+        ranks.append({"rank": d.get("rank"),
+                      "path": os.path.basename(p), "goodput": g})
+    ranks.sort(key=lambda r: (r["rank"] is None, r["rank"]))
+    job = {"ranks": len(ranks), "wall_s": 0.0, "productive_s": 0.0,
+           "unattributed_s": 0.0, "steps": 0, "badput_s": {}}
+    for r in ranks:
+        g = r["goodput"]
+        job["wall_s"] += g.get("wall_s", 0.0)
+        job["productive_s"] += g.get("productive_s", 0.0)
+        job["unattributed_s"] += g.get("unattributed_s", 0.0)
+        job["steps"] += g.get("steps", 0)
+        for c, s in (g.get("badput_s") or {}).items():
+            job["badput_s"][c] = job["badput_s"].get(c, 0.0) + s
+    job["goodput_ratio"] = round(
+        job["productive_s"] / job["wall_s"], 6) if job["wall_s"] \
+        else 0.0
+    for k in ("wall_s", "productive_s", "unattributed_s"):
+        job[k] = round(job[k], 6)
+    job["badput_s"] = {c: round(s, 6)
+                       for c, s in sorted(job["badput_s"].items())}
+    # per-rank skew: which rank ate each category (the straggler
+    # question, asked of badput instead of phase time)
+    skew = {}
+    cats = sorted({c for r in ranks for c in r["goodput"]["badput_s"]})
+    for cat in cats:
+        vals = {str(r["rank"]): r["goodput"]["badput_s"].get(cat, 0.0)
+                for r in ranks}
+        vmax, vmin = max(vals.values()), min(vals.values())
+        skew[cat] = {
+            "per_rank_s": {k: round(v, 6) for k, v in vals.items()},
+            "spread_s": round(vmax - vmin, 6),
+            "worst_rank": max(vals, key=lambda k: vals[k]),
+        }
+    return {"ranks": ranks, "job": job, "badput_skew": skew}
+
+
+def _rank_worker(args) -> int:
+    """--_rank: one worker of the multi_rank_merge stage — a tiny run
+    whose mxprof dump (goodput block riding) lands rank-qualified in
+    --outdir.  Rank 1 eats an injected retry storm so the merge has a
+    known skew answer."""
+    from mxnet_tpu.parallel import dist
+    from mxnet_tpu.resilience import chaos
+    from mxnet_tpu.telemetry import mxprof, tracing
+
+    tracing.set_rank(args._rank)
+
+    def storm():
+        with chaos.inject("dist.collective", times=RETRY_FAILURES):
+            dist.barrier()
+
+    _fresh_run(between_steps=storm if args._rank == 1 else None)
+    mxprof.dump(os.path.join(args.outdir,
+                             f"mxprof-rank{args._rank}.json"))
+    return 0
+
+
+def stage_multi_rank_merge():
+    expected = _expected_backoff("dist.barrier", RETRY_FAILURES)
+    with tempfile.TemporaryDirectory() as d:
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--_rank",
+             str(i), "--outdir", d],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=_REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            for i in range(2)]
+        tails = []
+        timed_out = False
+        try:
+            for p in procs:
+                try:
+                    tails.append(p.communicate(timeout=300)[0])
+                except subprocess.TimeoutExpired:
+                    timed_out = True
+                    tails.append("(timed out)")
+        finally:
+            # a hung/failed rank must fail THIS STAGE, never crash the
+            # report or leak a worker holding the temp dir
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        if timed_out or any(p.returncode != 0 for p in procs):
+            return {"ok": False,
+                    "error": "rank worker timed out" if timed_out
+                    else "rank worker failed",
+                    "tails": ["\n".join(t.splitlines()[-6:])
+                              for t in tails]}
+        paths = sorted(os.path.join(d, n) for n in os.listdir(d)
+                       if n.startswith("mxprof-rank"))
+        merged = merge_dumps(paths)
+    skew = merged["badput_skew"].get("retry_backoff", {})
+    job = merged["job"]
+    # rank 1 ate one storm of `expected` seconds after each step
+    want = STEPS * expected
+    got = job["badput_s"].get("retry_backoff", 0.0)
+    closure_ok = all(r["goodput"]["closure"]["ok"]
+                     for r in merged["ranks"])
+    ok = (len(merged["ranks"]) == 2
+          and merged["ranks"][0]["rank"] == 0
+          and merged["ranks"][1]["rank"] == 1
+          and skew.get("worst_rank") == "1"
+          and want <= got <= want + STEPS * SLACK_S
+          and skew.get("spread_s", 0.0) >= want * 0.9
+          and closure_ok and 0.0 < job["goodput_ratio"] < 1.0)
+    return {"ok": ok, "job": job, "badput_skew": skew,
+            "expected_rank1_backoff_s": round(want, 4),
+            "per_rank_closure_ok": closure_ok}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="exercise the mxgoodput ledger against chaos "
+                    "known-answer scenarios, write the GOODPUT.json "
+                    "verdict; or --merge rank dumps into the job "
+                    "rollup")
+    ap.add_argument("--out", default=os.path.join(_REPO, "GOODPUT.json"))
+    ap.add_argument("--no-gate", action="store_true",
+                    help="write the artifact but exit 0 regardless "
+                         "(tier-1 smoke)")
+    ap.add_argument("--quick", action="store_true",
+                    help="skip the process-spawning multi_rank_merge "
+                         "stage (tier-1 wall-clock)")
+    ap.add_argument("--merge", nargs="*", default=None,
+                    help="rank-qualified mxprof dump paths: write the "
+                         "job-level rollup of their goodput blocks "
+                         "instead of running scenarios")
+    ap.add_argument("--_rank", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--outdir", default=".", help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args._rank is not None:
+        return _rank_worker(args)
+
+    t0 = time.time()
+    if args.merge is not None:
+        merged = merge_dumps(args.merge)
+        merged["when"] = time.strftime("%Y-%m-%d %H:%M:%S")
+        with open(args.out, "w") as f:
+            json.dump(merged, f, indent=1)
+        print(json.dumps({"job": merged["job"]}))
+        print(f"wrote {args.out}")
+        return 0
+
+    from mxnet_tpu.telemetry import mxgoodput
+
+    stages = {}
+    stages["clean_run"] = stage_clean_run()
+    stages["retry_storm"] = stage_retry_storm()
+    stages["forced_checkpoint"] = stage_forced_checkpoint()
+    stages["preemption"] = stage_preemption()
+    if not args.quick:
+        stages["multi_rank_merge"] = stage_multi_rank_merge()
+    mxgoodput.disable()
+
+    gate_ok = all(s.get("ok") for s in stages.values())
+    artifact = {
+        "metric": "goodput/badput ledger known-answer scenarios + "
+                  "multi-rank rollup",
+        "when": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "duration_s": round(time.time() - t0, 1),
+        "stages": stages,
+        "gate_ok": gate_ok,
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({"gate_ok": gate_ok,
+                      "stages": {k: v["ok"]
+                                 for k, v in stages.items()}}))
+    print(f"wrote {args.out}")
+    if not gate_ok:
+        for k, v in stages.items():
+            if not v.get("ok"):
+                print(f"GOODPUT GATE FAIL: stage {k}: {v}",
+                      file=sys.stderr)
+    return 0 if gate_ok or args.no_gate else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,8 +3,9 @@
 committed ones.
 
 The nightly refreshes the tracked bench artifacts (FUSED_BENCH.json,
-SCALING.json, SERVING_BENCH.json, COMPILE_CACHE.json, HEALTH.json) in
-the work tree; this tool compares each against the version committed
+SCALING.json, SERVING_BENCH.json, COMPILE_CACHE.json, HEALTH.json,
+GOODPUT.json) in the work tree; this tool compares each against the
+version committed
 at --ref (``git show REF:NAME``) and fails on
 
   * a **throughput regression**: any tracked higher-is-better metric
@@ -23,6 +24,10 @@ at --ref (``git show REF:NAME``) and fails on
     strict — a false verdict fails even if the committed artifact was
     already false.  A nonfinite step or a broken detection path is
     never grandfathered.
+  * a **goodput failure** (GOODPUT.json): same strict policy — the
+    chaos known-answer stages must keep attributing each disruption
+    to the right badput category, and the clean-run goodput-ratio
+    floor (absolute, inside the report) rides the strict stage lane.
 
 Artifacts missing on either side are reported and skipped — a bench
 stage that timed out must fail the nightly through its own return
@@ -57,7 +62,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 DEFAULT_ARTIFACTS = ("FUSED_BENCH.json", "SCALING.json",
                      "SERVING_BENCH.json", "COMPILE_CACHE.json",
-                     "HEALTH.json")
+                     "HEALTH.json", "GOODPUT.json")
 
 _ATTRIBUTION_PATH = os.path.join(
     _REPO, "mxnet_tpu", "telemetry", "mxtriage", "attribution.py")
@@ -158,12 +163,31 @@ def _health(d) -> dict:
     return {"checks": c, "strict": True}
 
 
+def _goodput(d) -> dict:
+    """GOODPUT.json: same policy as the HEALTH.json lanes — every
+    check is STRICT (a goodput ratio, like a health verdict, is never
+    grandfathered by an already-bad baseline).  The ratio gates
+    through the stage checks (clean_run.ok carries an ABSOLUTE floor
+    inside the report), deliberately not as a relative-tolerance
+    metric lane: the chaos scenarios' ratios are noise-dominated by
+    design (tiny steps vs injected sleeps) and a %-drop lane on them
+    would flake the nightly without naming a real regression."""
+    c = {}
+    if "gate_ok" in d:
+        c["gate_ok"] = bool(d["gate_ok"])
+    for stage, row in (d.get("stages") or {}).items():
+        if isinstance(row, dict) and "ok" in row:
+            c[f"stages.{stage}.ok"] = bool(row["ok"])
+    return {"checks": c, "strict": True}
+
+
 EXTRACTORS = {
     "FUSED_BENCH.json": _fused,
     "SERVING_BENCH.json": _serving,
     "COMPILE_CACHE.json": _compile_cache,
     "SCALING.json": _scaling,
     "HEALTH.json": _health,
+    "GOODPUT.json": _goodput,
 }
 
 

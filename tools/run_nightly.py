@@ -468,6 +468,42 @@ def main():
         triage_rc = -1
         artifact["triage"] = {"returncode": -1, "note": "timed out"}
 
+    # goodput stage (ISSUE 14): the slow mxgoodput e2e (multi-process
+    # chaos known-answer run) plus the strict goodput report —
+    # GOODPUT.json is the tracked artifact and perf_compare gates it
+    # with STRICT lanes (a goodput ratio is never grandfathered).
+    # Runs BEFORE perf-compare so the artifact it diffs is fresh.
+    goodput_rc = None
+    try:
+        gsl = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests/test_mxgoodput.py",
+             "-q", "-m", "slow", "-p", "no:cacheprovider"],
+            capture_output=True, text=True, timeout=900, cwd=_REPO,
+            env=cpu_env)
+        gr = subprocess.run(
+            [sys.executable, "tools/goodput_report.py",
+             "--out", os.path.join(_REPO, "GOODPUT.json")],
+            capture_output=True, text=True, timeout=600, cwd=_REPO,
+            env=cpu_env)
+        goodput_rc = gr.returncode if gr.returncode != 0 \
+            else gsl.returncode
+        gate = {"returncode": gr.returncode,
+                "slow_tests_returncode": gsl.returncode,
+                "slow_tests_tail":
+                    "\n".join(gsl.stdout.splitlines()[-1:]),
+                "stderr_tail": "\n".join(gr.stderr.splitlines()[-6:])}
+        try:
+            rep = json.loads([ln for ln in gr.stdout.splitlines()
+                              if ln.startswith("{")][-1])
+            gate["gate_ok"] = rep["gate_ok"]
+            gate["stages"] = rep["stages"]
+        except (IndexError, ValueError, KeyError):
+            pass
+        artifact["goodput"] = gate
+    except subprocess.TimeoutExpired:
+        goodput_rc = -1
+        artifact["goodput"] = {"returncode": -1, "note": "timed out"}
+
     # perf-compare gate (ISSUE 10): the bench artifacts this nightly
     # just refreshed (FUSED/SCALING/COMPILE_CACHE/HEALTH; SERVING when
     # its strict lane rewrote it) vs the committed versions — >10%
@@ -502,7 +538,8 @@ def main():
         and resil_rc in (None, 0) and cc_rc in (None, 0) \
         and spmd_rc in (None, 0) and heavy_rc in (None, 0) \
         and mxprof_rc in (None, 0) and health_rc in (None, 0) \
-        and triage_rc in (None, 0) and perf_rc in (None, 0) else 1
+        and triage_rc in (None, 0) and goodput_rc in (None, 0) \
+        and perf_rc in (None, 0) else 1
 
 
 if __name__ == "__main__":

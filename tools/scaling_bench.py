@@ -205,6 +205,14 @@ def _phase_report(trace_path):
     state = snap.get("optimizer_state_bytes_per_device")
     if state:
         out["optimizer_state_bytes_per_device"] = state
+    # goodput lane: the ledger rode the snapshot (mxgoodput was
+    # enabled for the attribution steps) — rows carry the ratio and
+    # the badput decomposition so mxtriage attribution can rank a
+    # badput-category shift as a suspect
+    good = snap.get("goodput")
+    if isinstance(good, dict):
+        out["goodput_ratio"] = good.get("goodput_ratio")
+        out["badput_seconds"] = good.get("badput_s", {})
     return out
 
 
@@ -294,10 +302,11 @@ def _attribution_steps(args, one_step, rank):
     import tempfile
 
     from mxnet_tpu import profiler, telemetry
-    from mxnet_tpu.telemetry import mxprof
+    from mxnet_tpu.telemetry import mxgoodput, mxprof
 
     telemetry.enable()  # span tracing + metrics + the mxprof recorder
     mxprof.clear()      # attribute ONLY the steps below
+    mxgoodput.enable(fresh=True)  # goodput lane over the same window
     profiler.start()
     try:
         for _ in range(2):
