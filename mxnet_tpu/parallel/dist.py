@@ -29,6 +29,7 @@ import numpy as np
 from ..base import MXNetError
 from ..resilience import chaos as _chaos
 from ..resilience import retry as _retry
+from ..resilience.elastic import PeerFailed
 from ..telemetry import instruments as _ins
 from ..telemetry import tracing as _tracing
 
@@ -93,6 +94,38 @@ def _collective_timeout(timeout: Optional[float]) -> Optional[float]:
     return None
 
 
+#: Transport-error fingerprints of a DEAD PEER inside a collective:
+#: gloo raises these instead of hanging when the peer's socket tears
+#: down mid-operation (a hang is what the watchdog timeout covers).
+#: Matched lowercased against the error text.
+_PEER_ERROR_MARKS = (
+    "connection reset by peer", "connection closed by peer",
+    "connection refused", "broken pipe",
+    "read error [", "write error [",  # gloo tcp/pair.cc phrasing
+)
+
+
+def _classify_peer_error(exc: BaseException,
+                         what: str) -> Optional[PeerFailed]:
+    """A collective attempt raised: if the error text fingerprints a
+    torn peer connection, poison the sequence (the collective did NOT
+    complete consistently across ranks) and return the PeerFailed this
+    worker should raise instead — same classification as a watchdog
+    timeout, reached through the error path gloo actually takes when
+    the peer is dead rather than merely unreachable."""
+    global _POISONED
+    msg = str(exc).lower()
+    if not any(m in msg for m in _PEER_ERROR_MARKS):
+        return None
+    if not _POISONED:
+        _POISONED = what
+    return PeerFailed(
+        f"collective '{what}' failed on rank {jax.process_index()}/"
+        f"{jax.process_count()}: peer connection lost ({exc}). A peer "
+        f"worker died mid-collective; this worker's sequence is "
+        f"poisoned — restart the job.", what=what)
+
+
 def _run_with_watchdog(fn, timeout: Optional[float], what: str):
     """Run a blocking collective; abort loudly if a peer never shows up.
 
@@ -105,14 +138,24 @@ def _run_with_watchdog(fn, timeout: Optional[float], what: str):
     this error is process exit."""
     global _POISONED
     if _POISONED:
-        raise MXNetError(
+        # PeerFailed, poisoned=True: same non-transient in-process
+        # semantics as before (MXNetError subclass, fail fast), but a
+        # worker under the elastic supervisor can classify it and exit
+        # with the reserved RC_PEER_FAILED instead of a generic crash
+        raise PeerFailed(
             f"collective '{what}' refused: a previous collective "
             f"('{_POISONED}') timed out, so this worker is out of step "
             f"with its peers. Abort the process (dist.abort()) and "
-            f"restart the job.")
+            f"restart the job.", what=what, poisoned=True)
     timeout = _collective_timeout(timeout)
     if timeout is None:
-        return fn()
+        try:
+            return fn()
+        except Exception as e:
+            pf = _classify_peer_error(e, what)
+            if pf is not None:
+                raise pf from e
+            raise
     result, error = [], []
 
     def _target():
@@ -130,12 +173,15 @@ def _run_with_watchdog(fn, timeout: Optional[float], what: str):
         # poison all further collectives so a caller that swallows the
         # error cannot silently desynchronize the collective sequence
         _POISONED = what
-        raise MXNetError(
+        raise PeerFailed(
             f"collective '{what}' timed out after {timeout:.1f}s on "
             f"rank {jax.process_index()}/{jax.process_count()}: a peer "
             f"worker is unreachable (dead or stalled). Aborting "
-            f"(set {_TIMEOUT_ENV}=0 to wait forever).")
+            f"(set {_TIMEOUT_ENV}=0 to wait forever).", what=what)
     if error:
+        pf = _classify_peer_error(error[0], what)
+        if pf is not None:
+            raise pf from error[0]
         raise error[0]
     return result[0]
 
@@ -169,6 +215,15 @@ def _guard_single(site: str) -> None:
     if _chaos._ACTIVE:
         _retry.default_policy().call(
             lambda: _chaos.check("dist.collective"), site=site)
+
+
+def _stamp_rank() -> None:
+    """Stamp the process rank everywhere that keys on it: trace spans
+    (multi-rank merge) and chaos rank= plan selection."""
+    r = jax.process_index()
+    _tracing.set_rank(r)
+    _chaos.set_rank(r)
+
 
 _INITIALIZED = False
 
@@ -217,10 +272,10 @@ def init(coordinator_address: Optional[str] = None,
                 "PMI_SIZE") is not None:
             jax.distributed.initialize()
             _INITIALIZED = True
-            _tracing.set_rank(jax.process_index())
+            _stamp_rank()
             return
         _INITIALIZED = True  # single-process
-        _tracing.set_rank(jax.process_index())
+        _stamp_rank()
         return
     role = _env("DMLC_ROLE", default="worker")
     if role in ("scheduler", "server"):
@@ -244,7 +299,7 @@ def init(coordinator_address: Optional[str] = None,
     _INITIALIZED = True
     # spans emitted from here on carry args.rank — what trace_report
     # --merge keys its per-rank attribution and clock alignment on
-    _tracing.set_rank(jax.process_index())
+    _stamp_rank()
 
 
 def initialized() -> bool:

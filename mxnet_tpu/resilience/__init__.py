@@ -22,23 +22,33 @@ the recovery path is testable, and (b) survivable:
     bit-consistent with an uninterrupted run, including onto a smaller
     replica count;
   * :mod:`~mxnet_tpu.resilience.breaker` — the per-model circuit
-    breaker serving uses to degrade (503 one model) instead of dying.
+    breaker serving uses to degrade (503 one model) instead of dying;
+  * :mod:`~mxnet_tpu.resilience.elastic` + :mod:`heartbeat` — the
+    multi-host fault story: per-rank heartbeat stamps, ``PeerFailed``
+    classification of dead-peer collective timeouts, the job-level
+    checkpoint commit marker, and the supervisor
+    (``tools/elastic_run.py``) that restarts a job in replace or
+    shrink mode instead of leaving it wedged.
 
 See docs/resilience.md for the fault model, retry semantics, the
-resume contract, and breaker states.
+resume contract, breaker states, and elastic recovery.
 """
 from __future__ import annotations
 
 from . import chaos
+from . import elastic
+from . import heartbeat
 from . import preemption
 from .autockpt import AutoCheckpoint, latest_step_dir
 from .breaker import CircuitBreaker
 from .chaos import FaultInjected
+from .elastic import PeerFailed
 from .preemption import Preempted
 from .retry import RetryExhausted, RetryPolicy, default_policy
 
 __all__ = [
-    "chaos", "preemption", "FaultInjected", "Preempted",
+    "chaos", "preemption", "elastic", "heartbeat",
+    "FaultInjected", "Preempted", "PeerFailed",
     "AutoCheckpoint", "latest_step_dir", "CircuitBreaker",
     "RetryPolicy", "RetryExhausted", "default_policy",
 ]

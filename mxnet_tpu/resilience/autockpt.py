@@ -130,6 +130,29 @@ class AutoCheckpoint:
 
     # ---- the step hook --------------------------------------------------
 
+    @staticmethod
+    def _recovery_kind(reason: str) -> str:
+        """Classify a preemption trigger: a supervisor wind-down / peer
+        failure (mxelastic marks its reasons ``peer-failure: ...``) is
+        accounted as ``rank_failure_recovery``; everything else is a
+        genuine preemption."""
+        return "peer_failure" if reason.startswith("peer-failure") \
+            else "preempt"
+
+    @staticmethod
+    def _recovery_category(kind: str) -> str:
+        return "rank_failure_recovery" if kind == "peer_failure" \
+            else "preemption_recovery"
+
+    def stamp_failure(self, reason: str,
+                      kind: str = "peer_failure") -> None:
+        """Mark the NEXT save as cut by a failure (the elastic guard
+        calls this before its peer-failure sync save): the checkpoint
+        meta records why, and a resume from it opens the matching
+        goodput recovery window cross-process."""
+        self._preempt_info = {"reason": reason,
+                              "t_unix": time.time(), "kind": kind}
+
     def on_step(self, trainer) -> None:
         """Called by Trainer.step after the update.  Preemption wins
         over cadence: save NOW (sync) and raise Preempted."""
@@ -137,13 +160,16 @@ class AutoCheckpoint:
         if preemption.triggered():
             from ..telemetry import mxgoodput as _goodput
 
+            kind = self._recovery_kind(preemption.reason())
             if _goodput._ACTIVE:
                 # recovery starts where the step boundary OBSERVES the
                 # trigger (never from the signal handler itself)
-                _goodput.on_preemption_trigger()
+                _goodput.on_preemption_trigger(
+                    category=self._recovery_category(kind))
             t = preemption.trigger_time()
             self._preempt_info = {"reason": preemption.reason(),
-                                  "t_unix": t[0] if t else time.time()}
+                                  "t_unix": t[0] if t else time.time(),
+                                  "kind": kind}
             path = self.save(sync=True)
             raise Preempted(
                 f"preempted ({preemption.reason()}); checkpoint for "
@@ -276,6 +302,35 @@ class AutoCheckpoint:
         self._retry.call(lambda: self._write_once(snap),
                          site="checkpoint.save", retry_on=(OSError,))
 
+    @staticmethod
+    def _write_file(path: str, data, mode: str = "wb") -> None:
+        """Write + flush + fsync: the rename below only commits what
+        the disk actually has — an os.replace of dirty page cache is
+        atomic against a CRASHED PROCESS but not against a crashed
+        machine (or a kill -9 racing writeback)."""
+        with open(path, mode) as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+
+    @staticmethod
+    def _fsync_dir(path: str) -> None:
+        """fsync a DIRECTORY: the os.replace rename is itself metadata
+        that lives in the parent directory — without this, a hard kill
+        after the rename can still lose the commit, and resume would
+        find neither the .tmp- nor the final dir.  Best-effort on
+        filesystems that refuse O_RDONLY dir fsync."""
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass  # mxlint: disable=MX007 — fs without dir-fsync support
+        finally:
+            os.close(fd)
+
     def _write_once(self, snap: Dict) -> None:
         name = f"{_STEP_PREFIX}{snap['step']:08d}"
         tmp = os.path.join(self._dir, _TMP_PREFIX + name)
@@ -285,10 +340,10 @@ class AutoCheckpoint:
         os.makedirs(tmp)
         buf = io.BytesIO()
         np.savez(buf, **snap["params"])
-        with open(os.path.join(tmp, "params.npz"), "wb") as f:
-            f.write(buf.getvalue())
-        with open(os.path.join(tmp, "trainer.states"), "wb") as f:
-            f.write(snap["states"])
+        self._write_file(os.path.join(tmp, "params.npz"),
+                         buf.getvalue())
+        self._write_file(os.path.join(tmp, "trainer.states"),
+                         snap["states"])
         meta = {"step": snap["step"], "rng": snap["rng"],
                 "position": snap["position"],
                 "saved_unix": time.time()}
@@ -297,17 +352,41 @@ class AutoCheckpoint:
             # the trigger time to open the goodput recovery window —
             # even in a fresh process, the downtime is measured
             meta["preempt"] = snap["preempt"]
-        with open(os.path.join(tmp, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=1)
+        self._write_file(os.path.join(tmp, "meta.json"),
+                         json.dumps(meta, indent=1), mode="w")
+        self._fsync_dir(tmp)
+        old = None
         if os.path.exists(final):
-            shutil.rmtree(final)  # re-save of the same step
+            # re-save of the same step (the elastic guard re-saving
+            # the cadence step is the common case): NEVER rmtree the
+            # complete dir before the new one commits — a SIGKILL
+            # inside a slow rmtree would destroy the rank's newest
+            # checkpoint.  Rename it aside (a `.old-` name resume
+            # ignores) so the destruction window shrinks to two
+            # renames, and the complete copy survives either crash.
+            old = os.path.join(self._dir, f".old-{name}")
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            os.replace(final, old)
         os.replace(tmp, final)
+        # crash-consistency for the COMMIT itself: the rename must be
+        # durable before this save counts — a kill -9 right after
+        # _write_once returns must still find the complete step dir
+        self._fsync_dir(self._dir)
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
         self.saves += 1
         self._prune()
 
     def _prune(self) -> None:
         steps = []
         for name in os.listdir(self._dir):
+            if name.startswith(".old-"):
+                # aside-rename residue of a crashed re-save (the live
+                # _write_once already removed its own): sweep it
+                shutil.rmtree(os.path.join(self._dir, name),
+                              ignore_errors=True)
+                continue
             if name.startswith(_TMP_PREFIX):
                 continue  # an in-flight or crashed write; not ours
             if name.startswith(_STEP_PREFIX):
@@ -322,19 +401,26 @@ class AutoCheckpoint:
 
     # ---- resume path ----------------------------------------------------
 
-    def resume(self) -> Optional[dict]:
+    def resume(self, path: Optional[str] = None) -> Optional[dict]:
         """Restore the newest checkpoint into the attached trainer;
         returns its meta dict ({"step", "position", ...}) or None when
         the directory has no checkpoint (fresh start).  The restore
         re-shards onto the trainer's CURRENT replica layout — resuming
         onto fewer replicas than saved is first-class (the preempted
-        slice may come back smaller)."""
+        slice may come back smaller).
+
+        ``path`` pins an explicit step directory instead of the newest
+        one in this checkpointer's own dir — the elastic restart path:
+        every rank of a recovered job resumes from the ONE step dir the
+        supervisor's commit marker elected, so ranks can never mix
+        steps even when their own checkpoint cadences diverged."""
         from ..ndarray.ndarray import array as nd_array
         from ..resource import resource_manager
 
         from ..telemetry import mxgoodput as _goodput
 
-        path = latest_step_dir(self._dir)
+        if path is None:
+            path = latest_step_dir(self._dir)
         if path is None:
             return None
         with open(os.path.join(path, "meta.json")) as f:
@@ -346,7 +432,9 @@ class AutoCheckpoint:
                 # from it rather than double-counted; in-process the
                 # trigger already opened it and this is a no-op
                 _goodput.on_preemption_resume(
-                    meta["preempt"].get("t_unix"))
+                    meta["preempt"].get("t_unix"),
+                    category=self._recovery_category(
+                        meta["preempt"].get("kind", "preempt")))
             # the stamp is CONSUMED by this resume: a later resume
             # from the same checkpoint (crash after hours of resumed
             # training) must not re-open a window back to the original

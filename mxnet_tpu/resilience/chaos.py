@@ -45,7 +45,18 @@ the next N calls), ``kind@pF`` (each call fails with prob. F, seeded by
 ``MXNET_CHAOS_SEED``), each optionally ``:action`` where action is one
 of ``error`` (raise :class:`FaultInjected` — the default), ``die``
 (worker death), ``hang`` (sleep ``duration`` inside the site so real
-timeout machinery fires), ``preempt`` (trigger the preemption flag).
+timeout machinery fires; ``hang=SECONDS`` sets the duration in a
+spec), ``preempt`` (trigger the preemption flag); and optionally
+``:rank=R``.
+
+``rank=`` is the multi-process selector: a plan carrying it fires only
+in the process whose job rank is R, so one spec shipped identically
+into every worker's environment (the elastic supervisor does exactly
+this) can still kill or hang ONE deterministic rank.  The process rank
+is stamped by ``dist.init()`` (:func:`set_rank`) or resolved lazily
+from the launcher env (``MXNET_ELASTIC_RANK``, ``DMLC_WORKER_ID``,
+``PROCESS_ID``); a rank-selected plan in a process with no resolvable
+rank never fires.
 
 Every fire bumps ``mx_fault_injected_total{kind}`` and the per-kind
 :func:`stats`, which persist after a scope exits so tests can assert
@@ -61,7 +72,7 @@ from typing import Dict, List, Optional
 from ..base import MXNetError
 
 __all__ = ["FaultInjected", "inject", "check", "stats", "reset_stats",
-           "export_plans", "install_plans", "active"]
+           "export_plans", "install_plans", "active", "set_rank"]
 
 
 class FaultInjected(MXNetError):
@@ -97,17 +108,49 @@ _ENV_DONE = False
 
 _DEFAULT_ACTION = {"trainer.preempt": "preempt",
                    "dataloader.worker": "die",
-                   "trainer.numerics": "corrupt"}
+                   "trainer.numerics": "corrupt",
+                   "elastic.worker": "die"}
+
+#: This process's job rank for `rank=`-selected plans.  Stamped by
+#: dist.init() / set_rank(); None = not yet known (resolved lazily
+#: from the launcher env when a rank-selected plan is consulted).
+_RANK: Optional[int] = None
+
+
+def set_rank(rank: Optional[int]) -> None:
+    """Stamp the process's job rank (dist.init does this) — what a
+    ``rank=``-selected plan matches against."""
+    global _RANK
+    with _LOCK:
+        _RANK = None if rank is None else int(rank)
+
+
+def _current_rank_locked() -> Optional[int]:
+    """The stamped rank, else the launcher env contract (the elastic
+    supervisor / dmlc launchers export the rank before the framework
+    ever imports, so env resolution is race-free)."""
+    if _RANK is not None:
+        return _RANK
+    import os as _os
+
+    for name in ("MXNET_ELASTIC_RANK", "DMLC_WORKER_ID", "PROCESS_ID"):
+        v = _os.environ.get(name)
+        if v is not None:
+            try:
+                return int(v)
+            except ValueError:
+                continue
+    return None
 
 
 class _Plan:
     __slots__ = ("kind", "at", "times", "p", "action", "duration",
-                 "_rng", "_fired")
+                 "rank", "_rng", "_fired")
 
     def __init__(self, kind: str, at: Optional[int] = None,
                  times: Optional[int] = None, p: Optional[float] = None,
                  action: Optional[str] = None, duration: float = 0.0,
-                 seed: int = 0):
+                 rank: Optional[int] = None, seed: int = 0):
         if action is None:
             # the natural action per kind: a preemption site preempts,
             # a worker site kills the worker, everything else errors
@@ -121,8 +164,15 @@ class _Plan:
                 "call), times=N (the next N calls), or p=F (probability)")
         self.kind, self.at, self.times, self.p = kind, at, times, p
         self.action, self.duration = action, float(duration)
+        self.rank = None if rank is None else int(rank)
         self._rng = _random.Random(seed)
         self._fired = 0
+
+    def rank_matches(self) -> bool:
+        if self.rank is None:
+            return True
+        cur = _current_rank_locked()
+        return cur is not None and cur == self.rank
 
     def wants(self, nth: int) -> bool:
         if self.at is not None:
@@ -135,7 +185,7 @@ class _Plan:
         """Picklable form for shipping into spawn children."""
         return {"kind": self.kind, "at": self.at, "times": self.times,
                 "p": self.p, "action": self.action,
-                "duration": self.duration}
+                "duration": self.duration, "rank": self.rank}
 
 
 def active() -> bool:
@@ -168,7 +218,8 @@ def check(kind: str) -> Optional[str]:
         nth = _CALLS.get(kind, 0) + 1
         _CALLS[kind] = nth
         plan = next((pl for pl in _PLANS
-                     if pl.kind == kind and pl.wants(nth)), None)
+                     if pl.kind == kind and pl.rank_matches()
+                     and pl.wants(nth)), None)
         if plan is None:
             return None
         plan._fired += 1
@@ -199,6 +250,11 @@ class inject:
         with chaos.inject("dist.collective", at=1, action="hang",
                           duration=5.0):
         with chaos.inject("trainer.preempt", at=4, action="preempt"):
+        with chaos.inject("elastic.worker", at=4, rank=1):  # only rank 1
+
+    ``rank=`` makes a plan fire only in the process whose job rank
+    matches (multi-process chaos: one deterministic rank dies, the
+    siblings run clean even though they installed the same plan).
 
     Exiting the scope removes the plan (stats persist; see
     :func:`stats`/:func:`reset_stats`).  Scopes nest."""
@@ -206,9 +262,9 @@ class inject:
     def __init__(self, kind: str, at: Optional[int] = None,
                  times: Optional[int] = None, p: Optional[float] = None,
                  action: Optional[str] = None, duration: float = 0.0,
-                 seed: int = 0):
+                 rank: Optional[int] = None, seed: int = 0):
         self._plan = _Plan(kind, at=at, times=times, p=p, action=action,
-                           duration=duration, seed=seed)
+                           duration=duration, rank=rank, seed=seed)
 
     def __enter__(self):
         with _LOCK:
@@ -283,20 +339,36 @@ def _parse_spec(spec: str, seed: int) -> List[_Plan]:
         if "@" not in part:
             raise MXNetError(
                 f"MXNET_CHAOS_SPEC entry {part!r}: expected kind@selector"
-                "[:action] (e.g. 'trainer.preempt@4:preempt')")
+                "[:action][:rank=R] (e.g. 'trainer.preempt@4:preempt' "
+                "or 'elastic.worker@4:die:rank=1')")
         kind, rest = part.split("@", 1)
-        action = None
-        if ":" in rest:
-            rest, action = rest.split(":", 1)
+        sel, *mods = rest.split(":")
+        action, duration, rank = None, 0.0, None
+        for mod in mods:
+            if not mod:
+                continue
+            if mod.startswith("rank="):
+                rank = int(mod[len("rank="):])
+            elif mod.startswith("hang="):
+                action, duration = "hang", float(mod[len("hang="):])
+            elif "=" in mod:
+                # a typo'd key= modifier must die HERE with the real
+                # diagnosis, not fall through as a bogus action name
+                raise MXNetError(
+                    f"MXNET_CHAOS_SPEC entry {part!r}: unknown "
+                    f"modifier {mod!r} (expected rank=R or "
+                    f"hang=SECONDS)")
+            else:
+                action = mod
         at = times = p = None
-        if rest.startswith("x"):
-            times = int(rest[1:])
-        elif rest.startswith("p"):
-            p = float(rest[1:])
+        if sel.startswith("x"):
+            times = int(sel[1:])
+        elif sel.startswith("p"):
+            p = float(sel[1:])
         else:
-            at = int(rest)
+            at = int(sel)
         plans.append(_Plan(kind, at=at, times=times, p=p, action=action,
-                           seed=seed))
+                           duration=duration, rank=rank, seed=seed))
     return plans
 
 

@@ -1,0 +1,659 @@
+"""mxelastic — multi-host rank-failure detection and coordinated
+shrink/replace recovery.
+
+The preemption seam (PR 6) survives a *single-process* SIGTERM:
+checkpoint at the step boundary, resume bit-consistent.  The GSPMD
+spine (PR 9) made training *multi-process* — and a multi-process job
+has a failure mode no single process can recover from alone: one dead
+or hung rank wedges every survivor inside a blocking collective until
+the watchdog poisons the sequence, and the job is over unless someone
+restarts it.  This module is that someone.
+
+Three cooperating pieces (docs/resilience.md, "Elastic recovery"):
+
+  * **worker runtime** — ranks stamp heartbeats (:mod:`.heartbeat`),
+    classify a dist-collective watchdog timeout as :class:`PeerFailed`
+    (the peer is gone; this process is fine), cut a sync checkpoint at
+    the last completed step boundary through the existing preemption
+    seam, and exit with a *reserved* rc the supervisor understands:
+    ``RC_PEER_FAILED`` (43, "I observed a peer die") or
+    ``RC_WINDDOWN`` (44, "the supervisor asked me to stop; my
+    checkpoint is on disk").
+  * **supervisor** (:class:`Supervisor`, CLI ``tools/elastic_run.py``)
+    — launches N workers, watches exit codes + heartbeat ages, and on
+    a failure epoch coordinates recovery: wind down survivors, elect a
+    job-level **commit marker** (the newest *complete* checkpoint any
+    rank holds — every restarted rank resumes from that ONE step
+    directory, so resume can never mix steps across ranks), then
+    restart in **replace** mode (same world size) or **shrink** mode
+    (resume onto the survivors — ``Trainer.load_states(
+    allow_resize=True)`` re-shards the state), bounded by a restart
+    budget before declaring the job dead.
+  * **accounting** — restarts bump ``mx_elastic_restarts_total{mode}``,
+    heartbeat ages ride ``mx_rank_heartbeat_age_seconds{rank}``, and a
+    peer-failure resume opens the mxgoodput ``rank_failure_recovery``
+    badput window (closed at the first post-resume step) so recovery
+    is *measured*, never mystery badput.
+
+Disabled path: nothing here runs without the supervisor.  A job
+launched plainly has no heartbeat writer, no extra step hooks, and
+``elastic.enabled()`` is one env read — zero step cost.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import signal as _signal
+import sys
+import time
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from . import preemption
+from .preemption import Preempted
+
+__all__ = [
+    "PeerFailed", "RC_PEER_FAILED", "RC_WINDDOWN", "RESERVED_RCS",
+    "enabled", "rank", "world", "shared_dir", "install_winddown",
+    "guard", "WorkerContext", "elect_commit", "read_commit",
+    "committed_resume_path", "scan_rank_checkpoints", "Supervisor",
+]
+
+#: Reserved worker exit codes (the worker<->supervisor rc contract).
+#: 43 — this rank OBSERVED a peer failure (watchdog timeout / poisoned
+#: sequence), checkpointed where possible, and got out of the way.
+#: 44 — supervisor-initiated wind-down (SIGTERM observed at a step
+#: boundary, sync checkpoint cut by the preemption seam).
+RC_PEER_FAILED = 43
+RC_WINDDOWN = 44
+RESERVED_RCS = (RC_PEER_FAILED, RC_WINDDOWN)
+
+_COMMIT_NAME = "COMMIT.json"
+_RANK_DIR_PREFIX = "rank"
+
+
+class PeerFailed(MXNetError):
+    """A blocking collective gave up on an unreachable peer: either the
+    watchdog timed out (``poisoned=False`` on the first fire) or a
+    later collective refused because the sequence is already poisoned
+    (``poisoned=True``).  NOT transient — this process is out of step
+    with its peers and no in-process retry can fix that; the recovery
+    is coordinated (checkpoint, exit ``RC_PEER_FAILED``, let the
+    supervisor restart the job)."""
+
+    transient = False
+
+    def __init__(self, msg: str, what: str = "", poisoned: bool = False):
+        super().__init__(msg)
+        self.what = what
+        self.poisoned = poisoned
+
+    def __reduce__(self):
+        return (PeerFailed, (str(self), self.what, self.poisoned))
+
+
+# ---------------------------------------------------------------------------
+# worker-side runtime
+# ---------------------------------------------------------------------------
+
+def enabled() -> bool:
+    """True when this process runs under the elastic supervisor
+    (``MXNET_ELASTIC=1`` in the env the supervisor exports)."""
+    from ..util import env
+
+    return bool(env.get_bool("MXNET_ELASTIC"))
+
+
+def rank() -> Optional[int]:
+    from ..util import env
+
+    return env.get_int("MXNET_ELASTIC_RANK")
+
+
+def world() -> Optional[int]:
+    from ..util import env
+
+    return env.get_int("MXNET_ELASTIC_WORLD")
+
+
+def shared_dir() -> Optional[str]:
+    from ..util import env
+
+    d = env.get_str("MXNET_ELASTIC_DIR")
+    return d or None
+
+
+def install_winddown() -> None:
+    """Install the elastic SIGTERM handler: under the supervisor a
+    worker SIGTERM means "a peer failed; wind down" — the trigger
+    reason is marked ``peer-failure`` so the checkpoint meta (and the
+    goodput recovery window a resume opens) lands in
+    ``rank_failure_recovery``, not ``preemption_recovery``.  The
+    previous handler is chained."""
+    prev = _signal.getsignal(_signal.SIGTERM)
+
+    def _handler(signum, frame, _prev=prev):
+        preemption.trigger(
+            reason="peer-failure: supervisor wind-down (SIGTERM)")
+        if callable(_prev):
+            _prev(signum, frame)
+
+    _signal.signal(_signal.SIGTERM, _handler)
+
+
+def _hard_exit(code: int) -> None:
+    """Exit without the interpreter teardown: the jax coordination
+    service's shutdown barrier would block ~100s waiting for the dead
+    peer (the same rationale as ``dist.abort``)."""
+    sys.stderr.flush()
+    sys.stdout.flush()
+    os._exit(code)
+
+
+@contextlib.contextmanager
+def guard(auto_ckpt=None, exit_fn=None):
+    """Wrap a training loop with the worker side of the rc contract:
+
+      * :class:`PeerFailed` (watchdog timeout / poisoned sequence) —
+        cut a best-effort sync checkpoint at the last completed step
+        boundary (the failed collective never wrote back, so the
+        parameters ARE the last boundary), stamped ``peer_failure``,
+        then exit ``RC_PEER_FAILED``;
+      * :class:`Preempted` (supervisor wind-down observed at a step
+        boundary; the seam already saved synchronously) — exit
+        ``RC_WINDDOWN``.
+
+    ``exit_fn`` is injectable for tests; the default is a hard
+    ``os._exit`` (see :func:`_hard_exit`)."""
+    ex = exit_fn or _hard_exit
+    try:
+        yield
+    except PeerFailed as e:
+        if auto_ckpt is not None:
+            try:
+                auto_ckpt.stamp_failure(f"peer-failure: {e}")
+                auto_ckpt.save(sync=True)
+            except BaseException as save_err:  # noqa: BLE001
+                # the checkpoint is best-effort — an older complete one
+                # (or another rank's) still commits; exiting with the
+                # reserved rc is what recovery actually depends on
+                print(f"[mxelastic] peer-failure checkpoint failed: "
+                      f"{save_err}", file=sys.stderr, flush=True)
+        ex(RC_PEER_FAILED)
+    except Preempted:
+        ex(RC_WINDDOWN)
+
+
+class WorkerContext:
+    """The worker-side per-step runtime under the supervisor: stamps
+    the rank's heartbeat and probes the ``elastic.worker`` chaos site
+    (default action ``die`` — the deterministic one-rank kill/hang the
+    chaos e2e injects via ``elastic.worker@N:die:rank=K``).  Construct
+    only when :func:`enabled`; a plain job never pays for it."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 worker_rank: Optional[int] = None):
+        from .heartbeat import HeartbeatWriter
+
+        d = directory or shared_dir()
+        r = worker_rank if worker_rank is not None else rank()
+        if d is None or r is None:
+            raise MXNetError(
+                "WorkerContext needs the elastic env contract "
+                "(MXNET_ELASTIC_DIR + MXNET_ELASTIC_RANK) or explicit "
+                "directory/worker_rank")
+        self.rank = int(r)
+        self.heartbeat = HeartbeatWriter(d, self.rank)
+
+    def on_step(self, step: int) -> None:
+        """Call once per training step: chaos probe first (a ``die``
+        plan kills THIS step, before the beat, so the stamp's age
+        reflects the last completed step), then the heartbeat."""
+        from . import chaos as _chaos
+
+        if _chaos._ACTIVE:
+            if _chaos.check("elastic.worker") == "die":
+                _hard_exit(1)  # an unreserved rc: this rank IS the failure
+        self.heartbeat.beat(step=step)
+
+
+# ---------------------------------------------------------------------------
+# the job-level commit marker
+# ---------------------------------------------------------------------------
+
+def _complete_step_dirs(rank_dir: str) -> Dict[int, str]:
+    """step -> path of every COMPLETE checkpoint under one rank dir
+    (all three files present; ``.tmp-`` write residue ignored)."""
+    out: Dict[int, str] = {}
+    try:
+        names = os.listdir(rank_dir)
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith("step-"):
+            continue
+        try:
+            step = int(name[len("step-"):])
+        except ValueError:
+            continue
+        path = os.path.join(rank_dir, name)
+        if all(os.path.exists(os.path.join(path, f))
+               for f in ("meta.json", "params.npz", "trainer.states")):
+            out[step] = path
+    return out
+
+
+def scan_rank_checkpoints(directory: str) -> Dict[int, Dict[int, str]]:
+    """``{rank: {step: path}}`` over every ``rank<k>/`` checkpoint
+    subdirectory of the shared elastic dir."""
+    out: Dict[int, Dict[int, str]] = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith(_RANK_DIR_PREFIX):
+            continue
+        try:
+            r = int(name[len(_RANK_DIR_PREFIX):])
+        except ValueError:
+            continue
+        steps = _complete_step_dirs(os.path.join(directory, name))
+        if steps:
+            out[r] = steps
+    return out
+
+
+def elect_commit(directory: str, cause: str = "rank_failure",
+                 epoch: int = 0,
+                 failed_ranks: Optional[List[int]] = None) -> dict:
+    """Pick the job-level resume point and write ``COMMIT.json``
+    (atomically): the HIGHEST step for which any rank holds a complete
+    checkpoint (ties go to the lowest rank — deterministic).  Every
+    restarted rank resumes from that ONE step directory, which is what
+    makes "resume can never mix steps across ranks" structural rather
+    than hoped-for.  Sync data-parallel training keeps parameters and
+    optimizer state identical across ranks, so any rank's complete
+    checkpoint serves the whole job (and ``load_states(
+    allow_resize=True)`` re-shards it onto a different world size in
+    shrink mode).  ``step`` 0 with no path = no checkpoint yet; the
+    restarted job starts fresh."""
+    ckpts = scan_rank_checkpoints(directory)
+    best_step, best_rank, best_path = 0, None, None
+    for r in sorted(ckpts):
+        for step, path in ckpts[r].items():
+            if step > best_step:
+                best_step, best_rank, best_path = step, r, path
+    commit = {
+        "step": best_step,
+        "source_rank": best_rank,
+        "path": os.path.relpath(best_path, directory)
+        if best_path else None,
+        "cause": cause,
+        "epoch": int(epoch),
+        "failed_ranks": sorted(failed_ranks or []),
+        "t_unix": time.time(),
+    }
+    # same crash-consistency bar as the checkpoints it elects: fsync
+    # the payload before the rename and the parent dir after it — a
+    # machine crash racing writeback must not lose the marker and
+    # silently restart the whole job from step 0
+    from .autockpt import AutoCheckpoint
+
+    tmp = os.path.join(directory, f".tmp-{_COMMIT_NAME}")
+    AutoCheckpoint._write_file(tmp, json.dumps(commit, indent=1),
+                               mode="w")
+    os.replace(tmp, os.path.join(directory, _COMMIT_NAME))
+    AutoCheckpoint._fsync_dir(directory)
+    return commit
+
+
+def read_commit(directory: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(directory, _COMMIT_NAME)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def committed_resume_path(directory: str) -> Optional[str]:
+    """Absolute step-dir path of the committed resume point (None when
+    there is no commit marker or it names no checkpoint)."""
+    commit = read_commit(directory)
+    if not commit or not commit.get("path"):
+        return None
+    return os.path.join(os.path.abspath(directory), commit["path"])
+
+
+# ---------------------------------------------------------------------------
+# the supervisor
+# ---------------------------------------------------------------------------
+
+class Supervisor:
+    """Launch N copies of one worker command, watch them, recover.
+
+    The worker command is rank-agnostic; each rank gets the elastic env
+    contract (``MXNET_ELASTIC=1``, ``MXNET_ELASTIC_DIR/RANK/WORLD``)
+    plus the dmlc launcher contract (``DMLC_*`` with a fresh
+    coordinator port per generation — a restarted jax coordination
+    service must not collide with the dying one's socket).  Chaos env
+    (``MXNET_CHAOS*``) is forwarded ONLY to generation 0: an injected
+    fault describes the first life of the job, not an affliction every
+    recovery must re-suffer (``at=N`` schedules would otherwise re-kill
+    the respawned rank at its Nth call, forever).
+
+    One failure epoch = detect -> wind down survivors (SIGTERM; they
+    checkpoint via the preemption seam and exit a reserved rc; anything
+    still alive after the grace window is SIGKILLed and classified
+    failed/hung) -> elect the commit marker -> restart (``replace``
+    keeps the world size, ``shrink`` drops the failed ranks) -> watch
+    heartbeats until a rank reports a step past the committed one (the
+    MTTR end mark).  ``max_restarts`` epochs and the job is dead."""
+
+    def __init__(self, worker_cmd: List[str], world: int,
+                 directory: str, mode: str = "replace",
+                 max_restarts: Optional[int] = None,
+                 hb_timeout_s: Optional[float] = None,
+                 grace_s: Optional[float] = None,
+                 collective_timeout_s: Optional[float] = None,
+                 poll_s: float = 0.25,
+                 startup_timeout_s: Optional[float] = None,
+                 coordinator_host: str = "127.0.0.1",
+                 base_env: Optional[dict] = None):
+        from ..util import env
+
+        if mode not in ("replace", "shrink"):
+            raise MXNetError(f"elastic mode {mode!r}: expected "
+                             "'replace' or 'shrink'")
+        self.worker_cmd = list(worker_cmd)
+        self.world = int(world)
+        self.dir = os.path.abspath(directory)
+        os.makedirs(self.dir, exist_ok=True)
+        self.mode = mode
+        self.max_restarts = max_restarts if max_restarts is not None \
+            else env.get_int("MXNET_ELASTIC_MAX_RESTARTS")
+        self.hb_timeout = hb_timeout_s if hb_timeout_s is not None \
+            else env.get_float("MXNET_ELASTIC_HEARTBEAT_TIMEOUT_S")
+        self.collective_timeout = collective_timeout_s \
+            if collective_timeout_s is not None else self.hb_timeout
+        self.grace = grace_s if grace_s is not None else max(
+            env.get_float("MXNET_ELASTIC_GRACE_S"),
+            self.collective_timeout + 5.0)
+        self.poll_s = float(poll_s)
+        # liveness bound for ranks that never produce a FIRST stamp: a
+        # worker wedged before its first beat (stuck import, a hang
+        # before WorkerContext) has no exit code and no stamp to age,
+        # so without this the supervisor would spin forever — the
+        # exact wedge it exists to prevent, one level up.  None (the
+        # default) = AUTO: the bound (max(60, 4x hb timeout)) arms
+        # only once some rank of this job has actually stamped — a
+        # supervised command that never integrates heartbeats is
+        # watched by exit codes alone instead of being declared hung
+        # at 60s while healthy.  Explicit seconds force it on; 0
+        # forces it off.
+        self.startup_timeout = startup_timeout_s
+        self._saw_stamps = False
+        self.host = coordinator_host
+        self.base_env = dict(base_env if base_env is not None
+                             else os.environ)
+        self.log_dir = os.path.join(self.dir, "logs")
+        os.makedirs(self.log_dir, exist_ok=True)
+
+    # -- spawning ---------------------------------------------------------
+
+    @staticmethod
+    def _free_port() -> int:
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def _worker_env(self, gen: int, i: int, n: int, port: int) -> dict:
+        env = dict(self.base_env)
+        if gen > 0:
+            # chaos describes generation 0 only (see class docstring)
+            env.pop("MXNET_CHAOS", None)
+            env.pop("MXNET_CHAOS_SPEC", None)
+        env.update({
+            "MXNET_ELASTIC": "1",
+            "MXNET_ELASTIC_DIR": self.dir,
+            "MXNET_ELASTIC_RANK": str(i),
+            "MXNET_ELASTIC_WORLD": str(n),
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": self.host,
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": str(n),
+            "DMLC_WORKER_ID": str(i),
+        })
+        # the watchdog IS the in-collective failure detector: without
+        # it a dead peer means an infinite hang no supervisor can
+        # distinguish from slow compile.  An operator override stands.
+        env.setdefault("MXNET_KVSTORE_TIMEOUT",
+                       str(self.collective_timeout))
+        return env
+
+    def _spawn(self, gen: int, n: int) -> List[dict]:
+        import subprocess
+
+        port = self._free_port()
+        workers = []
+        for i in range(n):
+            log_path = os.path.join(self.log_dir,
+                                    f"gen{gen}-rank{i}.log")
+            log = open(log_path, "w")
+            p = subprocess.Popen(self.worker_cmd,
+                                 env=self._worker_env(gen, i, n, port),
+                                 stdout=log, stderr=subprocess.STDOUT)
+            workers.append({"rank": i, "proc": p, "log": log,
+                            "log_path": log_path})
+        return workers
+
+    @staticmethod
+    def _close_logs(workers: List[dict]) -> None:
+        for w in workers:
+            try:
+                w["log"].close()
+            except OSError:
+                pass  # mxlint: disable=MX007 — log fd teardown only
+
+    @staticmethod
+    def _teardown(workers: List[dict]) -> None:
+        """Kill whatever is still alive of one generation — the
+        supervisor dying (Ctrl-C, an outer timeout's SIGTERM) must
+        never orphan N training processes still holding the
+        coordinator port and writing into the shared dir."""
+        for w in workers:
+            if w["proc"].poll() is None:
+                try:
+                    w["proc"].kill()
+                except OSError:
+                    pass  # mxlint: disable=MX007 — exited under us
+        import subprocess
+
+        for w in workers:
+            if w["proc"].poll() is None:
+                try:
+                    w["proc"].wait(timeout=10)
+                except (subprocess.TimeoutExpired, OSError):
+                    pass  # mxlint: disable=MX007 — unwaitable zombie;
+                    # the kill above was delivered, nothing more to do
+
+    def _tails(self, workers: List[dict], lines: int = 12) -> dict:
+        out = {}
+        for w in workers:
+            try:
+                with open(w["log_path"]) as f:
+                    out[str(w["rank"])] = "\n".join(
+                        f.read().splitlines()[-lines:])
+            except OSError:
+                out[str(w["rank"])] = "(log unreadable)"
+        return out
+
+    # -- one generation ---------------------------------------------------
+
+    def _watch(self, workers: List[dict], mon, committed_step: int,
+               watch_first_step: bool) -> dict:
+        """Watch one generation to completion or failure epoch.
+        Returns {"ok": True} or {"ok": False, "failed": [...],
+        "t_detect": mono, "t_first_step": mono|None, ...}."""
+        t_first_step = None
+        gen_t0 = time.monotonic()
+        while True:
+            time.sleep(self.poll_s)
+            # ONE heartbeat-directory scan per poll feeds every
+            # consumer below (shared checkpoint filesystems are slow;
+            # stale() + max_step() would double the I/O)
+            stamps = mon.read()
+            if stamps:
+                self._saw_stamps = True  # this job DOES heartbeat
+            if watch_first_step and t_first_step is None:
+                steps = [s["step"] for s in stamps.values()
+                         if s.get("step") is not None]
+                if steps and max(steps) > committed_step:
+                    t_first_step = time.monotonic()
+            rcs = {w["rank"]: w["proc"].poll() for w in workers}
+            bad = [r for r, rc in rcs.items()
+                   if rc is not None and rc != 0]
+            if not bad:
+                alive = [r for r, rc in rcs.items() if rc is None]
+                if not alive:
+                    return {"ok": True, "t_first_step": t_first_step}
+                hung = [r for r in alive if r in stamps
+                        and stamps[r]["age_s"] > self.hb_timeout]
+                startup = self.startup_timeout \
+                    if self.startup_timeout is not None else (
+                        max(60.0, 4.0 * self.hb_timeout)
+                        if self._saw_stamps else 0.0)
+                if not hung and startup and \
+                        time.monotonic() - gen_t0 > startup:
+                    # a heartbeating job's rank never produced a FIRST
+                    # stamp inside the startup window: wedged before
+                    # its first beat
+                    hung = [r for r in alive if r not in stamps]
+                if not hung:
+                    continue
+            # --- failure epoch: wind down, classify ---
+            t_detect = time.monotonic()
+            for w in workers:
+                if w["proc"].poll() is None:
+                    try:
+                        w["proc"].send_signal(_signal.SIGTERM)
+                    except OSError:
+                        pass  # mxlint: disable=MX007 — exited under us
+            deadline = time.monotonic() + self.grace
+            while time.monotonic() < deadline and \
+                    any(w["proc"].poll() is None for w in workers):
+                time.sleep(self.poll_s)
+            killed = []
+            for w in workers:
+                if w["proc"].poll() is None:
+                    killed.append(w["rank"])
+                    try:
+                        w["proc"].kill()
+                    except OSError:
+                        pass  # mxlint: disable=MX007 — exited under us
+                    w["proc"].wait()
+            rcs = {w["rank"]: w["proc"].returncode for w in workers}
+            # failed = died with an unreserved rc, or hung past grace;
+            # reserved rcs are survivors doing the coordinated exit
+            failed = sorted(set(killed) | {
+                r for r, rc in rcs.items()
+                if rc not in (0,) + RESERVED_RCS})
+            return {"ok": False, "failed": failed, "rcs": rcs,
+                    "t_detect": t_detect, "t_first_step": t_first_step,
+                    "tails": self._tails(workers)}
+
+    # -- the job ----------------------------------------------------------
+
+    def run(self) -> dict:
+        """Supervise until success, or until the restart budget is
+        spent.  Returns the job report (also what
+        ``tools/elastic_run.py`` prints as JSON)."""
+        from .heartbeat import HeartbeatMonitor
+
+        mon = HeartbeatMonitor(self.dir)
+        report = {"ok": False, "mode": self.mode,
+                  "world_start": self.world, "restarts": 0,
+                  "epochs": []}
+        n = self.world
+        gen = 0
+        pending = None  # the epoch awaiting its first-post-resume step
+        current: List[dict] = []
+        try:
+            return self._run_loop(mon, report, n, gen, pending,
+                                  current)
+        finally:
+            # an interrupt/crash anywhere above (Ctrl-C in a poll
+            # sleep, an outer SIGTERM converted to SystemExit) must
+            # not orphan the live generation
+            self._teardown(current)
+
+    def _run_loop(self, mon, report, n, gen, pending,
+                  current: List[dict]) -> dict:
+        from ..telemetry import instruments as _ins
+
+        while True:
+            mon.clear()
+            committed = read_commit(self.dir) if gen > 0 else None
+            committed_step = committed["step"] if committed else 0
+            workers = self._spawn(gen, n)
+            current[:] = workers
+            try:
+                res = self._watch(workers, mon, committed_step,
+                                  watch_first_step=pending is not None)
+            finally:
+                self._close_logs(workers)
+            current[:] = []  # _watch returns only after every exit
+            if pending is not None:
+                # MTTR = detection -> first post-resume step (restart
+                # time is inside it; the step is the proof training
+                # actually recovered, not just that processes exist).
+                # The private monotonic stamp is popped UNCONDITIONALLY
+                # — it must never leak into the persisted report when
+                # the resumed generation dies before its first step.
+                t_det = pending.pop("_t_detect")
+                t1 = res.get("t_first_step")
+                pending["mttr_s"] = round(t1 - t_det, 3) \
+                    if t1 is not None else None
+                pending = None
+            if res["ok"]:
+                report["ok"] = True
+                report["final_world"] = n
+                return report
+            report["restarts"] += 1
+            epoch = {
+                "failed_ranks": res["failed"],
+                "rcs": {str(k): v for k, v in res["rcs"].items()},
+                "world_before": n,
+                "_t_detect": res["t_detect"],
+                "mttr_s": None,
+            }
+            if report["restarts"] > self.max_restarts:
+                epoch.pop("_t_detect")
+                epoch["budget_exhausted"] = True
+                epoch["log_tails"] = res["tails"]
+                report["epochs"].append(epoch)
+                report["final_world"] = n
+                report["error"] = (
+                    f"restart budget ({self.max_restarts}) exhausted; "
+                    f"job dead")
+                return report
+            if self.mode == "shrink":
+                # shrink by the ranks actually IDENTIFIED as failed;
+                # an epoch where every rank exited a reserved rc (e.g.
+                # a spurious watchdog fire) names nobody — restarting
+                # at full size is right, discarding a healthy machine
+                # is not
+                n = max(1, n - len(res["failed"]))
+            commit = elect_commit(self.dir, cause="rank_failure",
+                                  epoch=report["restarts"],
+                                  failed_ranks=res["failed"])
+            epoch["committed_step"] = commit["step"]
+            epoch["committed_source_rank"] = commit["source_rank"]
+            epoch["world_after"] = n
+            report["epochs"].append(epoch)
+            _ins.elastic_restarts_total(self.mode).inc()
+            pending = epoch
+            gen += 1

@@ -1,0 +1,171 @@
+"""Per-rank heartbeats over a shared directory (`resilience.heartbeat`).
+
+The elastic supervisor (:mod:`.elastic`, ``tools/elastic_run.py``) has
+no network channel to its workers beyond exit codes — on a TPU pod the
+only substrate every host shares is the checkpoint filesystem.  So
+liveness rides stamp files: each rank atomically rewrites
+``hb-rank<k>.json`` ({rank, pid, step, unix}) as it makes progress, and
+the supervisor reads the stamps' ages.  A rank that *dies* is seen
+through its exit code first; a rank that *hangs* (wedged device, stuck
+host thread, a chaos ``hang`` plan) is seen here — its stamp ages past
+``MXNET_ELASTIC_HEARTBEAT_TIMEOUT_S`` while the process is still alive.
+
+Two stamping modes:
+
+  * **per-step** (the default the elastic worker runtime uses):
+    ``beat(step=N)`` from the training loop.  A hang anywhere in the
+    step — collective, compile, input pipeline — ages the stamp, which
+    is exactly the "no forward progress" definition a supervisor wants;
+  * **background** (``start()``): a daemon thread stamps every
+    ``MXNET_ELASTIC_HEARTBEAT_S`` seconds — pure process-liveness for
+    workers whose step cadence is slower than the timeout.
+
+Every monitor read updates ``mx_rank_heartbeat_age_seconds{rank}``.
+Nothing in this module runs unless constructed — a job without the
+elastic supervisor pays zero step cost (the acceptance bar).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["HeartbeatWriter", "HeartbeatMonitor", "stamp_name"]
+
+_PREFIX = "hb-rank"
+
+
+def stamp_name(rank: int) -> str:
+    return f"{_PREFIX}{rank}.json"
+
+
+class HeartbeatWriter:
+    """One rank's stamp.  ``beat()`` is an atomic tmp-write +
+    ``os.replace`` (a reader never sees a torn stamp), cheap enough to
+    call every step."""
+
+    def __init__(self, directory: str, rank: int,
+                 interval_s: Optional[float] = None):
+        from ..util import env
+
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self.rank = int(rank)
+        self._path = os.path.join(self._dir, stamp_name(self.rank))
+        self._tmp = os.path.join(self._dir,
+                                 f".tmp-{stamp_name(self.rank)}")
+        self._interval = interval_s if interval_s is not None \
+            else env.get_float("MXNET_ELASTIC_HEARTBEAT_S")
+        self._last_step: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self, step: Optional[int] = None) -> None:
+        if step is not None:
+            self._last_step = int(step)
+        stamp = {"rank": self.rank, "pid": os.getpid(),
+                 "step": self._last_step, "unix": time.time()}
+        try:
+            with open(self._tmp, "w") as f:
+                json.dump(stamp, f)
+            os.replace(self._tmp, self._path)
+        except OSError:
+            # a flaky shared filesystem must never kill the step that
+            # happened to carry the heartbeat; a missed beat just ages
+            # the stamp, which is the signal's own failure mode
+            pass  # mxlint: disable=MX007 — liveness is best-effort by design
+
+    def start(self) -> "HeartbeatWriter":
+        """Background mode: stamp every ``interval_s`` seconds from a
+        daemon thread until :meth:`stop`."""
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"mx-heartbeat-rank{self.rank}")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._interval + 1.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.beat()
+            self._stop.wait(self._interval)
+
+
+class HeartbeatMonitor:
+    """Supervisor-side reader: stamp ages + last-reported steps."""
+
+    def __init__(self, directory: str):
+        self._dir = os.path.abspath(directory)
+
+    def read(self) -> Dict[int, dict]:
+        """All stamps -> ``{rank: {"age_s", "step", "pid"}}``.  Age is
+        ``now - mtime`` (writer and reader share the filesystem clock;
+        no cross-host clock agreement is assumed).  Updates the
+        ``mx_rank_heartbeat_age_seconds{rank}`` gauge."""
+        from ..telemetry import instruments as _ins
+
+        out: Dict[int, dict] = {}
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return out
+        now = time.time()
+        for name in names:
+            if not name.startswith(_PREFIX) or not name.endswith(".json"):
+                continue
+            path = os.path.join(self._dir, name)
+            try:
+                age = now - os.stat(path).st_mtime
+                with open(path) as f:
+                    stamp = json.load(f)
+                rank = int(stamp["rank"])
+            except (OSError, ValueError, KeyError, TypeError):
+                continue  # torn/foreign file: not a heartbeat
+            out[rank] = {"age_s": max(0.0, age),
+                         "step": stamp.get("step"),
+                         "pid": stamp.get("pid")}
+            _ins.rank_heartbeat_age_seconds(str(rank)).set(
+                out[rank]["age_s"])
+        return out
+
+    def stale(self, timeout_s: float,
+              ranks: Optional[List[int]] = None) -> List[int]:
+        """Ranks whose stamp is older than ``timeout_s`` (restricted to
+        ``ranks`` when given; a rank with NO stamp yet is not stale —
+        it may still be importing the framework)."""
+        stamps = self.read()
+        pool = stamps if ranks is None else \
+            {r: stamps[r] for r in ranks if r in stamps}
+        return sorted(r for r, s in pool.items()
+                      if s["age_s"] > timeout_s)
+
+    def max_step(self) -> Optional[int]:
+        """Highest step any rank has reported (the supervisor's
+        first-post-resume-step watch)."""
+        steps = [s["step"] for s in self.read().values()
+                 if s.get("step") is not None]
+        return max(steps) if steps else None
+
+    def clear(self) -> None:
+        """Remove every stamp (the supervisor does this before each
+        generation so a dead generation's stamps cannot read as live)."""
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return
+        for name in names:
+            if name.startswith(_PREFIX) or \
+                    name.startswith(f".tmp-{_PREFIX}"):
+                try:
+                    os.remove(os.path.join(self._dir, name))
+                except OSError:
+                    pass  # mxlint: disable=MX007 — racing writer re-stamps anyway

@@ -70,12 +70,17 @@ def install(signals=(getattr(_signal, "SIGTERM", None),)) -> None:
 
 
 def trigger(reason: str = "simulated") -> None:
-    """Set the flag (signal handler / chaos / tests)."""
+    """Set the flag (signal handler / chaos / tests).  The FIRST
+    trigger wins both the window time and the reason: chained SIGTERM
+    handlers (elastic wind-down chaining a previously installed
+    preemption.install handler) re-enter trigger(), and the second
+    handler's generic 'signal 15' must not overwrite the classified
+    'peer-failure: ...' reason the recovery accounting routes on."""
     import time as _time
 
     with _LOCK:
-        _REASON[0] = reason
-        if _TRIGGER_T[0] is None:  # first trigger wins: the window
+        if _TRIGGER_T[0] is None:  # first trigger wins
+            _REASON[0] = reason
             _TRIGGER_T[0] = (_time.time(), _time.monotonic())
     _FLAG.set()
 

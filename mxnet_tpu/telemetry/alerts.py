@@ -498,7 +498,10 @@ def goodput_rules(engine: AlertEngine,
       counter: fires when recovery seconds are being ADDED (a
       preemption just cost wall-clock), resolves when the growth
       stops — a raw-value rule would page forever after the first
-      preemption of the job's life."""
+      preemption of the job's life.
+    * ``rank_failure_recovery`` — same delta semantics over the
+      mxelastic category: fires while an elastic restart is costing
+      wall-clock, resolves once training is back."""
     if min_ratio is None:
         min_ratio = _env.get_float("MXNET_GOODPUT_MIN")
     engine.add_rule(
@@ -515,4 +518,13 @@ def goodput_rules(engine: AlertEngine,
         op=">", threshold=0, increase=True,
         description="preemption recovery seconds grew since the last "
                     "tick (a preemption just cost wall-clock)")
+    engine.add_rule(
+        "rank_failure_recovery", severity="warning", for_=0.0,
+        metric="mx_badput_seconds_total",
+        labels={"category": "rank_failure_recovery"},
+        op=">", threshold=0, increase=True,
+        description="rank-failure recovery seconds grew since the "
+                    "last tick (the elastic supervisor just restarted "
+                    "the job around a dead/hung rank — see "
+                    "mx_elastic_restarts_total{mode})")
     return engine

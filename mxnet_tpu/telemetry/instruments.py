@@ -511,6 +511,17 @@ _spec("mx_breaker_state", "gauge",
 _spec("mx_breaker_open_total", "counter",
       "Circuit-breaker trips (CLOSED/HALF-OPEN -> OPEN).",
       ("model", "version"))
+_spec("mx_rank_heartbeat_age_seconds", "gauge",
+      "Age of each rank's elastic heartbeat stamp at the supervisor's "
+      "last poll (resilience.heartbeat shared-dir stamp files). An age "
+      "past MXNET_ELASTIC_HEARTBEAT_TIMEOUT_S with the process alive "
+      "means the rank is hung, not dead.", ("rank",))
+_spec("mx_elastic_restarts_total", "counter",
+      "Elastic-supervisor job restarts after a rank failure, by "
+      "recovery mode ('replace' = same world size, 'shrink' = resume "
+      "onto the survivors). Growth is measured recovery, not mystery "
+      "badput — see mx_badput_seconds_total{category="
+      "'rank_failure_recovery'}.", ("mode",))
 
 
 def retry_total(site: str):
@@ -527,6 +538,14 @@ def breaker_state(model: str, version):
 
 def breaker_open_total(model: str, version):
     return _child("mx_breaker_open_total", (model, str(version)))
+
+
+def rank_heartbeat_age_seconds(rank: str):
+    return _child("mx_rank_heartbeat_age_seconds", (str(rank),))
+
+
+def elastic_restarts_total(mode: str):
+    return _child("mx_elastic_restarts_total", (mode,))
 
 
 # ---- compile cache ----------------------------------------------------

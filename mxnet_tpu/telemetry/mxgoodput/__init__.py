@@ -187,28 +187,33 @@ def on_step_entry() -> None:
         led.mark_step_entry()
 
 
-def on_preemption_trigger() -> None:
+def on_preemption_trigger(
+        category: str = "preemption_recovery") -> None:
     """Hook where the step boundary OBSERVES the preemption flag
     (AutoCheckpoint.on_step), before the sync save: opens the recovery
-    window at the trigger instant.  Never called from a signal
-    handler."""
+    window at the trigger instant.  ``category`` routes the window —
+    ``rank_failure_recovery`` when the trigger was an elastic
+    peer-failure wind-down rather than a genuine preemption.  Never
+    called from a signal handler."""
     if not _ACTIVE:
         return
     from ...resilience import preemption as _preemption
 
     t = _preemption.trigger_time()
-    ledger().open_recovery(t0_mono=t[1] if t else None)
+    ledger().open_recovery(t0_mono=t[1] if t else None,
+                           category=category)
 
 
-def on_preemption_resume(t_unix: Optional[float] = None) -> None:
+def on_preemption_resume(t_unix: Optional[float] = None,
+                         category: str = "preemption_recovery") -> None:
     """Hook in ``AutoCheckpoint.resume`` when the restored checkpoint
-    was a preemption save: opens the recovery window (idempotent when
-    the trigger already opened it in-process).  ``t_unix`` is the
-    trigger time persisted in the checkpoint meta — a fresh process
-    extends its wall back to it so the downtime is measured, not
-    forgotten."""
+    was a preemption (or elastic peer-failure) save: opens the
+    recovery window (idempotent when the trigger already opened it
+    in-process).  ``t_unix`` is the trigger time persisted in the
+    checkpoint meta — a fresh process extends its wall back to it so
+    the downtime is measured, not forgotten."""
     if _ACTIVE:
-        ledger().open_recovery(t0_unix=t_unix)
+        ledger().open_recovery(t0_unix=t_unix, category=category)
 
 
 if _env.get_bool("MXNET_GOODPUT"):

@@ -69,7 +69,8 @@ __all__ = ["CATEGORIES", "GoodputLedger"]
 #: ``unattributed`` is computed at snapshot time, never fed)
 CATEGORIES = (
     "compile", "data_wait", "checkpoint_save", "checkpoint_restore",
-    "preemption_recovery", "retry_backoff", "comm_stall",
+    "preemption_recovery", "rank_failure_recovery", "retry_backoff",
+    "comm_stall",
 )
 
 # comm half of a step record, mirroring the roofline split in
@@ -183,13 +184,22 @@ class GoodputLedger:
                 + self._badput["retry_backoff"])
 
     def open_recovery(self, t0_mono: Optional[float] = None,
-                      t0_unix: Optional[float] = None) -> None:
-        """Open the preemption-recovery window.  ``t0_mono`` is the
-        trigger instant on this process's monotonic clock; a resume in
-        a FRESH process passes ``t0_unix`` (the trigger time persisted
-        in the checkpoint meta) and the window — and the job wall —
-        extend back to it: the downtime between the preempted process
-        and this one is exactly what the category exists to expose."""
+                      t0_unix: Optional[float] = None,
+                      category: str = "preemption_recovery") -> None:
+        """Open a recovery window.  ``t0_mono`` is the trigger instant
+        on this process's monotonic clock; a resume in a FRESH process
+        passes ``t0_unix`` (the trigger time persisted in the
+        checkpoint meta) and the window — and the job wall — extend
+        back to it: the downtime between the preempted process and
+        this one is exactly what the category exists to expose.
+        ``category`` names where the window's seconds land:
+        ``preemption_recovery`` (the default) or
+        ``rank_failure_recovery`` (mxelastic — a peer died/hung and
+        the job restarted around it)."""
+        if category not in ("preemption_recovery",
+                            "rank_failure_recovery"):
+            raise ValueError(
+                f"unknown recovery category {category!r}")
         now = self._clock()
         with self._lock:
             if self._recovery is not None:
@@ -212,7 +222,7 @@ class GoodputLedger:
                 self._t0 = t0
                 self._t0_unix = min(self._t0_unix,
                                     t0_unix or self._t0_unix)
-            self._recovery = {"t0": t0,
+            self._recovery = {"t0": t0, "cat": category,
                               "mark": self._recovery_mark_locked()}
 
     def mark_step_entry(self) -> None:
@@ -235,9 +245,11 @@ class GoodputLedger:
         the recovery seconds attributed."""
         now = self._clock() if end_mono is None else end_mono
         with self._lock:
-            before = self._badput["preemption_recovery"]
+            cat = self._recovery["cat"] if self._recovery is not None \
+                else "preemption_recovery"
+            before = self._badput[cat]
             self._close_recovery_locked(now)
-            return self._badput["preemption_recovery"] - before
+            return self._badput[cat] - before
 
     def recovery_open(self) -> bool:
         with self._lock:
@@ -295,13 +307,14 @@ class GoodputLedger:
         if win is None:
             return
         self._recovery = None
+        cat = win.get("cat", "preemption_recovery")
         already = self._recovery_mark_locked() - win["mark"]
         s = max(0.0, (end_mono - win["t0"]) - max(0.0, already))
         if s:
-            self._badput["preemption_recovery"] += s
+            self._badput[cat] += s
             # counter bump under the lock is fine here: instruments'
             # RLock never calls back into the ledger
-            _ins.badput_seconds_total("preemption_recovery").inc(s)
+            _ins.badput_seconds_total(cat).inc(s)
 
     def _consume_one_locked(self, rec: dict) -> None:
         wall = max(0.0, float(rec.get("wall_s") or 0.0))

@@ -302,6 +302,46 @@ declare("MXNET_CKPT_EVERY", int, 0,
 declare("MXNET_CKPT_KEEP", int, 3,
         "Auto-checkpoint retention: keep the last K step directories, "
         "prune older ones after each successful save.")
+declare("MXNET_ELASTIC", bool, False,
+        "Set by the elastic supervisor (tools/elastic_run.py) in every "
+        "worker's env: this process runs under coordinated rank-failure "
+        "recovery (heartbeats, reserved exit codes, commit-marker "
+        "resume). Never set by hand; off = zero elastic code on the "
+        "step path. See docs/resilience.md (Elastic recovery).")
+declare("MXNET_ELASTIC_DIR", str, "",
+        "Shared coordination directory of an elastic job: per-rank "
+        "heartbeat stamps (hb-rank<k>.json), per-rank checkpoint "
+        "subdirs (rank<k>/step-N), the job-level COMMIT.json resume "
+        "marker, and per-generation worker logs. Exported by the "
+        "supervisor.")
+declare("MXNET_ELASTIC_RANK", int, None,
+        "This worker's job rank, exported by the elastic supervisor "
+        "(also what chaos rank= plan selectors match against). Default "
+        "is dynamic: unset outside an elastic job.")
+declare("MXNET_ELASTIC_WORLD", int, None,
+        "The elastic job's current world size (shrink-mode restarts "
+        "re-export a smaller value). Default is dynamic: unset outside "
+        "an elastic job.")
+declare("MXNET_ELASTIC_HEARTBEAT_S", float, 2.0,
+        "Interval of the background heartbeat thread "
+        "(resilience.heartbeat.HeartbeatWriter.start()); per-step "
+        "beat() calls ignore it.")
+declare("MXNET_ELASTIC_HEARTBEAT_TIMEOUT_S", float, 30.0,
+        "Heartbeat age past which the supervisor declares a "
+        "still-running rank HUNG and opens a failure epoch. Also the "
+        "default MXNET_KVSTORE_TIMEOUT the supervisor exports so "
+        "survivors' collective watchdogs fire instead of waiting "
+        "forever on the dead peer.")
+declare("MXNET_ELASTIC_MAX_RESTARTS", int, 3,
+        "Restart budget of the elastic supervisor: failure epochs "
+        "beyond this declare the job dead instead of thrashing "
+        "restarts against a persistent fault.")
+declare("MXNET_ELASTIC_GRACE_S", float, 30.0,
+        "Seconds the supervisor waits after SIGTERMing survivors for "
+        "them to cut their sync checkpoint and exit with a reserved "
+        "rc; anything still alive is SIGKILLed and classified hung. "
+        "Raised automatically to the collective watchdog timeout + 5s "
+        "when that is longer.")
 declare("MXNET_DRAIN_TIMEOUT_MS", float, 30000.0,
         "Hard deadline for InferenceServer.shutdown(drain=True): past "
         "it, still-queued requests fail with ServerClosed instead of "

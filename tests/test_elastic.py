@@ -1,0 +1,899 @@
+"""mxelastic (ISSUE 15): rank-failure detection, coordinated
+shrink/replace recovery, and the job supervisor.
+
+Fast tier-1 coverage: the chaos ``rank=`` selector, heartbeat stamps,
+the job-level commit marker (resume can never mix steps across
+ranks), the ``PeerFailed`` classification of dist watchdog timeouts /
+dead-peer connection errors (non-transient in-process, reserved-rc at
+the supervisor boundary), AutoCheckpoint crash-consistency (fsync'd
+rename commit), and the mxgoodput ``rank_failure_recovery`` routing.
+
+Slow (nightly elastic stage): the REAL multi-process e2e — a chaos
+plan kills exactly one rank mid-training and the supervisor recovers
+onto the survivors with loss parity vs an uninterrupted twin — and
+the kill-9-mid-async-write crash-consistency proof.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, resilience
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import chaos, elastic, heartbeat, preemption
+from mxnet_tpu.resilience.elastic import (RC_PEER_FAILED, RC_WINDDOWN,
+                                          PeerFailed)
+from mxnet_tpu.resilience.retry import RetryPolicy, is_transient
+from mxnet_tpu.telemetry import instruments as _ins
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    chaos.reset_stats()
+    chaos.set_rank(None)
+    preemption.clear()
+    yield
+    chaos.set_rank(None)
+    preemption.clear()
+
+
+def _make_net(prefix, seed=3):
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    net = nn.Dense(4, in_units=6, prefix=prefix)
+    net.initialize(ctx=mx.cpu())
+    return net
+
+
+def _trainer(net):
+    return mx.gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+
+
+def _one_step(net, tr, seed=0):
+    rng = np.random.RandomState(seed)
+    xb = nd.array(rng.rand(8, 6).astype("f4"), ctx=mx.cpu())
+    yb = nd.array(rng.rand(8, 4).astype("f4"), ctx=mx.cpu())
+    with autograd.record():
+        loss = ((net(xb) - yb) ** 2).sum()
+    loss.backward()
+    tr.step(8)
+
+
+# ---------------------------------------------------------------------------
+# chaos rank= selector (satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestChaosRankSelector:
+    def test_rank_selected_plan_fires_only_on_its_rank(self):
+        chaos.set_rank(0)
+        with chaos.inject("t.rank", at=1, rank=1, action="error") as sc:
+            assert chaos.check("t.rank") is None  # rank 0: no fire
+            assert sc.fired == 0
+        chaos.set_rank(1)
+        with chaos.inject("t.rank", at=1, rank=1, action="error") as sc:
+            with pytest.raises(chaos.FaultInjected):
+                chaos.check("t.rank")
+            assert sc.fired == 1
+
+    def test_unresolvable_rank_never_fires(self, monkeypatch):
+        for name in ("MXNET_ELASTIC_RANK", "DMLC_WORKER_ID",
+                     "PROCESS_ID"):
+            monkeypatch.delenv(name, raising=False)
+        chaos.set_rank(None)
+        with chaos.inject("t.norank", at=1, rank=2):
+            assert chaos.check("t.norank") is None
+
+    def test_rank_resolves_from_launcher_env(self, monkeypatch):
+        chaos.set_rank(None)
+        monkeypatch.setenv("MXNET_ELASTIC_RANK", "2")
+        with chaos.inject("t.envrank", at=1, rank=2, action="error"):
+            with pytest.raises(chaos.FaultInjected):
+                chaos.check("t.envrank")
+
+    def test_spec_grammar_rank_and_hang_duration(self):
+        plans = chaos._parse_spec(
+            "elastic.worker@4:die:rank=1,dist.collective@x2:hang=3.5",
+            seed=0)
+        p0, p1 = plans
+        assert (p0.kind, p0.at, p0.action, p0.rank) == \
+            ("elastic.worker", 4, "die", 1)
+        assert (p1.kind, p1.times, p1.action, p1.duration,
+                p1.rank) == ("dist.collective", 2, "hang", 3.5, None)
+
+    def test_rank_survives_spawn_transport(self):
+        with chaos.inject("t.ship", at=2, rank=3, action="error"):
+            specs = chaos.export_plans("t.ship")
+        assert specs[0]["rank"] == 3
+        chaos.install_plans(specs)
+        try:
+            chaos.set_rank(3)
+            assert chaos.check("t.ship") is None   # call #1
+            with pytest.raises(chaos.FaultInjected):
+                chaos.check("t.ship")              # call #2
+        finally:
+            with chaos._LOCK:
+                chaos._PLANS.clear()
+                chaos._recompute_active_locked()
+
+    def test_default_action_for_elastic_worker_is_die(self):
+        plans = chaos._parse_spec("elastic.worker@1:rank=0", seed=0)
+        assert plans[0].action == "die"
+        assert plans[0].rank == 0
+
+
+# ---------------------------------------------------------------------------
+# heartbeats
+# ---------------------------------------------------------------------------
+
+class TestHeartbeat:
+    def test_beat_monitor_and_gauge(self, tmp_path):
+        w = heartbeat.HeartbeatWriter(str(tmp_path), rank=1)
+        w.beat(step=3)
+        mon = heartbeat.HeartbeatMonitor(str(tmp_path))
+        stamps = mon.read()
+        assert stamps[1]["step"] == 3
+        assert stamps[1]["pid"] == os.getpid()
+        assert stamps[1]["age_s"] < 5.0
+        assert _ins.rank_heartbeat_age_seconds("1").value < 5.0
+        assert mon.stale(timeout_s=10.0) == []
+        assert mon.max_step() == 3
+
+    def test_stale_detection_on_aged_stamp(self, tmp_path):
+        heartbeat.HeartbeatWriter(str(tmp_path), rank=0).beat(step=1)
+        heartbeat.HeartbeatWriter(str(tmp_path), rank=1).beat(step=1)
+        old = time.time() - 120.0
+        os.utime(os.path.join(str(tmp_path), heartbeat.stamp_name(1)),
+                 (old, old))
+        mon = heartbeat.HeartbeatMonitor(str(tmp_path))
+        assert mon.stale(timeout_s=30.0) == [1]
+        # restricted to a rank subset (the supervisor passes the alive
+        # set: an exited rank's stale stamp is not a NEW failure)
+        assert mon.stale(timeout_s=30.0, ranks=[0]) == []
+
+    def test_clear_removes_stamps(self, tmp_path):
+        heartbeat.HeartbeatWriter(str(tmp_path), rank=0).beat()
+        mon = heartbeat.HeartbeatMonitor(str(tmp_path))
+        assert mon.read()
+        mon.clear()
+        assert mon.read() == {}
+
+    def test_background_writer_stamps_and_stops(self, tmp_path):
+        w = heartbeat.HeartbeatWriter(str(tmp_path), rank=2,
+                                      interval_s=0.05)
+        w.start()
+        try:
+            time.sleep(0.2)
+        finally:
+            w.stop()
+        stamps = heartbeat.HeartbeatMonitor(str(tmp_path)).read()
+        assert 2 in stamps and stamps[2]["age_s"] < 5.0
+
+
+# ---------------------------------------------------------------------------
+# the job-level commit marker
+# ---------------------------------------------------------------------------
+
+def _fake_ckpt(root, rank, step, complete=True, tmp=False):
+    name = f"step-{step:08d}"
+    if tmp:
+        name = ".tmp-" + name
+    d = os.path.join(root, f"rank{rank}", name)
+    os.makedirs(d)
+    files = ("meta.json", "params.npz", "trainer.states")
+    for f in files if complete else files[:1]:
+        with open(os.path.join(d, f), "w") as fh:
+            fh.write("{}")
+    return d
+
+
+class TestCommitMarker:
+    def test_elects_highest_complete_step_across_ranks(self, tmp_path):
+        root = str(tmp_path)
+        _fake_ckpt(root, 0, 2)
+        _fake_ckpt(root, 1, 4)
+        _fake_ckpt(root, 1, 6, tmp=True)        # interrupted write
+        _fake_ckpt(root, 0, 8, complete=False)  # torn dir
+        commit = elastic.elect_commit(root, epoch=1, failed_ranks=[0])
+        assert commit["step"] == 4
+        assert commit["source_rank"] == 1
+        assert commit["failed_ranks"] == [0]
+        got = elastic.read_commit(root)
+        assert got["step"] == 4 and got["cause"] == "rank_failure"
+        path = elastic.committed_resume_path(root)
+        assert path and path.endswith(
+            os.path.join("rank1", "step-00000004"))
+
+    def test_no_checkpoint_yet_commits_fresh_start(self, tmp_path):
+        commit = elastic.elect_commit(str(tmp_path))
+        assert commit["step"] == 0 and commit["path"] is None
+        assert elastic.committed_resume_path(str(tmp_path)) is None
+
+    def test_commit_marker_write_is_fsynced(self, tmp_path,
+                                            monkeypatch):
+        """COMMIT.json holds the same crash-consistency bar as the
+        checkpoints it elects: payload fsync before the rename,
+        parent-dir fsync after it."""
+        dir_syncs = []
+        real_dir = resilience.AutoCheckpoint._fsync_dir
+        monkeypatch.setattr(
+            resilience.AutoCheckpoint, "_fsync_dir",
+            staticmethod(lambda p: (dir_syncs.append(p),
+                                    real_dir(p))[1]))
+        synced = []
+        real_fsync = os.fsync
+        monkeypatch.setattr(os, "fsync",
+                            lambda fd: (synced.append(fd),
+                                        real_fsync(fd))[1])
+        _fake_ckpt(str(tmp_path), 0, 2)
+        elastic.elect_commit(str(tmp_path))
+        assert synced          # the payload write fsynced
+        assert dir_syncs[-1] == str(tmp_path)  # the rename committed
+
+    def test_resume_explicit_path_pins_the_committed_step(self,
+                                                          tmp_path):
+        """Two ranks with diverged cadences resume from the SAME
+        elected step dir — the no-mixed-steps contract."""
+        net_a = _make_net("cm_a_")
+        tr_a = _trainer(net_a)
+        ck_a = resilience.AutoCheckpoint(
+            str(tmp_path / "rank0"), tr_a, async_save=False)
+        _one_step(net_a, tr_a, seed=0)
+        ck_a.step = 2
+        ck_a.save(sync=True)
+        _one_step(net_a, tr_a, seed=1)
+        ck_a.step = 4
+        ck_a.save(sync=True)
+        commit = elastic.elect_commit(str(tmp_path))
+        assert commit["step"] == 4
+        # a fresh trainer resumes from the COMMITTED dir even though
+        # its own rank dir holds nothing
+        net_b = _make_net("cm_a_", seed=99)
+        tr_b = _trainer(net_b)
+        ck_b = resilience.AutoCheckpoint(
+            str(tmp_path / "rank1"), tr_b, async_save=False)
+        meta = ck_b.resume(
+            path=elastic.committed_resume_path(str(tmp_path)))
+        assert meta["step"] == 4 and ck_b.step == 4
+        for p_a, p_b in zip(net_a.collect_params().values(),
+                            net_b.collect_params().values()):
+            np.testing.assert_array_equal(
+                p_a.list_data()[0].asnumpy(),
+                p_b.list_data()[0].asnumpy())
+
+
+# ---------------------------------------------------------------------------
+# PeerFailed classification (satellite 4): watchdog timeout, poisoned
+# sequence, dead-peer connection error — each path non-transient
+# in-process, reserved-rc at the supervisor boundary
+# ---------------------------------------------------------------------------
+
+class TestPeerFailedClassification:
+    def test_watchdog_timeout_raises_peerfailed_nontransient(
+            self, monkeypatch):
+        from mxnet_tpu.parallel import dist
+
+        monkeypatch.setattr(dist, "_POISONED", None)
+        with pytest.raises(PeerFailed, match="timed out") as ei:
+            dist._run_with_watchdog(lambda: time.sleep(5.0), 0.2, "t")
+        assert ei.value.poisoned is False
+        assert not is_transient(ei.value)
+        monkeypatch.setattr(dist, "_POISONED", None)
+
+    def test_poisoned_sequence_refusal_is_peerfailed(self, monkeypatch):
+        from mxnet_tpu.parallel import dist
+
+        monkeypatch.setattr(dist, "_POISONED", "earlier")
+        with pytest.raises(PeerFailed, match="refused") as ei:
+            dist._run_with_watchdog(lambda: 1, 0.2, "t2")
+        assert ei.value.poisoned is True
+        assert not is_transient(ei.value)
+        monkeypatch.setattr(dist, "_POISONED", None)
+
+    def test_dead_peer_connection_error_classified_and_poisons(
+            self, monkeypatch):
+        """gloo raises (not hangs) when the peer socket tears down —
+        the same classification must come out of the error path."""
+        from mxnet_tpu.parallel import dist
+
+        monkeypatch.setattr(dist, "_POISONED", None)
+
+        def torn():
+            raise ValueError(
+                "UNKNOWN: Gloo all-reduce failed: Read error "
+                "[127.0.0.1]:7575: Connection reset by peer")
+
+        with pytest.raises(PeerFailed, match="peer connection lost"):
+            dist._run_with_watchdog(torn, 5.0, "allreduce")
+        assert dist._POISONED == "allreduce"
+        # and an ordinary error is NOT misclassified
+        monkeypatch.setattr(dist, "_POISONED", None)
+
+        def plain():
+            raise ValueError("shape mismatch")
+
+        with pytest.raises(ValueError, match="shape mismatch"):
+            dist._run_with_watchdog(plain, 5.0, "allreduce")
+        assert dist._POISONED is None
+
+    def test_peerfailed_never_retried(self, monkeypatch):
+        attempts = []
+
+        def fn():
+            attempts.append(1)
+            raise PeerFailed("peer gone", what="barrier")
+
+        with pytest.raises(PeerFailed):
+            RetryPolicy(max_attempts=5).call(fn, site="t.peer")
+        assert len(attempts) == 1  # non-transient: no second attempt
+
+    def test_peerfailed_pickles_with_flags(self):
+        import pickle
+
+        e = pickle.loads(pickle.dumps(
+            PeerFailed("m", what="allgather", poisoned=True)))
+        assert e.what == "allgather" and e.poisoned is True
+
+
+# ---------------------------------------------------------------------------
+# the worker guard: reserved rc contract
+# ---------------------------------------------------------------------------
+
+class TestWorkerGuard:
+    def test_peerfailed_cuts_checkpoint_and_exits_43(self, tmp_path):
+        net = _make_net("gd_a_")
+        tr = _trainer(net)
+        ck = resilience.AutoCheckpoint(str(tmp_path), tr,
+                                       async_save=False)
+        _one_step(net, tr)
+        ck.step = 3
+        codes = []
+        with elastic.guard(auto_ckpt=ck, exit_fn=codes.append):
+            raise PeerFailed("peer gone", what="allreduce")
+        assert codes == [RC_PEER_FAILED]
+        path = resilience.latest_step_dir(str(tmp_path))
+        assert path.endswith("step-00000003")
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        assert meta["preempt"]["kind"] == "peer_failure"
+        assert meta["preempt"]["reason"].startswith("peer-failure")
+
+    def test_preempted_winddown_exits_44(self):
+        codes = []
+        with elastic.guard(exit_fn=codes.append):
+            raise preemption.Preempted("winddown")
+        assert codes == [RC_WINDDOWN]
+
+    def test_checkpoint_failure_still_exits_reserved_rc(self, capsys):
+        class _Boom:
+            def stamp_failure(self, *a, **kw):
+                raise OSError("disk gone")
+
+            def save(self, **kw):
+                raise OSError("disk gone")
+
+        codes = []
+        with elastic.guard(auto_ckpt=_Boom(), exit_fn=codes.append):
+            raise PeerFailed("peer gone")
+        assert codes == [RC_PEER_FAILED]
+        assert "checkpoint failed" in capsys.readouterr().err
+
+    def test_clean_exit_passes_through(self):
+        codes = []
+        with elastic.guard(exit_fn=codes.append):
+            pass
+        assert codes == []
+
+
+# ---------------------------------------------------------------------------
+# AutoCheckpoint crash-consistency (satellite 2, fast half)
+# ---------------------------------------------------------------------------
+
+class TestCrashConsistency:
+    def test_rename_commit_is_fsynced(self, tmp_path, monkeypatch):
+        """Every file fsyncs before the rename, and the PARENT DIR
+        fsyncs after it — without the latter a kill -9 can lose the
+        rename itself."""
+        net = _make_net("fs_a_")
+        tr = _trainer(net)
+        ck = resilience.AutoCheckpoint(str(tmp_path), tr,
+                                       async_save=False)
+        _one_step(net, tr)
+        synced = []
+        real_fsync = os.fsync
+        monkeypatch.setattr(os, "fsync",
+                            lambda fd: (synced.append(fd),
+                                        real_fsync(fd))[1])
+        dir_syncs = []
+        real_dir = resilience.AutoCheckpoint._fsync_dir
+
+        def spy_dir(path):
+            dir_syncs.append(path)
+            real_dir(path)
+
+        monkeypatch.setattr(resilience.AutoCheckpoint, "_fsync_dir",
+                            staticmethod(spy_dir))
+        ck.step = 1
+        ck.save(sync=True)
+        assert len(synced) >= 3  # params.npz, trainer.states, meta.json
+        # tmp dir before the rename, parent dir after it
+        assert dir_syncs[-1] == str(tmp_path)
+        assert dir_syncs[-2].endswith(".tmp-step-00000001")
+
+    def test_resave_never_destroys_the_complete_copy(self, tmp_path,
+                                                     monkeypatch):
+        """Re-saving an existing step (the elastic guard re-saving the
+        cadence step) must keep a COMPLETE copy on disk at every
+        instant: the old dir is renamed aside, never rmtree'd before
+        the new one commits — a SIGKILL mid-re-save can cost the
+        rename, not the checkpoint."""
+        net = _make_net("rs_a_")
+        tr = _trainer(net)
+        ck = resilience.AutoCheckpoint(str(tmp_path), tr,
+                                       async_save=False)
+        _one_step(net, tr)
+        ck.step = 2
+        ck.save(sync=True)
+        # fail the COMMIT rename persistently (every retry attempt),
+        # after the old dir was renamed aside: the complete copy must
+        # survive as .old- instead of having been rmtree'd up front
+        real_replace = os.replace
+
+        def crashy(src, dst):
+            if ".tmp-step-00000002" in src and \
+                    dst.endswith("step-00000002"):
+                raise OSError("commit rename dies")
+            real_replace(src, dst)
+
+        monkeypatch.setattr(os, "replace", crashy)
+        ck.step = 2
+        with pytest.raises(Exception):
+            ck.save(sync=True)
+        monkeypatch.setattr(os, "replace", real_replace)
+        old = os.path.join(str(tmp_path), ".old-step-00000002")
+        assert all(os.path.exists(os.path.join(old, f)) for f in
+                   ("meta.json", "params.npz", "trainer.states"))
+        # a later healthy save sweeps the residue and recommits
+        ck.step = 2
+        ck.save(sync=True)
+        names = os.listdir(str(tmp_path))
+        assert "step-00000002" in names
+        assert not any(n.startswith(".old-") for n in names)
+
+    def test_winddown_reason_survives_chained_preemption_handler(
+            self, monkeypatch):
+        """A worker that installed preemption.install() BEFORE
+        elastic.install_winddown(): the chained handler re-triggers
+        with 'signal 15', which must NOT overwrite the classified
+        peer-failure reason (first trigger wins) — otherwise the
+        recovery window routes to the wrong goodput category."""
+        prev = signal.getsignal(signal.SIGTERM)
+        try:
+            # stand-in for a previously installed preemption handler
+            signal.signal(signal.SIGTERM,
+                          lambda s, f: preemption.trigger(
+                              reason=f"signal {s}"))
+            elastic.install_winddown()
+            handler = signal.getsignal(signal.SIGTERM)
+            handler(signal.SIGTERM, None)  # deliver without os.kill
+            assert preemption.triggered()
+            assert preemption.reason().startswith("peer-failure")
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+            preemption.clear()
+
+    @pytest.mark.slow
+    def test_kill9_mid_async_write_falls_back_to_previous_step(
+            self, tmp_path):
+        """A hard kill (not graceful preemption) mid-async-write must
+        leave the previous COMPLETE step dir as the resume point: the
+        interrupted write stays a ``.tmp-`` dir resume ignores."""
+        child = f"""
+import os, sys, time
+sys.path.insert(0, {_REPO!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, resilience
+from mxnet_tpu.gluon import nn
+
+np.random.seed(3); mx.random.seed(3)
+net = nn.Dense(4, in_units=6, prefix="k9_")
+net.initialize(ctx=mx.cpu())
+tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                      {{"learning_rate": 0.05, "momentum": 0.9}})
+rng = np.random.RandomState(0)
+xb = nd.array(rng.rand(8, 6).astype("f4")); yb = nd.array(rng.rand(8, 4).astype("f4"))
+with autograd.record():
+    loss = ((net(xb) - yb) ** 2).sum()
+loss.backward(); tr.step(8)
+ck = resilience.AutoCheckpoint({str(tmp_path)!r}, tr)
+ck.step = 1
+ck.save(sync=True)               # the complete fallback checkpoint
+real = resilience.AutoCheckpoint._write_file
+def slow(path, data, mode="wb"):
+    if path.endswith("trainer.states"):
+        print("MID_WRITE", flush=True)
+        time.sleep(60)           # parent SIGKILLs inside this window
+    real(path, data, mode)
+resilience.AutoCheckpoint._write_file = staticmethod(slow)
+ck.step = 2
+ck.save(sync=False)              # async: the daemon writer stalls
+print("QUEUED", flush=True)
+time.sleep(120)
+"""
+        p = subprocess.Popen([sys.executable, "-c", child],
+                             stdout=subprocess.PIPE, text=True,
+                             env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        try:
+            deadline = time.time() + 120
+            saw_mid = False
+            while time.time() < deadline:
+                line = p.stdout.readline()
+                if "MID_WRITE" in line:
+                    saw_mid = True
+                    break
+            assert saw_mid, "writer never reached the params write"
+            time.sleep(0.2)  # let it be truly mid-write
+            p.send_signal(signal.SIGKILL)
+            p.wait(timeout=30)
+        finally:
+            if p.poll() is None:
+                p.kill()
+        # the interrupted step-2 write is .tmp- junk; step-1 stands
+        names = sorted(os.listdir(str(tmp_path)))
+        assert any(n.startswith(".tmp-step-00000002") for n in names)
+        assert resilience.latest_step_dir(
+            str(tmp_path)).endswith("step-00000001")
+        net2 = _make_net("k9_", seed=99)
+        tr2 = _trainer(net2)
+        ck2 = resilience.AutoCheckpoint(str(tmp_path), tr2)
+        meta = ck2.resume()
+        assert meta["step"] == 1
+
+
+# ---------------------------------------------------------------------------
+# mxgoodput: rank_failure_recovery routing
+# ---------------------------------------------------------------------------
+
+class TestRankFailureGoodput:
+    def test_ledger_routes_category(self):
+        from mxnet_tpu.telemetry.mxgoodput.ledger import GoodputLedger
+
+        led = GoodputLedger()
+        led.open_recovery(category="rank_failure_recovery")
+        time.sleep(0.03)
+        got = led.close_recovery()
+        assert got > 0
+        assert led.category_seconds("rank_failure_recovery") \
+            == pytest.approx(got)
+        assert led.category_seconds("preemption_recovery") == 0.0
+        with pytest.raises(ValueError):
+            led.open_recovery(category="not_a_category")
+
+    def test_peer_failure_resume_opens_rank_failure_window(
+            self, tmp_path):
+        """A peer-failure checkpoint (the guard's sync save) resumed
+        in a FRESH process opens the recovery window into
+        rank_failure_recovery, not preemption_recovery."""
+        from mxnet_tpu.telemetry import mxgoodput
+
+        net = _make_net("rf_a_")
+        tr = _trainer(net)
+        ck = resilience.AutoCheckpoint(str(tmp_path), tr,
+                                       async_save=False)
+        _one_step(net, tr)
+        ck.step = 2
+        ck.stamp_failure("peer-failure: collective 'allreduce' timed "
+                         "out")
+        ck.save(sync=True)
+        net2 = _make_net("rf_a_", seed=99)
+        tr2 = _trainer(net2)
+        ck2 = resilience.AutoCheckpoint(str(tmp_path), tr2)
+        mxgoodput.enable(fresh=True)
+        try:
+            ck2.resume()
+            led = mxgoodput.ledger()
+            assert led.recovery_open()
+            got = led.close_recovery()
+            assert got >= 0.0
+            assert led.category_seconds("rank_failure_recovery") \
+                == pytest.approx(got)
+            assert led.category_seconds("preemption_recovery") == 0.0
+        finally:
+            mxgoodput.disable()
+
+    def test_plain_preemption_still_lands_in_preemption_recovery(
+            self, tmp_path):
+        from mxnet_tpu.telemetry import mxgoodput
+
+        net = _make_net("pp_a_")
+        tr = _trainer(net)
+        ck = resilience.AutoCheckpoint(str(tmp_path), tr,
+                                       async_save=False)
+        _one_step(net, tr)
+        ck.step = 2
+        ck.stamp_failure("signal 15", kind="preempt")
+        ck.save(sync=True)
+        net2 = _make_net("pp_a_", seed=98)
+        tr2 = _trainer(net2)
+        ck2 = resilience.AutoCheckpoint(str(tmp_path), tr2)
+        mxgoodput.enable(fresh=True)
+        try:
+            ck2.resume()
+            led = mxgoodput.ledger()
+            got = led.close_recovery()
+            assert led.category_seconds("preemption_recovery") \
+                == pytest.approx(got)
+            assert led.category_seconds("rank_failure_recovery") == 0.0
+        finally:
+            mxgoodput.disable()
+
+
+# ---------------------------------------------------------------------------
+# worker runtime + disabled-path cost
+# ---------------------------------------------------------------------------
+
+class TestWorkerRuntime:
+    def test_worker_context_beats_and_probes_chaos(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setenv("MXNET_ELASTIC", "1")
+        monkeypatch.setenv("MXNET_ELASTIC_DIR", str(tmp_path))
+        monkeypatch.setenv("MXNET_ELASTIC_RANK", "0")
+        assert elastic.enabled()
+        wc = elastic.WorkerContext()
+        wc.on_step(5)
+        stamps = heartbeat.HeartbeatMonitor(str(tmp_path)).read()
+        assert stamps[0]["step"] == 5
+        # the chaos probe is live at the site (error action raises
+        # in-place; `die` would hard-exit and is covered by the e2e)
+        with chaos.inject("elastic.worker", at=1, action="error"):
+            with pytest.raises(chaos.FaultInjected):
+                wc.on_step(6)
+
+    def test_worker_context_requires_the_env_contract(self,
+                                                      monkeypatch):
+        for name in ("MXNET_ELASTIC_DIR", "MXNET_ELASTIC_RANK"):
+            monkeypatch.delenv(name, raising=False)
+        with pytest.raises(mx.base.MXNetError):
+            elastic.WorkerContext()
+
+    def test_startup_wedge_without_first_heartbeat_is_detected(
+            self, tmp_path):
+        """A rank that hangs BEFORE its first beat has no exit code
+        and no stamp to age — the startup-timeout bound must classify
+        it hung instead of the supervisor spinning forever."""
+        sup = elastic.Supervisor(
+            [sys.executable, "-c", "import time; time.sleep(600)"],
+            world=1, directory=str(tmp_path), max_restarts=0,
+            hb_timeout_s=1.0, startup_timeout_s=1.0, grace_s=0.5,
+            poll_s=0.1)
+        t0 = time.time()
+        report = sup.run()
+        assert time.time() - t0 < 30
+        assert report["ok"] is False
+        assert report["epochs"][0]["failed_ranks"] == [0]
+        assert "budget" in report["error"]
+        # the private detection stamp never leaks into the report
+        assert all("_t_detect" not in e for e in report["epochs"])
+
+    def test_shrink_keeps_world_when_no_failed_rank_identified(
+            self, tmp_path, monkeypatch):
+        """An epoch where every rank exited a reserved rc (spurious
+        watchdog: one rank 43, peers 44, nobody SIGKILLed) names no
+        failed rank — shrink mode must restart at FULL size instead of
+        discarding a healthy machine."""
+        sup = elastic.Supervisor(
+            ["true"], world=2, directory=str(tmp_path), mode="shrink",
+            max_restarts=2, hb_timeout_s=1.0, grace_s=0.5, poll_s=0.05)
+        spawned = []
+        monkeypatch.setattr(sup, "_spawn",
+                            lambda gen, n: (spawned.append(n), [])[1])
+        results = [{"ok": False, "failed": [], "rcs": {0: 43, 1: 44},
+                    "t_detect": 0.0, "t_first_step": None, "tails": {}},
+                   {"ok": True, "t_first_step": None}]
+        monkeypatch.setattr(sup, "_watch",
+                            lambda *a, **kw: results.pop(0))
+        rep = sup.run()
+        assert rep["ok"] and spawned == [2, 2]  # world never shrank
+        assert rep["epochs"][0]["world_after"] == 2
+        # and a NAMED failure still shrinks
+        sup2 = elastic.Supervisor(
+            ["true"], world=2, directory=str(tmp_path), mode="shrink",
+            max_restarts=2, hb_timeout_s=1.0, grace_s=0.5, poll_s=0.05)
+        spawned2 = []
+        monkeypatch.setattr(sup2, "_spawn",
+                            lambda gen, n: (spawned2.append(n), [])[1])
+        results2 = [{"ok": False, "failed": [1], "rcs": {0: 43, 1: 1},
+                     "t_detect": 0.0, "t_first_step": None,
+                     "tails": {}},
+                    {"ok": True, "t_first_step": None}]
+        monkeypatch.setattr(sup2, "_watch",
+                            lambda *a, **kw: results2.pop(0))
+        rep2 = sup2.run()
+        assert rep2["ok"] and spawned2 == [2, 1]
+
+    def test_bench_cell_timeout_fails_cell_not_bench(self, monkeypatch,
+                                                     tmp_path):
+        """A wedged supervised job must fail ITS matrix cell (and
+        leave no orphaned process group), never crash the bench before
+        RESILIENCE.json is written."""
+        import importlib.util
+        import subprocess as sp
+
+        spec = importlib.util.spec_from_file_location(
+            "bench_resilience_under_test",
+            os.path.join(_REPO, "tools", "bench_resilience.py"))
+        br = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(br)
+
+        # a real sacrificial process group stands in for the wedged
+        # supervisor+workers: the timeout path must kill the GROUP
+        sac = sp.Popen([sys.executable, "-c",
+                        "import time; time.sleep(300)"],
+                       start_new_session=True)
+        calls = []
+
+        class _Wedged:
+            pid = sac.pid
+            returncode = None
+
+            def communicate(self, timeout=None):
+                if not calls:
+                    calls.append(1)
+                    raise sp.TimeoutExpired("elastic_run", timeout)
+                return ("", "")
+
+        monkeypatch.setattr(sp, "Popen", lambda *a, **kw: _Wedged())
+        try:
+            row = br._run_elastic("replace", "", timeout=1.0)
+        finally:
+            monkeypatch.undo()
+        assert row["ok"] is False and "timed out" in row["error"]
+        sac.wait(timeout=10)
+        assert sac.returncode is not None  # the group was reaped
+
+    def test_supervisor_interrupt_never_orphans_the_generation(
+            self, tmp_path, monkeypatch):
+        """Ctrl-C (or an outer SIGTERM) mid-watch must kill the live
+        workers — N background training processes holding the
+        coordinator port is the one thing a dying supervisor may not
+        leave behind."""
+        sup = elastic.Supervisor(
+            [sys.executable, "-c", "import time; time.sleep(600)"],
+            world=2, directory=str(tmp_path), hb_timeout_s=1.0,
+            grace_s=0.5, poll_s=0.05)
+        spawned = []
+        real_spawn = sup._spawn
+
+        def spy(gen, n):
+            ws = real_spawn(gen, n)
+            spawned.extend(ws)
+            return ws
+
+        monkeypatch.setattr(sup, "_spawn", spy)
+
+        def interrupted(*a, **kw):
+            raise KeyboardInterrupt()
+
+        monkeypatch.setattr(sup, "_watch", interrupted)
+        with pytest.raises(KeyboardInterrupt):
+            sup.run()
+        assert len(spawned) == 2
+        for w in spawned:
+            assert w["proc"].poll() is not None  # killed, not orphaned
+
+    def test_disabled_path_has_no_elastic_footprint(self, tmp_path):
+        """No supervisor => elastic.enabled() is False, no heartbeat
+        file appears, no chaos site is consulted — training is the
+        plain PR 6 path with zero step cost added."""
+        assert not elastic.enabled()
+        net = _make_net("off_a_")
+        tr = _trainer(net)
+        before = set(os.listdir(str(tmp_path)))
+        for s in range(3):
+            _one_step(net, tr, seed=s)
+        assert set(os.listdir(str(tmp_path))) == before
+        assert "elastic.worker" not in chaos.stats()
+
+
+# ---------------------------------------------------------------------------
+# the real multi-process e2e (nightly elastic stage)
+# ---------------------------------------------------------------------------
+
+def _run_supervised(tmp_path, mode, chaos_spec, workers=2, steps=8):
+    out = str(tmp_path / f"report_{mode}.json")
+    cmd = [sys.executable, os.path.join(_REPO, "tools",
+                                        "elastic_run.py"),
+           "--workers", str(workers), "--demo", "--cpu",
+           "--mode", mode, "--steps", str(steps), "--ckpt-every", "2",
+           "--hb-timeout", "8", "--collective-timeout", "6",
+           "--grace", "12", "--out", out]
+    if chaos_spec:
+        cmd += ["--chaos", chaos_spec]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MXNET_CHAOS", None)
+    env.pop("MXNET_CHAOS_SPEC", None)
+    p = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=420, env=env)
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-2000:]
+    with open(out) as f:
+        return json.load(f)
+
+
+@pytest.mark.slow
+def test_e2e_killed_rank_recovers_in_shrink_mode_with_parity(tmp_path):
+    """THE ISSUE 15 known-answer: chaos kills exactly rank 1 at its
+    4th step of a REAL 2-process job; the supervisor detects it (the
+    survivor exits RC_PEER_FAILED off the PeerFailed classification),
+    commits the marker, shrinks the world onto the survivor, and the
+    recovered loss matches an uninterrupted twin within the
+    scaling_bench parity bar — with a measured MTTR."""
+    twin = _run_supervised(tmp_path, "replace", "", workers=1)
+    assert twin["ok"] and twin["restarts"] == 0
+    rep = _run_supervised(tmp_path, "shrink",
+                          "elastic.worker@4:die:rank=1")
+    assert rep["ok"], rep
+    assert rep["restarts"] == 1
+    epoch = rep["epochs"][0]
+    assert epoch["failed_ranks"] == [1]
+    assert epoch["rcs"]["0"] == RC_PEER_FAILED  # survivor classified
+    assert epoch["committed_step"] == 4
+    assert rep["final_world"] == 1
+    assert epoch["mttr_s"] is not None and 0 < epoch["mttr_s"] < 60
+    rel = abs(rep["result"]["loss"] - twin["result"]["loss"]) \
+        / max(abs(twin["result"]["loss"]), 1e-6)
+    assert rel <= 1e-3, (rep["result"], twin["result"])
+
+
+@pytest.mark.slow
+def test_e2e_hung_rank_recovers_in_replace_mode(tmp_path):
+    """A HUNG (not dead) rank: chaos sleeps rank 1 inside its step;
+    the survivor's collective watchdog fires, the supervisor SIGKILLs
+    the hung rank after the wind-down grace and replaces the world at
+    full size."""
+    rep = _run_supervised(tmp_path, "replace",
+                          "elastic.worker@4:hang=600:rank=1")
+    assert rep["ok"], rep
+    assert rep["restarts"] == 1
+    epoch = rep["epochs"][0]
+    assert epoch["failed_ranks"] == [1]
+    assert rep["final_world"] == 2
+    assert epoch["mttr_s"] is not None and 0 < epoch["mttr_s"] < 60
+    assert rep["result"]["steps"] == 8
+
+
+@pytest.mark.slow
+def test_e2e_restart_budget_declares_job_dead(tmp_path):
+    """A fault that keeps firing past the budget (worker rc != 0 every
+    generation via a bad command) must end as a DEAD job with the
+    budget recorded, not thrash forever."""
+    out = str(tmp_path / "dead.json")
+    cmd = [sys.executable, os.path.join(_REPO, "tools",
+                                        "elastic_run.py"),
+           "--workers", "2", "--mode", "replace",
+           "--max-restarts", "1", "--hb-timeout", "5",
+           "--grace", "2", "--out", out, "--",
+           sys.executable, "-c", "import sys; sys.exit(7)"]
+    p = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=300,
+                       env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert p.returncode == 1
+    with open(out) as f:
+        rep = json.load(f)
+    assert rep["ok"] is False
+    assert rep["restarts"] == 2  # initial + 1 budgeted retry, then dead
+    assert "budget" in rep["error"]
+    # a resumed generation that dies before its first step leaves
+    # mttr_s null — and the private detection stamp must not leak
+    # into the persisted report
+    assert all("_t_detect" not in e for e in rep["epochs"])
+    assert rep["epochs"][1]["mttr_s"] is None

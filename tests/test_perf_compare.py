@@ -43,6 +43,23 @@ def _health(gate_ok=True, skip_ok=True):
                        "nonfinite_skip": {"ok": skip_ok}}}
 
 
+def _resilience(gate_ok=True, bit_consistent=True, die_shrink_ok=True,
+                mttr=2.5):
+    runs = {name: {"ok": True, "mttr_s": mttr}
+            for name in ("die_replace", "die_shrink", "hang_replace",
+                         "hang_shrink")}
+    runs["die_shrink"]["ok"] = die_shrink_ok
+    elastic_ok = all(r["ok"] for r in runs.values())
+    return {"gate_ok": gate_ok and bit_consistent and elastic_ok,
+            "recovery": {"resume_bit_consistent": bit_consistent,
+                         "recovery_time_to_first_step_s": 0.02},
+            "breaker": {"breaker_opened": True,
+                        "breaker_recovered": True,
+                        "healthz_always_up": True,
+                        "process_survived": True},
+            "elastic": {"ok": elastic_ok, "runs": runs}}
+
+
 def _goodput(gate_ok=True, preempt_ok=True, ratio=0.85):
     return {"gate_ok": gate_ok and preempt_ok,
             "stages": {
@@ -217,6 +234,53 @@ class TestCompareArtifact:
         res = pc.compare_artifact("GOODPUT.json", _goodput(),
                                   _goodput(), tolerance=0.10)
         assert res["ok"]
+
+    def test_resilience_strict_never_grandfathered(self):
+        """RESILIENCE.json follows the HEALTH/GOODPUT policy: every
+        lane is strict — a recovery failure fails even when the
+        committed baseline was already failing."""
+        bad = _resilience(gate_ok=False, bit_consistent=False)
+        res = pc.compare_artifact("RESILIENCE.json", bad, bad,
+                                  tolerance=0.10)
+        assert not res["ok"]
+        assert any("recovery.resume_bit_consistent" in f
+                   for f in res["new_integrity_failures"])
+        assert any("gate_ok" in f
+                   for f in res["new_integrity_failures"])
+
+    def test_resilience_elastic_cells_gate(self):
+        """Each (die|hang)x(replace|shrink) recovery cell is its own
+        strict lane — one broken mode fails the nightly even when the
+        aggregate flags happen to read true, and a cell that only
+        exists in the fresh run (first --elastic nightly) still
+        gates."""
+        base = _resilience()
+        fresh = _resilience(die_shrink_ok=False)
+        fresh["gate_ok"] = True  # aggregate lies; the cell must gate
+        fresh["elastic"]["ok"] = True
+        res = pc.compare_artifact("RESILIENCE.json", base, fresh,
+                                  tolerance=0.10)
+        assert not res["ok"]
+        assert any("elastic.die_shrink.ok" in f
+                   for f in res["new_integrity_failures"])
+        # fresh-only elastic stage (baseline predates --elastic)
+        old = _resilience()
+        del old["elastic"]
+        res = pc.compare_artifact("RESILIENCE.json", old,
+                                  _resilience(die_shrink_ok=False),
+                                  tolerance=0.10)
+        assert not res["ok"]
+
+    def test_resilience_clean_passes_with_no_mttr_pct_lane(self):
+        """MTTR gates absolutely inside the bench, not as a relative
+        lane — a noisier-but-within-budget recovery must not flake
+        the nightly."""
+        base = _resilience(mttr=2.0)
+        fresh = _resilience(mttr=9.0)  # 4.5x "slower", still in budget
+        res = pc.compare_artifact("RESILIENCE.json", base, fresh,
+                                  tolerance=0.10)
+        assert res["ok"]
+        assert not res["metrics"]  # no metric lanes at all: checks only
 
     def test_serving_extractor(self):
         b = {"unbatched": {"qps": 588.7}, "batched": {"qps": 987.9},

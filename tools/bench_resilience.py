@@ -2,7 +2,7 @@
 """Resilience bench (ISSUE 6 gate): measure the recovery paths, don't
 just test them.
 
-Two scenarios, one report (stdout JSON line + RESILIENCE.json):
+Scenarios, one report (stdout JSON line + RESILIENCE.json):
 
   * recovery — train a small data-parallel job with auto-checkpointing,
     inject a preemption mid-epoch, then measure RECOVERY TIME TO FIRST
@@ -18,11 +18,24 @@ Two scenarios, one report (stdout JSON line + RESILIENCE.json):
     serves again and ``/healthz`` stayed 200 throughout
     (``process_survived``).
 
+  * elastic (opt-in ``--elastic``; the nightly elastic stage runs it —
+    process-spawning, so the tier-1 smoke skips it) — the ISSUE 15
+    chaos known-answer e2e: a REAL 2-process job under
+    ``tools/elastic_run.py`` with chaos killing (and separately
+    hanging) exactly rank 1 mid-training, recovered in BOTH replace
+    and shrink mode.  Each cell of the (die|hang) x (replace|shrink)
+    matrix must recover in exactly one restart naming rank 1 as the
+    failure, land within the loss-parity bar of an UNINTERRUPTED twin
+    (same seed/steps, world 1 — the scaling_bench fixed-global-batch
+    argument makes losses comparable across world sizes), and commit
+    a measured MTTR (supervisor detection -> first post-resume step).
+
 Gate (skipped with --no-gate, enforced in
 tests/nightly/test_bench_resilience.py): resume must be bit-consistent,
 recovery under --max-recovery-s (generous: CPU compile included),
 breaker must have opened and recovered, healthz must never have
-flapped.
+flapped; with --elastic, every matrix cell must have recovered with
+loss parity and an MTTR under --max-recovery-s.
 
 CPU smoke: JAX_PLATFORMS=cpu python tools/bench_resilience.py --no-gate
 """
@@ -201,6 +214,113 @@ def scenario_breaker(trip_requests: int, units: int) -> dict:
     return out
 
 
+def _run_elastic(mode: str, chaos_spec: str, workers: int = 2,
+                 steps: int = 8, timeout: float = 420.0) -> dict:
+    """One supervised job under tools/elastic_run.py (fresh process —
+    the supervisor + workers must not inherit this bench's jax/chaos
+    state)."""
+    import subprocess
+    import tempfile
+
+    out = os.path.join(tempfile.mkdtemp(prefix="mx-elastic-bench-"),
+                       "report.json")
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "elastic_run.py"),
+           "--workers", str(workers), "--demo", "--cpu",
+           "--mode", mode, "--steps", str(steps), "--ckpt-every", "2",
+           "--hb-timeout", "8", "--collective-timeout", "6",
+           "--grace", "12", "--out", out]
+    if chaos_spec:
+        cmd += ["--chaos", chaos_spec]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MXNET_CHAOS", None)
+    env.pop("MXNET_CHAOS_SPEC", None)
+    import signal as _sig
+
+    # own session: a timeout can kill the supervisor AND its worker
+    # processes as one group instead of orphaning the generation
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True, env=env,
+                         start_new_session=True)
+    try:
+        stdout, _ = p.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        # one wedged cell must fail ITS cell, never crash the bench
+        # before RESILIENCE.json is written (the goodput_report
+        # multi_rank_merge lesson).  SIGTERM first so the supervisor's
+        # own teardown reaps its workers; SIGKILL the group as the
+        # backstop.
+        try:
+            os.killpg(p.pid, _sig.SIGTERM)
+            p.communicate(timeout=20)
+        except Exception:  # noqa: BLE001
+            try:
+                os.killpg(p.pid, _sig.SIGKILL)
+            except OSError:
+                pass  # mxlint: disable=MX007 — group already gone
+            p.communicate()
+        return {"ok": False, "error": f"supervisor timed out after "
+                                      f"{timeout:.0f}s"}
+    try:
+        with open(out) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"ok": False,
+                "error": f"supervisor rc={p.returncode}",
+                "tail": "\n".join(stdout.splitlines()[-8:])}
+
+
+def scenario_elastic(max_recovery_s: float, steps: int = 8) -> dict:
+    """The (die|hang) x (replace|shrink) known-answer matrix plus the
+    uninterrupted twin, each cell gated on recovery + parity + MTTR."""
+    parity_tol = 1e-3  # the scaling_bench loss-parity bar
+    twin = _run_elastic("replace", "", workers=1, steps=steps)
+    twin_loss = (twin.get("result") or {}).get("loss")
+    runs = {}
+    specs = {"die": "elastic.worker@4:die:rank=1",
+             "hang": "elastic.worker@4:hang=600:rank=1"}
+    for fault, spec in specs.items():
+        for mode in ("replace", "shrink"):
+            rep = _run_elastic(mode, spec, steps=steps)
+            epochs = rep.get("epochs") or []
+            loss = (rep.get("result") or {}).get("loss")
+            mttr = epochs[0].get("mttr_s") if epochs else None
+            detection_ok = bool(epochs) and \
+                epochs[0].get("failed_ranks") == [1]
+            parity = abs(loss - twin_loss) / max(abs(twin_loss), 1e-6) \
+                if None not in (loss, twin_loss) else None
+            row = {
+                "ok": bool(
+                    rep.get("ok") and rep.get("restarts") == 1
+                    and detection_ok
+                    and parity is not None and parity <= parity_tol
+                    and mttr is not None and 0 < mttr < max_recovery_s
+                    and rep.get("final_world")
+                    == (1 if mode == "shrink" else 2)),
+                "recovered": bool(rep.get("ok")),
+                "restarts": rep.get("restarts"),
+                "failed_ranks": epochs[0].get("failed_ranks")
+                if epochs else None,
+                "final_world": rep.get("final_world"),
+                "mttr_s": mttr,
+                "loss": loss,
+                "loss_rel_err_vs_twin": round(parity, 8)
+                if parity is not None else None,
+            }
+            if not row["ok"]:
+                row["report"] = rep
+            runs[f"{fault}_{mode}"] = row
+    return {
+        "ok": twin_loss is not None and all(r["ok"]
+                                            for r in runs.values()),
+        "twin_loss": twin_loss,
+        "parity_tol": parity_tol,
+        "steps": steps,
+        "runs": runs,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=8)
@@ -208,6 +328,11 @@ def main():
     ap.add_argument("--trip-requests", type=int, default=12)
     ap.add_argument("--units", type=int, default=6)
     ap.add_argument("--max-recovery-s", type=float, default=60.0)
+    ap.add_argument("--elastic", action="store_true",
+                    help="also run the multi-process elastic recovery "
+                         "matrix (slow; the nightly elastic stage "
+                         "does — the tier-1 smoke must not spawn "
+                         "2-process jobs)")
     ap.add_argument("--no-gate", action="store_true",
                     help="report only (tier-1 smoke); the strict gate "
                     "runs in tests/nightly/test_bench_resilience.py")
@@ -224,6 +349,8 @@ def main():
                                       args.units),
         "breaker": scenario_breaker(args.trip_requests, args.units),
     }
+    if args.elastic:
+        report["elastic"] = scenario_elastic(args.max_recovery_s)
     gate_ok = (
         report["recovery"]["resume_bit_consistent"]
         and report["recovery"]["recovery_time_to_first_step_s"]
@@ -232,7 +359,8 @@ def main():
         and report["breaker"]["breaker_recovered"]
         and report["breaker"]["requests_dropped_during_trip"] > 0
         and report["breaker"]["healthz_always_up"]
-        and report["breaker"]["process_survived"])
+        and report["breaker"]["process_survived"]
+        and report.get("elastic", {}).get("ok", True))
     report["gate_ok"] = bool(gate_ok)
     line = json.dumps(report)
     print(line)

@@ -4,8 +4,8 @@ committed ones.
 
 The nightly refreshes the tracked bench artifacts (FUSED_BENCH.json,
 SCALING.json, SERVING_BENCH.json, COMPILE_CACHE.json, HEALTH.json,
-GOODPUT.json) in the work tree; this tool compares each against the
-version committed
+GOODPUT.json, RESILIENCE.json) in the work tree; this tool compares
+each against the version committed
 at --ref (``git show REF:NAME``) and fails on
 
   * a **throughput regression**: any tracked higher-is-better metric
@@ -28,6 +28,12 @@ at --ref (``git show REF:NAME``) and fails on
     chaos known-answer stages must keep attributing each disruption
     to the right badput category, and the clean-run goodput-ratio
     floor (absolute, inside the report) rides the strict stage lane.
+  * a **resilience failure** (RESILIENCE.json): same strict policy —
+    bit-consistent resume, breaker recovery, and every elastic
+    (die|hang)x(replace|shrink) recovery cell gate as strict checks;
+    a recovery regression or gate_ok=false is never grandfathered.
+    MTTR gates absolutely inside the bench (--max-recovery-s), not as
+    a relative lane (restart wall is jax-import-noise dominated).
 
 Artifacts missing on either side are reported and skipped — a bench
 stage that timed out must fail the nightly through its own return
@@ -62,7 +68,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 DEFAULT_ARTIFACTS = ("FUSED_BENCH.json", "SCALING.json",
                      "SERVING_BENCH.json", "COMPILE_CACHE.json",
-                     "HEALTH.json", "GOODPUT.json")
+                     "HEALTH.json", "GOODPUT.json", "RESILIENCE.json")
 
 _ATTRIBUTION_PATH = os.path.join(
     _REPO, "mxnet_tpu", "telemetry", "mxtriage", "attribution.py")
@@ -181,6 +187,36 @@ def _goodput(d) -> dict:
     return {"checks": c, "strict": True}
 
 
+def _resilience(d) -> dict:
+    """RESILIENCE.json: HEALTH/GOODPUT policy — every lane is a STRICT
+    check (a broken recovery path or gate_ok=false is never
+    grandfathered by an already-bad baseline).  Deliberately no
+    relative-% MTTR lane: the chaos recoveries are process-spawn-noise
+    dominated (jax import wall inside the restart), so the MTTR gates
+    absolutely inside the bench (--max-recovery-s) and rides each
+    run's strict `ok` here — the goodput-ratio precedent."""
+    c = {}
+    if "gate_ok" in d:
+        c["gate_ok"] = bool(d["gate_ok"])
+    rec = d.get("recovery") or {}
+    if "resume_bit_consistent" in rec:
+        c["recovery.resume_bit_consistent"] = \
+            bool(rec["resume_bit_consistent"])
+    brk = d.get("breaker") or {}
+    for k in ("breaker_opened", "breaker_recovered",
+              "healthz_always_up", "process_survived"):
+        if k in brk:
+            c[f"breaker.{k}"] = bool(brk[k])
+    el = d.get("elastic")
+    if isinstance(el, dict):
+        if "ok" in el:
+            c["elastic.ok"] = bool(el["ok"])
+        for name, run in (el.get("runs") or {}).items():
+            if isinstance(run, dict) and "ok" in run:
+                c[f"elastic.{name}.ok"] = bool(run["ok"])
+    return {"checks": c, "strict": True}
+
+
 EXTRACTORS = {
     "FUSED_BENCH.json": _fused,
     "SERVING_BENCH.json": _serving,
@@ -188,6 +224,7 @@ EXTRACTORS = {
     "SCALING.json": _scaling,
     "HEALTH.json": _health,
     "GOODPUT.json": _goodput,
+    "RESILIENCE.json": _resilience,
 }
 
 
