@@ -32,6 +32,16 @@ if util.env.get_bool("MXNET_SAN"):
         (util.env.get_str("MXNET_SAN_SUPPRESS") or "").split(",")
         if s.strip()))
 
+# mxtune: apply the stored tuned knob config (if the config store has a
+# matching winner) BEFORE the submodule imports below read their knobs.
+# The overlay only fills knobs the process env leaves unset — explicit
+# MXNET_* settings always win — and this call never raises and never
+# initializes an accelerator backend.  See docs/autotune.md.
+if util.env.get_bool("MXNET_AUTOTUNE"):
+    from .autotune import startup as _mxtune_startup
+
+    _mxtune_startup.apply_startup_overlay(framework_version=__version__)
+
 from . import context
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import ops

@@ -1,0 +1,40 @@
+"""mxtune — goodput-optimal knob autotuning.
+
+The subsystem that ACTS on the observability stack instead of adding to
+it: it sweeps knob configurations through short measured runs (objective
+= mxgoodput goodput ratio, tiebreak = mxprof MFU/throughput), persists
+per-(scenario, mesh, device_kind, framework version) winners in a
+content-addressed store beside the compile cache, and applies the best
+stored config at import via an env-overlay that explicit ``MXNET_*``
+settings always override.
+
+Layout:
+
+* :mod:`~mxnet_tpu.autotune.space` — search space derived from the knob
+  registry's :class:`~mxnet_tpu.util.env.Tunable` metadata (declared
+  where each knob is, never duplicated).
+* :mod:`~mxnet_tpu.autotune.search` — successive halving with the
+  default config pinned as an arm (tuned >= default by construction);
+  crashed/timed-out trials are pruned, never fatal.
+* :mod:`~mxnet_tpu.autotune.store` — verified, quarantining config
+  store (compile-cache durability idiom).
+* :mod:`~mxnet_tpu.autotune.startup` — boot-time overlay application.
+
+Driver: ``tools/autotune.py`` (sweeps, ``--from-suspects`` feedback from
+mxtriage, committed ``AUTOTUNE.json`` artifact).  Docs:
+``docs/autotune.md``.
+"""
+from __future__ import annotations
+
+from .search import successive_halving
+from .space import (Dimension, dimensions, neighbor,
+                    priority_from_suspects, sample)
+from .startup import apply_startup_overlay
+from .store import ConfigStore, config_fingerprint, default_dir, entry_key
+
+__all__ = [
+    "Dimension", "dimensions", "sample", "neighbor",
+    "priority_from_suspects", "successive_halving",
+    "ConfigStore", "config_fingerprint", "default_dir", "entry_key",
+    "apply_startup_overlay",
+]

@@ -1,0 +1,146 @@
+"""Successive-halving searcher over knob configs.
+
+The budget schedule is the classic one (successive halving / ASHA
+family, in the spirit of TVM's measured search, arXiv 1802.04799): start
+many cheap trials, keep the better half, re-measure survivors at a
+doubled budget, repeat.  Three properties matter more than the schedule
+itself:
+
+* **The default config is a pinned arm.**  ``{}`` (all declared
+  defaults) enters rung 0 and is re-measured at EVERY rung regardless of
+  rank, so the final rung always contains a fresh default measurement at
+  the same budget as the winner.  "Tuned >= default" then holds by
+  argmax construction — the gate can never be lost to a stale or
+  smaller-budget default number.
+* **A crashed trial is a pruned trial.**  The runner reporting a crash,
+  timeout, or unparseable result scores ``-inf`` and is counted, never
+  re-raised — a knob setting that OOMs the child must rank last, not
+  kill the tune.
+* **Objective, then tiebreak.**  The objective is the goodput ratio from
+  the trial's embedded mxgoodput ledger; the tiebreak tuple (mxprof MFU,
+  throughput) orders configs the ratio cannot separate.
+
+The runner is injected (``runner(config, budget) -> result dict``), so
+tests drive the searcher with synthetic runners and the CLI drives it
+with bounded subprocess bench runs.
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .space import Dimension, neighbor, sample
+
+__all__ = ["successive_halving"]
+
+Runner = Callable[[Dict[str, Any], int], Optional[Dict[str, Any]]]
+
+_NEG_INF = float("-inf")
+
+
+class _Arm:
+    __slots__ = ("config", "objective", "tiebreak", "status", "pinned")
+
+    def __init__(self, config: Dict[str, Any], pinned: bool = False):
+        self.config = config
+        self.objective = _NEG_INF
+        self.tiebreak: Tuple[float, ...] = ()
+        self.status = "pending"
+        self.pinned = pinned
+
+    def score(self) -> Tuple[float, Tuple[float, ...]]:
+        return (self.objective, self.tiebreak)
+
+
+def _measure(arm: _Arm, runner: Runner, budget: int,
+             counters: Dict[str, int]) -> None:
+    counters["trials"] += 1
+    try:
+        result = runner(arm.config, budget)
+    except Exception:  # noqa: BLE001 — a crashed trial is a pruned trial
+        result = None
+    if not isinstance(result, dict) or not result.get("ok", True):
+        arm.objective, arm.tiebreak = _NEG_INF, ()
+        arm.status = "crashed"
+        counters["crashed"] += 1
+        return
+    try:
+        arm.objective = float(result["objective"])
+        arm.tiebreak = tuple(float(x)
+                             for x in result.get("tiebreak", ()))
+        arm.status = "ok"
+    except (KeyError, TypeError, ValueError):
+        arm.objective, arm.tiebreak = _NEG_INF, ()
+        arm.status = "crashed"
+        counters["crashed"] += 1
+
+
+def successive_halving(
+        runner: Runner,
+        dims: Sequence[Dimension],
+        *,
+        rng: random.Random,
+        n_initial: int = 8,
+        rungs: int = 3,
+        keep: float = 0.5,
+        base_budget: int = 4,
+        budget_growth: int = 2,
+        log: Optional[Callable[[str], None]] = None) -> Dict[str, Any]:
+    """Run the search; returns the report dict (best/default/delta/
+    trajectory/counters) ``tools/autotune.py`` embeds per scenario."""
+    say = log or (lambda _msg: None)
+    arms: List[_Arm] = [_Arm({}, pinned=True)]  # declared defaults
+    # proposals: half uniform-random restarts, half neighborhood moves
+    # off the default — the local moves find "default was nearly right"
+    # winners fast, the restarts cover the rest of the space
+    while len(arms) < max(2, n_initial):
+        cfg = sample(rng, dims) if len(arms) % 2 else \
+            neighbor(rng, {}, dims)
+        arms.append(_Arm(cfg))
+
+    counters = {"trials": 0, "crashed": 0, "pruned": 0}
+    trajectory: List[Dict[str, Any]] = []
+    for rung in range(max(1, rungs)):
+        budget = base_budget * (budget_growth ** rung)
+        for arm in arms:
+            _measure(arm, runner, budget, counters)
+        arms.sort(key=_Arm.score, reverse=True)
+        best = arms[0]
+        trajectory.append({
+            "rung": rung,
+            "budget": budget,
+            "arms": len(arms),
+            "best_objective": None if best.objective == _NEG_INF
+            else best.objective,
+            "crashed": sum(1 for a in arms if a.status == "crashed"),
+        })
+        say(f"rung {rung}: {len(arms)} arms @ budget {budget}, best "
+            f"objective {trajectory[-1]['best_objective']}")
+        if rung == max(1, rungs) - 1:
+            break
+        n_keep = max(1, int(math.ceil(len(arms) * keep)))
+        survivors = arms[:n_keep]
+        if not any(a.pinned for a in survivors):
+            survivors.append(next(a for a in arms if a.pinned))
+        counters["pruned"] += len(arms) - len(survivors)
+        arms = survivors
+
+    default_arm = next(a for a in arms if a.pinned)
+    best_arm = arms[0]  # sorted: argmax of the final rung, default incl.
+    none_ok = best_arm.objective != _NEG_INF
+    return {
+        "best_config": best_arm.config,
+        "best_objective": best_arm.objective if none_ok else None,
+        "best_tiebreak": list(best_arm.tiebreak),
+        "default_objective": None if default_arm.objective == _NEG_INF
+        else default_arm.objective,
+        "default_tiebreak": list(default_arm.tiebreak),
+        "delta": (best_arm.objective - default_arm.objective)
+        if none_ok and default_arm.objective != _NEG_INF else None,
+        "trajectory": trajectory,
+        "trials": counters["trials"],
+        "crashed": counters["crashed"],
+        "pruned": counters["pruned"],
+        "ok": none_ok,
+    }

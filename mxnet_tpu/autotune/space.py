@@ -1,0 +1,124 @@
+"""Search space derived from the knob registry.
+
+A knob that wants to be tuned declares :class:`~mxnet_tpu.util.env.Tunable`
+metadata where the knob itself is declared (``util/env.py``) — the space
+is never duplicated beside the registry, so a new tunable knob is one
+edit away from being swept.  This module turns that metadata into
+proposal generators: uniform random samples over each dimension and
+neighborhood mutations of an incumbent config (log-scale knobs double or
+halve, categorical knobs flip), both clamped to the declared range.
+
+Configs are plain ``{knob_name: value}`` dicts.  The empty dict is the
+canonical "all declared defaults" config — trials inject config entries
+into the subprocess environment, so an absent name means the child
+resolves that knob exactly as an untuned process would.
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Sequence
+
+from ..base import MXNetError
+from ..util import env
+
+__all__ = ["Dimension", "dimensions", "sample", "neighbor",
+           "priority_from_suspects"]
+
+
+class Dimension(NamedTuple):
+    name: str
+    typ: type
+    default: Any
+    tunable: env.Tunable
+
+
+def dimensions(names: Optional[Iterable[str]] = None) -> List[Dimension]:
+    """The tunable dimensions, from the knob registry.
+
+    ``names`` restricts (and orders) the space — the ``--from-suspects``
+    feedback channel passes mxtriage's ranked knob suspects here so the
+    sweep spends its budget on the dimensions attribution already
+    implicated.  Unknown or non-tunable names raise: a priority list
+    naming a knob the space cannot move is a caller bug.
+    """
+    by_name = {k.name: Dimension(k.name, k.typ, k.default, k.tunable)
+               for k in env.tunables()}
+    if names is None:
+        return [by_name[n] for n in sorted(by_name)]
+    out = []
+    for n in names:
+        if n not in by_name:
+            raise MXNetError(
+                f"{n!r} is not a tunable knob — declare Tunable "
+                "metadata on it in mxnet_tpu/util/env.py (tunable: "
+                f"{sorted(by_name)})")
+        out.append(by_name[n])
+    return out
+
+
+def _clamp(dim: Dimension, value: float) -> Any:
+    t = dim.tunable
+    value = min(max(value, t.lo), t.hi)
+    return int(round(value)) if dim.typ is int else float(value)
+
+
+def sample(rng: random.Random, dims: Sequence[Dimension]) -> Dict[str, Any]:
+    """One uniform random config over ``dims`` (log dimensions are
+    uniform in log space, so 256 KiB..64 MiB doesn't spend 99% of its
+    draws above 1 MiB)."""
+    out: Dict[str, Any] = {}
+    for d in dims:
+        t = d.tunable
+        if t.choices is not None:
+            out[d.name] = rng.choice(list(t.choices))
+        elif t.scale == "log":
+            out[d.name] = _clamp(
+                d, math.exp(rng.uniform(math.log(t.lo), math.log(t.hi))))
+        else:
+            out[d.name] = _clamp(d, rng.uniform(t.lo, t.hi))
+    return out
+
+
+def neighbor(rng: random.Random, config: Dict[str, Any],
+             dims: Sequence[Dimension]) -> Dict[str, Any]:
+    """Mutate ONE dimension of ``config`` — the local move successive
+    halving interleaves with random restarts.  A name absent from
+    ``config`` mutates from the knob's resolved value (declared default,
+    or the dynamic default's midpoint when that is None)."""
+    out = dict(config)
+    d = rng.choice(list(dims))
+    t = d.tunable
+    if t.choices is not None:
+        cur = out.get(d.name, d.default)
+        others = [c for c in t.choices if c != cur] or list(t.choices)
+        out[d.name] = rng.choice(others)
+        return out
+    cur = out.get(d.name, d.default)
+    if cur is None:  # dynamic default: start from the range midpoint
+        cur = math.sqrt(t.lo * t.hi) if t.scale == "log" \
+            else (t.lo + t.hi) / 2
+    if t.scale == "log":
+        out[d.name] = _clamp(d, cur * rng.choice((0.5, 2.0)))
+    else:
+        step = (t.hi - t.lo) / 8.0
+        out[d.name] = _clamp(d, cur + rng.choice((-step, step)))
+    return out
+
+
+def priority_from_suspects(suspects: Iterable[Dict[str, Any]]) -> List[str]:
+    """mxtriage feedback channel: filter a PERF_COMPARE.json ``suspects``
+    array down to the registered TUNABLE knob names, rank order
+    preserved, deduplicated.  Non-knob suspects (metrics, phases) and
+    knob suspects without Tunable metadata are skipped — attribution can
+    implicate a knob the space cannot move (e.g. a bool master switch
+    deliberately left untunable), and that must not crash the sweep."""
+    tunable_names = {k.name for k in env.tunables()}
+    out: List[str] = []
+    for s in suspects:
+        if s.get("kind") != "knob":
+            continue
+        name = s.get("name")
+        if name in tunable_names and name not in out:
+            out.append(name)
+    return out

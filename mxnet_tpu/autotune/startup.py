@@ -1,0 +1,50 @@
+"""Boot-time application of a stored tuned config.
+
+``import mxnet_tpu`` calls :func:`apply_startup_overlay` right after the
+knob registry exists (before any subsystem reads its knobs), so a warm
+process on a machine with a populated store boots already-tuned with
+zero manual env settings.  Precedence is owned by the registry: the
+overlay only fills knobs the process env leaves unset — an operator's
+explicit ``MXNET_*`` export always wins.
+
+This path MUST be free of failure modes: no store, an unreadable store,
+or a corrupt entry all mean "boot on defaults", silently.  It must also
+never initialize an accelerator backend (device_kind is therefore not
+part of startup matching — entries carry the tune-time ``platform``
+instead).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from ..util import env
+from .store import ConfigStore, default_dir
+
+__all__ = ["apply_startup_overlay"]
+
+
+def apply_startup_overlay(framework_version: str = "") \
+        -> Optional[Dict[str, Any]]:
+    """Apply the best matching stored config, if any.  Returns the
+    overlay application record (also via ``env.overlay_info()``) or
+    None; never raises."""
+    try:
+        if not env.get_bool("MXNET_AUTOTUNE"):
+            return None
+        root = default_dir()
+        if not root or not os.path.isdir(root):
+            return None
+        store = ConfigStore(root)
+        entry = store.best_for_startup(
+            scenario=env.get_str("MXNET_AUTOTUNE_SCENARIO") or "",
+            framework_version=framework_version,
+            platform=os.environ.get("JAX_PLATFORMS", "") or "")
+        if entry is None:
+            return None
+        return env.apply_overlay(
+            entry["config"],
+            fingerprint=entry.get("config_fingerprint", ""),
+            source=entry.get("path", root))
+    except Exception:  # noqa: BLE001 — tuning is an optimization, never a crash
+        return None

@@ -1,0 +1,205 @@
+"""Persistent tuned-config store (mxtune winners).
+
+Winning knob configs live beside the compile cache as content-addressed
+JSON entries: the filename is the sha256 of the canonical entry KEY
+(scenario/model fingerprint, mesh shape, device kind, framework
+version), so a process boots tuned by hashing its own identity and
+looking the digest up — no index file, no scan-order races.  The entry
+body carries its own payload digest; a load that fails to verify
+quarantines the file (rename to ``*.corrupt``) and reports a miss, never
+an error — a truncated write from a crashed tuner must not take down
+every process that shares the store volume (compile_cache/store.py
+precedent).
+
+Everything here is stdlib + the knob registry only: the store is
+consulted during ``import mxnet_tpu``, before any heavyweight subsystem
+exists.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..util import env
+
+__all__ = ["ConfigStore", "config_fingerprint", "entry_key",
+           "default_dir"]
+
+_MAGIC = "mxtc1"
+_SUFFIX = ".mxtc"
+
+
+def config_fingerprint(config: Dict[str, Any]) -> str:
+    """sha256 over the sorted config items — the identity mxprof dumps
+    stamp as ``tuned_config.fingerprint`` so perf_compare/mxtriage can
+    tell two runs apart by WHICH tuned config they booted with.
+    Deliberately mirrors env.fingerprint()'s serialization."""
+    h = hashlib.sha256()
+    for name, value in sorted(config.items()):
+        h.update(f"{name}={value!r}\x1f".encode())
+    return h.hexdigest()
+
+
+def entry_key(scenario: str, mesh: Sequence[int] = (),
+              device_kind: str = "", framework_version: str = "",
+              platform: str = "") -> Dict[str, Any]:
+    """The store key: what must match for a stored winner to apply.
+    ``platform`` (JAX_PLATFORMS at tune time) rides along because
+    device_kind needs an initialized backend to resolve — startup
+    matching falls back to it rather than initializing devices as an
+    import side effect."""
+    return {
+        "scenario": scenario,
+        "mesh": list(mesh),
+        "device_kind": device_kind,
+        "framework_version": framework_version,
+        "platform": platform,
+    }
+
+
+def _key_digest(key: Dict[str, Any]) -> str:
+    blob = json.dumps(key, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def default_dir() -> str:
+    """Where the store lives: MXNET_AUTOTUNE_DIR, else an ``autotune/``
+    subdirectory of the compile cache when one is configured, else empty
+    (store off)."""
+    d = env.get_str("MXNET_AUTOTUNE_DIR") or ""
+    if d:
+        return d
+    cc = env.get_str("MXNET_COMPILE_CACHE_DIR") or ""
+    return os.path.join(cc, "autotune") if cc else ""
+
+
+class ConfigStore:
+    """Directory of verified tuned-config entries.
+
+    ``put`` is atomic (temp file + ``os.replace``); ``get`` verifies the
+    payload digest and quarantines anything unreadable.  Counters mirror
+    the compile-cache store so goodput dashboards can watch hit rate.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        self._seq = 0
+        self.stats: Dict[str, int] = {
+            "hits": 0, "misses": 0, "puts": 0, "corrupt": 0}
+
+    # -- encode / decode ----------------------------------------------------
+
+    @staticmethod
+    def _encode(key: Dict[str, Any], config: Dict[str, Any],
+                objective: float, meta: Optional[Dict[str, Any]]) -> bytes:
+        entry = {
+            "magic": _MAGIC,
+            "key": key,
+            "config": config,
+            "config_fingerprint": config_fingerprint(config),
+            "objective": objective,
+            "meta": meta or {},
+            "created": time.time(),
+        }
+        return json.dumps(entry, sort_keys=True, indent=1).encode()
+
+    @staticmethod
+    def _decode(blob: bytes) -> Dict[str, Any]:
+        entry = json.loads(blob.decode())
+        if entry.get("magic") != _MAGIC:
+            raise ValueError(f"bad magic {entry.get('magic')!r}")
+        config = entry["config"]
+        if not isinstance(config, dict):
+            raise ValueError("config is not an object")
+        if entry.get("config_fingerprint") != config_fingerprint(config):
+            raise ValueError("config fingerprint mismatch")
+        float(entry["objective"])  # must be numeric
+        return entry
+
+    # -- store ops ----------------------------------------------------------
+
+    def _path(self, key: Dict[str, Any]) -> str:
+        return os.path.join(self.root, _key_digest(key) + _SUFFIX)
+
+    def put(self, key: Dict[str, Any], config: Dict[str, Any],
+            objective: float, meta: Optional[Dict[str, Any]] = None) -> str:
+        os.makedirs(self.root, exist_ok=True)
+        path = self._path(key)
+        self._seq += 1
+        tmp = f"{path}.tmp-{os.getpid()}-{self._seq}"
+        with open(tmp, "wb") as f:
+            f.write(self._encode(key, config, objective, meta))
+        os.replace(tmp, path)  # concurrent tuners: last writer wins, whole
+        self.stats["puts"] += 1  # #                 entries only
+        return path
+
+    def _quarantine(self, path: str) -> None:
+        self.stats["corrupt"] += 1
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:
+            pass  # already quarantined/removed by a peer — still a miss
+
+    def _load(self, path: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            return None
+        try:
+            entry = self._decode(blob)
+        except Exception:  # noqa: BLE001 — ANY decode failure is a miss
+            self._quarantine(path)
+            return None
+        entry["path"] = path
+        return entry
+
+    def get(self, key: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        entry = self._load(self._path(key))
+        self.stats["hits" if entry is not None else "misses"] += 1
+        return entry
+
+    def entries(self) -> List[Dict[str, Any]]:
+        """Every verified entry (corrupt files quarantined on the way)."""
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            if not name.endswith(_SUFFIX):
+                continue
+            entry = self._load(os.path.join(self.root, name))
+            if entry is not None:
+                out.append(entry)
+        return out
+
+    def best_for_startup(self, scenario: str = "",
+                         framework_version: str = "",
+                         platform: str = "") -> Optional[Dict[str, Any]]:
+        """The entry a fresh process should boot with.
+
+        Matching is conservative: the framework version must match
+        exactly (a winner tuned against other code is stale by
+        definition), the scenario must match when the caller pins one
+        (MXNET_AUTOTUNE_SCENARIO), and among the remainder entries for
+        this platform beat platform-less ones, newest ``created`` wins.
+        Returns None rather than guessing when nothing survives.
+        """
+        best = None
+        best_rank = None
+        for e in self.entries():
+            k = e.get("key", {})
+            if framework_version and \
+                    k.get("framework_version") != framework_version:
+                continue
+            if scenario and k.get("scenario") != scenario:
+                continue
+            rank = (1 if platform and k.get("platform") == platform else 0,
+                    e.get("created", 0.0))
+            if best_rank is None or rank > best_rank:
+                best, best_rank = e, rank
+        return best

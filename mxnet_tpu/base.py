@@ -43,19 +43,11 @@ _TRUTHY = {"1", "true", "yes", "on"}
 _FALSY = {"0", "false", "no", "off"}
 
 
-def get_env(name: str, default: Any = None, typ: Optional[type] = None) -> Any:
-    """dmlc::GetEnv analogue — typed env-var lookup.
-
-    Env vars keep MXNET_-compatible names where the knob has a reference
-    equivalent (ref: docs/faq/env_var.md).
-    """
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        # empty string means unset: launchers commonly export every knob
-        # with VAR="" as the 'use the default' spelling
-        return default
-    if typ is None:
-        typ = type(default) if default is not None else str
+def convert_env(name: str, raw: str, typ: type) -> Any:
+    """Parse an env-var string with env-var semantics (truthy/falsy
+    spellings for bools, numeric fallback).  Shared by :func:`get_env`
+    and the autotune env-overlay, which must convert stored values
+    exactly the way the environment would have."""
     if typ is bool:
         low = raw.strip().lower()
         if low in _TRUTHY:
@@ -73,6 +65,22 @@ def get_env(name: str, default: Any = None, typ: Optional[type] = None) -> Any:
         return typ(raw)
     except ValueError as e:
         raise MXNetError(f"env var {name}={raw!r} is not a {typ.__name__}") from e
+
+
+def get_env(name: str, default: Any = None, typ: Optional[type] = None) -> Any:
+    """dmlc::GetEnv analogue — typed env-var lookup.
+
+    Env vars keep MXNET_-compatible names where the knob has a reference
+    equivalent (ref: docs/faq/env_var.md).
+    """
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        # empty string means unset: launchers commonly export every knob
+        # with VAR="" as the 'use the default' spelling
+        return default
+    if typ is None:
+        typ = type(default) if default is not None else str
+    return convert_env(name, raw, typ)
 
 
 T = TypeVar("T")

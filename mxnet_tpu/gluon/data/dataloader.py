@@ -36,6 +36,7 @@ from ...ndarray.ndarray import NDArray, array as nd_array
 from ...resilience import chaos as _chaos
 from ...telemetry import instruments as _ins
 from ...telemetry import tracing as _tracing
+from ...util import env as _env
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 __all__ = ["DataLoader", "WorkerDied", "default_batchify_fn",
@@ -312,6 +313,11 @@ class DataLoader:
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = max(0, num_workers)
+        if prefetch is None:
+            # tunable knob (mxtune sweep dimension); the explicit
+            # prefetch= argument always wins, and the declared default
+            # is dynamic: 2 * num_workers
+            prefetch = _env.get_int("MXNET_PREFETCH_DEPTH")
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
         self._resume_from = 0

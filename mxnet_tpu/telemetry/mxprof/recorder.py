@@ -398,11 +398,13 @@ class FlightRecorder:
         # default still flips it)
         try:
             table = _env.resolved()
+            overlay = _env.overlay_info()
+            overlaid = set(overlay["applied"]) if overlay else set()
             knobs = {name: v for name, v in table.items()
-                     if name in os.environ}
+                     if name in os.environ or name in overlaid}
             knob_fp = _env.fingerprint()
         except Exception:  # noqa: BLE001 — a dump never fails on a bad knob
-            knobs, knob_fp = {}, None
+            knobs, knob_fp, overlay = {}, None, None
         out = {
             "pid": os.getpid(),
             "rank": _tracing._RANK,
@@ -416,6 +418,17 @@ class FlightRecorder:
             "knobs": knobs,
             "knob_fingerprint": knob_fp,
         }
+        # mxtune stamp: WHICH tuned config this process booted with (or
+        # None for an untuned run) — perf_compare/mxtriage tell
+        # tuned-from-stale by this fingerprint, and the overlaid names
+        # already ride in `knobs` above so attribution sees tuned values
+        if overlay is not None:
+            out["tuned_config"] = {
+                "fingerprint": overlay.get("fingerprint"),
+                "source": overlay.get("source"),
+                "applied": overlay.get("applied"),
+                "shadowed": overlay.get("shadowed"),
+            }
         # the goodput ledger rides every dump (mxprof.dump(), SIGUSR2,
         # embedded bench snapshots): a per-rank dump is what
         # tools/goodput_report.py --merge rolls into the job-level

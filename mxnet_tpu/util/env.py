@@ -18,17 +18,32 @@ whose default is computed (worker counts, probe budgets), which declare
 """
 from __future__ import annotations
 
+import os as _os
 import threading
-from typing import Any, Dict, List, NamedTuple, Optional
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 from ..base import MXNetError
+from ..base import convert_env as _convert_env
 from ..base import get_env as _raw_get_env  # the untyped low-level reader
 
 __all__ = [
-    "Knob", "declare", "knobs", "is_declared",
+    "Knob", "Tunable", "declare", "knobs", "is_declared", "tunables",
     "get_int", "get_bool", "get_str", "get_float",
+    "apply_overlay", "overlay_info", "clear_overlay",
     "resolved", "fingerprint", "generate_docs",
 ]
+
+
+class Tunable(NamedTuple):
+    """Optional search-space metadata a knob declares about itself, so
+    mxtune's space is derived from the registry instead of duplicated
+    beside it.  Either a numeric range (``lo``/``hi``, with ``scale``
+    'linear' or 'log' — log doubles/halves under neighborhood moves) or
+    an explicit ``choices`` tuple (categorical / bool knobs)."""
+    lo: Optional[float] = None
+    hi: Optional[float] = None
+    scale: str = "linear"
+    choices: Optional[Tuple[Any, ...]] = None
 
 
 class Knob(NamedTuple):
@@ -36,6 +51,7 @@ class Knob(NamedTuple):
     typ: type
     default: Any
     doc: str
+    tunable: Optional[Tunable] = None
 
 
 _KNOBS: Dict[str, Knob] = {}
@@ -43,26 +59,35 @@ _LOCK = threading.Lock()
 
 _UNSET = object()
 
+# Tuned-config overlay (mxnet_tpu.autotune): name -> RAW string value,
+# consulted by _get only when the process env leaves the knob unset.
+# Explicit MXNET_* settings therefore always win — the overlay is a
+# better default, never an override.
+_OVERLAY: Dict[str, str] = {}
+_OVERLAY_META: Optional[Dict[str, Any]] = None
 
-def declare(name: str, typ: type, default: Any, doc: str) -> Knob:
-    """Register a knob. Idempotent for identical declarations; a
-    conflicting re-declaration (different type or default) raises —
-    two call sites silently disagreeing about a knob's default is
-    exactly the drift this registry exists to prevent."""
+
+def declare(name: str, typ: type, default: Any, doc: str,
+            tunable: Optional[Tunable] = None) -> Knob:
+    """Register a knob. Duplicate registration raises loudly — even an
+    identical re-declaration means two call sites each believe they own
+    the knob, and the second would silently shadow doc/tunable edits to
+    the first. Every knob is declared exactly once, in this module."""
     if not name.startswith("MXNET_"):
         raise MXNetError(
             f"env knob {name!r} must use the MXNET_ prefix; other "
             "process env vars are not framework knobs")
-    k = Knob(name, typ, default, doc)
+    if tunable is not None and typ is bool and tunable.choices is None:
+        tunable = tunable._replace(choices=(False, True))
+    k = Knob(name, typ, default, doc, tunable)
     with _LOCK:
-        prev = _KNOBS.get(name)
-        if prev is not None:
-            if prev.typ is not typ or prev.default != default:
-                raise MXNetError(
-                    f"env knob {name} re-declared with conflicting "
-                    f"type/default: {prev.typ.__name__}/{prev.default!r} "
-                    f"vs {typ.__name__}/{default!r}")
-            return prev
+        if name in _KNOBS:
+            prev = _KNOBS[name]
+            raise MXNetError(
+                f"env knob {name} already registered "
+                f"({prev.typ.__name__}, default {prev.default!r}) — "
+                "duplicate declaration; every knob is declared exactly "
+                "once in mxnet_tpu/util/env.py")
         _KNOBS[name] = k
     return k
 
@@ -74,7 +99,13 @@ def is_declared(name: str) -> bool:
 def knobs() -> List[Knob]:
     """All declared knobs, sorted by name (docs generation order)."""
     with _LOCK:
-        return sorted(_KNOBS.values())
+        return sorted(_KNOBS.values(), key=lambda k: k.name)
+
+
+def tunables() -> List[Knob]:
+    """The knobs that declared :class:`Tunable` metadata — mxtune's
+    search-space surface, sorted by name."""
+    return [k for k in knobs() if k.tunable is not None]
 
 
 def _get(name: str, typ: type, default: Any) -> Any:
@@ -88,6 +119,10 @@ def _get(name: str, typ: type, default: Any) -> Any:
             f"env knob {name} is declared as {knob.typ.__name__}, "
             f"read as {typ.__name__}")
     dflt = knob.default if default is _UNSET else default
+    raw = _os.environ.get(name)
+    if (raw is None or raw == "") and name in _OVERLAY:
+        # precedence: explicit env (non-empty) > tuned overlay > default
+        return _convert_env(name, _OVERLAY[name], typ)
     return _raw_get_env(name, dflt, typ)
 
 
@@ -107,12 +142,97 @@ def get_float(name: str, default: Any = _UNSET) -> Optional[float]:
     return _get(name, float, default)
 
 
+def apply_overlay(config: Dict[str, Any], fingerprint: str = "",
+                  source: str = "") -> Dict[str, Any]:
+    """Install a tuned-config overlay (mxtune startup / trial runs).
+
+    ``config`` maps knob names to values (any JSON scalar; stored as the
+    string the environment would have carried).  Precedence is fixed:
+    a knob the process env sets explicitly (non-empty) keeps its env
+    value — those names are recorded as ``shadowed``; unregistered names
+    are recorded as ``ignored`` and dropped (a stale store entry naming
+    a since-removed knob must not poison the process).  Returns the
+    application record, also available via :func:`overlay_info` and
+    stamped into mxprof dumps as ``tuned_config``."""
+    global _OVERLAY_META
+    applied, shadowed, ignored = [], [], []
+    with _LOCK:
+        for name in sorted(config):
+            if name not in _KNOBS:
+                ignored.append(name)
+                continue
+            raw = _os.environ.get(name)
+            if raw is not None and raw != "":
+                shadowed.append(name)
+                continue
+            value = config[name]
+            _OVERLAY[name] = ("1" if value else "0") \
+                if isinstance(value, bool) else str(value)
+            applied.append(name)
+        _OVERLAY_META = {
+            "fingerprint": fingerprint,
+            "source": source,
+            "applied": applied,
+            "shadowed": shadowed,
+            "ignored": ignored,
+        }
+        return dict(_OVERLAY_META)
+
+
+def overlay_info() -> Optional[Dict[str, Any]]:
+    """The record of the last :func:`apply_overlay`, or None when no
+    tuned config is active."""
+    with _LOCK:
+        return dict(_OVERLAY_META) if _OVERLAY_META is not None else None
+
+
+def clear_overlay() -> None:
+    with _LOCK:
+        global _OVERLAY_META
+        _OVERLAY.clear()
+        _OVERLAY_META = None
+
+
+# Harness control vars that legitimately use the MXNET_ prefix without
+# being knobs (test seeding, nightly stage marking) — exempt from the
+# unknown-env warning below.
+_NON_KNOB_ENV = {"MXNET_NIGHTLY", "MXNET_TEST_SEED", "MXNET_TEST_PLATFORM"}
+_warned_unknown_env = False
+
+
+def _warn_unknown_env_once() -> None:
+    """Warn (once per process) about MXNET_* env vars that match no
+    registered knob — a typo'd knob is otherwise silently ignored
+    forever.  Runs at the first resolved() call, i.e. the first time
+    anything snapshots the configuration surface."""
+    global _warned_unknown_env
+    with _LOCK:
+        if _warned_unknown_env:
+            return
+        _warned_unknown_env = True
+        known = sorted(_KNOBS)
+    import difflib
+    import warnings
+
+    for name in sorted(_os.environ):
+        if (not name.startswith("MXNET_") or name in _KNOBS
+                or name in _NON_KNOB_ENV):
+            continue
+        close = difflib.get_close_matches(name, known, n=1)
+        hint = f" — did you mean {close[0]}?" if close else ""
+        warnings.warn(
+            f"env var {name} is not a registered MXNET_ knob and has "
+            f"no effect{hint} (see docs/env_vars.md)",
+            RuntimeWarning, stacklevel=3)
+
+
 def resolved() -> Dict[str, Any]:
-    """Every declared knob's RESOLVED value (env override or declared
-    default; dynamic defaults resolve to None).  This is the
-    performance-relevant configuration surface of the process — what a
-    bench artifact records so `perf_compare` can say "a knob changed"
-    instead of just "it got slower"."""
+    """Every declared knob's RESOLVED value (env override, tuned
+    overlay, or declared default; dynamic defaults resolve to None).
+    This is the performance-relevant configuration surface of the
+    process — what a bench artifact records so `perf_compare` can say
+    "a knob changed" instead of just "it got slower"."""
+    _warn_unknown_env_once()
     _GET = {int: get_int, bool: get_bool, str: get_str,
             float: get_float}
     out = {}
@@ -191,7 +311,8 @@ declare("MXNET_BACKWARD_DO_MIRROR", bool, False,
 declare("MXNET_FUSED_BUCKET_BYTES", int, 4 << 20,
         "Bucket size for the fused gradient allreduce "
         "(KVStore.pushpull_fused): one collective per ~this many bytes "
-        "of dtype-homogeneous dense gradients.")
+        "of dtype-homogeneous dense gradients.",
+        tunable=Tunable(lo=256 << 10, hi=64 << 20, scale="log"))
 declare("MXNET_FUSED_OPTIMIZER", bool, False,
         "SPMD trainer: concatenate fully-replicated parameters into one "
         "flat optimizer update. Default off — profiling showed the 1-D "
@@ -218,7 +339,8 @@ declare("MXNET_ZERO_MIN_SIZE", int, 2048,
         "Smallest parameter (elements) whose optimizer states shard "
         "across the data axis under MXNET_ZERO_STATES: big tensors "
         "carry the memory, tiny biases would pay collective latency "
-        "for nothing and stay replicated.")
+        "for nothing and stay replicated.",
+        tunable=Tunable(lo=256, hi=65536, scale="log"))
 declare("MXNET_SPMD_BUCKET_BYTES", int, 0,
         "Bucket size for the SPMD mesh-collective gradient reduce "
         "(KVStore.pushpull_fused under MXNET_SPMD=1). 0 = inherit "
@@ -270,16 +392,50 @@ declare("MXNET_COMPILE_CACHE_OPS", bool, False,
 declare("MXNET_FUSED_CACHE_MAX", int, 256,
         "Entry cap of the in-process FusedUpdater executable cache "
         "(LRU eviction past it). One entry per optimizer/tree/shape "
-        "signature per device.")
+        "signature per device.",
+        tunable=Tunable(lo=32, hi=1024, scale="log"))
 declare("MXNET_OP_CACHE_MAX", int, 4096,
         "Entry cap of each in-process ops-registry executable cache "
         "(jit and grad, LRU eviction past it). One entry per "
-        "(op, attrs) — plus signature when MXNET_COMPILE_CACHE_OPS=1.")
+        "(op, attrs) — plus signature when MXNET_COMPILE_CACHE_OPS=1.",
+        tunable=Tunable(lo=512, hi=16384, scale="log"))
+
+# -- autotune ---------------------------------------------------------------
+declare("MXNET_AUTOTUNE", bool, True,
+        "Apply the stored tuned knob config (mxtune) at import when the "
+        "config store has a matching winner: tuned values become the "
+        "process defaults via an env-overlay that any explicitly set "
+        "MXNET_* variable always overrides. 0 boots on declared "
+        "defaults only. See docs/autotune.md.")
+declare("MXNET_AUTOTUNE_DIR", str, "",
+        "Directory of the persistent tuned-config store "
+        "(autotune.store). Empty = derive <MXNET_COMPILE_CACHE_DIR>/"
+        "autotune when the compile cache dir is set, else the store "
+        "is off and startup never applies a tuned config.")
+declare("MXNET_AUTOTUNE_SCENARIO", str, "",
+        "Scenario tag the startup overlay matches store entries "
+        "against (a model fingerprint or a named bench scenario such "
+        "as 'mlp_train'). Empty = accept the newest entry for this "
+        "framework version regardless of scenario.")
+declare("MXNET_AUTOTUNE_TRIAL_TIMEOUT_S", float, 120.0,
+        "Wall-clock budget of one autotune trial subprocess "
+        "(tools/autotune.py). Past it the trial is killed and counted "
+        "as pruned — a hung or crashed trial must never crash the "
+        "tune itself.")
+
+# -- data pipeline ----------------------------------------------------------
+declare("MXNET_PREFETCH_DEPTH", int, None,
+        "DataLoader prefetch depth: batches each iterator keeps in "
+        "flight ahead of the consumer, in both the process and thread "
+        "worker pools. Default is computed: 2 * num_workers. The "
+        "DataLoader(prefetch=) argument overrides per loader.",
+        tunable=Tunable(lo=1, hi=16, scale="log"))
 
 # -- resilience -------------------------------------------------------------
 declare("MXNET_BREAKER_COOLDOWN_MS", float, 1000.0,
         "Serving circuit breaker: milliseconds an OPEN breaker waits "
-        "before letting one half-open probe request through.")
+        "before letting one half-open probe request through.",
+        tunable=Tunable(lo=100.0, hi=5000.0, scale="log"))
 declare("MXNET_BREAKER_THRESHOLD", int, 5,
         "Serving circuit breaker: consecutive executor failures that "
         "open the breaker (that model answers 503 until a probe "
@@ -348,7 +504,8 @@ declare("MXNET_DRAIN_TIMEOUT_MS", float, 30000.0,
         "the shutdown hanging forever on a wedged batch.")
 declare("MXNET_RETRY_BASE_MS", float, 50.0,
         "Retry policy: first backoff delay in milliseconds (doubles "
-        "per attempt, jittered ±50%, capped at MXNET_RETRY_MAX_MS).")
+        "per attempt, jittered ±50%, capped at MXNET_RETRY_MAX_MS).",
+        tunable=Tunable(lo=10.0, hi=500.0, scale="log"))
 declare("MXNET_RETRY_BUDGET_MS", float, 10000.0,
         "Retry policy: hard wall-clock budget across all attempts of "
         "one call, including backoff sleeps.")
@@ -356,7 +513,8 @@ declare("MXNET_RETRY_MAX_ATTEMPTS", int, 3,
         "Retry policy: total attempts per retryable call site "
         "(1 = no retry). Only transient errors retry.")
 declare("MXNET_RETRY_MAX_MS", float, 2000.0,
-        "Retry policy: backoff delay ceiling in milliseconds.")
+        "Retry policy: backoff delay ceiling in milliseconds.",
+        tunable=Tunable(lo=500.0, hi=10000.0, scale="log"))
 
 # -- observability ----------------------------------------------------------
 declare("MXNET_GOODPUT", bool, False,
@@ -394,7 +552,8 @@ declare("MXNET_HEALTH_EVERY", int, 1,
         "in-graph skip_step guard runs EVERY step regardless, and "
         "the raise policy checks every step synchronously (a "
         "cadence-skipped NaN step would otherwise be written back "
-        "before the raise).")
+        "before the raise).",
+        tunable=Tunable(lo=1, hi=64, scale="log"))
 declare("MXNET_HEALTH_POLICY", str, "record",
         "What a nonfinite gradient step does: 'record' (event + "
         "metrics only), 'raise' (NonFiniteGradient from Trainer.step, "

@@ -110,15 +110,18 @@ class TestEnvDocsSync:
         monkeypatch.setenv("MXNET_FUSED_BUCKET_BYTES", "")
         assert env.get_int("MXNET_FUSED_BUCKET_BYTES") == 4 << 20
 
-    def test_conflicting_redeclaration_raises(self):
-        with pytest.raises(mx.MXNetError):
+    def test_duplicate_declaration_raises(self):
+        with pytest.raises(mx.MXNetError, match="already registered"):
             env.declare("MXNET_ENGINE_TYPE", int, 3, "conflict")
-        # identical re-declaration is idempotent
-        k = env.declare("MXNET_USE_PALLAS", bool, True,
+        # even an IDENTICAL re-declaration is rejected loudly: two call
+        # sites each believing they own a knob is the drift the
+        # registry exists to prevent (the second would silently shadow
+        # doc/tunable edits to the first)
+        with pytest.raises(mx.MXNetError, match="already registered"):
+            env.declare("MXNET_USE_PALLAS", bool, True,
                         "Master switch for Pallas kernels (flash "
                         "attention, fused Conv+BN). 0 selects the XLA "
                         "fallbacks with identical semantics.")
-        assert k.default is True
 
 
 class TestLintDrivenHardening:

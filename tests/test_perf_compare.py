@@ -69,6 +69,13 @@ def _goodput(gate_ok=True, preempt_ok=True, ratio=0.85):
                     "ok": True, "job": {"goodput_ratio": 0.7}}}}
 
 
+def _autotune(io_ok=True, train_ok=True):
+    return {"gate_ok": io_ok and train_ok,
+            "scenarios": {
+                "mlp_train": {"ok": train_ok, "delta": 0.05},
+                "io_bound": {"ok": io_ok, "delta": 0.01}}}
+
+
 class TestCompareArtifact:
     def test_within_tolerance_ok(self):
         res = pc.compare_artifact("SCALING.json", _scaling(1.28),
@@ -282,6 +289,32 @@ class TestCompareArtifact:
         assert res["ok"]
         assert not res["metrics"]  # no metric lanes at all: checks only
 
+    def test_autotune_strict_never_grandfathered(self):
+        """AUTOTUNE.json (ISSUE 16) follows the HEALTH/GOODPUT policy:
+        a stored winner that no longer beats the measured defaults
+        fails even when the committed artifact was already failing."""
+        bad = _autotune(io_ok=False)
+        res = pc.compare_artifact("AUTOTUNE.json", bad, bad,
+                                  tolerance=0.10)
+        assert not res["ok"]
+        assert any("scenarios.io_bound.ok" in f
+                   for f in res["new_integrity_failures"])
+        assert any("gate_ok" in f
+                   for f in res["new_integrity_failures"])
+
+    def test_autotune_clean_passes_with_no_pct_lane(self):
+        """The objective deltas are noise-dominated quick-sweep goodput
+        ratios: the signal is ordinal (tuned >= default, per-scenario
+        ok), never a relative-% metric lane."""
+        res = pc.compare_artifact("AUTOTUNE.json", _autotune(),
+                                  _autotune(), tolerance=0.10)
+        assert res["ok"]
+        assert not res["metrics"]
+
+    def test_autotune_in_default_artifacts(self):
+        assert "AUTOTUNE.json" in pc.DEFAULT_ARTIFACTS
+        assert "AUTOTUNE.json" in pc.EXTRACTORS
+
     def test_serving_extractor(self):
         b = {"unbatched": {"qps": 588.7}, "batched": {"qps": 987.9},
              "batched_over_unbatched": 1.68}
@@ -421,7 +454,12 @@ class TestSuspects:
         assert any("program fingerprints stable" in c
                    for c in per["context"])
 
-    def test_clean_run_has_no_suspects_section(self, tmp_path):
+    def test_clean_run_has_empty_suspects_array(self, tmp_path):
+        """ISSUE 16: the top-level suspects array is a STABLE schema —
+        always present (empty on a clean run) so tools/autotune.py
+        --from-suspects parses an artifact, not a sometimes-there
+        debugging extra.  Per-artifact suspect sections still only
+        appear on failing lanes."""
         bd, fd = tmp_path / "b", tmp_path / "f"
         bd.mkdir(), fd.mkdir()
         (bd / "SCALING.json").write_text(json.dumps(_scaling_attr()))
@@ -431,8 +469,31 @@ class TestSuspects:
                         str(fd), "--artifacts", "SCALING.json",
                         "--out", out]) == 0
         rep = json.load(open(out))
-        assert "suspects" not in rep
+        assert rep["suspects"] == []
         assert "suspects" not in rep["artifacts"]["SCALING.json"]
+
+    def test_suspects_array_schema(self, tmp_path):
+        """Every merged suspect carries the fields the autotune
+        feedback channel consumes: kind, name, score, rank, artifact —
+        ranked best-first from 1."""
+        bd, fd = tmp_path / "b", tmp_path / "f"
+        bd.mkdir(), fd.mkdir()
+        (bd / "SCALING.json").write_text(
+            json.dumps(_scaling_attr(tp=1.3, gar=0.5)))
+        (fd / "SCALING.json").write_text(
+            json.dumps(_scaling_attr(tp=0.8, gar=1.5, knob=4096)))
+        out = str(tmp_path / "rep.json")
+        assert pc.main(["--baseline-dir", str(bd), "--fresh-dir",
+                        str(fd), "--artifacts", "SCALING.json",
+                        "--out", out]) == 1
+        sus = json.load(open(out))["suspects"]
+        assert isinstance(sus, list) and sus
+        for i, s in enumerate(sus):
+            assert isinstance(s["kind"], str)
+            assert isinstance(s["name"], str)
+            assert isinstance(s["score"], (int, float))
+            assert s["rank"] == i + 1
+            assert s["artifact"] == "SCALING.json"
 
     def test_failing_lane_without_aggregates_still_reports(
             self, tmp_path):

@@ -1,0 +1,363 @@
+#!/usr/bin/env python
+"""mxtune driver: sweep knob configs through short measured runs and
+commit the winners (ISSUE 16).
+
+Each trial is a BOUNDED SUBPROCESS running one scenario (below) with
+the candidate config injected as ``MXNET_*`` environment variables —
+the same spelling an operator would use, so the env-overlay precedence
+rules are exercised for real.  The child embeds a fresh mxgoodput
+ledger and the mxprof flight recorder; its objective is the goodput
+ratio, tiebroken by mxprof MFU and throughput.  A child that crashes,
+hangs past MXNET_AUTOTUNE_TRIAL_TIMEOUT_S, or prints garbage is a
+PRUNED trial, never a crashed tune.
+
+Scenarios (one training, one io-bound, per the AUTOTUNE.json gate):
+
+* ``mlp_train`` — the goodput_report clean-run MLP (Dense 32x64, sgd
+  momentum), warmup outside the ledger; sweeps the fused-step /
+  cache-size knobs.
+* ``io_bound`` — DataLoader with thread workers over a numpy-decode
+  dataset feeding a tiny train step; sweeps MXNET_PREFETCH_DEPTH (the
+  host->device prefetch dimension) where the goodput ratio directly
+  prices data-wait.
+
+Winners persist to the autotune config store (beside the compile
+cache — ``mxnet_tpu/autotune/store.py``) keyed by (scenario, mesh,
+device_kind, framework version), so a fresh process on this machine
+boots already-tuned via the startup overlay.  Explicit env settings
+always override stored winners.
+
+    python tools/autotune.py --quick --out AUTOTUNE.json
+    python tools/autotune.py --from-suspects PERF_COMPARE.json
+    python tools/autotune.py --scenarios io_bound --store-dir /tmp/tuned
+
+Exit: 0 when every scenario's tuned config >= its measured default
+(gate_ok), 1 otherwise, always 0 under --no-gate.
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# scenario -> default sweep dimensions (overridden by --from-suspects)
+SCENARIO_DIMS = {
+    "mlp_train": ["MXNET_FUSED_BUCKET_BYTES", "MXNET_FUSED_CACHE_MAX",
+                  "MXNET_OP_CACHE_MAX", "MXNET_ZERO_MIN_SIZE"],
+    "io_bound": ["MXNET_PREFETCH_DEPTH", "MXNET_OP_CACHE_MAX"],
+}
+
+
+# ---------------------------------------------------------------------------
+# trial child (--_trial): one measured run, one JSON line on stdout
+# ---------------------------------------------------------------------------
+
+def _trial_mlp_train(steps: int):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon import Trainer, nn
+    from mxnet_tpu.telemetry import mxgoodput
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.Dense(32, in_units=64)
+    net.initialize()
+    tr = Trainer(net.collect_params(), "sgd",
+                 {"learning_rate": 1e-3, "momentum": 0.9})
+    x = nd.array(np.random.rand(64, 64).astype("float32"))
+
+    def one_step():
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(64)
+
+    for _ in range(2):  # warmup (and its compiles) outside the ledger
+        one_step()
+    mxgoodput.enable(fresh=True)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        one_step()
+    wall = time.perf_counter() - t0
+    return mxgoodput.snapshot(), steps / max(wall, 1e-9)
+
+
+def _trial_io_bound(steps: int):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon import Trainer, nn
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.telemetry import mxgoodput
+
+    class _Decode:
+        """Simulated decode/augment (GIL released inside numpy) — the
+        bench_dataloader NumpyHeavy shape, sized for short trials."""
+
+        def __init__(self, n):
+            self.n = n
+            self.img = np.random.RandomState(0) \
+                .rand(128, 128, 3).astype(np.float32)
+
+        def __len__(self):
+            return self.n
+
+        def __getitem__(self, i):
+            x = self.img * (1.0 + 0.01 * (i % 7))
+            x = x[::-1].copy()
+            x = (x - x.mean()) / (x.std() + 1e-6)
+            return x.astype(np.float32)
+
+    batch = 8
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.Dense(8, in_units=64)
+    net.initialize()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 1e-3})
+    xs = nd.array(np.random.rand(batch, 64).astype("float32"))
+
+    def one_step():
+        with autograd.record():
+            loss = (net(xs) ** 2).sum()
+        loss.backward()
+        tr.step(batch)
+
+    # prefetch depth is read at construction: build the loader AFTER the
+    # config landed in the env (it did — we are the subprocess)
+    dl = DataLoader(_Decode((steps + 4) * batch), batch_size=batch,
+                    num_workers=2, worker_pool="thread")
+    one_step()  # compile warmup outside the ledger
+    it = iter(dl)
+    next(it)    # thread-pool spin-up outside the ledger too
+    mxgoodput.enable(fresh=True)
+    t0 = time.perf_counter()
+    n = 0
+    for b in itertools.islice(it, steps):
+        n += b.shape[0] if hasattr(b, "shape") else len(b)
+        one_step()
+    wall = time.perf_counter() - t0
+    return mxgoodput.snapshot(), n / max(wall, 1e-9)
+
+
+def run_trial(scenario: str, steps: int) -> int:
+    from mxnet_tpu.telemetry import mxprof
+
+    mxprof.enable()
+    fn = {"mlp_train": _trial_mlp_train,
+          "io_bound": _trial_io_bound}[scenario]
+    snap, throughput = fn(steps)
+    prof = mxprof.snapshot(live_hbm=False, include_records=False)
+    mfu = (prof.get("summary") or {}).get("mfu_mean")
+    result = {
+        "ok": True,
+        "objective": snap["goodput_ratio"],
+        "tiebreak": [mfu if mfu is not None else 0.0, throughput],
+        "goodput": {k: snap[k] for k in
+                    ("goodput_ratio", "wall_s", "productive_s", "steps")},
+        "throughput": throughput,
+    }
+    print(json.dumps(result))
+    # skip interpreter teardown: the measurement is on stdout, and the
+    # loader's worker threads + jax occasionally SIGABRT during exit
+    # cleanup — that must not read as a crashed trial
+    sys.stdout.flush()
+    os._exit(0)
+
+
+# ---------------------------------------------------------------------------
+# parent: subprocess runner + sweep
+# ---------------------------------------------------------------------------
+
+def _subprocess_runner(scenario: str, timeout_s: float, log):
+    def runner(config, budget):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = os.environ.get("JAX_PLATFORMS", "cpu")
+        # measure THIS config, not a previously stored winner
+        env["MXNET_AUTOTUNE"] = "0"
+        for name, value in config.items():
+            env[name] = ("1" if value else "0") \
+                if isinstance(value, bool) else str(value)
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--_trial", scenario, "--steps", str(budget)]
+        try:
+            p = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=timeout_s, env=env, cwd=_REPO)
+        except subprocess.TimeoutExpired:
+            log(f"  trial timeout ({timeout_s:.0f}s) — pruned: {config}")
+            return None
+        # a result line on stdout is the measurement — accept it even
+        # on a dirty exit status (teardown crashes after the print are
+        # the child's problem, not the config's); no line = pruned
+        for line in reversed(p.stdout.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+        log(f"  trial rc={p.returncode}, no result line — "
+            f"pruned: {config}")
+        return None
+
+    return runner
+
+
+def sweep_scenario(scenario: str, dim_names, *, seed: int, quick: bool,
+                   timeout_s: float, log) -> dict:
+    import random
+
+    from mxnet_tpu import autotune
+
+    dims = autotune.dimensions(dim_names)
+    runner = _subprocess_runner(scenario, timeout_s, log)
+    result = autotune.successive_halving(
+        runner, dims,
+        rng=random.Random(seed),
+        n_initial=4 if quick else 8,
+        rungs=2 if quick else 3,
+        base_budget=3 if quick else 4,
+        log=lambda m: log(f"  {m}"))
+    result["dims"] = [d.name for d in dims]
+    # the scenario gate: a measured default AND tuned >= default on the
+    # objective (the latter holds by argmax construction whenever both
+    # measurements exist — see autotune/search.py)
+    result["ok"] = bool(result["ok"]
+                        and result["default_objective"] is not None
+                        and result["delta"] is not None
+                        and result["delta"] >= 0)
+    return result
+
+
+def _priority_from_file(path: str, log):
+    from mxnet_tpu import autotune
+
+    with open(path) as f:
+        report = json.load(f)
+    suspects = report.get("suspects")
+    if not isinstance(suspects, list):
+        log(f"{path} has no top-level suspects array — regenerate it "
+            "with tools/perf_compare.py")
+        return None
+    names = autotune.priority_from_suspects(suspects)
+    if not names:
+        log(f"{path}: no tunable knob suspects among "
+            f"{len(suspects)} suspects — using scenario defaults")
+        return None
+    log(f"priority dimensions from {path}: {names}")
+    return names
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="sweep knob configs, persist winners, emit "
+                    "AUTOTUNE.json")
+    ap.add_argument("--scenarios", default="mlp_train,io_bound",
+                    help="comma-separated scenario names "
+                         f"(known: {sorted(SCENARIO_DIMS)})")
+    ap.add_argument("--from-suspects", default=None, metavar="PERF_COMPARE",
+                    help="read a perf_compare report and sweep its "
+                         "ranked tunable knob suspects first (the "
+                         "mxtriage feedback channel)")
+    ap.add_argument("--quick", action="store_true",
+                    help="bounded nightly sweep: fewer arms, fewer "
+                         "rungs, smaller budgets")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--store-dir", default=None,
+                    help="persist winners here (default: "
+                         "MXNET_AUTOTUNE_DIR, else "
+                         "<MXNET_COMPILE_CACHE_DIR>/autotune, else "
+                         "no persistence)")
+    ap.add_argument("--out", default="AUTOTUNE.json")
+    ap.add_argument("--no-gate", action="store_true",
+                    help="emit the report but exit 0 regardless "
+                         "(tier-1 CLI smoke lane)")
+    ap.add_argument("--_trial", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--steps", type=int, default=4, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args._trial is not None:
+        return run_trial(args._trial, args.steps)
+
+    def log(msg):
+        print(msg, file=sys.stderr)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autotune
+    from mxnet_tpu.util import env as _env
+
+    scenarios = [s.strip() for s in args.scenarios.split(",") if s.strip()]
+    unknown = [s for s in scenarios if s not in SCENARIO_DIMS]
+    if unknown:
+        print(f"error: unknown scenario(s) {unknown} "
+              f"(known: {sorted(SCENARIO_DIMS)})", file=sys.stderr)
+        return 2
+
+    priority = None
+    if args.from_suspects:
+        priority = _priority_from_file(args.from_suspects, log)
+
+    timeout_s = _env.get_float("MXNET_AUTOTUNE_TRIAL_TIMEOUT_S")
+    store_dir = args.store_dir if args.store_dir is not None \
+        else autotune.default_dir()
+    report = {
+        "metric": "autotune_goodput",
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+        "framework_version": mx.__version__,
+        "quick": bool(args.quick),
+        "priority": priority,
+        "scenarios": {},
+        "store": {"dir": store_dir or None, "persisted": []},
+    }
+    for scenario in scenarios:
+        dim_names = priority or SCENARIO_DIMS[scenario]
+        log(f"sweeping {scenario} over {dim_names} "
+            f"({'quick' if args.quick else 'full'}) ...")
+        res = sweep_scenario(scenario, dim_names, seed=args.seed,
+                             quick=args.quick, timeout_s=timeout_s,
+                             log=log)
+        report["scenarios"][scenario] = res
+        log(f"{scenario}: objective {res['default_objective']} -> "
+            f"{res['best_objective']} (delta {res['delta']}, "
+            f"{res['trials']} trials, {res['crashed']} crashed)"
+            + ("" if res["ok"] else " — GATE LANE FALSE"))
+        if res["ok"] and res["best_config"] and store_dir:
+            store = autotune.ConfigStore(store_dir)
+            key = autotune.entry_key(
+                scenario=scenario, mesh=[1], device_kind="",
+                framework_version=mx.__version__,
+                platform=os.environ.get("JAX_PLATFORMS", "") or "")
+            path = store.put(key, res["best_config"],
+                             res["best_objective"],
+                             meta={"quick": bool(args.quick),
+                                   "dims": res["dims"]})
+            report["store"]["persisted"].append(path)
+            log(f"  persisted winner -> {path}")
+
+    report["gate_ok"] = all(r["ok"]
+                            for r in report["scenarios"].values())
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({"gate_ok": report["gate_ok"],
+                      "scenarios": {s: r["ok"] for s, r in
+                                    report["scenarios"].items()}}))
+    print(f"wrote {args.out}", file=sys.stderr)
+    if not report["gate_ok"]:
+        print("GATE " + ("SKIPPED" if args.no_gate else "FAILED")
+              + ": a scenario's tuned config failed to match its "
+                "measured default", file=sys.stderr)
+        return 0 if args.no_gate else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

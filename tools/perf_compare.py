@@ -4,7 +4,8 @@ committed ones.
 
 The nightly refreshes the tracked bench artifacts (FUSED_BENCH.json,
 SCALING.json, SERVING_BENCH.json, COMPILE_CACHE.json, HEALTH.json,
-GOODPUT.json, RESILIENCE.json) in the work tree; this tool compares
+GOODPUT.json, RESILIENCE.json, AUTOTUNE.json) in the work tree; this
+tool compares
 each against the version committed
 at --ref (``git show REF:NAME``) and fails on
 
@@ -34,6 +35,10 @@ at --ref (``git show REF:NAME``) and fails on
     a recovery regression or gate_ok=false is never grandfathered.
     MTTR gates absolutely inside the bench (--max-recovery-s), not as
     a relative lane (restart wall is jax-import-noise dominated).
+  * an **autotune failure** (AUTOTUNE.json): same strict policy — a
+    stored tuned config that no longer beats the defaults on the
+    goodput objective (gate_ok / any scenario ok false) fails the
+    nightly rather than shipping a stale winner.
 
 Artifacts missing on either side are reported and skipped — a bench
 stage that timed out must fail the nightly through its own return
@@ -68,7 +73,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 DEFAULT_ARTIFACTS = ("FUSED_BENCH.json", "SCALING.json",
                      "SERVING_BENCH.json", "COMPILE_CACHE.json",
-                     "HEALTH.json", "GOODPUT.json", "RESILIENCE.json")
+                     "HEALTH.json", "GOODPUT.json", "RESILIENCE.json",
+                     "AUTOTUNE.json")
 
 _ATTRIBUTION_PATH = os.path.join(
     _REPO, "mxnet_tpu", "telemetry", "mxtriage", "attribution.py")
@@ -217,6 +223,24 @@ def _resilience(d) -> dict:
     return {"checks": c, "strict": True}
 
 
+def _autotune(d) -> dict:
+    """AUTOTUNE.json: the tuned-vs-default gate lanes, ALL STRICT — a
+    stale stored winner that now loses to the defaults (gate_ok or a
+    scenario's ok flipping false) fails the nightly outright, never
+    grandfathered.  Deliberately no relative-% lane on the objective:
+    the quick-sweep goodput ratios are tiny-step noise-dominated
+    (GOODPUT.json precedent); the signal that matters is ordinal —
+    tuned >= default — and that is exactly what each scenario's `ok`
+    carries."""
+    c = {}
+    if "gate_ok" in d:
+        c["gate_ok"] = bool(d["gate_ok"])
+    for scen, row in (d.get("scenarios") or {}).items():
+        if isinstance(row, dict) and "ok" in row:
+            c[f"scenarios.{scen}.ok"] = bool(row["ok"])
+    return {"checks": c, "strict": True}
+
+
 EXTRACTORS = {
     "FUSED_BENCH.json": _fused,
     "SERVING_BENCH.json": _serving,
@@ -225,6 +249,7 @@ EXTRACTORS = {
     "HEALTH.json": _health,
     "GOODPUT.json": _goodput,
     "RESILIENCE.json": _resilience,
+    "AUTOTUNE.json": _autotune,
 }
 
 
@@ -384,8 +409,10 @@ def main(argv=None) -> int:
     merged.sort(key=lambda s: -s["score"])
     for i, s in enumerate(merged):
         s["rank"] = i + 1
-    if merged:
-        report["suspects"] = merged
+    # ALWAYS present (possibly empty): `tools/autotune.py
+    # --from-suspects PERF_COMPARE.json` parses this array as a stable
+    # machine-readable schema, not a sometimes-there debugging extra
+    report["suspects"] = merged
     report["ok"] = not failures
     if args.out:
         with open(args.out, "w") as f:

@@ -525,6 +525,43 @@ def main():
         goodput_rc = -1
         artifact["goodput"] = {"returncode": -1, "note": "timed out"}
 
+    # autotune stage (ISSUE 16): the slow mxtune e2e tests (subprocess
+    # boot-tuned proof, CLI quick sweep) plus a quick bounded sweep on
+    # both gate scenarios refreshing AUTOTUNE.json — the tracked
+    # artifact perf_compare gates with STRICT lanes, so a stored winner
+    # that regresses below the measured default fails the nightly.
+    # Runs BEFORE perf-compare so the artifact it diffs is fresh.
+    autotune_rc = None
+    try:
+        asl = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests/test_autotune.py",
+             "-q", "-m", "slow", "-p", "no:cacheprovider"],
+            capture_output=True, text=True, timeout=900, cwd=_REPO,
+            env=cpu_env)
+        at = subprocess.run(
+            [sys.executable, "tools/autotune.py", "--quick",
+             "--out", os.path.join(_REPO, "AUTOTUNE.json")],
+            capture_output=True, text=True, timeout=900, cwd=_REPO,
+            env=cpu_env)
+        autotune_rc = at.returncode if at.returncode != 0 \
+            else asl.returncode
+        gate = {"returncode": at.returncode,
+                "slow_tests_returncode": asl.returncode,
+                "slow_tests_tail":
+                    "\n".join(asl.stdout.splitlines()[-1:]),
+                "stderr_tail": "\n".join(at.stderr.splitlines()[-6:])}
+        try:
+            rep = json.loads([ln for ln in at.stdout.splitlines()
+                              if ln.startswith("{")][-1])
+            gate["gate_ok"] = rep["gate_ok"]
+            gate["scenarios"] = rep["scenarios"]
+        except (IndexError, ValueError, KeyError):
+            pass
+        artifact["autotune"] = gate
+    except subprocess.TimeoutExpired:
+        autotune_rc = -1
+        artifact["autotune"] = {"returncode": -1, "note": "timed out"}
+
     # perf-compare gate (ISSUE 10): the bench artifacts this nightly
     # just refreshed (FUSED/SCALING/COMPILE_CACHE/HEALTH; SERVING when
     # its strict lane rewrote it) vs the committed versions — >10%
@@ -561,7 +598,7 @@ def main():
         and spmd_rc in (None, 0) and heavy_rc in (None, 0) \
         and mxprof_rc in (None, 0) and health_rc in (None, 0) \
         and triage_rc in (None, 0) and goodput_rc in (None, 0) \
-        and perf_rc in (None, 0) else 1
+        and autotune_rc in (None, 0) and perf_rc in (None, 0) else 1
 
 
 if __name__ == "__main__":
