@@ -152,6 +152,11 @@ class AutoCheckpoint:
         goodput recovery window cross-process."""
         self._preempt_info = {"reason": reason,
                               "t_unix": time.time(), "kind": kind}
+        from ..telemetry import mxblackbox as _bb
+
+        if _bb._ACTIVE:
+            _bb.emit("checkpoint", "failure stamped",
+                     step=self.step, reason=reason, kind=kind)
 
     def on_step(self, trainer) -> None:
         """Called by Trainer.step after the update.  Preemption wins
@@ -202,6 +207,14 @@ class AutoCheckpoint:
             self._ensure_writer()
             self._q.put(snap)
         self._record_blocking("save", time.monotonic() - t0, retry_mark)
+        from ..telemetry import mxblackbox as _bb
+
+        if _bb._ACTIVE:
+            # same msg text on every rank saving this step: the
+            # postmortem uses matched checkpoint events as cross-rank
+            # clock-sync marks (trace_report's collective-end analog)
+            _bb.emit("checkpoint", f"save step {snap['step']}",
+                     step=snap["step"], sync=sync)
         return final
 
     @staticmethod
@@ -401,7 +414,8 @@ class AutoCheckpoint:
 
     # ---- resume path ----------------------------------------------------
 
-    def resume(self, path: Optional[str] = None) -> Optional[dict]:
+    def resume(self, path: Optional[str] = None,
+               incident: Optional[str] = None) -> Optional[dict]:
         """Restore the newest checkpoint into the attached trainer;
         returns its meta dict ({"step", "position", ...}) or None when
         the directory has no checkpoint (fresh start).  The restore
@@ -413,7 +427,12 @@ class AutoCheckpoint:
         one in this checkpointer's own dir — the elastic restart path:
         every rank of a recovered job resumes from the ONE step dir the
         supervisor's commit marker elected, so ranks can never mix
-        steps even when their own checkpoint cadences diverged."""
+        steps even when their own checkpoint cadences diverged.
+
+        ``incident`` is the mxblackbox incident id the elastic COMMIT
+        marker carries: it stamps the goodput recovery window this
+        resume opens, tying the measured downtime to its postmortem
+        report."""
         from ..ndarray.ndarray import array as nd_array
         from ..resource import resource_manager
 
@@ -434,7 +453,8 @@ class AutoCheckpoint:
                 _goodput.on_preemption_resume(
                     meta["preempt"].get("t_unix"),
                     category=self._recovery_category(
-                        meta["preempt"].get("kind", "preempt")))
+                        meta["preempt"].get("kind", "preempt")),
+                    incident=incident)
             # the stamp is CONSUMED by this resume: a later resume
             # from the same checkpoint (crash after hours of resumed
             # training) must not re-open a window back to the original
@@ -461,6 +481,12 @@ class AutoCheckpoint:
         self._record_blocking("restore", time.monotonic() - t0,
                               retry_mark)
         preemption.clear()
+        from ..telemetry import mxblackbox as _bb
+
+        if _bb._ACTIVE:
+            _bb.emit("checkpoint", f"restore step {meta['step']}",
+                     step=int(meta["step"]), path=path,
+                     incident=incident)
         return meta
 
     def _consume_preempt_stamp(self, path: str, meta: Dict) -> None:

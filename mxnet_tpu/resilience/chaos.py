@@ -226,8 +226,15 @@ def check(kind: str) -> Optional[str]:
         _INJECTED[kind] = _INJECTED.get(kind, 0) + 1
         action, duration = plan.action, plan.duration
     from ..telemetry import instruments as _ins
+    from ..telemetry import mxblackbox as _bb
 
     _ins.fault_injected_total(kind).inc()
+    if _bb._ACTIVE:
+        # fired OUTSIDE _LOCK (the journal takes its own leaf lock);
+        # the entry lands before the action so a 'die' caller's
+        # os._exit still leaves the fire on disk
+        _bb.emit("chaos", f"fault fired at site '{kind}' call #{nth}",
+                 kind=kind, action=action, nth=nth)
     if action == "error":
         raise FaultInjected(kind, nth)
     if action == "hang":

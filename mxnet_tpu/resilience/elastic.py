@@ -166,10 +166,16 @@ def guard(auto_ckpt=None, exit_fn=None):
 
     ``exit_fn`` is injectable for tests; the default is a hard
     ``os._exit`` (see :func:`_hard_exit`)."""
+    from ..telemetry import mxblackbox as _bb
+
     ex = exit_fn or _hard_exit
     try:
         yield
     except PeerFailed as e:
+        if _bb._ACTIVE:
+            _bb.emit("elastic",
+                     f"peer failure observed: {e.what or 'collective'}",
+                     poisoned=e.poisoned)
         if auto_ckpt is not None:
             try:
                 auto_ckpt.stamp_failure(f"peer-failure: {e}")
@@ -180,8 +186,20 @@ def guard(auto_ckpt=None, exit_fn=None):
                 # reserved rc is what recovery actually depends on
                 print(f"[mxelastic] peer-failure checkpoint failed: "
                       f"{save_err}", file=sys.stderr, flush=True)
+        if _bb._ACTIVE:
+            # bundle AFTER the save so the journal tail shows the
+            # stamp+checkpoint this exit cut; category 'peer_failed'
+            # is a coordinated exit — postmortem never attributes the
+            # first failure to the rank that merely OBSERVED it
+            _bb.write_crash_bundle(
+                "peer_failed", reason=str(e), exc=e,
+                exit_record={"rc": RC_PEER_FAILED})
         ex(RC_PEER_FAILED)
-    except Preempted:
+    except Preempted as e:
+        if _bb._ACTIVE:
+            _bb.write_crash_bundle(
+                "preempted", reason=str(e),
+                exit_record={"rc": RC_WINDDOWN})
         ex(RC_WINDDOWN)
 
 
@@ -205,6 +223,13 @@ class WorkerContext:
                 "directory/worker_rank")
         self.rank = int(r)
         self.heartbeat = HeartbeatWriter(d, self.rank)
+        from ..telemetry import mxblackbox as _bb
+
+        if _bb._ACTIVE:
+            # identical msg on every rank of a generation: postmortem
+            # uses matched elastic events as clock-sync marks
+            _bb.emit("elastic", "generation start",
+                     rank=self.rank, world=world())
 
     def on_step(self, step: int) -> None:
         """Call once per training step: chaos probe first (a ``die``
@@ -214,6 +239,17 @@ class WorkerContext:
 
         if _chaos._ACTIVE:
             if _chaos.check("elastic.worker") == "die":
+                from ..telemetry import mxblackbox as _bb
+
+                if _bb._ACTIVE:
+                    # the dying rank's own flight record — the known-
+                    # answer source postmortem attributes first
+                    # failure from (category, rank, kill step)
+                    _bb.write_crash_bundle(
+                        "chaos",
+                        reason="chaos die at elastic.worker",
+                        step=step,
+                        exit_record={"rc": 1, "cause": "chaos-die"})
                 _hard_exit(1)  # an unreserved rc: this rank IS the failure
         self.heartbeat.beat(step=step)
 
@@ -267,7 +303,8 @@ def scan_rank_checkpoints(directory: str) -> Dict[int, Dict[int, str]]:
 
 def elect_commit(directory: str, cause: str = "rank_failure",
                  epoch: int = 0,
-                 failed_ranks: Optional[List[int]] = None) -> dict:
+                 failed_ranks: Optional[List[int]] = None,
+                 incident_id: Optional[str] = None) -> dict:
     """Pick the job-level resume point and write ``COMMIT.json``
     (atomically): the HIGHEST step for which any rank holds a complete
     checkpoint (ties go to the lowest rank — deterministic).  Every
@@ -278,7 +315,12 @@ def elect_commit(directory: str, cause: str = "rank_failure",
     checkpoint serves the whole job (and ``load_states(
     allow_resize=True)`` re-shards it onto a different world size in
     shrink mode).  ``step`` 0 with no path = no checkpoint yet; the
-    restarted job starts fresh."""
+    restarted job starts fresh.
+
+    ``incident_id`` is the mxblackbox postmortem id of the failure
+    epoch this commit recovers from: restarted ranks read it off the
+    marker and stamp it into the goodput recovery window
+    (``AutoCheckpoint.resume(incident=...)``)."""
     ckpts = scan_rank_checkpoints(directory)
     best_step, best_rank, best_path = 0, None, None
     for r in sorted(ckpts):
@@ -293,6 +335,7 @@ def elect_commit(directory: str, cause: str = "rank_failure",
         "cause": cause,
         "epoch": int(epoch),
         "failed_ranks": sorted(failed_ranks or []),
+        "incident": incident_id,
         "t_unix": time.time(),
     }
     # same crash-consistency bar as the checkpoints it elects: fsync
@@ -399,6 +442,10 @@ class Supervisor:
                              else os.environ)
         self.log_dir = os.path.join(self.dir, "logs")
         os.makedirs(self.log_dir, exist_ok=True)
+        # crash forensics: workers journal/bundle here, the supervisor
+        # scrapes SIGKILLed ranks into it, postmortem merges it
+        self.blackbox_dir = os.path.join(self.dir, "blackbox")
+        os.makedirs(self.blackbox_dir, exist_ok=True)
 
     # -- spawning ---------------------------------------------------------
 
@@ -432,6 +479,12 @@ class Supervisor:
         # distinguish from slow compile.  An operator override stands.
         env.setdefault("MXNET_KVSTORE_TIMEOUT",
                        str(self.collective_timeout))
+        # crash forensics ride every supervised worker (an operator's
+        # explicit MXNET_BLACKBOX=0 / custom dir stands); the
+        # generation stamp is per-spawn, never inherited
+        env.setdefault("MXNET_BLACKBOX", "1")
+        env.setdefault("MXNET_BLACKBOX_DIR", self.blackbox_dir)
+        env["MXNET_BLACKBOX_GEN"] = str(gen)
         return env
 
     def _spawn(self, gen: int, n: int) -> List[dict]:
@@ -442,21 +495,29 @@ class Supervisor:
         for i in range(n):
             log_path = os.path.join(self.log_dir,
                                     f"gen{gen}-rank{i}.log")
+            # stderr gets its OWN per-rank per-generation file (not
+            # merged into stdout): the crash bundle attaches its tail,
+            # and a traceback must not interleave with step output
+            err_path = os.path.join(self.log_dir,
+                                    f"gen{gen}-rank{i}.stderr")
             log = open(log_path, "w")
+            err = open(err_path, "w")
             p = subprocess.Popen(self.worker_cmd,
                                  env=self._worker_env(gen, i, n, port),
-                                 stdout=log, stderr=subprocess.STDOUT)
+                                 stdout=log, stderr=err)
             workers.append({"rank": i, "proc": p, "log": log,
-                            "log_path": log_path})
+                            "log_path": log_path, "err": err,
+                            "err_path": err_path})
         return workers
 
     @staticmethod
     def _close_logs(workers: List[dict]) -> None:
         for w in workers:
-            try:
-                w["log"].close()
-            except OSError:
-                pass  # mxlint: disable=MX007 — log fd teardown only
+            for key in ("log", "err"):
+                try:
+                    w[key].close()
+                except (OSError, KeyError):
+                    pass  # mxlint: disable=MX007 — log fd teardown only
 
     @staticmethod
     def _teardown(workers: List[dict]) -> None:
@@ -489,12 +550,36 @@ class Supervisor:
                         f.read().splitlines()[-lines:])
             except OSError:
                 out[str(w["rank"])] = "(log unreadable)"
+            err = self._stderr_tail(w, lines * 400)
+            if err:
+                out[str(w["rank"])] += "\n--- stderr ---\n" + err
         return out
+
+    @staticmethod
+    def _stderr_tail(w: dict, max_bytes: Optional[int] = None) -> str:
+        """Bounded tail of one worker's stderr file (what the scrape
+        bundle attaches)."""
+        from ..util import env
+
+        if max_bytes is None:
+            max_bytes = (env.get_int("MXNET_BLACKBOX_STDERR_TAIL_KB")
+                         or 64) * 1024
+        path = w.get("err_path")
+        if not path:
+            return ""
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - max_bytes))
+                return f.read().decode("utf-8", "replace")
+        except OSError:
+            return ""
 
     # -- one generation ---------------------------------------------------
 
     def _watch(self, workers: List[dict], mon, committed_step: int,
-               watch_first_step: bool) -> dict:
+               watch_first_step: bool, gen: int = 0) -> dict:
         """Watch one generation to completion or failure epoch.
         Returns {"ok": True} or {"ok": False, "failed": [...],
         "t_detect": mono, "t_first_step": mono|None, ...}."""
@@ -536,6 +621,7 @@ class Supervisor:
                     continue
             # --- failure epoch: wind down, classify ---
             t_detect = time.monotonic()
+            t_detect_unix = time.time()
             for w in workers:
                 if w["proc"].poll() is None:
                     try:
@@ -561,9 +647,74 @@ class Supervisor:
             failed = sorted(set(killed) | {
                 r for r, rc in rcs.items()
                 if rc not in (0,) + RESERVED_RCS})
+            exits = self._exit_records(workers, killed)
+            self._scrape_failed(workers, failed, exits, gen, stamps)
             return {"ok": False, "failed": failed, "rcs": rcs,
-                    "t_detect": t_detect, "t_first_step": t_first_step,
+                    "exits": exits,
+                    "t_detect": t_detect,
+                    "t_detect_unix": t_detect_unix,
+                    "t_first_step": t_first_step,
                     "tails": self._tails(workers)}
+
+    @staticmethod
+    def _exit_records(workers: List[dict], killed: List[int]) -> dict:
+        """Per-rank exit classification that keeps the SIGNAL, not
+        just the rc: Popen returncode < 0 is death-by-signal
+        (``WTERMSIG``), and whether the SIGKILL was the supervisor's
+        own grace-expiry kill or came from outside (the OOM killer)
+        changes the incident's meaning entirely — a chaos ``die``
+        (plain rc 1) must never read like either."""
+        from ..telemetry.mxblackbox import signal_name
+
+        out = {}
+        for w in workers:
+            rc = w["proc"].returncode
+            sig = -rc if rc is not None and rc < 0 else None
+            if w["rank"] in killed:
+                classified = "hung"  # supervisor SIGKILL at grace end
+            elif rc == 0:
+                classified = "clean"
+            elif rc == RC_PEER_FAILED:
+                classified = "peer_failed"
+            elif rc == RC_WINDDOWN:
+                classified = "winddown"
+            elif sig is not None:
+                # killed from OUTSIDE the supervisor: OOM killer,
+                # operator kill, a segfault's SIGSEGV
+                classified = f"killed:{signal_name(sig)}"
+            else:
+                classified = "died"
+            out[str(w["rank"])] = {
+                "rc": rc,
+                "signal": sig,
+                "signal_name": signal_name(sig),
+                "supervisor_sigkill": w["rank"] in killed,
+                "classified": classified,
+            }
+        return out
+
+    def _scrape_failed(self, workers: List[dict], failed: List[int],
+                       exits: dict, gen: int, stamps: dict) -> None:
+        """Supervisor-side crash bundles for the ranks that could not
+        write their own (SIGKILLed / hung / died hard): scrape the
+        rank's journal spill + stderr tail + last heartbeat.  Best-
+        effort — forensics never block recovery."""
+        from ..telemetry.mxblackbox import write_supervisor_bundle
+
+        for w in workers:
+            r = w["rank"]
+            if r not in failed:
+                continue
+            try:
+                hb = stamps.get(r)
+                write_supervisor_bundle(
+                    self.blackbox_dir, r, exits[str(r)], gen=gen,
+                    stderr_path=w.get("err_path"),
+                    stderr_tail=self._stderr_tail(w),
+                    heartbeat=dict(hb) if isinstance(hb, dict)
+                    else None)
+            except Exception:  # noqa: BLE001  # mxlint: disable=MX007 — forensics never block recovery
+                pass
 
     # -- the job ----------------------------------------------------------
 
@@ -590,6 +741,19 @@ class Supervisor:
             # not orphan the live generation
             self._teardown(current)
 
+    def _postmortem(self, epoch: int, gen: int,
+                    res: dict) -> Optional[dict]:
+        """Reconstruct one failure epoch's incident from the blackbox
+        dir (merged cross-rank journals, first-failure attribution)
+        into ``blackbox/INCIDENT-epoch<N>.json``.  Best-effort."""
+        from ..telemetry.mxblackbox import postmortem as _pm
+
+        return _pm.run_epoch(
+            self.blackbox_dir, epoch, gen=gen,
+            t_detect_unix=res.get("t_detect_unix"),
+            failed_ranks=res.get("failed"),
+            exits=res.get("exits"))
+
     def _run_loop(self, mon, report, n, gen, pending,
                   current: List[dict]) -> dict:
         from ..telemetry import instruments as _ins
@@ -602,7 +766,8 @@ class Supervisor:
             current[:] = workers
             try:
                 res = self._watch(workers, mon, committed_step,
-                                  watch_first_step=pending is not None)
+                                  watch_first_step=pending is not None,
+                                  gen=gen)
             finally:
                 self._close_logs(workers)
             current[:] = []  # _watch returns only after every exit
@@ -623,9 +788,16 @@ class Supervisor:
                 report["final_world"] = n
                 return report
             report["restarts"] += 1
+            # incident reconstruction BEFORE the commit election so
+            # the marker (and through it every restarted rank's
+            # recovery window) carries the incident id
+            incident = self._postmortem(report["restarts"], gen, res)
             epoch = {
                 "failed_ranks": res["failed"],
                 "rcs": {str(k): v for k, v in res["rcs"].items()},
+                "exits": res.get("exits", {}),
+                "incident_id": incident.get("incident_id")
+                if incident else None,
                 "world_before": n,
                 "_t_detect": res["t_detect"],
                 "mttr_s": None,
@@ -649,7 +821,8 @@ class Supervisor:
                 n = max(1, n - len(res["failed"]))
             commit = elect_commit(self.dir, cause="rank_failure",
                                   epoch=report["restarts"],
-                                  failed_ranks=res["failed"])
+                                  failed_ranks=res["failed"],
+                                  incident_id=epoch["incident_id"])
             epoch["committed_step"] = commit["step"]
             epoch["committed_source_rank"] = commit["source_rank"]
             epoch["world_after"] = n

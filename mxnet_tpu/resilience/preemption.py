@@ -78,11 +78,22 @@ def trigger(reason: str = "simulated") -> None:
     'peer-failure: ...' reason the recovery accounting routes on."""
     import time as _time
 
+    first = False
     with _LOCK:
         if _TRIGGER_T[0] is None:  # first trigger wins
             _REASON[0] = reason
             _TRIGGER_T[0] = (_time.time(), _time.monotonic())
+            first = True
     _FLAG.set()
+    if first:
+        from ..telemetry import mxblackbox as _bb
+
+        if _bb._ACTIVE:
+            # trigger() can run from a SIGTERM handler: the signal
+            # path enqueues; nothing here takes the journal lock
+            _bb.emit_from_signal("preemption",
+                                 f"preemption stamp: {reason}",
+                                 reason=reason)
 
 
 def triggered() -> bool:

@@ -140,6 +140,8 @@ class RetryPolicy:
                 if not is_transient(e, retry_on):
                     raise
                 if attempt >= self.max_attempts:
+                    self._journal_exhausted(site, "max_attempts",
+                                            attempt, e)
                     raise RetryExhausted(site, errors) from e
                 delay = self.delay_s(attempt, rng)
                 now = time.monotonic()
@@ -147,6 +149,9 @@ class RetryPolicy:
                 past_deadline = deadline is not None and \
                     now + delay >= deadline
                 if over_budget or past_deadline:
+                    self._journal_exhausted(
+                        site, "budget" if over_budget else "deadline",
+                        attempt, e)
                     raise RetryExhausted(site, errors) from e
                 _ins.retry_total(site).inc()
                 # the sleep is real wall-clock the job is NOT training:
@@ -165,6 +170,19 @@ class RetryPolicy:
                     _goodput.record_badput("retry_backoff", slept,
                                            site=site,
                                            overlaps_step=True)
+
+    @staticmethod
+    def _journal_exhausted(site: str, why: str, attempts: int,
+                           exc: BaseException) -> None:
+        """Blackbox feed: an exhaustion is the moment a transient
+        fault became a real failure — exactly what a postmortem needs
+        on the timeline."""
+        from ..telemetry import mxblackbox as _bb
+
+        if _bb._ACTIVE:
+            _bb.emit("retry", f"retry exhausted at '{site}' ({why})",
+                     site=site, why=why, attempts=attempts,
+                     error=repr(exc))
 
 
 _DEFAULT = None

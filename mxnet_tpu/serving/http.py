@@ -172,6 +172,25 @@ def _render_statusz(server) -> str:
             lines.append("  (none firing)")
     except Exception:  # noqa: BLE001
         lines.append("  (engine unavailable)")
+    try:
+        from ..telemetry import mxblackbox as _bb
+
+        if _bb.enabled():
+            evs = _bb.recent(3)
+            last = ", ".join(f"{e.get('category')}:{e.get('msg')}"
+                             for e in evs) or "none"
+            line = f"blackbox: {len(_bb.journal())} events — {last}"
+            inc = _bb.last_incident()
+            if inc is not None:
+                ff = inc.get("first_failure") or {}
+                line += (f"; last incident {inc.get('incident_id')} "
+                         f"(rank {ff.get('rank')} "
+                         f"{ff.get('category')})")
+            lines.append(line)
+        else:
+            lines.append("blackbox: (mxblackbox not enabled)")
+    except Exception:  # noqa: BLE001
+        lines.append("blackbox: (unavailable)")
     lines.append("")
     lines.append(f"rendered {time.strftime('%Y-%m-%d %H:%M:%S')}")
     return "\n".join(lines) + "\n"

@@ -52,6 +52,7 @@ from . import mxgoodput
 from . import mxhealth
 from . import mxtriage
 from . import alerts
+from . import mxblackbox
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricFamily", "MetricsRegistry",
@@ -60,7 +61,7 @@ __all__ = [
     "flow_start", "flow_end", "counter_event",
     "enable", "disable", "enabled",
     "metrics", "tracing", "instruments", "catalog", "mxprof",
-    "mxgoodput", "mxhealth", "mxtriage", "alerts",
+    "mxgoodput", "mxhealth", "mxtriage", "alerts", "mxblackbox",
 ]
 
 

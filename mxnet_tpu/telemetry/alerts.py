@@ -281,6 +281,15 @@ class AlertEngine:
               "severity": rule.severity, "state": state,
               "value": rule.last_value, "spec": rule.spec()}
         self._events.append(ev)
+        from . import mxblackbox as _bb
+
+        if _bb._ACTIVE:
+            # called under the engine lock: the journal's leaf lock
+            # and the instruments registry (already taken under this
+            # lock by alerts_firing above) are the only locks below
+            _bb.emit("alert", f"alert {rule.name} -> {state}",
+                     rule=rule.name, state=state,
+                     severity=rule.severity, value=rule.last_value)
         return ev
 
     def tick(self, now: Optional[float] = None) -> List[dict]:

@@ -45,6 +45,7 @@ __all__ = [
     "alerts_firing", "alerts_total",
     "goodput_ratio", "job_wall_seconds", "badput_seconds_total",
     "retry_backoff_seconds_total", "ckpt_seconds",
+    "blackbox_events_total", "incident_total",
     "build_info", "process_uptime_seconds", "process_rss_bytes",
     "retry_total", "fault_injected_total",
     "compile_cache_hit_total", "compile_cache_miss_total",
@@ -405,6 +406,27 @@ def retry_backoff_seconds_total(site: str):
 
 def ckpt_seconds(op: str, mode: str):
     return _child("mx_ckpt_seconds", (op, mode))
+
+
+# ---- mxblackbox: crash forensics --------------------------------------
+
+_spec("mx_blackbox_events_total", "counter",
+      "mxblackbox event-journal entries emitted, by category: alert "
+      "/ health / chaos / retry / checkpoint / preemption / compile "
+      "/ elastic / crash. 'crash' additionally counts every crash "
+      "bundle written by this process.", ("category",))
+_spec("mx_incident_total", "counter",
+      "Incident reports reconstructed by postmortem (supervisor "
+      "side), by first-failure category — 'unknown' when no bundle "
+      "evidence attributed the failure.", ("category",))
+
+
+def blackbox_events_total(category: str):
+    return _child("mx_blackbox_events_total", (category,))
+
+
+def incident_total(category: str):
+    return _child("mx_incident_total", (category,))
 
 
 # ---- process identity (what is being scraped) -------------------------

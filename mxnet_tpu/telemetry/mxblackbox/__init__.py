@@ -1,0 +1,288 @@
+"""mxblackbox — always-on crash forensics.
+
+mxprof (PR 10) explains a *step*, mxgoodput (PR 13) prices the
+*wall-clock*, mxelastic (PR 14) survives the *death* — mxblackbox
+answers the question the survivor can't: **why did the job die, and
+which rank died first?**
+
+Three pieces:
+
+  * a bounded, lock-cheap per-rank **event journal**
+    (:class:`.journal.EventJournal`): ring + append-only spill file,
+    unifying the streams that already exist but never meet — alert
+    transitions, mxhealth events, chaos fires, retry exhaustions,
+    checkpoint save/restore/commit-election, preemption stamps,
+    compile-provenance misses, elastic lifecycle — each entry with
+    both clocks, rank, step, and category;
+  * **crash bundles** (:mod:`.bundle`) on every abnormal-exit path
+    (``elastic.guard``'s PeerFailed/Preempted branches, the
+    NonFiniteGradient raise, a ``sys.excepthook``/signal last-gasp
+    hook, and a supervisor-side scrape for ranks that died too hard
+    to write their own): journal tail + mxprof ring + goodput ledger
+    + firing alerts + heartbeat ages + knob fingerprint, indexed like
+    mxtriage captures;
+  * **incident reconstruction** (:mod:`.postmortem`,
+    ``tools/postmortem.py``): a generation's bundles merged
+    cross-rank with trace_report-style clock alignment into one
+    causally-ordered ``INCIDENT.json`` naming the first failing rank,
+    category, step, and detection lag.
+
+Enable with ``MXNET_BLACKBOX=1`` or :func:`enable`; the elastic
+Supervisor exports both to its workers.  Disabled cost: every seam is
+one falsy check on ``_ACTIVE`` (the chaos/mxgoodput precedent, held
+to the 3% tier-1 overhead gate).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal as _signal
+import sys
+import threading
+from typing import List, Optional
+
+from ...util import env as _env
+from .bundle import (read_index, signal_name, write_bundle,
+                     write_supervisor_bundle)
+from .journal import EventJournal
+
+__all__ = [
+    "enable", "disable", "enabled", "journal", "emit",
+    "emit_from_signal", "write_crash_bundle", "install_crash_hooks",
+    "base_dir", "recent", "last_bundle", "last_incident",
+    "EventJournal", "write_bundle", "write_supervisor_bundle",
+    "read_index", "signal_name",
+]
+
+#: Fast-path flag: False means every seam (`if _bb._ACTIVE: ...`) is
+#: one falsy check and nothing below ever runs.
+_ACTIVE = False
+
+_lock = threading.Lock()
+_JOURNAL: Optional[EventJournal] = None
+_LAST_BUNDLE: Optional[str] = None
+_HOOKS = False
+_PREV_EXCEPTHOOK = None
+
+
+def base_dir() -> str:
+    return _env.get_str("MXNET_BLACKBOX_DIR") or "mxblackbox"
+
+
+def _rank() -> Optional[int]:
+    from .. import tracing as _tracing
+
+    return _tracing._RANK
+
+
+def journal() -> EventJournal:
+    """The process journal singleton.  Created lazily; recreated ONCE
+    if the job rank becomes known after creation (mxtriage lesson:
+    the rank qualifies the spill filename, and the supervisor scrape
+    looks the dead rank's spill up BY rank)."""
+    global _JOURNAL
+    rank = _rank()
+    j = _JOURNAL
+    if j is not None and (j._rank == rank or j._rank is not None):
+        return j
+    with _lock:
+        j = _JOURNAL
+        if j is None or (j._rank is None and rank is not None):
+            who = f"r{rank}" if rank is not None else f"p{os.getpid()}"
+            nj = EventJournal(
+                directory=base_dir(), who=who, rank=rank,
+                ring=_env.get_int("MXNET_BLACKBOX_RING") or 512,
+                spill_max_bytes=(
+                    _env.get_int("MXNET_BLACKBOX_SPILL_MB") or 8)
+                * 1024 * 1024,
+                gen=_env.get_int("MXNET_BLACKBOX_GEN"))
+            if j is not None:
+                # carry the pre-rank history into the rank journal so
+                # a bundle tail still shows startup events
+                for e in j.tail(nj._ring.maxlen):
+                    nj._ring.append(e)
+                j.close()
+            _JOURNAL = nj
+        return _JOURNAL
+
+
+def emit(category: str, msg: str = "", step: Optional[int] = None,
+         **fields) -> Optional[dict]:
+    """Journal one event (no-op unless enabled).  Seam call shape:
+    ``if _bb._ACTIVE: _bb.emit("chaos", ...)`` — the flag check stays
+    at the call site so the disabled path pays one attribute load."""
+    if not _ACTIVE:
+        return None
+    try:
+        entry = journal().emit(category, msg, step=step, **fields)
+    except Exception:  # noqa: BLE001 — forensics never break the host path
+        return None
+    try:
+        from .. import instruments as _ins
+
+        _ins.blackbox_events_total(category).inc()
+    except Exception:  # noqa: BLE001 — metrics are advisory here
+        pass
+    return entry
+
+
+def emit_from_signal(category: str, msg: str = "",
+                     step: Optional[int] = None, **fields) -> None:
+    """Signal-handler-safe :func:`emit`: enqueue to the journal's
+    daemon drainer and return.  No metric bump here — the registry
+    lock must not be taken from an interrupted frame."""
+    if not _ACTIVE:
+        return
+    try:
+        journal().emit_from_signal(category, msg, step=step, **fields)
+    except Exception:  # noqa: BLE001 — never raise out of a handler
+        pass
+
+
+def write_crash_bundle(category: str, reason: str = "",
+                       step: Optional[int] = None,
+                       exc: Optional[BaseException] = None,
+                       exit_record: Optional[dict] = None,
+                       extra: Optional[dict] = None) -> Optional[str]:
+    """Emit one crash bundle for THIS process (no-op unless enabled).
+    Returns the bundle directory."""
+    global _LAST_BUNDLE
+    if not _ACTIVE:
+        return None
+    d = write_bundle(category, reason=reason, base_dir=base_dir(),
+                     rank=_rank(), step=step, exc=exc,
+                     journal=journal(), exit_record=exit_record,
+                     extra=extra)
+    if d is not None:
+        _LAST_BUNDLE = d
+    return d
+
+
+# ---------------------------------------------------------------------------
+# last-gasp hooks
+# ---------------------------------------------------------------------------
+
+def _excepthook(exc_type, exc, tb):
+    if _ACTIVE and not issubclass(exc_type, KeyboardInterrupt):
+        try:
+            if exc is not None and exc.__traceback__ is None:
+                exc = exc.with_traceback(tb)
+            write_crash_bundle("crash",
+                               reason=f"uncaught {exc_type.__name__}",
+                               exc=exc)
+        except Exception:  # noqa: BLE001 — the hook must reach the chain
+            pass
+    hook = _PREV_EXCEPTHOOK or sys.__excepthook__
+    hook(exc_type, exc, tb)
+
+
+def _signal_last_gasp(signum, frame):
+    """SIGABRT/SIGQUIT: journal from the handler (queue hand-off —
+    the interrupted frame may hold any lock), write the bundle on a
+    daemon thread with a bounded join, then die by the default
+    disposition so the exit classification stays signal-resolved."""
+    name = signal_name(signum)
+    emit_from_signal("crash", f"fatal signal {name}", signum=signum)
+    done = threading.Event()
+
+    def _write():
+        try:
+            write_crash_bundle(
+                "crash", reason=f"fatal signal {name}",
+                exit_record={"signal": signum, "signal_name": name})
+        finally:
+            done.set()
+
+    threading.Thread(target=_write, daemon=True,
+                     name="mx-blackbox-lastgasp").start()
+    done.wait(timeout=3.0)
+    try:
+        _signal.signal(signum, _signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+    except (OSError, ValueError):
+        os._exit(128 + int(signum))
+
+
+def install_crash_hooks() -> bool:
+    """Chain ``sys.excepthook`` and install the SIGABRT/SIGQUIT
+    last-gasp handlers (main thread only — off it, the excepthook
+    still chains).  Idempotent."""
+    global _HOOKS, _PREV_EXCEPTHOOK
+    with _lock:
+        if _HOOKS:
+            return True
+        _PREV_EXCEPTHOOK = sys.excepthook
+        sys.excepthook = _excepthook
+        for sig in (_signal.SIGABRT, _signal.SIGQUIT):
+            try:
+                if _signal.getsignal(sig) in (_signal.SIG_DFL, None):
+                    _signal.signal(sig, _signal_last_gasp)
+            except (ValueError, OSError):
+                pass  # mxlint: disable=MX007 — not the main thread
+        _HOOKS = True
+    return True
+
+
+# ---------------------------------------------------------------------------
+# lifecycle + readers
+# ---------------------------------------------------------------------------
+
+def enable(hooks: bool = True) -> EventJournal:
+    """Turn the journal seams on (and install the last-gasp hooks).
+    Idempotent."""
+    global _ACTIVE
+    _ACTIVE = True
+    j = journal()
+    if hooks:
+        install_crash_hooks()
+    return j
+
+
+def disable() -> None:
+    """Drop the seam flag (journal and hooks stay; re-enable is
+    cheap).  The disabled path is back to one falsy check."""
+    global _ACTIVE
+    _ACTIVE = False
+
+
+def enabled() -> bool:
+    return _ACTIVE
+
+
+def recent(n: int = 20) -> List[dict]:
+    """Newest journal entries (what /statusz shows)."""
+    if _JOURNAL is None:
+        return []
+    return _JOURNAL.tail(n)
+
+
+def last_bundle() -> Optional[str]:
+    return _LAST_BUNDLE
+
+
+def last_incident() -> Optional[dict]:
+    """The newest INCIDENT-*.json under the blackbox dir (the
+    supervisor writes them next to the bundles), abbreviated for
+    /statusz.  None when there has been no incident."""
+    d = base_dir()
+    try:
+        paths = [os.path.join(d, n) for n in os.listdir(d)
+                 if n.startswith("INCIDENT") and n.endswith(".json")]
+    except OSError:
+        return None
+    paths.sort(key=lambda p: (os.path.getmtime(p), p))
+    for p in reversed(paths):
+        try:
+            with open(p) as f:
+                rep = json.load(f)
+            return {"incident_id": rep.get("incident_id"),
+                    "when": rep.get("when"),
+                    "first_failure": rep.get("first_failure"),
+                    "path": p}
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+if _env.get_bool("MXNET_BLACKBOX"):
+    enable()

@@ -1,0 +1,317 @@
+"""Crash-bundle emission: one rank-qualified directory per abnormal
+exit, indexed like mxtriage captures.
+
+A bundle is the flight-data-recorder payload for ONE process death:
+
+    <MXNET_BLACKBOX_DIR>/crash-<stamp>-<category>-<who>-<seq>/
+        meta.json        why/when/who + the exit record + knob fingerprint
+        journal.json     the journal tail (bounded, newest last)
+        mxprof.json      flight-recorder ring snapshot (when live)
+        goodput.json     goodput ledger snapshot (when live)
+        alerts.json      firing alerts + recent transition events
+        heartbeats.json  per-rank heartbeat ages at emission time
+        stderr.txt       bounded stderr tail (supervisor scrape only)
+
+Every block degrades to a stub (the /statusz pattern): a crash bundle
+written FROM a dying process must capture whatever is reachable and
+never raise back into the exit path.  ``meta.json`` is written last,
+atomically (tmp + ``os.replace``) — a bundle directory without a
+``meta.json`` is an interrupted write and the index never lists it.
+
+The supervisor writes bundles FOR ranks that could not write their own
+(SIGKILLed / OOM-killed): :func:`write_supervisor_bundle` scrapes the
+rank's on-disk journal spill, its stderr tail file, and its final
+heartbeat stamp, and records the signal-resolved exit classification
+(``WTERMSIG``) so a chaos ``die`` (rc 1) and an OOM kill (SIGKILL)
+stop reading identically.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import signal as _signal
+import sys
+import threading
+import time
+import traceback
+from typing import List, Optional
+
+__all__ = ["write_bundle", "write_supervisor_bundle", "read_index",
+           "signal_name"]
+
+_SEQ = itertools.count(1)
+_index_lock = threading.Lock()
+
+
+def signal_name(signum: Optional[int]) -> Optional[str]:
+    """'SIGKILL' for 9, etc. (None for a non-signal exit)."""
+    if not signum:
+        return None
+    try:
+        return _signal.Signals(int(signum)).name
+    except (ValueError, AttributeError):
+        return f"SIG{signum}"
+
+
+def _who(rank: Optional[int]) -> str:
+    # the mxtriage lesson: containerized multi-host ranks all run as
+    # pid 1, so the job rank qualifies artifact names once known
+    return f"r{rank}" if rank is not None else f"p{os.getpid()}"
+
+
+def _atomic_json(path: str, payload) -> None:
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, default=repr)
+    os.replace(tmp, path)
+
+
+def _block(fn):
+    """Run one gather; degrade to a stub dict on ANY failure."""
+    try:
+        return fn()
+    except Exception as e:  # noqa: BLE001 — a dying process gathers what it can
+        return {"unavailable": repr(e)}
+
+
+def _gather_mxprof():
+    mxprof = sys.modules.get("mxnet_tpu.telemetry.mxprof")
+    if mxprof is None or not mxprof.enabled():
+        return {"unavailable": "mxprof not enabled"}
+    return mxprof.recorder().dump_dict(live_hbm=False,
+                                       include_records=True)
+
+
+def _gather_goodput():
+    goodput = sys.modules.get("mxnet_tpu.telemetry.mxgoodput")
+    if goodput is None or not goodput.enabled():
+        return {"unavailable": "mxgoodput not enabled"}
+    return goodput.snapshot()
+
+
+def _gather_alerts():
+    alerts = sys.modules.get("mxnet_tpu.telemetry.alerts")
+    if alerts is None:
+        return {"unavailable": "alerts not imported"}
+    eng = alerts.default_engine()
+    return {"firing": eng.firing(), "events": eng.events()}
+
+
+def _gather_heartbeats():
+    from ...resilience import elastic as _elastic
+    from ...resilience.heartbeat import HeartbeatMonitor
+
+    d = _elastic.shared_dir()
+    if not d:
+        return {"unavailable": "no elastic shared dir"}
+    return {str(r): s for r, s in HeartbeatMonitor(d).read().items()}
+
+
+def _knob_fingerprint():
+    """The run's configuration surface, the mxprof dump shape: env-SET
+    / tuned-overlaid knob values by name, the fingerprint over the full
+    resolved table, and the tuned-config stamp when one is applied."""
+    from ...util import env as _env
+
+    table = _env.resolved()
+    overlay = _env.overlay_info()
+    overlaid = set(overlay["applied"]) if overlay else set()
+    knobs = {name: v for name, v in table.items()
+             if name in os.environ or name in overlaid}
+    out = {"knobs": knobs, "knob_fingerprint": _env.fingerprint()}
+    if overlay is not None:
+        out["tuned_config"] = {
+            "fingerprint": overlay.get("fingerprint"),
+            "source": overlay.get("source"),
+            "applied": overlay.get("applied"),
+        }
+    return out
+
+
+def write_bundle(category: str, reason: str = "",
+                 base_dir: Optional[str] = None,
+                 rank: Optional[int] = None,
+                 step: Optional[int] = None,
+                 exc: Optional[BaseException] = None,
+                 journal=None,
+                 exit_record: Optional[dict] = None,
+                 extra: Optional[dict] = None) -> Optional[str]:
+    """Write one crash bundle; returns its directory (None when even
+    the directory could not be created — emission is best-effort all
+    the way down)."""
+    from ...util import env as _env
+
+    base = base_dir or _env.get_str("MXNET_BLACKBOX_DIR") \
+        or "mxblackbox"
+    who = _who(rank)
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    d = os.path.join(base,
+                     f"crash-{stamp}-{category}-{who}-{next(_SEQ)}")
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError:
+        return None
+
+    def put(name, payload):
+        try:
+            _atomic_json(os.path.join(d, name), payload)
+        except (OSError, TypeError, ValueError):
+            pass  # mxlint: disable=MX007 — partial bundles beat no bundle
+
+    tail = _env.get_int("MXNET_BLACKBOX_TAIL") or 200
+    if journal is not None:
+        put("journal.json", _block(lambda: journal.tail(tail)))
+    put("mxprof.json", _block(_gather_mxprof))
+    put("goodput.json", _block(_gather_goodput))
+    put("alerts.json", _block(_gather_alerts))
+    put("heartbeats.json", _block(_gather_heartbeats))
+    meta = {
+        "category": category,
+        "reason": reason,
+        "when": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "t_unix": time.time(),
+        "t_mono": time.monotonic(),
+        "rank": rank,
+        "gen": _env.get_int("MXNET_BLACKBOX_GEN"),
+        "pid": os.getpid(),
+        "step": step,
+        "dir": d,
+        "exit": exit_record,
+        "config": _block(_knob_fingerprint),
+    }
+    if exc is not None:
+        meta["exception"] = {
+            "type": type(exc).__name__,
+            "msg": str(exc),
+            "traceback": "".join(traceback.format_exception(
+                type(exc), exc, exc.__traceback__))[-8000:],
+        }
+    if extra:
+        meta.update(extra)
+    # meta.json commits the bundle (written LAST, atomically): the
+    # index and postmortem treat a meta-less dir as an interrupted
+    # write and skip it
+    try:
+        _atomic_json(os.path.join(d, "meta.json"), meta)
+    except (OSError, TypeError, ValueError):
+        return None
+    _index(base, meta, rank)
+    try:
+        from .. import instruments as _ins
+
+        _ins.blackbox_events_total("crash").inc()
+    except Exception:  # noqa: BLE001 — metrics never block an exit path
+        pass
+    return d
+
+
+def write_supervisor_bundle(base_dir: str, rank: int,
+                            exit_record: dict,
+                            gen: Optional[int] = None,
+                            stderr_path: Optional[str] = None,
+                            stderr_tail: Optional[str] = None,
+                            heartbeat: Optional[dict] = None,
+                            ) -> Optional[str]:
+    """The supervisor-side scrape for a rank that could not write its
+    own bundle (SIGKILLed / hung past grace / died with an unreserved
+    rc and no bundle of its own this generation).  Reads the rank's
+    journal SPILL file from the shared blackbox dir — the dead process
+    cannot be asked, but its append-only journal survives it."""
+    from ...util import env as _env
+    from .journal import EventJournal
+
+    who = _who(rank)
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    d = os.path.join(base_dir,
+                     f"crash-{stamp}-scrape-{who}-{next(_SEQ)}")
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError:
+        return None
+    tail = _env.get_int("MXNET_BLACKBOX_TAIL") or 200
+    spill = os.path.join(base_dir, f"journal-{who}.jsonl")
+    events = EventJournal.read_spill(spill, tail=tail)
+
+    def put(name, payload):
+        try:
+            _atomic_json(os.path.join(d, name), payload)
+        except (OSError, TypeError, ValueError):
+            pass  # mxlint: disable=MX007 — partial bundles beat no bundle
+
+    put("journal.json", events)
+    if heartbeat is not None:
+        put("heartbeats.json", {str(rank): heartbeat})
+    if stderr_tail:
+        try:
+            with open(os.path.join(d, "stderr.txt"), "w") as f:
+                f.write(stderr_tail)
+        except OSError:
+            pass  # mxlint: disable=MX007 — partial bundles beat no bundle
+    meta = {
+        "category": "scrape",
+        "reason": "supervisor scrape: rank could not write its own "
+                  "bundle",
+        "when": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "t_unix": time.time(),
+        "t_mono": time.monotonic(),
+        "rank": rank,
+        "gen": gen,
+        "pid": None,
+        "step": events[-1].get("step") if events else None,
+        "dir": d,
+        "exit": exit_record,
+        "stderr_path": stderr_path,
+    }
+    try:
+        _atomic_json(os.path.join(d, "meta.json"), meta)
+    except (OSError, TypeError, ValueError):
+        return None
+    _index(base_dir, meta, rank)
+    return d
+
+
+# ---------------------------------------------------------------------------
+# the bundle index (the mxtriage shape: per-rank files, bounded,
+# atomic rewrite — ranks sharing a base dir must not interleave
+# read-modify-writes of one file)
+# ---------------------------------------------------------------------------
+
+def _index_path(base_dir: str, rank: Optional[int]) -> str:
+    name = "index.json" if rank is None else f"index-rank{rank}.json"
+    return os.path.join(base_dir, name)
+
+
+def read_index(base_dir: str, rank: Optional[int] = None) -> List[dict]:
+    try:
+        with open(_index_path(base_dir, rank)) as f:
+            return json.load(f)["bundles"]
+    except (OSError, ValueError, KeyError):
+        return []
+
+
+def _index(base_dir: str, meta: dict, rank: Optional[int]) -> None:
+    from ...util import env as _env
+
+    keep = _env.get_int("MXNET_BLACKBOX_HISTORY") or 64
+    # the whole read-modify-write sits under the lock on purpose: two
+    # in-process writers interleaving the RMW would drop each other's
+    # bundle from the index, and indexing happens a handful of times
+    # per process LIFETIME (each crash/scrape), never on a hot path
+    with _index_lock:
+        entries = read_index(base_dir, rank)  # mxlint: disable=MX008
+        entries.append({k: meta.get(k) for k in (
+            "dir", "category", "reason", "rank", "gen", "step",
+            "when", "pid")})
+        entries = entries[-keep:]
+        path = _index_path(base_dir, rank)
+        try:
+            os.makedirs(os.path.dirname(path) or ".",  # mxlint: disable=MX008
+                        exist_ok=True)
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:  # mxlint: disable=MX008
+                json.dump({"bundles": entries}, f, indent=1,
+                          default=repr)
+            os.replace(tmp, path)  # mxlint: disable=MX008
+        except OSError:
+            pass  # mxlint: disable=MX007 — the bundle itself stands

@@ -1,0 +1,241 @@
+"""The per-rank event journal: a bounded in-memory ring plus an
+append-only on-disk spill file.
+
+Design constraints, in order:
+
+  * **lock-cheap** — one plain (non-reentrant, leaf) ``threading.Lock``
+    around the ring append and the spill write; no other lock is taken
+    while it is held, so it can never participate in a lock-order
+    cycle (the mxsan bar).
+  * **signal-safe** — :meth:`EventJournal.emit_from_signal` NEVER takes
+    the journal lock inline: the interrupted frame may BE mid-``emit``
+    holding that very lock (the PR 10 SIGUSR2 self-deadlock lesson,
+    see mxtriage's ``_on_sigusr1``).  The signal path enqueues onto a
+    ``queue.SimpleQueue`` (reentrant-safe C implementation) and a
+    daemon thread performs the real emit once the interrupted frame
+    releases the lock.
+  * **crash-durable** — each spill record is one JSON line written by a
+    single ``os.write`` on an ``O_APPEND`` fd: the append-only analog
+    of the heartbeat stamp's tmp+``os.replace`` (a torn line at a hard
+    kill can only be the LAST line, and the reader skips unparsable
+    tails).  The spill rotates once (``.1`` suffix) past the size
+    bound, so disk use is bounded at ~2x the cap.
+
+Every entry carries both clocks (``t_unix`` for cross-rank merge,
+``t_mono`` for in-process intervals), the rank, the training step
+(caller-provided, or the mxprof step counter when one is live), the
+category, and free-form fields.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["EventJournal"]
+
+
+def _mxprof_step() -> Optional[int]:
+    """The flight recorder's current step, best-effort (None when
+    mxprof is not imported/enabled — the journal must not drag the
+    recorder in)."""
+    try:
+        import sys
+
+        mxprof = sys.modules.get("mxnet_tpu.telemetry.mxprof")
+        if mxprof is None:
+            return None
+        return mxprof.recorder()._step or None
+    except Exception:  # noqa: BLE001 — a step stamp is advisory
+        return None
+
+
+class EventJournal:
+    """One process's event journal (module-level singleton lives in
+    the package ``__init__``; tests build private ones)."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 who: str = "p0", ring: int = 512,
+                 spill_max_bytes: int = 8 * 1024 * 1024,
+                 rank: Optional[int] = None,
+                 gen: Optional[int] = None):
+        from collections import deque
+
+        self._dir = directory
+        self._who = who
+        self._rank = rank
+        self._gen = gen
+        self._ring: "deque[dict]" = deque(maxlen=max(16, int(ring)))
+        self._spill_max = max(64 * 1024, int(spill_max_bytes))
+        # a LEAF lock, deliberately non-reentrant: nothing called under
+        # it may emit (the signal-safety test pins this type)
+        self._lock = threading.Lock()
+        self._fd: Optional[int] = None
+        self._spilled_bytes = 0
+        self.dropped = 0  # spill write failures (ring still has them)
+        # the signal hand-off: SimpleQueue.put is reentrant-safe; the
+        # daemon drains it OUTSIDE any interrupted frame's locks
+        self._sigq: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._drainer: Optional[threading.Thread] = None
+
+    # ---- paths -------------------------------------------------------
+
+    def spill_path(self) -> Optional[str]:
+        if self._dir is None:
+            return None
+        return os.path.join(self._dir, f"journal-{self._who}.jsonl")
+
+    # ---- the emit path ----------------------------------------------
+
+    def emit(self, category: str, msg: str = "",
+             step: Optional[int] = None, **fields) -> dict:
+        """Append one entry (ring + spill).  Safe from any thread;
+        NEVER call from a signal handler — use
+        :meth:`emit_from_signal`."""
+        entry = {
+            "t_unix": time.time(),
+            "t_mono": time.monotonic(),
+            "rank": self._rank,
+            "step": step if step is not None else _mxprof_step(),
+            "category": category,
+            "msg": msg,
+        }
+        if self._gen is not None:
+            entry["gen"] = self._gen
+        if fields:
+            entry.update(fields)
+        line = None
+        if self._dir is not None:
+            try:
+                line = (json.dumps(entry, default=repr) + "\n").encode()
+            except (TypeError, ValueError):
+                line = None
+        with self._lock:
+            self._ring.append(entry)
+            if line is not None:
+                # deliberately under the lock: one O_APPEND write per
+                # entry keeps the spill in ring order, and the ~µs
+                # append is the whole cost of a rare forensic event —
+                # not a hot path worth a publish-outside dance
+                self._spill_locked(line)  # mxlint: disable=MX008
+        return entry
+
+    def emit_from_signal(self, category: str, msg: str = "",
+                         step: Optional[int] = None, **fields) -> None:
+        """Signal-handler-safe emit: enqueue and return.  The daemon
+        drainer performs the real :meth:`emit` once the interrupted
+        frame (which may hold the journal lock) resumes and releases
+        it.  The clocks are stamped HERE so the entry records when the
+        signal fired, not when the drainer got scheduled."""
+        self._sigq.put((category, msg, step,
+                        dict(fields, t_unix=time.time(),
+                             t_mono=time.monotonic())))
+        self._ensure_drainer()
+
+    def _ensure_drainer(self) -> None:
+        t = self._drainer
+        if t is not None and t.is_alive():
+            return
+        # benign race: two starters create two drainers; SimpleQueue
+        # hands each item to exactly one of them
+        t = threading.Thread(target=self._drain_loop, daemon=True,
+                             name="mx-blackbox-journal")
+        self._drainer = t
+        t.start()
+
+    def _drain_loop(self) -> None:
+        while True:
+            category, msg, step, fields = self._sigq.get()
+            t_unix = fields.pop("t_unix", None)
+            t_mono = fields.pop("t_mono", None)
+            try:
+                entry = self.emit(category, msg, step=step, **fields)
+                if t_unix is not None:
+                    entry["t_unix"] = t_unix
+                if t_mono is not None:
+                    entry["t_mono"] = t_mono
+            except Exception:  # noqa: BLE001 — forensics never kill the host
+                pass
+
+    # ---- spill file --------------------------------------------------
+
+    def _spill_locked(self, line: bytes) -> None:
+        """One O_APPEND write per entry; rotate past the size bound.
+        Failures count in ``dropped`` — the ring keeps the entry, and
+        journaling must never raise into the instrumented seam."""
+        try:
+            if self._fd is None:
+                os.makedirs(self._dir, exist_ok=True)
+                self._fd = os.open(
+                    self.spill_path(),
+                    os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+                try:
+                    self._spilled_bytes = os.fstat(self._fd).st_size
+                except OSError:
+                    self._spilled_bytes = 0
+            os.write(self._fd, line)
+            self._spilled_bytes += len(line)
+            if self._spilled_bytes >= self._spill_max:
+                self._rotate_locked()
+        except OSError:
+            self.dropped += 1
+
+    def _rotate_locked(self) -> None:
+        path = self.spill_path()
+        try:
+            if self._fd is not None:
+                os.close(self._fd)
+        except OSError:
+            pass  # mxlint: disable=MX007 — fd teardown only
+        self._fd = None
+        self._spilled_bytes = 0
+        try:
+            os.replace(path, path + ".1")
+        except OSError:
+            pass  # mxlint: disable=MX007 — rotation is best-effort
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fd is not None:
+                try:
+                    os.close(self._fd)
+                except OSError:
+                    pass  # mxlint: disable=MX007 — fd teardown only
+                self._fd = None
+
+    # ---- readers -----------------------------------------------------
+
+    def tail(self, n: int = 200) -> List[dict]:
+        """Last ``n`` ring entries, newest last (what a crash bundle
+        embeds)."""
+        with self._lock:
+            entries = list(self._ring)
+        return [dict(e) for e in entries[-max(0, int(n)):]]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @staticmethod
+    def read_spill(path: str, tail: Optional[int] = None) -> List[dict]:
+        """Parse a spill file (skipping a torn final line — the only
+        kind a single-write append can leave).  ``tail`` bounds the
+        result to the newest N entries.  The supervisor uses this to
+        scrape a SIGKILLed rank's journal, so it must tolerate any
+        on-disk state."""
+        out: List[dict] = []
+        try:
+            with open(path, "rb") as f:
+                for raw in f:
+                    try:
+                        out.append(json.loads(raw.decode("utf-8")))
+                    except (ValueError, UnicodeDecodeError):
+                        continue  # torn/garbled line: skip
+        except OSError:
+            return []
+        if tail is not None:
+            out = out[-max(0, int(tail)):]
+        return out

@@ -1,0 +1,335 @@
+"""Cross-rank incident reconstruction: N crash bundles -> one
+INCIDENT.json.
+
+The supervisor (or ``tools/postmortem.py``) hands this module a
+generation's bundles; it aligns the per-rank clocks, merges the
+journals into one causally-ordered timeline, and attributes the FIRST
+failure — which rank, which category, at which step, and how long
+detection lagged behind it.
+
+**Clock alignment** reuses the ``trace_report --merge`` technique: a
+blocking collective ends near-simultaneously on every rank, so matched
+occurrences of cross-rank-synchronized journal events pin each rank's
+offset onto rank 0's clock.  The sync marks here are the journal
+categories that record a cross-rank barrier: ``elastic`` lifecycle
+events and ``checkpoint`` commit/save events, matched by
+``(category, msg, k-th occurrence)`` and averaged exactly like the
+trace merger's collective-end marks.  Single-host test jobs share a
+wall clock, so offsets degrade gracefully to ~0 when no marks match.
+
+**First-failure attribution** prefers direct evidence over inference,
+in order: (1) the earliest *failure-class* journal/bundle event
+(``chaos`` fires with a lethal action, ``crash`` bundle emissions that
+are not coordinated wind-downs, ``health`` nonfinite raises); (2) a
+supervisor exit record with an unreserved rc or a kill signal; (3) the
+supervisor's own failed-rank classification.  Ranks that exited the
+reserved rcs 43/44 are classified victims/survivors, never the first
+failure — a peer observing a death is evidence OF the death, not the
+death itself.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["load_bundles", "reconstruct", "run_epoch"]
+
+_SEQ = itertools.count(1)
+
+#: journal/bundle categories that can BE a first failure (vs
+#: categories that merely observe one)
+_FAILURE_CATEGORIES = ("chaos", "health", "crash", "scrape")
+#: crash-bundle categories that are coordinated exits, not failures
+_COORDINATED = ("peer_failed", "preempted", "winddown")
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+def load_bundles(base_dir: str,
+                 gen: Optional[int] = None,
+                 since_unix: Optional[float] = None) -> List[dict]:
+    """Every committed bundle (has a readable ``meta.json``) under the
+    blackbox dir, optionally filtered to one generation / time window.
+    Returns ``[{"meta": ..., "journal": [...]}, ...]``."""
+    out: List[dict] = []
+    try:
+        names = sorted(os.listdir(base_dir))
+    except OSError:
+        return out
+    for name in names:
+        d = os.path.join(base_dir, name)
+        if not name.startswith("crash-") or not os.path.isdir(d):
+            continue
+        try:
+            with open(os.path.join(d, "meta.json")) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            continue  # interrupted write: never committed
+        if gen is not None and meta.get("gen") is not None \
+                and int(meta["gen"]) != int(gen):
+            continue
+        if since_unix is not None and \
+                (meta.get("t_unix") or 0) < since_unix:
+            continue
+        try:
+            with open(os.path.join(d, "journal.json")) as f:
+                journal = json.load(f)
+        except (OSError, ValueError):
+            journal = []
+        out.append({"meta": meta,
+                    "journal": journal if isinstance(journal, list)
+                    else []})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# clock alignment (the trace_report --merge technique on journal
+# events)
+# ---------------------------------------------------------------------------
+
+def _sync_marks(events: List[dict]) -> Dict[Tuple, float]:
+    """{(category, msg, k): t_unix} for the k-th occurrence of each
+    cross-rank-synchronized event — the journal analog of a blocking
+    collective's end timestamp."""
+    seen: Dict[Tuple, int] = defaultdict(int)
+    marks: Dict[Tuple, float] = {}
+    for ev in sorted(events, key=lambda e: e.get("t_unix", 0.0)):
+        if ev.get("category") not in ("elastic", "checkpoint"):
+            continue
+        key = (ev.get("category"), ev.get("msg"))
+        k = seen[key]
+        seen[key] = k + 1
+        marks[key + (k,)] = ev.get("t_unix", 0.0)
+    return marks
+
+
+def _offsets(per_rank: List[Tuple[int, List[dict]]]) -> Tuple[dict, dict]:
+    """rank -> seconds to ADD to that rank's t_unix to land on the
+    reference rank's clock (trace_report: offsets average
+    ``ref_marks[c] - marks[c]`` over the common marks)."""
+    if not per_rank:
+        return {}, {}
+    ref_rank, ref_events = per_rank[0]
+    ref_marks = _sync_marks(ref_events)
+    offsets = {ref_rank: 0.0}
+    aligned_on = {ref_rank: None}
+    for rank, events in per_rank[1:]:
+        marks = _sync_marks(events)
+        common = sorted(set(ref_marks) & set(marks))
+        if common:
+            offsets[rank] = sum(ref_marks[c] - marks[c]
+                                for c in common) / len(common)
+            aligned_on[rank] = len(common)
+        else:
+            offsets[rank] = 0.0  # nothing to align on: trust the clock
+            aligned_on[rank] = 0
+    return offsets, aligned_on
+
+
+# ---------------------------------------------------------------------------
+# reconstruction
+# ---------------------------------------------------------------------------
+
+def _failure_candidates(bundles: List[dict],
+                        offsets: dict) -> List[dict]:
+    """Every event that could BE the first failure, time-aligned."""
+    cands: List[dict] = []
+    for b in bundles:
+        meta = b["meta"]
+        rank = meta.get("rank")
+        off = offsets.get(rank, 0.0)
+        for ev in b["journal"]:
+            cat = ev.get("category")
+            if cat not in _FAILURE_CATEGORIES:
+                continue
+            if cat == "chaos" and ev.get("action") not in (
+                    "die", "hang", "error"):
+                continue
+            cands.append({
+                "rank": ev.get("rank", rank),
+                "category": cat,
+                "step": ev.get("step"),
+                "t_unix": (ev.get("t_unix") or 0.0) + off,
+                "msg": ev.get("msg", ""),
+                "source": "journal",
+            })
+        cat = meta.get("category")
+        if cat in _FAILURE_CATEGORIES and cat not in _COORDINATED:
+            # a scrape bundle carries the supervisor's exit
+            # classification; its own stamp time is DETECTION time,
+            # so prefer the last journal entry's time when present
+            t = meta.get("t_unix") or 0.0
+            if b["journal"]:
+                t = (b["journal"][-1].get("t_unix") or t)
+            cands.append({
+                "rank": meta.get("rank"),
+                "category": cat if cat != "scrape" else (
+                    (meta.get("exit") or {}).get("classified")
+                    or "scrape"),
+                "step": meta.get("step"),
+                "t_unix": t + offsets.get(meta.get("rank"), 0.0),
+                "msg": meta.get("reason", ""),
+                "source": "bundle",
+                "exit": meta.get("exit"),
+            })
+    cands.sort(key=lambda c: c["t_unix"])
+    return cands
+
+
+def reconstruct(bundles: List[dict],
+                t_detect_unix: Optional[float] = None,
+                failed_ranks: Optional[List[int]] = None,
+                exits: Optional[dict] = None,
+                epoch: Optional[int] = None,
+                timeline_max: int = 200) -> dict:
+    """Merge one generation's bundles into an incident report dict
+    (the INCIDENT.json payload)."""
+    per_rank: Dict[int, List[dict]] = {}
+    for b in bundles:
+        rank = b["meta"].get("rank")
+        key = -1 if rank is None else int(rank)
+        per_rank.setdefault(key, []).extend(b["journal"])
+    ordered = sorted(per_rank.items())
+    offsets, aligned_on = _offsets(ordered)
+
+    merged: List[dict] = []
+    for rank, events in ordered:
+        off = offsets.get(rank, 0.0)
+        for ev in events:
+            e = dict(ev)
+            e["t_aligned"] = (ev.get("t_unix") or 0.0) + off
+            merged.append(e)
+    # causal order: aligned time; ties break by (rank, mono) so one
+    # rank's own events never reorder against each other
+    merged.sort(key=lambda e: (e.get("t_aligned", 0.0),
+                               e.get("rank") or 0,
+                               e.get("t_mono") or 0.0))
+    merged = merged[-max(1, int(timeline_max)):]
+
+    cands = _failure_candidates(bundles, offsets)
+    first = None
+    if cands:
+        first = dict(cands[0])
+        if first.get("step") is None:
+            # the journal fire may predate a step stamp (chaos events
+            # carry the call count, not the step) — backfill from the
+            # same rank+category's bundle, which does know the step
+            for c in cands[1:]:
+                if c.get("step") is not None \
+                        and c.get("rank") == first.get("rank") \
+                        and c.get("category") == first.get("category"):
+                    first["step"] = c["step"]
+                    break
+    elif exits:
+        # no direct evidence: fall back to the exit-record
+        # classification (unreserved rc / kill signal), then to the
+        # supervisor's failed list
+        bad = [(r, x) for r, x in sorted(exits.items())
+               if x.get("rc") not in (0, 43, 44)
+               or x.get("signal") is not None]
+        if bad:
+            r, x = bad[0]
+            first = {"rank": int(r), "category": "exit",
+                     "step": None, "t_unix": None,
+                     "msg": f"rc {x.get('rc')} signal "
+                            f"{x.get('signal')}", "source": "exit"}
+    if first is None and failed_ranks:
+        first = {"rank": failed_ranks[0], "category": "unknown",
+                 "step": None, "t_unix": None,
+                 "msg": "supervisor classification only",
+                 "source": "supervisor"}
+
+    detection = None
+    if t_detect_unix is not None:
+        lag = None
+        if first is not None and first.get("t_unix"):
+            lag = round(t_detect_unix - first["t_unix"], 3)
+        # the heartbeat view of the same lag: the failed rank's last
+        # stamp age at detection
+        hb_lag = None
+        for b in bundles:
+            hbs = {}
+            try:
+                with open(os.path.join(b["meta"]["dir"],
+                                       "heartbeats.json")) as f:
+                    hbs = json.load(f)
+            except (OSError, ValueError, KeyError):
+                continue
+            if first is not None and str(first.get("rank")) in hbs:
+                stamp = hbs[str(first["rank"])]
+                if isinstance(stamp, dict) and "age_s" in stamp:
+                    hb_lag = stamp["age_s"]
+                    break
+        detection = {"t_detect_unix": t_detect_unix,
+                     "lag_s": lag,
+                     "heartbeat_age_s": hb_lag}
+
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    fr = first.get("rank") if first else None
+    incident_id = f"inc-{stamp}-e{epoch if epoch is not None else 0}" \
+                  f"-r{fr if fr is not None else 'x'}-{next(_SEQ)}"
+    report = {
+        "incident_id": incident_id,
+        "epoch": epoch,
+        "when": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "bundles": len(bundles),
+        "ranks": sorted(r for r, _ in ordered),
+        "first_failure": first,
+        "attributed": bool(first is not None
+                           and first.get("category") not in
+                           ("unknown",)),
+        "detection": detection,
+        "failed_ranks": sorted(failed_ranks or []),
+        "exits": exits or {},
+        "clock": {"offsets_s": {str(r): round(o, 6)
+                                for r, o in offsets.items()},
+                  "aligned_on": {str(r): a
+                                 for r, a in aligned_on.items()}},
+        "timeline": merged,
+    }
+    return report
+
+
+def run_epoch(base_dir: str, epoch: int,
+              gen: Optional[int] = None,
+              since_unix: Optional[float] = None,
+              t_detect_unix: Optional[float] = None,
+              failed_ranks: Optional[List[int]] = None,
+              exits: Optional[dict] = None,
+              out_path: Optional[str] = None) -> Optional[dict]:
+    """The supervisor entry point: reconstruct one failure epoch's
+    incident from the shared blackbox dir and write
+    ``INCIDENT-epoch<N>.json`` beside the bundles.  Best-effort all
+    the way down — forensics must never turn a recoverable failure
+    epoch into a supervisor crash."""
+    try:
+        bundles = load_bundles(base_dir, gen=gen,
+                               since_unix=since_unix)
+        report = reconstruct(bundles, t_detect_unix=t_detect_unix,
+                             failed_ranks=failed_ranks, exits=exits,
+                             epoch=epoch)
+        path = out_path or os.path.join(base_dir,
+                                        f"INCIDENT-epoch{epoch}.json")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1, default=repr)
+        os.replace(tmp, path)
+        report["path"] = path
+        try:
+            from .. import instruments as _ins
+
+            cat = (report.get("first_failure") or {}).get(
+                "category") or "unknown"
+            _ins.incident_total(str(cat)).inc()
+        except Exception:  # noqa: BLE001 — metrics never block recovery
+            pass
+        return report
+    except Exception:  # noqa: BLE001 — see docstring
+        return None

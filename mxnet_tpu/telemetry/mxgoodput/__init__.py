@@ -205,15 +205,19 @@ def on_preemption_trigger(
 
 
 def on_preemption_resume(t_unix: Optional[float] = None,
-                         category: str = "preemption_recovery") -> None:
+                         category: str = "preemption_recovery",
+                         incident: Optional[str] = None) -> None:
     """Hook in ``AutoCheckpoint.resume`` when the restored checkpoint
     was a preemption (or elastic peer-failure) save: opens the
     recovery window (idempotent when the trigger already opened it
     in-process).  ``t_unix`` is the trigger time persisted in the
     checkpoint meta — a fresh process extends its wall back to it so
-    the downtime is measured, not forgotten."""
+    the downtime is measured, not forgotten.  ``incident`` stamps the
+    window with the mxblackbox incident id (elastic restart: the
+    supervisor's COMMIT marker carries it)."""
     if _ACTIVE:
-        ledger().open_recovery(t0_unix=t_unix, category=category)
+        ledger().open_recovery(t0_unix=t_unix, category=category,
+                               incident=incident)
 
 
 if _env.get_bool("MXNET_GOODPUT"):

@@ -116,6 +116,9 @@ class GoodputLedger:
         # open preemption-recovery window:
         # {"t0": mono, "mark": badput-at-open for the subtracted cats}
         self._recovery: Optional[dict] = None
+        # the last CLOSED window: {"category", "seconds", "incident"}
+        # — the incident id ties the downtime to its postmortem report
+        self._last_recovery: Optional[dict] = None
 
     # ---- interval feeds ----------------------------------------------
 
@@ -185,7 +188,8 @@ class GoodputLedger:
 
     def open_recovery(self, t0_mono: Optional[float] = None,
                       t0_unix: Optional[float] = None,
-                      category: str = "preemption_recovery") -> None:
+                      category: str = "preemption_recovery",
+                      incident: Optional[str] = None) -> None:
         """Open a recovery window.  ``t0_mono`` is the trigger instant
         on this process's monotonic clock; a resume in a FRESH process
         passes ``t0_unix`` (the trigger time persisted in the
@@ -195,7 +199,11 @@ class GoodputLedger:
         ``category`` names where the window's seconds land:
         ``preemption_recovery`` (the default) or
         ``rank_failure_recovery`` (mxelastic — a peer died/hung and
-        the job restarted around it)."""
+        the job restarted around it).  ``incident`` stamps the window
+        with the mxblackbox incident id (the postmortem report this
+        downtime belongs to) — a later open may still stamp an
+        already-open window (the trigger opens it before the resume
+        learns the id)."""
         if category not in ("preemption_recovery",
                             "rank_failure_recovery"):
             raise ValueError(
@@ -203,7 +211,12 @@ class GoodputLedger:
         now = self._clock()
         with self._lock:
             if self._recovery is not None:
-                return  # first open wins (trigger beats resume)
+                # first open wins the clock; the incident stamp is
+                # still taken (trigger beats resume, resume knows the
+                # incident id)
+                if incident and not self._recovery.get("incident"):
+                    self._recovery["incident"] = incident
+                return
             t0 = t0_mono
             if t0 is None and t0_unix is not None:
                 t0 = now - max(0.0, time.time() - float(t0_unix))
@@ -224,6 +237,8 @@ class GoodputLedger:
                                     t0_unix or self._t0_unix)
             self._recovery = {"t0": t0, "cat": category,
                               "mark": self._recovery_mark_locked()}
+            if incident:
+                self._recovery["incident"] = incident
 
     def mark_step_entry(self) -> None:
         """Stamp the open recovery window with 'a training step has
@@ -310,6 +325,9 @@ class GoodputLedger:
         cat = win.get("cat", "preemption_recovery")
         already = self._recovery_mark_locked() - win["mark"]
         s = max(0.0, (end_mono - win["t0"]) - max(0.0, already))
+        self._last_recovery = {"category": cat,
+                               "seconds": round(s, 6),
+                               "incident": win.get("incident")}
         if s:
             self._badput[cat] += s
             # counter bump under the lock is fine here: instruments'
@@ -383,6 +401,8 @@ class GoodputLedger:
                 },
                 "recovery_open": self._recovery is not None,
             }
+            if self._last_recovery is not None:
+                out["last_recovery"] = dict(self._last_recovery)
         _ins.job_wall_seconds().set(wall)
         _ins.goodput_ratio().set(out["goodput_ratio"])
         return out

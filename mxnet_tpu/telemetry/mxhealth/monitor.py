@@ -208,6 +208,13 @@ class HealthMonitor:
         ev = {"t": time.time(), "step": step, "kind": kind, **detail}
         self._events.append(ev)
         _ins.health_events_total(kind).inc()
+        from .. import mxblackbox as _bb
+
+        if _bb._ACTIVE:
+            _bb.emit("health", f"health event {kind}", step=step,
+                     kind=kind, **{k: v for k, v in detail.items()
+                                   if isinstance(v, (int, float, str,
+                                                     bool))})
         return ev
 
     def _ingest(self, site: str, step: int,
@@ -242,26 +249,39 @@ class HealthMonitor:
             _ins.update_ratio().set(un / pn)
         if nf:
             _ins.nonfinite_total().inc(nf)
-        with self._state_lock:
-            self._samples.append(sample)
-            if nf:
-                self._nonfinite_steps += 1
-                self._event("nonfinite", step,
-                            {"count": nf, "site": site,
-                             "action": self.policy})
-                if guarded:
-                    self._skipped_steps += 1
-                    _ins.health_steps_skipped_total().inc()
-                if self.policy == "raise":
-                    raise NonFiniteGradient(step, nf, site)
-                return  # NaN norms must not poison the spike windows
-            if math.isfinite(gn):
-                spike = self._grad_mad.update(gn)
-                if spike is not None:
-                    self._event("grad-spike", step, spike)
-            drift = ratio_drift(un, pn, self.ratio_max)
-            if drift is not None:
-                self._event("update-ratio", step, drift)
+        try:
+            with self._state_lock:
+                self._samples.append(sample)
+                if nf:
+                    self._nonfinite_steps += 1
+                    self._event("nonfinite", step,
+                                {"count": nf, "site": site,
+                                 "action": self.policy})
+                    if guarded:
+                        self._skipped_steps += 1
+                        _ins.health_steps_skipped_total().inc()
+                    if self.policy == "raise":
+                        raise NonFiniteGradient(step, nf, site)
+                    return  # NaN norms must not poison spike windows
+                if math.isfinite(gn):
+                    spike = self._grad_mad.update(gn)
+                    if spike is not None:
+                        self._event("grad-spike", step, spike)
+                drift = ratio_drift(un, pn, self.ratio_max)
+                if drift is not None:
+                    self._event("update-ratio", step, drift)
+        except NonFiniteGradient as e:
+            # crash bundle OUTSIDE the state lock: the gatherers take
+            # other subsystems' locks (alerts engine, recorder), and
+            # those may take _state_lock on their own threads
+            from .. import mxblackbox as _bb
+
+            if _bb._ACTIVE:
+                _bb.write_crash_bundle(
+                    "health",
+                    reason=f"nonfinite gradient at step {step} "
+                           f"({site})", step=step, exc=e)
+            raise
 
     def record_straggler(self, step: int, detail: dict) -> None:
         """Straggler findings come from merged traces (tools), not the

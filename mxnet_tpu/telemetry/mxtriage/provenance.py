@@ -91,6 +91,12 @@ def record_miss(site: str, key) -> dict:
     # instrument accessors and the recorder hold their own locks)
     for comp in changed:
         _ins.compile_reason_total(site, comp).inc()
+    from .. import mxblackbox as _bb
+
+    if _bb._ACTIVE:
+        _bb.emit("compile", f"compile miss at '{site}'",
+                 site=site, components=changed,
+                 first=nearest is None)
     snk = _tracing._SINK
     if snk is not None:
         on_reason = getattr(snk, "on_compile_reason", None)

@@ -517,6 +517,43 @@ declare("MXNET_RETRY_MAX_MS", float, 2000.0,
         tunable=Tunable(lo=500.0, hi=10000.0, scale="log"))
 
 # -- observability ----------------------------------------------------------
+declare("MXNET_BLACKBOX", bool, False,
+        "Enable mxblackbox, the always-on crash-forensics layer, at "
+        "import: a bounded per-rank event journal (ring + append-only "
+        "spill file) fed by alert transitions, health events, chaos "
+        "fires, retry exhaustions, checkpoint/commit and elastic "
+        "lifecycle events, plus crash-bundle emission on every "
+        "abnormal-exit path. mxblackbox.enable() does the same at "
+        "runtime. See docs/observability.md (Crash forensics).")
+declare("MXNET_BLACKBOX_DIR", str, "mxblackbox",
+        "Directory for mxblackbox artifacts: per-rank journal spill "
+        "files, crash-bundle directories, per-rank bundle indexes, "
+        "and supervisor INCIDENT-epoch<N>.json reports. The elastic "
+        "Supervisor exports <dir>/blackbox to its workers.")
+declare("MXNET_BLACKBOX_GEN", int, None,
+        "Elastic generation number stamped into journal entries and "
+        "crash-bundle metadata. Exported by the Supervisor to each "
+        "worker generation; postmortem filters bundles by it.")
+declare("MXNET_BLACKBOX_HISTORY", int, 64,
+        "Crash-bundle index depth: each per-rank index file keeps "
+        "the newest N bundle entries (the mxtriage capture-history "
+        "shape; bundle directories themselves are not deleted).")
+declare("MXNET_BLACKBOX_RING", int, 512,
+        "Event-journal in-memory ring capacity (entries). The ring "
+        "is what a crash bundle embeds; the on-disk spill file keeps "
+        "the longer history.")
+declare("MXNET_BLACKBOX_SPILL_MB", int, 8,
+        "Event-journal spill-file size bound in MiB. Past it the "
+        "spill rotates once to a '.1' suffix, bounding disk use at "
+        "roughly twice this value per rank.")
+declare("MXNET_BLACKBOX_STDERR_TAIL_KB", int, 64,
+        "Per-rank stderr tail bound in KiB: the Supervisor keeps at "
+        "most this much of each worker's stderr file per generation "
+        "and attaches it to supervisor-side scrape bundles.")
+declare("MXNET_BLACKBOX_TAIL", int, 200,
+        "Journal-tail depth embedded in a crash bundle (newest N "
+        "entries), and the scrape depth when the supervisor reads a "
+        "dead rank's spill file.")
 declare("MXNET_GOODPUT", bool, False,
         "Enable mxgoodput, the job-level goodput/badput wall-clock "
         "ledger, at import: productive step seconds vs compile / "

@@ -302,6 +302,11 @@ def scenario_elastic(max_recovery_s: float, steps: int = 8) -> dict:
                 "restarts": rep.get("restarts"),
                 "failed_ranks": epochs[0].get("failed_ranks")
                 if epochs else None,
+                # the mxblackbox postmortem id for this cell's failure
+                # epoch — RESILIENCE.json names the incident it
+                # recovered from, not just that it recovered
+                "incident_id": epochs[0].get("incident_id")
+                if epochs else None,
                 "final_world": rep.get("final_world"),
                 "mttr_s": mttr,
                 "loss": loss,

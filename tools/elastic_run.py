@@ -88,7 +88,11 @@ def demo_worker(args) -> int:
     start = 0
     cpath = elastic.committed_resume_path(edir)
     if cpath is not None:
-        meta = ck.resume(path=cpath)
+        # the commit marker carries the mxblackbox incident id of the
+        # failure epoch this resume recovers from — it stamps the
+        # goodput rank_failure_recovery window
+        commit = elastic.read_commit(edir) or {}
+        meta = ck.resume(path=cpath, incident=commit.get("incident"))
         # the demo maps one batch to one step, so the committed step
         # counter IS the resume index (the commit marker guarantees
         # every rank picked the same one)

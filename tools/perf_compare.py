@@ -4,8 +4,8 @@ committed ones.
 
 The nightly refreshes the tracked bench artifacts (FUSED_BENCH.json,
 SCALING.json, SERVING_BENCH.json, COMPILE_CACHE.json, HEALTH.json,
-GOODPUT.json, RESILIENCE.json, AUTOTUNE.json) in the work tree; this
-tool compares
+GOODPUT.json, RESILIENCE.json, AUTOTUNE.json, INCIDENT.json) in the
+work tree; this tool compares
 each against the version committed
 at --ref (``git show REF:NAME``) and fails on
 
@@ -39,6 +39,10 @@ at --ref (``git show REF:NAME``) and fails on
     stored tuned config that no longer beats the defaults on the
     goodput objective (gate_ok / any scenario ok false) fails the
     nightly rather than shipping a stale winner.
+  * an **incident-attribution failure** (INCIDENT.json): same strict
+    policy — the chaos known-answer postmortem must keep naming the
+    injected rank/category/step; a first-failure attribution that
+    degrades to "unknown" is never grandfathered.
 
 Artifacts missing on either side are reported and skipped — a bench
 stage that timed out must fail the nightly through its own return
@@ -74,7 +78,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_ARTIFACTS = ("FUSED_BENCH.json", "SCALING.json",
                      "SERVING_BENCH.json", "COMPILE_CACHE.json",
                      "HEALTH.json", "GOODPUT.json", "RESILIENCE.json",
-                     "AUTOTUNE.json")
+                     "AUTOTUNE.json", "INCIDENT.json")
 
 _ATTRIBUTION_PATH = os.path.join(
     _REPO, "mxnet_tpu", "telemetry", "mxtriage", "attribution.py")
@@ -241,6 +245,23 @@ def _autotune(d) -> dict:
     return {"checks": c, "strict": True}
 
 
+def _incident(d) -> dict:
+    """INCIDENT.json: the crash-forensics known-answer lanes, ALL
+    STRICT — every selftest check (job recovered, incident written and
+    attributed, rank/category/step named exactly, the id flowing into
+    the epoch record and COMMIT marker, WTERMSIG-resolved exit
+    classification) fails the nightly on any false, never
+    grandfathered.  No metric lanes: detection lag is poll-interval
+    noise on a 1-core box; the signal is binary attribution
+    correctness."""
+    c = {}
+    if "gate_ok" in d:
+        c["gate_ok"] = bool(d["gate_ok"])
+    for check, ok in (d.get("checks") or {}).items():
+        c[f"checks.{check}"] = bool(ok)
+    return {"checks": c, "strict": True}
+
+
 EXTRACTORS = {
     "FUSED_BENCH.json": _fused,
     "SERVING_BENCH.json": _serving,
@@ -250,6 +271,7 @@ EXTRACTORS = {
     "GOODPUT.json": _goodput,
     "RESILIENCE.json": _resilience,
     "AUTOTUNE.json": _autotune,
+    "INCIDENT.json": _incident,
 }
 
 

@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Cross-rank incident reconstruction (ISSUE 17): merge a generation's
+mxblackbox crash bundles into one causally-ordered INCIDENT.json.
+
+Thin CLI over :mod:`mxnet_tpu.telemetry.mxblackbox.postmortem` — the
+elastic Supervisor invokes the same module per failure epoch; this
+tool re-runs it by hand over any blackbox dir, and carries the nightly
+known-answer selftest.
+
+    # reconstruct from a blackbox dir (a supervisor run's
+    # <elastic-dir>/blackbox, or any MXNET_BLACKBOX_DIR):
+    python tools/postmortem.py /ckpt/job1/blackbox --gen 0 \
+        --out INCIDENT.json
+
+    # the known-answer gate (what run_nightly's blackbox stage runs):
+    # supervise the demo job with a deterministic chaos kill of rank 1
+    # at step 4, then assert the reconstructed incident names exactly
+    # that rank / category / step — and that the incident id flowed
+    # into the COMMIT marker and the supervisor epoch record
+    JAX_PLATFORMS=cpu python tools/postmortem.py --selftest \
+        --out INCIDENT.json
+
+The selftest artifact is HEALTH-policy: ``gate_ok`` must be true, and
+perf_compare's INCIDENT.json lane is strict (never grandfathered) —
+attribution that silently degrades to "unknown" fails the nightly
+even if it was already broken at the baseline.
+
+Exit: 0 on success / gate pass, 1 on gate fail, 2 on usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+#: the known-answer injection (kept in one place so the docstring,
+#: the chaos spec, and the checks can never drift apart)
+_KA = {"rank": 1, "category": "chaos", "step": 4,
+       "spec": "elastic.worker@4:die:rank=1"}
+
+
+def _write(path: str, payload: dict) -> None:
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, default=repr)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def _abbrev(report: dict, timeline: int = 40) -> dict:
+    """The committed artifact keeps a bounded timeline (the full one
+    lives in the supervisor's INCIDENT-epoch file)."""
+    out = dict(report)
+    out["timeline"] = report.get("timeline", [])[-timeline:]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# selftest: the chaos known-answer e2e
+# ---------------------------------------------------------------------------
+
+def selftest(out_path: str, keep_dir: bool = False) -> int:
+    from mxnet_tpu.resilience.elastic import read_commit
+
+    d = tempfile.mkdtemp(prefix="mx-postmortem-ka-")
+    cmd = [sys.executable, os.path.join(_REPO, "tools",
+                                        "elastic_run.py"),
+           "--demo", "--cpu", "--workers", "2", "--steps", "8",
+           "--mode", "replace", "--dir", d,
+           "--hb-timeout", "8", "--collective-timeout", "6",
+           "--grace", "12", "--chaos", _KA["spec"]]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.time()
+    proc = subprocess.run(cmd, env=env, capture_output=True,
+                          text=True, timeout=600)
+    try:
+        sup_report = json.loads(
+            proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        sup_report = {"ok": False,
+                      "error": f"unparseable supervisor output "
+                               f"(rc {proc.returncode})",
+                      "stderr": proc.stderr[-2000:]}
+
+    epochs = sup_report.get("epochs") or [{}]
+    epoch0 = epochs[0]
+    incident_path = os.path.join(d, "blackbox", "INCIDENT-epoch1.json")
+    incident = {}
+    try:
+        with open(incident_path) as f:
+            incident = json.load(f)
+    except (OSError, ValueError):
+        pass
+    commit = read_commit(d) or {}
+    ff = incident.get("first_failure") or {}
+    detection = incident.get("detection") or {}
+
+    checks = {
+        "job_recovered": bool(sup_report.get("ok")),
+        "incident_written": bool(incident),
+        "attributed": bool(incident.get("attributed")),
+        "rank_correct": ff.get("rank") == _KA["rank"],
+        "category_correct": ff.get("category") == _KA["category"],
+        "step_correct": ff.get("step") == _KA["step"],
+        "incident_in_epoch":
+            epoch0.get("incident_id") ==
+            incident.get("incident_id") and
+            bool(incident.get("incident_id")),
+        "incident_in_commit":
+            commit.get("incident") == incident.get("incident_id"),
+        "detection_measured":
+            detection.get("lag_s") is not None,
+        "exit_classified":
+            (epoch0.get("exits", {}).get(str(_KA["rank"]), {})
+             .get("classified") == "died"),
+    }
+    artifact = {
+        "when": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "duration_s": round(time.time() - t0, 3),
+        "expected": dict(_KA),
+        "checks": checks,
+        "gate_ok": all(checks.values()),
+        "first_failure": ff,
+        "detection": detection,
+        "incident": _abbrev(incident) if incident else None,
+        "supervisor": {k: sup_report.get(k) for k in
+                       ("ok", "restarts", "mode", "final_world")},
+        "epoch": {k: epoch0.get(k) for k in
+                  ("failed_ranks", "incident_id", "committed_step",
+                   "mttr_s", "exits")},
+    }
+    _write(out_path, artifact)
+    ok = artifact["gate_ok"]
+    print(f"postmortem selftest: gate_ok={ok} "
+          f"first_failure=rank {ff.get('rank')} "
+          f"category {ff.get('category')} step {ff.get('step')} "
+          f"-> {out_path}")
+    if not ok:
+        bad = [k for k, v in checks.items() if not v]
+        print(f"  failed checks: {bad}", file=sys.stderr)
+        print(f"  supervisor: {json.dumps(sup_report)[:1500]}",
+              file=sys.stderr)
+    if not keep_dir:
+        import shutil
+
+        shutil.rmtree(d, ignore_errors=True)
+    else:
+        print(f"  kept {d}")
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge a generation's mxblackbox crash bundles "
+                    "into one causally-ordered incident report")
+    ap.add_argument("blackbox_dir", nargs="?",
+                    help="bundle directory (a supervisor run's "
+                         "<dir>/blackbox or any MXNET_BLACKBOX_DIR)")
+    ap.add_argument("--gen", type=int, default=None,
+                    help="only bundles of this elastic generation")
+    ap.add_argument("--epoch", type=int, default=0,
+                    help="epoch number stamped into the report")
+    ap.add_argument("--out", default=None,
+                    help="write the report here (default: "
+                         "INCIDENT-epoch<N>.json beside the bundles; "
+                         "for --selftest: INCIDENT.json)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the chaos known-answer e2e and gate the "
+                         "reconstructed incident (the nightly "
+                         "blackbox stage)")
+    ap.add_argument("--keep", action="store_true",
+                    help="selftest: keep the run directory")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest(args.out or "INCIDENT.json",
+                        keep_dir=args.keep)
+    if not args.blackbox_dir:
+        print("error: give a blackbox dir or --selftest",
+              file=sys.stderr)
+        return 2
+
+    from mxnet_tpu.telemetry.mxblackbox import postmortem as pm
+
+    report = pm.run_epoch(args.blackbox_dir, args.epoch,
+                          gen=args.gen, out_path=args.out)
+    if report is None:
+        print("error: reconstruction failed", file=sys.stderr)
+        return 1
+    ff = report.get("first_failure") or {}
+    print(f"{report['incident_id']}: {report['bundles']} bundles, "
+          f"ranks {report['ranks']}, first failure "
+          f"rank {ff.get('rank')} category {ff.get('category')} "
+          f"step {ff.get('step')} -> {report.get('path')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

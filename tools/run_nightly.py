@@ -562,6 +562,48 @@ def main():
         autotune_rc = -1
         artifact["autotune"] = {"returncode": -1, "note": "timed out"}
 
+    # blackbox stage (ISSUE 17): the slow crash-forensics e2e (a
+    # supervised chaos kill must yield bundles from every path — the
+    # dying rank's own, the survivor's peer_failed, the supervisor
+    # scrape — and a correctly-attributed incident) plus the strict
+    # postmortem known-answer selftest refreshing INCIDENT.json — the
+    # tracked artifact perf_compare gates with STRICT lanes (a
+    # first-failure attribution that degrades to 'unknown' is never
+    # grandfathered).  Runs BEFORE perf-compare so the artifact it
+    # diffs is fresh.
+    blackbox_rc = None
+    try:
+        bsl = subprocess.run(
+            [sys.executable, "-m", "pytest",
+             "tests/test_mxblackbox.py", "-q", "-m", "slow",
+             "-p", "no:cacheprovider"],
+            capture_output=True, text=True, timeout=1200, cwd=_REPO,
+            env=cpu_env)
+        br = subprocess.run(
+            [sys.executable, "tools/postmortem.py", "--selftest",
+             "--out", os.path.join(_REPO, "INCIDENT.json")],
+            capture_output=True, text=True, timeout=900, cwd=_REPO,
+            env=cpu_env)
+        blackbox_rc = br.returncode if br.returncode != 0 \
+            else bsl.returncode
+        gate = {"returncode": br.returncode,
+                "slow_tests_returncode": bsl.returncode,
+                "slow_tests_tail":
+                    "\n".join(bsl.stdout.splitlines()[-1:]),
+                "stderr_tail": "\n".join(br.stderr.splitlines()[-6:])}
+        try:
+            with open(os.path.join(_REPO, "INCIDENT.json")) as f:
+                rep = json.load(f)
+            gate["gate_ok"] = rep["gate_ok"]
+            gate["checks"] = rep["checks"]
+            gate["first_failure"] = rep["first_failure"]
+        except (OSError, ValueError, KeyError):
+            pass
+        artifact["blackbox"] = gate
+    except subprocess.TimeoutExpired:
+        blackbox_rc = -1
+        artifact["blackbox"] = {"returncode": -1, "note": "timed out"}
+
     # perf-compare gate (ISSUE 10): the bench artifacts this nightly
     # just refreshed (FUSED/SCALING/COMPILE_CACHE/HEALTH; SERVING when
     # its strict lane rewrote it) vs the committed versions — >10%
@@ -598,7 +640,8 @@ def main():
         and spmd_rc in (None, 0) and heavy_rc in (None, 0) \
         and mxprof_rc in (None, 0) and health_rc in (None, 0) \
         and triage_rc in (None, 0) and goodput_rc in (None, 0) \
-        and autotune_rc in (None, 0) and perf_rc in (None, 0) else 1
+        and autotune_rc in (None, 0) and blackbox_rc in (None, 0) \
+        and perf_rc in (None, 0) else 1
 
 
 if __name__ == "__main__":
