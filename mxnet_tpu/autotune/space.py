@@ -99,10 +99,14 @@ def neighbor(rng: random.Random, config: Dict[str, Any],
         cur = math.sqrt(t.lo * t.hi) if t.scale == "log" \
             else (t.lo + t.hi) / 2
     if t.scale == "log":
-        out[d.name] = _clamp(d, cur * rng.choice((0.5, 2.0)))
+        down, up = cur * 0.5, cur * 2.0
     else:
         step = (t.hi - t.lo) / 8.0
-        out[d.name] = _clamp(d, cur + rng.choice((-step, step)))
+        down, up = cur - step, cur + step
+    nv = _clamp(d, rng.choice((down, up)))
+    if nv == cur:  # clamped back onto the incumbent (cur at a bound):
+        nv = _clamp(d, down if nv == _clamp(d, up) else up)  # go the other way
+    out[d.name] = nv
     return out
 
 

@@ -50,6 +50,12 @@ class KVStore:
         self._updater: Optional[Callable] = None
         self._optimizer: Optional[opt_mod.Optimizer] = None
         self._compression = None
+        # MXNET_COMM_QUANT error-feedback residuals for the SPMD bucket
+        # reduce, keyed by ONE live bucket-layout signature: transient
+        # comm state (re-zeroed when the layout changes, not
+        # checkpointed — the optimizer-side residuals are the durable
+        # ones; these only span consecutive identical pushes)
+        self._quant_res: Dict[tuple, tuple] = {}
 
     # ---- identity --------------------------------------------------------
     @property
@@ -255,18 +261,51 @@ class KVStore:
                 shards.append(d[None])
             args.append(jax.make_array_from_single_device_arrays(
                 (nrep,) + shp, sh, shards))
-        out_g = _mesh_reduce(mesh.mesh, shapes)(*args)
+        import numpy as np
+        from .optimizer import comm as _comm
+
+        q = _comm.config()
+        sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+        quant = q.applies(sum(sizes))
+        if quant:
+            # encode each key's per-replica rows (+ residual), exchange
+            # 1-byte codes, sum the dequantized rows locally — same
+            # error-feedback scheme as the optimizer-side buckets
+            from .parallel.spmd import _global_put
+            qsig = (tuple(keys[p] for p in poss), shapes, nrep,
+                    q.mode, q.ef)
+            res = self._quant_res.get(qsig)
+            if res is None:
+                row_sh = NamedSharding(mesh.mesh, P("dp", None))
+                res = tuple(
+                    _global_put(np.zeros((nrep, n), np.float32),
+                                row_sh) for n in sizes)
+            out_g, new_res = _mesh_reduce_quant(
+                mesh.mesh, shapes, q.mode, q.ef)(args, res)
+            # one live layout: gradients push in a stable bucket order,
+            # so a signature change means the layout changed for good
+            self._quant_res = {qsig: new_res}
+        else:
+            out_g = _mesh_reduce(mesh.mesh, shapes)(*args)
         from .telemetry import tracing as _tracing
         _snk = _tracing._SINK
         if _tracing._ENABLED or _snk is not None:
             payload = sum(a.nbytes // nrep for a in args)
+            enc = q.mode if quant else "raw"
+            wire = sum(_comm.wire_nbytes(n, nrep, q.mode)
+                       for n in sizes) if quant else payload
             if _tracing._ENABLED:
                 from .telemetry import instruments as _ins
 
                 _ins.collective_bytes_total("all-reduce",
                                             "dp").inc(payload)
+                _ins.collective_wire_bytes_total("all-reduce", "dp",
+                                                 enc).inc(wire)
             if _snk is not None:  # mxprof flight recorder
                 _snk.on_bytes("all-reduce", "dp", payload)
+                _ob = getattr(_snk, "on_wire_bytes", None)
+                if _ob is not None:
+                    _ob("all-reduce", "dp", enc, wire)
         for p, og in zip(poss, out_g):
             per_dev = {s.device: s.data for s in og.addressable_shards}
             ctx0 = vals[p][0].ctx
@@ -547,6 +586,42 @@ def _mesh_reduce(mesh, shapes: tuple):
         return tuple(
             jax.lax.with_sharding_constraint(jnp.sum(s, axis=0), repl)
             for s in stacks)
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def _mesh_reduce_quant(mesh, shapes: tuple, mode: str, ef: bool):
+    """MXNET_COMM_QUANT variant of :func:`_mesh_reduce`: each stacked
+    [n_replica, ...] gradient is flattened to per-replica rows, rows are
+    encoded to 1-byte codes with per-block scales (plus the carried
+    error-feedback residual), the CODES are what the mesh exchanges,
+    and every replica sums the dequantized rows locally — identical
+    inputs on every shard, so outputs stay bit-identical across
+    replicas.  Returns ``(reduced, new_residuals)``."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from .optimizer import comm as _comm
+
+    repl = NamedSharding(mesh, P())
+    row_sh = NamedSharding(mesh, P("dp", None))
+    csn = jax.lax.with_sharding_constraint
+    f32 = jnp.float32
+
+    def f(stacks, res):
+        outs, new_res = [], []
+        for s, r, shp in zip(stacks, res, shapes):
+            dt = s.dtype
+            rows = csn(s.reshape(s.shape[0], -1), row_sh).astype(f32)
+            acc = rows + r if ef else rows
+            codes, scale = _comm.encode(acc, mode)
+            new_res.append(
+                csn(acc - _comm.decode(codes, scale), row_sh)
+                if ef else csn(jnp.zeros_like(acc), row_sh))
+            codes_r = csn(codes, repl)       # the 1-byte exchange
+            scale_r = csn(scale, repl)
+            red = jnp.sum(_comm.decode(codes_r, scale_r), axis=0)
+            outs.append(csn(red, repl).reshape(shp).astype(dt))
+        return tuple(outs), tuple(new_res)
 
     return jax.jit(f)
 

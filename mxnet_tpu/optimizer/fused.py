@@ -498,10 +498,17 @@ class FusedUpdater(Updater):
             return lowered
 
         # the NAMED sig view compile provenance diffs a miss against
-        # (sig layout: see the tuple built in update_multi)
+        # (sig layout: see the tuple built in update_multi).  The live
+        # collective wire encoding rides along as plan metadata: the
+        # per-replica program itself never encodes, but the kvstore
+        # reduce feeding it does, so a provenance diff can say "the
+        # executable rebuilt while the wire encoding flipped"
+        from . import comm as _comm
+
         components = {"optimizer": sig[0], "statics": sig[1],
                       "mp": sig[2], "donation": sig[3],
                       "device": sig[4], "health_mode": sig[5],
-                      "treedef": sig[6], "avals": sig[7]}
+                      "treedef": sig[6], "avals": sig[7],
+                      "wire_encoding": _comm.config().mode}
         return _FUSED_CACHE.compile(sig, build_lowered, self.optimizer,
                                     components=components)

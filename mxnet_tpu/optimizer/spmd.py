@@ -61,10 +61,12 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ndarray.ndarray import NDArray
+from ..resilience import chaos as _chaos
 from ..telemetry import instruments as _ins
 from ..telemetry import mxhealth as _mxhealth
 from ..telemetry import tracing as _tracing
 from ..util import env as _env
+from . import comm as _comm
 from .fused import (ExecutableCache, FusedUnsupported, _leaf_aval,
                     _nonfinite_count, _sq_norms, _tree_select,
                     apply_param)
@@ -126,6 +128,15 @@ def _pad_flat(x, padded: int):
     if f.shape[0] == padded:
         return f
     return jnp.pad(f, (0, padded - f.shape[0]))
+
+
+def _pad_rows(g, padded: int):
+    """Flatten a stacked ``(nshard,) + shape`` gradient per row and
+    zero-pad each row to the shard-divisible length (traced)."""
+    f = g.reshape(g.shape[0], -1)
+    if f.shape[1] == padded:
+        return f
+    return jnp.pad(f, ((0, 0), (0, padded - f.shape[1])))
 
 
 def _tree_map(fn, tree):
@@ -202,6 +213,15 @@ class SpmdUpdater(Updater):
         self._meta: Dict[int, _Meta] = {}
         self._pending: Optional[Dict[int, Any]] = None  # numpy trees
         self._phased = {}            # sig -> (reduce, update, gather)
+        # quantized collectives (MXNET_COMM_QUANT): static config, the
+        # per-bucket error-feedback residual state ((grad, weight-delta)
+        # pairs, dp-sharded rows beside _bstate), canonical residuals
+        # pending from set_states, and the overlap-mode stage programs
+        self._quant = _comm.config()
+        self._overlap = _env.get_bool("MXNET_COMM_OVERLAP")
+        self._qstate: Dict[int, Tuple] = {}  # bucket ordinal -> (g, w)
+        self._pending_q: Optional[Dict[str, Any]] = None
+        self._overlap_fns = {}       # sig -> (bucket reduce fns, tail)
         # steady-state caches: the signature (treedef/avals never
         # change while the param set is stable) and the replicated
         # weight globals (last step's OUTPUT is next step's input when
@@ -289,6 +309,15 @@ class SpmdUpdater(Updater):
             for _, ps in sorted(smalls.items()))
         return _Plan(tuple(buckets), small_groups, tuple(singles))
 
+    def _quant_buckets(self, plan: _Plan) -> Tuple[int, ...]:
+        """Bucket ordinals whose collectives quantize: ZeRO sharding
+        active (a 1-shard mesh moves no wire bytes) and the bucket
+        clears MXNET_COMM_QUANT_MIN_SIZE."""
+        if not (self._flat and self._quant.active):
+            return ()
+        return tuple(bi for bi, b in enumerate(plan.buckets)
+                     if self._quant.applies(b.total))
+
     # ---- sharding/data movement -----------------------------------------
     def _shard(self, flat: bool) -> NamedSharding:
         return NamedSharding(self._mesh.mesh, P(AXIS) if flat else P())
@@ -342,6 +371,36 @@ class SpmdUpdater(Updater):
                 return _global_put(f, self._shard(True))
 
             self._pstate[i] = _tree_map(put_single, host[i])
+        # error-feedback residuals for the quantized buckets: restore
+        # the canonical per-param residuals (grad side: total owed
+        # signal, assigned to replica 0's row — the per-row split is a
+        # mesh artifact, the SUM is the state; weight side: the flat
+        # concat maps 1:1 onto the shard rows) or start at zero
+        self._qstate.clear()
+        qbis = self._quant_buckets(plan)
+        if qbis:
+            nshard = self.nshard
+            pend_q = self._pending_q or {}
+            pg = pend_q.get("grads") or {}
+            pw = pend_q.get("weights") or {}
+            row_sh = NamedSharding(self._mesh.mesh, P(AXIS, None))
+            for bi in qbis:
+                b = plan.buckets[bi]
+                gres = np.zeros((nshard, b.total), np.float32)
+                wflat = np.zeros((b.total,), np.float32)
+                for p, off in zip(b.pos, b.offsets):
+                    i = indices[p]
+                    m = self._meta[i]
+                    if i in pg:
+                        gres[0, off:off + m.size] = \
+                            np.asarray(pg[i], np.float32).reshape(-1)
+                    if i in pw:
+                        wflat[off:off + m.size] = \
+                            np.asarray(pw[i], np.float32).reshape(-1)
+                self._qstate[bi] = (
+                    _global_put(gres, row_sh),
+                    _global_put(wflat.reshape(nshard, -1), row_sh))
+        self._pending_q = None
         self._pending = None
 
     def _gather_np(self, garr) -> np.ndarray:
@@ -453,15 +512,29 @@ class SpmdUpdater(Updater):
                 [r.data[None] for r in g])
             for i, g in zip(indices, grads))
         plan = self._plan
+        qbis = self._quant_buckets(plan)
         s_tup = (tuple(self._bstate[bi]
                        for bi in range(len(plan.buckets))),
                  tuple(self._pstate[i] for i in indices
                        if i in self._pstate))
+        if qbis:
+            # residual state rides the donated states argument; the
+            # traced per-quant-bucket scale multiplier is 1.0 except
+            # under chaos (site comm.quant: a flipped scale must light
+            # up mxhealth, not silently corrupt the run)
+            from ..parallel.spmd import _global_put
+            s_tup = s_tup + (tuple(self._qstate[bi] for bi in qbis),)
+            qm = np.ones((len(qbis),), np.float32)
+            if _chaos._ACTIVE \
+                    and _chaos.check("comm.quant") == "corrupt":
+                qm[0] = np.float32("inf")
+            qmult = _global_put(qm, NamedSharding(mesh.mesh, P()))
         mp_flags = tuple(self._mp[i] for i in indices)
         metas = tuple(self._meta[i] for i in indices)
 
         hm = _mxhealth.mode() if _mxhealth._ACTIVE else None
-        args = (w_tup, g_tup, s_tup, h_vecs)
+        args = (w_tup, g_tup, s_tup, h_vecs) if not qbis \
+            else (w_tup, g_tup, s_tup, h_vecs, qmult)
         # raise policy: donation off — pre-step state buffers must
         # survive the raise (fused-path precedent)
         donate = mesh.devices[0].platform not in ("cpu",) \
@@ -469,7 +542,7 @@ class SpmdUpdater(Updater):
         sig_key = (idx_key, nrep, opt.fused_static_key(),
                    tuple(m.dtype for m in metas),
                    tuple(str(g[0].data.dtype) for g in grads),
-                   tuple(h_vecs), hm)
+                   tuple(h_vecs), hm, self._quant)
         if self._sig_cache is not None and self._sig_cache[0] == sig_key:
             sig = self._sig_cache[1]
         else:
@@ -482,7 +555,7 @@ class SpmdUpdater(Updater):
             sig = (type(opt), opt.fused_static_key(), mp_flags, metas,
                    plan, self._flat, donate, self._layout, hm,
                    tuple(str(d) for d in mesh.devices), treedef,
-                   tuple(_leaf_aval(x) for x in leaves))
+                   tuple(_leaf_aval(x) for x in leaves), self._quant)
             self._sig_cache = (sig_key, sig)
 
         # the phased (3-dispatch) variant keys on capture_active(), NOT
@@ -490,14 +563,20 @@ class SpmdUpdater(Updater):
         # one-program step it exists to measure.  With mxhealth on, the
         # unified program runs even while capturing — the numerics
         # outputs (and the skip_step guard) live inside it, and a
-        # capture must not turn the guard off.
-        if self._flat and _tracing.capture_active() and hm is None:
-            new_w, new_s = self._run_phased(sig, args, mp_flags, metas)
+        # capture must not turn the guard off.  MXNET_COMM_OVERLAP
+        # outranks the phased variant: serializing the stages would
+        # un-overlap exactly what the lane measures.
+        if self._overlap and self._flat and hm is None and plan.buckets:
+            new_w, new_s = self._run_overlap(sig, args, mp_flags,
+                                             metas, qbis)
+        elif self._flat and _tracing.capture_active() and hm is None:
+            new_w, new_s = self._run_phased(sig, args, mp_flags, metas,
+                                            qbis)
         else:
             fn = _SPMD_CACHE.lookup(sig)
             if fn is None:
                 fn = self._compile(sig, args, mp_flags, metas, donate,
-                                   hm)
+                                   hm, qbis)
             out = fn(*args)
             if hm is not None:
                 new_w, new_s, health = out
@@ -515,7 +594,7 @@ class SpmdUpdater(Updater):
             c = _SPMD_CACHE.cost(sig)
             if c is not None:
                 snk.on_flops(_SPMD_CACHE.site, c)
-        self._count_bytes(metas, plan)
+        self._count_bytes(metas, plan, qbis)
 
         for i, w, nw in zip(indices, weights, new_w):
             per_dev = {s.device: s.data for s in nw.addressable_shards}
@@ -524,14 +603,17 @@ class SpmdUpdater(Updater):
                 r._data = per_dev[r.ctx.jax_device]
                 bound.append(r._data)
             self._w_global[i] = (tuple(bound), nw)
-        nb_states, np_states = new_s
+        nb_states, np_states = new_s[0], new_s[1]
         for bi, tree in enumerate(nb_states):
             self._bstate[bi] = tree
         pidx = [i for i in indices if i in self._pstate]
         for i, tree in zip(pidx, np_states):
             self._pstate[i] = tree
+        if qbis:
+            for j, bi in enumerate(qbis):
+                self._qstate[bi] = new_s[2][j]
 
-    def _count_bytes(self, metas, plan):
+    def _count_bytes(self, metas, plan, qbis=()):
         snk = _tracing._SINK
         if not _tracing._ENABLED and snk is None:
             return
@@ -554,29 +636,85 @@ class SpmdUpdater(Updater):
                 _ins.collective_bytes_total("all-reduce", AXIS).inc(ar)
             if snk is not None:
                 snk.on_bytes("all-reduce", AXIS, ar)
+        # the WIRE view: what actually crosses the interconnect this
+        # step, split by encoding.  Quantized buckets move 1-byte codes
+        # plus one f32 scale per 512-element block on both legs;
+        # everything
+        # else moves its payload dtype as-is ('raw').  The logical
+        # counters above stay flat by design — the two series disagree
+        # exactly when MXNET_COMM_QUANT is earning its keep.
+        mode, nshard, qset = self._quant.mode, self.nshard, set(qbis)
+        wire: Dict[Tuple[str, str], int] = {}
+
+        def add(op, enc, n):
+            wire[(op, enc)] = wire.get((op, enc), 0) + n
+
+        for bi, b in enumerate(plan.buckets):
+            if bi in qset:
+                n = _comm.wire_nbytes(b.total, nshard, mode)
+                add("reduce-scatter", mode, n)
+                add("all-gather", mode, n)
+            else:
+                n = nbytes(b.pos)
+                add("reduce-scatter", "raw", n)
+                add("all-gather", "raw", n)
+        if plan.singles:
+            n = nbytes(plan.singles)
+            add("reduce-scatter", "raw", n)
+            add("all-gather", "raw", n)
+        if ar:
+            add("all-reduce", "raw", ar)
+        ob = getattr(snk, "on_wire_bytes", None) \
+            if snk is not None else None
+        for (op, enc), n in wire.items():
+            if _tracing._ENABLED:
+                _ins.collective_wire_bytes_total(op, AXIS, enc).inc(n)
+            if ob is not None:
+                ob(op, AXIS, enc, n)
 
     # ---- program builders ------------------------------------------------
-    def _stages(self, mp_flags, metas):
+    def _stages(self, mp_flags, metas, qbis=()):
         """The three stages of the step, split at the collective
         boundaries.  ``_build_step`` composes them into ONE program;
         the phased tracing variant runs them as three so trace_report
-        can attribute wall time per phase.
+        can attribute wall time per phase; the overlap variant runs
+        ``reduce_bucket`` as one tiny program per bucket (issued in
+        gradient-ready order) and everything else as a tail program.
 
         Stage contracts (all traced, all pure):
-          reduce(gstacks)                  -> reduced parts
+          reduce(gstacks[, qres, qmult])   -> reduced parts
+                                              (+ new grad residuals)
           update(weights, parts, states, hyper) -> (new flat/shaped
                                               weights parts, new states)
-          gather(parts)                    -> per-param full weights
+          gather(parts[, qres])            -> per-param full weights
+                                              (+ new delta residuals)
         'parts' are plan-shaped: one concat flat per bucket (sharded),
         one concat flat per small group (replicated), one flat per
         single (sharded).
+
+        ``qbis`` names the bucket ordinals whose collectives quantize
+        (MXNET_COMM_QUANT): their gradient reduce becomes encode ->
+        1-byte all-to-all + scale exchange -> local weighted sum, and
+        their weight gather becomes a 1-byte all-gather of the encoded
+        weight DELTA — every shard applies the identical dequantized
+        delta to the identical replicated old weights, so replicas stay
+        bit-identical.  Both legs carry error-feedback residuals (the
+        quantization remainder re-enters the next step's payload).
+        With ``qbis`` empty every traced op below is byte-identical to
+        the unquantized program.
         """
         opt = self.optimizer
         plan = self._plan
         mesh = self._mesh
+        nsh = mesh.size(AXIS)
         shard = NamedSharding(mesh.mesh, P(AXIS))
         repl = NamedSharding(mesh.mesh, P())
+        row_sh = NamedSharding(mesh.mesh, P(AXIS, None))
+        col_sh = NamedSharding(mesh.mesh, P(None, AXIS))
         csn = lax.with_sharding_constraint
+        mode, ef = self._quant.mode, self._quant.ef
+        qpos = {bi: j for j, bi in enumerate(qbis)}
+        f32 = jnp.float32
         # static per-bucket segment-id arrays (element -> param position
         # in the hyper vector), built on the host ONCE.  A constant-index
         # gather partitions cleanly; jnp.repeat inside the sharded
@@ -585,22 +723,67 @@ class SpmdUpdater(Updater):
         b_seg = [np.repeat(np.asarray(b.pos, np.int64),
                            np.asarray(b.sizes)) for b in plan.buckets]
 
-        def reduce_stage(gstacks):
-            parts = []
-            for b in plan.buckets:
+        def reduce_bucket(bi, gsub, qpair=None, qmult=None):
+            """One bucket's gradient reduce; ``gsub`` are the stacked
+            grads for ``plan.buckets[bi].pos`` in order.  Unquantized:
+            replica-sum then shard (-> (part,)).  Quantized: encode the
+            per-replica rows (+ residual), exchange 1-byte codes, sum
+            the dequantized rows locally (-> (part, new_gres))."""
+            b = plan.buckets[bi]
+            j = qpos.get(bi)
+            if j is None:
                 cat = jnp.concatenate(
-                    [_pad_flat(gstacks[p].reshape(
-                        gstacks[p].shape[0], -1).sum(axis=0),
-                        metas[p].padded) for p in b.pos])
-                parts.append(csn(cat, shard))      # reduce-scatter
+                    [_pad_flat(g.reshape(g.shape[0], -1).sum(axis=0),
+                               metas[p].padded)
+                     for g, p in zip(gsub, b.pos)])
+                return (csn(cat, shard),)          # reduce-scatter
+            gdt = gsub[0].dtype
+            rows = jnp.concatenate(
+                [_pad_rows(g, metas[p].padded)
+                 for g, p in zip(gsub, b.pos)], axis=1)
+            rows = csn(rows, row_sh).astype(f32)   # (nshard, total)
+            acc = rows + qpair[0] if ef else rows
+            codes, scale = _comm.encode(acc, mode)
+            scale = scale * qmult[j]               # chaos: comm.quant
+            new_gres = csn(acc - _comm.decode(codes, scale), row_sh) \
+                if ef else csn(jnp.zeros_like(acc), row_sh)
+            codes_t = csn(codes, col_sh)           # all-to-all, 1B/elem
+            scale_r = csn(scale, repl)             # scale exchange
+            red = jnp.sum(_comm.decode(codes_t, scale_r),
+                          axis=0).astype(gdt)
+            return csn(red, shard), new_gres
+
+        def reduce_rest(rmap):
+            """The small-group all-reduces and single-param reduces;
+            ``rmap`` maps param position -> stacked grads."""
+            parts = []
             for g in plan.smalls:
                 cat = jnp.concatenate(
-                    [gstacks[p].reshape(gstacks[p].shape[0], -1)
+                    [rmap[p].reshape(rmap[p].shape[0], -1)
                      for p in g.pos], axis=1).sum(axis=0)
                 parts.append(csn(cat, repl))       # one all-reduce
             for p in plan.singles:
                 parts.append(csn(_pad_flat(
-                    gstacks[p].sum(axis=0), metas[p].padded), shard))
+                    rmap[p].sum(axis=0), metas[p].padded), shard))
+            return tuple(parts)
+
+        rest_pos = tuple(sorted(
+            {p for g in plan.smalls for p in g.pos}
+            | set(plan.singles)))
+
+        def reduce_stage(gstacks, qres=(), qmult=None):
+            parts, new_gres = [], []
+            for bi, b in enumerate(plan.buckets):
+                j = qpos.get(bi)
+                out = reduce_bucket(
+                    bi, tuple(gstacks[p] for p in b.pos),
+                    qres[j] if j is not None else None, qmult)
+                parts.append(out[0])
+                if j is not None:
+                    new_gres.append(out[1])
+            parts.extend(reduce_rest({p: gstacks[p] for p in rest_pos}))
+            if qbis:
+                return tuple(parts), tuple(new_gres)
             return tuple(parts)
 
         def update_stage(weights, parts, states, hyper_vecs):
@@ -655,13 +838,40 @@ class SpmdUpdater(Updater):
             new_pstates = tuple(new_p[p] for p in sorted(new_p))
             return tuple(new_parts), (tuple(new_b), new_pstates)
 
-        def gather_stage(parts, weights):
+        def gather_stage(parts, weights, qres=()):
             """parts -> per-param full-shape weights (original order);
-            `weights` only supplies dtypes."""
+            `weights` supplies dtypes — and, for quantized buckets, the
+            replicated OLD values the encoded delta applies to."""
             out: Dict[int, Any] = {}
+            new_wres = []
             k = 0
-            for b in plan.buckets:
-                full = csn(parts[k], repl)          # all-gather
+            for bi, b in enumerate(plan.buckets):
+                j = qpos.get(bi)
+                if j is None:
+                    full = csn(parts[k], repl)      # all-gather
+                else:
+                    # quantized: gather the encoded weight DELTA, not
+                    # the weights — every shard applies the identical
+                    # dequantized update to the identical replicated
+                    # old flat, so replicas stay bit-identical and the
+                    # wire moves 1 byte/elem
+                    old_full = jnp.concatenate(
+                        [_pad_flat(weights[p], metas[p].padded)
+                         .astype(f32) for p in b.pos])
+                    delta = parts[k].astype(f32) - csn(old_full, shard)
+                    acc = csn(delta.reshape(nsh, -1), row_sh)
+                    if ef:
+                        acc = acc + qres[j][1]
+                    codes, scale = _comm.encode(acc, mode)
+                    new_wres.append(
+                        csn(acc - _comm.decode(codes, scale), row_sh)
+                        if ef else csn(jnp.zeros_like(acc), row_sh))
+                    codes_r = csn(codes, repl)      # all-gather, 1B/elem
+                    scale_r = csn(scale, repl)      # scale exchange
+                    deq = _comm.decode(codes_r, scale_r).reshape(-1)
+                    # pin the result replicated: it feeds straight back
+                    # as next step's weights input (cached all-gather)
+                    full = csn(old_full + deq, repl)
                 for p, off, sz in zip(b.pos, b.offsets, b.sizes):
                     m = metas[p]
                     out[p] = lax.slice(full, (off,), (off + m.size,)) \
@@ -682,19 +892,40 @@ class SpmdUpdater(Updater):
                 out[p] = lax.slice(full, (0,), (m.size,)) \
                     .reshape(m.shape).astype(weights[p].dtype)
                 k += 1
-            return tuple(out[p] for p in range(len(metas)))
+            full_w = tuple(out[p] for p in range(len(metas)))
+            if qbis:
+                # pin every weight output replicated — the constraint
+                # on `full` doesn't survive the slice, and an extra
+                # consumer (the mxhealth tail) can tip propagation
+                # into dp-sharding an output that the per-replica
+                # writeback and the next step's cached executable both
+                # need as full copies
+                full_w = tuple(csn(w, repl) for w in full_w)
+                return full_w, tuple(new_wres)
+            return full_w
 
-        return reduce_stage, update_stage, gather_stage
+        return (reduce_stage, update_stage, gather_stage,
+                reduce_bucket, reduce_rest, rest_pos)
 
-    def _build_step(self, mp_flags, metas, health_mode=None):
+    def _build_step(self, mp_flags, metas, health_mode=None, qbis=()):
         reduce_stage, update_stage, gather_stage = self._stages(
-            mp_flags, metas)
+            mp_flags, metas, qbis)[:3]
 
-        def step(weights, gstacks, states, hyper_vecs):
-            parts = reduce_stage(gstacks)
-            new_parts, new_s = update_stage(weights, parts, states,
+        def step(weights, gstacks, states, hyper_vecs, qmult=None):
+            if qbis:
+                parts, new_gres = reduce_stage(gstacks, states[2],
+                                               qmult)
+            else:
+                parts = reduce_stage(gstacks)
+            new_parts, new_s = update_stage(weights, parts,
+                                            (states[0], states[1]),
                                             hyper_vecs)
-            new_w = gather_stage(new_parts, weights)
+            if qbis:
+                new_w, new_wres = gather_stage(new_parts, weights,
+                                               states[2])
+                new_s = new_s + (tuple(zip(new_gres, new_wres)),)
+            else:
+                new_w = gather_stage(new_parts, weights)
             if health_mode is None:
                 return new_w, new_s
             # mxhealth numerics, inside the SAME mesh program: grad
@@ -721,14 +952,15 @@ class SpmdUpdater(Updater):
         return step
 
     def _compile(self, sig, args, mp_flags, metas, donate,
-                 health_mode=None):
+                 health_mode=None, qbis=()):
         cell = {}
 
         def build_lowered():
             lowered = cell.get("lowered")
             if lowered is None:
                 jitted = jax.jit(
-                    self._build_step(mp_flags, metas, health_mode),
+                    self._build_step(mp_flags, metas, health_mode,
+                                     qbis),
                     donate_argnums=(2,) if donate else ())
                 lowered = cell["lowered"] = jitted.lower(*args)
             return lowered
@@ -740,12 +972,12 @@ class SpmdUpdater(Updater):
                       "flat": sig[5], "donation": sig[6],
                       "layout": sig[7], "health_mode": sig[8],
                       "devices": sig[9], "treedef": sig[10],
-                      "avals": sig[11]}
+                      "avals": sig[11], "quant": sig[12]}
         return _SPMD_CACHE.compile(sig, build_lowered, self.optimizer,
                                    components=components)
 
     # ---- phased variant (tracing only) -----------------------------------
-    def _run_phased(self, sig, args, mp_flags, metas):
+    def _run_phased(self, sig, args, mp_flags, metas, qbis=()):
         """Attribution mode: the same stages as the fused program run
         as three dispatches with spans (`reduce-scatter`,
         `shard-update`, `all-gather`), so ``trace_report`` shows where
@@ -755,25 +987,108 @@ class SpmdUpdater(Updater):
             return _ins.training_phase_seconds(phase) \
                 if _tracing._ENABLED else None
 
-        weights, gstacks, states, h_vecs = args
+        weights, gstacks, states, h_vecs = args[:4]
+        qmult = args[4] if len(args) > 4 else None
         fns = self._phased.get(sig)
         if fns is None:
             reduce_stage, update_stage, gather_stage = self._stages(
-                mp_flags, metas)
+                mp_flags, metas, qbis)[:3]
             fns = self._phased[sig] = (
                 jax.jit(reduce_stage), jax.jit(update_stage),
                 jax.jit(gather_stage))
         reduce_fn, update_fn, gather_fn = fns
         with _tracing.span("reduce-scatter", cat="training",
                            metric=_phase_metric("reduce-scatter")):
-            parts = jax.block_until_ready(reduce_fn(gstacks))
+            if qbis:
+                parts, new_gres = jax.block_until_ready(
+                    reduce_fn(gstacks, states[2], qmult))
+            else:
+                parts = jax.block_until_ready(reduce_fn(gstacks))
         with _tracing.span("shard-update", cat="training",
                            metric=_phase_metric("shard-update")):
             new_parts, new_s = jax.block_until_ready(
-                update_fn(weights, parts, states, h_vecs))
+                update_fn(weights, parts, (states[0], states[1]),
+                          h_vecs))
         with _tracing.span("all-gather", cat="training",
                            metric=_phase_metric("all-gather")):
-            new_w = jax.block_until_ready(gather_fn(new_parts, weights))
+            if qbis:
+                new_w, new_wres = jax.block_until_ready(
+                    gather_fn(new_parts, weights, states[2]))
+                new_s = new_s + (tuple(zip(new_gres, new_wres)),)
+            else:
+                new_w = jax.block_until_ready(
+                    gather_fn(new_parts, weights))
+        return new_w, new_s
+
+    # ---- overlap variant (MXNET_COMM_OVERLAP) ----------------------------
+    def _run_overlap(self, sig, args, mp_flags, metas, qbis):
+        """Gradient-ready-order overlap: each bucket's reduce is its
+        OWN dispatch, issued in reverse bucket order (buckets pack
+        parameters in registration = forward order, so the LAST bucket's
+        grads leave the backward first) and left in flight while later
+        dispatches queue behind it; one tail program (small/single
+        reduces + shard update + weight gather) then consumes the
+        in-flight parts.  Nothing here blocks between bucket issues —
+        the host races ahead exactly like the async engine's dependency
+        queue, and the device overlaps each bucket's collective with the
+        next one's staging, targeting step ~= max(compute, comm) rather
+        than the sum.  The spans put only DISPATCH time under
+        `reduce-scatter`; all wait lands in `shard-update`, so an
+        overlapped run's roofline verdict reflects EXPOSED comm (~0 when
+        the collectives hide), not total comm."""
+        def _phase_metric(phase):
+            return _ins.training_phase_seconds(phase) \
+                if _tracing._ENABLED else None
+
+        weights, gstacks, states, h_vecs = args[:4]
+        qmult = args[4] if len(args) > 4 else None
+        plan = self._plan
+        qpos = {bi: j for j, bi in enumerate(qbis)}
+        fns = self._overlap_fns.get(sig)
+        if fns is None:
+            (_, update_stage, gather_stage, reduce_bucket,
+             reduce_rest, rest_pos) = self._stages(mp_flags, metas,
+                                                   qbis)
+            bucket_fns = tuple(
+                jax.jit(lambda gsub, qpair, qm, bi=bi:
+                        reduce_bucket(bi, gsub, qpair, qm))
+                for bi in range(len(plan.buckets)))
+
+            def tail(weights, bparts, rmap, states2, h_vecs, qres):
+                parts = tuple(bparts) + reduce_rest(rmap)
+                new_parts, new_s = update_stage(weights, parts,
+                                                states2, h_vecs)
+                if qbis:
+                    new_w, new_wres = gather_stage(new_parts, weights,
+                                                   qres)
+                    return new_w, new_s, new_wres
+                return gather_stage(new_parts, weights), new_s, ()
+
+            fns = self._overlap_fns[sig] = (bucket_fns, jax.jit(tail),
+                                            rest_pos)
+        bucket_fns, tail_fn, rest_pos = fns
+        nb = len(plan.buckets)
+        bparts = [None] * nb
+        new_gres = [None] * len(qbis)
+        with _tracing.span("reduce-scatter", cat="training",
+                           metric=_phase_metric("reduce-scatter")):
+            for bi in reversed(range(nb)):      # gradient-ready order
+                j = qpos.get(bi)
+                out = bucket_fns[bi](
+                    tuple(gstacks[p] for p in plan.buckets[bi].pos),
+                    states[2][j] if j is not None else None, qmult)
+                bparts[bi] = out[0]
+                if j is not None:
+                    new_gres[j] = out[1]
+        with _tracing.span("shard-update", cat="training",
+                           metric=_phase_metric("shard-update")):
+            new_w, new_s, new_wres = jax.block_until_ready(tail_fn(
+                weights, tuple(bparts),
+                {p: gstacks[p] for p in rest_pos},
+                (states[0], states[1]), h_vecs,
+                states[2] if qbis else ()))
+        if qbis:
+            new_s = new_s + (tuple(zip(new_gres, new_wres)),)
         return new_w, new_s
 
     # ---- serialization ---------------------------------------------------
@@ -809,6 +1124,31 @@ class SpmdUpdater(Updater):
         for i, tree in (self._pending or {}).items():
             if i not in payload:  # loaded but never stepped: pass through
                 payload[i] = _tree_map(np.asarray, tree)
+        # quantization error-feedback residuals travel WITH the
+        # optimizer state (dropping them on resume re-introduces the
+        # bias the feedback cancels).  Serialized canonically: per-param
+        # full-shape arrays, grad side summed over replica rows — the
+        # per-row split is a mesh artifact, so this loads onto any mesh
+        # shape AND into the per-replica Updater, which stores unknown
+        # string keys verbatim and re-emits them (fallback hand-off).
+        if self._qstate and plan is not None:
+            gsum_d: Dict[int, np.ndarray] = {}
+            wflat_d: Dict[int, np.ndarray] = {}
+            for bi, (gres, wres) in sorted(self._qstate.items()):
+                b = plan.buckets[bi]
+                gsum = self._gather_np(gres).sum(axis=0)
+                wflat = self._gather_np(wres).reshape(-1)
+                for p, off in zip(b.pos, b.offsets):
+                    i = indices[p]
+                    m = self._meta[i]
+                    gsum_d[i] = gsum[off:off + m.size].reshape(m.shape)
+                    wflat_d[i] = wflat[off:off + m.size] \
+                        .reshape(m.shape)
+            payload[_comm.RESIDUAL_KEY] = _comm.canonical_residuals(
+                gsum_d, wflat_d, self._quant.mode)
+        elif self._pending_q is not None:
+            # loaded but never stepped: pass the residuals through
+            payload[_comm.RESIDUAL_KEY] = self._pending_q
         if dump_optimizer:
             return pickle.dumps((payload,
                                  self.optimizer.__class__.__name__,
@@ -822,9 +1162,12 @@ class SpmdUpdater(Updater):
         data = pickle.loads(states)
         if isinstance(data, tuple) and len(data) == 3:
             data = data[0]
-        self._pending = dict(data)
+        data = dict(data)
+        self._pending_q = data.pop(_comm.RESIDUAL_KEY, None)
+        self._pending = data
         self._bstate.clear()
         self._pstate.clear()
+        self._qstate.clear()
         self._mp.clear()
         self._plan = None
         self._plan_indices = None

@@ -35,6 +35,13 @@ model):
                         entry: one gradient bucket poisoned to NaN on
                         the selected step (the mxhealth detection /
                         skip_step bit-consistency fixture)
+    comm.quant          quantized-collective corruption at the SPMD
+                        step (optimizer/spmd.py, MXNET_COMM_QUANT):
+                        the first quantized bucket's dequant scale is
+                        flipped to inf on the selected step, so a bad
+                        encode/decode must light up mxhealth's
+                        nonfinite detector rather than silently skew
+                        the weights
 
 Plans are installed via the :func:`inject` context manager (scoped,
 exception-safe) or — for subprocess experiments like the nightly chaos
@@ -109,6 +116,7 @@ _ENV_DONE = False
 _DEFAULT_ACTION = {"trainer.preempt": "preempt",
                    "dataloader.worker": "die",
                    "trainer.numerics": "corrupt",
+                   "comm.quant": "corrupt",
                    "elastic.worker": "die"}
 
 #: This process's job rank for `rank=`-selected plans.  Stamped by

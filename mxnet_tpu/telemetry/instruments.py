@@ -36,6 +36,7 @@ __all__ = [
     "spmd_step_total", "spmd_compile_seconds",
     "data_wait_seconds", "data_wait_last_seconds",
     "collective_seconds", "collective_bytes_total",
+    "collective_wire_bytes_total",
     "step_layout_axis_size", "step_state_shard_factor",
     "step_mfu", "step_last_seconds", "step_flops_total",
     "step_roofline_total",
@@ -169,8 +170,17 @@ _spec("mx_collective_seconds", "histogram",
 _spec("mx_collective_bytes_total", "counter",
       "Logical payload bytes moved by collectives, by operation "
       "(reduce-scatter/all-gather/all-reduce) and mesh axis — the "
-      "bytes-on-wire half of scaling-efficiency attribution.",
+      "model-sized half of scaling-efficiency attribution (what the "
+      "step REDUCES, independent of encoding).",
       ("op", "axis"))
+_spec("mx_collective_wire_bytes_total", "counter",
+      "Bytes collectives actually put on the interconnect, by "
+      "operation, mesh axis, and wire encoding ('raw' = the payload "
+      "dtype as-is; 'int8'/'fp8' = MXNET_COMM_QUANT codes plus their "
+      "scale rows). The bytes-halving gate of a quantized-collective "
+      "change measures THIS series; mx_collective_bytes_total stays "
+      "flat by design.",
+      ("op", "axis", "encoding"))
 _spec("mx_step_layout_axis_size", "gauge",
       "Size of each mesh axis the active training-step layout runs "
       "over (1 = axis unused).", ("axis",))
@@ -218,6 +228,11 @@ def collective_seconds(op: str):
 
 def collective_bytes_total(op: str, axis: str):
     return _child("mx_collective_bytes_total", (op, axis))
+
+
+def collective_wire_bytes_total(op: str, axis: str, encoding: str):
+    return _child("mx_collective_wire_bytes_total",
+                  (op, axis, encoding))
 
 
 def step_layout_axis_size(axis: str):

@@ -64,14 +64,20 @@ _UPDATE_PREFERENCE = ("optimizer-update", "fused-update",
 
 class _Pending:
     __slots__ = ("phases", "collectives", "data_wait", "bytes",
-                 "flops", "bytes_accessed", "compiles", "compile_s",
-                 "compile_reasons")
+                 "wire_bytes", "flops", "bytes_accessed", "compiles",
+                 "compile_s", "compile_reasons")
 
     def __init__(self):
         self.phases: Dict[str, float] = {}
         self.collectives: Dict[str, float] = {}
         self.data_wait = 0.0
         self.bytes: Dict[str, int] = {}
+        # WIRE view keyed "op@axis:encoding" — what actually crossed
+        # the interconnect (1 byte/elem + scales under
+        # MXNET_COMM_QUANT); `bytes` above stays the logical
+        # model-sized payload, so ratio(wire/logical) is the
+        # quantization win
+        self.wire_bytes: Dict[str, int] = {}
         self.flops = 0.0
         self.bytes_accessed = 0.0
         self.compiles = 0
@@ -84,7 +90,8 @@ class _Pending:
 
     def empty(self) -> bool:
         return not (self.phases or self.collectives or self.bytes
-                    or self.data_wait or self.compiles or self.flops
+                    or self.wire_bytes or self.data_wait
+                    or self.compiles or self.flops
                     or self.compile_reasons)
 
 
@@ -206,6 +213,13 @@ class FlightRecorder:
             b = self._pending.bytes
             b[key] = b.get(key, 0) + int(nbytes)
 
+    def on_wire_bytes(self, op: str, axis: str, encoding: str,
+                      nbytes: int) -> None:
+        key = f"{op}@{axis}:{encoding}"
+        with self._lock:
+            b = self._pending.wire_bytes
+            b[key] = b.get(key, 0) + int(nbytes)
+
     def on_flops(self, site: str, cost) -> None:
         with self._lock:
             self._pending.flops += cost.flops
@@ -274,6 +288,7 @@ class FlightRecorder:
             "collectives": {k: round(v, 6) for k, v in
                             sorted(p.collectives.items())},
             "collective_bytes": dict(p.bytes),
+            "collective_wire_bytes": dict(p.wire_bytes),
             "flops": p.flops,
             "bytes_accessed": p.bytes_accessed,
             "mfu": None if mfu is None else round(mfu, 6),
@@ -348,16 +363,20 @@ class FlightRecorder:
         out["wall_s_mean"] = round(sum(walls) / len(walls), 6)
         phases: Dict[str, float] = {}
         nbytes: Dict[str, int] = {}
+        wbytes: Dict[str, int] = {}
         verdicts: Dict[str, int] = {}
         for r in recs:
             for k, v in r["phases"].items():
                 phases[k] = phases.get(k, 0.0) + v
             for k, v in r["collective_bytes"].items():
                 nbytes[k] = nbytes.get(k, 0) + v
+            for k, v in r.get("collective_wire_bytes", {}).items():
+                wbytes[k] = wbytes.get(k, 0) + v
             verdicts[r["verdict"]] = verdicts.get(r["verdict"], 0) + 1
         out["phase_seconds"] = {k: round(v, 6)
                                 for k, v in sorted(phases.items())}
         out["collective_bytes"] = nbytes
+        out["collective_wire_bytes"] = wbytes
         out["verdicts"] = verdicts
         out["data_wait_s_total"] = round(
             sum(r["data_wait_s"] for r in recs), 6)

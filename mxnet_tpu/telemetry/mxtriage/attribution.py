@@ -32,7 +32,8 @@ __all__ = ["collect_aggregates", "rank_suspects"]
 
 # dict nodes carrying at least one of these keys are mxprof aggregate
 # blocks (a SCALING sweep row, an embedded snapshot summary, ...)
-_SIGNAL_KEYS = ("phase_seconds", "collective_bytes", "data_wait_s",
+_SIGNAL_KEYS = ("phase_seconds", "collective_bytes",
+                "collective_wire_bytes", "data_wait_s",
                 "data_wait_s_total", "mfu", "compiles",
                 "compile_reasons", "knobs", "knob_fingerprint",
                 "hlo_fingerprints", "badput_seconds", "goodput_ratio")
@@ -41,8 +42,16 @@ _SIGNAL_KEYS = ("phase_seconds", "collective_bytes", "data_wait_s",
 # signal is relative to the others
 _WEIGHTS = {"phase": 1.0, "data-wait": 1.0, "mfu": 1.0, "badput": 1.0,
             "goodput": 1.0, "compiles": 0.9, "collective-bytes": 0.5}
-# flat scores for qualitative suspects (no meaningful magnitude)
-_FLAT = {"knob": 0.75, "program": 0.8}
+# flat scores for qualitative suspects (no meaningful magnitude).
+# "encoding" is the comm-encoding knob (MXNET_COMM_QUANT...): a flipped
+# wire encoding changes numerics AND bytes at once, so it outranks a
+# generic knob change
+_FLAT = {"knob": 0.75, "program": 0.8, "encoding": 0.85}
+
+# knobs that select the collective wire encoding: their change is an
+# "encoding" suspect, not a plain "knob" one
+_ENCODING_KNOBS = ("MXNET_COMM_QUANT", "MXNET_COMM_QUANT_EF",
+                   "MXNET_COMM_QUANT_MIN_SIZE")
 
 # ignore sub-floor noise: seconds for phases/data-wait, fraction
 # for relative changes
@@ -167,20 +176,24 @@ def _diff_node(where: str, base: dict, fresh: dict,
             "change": _pct(float(bg), float(fg)),
             "score": round(rel * _WEIGHTS["goodput"], 4)})
     # collective bytes drift (a bucket-plan / quantization change
-    # shows up here before anywhere else)
-    bb, fb = base.get("collective_bytes") or {}, \
-        fresh.get("collective_bytes") or {}
-    for name in sorted(set(bb) | set(fb)):
-        b, f = float(bb.get(name, 0) or 0), float(fb.get(name, 0) or 0)
-        if b <= 0 and f <= 0:
-            continue
-        rel = abs(f - b) / max(b, f)
-        if rel > _REL_FLOOR:
-            suspects.append({
-                "kind": "collective-bytes", "name": name,
-                "where": where, "base": int(b), "fresh": int(f),
-                "change": _pct(b, f),
-                "score": round(rel * _WEIGHTS["collective-bytes"], 4)})
+    # shows up here before anywhere else); the wire view diffs the
+    # same way — its keys carry the encoding ("op@axis:int8"), so a
+    # lane that silently fell back to raw names itself
+    for sig_key in ("collective_bytes", "collective_wire_bytes"):
+        bb, fb = base.get(sig_key) or {}, fresh.get(sig_key) or {}
+        for name in sorted(set(bb) | set(fb)):
+            b = float(bb.get(name, 0) or 0)
+            f = float(fb.get(name, 0) or 0)
+            if b <= 0 and f <= 0:
+                continue
+            rel = abs(f - b) / max(b, f)
+            if rel > _REL_FLOOR:
+                suspects.append({
+                    "kind": "collective-bytes", "name": name,
+                    "where": where, "base": int(b), "fresh": int(f),
+                    "change": _pct(b, f),
+                    "score": round(
+                        rel * _WEIGHTS["collective-bytes"], 4)})
     # compile-count growth = a recompile storm; name its cause when
     # the provenance aggregates rode along
     bc, fc = base.get("compiles"), fresh.get("compiles")
@@ -195,19 +208,22 @@ def _diff_node(where: str, base: dict, fresh: dict,
         if isinstance(reasons, dict) and reasons:
             sus["reasons"] = reasons
         suspects.append(sus)
-    # registered knobs: a changed value is a first-class suspect
+    # registered knobs: a changed value is a first-class suspect; a
+    # changed comm-encoding knob is an "encoding" suspect (numerics
+    # AND wire bytes move together when one flips)
     bk, fk = base.get("knobs") or {}, fresh.get("knobs") or {}
     for name in sorted(set(bk) | set(fk)):
         if bk.get(name) != fk.get(name):
+            kind = "encoding" if name in _ENCODING_KNOBS else "knob"
             suspects.append({
-                "kind": "knob", "name": name, "where": where,
+                "kind": kind, "name": name, "where": where,
                 "base": bk.get(name), "fresh": fk.get(name),
                 "change": f"{bk.get(name)!r} -> {fk.get(name)!r}",
-                "score": _FLAT["knob"]})
+                "score": _FLAT[kind]})
     bkf, fkf = base.get("knob_fingerprint"), \
         fresh.get("knob_fingerprint")
     if bkf and fkf:
-        if bkf != fkf and not any(s["kind"] == "knob"
+        if bkf != fkf and not any(s["kind"] in ("knob", "encoding")
                                   and s["where"] == where
                                   for s in suspects):
             suspects.append({

@@ -345,6 +345,33 @@ declare("MXNET_SPMD_BUCKET_BYTES", int, 0,
         "Bucket size for the SPMD mesh-collective gradient reduce "
         "(KVStore.pushpull_fused under MXNET_SPMD=1). 0 = inherit "
         "MXNET_FUSED_BUCKET_BYTES.")
+declare("MXNET_COMM_QUANT", str, "none",
+        "Wire encoding for the SPMD bucket collectives (the gradient "
+        "reduce and the fresh-weight gather in optimizer/spmd.py, and "
+        "KVStore.pushpull_fused's SPMD bucket all-reduce): 'int8' "
+        "(symmetric linear, 1 byte/elem) or 'fp8' (e4m3 emulation, "
+        "1 byte/elem) quantize with per-512-element-block scales and error-feedback "
+        "residuals; 'none' keeps full-precision collectives. See "
+        "docs/sharding.md#quantized-collectives.",
+        tunable=Tunable(choices=("none", "int8", "fp8")))
+declare("MXNET_COMM_QUANT_EF", bool, True,
+        "Carry error-feedback residuals for MXNET_COMM_QUANT (the "
+        "quantization remainder re-enters the next step's payload "
+        "before encoding). Disable ONLY for A/B experiments — without "
+        "feedback the rounding bias accumulates into the weights.",
+        tunable=Tunable())
+declare("MXNET_COMM_QUANT_MIN_SIZE", int, 2048,
+        "Smallest bucket (padded elements) MXNET_COMM_QUANT encodes; "
+        "tiny buckets stay fp32 — their scale rows and encode/decode "
+        "work would cost more than the bytes they save.",
+        tunable=Tunable(lo=256, hi=262144, scale="log"))
+declare("MXNET_COMM_OVERLAP", bool, False,
+        "Dispatch each SPMD bucket's gradient reduce as its own "
+        "program, issued in gradient-ready (reverse-bucket) order "
+        "while the backward is still executing, so collectives overlap "
+        "compute and the step approaches max(compute, comm) instead "
+        "of their sum. See docs/performance.md.",
+        tunable=Tunable())
 
 # -- ops / kernels ----------------------------------------------------------
 declare("MXNET_BN_EXACT_VAR", bool, False,

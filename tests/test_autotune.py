@@ -145,6 +145,9 @@ class TestSpace:
             cfg = autotune.sample(rng, dims)
             for d in dims:
                 v = cfg[d.name]
+                if d.tunable.choices is not None:
+                    assert v in d.tunable.choices
+                    continue
                 assert d.tunable.lo <= v <= d.tunable.hi
                 assert isinstance(v, int) if d.typ is int else True
 
@@ -157,7 +160,10 @@ class TestSpace:
             changed = [n for n in nxt if nxt[n] != base.get(n)]
             assert len(changed) == 1
             d = next(d for d in dims if d.name == changed[0])
-            assert d.tunable.lo <= nxt[changed[0]] <= d.tunable.hi
+            if d.tunable.choices is not None:
+                assert nxt[changed[0]] in d.tunable.choices
+            else:
+                assert d.tunable.lo <= nxt[changed[0]] <= d.tunable.hi
 
     def test_dimensions_subset_orders_and_validates(self):
         dims = autotune.dimensions(["MXNET_PREFETCH_DEPTH",

@@ -164,6 +164,15 @@ def _scaling(d) -> dict:
     p = d.get("parity")
     if isinstance(p, dict) and "ok" in p:
         c["parity.ok"] = bool(p["ok"])
+    # quantized-lane gates (run_nightly merges them into the report):
+    # strict like every correctness check — wire bytes <= 0.30x the
+    # fp32 lane, loss parity vs fp32 <= 1e-3, exposed comm under
+    # overlap no worse than the un-overlapped lane
+    q = d.get("quant")
+    if isinstance(q, dict):
+        for name in ("wire_ok", "loss_parity_ok", "comm_stall_ok"):
+            if name in q:
+                c[f"quant.{name}"] = bool(q[name])
     return {"higher": m, "lower": lo, "checks": c}
 
 

@@ -175,6 +175,12 @@ def _phase_report(trace_path):
         "phase_seconds": phases,
         "trace_check_ok": rep["check"]["ok"],
         "collective_bytes": snap["summary"].get("collective_bytes", {}),
+        # wire view (keys "op@axis:encoding"): what actually crossed
+        # the interconnect — under MXNET_COMM_QUANT this diverges from
+        # the model-sized logical bytes above, and the nightly's
+        # <=0.30x gate reads THIS
+        "collective_wire_bytes": snap["summary"].get(
+            "collective_wire_bytes", {}),
         "mfu": {
             "per_step": mfus,
             "mean": snap["summary"].get("mfu_mean"),
@@ -213,6 +219,11 @@ def _phase_report(trace_path):
     if isinstance(good, dict):
         out["goodput_ratio"] = good.get("goodput_ratio")
         out["badput_seconds"] = good.get("badput_s", {})
+        # the comm-stall lane the overlap gate reads: EXPOSED
+        # communication seconds (overlap hides comm inside the update
+        # dispatch, so this drops when MXNET_COMM_OVERLAP earns it)
+        out["comm_stall_s"] = round(float(
+            good.get("badput_s", {}).get("comm_stall", 0.0)), 6)
     return out
 
 
@@ -230,6 +241,10 @@ def worker(args):
         # operator's shell must not turn the per-replica measurement
         # into a second SPMD run (the nightly gate compares the two)
         os.environ["MXNET_SPMD"] = "0"
+    # pin the comm lane the same way: the quantized/overlapped rows and
+    # the raw baseline must not bleed into each other via the shell
+    os.environ["MXNET_COMM_QUANT"] = args.quant
+    os.environ["MXNET_COMM_OVERLAP"] = "1" if args.overlap else "0"
     import mxnet_tpu as mx
     from mxnet_tpu import parallel
     from mxnet_tpu.parallel import dist
@@ -274,8 +289,13 @@ def worker(args):
     # _phase_report is pure waste on the other ranks
     phase_rep = _phase_report(trace) if trace and rank == 0 else None
     if rank == 0:
+        # the quantized lane is its OWN path label ("spmd-int8"): its
+        # rows sit beside the raw rows in SCALING.json and diff/gate
+        # against them instead of silently replacing them
+        path_label = args.path if args.quant == "none" \
+            else f"{args.path}-{args.quant}"
         row = {
-            "model": args.model, "path": args.path,
+            "model": args.model, "path": path_label,
             "processes": n_proc, "devices": n_dev,
             "batch_per_device": bs_global // n_dev,
             "global_batch": bs_global,
@@ -428,7 +448,10 @@ def _spawn_sweep(args, n):
                "--image-size", str(args.image_size),
                "--seq-len", str(args.seq_len), "--dtype", args.dtype,
                "--seed", str(args.seed),
-               "--global-batch", str(args.global_batch)]
+               "--global-batch", str(args.global_batch),
+               "--quant", args.quant]
+        if args.overlap:
+            cmd.append("--overlap")
         if args.phases:
             cmd.append("--phases")
         if trace_dir:
@@ -523,6 +546,14 @@ def main():
                     choices=["replica", "spmd", "gspmd"])
     ap.add_argument("--spmd", action="store_true",
                     help="shorthand for --path spmd")
+    ap.add_argument("--quant", default="none",
+                    choices=["none", "int8", "fp8"],
+                    help="collective wire encoding for the run "
+                         "(MXNET_COMM_QUANT); the row's path label "
+                         "becomes e.g. 'spmd-int8'")
+    ap.add_argument("--overlap", action="store_true",
+                    help="launch bucket collectives in gradient-ready "
+                         "order (MXNET_COMM_OVERLAP=1)")
     ap.add_argument("--procs", default="1,2,4",
                     help="comma-separated process counts for the sweep")
     ap.add_argument("--steps", type=int, default=5)
@@ -583,6 +614,7 @@ def main():
     report = {"when": time.strftime("%Y-%m-%d %H:%M:%S"),
               "backend": "cpu+gloo localhost (dev box)",
               "path": args.path,
+              "quant": args.quant, "overlap": bool(args.overlap),
               "note": "validates harness+program, not ICI/DCN "
                       "bandwidth; see docstring for the pod command",
               "sweep": results}
