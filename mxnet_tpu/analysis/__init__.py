@@ -38,10 +38,19 @@ from .dataflow import (  # noqa: F401  — registers MX008–MX012
 )
 from .reporters import render_text, render_json, render_sarif
 from .drift import instrument_names, chaos_sites, drift_findings
+# mxir: the StableHLO program auditor (MX014–MX018) — same one-level
+# import rule as .dataflow above.
+from .ir import (  # noqa: F401  — registers MX014–MX018
+    IrParseError, audit_module, parse_module, estimate_wire_bytes,
+    wire_drift, ProgramAudit, render_ir_json, IR_RULE_IDS, FIXTURES,
+)
 
 __all__ = [
     "LintEngine", "Violation", "Rule", "RULE_REGISTRY", "register_rule",
     "load_baseline", "diff_baseline", "make_baseline",
     "render_text", "render_json", "render_sarif",
     "instrument_names", "chaos_sites", "drift_findings",
+    "IrParseError", "audit_module", "parse_module",
+    "estimate_wire_bytes", "wire_drift", "ProgramAudit",
+    "render_ir_json", "IR_RULE_IDS", "FIXTURES",
 ]

@@ -584,6 +584,25 @@ def build_project(paths: Sequence[str],
     return proj
 
 
+class _LazyParsed:
+    """``parsed``-mapping view over the engine's FileContexts that
+    forces a context's (possibly lazy) parse only when the summary
+    cache actually misses — on a warm incremental-cache run the engine
+    never parsed unchanged files, and neither should we."""
+
+    def __init__(self, ctxs: Sequence[Any]) -> None:
+        self._by_path = {os.path.abspath(c.path): c for c in ctxs}
+
+    def get(self, path: str, default: Any = None) -> Any:
+        c = self._by_path.get(path)
+        if c is None:
+            return default
+        try:
+            return c.tree
+        except SyntaxError:
+            return default  # build_project re-parses and records the error
+
+
 def get_project(ctxs: Sequence[Any], use_cache: bool = True) -> Project:
     """Memoized :func:`build_project` over the engine's FileContexts —
     the five dataflow rules in one engine run share one build."""
@@ -601,8 +620,8 @@ def get_project(ctxs: Sequence[Any], use_cache: bool = True) -> Project:
         hit = _MEMO.get(key)
     if hit is not None:
         return hit
-    parsed = {os.path.abspath(c.path): c.tree for c in ctxs}
-    proj = build_project(paths, parsed=parsed, use_cache=use_cache)
+    proj = build_project(paths, parsed=_LazyParsed(ctxs),
+                         use_cache=use_cache)
     with _MEMO_LOCK:
         if len(_MEMO) >= _MEMO_MAX:
             _MEMO.pop(next(iter(_MEMO)))

@@ -222,6 +222,7 @@ class ExceptionPathLeak(Rule):
     ``Future.add_done_callback`` style deferred release)."""
 
     id = "MX010"
+    cacheable = "file"
     name = "exception-path-leak"
     description = ("Resource acquire (begin_use/acquire) without a "
                    "release on every exit path incl. exceptions — "
@@ -369,6 +370,7 @@ class RetryUnsafeSideEffect(Rule):
     results only after the last fallible operation."""
 
     id = "MX011"
+    cacheable = "file"
     name = "retry-unsafe-side-effect"
     description = ("RetryPolicy-wrapped callable mutates caller-"
                    "visible state before its success point — a "

@@ -18,6 +18,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple, Type
 __all__ = [
     "Violation", "FileContext", "Rule", "RULE_REGISTRY", "register_rule",
     "LintEngine", "load_baseline", "diff_baseline", "make_baseline",
+    "rules_version",
 ]
 
 # `# mxlint: disable=MX001,MX004` suppresses those rules on that line;
@@ -56,14 +57,21 @@ class Violation:
 
 class FileContext:
     """Per-file state shared by all rules: parsed tree, source lines,
-    pragma map, and a node→enclosing-symbol resolver."""
+    pragma map, and a node→enclosing-symbol resolver.
+
+    ``tree`` may be omitted: the parse (and the symbol walk over it)
+    then happens lazily on first access.  The incremental cache hands
+    project rules a lazy context for unchanged files — the dataflow
+    summary cache usually satisfies them from its own sha-keyed store
+    without ever forcing the parse."""
 
     def __init__(self, path: str, relpath: str, source: str,
-                 tree: ast.Module):
+                 tree: Optional[ast.Module] = None):
         self.path = path
         self.relpath = relpath
+        self._source = source
         self.lines = source.splitlines()
-        self.tree = tree
+        self._tree = tree
         self._pragmas: Dict[int, Set[str]] = {}
         for i, ln in enumerate(self.lines, 1):
             m = _PRAGMA.search(ln)
@@ -76,13 +84,48 @@ class FileContext:
         # same single walk also buckets nodes by kind so each rule
         # iterates a precomputed list instead of re-walking the tree
         # (six full ast.walk passes per file blew the CLI's time budget).
-        self._spans: List[Tuple[int, int, str]] = []
-        self.functions: List[ast.AST] = []
-        self.classes: List[ast.ClassDef] = []
-        self.withs: List[ast.AST] = []
-        self.calls: List[ast.Call] = []
-        self.subscripts: List[ast.Subscript] = []
-        self._index_symbols(tree, [])
+        self._spans: Optional[List[Tuple[int, int, str]]] = None
+        self._functions: List[ast.AST] = []
+        self._classes: List[ast.ClassDef] = []
+        self._withs: List[ast.AST] = []
+        self._calls: List[ast.Call] = []
+        self._subscripts: List[ast.Subscript] = []
+
+    @property
+    def tree(self) -> ast.Module:
+        if self._tree is None:
+            self._tree = ast.parse(self._source, filename=self.relpath)
+        return self._tree
+
+    def _ensure_index(self) -> None:
+        if self._spans is None:
+            self._spans = []
+            self._index_symbols(self.tree, [])
+
+    @property
+    def functions(self) -> List[ast.AST]:
+        self._ensure_index()
+        return self._functions
+
+    @property
+    def classes(self) -> List[ast.ClassDef]:
+        self._ensure_index()
+        return self._classes
+
+    @property
+    def withs(self) -> List[ast.AST]:
+        self._ensure_index()
+        return self._withs
+
+    @property
+    def calls(self) -> List[ast.Call]:
+        self._ensure_index()
+        return self._calls
+
+    @property
+    def subscripts(self) -> List[ast.Subscript]:
+        self._ensure_index()
+        return self._subscripts
 
     def _index_symbols(self, node: ast.AST, stack: List[str]) -> None:
         for child in ast.iter_child_nodes(node):
@@ -92,20 +135,21 @@ class FileContext:
                 end = getattr(child, "end_lineno", child.lineno)
                 self._spans.append((child.lineno, end, qual))
                 if isinstance(child, ast.ClassDef):
-                    self.classes.append(child)
+                    self._classes.append(child)
                 else:
-                    self.functions.append(child)
+                    self._functions.append(child)
                 self._index_symbols(child, stack + [child.name])
             else:
                 if isinstance(child, ast.Call):
-                    self.calls.append(child)
+                    self._calls.append(child)
                 elif isinstance(child, ast.Subscript):
-                    self.subscripts.append(child)
+                    self._subscripts.append(child)
                 elif isinstance(child, (ast.With, ast.AsyncWith)):
-                    self.withs.append(child)
+                    self._withs.append(child)
                 self._index_symbols(child, stack)
 
     def symbol_at(self, lineno: int) -> str:
+        self._ensure_index()
         best = "<module>"
         best_len = None
         for lo, hi, qual in self._spans:
@@ -133,14 +177,37 @@ class Rule:
     """Base rule.  Subclasses set ``id``/``name``/``description`` and
     implement ``check``; cross-file rules also override ``finalize``.
     A fresh instance is built per engine run, so instance state is
-    safe for cross-file accumulation."""
+    safe for cross-file accumulation.
+
+    ``cacheable`` opts a rule into the incremental cache:
+
+    * ``"file"`` — ``check()`` is a pure function of one file's bytes;
+      its (pragma-filtered) findings are replayed verbatim for files
+      whose content hash is unchanged.
+    * ``"contrib"`` — the rule accumulates cross-file state, but each
+      file's *contribution* to that state is pure.  The rule provides
+      ``contribution(ctx)`` (a JSON-serializable per-file record) and
+      ``absorb(contrib, relpath)`` (replay it into instance state,
+      returning the per-file findings); ``finalize()`` then works
+      exactly as on a cold run because every file was absorbed in the
+      same sorted order.
+    * ``""`` (default) — never cached; ``check()`` runs every time
+      (project rules whose finalize needs live FileContexts).
+    """
 
     id: str = "MX000"
     name: str = "base"
     description: str = ""
+    cacheable: str = ""
 
     def check(self, ctx: FileContext) -> Iterable[Violation]:
         return ()
+
+    def contribution(self, ctx: FileContext) -> dict:
+        raise NotImplementedError
+
+    def absorb(self, contrib: dict, relpath: str) -> Iterable[Violation]:
+        raise NotImplementedError
 
     def finalize(self) -> Iterable[Violation]:
         return ()
@@ -185,6 +252,8 @@ class LintEngine:
             ids = [i for i in ids if i not in set(disable)]
         self.rules: List[Rule] = [RULE_REGISTRY[i]() for i in ids]
         self.errors: List[str] = []  # unparsable files (reported, not fatal)
+        self.cache_hits = 0    # files served from the incremental cache
+        self.cache_misses = 0  # files read+parsed this run
 
     def _files(self, paths: Sequence[str]) -> List[str]:
         out: List[str] = []
@@ -200,28 +269,166 @@ class LintEngine:
                            for f in filenames if f.endswith(".py"))
         return sorted(set(out))
 
-    def run(self, paths: Sequence[str]) -> List[Violation]:
+    def _entry_valid(self, entry: dict, sha: str) -> bool:
+        """A cache entry serves a file iff the content hash matches and
+        it carries data for every enabled cacheable rule (an entry from
+        a narrower ``--enable`` run must not silently drop findings)."""
+        if not isinstance(entry, dict) or entry.get("sha256") != sha:
+            return False
+        rules = entry.get("rules", {})
+        contrib = entry.get("contrib", {})
+        for rule in self.rules:
+            if rule.cacheable == "file" and rule.id not in rules:
+                return False
+            if rule.cacheable == "contrib" and rule.id not in contrib:
+                return False
+        return True
+
+    def run(self, paths: Sequence[str],
+            cache_path: Optional[str] = None) -> List[Violation]:
+        """Lint ``paths``.  With ``cache_path``, unchanged files (by
+        content sha256, keyed to the rules-version) replay their cached
+        findings instead of re-parsing; the cache is rewritten
+        atomically afterwards.  Cold and warm runs produce identical
+        violations — the parity test pins this."""
+        caching = cache_path is not None
+        old_files = _load_lint_cache(cache_path) if caching else {}
+        new_files: Dict[str, dict] = {}
+        self.cache_hits = 0
+        self.cache_misses = 0
         violations: List[Violation] = []
         for path in self._files(paths):
             rel = os.path.relpath(path, self.root).replace(os.sep, "/")
             try:
                 with open(path, "r", encoding="utf-8") as f:
                     source = f.read()
+            except (UnicodeDecodeError, OSError) as e:
+                self.errors.append(f"{rel}: {type(e).__name__}: {e}")
+                continue
+            sha = hashlib.sha256(source.encode("utf-8")).hexdigest()
+            entry = old_files.get(rel) if caching else None
+            if entry is not None and self._entry_valid(entry, sha):
+                self.cache_hits += 1
+                # lazy context: non-cacheable (project) rules still get
+                # their check() call, but nothing parses unless one of
+                # them actually needs the tree
+                ctx = FileContext(path, rel, source)
+                for rule in self.rules:
+                    if rule.cacheable == "file":
+                        violations.extend(
+                            Violation(**d) for d in entry["rules"][rule.id])
+                    elif rule.cacheable == "contrib":
+                        violations.extend(
+                            rule.absorb(entry["contrib"][rule.id], rel))
+                    else:
+                        for v in rule.check(ctx):
+                            if not ctx.suppressed(v.rule, v.line):
+                                violations.append(v)
+                new_files[rel] = entry
+                continue
+            self.cache_misses += 1
+            try:
                 tree = ast.parse(source, filename=rel)
-            except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            except SyntaxError as e:
                 self.errors.append(f"{rel}: {type(e).__name__}: {e}")
                 continue
             ctx = FileContext(path, rel, source, tree)
+            fresh = {"sha256": sha, "rules": {}, "contrib": {}}
             for rule in self.rules:
-                for v in rule.check(ctx):
-                    if not ctx.suppressed(v.rule, v.line):
-                        violations.append(v)
+                if rule.cacheable == "contrib":
+                    contrib = rule.contribution(ctx)
+                    fresh["contrib"][rule.id] = contrib
+                    violations.extend(rule.absorb(contrib, rel))
+                    continue
+                vs = [v for v in rule.check(ctx)
+                      if not ctx.suppressed(v.rule, v.line)]
+                violations.extend(vs)
+                if rule.cacheable == "file":
+                    fresh["rules"][rule.id] = [_viol_dict(v) for v in vs]
+            new_files[rel] = fresh
         for rule in self.rules:
             # finalize() findings carry their own file context; pragma
             # filtering already happened when the rule recorded the site
             violations.extend(rule.finalize())
         violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+        if caching:
+            # merge so linting a subset does not evict other files
+            merged = dict(old_files)
+            merged.update(new_files)
+            _store_lint_cache(cache_path, merged)
         return violations
+
+
+# ---------------------------------------------------------------------------
+# Incremental cache: findings keyed on (content sha256, rules-version).
+# Any edit under the analysis package flips the rules-version and
+# invalidates everything — rule logic changes must never replay stale
+# findings.
+# ---------------------------------------------------------------------------
+
+def _viol_dict(v: Violation) -> dict:
+    return {"rule": v.rule, "path": v.path, "line": v.line, "col": v.col,
+            "message": v.message, "symbol": v.symbol, "src": v.src}
+
+
+def rules_version() -> str:
+    """sha256 over every ``.py`` file in the analysis package, sorted
+    by relative path — the cache key component that ties cached
+    findings to the exact rule implementations that produced them."""
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    sources: List[Tuple[str, bytes]] = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            try:
+                with open(full, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                blob = b""
+            sources.append(
+                (os.path.relpath(full, pkg).replace(os.sep, "/"), blob))
+    h = hashlib.sha256()
+    for rel, blob in sorted(sources):
+        h.update(rel.encode())
+        h.update(b"\0")
+        h.update(blob)
+        h.update(b"\0")
+    return h.hexdigest()
+
+
+def _load_lint_cache(path: str) -> Dict[str, dict]:
+    """The cache's files map, or ``{}`` when absent, unreadable, or
+    written by a different rules-version (never an error: a bad cache
+    is just a cold run)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(doc, dict) or doc.get("version") != 1 \
+            or doc.get("rules_version") != rules_version():
+        return {}
+    files = doc.get("files")
+    return files if isinstance(files, dict) else {}
+
+
+def _store_lint_cache(path: str, files: Dict[str, dict]) -> None:
+    doc = {"version": 1, "rules_version": rules_version(),
+           "files": files}
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass  # mxlint: disable=MX007 — cache write is best-effort
+
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,48 @@
+"""mxir — static verification of compiled StableHLO step programs.
+
+The missing layer under mxlint/mxflow: those verify the Python that
+*builds* programs; mxir verifies the programs themselves.  A
+line-oriented parser (:mod:`parser`) turns the module text jax emits
+(`lowered.as_text()` — the exact bytes the persistent compile cache
+stores under its ``stablehlo`` tier) into a queryable IR, and five
+program rules (:mod:`rules`, MX014–MX018) check the invariants PR 9's
+ZeRO spine and PR 18's quantized collectives made load-bearing:
+donation actually landing in the module, no oversized replicated
+tensors under a multi-device mesh, no precision round trips around
+the comm-quant path, collective hygiene plus a static wire-bytes
+model cross-checked against the measured counters, and no host
+transfers inside a step.
+
+Entry points: :func:`audit_module` for one program's text,
+:class:`.report.ProgramAudit`/:func:`.report.render_ir_json` for the
+MXIR.json artifact.  The runtime hook lives framework-side in
+:mod:`mxnet_tpu.compile_cache.audit` (it needs env knobs and
+instruments); the offline CLI is ``tools/mxir.py``.
+
+Stdlib-only, like the rest of ``mxnet_tpu.analysis``.
+"""
+# NOTE one-level `from .parser import X` forms throughout — the
+# two-level / `from . import x` forms route through the ROOT package
+# and break the mxlint CLI's standalone (jax-free) load; see
+# analysis/__init__.py.
+from .parser import (  # noqa: F401
+    IrParseError, TensorType, FuncArg, FuncResult, Op, Func, Module,
+    Sharding, parse_module, parse_sharding,
+)
+from .rules import (  # noqa: F401  — registers MX014–MX018 on import
+    IrContext, IrRule, DonationDropped, OversizedReplicated,
+    PrecisionLeak, CollectiveAudit, HostTransfer, WireEstimate,
+    estimate_wire_bytes, wire_drift, audit_module, IR_RULE_IDS,
+)
+from .report import ProgramAudit, render_ir_json  # noqa: F401
+from .fixtures import FIXTURES  # noqa: F401
+
+__all__ = [
+    "FIXTURES",
+    "IrParseError", "TensorType", "FuncArg", "FuncResult", "Op",
+    "Func", "Module", "Sharding", "parse_module", "parse_sharding",
+    "IrContext", "IrRule", "DonationDropped", "OversizedReplicated",
+    "PrecisionLeak", "CollectiveAudit", "HostTransfer", "WireEstimate",
+    "estimate_wire_bytes", "wire_drift", "audit_module", "IR_RULE_IDS",
+    "ProgramAudit", "render_ir_json",
+]

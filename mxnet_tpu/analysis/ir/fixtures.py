@@ -1,0 +1,157 @@
+"""Known-answer StableHLO fixtures for the program rules.
+
+One ``(bad, clean)`` module-text pair per rule: the bad twin is seeded
+with exactly one violation of its rule (and nothing else), the clean
+twin is the same program with the hazard repaired.  Both the test
+suite and ``tools/mxir.py --selftest`` audit these pairs and require
+seeded == 1 / clean == 0 — a rule that drifts into over- or
+under-reporting fails the same gate from both directions.
+
+The texts are shaped after real jax CPU lowerings (module attributes,
+``mhlo.sharding`` arg attrs, ``@Sharding`` custom_calls, elementwise
+shorthand types) so the parser exercised here is the parser the
+runtime hook runs, on the syntax it actually sees.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+__all__ = ["FIXTURES"]
+
+
+def _module(body: str, num_partitions: int = 2) -> str:
+    return (
+        "module @jit_step attributes "
+        f"{{mhlo.num_partitions = {num_partitions} : i32, "
+        "mhlo.num_replicas = 1 : i32} {\n"
+        + body
+        + "\n}\n"
+    )
+
+
+_SPEC = '"{devices=[2,1]<=[2]}"'
+
+# -- MX014: call site donated, lowered module aliases nothing ---------------
+
+_MX014_BAD = _module(
+    "  func.func public @main(%arg0: tensor<8x8xf32>, "
+    "%arg1: tensor<8x8xf32>) -> (tensor<8x8xf32> "
+    '{jax.result_info = ""}) {\n'
+    "    %0 = stablehlo.add %arg0, %arg1 : tensor<8x8xf32>\n"
+    "    return %0 : tensor<8x8xf32>\n"
+    "  }", num_partitions=1)
+
+_MX014_CLEAN = _module(
+    "  func.func public @main(%arg0: tensor<8x8xf32> "
+    "{tf.aliasing_output = 0 : i32}, "
+    "%arg1: tensor<8x8xf32>) -> (tensor<8x8xf32> "
+    '{jax.result_info = ""}) {\n'
+    "    %0 = stablehlo.add %arg0, %arg1 : tensor<8x8xf32>\n"
+    "    return %0 : tensor<8x8xf32>\n"
+    "  }", num_partitions=1)
+
+# -- MX015: oversized replicated pin under a multi-device mesh --------------
+# 64x64xf32 = 16 KiB; audited with repl_bytes = 1024
+
+_MX015_BAD = _module(
+    "  func.func public @main(%arg0: tensor<64x64xf32> "
+    f"{{mhlo.sharding = {_SPEC}}}) -> (tensor<64x64xf32> "
+    f'{{jax.result_info = "", mhlo.sharding = {_SPEC}}}) {{\n'
+    "    %0 = stablehlo.custom_call @Sharding(%arg0) "
+    '{backend_config = "", mhlo.sharding = "{replicated}"} : '
+    "(tensor<64x64xf32>) -> tensor<64x64xf32>\n"
+    "    %1 = stablehlo.custom_call @Sharding(%0) "
+    f"{{backend_config = \"\", mhlo.sharding = {_SPEC}}} : "
+    "(tensor<64x64xf32>) -> tensor<64x64xf32>\n"
+    "    return %1 : tensor<64x64xf32>\n"
+    "  }")
+
+_MX015_CLEAN = _module(
+    "  func.func public @main(%arg0: tensor<64x64xf32> "
+    f"{{mhlo.sharding = {_SPEC}}}) -> (tensor<64x64xf32> "
+    f'{{jax.result_info = "", mhlo.sharding = {_SPEC}}}) {{\n'
+    "    %0 = stablehlo.custom_call @Sharding(%arg0) "
+    f"{{backend_config = \"\", mhlo.sharding = {_SPEC}}} : "
+    "(tensor<64x64xf32>) -> tensor<64x64xf32>\n"
+    "    return %0 : tensor<64x64xf32>\n"
+    "  }")
+
+# -- MX016: quantization round trip re-encoded from decoded values ----------
+
+_MX016_BAD = _module(
+    "  func.func public @main(%arg0: tensor<8x8xf32>) -> "
+    '(tensor<8x8xi8> {jax.result_info = ""}) {\n'
+    "    %0 = stablehlo.convert %arg0 : (tensor<8x8xf32>) -> "
+    "tensor<8x8xi8>\n"
+    "    %1 = stablehlo.convert %0 : (tensor<8x8xi8>) -> "
+    "tensor<8x8xf32>\n"
+    "    %2 = stablehlo.convert %1 : (tensor<8x8xf32>) -> "
+    "tensor<8x8xi8>\n"
+    "    return %2 : tensor<8x8xi8>\n"
+    "  }", num_partitions=1)
+
+_MX016_CLEAN = _module(
+    "  func.func public @main(%arg0: tensor<8x8xf32>) -> "
+    '(tensor<8x8xf32> {jax.result_info = ""}) {\n'
+    "    %0 = stablehlo.convert %arg0 : (tensor<8x8xf32>) -> "
+    "tensor<8x8xi8>\n"
+    "    %1 = stablehlo.convert %0 : (tensor<8x8xi8>) -> "
+    "tensor<8x8xf32>\n"
+    "    return %1 : tensor<8x8xf32>\n"
+    "  }", num_partitions=1)
+
+# -- MX017: duplicate collective (same pin issued twice) --------------------
+
+_MX017_BAD = _module(
+    "  func.func public @main(%arg0: tensor<8x8xf32> "
+    f"{{mhlo.sharding = {_SPEC}}}) -> (tensor<8x8xf32> "
+    '{jax.result_info = ""}) {\n'
+    "    %0 = stablehlo.custom_call @Sharding(%arg0) "
+    f"{{backend_config = \"\", mhlo.sharding = {_SPEC}}} : "
+    "(tensor<8x8xf32>) -> tensor<8x8xf32>\n"
+    "    %1 = stablehlo.custom_call @Sharding(%arg0) "
+    f"{{backend_config = \"\", mhlo.sharding = {_SPEC}}} : "
+    "(tensor<8x8xf32>) -> tensor<8x8xf32>\n"
+    "    %2 = stablehlo.add %0, %1 : tensor<8x8xf32>\n"
+    "    return %2 : tensor<8x8xf32>\n"
+    "  }")
+
+_MX017_CLEAN = _module(
+    "  func.func public @main(%arg0: tensor<8x8xf32> "
+    f"{{mhlo.sharding = {_SPEC}}}) -> (tensor<8x8xf32> "
+    '{jax.result_info = ""}) {\n'
+    "    %0 = stablehlo.custom_call @Sharding(%arg0) "
+    f"{{backend_config = \"\", mhlo.sharding = {_SPEC}}} : "
+    "(tensor<8x8xf32>) -> tensor<8x8xf32>\n"
+    "    %1 = stablehlo.add %0, %0 : tensor<8x8xf32>\n"
+    "    return %1 : tensor<8x8xf32>\n"
+    "  }")
+
+# -- MX018: host transfer inside a step program -----------------------------
+
+_MX018_BAD = _module(
+    "  func.func public @main(%arg0: tensor<8xf32>) -> "
+    '(tensor<8xf32> {jax.result_info = ""}) {\n'
+    "    %0 = stablehlo.custom_call @xla_python_cpu_callback(%arg0) "
+    '{backend_config = ""} : (tensor<8xf32>) -> tensor<8xf32>\n'
+    "    return %0 : tensor<8xf32>\n"
+    "  }", num_partitions=1)
+
+_MX018_CLEAN = _module(
+    "  func.func public @main(%arg0: tensor<8xf32>) -> "
+    '(tensor<8xf32> {jax.result_info = ""}) {\n'
+    "    %0 = stablehlo.add %arg0, %arg0 : tensor<8xf32>\n"
+    "    return %0 : tensor<8xf32>\n"
+    "  }", num_partitions=1)
+
+
+#: rule id -> {"bad": text, "clean": text, "kwargs": audit kwargs}
+FIXTURES: Dict[str, Dict] = {
+    "MX014": {"bad": _MX014_BAD, "clean": _MX014_CLEAN,
+              "kwargs": {"expect_donation": True}},
+    "MX015": {"bad": _MX015_BAD, "clean": _MX015_CLEAN,
+              "kwargs": {"repl_bytes": 1024}},
+    "MX016": {"bad": _MX016_BAD, "clean": _MX016_CLEAN, "kwargs": {}},
+    "MX017": {"bad": _MX017_BAD, "clean": _MX017_CLEAN, "kwargs": {}},
+    "MX018": {"bad": _MX018_BAD, "clean": _MX018_CLEAN, "kwargs": {}},
+}

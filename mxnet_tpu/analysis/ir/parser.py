@@ -1,0 +1,559 @@
+"""A lightweight StableHLO text parser — the IR under mxir (MX014–18).
+
+Parses the module text jax emits (``lowered.as_text()`` — the same
+bytes the persistent compile cache stores under its ``stablehlo``
+tier) into a flat, queryable structure: module attributes, functions
+with per-argument sharding/donation attributes, per-result shardings,
+and one record per op with operands, attribute dict, and input/output
+tensor types.
+
+This is deliberately NOT an MLIR parser.  It is a line-oriented
+scanner with quote- and bracket-aware splitting that understands
+exactly the textual shapes jax's StableHLO printer produces — enough
+structure for the program-level rules, nothing more.  Anything it
+does not recognize is skipped (an unknown line contributes no op);
+anything *structurally* surprising raises :class:`IrParseError`, which
+every caller converts to a counted ``parse_skipped``, never a crash.
+
+Stdlib-only, like the rest of ``mxnet_tpu.analysis``.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "IrParseError", "TensorType", "FuncArg", "FuncResult", "Op",
+    "Func", "Module", "parse_module", "parse_sharding", "Sharding",
+]
+
+
+class IrParseError(Exception):
+    """The module text did not match the shapes this parser knows."""
+
+
+# bytes per element for the dtypes jax programs actually carry; i4/i2
+# round up to one byte (they pack on the wire, but the rules only
+# compare against multi-megabyte thresholds where the factor-of-two
+# never matters)
+_ITEMSIZE = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
+    "i16": 2, "ui16": 2, "i8": 1, "ui8": 1, "i4": 1, "ui4": 1,
+    "i1": 1, "i2": 1,
+}
+
+
+def _itemsize(dtype: str) -> Optional[int]:
+    if dtype in _ITEMSIZE:
+        return _ITEMSIZE[dtype]
+    if dtype.startswith("f8"):          # f8E4M3FN, f8E5M2, ...
+        return 1
+    if dtype.startswith("complex<f32"):
+        return 8
+    if dtype.startswith("complex<f64"):
+        return 16
+    return None
+
+
+@dataclass(frozen=True)
+class TensorType:
+    """``tensor<4x8x256xf32>`` → shape (4, 8, 256), dtype 'f32'.
+    Dynamic dims parse as None and poison ``nbytes``."""
+
+    shape: Tuple[Optional[int], ...]
+    dtype: str
+
+    @property
+    def nbytes(self) -> Optional[int]:
+        size = _itemsize(self.dtype)
+        if size is None:
+            return None
+        n = 1
+        for d in self.shape:
+            if d is None:
+                return None
+            n *= d
+        return n * size
+
+
+_TENSOR = re.compile(r"^tensor<(.*)>$", re.S)
+
+
+def _parse_type(text: str) -> Optional[TensorType]:
+    """TensorType for ``tensor<...>`` text; None for tokens/tuples/
+    anything else (callers treat None as 'unknown, count nothing')."""
+    m = _TENSOR.match(text.strip())
+    if not m:
+        return None
+    inner = m.group(1)
+    # encoding attribute tail: tensor<8x4xf32, #stablehlo.bounds<...>>
+    inner = _split_top(inner, ",")[0].strip()
+    parts = inner.split("x")
+    dtype = parts[-1]
+    dims: List[Optional[int]] = []
+    for p in parts[:-1]:
+        p = p.strip()
+        if p == "?":
+            dims.append(None)
+        elif p.isdigit():
+            dims.append(int(p))
+        else:
+            return None  # not a ranked tensor shape after all
+    return TensorType(tuple(dims), dtype)
+
+
+# ---------------------------------------------------------------------------
+# quote/bracket-aware scanning: sharding strings embed braces INSIDE
+# quoted attribute values ("{devices=[2,1]<=[2]}"), so depth tracking
+# must ignore everything between double quotes
+# ---------------------------------------------------------------------------
+
+_OPEN = {"(": ")", "[": "]", "{": "}", "<": ">"}
+_CLOSE = {v: k for k, v in _OPEN.items()}
+
+
+def _split_top(s: str, sep: str) -> List[str]:
+    """Split ``s`` at ``sep`` occurrences that sit at bracket depth 0
+    and outside string quotes.  ``<`` / ``>`` count as brackets only in
+    type position (``tensor<...>``); comparison text never appears at
+    attribute top level in the printer's output."""
+    out: List[str] = []
+    depth = 0
+    quoted = False
+    start = 0
+    i = 0
+    n = len(s)
+    ln = len(sep)
+    while i < n:
+        c = s[i]
+        if quoted:
+            if c == "\\":
+                i += 2
+                continue
+            if c == '"':
+                quoted = False
+        elif c == '"':
+            quoted = True
+        elif c in _OPEN:
+            depth += 1
+        elif c in _CLOSE:
+            depth = max(0, depth - 1)
+        elif depth == 0 and s.startswith(sep, i):
+            out.append(s[start:i])
+            i += ln
+            start = i
+            continue
+        i += 1
+    out.append(s[start:])
+    return out
+
+
+def _find_top(s: str, sub: str, start: int = 0) -> int:
+    """Index of the first ``sub`` at depth 0 outside quotes, else -1.
+    The match test runs BEFORE depth bookkeeping so a ``sub`` that
+    itself begins with a bracket ("{") is findable at depth 0."""
+    depth = 0
+    quoted = False
+    i = start
+    n = len(s)
+    while i < n:
+        c = s[i]
+        if quoted:
+            if c == "\\":
+                i += 2
+                continue
+            if c == '"':
+                quoted = False
+        elif depth == 0 and c != '"' and s.startswith(sub, i):
+            return i
+        elif c == '"':
+            quoted = True
+        elif c in _OPEN:
+            depth += 1
+        elif c in _CLOSE:
+            depth = max(0, depth - 1)
+        i += 1
+    return -1
+
+
+def _matching(s: str, open_idx: int) -> int:
+    """Index of the bracket closing ``s[open_idx]`` (quote-aware)."""
+    opener = s[open_idx]
+    closer = _OPEN[opener]
+    depth = 0
+    quoted = False
+    i = open_idx
+    n = len(s)
+    while i < n:
+        c = s[i]
+        if quoted:
+            if c == "\\":
+                i += 2
+                continue
+            if c == '"':
+                quoted = False
+        elif c == '"':
+            quoted = True
+        elif c == opener:
+            depth += 1
+        elif c == closer:
+            depth -= 1
+            if depth == 0:
+                return i
+        i += 1
+    raise IrParseError(f"unbalanced {opener!r} at index {open_idx}")
+
+
+def _parse_attr_dict(body: str) -> Dict[str, str]:
+    """``mhlo.sharding = "{replicated}", tf.aliasing_output = 0 : i32``
+    → {"mhlo.sharding": "{replicated}", "tf.aliasing_output": "0"}.
+    Values are raw text with surrounding quotes and ``: type`` suffixes
+    stripped; flag attributes (no ``=``) map to ""."""
+    attrs: Dict[str, str] = {}
+    for item in _split_top(body, ","):
+        item = item.strip()
+        if not item:
+            continue
+        eq = _find_top(item, "=")
+        if eq < 0:
+            attrs[item] = ""
+            continue
+        key = item[:eq].strip()
+        val = item[eq + 1:].strip()
+        colon = _find_top(val, " : ")
+        if colon >= 0:
+            val = val[:colon].strip()
+        if len(val) >= 2 and val[0] == '"' and val[-1] == '"':
+            val = val[1:-1]
+        attrs[key] = val
+    return attrs
+
+
+# ---------------------------------------------------------------------------
+# sharding annotations
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Sharding:
+    """Classified GSPMD sharding text.  ``kind`` is 'replicated',
+    'devices', 'maximal', 'manual', or 'other'; ``tile`` holds the
+    devices-form tile dims (the trailing replication dim already
+    dropped when ``last_tile_dim_replicate`` was present)."""
+
+    kind: str
+    text: str
+    tile: Tuple[int, ...] = ()
+
+    @property
+    def is_replicated(self) -> bool:
+        if self.kind == "replicated":
+            return True
+        return self.kind == "devices" and all(t == 1 for t in self.tile)
+
+    @property
+    def sharded_dims(self) -> Tuple[int, ...]:
+        return tuple(i for i, t in enumerate(self.tile) if t > 1)
+
+
+_DEVICES = re.compile(r"devices=\[([0-9,]+)\]")
+
+
+def parse_sharding(text: Optional[str]) -> Optional[Sharding]:
+    if text is None:
+        return None
+    t = text.strip()
+    if t.startswith("{") and t.endswith("}"):
+        t = t[1:-1].strip()
+    if t == "replicated":
+        return Sharding("replicated", text)
+    if t == "manual":
+        return Sharding("manual", text)
+    if t.startswith("maximal"):
+        return Sharding("maximal", text)
+    m = _DEVICES.search(t)
+    if m:
+        tile = tuple(int(x) for x in m.group(1).split(",") if x)
+        if "last_tile_dim_replicate" in t and len(tile) > 1:
+            tile = tile[:-1]
+        return Sharding("devices", text, tile)
+    return Sharding("other", text)
+
+
+# ---------------------------------------------------------------------------
+# module structure
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FuncArg:
+    name: str                       # "%arg0"
+    type: Optional[TensorType]
+    attrs: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def sharding(self) -> Optional[Sharding]:
+        return parse_sharding(self.attrs.get("mhlo.sharding"))
+
+    @property
+    def alias_output(self) -> Optional[int]:
+        v = self.attrs.get("tf.aliasing_output")
+        if v is None:
+            v = self.attrs.get("jax.buffer_donor")
+            return 0 if v == "true" else None
+        try:
+            return int(v)
+        except ValueError:
+            return None
+
+
+@dataclass
+class FuncResult:
+    type: Optional[TensorType]
+    attrs: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def sharding(self) -> Optional[Sharding]:
+        return parse_sharding(self.attrs.get("mhlo.sharding"))
+
+
+@dataclass
+class Op:
+    name: str                       # "stablehlo.add", "call", ...
+    results: List[str]              # SSA ids ("%3"), may be empty
+    operands: List[str]
+    attrs: Dict[str, str]
+    in_types: List[Optional[TensorType]]
+    out_types: List[Optional[TensorType]]
+    line: int                       # 1-based line in the module text
+    target: str = ""                # custom_call "@Sharding" / call "@fn"
+
+    @property
+    def sharding(self) -> Optional[Sharding]:
+        return parse_sharding(self.attrs.get("mhlo.sharding"))
+
+
+@dataclass
+class Func:
+    name: str                       # "main"
+    visibility: str                 # "public" / "private" / ""
+    args: List[FuncArg]
+    results: List[FuncResult]
+    ops: List[Op] = field(default_factory=list)
+    returns: List[str] = field(default_factory=list)  # returned SSA ids
+    line: int = 0
+
+
+@dataclass
+class Module:
+    name: str
+    num_partitions: int
+    num_replicas: int
+    funcs: Dict[str, Func]
+
+    @property
+    def main(self) -> Optional[Func]:
+        if "main" in self.funcs:
+            return self.funcs["main"]
+        for f in self.funcs.values():
+            if f.visibility == "public":
+                return f
+        return next(iter(self.funcs.values()), None)
+
+
+_SSA = re.compile(r"%[A-Za-z0-9_]+")
+_RESULTS = re.compile(
+    r"^%[A-Za-z0-9_]+(?::\d+)?(?:\s*,\s*%[A-Za-z0-9_]+(?::\d+)?)*$")
+_INT_ATTR = re.compile(r"=\s*(-?\d+)\s*:\s*i\d+")
+
+
+def _module_attr(header: str, key: str) -> int:
+    m = re.search(re.escape(key) + r"\s*=\s*(\d+)", header)
+    return int(m.group(1)) if m else 1
+
+
+def _parse_func_header(header: str, line: int) -> Func:
+    """``func.func public @main(%arg0: T {attrs}, ...) -> (T {attrs})``
+    (the trailing `` {`` already stripped)."""
+    at = header.index("@")
+    lp = header.index("(", at)
+    name = header[at + 1:lp].strip()
+    visibility = ""
+    for vis in ("public", "private"):
+        if f" {vis} " in header[:at]:
+            visibility = vis
+    rp = _matching(header, lp)
+    args: List[FuncArg] = []
+    arg_body = header[lp + 1:rp]
+    if arg_body.strip():
+        for part in _split_top(arg_body, ","):
+            part = part.strip()
+            if not part.startswith("%"):
+                continue
+            colon = _find_top(part, ":")
+            aname = part[:colon].strip()
+            rest = part[colon + 1:].strip()
+            attrs: Dict[str, str] = {}
+            brace = _find_top(rest, "{")
+            if brace >= 0:
+                close = _matching(rest, brace)
+                attrs = _parse_attr_dict(rest[brace + 1:close])
+                rest = rest[:brace].strip()
+            args.append(FuncArg(aname, _parse_type(rest), attrs))
+    results: List[FuncResult] = []
+    arrow = _find_top(header, "->", rp)
+    if arrow >= 0:
+        res = header[arrow + 2:].strip()
+        if res.startswith("("):
+            res = res[1:_matching(res, 0)]
+            items = _split_top(res, ",")
+        else:
+            items = [res]
+        for item in items:
+            item = item.strip()
+            if not item:
+                continue
+            attrs = {}
+            brace = _find_top(item, "{")
+            if brace >= 0:
+                close = _matching(item, brace)
+                attrs = _parse_attr_dict(item[brace + 1:close])
+                item = item[:brace].strip()
+            results.append(FuncResult(_parse_type(item), attrs))
+    return Func(name, visibility, args, results, line=line)
+
+
+def _parse_op_line(text: str, line: int) -> Optional[Op]:
+    """One op statement → :class:`Op`, or None for text that is not an
+    op (closing braces, region headers, anything unrecognized)."""
+    s = text.strip()
+    if not s or s.startswith("//") or s in ("}", "})", "},"):
+        return None
+    results: List[str] = []
+    eq = _find_top(s, "=")
+    if eq > 0 and _RESULTS.match(s[:eq].strip()):
+        for r in _split_top(s[:eq], ","):
+            results.append(r.strip().split(":")[0])
+        s = s[eq + 1:].strip()
+    if not s or s[0] in "}{)":
+        return None
+    # op name: "stablehlo.add", "call", "return", "func.return" ...
+    m = re.match(r"^([A-Za-z_][A-Za-z0-9_.]*)", s)
+    if not m:
+        return None
+    name = m.group(1)
+    rest = s[m.end():].strip()
+    target = ""
+    if rest.startswith("@"):        # custom_call @Target / call @fn
+        tm = re.match(r"^@([A-Za-z0-9_.$]+)", rest)
+        if tm:
+            target = "@" + tm.group(1)
+            rest = rest[tm.end():].strip()
+    # split off the trailing type signature (the last top-level " : ")
+    sig = ""
+    colon = _find_top(rest, " : ")
+    while colon >= 0:
+        nxt = _find_top(rest, " : ", colon + 3)
+        if nxt < 0:
+            sig = rest[colon + 3:].strip()
+            rest = rest[:colon].strip()
+            break
+        colon = nxt
+    # `stablehlo.constant dense<..> : tensor<f32>` — the dense literal
+    # can contain commas/brackets; operands are just the SSA ids used
+    operands = [] if name.endswith("constant") else _SSA.findall(rest)
+    attrs: Dict[str, str] = {}
+    i = 0
+    while True:
+        brace = _find_top(rest, "{", i)
+        if brace < 0:
+            break
+        close = _matching(rest, brace)
+        attrs.update(_parse_attr_dict(rest[brace + 1:close]))
+        i = close + 1
+    # structured attrs outside braces: `dims = [...]`, `dimensions = [..]`
+    for am in re.finditer(
+            r"\b(dims|dimensions|across dimensions)\s*=\s*\[([0-9,\s]*)\]",
+            rest):
+        attrs[am.group(1).replace("across ", "")] = am.group(2).strip()
+    in_types: List[Optional[TensorType]] = []
+    out_types: List[Optional[TensorType]] = []
+    if sig:
+        arrow = _find_top(sig, "->")
+        if arrow >= 0:
+            ins, outs = sig[:arrow].strip(), sig[arrow + 2:].strip()
+            for side, dst in ((ins, in_types), (outs, out_types)):
+                if side.startswith("("):
+                    side = side[1:_matching(side, 0)]
+                    dst.extend(_parse_type(p) for p in
+                               _split_top(side, ",") if p.strip())
+                elif side:
+                    dst.append(_parse_type(side))
+        else:
+            # elementwise shorthand: one type, inputs == output
+            t = _parse_type(sig)
+            out_types.append(t)
+            in_types.extend([t] * max(1, len(operands)))
+    return Op(name, results, operands, attrs, in_types, out_types,
+              line, target)
+
+
+def parse_module(text: str) -> Module:
+    """Parse one StableHLO module's text.  Raises :class:`IrParseError`
+    when the text has no module/function structure to speak of."""
+    try:
+        return _parse_module(text)
+    except IrParseError:
+        raise
+    except Exception as e:  # noqa: BLE001 — any slip becomes IrParseError
+        raise IrParseError(f"{type(e).__name__}: {e}") from e
+
+
+def _parse_module(text: str) -> Module:
+    name = ""
+    num_partitions = 1
+    num_replicas = 1
+    funcs: Dict[str, Func] = {}
+    cur: Optional[Func] = None
+    pending: List[str] = []     # multi-line func header accumulator
+    pending_line = 0
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if pending:
+            pending.append(line)
+            joined = " ".join(pending)
+            if joined.rstrip().endswith("{") and \
+                    _find_top(joined.rstrip()[:-1], "(") >= 0:
+                cur = _parse_func_header(
+                    joined.rstrip()[:-1].strip(), pending_line)
+                funcs[cur.name] = cur
+                pending = []
+            continue
+        if line.startswith("module"):
+            m = re.search(r"@([A-Za-z0-9_.$-]+)", line)
+            name = m.group(1) if m else ""
+            num_partitions = _module_attr(line, "mhlo.num_partitions")
+            num_replicas = _module_attr(line, "mhlo.num_replicas")
+            continue
+        if line.startswith("func.func") or line.startswith("func @"):
+            if line.rstrip().endswith("{"):
+                cur = _parse_func_header(
+                    line.rstrip()[:-1].strip(), lineno)
+                funcs[cur.name] = cur
+            else:
+                pending = [line]
+                pending_line = lineno
+            continue
+        if cur is None:
+            continue
+        op = _parse_op_line(line, lineno)
+        if op is None:
+            continue
+        if op.name in ("return", "func.return", "stablehlo.return"):
+            if op.name != "stablehlo.return":   # region yields ignored
+                cur.returns = list(op.operands)
+            continue
+        cur.ops.append(op)
+    if not funcs:
+        raise IrParseError("no func.func found in module text")
+    return Module(name, num_partitions, num_replicas, funcs)

@@ -1,0 +1,65 @@
+"""MXIR report rendering — the MXLINT.json-shaped artifact for
+program audits (one entry per audited program instead of per file)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..engine import RULE_REGISTRY, Violation
+from .rules import IR_RULE_IDS
+
+__all__ = ["ProgramAudit", "render_ir_json"]
+
+
+@dataclass
+class ProgramAudit:
+    """One audited program: the site label, its violations, and —
+    when the text failed to parse — the counted (never fatal) error."""
+
+    site: str
+    violations: List[Violation] = field(default_factory=list)
+    parse_error: Optional[str] = None
+    wire: Optional[dict] = None      # static wire estimate, if computed
+
+    @property
+    def parse_skipped(self) -> bool:
+        return self.parse_error is not None
+
+
+def render_ir_json(audits: Sequence[ProgramAudit]) -> dict:
+    """The MXIR.json shape — per-rule counts first (the trajectory the
+    nightly tracks), then per-program summaries, then the findings.
+    Mirrors :func:`..reporters.render_json` so the same tooling reads
+    both artifacts."""
+    violations: List[Violation] = []
+    for a in audits:
+        violations.extend(a.violations)
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    per_rule: Dict[str, int] = {}
+    for v in violations:
+        per_rule[v.rule] = per_rule.get(v.rule, 0) + 1
+    skipped = sum(1 for a in audits if a.parse_skipped)
+    return {
+        "ok": not violations,
+        "counts": {
+            "programs": len(audits),
+            "violations": len(violations),
+            "parse_skipped": skipped,
+        },
+        "per_rule": per_rule,
+        "rules": {rid: {"name": RULE_REGISTRY[rid].name,
+                        "description": RULE_REGISTRY[rid].description}
+                  for rid in IR_RULE_IDS if rid in RULE_REGISTRY},
+        "programs": [{
+            "site": a.site,
+            "violations": len(a.violations),
+            "parse_skipped": a.parse_skipped,
+            **({"parse_error": a.parse_error} if a.parse_error else {}),
+            **({"wire": a.wire} if a.wire else {}),
+        } for a in audits],
+        "violations": [{
+            "rule": v.rule, "path": v.path, "line": v.line,
+            "col": v.col, "symbol": v.symbol, "message": v.message,
+            "fingerprint": v.fingerprint,
+        } for v in violations],
+    }

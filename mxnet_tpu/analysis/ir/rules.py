@@ -1,0 +1,622 @@
+"""mxir program rules MX014–MX018: static verification of compiled
+StableHLO step programs.
+
+Where MX001–MX013 verify the Python that *builds* programs, these
+rules verify the programs themselves — the lowered module text every
+executable cache compiles and the persistent compile cache stores.
+Each rule is grounded in a bug class this repo shipped:
+
+  * MX014 — a call site declared donation but the lowered module
+    carries no input/output alias: the donated buffer is silently kept
+    live and peak HBM doubles (the exact failure mode ``alias_ok``
+    guards dynamically — this is its static twin);
+  * MX015 — an above-threshold tensor pinned or returned REPLICATED in
+    a multi-partition program (the PR 18 gather-replication class:
+    sharding propagation flipped, the fix pinned replication, and
+    nothing verified the pin's cost);
+  * MX016 — precision leaks around the int8/fp8 comm-quant path: f64
+    creep, and widen→narrow round trips that throw away the precision
+    they just paid for;
+  * MX017 — collective hygiene: dead or duplicate collectives /
+    resharding pins, plus the static wire-bytes model whose drift
+    against the measured ``mx_collective_wire_bytes_total`` is itself
+    a violation (:func:`estimate_wire_bytes` / :func:`wire_drift`);
+  * MX018 — host transfers (infeed/outfeed/send/recv/host callbacks)
+    inside a step program: every one is a device→host sync the async
+    dispatch pipeline stalls on.
+
+All rules run over the :mod:`parser` IR through :class:`IrContext`;
+they register in the ordinary mxlint ``RULE_REGISTRY`` so reporters,
+``--list-rules``, and the generated docs cover MX001–MX018 uniformly,
+but ``Rule.check`` (the Python-AST hook) is a no-op — programs enter
+through :func:`audit_module`.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..engine import Rule, Violation, register_rule
+from .parser import (
+    IrParseError, Module, Op, Sharding, parse_module, parse_sharding,
+)
+
+__all__ = [
+    "IrContext", "IrRule", "DonationDropped", "OversizedReplicated",
+    "PrecisionLeak", "CollectiveAudit", "HostTransfer",
+    "WireEstimate", "estimate_wire_bytes", "wire_drift",
+    "audit_module", "IR_RULE_IDS",
+]
+
+IR_RULE_IDS = ("MX014", "MX015", "MX016", "MX017", "MX018")
+
+#: default MX015 threshold (bytes) — mirrors MXNET_IR_REPL_BYTES
+DEFAULT_REPL_BYTES = 64 << 20
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit, shift in (("GiB", 30), ("MiB", 20), ("KiB", 10)):
+        if n >= (1 << shift):
+            return f"{n / (1 << shift):.1f} {unit}"
+    return f"{n} B"
+
+
+class IrContext:
+    """One audited program: the parsed module, its source lines (for
+    violation anchors), and the audit-site metadata the runtime hook
+    passes through."""
+
+    def __init__(self, module: Module, text: str, site: str = "program",
+                 expect_donation: bool = False,
+                 repl_bytes: int = DEFAULT_REPL_BYTES):
+        self.module = module
+        self.lines = text.splitlines()
+        self.site = site
+        #: Violation.path — "ir://<site>" keeps program findings
+        #: unmistakably distinct from file findings in shared reports
+        self.path = f"ir://{site}"
+        self.expect_donation = expect_donation
+        self.repl_bytes = repl_bytes
+
+    def src(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def violation(self, rule_id: str, line: int, message: str,
+                  symbol: str = "main") -> Violation:
+        return Violation(rule=rule_id, path=self.path, line=line,
+                         col=0, message=message, symbol=symbol,
+                         src=self.src(line))
+
+
+class IrRule(Rule):
+    """Base for program rules: engine ``check``/``finalize`` are
+    no-ops (these rules see modules, not Python files); subclasses
+    implement :meth:`check_program`."""
+
+    def check_program(self, ctx: IrContext) -> Iterable[Violation]:
+        return ()
+
+
+# ---------------------------------------------------------------------------
+# MX014 — donation dropped
+# ---------------------------------------------------------------------------
+
+@register_rule
+class DonationDropped(IrRule):
+    """MX014: the call site compiled with ``donate_argnums`` but the
+    lowered module aliases NO argument to an output.  XLA then keeps
+    every donated input live next to its output — silent 2x HBM on the
+    largest buffers in the program (the optimizer state and weights
+    the donation was protecting)."""
+
+    id = "MX014"
+    name = "donation-dropped"
+    description = ("Call site declared buffer donation but the lowered "
+                   "module has no input/output alias — donated buffers "
+                   "stay live and peak HBM doubles.")
+
+    def check_program(self, ctx: IrContext) -> Iterable[Violation]:
+        if not ctx.expect_donation:
+            return
+        main = ctx.module.main
+        if main is None or not main.args:
+            return
+        if any(a.alias_output is not None for a in main.args):
+            return
+        yield ctx.violation(
+            self.id, main.line,
+            f"compile site {ctx.site!r} declared donate_argnums but "
+            f"none of the {len(main.args)} module arguments carries "
+            "an input/output alias (tf.aliasing_output) — XLA will "
+            "keep every donated buffer live beside its result, "
+            "doubling peak HBM for the step state.")
+
+
+# ---------------------------------------------------------------------------
+# MX015 — oversized replicated tensor in a multi-partition program
+# ---------------------------------------------------------------------------
+
+@register_rule
+class OversizedReplicated(IrRule):
+    """MX015: a tensor above ``MXNET_IR_REPL_BYTES`` pinned (via a
+    ``@Sharding`` custom_call) or returned with REPLICATED sharding in
+    a program lowered for a multi-device mesh.  Every partition then
+    materializes the full tensor — the PR 18 gather-replication bug
+    class, caught statically instead of as an HBM OOM.  Arguments are
+    exempt: replicated weights *inputs* are the data-parallel contract;
+    it is producing a fresh full-size replicated value inside the
+    program that multiplies memory."""
+
+    id = "MX015"
+    name = "oversized-replicated"
+    description = ("Tensor above MXNET_IR_REPL_BYTES pinned or returned "
+                   "replicated in a multi-partition program — every "
+                   "device materializes the full value.")
+
+    def check_program(self, ctx: IrContext) -> Iterable[Violation]:
+        if ctx.module.num_partitions <= 1:
+            return
+        main = ctx.module.main
+        if main is None:
+            return
+        limit = ctx.repl_bytes
+        for op in main.ops:
+            if op.target != "@Sharding" or not op.out_types:
+                continue
+            sh = op.sharding
+            t = op.out_types[0]
+            if sh is not None and sh.is_replicated and t is not None \
+                    and t.nbytes is not None and t.nbytes > limit:
+                yield ctx.violation(
+                    self.id, op.line,
+                    f"sharding pin replicates a {_fmt_bytes(t.nbytes)} "
+                    f"tensor (tensor<{'x'.join(map(str, t.shape))}x"
+                    f"{t.dtype}>) across all "
+                    f"{ctx.module.num_partitions} partitions "
+                    f"(> MXNET_IR_REPL_BYTES={limit}); shard it, or "
+                    "raise the threshold if the replication is truly "
+                    "load-bearing.")
+        for i, res in enumerate(main.results):
+            sh = res.sharding
+            t = res.type
+            if sh is not None and sh.is_replicated and t is not None \
+                    and t.nbytes is not None and t.nbytes > limit:
+                yield ctx.violation(
+                    self.id, main.line,
+                    f"program output #{i} is a replicated "
+                    f"{_fmt_bytes(t.nbytes)} tensor in a "
+                    f"{ctx.module.num_partitions}-partition program "
+                    f"(> MXNET_IR_REPL_BYTES={limit}) — every device "
+                    "holds the full value.")
+
+
+# ---------------------------------------------------------------------------
+# MX016 — precision leak
+# ---------------------------------------------------------------------------
+
+_NARROW = re.compile(r"^(i8|ui8|f16|bf16|f8.*)$")
+_WIDE = {"f32", "f64"}
+
+
+@register_rule
+class PrecisionLeak(IrRule):
+    """MX016: precision anomalies in a mixed-precision step program —
+    any f64 compute (the silent x64 upcast class: one stray Python
+    float promotes a whole chain to double and halves TPU throughput),
+    and widen→narrow round trips where a value is converted up to
+    f32/f64 and the *direct* result converted straight back down (the
+    comm-quant decode→re-encode shape: the widening bought nothing and
+    the narrow grid quantizes twice)."""
+
+    id = "MX016"
+    name = "precision-leak"
+    description = ("f64 compute in a step program, or a widen->narrow "
+                   "convert round trip (value upcast and immediately "
+                   "re-quantized) around the comm-quant encode path.")
+
+    def check_program(self, ctx: IrContext) -> Iterable[Violation]:
+        for func in ctx.module.funcs.values():
+            defs: Dict[str, Op] = {}
+            for op in func.ops:
+                for r in op.results:
+                    defs[r] = op
+            for op in func.ops:
+                for t in op.out_types:
+                    if t is not None and t.dtype == "f64":
+                        yield ctx.violation(
+                            self.id, op.line,
+                            f"{op.name} produces f64 — double-precision "
+                            "compute in a step program is almost always "
+                            "an accidental x64 promotion (TPUs emulate "
+                            "f64 at a large cost).", symbol=func.name)
+                        break
+                if not op.name.endswith("convert") or not op.operands:
+                    continue
+                src_t = op.in_types[0] if op.in_types else None
+                dst_t = op.out_types[0] if op.out_types else None
+                if src_t is None or dst_t is None or \
+                        src_t.dtype not in _WIDE or \
+                        not _NARROW.match(dst_t.dtype):
+                    continue
+                feeder = defs.get(op.operands[0])
+                if feeder is None or not feeder.name.endswith("convert"):
+                    continue
+                f_src = feeder.in_types[0] if feeder.in_types else None
+                if f_src is not None and f_src.dtype == dst_t.dtype:
+                    yield ctx.violation(
+                        self.id, op.line,
+                        f"{dst_t.dtype}->{src_t.dtype}->{dst_t.dtype} "
+                        "convert round trip: the upcast result feeds "
+                        "straight back into the narrow grid, "
+                        "quantizing twice for nothing — drop the "
+                        "round trip or do real f32 compute between "
+                        "the casts.", symbol=func.name)
+
+
+# ---------------------------------------------------------------------------
+# MX017 — collective audit (+ static wire-bytes model)
+# ---------------------------------------------------------------------------
+
+#: explicit collective ops (shard_map/manual programs) — GSPMD
+#: programs express collectives as @Sharding transitions instead
+_COLLECTIVE_OPS = {
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+    "collective_permute", "collective_broadcast", "cross-replica-sum",
+}
+
+
+def _is_collective(op: Op) -> bool:
+    return op.name.split(".")[-1] in _COLLECTIVE_OPS or \
+        op.target == "@Sharding"
+
+
+@register_rule
+class CollectiveAudit(IrRule):
+    """MX017: collective hygiene.  A DEAD collective or resharding pin
+    (result never used, not returned) still moves its bytes before XLA
+    DCE can prove otherwise — and a pin the author believes is
+    load-bearing but is actually dead means the *intended* sharding
+    never happens.  A DUPLICATE collective (same op, same operands,
+    same attributes) moves the same bytes twice.  The third face of
+    the rule is dynamic: :func:`wire_drift` compares this module's
+    static wire-bytes estimate (:func:`estimate_wire_bytes`) against
+    the measured ``mx_collective_wire_bytes_total`` counter — drift
+    above tolerance means the program on the wire is not the program
+    the model (and the capacity plan) believes is running."""
+
+    id = "MX017"
+    name = "collective-audit"
+    description = ("Dead or duplicate collective / resharding pin in a "
+                   "step program, or static wire-bytes model drifting "
+                   "from the measured collective counters beyond "
+                   "MXNET_IR_WIRE_TOL.")
+
+    def check_program(self, ctx: IrContext) -> Iterable[Violation]:
+        for func in ctx.module.funcs.values():
+            used = set(func.returns)
+            for op in func.ops:
+                used.update(op.operands)
+            seen: Dict[Tuple, int] = {}
+            for op in func.ops:
+                if not _is_collective(op):
+                    continue
+                if op.results and not any(r in used for r in op.results):
+                    what = f"custom_call {op.target}" if op.target \
+                        else op.name
+                    yield ctx.violation(
+                        self.id, op.line,
+                        f"dead collective: {what} result is never used "
+                        "and not returned — the bytes still move, and "
+                        "if this pin was meant to constrain sharding "
+                        "it constrains nothing.", symbol=func.name)
+                key = (op.name, op.target, tuple(op.operands),
+                       tuple(sorted(op.attrs.items())))
+                prev = seen.get(key)
+                if prev is not None:
+                    yield ctx.violation(
+                        self.id, op.line,
+                        f"duplicate collective: identical "
+                        f"{op.target or op.name} on "
+                        f"{', '.join(op.operands)} already issued at "
+                        f"module line {prev} — the same payload "
+                        "crosses the wire twice.", symbol=func.name)
+                else:
+                    seen[key] = op.line
+
+
+# -- static wire-bytes model -------------------------------------------------
+
+#: elementwise / shape-preserving ops: sharding state propagates
+#: spec-exactly
+_PROPAGATE = {
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "negate", "abs", "exponential", "log", "sqrt", "rsqrt", "tanh",
+    "logistic", "sign", "floor", "ceil", "round_nearest_even",
+    "round_nearest_afz", "clamp", "select", "compare", "and", "or",
+    "xor", "not", "power", "remainder", "atan2", "convert",
+    "bitcast_convert", "copy", "optimization_barrier",
+}
+#: shape-changing but data-local ops: still sharded-ish, but the tile
+#: assignment no longer maps (spec degrades to unknown)
+_RESHAPEY = {
+    "reshape", "pad", "slice", "dynamic_slice", "concatenate",
+    "transpose", "broadcast_in_dim", "dynamic_update_slice", "iota",
+    "gather",
+}
+
+_REPL = ("repl",)
+_PARTIAL = ("partial",)
+_UNKNOWN = ("unknown",)
+
+
+def _classify_target(sh: Optional[Sharding]) -> Tuple:
+    if sh is None:
+        return _UNKNOWN
+    if sh.is_replicated:
+        return _REPL
+    if sh.kind == "devices":
+        return ("sharded", sh.text, sh.sharded_dims)
+    return _UNKNOWN
+
+
+def _join(states: Sequence[Tuple]) -> Tuple:
+    states = [s for s in states if s is not None]
+    if not states:
+        return _UNKNOWN
+    if any(s == _PARTIAL for s in states):
+        return _PARTIAL
+    sharded = [s for s in states if s[0] == "sharded"]
+    if sharded:
+        specs = {s[1] for s in sharded}
+        if len(specs) == 1 and all(
+                s[0] in ("sharded", "repl") for s in states):
+            return sharded[0]
+        return ("sharded", None, ())
+    if all(s == _REPL for s in states):
+        return _REPL
+    return _UNKNOWN
+
+
+def _lane(dtype: str) -> str:
+    if dtype in ("i8", "ui8"):
+        return "int8"
+    if dtype.startswith("f8"):
+        return "fp8"
+    return dtype
+
+
+@dataclass
+class WireEstimate:
+    """Static per-execution wire model: one leg per collective the
+    abstract interpretation could classify.  ``by_lane`` buckets bytes
+    by payload dtype the same way the runtime counter's ``encoding``
+    label does (i8 → "int8", f8* → "fp8"), so the two are directly
+    comparable lane by lane."""
+
+    legs: List[dict] = field(default_factory=list)
+    unknown_transitions: int = 0
+
+    @property
+    def total(self) -> int:
+        return sum(leg["nbytes"] for leg in self.legs)
+
+    @property
+    def by_lane(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for leg in self.legs:
+            out[leg["lane"]] = out.get(leg["lane"], 0) + leg["nbytes"]
+        return out
+
+
+def estimate_wire_bytes(module: Module) -> WireEstimate:
+    """Abstract-interpret the main function's sharding states and
+    price every collective transition.
+
+    Byte conventions match the runtime counter (one logical copy of
+    the payload per leg): reduce-scatter / all-reduce / all-gather
+    count the full tensor's bytes; an all-to-all between two sharded
+    layouts counts ``nbytes / num_partitions`` (each device forwards
+    its shard); replicated→sharded is a local slice (0 bytes).
+    Unclassifiable transitions count nothing and are tallied in
+    ``unknown_transitions`` — precision over recall, like every other
+    rule in this package."""
+    est = WireEstimate()
+    main = module.main
+    if main is None:
+        return est
+    nparts = max(1, module.num_partitions)
+    state: Dict[str, Tuple] = {}
+    for arg in main.args:
+        state[arg.name] = _classify_target(arg.sharding)
+    for op in main.ops:
+        short = op.name.split(".")[-1]
+        if op.name.endswith("constant"):
+            for r in op.results:
+                state[r] = _REPL
+            continue
+        if op.target == "@Sharding":
+            src = state.get(op.operands[0], _UNKNOWN) \
+                if op.operands else _UNKNOWN
+            dst = _classify_target(op.sharding)
+            t = op.out_types[0] if op.out_types else None
+            nbytes = t.nbytes if t is not None else None
+            kind = None
+            amount = 0
+            if nbytes is not None:
+                if src == _PARTIAL and dst[0] == "sharded":
+                    kind, amount = "reduce-scatter", nbytes
+                elif src == _PARTIAL and dst == _REPL:
+                    kind, amount = "all-reduce", nbytes
+                elif src[0] == "sharded" and dst == _REPL:
+                    kind, amount = "all-gather", nbytes
+                elif src[0] == "sharded" and dst[0] == "sharded":
+                    if src[1] is None or src[1] != dst[1]:
+                        kind, amount = "all-to-all", nbytes // nparts
+                elif src == _REPL:
+                    pass                      # local slice / no-op
+                else:
+                    est.unknown_transitions += 1
+            else:
+                est.unknown_transitions += 1
+            if kind is not None and amount > 0:
+                est.legs.append({
+                    "kind": kind, "nbytes": int(amount),
+                    "lane": _lane(t.dtype), "line": op.line,
+                })
+            for r in op.results:
+                state[r] = dst
+            continue
+        if short in _COLLECTIVE_OPS:
+            t = op.out_types[0] if op.out_types else None
+            if t is not None and t.nbytes is not None:
+                est.legs.append({
+                    "kind": short.replace("_", "-"),
+                    "nbytes": int(t.nbytes),
+                    "lane": _lane(t.dtype), "line": op.line,
+                })
+            for r in op.results:
+                state[r] = _UNKNOWN
+            continue
+        if short == "reduce":
+            src = state.get(op.operands[0], _UNKNOWN) \
+                if op.operands else _UNKNOWN
+            dims = {int(d) for d in
+                    op.attrs.get("dimensions", "").split(",")
+                    if d.strip().isdigit()}
+            if src == _PARTIAL:
+                out = _PARTIAL
+            elif src[0] == "sharded":
+                # reducing over a *provably sharded* dim leaves
+                # per-device partial sums; with a degraded spec we keep
+                # the sharded state — comm-quant scale reductions run
+                # over local block dims of padded (spec-degraded) data,
+                # and flagging those partial would misprice the int8
+                # exchange legs as reduce-scatters
+                out = _PARTIAL if (src[1] is not None and
+                                   dims & set(src[2])) else src
+            else:
+                out = src
+            for r in op.results:
+                state[r] = out
+            continue
+        if short == "call":
+            # private helpers (@round, @clip, @_pad_*) are data-local:
+            # sharding survives the call, the tile assignment may not
+            out = _join([state.get(o, _UNKNOWN) for o in op.operands])
+            if out[0] == "sharded":
+                out = ("sharded", None, ())
+            for r in op.results:
+                state[r] = out
+            continue
+        if short in _PROPAGATE:
+            out = _join([state.get(o, _UNKNOWN) for o in op.operands])
+            for r in op.results:
+                state[r] = out
+            continue
+        if short in _RESHAPEY:
+            out = _join([state.get(o, _UNKNOWN) for o in op.operands])
+            if out[0] == "sharded":
+                out = ("sharded", None, ())
+            for r in op.results:
+                state[r] = out
+            continue
+        for r in op.results:
+            state[r] = _UNKNOWN
+    return est
+
+
+def wire_drift(static_bytes: float, measured_bytes: float,
+               tol: float) -> Optional[str]:
+    """MX017's dynamic face: relative drift between the static model
+    and the measured counter, same lane, same step count.  Returns the
+    violation message when drift exceeds ``tol`` (``None`` when the
+    model and the wire agree)."""
+    if measured_bytes <= 0 and static_bytes <= 0:
+        return None
+    denom = max(measured_bytes, 1.0)
+    drift = abs(static_bytes - measured_bytes) / denom
+    if drift <= tol:
+        return None
+    return (f"static wire-bytes model predicts {int(static_bytes)} B "
+            f"but the measured collective counter moved "
+            f"{int(measured_bytes)} B — {drift:.1%} drift exceeds "
+            f"MXNET_IR_WIRE_TOL={tol:g}; the program on the wire is "
+            "not the program the model believes is running.")
+
+
+# ---------------------------------------------------------------------------
+# MX018 — host transfer inside a step program
+# ---------------------------------------------------------------------------
+
+_HOST_TARGET = re.compile(r"callback|infeed|outfeed|host_|py_func",
+                          re.IGNORECASE)
+_HOST_OPS = {"infeed", "outfeed", "send", "recv"}
+
+
+@register_rule
+class HostTransfer(IrRule):
+    """MX018: infeed/outfeed/send/recv or a host callback custom_call
+    inside a step program.  Each one is a synchronous device↔host
+    round trip in the middle of the hot loop — the compiled-program
+    equivalent of MX002's ``.asnumpy()``-in-the-step, and invisible
+    from the Python source once a library buried it in a traced
+    helper (``jax.debug.print``, ``io_callback``, host metrics)."""
+
+    id = "MX018"
+    name = "host-transfer"
+    description = ("infeed/outfeed/send/recv or host-callback "
+                   "custom_call inside a compiled step program — a "
+                   "device<->host sync in the hot loop.")
+
+    def check_program(self, ctx: IrContext) -> Iterable[Violation]:
+        for func in ctx.module.funcs.values():
+            for op in func.ops:
+                short = op.name.split(".")[-1]
+                if short in _HOST_OPS:
+                    yield ctx.violation(
+                        self.id, op.line,
+                        f"{op.name} inside a step program is a "
+                        "synchronous device<->host transfer; move the "
+                        "host exchange outside the compiled step.",
+                        symbol=func.name)
+                elif op.target and op.target != "@Sharding" and \
+                        _HOST_TARGET.search(op.target):
+                    yield ctx.violation(
+                        self.id, op.line,
+                        f"custom_call {op.target} is a host callback — "
+                        "the step blocks on Python while the mesh "
+                        "idles; hoist it out of the traced step or "
+                        "gate it behind a debug knob.",
+                        symbol=func.name)
+
+
+# ---------------------------------------------------------------------------
+# the audit entry point
+# ---------------------------------------------------------------------------
+
+def audit_module(text: str, site: str = "program",
+                 expect_donation: bool = False,
+                 repl_bytes: int = DEFAULT_REPL_BYTES,
+                 rules: Optional[Sequence[str]] = None,
+                 module: Optional[Module] = None
+                 ) -> List[Violation]:
+    """Parse ``text`` and run the program rules (all five, or the ids
+    in ``rules``).  Raises :class:`IrParseError` when the text cannot
+    be parsed — callers count it as ``parse_skipped``.  Pass an
+    already-parsed ``module`` to skip the re-parse."""
+    if module is None:
+        module = parse_module(text)
+    ctx = IrContext(module, text, site=site,
+                    expect_donation=expect_donation,
+                    repl_bytes=repl_bytes)
+    out: List[Violation] = []
+    for cls in (DonationDropped, OversizedReplicated, PrecisionLeak,
+                CollectiveAudit, HostTransfer):
+        if rules is not None and cls.id not in rules:
+            continue
+        out.extend(cls().check_program(ctx))
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return out

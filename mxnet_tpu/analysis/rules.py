@@ -131,6 +131,7 @@ class RecompileHazard(Rule):
     fused-step path is built on."""
 
     id = "MX001"
+    cacheable = "file"
     name = "recompile-hazard"
     description = ("Host scalar coercion or materialization inside a "
                    "jit-compiled function (silent recompile / trace "
@@ -223,6 +224,7 @@ class HostSyncInHotPath(Rule):
     this rule used to carry is gone)."""
 
     id = "MX002"
+    cacheable = "file"
     name = "hot-path-host-sync"
     description = ("Device->host synchronization (.asnumpy()/np.asarray/"
                    ".item()/.wait_to_read()) written directly inside "
@@ -308,6 +310,7 @@ class UntrackedEnvKnob(Rule):
     forever — the registry raises on undeclared names instead."""
 
     id = "MX003"
+    cacheable = "file"
     name = "untracked-env-knob"
     description = ("os.environ/get_env read of a MXNET_* name outside "
                    "the mxnet_tpu.util.env knob registry.")
@@ -372,6 +375,7 @@ class UnguardedSharedState(Rule):
     house style — follow it or justify the race in the baseline."""
 
     id = "MX004"
+    cacheable = "file"
     name = "unguarded-shared-state"
     description = ("Write to a module-level mutable container from a "
                    "function body with no enclosing `with <lock>:`.")
@@ -480,6 +484,7 @@ class DonationMisuse(Rule):
     is a no-op — the worst kind of portability bug)."""
 
     id = "MX005"
+    cacheable = "file"
     name = "donation-misuse"
     description = ("Variable passed at a donated argument position is "
                    "read after the donating call in the same scope.")
@@ -615,6 +620,7 @@ class SwallowedException(Rule):
     catch-everything-do-nothing is the bug."""
 
     id = "MX007"
+    cacheable = "file"
     name = "swallowed-exception"
     description = ("Bare except/except Exception with a pass-only body "
                    "in Trainer/KVStore/serving/dataloader/resilience "
@@ -688,6 +694,7 @@ class OpRegistryContract(Rule):
     name = "op-registry-contract"
     description = ("Duplicate register_op name/alias, or a registered "
                    "op missing a docstring.")
+    cacheable = "contrib"
 
     def __init__(self) -> None:
         #: name -> (first path, line); duplicates reported at 2nd site
@@ -701,7 +708,15 @@ class OpRegistryContract(Rule):
                     _terminal_name(dec.func) == "register_op":
                 yield dec
 
-    def check(self, ctx: FileContext) -> Iterable[Violation]:
+    def contribution(self, ctx: FileContext) -> dict:
+        """This file's pure share of the cross-file state: every
+        ``register_op`` name in order (with the site needed to rebuild
+        a duplicate finding against ANY prior file), plus the per-file
+        docstring findings — both independent of other files, so an
+        unchanged file replays from cache while dup detection still
+        runs fresh across the whole walk in :meth:`absorb`."""
+        regs: List[dict] = []
+        doc_violations: List[dict] = []
         for node in ctx.functions:
             for call in self._register_calls(node):
                 names: List[str] = []
@@ -715,25 +730,49 @@ class OpRegistryContract(Rule):
                             e.value for e in kw.value.elts
                             if isinstance(e, ast.Constant)
                             and isinstance(e.value, str))
-                for name in names:
-                    prev = self._names.get(name)
-                    if prev is not None and not ctx.suppressed(
-                            self.id, call.lineno):
-                        self._dups.append(ctx.violation(
-                            self.id, call,
-                            f"op name {name!r} already registered at "
-                            f"{prev[0]}:{prev[1]} — the runtime "
-                            "registry will raise when both modules "
-                            "import."))
-                    else:
-                        self._names.setdefault(
-                            name, (ctx.relpath, call.lineno))
+                if names:
+                    line = call.lineno
+                    src = ctx.lines[line - 1].strip() \
+                        if line <= len(ctx.lines) else ""
+                    regs.append({
+                        "names": names, "line": line,
+                        "col": call.col_offset,
+                        "symbol": ctx.symbol_at(line), "src": src,
+                        "suppressed": ctx.suppressed(self.id, line)})
                 if not ast.get_docstring(node):
-                    yield ctx.violation(
+                    v = ctx.violation(
                         self.id, node,
                         f"registered op {node.name!r} has no docstring; "
                         "the op catalogue renders it — state the "
                         "semantic contract in one line.")
+                    if not ctx.suppressed(self.id, v.line):
+                        doc_violations.append({
+                            "rule": v.rule, "path": v.path,
+                            "line": v.line, "col": v.col,
+                            "message": v.message, "symbol": v.symbol,
+                            "src": v.src})
+        return {"regs": regs, "violations": doc_violations}
+
+    def absorb(self, contrib: dict, relpath: str) -> Iterable[Violation]:
+        for reg in contrib["regs"]:
+            for name in reg["names"]:
+                prev = self._names.get(name)
+                if prev is not None and not reg["suppressed"]:
+                    self._dups.append(Violation(
+                        rule=self.id, path=relpath, line=reg["line"],
+                        col=reg["col"],
+                        message=(
+                            f"op name {name!r} already registered at "
+                            f"{prev[0]}:{prev[1]} — the runtime "
+                            "registry will raise when both modules "
+                            "import."),
+                        symbol=reg["symbol"], src=reg["src"]))
+                else:
+                    self._names.setdefault(name, (relpath, reg["line"]))
+        return [Violation(**d) for d in contrib["violations"]]
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        return self.absorb(self.contribution(ctx), ctx.relpath)
 
     def finalize(self) -> Iterable[Violation]:
         return self._dups
@@ -762,6 +801,7 @@ class PerReplicaDispatch(Rule):
     code must land on the SPMD spine."""
 
     id = "MX013"
+    cacheable = "file"
     name = "per-replica-dispatch"
     description = ("Per-replica dispatch loop, or device_put without a "
                    "sharding, in Trainer/Updater/KVStore step-chain "

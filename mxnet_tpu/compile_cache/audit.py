@@ -1,0 +1,107 @@
+"""mxir runtime hook: audit every lowered program at compile time.
+
+The static rules (``mxnet_tpu.analysis.ir``, MX014–MX018) are
+stdlib-only and know nothing about the framework; this module is the
+framework-side shim that wires them into the executable caches — the
+fused optimizer step, the SpmdUpdater, the SPMDTrainer, and serving's
+per-bucket executors all funnel their compiles through
+:func:`maybe_audit`.
+
+Opt-in (``MXNET_IR_AUDIT=1``) and deliberately cheap when off: the
+disabled path is one memoized boolean check, no text materialization
+(the caches hand a *thunk* for the module text, and the thunk is only
+called when the audit runs — lowering-to-text is the expensive part).
+Violations increment ``mx_ir_violations_total{rule}`` and accumulate
+in an in-process report; ``MXNET_IR_OUT`` additionally rewrites an
+MXIR.json artifact after each audited compile.  An audit NEVER breaks
+a compile: parse failures are counted as ``parse_skipped`` and rule
+crashes are recorded as that program's ``parse_error`` — the program
+still runs; the finding channel is metrics + report.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..analysis import ir as _ir
+from ..analysis import sanitizer as _mxsan
+from ..telemetry import instruments as _ins
+from ..util import env as _env
+
+__all__ = ["enabled", "maybe_audit", "audits", "last_report", "reset"]
+
+_lock = threading.Lock()
+#: site -> newest ProgramAudit for that site (bounded: one per site)
+_AUDITS: Dict[str, "_ir.ProgramAudit"] = _mxsan.track(
+    {}, "compile_cache.audit._AUDITS", reads="unlocked-ok")
+
+
+def enabled() -> bool:
+    """Is the program auditor on?  The entire audit-off cost at every
+    hooked compile site is this one knob read."""
+    return bool(_env.get_bool("MXNET_IR_AUDIT"))
+
+
+def maybe_audit(site: str, text_fn: Callable[[], str],
+                expect_donation: bool = False
+                ) -> Optional["_ir.ProgramAudit"]:
+    """Audit one program when the auditor is on; no-op (and no text
+    materialization) when off.
+
+    ``site`` labels the compile site ("optimizer.fused_step",
+    "serving:<name>/v<n>/<bucket>", ...); ``text_fn`` returns the
+    StableHLO module text (the executable caches pass their memoizing
+    ``text()`` closure, so an already-rendered module is free);
+    ``expect_donation`` is the call site's donate decision — MX014
+    fires when it is True but the lowered module aliases nothing.
+    """
+    if not enabled():
+        return None
+    try:
+        text = text_fn()
+        module = _ir.parse_module(text)
+        violations = _ir.audit_module(
+            text, site=site, expect_donation=expect_donation,
+            repl_bytes=int(_env.get_int("MXNET_IR_REPL_BYTES") or 0),
+            module=module)
+        est = _ir.estimate_wire_bytes(module)
+        audit = _ir.ProgramAudit(
+            site=site, violations=violations,
+            wire={"total": est.total, "by_lane": est.by_lane,
+                  "legs": len(est.legs),
+                  "unknown_transitions": est.unknown_transitions})
+    except _ir.IrParseError as e:
+        audit = _ir.ProgramAudit(site=site, parse_error=str(e))
+    except Exception as e:  # noqa: BLE001 — audits never break compiles
+        audit = _ir.ProgramAudit(
+            site=site, parse_error=f"{type(e).__name__}: {e}")
+    for v in audit.violations:
+        _ins.ir_violations_total(v.rule).inc()
+    with _lock:
+        _AUDITS[site] = audit
+    out = _env.get_str("MXNET_IR_OUT") or ""
+    if out:
+        try:
+            with open(out, "w", encoding="utf-8") as f:
+                json.dump(last_report(), f, indent=1, sort_keys=True)
+                f.write("\n")
+        except OSError:
+            pass  # a broken artifact path must not break the compile
+    return audit
+
+
+def audits() -> List["_ir.ProgramAudit"]:
+    """Snapshot of the newest audit per site (sorted by site)."""
+    with _lock:
+        return [_AUDITS[k] for k in sorted(_AUDITS)]
+
+
+def last_report() -> dict:
+    """The cumulative MXIR.json document for this process."""
+    return _ir.render_ir_json(audits())
+
+
+def reset() -> None:
+    with _lock:
+        _AUDITS.clear()

@@ -27,6 +27,8 @@ def _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight):
 @register_op("sgd_update", num_outputs=1, mutate_inputs=(0,))
 def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                 clip_gradient=-1.0, lazy_update=True):
+    """Vanilla SGD step: w -= lr * (rescaled, clipped grad
+    + wd * w)."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
     return weight - lr * g
 
@@ -34,6 +36,8 @@ def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
 @register_op("sgd_mom_update", num_outputs=2, mutate_inputs=(0, 2))
 def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    """SGD with momentum: mom = momentum*mom - lr*g;
+    w += mom.  Returns (new_weight, new_mom)."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
     new_mom = momentum * mom - lr * g
     return weight + new_mom, new_mom
@@ -42,6 +46,8 @@ def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
 @register_op("nag_mom_update", num_outputs=2, mutate_inputs=(0, 2))
 def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0):
+    """Nesterov accelerated gradient: momentum update with the
+    gradient looked ahead one step.  Returns (new_weight, new_mom)."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
     new_mom = momentum * mom + g
     return weight - lr * (g + momentum * new_mom), new_mom
@@ -51,6 +57,9 @@ def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
 def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                  lazy_update=True):
+    """Adam step (no bias correction, reference convention):
+    first/second-moment EMAs drive w -= lr * m / (sqrt(v) + eps).
+    Returns (new_weight, new_mean, new_var)."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
     new_mean = beta1 * mean + (1 - beta1) * g
     new_var = beta2 * var + (1 - beta2) * jnp.square(g)
@@ -62,6 +71,9 @@ def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
 def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
                     wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                     clip_weights=-1.0):
+    """RMSProp: EMA of squared gradients normalizes the step;
+    optional clip_weights bounds the result.  Returns (new_weight,
+    new_n)."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
     new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
     w = weight - lr * g / jnp.sqrt(new_n + epsilon)
@@ -74,6 +86,9 @@ def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
 def _rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
                         gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                         clip_gradient=-1.0, clip_weights=-1.0):
+    """RMSProp (Graves variant): centered second moment plus a
+    momentum-like delta accumulator.  Returns (new_weight, new_n,
+    new_g, new_delta)."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
     new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
     new_g = (1 - gamma1) * g + gamma1 * g_state
@@ -87,6 +102,9 @@ def _rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
 @register_op("ftrl_update", num_outputs=3, mutate_inputs=(0, 2, 3))
 def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
                  rescale_grad=1.0, clip_gradient=-1.0):
+    """FTRL-proximal: z/n accumulators with L1 soft-thresholding
+    (lamda1) and per-coordinate lr.  Returns (new_weight, new_z,
+    new_n)."""
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
@@ -103,6 +121,8 @@ def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
 @register_op("signsgd_update", num_outputs=1, mutate_inputs=(0,))
 def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                     clip_gradient=-1.0):
+    """SignSGD: steps by the SIGN of the rescaled gradient only;
+    wd decays the weight directly."""
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
@@ -112,6 +132,8 @@ def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
 @register_op("signum_update", num_outputs=2, mutate_inputs=(0, 2))
 def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    """Signum: momentum EMA of the gradient, step by its sign
+    (SignSGD with momentum).  Returns (new_weight, new_mom)."""
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
@@ -124,6 +146,8 @@ def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
              aliases=("_sparse_adagrad_update",))
 def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0):
+    """AdaGrad: accumulated squared gradients give per-coordinate
+    lr decay.  Returns (new_weight, new_history)."""
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
@@ -134,6 +158,9 @@ def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
 @register_op("adadelta_update", num_outputs=3, mutate_inputs=(0, 2, 3))
 def _adadelta_update(weight, grad, acc_g, acc_delta, lr=1.0, rho=0.9,
                      epsilon=1e-5, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """AdaDelta: RMS-ratio of accumulated delta to accumulated
+    gradient replaces the global lr.  Returns (new_weight, new_acc_g,
+    new_acc_delta)."""
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
@@ -148,6 +175,8 @@ def _adadelta_update(weight, grad, acc_g, acc_delta, lr=1.0, rho=0.9,
 def _adamax_update(weight, grad, mean, var, lr=0.002, beta1=0.9, beta2=0.999,
                    epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                    t=1):
+    """AdaMax: Adam with the infinity norm as the second moment
+    (running max of |g|).  Returns (new_weight, new_mean, new_var)."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
     new_mean = beta1 * mean + (1 - beta1) * g
     new_var = jnp.maximum(beta2 * var, jnp.abs(g))
@@ -159,6 +188,8 @@ def _adamax_update(weight, grad, mean, var, lr=0.002, beta1=0.9, beta2=0.999,
 def _nadam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
                   epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                   t=1, schedule_decay=0.004):
+    """Nadam: Adam with Nesterov momentum via the schedule-decay
+    momentum correction.  Returns (new_weight, new_mean, new_var)."""
     g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
     m_t = beta1 * (1 - 0.5 * 0.96 ** (t * schedule_decay))
     m_t1 = beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * schedule_decay))
@@ -177,6 +208,9 @@ def _nadam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
 @register_op("mp_sgd_update", num_outputs=2, mutate_inputs=(0, 2))
 def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0, lazy_update=True):
+    """Multi-precision SGD: updates the fp32 master copy and
+    casts back to the low-precision weight dtype.  Returns
+    (new_weight, new_weight32)."""
     g = _rescale_clip(grad.astype(jnp.float32), rescale_grad, clip_gradient,
                       wd, weight32)
     new_w32 = weight32 - lr * g
@@ -187,6 +221,9 @@ def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
 def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
                        wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                        lazy_update=True):
+    """Multi-precision SGD with momentum: fp32 master-copy math,
+    low-precision weight output.  Returns (new_weight, new_mom,
+    new_weight32)."""
     g = _rescale_clip(grad.astype(jnp.float32), rescale_grad, clip_gradient,
                       wd, weight32)
     new_mom = momentum * mom - lr * g
@@ -198,6 +235,9 @@ def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
 def _mp_adam_update(weight, grad, mean, var, weight32, lr=0.001, beta1=0.9,
                     beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                     clip_gradient=-1.0):
+    """Multi-precision Adam: fp32 master-copy moments and update,
+    cast back to the weight dtype.  Returns (new_weight, new_mean,
+    new_var, new_weight32)."""
     g = _rescale_clip(grad.astype(jnp.float32), rescale_grad, clip_gradient,
                       wd, weight32)
     new_mean = beta1 * mean + (1 - beta1) * g
@@ -227,6 +267,8 @@ def _chunk(arrays, n, per):
 @register_op("multi_sum_sq", differentiable=False,
              num_outputs=lambda attrs: int(attrs.get("num_arrays", 1)))
 def _multi_sum_sq(*arrays, num_arrays=1):
+    """Per-array sum of squares in fp32 (the LARS norm inputs);
+    one (1,)-shaped output per input array."""
     return tuple(jnp.sum(jnp.square(a.astype(jnp.float32))).reshape((1,))
                  for a in arrays)
 
@@ -235,6 +277,8 @@ def _multi_sum_sq(*arrays, num_arrays=1):
              num_outputs=lambda attrs: int(attrs.get("num_weights", 1)))
 def _multi_sgd_update(*arrays, lrs=(), wds=(), rescale_grad=1.0,
                       clip_gradient=-1.0, num_weights=1):
+    """Fused SGD over many weights in one launch: interleaved
+    [w0, g0, w1, g1, ...] inputs, per-weight lrs/wds attrs."""
     outs = []
     for i, (w, g) in enumerate(_chunk(arrays, num_weights, 2)):
         gg = _rescale_clip(g, rescale_grad, clip_gradient, wds[i], w)
@@ -247,6 +291,8 @@ def _multi_sgd_update(*arrays, lrs=(), wds=(), rescale_grad=1.0,
 def _multi_sgd_mom_update(*arrays, lrs=(), wds=(), momentum=0.0,
                           rescale_grad=1.0, clip_gradient=-1.0,
                           num_weights=1):
+    """Fused momentum-SGD over many weights in one launch:
+    interleaved [w, g, mom] triples, per-weight lrs/wds attrs."""
     outs = []
     for i, (w, g, m) in enumerate(_chunk(arrays, num_weights, 3)):
         gg = _rescale_clip(g, rescale_grad, clip_gradient, wds[i], w)
@@ -259,6 +305,8 @@ def _multi_sgd_mom_update(*arrays, lrs=(), wds=(), momentum=0.0,
              num_outputs=lambda attrs: int(attrs.get("num_weights", 1)))
 def _multi_mp_sgd_update(*arrays, lrs=(), wds=(), rescale_grad=1.0,
                          clip_gradient=-1.0, num_weights=1):
+    """Fused multi-precision SGD: interleaved [w, g, w32]
+    triples, fp32 master-copy math, per-weight lrs/wds attrs."""
     outs = []
     for i, (w, g, w32) in enumerate(_chunk(arrays, num_weights, 3)):
         gg = _rescale_clip(g.astype(jnp.float32), rescale_grad,
@@ -272,6 +320,9 @@ def _multi_mp_sgd_update(*arrays, lrs=(), wds=(), rescale_grad=1.0,
 def _multi_mp_sgd_mom_update(*arrays, lrs=(), wds=(), momentum=0.0,
                              rescale_grad=1.0, clip_gradient=-1.0,
                              num_weights=1):
+    """Fused multi-precision momentum-SGD: interleaved
+    [w, g, mom, w32] quads, fp32 master-copy math, per-weight
+    lrs/wds attrs."""
     outs = []
     for i, (w, g, m, w32) in enumerate(_chunk(arrays, num_weights, 4)):
         gg = _rescale_clip(g.astype(jnp.float32), rescale_grad,

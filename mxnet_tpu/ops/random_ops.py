@@ -18,37 +18,48 @@ def _dt(dtype):
 
 @register_op("_random_uniform", differentiable=False, aliases=("random_uniform",))
 def _uniform(key, low=0.0, high=1.0, shape=(), dtype="float32"):
+    """Draw `shape` samples uniformly from the half-open interval
+    [low, high)."""
     return jax.random.uniform(key, tuple(shape), _dt(dtype), low, high)
 
 
 @register_op("_random_normal", differentiable=False,
              aliases=("random_normal", "normal_op"))
 def _normal(key, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    """Draw `shape` samples from the normal distribution
+    N(loc, scale^2)."""
     return loc + scale * jax.random.normal(key, tuple(shape), _dt(dtype))
 
 
 @register_op("_random_randint", differentiable=False)
 def _randint(key, low=0, high=1, shape=(), dtype="int32"):
+    """Draw `shape` integers uniformly from [low, high)."""
     return jax.random.randint(key, tuple(shape), low, high, _dt(dtype))
 
 
 @register_op("_random_gamma", differentiable=False)
 def _gamma(key, alpha=1.0, beta=1.0, shape=(), dtype="float32"):
+    """Draw `shape` samples from Gamma(alpha) scaled by `beta`
+    (beta is the scale parameter, reference convention)."""
     return jax.random.gamma(key, alpha, tuple(shape), _dt(dtype)) * beta
 
 
 @register_op("_random_exponential", differentiable=False)
 def _exponential(key, lam=1.0, shape=(), dtype="float32"):
+    """Draw `shape` samples from Exponential(lam) (rate
+    parameterization: mean 1/lam)."""
     return jax.random.exponential(key, tuple(shape), _dt(dtype)) / lam
 
 
 @register_op("_random_poisson", differentiable=False)
 def _poisson(key, lam=1.0, shape=(), dtype="float32"):
+    """Draw `shape` samples from Poisson(lam), cast to `dtype`."""
     return jax.random.poisson(key, lam, tuple(shape)).astype(_dt(dtype))
 
 
 @register_op("_random_bernoulli", differentiable=False)
 def _bernoulli(key, p=0.5, shape=(), dtype="float32"):
+    """Draw `shape` Bernoulli(p) trials as 0/1 values in `dtype`."""
     return jax.random.bernoulli(key, p, tuple(shape)).astype(_dt(dtype))
 
 
@@ -59,6 +70,9 @@ def _multinomial_nout(attrs):
 @register_op("_sample_multinomial", differentiable=False,
              num_outputs=_multinomial_nout)
 def _multinomial(key, data, shape=(), get_prob=False, dtype="int32"):
+    """Sample category indices from the (unnormalized) distribution(s)
+    in `data`; with get_prob=True also return the log-probability of
+    each draw (the REINFORCE use case)."""
     logits = jnp.log(jnp.maximum(data, 1e-30))
     n = int(shape[0]) if shape else 1
     if data.ndim == 1:
@@ -83,21 +97,26 @@ def _multinomial(key, data, shape=(), get_prob=False, dtype="int32"):
 
 @register_op("_shuffle", differentiable=False, aliases=("shuffle",))
 def _shuffle(key, data):
+    """Randomly permute `data` along its first axis."""
     return jax.random.permutation(key, data, axis=0)
 
 
 @register_op("_random_gumbel", differentiable=False)
 def _gumbel(key, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    """Draw `shape` samples from Gumbel(loc, scale)."""
     return loc + scale * jax.random.gumbel(key, tuple(shape), _dt(dtype))
 
 
 @register_op("_random_laplace", differentiable=False)
 def _laplace(key, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    """Draw `shape` samples from Laplace(loc, scale)."""
     return loc + scale * jax.random.laplace(key, tuple(shape), _dt(dtype))
 
 
 @register_op("_random_negative_binomial", differentiable=False)
 def _neg_binomial(key, k=1, p=1.0, shape=(), dtype="float32"):
+    """Draw `shape` samples from NegativeBinomial(k, p) via the
+    Gamma–Poisson mixture."""
     k1, k2 = jax.random.split(key)
     lam = jax.random.gamma(k1, k, tuple(shape)) * (1 - p) / p
     return jax.random.poisson(k2, lam, tuple(shape)).astype(_dt(dtype))
@@ -126,6 +145,8 @@ def _multisample(key, shape, dtype, draw, *params):
 @register_op("_sample_uniform", differentiable=False,
              aliases=("sample_uniform",))
 def _sample_uniform(key, low, high, shape=(), dtype="float32"):
+    """Per-row uniform draws: each (low[i], high[i]) pair yields
+    `shape` samples; output shape is low.shape + shape."""
     return _multisample(
         key, shape, dtype,
         lambda k, s, lo, hi: jax.random.uniform(k, s, jnp.float32, lo, hi),
@@ -135,6 +156,8 @@ def _sample_uniform(key, low, high, shape=(), dtype="float32"):
 @register_op("_sample_normal", differentiable=False,
              aliases=("sample_normal",))
 def _sample_normal(key, mu, sigma, shape=(), dtype="float32"):
+    """Per-row normal draws: each (mu[i], sigma[i]) pair yields
+    `shape` samples; output shape is mu.shape + shape."""
     return _multisample(
         key, shape, dtype,
         lambda k, s, m, sd: m + sd * jax.random.normal(k, s),
@@ -144,7 +167,8 @@ def _sample_normal(key, mu, sigma, shape=(), dtype="float32"):
 @register_op("_sample_gamma", differentiable=False,
              aliases=("sample_gamma",))
 def _sample_gamma(key, alpha, beta, shape=(), dtype="float32"):
-    # beta is the SCALE parameter (reference convention)
+    """Per-row gamma draws from Gamma(alpha[i]) scaled by beta[i]
+    (beta is the SCALE parameter, reference convention)."""
     return _multisample(
         key, shape, dtype,
         lambda k, s, a, b: b * jax.random.gamma(k, a, s),
@@ -154,6 +178,8 @@ def _sample_gamma(key, alpha, beta, shape=(), dtype="float32"):
 @register_op("_sample_exponential", differentiable=False,
              aliases=("sample_exponential",))
 def _sample_exponential(key, lam, shape=(), dtype="float32"):
+    """Per-row exponential draws with rate lam[i]; output shape is
+    lam.shape + shape."""
     return _multisample(
         key, shape, dtype,
         lambda k, s, l: jax.random.exponential(k, s) / l, lam)
@@ -162,6 +188,8 @@ def _sample_exponential(key, lam, shape=(), dtype="float32"):
 @register_op("_sample_poisson", differentiable=False,
              aliases=("sample_poisson",))
 def _sample_poisson(key, lam, shape=(), dtype="float32"):
+    """Per-row Poisson draws with rate lam[i]; output shape is
+    lam.shape + shape."""
     return _multisample(
         key, shape, dtype,
         lambda k, s, l: jax.random.poisson(k, l, s).astype(jnp.float32),
@@ -171,7 +199,8 @@ def _sample_poisson(key, lam, shape=(), dtype="float32"):
 @register_op("_sample_negative_binomial", differentiable=False,
              aliases=("sample_negative_binomial",))
 def _sample_negative_binomial(key, k, p, shape=(), dtype="float32"):
-    # NB(k, p) = Poisson(lambda), lambda ~ Gamma(k, (1-p)/p)
+    """Per-row negative-binomial draws: NB(k[i], p[i]) =
+    Poisson(lambda), lambda ~ Gamma(k, (1-p)/p)."""
     def draw(kk, s, kv, pv):
         k1, k2 = jax.random.split(kk)
         lam = jax.random.gamma(k1, kv, s) * (1.0 - pv) / pv
@@ -185,7 +214,8 @@ def _sample_negative_binomial(key, k, p, shape=(), dtype="float32"):
              aliases=("sample_generalized_negative_binomial",))
 def _sample_gen_negative_binomial(key, mu, alpha, shape=(),
                                   dtype="float32"):
-    # GNB(mu, alpha): Poisson with Gamma(1/alpha, mu*alpha) mixed rate
+    """Per-row generalized-negative-binomial draws: GNB(mu, alpha) is
+    Poisson with Gamma(1/alpha, mu*alpha) mixed rate."""
     def draw(kk, s, m, a):
         k1, k2 = jax.random.split(kk)
         lam = jax.random.gamma(k1, 1.0 / a, s) * m * a
@@ -211,6 +241,8 @@ def _pdf(logpdf, sample, params, is_log):
 
 @register_op("_random_pdf_uniform", aliases=("random_pdf_uniform",))
 def _pdf_uniform(sample, low, high, is_log=False):
+    """Density of `sample` under Uniform(low, high), row-wise
+    parameters; is_log=True returns the log-density."""
     from jax.scipy.stats import uniform as U
 
     return _pdf(lambda x, lo, hi: U.logpdf(x, lo, hi - lo), sample,
@@ -219,6 +251,8 @@ def _pdf_uniform(sample, low, high, is_log=False):
 
 @register_op("_random_pdf_normal", aliases=("random_pdf_normal",))
 def _pdf_normal(sample, mu, sigma, is_log=False):
+    """Density of `sample` under N(mu, sigma^2), row-wise parameters;
+    is_log=True returns the log-density."""
     from jax.scipy.stats import norm
 
     return _pdf(norm.logpdf, sample, (mu, sigma), is_log)
@@ -226,6 +260,8 @@ def _pdf_normal(sample, mu, sigma, is_log=False):
 
 @register_op("_random_pdf_gamma", aliases=("random_pdf_gamma",))
 def _pdf_gamma(sample, alpha, beta, is_log=False):
+    """Density of `sample` under Gamma(alpha, scale=beta), row-wise
+    parameters; is_log=True returns the log-density."""
     from jax.scipy.stats import gamma
 
     return _pdf(lambda x, a, b: gamma.logpdf(x, a, scale=b), sample,
@@ -234,6 +270,8 @@ def _pdf_gamma(sample, alpha, beta, is_log=False):
 
 @register_op("_random_pdf_exponential", aliases=("random_pdf_exponential",))
 def _pdf_exponential(sample, lam, is_log=False):
+    """Density of `sample` under Exponential(lam) (rate
+    parameterization); is_log=True returns the log-density."""
     from jax.scipy.stats import expon
 
     return _pdf(lambda x, l: expon.logpdf(x, scale=1.0 / l), sample,
@@ -242,6 +280,8 @@ def _pdf_exponential(sample, lam, is_log=False):
 
 @register_op("_random_pdf_poisson", aliases=("random_pdf_poisson",))
 def _pdf_poisson(sample, lam, is_log=False):
+    """Probability mass of `sample` under Poisson(lam), row-wise
+    parameters; is_log=True returns the log-mass."""
     from jax.scipy.stats import poisson
 
     return _pdf(lambda x, l: poisson.logpmf(x, l), sample, (lam,),
@@ -250,6 +290,8 @@ def _pdf_poisson(sample, lam, is_log=False):
 
 @register_op("_random_pdf_negative_binomial", aliases=("random_pdf_negative_binomial",))
 def _pdf_negative_binomial(sample, k, p, is_log=False):
+    """Probability mass of `sample` under NegativeBinomial(k, p),
+    row-wise parameters; is_log=True returns the log-mass."""
     from jax.scipy.stats import nbinom
 
     return _pdf(lambda x, kv, pv: nbinom.logpmf(x, kv, pv), sample,

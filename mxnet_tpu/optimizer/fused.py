@@ -51,6 +51,7 @@ from ..telemetry import tracing as _tracing
 from ..telemetry.mxprof import costs as _costs
 from ..util import env as _env
 from .. import compile_cache as _cc
+from ..compile_cache import audit as _ir_audit
 from .optimizer import Optimizer, Updater
 
 __all__ = ["FusedUpdater", "FusedUnsupported", "ExecutableCache",
@@ -144,7 +145,7 @@ class ExecutableCache:
                     "evictions": self.evictions, "size": len(self.data)}
 
     def compile(self, sig, build_lowered, optimizer, alias_ok=True,
-                components=None):
+                components=None, donate=False):
         """Build (or load from the persistent store) the executable for
         ``sig``; insert, LRU-evict past MXNET_FUSED_CACHE_MAX, count.
         ``alias_ok=False`` forces the program-text key even for
@@ -153,7 +154,10 @@ class ExecutableCache:
         framework version cannot pin.  ``components`` is the NAMED view
         of ``sig`` for compile provenance — with the persistent cache
         off (the default), the provenance diff is recorded here, since
-        reaching this method already means the site cache missed."""
+        reaching this method already means the site cache missed.
+        ``donate`` is the call site's donation decision, forwarded to
+        the mxir program auditor so MX014 can verify the lowered
+        module actually aliases something."""
         t0 = time.perf_counter()
         cell = {}
 
@@ -184,6 +188,11 @@ class ExecutableCache:
             _prov.record_miss(self.site, _cc.cache_key(
                 self.site, parts=(sig,), components=components))
             compiled, origin = build_lowered().compile(), "compiled"
+        # mxir program audit (MXNET_IR_AUDIT=1): one boolean check when
+        # off; when on, reuses the memoized text() render.  Runs for
+        # cache loads too — a disk-loaded executable is still this
+        # process's step program and its invariants still hold or not.
+        _ir_audit.maybe_audit(self.site, text, expect_donation=donate)
         dt = time.perf_counter() - t0
         # static cost analysis for MFU accounting — computed on the
         # executable object, so a persistent-cache load (origin
@@ -511,4 +520,4 @@ class FusedUpdater(Updater):
                       "treedef": sig[6], "avals": sig[7],
                       "wire_encoding": _comm.config().mode}
         return _FUSED_CACHE.compile(sig, build_lowered, self.optimizer,
-                                    components=components)
+                                    components=components, donate=donate)

@@ -60,6 +60,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..analysis import sanitizer as _mxsan
 from ..ndarray.ndarray import NDArray
 from ..resilience import chaos as _chaos
 from ..telemetry import instruments as _ins
@@ -219,7 +220,10 @@ class SpmdUpdater(Updater):
         # pending from set_states, and the overlap-mode stage programs
         self._quant = _comm.config()
         self._overlap = _env.get_bool("MXNET_COMM_OVERLAP")
-        self._qstate: Dict[int, Tuple] = {}  # bucket ordinal -> (g, w)
+        # mxsan: updater-thread state, but checkpoint get/set_states
+        # may read it cross-thread — Eraser proves the discipline
+        self._qstate: Dict[int, Tuple] = _mxsan.track(
+            {}, "optimizer.spmd._qstate")  # bucket ordinal -> (g, w)
         self._pending_q: Optional[Dict[str, Any]] = None
         self._overlap_fns = {}       # sig -> (bucket reduce fns, tail)
         # steady-state caches: the signature (treedef/avals never
@@ -974,7 +978,7 @@ class SpmdUpdater(Updater):
                       "devices": sig[9], "treedef": sig[10],
                       "avals": sig[11], "quant": sig[12]}
         return _SPMD_CACHE.compile(sig, build_lowered, self.optimizer,
-                                   components=components)
+                                   components=components, donate=donate)
 
     # ---- phased variant (tracing only) -----------------------------------
     def _run_phased(self, sig, args, mp_flags, metas, qbis=()):

@@ -580,7 +580,8 @@ class SPMDTrainer:
                 fn = _STEP_CACHE.compile(sig, build_lowered,
                                          self._optimizer,
                                          alias_ok=False,
-                                         components=components)
+                                         components=components,
+                                         donate=self._donate)
             # per-trainer fast path keyed by input avals: a batch-shape
             # change rebuilds (AOT does not silently retrace), a repeat
             # shape is one dict hit.  The executable's static cost

@@ -28,6 +28,7 @@ from ..telemetry import instruments as _ins
 from ..telemetry import tracing as _tracing
 from ..telemetry.mxprof import costs as _costs
 from .. import compile_cache as _cc
+from ..compile_cache import audit as _ir_audit
 from . import ModelNotFound, ServingError
 from .metrics import ModelMetrics
 
@@ -322,8 +323,21 @@ class _ModelEntry:
                     p_structs, key_struct, *in_structs)
             return lowered
 
+        def text():
+            t = cell.get("text")
+            if t is None:
+                t = cell["text"] = build_lowered().as_text()
+            return t
+
         def compile_fn():
             return build_lowered().compile()
+
+        # mxir program audit (MXNET_IR_AUDIT=1): serving programs are
+        # inference-only — donation is never declared here, so MX014
+        # stays quiet and the audit watches for replication, precision,
+        # collective, and host-transfer hazards in the served program
+        _ir_audit.maybe_audit(
+            f"serving:{self.name}/v{self.version}/b{bucket}", text)
 
         # the named identity view compile provenance diffs a miss
         # against — which of program / bucket / avals / params changed.
@@ -364,7 +378,7 @@ class _ModelEntry:
             return _cc.cache_key(
                 "serving.bucket",
                 parts=(bucket, in_avals, p_avals),
-                program_text=build_lowered().as_text(),
+                program_text=text(),
                 components=components)
 
         return _cc.get_or_compile(

@@ -58,7 +58,7 @@ __all__ = [
     "serving_counter", "serving_queue_depth", "serving_occupancy",
     "serving_request_latency", "serving_compile_total",
     "serving_compile_seconds",
-    "san_violations_total",
+    "san_violations_total", "ir_violations_total",
     "specs", "refresh_process_gauges",
 ]
 
@@ -675,6 +675,19 @@ _spec("mx_san_violations_total", "counter",
 
 def san_violations_total(kind: str):
     return _child("mx_san_violations_total", (kind,))
+
+
+_spec("mx_ir_violations_total", "counter",
+      "mxir StableHLO program-audit violations by rule (MX014 "
+      "donation-dropped, MX015 oversized-replicated, MX016 "
+      "precision-leak, MX017 collective-audit, MX018 host-transfer), "
+      "counted at executable-cache compile time under "
+      "MXNET_IR_AUDIT=1. Any non-zero value is a finding — alert on "
+      "it.", ("rule",))
+
+
+def ir_violations_total(rule: str):
+    return _child("mx_ir_violations_total", (rule,))
 
 
 # ---- serving ----------------------------------------------------------

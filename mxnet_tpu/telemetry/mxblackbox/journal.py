@@ -35,6 +35,8 @@ import threading
 import time
 from typing import List, Optional
 
+from ...analysis import sanitizer as _mxsan
+
 __all__ = ["EventJournal"]
 
 
@@ -68,7 +70,12 @@ class EventJournal:
         self._who = who
         self._rank = rank
         self._gen = gen
-        self._ring: "deque[dict]" = deque(maxlen=max(16, int(ring)))
+        # mxsan: every post-publish access holds self._lock (emit,
+        # tail, __len__); the pre-publish carry-over appends in the
+        # package __init__ run while the journal is still exclusive
+        self._ring: "deque[dict]" = _mxsan.track(
+            deque(maxlen=max(16, int(ring))),
+            "telemetry.mxblackbox.journal._ring")
         self._spill_max = max(64 * 1024, int(spill_max_bytes))
         # a LEAF lock, deliberately non-reentrant: nothing called under
         # it may emit (the signal-safety test pins this type)

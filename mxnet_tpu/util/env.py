@@ -640,6 +640,30 @@ declare("MXNET_HEALTH_SPIKE_K", float, 8.0,
 declare("MXNET_HEALTH_WINDOW", int, 64,
         "Window (samples) of the rolling median/MAD spike detectors "
         "for loss and grad-norm.")
+declare("MXNET_IR_AUDIT", bool, False,
+        "Enable mxir, the StableHLO program auditor, at every "
+        "executable-cache compile (fused step, SpmdUpdater, "
+        "SPMDTrainer, serving buckets): rules MX014-MX018 run over "
+        "the lowered module text and violations increment "
+        "mx_ir_violations_total{rule}. Opt-in; audit-off overhead is "
+        "one boolean check per compile. See docs/static_analysis.md "
+        "(Program audits).")
+declare("MXNET_IR_OUT", str, "",
+        "When set (and MXNET_IR_AUDIT is on), path the runtime audit "
+        "hook rewrites with the cumulative MXIR.json report after "
+        "each audited compile.")
+declare("MXNET_IR_REPL_BYTES", int, 64 << 20,
+        "MX015 threshold in bytes: a tensor at least this large "
+        "pinned or returned REPLICATED in a multi-partition program "
+        "is an oversized-replicated violation (every device "
+        "materializes the full value - the PR 18 gather-replication "
+        "bug class).")
+declare("MXNET_IR_WIRE_TOL", float, 0.25,
+        "MX017 drift tolerance: relative disagreement allowed between "
+        "the static per-program wire-bytes model and the measured "
+        "mx_collective_wire_bytes_total lane before the drift itself "
+        "becomes a violation. The default absorbs the ~0.8% "
+        "quant-scale overhead the static model does not price.")
 declare("MXNET_PROFILER_AUTOSTART", bool, False,
         "Start the chrome-trace profiler at import (ref: "
         "MXNET_PROFILER_AUTOSTART).")

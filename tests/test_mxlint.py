@@ -408,3 +408,161 @@ class TestCLISmoke:
                        for v in report["new"])
         finally:
             os.unlink(tmp)
+
+
+class TestIncrementalCache:
+    """ISSUE 19 satellite: findings cache keyed on (content sha256,
+    rules-version).  The invariant everything rests on: a warm run is
+    finding-identical to a cold run — including MX006's cross-file
+    duplicate detection, which replays per-file contributions instead
+    of per-file findings."""
+
+    def _tree(self, tmp_path):
+        (tmp_path / "a.py").write_text(
+            "import jax\n\n"
+            "from mxnet_tpu.ops.registry import register_op\n\n\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return int(x) + 1\n\n\n"
+            "@register_op(\"dup_op\")\n"
+            "def _dup1(a):\n"
+            "    return a\n")
+        (tmp_path / "b.py").write_text(
+            "from mxnet_tpu.ops.registry import register_op\n\n\n"
+            "@register_op(\"dup_op\")\n"
+            "def _dup2(a):\n"
+            "    \"\"\"Doc.\"\"\"\n"
+            "    return a\n")
+        return str(tmp_path), str(tmp_path / "cache.json")
+
+    def test_cold_warm_parity(self, tmp_path):
+        root, cache = self._tree(tmp_path)
+        cold_eng = analysis.LintEngine(root=root)
+        cold = cold_eng.run([root], cache_path=cache)
+        assert cold_eng.cache_misses == 2 and cold_eng.cache_hits == 0
+        # the synthetic tree must exercise a "file" rule, a per-file
+        # MX006 finding, AND the cross-file MX006 dup
+        assert {v.rule for v in cold} >= {"MX001", "MX006"}
+        assert any("already registered" in v.message for v in cold)
+        warm_eng = analysis.LintEngine(root=root)
+        warm = warm_eng.run([root], cache_path=cache)
+        assert warm_eng.cache_hits == 2 and warm_eng.cache_misses == 0
+        assert warm == cold
+
+    def test_edit_invalidates_only_that_file(self, tmp_path):
+        root, cache = self._tree(tmp_path)
+        analysis.LintEngine(root=root).run([root], cache_path=cache)
+        (tmp_path / "b.py").write_text(
+            "from mxnet_tpu.ops.registry import register_op\n\n\n"
+            "@register_op(\"other_op\")\n"
+            "def _dup2(a):\n"
+            "    \"\"\"Doc.\"\"\"\n"
+            "    return a\n")
+        eng = analysis.LintEngine(root=root)
+        vs = eng.run([root], cache_path=cache)
+        assert eng.cache_hits == 1 and eng.cache_misses == 1
+        assert not any("already registered" in v.message for v in vs)
+
+    def test_rules_version_change_invalidates_everything(self, tmp_path):
+        root, cache = self._tree(tmp_path)
+        analysis.LintEngine(root=root).run([root], cache_path=cache)
+        with open(cache) as f:
+            doc = json.load(f)
+        doc["rules_version"] = "0" * 64
+        with open(cache, "w") as f:
+            json.dump(doc, f)
+        eng = analysis.LintEngine(root=root)
+        eng.run([root], cache_path=cache)
+        assert eng.cache_misses == 2 and eng.cache_hits == 0
+
+    def test_corrupt_cache_is_a_cold_run_not_an_error(self, tmp_path):
+        root, cache = self._tree(tmp_path)
+        with open(cache, "w") as f:
+            f.write("{not json")
+        eng = analysis.LintEngine(root=root)
+        vs = eng.run([root], cache_path=cache)
+        assert eng.cache_misses == 2 and vs
+        with open(cache) as f:
+            assert json.load(f)["version"] == 1  # rewritten valid
+
+    def test_no_cache_path_writes_nothing(self, tmp_path):
+        root, cache = self._tree(tmp_path)
+        analysis.LintEngine(root=root).run([root])
+        assert not os.path.exists(cache)
+
+    def test_narrower_enable_entry_does_not_serve_wider_run(self,
+                                                            tmp_path):
+        # an entry written by --enable=MX001 lacks the other cacheable
+        # rules' findings; a full run must treat it as a miss, never
+        # silently drop findings
+        root, cache = self._tree(tmp_path)
+        analysis.LintEngine(root=root, enable=["MX001"]).run(
+            [root], cache_path=cache)
+        eng = analysis.LintEngine(root=root)
+        vs = eng.run([root], cache_path=cache)
+        assert eng.cache_misses == 2
+        assert any(v.rule == "MX006" for v in vs)
+
+    def test_cli_cache_flags(self, tmp_path):
+        import subprocess
+        import sys
+
+        root, _ = self._tree(tmp_path)
+        cache = str(tmp_path / "cli_cache.json")
+        cli = [sys.executable, os.path.join(_REPO, "tools", "mxlint.py"),
+               root, "--json", "--cache-file", cache]
+        runs = []
+        for extra in ([], [], ["--no-cache"]):
+            p = subprocess.run(cli + extra, capture_output=True,
+                               text=True, timeout=60)
+            runs.append(json.loads(p.stdout))
+        cold, warm, off = runs
+        assert cold["cache"] == {"enabled": True, "hits": 0, "misses": 2}
+        assert warm["cache"] == {"enabled": True, "hits": 2, "misses": 0}
+        assert off["cache"]["enabled"] is False
+        assert cold["new"] == warm["new"] == off["new"]
+
+
+class TestLintDocsSync:
+    """tools/gen_lint_docs.py: the rule catalogue table in
+    docs/static_analysis.md is generated from RULE_REGISTRY and must
+    not drift (the registry-then-docs contract gen_metric_docs keeps
+    for metrics and --env-docs keeps for knobs)."""
+
+    def _mod(self):
+        import importlib.util
+        path = os.path.join(_REPO, "tools", "gen_lint_docs.py")
+        spec = importlib.util.spec_from_file_location("gen_lint_docs",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_catalog_in_sync(self):
+        mod = self._mod()
+        ok, table = mod.apply_block(
+            os.path.join(_REPO, "docs", "static_analysis.md"),
+            write=False)
+        assert ok, ("lint rule catalogue out of sync — run "
+                    "`python tools/gen_lint_docs.py --write`")
+        # every registered rule has a row
+        for rid in analysis.RULE_REGISTRY:
+            assert f"| {rid} |" in table
+
+    def test_check_mode_detects_drift(self, tmp_path):
+        mod = self._mod()
+        doc = tmp_path / "doc.md"
+        doc.write_text("x\n" + mod._BEGIN + "\nstale\n" + mod._END
+                       + "\ny\n")
+        ok, _ = mod.apply_block(str(doc), write=False)
+        assert not ok
+        ok, _ = mod.apply_block(str(doc), write=True)
+        ok2, _ = mod.apply_block(str(doc), write=False)
+        assert ok2
+
+    def test_missing_markers_is_an_error(self, tmp_path):
+        mod = self._mod()
+        doc = tmp_path / "doc.md"
+        doc.write_text("no markers here\n")
+        with pytest.raises(ValueError):
+            mod.apply_block(str(doc), write=False)

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Regenerate (or verify) the lint rule catalogue in
+docs/static_analysis.md.
+
+Every rule is DECLARED once in the analysis package (``@register_rule``
+sets id/name/description on the class); the table between the
+``lint-rule-catalog`` markers is GENERATED from that registry — the
+same registry-then-docs contract `tools/gen_metric_docs.py` keeps for
+the metric catalogue and `tools/mxlint.py --env-docs` keeps for the
+knob registry.
+
+    python tools/gen_lint_docs.py           # check (exit 1 on drift)
+    python tools/gen_lint_docs.py --write   # rewrite the table
+
+A tier-1 sync test (tests/test_mxlint.py) runs the check, so a PR that
+registers a rule cannot ship with a stale catalogue.  The analysis
+package is loaded standalone (no mxnet_tpu/__init__, no jax) so the
+check costs milliseconds.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+from typing import List, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_BEGIN = "<!-- BEGIN GENERATED: lint-rule-catalog " \
+    "(tools/gen_lint_docs.py --write) -->"
+_END = "<!-- END GENERATED: lint-rule-catalog -->"
+
+
+def _load_analysis():
+    if "mxnet_tpu.analysis" in sys.modules:
+        return sys.modules["mxnet_tpu.analysis"]
+    pkg_dir = os.path.join(_REPO, "mxnet_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "mxnet_tpu.analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["mxnet_tpu.analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _scope(cls) -> str:
+    if ".ir." in cls.__module__:
+        return "StableHLO IR"
+    if getattr(cls, "cacheable", "") == "file":
+        return "file"
+    if getattr(cls, "cacheable", "") == "contrib":
+        return "cross-file"
+    return "project"
+
+
+def _cached(cls) -> str:
+    mode = getattr(cls, "cacheable", "")
+    if mode == "file":
+        return "yes"
+    if mode == "contrib":
+        return "yes (contribution)"
+    if ".ir." in cls.__module__:
+        return "n/a (audits compiled programs, not source)"
+    return "no"
+
+
+def table_markdown(analysis) -> str:
+    """The generated block body: one row per registered rule, sorted
+    by id.  Pipes in descriptions are escaped so the table survives."""
+    rows: List[Tuple[str, ...]] = []
+    for rid, cls in sorted(analysis.RULE_REGISTRY.items()):
+        desc = " ".join(str(cls.description).split()).replace("|", "\\|")
+        rows.append((rid, cls.name, _scope(cls), _cached(cls), desc))
+    lines = [
+        "| Rule | Name | Scope | Cached | Description |",
+        "| --- | --- | --- | --- | --- |",
+    ]
+    lines.extend("| {} | {} | {} | {} | {} |".format(*r) for r in rows)
+    return "\n".join(lines)
+
+
+def apply_block(path: str, write: bool) -> Tuple[bool, str]:
+    """Replace (or verify) the marker-delimited block in ``path``.
+    Returns ``(in_sync, rendered_table)``; raises ``ValueError`` when
+    the markers are missing or unordered."""
+    analysis = _load_analysis()
+    table = table_markdown(analysis)
+    with open(path, "r", encoding="utf-8") as f:
+        doc = f.read()
+    try:
+        lo = doc.index(_BEGIN)
+        hi = doc.index(_END)
+    except ValueError:
+        raise ValueError(f"{path}: lint-rule-catalog markers not found")
+    if hi < lo:
+        raise ValueError(f"{path}: END marker precedes BEGIN marker")
+    current = doc[lo + len(_BEGIN):hi].strip("\n")
+    if current == table:
+        return True, table
+    if write:
+        new_doc = doc[:lo] + _BEGIN + "\n" + table + "\n" + doc[hi:]
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(new_doc)
+        os.replace(tmp, path)
+    return False, table
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--write", action="store_true",
+                    help="rewrite the generated block in place")
+    ap.add_argument("--path",
+                    default=os.path.join(_REPO, "docs",
+                                         "static_analysis.md"),
+                    help="docs file (default: docs/static_analysis.md)")
+    args = ap.parse_args(argv)
+    try:
+        ok, _ = apply_block(args.path, write=args.write)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if ok:
+        print("lint rule catalogue in sync")
+        return 0
+    if args.write:
+        print("lint rule catalogue regenerated")
+        return 0
+    print("lint rule catalogue OUT OF SYNC — run "
+          "`python tools/gen_lint_docs.py --write`", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,420 @@
+#!/usr/bin/env python
+"""mxir CLI — audit compiled StableHLO programs (rules MX014–MX018).
+
+Offline (no jax import, like mxlint):
+
+    python tools/mxir.py /path/to/compile-cache        # audit a cache dir
+    python tools/mxir.py module.mlir --json            # audit one module
+    python tools/mxir.py CACHE --out MXIR.json
+
+Walks ``*.mxcc`` entries (the persistent compile cache's on-disk
+format), audits every ``stablehlo``-tier payload, and renders the
+MXLINT-shaped MXIR.json report.  Entries that fail to decode or parse
+are counted as ``parse_skipped`` — never fatal.  Exit status: 0 when
+no violations, 1 when any program has findings.
+
+Selftest (imports the framework; drives real compiles):
+
+    python tools/mxir.py --selftest --out MXIR.json
+
+Runs the full known-answer gate: per-rule seeded/clean fixture pairs,
+the PR 18 gather-replication case lowered live and caught as MX015,
+an MXNET_IR_AUDIT=1 audit of real fused + SPMD step programs (must be
+clean), the static wire-bytes model cross-checked against the measured
+``mx_collective_wire_bytes_total`` int8 lane (MXNET_IR_WIRE_TOL), and
+the audit-off overhead guard (<= 3% of a fused step).  Writes the
+stage results plus the live report with a top-level ``gate_ok``.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import importlib.util
+import json
+import os
+import struct
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_MXCC_MAGIC = b"MXCC1\n"
+
+
+def _load_analysis():
+    """Load mxnet_tpu.analysis standalone (no mxnet_tpu/__init__.py,
+    no jax) — same idiom as tools/mxlint.py."""
+    if "mxnet_tpu.analysis" in sys.modules:
+        return sys.modules["mxnet_tpu.analysis"]
+    pkg_dir = os.path.join(_REPO, "mxnet_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "mxnet_tpu.analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["mxnet_tpu.analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _decode_mxcc(path: str):
+    """Minimal reader for one ``.mxcc`` entry: (header, payload).
+    Raises ValueError on any structural problem (the caller counts it
+    as a skip — offline audit never quarantines, that is the runtime
+    store's job)."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    if not blob.startswith(_MXCC_MAGIC):
+        raise ValueError("bad magic (not a compile-cache entry)")
+    off = len(_MXCC_MAGIC)
+    if len(blob) < off + 4:
+        raise ValueError("truncated header length")
+    (hlen,) = struct.unpack(">I", blob[off:off + 4])
+    off += 4
+    hjson = blob[off:off + hlen]
+    if len(hjson) != hlen:
+        raise ValueError("truncated header")
+    try:
+        header = json.loads(hjson)
+    except ValueError as e:
+        raise ValueError(f"unparseable header: {e}")
+    payload = blob[off + hlen:]
+    want = header.get("payload_sha256")
+    if want and hashlib.sha256(payload).hexdigest() != want:
+        raise ValueError("payload sha256 mismatch")
+    return header, payload
+
+
+def _audit_offline(analysis, target: str, repl_bytes: int):
+    """Audit a cache directory (``*.mxcc``) or a single module file.
+    Returns a list of ProgramAudit."""
+    audits = []
+
+    def one(site: str, text: str):
+        try:
+            module = analysis.parse_module(text)
+            violations = analysis.audit_module(
+                text, site=site, repl_bytes=repl_bytes, module=module)
+            est = analysis.estimate_wire_bytes(module)
+            audits.append(analysis.ProgramAudit(
+                site=site, violations=violations,
+                wire={"total": est.total, "by_lane": est.by_lane,
+                      "legs": len(est.legs),
+                      "unknown_transitions": est.unknown_transitions}))
+        except analysis.IrParseError as e:
+            audits.append(analysis.ProgramAudit(site=site,
+                                                parse_error=str(e)))
+        except Exception as e:  # noqa: BLE001 — offline audit never dies
+            audits.append(analysis.ProgramAudit(
+                site=site, parse_error=f"{type(e).__name__}: {e}"))
+
+    if os.path.isdir(target):
+        for name in sorted(os.listdir(target)):
+            if not name.endswith(".mxcc"):
+                continue
+            path = os.path.join(target, name)
+            site = name[:-len(".mxcc")]
+            try:
+                header, payload = _decode_mxcc(path)
+            except (OSError, ValueError) as e:
+                audits.append(analysis.ProgramAudit(
+                    site=site, parse_error=f"undecodable entry: {e}"))
+                continue
+            if header.get("tier") != "stablehlo":
+                continue  # exec/alias tiers carry no module text
+            site = header.get("site") or site
+            try:
+                text = payload.decode("utf-8")
+            except UnicodeDecodeError as e:
+                audits.append(analysis.ProgramAudit(
+                    site=site, parse_error=f"non-utf8 payload: {e}"))
+                continue
+            one(site, text)
+    else:
+        with open(target, "r", encoding="utf-8") as f:
+            one(os.path.basename(target), f.read())
+    return audits
+
+
+# ---------------------------------------------------------------------------
+# selftest stages
+# ---------------------------------------------------------------------------
+
+def _stage_rules_known_answer(analysis) -> dict:
+    per_rule = {}
+    ok = True
+    for rid, fx in sorted(analysis.FIXTURES.items()):
+        bad = analysis.audit_module(fx["bad"], **fx["kwargs"])
+        clean = analysis.audit_module(fx["clean"], **fx["kwargs"])
+        nbad = sum(1 for v in bad if v.rule == rid)
+        entry = {"bad": nbad, "bad_total": len(bad),
+                 "clean": len(clean)}
+        entry["ok"] = (nbad == 1 and len(bad) == 1 and not clean)
+        ok = ok and entry["ok"]
+        per_rule[rid] = entry
+    return {"ok": ok, "per_rule": per_rule}
+
+
+def _stage_pr18_gather(analysis) -> dict:
+    """The PR 18 bug class, reproduced live: a with_sharding_constraint
+    that pins a large tensor replicated on a multi-device mesh must be
+    caught as MX015 by the static audit of the real lowered text; the
+    sharded twin must be clean."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()[:2]
+    mesh = Mesh(np.asarray(devs), ("dp",))
+    sharded = NamedSharding(mesh, P("dp"))
+    repl = NamedSharding(mesh, P())
+    x = jax.device_put(np.zeros((1024, 64), np.float32), sharded)
+
+    def pinned_gather(v):
+        return jax.lax.with_sharding_constraint(v * 2.0, repl)
+
+    def pinned_sharded(v):
+        return jax.lax.with_sharding_constraint(v * 2.0, sharded)
+
+    bad_text = jax.jit(pinned_gather).lower(x).as_text()
+    clean_text = jax.jit(pinned_sharded).lower(x).as_text()
+    bad = analysis.audit_module(bad_text, site="pr18_gather_bad",
+                                repl_bytes=1024)
+    clean = analysis.audit_module(clean_text, site="pr18_gather_clean",
+                                  repl_bytes=1024)
+    bad_n = sum(1 for v in bad if v.rule == "MX015")
+    clean_n = sum(1 for v in clean if v.rule == "MX015")
+    return {"ok": bad_n >= 1 and clean_n == 0,
+            "bad_mx015": bad_n, "clean_mx015": clean_n}
+
+
+def _build_spmd_trainer(mx, shapes, spmd=True, fuse=False):
+    import numpy as np
+    from mxnet_tpu.gluon.parameter import Parameter
+    from mxnet_tpu.gluon.trainer import Trainer
+    from mxnet_tpu.ndarray.ndarray import array as nd_array
+
+    ctx = [mx.cpu(0), mx.cpu(1)]
+    rng = np.random.RandomState(0)
+    params = []
+    for i, shp in enumerate(shapes):
+        p = Parameter(f"w{i}", shape=shp, dtype="float32")
+        p.initialize(ctx=ctx)
+        p.set_data(nd_array(rng.randn(*shp).astype("float32")))
+        params.append(p)
+    kw = {"fuse_step": True} if fuse else {"kvstore": "device",
+                                           "spmd": True}
+    t = Trainer(params, "sgd", {"momentum": 0.9}, **kw)
+
+    def set_grads(step):
+        r = np.random.RandomState(1000 + step)
+        for p in params:
+            g = r.randn(*p.shape).astype("float32")
+            for rr, gnd in enumerate(p.list_grad()):
+                gnd._data = nd_array(g * (rr + 1), ctx=gnd.ctx).data
+
+    return t, set_grads
+
+
+def _stage_live_and_wire(analysis) -> tuple:
+    """Drive real fused + SPMD int8-quant compiles under
+    MXNET_IR_AUDIT=1; the audits must be clean, and the SPMD program's
+    static int8 wire lane must agree with the measured counter."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.compile_cache import audit as _audit
+    from mxnet_tpu.telemetry import instruments as _ins, tracing
+    from mxnet_tpu.util import env as _env
+
+    shapes = [(16, 8), (33,), (4, 3, 2)]
+    _audit.reset()
+
+    # fused single-replica-group step
+    tf_, gf = _build_spmd_trainer(mx, shapes, fuse=True)
+    gf(0)
+    tf_.step(2)
+
+    # SPMD int8-quant step (env set in main before the jax import)
+    ts, gs = _build_spmd_trainer(mx, shapes, spmd=True)
+    gs(0)
+    ts.step(2)  # untraced warmup engages the mesh + compiles
+
+    ops = ("reduce-scatter", "all-gather", "all-to-all", "all-reduce")
+    tracing.enable()
+    try:
+        before = {op: _ins.collective_wire_bytes_total(
+            op, "dp", "int8").value for op in ops}
+        gs(1)
+        ts.step(2)
+        measured = sum(
+            _ins.collective_wire_bytes_total(op, "dp", "int8").value
+            - before[op] for op in ops)
+    finally:
+        tracing.disable()
+
+    audits = _audit.audits()
+    sites = {a.site: a for a in audits}
+    nviol = sum(len(a.violations) for a in audits)
+    nskip = sum(1 for a in audits if a.parse_skipped)
+    live = {
+        "ok": (nviol == 0 and nskip == 0
+               and "optimizer.fused_step" in sites
+               and "optimizer.spmd_step" in sites),
+        "programs": sorted(sites),
+        "violations": nviol,
+        "parse_skipped": nskip,
+    }
+
+    spmd = sites.get("optimizer.spmd_step")
+    static_int8 = 0
+    if spmd is not None and spmd.wire:
+        static_int8 = int(spmd.wire["by_lane"].get("int8", 0))
+    tol = float(_env.get_float("MXNET_IR_WIRE_TOL") or 0.25)
+    drift_msg = analysis.wire_drift(static_int8, measured, tol)
+    drift = (abs(static_int8 - measured) / max(measured, 1.0))
+    wire = {
+        "ok": drift_msg is None and static_int8 > 0 and measured > 0,
+        "static_int8_bytes": static_int8,
+        "measured_int8_bytes": int(measured),
+        "drift": round(drift, 4),
+        "tol": tol,
+        **({"message": drift_msg} if drift_msg else {}),
+    }
+    return live, wire, tf_, gf
+
+
+def _stage_overhead(tf_, gf) -> dict:
+    """The audit-off cost at a hooked compile site is one enabled()
+    check; gate it at <= 3% of a fused optimizer step (the same
+    tolerance the profiler overhead tests use)."""
+    import gc
+
+    from mxnet_tpu.compile_cache import audit as _audit
+
+    os.environ.pop("MXNET_IR_AUDIT", None)
+    assert not _audit.enabled()
+
+    def best(fn, reps=5):
+        out = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            out = min(out, time.perf_counter() - t0)
+        return out
+
+    n_guard = 1000
+    gc.disable()
+    try:
+        t_guard = best(lambda: [
+            _audit.maybe_audit("overhead.probe", lambda: "")
+            for _ in range(n_guard)]) / n_guard
+
+        gf(2)
+
+        def one_step():
+            tf_.step(2)
+        t_step = best(one_step)
+    finally:
+        gc.enable()
+    ratio = t_guard / max(t_step, 1e-9)
+    return {"ok": ratio <= 0.03, "guard_s": t_guard,
+            "step_s": t_step, "ratio": round(ratio, 6)}
+
+
+def _selftest(out_path: str | None) -> int:
+    # env must be pinned BEFORE jax/mxnet_tpu import: 8 host devices
+    # for the 2-device mesh, int8 comm-quant for the wire-model stage,
+    # and the audit itself
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["MXNET_IR_AUDIT"] = "1"
+    os.environ["MXNET_COMM_QUANT"] = "int8"
+    os.environ["MXNET_COMM_QUANT_MIN_SIZE"] = "1"
+    os.environ["MXNET_ZERO_MIN_SIZE"] = "1"
+    sys.path.insert(0, _REPO)
+
+    analysis = _load_analysis()
+
+    stages = {}
+    stages["rules_known_answer"] = _stage_rules_known_answer(analysis)
+    stages["pr18_gather"] = _stage_pr18_gather(analysis)
+    live, wire, tf_, gf = _stage_live_and_wire(analysis)
+    stages["live_audit"] = live
+    stages["wire_model"] = wire
+    stages["overhead"] = _stage_overhead(tf_, gf)
+
+    from mxnet_tpu.compile_cache import audit as _audit
+    gate_ok = all(s["ok"] for s in stages.values())
+    doc = {
+        "gate_ok": gate_ok,
+        "stages": stages,
+        "report": _audit.last_report(),
+    }
+    text = json.dumps(doc, indent=1, sort_keys=True) + "\n"
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            f.write(text)
+    for name, s in stages.items():
+        detail = json.dumps(
+            {k: v for k, v in s.items() if k != "ok"},
+            sort_keys=True)[:200]
+        print(f"{'PASS' if s['ok'] else 'FAIL'}  {name}  {detail}")
+    print(f"mxir --selftest: {'OK' if gate_ok else 'FAIL'}")
+    return 0 if gate_ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxir", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("target", nargs="?", default=None,
+                    help="compile-cache directory (*.mxcc) or a "
+                         "StableHLO module text file")
+    ap.add_argument("--json", action="store_true",
+                    help="print the MXIR.json document to stdout")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the MXIR.json document to FILE")
+    ap.add_argument("--repl-bytes", type=int, default=64 << 20,
+                    help="MX015 threshold in bytes (default 64 MiB)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the known-answer + live gate "
+                         "(imports jax; drives real compiles)")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return _selftest(args.out)
+
+    if not args.target:
+        ap.error("a cache directory / module file is required "
+                 "(or --selftest)")
+    if not os.path.exists(args.target):
+        print(f"mxir: no such path: {args.target}", file=sys.stderr)
+        return 2
+
+    analysis = _load_analysis()
+    audits = _audit_offline(analysis, args.target, args.repl_bytes)
+    doc = analysis.render_ir_json(audits)
+    text = json.dumps(doc, indent=1, sort_keys=True) + "\n"
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+    if args.json:
+        sys.stdout.write(text)
+    else:
+        for a in audits:
+            mark = "SKIP" if a.parse_skipped else (
+                "FAIL" if a.violations else "ok")
+            print(f"{mark:>4}  {a.site}  "
+                  f"({len(a.violations)} finding(s))")
+            for v in a.violations:
+                print(f"      {v.rule} L{v.line}: {v.message}")
+        c = doc["counts"]
+        print(f"mxir: {c['programs']} program(s), "
+              f"{c['violations']} violation(s), "
+              f"{c['parse_skipped']} parse-skipped")
+    return 1 if doc["counts"]["violations"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

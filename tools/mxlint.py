@@ -145,6 +145,17 @@ def main(argv=None) -> int:
                     metavar="FILE",
                     help="generate docs/env_vars.md content from the "
                     "knob registry (to FILE, or stdout)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the incremental findings cache "
+                    "(.mxlint_cache.json, keyed on content sha256 + "
+                    "rules-version; cold and warm runs are "
+                    "finding-identical — this flag exists for "
+                    "debugging the cache itself)")
+    ap.add_argument("--cache-file",
+                    default=os.path.join(_REPO, ".mxlint_cache.json"),
+                    metavar="FILE",
+                    help="incremental cache location (default: "
+                    ".mxlint_cache.json at the repo root)")
     args = ap.parse_args(argv)
 
     if args.env_docs is not None:
@@ -183,7 +194,8 @@ def main(argv=None) -> int:
         if args.enable else None,
         disable=[s.strip() for s in args.disable.split(",")]
         if args.disable else None)
-    violations = engine.run(paths)
+    violations = engine.run(
+        paths, cache_path=None if args.no_cache else args.cache_file)
     elapsed = time.perf_counter() - t0
 
     if args.write_baseline:
@@ -226,6 +238,9 @@ def main(argv=None) -> int:
 
     report = analysis.render_json(new, suppressed, stale, engine.errors)
     report["elapsed_seconds"] = round(elapsed, 3)
+    report["cache"] = {"hits": engine.cache_hits,
+                       "misses": engine.cache_misses,
+                       "enabled": not args.no_cache}
     if args.sarif is not None:
         sarif = analysis.render_sarif(new)
         if args.sarif == "-":
@@ -244,7 +259,8 @@ def main(argv=None) -> int:
         sys.stdout.write("\n")
     else:
         print(analysis.render_text(new, suppressed, stale, engine.errors))
-        print(f"({elapsed:.2f}s)")
+        print(f"({elapsed:.2f}s, cache: {engine.cache_hits} hit / "
+              f"{engine.cache_misses} miss)")
 
     failed = bool(new) or bool(engine.errors) or \
         (args.check and bool(stale))

@@ -4,7 +4,8 @@ committed ones.
 
 The nightly refreshes the tracked bench artifacts (FUSED_BENCH.json,
 SCALING.json, SERVING_BENCH.json, COMPILE_CACHE.json, HEALTH.json,
-GOODPUT.json, RESILIENCE.json, AUTOTUNE.json, INCIDENT.json) in the
+GOODPUT.json, RESILIENCE.json, AUTOTUNE.json, INCIDENT.json,
+MXIR.json) in the
 work tree; this tool compares
 each against the version committed
 at --ref (``git show REF:NAME``) and fails on
@@ -43,6 +44,11 @@ at --ref (``git show REF:NAME``) and fails on
     policy — the chaos known-answer postmortem must keep naming the
     injected rank/category/step; a first-failure attribution that
     degrades to "unknown" is never grandfathered.
+  * an **IR-audit failure** (MXIR.json): same strict policy — every
+    mxir selftest stage (per-rule seeded/clean known answers, the
+    live PR 18 replicated-gather catch, clean real step programs,
+    wire-model-vs-counter agreement, audit-off overhead bound) fails
+    the nightly on any false, never grandfathered.
 
 Artifacts missing on either side are reported and skipped — a bench
 stage that timed out must fail the nightly through its own return
@@ -78,7 +84,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_ARTIFACTS = ("FUSED_BENCH.json", "SCALING.json",
                      "SERVING_BENCH.json", "COMPILE_CACHE.json",
                      "HEALTH.json", "GOODPUT.json", "RESILIENCE.json",
-                     "AUTOTUNE.json", "INCIDENT.json")
+                     "AUTOTUNE.json", "INCIDENT.json", "MXIR.json")
 
 _ATTRIBUTION_PATH = os.path.join(
     _REPO, "mxnet_tpu", "telemetry", "mxtriage", "attribution.py")
@@ -271,6 +277,25 @@ def _incident(d) -> dict:
     return {"checks": c, "strict": True}
 
 
+def _mxir(d) -> dict:
+    """MXIR.json: the StableHLO auditor's known-answer lanes, ALL
+    STRICT — every selftest stage (per-rule seeded fixture fires /
+    clean fixture silent, the PR 18 replicated-gather caught on a
+    live lowering, zero violations on the real step programs, the
+    static wire-bytes model within tolerance of the measured
+    collective counter, audit-off overhead under its bound) fails the
+    nightly on any false, never grandfathered.  No metric lanes: the
+    wire-model drift already gates absolutely inside the selftest via
+    MXNET_IR_WIRE_TOL."""
+    c = {}
+    if "gate_ok" in d:
+        c["gate_ok"] = bool(d["gate_ok"])
+    for stage, row in (d.get("stages") or {}).items():
+        if isinstance(row, dict) and "ok" in row:
+            c[f"stages.{stage}.ok"] = bool(row["ok"])
+    return {"checks": c, "strict": True}
+
+
 EXTRACTORS = {
     "FUSED_BENCH.json": _fused,
     "SERVING_BENCH.json": _serving,
@@ -281,6 +306,7 @@ EXTRACTORS = {
     "RESILIENCE.json": _resilience,
     "AUTOTUNE.json": _autotune,
     "INCIDENT.json": _incident,
+    "MXIR.json": _mxir,
 }
 
 

@@ -687,6 +687,38 @@ def main():
         blackbox_rc = -1
         artifact["blackbox"] = {"returncode": -1, "note": "timed out"}
 
+    # mxir stage (ISSUE 19): the StableHLO auditor's end-to-end
+    # known-answer selftest — per-rule seeded/clean fixture pairs, the
+    # PR 18 replicated-gather caught live, the static wire-bytes model
+    # checked against the measured collective counter, and the
+    # audit-off overhead bound — refreshing MXIR.json, the tracked
+    # artifact perf_compare gates with STRICT lanes (a rule that stops
+    # firing on its seeded fixture is never grandfathered).  Runs
+    # BEFORE perf-compare so the artifact it diffs is fresh.
+    mxir_rc = None
+    try:
+        ir = subprocess.run(
+            [sys.executable, "tools/mxir.py", "--selftest",
+             "--out", os.path.join(_REPO, "MXIR.json")],
+            capture_output=True, text=True, timeout=900, cwd=_REPO,
+            env=cpu_env)
+        mxir_rc = ir.returncode
+        gate = {"returncode": ir.returncode,
+                "tail": "\n".join(ir.stdout.splitlines()[-6:]),
+                "stderr_tail": "\n".join(ir.stderr.splitlines()[-6:])}
+        try:
+            with open(os.path.join(_REPO, "MXIR.json")) as f:
+                rep = json.load(f)
+            gate["gate_ok"] = rep["gate_ok"]
+            gate["stages"] = {k: v.get("ok")
+                              for k, v in rep["stages"].items()}
+        except (OSError, ValueError, KeyError):
+            pass
+        artifact["mxir"] = gate
+    except subprocess.TimeoutExpired:
+        mxir_rc = -1
+        artifact["mxir"] = {"returncode": -1, "note": "timed out"}
+
     # perf-compare gate (ISSUE 10): the bench artifacts this nightly
     # just refreshed (FUSED/SCALING/COMPILE_CACHE/HEALTH; SERVING when
     # its strict lane rewrote it) vs the committed versions — >10%
@@ -724,7 +756,7 @@ def main():
         and mxprof_rc in (None, 0) and health_rc in (None, 0) \
         and triage_rc in (None, 0) and goodput_rc in (None, 0) \
         and autotune_rc in (None, 0) and blackbox_rc in (None, 0) \
-        and perf_rc in (None, 0) else 1
+        and mxir_rc in (None, 0) and perf_rc in (None, 0) else 1
 
 
 if __name__ == "__main__":
