@@ -44,6 +44,11 @@ from .ir import (  # noqa: F401  — registers MX014–MX018
     IrParseError, audit_module, parse_module, estimate_wire_bytes,
     wire_drift, ProgramAudit, render_ir_json, IR_RULE_IDS, FIXTURES,
 )
+# mxrank: cross-rank collective-schedule verification (MX019–MX020) —
+# same one-level import rule as .dataflow above.
+from .mxrank import (  # noqa: F401  — registers MX019–MX020
+    RankDivergentSchedule, DataDivergentSchedule,
+)
 
 __all__ = [
     "LintEngine", "Violation", "Rule", "RULE_REGISTRY", "register_rule",
@@ -53,4 +58,5 @@ __all__ = [
     "IrParseError", "audit_module", "parse_module",
     "estimate_wire_bytes", "wire_drift", "ProgramAudit",
     "render_ir_json", "IR_RULE_IDS", "FIXTURES",
+    "RankDivergentSchedule", "DataDivergentSchedule",
 ]

@@ -26,11 +26,15 @@ class ProgramAudit:
         return self.parse_error is not None
 
 
-def render_ir_json(audits: Sequence[ProgramAudit]) -> dict:
+def render_ir_json(audits: Sequence[ProgramAudit],
+                   alias_skipped: int = 0) -> dict:
     """The MXIR.json shape — per-rule counts first (the trajectory the
     nightly tracks), then per-program summaries, then the findings.
     Mirrors :func:`..reporters.render_json` so the same tooling reads
-    both artifacts."""
+    both artifacts.  ``alias_skipped`` counts the cache entries the
+    offline audit passed over because they carry no module text (the
+    exec/alias persistence tiers) — reported so "N programs audited"
+    can never silently mean "most of the cache was skipped"."""
     violations: List[Violation] = []
     for a in audits:
         violations.extend(a.violations)
@@ -45,6 +49,7 @@ def render_ir_json(audits: Sequence[ProgramAudit]) -> dict:
             "programs": len(audits),
             "violations": len(violations),
             "parse_skipped": skipped,
+            "alias_skipped": int(alias_skipped),
         },
         "per_rule": per_rule,
         "rules": {rid: {"name": RULE_REGISTRY[rid].name,

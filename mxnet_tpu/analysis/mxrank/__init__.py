@@ -1,0 +1,34 @@
+"""mxrank — cross-rank collective-schedule verification (static half).
+
+The SPMD spine assumes every rank issues the same sequence of
+collectives; GSPMD-style sharding and portable redistribution plans
+take it as an axiom.  mxrank makes it a *checked invariant*:
+
+  * ``taint.py`` — a two-bit rank/data taint lattice with collective
+    results as the sanitizer;
+  * ``rules.py`` — MX019 (rank-divergent schedule) and MX020
+    (data-divergent schedule) on top of the mxflow project index.
+
+The runtime half — the rolling schedule fingerprint every collective
+site appends to, compared across ranks on watchdog timeout — lives in
+``mxnet_tpu/parallel/schedule.py``; see docs/static_analysis.md for
+the rule catalogue and docs/resilience.md for the ScheduleDivergence
+failure classification the fingerprints feed.
+
+Stdlib-only like the rest of the analysis package (the CLI loads it
+without jax).
+"""
+# NOTE one-level relative imports only — see analysis/__init__ for why
+# the two-level form breaks the standalone (jax-free) load.
+from .rules import (  # noqa: F401  — registers MX019–MX020
+    DataDivergentSchedule, RankDivergentSchedule,
+)
+from .taint import (  # noqa: F401
+    COLLECTIVE_NAMES, DATA, RANK, Divergence, ModuleTaint, taint_names,
+)
+
+__all__ = [
+    "RankDivergentSchedule", "DataDivergentSchedule",
+    "ModuleTaint", "Divergence", "RANK", "DATA", "taint_names",
+    "COLLECTIVE_NAMES",
+]

@@ -1,0 +1,159 @@
+"""mxrank rules (MX019–MX020): cross-rank collective-schedule
+verification, the static half of the mxrank invariant (the runtime
+half is ``parallel/schedule.py``'s fingerprint ledger).
+
+Both rules ride the mxflow project index for *scope* — a function is
+checked when it is hot (the Trainer/Updater/KVStore step chain),
+reachable from a hot function through the resolved call graph, or
+lives under ``parallel/`` (the collective layer itself); serving is
+out of scope — and the mxrank taint lattice (``taint.py``) for the
+finding itself: a rank-/data-tainted predicate whose paths issue
+different collective multisets.
+
+Same precision-over-recall policy as MX008–MX012: an unresolvable
+call contributes nothing, and a finding needs BOTH the tainted
+predicate AND asymmetric collectives — rank-gated logging or
+checkpointing never fires.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from ..engine import FileContext, Rule, Violation, register_rule
+# NOTE `from ..dataflow import X` (one level into the sibling package),
+# never `from ..dataflow.rules import X`: the two-level form walks the
+# import from the ROOT package and breaks the CLI's standalone
+# (jax-free) load — see analysis/__init__.
+from ..dataflow import Project, get_project
+from .taint import DATA, RANK, Divergence, ModuleTaint, taint_names
+
+__all__ = ["RankDivergentSchedule", "DataDivergentSchedule"]
+
+
+def _reachable_from_hot(proj: Project) -> Set[str]:
+    """Quals reachable from the step chain via resolved call edges."""
+    seen: Set[str] = set()
+    work = [f for f in proj.funcs.values() if f.hot]
+    seen.update(f.qual for f in work)
+    while work:
+        fn = work.pop()
+        for _entry, callees in fn.edges:
+            for g in callees:
+                if g.qual not in seen:
+                    seen.add(g.qual)
+                    work.append(g)
+    return seen
+
+
+def _parallel_mod(mod: str) -> bool:
+    return "parallel" in mod.split(".")
+
+
+def _serving_mod(mod: str) -> bool:
+    return "serving" in mod.split(".")
+
+
+class _MxrankRule(Rule):
+    """Base: record every FileContext, share the project in
+    finalize(), run the module taint walk once per file."""
+
+    def __init__(self) -> None:
+        self._ctxs: List[FileContext] = []
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        self._ctxs.append(ctx)
+        return ()
+
+    def finalize(self) -> Iterable[Violation]:
+        if not self._ctxs:
+            return ()
+        proj = get_project(self._ctxs)
+        hot_reach = _reachable_from_hot(proj)
+        out: List[Violation] = []
+        for ctx in self._ctxs:
+            mod = proj.path_mod.get(ctx.path)
+            if mod is None or _serving_mod(mod):
+                continue
+            try:
+                mt = ModuleTaint(ctx.tree)
+            except SyntaxError:
+                continue
+            in_parallel = _parallel_mod(mod)
+            for name, cls, node in mt.functions():
+                qual = f"{mod}:{cls}.{name}" if cls else f"{mod}:{name}"
+                fi = proj.funcs.get(qual)
+                if fi is None:
+                    continue
+                if not (fi.hot or in_parallel or qual in hot_reach):
+                    continue
+                for d in mt.analyze(name, cls, node):
+                    if not self._selects(d):
+                        continue
+                    v = ctx.violation(self.id, d.node, self._message(d))
+                    if not ctx.suppressed(self.id, v.line):
+                        out.append(v)
+        return out
+
+    def _selects(self, d: Divergence) -> bool:
+        raise NotImplementedError
+
+    def _message(self, d: Divergence) -> str:
+        raise NotImplementedError
+
+
+@register_rule
+class RankDivergentSchedule(_MxrankRule):
+    """MX019: a collective call site reachable under a rank-tainted
+    branch where the sibling path issues a different collective
+    multiset.  Rank 0 enters a reduce rank 1 never issues; the job
+    hangs until the watchdog fires and — without the runtime ledger —
+    is misclassified as a peer failure and replayed forever."""
+
+    id = "MX019"
+    name = "rank-divergent-schedule"
+    description = ("Collective schedule depends on rank identity: a "
+                   "branch on rank()/process_index()/rank-env state "
+                   "where the two paths issue different collective "
+                   "multisets — ranks deadlock in the collective.")
+
+    def _selects(self, d: Divergence) -> bool:
+        return bool(d.taint & RANK)
+
+    def _message(self, d: Divergence) -> str:
+        return (f"collective schedule diverges across ranks: "
+                f"{d.describe()} under a "
+                f"{taint_names(d.taint)}-tainted predicate — every "
+                "rank must issue the identical collective sequence; "
+                "hoist the collective out of the rank conditional "
+                "(keep only non-collective work rank-gated).")
+
+
+@register_rule
+class DataDivergentSchedule(_MxrankRule):
+    """MX020: collective order/count depends on a data-tainted
+    predicate (loss scalar, nonfinite count, batch contents) that was
+    not first made globally consistent.  Each rank sees different
+    data, so ranks take different branches and the schedules drift.
+    The clean pattern is the mxhealth ``skip_step`` idiom: all-reduce
+    the predicate, then branch — which this rule recognizes by
+    construction (a collective's result carries no taint)."""
+
+    id = "MX020"
+    name = "data-divergent-schedule"
+    description = ("Collective order/count depends on a data-tainted "
+                   "predicate (loss/nonfinite/batch) without an "
+                   "enclosing all-reduce of that predicate — ranks "
+                   "see different data and desynchronize.")
+
+    def _selects(self, d: Divergence) -> bool:
+        # pure data taint; rank-tainted predicates are MX019's finding
+        return bool(d.taint & DATA) and not (d.taint & RANK)
+
+    def _message(self, d: Divergence) -> str:
+        return (f"collective schedule depends on per-rank data: "
+                f"{d.describe()} under a data-tainted predicate — "
+                "all-reduce the predicate first (the mxhealth "
+                "skip_step idiom) so every rank takes the same "
+                "branch, then branch on the globally consistent "
+                "result.")

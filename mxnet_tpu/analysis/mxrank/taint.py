@@ -1,0 +1,425 @@
+"""The rank/data taint lattice behind MX019–MX020.
+
+SPMD correctness rests on one invariant: **every rank issues the same
+sequence of collectives**.  A value is *rank-tainted* when it may
+differ across ranks because of rank identity (``dist.rank()``,
+``jax.process_index()``, ``MXNET_ELASTIC_RANK``/``DMLC_WORKER_ID`` env
+reads, heartbeat/supervisor state) and *data-tainted* when it may
+differ because each rank sees different data (batch contents, loss
+scalars, nonfinite counts).  Branching on either kind in a path that
+issues collectives lets rank 0 enter a reduce rank 1 never issues —
+the job then hangs until the watchdog fires.
+
+The lattice is a two-bit union: ``RANK | DATA``; joins are bitwise or.
+The single **sanitizer** is a collective itself: ``allreduce(x)``
+returns the same value on every rank, so its result carries no taint.
+That is exactly why the mxhealth ``skip_step`` idiom — all-reduce the
+nonfinite flag, then branch — is clean *by construction* here.
+
+Propagation is intra-procedural in statement order with one level of
+same-module helper summaries (two rounds, so ``def _is_chief(self):
+return dist.rank() == 0`` taints its callers).  Branches join into a
+shared environment and loop bodies are walked twice for loop-carried
+taint — a may-analysis over-approximation.  Per the house
+precision-over-recall policy a finding needs BOTH a tainted predicate
+AND asymmetric collective multisets on the two paths; rank-dependent
+logging, checkpoint-writing, etc. never fires.
+"""
+from __future__ import annotations
+
+import ast
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["RANK", "DATA", "taint_names", "COLLECTIVE_NAMES",
+           "Divergence", "ModuleTaint"]
+
+#: taint bits — joins are bitwise or
+RANK = 1
+DATA = 2
+
+#: collective entry points whose *result* is globally consistent (the
+#: sanitizer set) and whose *issue* must be schedule-identical across
+#: ranks.  Mirrors dataflow.summaries._COLLECTIVES plus the dist.py
+#: public names.
+COLLECTIVE_NAMES = {
+    "allreduce", "allgather", "all_gather", "barrier", "broadcast",
+    "pushpull", "pushpull_fused", "psum", "pmean", "all_reduce",
+    "allreduce_nd", "allgather_np",
+}
+
+#: call leaf names that return the caller's rank identity
+_RANK_CALLS = {"rank", "process_index", "local_rank", "node_rank"}
+#: env vars that encode rank identity (the elastic/DMLC contract)
+_RANK_ENV = {"MXNET_ELASTIC_RANK", "DMLC_WORKER_ID", "PROCESS_ID",
+             "RANK", "LOCAL_RANK"}
+#: attribute loads that carry rank identity (self.rank, ctx.worker_id,
+#: heartbeat/supervisor per-rank state)
+_RANK_ATTRS = {"rank", "process_index", "worker_id", "local_rank",
+               "node_rank", "is_chief"}
+_RANK_PARAMS = {"rank", "local_rank", "worker_id"}
+#: parameter names that carry per-rank data shards
+_DATA_PARAMS = {"data", "batch", "batches", "label", "labels",
+                "inputs", "loss", "losses", "sample", "samples",
+                "target", "targets", "grad", "grads", "logits"}
+#: env-registry / os.environ read entry points (first arg is the key)
+_ENV_READS = {"get", "getenv", "get_int", "get_str", "get_bool",
+              "get_float"}
+
+
+def taint_names(t: int) -> str:
+    parts = [n for bit, n in ((RANK, "rank"), (DATA, "data")) if t & bit]
+    return "+".join(parts) or "none"
+
+
+def _terminal(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _fmt_multiset(ms: Counter) -> str:
+    if not ms:
+        return "no collective"
+    items = [f"{name} x{n}" if n > 1 else name
+             for name, n in sorted(ms.items())]
+    return "{" + ", ".join(items) + "}"
+
+
+class Divergence:
+    """One schedule-divergence site: a tainted predicate whose paths
+    issue different collective multisets (``kind='branch'``) or a
+    tainted loop predicate with collectives in the body
+    (``kind='loop'``)."""
+
+    __slots__ = ("kind", "node", "taint", "ms_then", "ms_else")
+
+    def __init__(self, kind: str, node: ast.AST, taint: int,
+                 ms_then: Counter, ms_else: Optional[Counter]):
+        self.kind = kind
+        self.node = node
+        self.taint = taint
+        self.ms_then = ms_then
+        self.ms_else = ms_else
+
+    def describe(self) -> str:
+        if self.kind == "loop":
+            return (f"loop bounded by a {taint_names(self.taint)}-"
+                    f"tainted predicate issues "
+                    f"{_fmt_multiset(self.ms_then)} per iteration")
+        return (f"one path issues {_fmt_multiset(self.ms_then)}, the "
+                f"sibling path {_fmt_multiset(self.ms_else or Counter())}")
+
+
+class _FnSummary:
+    """What a same-module helper contributes at its call sites."""
+
+    __slots__ = ("ret_taint", "collectives")
+
+    def __init__(self, ret_taint: int, collectives: Counter):
+        self.ret_taint = ret_taint
+        self.collectives = collectives
+
+
+class _Walker:
+    """One statement-order pass over a function body: taint
+    environment, return taint, collective multiset, divergence
+    findings.  Nested defs/lambdas are opaque (precision over
+    recall)."""
+
+    def __init__(self, fn: ast.AST, cls: Optional[str],
+                 summaries: Dict[Tuple[str, str], _FnSummary]):
+        self.fn = fn
+        self.cls = cls or ""
+        self.summaries = summaries
+        self.env: Dict[str, int] = {}
+        self.ret_taint = 0
+        self.collectives: Counter = Counter()
+        self.findings: List[Divergence] = []
+        # the loop-body second walk only refreshes the env — it must
+        # not double-count collectives or duplicate findings
+        self._shadow = False
+        self._seed_params()
+
+    def run(self) -> "_Walker":
+        self._stmts(self.fn.body)
+        return self
+
+    # ---- seeding ------------------------------------------------------
+
+    def _seed_params(self) -> None:
+        args = self.fn.args
+        names = [a.arg for a in (list(getattr(args, "posonlyargs", []))
+                                 + list(args.args)
+                                 + list(args.kwonlyargs))]
+        for n in names:
+            low = n.lower()
+            if low in _DATA_PARAMS:
+                self.env[n] = DATA
+            elif low in _RANK_PARAMS:
+                self.env[n] = RANK
+
+    # ---- statements ---------------------------------------------------
+
+    def _stmts(self, body: List[ast.stmt]) -> None:
+        for i, stmt in enumerate(body):
+            self._stmt(stmt, body[i + 1:])
+
+    def _loop_body_again(self, body: List[ast.stmt]) -> None:
+        """Second walk for loop-carried taint, findings suppressed."""
+        prev, self._shadow = self._shadow, True
+        try:
+            self._stmts(body)
+        finally:
+            self._shadow = prev
+
+    def _stmt(self, stmt: ast.stmt, rest: List[ast.stmt]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Assign):
+            t = self._expr(stmt.value)
+            for tgt in stmt.targets:
+                self._assign(tgt, t)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._assign(stmt.target, self._expr(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            t = self._expr(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                name = stmt.target.id
+                self.env[name] = self.env.get(name, 0) | t
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.ret_taint |= self._expr(stmt.value)
+        elif isinstance(stmt, ast.If):
+            t = self._expr(stmt.test)
+            if t and not self._shadow:
+                self._branch(stmt, t, rest)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            t = self._expr(stmt.test)
+            if t and not self._shadow:
+                ms = self._collect(stmt.body)
+                if ms:
+                    self.findings.append(
+                        Divergence("loop", stmt, t, ms, None))
+            self._stmts(stmt.body)
+            self._loop_body_again(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, ast.For):
+            t = self._expr(stmt.iter)
+            self._assign(stmt.target, t)
+            if t and not self._shadow:
+                ms = self._collect(stmt.body)
+                if ms:
+                    self.findings.append(
+                        Divergence("loop", stmt, t, ms, None))
+            self._stmts(stmt.body)
+            self._loop_body_again(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                t = self._expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars, t)
+            self._stmts(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body)
+            for h in stmt.handlers:
+                self._stmts(h.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+        else:
+            # Expr/Raise/Assert/Delete/...: evaluate the expressions so
+            # bare collective calls are still counted
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._expr(child)
+
+    def _assign(self, target: ast.AST, taint: int) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = taint
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign(e, taint)
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, taint)
+        # attribute/subscript stores are opaque
+
+    # ---- branch analysis ----------------------------------------------
+
+    @staticmethod
+    def _terminates(body: List[ast.stmt]) -> bool:
+        return bool(body) and isinstance(
+            body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+    def _branch(self, stmt: ast.If, taint: int,
+                rest: List[ast.stmt]) -> None:
+        ms_then = self._collect(stmt.body)
+        ms_else = self._collect(stmt.orelse)
+        # an early exit makes the *rest of the block* the other path's
+        # schedule: `if rank()==0: return` followed by allreduce
+        # diverges just as surely as a collective inside the branch
+        rest_ms = self._collect(rest)
+        eff_then = ms_then if self._terminates(stmt.body) \
+            else ms_then + rest_ms
+        eff_else = ms_else if self._terminates(stmt.orelse) \
+            else ms_else + rest_ms
+        if eff_then != eff_else:
+            self.findings.append(
+                Divergence("branch", stmt, taint, eff_then, eff_else))
+
+    def _collect(self, stmts: List[ast.stmt]) -> Counter:
+        """Collective multiset issued anywhere under ``stmts``: direct
+        calls plus same-module helper expansion (nested defs are
+        opaque)."""
+        out: Counter = Counter()
+        stack: List[ast.AST] = list(stmts)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(n, ast.Call):
+                name = _terminal(n.func)
+                if name in COLLECTIVE_NAMES:
+                    out[name] += 1
+                else:
+                    s = self._summary_for_call(n)
+                    if s is not None:
+                        out.update(s.collectives)
+            stack.extend(ast.iter_child_nodes(n))
+        return out
+
+    # ---- expressions --------------------------------------------------
+
+    def _expr(self, node: Optional[ast.AST]) -> int:
+        if node is None or isinstance(node, (ast.Constant, ast.Lambda)):
+            return 0
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, 0)
+        if isinstance(node, ast.Attribute):
+            base = self._expr(node.value)
+            if node.attr in _RANK_ATTRS:
+                return base | RANK
+            return base
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.Subscript):
+            t = self._expr(node.value) | self._expr(node.slice)
+            if self._env_key_rank(node.slice) and \
+                    _terminal(node.value) == "environ":
+                t |= RANK
+            return t
+        if isinstance(node, ast.Compare):
+            t = self._expr(node.left)
+            for c in node.comparators:
+                t |= self._expr(c)
+            return t
+        if isinstance(node, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp, ast.DictComp)):
+            for gen in node.generators:
+                self._assign(gen.target, self._expr(gen.iter))
+                for cond in gen.ifs:
+                    self._expr(cond)
+            if isinstance(node, ast.DictComp):
+                return self._expr(node.key) | self._expr(node.value)
+            return self._expr(node.elt)
+        # BinOp/BoolOp/UnaryOp/IfExp/Tuple/List/Set/Dict/Starred/
+        # JoinedStr/...: join over child expressions
+        t = 0
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                t |= self._expr(child)
+        return t
+
+    def _call(self, call: ast.Call) -> int:
+        f = call.func
+        name = _terminal(f)
+        arg_t = 0
+        for a in call.args:
+            arg_t |= self._expr(a)
+        for kw in call.keywords:
+            arg_t |= self._expr(kw.value)
+        recv_t = self._expr(f.value) if isinstance(f, ast.Attribute) \
+            else 0
+        if name in COLLECTIVE_NAMES:
+            if not self._shadow:
+                self.collectives[name] += 1
+            # THE sanitizer: a collective's result is identical on
+            # every rank regardless of what went in
+            return 0
+        if name in _RANK_CALLS:
+            return RANK
+        if name in _ENV_READS and call.args and \
+                self._env_key_rank(call.args[0]):
+            return RANK
+        s = self._summary_for_call(call)
+        if s is not None:
+            if not self._shadow:
+                self.collectives.update(s.collectives)
+            if s.collectives and s.ret_taint == 0:
+                # the helper all-reduced on the way out — treat its
+                # result as globally consistent like a direct collective
+                return 0
+            return s.ret_taint | arg_t | recv_t
+        # unresolvable call: taint flows through (isnan(loss) is DATA
+        # because loss is, model(batch) is DATA because batch is)
+        return arg_t | recv_t
+
+    @staticmethod
+    def _env_key_rank(node: ast.AST) -> bool:
+        return isinstance(node, ast.Constant) and \
+            isinstance(node.value, str) and node.value in _RANK_ENV
+
+    def _summary_for_call(self, call: ast.Call
+                          ) -> Optional[_FnSummary]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return self.summaries.get(("", f.id))
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self":
+            return self.summaries.get((self.cls, f.attr))
+        return None
+
+
+class ModuleTaint:
+    """Two-round taint summaries for one module, then per-function
+    divergence findings.  Round 1 walks every function without helper
+    info; round 2 re-walks with round-1 return-taint/collective
+    summaries, so one level of same-module helpers resolves."""
+
+    def __init__(self, tree: ast.Module):
+        self._fns: List[Tuple[str, Optional[str], ast.AST]] = []
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._fns.append((node.name, None, node))
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._fns.append((item.name, node.name, item))
+        summaries: Dict[Tuple[str, str], _FnSummary] = {}
+        for _ in range(2):
+            fresh: Dict[Tuple[str, str], _FnSummary] = {}
+            for name, cls, node in self._fns:
+                w = _Walker(node, cls, summaries).run()
+                fresh[(cls or "", name)] = _FnSummary(
+                    w.ret_taint, w.collectives)
+            summaries = fresh
+        self.summaries = summaries
+
+    def functions(self) -> List[Tuple[str, Optional[str], ast.AST]]:
+        return list(self._fns)
+
+    def analyze(self, name: str, cls: Optional[str],
+                node: ast.AST) -> List[Divergence]:
+        return _Walker(node, cls, self.summaries).run().findings
+
+    def return_taint(self, name: str, cls: str = "") -> int:
+        s = self.summaries.get((cls, name))
+        return s.ret_taint if s else 0

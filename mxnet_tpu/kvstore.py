@@ -199,7 +199,17 @@ class KVStore:
         if cur:
             buckets.append(cur)
         dist = self._kind.startswith("dist")
+        from .parallel import schedule as _schedule
+
         for bucket in buckets:
+            # schedule-ledger record: one entry per LOGICAL bucket
+            # reduce, before the retry loop (a one-sided transient
+            # retry must not shift this rank's seq off its peers')
+            d0 = vals[bucket[0]][0].data
+            _schedule.record(
+                "kvstore.pushpull_fused", "pushpull", str(d0.dtype),
+                sum(vals[p][0].data.size * vals[p][0].data.dtype.itemsize
+                    for p in bucket))
             # chaos probe + retry per bucket — the retry policy is
             # ALWAYS engaged (a transient-marked infra failure in the
             # reduce retries in production too, not only under chaos).

@@ -68,6 +68,8 @@ def _corner_to_center(boxes):
 
 @register_op("box_iou", aliases=("_contrib_box_iou",), differentiable=False)
 def _box_iou(lhs, rhs, format="corner"):
+    """Pairwise IoU of two box sets [..., 4] -> [*lhs_batch, *rhs_batch]
+    ('corner' x1,y1,x2,y2 or 'center' cx,cy,w,h layout)."""
     if format == "center":
         lhs = _center_to_corner(lhs)
         rhs = _center_to_corner(rhs)
@@ -505,6 +507,8 @@ def _boolean_mask(data, index, axis=0):
 
 @register_op("_contrib_fft", aliases=("fft",))
 def _fft(data, compute_size=128):
+    """1-D FFT over the last axis: real (n, d) -> interleaved re/im
+    (n, 2d), float32 (ref cuFFT convention)."""
     spec = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
     out = jnp.stack([spec.real, spec.imag], axis=-1)
     return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
@@ -513,6 +517,8 @@ def _fft(data, compute_size=128):
 
 @register_op("_contrib_ifft", aliases=("ifft",))
 def _ifft(data, compute_size=128):
+    """Inverse of ``fft``: interleaved re/im (n, 2d) -> real (n, d),
+    UNNORMALIZED (scaled by d; callers divide, matching cuFFT)."""
     d = data.shape[-1] // 2
     pairs = data.reshape(data.shape[:-1] + (d, 2))
     spec = pairs[..., 0] + 1j * pairs[..., 1]

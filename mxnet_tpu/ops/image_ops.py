@@ -75,11 +75,13 @@ def _crop(x, x0=0, y0=0, width=0, height=0):
 
 @register_op("_image_flip_left_right", aliases=("image_flip_left_right",))
 def _flip_lr(x):
+    """Mirror HWC/NHWC horizontally (flip the width axis)."""
     return jnp.flip(x, axis=-2)
 
 
 @register_op("_image_flip_up_down", aliases=("image_flip_up_down",))
 def _flip_ud(x):
+    """Mirror HWC/NHWC vertically (flip the height axis)."""
     return jnp.flip(x, axis=1 if _is_batched(x) else 0)
 
 
@@ -90,12 +92,14 @@ def _keyed_coin(key):
 @register_op("_image_random_flip_left_right",
              aliases=("image_random_flip_left_right",))
 def _random_flip_lr(x, key):
+    """Horizontal mirror with probability 0.5 under the given PRNG key."""
     return jnp.where(_keyed_coin(key), jnp.flip(x, axis=-2), x)
 
 
 @register_op("_image_random_flip_up_down",
              aliases=("image_random_flip_up_down",))
 def _random_flip_ud(x, key):
+    """Vertical mirror with probability 0.5 under the given PRNG key."""
     ax = 1 if _is_batched(x) else 0
     return jnp.where(_keyed_coin(key), jnp.flip(x, axis=ax), x)
 
@@ -103,12 +107,15 @@ def _random_flip_ud(x, key):
 @register_op("_image_random_brightness",
              aliases=("image_random_brightness",))
 def _random_brightness(x, key, min_factor=0.5, max_factor=1.5):
+    """Scale pixel values by a uniform factor in [min_factor, max_factor]."""
     f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
     return (x.astype(jnp.float32) * f).astype(x.dtype)
 
 
 @register_op("_image_random_contrast", aliases=("image_random_contrast",))
 def _random_contrast(x, key, min_factor=0.5, max_factor=1.5):
+    """Blend toward the scalar luminance mean by a uniform random factor
+    (factor 1 = identity, 0 = flat gray)."""
     f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
     xf = x.astype(jnp.float32)
     # luminance-mean pivot (ref: image_random.cc contrast aug)
@@ -120,6 +127,8 @@ def _random_contrast(x, key, min_factor=0.5, max_factor=1.5):
 @register_op("_image_random_saturation",
              aliases=("image_random_saturation",))
 def _random_saturation(x, key, min_factor=0.5, max_factor=1.5):
+    """Blend toward per-pixel grayscale by a uniform random factor
+    (factor 1 = identity, 0 = fully desaturated)."""
     f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
     xf = x.astype(jnp.float32)
     coef = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)

@@ -81,17 +81,22 @@ def _linalg_trsm(a, b, transpose=False, rightside=False, lower=True,
 
 @register_op("linalg_sumlogdiag")
 def _linalg_sumlogdiag(a):
+    """Sum of log of the diagonal of each [..., n, n] matrix (the
+    log-det of a Cholesky factor)."""
     d = jnp.diagonal(a, axis1=-2, axis2=-1)
     return jnp.sum(jnp.log(d), axis=-1)
 
 
 @register_op("linalg_extractdiag")
 def _linalg_extractdiag(a, offset=0):
+    """Extract the k-th diagonal of each [..., n, n] matrix as a vector."""
     return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
 
 
 @register_op("linalg_makediag")
 def _linalg_makediag(a, offset=0):
+    """Embed a vector as the k-th diagonal of an otherwise-zero square
+    matrix (inverse of ``linalg_extractdiag``)."""
     n = a.shape[-1] + abs(offset)
     base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
     idx = jnp.arange(a.shape[-1])
@@ -110,12 +115,16 @@ def _trian_indices(n, offset, lower):
 
 @register_op("linalg_extracttrian")
 def _linalg_extracttrian(a, offset=0, lower=True):
+    """Pack the lower (or upper) triangle of each [..., n, n] matrix
+    into a flat row-major vector."""
     r, c = _trian_indices(a.shape[-1], offset, lower)
     return a[..., r, c]
 
 
 @register_op("linalg_maketrian")
 def _linalg_maketrian(a, offset=0, lower=True):
+    """Unpack a flat triangle vector into an otherwise-zero square
+    matrix (inverse of ``linalg_extracttrian``; n inferred from length)."""
     # solve k = n(n+1)/2 - |offset| terms for n given the packed length
     k = a.shape[-1]
     n = 1
@@ -149,27 +158,33 @@ def _linalg_gelqf(a):
 
 @register_op("linalg_inverse", aliases=("inverse",))
 def _linalg_inverse(a):
+    """Matrix inverse of each [..., n, n] matrix."""
     return jnp.linalg.inv(a)
 
 
 @register_op("linalg_det", aliases=("det",))
 def _linalg_det(a):
+    """Determinant of each [..., n, n] matrix."""
     return jnp.linalg.det(a)
 
 
 @register_op("linalg_slogdet", aliases=("slogdet",), num_outputs=2)
 def _linalg_slogdet(a):
+    """Sign and log|det| of each [..., n, n] matrix (numerically safe
+    where ``det`` would over/underflow)."""
     sign, logdet = jnp.linalg.slogdet(a)
     return sign, logdet
 
 
 @register_op("linalg_solve", aliases=("solve",))
 def _linalg_solve(a, b):
+    """Solve the linear system A X = B for X (batched)."""
     return jnp.linalg.solve(a, b)
 
 
 @register_op("moments", num_outputs=2)
 def _moments(data, axes=None, keepdims=False):
+    """Mean and variance over ``axes`` in one pass (ref: moments.cc)."""
     mean = jnp.mean(data, axis=axes, keepdims=keepdims)
     var = jnp.var(data, axis=axes, keepdims=keepdims)
     return mean, var

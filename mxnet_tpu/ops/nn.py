@@ -35,6 +35,8 @@ from .registry import register_op
 @register_op("FullyConnected", aliases=("fully_connected",))
 def _fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
                      flatten=True):
+    """Dense layer: data @ weight.T + bias, flattening trailing dims
+    first when ``flatten`` (ref: fully_connected-inl.h)."""
     if flatten and data.ndim > 2:
         data = data.reshape(data.shape[0], -1)
     out = jnp.matmul(data, weight.T)
@@ -55,6 +57,8 @@ def _conv_dims(kernel):
 def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                  pad=(), num_filter=0, num_group=1, no_bias=False,
                  layout=None, cudnn_tune=None, cudnn_off=False, workspace=1024):
+    """N-D grouped convolution, NCHW-family layouts, with optional bias
+    (ref: convolution-inl.h)."""
     nd = len(kernel) if kernel else data.ndim - 2
     stride = tuple(stride) if stride else (1,) * nd
     dilate = tuple(dilate) if dilate else (1,) * nd
@@ -169,6 +173,8 @@ def pool_window(data_shape, kernel, stride, pad, pooling_convention,
 def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
              global_pool=False, pooling_convention="valid", count_include_pad=True,
              cudnn_off=False, layout=None):
+    """max/avg/sum/lp pooling with valid/full conventions and global
+    mode (ref: pooling-inl.h)."""
     channels_last = bool(layout) and layout[-1] == "C"
     if global_pool:
         axes = (tuple(range(1, data.ndim - 1)) if channels_last
@@ -222,6 +228,8 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
                 momentum=0.9, fix_gamma=False, use_global_stats=False,
                 output_mean_var=False, axis=1, cudnn_off=False, _train=False,
                 exact_var=None):
+    """Batch normalization over ``axis`` using batch stats in training
+    and moving stats in inference (ref: batch_norm-inl.h)."""
     shape = [1] * data.ndim
     shape[axis] = data.shape[axis]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
@@ -292,6 +300,7 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
 
 @register_op("LayerNorm", aliases=("layer_norm",))
 def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    """Layer normalization over ``axis`` with learned scale and shift."""
     mean = jnp.mean(data, axis=axis, keepdims=True)
     var = jnp.var(data, axis=axis, keepdims=True)
     out = (data - mean) * lax.rsqrt(var + eps)
@@ -302,6 +311,8 @@ def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
 
 @register_op("InstanceNorm", aliases=("instance_norm",))
 def _instance_norm(data, gamma, beta, eps=1e-3):
+    """Instance normalization: normalize each (sample, channel) over its
+    spatial dims."""
     red = tuple(range(2, data.ndim))
     mean = jnp.mean(data, axis=red, keepdims=True)
     var = jnp.var(data, axis=red, keepdims=True)
@@ -312,6 +323,8 @@ def _instance_norm(data, gamma, beta, eps=1e-3):
 
 @register_op("GroupNorm", aliases=("group_norm",))
 def _group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    """Group normalization: normalize over channel groups + spatial dims
+    (batch-size independent)."""
     b, c = data.shape[:2]
     rest = data.shape[2:]
     x = data.reshape((b, num_groups, c // num_groups) + rest)
@@ -325,6 +338,8 @@ def _group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
 
 @register_op("RMSNorm", aliases=("rms_norm",))
 def _rms_norm(data, gamma, axis=-1, eps=1e-6):
+    """RMS normalization over ``axis``: scale by 1/RMS and gamma, no
+    mean subtraction."""
     ms = jnp.mean(jnp.square(data), axis=axis, keepdims=True)
     return data * lax.rsqrt(ms + eps) * gamma
 
@@ -335,6 +350,8 @@ def _rms_norm(data, gamma, axis=-1, eps=1e-6):
 
 @register_op("Activation", aliases=("activation",))
 def _activation(data, act_type="relu"):
+    """Elementwise activation selected by ``act_type`` (relu, sigmoid,
+    tanh, softrelu, gelu, silu, ...)."""
     return {
         "relu": lambda x: jnp.maximum(x, 0),
         "sigmoid": jax.nn.sigmoid,
@@ -350,6 +367,8 @@ def _activation(data, act_type="relu"):
 @register_op("LeakyReLU", aliases=("leaky_relu",))
 def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
                 lower_bound=0.125, upper_bound=0.334):
+    """Leaky-ReLU family: leaky/prelu/elu/selu/gelu/rrelu (rrelu uses
+    the deterministic midpoint slope, the reference's inference path)."""
     if act_type == "leaky":
         return jnp.where(data >= 0, data, slope * data)
     if act_type == "prelu":
@@ -375,6 +394,8 @@ def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
 
 @register_op("softmax")
 def _softmax(data, axis=-1, temperature=None, length=None):
+    """Softmax over ``axis`` with optional temperature and per-row valid
+    ``length`` masking."""
     x = data / temperature if temperature else data
     if length is not None:
         pos = jnp.arange(x.shape[axis])
@@ -387,12 +408,15 @@ def _softmax(data, axis=-1, temperature=None, length=None):
 
 @register_op("log_softmax")
 def _log_softmax(data, axis=-1, temperature=None):
+    """Numerically-stable log(softmax) over ``axis`` with optional
+    temperature."""
     x = data / temperature if temperature else data
     return jax.nn.log_softmax(x, axis=axis)
 
 
 @register_op("softmin")
 def _softmin(data, axis=-1):
+    """Softmax of the negated input (small values get large weights)."""
     return jax.nn.softmax(-data, axis=axis)
 
 
@@ -450,6 +474,9 @@ def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1,
 
 @register_op("Dropout", aliases=("dropout",))
 def _dropout(data, key, p=0.5, mode="training", axes=(), _train=False):
+    """Inverted dropout: zero with probability p and rescale by 1/(1-p)
+    in training (``axes`` broadcast one shared mask); identity in
+    inference unless mode='always'."""
     apply_it = (mode == "always") or _train
     if not apply_it or p == 0.0:
         return data
@@ -468,6 +495,8 @@ def _dropout(data, key, p=0.5, mode="training", axes=(), _train=False):
 @register_op("Embedding", aliases=("embedding",))
 def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
                sparse_grad=False):
+    """Integer-index row lookup into the (input_dim, output_dim) weight
+    table, out-of-range indices clipped."""
     idx = data.astype(jnp.int32)
     return jnp.take(weight, idx, axis=0, mode="clip")
 
@@ -478,11 +507,14 @@ def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
 
 @register_op("MakeLoss", aliases=("make_loss",))
 def _make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    """Mark a symbol as a loss head: identity forward, gradient of 1
+    flows back (ref: make_loss.cc)."""
     return data
 
 
 @register_op("stop_gradient", aliases=("BlockGrad", "block_grad"))
 def _stop_gradient(data):
+    """Identity forward, zero gradient back (ref: BlockGrad)."""
     return lax.stop_gradient(data)
 
 
@@ -616,6 +648,8 @@ def _grid_sample_bilinear(data, grid):
 
 @register_op("BilinearSampler", aliases=("bilinear_sampler",))
 def _bilinear_sampler(data, grid, cudnn_off=False):
+    """Sample NCHW data at normalized grid coords ([-1, 1]) with
+    bilinear interpolation, zero padding outside (ref: STN sampler)."""
     return _grid_sample_bilinear(data, grid)
 
 
@@ -666,16 +700,20 @@ def _spatial_transformer(data, loc, target_shape=(0, 0),
 
 @register_op("hard_sigmoid")
 def _hard_sigmoid(data, alpha=0.2, beta=0.5):
+    """Piecewise-linear sigmoid: clip(alpha * x + beta, 0, 1)."""
     return jnp.clip(alpha * data + beta, 0.0, 1.0)
 
 
 @register_op("hard_swish")
 def _hard_swish(data):
+    """x * hard_sigmoid(x) with the MobileNetV3 constants (x * clip(
+    x/6 + 0.5, 0, 1))."""
     return data * jnp.clip(data / 6.0 + 0.5, 0.0, 1.0)
 
 
 @register_op("mish")
 def _mish(data):
+    """Mish activation: x * tanh(softplus(x))."""
     return data * jnp.tanh(jax.nn.softplus(data))
 
 
@@ -722,6 +760,8 @@ def _regression_head(name, fwd, bwd_grad):
 
     @register_op(name, aliases=(snake,))
     def head(data, label, grad_scale=1.0):
+        """Regression output head: forward transform of data, backward
+        (out - label) * grad_scale / batch (ref: regression_output-inl.h)."""
         return core(data, label, float(grad_scale))
 
     return head
@@ -791,6 +831,8 @@ def _im2col_impl(data, kernel, stride, dilate, pad):
 
 @register_op("im2col")
 def _im2col(data, kernel=(), stride=(), dilate=(), pad=()):
+    """Unfold sliding kernel patches of NCHW data into columns
+    (N, C*prod(kernel), L) (ref: im2col.h)."""
     return _im2col_impl(data, kernel, stride, dilate, pad)
 
 
@@ -821,6 +863,8 @@ def _col2im(data, output_size=(), kernel=(), stride=(), dilate=(),
 @register_op("Correlation", aliases=("correlation",))
 def _correlation(data1, data2, kernel_size=1, max_displacement=1,
                  stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation layer: per-displacement patch similarity of
+    two NCHW feature maps over a (2d+1)^2 window."""
     if kernel_size != 1 or stride1 != 1 or stride2 != 1:
         raise MXNetError("Correlation: this build supports "
                          "kernel_size=1, stride1=1, stride2=1")
@@ -864,6 +908,8 @@ def _deformable_convolution(data, offset, weight, bias=None, kernel=(),
                             stride=(), dilate=(), pad=(), num_filter=0,
                             num_group=1, num_deformable_group=1,
                             no_bias=False, layout=None, workspace=1024):
+    """Deformable convolution v1: bilinear-sample inputs at learned
+    per-position offsets, then convolve (ref: deformable_convolution)."""
     if num_group != 1 or num_deformable_group != 1:
         raise MXNetError("DeformableConvolution: this build supports "
                          "num_group=num_deformable_group=1")

@@ -230,6 +230,8 @@ def _quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
 @register_op("_contrib_quantized_flatten", aliases=("quantized_flatten",),
              num_outputs=3, differentiable=False)
 def _quantized_flatten(data, min_data, max_data):
+    """Flatten quantized data to (N, -1), passing the min/max calibration
+    scalars through unchanged."""
     return (data.reshape((data.shape[0], -1)), min_data.reshape(()),
             max_data.reshape(()))
 

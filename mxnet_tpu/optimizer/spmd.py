@@ -570,6 +570,18 @@ class SpmdUpdater(Updater):
         # capture must not turn the guard off.  MXNET_COMM_OVERLAP
         # outranks the phased variant: serializing the stages would
         # un-overlap exactly what the lane measures.
+        # schedule-ledger record: ONE entry per step dispatch (the
+        # fused program carries every bucket collective), logged before
+        # the dispatch so a divergent rank that wedges inside the
+        # program has already published what it entered.  The overlap
+        # variant additionally records its per-bucket reduce dispatches
+        # (its collectives are separate programs).
+        from ..parallel import schedule as _schedule
+
+        _schedule.record(
+            "spmd.step", "fused-step",
+            str(metas[0].dtype) if metas else "",
+            sum(m.size * np.dtype(m.dtype).itemsize for m in metas))
         if self._overlap and self._flat and hm is None and plan.buckets:
             new_w, new_s = self._run_overlap(sig, args, mp_flags,
                                              metas, qbis)
@@ -1074,10 +1086,14 @@ class SpmdUpdater(Updater):
         nb = len(plan.buckets)
         bparts = [None] * nb
         new_gres = [None] * len(qbis)
+        from ..parallel import schedule as _schedule
+
         with _tracing.span("reduce-scatter", cat="training",
                            metric=_phase_metric("reduce-scatter")):
             for bi in reversed(range(nb)):      # gradient-ready order
                 j = qpos.get(bi)
+                _schedule.record("spmd.reduce_bucket", "reduce-scatter",
+                                 "", int(plan.buckets[bi].total))
                 out = bucket_fns[bi](
                     tuple(gstacks[p] for p in plan.buckets[bi].pos),
                     states[2][j] if j is not None else None, qmult)

@@ -21,6 +21,7 @@ from __future__ import annotations
 import functools
 import os
 import threading
+import time
 from typing import Optional
 
 import jax
@@ -29,9 +30,10 @@ import numpy as np
 from ..base import MXNetError
 from ..resilience import chaos as _chaos
 from ..resilience import retry as _retry
-from ..resilience.elastic import PeerFailed
+from ..resilience.elastic import PeerFailed, ScheduleDivergence
 from ..telemetry import instruments as _ins
 from ..telemetry import tracing as _tracing
+from . import schedule as _schedule
 
 __all__ = ["init", "initialized", "rank", "num_workers", "barrier",
            "allreduce_nd", "allgather_np", "abort"]
@@ -173,6 +175,25 @@ def _run_with_watchdog(fn, timeout: Optional[float], what: str):
         # poison all further collectives so a caller that swallows the
         # error cannot silently desynchronize the collective sequence
         _POISONED = what
+        # before concluding "dead peer": compare collective schedules.
+        # A hang where the peers issued DIFFERENT collectives is a
+        # deterministic program bug (MX019/MX020 class) — restarting
+        # replays it, so it must not classify as PeerFailed.
+        div = _schedule.divergence_details()
+        if div is not None:
+            _ins.schedule_divergence_total(what).inc()
+            raise ScheduleDivergence(
+                f"collective '{what}' timed out after {timeout:.1f}s "
+                f"on rank {jax.process_index()}/{jax.process_count()} "
+                f"because the collective schedules diverged at seq "
+                f"{div['seq']}: this rank issued {div['mine']} while "
+                f"rank {div['peer']} issued {div['theirs']}. This is "
+                f"a deterministic program bug (rank-/data-dependent "
+                f"collective schedule) — do NOT restart; fix the "
+                f"program (mxlint MX019/MX020 flags the static "
+                f"class).", what=what, seq=div["seq"],
+                mine=div["mine"], theirs=div["theirs"],
+                peer=div["peer"])
         raise PeerFailed(
             f"collective '{what}' timed out after {timeout:.1f}s on "
             f"rank {jax.process_index()}/{jax.process_count()}: a peer "
@@ -186,7 +207,8 @@ def _run_with_watchdog(fn, timeout: Optional[float], what: str):
     return result[0]
 
 
-def _resilient(fn, timeout: Optional[float], what: str, site: str):
+def _resilient(fn, timeout: Optional[float], what: str, site: str,
+               op: str = "", dtype: str = "", nbytes: int = 0):
     """One collective under the full resilience stack: each ATTEMPT is
     a chaos-probed collective under the watchdog; transient failures
     (injected faults, or infra errors marked ``transient``) retry under
@@ -194,12 +216,39 @@ def _resilient(fn, timeout: Optional[float], what: str, site: str):
     watchdog timeout — which poisons the collective sequence — is NOT
     transient and fails immediately.
 
+    The schedule-ledger record happens HERE, once per logical
+    collective and before the attempt (the schedule is what this rank
+    *issues*), so retries cannot shift its seq numbering off its
+    peers'.  A ``dist.divergence`` chaos fire records a corrupted
+    entry instead and stalls inside the watchdog window — simulating
+    a rank that entered a *different* collective — so the real
+    timeout-and-compare machinery is what reclassifies the failure.
+
     The chaos probe runs INSIDE the watchdog window, so a ``hang``
     plan stalls the collective exactly like a dead peer would and the
     real timeout machinery (watchdog fire, sequence poisoning) is what
     gets exercised."""
+    op = op or what
+    diverge = _chaos._ACTIVE and \
+        _chaos.check("dist.divergence") == "corrupt"
+    if diverge:
+        _schedule.record(site, op + "!divergent", dtype, nbytes)
+        _schedule.publish(force=True)
+    else:
+        _schedule.record(site, op, dtype, nbytes)
 
     def probed():
+        if diverge:
+            # this rank "entered a different collective": never join
+            # the real one, let the watchdog fire and the schedule
+            # compare reclassify.  Bounded so a misconfigured run
+            # (no watchdog timeout) cannot deadlock forever.
+            t = _collective_timeout(timeout)
+            time.sleep(4.0 * t if t else 60.0)
+            raise PeerFailed(
+                f"collective '{what}' divergence stall elapsed with "
+                f"no watchdog configured (set {_TIMEOUT_ENV})",
+                what=what)
         if _chaos._ACTIVE:
             _chaos.check("dist.collective")
         return fn()
@@ -208,10 +257,30 @@ def _resilient(fn, timeout: Optional[float], what: str, site: str):
         lambda: _run_with_watchdog(probed, timeout, what), site=site)
 
 
-def _guard_single(site: str) -> None:
-    """Chaos + retry coverage for the single-process short-circuits, so
-    injection tests exercise the retry machinery without a multi-host
-    job.  Free when chaos is off (one falsy check)."""
+def _guard_single(site: str, op: str = "", dtype: str = "",
+                  nbytes: int = 0) -> None:
+    """Chaos + retry + schedule-ledger coverage for the single-process
+    short-circuits, so injection tests exercise the retry machinery —
+    and the divergence compare, against stamp files a test fakes —
+    without a multi-host job.  Free when chaos is off and the ledger
+    is off (two falsy checks)."""
+    op = op or site.rsplit(".", 1)[-1]
+    if _chaos._ACTIVE and _chaos.check("dist.divergence") == "corrupt":
+        _schedule.record(site, op + "!divergent", dtype, nbytes)
+        _schedule.publish(force=True)
+        div = _schedule.divergence_details()
+        if div is not None:
+            _ins.schedule_divergence_total(site).inc()
+            raise ScheduleDivergence(
+                f"collective '{site}' diverged at seq {div['seq']}: "
+                f"this rank issued {div['mine']} while rank "
+                f"{div['peer']} issued {div['theirs']} — "
+                f"deterministic program bug (MX019/MX020 class), do "
+                f"not restart.", what=site, seq=div["seq"],
+                mine=div["mine"], theirs=div["theirs"],
+                peer=div["peer"])
+    else:
+        _schedule.record(site, op, dtype, nbytes)
     if _chaos._ACTIVE:
         _retry.default_policy().call(
             lambda: _chaos.check("dist.collective"), site=site)
@@ -328,7 +397,7 @@ def barrier(name: str = "mxnet_tpu_barrier",
 
     _resilient(
         lambda: multihost_utils.sync_global_devices(name), timeout,
-        f"barrier:{name}", "dist.barrier")
+        f"barrier:{name}", "dist.barrier", op="barrier")
 
 
 @_collective_span("allgather")
@@ -340,9 +409,11 @@ def allgather_np(value: np.ndarray,
         return np.asarray(value)[None]
     from jax.experimental import multihost_utils
 
+    v = np.asarray(value)
     return _resilient(
-        lambda: np.asarray(multihost_utils.process_allgather(value)),
-        timeout, "allgather", "dist.allgather")
+        lambda: np.asarray(multihost_utils.process_allgather(v)),
+        timeout, "allgather", "dist.allgather", op="allgather",
+        dtype=str(v.dtype), nbytes=int(v.nbytes))
 
 
 _DCN_MESH = None
@@ -400,7 +471,10 @@ def _allreduce_device(x, timeout: Optional[float] = None):
         jax.block_until_ready(out)
         return out.addressable_data(0)
 
-    return _resilient(_go, timeout, "allreduce", "dist.allreduce")
+    return _resilient(
+        _go, timeout, "allreduce", "dist.allreduce", op="allreduce",
+        dtype=str(garr.dtype),
+        nbytes=int(garr.size) * int(np.dtype(garr.dtype).itemsize))
 
 
 @_collective_span("allreduce")

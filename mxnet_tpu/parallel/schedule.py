@@ -1,0 +1,278 @@
+"""Runtime collective-schedule ledger — the dynamic half of mxrank.
+
+The static rules (MX019/MX020, ``analysis/mxrank/``) prevent
+rank-divergent collective schedules at lint time; this module catches
+the instances that survive.  Every collective site appends
+``(site, op, dtype, nbytes, seq)`` to a bounded rolling fingerprint —
+one deque append when the ledger is on, one boolean check when off —
+and each rank piggybacks its last-K window on the elastic heartbeat
+seam as an atomic ``sched-rank<k>.json`` stamp next to the
+``hb-rank<k>.json`` liveness stamps.
+
+On a collective watchdog timeout the ``PeerFailed`` path first calls
+:func:`divergence_details`: publish our fingerprint, poll the peers'
+stamps for a bounded wait, and align the overlapping windows by
+``seq``.  Same seq + different ``(site, op, dtype, nbytes)`` means the
+ranks issued different collectives — a deterministic program bug the
+supervisor must NOT restart-loop on — and the failure reclassifies to
+``ScheduleDivergence``.  A peer that is merely *behind* (shorter
+window, all overlapping entries equal) stays a ``PeerFailed``: that is
+a dead or stalled peer, and restarting is the right reaction.
+
+Knobs: ``MXNET_RANKCHECK`` (master switch, default on),
+``MXNET_RANKCHECK_WINDOW`` (entries kept), ``MXNET_RANKCHECK_WAIT_S``
+(timeout-path poll bound).  See docs/resilience.md (Schedule
+divergence).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+__all__ = ["stamp_name", "enabled", "record", "fingerprint", "publish",
+           "read_peer", "compare", "divergence_details", "configure",
+           "reset"]
+
+_PREFIX = "sched-rank"
+
+_lock = threading.Lock()
+#: tri-state master switch: None = not yet resolved from MXNET_RANKCHECK
+_ON: Optional[bool] = None
+_window: Optional[Deque[Tuple[str, str, str, int, int]]] = None
+_seq = 0
+_published_seq = -1
+#: explicit configure() beats the elastic env contract
+_dir: Optional[str] = None
+_rank: Optional[int] = None
+_gauge = None
+
+
+def stamp_name(rank: int) -> str:
+    return f"{_PREFIX}{rank}.json"
+
+
+def enabled() -> bool:
+    """Ledger on?  Resolved once from ``MXNET_RANKCHECK``; after that
+    this is the one boolean check the ledger-off path pays."""
+    global _ON
+    if _ON is None:
+        from ..util import env
+
+        _ON = bool(env.get_bool("MXNET_RANKCHECK"))
+    return _ON
+
+
+def configure(directory: Optional[str] = None,
+              rank: Optional[int] = None) -> None:
+    """Pin the stamp directory / rank explicitly (the heartbeat writer
+    does this; outside an elastic job the env contract is absent)."""
+    global _dir, _rank
+    if directory is not None:
+        _dir = os.path.abspath(directory)
+    if rank is not None:
+        _rank = int(rank)
+
+
+def reset() -> None:
+    """Test hook: drop the ledger and re-resolve every lazy global."""
+    global _ON, _window, _seq, _published_seq, _dir, _rank, _gauge
+    with _lock:
+        _ON = None
+        _window = None
+        _seq = 0
+        _published_seq = -1
+        _dir = None
+        _rank = None
+        _gauge = None
+
+
+def _ensure_window() -> Deque[Tuple[str, str, str, int, int]]:
+    global _window
+    if _window is None:
+        from ..util import env
+
+        n = env.get_int("MXNET_RANKCHECK_WINDOW") or 256
+        _window = deque(maxlen=max(8, n))
+    return _window
+
+
+def _resolve_dir() -> Optional[str]:
+    if _dir is not None:
+        return _dir
+    from ..util import env
+
+    return env.get_str("MXNET_ELASTIC_DIR") or None
+
+
+def _resolve_rank() -> Optional[int]:
+    if _rank is not None:
+        return _rank
+    for name in ("MXNET_ELASTIC_RANK", "DMLC_WORKER_ID", "PROCESS_ID"):
+        v = os.environ.get(name)
+        if v is not None:
+            try:
+                return int(v)
+            except ValueError:
+                continue
+    return None
+
+
+def _set_gauge(seq: int) -> None:
+    global _gauge
+    if _gauge is None:
+        try:
+            from ..telemetry import instruments as _ins
+
+            _gauge = _ins.collective_schedule_seq()
+        except Exception:
+            return
+    _gauge.set(seq)
+
+
+def record(site: str, op: str, dtype: str = "", nbytes: int = 0) -> int:
+    """Append one collective issue to the ledger; returns its seq (or
+    -1 with the ledger off).  Called once per *logical* collective —
+    before the attempt, outside the retry loop — so a transient-fault
+    retry on one rank cannot shift its seq numbering off its peers'."""
+    if not enabled():
+        return -1
+    global _seq
+    with _lock:
+        seq = _seq
+        _seq += 1
+        _ensure_window().append((site, op, dtype, int(nbytes), seq))
+    _set_gauge(seq)
+    return seq
+
+
+def fingerprint() -> dict:
+    """The publishable view: rank, next seq, rolling window, digest."""
+    with _lock:
+        win: List[list] = [list(e) for e in (_window or ())]
+        seq = _seq
+    h = hashlib.sha1()
+    for e in win:
+        h.update(f"{e[0]}|{e[1]}|{e[2]}|{e[3]}|{e[4]}\n".encode())
+    return {"rank": _resolve_rank(), "seq": seq, "window": win,
+            "digest": h.hexdigest()[:16]}
+
+
+def publish(force: bool = False) -> bool:
+    """Atomically stamp this rank's fingerprint into the shared
+    directory (tmp-write + ``os.replace``, like the heartbeat).  Skips
+    the write when nothing was recorded since the last publish unless
+    ``force``; best-effort like the heartbeat — a flaky filesystem
+    must never fail the step that carried the piggyback."""
+    if not enabled():
+        return False
+    d, r = _resolve_dir(), _resolve_rank()
+    if d is None or r is None:
+        return False
+    global _published_seq
+    fp = fingerprint()
+    if not force and fp["seq"] == _published_seq:
+        return False
+    path = os.path.join(d, stamp_name(r))
+    tmp = os.path.join(d, f".tmp-{stamp_name(r)}")
+    try:
+        with open(tmp, "w") as f:
+            json.dump(fp, f)
+        os.replace(tmp, path)
+    except OSError:
+        return False  # mxlint: disable=MX007 — piggyback is best-effort
+    _published_seq = fp["seq"]
+    return True
+
+
+def read_peer(rank: int,
+              directory: Optional[str] = None) -> Optional[dict]:
+    d = directory or _resolve_dir()
+    if d is None:
+        return None
+    try:
+        with open(os.path.join(d, stamp_name(rank))) as f:
+            fp = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return fp if isinstance(fp, dict) else None
+
+
+def _trail(fp: dict, seq: int, k: int = 5) -> List[str]:
+    """The last ``k`` schedule entries up to and including ``seq``,
+    rendered ``op@seq(site)`` — what the divergence error names."""
+    win = [e for e in fp.get("window", ()) if e[4] <= seq]
+    return [f"{e[1]}@{e[4]}({e[0]})" for e in win[-k:]]
+
+
+def compare(mine: dict, theirs: dict) -> Optional[dict]:
+    """Align the two windows by seq; first overlapping seq whose
+    ``(site, op, dtype, nbytes)`` differs is the divergence.  Returns
+    ``{"seq", "peer", "mine", "theirs"}`` or None when every
+    overlapping entry matches (a peer merely behind is NOT divergent —
+    that is what PeerFailed is for)."""
+    a = {e[4]: e for e in mine.get("window", ())}
+    b = {e[4]: e for e in theirs.get("window", ())}
+    for q in sorted(set(a) & set(b)):
+        if tuple(a[q][:4]) != tuple(b[q][:4]):
+            return {"seq": q, "peer": theirs.get("rank"),
+                    "mine": _trail(mine, q), "theirs": _trail(theirs, q)}
+    return None
+
+
+def _peer_ranks(d: str, me: int) -> List[int]:
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        if name.startswith(_PREFIX) and name.endswith(".json"):
+            try:
+                r = int(name[len(_PREFIX):-len(".json")])
+            except ValueError:
+                continue
+            if r != me:
+                out.append(r)
+    return sorted(out)
+
+
+def divergence_details(wait_s: Optional[float] = None
+                       ) -> Optional[dict]:
+    """The watchdog-timeout hook: publish our fingerprint, then poll
+    the peers' stamps for up to ``wait_s`` (MXNET_RANKCHECK_WAIT_S)
+    comparing windows.  First mismatch wins; None means no divergence
+    evidence surfaced in time and the timeout stays a PeerFailed."""
+    if not enabled():
+        return None
+    d, me = _resolve_dir(), _resolve_rank()
+    if d is None or me is None:
+        return None
+    publish(force=True)
+    if wait_s is None:
+        from ..util import env
+
+        w = env.get_float("MXNET_RANKCHECK_WAIT_S")
+        wait_s = 3.0 if w is None else w
+    deadline = time.monotonic() + max(0.0, wait_s)
+    mine = fingerprint()
+    settled = set()  # peers whose window already reached our seq
+    while True:
+        for r in _peer_ranks(d, me):
+            if r in settled:
+                continue
+            fp = read_peer(r, d)
+            if fp is None:
+                continue
+            div = compare(mine, fp)
+            if div is not None:
+                return div
+            if fp.get("seq", -1) >= mine["seq"]:
+                settled.add(r)
+        if time.monotonic() >= deadline:
+            return None
+        time.sleep(0.1)

@@ -117,6 +117,7 @@ _DEFAULT_ACTION = {"trainer.preempt": "preempt",
                    "dataloader.worker": "die",
                    "trainer.numerics": "corrupt",
                    "comm.quant": "corrupt",
+                   "dist.divergence": "corrupt",
                    "elastic.worker": "die"}
 
 #: This process's job rank for `rank=`-selected plans.  Stamped by

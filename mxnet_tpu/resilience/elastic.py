@@ -54,7 +54,8 @@ from . import preemption
 from .preemption import Preempted
 
 __all__ = [
-    "PeerFailed", "RC_PEER_FAILED", "RC_WINDDOWN", "RESERVED_RCS",
+    "PeerFailed", "ScheduleDivergence", "RC_PEER_FAILED",
+    "RC_WINDDOWN", "RC_DIVERGENCE", "RESERVED_RCS",
     "enabled", "rank", "world", "shared_dir", "install_winddown",
     "guard", "WorkerContext", "elect_commit", "read_commit",
     "committed_resume_path", "scan_rank_checkpoints", "Supervisor",
@@ -65,9 +66,13 @@ __all__ = [
 #: sequence), checkpointed where possible, and got out of the way.
 #: 44 — supervisor-initiated wind-down (SIGTERM observed at a step
 #: boundary, sync checkpoint cut by the preemption seam).
+#: 45 — the watchdog-timeout schedule compare proved the ranks issued
+#: DIFFERENT collective schedules (mxrank): a deterministic program
+#: bug the supervisor must not burn restart budget replaying.
 RC_PEER_FAILED = 43
 RC_WINDDOWN = 44
-RESERVED_RCS = (RC_PEER_FAILED, RC_WINDDOWN)
+RC_DIVERGENCE = 45
+RESERVED_RCS = (RC_PEER_FAILED, RC_WINDDOWN, RC_DIVERGENCE)
 
 _COMMIT_NAME = "COMMIT.json"
 _RANK_DIR_PREFIX = "rank"
@@ -91,6 +96,37 @@ class PeerFailed(MXNetError):
 
     def __reduce__(self):
         return (PeerFailed, (str(self), self.what, self.poisoned))
+
+
+class ScheduleDivergence(MXNetError):
+    """The watchdog fired AND the cross-rank schedule compare proved
+    the ranks issued *different* collectives at the same sequence
+    index — the deterministic rank-/data-divergent control-flow bug
+    class the static MX019/MX020 rules flag at lint time
+    (``parallel/schedule.py`` is the runtime ledger behind the
+    compare).  NOT transient, and unlike :class:`PeerFailed` a
+    restart cannot help: every generation replays the same divergent
+    schedule, so the supervisor treats this as job-fatal without
+    consuming restart budget.  Deliberately a SIBLING of PeerFailed,
+    not a subclass — ``except PeerFailed`` recovery paths must never
+    swallow a program bug as a dead peer."""
+
+    transient = False
+
+    def __init__(self, msg: str, what: str = "",
+                 seq: Optional[int] = None, mine=None, theirs=None,
+                 peer: Optional[int] = None):
+        super().__init__(msg)
+        self.what = what
+        self.seq = seq          # first divergent seq index
+        self.mine = list(mine or ())    # this rank's site trail
+        self.theirs = list(theirs or ())  # the peer's site trail
+        self.peer = peer
+
+    def __reduce__(self):
+        return (ScheduleDivergence,
+                (str(self), self.what, self.seq, self.mine,
+                 self.theirs, self.peer))
 
 
 # ---------------------------------------------------------------------------
@@ -160,6 +196,10 @@ def guard(auto_ckpt=None, exit_fn=None):
         boundary (the failed collective never wrote back, so the
         parameters ARE the last boundary), stamped ``peer_failure``,
         then exit ``RC_PEER_FAILED``;
+      * :class:`ScheduleDivergence` (the timeout's schedule compare
+        proved a program bug, not a dead peer) — same best-effort
+        checkpoint, then exit ``RC_DIVERGENCE`` so the supervisor
+        aborts the job instead of burning restarts replaying it;
       * :class:`Preempted` (supervisor wind-down observed at a step
         boundary; the seam already saved synchronously) — exit
         ``RC_WINDDOWN``.
@@ -171,6 +211,24 @@ def guard(auto_ckpt=None, exit_fn=None):
     ex = exit_fn or _hard_exit
     try:
         yield
+    except ScheduleDivergence as e:
+        if _bb._ACTIVE:
+            _bb.emit("elastic",
+                     f"schedule divergence: {e.what or 'collective'}",
+                     seq=e.seq, peer=e.peer)
+        if auto_ckpt is not None:
+            try:
+                auto_ckpt.stamp_failure(f"schedule-divergence: {e}")
+                auto_ckpt.save(sync=True)
+            except BaseException as save_err:  # noqa: BLE001
+                print(f"[mxelastic] divergence checkpoint failed: "
+                      f"{save_err}", file=sys.stderr, flush=True)
+        if _bb._ACTIVE:
+            _bb.write_crash_bundle(
+                "schedule_divergence", reason=str(e), exc=e,
+                exit_record={"rc": RC_DIVERGENCE, "seq": e.seq,
+                             "mine": e.mine, "theirs": e.theirs})
+        ex(RC_DIVERGENCE)
     except PeerFailed as e:
         if _bb._ACTIVE:
             _bb.emit("elastic",
@@ -678,6 +736,8 @@ class Supervisor:
                 classified = "peer_failed"
             elif rc == RC_WINDDOWN:
                 classified = "winddown"
+            elif rc == RC_DIVERGENCE:
+                classified = "divergence"
             elif sig is not None:
                 # killed from OUTSIDE the supervisor: OOM killer,
                 # operator kill, a segfault's SIGSEGV
@@ -787,6 +847,37 @@ class Supervisor:
                 report["ok"] = True
                 report["final_world"] = n
                 return report
+            diverged = sorted(
+                int(r) for r, e in res.get("exits", {}).items()
+                if e.get("classified") == "divergence")
+            if diverged:
+                # a schedule divergence is a deterministic program
+                # bug: every restart replays the identical divergent
+                # collective sequence, so the job is fatal NOW — zero
+                # restarts consumed, budget untouched
+                incident = self._postmortem(
+                    report["restarts"] + 1, gen, res)
+                report["epochs"].append({
+                    "failed_ranks": res["failed"],
+                    "rcs": {str(k): v for k, v in res["rcs"].items()},
+                    "exits": res.get("exits", {}),
+                    "incident_id": incident.get("incident_id")
+                    if incident else None,
+                    "world_before": n,
+                    "mttr_s": None,
+                    "schedule_divergence": True,
+                    "diverged_ranks": diverged,
+                    "log_tails": res["tails"],
+                })
+                report["final_world"] = n
+                report["error"] = (
+                    f"schedule divergence on rank(s) {diverged}: the "
+                    "ranks issued different collective sequences — a "
+                    "deterministic program bug (see MX019/MX020); "
+                    "restarting would replay it, job aborted with 0 "
+                    "restarts consumed")
+                _ins.elastic_restarts_total("aborted").inc()
+                return report
             report["restarts"] += 1
             # incident reconstruction BEFORE the commit election so
             # the marker (and through it every restarted rank's
@@ -811,6 +902,10 @@ class Supervisor:
                 report["error"] = (
                     f"restart budget ({self.max_restarts}) exhausted; "
                     f"job dead")
+                # job-fatal outcomes get their own counter label —
+                # reusing the recovery mode here would read as one
+                # more measured recovery when the job in fact died
+                _ins.elastic_restarts_total("aborted").inc()
                 return report
             if self.mode == "shrink":
                 # shrink by the ranks actually IDENTIFIED as failed;

@@ -61,6 +61,11 @@ class HeartbeatWriter:
         self._last_step: Optional[int] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # the schedule ledger (parallel/schedule.py) piggybacks its
+        # fingerprint stamps on this seam — same directory, same rank
+        from ..parallel import schedule as _schedule
+
+        _schedule.configure(self._dir, self.rank)
 
     def beat(self, step: Optional[int] = None) -> None:
         if step is not None:
@@ -76,6 +81,12 @@ class HeartbeatWriter:
             # happened to carry the heartbeat; a missed beat just ages
             # the stamp, which is the signal's own failure mode
             pass  # mxlint: disable=MX007 — liveness is best-effort by design
+        # piggyback: refresh this rank's collective-schedule fingerprint
+        # whenever its seq advanced (no-op with the ledger off; skipped
+        # internally when nothing was recorded since the last publish)
+        from ..parallel import schedule as _schedule
+
+        _schedule.publish()
 
     def start(self) -> "HeartbeatWriter":
         """Background mode: stamp every ``interval_s`` seconds from a
@@ -157,14 +168,20 @@ class HeartbeatMonitor:
 
     def clear(self) -> None:
         """Remove every stamp (the supervisor does this before each
-        generation so a dead generation's stamps cannot read as live)."""
+        generation so a dead generation's stamps cannot read as live).
+        Schedule-fingerprint stamps go too: seq numbering restarts at 0
+        in a new generation, so a stale fingerprint would compare as a
+        false divergence."""
+        from ..parallel import schedule as _schedule
+
+        prefixes = (_PREFIX, f".tmp-{_PREFIX}",
+                    _schedule._PREFIX, f".tmp-{_schedule._PREFIX}")
         try:
             names = os.listdir(self._dir)
         except OSError:
             return
         for name in names:
-            if name.startswith(_PREFIX) or \
-                    name.startswith(f".tmp-{_PREFIX}"):
+            if name.startswith(prefixes):
                 try:
                     os.remove(os.path.join(self._dir, name))
                 except OSError:

@@ -556,9 +556,22 @@ _spec("mx_rank_heartbeat_age_seconds", "gauge",
 _spec("mx_elastic_restarts_total", "counter",
       "Elastic-supervisor job restarts after a rank failure, by "
       "recovery mode ('replace' = same world size, 'shrink' = resume "
-      "onto the survivors). Growth is measured recovery, not mystery "
-      "badput — see mx_badput_seconds_total{category="
+      "onto the survivors, 'aborted' = a job-fatal outcome — restart "
+      "budget exhausted or a schedule divergence — that consumed NO "
+      "restart). Growth of the recovery modes is measured recovery, "
+      "not mystery badput — see mx_badput_seconds_total{category="
       "'rank_failure_recovery'}.", ("mode",))
+_spec("mx_collective_schedule_seq", "gauge",
+      "Next sequence index of the mxrank collective-schedule ledger "
+      "(parallel/schedule.py): how many collectives this process has "
+      "issued since start. Ranks drifting apart here while the job is "
+      "'healthy' is the early smoke of a divergent schedule.")
+_spec("mx_schedule_divergence_total", "counter",
+      "Watchdog timeouts the cross-rank schedule compare reclassified "
+      "as ScheduleDivergence, by collective site. Any nonzero value "
+      "is a deterministic program bug (rank-/data-divergent control "
+      "flow, the MX019/MX020 class) — the job aborts without "
+      "restarts; fix the program.", ("site",))
 
 
 def retry_total(site: str):
@@ -583,6 +596,14 @@ def rank_heartbeat_age_seconds(rank: str):
 
 def elastic_restarts_total(mode: str):
     return _child("mx_elastic_restarts_total", (mode,))
+
+
+def collective_schedule_seq():
+    return _child("mx_collective_schedule_seq")
+
+
+def schedule_divergence_total(site: str):
+    return _child("mx_schedule_divergence_total", (site,))
 
 
 # ---- compile cache ----------------------------------------------------

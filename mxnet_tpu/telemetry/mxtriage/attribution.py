@@ -36,7 +36,8 @@ _SIGNAL_KEYS = ("phase_seconds", "collective_bytes",
                 "collective_wire_bytes", "data_wait_s",
                 "data_wait_s_total", "mfu", "compiles",
                 "compile_reasons", "knobs", "knob_fingerprint",
-                "hlo_fingerprints", "badput_seconds", "goodput_ratio")
+                "hlo_fingerprints", "badput_seconds", "goodput_ratio",
+                "schedule_divergences")
 
 # kind weights: how alarming a 1.0 (=100%) relative change of each
 # signal is relative to the others
@@ -45,8 +46,11 @@ _WEIGHTS = {"phase": 1.0, "data-wait": 1.0, "mfu": 1.0, "badput": 1.0,
 # flat scores for qualitative suspects (no meaningful magnitude).
 # "encoding" is the comm-encoding knob (MXNET_COMM_QUANT...): a flipped
 # wire encoding changes numerics AND bytes at once, so it outranks a
-# generic knob change
-_FLAT = {"knob": 0.75, "program": 0.8, "encoding": 0.85}
+# generic knob change; "divergence" (mxrank ScheduleDivergence counts)
+# outranks everything qualitative — ranks issuing different collective
+# schedules is a correctness bug, not a perf drift
+_FLAT = {"knob": 0.75, "program": 0.8, "encoding": 0.85,
+         "divergence": 0.95}
 
 # knobs that select the collective wire encoding: their change is an
 # "encoding" suspect, not a plain "knob" one
@@ -194,6 +198,21 @@ def _diff_node(where: str, base: dict, fresh: dict,
                     "change": _pct(b, f),
                     "score": round(
                         rel * _WEIGHTS["collective-bytes"], 4)})
+    # schedule divergences (mxrank): any growth is a top suspect —
+    # a fresh run whose ranks issued different collective schedules
+    # has a program bug (MX019/MX020 class), whatever the perf says
+    bd, fd = base.get("schedule_divergences"), \
+        fresh.get("schedule_divergences")
+    if isinstance(fd, (int, float)) and \
+            fd > (bd if isinstance(bd, (int, float)) else 0):
+        suspects.append({
+            "kind": "divergence", "name": "schedule_divergences",
+            "where": where,
+            "base": int(bd) if isinstance(bd, (int, float)) else 0,
+            "fresh": int(fd),
+            "change": "collective schedules diverged across ranks "
+                      "(see mxlint MX019/MX020)",
+            "score": _FLAT["divergence"]})
     # compile-count growth = a recompile storm; name its cause when
     # the provenance aggregates rode along
     bc, fc = base.get("compiles"), fresh.get("compiles")

@@ -529,6 +529,26 @@ declare("MXNET_DRAIN_TIMEOUT_MS", float, 30000.0,
         "Hard deadline for InferenceServer.shutdown(drain=True): past "
         "it, still-queued requests fail with ServerClosed instead of "
         "the shutdown hanging forever on a wedged batch.")
+declare("MXNET_RANKCHECK", bool, True,
+        "Master switch of the runtime collective-schedule ledger "
+        "(parallel.schedule): every collective site appends "
+        "(site, op, dtype, nbytes, seq) to a rolling fingerprint, and "
+        "a collective watchdog timeout compares fingerprints across "
+        "ranks to reclassify schedule divergence (a deterministic "
+        "program bug — see mxlint MX019/MX020) as ScheduleDivergence "
+        "instead of burning restarts on PeerFailed. Off = one boolean "
+        "check per collective.")
+declare("MXNET_RANKCHECK_WINDOW", int, 256,
+        "Entries kept in the rolling collective-schedule fingerprint "
+        "window (minimum 8). Divergence older than the window on BOTH "
+        "ranks cannot be pinpointed; larger windows cost only memory "
+        "and stamp-file size.")
+declare("MXNET_RANKCHECK_WAIT_S", float, 3.0,
+        "How long the collective-watchdog timeout path polls peers' "
+        "schedule fingerprints before giving up and keeping the "
+        "PeerFailed classification. Bounded so a genuinely dead peer "
+        "(no fingerprint forthcoming) only delays the failure epoch "
+        "by this much.")
 declare("MXNET_RETRY_BASE_MS", float, 50.0,
         "Retry policy: first backoff delay in milliseconds (doubles "
         "per attempt, jittered ±50%, capped at MXNET_RETRY_MAX_MS).",

@@ -113,6 +113,7 @@ class TestOfflineCli:
         doc = json.loads(p.stdout)
         assert doc["counts"]["violations"] == 0
         assert doc["counts"]["parse_skipped"] == 1  # the corrupt entry
+        assert doc["counts"]["alias_skipped"] == 1  # the exec-tier entry
         assert any(pr["site"] == "test.site" for pr in doc["programs"])
 
     def test_seeded_cache_fails_with_findings(self, tmp_path):

@@ -1,0 +1,571 @@
+"""mxrank cross-rank collective-schedule verification (ISSUE 20):
+static divergence rules (MX019 rank-tainted, MX020 data-tainted) with
+seeded/clean fixture pairs over the mxflow taint lattice, the runtime
+schedule ledger (fingerprint encode/compare, publish/read round-trip,
+bounded window, off-switch cost), the watchdog-timeout reclassification
+(PeerFailed -> ScheduleDivergence only on fingerprint mismatch), and
+the supervisor's job-fatal-no-restart handling of a divergence exit."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from mxnet_tpu import analysis
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_source(tmp_path, source, enable=None, name="fixture.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    eng = analysis.LintEngine(root=str(tmp_path), enable=enable)
+    return eng.run([str(f)])
+
+
+def rules_hit(violations):
+    return sorted({v.rule for v in violations})
+
+
+# ---------------------------------------------------------------------------
+# MX019 — rank-divergent collective schedule
+# ---------------------------------------------------------------------------
+
+class TestMX019:
+    def test_flags_rank_gated_collective_in_hot_step(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            from mxnet_tpu.parallel import dist
+
+            class MyTrainer:
+                def step(self, grads):
+                    if dist.rank() == 0:
+                        dist.barrier("ckpt")
+                    dist.allreduce_nd(grads)
+            """, enable=["MX019"])
+        assert rules_hit(vs) == ["MX019"]
+        assert "barrier" in vs[0].message
+
+    def test_flags_rank_gated_early_return_skipping_collective(
+            self, tmp_path):
+        vs = lint_source(tmp_path, """
+            from mxnet_tpu.parallel import dist
+
+            class MyTrainer:
+                def step(self, grads):
+                    if dist.rank() != 0:
+                        return
+                    dist.allreduce_nd(grads)
+            """, enable=["MX019"])
+        assert rules_hit(vs) == ["MX019"]
+
+    def test_flags_env_rank_read_as_rank_source(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            from mxnet_tpu.parallel import dist
+            from mxnet_tpu.util import env
+
+            class MyTrainer:
+                def step(self, grads):
+                    r = env.get_int("MXNET_ELASTIC_RANK")
+                    if r == 0:
+                        dist.barrier("only-chief")
+                    dist.allreduce_nd(grads)
+            """, enable=["MX019"])
+        assert rules_hit(vs) == ["MX019"]
+
+    def test_flags_rank_divergent_loop_trip_count(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            from mxnet_tpu.parallel import dist
+
+            class MyTrainer:
+                def step(self, grads):
+                    for _ in range(dist.rank() + 1):
+                        dist.allreduce_nd(grads)
+            """, enable=["MX019"])
+        assert rules_hit(vs) == ["MX019"]
+
+    def test_clean_rank_gated_noncollective_work(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            from mxnet_tpu.parallel import dist
+
+            class MyTrainer:
+                def step(self, grads):
+                    if dist.rank() == 0:
+                        print("chief logging")
+                    dist.allreduce_nd(grads)
+            """, enable=["MX019"])
+        assert vs == []
+
+    def test_clean_symmetric_collectives_in_both_branches(self,
+                                                          tmp_path):
+        vs = lint_source(tmp_path, """
+            from mxnet_tpu.parallel import dist
+
+            class MyTrainer:
+                def step(self, grads, big):
+                    if dist.rank() % 2 == 0:
+                        dist.allreduce_nd(grads)
+                    else:
+                        dist.allreduce_nd(grads)
+            """, enable=["MX019"])
+        assert vs == []
+
+    def test_cold_scope_is_out_of_bounds(self, tmp_path):
+        # not hot, not parallel/*, not reachable from a hot step:
+        # mxrank must not flag offline tooling
+        vs = lint_source(tmp_path, """
+            from mxnet_tpu.parallel import dist
+
+            def offline_report():
+                if dist.rank() == 0:
+                    dist.barrier("report")
+            """, enable=["MX019"])
+        assert vs == []
+
+    def test_pragma_suppression(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            from mxnet_tpu.parallel import dist
+
+            class MyTrainer:
+                def step(self, grads):
+                    if dist.rank() == 0:  # mxlint: disable=MX019
+                        dist.barrier("ckpt")
+                    dist.allreduce_nd(grads)
+            """, enable=["MX019"])
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# MX020 — data-divergent collective schedule
+# ---------------------------------------------------------------------------
+
+class TestMX020:
+    def test_flags_loss_gated_early_return_before_collective(
+            self, tmp_path):
+        vs = lint_source(tmp_path, """
+            import math
+            from mxnet_tpu.parallel import dist
+
+            class MyTrainer:
+                def step(self, loss, grads):
+                    if math.isnan(loss):
+                        return
+                    dist.allreduce_nd(grads)
+            """, enable=["MX020"])
+        assert rules_hit(vs) == ["MX020"]
+
+    def test_clean_allreduced_predicate_skip_step_idiom(self,
+                                                        tmp_path):
+        # the mxhealth skip_step pattern: the predicate itself is
+        # all-reduced first, so every rank takes the same branch
+        vs = lint_source(tmp_path, """
+            import math
+            from mxnet_tpu.parallel import dist
+
+            class MyTrainer:
+                def step(self, loss, grads):
+                    bad = dist.allreduce_nd(math.isnan(loss))
+                    if bad:
+                        return
+                    dist.allreduce_nd(grads)
+            """, enable=["MX020"])
+        assert vs == []
+
+    def test_rank_taint_outranks_data_taint(self, tmp_path):
+        # a predicate that is BOTH rank- and data-tainted is MX019
+        vs = lint_source(tmp_path, """
+            from mxnet_tpu.parallel import dist
+
+            class MyTrainer:
+                def step(self, loss, grads):
+                    if dist.rank() == 0 and loss > 10.0:
+                        return
+                    dist.allreduce_nd(grads)
+            """, enable=["MX019", "MX020"])
+        assert rules_hit(vs) == ["MX019"]
+
+    def test_clean_data_branch_without_collectives(self, tmp_path):
+        vs = lint_source(tmp_path, """
+            from mxnet_tpu.parallel import dist
+
+            class MyTrainer:
+                def step(self, loss, grads):
+                    dist.allreduce_nd(grads)
+                    if loss > 10.0:
+                        self.overflow_count += 1
+            """, enable=["MX020"])
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# the taint lattice itself (fast unit surface)
+# ---------------------------------------------------------------------------
+
+class TestTaintLattice:
+    def _mt(self, src):
+        import ast
+
+        from mxnet_tpu.analysis.mxrank import ModuleTaint
+
+        return ModuleTaint(ast.parse(textwrap.dedent(src)))
+
+    def test_rank_and_data_param_seeding(self):
+        from mxnet_tpu.analysis.mxrank import DATA, RANK
+
+        mt = self._mt("""
+            def f(rank, loss):
+                a = rank + 1
+                b = loss * 2.0
+                c = a if b else rank
+                return c
+            """)
+        assert mt.return_taint("f") == (RANK | DATA)
+
+    def test_collective_sanitizes(self):
+        mt = self._mt("""
+            def f(loss):
+                import mxnet_tpu.parallel.dist as dist
+                ok = dist.allreduce_nd(loss)
+                return ok
+            """)
+        assert mt.return_taint("f") == 0
+
+    def test_helper_summary_propagates_taint(self):
+        from mxnet_tpu.analysis.mxrank import RANK
+
+        mt = self._mt("""
+            def who_am_i():
+                import jax
+                return jax.process_index()
+
+            def f():
+                return who_am_i() + 1
+            """)
+        assert mt.return_taint("f") == RANK
+
+    def test_divergence_names_the_branch_multisets(self):
+        mt = self._mt("""
+            def step(rank):
+                import mxnet_tpu.parallel.dist as dist
+                if rank == 0:
+                    dist.barrier("x")
+                dist.allreduce_nd(1)
+            """)
+        funcs = {name: node for name, cls, node in mt.functions()}
+        divs = mt.analyze("step", None, funcs["step"])
+        assert len(divs) == 1
+        msg = divs[0].describe()
+        assert "barrier" in msg and "allreduce" in msg
+
+
+# ---------------------------------------------------------------------------
+# runtime ledger: fingerprint encode / compare / publish
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def sched(tmp_path, monkeypatch):
+    from mxnet_tpu.parallel import schedule
+
+    monkeypatch.setenv("MXNET_RANKCHECK", "1")
+    schedule.reset()
+    schedule.configure(str(tmp_path), 0)
+    yield schedule
+    schedule.reset()
+
+
+class TestScheduleLedger:
+    def test_record_assigns_dense_seq_and_sets_gauge(self, sched):
+        assert sched.record("dist.allreduce", "allreduce",
+                            "float32", 4096) == 0
+        assert sched.record("dist.barrier", "barrier") == 1
+        fp = sched.fingerprint()
+        assert fp["seq"] == 2 and len(fp["window"]) == 2
+        assert fp["window"][0] == ["dist.allreduce", "allreduce",
+                                   "float32", 4096, 0]
+
+    def test_window_is_bounded(self, tmp_path, monkeypatch):
+        from mxnet_tpu.parallel import schedule
+
+        monkeypatch.setenv("MXNET_RANKCHECK", "1")
+        monkeypatch.setenv("MXNET_RANKCHECK_WINDOW", "8")
+        schedule.reset()
+        schedule.configure(str(tmp_path), 0)
+        try:
+            for i in range(50):
+                schedule.record("s", "op", "", i)
+            fp = schedule.fingerprint()
+            assert fp["seq"] == 50 and len(fp["window"]) == 8
+            assert fp["window"][0][4] == 42  # oldest retained seq
+        finally:
+            schedule.reset()
+
+    def test_digest_is_content_addressed(self, sched):
+        sched.record("s", "allreduce", "f32", 8)
+        a = sched.fingerprint()["digest"]
+        assert a == sched.fingerprint()["digest"]
+        sched.record("s", "barrier", "", 0)
+        assert sched.fingerprint()["digest"] != a
+
+    def test_publish_read_peer_roundtrip(self, sched, tmp_path):
+        sched.record("s", "allreduce", "f32", 8)
+        assert sched.publish(force=True)
+        fp = sched.read_peer(0, str(tmp_path))
+        assert fp["seq"] == 1 and fp["rank"] == 0
+        # unchanged seq -> publish skipped unless forced
+        assert sched.publish() is False
+
+    def test_compare_matching_and_behind_peer_are_none(self, sched):
+        for _ in range(3):
+            sched.record("s", "allreduce", "f32", 8)
+        mine = sched.fingerprint()
+        same = dict(mine, rank=1)
+        assert sched.compare(mine, same) is None
+        behind = {"rank": 1, "seq": 2,
+                  "window": mine["window"][:2]}
+        assert sched.compare(mine, behind) is None  # dead, not divergent
+
+    def test_compare_finds_first_divergent_seq(self, sched):
+        for _ in range(3):
+            sched.record("dist.allreduce", "allreduce", "f32", 8)
+        mine = sched.fingerprint()
+        theirs = {"rank": 1, "seq": 3, "window": [
+            ["dist.allreduce", "allreduce", "f32", 8, 0],
+            ["dist.barrier", "barrier", "", 0, 1],
+            ["dist.allreduce", "allreduce", "f32", 8, 2]]}
+        div = sched.compare(mine, theirs)
+        assert div["seq"] == 1 and div["peer"] == 1
+        assert "barrier@1" in " ".join(div["theirs"])
+
+    def test_off_switch_records_nothing(self, tmp_path, monkeypatch):
+        from mxnet_tpu.parallel import schedule
+
+        monkeypatch.setenv("MXNET_RANKCHECK", "0")
+        schedule.reset()
+        try:
+            assert schedule.record("s", "op") == -1
+            assert schedule.fingerprint()["seq"] == 0
+            assert schedule.publish(force=True) is False
+            assert schedule.divergence_details(wait_s=0.0) is None
+        finally:
+            schedule.reset()
+
+    def test_ledger_off_overhead_gate(self, monkeypatch):
+        """The tier-1 overhead gate: with MXNET_RANKCHECK=0 a record()
+        is one resolved boolean check.  Bound it ABSOLUTELY at 2us per
+        call (best of 5 trials): the cheapest real collective this
+        guards is ~100us+ of dispatch, so 2us keeps the ledger-off tax
+        well under the 3%% acceptance bar without a flaky A/B timing."""
+        from mxnet_tpu.parallel import schedule
+
+        monkeypatch.setenv("MXNET_RANKCHECK", "0")
+        schedule.reset()
+        try:
+            schedule.record("warm", "up")  # resolve _ON once
+            n = 100_000
+            best = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    schedule.record("dist.allreduce", "allreduce",
+                                    "float32", 4096)
+                best = min(best, time.perf_counter() - t0)
+            assert best / n < 2e-6, f"{best / n * 1e9:.0f}ns per call"
+        finally:
+            schedule.reset()
+
+
+# ---------------------------------------------------------------------------
+# the watchdog-timeout reclassification (single-process, fake peers)
+# ---------------------------------------------------------------------------
+
+class TestReclassification:
+    def _fake_peer(self, tmp_path, window, seq=None):
+        from mxnet_tpu.parallel import schedule
+
+        fp = {"rank": 1, "seq": seq if seq is not None
+              else (window[-1][4] + 1 if window else 0),
+              "window": window, "digest": "peer"}
+        with open(os.path.join(str(tmp_path),
+                               schedule.stamp_name(1)), "w") as f:
+            json.dump(fp, f)
+
+    def test_timeout_with_divergent_peer_raises_divergence(
+            self, sched, tmp_path, monkeypatch):
+        from mxnet_tpu.parallel import dist
+        from mxnet_tpu.resilience.elastic import ScheduleDivergence
+
+        monkeypatch.setenv("MXNET_RANKCHECK_WAIT_S", "0.5")
+        monkeypatch.setattr(dist, "_POISONED", None)
+        sched.record("dist.allreduce", "allreduce", "f32", 8)
+        self._fake_peer(tmp_path,
+                        [["dist.barrier", "barrier", "", 0, 0]])
+        with pytest.raises(ScheduleDivergence) as ei:
+            dist._run_with_watchdog(lambda: time.sleep(5.0), 0.2,
+                                    "allreduce")
+        assert ei.value.seq == 0 and ei.value.peer == 1
+        assert ei.value.transient is False
+        assert "MX019" in str(ei.value)
+        monkeypatch.setattr(dist, "_POISONED", None)
+
+    def test_timeout_with_matching_peer_stays_peerfailed(
+            self, sched, tmp_path, monkeypatch):
+        from mxnet_tpu.parallel import dist
+        from mxnet_tpu.resilience.elastic import PeerFailed
+
+        monkeypatch.setenv("MXNET_RANKCHECK_WAIT_S", "0.2")
+        monkeypatch.setattr(dist, "_POISONED", None)
+        sched.record("dist.allreduce", "allreduce", "f32", 8)
+        self._fake_peer(tmp_path,
+                        [["dist.allreduce", "allreduce", "f32", 8, 0]])
+        with pytest.raises(PeerFailed):
+            dist._run_with_watchdog(lambda: time.sleep(5.0), 0.2,
+                                    "allreduce")
+        monkeypatch.setattr(dist, "_POISONED", None)
+
+    def test_chaos_divergence_site_raises_on_single_process(
+            self, sched, tmp_path):
+        from mxnet_tpu.parallel import dist
+        from mxnet_tpu.resilience import chaos
+        from mxnet_tpu.resilience.elastic import ScheduleDivergence
+        from mxnet_tpu.telemetry import instruments as _ins
+
+        self._fake_peer(tmp_path,
+                        [["dist.allreduce", "allreduce", "", 0, 0]])
+        before = _ins.schedule_divergence_total("dist.allreduce").value
+        with chaos.inject("dist.divergence", at=1):
+            with pytest.raises(ScheduleDivergence) as ei:
+                dist._guard_single("dist.allreduce")
+        assert "!divergent" in " ".join(ei.value.mine)
+        assert _ins.schedule_divergence_total(
+            "dist.allreduce").value == before + 1
+        # the next collective records clean again
+        dist._guard_single("dist.allreduce")
+
+    def test_heartbeat_piggyback_publishes_and_clear_removes(
+            self, sched, tmp_path):
+        from mxnet_tpu.resilience.heartbeat import (HeartbeatMonitor,
+                                                    HeartbeatWriter)
+
+        w = HeartbeatWriter(str(tmp_path), rank=0)
+        sched.record("dist.allreduce", "allreduce", "f32", 8)
+        w.beat(step=1)
+        stamp = tmp_path / sched.stamp_name(0)
+        assert stamp.exists()
+        assert sched.read_peer(0, str(tmp_path))["seq"] == 1
+        HeartbeatMonitor(str(tmp_path)).clear()
+        assert not stamp.exists()  # new generation: no stale compares
+
+
+# ---------------------------------------------------------------------------
+# supervisor: a divergence exit is job-fatal with zero restarts
+# ---------------------------------------------------------------------------
+
+class TestSupervisorDivergence:
+    def _sup(self, tmp_path, **kw):
+        from mxnet_tpu.resilience import elastic
+
+        return elastic.Supervisor(
+            ["true"], world=2, directory=str(tmp_path),
+            hb_timeout_s=1.0, grace_s=0.5, poll_s=0.05, **kw)
+
+    def test_divergence_exit_aborts_without_restart(self, tmp_path,
+                                                    monkeypatch):
+        from mxnet_tpu.resilience.elastic import RC_DIVERGENCE
+        from mxnet_tpu.telemetry import instruments as _ins
+
+        sup = self._sup(tmp_path, max_restarts=3)
+        spawned = []
+        monkeypatch.setattr(sup, "_spawn",
+                            lambda gen, n: (spawned.append(n), [])[1])
+        monkeypatch.setattr(sup, "_watch", lambda *a, **kw: {
+            "ok": False, "failed": [], "rcs": {0: RC_DIVERGENCE, 1: 44},
+            "exits": {0: {"rc": RC_DIVERGENCE,
+                          "classified": "divergence"},
+                      1: {"rc": 44, "classified": "winddown"}},
+            "t_detect": 0.0, "t_detect_unix": 0.0,
+            "t_first_step": None, "tails": {}})
+        before = _ins.elastic_restarts_total("aborted").value
+        rep = sup.run()
+        assert rep["ok"] is False
+        assert rep["restarts"] == 0  # the budget was NOT consumed
+        assert spawned == [2]        # and no second generation spawned
+        assert "divergence" in rep["error"]
+        epoch = rep["epochs"][0]
+        assert epoch["schedule_divergence"] is True
+        assert epoch["diverged_ranks"] == [0]
+        assert _ins.elastic_restarts_total("aborted").value \
+            == before + 1
+
+    def test_exit_record_classifies_rc45_as_divergence(self):
+        from mxnet_tpu.resilience import elastic
+
+        class _P:
+            returncode = elastic.RC_DIVERGENCE
+
+            def poll(self):
+                return self.returncode
+
+        recs = elastic.Supervisor._exit_records(
+            [{"rank": 0, "proc": _P()}], killed=[])
+        assert recs["0"]["classified"] == "divergence"
+
+    def test_budget_exhaustion_emits_aborted_counter(self, tmp_path,
+                                                     monkeypatch):
+        """Regression (satellite bugfix): the budget-exhausted
+        job-dead path must count mode='aborted', not go unmetered."""
+        from mxnet_tpu.telemetry import instruments as _ins
+
+        sup = self._sup(tmp_path, max_restarts=0)
+        monkeypatch.setattr(sup, "_spawn", lambda gen, n: [])
+        monkeypatch.setattr(sup, "_watch", lambda *a, **kw: {
+            "ok": False, "failed": [0], "rcs": {0: 1, 1: 44},
+            "exits": {0: {"rc": 1, "classified": "died"},
+                      1: {"rc": 44, "classified": "winddown"}},
+            "t_detect": 0.0, "t_detect_unix": 0.0,
+            "t_first_step": None, "tails": {}})
+        before = _ins.elastic_restarts_total("aborted").value
+        rep = sup.run()
+        assert rep["ok"] is False and "budget" in rep["error"]
+        assert _ins.elastic_restarts_total("aborted").value \
+            == before + 1
+
+
+# ---------------------------------------------------------------------------
+# the real 2-process e2e (nightly mxrank stage)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_e2e_divergent_rank_is_classified_not_restarted(tmp_path):
+    """THE ISSUE 20 known-answer, live: chaos makes rank 1 of a REAL
+    2-process job enter a different collective at its 3rd site visit;
+    the honest rank's watchdog fires, the schedule fingerprints
+    disagree at one seq, BOTH ranks exit RC_DIVERGENCE (45), and the
+    supervisor aborts the job with ZERO restarts consumed instead of
+    burning the budget replaying a deterministic bug."""
+    from mxnet_tpu.resilience.elastic import RC_DIVERGENCE
+
+    out = str(tmp_path / "divergence.json")
+    cmd = [sys.executable, os.path.join(_REPO, "tools",
+                                        "elastic_run.py"),
+           "--workers", "2", "--demo", "--cpu", "--mode", "replace",
+           "--steps", "8", "--ckpt-every", "2", "--hb-timeout", "8",
+           "--collective-timeout", "6", "--grace", "12", "--out", out,
+           "--chaos", "dist.divergence@3:rank=1"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_RANKCHECK_WAIT_S="6")
+    env.pop("MXNET_CHAOS", None)
+    env.pop("MXNET_CHAOS_SPEC", None)
+    p = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=420, env=env)
+    assert p.returncode == 1, p.stdout[-3000:] + p.stderr[-2000:]
+    with open(out) as f:
+        rep = json.load(f)
+    assert rep["ok"] is False
+    assert rep["restarts"] == 0, rep
+    assert "divergence" in rep["error"]
+    epoch = rep["epochs"][0]
+    assert epoch["schedule_divergence"] is True
+    assert epoch["diverged_ranks"], epoch
+    assert RC_DIVERGENCE in [int(v) for v in epoch["rcs"].values()]

@@ -86,8 +86,11 @@ def _decode_mxcc(path: str):
 
 def _audit_offline(analysis, target: str, repl_bytes: int):
     """Audit a cache directory (``*.mxcc``) or a single module file.
-    Returns a list of ProgramAudit."""
+    Returns ``(audits, alias_skipped)`` — the ProgramAudits plus the
+    count of exec/alias-tier entries skipped for carrying no module
+    text."""
     audits = []
+    alias_skipped = 0
 
     def one(site: str, text: str):
         try:
@@ -120,7 +123,10 @@ def _audit_offline(analysis, target: str, repl_bytes: int):
                     site=site, parse_error=f"undecodable entry: {e}"))
                 continue
             if header.get("tier") != "stablehlo":
-                continue  # exec/alias tiers carry no module text
+                # exec/alias tiers carry no module text; COUNTED so the
+                # artifact says how much of the cache went unaudited
+                alias_skipped += 1
+                continue
             site = header.get("site") or site
             try:
                 text = payload.decode("utf-8")
@@ -132,7 +138,7 @@ def _audit_offline(analysis, target: str, repl_bytes: int):
     else:
         with open(target, "r", encoding="utf-8") as f:
             one(os.path.basename(target), f.read())
-    return audits
+    return audits, alias_skipped
 
 
 # ---------------------------------------------------------------------------
@@ -393,8 +399,9 @@ def main(argv=None) -> int:
         return 2
 
     analysis = _load_analysis()
-    audits = _audit_offline(analysis, args.target, args.repl_bytes)
-    doc = analysis.render_ir_json(audits)
+    audits, alias_skipped = _audit_offline(analysis, args.target,
+                                           args.repl_bytes)
+    doc = analysis.render_ir_json(audits, alias_skipped=alias_skipped)
     text = json.dumps(doc, indent=1, sort_keys=True) + "\n"
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
@@ -412,7 +419,8 @@ def main(argv=None) -> int:
         c = doc["counts"]
         print(f"mxir: {c['programs']} program(s), "
               f"{c['violations']} violation(s), "
-              f"{c['parse_skipped']} parse-skipped")
+              f"{c['parse_skipped']} parse-skipped, "
+              f"{c['alias_skipped']} alias-skipped")
     return 1 if doc["counts"]["violations"] else 0
 
 

@@ -84,7 +84,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_ARTIFACTS = ("FUSED_BENCH.json", "SCALING.json",
                      "SERVING_BENCH.json", "COMPILE_CACHE.json",
                      "HEALTH.json", "GOODPUT.json", "RESILIENCE.json",
-                     "AUTOTUNE.json", "INCIDENT.json", "MXIR.json")
+                     "AUTOTUNE.json", "INCIDENT.json", "MXIR.json",
+                     "MXRANK.json")
 
 _ATTRIBUTION_PATH = os.path.join(
     _REPO, "mxnet_tpu", "telemetry", "mxtriage", "attribution.py")
@@ -296,6 +297,21 @@ def _mxir(d) -> dict:
     return {"checks": c, "strict": True}
 
 
+def _mxrank(d) -> dict:
+    """MXRANK.json: the cross-rank schedule-verification gate, ALL
+    STRICT — MX019/MX020 repo-wide lint clean (no baseline; a
+    rank-divergent schedule is never grandfathered), the
+    fixture/ledger/reclassification units, and the 2-process chaos
+    e2e where a live divergence must classify as ScheduleDivergence
+    with zero restarts.  Any lane flipping to false fails the run."""
+    c = {}
+    if "gate_ok" in d:
+        c["gate_ok"] = bool(d["gate_ok"])
+    for check, ok in (d.get("checks") or {}).items():
+        c[f"checks.{check}"] = bool(ok)
+    return {"checks": c, "strict": True}
+
+
 EXTRACTORS = {
     "FUSED_BENCH.json": _fused,
     "SERVING_BENCH.json": _serving,
@@ -307,6 +323,7 @@ EXTRACTORS = {
     "AUTOTUNE.json": _autotune,
     "INCIDENT.json": _incident,
     "MXIR.json": _mxir,
+    "MXRANK.json": _mxrank,
 }
 
 

@@ -719,6 +719,51 @@ def main():
         mxir_rc = -1
         artifact["mxir"] = {"returncode": -1, "note": "timed out"}
 
+    # mxrank stage (ISSUE 20): cross-rank collective-schedule
+    # verification, both halves — the repo must lint CLEAN under
+    # MX019/MX020 strict (no baseline: a rank-divergent schedule is
+    # never grandfathered), the fixture/ledger/reclassification units
+    # must hold, and the slow 2-process chaos e2e must classify a live
+    # divergence as ScheduleDivergence with ZERO restarts.  Refreshes
+    # MXRANK.json, the tracked artifact perf_compare gates with
+    # STRICT lanes.  Runs BEFORE perf-compare so the diff is fresh.
+    mxrank_rc = None
+    try:
+        lint = subprocess.run(
+            [sys.executable, "tools/mxlint.py", "mxnet_tpu",
+             "--enable", "MX019,MX020"],
+            capture_output=True, text=True, timeout=600, cwd=_REPO,
+            env=cpu_env)
+        unit = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests/test_mxrank.py",
+             "-q", "-m", "not slow", "-p", "no:cacheprovider"],
+            capture_output=True, text=True, timeout=600, cwd=_REPO,
+            env=cpu_env)
+        e2e = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests/test_mxrank.py",
+             "-q", "-m", "slow", "-p", "no:cacheprovider"],
+            capture_output=True, text=True, timeout=900, cwd=_REPO,
+            env=cpu_env)
+        checks = {"lint_clean": lint.returncode == 0,
+                  "unit": unit.returncode == 0,
+                  "e2e_divergence": e2e.returncode == 0}
+        rep = {"gate_ok": all(checks.values()), "checks": checks,
+               "returncodes": {"lint": lint.returncode,
+                               "unit": unit.returncode,
+                               "e2e": e2e.returncode}}
+        with open(os.path.join(_REPO, "MXRANK.json"), "w") as f:
+            json.dump(rep, f, indent=1)
+        mxrank_rc = 0 if rep["gate_ok"] else 1
+        artifact["mxrank"] = {
+            "returncode": mxrank_rc, "gate_ok": rep["gate_ok"],
+            "checks": checks,
+            "lint_tail": "\n".join(lint.stdout.splitlines()[-2:]),
+            "unit_tail": "\n".join(unit.stdout.splitlines()[-2:]),
+            "e2e_tail": "\n".join(e2e.stdout.splitlines()[-2:])}
+    except subprocess.TimeoutExpired:
+        mxrank_rc = -1
+        artifact["mxrank"] = {"returncode": -1, "note": "timed out"}
+
     # perf-compare gate (ISSUE 10): the bench artifacts this nightly
     # just refreshed (FUSED/SCALING/COMPILE_CACHE/HEALTH; SERVING when
     # its strict lane rewrote it) vs the committed versions — >10%
@@ -756,7 +801,8 @@ def main():
         and mxprof_rc in (None, 0) and health_rc in (None, 0) \
         and triage_rc in (None, 0) and goodput_rc in (None, 0) \
         and autotune_rc in (None, 0) and blackbox_rc in (None, 0) \
-        and mxir_rc in (None, 0) and perf_rc in (None, 0) else 1
+        and mxir_rc in (None, 0) and mxrank_rc in (None, 0) \
+        and perf_rc in (None, 0) else 1
 
 
 if __name__ == "__main__":
